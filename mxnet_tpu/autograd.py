@@ -1,0 +1,295 @@
+"""Imperative autograd: record / backward / grad.
+
+Reference: python/mxnet/autograd.py (record:122, backward, grad,
+train/predict modes) and the C++ tape in src/imperative/imperative.cc
+(RecordOp, Backward:278, AGInfo include/mxnet/imperative.h:42).
+
+TPU-native design: the reference builds an nnvm graph of recorded ops
+and re-executes a generated backward graph.  Here each recorded op
+captures its ``jax.vjp`` closure at forward time (linearization with
+residuals held on device); ``backward()`` walks the tape in reverse,
+feeding cotangents through the vjp closures and accumulating into the
+``.grad`` buffers of marked variables.  The hot training path is meant
+to go through ``hybridize()`` (cached_op.py) where the *whole* step is
+one ``jax.grad``-transformed jitted function; this tape is the parity
+path for non-hybridized imperative code.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_grad",
+           "set_recording", "set_training"]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "v"):
+        _STATE.v = {"recording": False, "training": False, "tape": []}
+    return _STATE.v
+
+
+class AGNode:
+    """Autograd metadata attached to an NDArray (reference: AGInfo)."""
+
+    __slots__ = ("grad_req", "grad", "ct", "is_variable", "array_ref")
+
+    def __init__(self, grad_req=None, grad=None, is_variable=False):
+        self.grad_req = grad_req
+        self.grad = grad
+        self.ct = None
+        self.is_variable = is_variable
+        self.array_ref = None
+
+
+class _Entry:
+    __slots__ = ("in_nodes", "out_nodes", "vjp_fn", "out_avals")
+
+    def __init__(self, in_nodes, out_nodes, vjp_fn, out_avals):
+        self.in_nodes = in_nodes
+        self.out_nodes = out_nodes
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals
+
+
+# ---------------------------------------------------------------- scopes
+
+
+class _Scope:
+    def __init__(self, flag, value):
+        self._flag = flag
+        self._value = value
+        self._old = None
+
+    def __enter__(self):
+        st = _st()
+        self._old = st[self._flag]
+        st[self._flag] = self._value
+        return self
+
+    def __exit__(self, *a):
+        _st()[self._flag] = self._old
+
+
+class _DualScope:
+    def __init__(self, recording, training):
+        self._r = recording
+        self._t = training
+        self._old = None
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st["recording"], st["training"])
+        if self._r is not None:
+            st["recording"] = self._r
+        if self._t is not None:
+            st["training"] = self._t
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st["recording"], st["training"] = self._old
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — enable recording (+train mode)."""
+    return _DualScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _DualScope(False, train_mode)
+
+
+def train_mode():
+    return _DualScope(None, True)
+
+
+def predict_mode():
+    return _DualScope(None, False)
+
+
+def is_recording():
+    return _st()["recording"]
+
+
+def is_training():
+    return _st()["training"]
+
+
+def set_recording(flag):
+    st = _st()
+    old = st["recording"]
+    st["recording"] = bool(flag)
+    return old
+
+
+def set_training(flag):
+    st = _st()
+    old = st["training"]
+    st["training"] = bool(flag)
+    return old
+
+
+# ---------------------------------------------------------------- tape
+
+
+def _any_recorded(inputs):
+    from .ndarray.ndarray import NDArray
+
+    return any(isinstance(a, NDArray) and a._ag_node is not None for a in inputs)
+
+
+def record_op(inputs, outputs, vjp_fn):
+    """Append one op application to the tape (reference: RecordOp)."""
+    from .ndarray.ndarray import NDArray
+
+    in_nodes = [a._ag_node if isinstance(a, NDArray) else None for a in inputs]
+    out_nodes = []
+    for o in outputs:
+        node = AGNode()
+        o._ag_node = node
+        out_nodes.append(node)
+    out_avals = [(o.shape, o.dtype) for o in outputs]
+    _st()["tape"].append(_Entry(in_nodes, out_nodes, vjp_fn, out_avals))
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        node = AGNode(grad_req=req, grad=g, is_variable=True)
+        node.array_ref = v
+        v._ag_node = node
+
+
+def get_grad(x):
+    node = x._ag_node
+    if node is None or not node.is_variable:
+        return None
+    return node.grad
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from head arrays, accumulating into variable .grad.
+
+    Reference: MXAutogradBackwardEx → Imperative::Backward
+    (src/imperative/imperative.cc:278).
+    """
+    _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars=True)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional-style gradient (reference: autograd.grad)."""
+    if create_graph:
+        raise NotImplementedError(
+            "higher-order imperative grad: use hybridize() + jax.grad composition"
+        )
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    cts = _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars=False,
+                         want_nodes=[v._ag_node for v in variables])
+    from .ndarray.ndarray import NDArray
+
+    out = []
+    for v, ct in zip(variables, cts):
+        if ct is None:
+            raise MXNetError("one of the variables does not participate in the graph")
+        out.append(NDArray(ct, v._ctx))
+    return out
+
+
+def _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars, want_nodes=None):
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    st = _st()
+    tape = st["tape"]
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    for h, hg in zip(heads, head_grads):
+        node = h._ag_node
+        if node is None:
+            raise MXNetError("cannot differentiate: array is not in a recorded graph "
+                             "(is autograd.record() active and attach_grad called?)")
+        g = hg._data if isinstance(hg, NDArray) else (
+            hg if hg is not None else jnp.ones(h.shape, dtype=h.dtype))
+        node.ct = g if node.ct is None else node.ct + g
+
+    # reverse sweep
+    for entry in reversed(tape):
+        if all(n.ct is None for n in entry.out_nodes):
+            continue
+        cts = []
+        for n, (shape, dtype) in zip(entry.out_nodes, entry.out_avals):
+            cts.append(n.ct if n.ct is not None else jnp.zeros(shape, dtype=dtype))
+        ct_in = tuple(cts) if len(cts) > 1 else cts[0]
+        in_cts = entry.vjp_fn(ct_in)
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        for node, ct in zip(entry.in_nodes, in_cts):
+            if node is None or ct is None:
+                continue
+            node.ct = ct if node.ct is None else node.ct + ct
+
+    # deliver to variables
+    results = None
+    if accumulate_to_vars:
+        _deliver_variable_grads(tape, heads)
+    if want_nodes is not None:
+        results = [n.ct if n is not None else None for n in want_nodes]
+
+    # cleanup
+    if not retain_graph:
+        for entry in tape:
+            for n in entry.out_nodes:
+                n.ct = None
+        st["tape"] = []
+    else:
+        for entry in tape:
+            for n in entry.out_nodes:
+                if not n.is_variable:
+                    n.ct = None
+    _clear_variable_cts(tape, heads)
+    return results
+
+
+def _iter_all_nodes(tape, heads):
+    seen = set()
+    for entry in tape:
+        for n in entry.in_nodes + entry.out_nodes:
+            if n is not None and id(n) not in seen:
+                seen.add(id(n))
+                yield n
+    for h in heads:
+        if h._ag_node is not None and id(h._ag_node) not in seen:
+            seen.add(id(h._ag_node))
+            yield h._ag_node
+
+
+def _deliver_variable_grads(tape, heads):
+    from .ndarray.ndarray import NDArray
+
+    for n in _iter_all_nodes(tape, heads):
+        if n.is_variable and n.ct is not None and n.grad_req != "null":
+            if n.grad_req == "add":
+                n.grad._data = n.grad._data + n.ct
+            else:  # write
+                n.grad._data = n.ct
+
+
+def _clear_variable_cts(tape, heads):
+    for n in _iter_all_nodes(tape, heads):
+        n.ct = None

@@ -1,0 +1,187 @@
+"""Shared plumbing: errors, dtype mapping, name management, registries.
+
+Replaces the reference's ctypes bridge + dmlc registries
+(python/mxnet/base.py, include/dmlc/registry.h).  There is no C ABI to
+cross here — the "backend" is jax/XLA in-process — so this module keeps
+only the parts that shape the public API: MXNetError, dtype name↔numpy
+mapping (mirrors ``include/mxnet/tensor_blob.h`` / mshadow type codes),
+and the attribute/name scoping used by Symbol and Gluon.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as _np
+
+__all__ = ["MXNetError", "NameManager", "AttrScope", "string_types", "numeric_types"]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# mshadow type-code ↔ numpy mapping (reference: python/mxnet/base.py:480
+# _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP).  bfloat16 added as a first-class
+# citizen (code 12 matches the reference's mshadow bfloat16 slot).
+try:
+    import ml_dtypes as _mld
+
+    bfloat16 = _np.dtype(_mld.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+    _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6,
+    _np.dtype(_np.bool_): 7,
+    _np.dtype(_np.int16): 8,
+    _np.dtype(_np.uint16): 9,
+    _np.dtype(_np.uint32): 10,
+    _np.dtype(_np.uint64): 11,
+}
+if bfloat16 is not None:
+    _DTYPE_NP_TO_MX[bfloat16] = 12
+
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-ish (str, np.dtype, type, jnp dtype) to np.dtype."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        if bfloat16 is None:
+            raise MXNetError("bfloat16 requires ml_dtypes")
+        return bfloat16
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    d = np_dtype(dtype)
+    if bfloat16 is not None and d == bfloat16:
+        return "bfloat16"
+    return d.name
+
+
+class _ThreadLocalScope:
+    """Stack of scopes, thread-local, used by NameManager/AttrScope/others."""
+
+    _state = None  # subclass sets a threading.local
+
+    @classmethod
+    def current(cls):
+        if not hasattr(cls._state, "value") or not cls._state.value:
+            cls._state.value = [cls()]
+        return cls._state.value[-1]
+
+    def __enter__(self):
+        if not hasattr(type(self)._state, "value") or not type(self)._state.value:
+            type(self)._state.value = [type(self)()]
+        type(self)._state.value.append(self)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        type(self)._state.value.pop()
+
+
+class NameManager(_ThreadLocalScope):
+    """Autogenerates unique names for symbols/blocks.
+
+    Reference: python/mxnet/name.py NameManager — same counter-per-hint
+    behaviour so exported symbol JSON matches the reference naming scheme
+    (``convolution0``, ``fullyconnected1``, ...).
+    """
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+
+class AttrScope(_ThreadLocalScope):
+    """Attribute scoping for symbols (reference: python/mxnet/attribute.py).
+
+    ``with AttrScope(ctx_group='dev1'):`` attaches attrs to symbols created
+    inside — this is how the reference expresses manual model parallelism
+    (``group2ctx``, src/executor/graph_executor.cc:1628) and we keep the
+    same surface, mapping ctx_group onto sharding annotations instead.
+    """
+
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {str(k): str(v) for k, v in kwargs.items()}
+
+    def get(self, attr):
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+
+_SNAKE1 = re.compile(r"(.)([A-Z][a-z]+)")
+_SNAKE2 = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def camel_to_snake(name):
+    return _SNAKE2.sub(r"\1_\2", _SNAKE1.sub(r"\1_\2", name)).lower()
+
+
+class Registry:
+    """Minimal dmlc-style registry (include/dmlc/registry.h).
+
+    Used for metrics, initializers, optimizers, data iterators — anywhere
+    the reference exposes ``@register`` + ``create(name, **kwargs)``.
+    """
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._entries = {}
+
+    def register(self, obj, name=None):
+        name = (name or obj.__name__).lower()
+        self._entries[name] = obj
+        return obj
+
+    def alias(self, obj, *names):
+        for n in names:
+            self._entries[n.lower()] = obj
+        return obj
+
+    def find(self, name):
+        entry = self._entries.get(name.lower())
+        if entry is None:
+            raise MXNetError(
+                "%s %r is not registered; known: %s"
+                % (self._kind, name, sorted(self._entries))
+            )
+        return entry
+
+    def create(self, name, *args, **kwargs):
+        return self.find(name)(*args, **kwargs)
+
+    def entries(self):
+        return dict(self._entries)

@@ -1,0 +1,22 @@
+"""``mx.nd`` — imperative NDArray API (reference: python/mxnet/ndarray/)."""
+
+from .. import ops as _ops  # registers all operators
+from .ndarray import (NDArray, array, arange, concatenate, empty, full, load,
+                      moveaxis, ones, save, waitall, zeros,
+                      imperative_invoke)
+from .register import populate as _populate
+
+_populate(globals())
+
+# `stack` op func from registry shadows nothing; keep `stack_arrays` too
+from .ndarray import stack_arrays  # noqa: E402,F401
+
+from . import random  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+
+
+def onehot_encode(indices, out):
+    """reference: mx.nd.onehot_encode legacy helper."""
+    res = imperative_invoke("one_hot", [indices], {"depth": out.shape[1]})[0]
+    out._assign(res._data.astype(out.dtype))
+    return out

@@ -1,0 +1,765 @@
+"""NDArray — the imperative tensor, backed by a jax.Array in HBM.
+
+Reference: include/mxnet/ndarray.h:82 (class NDArray), src/ndarray/
+ndarray.cc, python/mxnet/ndarray/ndarray.py.
+
+TPU-native design notes:
+
+- The reference NDArray is a ref-counted Chunk(Storage::Handle + engine
+  var); ops are pushed to the async engine and the user thread never
+  blocks until an explicit sync (``asnumpy``/``wait_to_read``).  Here the
+  buffer is a ``jax.Array`` — XLA's async dispatch *is* the engine:
+  every op returns immediately with a future-backed array, and
+  ``asnumpy()``/``wait_to_read()`` are the sync points
+  (``jax.Array.block_until_ready``).  No re-implementation of
+  ThreadedEngine is needed or wanted (SURVEY.md §7 design stance).
+- NDArray is *mutable* at the Python level (``a[:] = x``, ``a += b``,
+  optimizer in-place updates): mutation rebinds the internal ``_data``
+  to a new functional value (``jax.Array.at[...]``), which XLA turns
+  into in-place donation where safe.  Basic-slice reads return a view
+  object carrying a writeback link to the base (parity with the
+  reference's Slice/At write-through views, ndarray.h:810).
+- Eager ops dispatch through the op registry's per-op jit cache
+  (ops/registry.py), so steady-state imperative code runs compiled
+  kernels; ``hybridize``/Symbol stage whole graphs instead.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype, numeric_types
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "save", "load", "waitall", "imperative_invoke",
+           "moveaxis", "stack_arrays"]
+
+# ops that consume an explicit PRNG key as first tensor input
+RANDOM_OPS = {
+    "_random_uniform", "_random_normal", "_random_gamma", "_random_exponential",
+    "_random_poisson", "_random_negative_binomial",
+    "_random_generalized_negative_binomial", "_random_randint",
+    "_sample_multinomial", "_sample_uniform", "_sample_normal", "_sample_gamma",
+    "_shuffle", "_sample_unique_zipfian",
+}
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class NDArray:
+    """An n-dimensional array on a device (TPU HBM by default)."""
+
+    __slots__ = ("_data", "_ctx", "_ag_node", "_writeback", "__weakref__")
+
+    # make numpy defer to NDArray in mixed expressions (np * nd)
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, _writeback=None):
+        self._data = data
+        self._ctx = ctx
+        self._ag_node = None
+        self._writeback = _writeback  # (base NDArray, index) for slice views
+
+    # ------------------------------------------------------------- basics
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+            platform = dev.platform
+        except Exception:
+            return current_context()
+        if platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def data_jax(self):
+        """The underlying jax.Array (TPU-native escape hatch)."""
+        return self._data
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            body = str(arr)
+        except Exception as e:  # async error surfaces at sync point
+            body = "<error: %s>" % e
+        return "%s\n<NDArray %s @%s>" % (body, "x".join(map(str, self.shape)), self.context)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy().item())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------- sync
+    def asnumpy(self):
+        """Copy to host, blocking until the value is ready.
+
+        Reference parity: the implicit engine sync point
+        (``NDArray::WaitToRead`` + copy, ndarray.h:359).
+        """
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # ------------------------------------------------------------- dtype/device
+    def astype(self, dtype, copy=True):
+        d = np_dtype(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return NDArray(self._data.astype(d), self._ctx)
+
+    def as_in_context(self, ctx):
+        import jax
+
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        """Copy into another NDArray/Context (reference: CopyFromTo,
+        src/ndarray/ndarray.cc:1186)."""
+        import jax
+
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), other)
+        if not isinstance(other, NDArray):
+            raise TypeError("copyto target must be NDArray or Context")
+        if other.shape != self.shape:
+            raise ValueError("copyto shape mismatch %s vs %s" % (self.shape, other.shape))
+        other._assign(jax.device_put(self._data.astype(other.dtype),
+                                     other.context.jax_device))
+        return other
+
+    def copy(self):
+        return NDArray(self._data + 0 if self.dtype != _np.bool_ else self._data,
+                       self._ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
+
+    @property
+    def stype(self):
+        return "default"
+
+    # ------------------------------------------------------------- mutation
+    def _assign(self, new_jax_value):
+        """Rebind the buffer; propagate through view writeback if present."""
+        from .. import autograd as _ag
+
+        if self._ag_node is not None and _ag.is_recording():
+            raise MXNetError(
+                "in-place write on an array participating in a recorded graph"
+            )
+        self._data = new_jax_value
+        if self._writeback is not None:
+            base, index = self._writeback
+            base._assign(base._data.at[index].set(new_jax_value))
+
+    def __setitem__(self, key, value):
+        jnp = _jnp()
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, numeric_types):
+            v = value
+        else:
+            v = jnp.asarray(value)
+        if key is None or key == slice(None):
+            if isinstance(v, (int, float)):
+                self._assign(jnp.full(self.shape, v, dtype=self.dtype))
+            else:
+                v = jnp.asarray(v, dtype=self.dtype)
+                self._assign(jnp.broadcast_to(v, self.shape) + 0)
+            return
+        key = _clean_index(key)
+        self._assign(self._data.at[key].set(v))
+
+    def __getitem__(self, key):
+        if key is None:
+            return NDArray(self._data[None], self._ctx)
+        ck = _clean_index(key)
+        if _is_basic_index(ck):
+            # basic index → view with writeback (reference Slice/At views)
+            return NDArray(self._data[ck], self._ctx, _writeback=(self, ck))
+        if isinstance(ck, NDArray):
+            ck = ck._data.astype("int32")
+        return NDArray(self._data[ck], self._ctx)
+
+    def slice(self, begin, end, step=None):
+        return imperative_invoke("slice", [self], {"begin": begin, "end": end,
+                                                   "step": step or ()})[0]
+
+    def slice_axis(self, axis, begin, end):
+        return imperative_invoke("slice_axis", [self],
+                                 {"axis": axis, "begin": begin, "end": end})[0]
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate gradient buffer and mark for autograd
+        (reference: python/mxnet/ndarray/ndarray.py attach_grad →
+        MXAutogradMarkVariables)."""
+        from .. import autograd as _ag
+
+        _ag.mark_variables([self], [zeros(self.shape, dtype=self.dtype,
+                                          ctx=self.context)], grad_req)
+
+    @property
+    def grad(self):
+        from .. import autograd as _ag
+
+        return _ag.get_grad(self)
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd as _ag
+
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- ops sugar
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return imperative_invoke("Reshape", [self],
+                                 {"shape": shape,
+                                  "reverse": kwargs.get("reverse", False)})[0]
+
+    def reshape_like(self, other):
+        return imperative_invoke("reshape_like", [self, other], {})[0]
+
+    def expand_dims(self, axis):
+        return imperative_invoke("expand_dims", [self], {"axis": axis})[0]
+
+    def squeeze(self, axis=None):
+        return imperative_invoke("squeeze", [self], {"axis": axis})[0]
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return imperative_invoke("transpose", [self], {"axes": axes})[0]
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return imperative_invoke("Flatten", [self], {})[0]
+
+    def flip(self, axis):
+        return imperative_invoke("reverse", [self], {"axis": axis})[0]
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return imperative_invoke("sum", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return imperative_invoke("mean", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def max(self, axis=None, keepdims=False):
+        return imperative_invoke("max", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def min(self, axis=None, keepdims=False):
+        return imperative_invoke("min", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def prod(self, axis=None, keepdims=False):
+        return imperative_invoke("prod", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def argmax(self, axis=None, keepdims=False):
+        return imperative_invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def argmin(self, axis=None, keepdims=False):
+        return imperative_invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})[0]
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return imperative_invoke("norm", [self], {"ord": ord, "axis": axis,
+                                                  "keepdims": keepdims})[0]
+
+    def abs(self):
+        return imperative_invoke("abs", [self], {})[0]
+
+    def sqrt(self):
+        return imperative_invoke("sqrt", [self], {})[0]
+
+    def square(self):
+        return imperative_invoke("square", [self], {})[0]
+
+    def exp(self):
+        return imperative_invoke("exp", [self], {})[0]
+
+    def log(self):
+        return imperative_invoke("log", [self], {})[0]
+
+    def sigmoid(self):
+        return imperative_invoke("sigmoid", [self], {})[0]
+
+    def tanh(self):
+        return imperative_invoke("tanh", [self], {})[0]
+
+    def relu(self):
+        return imperative_invoke("relu", [self], {})[0]
+
+    def softmax(self, axis=-1):
+        return imperative_invoke("softmax", [self], {"axis": axis})[0]
+
+    def log_softmax(self, axis=-1):
+        return imperative_invoke("log_softmax", [self], {"axis": axis})[0]
+
+    def clip(self, a_min, a_max):
+        return imperative_invoke("clip", [self], {"a_min": a_min, "a_max": a_max})[0]
+
+    def round(self):
+        return imperative_invoke("round", [self], {})[0]
+
+    def sign(self):
+        return imperative_invoke("sign", [self], {})[0]
+
+    def sort(self, axis=-1, is_ascend=True):
+        return imperative_invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})[0]
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return imperative_invoke("argsort", [self], {"axis": axis,
+                                                     "is_ascend": is_ascend})[0]
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        out = imperative_invoke("topk", [self], {"axis": axis, "k": k,
+                                                 "ret_typ": ret_typ,
+                                                 "is_ascend": is_ascend})
+        return out if len(out) > 1 else out[0]
+
+    def take(self, indices, axis=0, mode="clip"):
+        return imperative_invoke("take", [self, _as_nd(indices)],
+                                 {"axis": axis, "mode": mode})[0]
+
+    def one_hot(self, depth, **kw):
+        return imperative_invoke("one_hot", [self], {"depth": depth, **kw})[0]
+
+    def broadcast_to(self, shape):
+        return imperative_invoke("broadcast_to", [self], {"shape": shape})[0]
+
+    def broadcast_like(self, other):
+        return imperative_invoke("broadcast_like", [self, other], {})[0]
+
+    def tile(self, reps):
+        return imperative_invoke("tile", [self], {"reps": reps})[0]
+
+    def repeat(self, repeats, axis=None):
+        return imperative_invoke("repeat", [self], {"repeats": repeats, "axis": axis})[0]
+
+    def pad(self, mode="constant", pad_width=(), constant_value=0.0):
+        return imperative_invoke("Pad", [self], {"mode": mode, "pad_width": pad_width,
+                                                 "constant_value": constant_value})[0]
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return imperative_invoke("SliceChannel", [self],
+                                 {"num_outputs": num_outputs, "axis": axis,
+                                  "squeeze_axis": squeeze_axis})
+
+    def diag(self, k=0):
+        return imperative_invoke("diag", [self], {"k": k})[0]
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return imperative_invoke("dot", [self, other],
+                                 {"transpose_a": transpose_a,
+                                  "transpose_b": transpose_b})[0]
+
+    # ------------------------------------------------------------- arithmetic
+    def _binop(self, other, opname, scalarname, reverse=False):
+        if isinstance(other, NDArray):
+            args = [other, self] if reverse else [self, other]
+            return imperative_invoke(opname, args, {})[0]
+        if isinstance(other, numeric_types):
+            sname = scalarname
+            if reverse and "_r" + scalarname[1:] in _SCALAR_REV:
+                sname = "_r" + scalarname[1:]
+            return imperative_invoke(sname, [self], {"scalar": float(other)})[0]
+        return self._binop(array(other, ctx=self.context), opname, scalarname, reverse)
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke("negative", [self], {})[0]
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._assign(out._data)
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._assign(out._data)
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._assign(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._assign(out._data)
+        return self
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+
+_SCALAR_REV = {"_rminus_scalar", "_rdiv_scalar", "_rmod_scalar", "_rpower_scalar"}
+
+
+def _clean_index(key):
+    """Convert NDArray indices inside a key to jax arrays."""
+    if isinstance(key, NDArray):
+        return key._data.astype("int32")
+    if isinstance(key, tuple):
+        return tuple(
+            k._data.astype("int32") if isinstance(k, NDArray) else k for k in key
+        )
+    if isinstance(key, (list, _np.ndarray)):
+        return _np.asarray(key, dtype=_np.int32)
+    return key
+
+
+def _is_basic_index(key):
+    if isinstance(key, (int, slice)) or key is Ellipsis:
+        return True
+    if isinstance(key, tuple):
+        return all(isinstance(k, (int, slice)) or k is Ellipsis for k in key)
+    return False
+
+
+def _as_nd(x, ctx=None):
+    if isinstance(x, NDArray):
+        return x
+    return array(x, ctx=ctx)
+
+
+# ----------------------------------------------------------------- dispatch
+
+
+def imperative_invoke(op_name, inputs, attrs, out=None):
+    """The imperative dispatch path.
+
+    Reference analog: MXImperativeInvokeEx → Imperative::Invoke
+    (src/c_api/c_api_ndarray.cc:132, src/imperative/imperative.cc) —
+    shape/type inference, engine push, and autograd recording in one.
+    Here: unwrap → (jit-cached) pure fn → wrap, with jax.vjp capture when
+    autograd is recording.
+    """
+    from .. import autograd as _ag
+
+    op = _reg.get(op_name)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    attrs = op.canonicalize_attrs(attrs)
+
+    arrays = [a._data if isinstance(a, NDArray) else a for a in inputs]
+    ctx = None
+    for a in inputs:
+        if isinstance(a, NDArray):
+            ctx = a._ctx
+            break
+
+    needs_key = op_name in RANDOM_OPS
+    if op_name == "Dropout":
+        # training-mode gate (reference: dropout.cc runs only in train pass)
+        if attrs.get("mode", "training") == "always" or _ag.is_training():
+            needs_key = True  # key=... kwarg threaded below
+        else:
+            return _wrap_outputs((arrays[0],), ctx, out)
+
+    if needs_key:
+        from ..random import next_key
+
+        arrays = [next_key()] + arrays
+
+    recording = _ag.is_recording() and _ag._any_recorded(inputs)
+    if recording:
+        import jax
+
+        fn = op.bind_attrs(attrs)
+        if needs_key:
+            outv, vjp_fn = _vjp_with_aux(fn, arrays)
+        else:
+            outv, vjp_fn = jax.vjp(fn, *arrays)
+        result = outv if isinstance(outv, tuple) else (outv,)
+        out_nds = _wrap_outputs(result, ctx, out)
+        _ag.record_op(inputs, out_nds, vjp_fn)
+        return out_nds
+
+    if needs_key:
+        # keys vary per call → bypass the static jit cache (jax still
+        # compiles the underlying primitives)
+        result = op.bind_attrs(attrs)(*arrays)
+    else:
+        result = op.jitted(attrs)(*arrays)
+    result = result if isinstance(result, tuple) else (result,)
+    return _wrap_outputs(result, ctx, out)
+
+
+def _vjp_with_aux(fn, arrays):
+    """vjp over (key, *tensors): drop the key cotangent."""
+    import jax
+
+    outv, vjp_all = jax.vjp(fn, *arrays)
+
+    def vjp_fn(ct):
+        grads = vjp_all(ct)
+        return grads[1:]  # drop key cotangent
+
+    return outv, vjp_fn
+
+
+def _wrap_outputs(result, ctx, out=None):
+    nds = [NDArray(r, ctx) for r in result]
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, nds):
+            dst._assign(src._data)
+        return list(outs)
+    return nds
+
+
+# ----------------------------------------------------------------- creation
+
+
+def array(source, ctx=None, dtype=None):
+    import jax
+
+    if isinstance(source, NDArray):
+        src = source.asnumpy()
+    else:
+        src = _np.asarray(source)
+    if dtype is None:
+        dtype = src.dtype if src.dtype != _np.float64 else _np.float32
+        if src.dtype == _np.int64 and not isinstance(source, _np.ndarray):
+            dtype = src.dtype
+    src = src.astype(np_dtype(dtype))
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(src, ctx.jax_device), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ctx = ctx or current_context()
+    jnp = _jnp()
+    with jax.default_device(ctx.jax_device):
+        d = jnp.zeros(shape, dtype=np_dtype(dtype))
+    return NDArray(d, ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ctx = ctx or current_context()
+    jnp = _jnp()
+    with jax.default_device(ctx.jax_device):
+        d = jnp.ones(shape, dtype=np_dtype(dtype))
+    return NDArray(d, ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    import jax
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    ctx = ctx or current_context()
+    jnp = _jnp()
+    with jax.default_device(ctx.jax_device):
+        d = jnp.full(shape, val, dtype=np_dtype(dtype or "float32"))
+    return NDArray(d, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    return imperative_invoke("_arange", [],
+                             {"start": start, "stop": stop, "step": step,
+                              "repeat": repeat, "dtype": dtype})[0]
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return imperative_invoke("Concat", list(arrays), {"dim": axis})[0]
+
+
+def stack_arrays(arrays, axis=0):
+    return imperative_invoke("stack", list(arrays), {"axis": axis})[0]
+
+
+def moveaxis(tensor, source, destination):
+    jnp = _jnp()
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+
+
+def waitall():
+    """Block until all async computation completes
+    (reference: MXNDArrayWaitAll)."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+# ----------------------------------------------------------------- save/load
+
+_MAGIC = b"MXTPU001"
+
+
+def save(fname, data):
+    """Serialize NDArrays (reference: src/ndarray/ndarray.cc Save/Load,
+    mx.nd.save — dict or list of arrays).  Format: npz under the hood."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+        _np.savez(_ensure_ext(fname), __format__="dict", **arrays)
+    elif isinstance(data, (list, tuple)):
+        arrays = {"arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
+        _np.savez(_ensure_ext(fname), __format__="list", **arrays)
+    else:
+        raise TypeError("save expects NDArray, list or dict")
+    import os
+
+    if os.path.exists(fname + ".npz") and not fname.endswith(".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def _ensure_ext(fname):
+    return fname
+
+
+def load(fname, ctx=None):
+    data = _np.load(fname if _np.lib.format.read_magic else fname, allow_pickle=False)
+    try:
+        fmt = str(data["__format__"])
+    except KeyError:
+        fmt = "dict"
+    if fmt == "list":
+        n = len([k for k in data.files if k.startswith("arr_")])
+        return [array(data["arr_%d" % i], ctx=ctx) for i in range(n)]
+    return {k: array(v, ctx=ctx) for k, v in data.items() if k != "__format__"}

@@ -1,0 +1,69 @@
+"""``mx.nd.random`` — random sampling functions
+(reference: python/mxnet/ndarray/random.py)."""
+
+from __future__ import annotations
+
+from .ndarray import NDArray, imperative_invoke
+
+
+def _sample(opname, shape, dtype, ctx, kwargs, tensors=()):
+    attrs = {"shape": (shape,) if isinstance(shape, int) else tuple(shape or (1,)),
+             "dtype": dtype or "float32"}
+    attrs.update(kwargs)
+    return imperative_invoke(opname, list(tensors), attrs)[0]
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(low, NDArray):
+        return _sample("_sample_uniform", shape if shape != (1,) else (), dtype, ctx,
+                       {}, tensors=(low, high))
+    return _sample("_random_uniform", shape, dtype, ctx, {"low": low, "high": high})
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(loc, NDArray):
+        return _sample("_sample_normal", shape if shape != (1,) else (), dtype, ctx,
+                       {}, tensors=(loc, scale))
+    return _sample("_random_normal", shape, dtype, ctx, {"loc": loc, "scale": scale})
+
+
+randn = normal
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    if isinstance(alpha, NDArray):
+        return _sample("_sample_gamma", shape if shape != (1,) else (), dtype, ctx,
+                       {}, tensors=(alpha, beta))
+    return _sample("_random_gamma", shape, dtype, ctx, {"alpha": alpha, "beta": beta})
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample("_random_exponential", shape, dtype, ctx, {"lam": 1.0 / scale})
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample("_random_poisson", shape, dtype, ctx, {"lam": lam})
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample("_random_negative_binomial", shape, dtype, ctx, {"k": k, "p": p})
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype=None, ctx=None,
+                                  **kwargs):
+    return _sample("_random_generalized_negative_binomial", shape, dtype, ctx,
+                   {"mu": mu, "alpha": alpha})
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, **kwargs):
+    return _sample("_random_randint", shape, dtype, ctx, {"low": low, "high": high})
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
+    attrs = {"shape": (shape,) if isinstance(shape, int) else tuple(shape),
+             "get_prob": get_prob, "dtype": dtype}
+    return imperative_invoke("_sample_multinomial", [data], attrs)[0]
+
+
+def shuffle(data, **kwargs):
+    return imperative_invoke("_shuffle", [data], {})[0]

@@ -1,0 +1,141 @@
+"""Sparse NDArray storage types: row_sparse and CSR.
+
+Reference: include/mxnet/ndarray.h:61 (NDArrayStorageType),
+python/mxnet/ndarray/sparse.py, src/operator/tensor/cast_storage-inl.h.
+
+TPU-native stance: XLA has no first-class sparse buffers; row_sparse is
+represented as (indices, values) host-side metadata over dense jax
+arrays and converts to dense at op boundaries (XLA scatter/gather).
+This gives API parity for embedding/optimizer flows
+(``row_sparse_pull``); kernels stay dense-MXU friendly.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from .ndarray import NDArray, array, imperative_invoke, zeros as _dense_zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_stype", "_aux")
+
+    @property
+    def stype(self):
+        return self._stype
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: (indices into dim0, values for those rows)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        import jax.numpy as jnp
+
+        dense = jnp.zeros(shape, dtype=data.dtype).at[indices].set(data)
+        super().__init__(dense, ctx)
+        self._stype = "row_sparse"
+        self._aux = (indices, data)
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[0], self._ctx)
+
+    @property
+    def data(self):
+        return NDArray(self._aux[1], self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cast row_sparse→%s unsupported" % stype)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax.numpy as jnp
+
+        dense = _np.zeros(shape, dtype=_np.asarray(data).dtype)
+        d = _np.asarray(data)
+        ind = _np.asarray(indices).astype(_np.int64)
+        ptr = _np.asarray(indptr).astype(_np.int64)
+        for row in range(shape[0]):
+            lo, hi = ptr[row], ptr[row + 1]
+            dense[row, ind[lo:hi]] = d[lo:hi]
+        super().__init__(jnp.asarray(dense), ctx)
+        self._stype = "csr"
+        self._aux = (d, ind, ptr)
+
+    @property
+    def data(self):
+        return array(self._aux[0], ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return array(self._aux[1], ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return array(self._aux[2], ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cast csr→%s unsupported" % stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        import jax.numpy as jnp
+
+        d = jnp.asarray(_np.asarray(data, dtype=np_dtype(dtype)))
+        i = jnp.asarray(_np.asarray(indices, dtype=_np.int64))
+        return RowSparseNDArray(d, i, shape, ctx)
+    dense = _np.asarray(arg1, dtype=np_dtype(dtype))
+    nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    import jax.numpy as jnp
+
+    return RowSparseNDArray(jnp.asarray(dense[nz]), jnp.asarray(nz),
+                            dense.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indices, indptr, shape, ctx)
+    dense = _np.asarray(arg1, dtype=np_dtype(dtype))
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = _np.where(row != 0)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(data, dtype=dense.dtype),
+                      _np.asarray(indices), _np.asarray(indptr), dense.shape, ctx)
+
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        return NDArray(arr._data, arr._ctx)
+    if stype == "row_sparse":
+        dense = arr.asnumpy()
+        return row_sparse_array(dense, shape=dense.shape, ctx=arr._ctx,
+                                dtype=dense.dtype)
+    if stype == "csr":
+        dense = arr.asnumpy()
+        return csr_matrix(dense, shape=dense.shape, ctx=arr._ctx, dtype=dense.dtype)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    z = _np.zeros(shape, dtype=np_dtype(dtype))
+    return cast_storage(array(z, ctx=ctx), stype)

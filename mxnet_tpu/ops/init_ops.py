@@ -1,0 +1,180 @@
+"""Creation ops: zeros/ones/full/arange/eye and random samplers.
+
+Reference: src/operator/tensor/init_op.cc, src/operator/random/
+(sample_op.cc multinomial_op.cc unique_sample_op.cc) and
+include/mxnet/random_generator.h.
+
+Random ops take an explicit PRNG ``key`` argument (pure functions); the
+NDArray layer threads keys from the global/trace-scoped generator in
+mxnet_tpu/random.py — the TPU-native replacement for the reference's
+per-device RNG resource (src/resource.cc kRandom).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+@register("_zeros", aliases=("zeros",))
+def zeros(shape=(), dtype="float32", **_):
+    return jnp.zeros(tuple(shape), dtype=np_dtype(dtype))
+
+
+@register("_ones", aliases=("ones",))
+def ones(shape=(), dtype="float32", **_):
+    return jnp.ones(tuple(shape), dtype=np_dtype(dtype))
+
+
+@register("_full", aliases=("full",))
+def full(shape=(), value=0.0, dtype="float32", **_):
+    return jnp.full(tuple(shape), value, dtype=np_dtype(dtype))
+
+
+@register("zeros_like")
+def zeros_like(x, **_):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x, **_):
+    return jnp.ones_like(x)
+
+
+@register("_arange", aliases=("arange",))
+def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("_linspace", aliases=("linspace",))
+def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", **_):
+    return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint),
+                        dtype=np_dtype(dtype))
+
+
+@register("_eye", aliases=("eye",))
+def eye(N=1, M=0, k=0, dtype="float32", **_):
+    m = int(M) if M else int(N)
+    return jnp.eye(int(N), m, k=int(k), dtype=np_dtype(dtype))
+
+
+# ------------------------------------------------------------------- random
+
+# All samplers: fn(key, [dist-param tensors...], shape=..., dtype=...)
+
+
+@register("_random_uniform", aliases=("random_uniform", "uniform"))
+def random_uniform(key, low=0.0, high=1.0, shape=(1,), dtype="float32", **_):
+    d = np_dtype(dtype)
+    return jax.random.uniform(key, tuple(shape), dtype=d, minval=low, maxval=high)
+
+
+@register("_random_normal", aliases=("random_normal", "normal"))
+def random_normal(key, loc=0.0, scale=1.0, shape=(1,), dtype="float32", **_):
+    d = np_dtype(dtype)
+    return jax.random.normal(key, tuple(shape), dtype=d) * scale + loc
+
+
+@register("_random_gamma", aliases=("random_gamma",))
+def random_gamma(key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", **_):
+    d = np_dtype(dtype)
+    return jax.random.gamma(key, alpha, tuple(shape), dtype=d) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",))
+def random_exponential(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    d = np_dtype(dtype)
+    return jax.random.exponential(key, tuple(shape), dtype=d) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",))
+def random_poisson(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    out = jax.random.poisson(key, lam, tuple(shape))
+    return out.astype(np_dtype(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",))
+def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype="float32", **_):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), tuple(shape)) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(np_dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",))
+def random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", **_):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, tuple(shape)) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, tuple(shape)).astype(np_dtype(dtype))
+
+
+@register("_random_randint", aliases=("random_randint", "randint"))
+def random_randint(key, low=0, high=1, shape=(1,), dtype="int32", **_):
+    return jax.random.randint(key, tuple(shape), int(low), int(high),
+                              dtype=np_dtype(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",))
+def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32", **_):
+    n = int(shape[0]) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+    if not shape:
+        out = out.squeeze(-1) if out.ndim > 1 else out[0]
+    return out.astype(np_dtype(dtype))
+
+
+@register("_sample_uniform", aliases=("sample_uniform",))
+def sample_uniform(key, low, high, shape=(), dtype="float32", **_):
+    d = np_dtype(dtype)
+    tail = tuple(shape) if shape else ()
+    u = jax.random.uniform(key, low.shape + tail, dtype=d)
+    low = low.reshape(low.shape + (1,) * len(tail))
+    high = high.reshape(high.shape + (1,) * len(tail))
+    return low + u * (high - low)
+
+
+@register("_sample_normal", aliases=("sample_normal",))
+def sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
+    d = np_dtype(dtype)
+    tail = tuple(shape) if shape else ()
+    z = jax.random.normal(key, mu.shape + tail, dtype=d)
+    mu = mu.reshape(mu.shape + (1,) * len(tail))
+    sigma = sigma.reshape(sigma.shape + (1,) * len(tail))
+    return mu + z * sigma
+
+
+@register("_sample_gamma", aliases=("sample_gamma",))
+def sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
+    d = np_dtype(dtype)
+    tail = tuple(shape) if shape else ()
+    alpha_b = alpha.reshape(alpha.shape + (1,) * len(tail))
+    g = jax.random.gamma(key, jnp.broadcast_to(alpha_b, alpha.shape + tail), dtype=d)
+    beta = beta.reshape(beta.shape + (1,) * len(tail))
+    return g * beta
+
+
+@register("_shuffle", aliases=("shuffle",))
+def shuffle(key, data, **_):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian")
+def sample_unique_zipfian(key, range_max=1, shape=(1,), **_):
+    # approximate: log-uniform samples (used by sampled softmax candidates)
+    n = int(shape[-1]) if shape else 1
+    u = jax.random.uniform(key, (n,))
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    return jnp.clip(out, 0, int(range_max) - 1)

@@ -1,0 +1,645 @@
+"""Neural-network operators: conv, FC, norm, pooling, activation, softmax.
+
+Reference: src/operator/nn/ (convolution.cc, fully_connected.cc:239-328,
+batch_norm.cc, pooling.cc, activation.cc, softmax.cc, dropout.cc,
+layer_norm.cc, lrn.cc, upsampling.cc, deconvolution.cc) plus the cuDNN
+specializations under src/operator/nn/cudnn/.
+
+TPU-first notes:
+- Convolution/FullyConnected lower to ``lax.conv_general_dilated`` /
+  ``dot_general`` → the MXU.  Layout stays NCHW at the API (reference
+  default); XLA relayouts internally for the TPU (it prefers NHWC and
+  does this transformation for free during layout assignment).
+- BatchNorm is functional: returns (out, mean, var); running-stat
+  updates are performed by the caller (gluon/nn/basic_layers.py) so the
+  op stays pure/traceable.  Cross-device sync BN uses lax.pmean when
+  running under shard_map (see parallel/).
+- Dropout takes an explicit PRNG key input (op purity) — the NDArray
+  layer threads keys from mxnet_tpu.random.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import register
+
+
+def _tup(v, n):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    return t
+
+
+def _conv_dn(nd):
+    # (lhs, rhs, out) specs for 1/2/3-D NC* layouts
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_dimension_numbers((0,) * (nd + 2), (0,) * (nd + 2), (lhs, rhs, lhs))
+
+
+@register("Convolution", aliases=("conv",))
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                num_filter=1, num_group=1, no_bias=False, layout=None, cudnn_off=False,
+                cudnn_tune=None, workspace=1024, **_):
+    """N-D convolution (reference: src/operator/nn/convolution.cc).
+
+    cudnn_*/workspace attrs are accepted for API parity and ignored —
+    XLA picks the TPU conv algorithm.
+    """
+    nd = len(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad else (0,) * nd
+    dn = _conv_dn(nd)
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=None,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                  adj=(), target_shape=(), num_filter=1, num_group=1, no_bias=True,
+                  layout=None, **_):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+
+    Implemented as the gradient of convolution via lhs-dilation, which XLA
+    maps back onto the MXU."""
+    nd = len(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad else (0,) * nd
+    adj = _tup(adj, nd) if adj else (0,) * nd
+    kernel = _tup(kernel, nd)
+    # weight layout in MXNet deconv: (in_c, out_c/group, *kernel)
+    dn = _conv_dn(nd)
+    eff_k = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    padding = [(ek - 1 - p, ek - 1 - p + a) for ek, p, a in zip(eff_k, pad, adj)]
+    # flip spatial dims + swap in/out channels → standard transposed conv
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape((int(num_group), ic // int(num_group), ocg) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((ocg * int(num_group), ic // int(num_group)) + w.shape[3:])
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("FullyConnected", aliases=("fc",))
+def fully_connected(data, weight, bias=None, num_hidden=1, no_bias=False, flatten=True, **_):
+    """reference: src/operator/nn/fully_connected.cc:239-328."""
+    if flatten:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        x = data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+@register("Activation")
+def activation(data, act_type="relu", **_):
+    f = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+    }[act_type]
+    return f(data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, **_):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if data.ndim > 1 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data > 0, data, alpha * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        # eval-mode rrelu (mean slope); training rrelu needs RNG — use Dropout-style key path
+        return jnp.where(data > 0, data, (lower_bound + upper_bound) / 2.0 * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None, **_):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        steps = jnp.arange(x.shape[int(axis)])
+        shape = [1] * x.ndim
+        shape[int(axis)] = -1
+        mask = steps.reshape(shape) < jnp.expand_dims(length, int(axis))
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=int(axis))
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, **_):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register("softmin")
+def softmin(data, axis=-1, **_):
+    return jax.nn.softmax(-data, axis=int(axis))
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance", **_):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, smooth_alpha):
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+@jax.custom_vjp
+def _softmax_output(data, label, grad_scale, ignore_label, multi_output,
+                    use_ignore, normalization, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                               use_ignore, normalization, smooth_alpha)
+
+
+def _softmax_output_vjp_fwd(data, label, grad_scale, ignore_label, multi_output,
+                            use_ignore, normalization, smooth_alpha):
+    out = _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                              use_ignore, normalization, smooth_alpha)
+    return out, (out, label, grad_scale, ignore_label, multi_output, use_ignore,
+                 normalization, smooth_alpha)
+
+
+def _softmax_output_vjp_bwd(res, g):
+    (out, label, grad_scale, ignore_label, multi_output, use_ignore,
+     normalization, smooth_alpha) = res
+    axis = 1 if multi_output else -1
+    ncls = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, ncls, dtype=out.dtype, axis=axis)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / (ncls - 1) * (1.0 - onehot)
+    grad = out - onehot
+    if use_ignore:
+        keep = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(keep, axis)
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid":
+        if use_ignore:
+            valid = jnp.maximum(jnp.sum((lab != int(ignore_label)).astype(out.dtype)), 1.0)
+        else:
+            valid = float(_np.prod(lab.shape))
+        scale = scale / valid
+    grad = grad * scale
+    # out grad ignores incoming cotangent by design (reference semantics:
+    # SoftmaxOutput *is* the loss layer; incoming head grad is all-ones)
+    return (grad.astype(out.dtype), jnp.zeros_like(label), None, None, None, None, None, None)
+
+
+_softmax_output.defvjp(_softmax_output_vjp_fwd, _softmax_output_vjp_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                   use_ignore=False, preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, **_):
+    """Softmax forward with fused cross-entropy backward
+    (reference: src/operator/softmax_output.cc — the Module-API loss layer)."""
+    return _softmax_output(data, label, float(grad_scale), float(ignore_label),
+                           bool(multi_output), bool(use_ignore), normalization,
+                           float(smooth_alpha))
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label, **_):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0, **_):
+    return _regression_out(data, label, grad_scale, "linear")
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0, **_):
+    return _regression_out(data, label, grad_scale, "mae")
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0, **_):
+    return _regression_out(data, label, grad_scale, "logistic")
+
+
+@jax.custom_vjp
+def _regression_core(data, label, grad_scale, kind):
+    return jax.nn.sigmoid(data) if kind == "logistic" else data
+
+
+def _regression_fwd(data, label, grad_scale, kind):
+    out = jax.nn.sigmoid(data) if kind == "logistic" else data
+    return out, (out, label, grad_scale, kind, data.shape)
+
+
+def _regression_bwd(res, g):
+    out, label, grad_scale, kind, shape = res
+    label = label.reshape(shape)
+    num = shape[1] if len(shape) > 1 else 1
+    if kind == "mae":
+        grad = jnp.sign(out - label)
+    else:  # linear & logistic share (pred - label)
+        grad = out - label
+    grad = grad * (grad_scale / num)
+    return (grad.astype(out.dtype), jnp.zeros_like(label), None, None)
+
+
+_regression_core.defvjp(_regression_fwd, _regression_bwd)
+
+
+def _regression_out(data, label, grad_scale, kind):
+    return _regression_core(data, label, float(grad_scale), kind)
+
+
+# ---------------------------------------------------------------- norm layers
+
+
+@register("BatchNorm", num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
+               cudnn_off=False, **_):
+    """Functional BatchNorm (reference: src/operator/nn/batch_norm.cc).
+
+    Returns (out, batch_mean, batch_var).  The Gluon layer / executor
+    updates moving stats outside (keeps the op pure → traceable); when
+    ``use_global_stats`` (inference) the moving stats are used directly.
+    """
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if use_global_stats:
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.mean(jnp.square(data - _expand(mean, ax, data.ndim)), axis=red)
+    inv = lax.rsqrt(var + eps)
+    out = (data - _expand(mean, ax, data.ndim)) * _expand(g * inv, ax, data.ndim) \
+        + _expand(beta, ax, data.ndim)
+    return out, mean, var
+
+
+def _expand(v, axis, ndim):
+    shape = [1] * ndim
+    shape[axis] = -1
+    return v.reshape(shape)
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **_):
+    ax = int(axis)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3, **_):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **_):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **_):
+    """Local response norm across channels (reference: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = int(nsize) // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    window = sum(
+        lax.slice_in_dim(sq, i, i + data.shape[1], axis=1) for i in range(int(nsize))
+    )
+    return data / jnp.power(knorm + alpha / nsize * window, beta)
+
+
+# ---------------------------------------------------------------- pooling
+
+
+@register("Pooling")
+def pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=False,
+            pooling_convention="valid", count_include_pad=True, cudnn_off=False,
+            p_value=2, layout=None, **_):
+    """reference: src/operator/nn/pooling.cc — max/avg/sum/lp pooling,
+    'valid' (floor) vs 'full' (ceil) conventions, global pooling."""
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd) if stride else (1,) * nd
+    pad = _tup(pad, nd) if pad else (0,) * nd
+
+    padding = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            # ceil convention: possibly extra padding on the high side
+            size = data.shape[2 + i]
+            out_sz = -(-(size + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
+            hi = max(needed, pad[i])
+        padding.append((lo, hi))
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+
+    if pool_type == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+        return out
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad and pooling_convention != "full":
+            denom = float(_np.prod(kernel))
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p = float(p_value)
+        powed = lax.reduce_window(jnp.power(jnp.abs(data), p), 0.0, lax.add,
+                                  window, strides, padding)
+        return jnp.power(powed, 1.0 / p)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0, **_):
+    """reference: src/operator/roi_pooling.cc — fixed-size output so it
+    stays jittable (static shapes)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    H, W = data.shape[2], data.shape[3]
+
+    def pool_one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bidx]  # (C, H, W)
+
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(i, j):
+            hstart = y1 + (i * rh) // ph
+            hend = y1 + ((i + 1) * rh + ph - 1) // ph
+            wstart = x1 + (j * rw) // pw
+            wend = x1 + ((j + 1) * rw + pw - 1) // pw
+            m = ((ys[:, None] >= hstart) & (ys[:, None] < hend)
+                 & (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(m[None], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        cells = jnp.stack([jnp.stack([cell(i, j) for j in range(pw)], -1)
+                           for i in range(ph)], -2)  # (C, ph, pw)
+        return cells
+
+    return jax.vmap(pool_one)(rois)
+
+
+# ---------------------------------------------------------------- dropout
+
+
+@register("Dropout")
+def dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False, **_):
+    """reference: src/operator/nn/dropout.cc.  ``key`` is an explicit PRNG
+    key threaded by the NDArray layer (mxnet_tpu/random.py) so the op is
+    pure; in 'always' mode or outside autograd training scope the caller
+    passes key=None → identity."""
+    if key is None or p <= 0.0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(data.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------- resize/upsample
+
+
+@register("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=512, **_):
+    data = args[0]
+    s = int(scale)
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+        if len(args) > 1 and multi_input_mode == "concat":
+            outs = [out]
+            for a in args[1:]:
+                ss = data.shape[2] * s // a.shape[2]
+                outs.append(jnp.repeat(jnp.repeat(a, ss, axis=2), ss, axis=3))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+    # bilinear upsampling uses a deconv in the reference; use jax.image
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * s, w * s), method="bilinear")
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, **_):
+    """reference: src/operator/bilinear_sampler.cc (STN sampler)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yy, xx):
+        yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return img[:, yy, xx]
+
+    def sample_one(img, y0_, x0_, wy_, wx_):
+        v00 = gather(img, y0_, x0_)
+        v01 = gather(img, y0_, x0_ + 1)
+        v10 = gather(img, y0_ + 1, x0_)
+        v11 = gather(img, y0_ + 1, x0_ + 1)
+        return (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+
+    return jax.vmap(sample_one)(data, y0, x0, wy, wx)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0), **_):
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        theta = data.reshape((-1, 2, 3))
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)  # (3, h*w)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (n, 2, h*w)
+        return out.reshape((-1, 2, h, w))
+    # warp type: data is (n, 2, h, w) flow
+    n = data.shape[0]
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    fx = (data[:, 0] + gx) * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+    fy = (data[:, 1] + gy) * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+    return jnp.stack([fx, fy], axis=1)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                        sampler_type="bilinear", **_):
+    grid = grid_generator(loc, transform_type="affine", target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first", **_):
+    """CTC loss (reference: src/operator/contrib/ctc_loss.cc, 3rdparty/ctc_include).
+
+    data: (seq, batch, alphabet) activations (pre-softmax).
+    Uses a lax.scan forward algorithm in log space.
+    """
+    seq_len, batch, alphabet = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else alphabet - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "last":
+        pass  # labels already 0-based
+    max_lab = lab.shape[1]
+    if label_lengths is not None and use_label_lengths:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # reference: 0 (or -1) padding marks end when blank is 'first'
+        valid = (lab > 0) if blank == 0 else (lab >= 0)
+        lab_len = jnp.sum(valid.astype(jnp.int32), axis=1)
+    if data_lengths is not None and use_data_lengths:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((batch,), seq_len, dtype=jnp.int32)
+
+    # extended label sequence with blanks: length 2L+1
+    ext_len = 2 * max_lab + 1
+    pos = jnp.arange(ext_len)
+    ext = jnp.where(pos % 2 == 0, blank, lab[:, jnp.minimum(pos // 2, max_lab - 1)])
+    neg_inf = jnp.asarray(-1e30, dtype=logp.dtype)
+
+    alpha0 = jnp.full((batch, ext_len), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0, jnp.take_along_axis(logp[0], first_lab[:, None], 1)[:, 0], neg_inf))
+
+    def step(alpha, t):
+        lp = logp[t]  # (batch, alphabet)
+        emit = jnp.take_along_axis(lp, ext, axis=1)  # (batch, ext_len)
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((batch, 1), neg_inf), alpha[:, :-1]], 1)
+        a_shift2 = jnp.concatenate([jnp.full((batch, 2), neg_inf), alpha[:, :-2]], 1)
+        same = (ext == jnp.concatenate([jnp.full((batch, 2), -1, dtype=jnp.int32),
+                                        ext[:, :-2]], 1))
+        is_blank = ext == blank
+        allow2 = ~(is_blank | same)
+        cand = jnp.where(allow2, jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2),
+                         jnp.logaddexp(a_prev, a_shift1))
+        new_alpha = cand + emit
+        # freeze past data length
+        active = (t < dat_len)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return new_alpha, None
+
+    alphaT, _unused = lax.scan(step, alpha0, jnp.arange(1, seq_len))
+    end1 = 2 * lab_len
+    end2 = 2 * lab_len - 1
+    p1 = jnp.take_along_axis(alphaT, end1[:, None], 1)[:, 0]
+    p2 = jnp.where(lab_len > 0,
+                   jnp.take_along_axis(alphaT, jnp.maximum(end2, 0)[:, None], 1)[:, 0],
+                   neg_inf)
+    return -jnp.logaddexp(p1, p2)

@@ -1,0 +1,118 @@
+"""Reduction and broadcast-to operator family.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc and
+broadcast_reduce_op_index.cc (sum/mean/prod/max/min/norm/argmax/argmin,
+broadcast_to/broadcast_axis).  MXNet axis semantics preserved: ``axis``
+may be int, tuple or None; ``keepdims``; ``exclude`` reduces over all
+axes *not* listed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(name, jf):
+    @register(name, aliases=("%s_axis" % name,))
+    def _op(x, axis=None, keepdims=False, exclude=False, **_):
+        axes = _norm_axis(axis, x.ndim, exclude)
+        return jf(x, axis=axes, keepdims=bool(keepdims))
+
+    return _op
+
+
+for _name, _jf in [
+    ("sum", jnp.sum),
+    ("mean", jnp.mean),
+    ("prod", jnp.prod),
+    ("max", jnp.max),
+    ("min", jnp.min),
+    ("nansum", jnp.nansum),
+    ("nanprod", jnp.nanprod),
+]:
+    _make_reduce(_name, _jf)
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False, **_):
+    axes = None if axis is None else _norm_axis(axis, x.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=bool(keepdims)))
+
+
+def _index_reduce(name, jf):
+    @register(name)
+    def _op(x, axis=None, keepdims=False, **_):
+        if axis is None:
+            out = jf(x.reshape(-1), axis=0)
+            if keepdims:
+                out = out.reshape((1,) * x.ndim)
+            return out.astype(jnp.float32)
+        out = jf(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+        # reference returns float32 indices (mshadow legacy)
+        return out.astype(jnp.float32)
+
+    return _op
+
+
+_index_reduce("argmax", jnp.argmax)
+_index_reduce("argmin", jnp.argmin)
+
+
+@register("argmax_channel")
+def argmax_channel(x, **_):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=None, **_):
+    # MXNet: 0 in target shape means "keep source dim"
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=(), **_):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like")
+def broadcast_like(x, y, lhs_axes=None, rhs_axes=None, **_):
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, y.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = y.shape[ra]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("cumsum")
+def cumsum(x, axis=None, dtype=None, **_):
+    from ..base import np_dtype
+
+    d = np_dtype(dtype) if dtype is not None else None
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1), dtype=d)
+    return jnp.cumsum(x, axis=int(axis), dtype=d)

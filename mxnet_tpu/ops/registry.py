@@ -1,0 +1,131 @@
+"""Operator registry — TPU-native replacement for the nnvm op registry.
+
+Reference: include/mxnet/op_attr_types.h (FCompute:263, FComputeEx:273),
+nnvm ``NNVM_REGISTER_OP`` and the per-op attribute tables consumed by
+``src/imperative/imperative.cc`` and ``src/executor/graph_executor.cc``.
+
+Design (TPU-first): an operator here is a *pure jax function*
+``fn(*tensor_inputs, **attrs) -> jax.Array | tuple``.  That single pure
+function replaces the reference's whole per-op attribute bundle:
+
+- shape/type inference  → ``jax.eval_shape`` on the same fn
+- FCompute cpu/gpu      → XLA lowers the fn for any backend
+- FGradient             → ``jax.vjp`` of the same fn
+- kernel tuning/fusion  → XLA fusion (+ Pallas kernels where we override)
+
+Eager dispatch jits each op keyed on (attrs, input avals) via
+``jax.jit(..., static_argnames=...)`` so imperative NDArray calls hit a
+compiled executable after the first call — this is the analog of the
+reference engine's cached ThreadedOpr path (src/engine/threaded_engine.h).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["Op", "register", "get", "list_ops", "apply_op"]
+
+_OP_REGISTRY: dict[str, "Op"] = {}
+
+
+def _hashable(v):
+    """Normalize attr values to hashable, canonical forms."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, _np.ndarray):
+        return tuple(v.ravel().tolist()) if v.size < 64 else v.tobytes()
+    if isinstance(v, _np.generic):
+        return v.item()
+    return v
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (reference-compatible, e.g. 'Convolution').
+    fn : pure function ``fn(*arrays, **attrs)``.
+    num_outputs : static output count, or a callable(attrs)->int.
+    """
+
+    def __init__(self, name, fn, num_outputs=1, aliases=(), defaults=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.aliases = tuple(aliases)
+        self.defaults = dict(defaults or {})
+        self._jit_cache = {}
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+    def canonicalize_attrs(self, attrs):
+        out = dict(self.defaults)
+        out.update(attrs)
+        return {k: _hashable(v) for k, v in out.items()}
+
+    def bind_attrs(self, attrs):
+        """A pure fn of tensors only, with attrs closed over (for vjp/trace)."""
+        fn = self.fn
+        return functools.partial(fn, **attrs)
+
+    def jitted(self, attrs):
+        """Compiled entry point for eager dispatch, cached per attr-set."""
+        key = tuple(sorted(attrs.items()))
+        entry = self._jit_cache.get(key)
+        if entry is None:
+            entry = jax.jit(self.bind_attrs(attrs))
+            self._jit_cache[key] = entry
+        return entry
+
+    def nout(self, attrs):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+
+def register(name, num_outputs=1, aliases=(), **defaults):
+    """Decorator: register a pure jax function as an operator.
+
+    ``@register("dot", aliases=["Dot"])``
+    """
+
+    def deco(fn):
+        op = Op(name, fn, num_outputs=num_outputs, aliases=aliases, defaults=defaults)
+        _OP_REGISTRY[name] = op
+        for a in aliases:
+            _OP_REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def get(name):
+    op = _OP_REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("Operator %r is not registered" % (name,))
+    return op
+
+
+def list_ops():
+    return sorted(set(o.name for o in _OP_REGISTRY.values()))
+
+
+def apply_op(name, *arrays, **attrs):
+    """Eagerly apply a registered op to raw jax arrays."""
+    op = get(name)
+    attrs = op.canonicalize_attrs(attrs)
+    try:
+        return op.jitted(attrs)(*arrays)
+    except TypeError:
+        # attrs that fail jit staging (e.g. unhashable leftovers) fall back
+        # to op-by-op eager tracing
+        return op.bind_attrs(attrs)(*arrays)

@@ -1,0 +1,81 @@
+"""PRNG management — TPU-native replacement for the reference RNG resource.
+
+Reference: include/mxnet/random_generator.h, src/resource.cc (kRandom /
+kParallelRandom resources), python/mxnet/random.py (mx.random.seed).
+
+Design: a process-global counter-based key chain (jax threefry).  Eager
+ops call ``next_key()`` for a fresh key.  Inside a CachedOp/Executor
+trace, a :class:`TraceRNG` scope is active instead: keys derive from a
+*traced* seed input by ``fold_in`` of a per-trace counter, so compiled
+graphs get fresh randomness every call without retracing — the analog of
+the reference passing the RNG resource into kernels at run time rather
+than build time.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+__all__ = ["seed", "next_key", "TraceRNG", "get_state"]
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "rng"):
+        _state.rng = {"seed": _np.random.randint(0, 2**31 - 1), "counter": 0}
+    return _state.rng
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the framework RNG (reference: python/mxnet/random.py:seed).
+
+    Also seeds numpy-side shuffling used by data iterators.
+    """
+    g = _global()
+    g["seed"] = int(seed_state)
+    g["counter"] = 0
+
+
+class TraceRNG:
+    """Scope active while tracing a graph: keys derive from a traced seed."""
+
+    _active = threading.local()
+
+    def __init__(self, key_tracer):
+        self.key = key_tracer
+        self.counter = 0
+
+    def __enter__(self):
+        stack = getattr(TraceRNG._active, "stack", None)
+        if stack is None:
+            stack = TraceRNG._active.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *a):
+        TraceRNG._active.stack.pop()
+
+    @classmethod
+    def current(cls):
+        stack = getattr(cls._active, "stack", None)
+        return stack[-1] if stack else None
+
+
+def next_key():
+    """A fresh PRNG key (eager) or traced derived key (inside a trace)."""
+    import jax
+
+    tr = TraceRNG.current()
+    if tr is not None:
+        tr.counter += 1
+        return jax.random.fold_in(tr.key, tr.counter)
+    g = _global()
+    g["counter"] += 1
+    return jax.random.fold_in(jax.random.PRNGKey(g["seed"]), g["counter"])
+
+
+def get_state():
+    return dict(_global())
