@@ -1,0 +1,314 @@
+"""Executor — stages a Symbol graph into jitted XLA computations.
+
+Reference: include/mxnet/executor.h, src/executor/graph_executor.cc
+(GraphExecutor::Init:298, RunOps:1347, Forward:64, Backward:77).
+
+TPU-native design (SURVEY.md §7): instead of nnvm memory planning +
+engine-cached oprs, ``make_eval_fn`` topologically evaluates the DAG as
+one pure jax function and jits it — XLA does scheduling/fusion/memory
+planning.  Forward+backward are fused into a single compiled computation
+(the analog of the reference's bulked segments, graph_executor.cc:1187):
+``forward(is_train=True)`` is *lazy*; the fused fwd+bwd executable runs
+at ``backward()``, so one batch costs exactly one XLA launch.
+
+BatchNorm moving stats: the graph returns updated aux values as extra
+outputs (pure function), and the executor writes them back — replacing
+the reference's in-op mutable aux state (batch_norm.cc).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops import registry as _reg
+from .ops.registry import OP_AUX_INPUTS, OP_INPUT_NAMES
+from .random import TraceRNG
+
+__all__ = ["Executor", "make_eval_fn"]
+
+_RANDOM_OP_NAMES = None
+
+
+def _random_ops():
+    global _RANDOM_OP_NAMES
+    if _RANDOM_OP_NAMES is None:
+        from .ndarray.ndarray import RANDOM_OPS
+
+        _RANDOM_OP_NAMES = set(RANDOM_OPS) | {"Dropout"}
+    return _RANDOM_OP_NAMES
+
+
+def make_eval_fn(symbol, is_train):
+    """Build ``fn(arg_vals, aux_vals, seed) -> (outputs, new_aux)``.
+
+    Pure and jittable; seed feeds a TraceRNG so dropout/random ops get
+    fresh randomness per call without retracing.
+    """
+    nodes = symbol._topo_nodes()
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    aux_ids = symbol._aux_nodes()
+    out_entries = list(symbol._outputs)
+
+    def fn(arg_vals, aux_vals, seed):
+        import jax
+
+        arg_map = dict(zip(arg_names, arg_vals))
+        aux_map = dict(zip(aux_names, aux_vals))
+        new_aux = dict(aux_map)
+        values = {}
+
+        key = jax.random.PRNGKey(seed)
+        with TraceRNG(key):
+            from .random import next_key
+
+            for node in nodes:
+                if node.is_variable:
+                    if id(node) in aux_ids:
+                        values[id(node)] = (aux_map[node.name],)
+                    else:
+                        values[id(node)] = (arg_map[node.name],)
+                    continue
+                in_vals = [values[id(inp)][idx] for inp, idx in node.inputs]
+                op = _reg.get(node.op)
+                attrs = dict(node.attrs)
+                if node.op == "BatchNorm":
+                    out = _eval_batchnorm(node, in_vals, attrs, is_train,
+                                          new_aux)
+                elif node.op == "Dropout":
+                    if is_train or attrs.get("mode") == "always":
+                        out = op.fn(next_key(), *in_vals, **attrs)
+                    else:
+                        out = in_vals[0]
+                elif node.op in _random_ops():
+                    out = op.fn(next_key(), *in_vals, **attrs)
+                else:
+                    out = op.fn(*in_vals, **attrs)
+                values[id(node)] = out if isinstance(out, tuple) else (out,)
+
+        outputs = [values[id(n)][idx] for n, idx in out_entries]
+        return outputs, [new_aux[n] for n in aux_names]
+
+    meta = {"arg_names": arg_names, "aux_names": aux_names}
+    return fn, meta
+
+
+def _eval_batchnorm(node, in_vals, attrs, is_train, new_aux):
+    """BatchNorm with functional moving-stat update."""
+    op = _reg.get("BatchNorm")
+    use_global = (not is_train) or attrs.get("use_global_stats", False)
+    want_mv = attrs.get("output_mean_var", False)
+    attrs = dict(attrs)
+    attrs["use_global_stats"] = use_global
+    attrs["output_mean_var"] = True
+    out, mean, var = op.fn(*in_vals, **attrs)
+    if not use_global:
+        momentum = attrs.get("momentum", 0.9)
+        input_names = OP_INPUT_NAMES["BatchNorm"]
+        for (inp, _), iname in zip(node.inputs, input_names):
+            if inp.is_variable and iname in OP_AUX_INPUTS["BatchNorm"]:
+                stat = mean if iname == "moving_mean" else var
+                old = new_aux.get(inp.name)
+                if old is not None:
+                    new_aux[inp.name] = momentum * old + (1.0 - momentum) * stat
+    if want_mv:
+        return (out, mean, var)
+    return out
+
+
+class Executor:
+    """Bound executor (reference: executor.py Executor / GraphExecutor)."""
+
+    def __init__(self, symbol, ctx, arg_arrays, grad_dict, grad_req, aux_arrays,
+                 shared_buffer=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_arrays = list(arg_arrays)
+        self.aux_arrays = list(aux_arrays)
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.grad_req = grad_req
+        self.grad_dict = dict(grad_dict)
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
+        self._fns = {}  # (is_train, mode) -> jitted callables
+        self._outputs = None
+        self._fwd_state = None  # (arg jax vals, aux jax vals, seed)
+        self._monitor_callback = None
+        self._seed_counter = _np.random.randint(0, 2**31 - 1)
+
+    # ------------------------------------------------------------- dicts
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in aux states" % name)
+
+    # ------------------------------------------------------------- compile
+    def _get_fns(self, is_train):
+        import jax
+
+        key = is_train
+        if key in self._fns:
+            return self._fns[key]
+        fn, _meta = make_eval_fn(self._symbol, is_train)
+
+        fwd = jax.jit(fn)
+
+        diff_idx = [i for i, n in enumerate(self._arg_names)
+                    if self.grad_req.get(n, "write") != "null"]
+
+        def fwd_bwd(arg_vals, aux_vals, seed, out_grads):
+            diff_vals = [arg_vals[i] for i in diff_idx]
+
+            def wrt(diff_vals_):
+                full = list(arg_vals)
+                for i, v in zip(diff_idx, diff_vals_):
+                    full[i] = v
+                outs, new_aux = fn(full, aux_vals, seed)
+                return outs, new_aux
+
+            (outs, new_aux), vjp = jax.vjp(wrt, diff_vals)
+            import jax.numpy as jnp
+
+            og = [g if g is not None else jnp.ones_like(o)
+                  for g, o in zip(out_grads, outs)]
+            zero_aux = [jnp.zeros_like(a) for a in new_aux]
+            (dargs,) = vjp((og, zero_aux))
+            return outs, new_aux, dargs
+
+        bwd = jax.jit(fwd_bwd)
+        self._fns[key] = (fwd, bwd, diff_idx)
+        return self._fns[key]
+
+    # ------------------------------------------------------------- running
+    def forward(self, is_train=False, **kwargs):
+        """Lazy in train mode (fused with backward); eager in eval.
+
+        reference: executor.py forward → MXExecutorForward."""
+        if kwargs:
+            import jax
+
+            dev = self._ctx.jax_device if self._ctx is not None else None
+            for name, arr in kwargs.items():
+                if name not in self.arg_dict:
+                    raise MXNetError("unknown argument %r" % name)
+                dst = self.arg_dict[name]
+                if isinstance(arr, NDArray):
+                    val = arr.astype(dst.dtype)._data
+                    if dev is not None:
+                        val = jax.device_put(val, dev)
+                    dst._assign(val)
+                else:
+                    dst[:] = arr
+        self._seed_counter += 1
+        arg_vals = [a._data for a in self.arg_arrays]
+        aux_vals = [a._data for a in self.aux_arrays]
+        self._fwd_state = (arg_vals, aux_vals, self._seed_counter, is_train)
+        self._outputs = None
+        if not is_train:
+            self._materialize()
+        return self.outputs
+
+    def _materialize(self):
+        if self._outputs is not None or self._fwd_state is None:
+            return
+        arg_vals, aux_vals, seed, is_train = self._fwd_state
+        fwd, _bwd, _d = self._get_fns(is_train)
+        outs, new_aux = fwd(arg_vals, aux_vals, seed)
+        self._set_outputs(outs, new_aux)
+
+    def _set_outputs(self, outs, new_aux):
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        for arr, new in zip(self.aux_arrays, new_aux):
+            arr._assign(new)
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self._outputs):
+                self._monitor_callback(name, out)
+
+    @property
+    def outputs(self):
+        self._materialize()
+        return self._outputs if self._outputs is not None else []
+
+    def backward(self, out_grads=None, is_train=True):
+        """Fused fwd+bwd executable; writes grads per grad_req
+        (reference: MXExecutorBackwardEx)."""
+        if self._fwd_state is None:
+            raise MXNetError("backward() called before forward(is_train=True)")
+        arg_vals, aux_vals, seed, was_train = self._fwd_state
+        if not was_train:
+            raise MXNetError("backward requires forward(is_train=True)")
+        _fwd, bwd, diff_idx = self._get_fns(True)
+        n_out = len(self._symbol._outputs)
+        if out_grads is None:
+            ogs = [None] * n_out
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = [g._data if isinstance(g, NDArray) else g for g in out_grads]
+        outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
+        if self._outputs is None:
+            self._set_outputs(outs, new_aux)
+        for i, g in zip(diff_idx, dargs):
+            name = self._arg_names[i]
+            garr = self.grad_dict.get(name)
+            if garr is None:
+                continue
+            if self.grad_req.get(name, "write") == "add":
+                garr._assign(garr._data + g)
+            else:
+                garr._assign(g)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes (reference: executor.py reshape).
+        jit caches per-shape, so this is just fresh arrays."""
+        from .ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        for name, arr, shape in zip(self._arg_names, self.arg_arrays, arg_shapes):
+            if tuple(arr.shape) == tuple(shape):
+                new_args.append(arr)
+            else:
+                new_args.append(zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+        grad_dict = {n: zeros(s, ctx=self._ctx)
+                     for n, s in zip(self._arg_names, arg_shapes)
+                     if self.grad_req.get(n, "write") != "null"}
+        aux = [a if tuple(a.shape) == tuple(s) else zeros(s, ctx=self._ctx)
+               for a, s in zip(self.aux_arrays, aux_shapes)]
+        return Executor(self._symbol, self._ctx, new_args, grad_dict,
+                        self.grad_req, aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self._symbol.list_outputs())]
+        for node in self._symbol._topo_nodes():
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append("Op:%s, Name=%s" % (node.op, node.name))
+        return "\n".join(lines)

@@ -1,0 +1,525 @@
+"""Data iterators.
+
+Reference: python/mxnet/io/io.py (DataIter:178, DataBatch, NDArrayIter:489,
+MXDataIter:788) and the C++ iterator chain in src/io/ (parser →
+augmenter → BatchLoader iter_batchloader.h:42 → PrefetcherIter
+iter_prefetcher.h:47).
+
+TPU-native notes: batches are assembled host-side in numpy (cheap) and
+shipped to HBM once per batch (single device_put — the analog of the
+reference's PrefetcherIter double buffering is PrefetchingIter below,
+which overlaps host assembly with device compute using a background
+thread; XLA async dispatch overlaps the copy).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (_np.float32, "NCHW")
+
+
+class DataBatch:
+    """One batch (reference: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter:
+    """Base iterator (reference: io.py:178)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (reference: ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (reference: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h — dmlc::ThreadedIter double buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, capacity=2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter == 1, "only one iterator is supported (parity w/ ref)"
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = iters[0].batch_size
+        self._queue = _queue.Queue(maxsize=capacity)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return self.iters[0].provide_data
+        return [DataDesc(self.rename_data[0].get(d.name, d.name), d.shape, d.dtype)
+                if isinstance(d, DataDesc) else d for d in self.iters[0].provide_data]
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return self.iters[0].provide_label
+        return [DataDesc(self.rename_label[0].get(l.name, l.name), l.shape, l.dtype)
+                if isinstance(l, DataDesc) else l for l in self.iters[0].provide_label]
+
+    def _start(self):
+        self._stop.clear()
+
+        def worker():
+            try:
+                for batch in self.iters[0]:
+                    if self._stop.is_set():
+                        return
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        # drain
+        while self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.01)
+            except _queue.Empty:
+                pass
+        self._thread.join()
+        while not self._queue.empty():
+            self._queue.get()
+        self.iters[0].reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data/label argument into list of (name, numpy) pairs."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py:489)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.idx = _np.arange(self.num_data)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cache_idx = None
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.idx = self.idx[:new_n]
+            self.num_data = new_n
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                -self.batch_size < self.cursor < 0:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data
+        end = min(self.cursor + self.batch_size, self.num_data)
+        sel = self.idx[self.cursor:end]
+        if len(sel) < self.batch_size:  # pad by wrapping
+            pad = self.batch_size - len(sel)
+            sel = _np.concatenate([sel, self.idx[:pad]])
+        return [array(v[sel]) for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[self.cursor:end]
+
+
+def _read_idx_images(path):
+    with open(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "bad MNIST image file"
+        return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    with open(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "bad MNIST label file"
+        return _np.frombuffer(f.read(), dtype=_np.uint8)
+
+
+def _synthetic_mnist(n, seed=0):
+    """Deterministic MNIST-like synthetic digits (this container has zero
+    egress, so real MNIST may be absent).  Digits are separable: class k
+    lights up a distinct 7x7 quadrant pattern + noise, so models actually
+    converge — good enough for convergence tests mirroring
+    tests/python/train in the reference."""
+    rng = _np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(_np.uint8)
+    imgs = rng.rand(n, 28, 28).astype(_np.float32) * 0.2
+    for k in range(10):
+        mask = labels == k
+        r, c = divmod(k, 4)
+        imgs[mask, r * 7:(r + 1) * 7, c * 7:(c + 1) * 7] += 0.8
+    return (imgs * 255).astype(_np.uint8), labels
+
+
+class MNISTIter(DataIter):
+    """MNIST source iterator (reference: src/io/iter_mnist.cc:260).
+
+    Reads idx files when present at `image`/`label` paths; falls back to
+    deterministic synthetic digits (zero-egress container).
+    """
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, seed=0,
+                 silent=False, num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        if os.path.exists(image) and os.path.exists(label):
+            imgs = _read_idx_images(image)
+            labels = _read_idx_labels(label)
+        else:
+            n = 6000 if "train" in str(image) else 1000
+            imgs, labels = _synthetic_mnist(n, seed=0 if "train" in str(image) else 1)
+        imgs = imgs.astype(_np.float32) / 255.0
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.reshape(len(imgs), 1, 28, 28)
+        # dist-data-parallel sharding (reference: iter_mnist num_parts/part_index)
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            labels = labels[part_index::num_parts]
+        self._inner = NDArrayIter(imgs, labels.astype(_np.float32),
+                                  batch_size=batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class CSVIter(DataIter):
+    """CSV source iterator (reference: src/io/iter_csv.cc:218)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        else:
+            label = _np.zeros((data.shape[0],), dtype=_np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  shuffle=False,
+                                  last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (reference: src/io/iter_image_recordio_2.cc:748).
+
+    Decodes a RecordIO file of packed images (recordio.py format),
+    applies basic augmentation (crop/mirror/mean), assembles NCHW batches.
+    JPEG decode uses PIL if available, raw arrays otherwise.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1, label_width=1,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 rand_crop=False, rand_mirror=False, num_parts=1, part_index=0,
+                 preprocess_threads=4, **kwargs):
+        super().__init__(batch_size)
+        from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
+        self._records = []
+        rec = MXRecordIO(path_imgrec, "r")
+        while True:
+            item = rec.read()
+            if item is None:
+                break
+            self._records.append(item)
+        rec.close()
+        if num_parts > 1:
+            self._records = self._records[part_index::num_parts]
+        self._unpack_img = unpack_img
+        self.shuffle = shuffle
+        self._order = _np.arange(len(self._records))
+        self.cursor = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape, _np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape, _np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self._order)
+        self.cursor = 0
+
+    def _augment(self, img):
+        c, h, w = self.data_shape
+        if img.shape[0] != h or img.shape[1] != w:
+            if self.rand_crop and img.shape[0] >= h and img.shape[1] >= w:
+                y = _np.random.randint(0, img.shape[0] - h + 1)
+                x = _np.random.randint(0, img.shape[1] - w + 1)
+                img = img[y:y + h, x:x + w]
+            else:  # center crop / pad
+                img = _center_fit(img, h, w)
+        if self.rand_mirror and _np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.astype(_np.float32)
+        if self.mean.any():
+            img = img - self.mean
+        return img.transpose(2, 0, 1)  # HWC→CHW
+
+    def iter_next(self):
+        return self.cursor + self.batch_size <= len(self._records)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        datas = []
+        labels = []
+        for i in range(self.batch_size):
+            item = self._records[self._order[self.cursor + i]]
+            header, img = self._unpack_img(item)
+            datas.append(self._augment(img))
+            lab = header.label
+            labels.append(float(lab) if _np.isscalar(lab) or lab.ndim == 0
+                          else _np.asarray(lab, dtype=_np.float32))
+        self.cursor += self.batch_size
+        data = array(_np.stack(datas))
+        label = array(_np.asarray(labels, dtype=_np.float32))
+        return DataBatch(data=[data], label=[label], pad=0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _center_fit(img, h, w):
+    out = _np.zeros((h, w) + img.shape[2:], dtype=img.dtype)
+    sy = max((img.shape[0] - h) // 2, 0)
+    sx = max((img.shape[1] - w) // 2, 0)
+    dy = max((h - img.shape[0]) // 2, 0)
+    dx = max((w - img.shape[1]) // 2, 0)
+    ch = min(h, img.shape[0])
+    cw = min(w, img.shape[1])
+    out[dy:dy + ch, dx:dx + cw] = img[sy:sy + ch, sx:sx + cw]
+    return out

@@ -1,0 +1,4 @@
+"""``mx.kv`` — KVStore (reference: include/mxnet/kvstore.h, src/kvstore/)."""
+
+from .kvstore import KVStore, create  # noqa: F401
+from .gradient_compression import GradientCompression  # noqa: F401

@@ -1,0 +1,50 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: src/kvstore/gradient_compression.{h,cc,cu} (kTwoBit:38) —
+values are quantized to {-threshold, 0, +threshold}; the quantization
+residual is kept worker-side and added to the next gradient (error
+feedback), so compression error does not accumulate.
+
+TPU note: the actual bit-packing of the reference (16 2-bit values per
+float) matters for ZMQ wire size; here the "wire" is ICI/DCN handled by
+XLA, so we keep the *numerics* (quantize→dequantize with residual) in
+one fused jitted kernel — int8/fp8 grad allreduce is the production
+path (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _two_bit_round_trip(grad, residual, threshold):
+    g = grad + residual
+    pos = (g >= threshold).astype(grad.dtype)
+    neg = (g <= -threshold).astype(grad.dtype)
+    out = pos * threshold - neg * threshold
+    new_residual = g - out
+    return out, new_residual
+
+
+class GradientCompression:
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def get_params(self):
+        return {"type": "2bit", "threshold": self.threshold}
+
+    def compress_decompress(self, key, grad):
+        """Quantize+dequantize with per-key residual (error feedback)."""
+        from ..ndarray import NDArray
+
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros(grad.shape, dtype=grad.dtype)
+        out, new_res = _two_bit_round_trip(grad._data, res,
+                                           jnp.asarray(self.threshold,
+                                                       dtype=grad.dtype))
+        self._residuals[key] = new_res
+        return NDArray(out, grad._ctx)

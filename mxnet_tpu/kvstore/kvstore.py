@@ -1,0 +1,287 @@
+"""KVStore — the parameter-synchronisation façade.
+
+Reference: include/mxnet/kvstore.h:59-442, src/kvstore/kvstore_local.h:69,
+comm.h (CommCPU/CommDevice/CommDeviceTree), kvstore_nccl.h, kvstore_dist.h.
+
+TPU-native design: there is no parameter server and no NCCL — reduction
+is either trivial (single process: sum the pushed list, one fused XLA
+kernel) or an ``lax.psum`` over the device mesh inside the jitted train
+step (kvstore type 'tpu'; see mxnet_tpu/parallel/).  The KVStore *API*
+(init/push/pull/set_optimizer/rank/num_workers/barrier) is kept verbatim
+so Module/Trainer code written against the reference runs unchanged:
+
+- 'local' / 'device' / 'nccl' / 'tpu'  → in-process store; push sums
+  across the per-device gradient copies (the reference's Comm::Reduce,
+  comm.h:57) and runs the updater if set.
+- 'dist_sync' / 'dist_async' → multi-process via ``jax.distributed``
+  when launched under tools/launch.py (DMLC_* env parity); cross-worker
+  reduction uses a host-level allreduce over the process group.  On a
+  single process they degrade to 'local' with num_workers=1.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array, zeros
+from ..optimizer import Optimizer, get_updater
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.cc:40 factory)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl", "tpu"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_async", "dist_sync_device", "dist_device_sync"):
+        return DistKVStore(name)
+    raise MXNetError("unknown KVStore type %r" % name)
+
+
+class KVStore:
+    """Single-process store (reference: KVStoreLocal, kvstore_local.h:69)."""
+
+    def __init__(self, type_name="local"):
+        self._type = type_name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._str_keys = set()
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # ------------------------------------------------------------- core
+    def _canon(self, key):
+        return key
+
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce pushed values per key; apply updater if set
+        (reference: KVStoreLocal::PushImpl → Comm::Reduce comm.h:57)."""
+        keys, values = _key_value_list(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                from ..ndarray import imperative_invoke
+
+                merged = imperative_invoke("add_n", list(vlist), {})[0]
+            else:
+                merged = merged.copy()
+            if self._compression is not None:
+                merged = self._compression.compress_decompress(k, merged)
+            if self._updater is not None:
+                self._updater(_key_int(k), merged, self._store[k])
+            else:
+                self._store[k] += merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value (reference: Comm::Broadcast comm.h:62)."""
+        assert out is not None
+        keys, outs = _key_value_list(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            for o in olist:
+                self._store[k].copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull selected rows (reference: PullRowSparse kvstore.h:232).
+
+        Rows outside row_ids are zeroed in the output — dense emulation of
+        the row_sparse pull contract."""
+        assert out is not None and row_ids is not None
+        keys, outs = _key_value_list(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(outs[0])
+        for k, olist in zip(keys, outs):
+            full = self._store[k]
+            for o, rid in zip(olist, row_ids if isinstance(row_ids, list)
+                              else [row_ids] * len(olist)):
+                idx = rid.asnumpy().astype(_np.int64) if isinstance(rid, NDArray) \
+                    else _np.asarray(rid, dtype=_np.int64)
+                dense = _np.zeros(full.shape, dtype=full.asnumpy().dtype)
+                src = full.asnumpy()
+                dense[idx] = src[idx]
+                o[:] = dense
+
+    # ------------------------------------------------------------- config
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """reference: kvstore.py set_optimizer → server-side optimizer;
+        here the 'server' is in-process."""
+        if not isinstance(optimizer, Optimizer):
+            raise TypeError("optimizer must be an Optimizer")
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression with error feedback
+        (reference: gradient_compression.h:52)."""
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("only 2bit compression is supported (parity)")
+        self._compression = GradientCompression(
+            threshold=float(params.get("threshold", 0.5)))
+
+    # ------------------------------------------------------------- dist API
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "updater is not set"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+class DistKVStore(KVStore):
+    """Multi-process synchronous store over jax.distributed.
+
+    Reference: kvstore_dist.h:44 (worker) + kvstore_dist_server.h:155.
+    The ps-lite push/pull wire protocol is replaced by collective
+    reduction across the jax process group (DCN); server-side optimizer
+    semantics (sync aggregation of num_workers pushes before update,
+    kvstore_dist_server.h:346) are preserved by reducing first, then
+    applying the updater once per pushed key.
+    """
+
+    def __init__(self, type_name):
+        super().__init__(type_name)
+        self._rank = int(os.environ.get("DMLC_WORKER_ID",
+                                        os.environ.get("JAX_PROCESS_ID", 0)))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", 1))
+        self._group = None
+        if self._num_workers > 1:
+            self._init_process_group()
+
+    def _init_process_group(self):
+        import jax
+
+        coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+        try:
+            jax.distributed.initialize(
+                coordinator_address="%s:%s" % (coord, port),
+                num_processes=self._num_workers,
+                process_id=self._rank)
+            self._group = True
+        except Exception as e:  # already initialized or single-host fallback
+            if "already" in str(e).lower():
+                self._group = True
+            else:
+                raise MXNetError("dist kvstore init failed: %s" % e)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def push(self, key, value, priority=0):
+        keys, values = _key_value_list(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                from ..ndarray import imperative_invoke
+
+                merged = imperative_invoke("add_n", list(vlist), {})[0]
+            else:
+                merged = merged.copy()
+            if self._num_workers > 1:
+                merged = self._allreduce(merged)
+            if self._compression is not None:
+                merged = self._compression.compress_decompress(k, merged)
+            if self._updater is not None:
+                self._updater(_key_int(k), merged, self._store[k])
+            else:
+                self._store[k] += merged
+
+    def _allreduce(self, arr):
+        """Cross-process sum over DCN via a tiny jitted psum."""
+        import jax
+
+        from ..parallel import host_allreduce
+
+        return NDArray(host_allreduce(arr._data), arr._ctx)
+
+    def barrier(self):
+        if self._num_workers > 1:
+            import jax
+
+            # a zero-byte allreduce doubles as a barrier
+            self._allreduce(array(_np.zeros(1, dtype=_np.float32)))
+
+
+def _key_value(key, value):
+    """Normalize (key(s), value(s)) to parallel lists."""
+    if isinstance(key, (str, int)):
+        return [key], [value if isinstance(value, NDArray) else value]
+    assert len(key) == len(value)
+    return list(key), list(value)
+
+
+def _key_value_list(key, value):
+    """Normalize to (keys, list-of-NDArray-lists)."""
+    if isinstance(key, (str, int)):
+        vlist = value if isinstance(value, (list, tuple)) else [value]
+        return [key], [list(vlist)]
+    out_keys = list(key)
+    out_vals = []
+    for v in value:
+        out_vals.append(list(v) if isinstance(v, (list, tuple)) else [v])
+    return out_keys, out_vals
+
+
+def _key_int(key):
+    if isinstance(key, int):
+        return key
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key

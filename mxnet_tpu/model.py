@@ -1,0 +1,38 @@
+"""Checkpoint helpers (reference: python/mxnet/model.py
+save_checkpoint/load_checkpoint; the legacy FeedForward API is covered
+by Module).
+"""
+
+from __future__ import annotations
+
+from .ndarray import load as nd_load, save as nd_save
+from .symbol import load as sym_load
+
+BatchEndParam = None  # kept in module.base_module
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save symbol JSON + params (reference: model.py save_checkpoint)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params)
+    (reference: model.py load_checkpoint)."""
+    symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)

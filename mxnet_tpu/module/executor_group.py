@@ -1,0 +1,183 @@
+"""DataParallelExecutorGroup — batch-sliced executors across contexts.
+
+Reference: python/mxnet/module/executor_group.py:143 — splits each batch
+across contexts (:303), runs per-device executors fwd/bwd, exposes
+merged outputs.
+
+TPU note: the production data-parallel path on TPU is a sharded batch
+over the ICI mesh via kvstore='tpu' (one jit, XLA collectives) — see
+mxnet_tpu/parallel/.  This group exists for API parity (multi-ctx
+Module, tests ≈ test_multi_device_exec.py) and works over any jax
+devices, including the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, concatenate, zeros
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """reference: python/mxnet/executor_manager.py _split_input_slice."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = state_names or []
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.data_names = [d[0] for d in data_shapes]
+        self.label_names = [l[0] for l in label_shapes] if label_shapes else []
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.batch_size = data_shapes[0][1][0]
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+        self.execs = []
+        self._default_execs = None
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = ("null" if name in self.fixed_param_names
+                                       or not for_training else grad_req)
+            elif name in self.data_names:
+                self.grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                self.grad_req[name] = "null"
+        self._bind_execs()
+
+    def _bind_execs(self):
+        self.execs = []
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            n = sl.stop - sl.start
+            shapes = {}
+            for name, shape in self.data_shapes:
+                shapes[name] = (n,) + tuple(shape[1:])
+            for name, shape in (self.label_shapes or []):
+                shapes[name] = (n,) + tuple(shape[1:])
+            ex = self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
+                                         **shapes)
+            self.execs.append(ex)
+        self.shared_data_arrays = [{} for _ in self.contexts]
+
+    # --------------------------------------------------------------- params
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for ex in self.execs:
+            ex.copy_params_from(arg_params, aux_params,
+                                allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts
+        (reference: executor_group.get_params)."""
+        for name in self.param_names:
+            arrs = [ex.arg_dict[name] for ex in self.execs]
+            out = arrs[0]
+            if len(arrs) > 1:
+                acc = arrs[0].asnumpy()
+                for a in arrs[1:]:
+                    acc = acc + a.asnumpy()
+                arg_params[name][:] = acc / len(arrs)
+            else:
+                arg_params[name][:] = out
+        for name in self.aux_names:
+            arrs = [ex.aux_dict[name] for ex in self.execs]
+            if len(arrs) > 1:
+                acc = arrs[0].asnumpy()
+                for a in arrs[1:]:
+                    acc = acc + a.asnumpy()
+                aux_params[name][:] = acc / len(arrs)
+            else:
+                aux_params[name][:] = arrs[0]
+
+    # --------------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        label = data_batch.label if data_batch.label is not None else []
+        for i, ex in enumerate(self.execs):
+            sl = self.slices[i]
+            feed = {}
+            for name, arr in zip(self.data_names, data):
+                feed[name] = arr[sl]
+            for name, arr in zip(self.label_names, label):
+                feed[name] = arr[sl]
+            ex.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run backward")
+        for i, ex in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                sl = self.slices[i]
+                og = [g[sl] for g in out_grads]
+            ex.backward(out_grads=og)
+
+    def get_outputs(self, merge_multi_context=True):
+        if not merge_multi_context or len(self.execs) == 1:
+            outs = [[ex.outputs[i] for ex in self.execs]
+                    for i in range(len(self.execs[0].outputs))]
+            if merge_multi_context:
+                return [o[0] for o in outs]
+            return outs
+        merged = []
+        for i in range(len(self.execs[0].outputs)):
+            merged.append(concatenate([ex.outputs[i] for ex in self.execs],
+                                      axis=0))
+        return merged
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = []
+        for name in self.data_names:
+            per_dev = [ex.grad_dict.get(name) for ex in self.execs]
+            if merge_multi_context:
+                grads.append(concatenate(per_dev, axis=0) if len(per_dev) > 1
+                             else per_dev[0])
+            else:
+                grads.append(per_dev)
+        return grads
+
+    @property
+    def grad_arrays(self):
+        """grad_arrays[param_idx] = list of per-device grads
+        (layout matches reference for kvstore consumption)."""
+        out = []
+        for name in self.param_names:
+            out.append([ex.grad_dict[name] for ex in self.execs
+                        if name in ex.grad_dict])
+        return out
+
+    @property
+    def param_arrays(self):
+        out = []
+        for name in self.param_names:
+            out.append([ex.arg_dict[name] for ex in self.execs])
+        return out
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for i, ex in enumerate(self.execs):
+            sl = self.slices[i]
+            labels_slice = [l[sl] for l in labels] if not pre_sliced else labels[i]
+            eval_metric.update(labels_slice, ex.outputs)

@@ -611,7 +611,19 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
         # compiles the underlying primitives)
         result = op.bind_attrs(attrs)(*arrays)
     else:
-        result = op.jitted(attrs)(*arrays)
+        try:
+            result = op.jitted(attrs)(*arrays)
+        except ValueError as e:
+            if "incompatible devices" not in str(e):
+                raise
+            # cross-device inputs (e.g. kvstore reduce over per-device
+            # grads): gather to the first input's device, like the
+            # reference's CommCPU copy-to-reduce (src/kvstore/comm.h:103)
+            import jax
+
+            dev = list(arrays[0].devices())[0]
+            arrays = [jax.device_put(a, dev) for a in arrays]
+            result = op.jitted(attrs)(*arrays)
     result = result if isinstance(result, tuple) else (result,)
     return _wrap_outputs(result, ctx, out)
 

@@ -196,56 +196,54 @@ def softmax_activation(data, mode="instance", **_):
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
 
 
-def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
-                        use_ignore, normalization, smooth_alpha):
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _softmax_output_core(grad_scale, ignore_label, multi_output, use_ignore,
+                         normalization, smooth_alpha):
+    """Build a custom-vjp softmax-output fn for a static config.
+
+    The backward is the fused (softmax - onehot(label)) cross-entropy
+    gradient of the reference (src/operator/softmax_output.cc), ignoring
+    the incoming head cotangent — SoftmaxOutput *is* the loss layer.
+    """
     axis = 1 if multi_output else -1
-    return jax.nn.softmax(data, axis=axis)
 
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.softmax(data, axis=axis)
 
-@jax.custom_vjp
-def _softmax_output(data, label, grad_scale, ignore_label, multi_output,
-                    use_ignore, normalization, smooth_alpha):
-    return _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
-                               use_ignore, normalization, smooth_alpha)
+    def fwd(data, label):
+        out = jax.nn.softmax(data, axis=axis)
+        return out, (out, label)
 
-
-def _softmax_output_vjp_fwd(data, label, grad_scale, ignore_label, multi_output,
-                            use_ignore, normalization, smooth_alpha):
-    out = _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
-                              use_ignore, normalization, smooth_alpha)
-    return out, (out, label, grad_scale, ignore_label, multi_output, use_ignore,
-                 normalization, smooth_alpha)
-
-
-def _softmax_output_vjp_bwd(res, g):
-    (out, label, grad_scale, ignore_label, multi_output, use_ignore,
-     normalization, smooth_alpha) = res
-    axis = 1 if multi_output else -1
-    ncls = out.shape[axis]
-    lab = label.astype(jnp.int32)
-    onehot = jax.nn.one_hot(lab, ncls, dtype=out.dtype, axis=axis)
-    if smooth_alpha:
-        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / (ncls - 1) * (1.0 - onehot)
-    grad = out - onehot
-    if use_ignore:
-        keep = (lab != int(ignore_label)).astype(out.dtype)
-        grad = grad * jnp.expand_dims(keep, axis)
-    scale = grad_scale
-    if normalization == "batch":
-        scale = scale / out.shape[0]
-    elif normalization == "valid":
+    def bwd(res, g):
+        out, label = res
+        ncls = out.shape[axis]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, ncls, dtype=out.dtype, axis=axis)
+        if smooth_alpha:
+            onehot = (onehot * (1.0 - smooth_alpha)
+                      + smooth_alpha / (ncls - 1) * (1.0 - onehot))
+        grad = out - onehot
         if use_ignore:
-            valid = jnp.maximum(jnp.sum((lab != int(ignore_label)).astype(out.dtype)), 1.0)
-        else:
-            valid = float(_np.prod(lab.shape))
-        scale = scale / valid
-    grad = grad * scale
-    # out grad ignores incoming cotangent by design (reference semantics:
-    # SoftmaxOutput *is* the loss layer; incoming head grad is all-ones)
-    return (grad.astype(out.dtype), jnp.zeros_like(label), None, None, None, None, None, None)
+            keep = (lab != int(ignore_label)).astype(out.dtype)
+            grad = grad * jnp.expand_dims(keep, axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / out.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                scale = scale / jnp.maximum(
+                    jnp.sum((lab != int(ignore_label)).astype(out.dtype)), 1.0)
+            else:
+                scale = scale / float(_np.prod(lab.shape))
+        grad = grad * scale
+        return (grad.astype(out.dtype), jnp.zeros_like(label))
 
-
-_softmax_output.defvjp(_softmax_output_vjp_fwd, _softmax_output_vjp_bwd)
+    f.defvjp(fwd, bwd)
+    return f
 
 
 @register("SoftmaxOutput", aliases=("Softmax",))
@@ -254,9 +252,10 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=
                    out_grad=False, smooth_alpha=0.0, **_):
     """Softmax forward with fused cross-entropy backward
     (reference: src/operator/softmax_output.cc — the Module-API loss layer)."""
-    return _softmax_output(data, label, float(grad_scale), float(ignore_label),
-                           bool(multi_output), bool(use_ignore), normalization,
-                           float(smooth_alpha))
+    f = _softmax_output_core(float(grad_scale), float(ignore_label),
+                             bool(multi_output), bool(use_ignore),
+                             str(normalization), float(smooth_alpha))
+    return f(data, label)
 
 
 @register("softmax_cross_entropy")
@@ -281,47 +280,53 @@ def logistic_regression_output(data, label, grad_scale=1.0, **_):
     return _regression_out(data, label, grad_scale, "logistic")
 
 
-@jax.custom_vjp
-def _regression_core(data, label, grad_scale, kind):
-    return jax.nn.sigmoid(data) if kind == "logistic" else data
+@functools.lru_cache(maxsize=None)
+def _regression_core(grad_scale, kind):
+    @jax.custom_vjp
+    def f(data, label):
+        return jax.nn.sigmoid(data) if kind == "logistic" else data
 
+    def fwd(data, label):
+        out = jax.nn.sigmoid(data) if kind == "logistic" else data
+        return out, (out, label)
 
-def _regression_fwd(data, label, grad_scale, kind):
-    out = jax.nn.sigmoid(data) if kind == "logistic" else data
-    return out, (out, label, grad_scale, kind, data.shape)
+    def bwd(res, g):
+        out, label = res
+        lab = label.reshape(out.shape)
+        num = out.shape[1] if out.ndim > 1 else 1
+        if kind == "mae":
+            grad = jnp.sign(out - lab)
+        else:  # linear & logistic share (pred - label)
+            grad = out - lab
+        grad = grad * (grad_scale / num)
+        # label cotangent must keep the primal label's shape
+        return (grad.astype(out.dtype), jnp.zeros_like(label))
 
-
-def _regression_bwd(res, g):
-    out, label, grad_scale, kind, shape = res
-    label = label.reshape(shape)
-    num = shape[1] if len(shape) > 1 else 1
-    if kind == "mae":
-        grad = jnp.sign(out - label)
-    else:  # linear & logistic share (pred - label)
-        grad = out - label
-    grad = grad * (grad_scale / num)
-    return (grad.astype(out.dtype), jnp.zeros_like(label), None, None)
-
-
-_regression_core.defvjp(_regression_fwd, _regression_bwd)
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def _regression_out(data, label, grad_scale, kind):
-    return _regression_core(data, label, float(grad_scale), kind)
+    return _regression_core(float(grad_scale), kind)(data, label)
 
 
 # ---------------------------------------------------------------- norm layers
 
 
-@register("BatchNorm", num_outputs=3)
+def _bn_nout(attrs):
+    return 3 if attrs.get("output_mean_var") else 1
+
+
+@register("BatchNorm", num_outputs=_bn_nout)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
                cudnn_off=False, **_):
     """Functional BatchNorm (reference: src/operator/nn/batch_norm.cc).
 
-    Returns (out, batch_mean, batch_var).  The Gluon layer / executor
-    updates moving stats outside (keeps the op pure → traceable); when
-    ``use_global_stats`` (inference) the moving stats are used directly.
+    Returns out, or (out, batch_mean, batch_var) when ``output_mean_var``.
+    The Gluon layer / executor updates moving stats outside (keeps the op
+    pure → traceable); when ``use_global_stats`` (inference) the moving
+    stats are used directly.
     """
     ax = int(axis) % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
@@ -334,7 +339,9 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     inv = lax.rsqrt(var + eps)
     out = (data - _expand(mean, ax, data.ndim)) * _expand(g * inv, ax, data.ndim) \
         + _expand(beta, ax, data.ndim)
-    return out, mean, var
+    if output_mean_var:
+        return out, mean, var
+    return out
 
 
 def _expand(v, axis, ndim):

@@ -88,17 +88,16 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
     return new_w, new_n
 
 
-@register("rmspropalex_update", num_outputs=3)
-def rmspropalex_update(weight, grad, n, g_state, delta=None, lr=0.001, gamma1=0.95,
+@register("rmspropalex_update", num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, **_):
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
     new_g = (1.0 - gamma1) * g + gamma1 * g_state
-    d = delta if delta is not None else jnp.zeros_like(weight)
-    new_delta = gamma2 * d - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
-    return weight + new_delta, new_n, new_g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    return weight + new_delta, new_n, new_g, new_delta
 
 
 @register("signsgd_update")

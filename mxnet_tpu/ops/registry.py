@@ -32,6 +32,49 @@ __all__ = ["Op", "register", "get", "list_ops", "apply_op"]
 
 _OP_REGISTRY: dict[str, "Op"] = {}
 
+# Ordered tensor-input names per op (reference: each op's ListArguments()).
+# Drives both nd.* kwarg handling and Symbol auto-created variables
+# (e.g. FullyConnected with no weight= grows a "<name>_weight" variable,
+# matching python/mxnet/symbol autogen behaviour).
+OP_INPUT_NAMES = {
+    "Convolution": ("data", "weight", "bias"),
+    "Deconvolution": ("data", "weight", "bias"),
+    "FullyConnected": ("data", "weight", "bias"),
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "L2Normalization": ("data",),
+    "Embedding": ("data", "weight"),
+    "LeakyReLU": ("data", "gamma"),
+    "SoftmaxOutput": ("data", "label"),
+    "LinearRegressionOutput": ("data", "label"),
+    "MAERegressionOutput": ("data", "label"),
+    "LogisticRegressionOutput": ("data", "label"),
+    "CTCLoss": ("data", "label", "data_lengths", "label_lengths"),
+    "SequenceMask": ("data", "sequence_length"),
+    "SequenceLast": ("data", "sequence_length"),
+    "SequenceReverse": ("data", "sequence_length"),
+    "dot": ("lhs", "rhs"),
+    "batch_dot": ("lhs", "rhs"),
+    "where": ("condition", "x", "y"),
+    "take": ("a", "indices"),
+    "ROIPooling": ("data", "rois"),
+    "BilinearSampler": ("data", "grid"),
+    "GridGenerator": ("data",),
+    "SpatialTransformer": ("data", "loc"),
+    "RNN": ("data", "parameters", "state", "state_cell"),
+}
+
+# Inputs that are auxiliary states (not gradient targets; updated by the
+# executor, reference: symbol list_auxiliary_states / NDArray aux states)
+OP_AUX_INPUTS = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+}
+
+# ops whose label-ish inputs get auto-created as "<name>_label" variables
+OP_LABEL_INPUTS = {"SoftmaxOutput", "LinearRegressionOutput",
+                   "MAERegressionOutput", "LogisticRegressionOutput", "CTCLoss"}
+
 
 def _hashable(v):
     """Normalize attr values to hashable, canonical forms."""

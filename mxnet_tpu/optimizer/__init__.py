@@ -1,0 +1,6 @@
+"""``mx.optimizer`` (reference: python/mxnet/optimizer/)."""
+
+from .optimizer import (SGD, Adam, AdaDelta, AdaGrad, Adamax, DCASGD, FTML,  # noqa: F401
+                        Ftrl, LBSGD, NAG, Nadam, Optimizer, RMSProp, SGLD,
+                        Signum, Test, Updater, ccSGD, create, get_updater,
+                        register)

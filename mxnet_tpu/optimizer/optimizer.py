@@ -1,0 +1,623 @@
+"""Optimizers (reference: python/mxnet/optimizer/optimizer.py:46-1608).
+
+Same registry/API surface: ``Optimizer.create_optimizer``/``create``,
+per-param lr/wd multipliers, ``create_state``, ``update``, and the
+``Updater`` used by KVStore.  Updates dispatch to the fused update ops
+(ops/optimizer_ops.py) so each step is one XLA kernel per weight; the
+reference's multi-tensor aggregation (MXNET_OPTIMIZER_AGGREGATION_SIZE)
+is unnecessary under jit — XLA fuses across weights when the whole step
+is staged (gluon Trainer.step_fused / Module) — but the eager path here
+still keeps per-weight fused kernels.
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError, Registry
+from ..ndarray import NDArray, imperative_invoke, zeros
+
+_REG = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:46)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+
+    create_optimizer = staticmethod(create)
+
+    # ------------------------------------------------------------- state
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights
+        (reference: optimizer.py create_state_multi_precision)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (_np.float16,):
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype in (_np.float16,):
+            master, base_state = state
+            grad32 = grad.astype(_np.float32)
+            self.update(index, master, grad32, base_state)
+            weight[:] = master.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # ------------------------------------------------------------- lr/wd
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("lr_scheduler", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.lr_scheduler = None
+
+
+def _fused(name, index, weight, grad, states, opt, **extra):
+    """Run a fused update op and write results back in place."""
+    attrs = {"lr": opt._get_lr(index), "wd": opt._get_wd(index),
+             "rescale_grad": opt.rescale_grad,
+             "clip_gradient": opt.clip_gradient if opt.clip_gradient else -1.0}
+    attrs.update(extra)
+    inputs = [weight, grad] + list(states)
+    outs = imperative_invoke(name, inputs, attrs)
+    weight._assign(outs[0]._data)
+    for st, new in zip(states, outs[1:]):
+        st._assign(new._data)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional multi-precision
+    (reference: optimizer.py SGD; fused kernels optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if state is None:
+            _fused("sgd_update", index, weight, grad, [], self)
+        else:
+            _fused("sgd_mom_update", index, weight, grad, [state], self,
+                   momentum=self.momentum)
+
+
+@register
+class Test(Optimizer):
+    """Trivial test optimizer (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight - grad * self.rescale_grad
+        state[:] = weight
+
+
+ccSGD = register(type("ccSGD", (SGD,), {}))  # deprecated alias (reference parity)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptation
+    (reference: optimizer.py LBSGD)."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.adaptive = True
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        # LARS trust ratio
+        wnorm = float(weight.norm().asscalar())
+        gnorm = float(grad.norm().asscalar()) * self.rescale_grad
+        if wnorm > 0 and gnorm > 0:
+            lr = lr * min(wnorm / (gnorm + wd * wnorm + 1e-9), 10.0)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        if state is not None:
+            state[:] = self.momentum * state - lr * g
+            weight[:] = weight + state
+        else:
+            weight[:] = weight - lr * g
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            mom[:] = self.momentum * mom - lr * (comp + wd * weight)
+            step = mom
+        else:
+            step = -lr * (comp + wd * weight)
+        prev[:] = weight
+        weight[:] = weight + step
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if state is None:
+            _fused("sgd_update", index, weight, grad, [], self)
+        else:
+            _fused("nag_mom_update", index, weight, grad, [state], self,
+                   momentum=self.momentum)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from ..ndarray import random as ndr
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = ndr.normal(0, math.sqrt(lr), shape=weight.shape)
+        weight[:] = weight - lr / 2 * (g + wd * weight) + noise
+
+
+@register
+class Adam(Optimizer):
+    """reference: optimizer.py Adam; fused adam_update kernel."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        attrs = {"lr": lr, "wd": self._get_wd(index),
+                 "rescale_grad": self.rescale_grad,
+                 "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
+                 "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
+        outs = imperative_invoke("adam_update", [weight, grad, mean, var], attrs)
+        weight._assign(outs[0]._data)
+        mean._assign(outs[1]._data)
+        var._assign(outs[2]._data)
+
+
+@register
+class Signum(Optimizer):
+    """reference: optimizer.py Signum (signSGD + momentum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if state is None:
+            _fused("signsgd_update", index, weight, grad, [], self)
+        else:
+            _fused("signum_update", index, weight, grad, [state], self,
+                   momentum=self.momentum, wd_lh=self.wd_lh)
+
+
+@register
+class FTML(Optimizer):
+    """reference: optimizer.py FTML."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        attrs = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                 "rescale_grad": self.rescale_grad,
+                 "clip_grad": self.clip_gradient if self.clip_gradient else -1.0,
+                 "beta1": self.beta1, "beta2": self.beta2,
+                 "epsilon": self.epsilon, "t": t}
+        outs = imperative_invoke("ftml_update", [weight, grad, d, v, z], attrs)
+        weight._assign(outs[0]._data)
+        d._assign(outs[1]._data)
+        v._assign(outs[2]._data)
+        z._assign(outs[3]._data)
+
+
+@register
+class Ftrl(Optimizer):
+    """reference: optimizer.py Ftrl."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        attrs = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                 "rescale_grad": self.rescale_grad,
+                 "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
+                 "lamda1": self.lamda1, "beta": self.beta}
+        outs = imperative_invoke("ftrl_update", [weight, grad, z, n], attrs)
+        weight._assign(outs[0]._data)
+        z._assign(outs[1]._data)
+        n._assign(outs[2]._data)
+
+
+@register
+class Adamax(Optimizer):
+    """reference: optimizer.py Adamax."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m[:] = self.beta1 * m + (1.0 - self.beta1) * g
+        u[:] = imperative_invoke("_maximum", [u * self.beta2, g.abs()], {})[0]
+        weight[:] = weight - lr * m / (u + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """reference: optimizer.py Nadam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        g_prime = g / (1.0 - self.m_schedule)
+        m[:] = self.beta1 * m + (1.0 - self.beta1) * g
+        v[:] = self.beta2 * v + (1.0 - self.beta2) * g * g
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight[:] = weight - lr * m_bar / ((v_prime ** 0.5) + self.epsilon)
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference: optimizer.py AdaGrad."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        state[:] = state + g * g
+        weight[:] = weight - lr * g / ((state ** 0.5) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    """reference: optimizer.py RMSProp (Tieleman & Hinton; centered variant)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                    zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                    zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        if self.centered:
+            n, g_st, delta = state
+            attrs = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                     "rescale_grad": self.rescale_grad,
+                     "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
+                     "gamma1": self.gamma1, "gamma2": self.gamma2,
+                     "epsilon": self.epsilon}
+            outs = imperative_invoke("rmspropalex_update",
+                                     [weight, grad, n, g_st, delta], attrs)
+            weight._assign(outs[0]._data)
+            n._assign(outs[1]._data)
+            g_st._assign(outs[2]._data)
+            delta._assign(outs[3]._data)
+        else:
+            _fused("rmsprop_update", index, weight, grad, [state[0]], self,
+                   gamma1=self.gamma1, epsilon=self.epsilon,
+                   clip_weights=self.clip_weights if self.clip_weights else -1.0)
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference: optimizer.py AdaDelta."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * g * g
+        current_delta = ((acc_delta + self.epsilon) ** 0.5
+                         / (acc_g + self.epsilon) ** 0.5) * g
+        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) * current_delta ** 2
+        weight[:] = weight - current_delta - wd * weight
+
+
+class Updater:
+    """KVStore-side updater (reference: optimizer.py:1608 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
+                            else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

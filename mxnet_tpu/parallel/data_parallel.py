@@ -1,0 +1,62 @@
+"""Sharded data-parallel training step — the production TPU path.
+
+This replaces the reference's whole gradient-synchronisation machinery
+(DataParallelExecutorGroup batch slicing + Comm reduce + KVStore
+push/pull, SURVEY.md §3.4) with ONE jitted SPMD step over a mesh:
+
+- batch sharded over 'dp' (NamedSharding)
+- params replicated over 'dp', optionally sharded over 'tp'
+- loss gradient psum happens implicitly when XLA partitions the step
+  (GSPMD inserts the all-reduce on the grad reduction)
+
+``make_train_step`` works with any pure loss_fn(params, batch) — the
+gluon Trainer and Module multi-chip paths build theirs from the traced
+block/symbol.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["make_train_step", "DataParallelStep"]
+
+
+def make_train_step(loss_fn, optimizer_update, mesh, param_shardings=None,
+                    donate_params=True):
+    """Build a jitted sharded train step.
+
+    loss_fn(params_pytree, batch_pytree) -> scalar loss
+    optimizer_update(params, grads, opt_state) -> (new_params, new_opt_state)
+
+    Returns step(params, opt_state, batch) -> (loss, params, opt_state),
+    jitted with batch sharded over 'dp' and params/state sharded per
+    ``param_shardings`` (replicated by default).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    batch_shard = NamedSharding(mesh, P("dp"))
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_params, new_opt_state = optimizer_update(params, grads, opt_state)
+        return loss, new_params, new_opt_state
+
+    in_shardings = (param_shardings if param_shardings is not None else repl,
+                    repl, batch_shard)
+    donate = (0, 1) if donate_params else ()
+    return jax.jit(step, in_shardings=in_shardings,
+                   donate_argnums=donate)
+
+
+class DataParallelStep:
+    """Convenience wrapper holding mesh + compiled step + device params."""
+
+    def __init__(self, loss_fn, optimizer_update, mesh=None):
+        from .mesh import get_default_mesh
+
+        self.mesh = mesh or get_default_mesh()
+        self._step = make_train_step(loss_fn, optimizer_update, self.mesh)
+
+    def __call__(self, params, opt_state, batch):
+        return self._step(params, opt_state, batch)

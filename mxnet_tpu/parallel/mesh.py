@@ -1,0 +1,82 @@
+"""Device meshes and collectives.
+
+Reference analog: the device topology planning in src/kvstore/
+gpu_topology.h (tree-reduce link-penalty search) and comm.h device
+communication.  On TPU none of that is needed: the mesh axes map onto
+the physical torus by XLA, and collectives ride ICI.  ``create_mesh``
+is the single entry point: axes ('dp','tp','pp','sp','ep') with sizes
+chosen by the caller (1 collapses the axis).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+_DEFAULT_MESH = None
+
+AXIS_ORDER = ("pp", "dp", "sp", "ep", "tp")  # tp innermost → fastest ICI links
+
+
+def create_mesh(axis_sizes=None, devices=None):
+    """Create a ``jax.sharding.Mesh``.
+
+    axis_sizes: dict like {'dp': 4, 'tp': 2}; remaining devices must be
+    covered (product == ndev).  Default: all devices on 'dp'.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {"dp": n}
+    sizes = [int(axis_sizes.get(a, 1)) for a in AXIS_ORDER]
+    prod = int(_np.prod(sizes))
+    if prod != n:
+        raise ValueError("mesh axes %r product %d != %d devices"
+                         % (axis_sizes, prod, n))
+    arr = _np.asarray(devices).reshape(sizes)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def set_default_mesh(mesh):
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+def get_default_mesh():
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = create_mesh()
+    return _DEFAULT_MESH
+
+
+def data_parallel_sharding(mesh, ndim):
+    """NamedSharding: dim0 over 'dp', rest replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P("dp", *([None] * (ndim - 1))) if ndim > 0 else P()
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def host_allreduce(value):
+    """Sum a host-side array across all devices/processes.
+
+    Used by the dist kvstore barrier/reduction path (the DCN analog of
+    ps-lite push aggregation, kvstore_dist_server.h:346).  Single-process
+    fallback: identity.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(_np.asarray(value))
+    return gathered.sum(axis=0)

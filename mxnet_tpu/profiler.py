@@ -1,0 +1,238 @@
+"""Profiler — chrome://tracing output + aggregate stats.
+
+Reference: src/profiler/profiler.h:256 (Profiler singleton, ProfileStat
+arrays, chrome-tracing JSON dump :87,437), aggregate_stats.cc,
+python/mxnet/profiler.py:33 (set_config/set_state/dump, custom
+domains/tasks/counters/markers).
+
+TPU-native: two layers. (1) A Python-side event recorder with the same
+API (set_config/set_state/dump/dumps, Domain/Task/Frame/Counter/Marker)
+producing chrome-tracing JSON — this traces the *framework* (op
+dispatch, iterator, kvstore). (2) ``start_xla_trace``/``stop_xla_trace``
+wrap ``jax.profiler`` for device-side traces viewable in TensorBoard /
+Perfetto — the analog of the reference's device-level opr profiling,
+since XLA owns kernel timing on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_state = {
+    "config": {"profile_all": False, "profile_symbolic": True,
+               "profile_imperative": True, "profile_memory": False,
+               "profile_api": False, "aggregate_stats": False,
+               "filename": "profile.json"},
+    "running": False,
+    "events": [],
+    "lock": threading.Lock(),
+    "xla_dir": None,
+}
+
+
+def set_config(**kwargs):
+    """reference: profiler.py:33 set_config."""
+    _state["config"].update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' | 'stop' (reference: profiler.py:89)."""
+    if state == "run":
+        _state["running"] = True
+    elif state == "stop":
+        _state["running"] = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def is_running():
+    return _state["running"]
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1000.0
+
+
+def add_event(name, cat, ph, ts=None, pid=0, tid=None, args=None, dur=None):
+    if not _state["running"]:
+        return
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": ts if ts is not None else _now_us(),
+          "pid": pid, "tid": tid if tid is not None else threading.get_ident()}
+    if args:
+        ev["args"] = args
+    if dur is not None:
+        ev["dur"] = dur
+    with _state["lock"]:
+        _state["events"].append(ev)
+
+
+class scope:
+    """``with profiler.scope('fwd'):`` records a complete event."""
+
+    def __init__(self, name, cat="framework"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *a):
+        add_event(self.name, self.cat, "X", ts=self.t0, dur=_now_us() - self.t0)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome-tracing JSON (reference: profiler.py dump)."""
+    fname = _state["config"].get("filename", "profile.json")
+    with _state["lock"]:
+        events = list(_state["events"])
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return fname
+
+
+def dumps(reset=False):
+    """In-memory aggregate table (reference: aggregate_stats.cc)."""
+    with _state["lock"]:
+        events = list(_state["events"])
+        if reset:
+            _state["events"] = []
+    agg = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        st = agg.setdefault(ev["name"], {"count": 0, "total_us": 0.0,
+                                         "min_us": float("inf"), "max_us": 0.0})
+        d = ev.get("dur", 0.0)
+        st["count"] += 1
+        st["total_us"] += d
+        st["min_us"] = min(st["min_us"], d)
+        st["max_us"] = max(st["max_us"], d)
+    lines = ["%-40s %8s %12s %12s %12s" % ("Name", "Calls", "Total(us)",
+                                           "Min(us)", "Max(us)")]
+    for name, st in sorted(agg.items(), key=lambda kv: -kv[1]["total_us"]):
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f"
+                     % (name[:40], st["count"], st["total_us"],
+                        st["min_us"], st["max_us"]))
+    return "\n".join(lines)
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+# ------------------------------------------------------------- XLA traces
+
+
+def start_xla_trace(log_dir="/tmp/mxnet_tpu_trace"):
+    """Device-side trace via jax.profiler (TensorBoard/Perfetto viewable)."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _state["xla_dir"] = log_dir
+
+
+def stop_xla_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+    return _state["xla_dir"]
+
+
+# ------------------------------------------------------------- user scopes
+# reference: c_api_profile.cc domains/tasks/frames/counters/markers
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = _now_us()
+
+    def stop(self):
+        if self._t0 is not None:
+            add_event(self.name, self.domain.name, "X", ts=self._t0,
+                      dur=_now_us() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Span):
+    pass
+
+
+class Frame(_Span):
+    pass
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__(Domain("event"), name)
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+        add_event(self.name, self.domain.name, "C",
+                  args={self.name: value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        add_event(self.name, self.domain.name, "i",
+                  args={"scope": scope})

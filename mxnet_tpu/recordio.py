@@ -1,0 +1,200 @@
+"""RecordIO (reference: python/mxnet/recordio.py + dmlc-core recordio).
+
+Same binary format concept: magic + length-prefixed records with
+continuation handling omitted (single-part records), plus the IRHeader
+image packing used by im2rec/ImageRecordIter.  A C++ fast path for bulk
+sequential reads lives in mxnet_tpu/native/ (used when built).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+_MAGIC = 0xCED7230A
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer (reference: recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = flag == "w"
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        self.handle = open(self.uri, "wb" if self.writable else "rb")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        if not self.writable:
+            d["_pos"] = self.handle.tell() if self.is_open else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        if not self.writable:
+            self.handle.seek(pos)
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self.handle.write(struct.pack("<II", _MAGIC, len(buf)))
+        self.handle.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, length = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError("invalid RecordIO magic in %s" % self.uri)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed record file (reference: recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.exists(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a string payload with IRHeader (reference: recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id, header.id2)
+        return hdr + s
+    label = _np.asarray(header.label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack IRHeader + payload (reference: recordio.unpack)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (HWC uint8).  Uses PIL when available for JPEG;
+    otherwise stores raw npy bytes (format-tagged)."""
+    try:
+        from io import BytesIO
+
+        from PIL import Image
+
+        buff = BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(img).save(buff, format=fmt, quality=quality)
+        return pack(header, b"IMG0" + buff.getvalue())
+    except ImportError:
+        arr = _np.ascontiguousarray(img, dtype=_np.uint8)
+        meta = struct.pack("<III", *((arr.shape + (1, 1, 1))[:3]))
+        return pack(header, b"RAW0" + meta + arr.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (IRHeader, HWC uint8 array)."""
+    header, payload = unpack(s)
+    tag = payload[:4]
+    if tag == b"RAW0":
+        h, w, c = struct.unpack("<III", payload[4:16])
+        img = _np.frombuffer(payload[16:16 + h * w * c], dtype=_np.uint8)
+        img = img.reshape((h, w, c) if c > 1 else (h, w))
+    elif tag == b"IMG0":
+        from io import BytesIO
+
+        from PIL import Image
+
+        img = _np.asarray(Image.open(BytesIO(payload[4:])))
+    else:
+        # assume raw JPEG from the reference's im2rec
+        from io import BytesIO
+
+        from PIL import Image
+
+        img = _np.asarray(Image.open(BytesIO(payload)))
+    return header, img

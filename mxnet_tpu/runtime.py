@@ -1,0 +1,82 @@
+"""Runtime feature detection (reference: include/mxnet/libinfo.h:134,
+src/libinfo.cc, python/mxnet/runtime.py).
+
+Features reflect what this build actually supports: TPU/XLA in place of
+CUDA/CUDNN, etc.  Queryable the same way: ``mx.runtime.Features()``.
+"""
+
+from __future__ import annotations
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    feats = {}
+
+    def add(name, enabled):
+        feats[name] = Feature(name, bool(enabled))
+
+    try:
+        import jax
+
+        has_jax = True
+        try:
+            platforms = {d.platform for d in jax.devices()}
+        except RuntimeError:
+            platforms = set()
+    except ImportError:  # pragma: no cover
+        has_jax = False
+        platforms = set()
+    add("TPU", bool(platforms - {"cpu"}))
+    add("XLA", has_jax)
+    add("PALLAS", has_jax)
+    add("CUDA", False)
+    add("CUDNN", False)
+    add("NCCL", False)
+    add("MKLDNN", False)
+    add("OPENCV", _has("cv2"))
+    add("PIL", _has("PIL"))
+    add("BLAS_OPEN", True)
+    add("LAPACK", True)
+    add("F16C", True)
+    add("BF16", True)
+    add("DIST_KVSTORE", True)
+    add("INT64_TENSOR_SIZE", True)
+    add("SIGNAL_HANDLER", False)
+    add("PROFILER", True)
+    add("NATIVE_IO", _has_native())
+    return feats
+
+
+def _has(mod):
+    import importlib.util
+
+    return importlib.util.find_spec(mod) is not None
+
+
+def _has_native():
+    import os
+
+    return os.path.exists(os.path.join(os.path.dirname(__file__), "native",
+                                       "libmxtpu.so"))
+
+
+class Features(dict):
+    """Map of feature name → Feature (reference: runtime.Features)."""
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, feature_name):
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

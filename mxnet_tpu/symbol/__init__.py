@@ -1,0 +1,11 @@
+"""``mx.sym`` — symbolic graph API (reference: python/mxnet/symbol/)."""
+
+from .symbol import Group, Symbol, Variable, load, load_json, var  # noqa: F401
+from .register import populate as _populate
+
+_populate(globals())
+
+from . import random  # noqa: E402,F401
+
+zeros = globals()["_zeros"]
+ones = globals()["_ones"]

@@ -1,0 +1,17 @@
+"""``mx.sym.random`` (reference: python/mxnet/symbol/random.py)."""
+
+from .symbol import _create
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", **kwargs):
+    kwargs.pop("ctx", None)
+    return _create("_random_uniform", [], {"low": low, "high": high,
+                                           "shape": shape, "dtype": dtype},
+                   name=kwargs.get("name"))
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", **kwargs):
+    kwargs.pop("ctx", None)
+    return _create("_random_normal", [], {"loc": loc, "scale": scale,
+                                          "shape": shape, "dtype": dtype},
+                   name=kwargs.get("name"))

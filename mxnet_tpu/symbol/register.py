@@ -1,0 +1,52 @@
+"""Autogenerate ``sym.*`` op functions (reference: python/mxnet/symbol/
+register.py — one function per registered op, building graph nodes)."""
+
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _create
+
+
+def _make_sym_func(op_name):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("out", None)
+        inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
+                inputs.extend(a)
+            else:
+                raise TypeError(
+                    "%s: positional args must be Symbols; pass attrs as kwargs"
+                    % op_name)
+        names = _reg.OP_INPUT_NAMES.get(op_name)
+        if names:
+            taken = len(inputs)
+            for tn in names[taken:]:
+                if tn in kwargs and isinstance(kwargs[tn], Symbol):
+                    inputs.append(kwargs.pop(tn))
+                elif tn in kwargs and kwargs[tn] is None:
+                    kwargs.pop(tn)
+                elif any(isinstance(v, Symbol) for v in kwargs.values()):
+                    continue
+        else:
+            for k in list(kwargs):
+                if isinstance(kwargs[k], Symbol):
+                    inputs.append(kwargs.pop(k))
+        return _create(op_name, inputs, kwargs, name=name)
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    return fn
+
+
+def populate(namespace):
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        f = _make_sym_func(name)
+        namespace[name] = f
+        for alias in op.aliases:
+            namespace.setdefault(alias, f)
+    return namespace

@@ -1,0 +1,713 @@
+"""Symbol — declarative graph API staged onto XLA.
+
+Reference: python/mxnet/symbol/symbol.py (class Symbol, simple_bind:1289,
+infer_shape, save/load JSON) and the nnvm graph it fronts.
+
+TPU-native design: a Symbol is a lightweight DAG of op nodes.  There is
+no separate GraphExecutor memory planner / engine — ``bind`` builds a
+*pure jax function* by topologically evaluating the DAG with jax values
+and jits it (executor.py); XLA then does scheduling, fusion, memory
+planning and rematerialization (SURVEY.md §7 design stance).  JSON
+save/load keeps the nnvm-style {nodes, arg_nodes, heads} structure so
+checkpoints look familiar.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..base import AttrScope, MXNetError, NameManager
+from ..ops import registry as _reg
+from ..ops.registry import OP_AUX_INPUTS, OP_INPUT_NAMES, OP_LABEL_INPUTS
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "attr_dict")
+
+    def __init__(self, op, name, attrs, inputs, num_outputs=1, attr_dict=None):
+        self.op = op  # None for variables
+        self.name = name
+        self.attrs = attrs  # op attrs (hashable canonical form)
+        self.inputs = inputs  # list of (node, out_index)
+        self.num_outputs = num_outputs
+        self.attr_dict = attr_dict or {}  # user attrs (ctx_group, lr_mult...)
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+
+class Symbol:
+    """An output list of graph nodes (reference: symbol.py Symbol)."""
+
+    def __init__(self, outputs):
+        self._outputs = outputs  # list of (node, out_index)
+
+    # ---------------------------------------------------------- topology
+    def _topo_nodes(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self):
+        """Input variable names in topo order (reference: ListArguments)."""
+        aux = set(self._aux_nodes())
+        return [n.name for n in self._topo_nodes()
+                if n.is_variable and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_nodes()
+        return [n.name for n in self._topo_nodes() if id(n) in aux]
+
+    def _aux_nodes(self):
+        """ids of variable nodes feeding aux input slots."""
+        aux_ids = set()
+        for node in self._topo_nodes():
+            if node.op is None:
+                continue
+            aux_names = OP_AUX_INPUTS.get(node.op, ())
+            if not aux_names:
+                continue
+            input_names = OP_INPUT_NAMES.get(node.op, ())
+            for (inp, _), iname in zip(node.inputs, input_names):
+                if iname in aux_names and inp.is_variable:
+                    aux_ids.add(id(inp))
+        return aux_ids
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.num_outputs > 1:
+                names.append("%s_output%d" % (node.name, idx))
+            else:
+                names.append(node.name + "_output" if not node.is_variable
+                             else node.name)
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_variable]
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group [%s]" % ", ".join(
+            n.name for n, _ in self._outputs))
+
+    def __iter__(self):
+        return (Symbol([out]) for out in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            outs = self.list_outputs()
+            index = outs.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # ---------------------------------------------------------- attrs
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attr_dict.get(key)
+        return None
+
+    def list_attr(self):
+        if len(self._outputs) == 1:
+            return dict(self._outputs[0][0].attr_dict)
+        return {}
+
+    def attr_dict(self):
+        ret = {}
+        for node in self._topo_nodes():
+            d = dict(node.attr_dict)
+            if node.op is not None:
+                d.update({k: str(v) for k, v in node.attrs.items()})
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node.attr_dict.update({k: str(v) for k, v in kwargs.items()})
+
+    # ---------------------------------------------------------- arithmetic
+    def _binop(self, other, opname, scalarname, reverse=False):
+        if isinstance(other, Symbol):
+            args = (other, self) if reverse else (self, other)
+            return _create(opname, list(args), {})
+        if isinstance(other, (int, float)):
+            sname = scalarname
+            if reverse and "_r" + scalarname[1:] in _REV_SCALARS:
+                sname = "_r" + scalarname[1:]
+            return _create(sname, [self], {"scalar": float(other)})
+        raise TypeError("unsupported operand: %r" % (other,))
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "elemwise_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __eq__(self, o):
+        return self._binop(o, "elemwise_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binop(o, "elemwise_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "elemwise_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "elemwise_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "elemwise_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "elemwise_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # sugar mirroring NDArray
+    def reshape(self, shape, **kw):
+        return _create("Reshape", [self], {"shape": shape, **kw})
+
+    def sum(self, axis=None, keepdims=False):
+        return _create("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _create("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return _create("transpose", [self], {"axes": axes})
+
+    def softmax(self, axis=-1):
+        return _create("softmax", [self], {"axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return _create("slice_axis", [self], {"axis": axis, "begin": begin,
+                                              "end": end})
+
+    def astype(self, dtype):
+        return _create("Cast", [self], {"dtype": str(_np.dtype(dtype))})
+
+    def get_internals(self):
+        """All intermediate outputs as a grouped symbol
+        (reference: Symbol.get_internals)."""
+        outs = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        if len(self._outputs) != 1:
+            return None
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ---------------------------------------------------------- inference
+    def infer_shape(self, *args, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) via jax.eval_shape
+        (reference: infer_shape → fixpoint pass infer_graph_attr_pass.cc;
+        here shape propagation is exact tracing, no fixpoint needed)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except Exception:
+            # partial infer falls back to the same impl with skips
+            return self.infer_shape_partial(*args, **kwargs)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = shape
+        known.update({k: v for k, v in kwargs.items() if v is not None})
+
+        # infer missing parameter shapes structurally: evaluate with
+        # shape-polymorphic placeholders is impossible; instead require
+        # data-like inputs and derive parameter shapes via op semantics.
+        shapes = _infer_param_shapes(self, known)
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        if not partial and any(s is None for s in arg_shapes + aux_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("infer_shape: cannot infer %s" % missing)
+
+        out_shapes = None
+        if all(s is not None for s in arg_shapes + aux_shapes):
+            from ..executor import make_eval_fn
+
+            fn, _meta = make_eval_fn(self, is_train=False)
+            arg_avals = [jax.ShapeDtypeStruct(tuple(s), _np.float32)
+                         for s in arg_shapes]
+            aux_avals = [jax.ShapeDtypeStruct(tuple(s), _np.float32)
+                         for s in aux_shapes]
+            outs = jax.eval_shape(fn, arg_avals, aux_avals, 0)
+            out_shapes = [tuple(o.shape) for o in outs[0]]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtype = _np.float32
+        if args:
+            dtype = _np.dtype(args[0]) if args[0] is not None else _np.float32
+        return ([_np.dtype(dtype)] * len(arg_names),
+                [_np.dtype(dtype)] * len(self._outputs),
+                [_np.dtype(dtype)] * len(self.list_auxiliary_states()))
+
+    # ---------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arg/grad arrays from inferred shapes and bind
+        (reference: symbol.py:1289 → MXExecutorSimpleBind)."""
+        from ..context import current_context
+        from ..executor import Executor
+        from ..ndarray import zeros
+
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+        if missing or any(s is None for s in aux_shapes):
+            raise MXNetError(
+                "simple_bind: cannot infer shapes for %s — provide input "
+                "shapes (e.g. data=(batch, ...))" % (missing,))
+        type_dict = type_dict or {}
+        args = [zeros(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
+                for n, s in zip(arg_names, arg_shapes)]
+        aux = [zeros(s, ctx=ctx) for s in aux_shapes]
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, list):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        grads = {n: zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)
+                 if reqs.get(n, "write") != "null"}
+        return Executor(self, ctx, args, grads, reqs, aux,
+                        shared_buffer=shared_buffer)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        """reference: symbol.py bind → GraphExecutor::Bind."""
+        from ..executor import Executor
+
+        arg_names = self.list_arguments()
+        if isinstance(args, dict):
+            args = [args[n] for n in arg_names]
+        if isinstance(args_grad, dict):
+            grads = args_grad
+        elif isinstance(args_grad, (list, tuple)):
+            grads = dict(zip(arg_names, args_grad))
+        elif args_grad is None:
+            grads = {}
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, list):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = dict(grad_req)
+        if aux_states is None:
+            aux_states = []
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, dict):
+            aux_states = [aux_states[n] for n in aux_names]
+        return Executor(self, ctx, list(args), grads, reqs, list(aux_states))
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def grad(self, wrt):  # pragma: no cover - parity stub
+        raise MXNetError("Symbol.grad is deprecated in the reference; "
+                         "use bind(grad_req=...) + backward")
+
+    # ---------------------------------------------------------- serialization
+    def tojson(self):
+        """nnvm-style JSON (reference: MXSymbolSaveToJSON)."""
+        nodes = self._topo_nodes()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.is_variable:
+                arg_nodes.append(i)
+            jnodes.append({
+                "op": n.op or "null",
+                "name": n.name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in (n.attrs or {}).items()},
+                "inputs": [[node_ids[id(inp)], idx, 0] for inp, idx in n.inputs],
+            })
+        heads = [[node_ids[id(n)], idx, 0] for n, idx in self._outputs]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10500],
+                                     "mxnet_tpu": ["int", 1]}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # execution sugar
+    def __call__(self, *args, **kwargs):
+        s = self.__copy__()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _compose(self, *args, **kwargs):
+        """Replace variable inputs with other symbols (reference: composition).
+
+        Rebuilds the node graph so shared upstream symbols are untouched.
+        """
+        name_map = {}
+        if args:
+            vars_ = [n for n in self._topo_nodes() if n.is_variable]
+            for v, a in zip(vars_, args):
+                name_map[v.name] = a
+        name_map.update(kwargs)
+        replaced = {}  # id(old var node) -> (replacement node, out idx)
+        copies = {}    # id(old op node) -> new node
+
+        def map_entry(inp, idx):
+            if id(inp) in replaced:
+                return replaced[id(inp)]
+            if id(inp) in copies:
+                return (copies[id(inp)], idx)
+            return (inp, idx)
+
+        for node in self._topo_nodes():
+            if node.is_variable:
+                if node.name in name_map:
+                    replaced[id(node)] = name_map[node.name]._outputs[0]
+                continue
+            new_inputs = [map_entry(inp, idx) for inp, idx in node.inputs]
+            copies[id(node)] = _Node(node.op, node.name, node.attrs, new_inputs,
+                                     node.num_outputs, dict(node.attr_dict))
+        self._outputs = [map_entry(n, idx) for n, idx in self._outputs]
+
+
+_REV_SCALARS = {"_rminus_scalar", "_rdiv_scalar", "_rmod_scalar", "_rpower_scalar"}
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference: symbol.var)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current().get(attr or {})
+    if shape is not None:
+        attr["__shape__"] = str(shape)
+    if lr_mult is not None:
+        attr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attr["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attr["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        attr["__init__"] = init if isinstance(init, str) else init.dumps()
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            attr[k] = str(v)
+    node = _Node(None, name, {}, [], 1, attr)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one with multiple outputs (reference: sym.Group)."""
+    outputs = []
+    for s in symbols:
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def _create(op_name, input_syms, attrs, name=None):
+    """Create an op node symbol; auto-create missing input variables."""
+    op = _reg.get(op_name)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    attrs = op.canonicalize_attrs(attrs)
+    hint = op.name.lower().lstrip("_")
+    name = NameManager.current().get(name, hint)
+    attr_dict = AttrScope.current().get({})
+
+    inputs = []
+    for s in input_syms:
+        if isinstance(s, Symbol):
+            if len(s._outputs) != 1:
+                raise MXNetError("cannot use grouped symbol as single input")
+            inputs.append(s._outputs[0])
+        else:
+            raise TypeError("symbol inputs must be Symbols")
+
+    # auto-create missing parameter variables (reference autogen behaviour)
+    needed = OP_INPUT_NAMES.get(op.name, ())
+    if needed and len(inputs) < len(needed):
+        no_bias = attrs.get("no_bias", False)
+        use_seq = attrs.get("use_sequence_length", False)
+        for iname in needed[len(inputs):]:
+            if iname == "bias" and no_bias:
+                continue
+            if iname == "sequence_length" and not use_seq:
+                continue
+            if iname in ("data_lengths", "label_lengths"):
+                continue
+            v = Variable("%s_%s" % (name, iname))
+            inputs.append(v._outputs[0])
+
+    nout = op.nout(attrs)
+    node = _Node(op.name, name, attrs, inputs, nout, attr_dict)
+    return Symbol([(node, i) for i in range(nout)]) if nout > 1 else \
+        Symbol([(node, 0)])
+
+
+def load_json(json_str):
+    """Load from nnvm-style JSON (reference: MXSymbolCreateFromJSON;
+    versioned upgrade pass src/nnvm/legacy_json_util.cc is unnecessary —
+    we only load our own v1 format plus plain reference graphs)."""
+    g = json.loads(json_str)
+    jnodes = g["nodes"]
+    nodes = []
+    for jn in jnodes:
+        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        parsed = {}
+        for k, v in attrs.items():
+            parsed[k] = _parse_attr_value(v)
+        op = jn["op"] if jn["op"] != "null" else None
+        inputs = [(nodes[i], idx) for i, idx, *_ in jn.get("inputs", [])]
+        nout = 1
+        if op is not None:
+            try:
+                nout = _reg.get(op).nout(_reg.get(op).canonicalize_attrs(parsed))
+            except MXNetError:
+                pass
+        node = _Node(op, jn["name"], parsed if op else {}, inputs, nout)
+        nodes.append(node)
+    heads = [(nodes[i], idx) for i, idx, *_ in g["heads"]]
+    return Symbol(heads)
+
+
+def _parse_attr_value(v):
+    if not isinstance(v, str):
+        return v
+    try:
+        return json.loads(v)
+    except (ValueError, TypeError):
+        pass
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    if v.startswith("(") and v.endswith(")"):
+        try:
+            inner = v[1:-1].strip().rstrip(",")
+            if not inner:
+                return ()
+            return tuple(int(x) if "." not in x else float(x)
+                         for x in inner.split(","))
+        except ValueError:
+            pass
+    return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _infer_param_shapes(symbol, known):
+    """Forward shape propagation through the DAG, solving parameter
+    shapes from op semantics (the TPU analog of the reference's shape
+    inference attributes, src/executor/infer_graph_attr_pass.cc:325)."""
+    shapes = dict(known)
+    node_out_shapes = {}
+
+    def get_in_shapes(node):
+        res = []
+        for inp, idx in node.inputs:
+            if inp.is_variable:
+                res.append(tuple(shapes[inp.name]) if inp.name in shapes else None)
+            else:
+                outs = node_out_shapes.get(id(inp))
+                res.append(None if outs is None else outs[idx])
+        return res
+
+    import jax
+
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            if node.name not in shapes and "__shape__" in node.attr_dict:
+                shapes[node.name] = tuple(
+                    _parse_attr_value(node.attr_dict["__shape__"]))
+            continue
+        in_shapes = get_in_shapes(node)
+        # solve unknown parameter-variable shapes from op semantics
+        _solve_params(node, in_shapes, shapes)
+        in_shapes = get_in_shapes(node)
+        if any(s is None for s in in_shapes):
+            node_out_shapes[id(node)] = None
+            continue
+        op = _reg.get(node.op)
+        fn = op.bind_attrs(node.attrs)
+        try:
+            avals = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
+            if node.op in _RANDOMISH:
+                out = jax.eval_shape(lambda *xs: fn(jax.random.PRNGKey(0), *xs),
+                                     *avals)
+            else:
+                out = jax.eval_shape(fn, *avals)
+        except Exception:
+            node_out_shapes[id(node)] = None
+            continue
+        if isinstance(out, (tuple, list)):
+            node_out_shapes[id(node)] = [tuple(o.shape) for o in out]
+        else:
+            node_out_shapes[id(node)] = [tuple(out.shape)]
+    return shapes
+
+
+_RANDOMISH = {"Dropout"}
+
+
+def _solve_params(node, in_shapes, shapes):
+    """Derive parameter shapes for common layers (FC/conv/BN/embedding)."""
+    names = OP_INPUT_NAMES.get(node.op, ())
+    if not names or in_shapes[0] is None:
+        return
+    data_shape = in_shapes[0]
+    a = node.attrs
+
+    def setv(i, shape):
+        inp, _ = node.inputs[i]
+        if inp.is_variable and inp.name not in shapes:
+            shapes[inp.name] = tuple(int(x) for x in shape)
+
+    if node.op == "FullyConnected":
+        nh = int(a.get("num_hidden", 1))
+        flat = a.get("flatten", True)
+        in_dim = int(_np.prod(data_shape[1:])) if flat else data_shape[-1]
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "weight":
+                setv(i, (nh, in_dim))
+            elif nm == "bias":
+                setv(i, (nh,))
+    elif node.op in ("Convolution", "Deconvolution"):
+        k = tuple(a.get("kernel", ()))
+        nf = int(a.get("num_filter", 1))
+        ng = int(a.get("num_group", 1))
+        cin = data_shape[1]
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "weight":
+                if node.op == "Convolution":
+                    setv(i, (nf, cin // ng) + k)
+                else:
+                    setv(i, (cin, nf // ng) + k)
+            elif nm == "bias":
+                setv(i, (nf,))
+    elif node.op in ("BatchNorm",):
+        ax = int(a.get("axis", 1)) % len(data_shape)
+        c = data_shape[ax]
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm != "data":
+                setv(i, (c,))
+    elif node.op in ("LayerNorm",):
+        ax = int(a.get("axis", -1)) % len(data_shape)
+        c = data_shape[ax]
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm != "data":
+                setv(i, (c,))
+    elif node.op == "InstanceNorm":
+        c = data_shape[1]
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm != "data":
+                setv(i, (c,))
+    elif node.op == "Embedding":
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "weight":
+                setv(i, (int(a.get("input_dim", 1)), int(a.get("output_dim", 1))))
+    elif node.op == "LeakyReLU" and a.get("act_type") == "prelu":
+        if len(node.inputs) > 1:
+            setv(1, (data_shape[1],))
+    elif node.op in OP_LABEL_INPUTS:
+        # label shape mirrors data minus class axis for SoftmaxOutput
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "label":
+                if node.op == "SoftmaxOutput":
+                    if a.get("multi_output"):
+                        setv(i, (data_shape[0],) + data_shape[2:])
+                    else:
+                        setv(i, data_shape[:-1])
+                else:
+                    setv(i, data_shape)

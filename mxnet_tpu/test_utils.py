@@ -1,0 +1,267 @@
+"""Test utilities (reference: python/mxnet/test_utils.py):
+assert_almost_equal, check_numeric_gradient, check_symbolic_forward/
+backward, check_consistency (eager-vs-jit-vs-sharded on TPU instead of
+cpu-vs-gpu), rand_ndarray, default contexts.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+_rng = _np.random.RandomState(1234)
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._default_ctx.value = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return tuple(_rng.randint(1, d + 1) for d in (dim0, dim1, dim2))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution="uniform"):
+    if distribution == "normal":
+        data = _rng.standard_normal(shape)
+    else:
+        data = _rng.uniform(-1, 1, size=shape)
+    arr = array(data.astype(dtype or _np.float32))
+    if stype != "default":
+        return arr.tostype(stype)
+    return arr
+
+
+def random_arrays(*shapes):
+    arrays = [_rng.standard_normal(size=s).astype(_np.float32) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    diff = _np.abs(a - b)
+    tol = atol + rtol * _np.abs(b)
+    violation = diff / (tol + 1e-37)
+    idx = _np.unravel_index(_np.argmax(violation), violation.shape) \
+        if violation.size else ()
+    return idx, violation.max() if violation.size else 0.0
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """reference: test_utils.assert_almost_equal."""
+    a_np, b_np = _as_np(a), _as_np(b)
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    if not _np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        idx, max_v = find_max_violation(a_np, b_np, rtol, atol)
+        raise AssertionError(
+            "Items are not equal (rtol=%g, atol=%g): max violation %.4g at %s\n"
+            " %s: %s\n %s: %s" % (rtol, atol, max_v, idx, names[0],
+                                  a_np.flat[:10], names[1], b_np.flat[:10]))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    return _np.allclose(_as_np(a), _as_np(b), rtol=rtol or 1e-5,
+                        atol=atol or 1e-20, equal_nan=equal_nan)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype=_np.float32):
+    """Compare symbolic gradients to central finite differences
+    (reference: test_utils.check_numeric_gradient — the backbone of
+    test_operator.py)."""
+    ctx = ctx or current_context()
+    if isinstance(location, (list, tuple)):
+        arg_names = sym.list_arguments()
+        location = dict(zip(arg_names, location))
+    location = {k: (v if isinstance(v, NDArray) else array(v, dtype=dtype))
+                for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = list(location.keys())
+
+    ex = sym.bind(ctx, {k: v.copy() for k, v in location.items()},
+                  args_grad={k: array(_np.zeros(v.shape, dtype=dtype))
+                             for k, v in location.items() if k in grad_nodes},
+                  grad_req={k: ("write" if k in grad_nodes else "null")
+                            for k in location},
+                  aux_states=aux_states)
+    ex.forward(is_train=use_forward_train)
+    out = ex.outputs[0]
+    ograd = array(_np.ones(out.shape, dtype=dtype))
+    ex.backward([ograd])
+    sym_grads = {k: ex.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    def loss_at(loc):
+        ex2 = sym.bind(ctx, {k: array(v) for k, v in loc.items()},
+                       args_grad=None, grad_req={k: "null" for k in loc},
+                       aux_states=aux_states)
+        ex2.forward(is_train=use_forward_train)
+        return ex2.outputs[0].asnumpy().sum()
+
+    base = {k: v.asnumpy().astype(_np.float64) for k, v in location.items()}
+    for name in grad_nodes:
+        arr = base[name]
+        num_grad = _np.zeros_like(arr)
+        flat = arr.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps / 2
+            fp = loss_at(base)
+            flat[i] = orig - numeric_eps / 2
+            fm = loss_at(base)
+            flat[i] = orig
+            ng_flat[i] = (fp - fm) / numeric_eps
+        assert_almost_equal(sym_grads[name], num_grad, rtol=rtol,
+                            atol=atol or 1e-4,
+                            names=("symbolic_grad(%s)" % name, "numeric_grad"))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=_np.float32):
+    """reference: test_utils.check_symbolic_forward."""
+    ctx = ctx or current_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    args = {k: (v if isinstance(v, NDArray) else array(v, dtype=dtype))
+            for k, v in location.items()}
+    ex = sym.bind(ctx, args, grad_req={k: "null" for k in args},
+                  aux_states=aux_states)
+    outputs = ex.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, dtype=_np.float32):
+    """reference: test_utils.check_symbolic_backward."""
+    ctx = ctx or current_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    args = {k: (v if isinstance(v, NDArray) else array(v, dtype=dtype))
+            for k, v in location.items()}
+    grads = {k: array(_np.zeros(v.shape, dtype=dtype)) for k, v in args.items()}
+    ex = sym.bind(ctx, args, args_grad=grads, grad_req=grad_req,
+                  aux_states=aux_states)
+    ex.forward(is_train=True)
+    ogs = [g if isinstance(g, NDArray) else array(g, dtype=dtype)
+           for g in (out_grads if isinstance(out_grads, (list, tuple))
+                     else [out_grads])]
+    ex.backward(ogs)
+    for name, exp in expected.items():
+        assert_almost_equal(ex.grad_dict[name], exp, rtol=rtol, atol=atol,
+                            names=("grad(%s)" % name, "expected"))
+    return {k: v.asnumpy() for k, v in ex.grad_dict.items()}
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, rtol=1e-4, atol=1e-4,
+                      arg_params=None, aux_params=None, raise_on_err=True):
+    """Cross-backend consistency: run the symbol (a) eagerly op-by-op via
+    NDArray, (b) staged via the jitted Executor, (c) on every available
+    device context — and compare.
+
+    This is the TPU analog of the reference's cpu-vs-gpu
+    check_consistency (tests/python/gpu/test_operator_gpu.py).
+    """
+    import jax
+
+    if ctx_list is None:
+        ctx_list = [{"ctx": cpu()}]
+        if any(d.platform != "cpu" for d in jax.devices()):
+            from .context import tpu
+
+            ctx_list.append({"ctx": tpu()})
+    arg_names = sym.list_arguments()
+    shapes = {}
+    for spec in ctx_list:
+        for k, v in spec.items():
+            if k != "ctx" and k != "type_dict":
+                shapes[k] = v
+    results = []
+    base_args = None
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        ex = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+        if base_args is None:
+            base_args = {}
+            for name in arg_names:
+                arr = _rng.standard_normal(ex.arg_dict[name].shape) * scale
+                base_args[name] = arr.astype(_np.float32)
+        for name in arg_names:
+            ex.arg_dict[name][:] = base_args[name]
+        outs = ex.forward(is_train=False)
+        results.append([o.asnumpy() for o in outs])
+    ref = results[0]
+    for res in results[1:]:
+        for a, b in zip(ref, res):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol)
+    return results
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ex = sym.bind(ctx or current_context(),
+                  {k: array(v) for k, v in inputs.items()})
+    outputs = ex.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in outputs]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+class DummyIter:
+    """Repeat one batch forever (reference: test_utils.DummyIter)."""
+
+    def __init__(self, real_iter):
+        self.real_iter = real_iter
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.the_batch = next(real_iter)
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        return self.the_batch
+
+    __next__ = next

@@ -1,0 +1,121 @@
+"""Network visualization (reference: python/mxnet/visualization.py):
+print_summary (layer table with params/shapes) and plot_network
+(graphviz dot source; rendering optional).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64,
+                                                                  0.74, 1.0)):
+    """reference: visualization.print_summary."""
+    if shape is None:
+        raise MXNetError("Input shape is required to print the summary")
+    show_shape = True
+    _, out_shapes, _ = symbol.infer_shape(**shape)
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+    arg_names = symbol.list_arguments()
+    arg_shape_dict = dict(zip(arg_names, arg_shapes))
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"],
+              positions)
+    print("=" * line_length)
+    total_params = 0
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        pre_nodes = [nodes[item[0]]["name"] for item in node["inputs"]
+                     if nodes[item[0]]["op"] != "null"]
+        cur_param = 0
+        for item in node["inputs"]:
+            input_name = nodes[item[0]]["name"]
+            if nodes[item[0]]["op"] == "null" and input_name in arg_shape_dict:
+                if input_name.startswith(name):
+                    cur_param += int(_np.prod(arg_shape_dict[input_name]))
+        first_connection = pre_nodes[0] if pre_nodes else ""
+        fields = ["%s(%s)" % (name, op), "", cur_param, first_connection]
+        print_row(fields, positions)
+        for conn in pre_nodes[1:]:
+            print_row(["", "", "", conn], positions)
+        total_params += cur_param
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Graphviz dot source for the graph (reference: plot_network).
+
+    Returns a source-holding object with ``.source`` and ``.render``;
+    uses the graphviz package if installed, else a minimal stand-in.
+    """
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    lines = ["digraph %s {" % json.dumps(title), "  rankdir=BT;"]
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and not (name.endswith("data") or
+                                     name.endswith("label")):
+                continue
+            lines.append('  n%d [label="%s", shape=oval];' % (i, name))
+        else:
+            label = "%s\\n%s" % (op, name)
+            lines.append('  n%d [label="%s", shape=box];' % (i, label))
+    visible = {i for i, n in enumerate(nodes)
+               if n["op"] != "null" or not hide_weights
+               or n["name"].endswith("data") or n["name"].endswith("label")}
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            j = item[0]
+            if j in visible:
+                lines.append("  n%d -> n%d;" % (j, i))
+    lines.append("}")
+    source = "\n".join(lines)
+    try:
+        import graphviz
+
+        dot = graphviz.Source(source)
+        return dot
+    except ImportError:
+        class _Dot:
+            def __init__(self, src):
+                self.source = src
+
+            def render(self, filename=None, **kwargs):
+                fname = (filename or title) + ".dot"
+                with open(fname, "w") as f:
+                    f.write(self.source)
+                return fname
+
+            def _repr_svg_(self):
+                return None
+
+        return _Dot(source)

@@ -1,0 +1,29 @@
+"""Test config: force the CPU platform with 8 virtual devices.
+
+Mirrors the reference test strategy (SURVEY.md §4): distributed semantics
+are tested with local multi-device processes, like `launch.py -n 4`, but
+here via XLA's virtual host devices instead of spawning workers.
+
+Must run before any jax import (pytest imports conftest first).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Reproducible-yet-varied tests (reference: tests/python/unittest/
+    common.py with_seed decorator)."""
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    np.random.seed(42)
+    yield

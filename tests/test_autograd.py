@@ -1,0 +1,118 @@
+"""Autograd tests (mirrors reference tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 4 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = mx.nd.array([[0.5, -1.0], [2.0, 0.0]])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x) * mx.nd.sigmoid(x)
+        z = y.sum()
+    z.backward()
+    xn = x.asnumpy()
+    sig = 1 / (1 + np.exp(-xn))
+    expected = np.exp(xn) * sig + np.exp(xn) * sig * (1 - sig)
+    assert_almost_equal(x.grad, expected, rtol=1e-4)
+
+
+def test_multiple_variables():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy())
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_req_add():
+    w = mx.nd.array([2.0])
+    w.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            loss = (w * w).sum()
+        loss.backward()
+    assert_almost_equal(w.grad, np.array([12.0]))
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0]))
+
+
+def test_grad_function():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    (gx,) = ag.grad(y, [x])
+    assert_almost_equal(gx, np.array([6.0]))
+
+
+def test_detach_stops_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).detach() * x  # only the outer x should contribute
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+
+
+def test_training_modes():
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_training()
+        assert ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+            assert ag.is_recording()
+    with ag.pause():
+        assert not ag.is_recording()
+
+
+def test_backward_without_record_raises():
+    x = mx.nd.ones((2,))
+    with pytest.raises(mx.MXNetError):
+        x.backward()
+
+
+def test_retain_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert_almost_equal(x.grad, g1)
+
+
+def test_dropout_respects_modes():
+    x = mx.nd.ones((100,))
+    with ag.record(train_mode=False):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 1).all()
+    with ag.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 0).any()

@@ -1,0 +1,252 @@
+"""Metric / IO / KVStore tests (mirrors reference test_metric.py,
+test_io.py, test_kvstore.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ------------------------------------------------------------------- metric
+
+def test_accuracy():
+    m = mx.metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2.0 / 3)
+
+
+def test_topk():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.8, 0.1, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [1.0]])
+    for name, expected in [("mse", (0.25 + 1.0) / 2),
+                           ("mae", (0.5 + 1.0) / 2),
+                           ("rmse", np.sqrt((0.25 + 1.0) / 2))]:
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(expected), name
+
+
+def test_f1_and_composite():
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    f1 = mx.metric.create("f1")
+    f1.update([label], [pred])
+    assert 0 < f1.get()[1] <= 1.0
+    comp = mx.metric.create(["acc", "f1"])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert len(names) == 2
+
+
+def test_perplexity_and_ce():
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    ce = mx.metric.create("ce")
+    ce.update([label], [pred])
+    expected = -(np.log(0.75) + np.log(0.5)) / 2
+    assert ce.get()[1] == pytest.approx(expected, rel=1e-4)
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+
+    m = mx.metric.np(feval)
+    m.update([mx.nd.array([1.0])], [mx.nd.array([0.5])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------------- io
+
+def test_ndarray_iter():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, None, batch_size=3, shuffle=True,
+                           last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 3
+
+
+def test_mnist_iter():
+    it = mx.io.MNISTIter(image="train", batch_size=50, flat=False)
+    batch = next(it)
+    assert batch.data[0].shape == (50, 1, 28, 28)
+    assert batch.label[0].shape == (50,)
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    np.savetxt(data_path, np.random.rand(10, 3), delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,), batch_size=5)
+    batch = next(it)
+    assert batch.data[0].shape == (5, 3)
+
+
+def test_prefetching_iter():
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(x, None, batch_size=4)
+    pf = mx.io.PrefetchingIter(base)
+    batches = [b for b in iter(pf.next, None) if b][:3] if False else []
+    # simple drain loop
+    count = 0
+    try:
+        while True:
+            pf.next()
+            count += 1
+    except StopIteration:
+        pass
+    assert count == 3
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "test.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        rec.write(b"payload%d" % i)
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    items = []
+    while True:
+        item = rec.read()
+        if item is None:
+            break
+        items.append(item)
+    assert items == [b"payload%d" % i for i in range(5)]
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(4):
+        rec.write_idx(i, b"rec%d" % i)
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert rec.read_idx(2) == b"rec2"
+    assert rec.keys == [0, 1, 2, 3]
+
+
+def test_pack_unpack_img(tmp_path):
+    from mxnet_tpu import recordio
+
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    packed = recordio.pack_img(recordio.IRHeader(0, 3.0, 7, 0), img)
+    header, out = recordio.unpack_img(packed)
+    assert header.label == 3.0
+    assert out.shape[0] == 8
+
+
+# ------------------------------------------------------------------- kvstore
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2, 2)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 1).all()
+    kv.push("w", mx.nd.ones((2, 2)) * 2)
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 3).all()  # no updater → accumulate
+
+
+def test_kvstore_multi_device_reduce():
+    kv = mx.kv.create("device")
+    kv.init(3, mx.nd.zeros((2,)))
+    grads = [mx.nd.array([1.0, 2.0]), mx.nd.array([3.0, 4.0])]
+    kv.push(3, grads)
+    out = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+    kv.pull(3, out=out)
+    assert_almost_equal(out[0], np.array([4.0, 6.0]))
+    assert_almost_equal(out[1], np.array([4.0, 6.0]))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2,)))
+
+    def update(key, grad, weight):
+        weight -= 0.1 * grad
+
+    kv.set_updater(update)
+    kv.push("w", mx.nd.ones((2,)))
+    out = mx.nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, np.array([0.9, 0.9]))
+
+
+def test_kvstore_optimizer():
+    kv = mx.kv.create("local")
+    kv.init("0", mx.nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    kv.push("0", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull("0", out=out)
+    assert_almost_equal(out, np.full(3, 0.5))
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3)))
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 3]))
+    on = out.asnumpy()
+    assert (on[0] == 0).all() and (on[2] == 0).all()
+    assert (on[1] == [3, 4, 5]).all()
+
+
+def test_gradient_compression_2bit():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", mx.nd.array([0.6, -0.6, 0.2, 0.0]))
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, np.array([0.5, -0.5, 0.0, 0.0]))
+    # error feedback: residual 0.1+0.2=0.3 short of threshold, next push adds
+    kv.push("w", mx.nd.array([0.3, 0.0, 0.4, 0.0]))
+    kv.pull("w", out=out)
+    # 0.3+residual(0.1)=0.4 <0.5 → 0 ; 0.2+0.4=0.6 → +0.5
+    assert_almost_equal(out, np.array([0.5, -0.6 + 0.0 - -0.1 * 0, 0.5, 0.0]),
+                        atol=0.11)
+
+
+def test_kvstore_type_and_rank():
+    kv = mx.kv.create("tpu")
+    assert kv.type == "tpu"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_kvstore_errors():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push("nope", mx.nd.ones((1,)))
+    kv.init("a", mx.nd.ones((1,)))
+    with pytest.raises(mx.MXNetError):
+        kv.init("a", mx.nd.ones((1,)))

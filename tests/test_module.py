@@ -1,0 +1,137 @@
+"""Module API tests (mirrors reference tests/python/unittest/test_module.py
++ tests/python/train convergence tests)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_sym(nh=32, nclass=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=nclass, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=400, dim=10, nclass=4, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, nclass, n)
+    centers = rng.randn(nclass, dim) * 3
+    x = centers[y] + rng.randn(n, dim) * 0.5
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_predict():
+    x, y = _toy_data(80)
+    train = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd")
+    out = mod.predict(mx.io.NDArrayIter(x, y, batch_size=20))
+    assert out.shape == (80, 4)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(80), rtol=1e-4)
+
+
+def test_module_checkpoint(tmp_path):
+    x, y = _toy_data(80)
+    train = mx.io.NDArrayIter(x, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd")
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    out1 = mod.predict(mx.io.NDArrayIter(x, y, batch_size=20))
+    out2 = mod2.predict(mx.io.NDArrayIter(x, y, batch_size=20))
+    assert_almost_equal(out1, out2, rtol=1e-5)
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.One())
+    args, auxs = mod.get_params()
+    assert (args["fc1_weight"].asnumpy() == 1).all()
+    args["fc1_weight"][:] = 2.0
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert (args2["fc1_weight"].asnumpy() == 2).all()
+
+
+def test_module_adam_and_momentum():
+    x, y = _toy_data(200)
+    for opt, params in [("adam", {"learning_rate": 0.01}),
+                        ("sgd", {"learning_rate": 0.1, "momentum": 0.9})]:
+        train = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(train, num_epoch=4, optimizer=opt, optimizer_params=params)
+        score = mod.score(mx.io.NDArrayIter(x, y, batch_size=50), "acc")
+        assert score[0][1] > 0.9, (opt, score)
+
+
+def test_module_multi_device_exec():
+    """Batch slicing across two (virtual) cpu contexts
+    (mirrors test_multi_device_exec.py)."""
+    x, y = _toy_data(200)
+    train = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2}, kvstore="local")
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_input_grads():
+    x, y = _toy_data(8)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward_backward(batch)
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (8, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """Variable-length 'sequences' via bucketing (mirrors BucketingModule
+    usage; per-bucket jit = XLA shape buckets)."""
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    x, y = _toy_data(40)
+    batch10 = mx.io.DataBatch(data=[mx.nd.array(x[:20])],
+                              label=[mx.nd.array(y[:20])],
+                              bucket_key=10,
+                              provide_data=[("data", (20, 10))],
+                              provide_label=[("softmax_label", (20,))])
+    mod.bind(data_shapes=[("data", (20, 10))],
+             label_shapes=[("softmax_label", (20,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    mod.forward(batch10)
+    mod.backward()
+    mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (20, 4) or out.shape == (20, 8)

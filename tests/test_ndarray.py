@@ -1,0 +1,179 @@
+"""NDArray tests (mirrors reference tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1, 1, 1]
+    c = mx.nd.full((2, 2), 7.5)
+    assert (c.asnumpy() == 7.5).all()
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.size == 4 and d.ndim == 2
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[4.0, 3.0], [2.0, 1.0]])
+    assert_almost_equal(a + b, np.full((2, 2), 5.0))
+    assert_almost_equal(a - b, a.asnumpy() - b.asnumpy())
+    assert_almost_equal(a * 2 + 1, a.asnumpy() * 2 + 1)
+    assert_almost_equal(1.0 / a, 1.0 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(2 - a, 2 - a.asnumpy())
+    assert_almost_equal((a > 2), (a.asnumpy() > 2).astype(np.float32))
+
+
+def test_inplace():
+    a = mx.nd.ones((3,))
+    a += 2
+    assert (a.asnumpy() == 3).all()
+    a *= 2
+    assert (a.asnumpy() == 6).all()
+    a /= 3
+    assert (a.asnumpy() == 2).all()
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    assert a[1].shape == (4,)
+    assert a[1, 2].asscalar() == 6
+    assert a[0:2].shape == (2, 4)
+    a[0, 0] = 100.0
+    assert a[0, 0].asscalar() == 100
+    a[1] = 0
+    assert (a[1].asnumpy() == 0).all()
+    # fancy indexing copies
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert a[idx].shape == (2, 4)
+
+
+def test_view_writeback():
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    v = a[0:1]
+    v[:] = -1
+    assert (a.asnumpy()[0] == -1).all()
+
+
+def test_reshape_transpose():
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.reshape((3, 2)).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.T.shape == (3, 2)
+    assert a.reshape((0, -1)).shape == (2, 3)
+    assert mx.nd.Reshape(a, shape=(-2,)).shape == (2, 3)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.expand_dims(0).squeeze(0).shape == (2, 3)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(a.sum(), x.sum(), rtol=1e-4)
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1), rtol=1e-4)
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean(axis=(0, 2)), rtol=1e-4)
+    assert_almost_equal(a.max(axis=0), x.max(axis=0))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(mx.nd.sum(a, axis=1, exclude=True),
+                        x.sum(axis=(0, 2)), rtol=1e-4)
+    assert a.argmax(axis=2).shape == (3, 4)
+
+
+def test_dot():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(5, 3).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b,
+                        rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True),
+        a @ b, rtol=1e-4)
+    x = np.random.rand(2, 4, 5).astype(np.float32)
+    y = np.random.rand(2, 5, 3).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y)),
+                        x @ y, rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = mx.nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_copyto_and_context():
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    assert (b.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    d = {"w": mx.nd.array([1.0, 2.0]), "b": mx.nd.ones((2, 2))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    fname2 = str(tmp_path / "nd_list.params")
+    mx.nd.save(fname2, [mx.nd.ones((3,))])
+    ll = mx.nd.load(fname2)
+    assert isinstance(ll, list) and ll[0].shape == (3,)
+
+
+def test_astype_dtypes():
+    a = mx.nd.ones((2, 2))
+    assert a.astype("float16").dtype == np.float16
+    assert a.astype(np.int32).dtype == np.int32
+    import mxnet_tpu.base as base
+
+    if base.bfloat16 is not None:
+        assert a.astype("bfloat16").dtype == base.bfloat16
+
+
+def test_wait_sync():
+    a = mx.nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert (b.asnumpy() == 2).all()
+
+
+def test_take_onehot_pick():
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert_almost_equal(mx.nd.take(w, idx), w.asnumpy()[[0, 2]])
+    oh = mx.nd.one_hot(idx, depth=4)
+    assert oh.shape == (2, 4)
+    assert oh.asnumpy()[1, 2] == 1.0
+    x = mx.nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    p = mx.nd.pick(x, mx.nd.array([0, 2]), axis=1)
+    assert p.asnumpy().tolist() == [1.0, 6.0]
+
+
+def test_error_on_unknown_op():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.imperative_invoke("BogusOp", [], {})
+
+
+def test_sparse_facades():
+    dense = np.array([[1, 0], [0, 0], [3, 4]], dtype=np.float32)
+    rs = mx.nd.sparse.row_sparse_array(dense, shape=dense.shape)
+    assert rs.stype == "row_sparse"
+    assert_almost_equal(rs.tostype("default"), dense)
+    assert rs.indices.asnumpy().tolist() == [0, 2]
+    csr = mx.nd.sparse.csr_matrix(dense, shape=dense.shape)
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.tostype("default"), dense)

@@ -1,0 +1,288 @@
+"""Operator tests: forward vs numpy + numeric gradients.
+
+Mirrors the reference's largest test file
+(tests/python/unittest/test_operator.py): every op family gets a
+forward check against numpy and key ops get
+check_numeric_gradient.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward)
+
+
+def test_unary_forward():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    a = mx.nd.array(x)
+    cases = {
+        "sqrt": np.sqrt, "exp": np.exp, "log": np.log, "abs": np.abs,
+        "square": np.square, "sign": np.sign, "floor": np.floor,
+        "ceil": np.ceil, "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+    }
+    for name, f in cases.items():
+        out = mx.nd.imperative_invoke(name, [a], {})[0]
+        assert_almost_equal(out, f(x), rtol=1e-4, atol=1e-5)
+
+
+def test_binary_broadcast():
+    x = np.random.rand(2, 3, 1).astype(np.float32)
+    y = np.random.rand(1, 3, 4).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    assert_almost_equal(mx.nd.broadcast_add(a, b), x + y, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_mul(a, b), x * y, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_maximum(a, b), np.maximum(x, y))
+    assert_almost_equal(mx.nd.broadcast_power(a + 1, b), (x + 1) ** y, rtol=1e-4)
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 6).astype(np.float32)
+    w = np.random.rand(3, 6).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+    out2 = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), no_bias=True,
+                                num_hidden=3)
+    assert_almost_equal(out2, x @ w.T, rtol=1e-4)
+
+
+def test_convolution_shapes_and_values():
+    # identity kernel check
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.zeros((1, 1, 3, 3), dtype=np.float32)
+    w[0, 0, 1, 1] = 1.0
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=1, pad=(1, 1), no_bias=True)
+    assert_almost_equal(out, x, rtol=1e-5)
+    # stride/pad shape math
+    out2 = mx.nd.Convolution(mx.nd.ones((2, 3, 8, 8)), mx.nd.ones((4, 3, 3, 3)),
+                             kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                             num_filter=4, no_bias=True)
+    assert out2.shape == (2, 4, 4, 4)
+    # grouped conv
+    out3 = mx.nd.Convolution(mx.nd.ones((1, 4, 4, 4)), mx.nd.ones((4, 2, 3, 3)),
+                             kernel=(3, 3), num_filter=4, num_group=2,
+                             no_bias=True)
+    assert out3.shape == (1, 4, 2, 2)
+
+
+def test_deconvolution_inverts_stride():
+    x = mx.nd.ones((1, 2, 4, 4))
+    w = mx.nd.ones((2, 3, 2, 2))
+    out = mx.nd.Deconvolution(x, w, kernel=(2, 2), stride=(2, 2), num_filter=3)
+    assert out.shape == (1, 3, 8, 8)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    assert_almost_equal(out, np.array([[[[5, 7], [13, 15]]]], dtype=np.float32))
+    out_avg = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type="avg")
+    assert_almost_equal(out_avg, np.array([[[[2.5, 4.5], [10.5, 12.5]]]]))
+    g = mx.nd.Pooling(mx.nd.array(x), pool_type="max", global_pool=True,
+                      kernel=(1, 1))
+    assert g.asnumpy().ravel()[0] == 15.0
+
+
+def test_batchnorm_train_and_global():
+    x = np.random.rand(4, 3, 2, 2).astype(np.float32) * 5
+    gamma = np.ones(3, dtype=np.float32)
+    beta = np.zeros(3, dtype=np.float32)
+    mean = np.zeros(3, dtype=np.float32)
+    var = np.ones(3, dtype=np.float32)
+    out, bmean, bvar = mx.nd.imperative_invoke(
+        "BatchNorm",
+        [mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+         mx.nd.array(mean), mx.nd.array(var)],
+        {"fix_gamma": False, "eps": 1e-5, "output_mean_var": True})
+    expected_mean = x.mean(axis=(0, 2, 3))
+    assert_almost_equal(bmean, expected_mean, rtol=1e-4)
+    normed = out.asnumpy()
+    assert abs(normed.mean()) < 1e-4
+    assert abs(normed.std() - 1.0) < 1e-2
+
+
+def test_softmax_and_logsoftmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    sm = mx.nd.softmax(mx.nd.array(x))
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert_almost_equal(sm, e / e.sum(axis=1, keepdims=True), rtol=1e-4)
+    lsm = mx.nd.log_softmax(mx.nd.array(x))
+    assert_almost_equal(lsm, np.log(e / e.sum(axis=1, keepdims=True)),
+                        rtol=1e-4)
+
+
+def test_activation_variants():
+    x = np.array([[-2.0, -0.5, 0.0, 0.5, 2.0]], dtype=np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(a, act_type="relu"),
+                        np.maximum(x, 0))
+    assert_almost_equal(mx.nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x))
+    elu = mx.nd.LeakyReLU(a, act_type="elu", slope=1.0)
+    assert_almost_equal(elu, np.where(x > 0, x, np.exp(x) - 1), rtol=1e-4)
+
+
+def test_transpose_slice_pad_tile():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.transpose(a, axes=(2, 0, 1)),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(mx.nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(mx.nd.slice_axis(a, axis=2, begin=1, end=3),
+                        x[:, :, 1:3])
+    p = mx.nd.Pad(a, mode="constant", pad_width=(0, 0, 1, 1, 0, 0),
+                  constant_value=9)
+    assert p.shape == (2, 5, 4)
+    assert p.asnumpy()[0, 0, 0] == 9
+    assert_almost_equal(mx.nd.tile(a, reps=(1, 2, 1)), np.tile(x, (1, 2, 1)))
+
+
+def test_ordering_ops():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sort(a, axis=1), np.sort(x, axis=1))
+    assert_almost_equal(mx.nd.argsort(a, axis=1).astype("int32"),
+                        np.argsort(x, axis=1).astype(np.int32))
+    vals, inds = mx.nd.topk(a, axis=1, k=2, ret_typ="both")
+    assert_almost_equal(vals, np.sort(x, axis=1)[:, ::-1][:, :2])
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 5, 9], dtype=np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out, w[[1, 5, 9]])
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (seq, batch, feat)
+    lens = np.array([2, 4], dtype=np.float32)
+    masked = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(lens),
+                                use_sequence_length=True, value=0.0)
+    mn = masked.asnumpy()
+    assert (mn[2:, 0] == 0).all() and (mn[:, 1] == x[:, 1]).all()
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(lens),
+                              use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[3, 1]]))
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), mx.nd.array(lens),
+                                use_sequence_length=True)
+    rn = rev.asnumpy()
+    assert_almost_equal(rn[0, 0], x[1, 0])
+    assert_almost_equal(rn[:, 1], x[::-1, 1])
+
+
+def test_where_clip_cast():
+    x = np.array([[1.0, -2.0], [3.0, -4.0]], dtype=np.float32)
+    a = mx.nd.array(x)
+    cond = mx.nd.array((x > 0).astype(np.float32))
+    out = mx.nd.where(cond, a, -a)
+    assert (out.asnumpy() > 0).all()
+    assert_almost_equal(mx.nd.clip(a, -1.5, 1.5), np.clip(x, -1.5, 1.5))
+    assert mx.nd.Cast(a, dtype="int32").dtype == np.int32
+
+
+def test_numeric_gradient_fc():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    check_numeric_gradient(fc, {"data": np.random.rand(2, 4).astype(np.float32),
+                                "fc_weight": np.random.rand(3, 4).astype(np.float32),
+                                "fc_bias": np.random.rand(3).astype(np.float32)},
+                           numeric_eps=1e-2, rtol=0.05)
+
+
+def test_numeric_gradient_tanh_chain():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Activation(data, act_type="tanh")
+    out = mx.sym.sum(out * out)
+    check_numeric_gradient(out, {"data": np.random.rand(3, 3).astype(np.float32)},
+                           numeric_eps=1e-2, rtol=0.05)
+
+
+def test_symbolic_forward_checks():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.elemwise_add(a, b)
+    av = np.random.rand(2, 2).astype(np.float32)
+    bv = np.random.rand(2, 2).astype(np.float32)
+    check_symbolic_forward(out, {"a": av, "b": bv}, [av + bv])
+
+
+def test_layer_norm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    g = np.random.rand(6).astype(np.float32)
+    b = np.random.rand(6).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, expected, rtol=1e-4)
+
+
+def test_lrn_runs():
+    x = mx.nd.ones((1, 8, 4, 4))
+    out = mx.nd.LRN(x, nsize=5)
+    assert out.shape == x.shape
+
+
+def test_l2_normalization():
+    x = np.random.rand(2, 4).astype(np.float32)
+    out = mx.nd.L2Normalization(mx.nd.array(x))
+    expected = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    assert_almost_equal(out, expected, rtol=1e-5)
+
+
+def test_upsampling():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = mx.nd.UpSampling(mx.nd.array(x), scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    assert out.asnumpy()[0, 0, 0, 1] == 0.0
+    assert out.asnumpy()[0, 0, 0, 2] == 1.0
+
+
+def test_random_samplers_shapes_and_ranges():
+    u = mx.nd.random.uniform(2.0, 3.0, shape=(100,))
+    un = u.asnumpy()
+    assert (un >= 2.0).all() and (un < 3.0).all()
+    n = mx.nd.random.normal(0.0, 1.0, shape=(500,))
+    assert abs(n.asnumpy().mean()) < 0.3
+    r = mx.nd.random.randint(0, 5, shape=(50,))
+    rn = r.asnumpy()
+    assert (rn >= 0).all() and (rn < 5).all()
+    p = mx.nd.random.multinomial(mx.nd.array([0.0, 0.0, 1.0]), shape=(20,))
+    assert (p.asnumpy() == 2).all()
+
+
+def test_seed_reproducibility():
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_ctc_loss_matches_simple_case():
+    # single batch, alphabet {blank,a}: P(label 'a') over 2 steps
+    logits = np.zeros((2, 1, 2), dtype=np.float32)  # uniform
+    label = np.array([[1]], dtype=np.float32)
+    loss = mx.nd.CTCLoss(mx.nd.array(logits), mx.nd.array(label))
+    # paths producing 'a': aa, a-, -a → 3/4 of prob mass
+    assert_almost_equal(loss, np.array([-np.log(0.75)]), rtol=1e-3)
+
+
+def test_gather_scatter():
+    data = np.random.rand(3, 4).astype(np.float32)
+    out = mx.nd.gather_nd(mx.nd.array(data),
+                          mx.nd.array([[0, 2], [1, 3]], dtype="int32"))
+    assert_almost_equal(out, data[[0, 2], [1, 3]])
+    sc = mx.nd.scatter_nd(mx.nd.array([1.0, 2.0]),
+                          mx.nd.array([[0, 1], [2, 0]], dtype="int32"),
+                          shape=(3, 4))
+    assert sc.asnumpy()[0, 2] == 1.0 and sc.asnumpy()[1, 0] == 2.0

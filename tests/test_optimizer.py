@@ -1,0 +1,103 @@
+"""Optimizer tests (mirrors reference tests/python/unittest/test_optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_sgd_matches_numpy():
+    w = mx.nd.array([1.0, 2.0, 3.0])
+    g = mx.nd.array([0.1, 0.2, 0.3])
+    o = opt.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    assert_almost_equal(w, np.array([1.0, 2.0, 3.0]) - 0.1 * np.array([0.1, 0.2, 0.3]),
+                        rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([1.0])
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)  # mom = -0.1 ; w = 0.9
+    o.update(0, w, g, state)  # mom = -0.19 ; w = 0.71
+    assert_almost_equal(w, np.array([0.71]), rtol=1e-5)
+
+
+def test_sgd_wd_and_clip():
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([100.0])
+    o = opt.SGD(learning_rate=0.1, wd=0.1, clip_gradient=1.0, rescale_grad=1.0)
+    o.update(0, w, g, o.create_state(0, w))
+    # g_clipped=1, +wd*w=0.1 → step = -0.1*1.1
+    assert_almost_equal(w, np.array([1.0 - 0.11]), rtol=1e-5)
+
+
+def test_adam_first_step():
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([0.5])
+    o = opt.Adam(learning_rate=0.01, rescale_grad=1.0)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # first step of adam ≈ -lr * sign(g) (bias-corrected)
+    assert abs(w.asscalar() - (1.0 - 0.01)) < 1e-3
+
+
+def test_rmsprop_adagrad_adadelta_run():
+    for name, kwargs in [("rmsprop", {}), ("adagrad", {}), ("adadelta", {}),
+                         ("ftrl", {}), ("signum", {}), ("nag", {"momentum": 0.9}),
+                         ("adamax", {}), ("nadam", {}), ("ftml", {})]:
+        o = opt.create(name, rescale_grad=1.0, **kwargs)
+        w = mx.nd.array([1.0, -1.0])
+        g = mx.nd.array([0.1, -0.1])
+        state = o.create_state(0, w)
+        before = w.asnumpy().copy()
+        o.update(0, w, g, state)
+        assert not np.allclose(w.asnumpy(), before), name
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    sched = FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = mx.nd.array([0.0])
+    g = mx.nd.array([1.0])
+    for _ in range(6):
+        o.update(0, w, g, None)
+    assert o._get_lr(0) < 1.0
+
+
+def test_updater_and_states_roundtrip(tmp_path):
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    updater = opt.get_updater(o)
+    w = mx.nd.array([1.0])
+    g = mx.nd.array([1.0])
+    updater(0, g, w)
+    states = updater.get_states()
+    updater2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    updater2.set_states(states)
+    assert 0 in updater2.states
+
+
+def test_multi_precision():
+    w16 = mx.nd.array(np.array([1.0], dtype=np.float16))
+    g16 = mx.nd.array(np.array([0.1], dtype=np.float16))
+    o = opt.SGD(learning_rate=0.1, multi_precision=True, rescale_grad=1.0)
+    state = o.create_state_multi_precision(0, w16)
+    assert state[0].dtype == np.float32
+    o.update_multi_precision(0, w16, g16, state)
+    assert abs(w16.asscalar() - 0.99) < 1e-2
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "a_weight", 1: "b_weight"},
+                rescale_grad=1.0)
+    o.set_lr_mult({"a_weight": 0.1})
+    o.set_wd_mult({})
+    assert o._get_lr(0) == pytest.approx(0.1)
+    assert o._get_lr(1) == pytest.approx(1.0)

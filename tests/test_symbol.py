@@ -1,0 +1,146 @@
+"""Symbol tests (mirrors reference tests/python/unittest/test_symbol.py +
+test_infer_shape.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments():
+    mlp = _mlp()
+    assert mlp.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert mlp.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    mlp = _mlp()
+    arg_shapes, out_shapes, aux_shapes = mlp.infer_shape(data=(16, 10))
+    d = dict(zip(mlp.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 10)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (4, 8)
+    assert d["softmax_label"] == (16,)
+    assert out_shapes == [(16, 4)]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert d["bn_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 8, 8)]
+    aux_d = dict(zip(net.list_auxiliary_states(), aux_shapes))
+    assert aux_d["bn_moving_mean"] == (8,)
+    assert aux_d["bn_moving_var"] == (8,)
+
+
+def test_json_roundtrip():
+    mlp = _mlp()
+    js = mlp.tojson()
+    loaded = mx.sym.load_json(js)
+    assert loaded.list_arguments() == mlp.list_arguments()
+    assert loaded.list_outputs() == mlp.list_outputs()
+    # same numeric behavior
+    args = {n: mx.nd.array(np.random.rand(*s).astype(np.float32))
+            for n, s in zip(mlp.list_arguments(),
+                            mlp.infer_shape(data=(2, 10))[0])}
+    e1 = mlp.bind(mx.cpu(), {k: v.copy() for k, v in args.items()})
+    e2 = loaded.bind(mx.cpu(), {k: v.copy() for k, v in args.items()})
+    assert_almost_equal(e1.forward()[0], e2.forward()[0], rtol=1e-5)
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    b = a * 2
+    c = a + 1
+    g = mx.sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_composition():
+    a = mx.sym.Variable("a")
+    net1 = mx.sym.FullyConnected(a, num_hidden=4, name="fc_inner")
+    data2 = mx.sym.Variable("d2")
+    composed = net1(a=mx.sym.FullyConnected(data2, num_hidden=6, name="fc_outer"))
+    args = composed.list_arguments()
+    assert "d2" in args and "fc_outer_weight" in args and "fc_inner_weight" in args
+
+
+def test_internals():
+    mlp = _mlp()
+    internals = mlp.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+    fc1_out = internals["fc1_output"]
+    assert fc1_out.infer_shape(data=(2, 10))[1] == [(2, 8)]
+
+
+def test_variable_attrs():
+    v = mx.sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    arg_shapes, _, _ = (v * 2).infer_shape()
+    assert arg_shapes == [(3, 4)]
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+    assert a.attr("ctx_group") == "dev1"
+
+
+def test_simple_bind_and_forward():
+    mlp = _mlp()
+    ex = mlp.simple_bind(ctx=mx.cpu(), data=(4, 10))
+    ex.arg_dict["data"][:] = np.random.rand(4, 10)
+    ex.arg_dict["fc1_weight"][:] = np.random.rand(8, 10) * 0.1
+    outs = ex.forward(is_train=False)
+    assert outs[0].shape == (4, 4)
+    assert_almost_equal(outs[0].asnumpy().sum(axis=1), np.ones(4), rtol=1e-4)
+
+
+def test_executor_backward_matches_autograd():
+    x = np.random.rand(3, 5).astype(np.float32)
+    w = np.random.rand(2, 5).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, no_bias=True, name="fc")
+    loss = mx.sym.sum(fc * fc)
+    ex = loss.bind(mx.cpu(), {"data": mx.nd.array(x), "fc_weight": mx.nd.array(w)},
+                   args_grad={"data": mx.nd.zeros((3, 5)),
+                              "fc_weight": mx.nd.zeros((2, 5))})
+    ex.forward(is_train=True)
+    ex.backward()
+    # autograd reference
+    xa = mx.nd.array(x)
+    wa = mx.nd.array(w)
+    xa.attach_grad()
+    wa.attach_grad()
+    with mx.autograd.record():
+        out = (mx.nd.FullyConnected(xa, wa, no_bias=True, num_hidden=2) ** 2).sum()
+    out.backward()
+    assert_almost_equal(ex.grad_dict["data"], xa.grad, rtol=1e-4)
+    assert_almost_equal(ex.grad_dict["fc_weight"], wa.grad, rtol=1e-4)
+
+
+def test_save_load_file(tmp_path):
+    mlp = _mlp()
+    fname = str(tmp_path / "sym.json")
+    mlp.save(fname)
+    loaded = mx.sym.load(fname)
+    assert loaded.list_arguments() == mlp.list_arguments()
