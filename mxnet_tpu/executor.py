@@ -83,6 +83,8 @@ def make_eval_fn(symbol, is_train):
                     else:
                         out = in_vals[0]
                 elif node.op in _random_ops():
+                    if node.op == "RNN" and not is_train:
+                        attrs["p"] = 0.0  # no dropout at inference
                     out = op.fn(next_key(), *in_vals, **attrs)
                 else:
                     out = op.fn(*in_vals, **attrs)

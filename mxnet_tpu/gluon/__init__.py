@@ -1,0 +1,17 @@
+"""Gluon — the imperative/hybrid high-level API.
+
+Reference: python/mxnet/gluon/ (Block/HybridBlock, Parameter, Trainer,
+nn/rnn layer libraries, loss, data, model_zoo).
+"""
+
+from .parameter import Parameter, ParameterDict, Constant  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import split_and_load  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import rnn  # noqa: F401
+from . import data  # noqa: F401
+from . import model_zoo  # noqa: F401
+from . import contrib  # noqa: F401

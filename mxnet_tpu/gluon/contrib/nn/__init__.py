@@ -1,0 +1,70 @@
+"""Gluon contrib nn layers (reference: python/mxnet/gluon/contrib/nn/
+basic_layers.py: Concurrent, HybridConcurrent, Identity, SparseEmbedding,
+SyncBatchNorm).
+"""
+
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import BatchNorm, HybridSequential, Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray
+
+        out = [block(x) for block in self._children.values()]
+        return ndarray.concatenate(out, axis=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybrid version of Concurrent."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.Concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference:
+    src/operator/contrib/sync_batch_norm.cc:48).
+
+    TPU-native: inside a pjit/shard_map-sharded step the batch axis is
+    global, so plain BatchNorm already computes global-batch statistics
+    (stats reductions become XLA psums over the mesh).  This subclass
+    exists for API parity; `num_devices` is accepted and unused.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

@@ -1,0 +1,240 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py:
+MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
+ImageFolderDataset).
+
+No network egress in this environment: datasets read pre-downloaded
+files from ``root`` when present, else raise with instructions; use
+``SyntheticImageDataset`` for tests/benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from .... import ndarray, recordio
+from ....base import np_dtype
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset",
+           "SyntheticImageDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from pre-downloaded idx-gz files (reference: datasets.py
+    MNIST)."""
+
+    _train_files = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+    _test_files = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        image_file, label_file = self._train_files if self._train \
+            else self._test_files
+        image_path = os.path.join(self._root, image_file)
+        label_path = os.path.join(self._root, label_file)
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise RuntimeError(
+                "MNIST files not found under %s (no network egress; place "
+                "%s and %s there, or use SyntheticImageDataset)" %
+                (self._root, image_file, label_file))
+        with gzip.open(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            label = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+        with gzip.open(image_path, "rb") as f:
+            _, _, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            data = data.reshape(len(label), rows, cols, 1)
+        self._label = label
+        self._data = [ndarray.array(x, dtype="uint8") for x in data]
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle tarball (reference: datasets.py
+    CIFAR10)."""
+
+    _archive = "cifar-10-python.tar.gz"
+    _folder = "cifar-10-batches-py"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, fobj):
+        d = pickle.load(fobj, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        labels = d.get(b"labels", d.get(b"fine_labels"))
+        return data, _np.asarray(labels, dtype=_np.int32)
+
+    def _batches(self):
+        if self._train:
+            return ["data_batch_%d" % i for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        folder = os.path.join(self._root, self._folder)
+        archive = os.path.join(self._root, self._archive)
+        datas, labels = [], []
+        if os.path.isdir(folder):
+            for b in self._batches():
+                with open(os.path.join(folder, b), "rb") as f:
+                    d, l = self._read_batch(f)
+                datas.append(d)
+                labels.append(l)
+        elif os.path.exists(archive):
+            with tarfile.open(archive) as tf:
+                for b in self._batches():
+                    f = tf.extractfile("%s/%s" % (self._folder, b))
+                    d, l = self._read_batch(f)
+                    datas.append(d)
+                    labels.append(l)
+        else:
+            raise RuntimeError(
+                "CIFAR10 files not found under %s (no network egress; place "
+                "%s there, or use SyntheticImageDataset)" %
+                (self._root, self._archive))
+        data = _np.concatenate(datas)
+        self._label = _np.concatenate(labels)
+        self._data = [ndarray.array(x, dtype="uint8") for x in data]
+
+
+class CIFAR100(CIFAR10):
+    _archive = "cifar-100-python.tar.gz"
+    _folder = "cifar-100-python"
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        return ["train"] if self._train else ["test"]
+
+    def _read_batch(self, fobj):
+        d = pickle.load(fobj, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        return data, _np.asarray(d[key], dtype=_np.int32)
+
+
+class SyntheticImageDataset(Dataset):
+    """Deterministic random images+labels — for tests and benchmarks in
+    egress-free environments (TPU-native addition; parity datasets above
+    need the real files)."""
+
+    def __init__(self, length=1024, shape=(32, 32, 3), num_classes=10,
+                 transform=None, seed=0):
+        self._length = length
+        rng = _np.random.RandomState(seed)
+        self._images = rng.randint(0, 256, size=(length,) + tuple(shape),
+                                   dtype=_np.uint8)
+        self._labels = rng.randint(0, num_classes, size=(length,),
+                                   ).astype(_np.int32)
+        self._transform = transform
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        img = ndarray.array(self._images[idx], dtype="uint8")
+        label = self._labels[idx]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over an image RecordIO file (reference: datasets.py
+    ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img_bytes = recordio.unpack(record)
+        from .... import image as _image
+
+        img = _image.imdecode(img_bytes, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """Folder-of-class-folders image dataset (reference: datasets.py
+    ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image as _image
+
+        with open(self.items[idx][0], "rb") as f:
+            img = _image.imdecode(f.read(), flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

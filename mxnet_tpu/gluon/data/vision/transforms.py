@@ -1,0 +1,245 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/
+transforms.py: Compose, Cast, ToTensor, Normalize, Resize, CenterCrop,
+RandomResizedCrop, RandomFlipLeftRight, ...).
+
+Transforms run on host numpy (cheap per-sample work in DataLoader
+workers); the batched result is device_put once.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential, Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomLighting", "RandomColorJitter"]
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, ndarray.NDArray) else _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Chain transforms (reference: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: transforms.py
+    ToTensor over src/operator/image/totensor)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        arr = _as_np(x).astype(_np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return ndarray.array(arr)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x):
+        arr = _as_np(x)
+        mean = self._mean.reshape((-1, 1, 1)) if self._mean.ndim else self._mean
+        std = self._std.reshape((-1, 1, 1)) if self._std.ndim else self._std
+        return ndarray.array((arr - mean) / std)
+
+
+def _resize_np(arr, size, interp="bilinear"):
+    """Bilinear resize HWC uint8/float via pure numpy."""
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        size = (size, size)
+    ow, oh = size  # reference order: (width, height)
+    if (oh, ow) == (h, w):
+        return arr
+    ys = _np.linspace(0, h - 1, oh)
+    xs = _np.linspace(0, w - 1, ow)
+    y0 = _np.floor(ys).astype(_np.int64)
+    x0 = _np.floor(xs).astype(_np.int64)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    a = arr[_np.ix_(y0, x0)].astype(_np.float32)
+    b = arr[_np.ix_(y0, x1)].astype(_np.float32)
+    c = arr[_np.ix_(y1, x0)].astype(_np.float32)
+    d = arr[_np.ix_(y1, x1)].astype(_np.float32)
+    out = a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx + \
+        c * wy * (1 - wx) + d * wy * wx
+    if arr.dtype == _np.uint8:
+        out = _np.clip(_np.rint(out), 0, 255).astype(_np.uint8)
+    return out
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        arr = _as_np(x)
+        size = self._size
+        if self._keep and isinstance(size, int):
+            h, w = arr.shape[:2]
+            if h < w:
+                size = (int(w * size / h), size)
+            else:
+                size = (size, int(h * size / w))
+        return ndarray.array(_resize_np(arr, size), dtype=arr.dtype)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        arr = _as_np(x)
+        ow, oh = self._size
+        h, w = arr.shape[:2]
+        if h < oh or w < ow:
+            arr = _resize_np(arr, (max(ow, w), max(oh, h)))
+            h, w = arr.shape[:2]
+        y = (h - oh) // 2
+        xo = (w - ow) // 2
+        return ndarray.array(arr[y:y + oh, xo:xo + ow], dtype=arr.dtype)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        arr = _as_np(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            nw = int(round(_np.sqrt(target_area * aspect)))
+            nh = int(round(_np.sqrt(target_area / aspect)))
+            if nw <= w and nh <= h:
+                y = _np.random.randint(0, h - nh + 1)
+                xo = _np.random.randint(0, w - nw + 1)
+                crop = arr[y:y + nh, xo:xo + nw]
+                return ndarray.array(_resize_np(crop, self._size),
+                                     dtype=arr.dtype)
+        return CenterCrop(self._size).forward(ndarray.array(arr, dtype=arr.dtype))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return ndarray.array(_as_np(x)[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return ndarray.array(_as_np(x)[::-1].copy())
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, magnitude):
+        super().__init__()
+        self._m = magnitude
+
+    def _alpha(self):
+        return 1.0 + _np.random.uniform(-self._m, self._m)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        arr = _as_np(x).astype(_np.float32) * self._alpha()
+        return ndarray.array(arr)
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        arr = _as_np(x).astype(_np.float32)
+        gray = arr.mean()
+        return ndarray.array(gray + (arr - gray) * self._alpha())
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        arr = _as_np(x).astype(_np.float32)
+        gray = arr.mean(axis=-1, keepdims=True)
+        return ndarray.array(gray + (arr - gray) * self._alpha())
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference: transforms.py)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        arr = _as_np(x).astype(_np.float32)
+        alpha = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return ndarray.array(arr + rgb)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i].forward(x)
+        return x

@@ -1,0 +1,479 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py (Parameter, ParameterDict,
+Constant; deferred initialization via DeferredInitializationError).
+
+TPU-native notes: a Parameter keeps one NDArray per Context (the
+reference keeps per-GPU copies managed by the Trainer/KVStore; here
+multi-device data parallelism normally rides a jax.sharding Mesh
+instead, but the per-ctx list API is preserved for parity).  Gradient
+buffers attach through the autograd tape (autograd.mark_variables),
+matching the reference's attach_grad semantics.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from .. import autograd, initializer, ndarray
+from ..base import MXNetError, np_dtype
+from ..context import Context, cpu, current_context
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (ndarray.NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter used before its shape is known (reference:
+    gluon/parameter.py DeferredInitializationError)."""
+
+
+class Parameter:
+    """A trainable weight of a Block.
+
+    Parameters follow the reference semantics: created (possibly with an
+    unknown shape containing 0s), `initialize()`d with an Initializer,
+    then `.data(ctx)` returns the NDArray and `.grad(ctx)` its gradient
+    buffer.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._ctx_list = None   # list[Context]
+        self._data = None       # list[NDArray] aligned with _ctx_list
+        self._grad = None
+        self._deferred_init = ()
+        self._trainer = None
+        if not differentiable:
+            grad_req = "null"
+        self.grad_req = grad_req
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+    # ------------------------------------------------------------ grad_req
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError("invalid grad_req %r" % (req,))
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                for d in self._data:
+                    d._ag_node = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # ------------------------------------------------------------ init
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Allocate and initialize this parameter on ctx(s)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self.shape is None or any(s <= 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid shape %s."
+                % (self.name, self.shape))
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        init = init or self.init or default_init
+        if isinstance(init, str):
+            init = initializer.create(init)
+        data = _np.zeros(self.shape, dtype=np_dtype(self.dtype))
+        init_desc = initializer.InitDesc(self.name)
+        init(init_desc, data)  # fills in place (reference semantics)
+        self._data = [ndarray.array(data, ctx=c, dtype=self.dtype)
+                      for c in self._ctx_list]
+        self._deferred_init = ()
+        if self.grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self, shape):
+        """Complete deferred init once the shape is known (reference:
+        _finish_deferred_init in gluon/parameter.py)."""
+        shape = tuple(int(s) for s in shape)
+        if self.shape is not None and len(self.shape) == len(shape):
+            # merge: keep known dims, fill 0s
+            merged = []
+            for known, new in zip(self.shape, shape):
+                if known > 0 and new > 0 and known != new:
+                    raise ValueError(
+                        "Deferred-init shape mismatch for %s: %s vs %s"
+                        % (self.name, self.shape, shape))
+                merged.append(known if known > 0 else new)
+            shape = tuple(merged)
+        self.shape = shape
+        if self._deferred_init:
+            init, default_init = self._deferred_init
+            self._finish_init(init, default_init)
+
+    def _init_grad(self):
+        self._grad = [ndarray.zeros(self.shape, ctx=d.context, dtype=self.dtype)
+                      for d in self._data]
+        for d, g in zip(self._data, self._grad):
+            autograd.mark_variables([d], [g], self.grad_req)
+
+    # ------------------------------------------------------------ access
+    def _check_initialized(self, ctx=None):
+        if self._data is not None:
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because its shape "
+                "is unknown (deferred init). Run a forward pass first or set "
+                "the full shape." % self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. You should initialize "
+            "parameters with Block.initialize() before use." % self.name)
+
+    def _ctx_index(self, ctx):
+        if ctx is None:
+            return 0
+        for i, c in enumerate(self._ctx_list):
+            if c == ctx:
+                return i
+        # device_id-insensitive fallback: same device type
+        for i, c in enumerate(self._ctx_list):
+            if c.device_type == ctx.device_type:
+                return i
+        raise RuntimeError(
+            "Parameter %s was not initialized on context %s (has %s)."
+            % (self.name, ctx, self._ctx_list))
+
+    def data(self, ctx=None):
+        """The parameter value on ctx (reference: Parameter.data)."""
+        ov = _override_get(self)
+        if ov is not None:
+            return ov
+        self._check_initialized(ctx)
+        return self._data[self._ctx_index(ctx)]
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data)
+
+    def grad(self, ctx=None):
+        self._check_initialized(ctx)
+        if self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient of Parameter %s because grad_req='null'"
+                % self.name)
+        return self._grad[self._ctx_index(ctx)]
+
+    def list_grad(self):
+        self._check_initialized()
+        if self._grad is None:
+            raise RuntimeError("grad_req='null' for %s" % self.name)
+        return list(self._grad)
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return list(self._ctx_list)
+        self._check_initialized()
+        return list(self._ctx_list)
+
+    def set_data(self, data):
+        """Set value on every context."""
+        if self._data is None:
+            # allow set before init in the deferred case: fixes shape
+            if self._deferred_init:
+                self._finish_deferred_init(data.shape)
+            else:
+                raise RuntimeError("Parameter %s not initialized" % self.name)
+        if tuple(data.shape) != tuple(self.shape):
+            raise ValueError("shape mismatch for %s: %s vs %s"
+                             % (self.name, data.shape, self.shape))
+        src = data if isinstance(data, ndarray.NDArray) else ndarray.array(data)
+        for d in self._data:
+            with autograd.pause():
+                d._assign(src.as_in_context(d.context)._data.astype(d.dtype))
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g._assign(g._data * 0)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            cur = self._data[0]
+            self._ctx_list = list(ctx)
+            self._data = [cur.as_in_context(c) for c in ctx]
+            if self.grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init:
+            self._ctx_list = list(ctx)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = [d.astype(dtype) for d in self._data]
+            if self._grad is not None:
+                self._init_grad()
+
+    def var(self):
+        """Symbol variable for this parameter (symbolic composition)."""
+        from .. import symbol
+        return symbol.Variable(self.name, shape=self.shape, dtype=self.dtype,
+                               lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+    def __reduce__(self):  # pickling support for DataLoader workers
+        return (_rebuild_parameter,
+                (self.name, self.grad_req, self.shape, self.dtype,
+                 self.lr_mult, self.wd_mult,
+                 None if self._data is None else self._data[0].asnumpy()))
+
+
+def _rebuild_parameter(name, grad_req, shape, dtype, lr_mult, wd_mult, value):
+    p = Parameter(name, grad_req=grad_req, shape=shape, dtype=dtype,
+                  lr_mult=lr_mult, wd_mult=wd_mult)
+    if value is not None:
+        p.initialize(init=initializer.Constant(0), ctx=cpu())
+        p.set_data(ndarray.array(value))
+    return p
+
+
+class Constant(Parameter):
+    """A constant (non-trainable) parameter holding a fixed value
+    (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = value.asnumpy() if isinstance(value, ndarray.NDArray) \
+                else _np.asarray(value, dtype="float32")
+        self.value = value
+
+        class _CInit(initializer.Initializer):
+            def _init_weight(s, _, arr):
+                arr[...] = value
+
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit(),
+                         differentiable=False)
+
+
+# --------------------------------------------------------------- override
+# Thread-local map Parameter -> NDArray(tracer) active while a HybridBlock
+# is being staged into one XLA graph (block.py CachedGraph); lets the same
+# layer code run both eagerly and under trace.
+import threading as _threading
+
+_OVERRIDE = _threading.local()
+
+
+class param_override:
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    def __enter__(self):
+        stack = getattr(_OVERRIDE, "stack", None)
+        if stack is None:
+            stack = _OVERRIDE.stack = []
+        stack.append(self.mapping)
+        return self
+
+    def __exit__(self, *a):
+        _OVERRIDE.stack.pop()
+
+
+def _override_get(param):
+    stack = getattr(_OVERRIDE, "stack", None)
+    if not stack:
+        return None
+    for m in reversed(stack):
+        if param in m:
+            return m[param]
+    return None
+
+
+class ParameterDict:
+    """A prefix-scoped dictionary of Parameters (reference:
+    gluon/parameter.py ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join("  %r" % p for p in self._params.values())
+        return "ParameterDict(prefix=%r\n%s\n)" % (self._prefix, s)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        """Retrieve-or-create a Parameter named prefix+name."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and param.shape is not None and v is not None:
+                    v = tuple(v)
+                    if len(v) == len(param.shape):
+                        merged = tuple(a if a > 0 else b
+                                       for a, b in zip(param.shape, v))
+                        param.shape = merged
+                        continue
+                if getattr(param, k, None) in (None, v) or k in ("init",):
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise ValueError("No constant named %s" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("Cannot update self with other because they "
+                                 "have different Parameters with the same name %s" % k)
+            self._params[k] = v
+
+    # ------------------------------------------------------------ bulk ops
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for p in self.values():
+            p.initialize(None, ctx, default_init=init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def list_ctx(self):
+        ctxs = []
+        for p in self.values():
+            for c in p.list_ctx():
+                if c not in ctxs:
+                    ctxs.append(c)
+        return ctxs
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def cast(self, dtype):
+        for p in self.values():
+            p.cast(dtype)
+
+    # ------------------------------------------------------------ save/load
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for p in self.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = p.data().as_in_context(cpu())
+        ndarray.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = ndarray.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise IOError("Parameter %s is missing in file %s"
+                                  % (name, filename))
+        for name, value in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError("Parameter %s loaded from %s is not present "
+                                  "in this ParameterDict" % (name, filename))
+                continue
+            p = self._params[name]
+            if p._data is None:
+                p.shape = tuple(value.shape)
+                p.initialize(init=initializer.Constant(0),
+                             ctx=p._ctx_list or ctx or [current_context()])
+            p.set_data(value)

@@ -1,0 +1,174 @@
+"""Fused multi-layer RNN/LSTM/GRU Gluon layers.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer over the
+monolithic RNN op src/operator/rnn.cc).  The compute is ops/rnn.py's
+lax.scan kernel; parameters are kept as separate Gluon Parameters
+(l0_i2h_weight, ...) and packed into the cuDNN flat layout at forward,
+matching the reference's parameter naming for checkpoint parity.
+"""
+
+from __future__ import annotations
+
+from ... import autograd, ndarray
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        self._mode = mode  # before super(): _alias() runs in Block.__init__
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     (ng * nh, ni), i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     (ng * nh, nh), h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     (ng * nh,), i2h_bias_initializer)
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     (ng * nh,), h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def __repr__(self):
+        return "%s(%s, %s layers, hidden=%s%s)" % (
+            type(self).__name__, self._layout, self._num_layers,
+            self._hidden_size, ", bidirectional" if self._dir == 2 else "")
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = getattr(self, "%s%d_i2h_weight" % (j, i))
+                p.shape = (self._gates * self._hidden_size, ni)
+            ni = self._hidden_size * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if func is None:
+            func = ndarray.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **{**info, **kwargs}))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if isinstance(states, ndarray.NDArray):
+            states = [states]
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.context,
+                                      dtype=inputs.dtype)
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+
+        # pack parameters into the cuDNN flat layout: all weights
+        # (layer-major, i2h then h2h), then all biases
+        ws = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(params["%s%d_i2h_weight" % (j, i)].reshape((-1,)))
+                ws.append(params["%s%d_h2h_weight" % (j, i)].reshape((-1,)))
+        bs = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(params["%s%d_i2h_bias" % (j, i)])
+                bs.append(params["%s%d_h2h_bias" % (j, i)])
+        flat = F.Concat(*(ws + bs), dim=0)
+
+        rnn_args = [inputs, flat] + states
+        out = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        outputs, states_out = out[0], list(out[1:])
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if skip_states:
+            return outputs
+        return outputs, states_out
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu/tanh) (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

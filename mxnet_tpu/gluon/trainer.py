@@ -1,0 +1,164 @@
+"""Gluon Trainer — applies an Optimizer to a set of Parameters.
+
+Reference: python/mxnet/gluon/trainer.py:27 (kvstore setup :169,
+step :302, allreduce_grads :331, update :363).
+
+TPU-native notes: on a single chip the update is a direct fused
+optimizer-op call per parameter (the reference's updater path).  For
+multi-device data parallel, grads living on different devices are
+reduced through the KVStore façade ('local'/'device'/'tpu'), whose
+'tpu' backend lowers push+pull to an XLA psum over the mesh
+(SURVEY.md §2.3) — the sharded flagship path instead jits the whole
+train step over the mesh (parallel/data_parallel.py).
+"""
+
+from __future__ import annotations
+
+from .. import kvstore as _kvstore
+from .. import optimizer as _optimizer
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a list/dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError("invalid parameter %r" % (p,))
+            self._param2idx[p.name] = i
+            self._params.append(p)
+            p._trainer = self
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+
+    def _check_contexts(self):
+        contexts = None
+        for p in self._params:
+            ctx = p.list_ctx() if p._data is not None or p._deferred_init else None
+            if ctx is None:
+                continue
+            if contexts is None:
+                contexts = ctx
+            elif contexts != ctx:
+                raise ValueError(
+                    "All Parameters must be initialized on the same set of "
+                    "contexts, but %s has %s vs %s" % (p.name, ctx, contexts))
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, _optimizer.Optimizer):
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params must be empty when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = _optimizer.create(optimizer, **optimizer_params)
+            self._optimizer.param_dict = param_dict
+        self._updaters = [_optimizer.get_updater(self._optimizer)
+                          for _ in self._contexts] or \
+                         [_optimizer.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if len(self._contexts) > 1 and self._kvstore_type:
+            kv = _kvstore.create(self._kvstore_type) \
+                if isinstance(self._kvstore_type, str) else self._kvstore_type
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    kv.init(i, p.data(self._contexts[0]))
+            self._kvstore = kv
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # ------------------------------------------------------------ step
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads across devices, then update
+        (reference: trainer.py step:302)."""
+        if not self._kv_initialized:
+            self._contexts = self._contexts or self._check_contexts()
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                grads = p.list_grad()
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        n_dev = max(len(p.list_data()) for p in self._params) \
+            if self._params else 1
+        while len(self._updaters) < n_dev:
+            # one Updater per device copy: per-index optimizer state must
+            # not be shared across copies (reference: trainer.py _updaters)
+            self._updaters.append(_optimizer.get_updater(self._optimizer))
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            for upd, data, grad in zip(self._updaters,
+                                       p.list_data(), p.list_grad()):
+                upd(i, grad, data)
+
+    # ------------------------------------------------------------ states
+    def save_states(self, fname):
+        import pickle
+
+        with open(fname, "wb") as f:
+            pickle.dump(self._updaters[0].get_states(dump_optimizer=True)
+                        if hasattr(self._updaters[0], "get_states")
+                        else self._updaters[0].states, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        for u in self._updaters:
+            if hasattr(u, "set_states"):
+                u.set_states(states)
+            else:
+                u.states = states

@@ -1,0 +1,100 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py).
+
+split_and_load is the Gluon data-parallel entry: slice a batch across
+contexts.  On TPU the preferred path is a sharded batch over a
+jax.sharding Mesh (parallel/), but the per-ctx list API is kept for
+parity with the reference multi-device semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into `num_slice` pieces along batch_axis
+    (reference: gluon/utils.py split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's a multiple of the number of "
+            "devices, or set even_split=False." % (data.shape, num_slice, batch_axis))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto one context
+    (reference: gluon/utils.py split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the concatenated L2 norm is at most max_norm
+    (reference: gluon/utils.py clip_global_norm)."""
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total = None
+    for a in arrays:
+        n = (a.astype("float32") ** 2).sum()
+        total = n if total is None else total + n.as_in_context(ctx)
+    total_norm = float(total.sqrt().asscalar())
+    if check_isfinite and not _np.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Parity stub: this environment has no network egress; point `path`
+    at a pre-downloaded file instead (reference: gluon/utils.py download)."""
+    import os
+
+    fname = path if path and not os.path.isdir(path) else \
+        os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%s) unavailable: no network egress in this environment. "
+        "Place the file at %s manually." % (url, fname))

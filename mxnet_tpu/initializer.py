@@ -107,13 +107,16 @@ def register(klass):
     return klass
 
 
+_ALIASES = {"zeros": "zero", "ones": "one"}
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name
     if name.startswith("["):  # dumps() round-trip
         cls_name, kw = json.loads(name)
         return _REG.create(cls_name, **kw)
-    return _REG.create(name, **kwargs)
+    return _REG.create(_ALIASES.get(name.lower(), name), **kwargs)
 
 
 @register

@@ -42,7 +42,7 @@ RANDOM_OPS = {
     "_random_poisson", "_random_negative_binomial",
     "_random_generalized_negative_binomial", "_random_randint",
     "_sample_multinomial", "_sample_uniform", "_sample_normal", "_sample_gamma",
-    "_shuffle", "_sample_unique_zipfian",
+    "_shuffle", "_sample_unique_zipfian", "RNN",
 }
 
 
@@ -580,6 +580,10 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
             break
 
     needs_key = op_name in RANDOM_OPS
+    if op_name == "RNN" and not _ag.is_training():
+        # inference pass disables inter-layer dropout (reference: cuDNN RNN
+        # forward-inference path, src/operator/cudnn_rnn-inl.h)
+        attrs = dict(attrs, p=0.0)
     if op_name == "Dropout":
         # training-mode gate (reference: dropout.cc runs only in train pass)
         if attrs.get("mode", "training") == "always" or _ag.is_training():

@@ -507,3 +507,44 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
         return col[idx]
 
     return jax.vmap(rev_one, in_axes=(1, 0), out_axes=1)(data, sequence_length.astype(jnp.int32))
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
+    """Pick elements along an axis by per-position index
+    (reference: src/operator/tensor/broadcast_reduce_op_index.cc pick)."""
+    ax = int(axis) % data.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, data.shape[ax] - 1)
+    else:  # wrap
+        idx = idx % data.shape[ax]
+    # index shape == data shape minus `axis` (broadcasting collapsed)
+    idx_full = jnp.expand_dims(idx.reshape(
+        tuple(d for i, d in enumerate(data.shape) if i != ax)), ax)
+    out = jnp.take_along_axis(data, idx_full, axis=ax)
+    if keepdims:
+        return out
+    return jnp.squeeze(out, axis=ax)
+
+
+@register("SwapAxis", aliases=("swapaxes", "swapaxis"))
+def swapaxes(data, dim1=0, dim2=0, **_):
+    """reference: src/operator/swapaxis.cc"""
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@register("Crop", aliases=("crop",))
+def crop_like(data, *like, offset=(), h_w=(), center_crop=False, num_args=1, **_):
+    """Crop data to the spatial size of a second input or explicit h_w
+    (reference: src/operator/crop.cc)."""
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = h_w
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oh, ow = (h - th) // 2, (w - tw) // 2
+    else:  # reference default: top-left at `offset` (crop-inl.h:130)
+        oh, ow = offset if offset else (0, 0)
+    return data[:, :, oh:oh + th, ow:ow + tw]

@@ -1,0 +1,176 @@
+"""Monolithic multi-layer RNN op (vanilla RNN / LSTM / GRU).
+
+Reference: src/operator/rnn.cc:47 (rnn_enum rnn-inl.h:49), the cuDNN
+path src/operator/cudnn_rnn-inl.h and CPU impl src/operator/rnn_impl.h.
+
+TPU-native design: time recurrence is a single ``lax.scan`` per
+layer/direction — XLA compiles the whole stack into one fused loop with
+the gate matmuls on the MXU (batched (B,in)x(in,4H)).  Parameter
+layout matches the reference's packed cuDNN format: per layer, per
+direction: W_i2h, W_h2h (flattened, gates-major), then all biases
+b_i2h, b_h2h — so checkpoints round-trip with the reference layout.
+Gate orders follow cuDNN: LSTM = (i, f, g, o), GRU = (r, z, n).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional, mode):
+    """Total packed parameter count (reference: rnn-inl.h GetRnnParamSize)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (in_size + state_size + 2)
+    return size
+
+
+def _unpack(parameters, num_layers, input_size, state_size, dirs, gates):
+    """Slice the packed parameter vector into per-(layer,dir) weights."""
+    ws, off = [], 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        layer_ws = []
+        for d in range(dirs):
+            n_i2h = gates * state_size * in_size
+            n_h2h = gates * state_size * state_size
+            w_i2h = parameters[off:off + n_i2h].reshape(
+                (gates * state_size, in_size))
+            off += n_i2h
+            w_h2h = parameters[off:off + n_h2h].reshape(
+                (gates * state_size, state_size))
+            off += n_h2h
+            layer_ws.append([w_i2h, w_h2h, None, None])
+        ws.append(layer_ws)
+    for layer in range(num_layers):
+        for d in range(dirs):
+            n_b = gates * state_size
+            ws[layer][d][2] = parameters[off:off + n_b]
+            off += n_b
+            ws[layer][d][3] = parameters[off:off + n_b]
+            off += n_b
+    return ws
+
+
+def _cell_step(mode, state_size, clip_min=None, clip_max=None):
+    if mode == "lstm":
+        def step(carry, gates_x, w_h2h, b_h2h):
+            h, c = carry
+            g = gates_x + h @ w_h2h.T + b_h2h
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c_new = f * c + i * jnp.tanh(gg)
+            if clip_min is not None:
+                # clip every step (reference: cudnn_rnn clip mode), not
+                # just the final state
+                c_new = jnp.clip(c_new, clip_min, clip_max)
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+    elif mode == "gru":
+        def step(carry, gates_x, w_h2h, b_h2h):
+            (h,) = carry
+            gh = h @ w_h2h.T + b_h2h
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1.0 - z) * n + z * h
+            return (h_new,), h_new
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, gates_x, w_h2h, b_h2h):
+            (h,) = carry
+            h_new = act(gates_x + h @ w_h2h.T + b_h2h)
+            return (h_new,), h_new
+    return step
+
+
+def _run_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, state_size,
+                   reverse, clip_min=None, clip_max=None):
+    """One layer, one direction: scan over time.  x: (T, B, in)."""
+    # hoist the input projection out of the loop: one big MXU matmul
+    gates_x = jnp.einsum("tbi,gi->tbg", x, w_i2h) + b_i2h
+    step = _cell_step(mode, state_size, clip_min, clip_max)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+
+    def body(carry, gx):
+        return step(carry, gx, w_h2h, b_h2h)
+
+    carry, ys = lax.scan(body, carry0, gates_x, reverse=reverse)
+    return carry, ys
+
+
+@register("RNN")
+def rnn(key, data, parameters, state, state_cell=None, state_size=0,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, projection_size=None, use_sequence_length=False,
+        sequence_length=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False, **_):
+    """data: (seq, batch, input); state: (L*dirs, batch, H).
+
+    Returns output (T,B,H*dirs), or (output, state_out[, statecell_out])
+    with ``state_outputs``.
+    """
+    state_size = int(state_size)
+    num_layers = int(num_layers)
+    dirs = 2 if bidirectional else 1
+    gates = _GATES[mode]
+    input_size = data.shape[2]
+    ws = _unpack(parameters, num_layers, input_size, state_size, dirs, gates)
+
+    x = data
+    h_outs, c_outs = [], []
+    for layer in range(num_layers):
+        if layer > 0 and p > 0.0 and key is not None:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+        dir_outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            w_i2h, w_h2h, b_i2h, b_h2h = ws[layer][d]
+            carry, ys = _run_direction(
+                x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, state_size,
+                reverse=(d == 1), clip_min=lstm_state_clip_min,
+                clip_max=lstm_state_clip_max)
+            if mode == "lstm":
+                hT, cT = carry
+                c_outs.append(cT)
+            else:
+                (hT,) = carry
+            h_outs.append(hT)
+            dir_outs.append(ys)
+        x = jnp.concatenate(dir_outs, axis=-1) if dirs == 2 else dir_outs[0]
+
+    out = x
+    if not state_outputs:
+        return out
+    h_state = jnp.stack(h_outs, axis=0)
+    if mode == "lstm":
+        return out, h_state, jnp.stack(c_outs, axis=0)
+    return out, h_state
+
+
+def _rnn_nout(attrs):
+    if not attrs.get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+# re-register with dynamic output count
+from .registry import _OP_REGISTRY  # noqa: E402
+
+_OP_REGISTRY["RNN"].num_outputs = _rnn_nout

@@ -1,0 +1,241 @@
+"""Gluon Block/Parameter/Trainer tests.
+
+Modeled on the reference tests/python/unittest/test_gluon.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var().name == "weight"
+    p.reset_ctx([mx.cpu(0)])
+    assert p.list_ctx() == [mx.cpu(0)]
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+    with pytest.raises(RuntimeError):
+        p.list_data()
+
+
+def test_paramdict(tmp_path):
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    fname = str(tmp_path / "test_paramdict.params")
+    params.save(fname)
+    params.load(fname, mx.cpu())
+
+
+def test_basic_dense():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation="tanh", in_units=10))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation="tanh", in_units=128))
+    model.add(nn.Dense(32, in_units=64))
+    model.initialize()
+    x = mx.nd.array(np.random.rand(32, 10).astype("float32"))
+    assert model(x).shape == (32, 32)
+
+
+def test_dense_deferred_shape():
+    dense = nn.Dense(7)
+    dense.initialize()
+    out = dense(mx.nd.ones((4, 3)))
+    assert out.shape == (4, 7)
+    assert dense.weight.shape == (7, 3)
+
+
+def test_hybrid_parity_dense():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 10).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_parity_conv_bn():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(), nn.Activation("relu"),
+            nn.MaxPool2D(), nn.Flatten(), nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_training_gradients_match():
+    """Hybridized backward must equal eager backward."""
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        return net
+
+    x = mx.nd.array(np.random.rand(8, 10).astype("float32"))
+    y = mx.nd.array(np.random.randint(0, 4, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net1 = build()
+    net1.initialize(init="one")
+    with mx.autograd.record():
+        l1 = loss_fn(net1(x), y)
+    l1.backward()
+
+    net2 = build()
+    net2.initialize(init="one")
+    net2.hybridize()
+    with mx.autograd.record():
+        l2 = loss_fn(net2(x), y)
+    l2.backward()
+
+    for p1, p2 in zip(net1.collect_params().values(),
+                      net2.collect_params().values()):
+        assert_almost_equal(p1.grad().asnumpy(), p2.grad().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.rand(8, 4, 3, 3).astype("float32") * 5 + 2)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    # inference mode uses running stats, no update
+    rm_before = bn.running_mean.data().asnumpy().copy()
+    bn(x)
+    assert_almost_equal(rm_before, bn.running_mean.data().asnumpy())
+
+
+def test_trainer_sgd_converges():
+    np.random.seed(0)
+    w_true = np.random.rand(4, 3).astype("float32")
+    x = np.random.rand(256, 3).astype("float32")
+    y = x @ w_true.T
+    net = nn.Dense(4, in_units=3, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(100):
+        with mx.autograd.record():
+            l = loss_fn(net(mx.nd.array(x)), mx.nd.array(y))
+        l.backward()
+        trainer.step(x.shape[0])
+    assert float(l.mean().asscalar()) < 1e-3
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.ones((2, 4))
+    out1 = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net2.load_parameters(fname)
+    out2 = net2(x).asnumpy()
+    assert_almost_equal(out1, out2)
+
+
+def test_export_and_symbolblock_import(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.ones((2, 4))
+    net(x)
+    net.hybridize()
+    out1 = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0000.params")
+    out2 = net2(x).asnumpy()
+    assert_almost_equal(out1, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_getitem():
+    net = nn.Sequential()
+    for _ in range(5):
+        net.add(nn.Dense(4))
+    assert len(net) == 5
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[1:3]) == 2
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4))
+        net.add(nn.BatchNorm(in_channels=4))
+    sel = net.collect_params(".*gamma|.*beta")
+    assert all(("gamma" in k) or ("beta" in k) for k in sel.keys())
+    assert len(sel) == 2
+
+
+def test_constant_param():
+    const = np.ones((2, 2), dtype="float32") * 3
+    c = gluon.Constant("const", const)
+    c.initialize()
+    assert (c.data().asnumpy() == 3).all()
+    assert c.grad_req == "null"
+
+
+def test_zoneout_residual_cells():
+    cell = gluon.rnn.ResidualCell(gluon.rnn.GRUCell(4, input_size=4))
+    cell.initialize()
+    x = mx.nd.ones((2, 4))
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
+    captured = capsys.readouterr()
+    assert "Total params" in captured.out
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.ones((3,)) * 4, mx.nd.ones((2,)) * 3]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert total <= 1.01
+    assert norm > 1.0
+
+
+def test_split_and_load():
+    x = mx.nd.array(np.arange(24).reshape(8, 3))
+    parts = gluon.utils.split_and_load(x, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 3)
+    got = np.concatenate([p.asnumpy() for p in parts])
+    assert_almost_equal(got, x.asnumpy())

@@ -1,0 +1,89 @@
+"""Gluon data tests (modeled on reference tests/python/unittest/
+test_gluon_data.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler,
+                                  SimpleDataset)
+from mxnet_tpu.gluon.data.vision import SyntheticImageDataset, transforms
+
+
+def test_array_dataset():
+    x = np.random.rand(10, 3).astype("float32")
+    y = np.arange(10).astype("int32")
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    assert (xi == x[3]).all()
+    assert yi == 3
+
+
+def test_simple_dataset_transform():
+    ds = SimpleDataset(list(range(10))).transform(lambda a: a * 2)
+    assert ds[4] == 8
+    ds2 = SimpleDataset([(1, 2), (3, 4)]).transform_first(lambda a: a * 10)
+    assert ds2[1] == (30, 4)
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+    bs = BatchSampler(SequentialSampler(10), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3, 3]
+
+
+def test_dataloader_basic():
+    x = np.random.rand(20, 4).astype("float32")
+    y = np.arange(20).astype("int32")
+    loader = DataLoader(ArrayDataset(x, y), batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    bx, by = batches[0]
+    assert bx.shape == (5, 4)
+    assert by.shape == (5,)
+
+
+def test_dataloader_shuffle_and_workers():
+    x = np.arange(30).astype("float32")
+    loader = DataLoader(ArrayDataset(x), batch_size=10, shuffle=True,
+                        num_workers=2)
+    seen = np.sort(np.concatenate([b.asnumpy() for b in loader]))
+    assert (seen == np.arange(30)).all()
+
+
+def test_synthetic_image_dataset_pipeline():
+    ds = SyntheticImageDataset(length=32, shape=(8, 8, 3))
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.5, 0.5)])
+    loader = DataLoader(ds.transform_first(tf), batch_size=8)
+    for bx, by in loader:
+        assert bx.shape == (8, 3, 8, 8)
+        assert by.shape == (8,)
+        break
+
+
+def test_transforms():
+    img = mx.nd.array(np.random.randint(0, 255, (10, 12, 3)), dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 10, 12)
+    assert t.dtype == np.float32
+    r = transforms.Resize((6, 5))(img)   # (w, h)
+    assert r.shape == (5, 6, 3)
+    c = transforms.CenterCrop((6, 4))(img)
+    assert c.shape == (4, 6, 3)
+    f = transforms.RandomFlipLeftRight()(img)
+    assert f.shape == img.shape
+
+
+def test_last_batch_rollover():
+    x = np.arange(10).astype("float32")
+    loader = DataLoader(ArrayDataset(x), batch_size=3, last_batch="rollover")
+    n1 = sum(1 for _ in loader)
+    n2 = sum(1 for _ in loader)
+    assert n1 == 3
+    assert n2 == 3

@@ -1,0 +1,47 @@
+"""Model zoo tests (modeled on reference tests/python/unittest/
+test_gluon_model_zoo.py) — small inputs, eager and hybridized."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2",
+                                  "mobilenet0.25", "mobilenetv2_0.25",
+                                  "squeezenet1.1"])
+def test_models_forward(name):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 224, 224).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_resnet18_hybrid_parity():
+    net = vision.get_model("resnet18_v1", classes=7)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 64, 64).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-3, atol=1e-4)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("not_a_model")
+
+
+def test_resnet50_structure():
+    net = vision.resnet50_v1(classes=13)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 13)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    # ~25.6M params at 1000 classes; at 13 classes fc shrinks
+    assert 23_000_000 < n_params < 26_000_000

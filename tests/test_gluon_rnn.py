@@ -1,0 +1,157 @@
+"""Gluon RNN tests (modeled on reference tests/python/unittest/
+test_gluon_rnn.py): cells vs fused layers, bidirectional, stacking.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, n_states in [(gluon.rnn.RNNCell, 1),
+                               (gluon.rnn.LSTMCell, 2),
+                               (gluon.rnn.GRUCell, 1)]:
+        cell = cell_cls(100, input_size=50)
+        cell.initialize()
+        x = mx.nd.ones((8, 50))
+        states = cell.begin_state(8)
+        assert len(states) == n_states
+        out, new_states = cell(x, states)
+        assert out.shape == (8, 100)
+        assert len(new_states) == n_states
+
+
+def test_cell_unroll_merged_vs_list():
+    cell = gluon.rnn.LSTMCell(16, input_size=8)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(4, 5, 8).astype("float32"))  # NTC
+    outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (4, 5, 16)
+    outs_list, _ = cell.unroll(5, x, layout="NTC", merge_outputs=False)
+    assert len(outs_list) == 5
+    assert_almost_equal(outs.asnumpy()[:, 0], outs_list[0].asnumpy())
+
+
+def test_fused_lstm_matches_cell():
+    """The fused lax.scan LSTM must match step-wise LSTMCell math."""
+    hidden, inp, T, B = 6, 4, 5, 3
+    layer = gluon.rnn.LSTM(hidden, input_size=inp)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(T, B, inp).astype("float32"))
+    out = layer(x)
+
+    cell = gluon.rnn.LSTMCell(hidden, input_size=inp)
+    cell.initialize()
+    # copy fused layer weights into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(out.asnumpy(), outs.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_gru_matches_cell():
+    hidden, inp, T, B = 6, 4, 5, 3
+    layer = gluon.rnn.GRU(hidden, input_size=inp)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(T, B, inp).astype("float32"))
+    out = layer(x)
+
+    cell = gluon.rnn.GRUCell(hidden, input_size=inp)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(out.asnumpy(), outs.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layouts_and_states():
+    lstm = gluon.rnn.LSTM(7, num_layers=2, layout="NTC", input_size=5)
+    lstm.initialize()
+    x = mx.nd.array(np.random.rand(3, 9, 5).astype("float32"))
+    states = lstm.begin_state(3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (3, 9, 7)
+    assert new_states[0].shape == (2, 3, 7)
+    assert new_states[1].shape == (2, 3, 7)
+
+
+def test_bidirectional_fused():
+    lstm = gluon.rnn.LSTM(7, num_layers=2, bidirectional=True, input_size=5)
+    lstm.initialize()
+    x = mx.nd.array(np.random.rand(9, 3, 5).astype("float32"))
+    out = lstm(x)
+    assert out.shape == (9, 3, 14)
+
+
+def test_bidirectional_cell():
+    cell = gluon.rnn.BidirectionalCell(
+        gluon.rnn.LSTMCell(4, input_size=3, prefix="l_"),
+        gluon.rnn.LSTMCell(4, input_size=3, prefix="r_"))
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 6, 3).astype("float32"))
+    outs, states = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 6, 8)
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8, input_size=4))
+    stack.add(gluon.rnn.DropoutCell(0.2))
+    stack.add(gluon.rnn.GRUCell(6, input_size=8))
+    stack.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 4).astype("float32"))
+    outs, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 6)
+
+
+def test_rnn_gradient_flows():
+    lstm = gluon.rnn.LSTM(5, num_layers=1, input_size=4)
+    lstm.initialize()
+    x = mx.nd.array(np.random.rand(7, 2, 4).astype("float32"))
+    with mx.autograd.record():
+        out = lstm(x)
+        loss = out.sum()
+    loss.backward()
+    g = lstm.l0_i2h_weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_rnn_train_overfit():
+    """Tiny LSTM regression: loss must drop (end-to-end scan autodiff)."""
+    np.random.seed(0)
+    T, B, C = 6, 8, 3
+    x = mx.nd.array(np.random.rand(T, B, C).astype("float32"))
+    y = mx.nd.array(np.random.rand(B, 1).astype("float32"))
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.rnn = gluon.rnn.LSTM(8, input_size=C)
+            self.out = gluon.nn.Dense(1)
+
+        def hybrid_forward(self, F, x):
+            h = self.rnn(x)
+            last = F.SequenceLast(h, axis=0)
+            return self.out(last)
+
+    net = Net()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for i in range(60):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(B)
+        if first is None:
+            first = float(l.mean().asscalar())
+    last = float(l.mean().asscalar())
+    assert last < first * 0.3, (first, last)
