@@ -1,0 +1,76 @@
+"""Benchmark: ResNet-50 training throughput, single chip.
+
+Headline metric (BASELINE.md): ResNet-50 training img/s — reference
+MXNet 1.2 on V100 fp32: 298.51 img/s @ bs=32, 363.69 img/s @ bs=128
+(docs/faq/perf.md:225-236).  vs_baseline compares against the bs=128
+V100 number.
+
+The whole train step (fwd+bwd+SGD momentum+BN stat update) is one
+jitted XLA computation (parallel/gluon_step.py); compute in bfloat16
+with fp32 master weights (MXU-native mixed precision, the analog of the
+reference's multi-precision SGD).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69  # ResNet-50 training bs=128, V100 fp32
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.gluon_step import GluonTrainStep
+    from mxnet_tpu.parallel.mesh import create_mesh
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    devices = jax.devices()[:1]  # single-chip benchmark
+    mesh = create_mesh({"dp": 1}, devices=devices)
+
+    net = vision.resnet50_v1()
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    with ctx:
+        net.initialize(ctx=ctx)
+        net(mx.nd.zeros((1, 3, 32, 32), ctx=ctx))  # resolve deferred shapes
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9,
+                          wd=1e-4, compute_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    x, y = step.put_batch(x, y)  # device-resident synthetic batch
+
+    # warmup (compile + 2 steps)
+    for _ in range(3):
+        l = step(x, y)
+    jax.block_until_ready(l)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = step(x, y)
+    jax.block_until_ready(l)
+    dt = time.perf_counter() - t0
+
+    img_s = steps * batch / dt
+    print(json.dumps({
+        "metric": "resnet50_v1 training img/s (bs=%d, bf16 compute, 1 chip)"
+                  % batch,
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
