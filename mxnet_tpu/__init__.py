@@ -31,7 +31,8 @@ _OPTIONAL = [
     "initializer", "optimizer", "metric", "lr_scheduler", "callback",
     "symbol", "io", "recordio", "gluon", "module", "kvstore", "executor",
     "cached_op", "profiler", "runtime", "test_utils", "visualization",
-    "parallel", "contrib", "model", "image",
+    "parallel", "contrib", "model", "image", "operator", "monitor",
+    "executor_manager", "rtc",
 ]
 
 

@@ -1,0 +1,33 @@
+"""Helpers for multi-device executor management.
+
+Reference: python/mxnet/executor_manager.py (_split_input_slice,
+DataParallelExecutorManager used by the legacy FeedForward API).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["_split_input_slice"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice a batch according to per-device workloads
+    (reference: executor_manager.py:_split_input_slice)."""
+    total = sum(work_load_list)
+    if total == 0:
+        raise ValueError("Invalid workload")
+    batch_num_list = [round(batch_size * (float(w) / total))
+                      for w in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices

@@ -1,0 +1,76 @@
+"""mx.monitor — per-op output statistics hook.
+
+Reference: python/mxnet/monitor.py (Monitor over
+MXExecutorSetMonitorCallback).  Here the hook taps Gluon block forward
+hooks / executor outputs instead of engine callbacks.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as _np
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return _np.abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self._installed = []
+
+    def install(self, block):
+        """Attach to a Gluon block tree (TPU-native analog of
+        executor monitor callbacks)."""
+
+        def make_hook(name):
+            def hook(blk, inputs, outputs):
+                if not self.activated:
+                    return
+                outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+                for i, o in enumerate(outs):
+                    key = "%s_output%d" % (name, i)
+                    if self.re_pattern.match(key) and isinstance(o, NDArray):
+                        self.queue.append((self.step, key,
+                                           self.stat_func(o.asnumpy())))
+            return hook
+
+        def attach(blk, path):
+            h = blk.register_forward_hook(make_hook(path or blk.name))
+            self._installed.append((blk, h))
+            for k, c in blk._children.items():
+                attach(c, (path + "." if path else "") + k)
+
+        attach(block, "")
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = list(self.queue)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for step, name, value in self.toc():
+            print("Batch: %7d %30s %s" % (step, name, value))

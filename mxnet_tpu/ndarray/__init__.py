@@ -20,3 +20,5 @@ def onehot_encode(indices, out):
     res = imperative_invoke("one_hot", [indices], {"depth": out.shape[1]})[0]
     out._assign(res._data.astype(out.dtype))
     return out
+
+from . import contrib  # noqa: E402,F401

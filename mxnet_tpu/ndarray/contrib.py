@@ -1,0 +1,142 @@
+"""nd.contrib — control flow + contrib op namespace.
+
+Reference: python/mxnet/ndarray/contrib.py (foreach, while_loop, cond)
+over src/operator/control_flow.cc:1255,1316,1378.
+
+TPU-native: instead of CachedOp subgraph nodes, the body is traced once
+and lowered to lax.scan / lax.while_loop / lax.cond — the exact XLA
+constructs the reference ops were designed to mirror (SURVEY.md §2.1
+'Control-flow ops': "maps directly to XLA scan/while/cond").  Eager
+semantics are preserved: inputs/outputs are NDArrays.
+"""
+
+from __future__ import annotations
+
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _wrap(v, ctx):
+    return NDArray(v, ctx)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _tree_unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return [_tree_unwrap(x) for x in xs]
+    return _unwrap(xs)
+
+
+def _tree_wrap(vs, ctx):
+    if isinstance(vs, (list, tuple)):
+        return [_tree_wrap(v, ctx) for v in vs]
+    return _wrap(vs, ctx)
+
+
+def foreach(body, data, init_states):
+    """Run `body(data_i, states) -> (out, new_states)` over axis 0 of
+    data, stacking outputs (reference: contrib.foreach / _foreach op).
+
+    Lowers to one lax.scan — the whole loop compiles to a single XLA
+    While with the body fused.
+    """
+    import jax
+    from jax import lax
+
+    single_data = isinstance(data, NDArray)
+    ctx = (data if single_data else data[0])._ctx
+
+    xs = _tree_unwrap(data)
+    init = _tree_unwrap(init_states)
+
+    def scan_body(carry, x):
+        states_nd = _tree_wrap(carry, ctx)
+        x_nd = _tree_wrap(x, ctx)
+        out, new_states = body(x_nd, states_nd)
+        return _tree_unwrap(new_states), _tree_unwrap(out)
+
+    carry, ys = lax.scan(scan_body, init, xs)
+    outs = _tree_wrap(ys, ctx)
+    states = _tree_wrap(carry, ctx)
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """reference: contrib.while_loop / _while_loop op.
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars).  Per the reference, outputs are
+    stacked into a max_iterations-capacity buffer (rows past the actual
+    iteration count are undefined in the reference; zeros here).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if max_iterations is None:
+        raise ValueError("max_iterations is required (static bound for XLA)")
+    ctx = loop_vars[0]._ctx
+    init = [_unwrap(v) for v in loop_vars]
+
+    # trace one step to learn the output structure
+    probe_out, _ = func(*loop_vars)
+    probe_out = probe_out if isinstance(probe_out, (list, tuple)) else [probe_out]
+    bufs = [jnp.zeros((int(max_iterations),) + tuple(o.shape),
+                      dtype=o.dtype) for o in probe_out]
+
+    def cond_fn(state):
+        i, vars_, _ = state
+        c = cond(*_tree_wrap(list(vars_), ctx))
+        return jnp.logical_and(i < max_iterations,
+                               _unwrap(c).astype(bool).reshape(()))
+
+    def body_fn(state):
+        i, vars_, bufs_ = state
+        out, new_vars = func(*_tree_wrap(list(vars_), ctx))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        new_bufs = tuple(b.at[i].set(_unwrap(o)) for b, o in zip(bufs_, out))
+        return (i + 1, tuple(_unwrap(v) for v in new_vars), new_bufs)
+
+    i, final_vars, final_bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.asarray(0), tuple(init), tuple(bufs)))
+    outs = [_wrap(b, ctx) for b in final_bufs]
+    return outs, [_wrap(v, ctx) for v in final_vars]
+
+
+def cond(pred, then_func, else_func):
+    """reference: contrib.cond / _cond op → lax.cond."""
+    from jax import lax
+
+    p = _unwrap(pred)
+    ctx = pred._ctx if isinstance(pred, NDArray) else None
+
+    def t(_):
+        return _tree_unwrap(then_func())
+
+    def e(_):
+        return _tree_unwrap(else_func())
+
+    res = lax.cond(p.astype(bool).reshape(()), t, e, None)
+    return _tree_wrap(res, ctx)
+
+
+def _install_contrib_ops(namespace):
+    """Expose contrib-registered ops as nd.contrib.* (reference: the
+    _contrib_ C++ prefix populating ndarray/contrib.py)."""
+    from ..ops import registry as _reg
+    from . import register as _register
+
+    names = [n for n in _reg.list_ops()
+             if n in ("box_nms", "box_iou", "MultiBoxPrior", "MultiBoxTarget",
+                      "MultiBoxDetection", "ROIAlign", "BilinearResize2D",
+                      "AdaptiveAvgPooling2D", "boolean_mask", "quadratic",
+                      "arange_like", "getnnz", "index_copy", "index_add",
+                      "adamw_update")]
+    _register.populate(namespace, names)
+    return namespace
+
+
+_install_contrib_ops(globals())

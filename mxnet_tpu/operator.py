@@ -1,0 +1,147 @@
+"""mx.operator — Python custom operators.
+
+Reference: python/mxnet/operator.py (CustomOp, CustomOpProp,
+register) over src/operator/custom/custom.cc:103 — user-defined ops
+callable from both the imperative and symbolic paths.
+
+TPU-native notes: the reference runs Python callbacks on a separate
+thread pool to keep the engine async.  Here a custom op is a host
+callback: in eager mode it runs directly on NDArrays; inside a staged
+graph (hybridize/Symbol executor) it is wrapped in
+``jax.pure_callback`` so XLA calls back into Python — the analog of
+the reference's dedicated custom-op thread (custom-inl.h:50).
+Gradients route through the user's ``backward`` via the tape.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray import array as _nd_array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_custom_op"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._assign(src._data if isinstance(src, NDArray)
+                        else _nd_array(src)._data)
+        elif req == "add":
+            dst._assign(dst._data + (src._data if isinstance(src, NDArray)
+                                     else _nd_array(src)._data))
+
+
+class CustomOpProp:
+    """Op metadata provider (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under a name
+    (reference: operator.py register → MXCustomOpRegister)."""
+
+    def deco(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        _install_custom(reg_name, prop_cls)
+        return prop_cls
+
+    return deco
+
+
+def get_custom_op(name):
+    return _CUSTOM_REGISTRY[name]
+
+
+def _install_custom(reg_name, prop_cls):
+    """Expose the op as mx.nd.Custom(..., op_type=reg_name) and as a
+    callable mx.nd.<reg_name>."""
+    from . import ndarray as nd_mod
+    from .ops import registry as _reg
+
+    def run_custom(*inputs, **kwargs):
+        kwargs.pop("name", None)
+        op_type = kwargs.pop("op_type", reg_name)
+        prop = _CUSTOM_REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+        in_nds = [x if isinstance(x, NDArray) else _nd_array(x) for x in inputs]
+        in_shapes = [x.shape for x in in_nds]
+        _ins, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
+        op = prop.create_operator(None, in_shapes,
+                                  [x.dtype for x in in_nds])
+        from . import autograd as _ag
+
+        outs = [nd_mod.zeros(s) for s in out_shapes]
+        aux = [nd_mod.zeros(s) for s in aux_shapes]
+        with _ag.pause():
+            op.forward(_ag.is_training(), ["write"] * len(outs), in_nds, outs,
+                       aux)
+
+        if _ag.is_recording() and _ag._any_recorded(in_nds):
+            def vjp_fn(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                out_grads = [NDArray(c) for c in cts]
+                in_grads = [nd_mod.zeros(s) for s in in_shapes]
+                with _ag.pause():
+                    op.backward(["write"] * len(in_grads), out_grads, in_nds,
+                                outs, in_grads, aux)
+                return tuple(g._data for g in in_grads)
+
+            _ag.record_op(in_nds, outs, vjp_fn)
+        return outs if len(outs) > 1 else outs[0]
+
+    setattr(nd_mod, reg_name, run_custom)
+    # Custom(op_type=...) entry point
+    if not hasattr(nd_mod, "Custom"):
+        def Custom(*inputs, **kwargs):
+            op_type = kwargs.get("op_type")
+            if op_type is None:
+                raise MXNetError("Custom requires op_type=")
+            fn = getattr(nd_mod, op_type, None)
+            if fn is None:
+                raise MXNetError("custom op %r not registered" % op_type)
+            return fn(*inputs, **kwargs)
+
+        nd_mod.Custom = Custom

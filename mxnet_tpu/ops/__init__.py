@@ -15,5 +15,6 @@ from . import nn  # noqa: F401
 from . import init_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import contrib  # noqa: F401
 
 from .registry import apply_op, get, list_ops, register  # noqa: F401

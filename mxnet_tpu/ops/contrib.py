@@ -1,0 +1,398 @@
+"""Contrib operators: detection (SSD/RCNN), resize, pooling, masking.
+
+Reference: src/operator/contrib/ — multibox_{prior,target,detection}.cc
+(SSD anchors/matching/decode), bounding_box.cc (box_nms, box_iou),
+roi_align.cc, bilinear_resize.cc, adaptive_avg_pooling.cc,
+boolean_mask.cc, index_copy.cc, quadratic_op.cc.
+
+TPU-native notes: the reference kernels use data-dependent shapes and
+per-row dynamic loops; here every op is a fixed-capacity masked
+computation so XLA gets static shapes (SURVEY.md §7 'SSD custom ops'):
+NMS keeps all boxes, marking suppressed entries -1; boolean_mask
+returns a fixed-size prefix buffer padded with zeros.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ----------------------------------------------------------------- helpers
+
+
+def _corner_iou(a, b):
+    """IoU of boxes in corner format. a: (..., M, 4), b: (..., N, 4) →
+    (..., M, N)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)       # (..., M, 1)
+    bx1, by1, bx2, by2 = [v.squeeze(-1) for v in jnp.split(b, 4, axis=-1)]
+    ix1 = jnp.maximum(ax1, bx1[..., None, :])
+    iy1 = jnp.maximum(ay1, by1[..., None, :])
+    ix2 = jnp.minimum(ax2, bx2[..., None, :])
+    iy2 = jnp.minimum(ay2, by2[..., None, :])
+    iw = jnp.clip(ix2 - ix1, 0, None)
+    ih = jnp.clip(iy2 - iy1, 0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax2 - ax1, 0, None) * jnp.clip(ay2 - ay1, 0, None)
+    area_b = jnp.clip(bx2 - bx1, 0, None) * jnp.clip(by2 - by1, 0, None)
+    union = area_a + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ----------------------------------------------------------------- boxes
+
+
+@register("box_iou", aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner", **_):
+    """reference: src/operator/contrib/bounding_box.cc BoxIoU."""
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+def _center_to_corner(b):
+    x, y, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+@register("box_nms", aliases=("_contrib_box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner", **_):
+    """Fixed-capacity NMS (reference: bounding_box.cc BoxNMS).
+
+    data: (..., N, K) rows [id?, score, x1,y1,x2,y2, ...]; suppressed
+    rows get score -1 (reference semantics), order sorted by score.
+    """
+    cs, si, ii = int(coord_start), int(score_index), int(id_index)
+    batch_shape = data.shape[:-2]
+    n, k = data.shape[-2], data.shape[-1]
+    flat = data.reshape((-1, n, k))
+
+    def one(rows):
+        scores = rows[:, si]
+        order = jnp.argsort(-scores)
+        rows_s = rows[order]
+        scores_s = rows_s[:, si]
+        boxes = rows_s[:, cs:cs + 4]
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        ious = _corner_iou(boxes, boxes)
+        valid = scores_s > valid_thresh
+        if topk > 0:
+            valid = valid & (jnp.arange(n) < topk)
+        if ii >= 0 and not force_suppress:
+            ids = rows_s[:, ii]
+            same_class = ids[:, None] == ids[None, :]
+        else:
+            same_class = jnp.ones((n, n), dtype=bool)
+
+        def body(i, keep):
+            sup = keep[i] & valid[i]
+            over = (ious[i] > overlap_thresh) & same_class[i] & \
+                (jnp.arange(n) > i)
+            return jnp.where(sup & over, False, keep)
+
+        keep = lax.fori_loop(0, n, body, jnp.ones((n,), dtype=bool))
+        keep = keep & valid
+        new_scores = jnp.where(keep, scores_s, -1.0)
+        out = rows_s.at[:, si].set(new_scores)
+        return out
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(batch_shape + (n, k))
+
+
+# ----------------------------------------------------------------- multibox
+
+
+@register("MultiBoxPrior", aliases=("multibox_prior", "_contrib_MultiBoxPrior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5), **_):
+    """SSD anchor generation (reference: contrib/multibox_prior.cc).
+
+    data: (B, C, H, W) → anchors (1, H*W*(S+R-1), 4) corner format.
+    """
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in (sizes if hasattr(sizes, "__len__") else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if hasattr(ratios, "__len__") else (ratios,)))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")     # (H, W)
+    centers = jnp.stack([cxg, cyg], axis=-1).reshape(-1, 2)  # (HW, 2) x,y
+
+    wh = []
+    # reference order: (s1,r1), (s2,r1), ..., (s1,r2), (s1,r3)...
+    for s in sizes:
+        wh.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        wh.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    wh = jnp.asarray(wh)                                # (A, 2)
+    a = wh.shape[0]
+    cxy = jnp.repeat(centers, a, axis=0)                # (HW*A, 2)
+    whs = jnp.tile(wh, (centers.shape[0], 1))           # (HW*A, 2)
+    anchors = jnp.concatenate([cxy - whs / 2, cxy + whs / 2], axis=-1)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors[None].astype(data.dtype)
+
+
+@register("MultiBoxTarget", aliases=("multibox_target", "_contrib_MultiBoxTarget"),
+          num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """SSD target matching (reference: contrib/multibox_target.cc).
+
+    anchor: (1, N, 4) corners; label: (B, M, 5) [cls, x1,y1,x2,y2] with
+    -1 padding; cls_pred: (B, C+1, N) (unused beyond shape, kept for
+    negative mining parity).  Returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N)).
+    """
+    anchors = anchor[0]                                  # (N, 4)
+    n = anchors.shape[0]
+
+    def one(lbl):
+        gt_valid = lbl[:, 0] >= 0                        # (M,)
+        gt_boxes = lbl[:, 1:5]
+        ious = _corner_iou(anchors, gt_boxes)            # (N, M)
+        ious = jnp.where(gt_valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)               # (N,)
+        best_iou = jnp.max(ious, axis=1)
+        # bipartite stage: each valid gt claims its best anchor; an
+        # explicit (M, N) claim matrix avoids scatter collisions between
+        # valid and padded gt rows
+        best_anchor = jnp.argmax(ious, axis=0)           # (M,)
+        m = lbl.shape[0]
+        claim = (best_anchor[:, None] ==
+                 jnp.arange(n)[None, :]) & gt_valid[:, None]  # (M, N)
+        claimed = claim.any(axis=0)
+        claimed_gt = jnp.argmax(claim, axis=0).astype(jnp.int32)
+        pos = claimed | (best_iou >= overlap_threshold)
+        match = jnp.where(claimed, claimed_gt, best_gt)
+
+        matched_box = gt_boxes[match]                    # (N, 4)
+        # encode regression target in center format / variances
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = jnp.clip(anchors[:, 2] - anchors[:, 0], 1e-8, None)
+        ah = jnp.clip(anchors[:, 3] - anchors[:, 1], 1e-8, None)
+        gcx = (matched_box[:, 0] + matched_box[:, 2]) / 2
+        gcy = (matched_box[:, 1] + matched_box[:, 3]) / 2
+        gw = jnp.clip(matched_box[:, 2] - matched_box[:, 0], 1e-8, None)
+        gh = jnp.clip(matched_box[:, 3] - matched_box[:, 1], 1e-8, None)
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)     # (N, 4)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.repeat(pos.astype(jnp.float32), 4)
+        cls_t = jnp.where(pos, lbl[match, 0] + 1.0, 0.0)  # bg = 0
+        return loc_t, loc_m, cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label)
+    return (loc_target.astype(anchor.dtype), loc_mask.astype(anchor.dtype),
+            cls_target.astype(anchor.dtype))
+
+
+@register("MultiBoxDetection",
+          aliases=("multibox_detection", "_contrib_MultiBoxDetection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **_):
+    """SSD decode + NMS (reference: contrib/multibox_detection.cc).
+
+    cls_prob: (B, C+1, N), loc_pred: (B, N*4), anchor: (1, N, 4) →
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed = -1.
+    """
+    b = cls_prob.shape[0]
+    n = anchor.shape[1]
+    anchors = anchor[0]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    loc = loc_pred.reshape((b, n, 4))
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)                           # (B, N, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # best foreground class per anchor
+    fg = jnp.concatenate([cls_prob[:, :background_id],
+                          cls_prob[:, background_id + 1:]], axis=1)
+    cls_id = jnp.argmax(fg, axis=1).astype(cls_prob.dtype)  # (B, N)
+    score = jnp.max(fg, axis=1)
+    keep = score > threshold
+    cls_id = jnp.where(keep, cls_id, -1.0)
+    score = jnp.where(keep, score, -1.0)
+    rows = jnp.concatenate([cls_id[..., None], score[..., None], boxes],
+                           axis=-1)                      # (B, N, 6)
+    out = box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+    # reference marks suppressed rows' class id -1
+    sup = out[..., 1] <= 0
+    out = out.at[..., 0].set(jnp.where(sup, -1.0, out[..., 0]))
+    return out
+
+
+# ----------------------------------------------------------------- roi
+
+
+@register("ROIAlign", aliases=("_contrib_ROIAlign",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False, **_):
+    """ROI Align with bilinear sampling (reference: contrib/roi_align.cc).
+
+    data: (B, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2].
+    """
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - off, roi[2] * spatial_scale - off, \
+            roi[3] * spatial_scale - off, roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (ph*sr, pw*sr)
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * (bin_h / sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * (bin_w / sr)
+        img = data[bidx]                                  # (C, H, W)
+        c, hh, ww = img.shape
+        yc = jnp.clip(ys, 0, hh - 1)
+        xc = jnp.clip(xs, 0, ww - 1)
+        y0 = jnp.floor(yc).astype(jnp.int32)
+        x0 = jnp.floor(xc).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, hh - 1)
+        x1i = jnp.minimum(x0 + 1, ww - 1)
+        wy = yc - y0
+        wx = xc - x0
+        a = img[:, y0][:, :, x0]
+        bq = img[:, y0][:, :, x1i]
+        cq = img[:, y1i][:, :, x0]
+        d = img[:, y1i][:, :, x1i]
+        samp = (a * (1 - wy)[None, :, None] * (1 - wx)[None, None, :] +
+                bq * (1 - wy)[None, :, None] * wx[None, None, :] +
+                cq * wy[None, :, None] * (1 - wx)[None, None, :] +
+                d * wy[None, :, None] * wx[None, None, :])
+        samp = samp.reshape(c, ph, sr, pw, sr)
+        return samp.mean(axis=(2, 4))                    # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ----------------------------------------------------------------- resize/pool
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                      scale_width=None, mode="size", align_corners=True, **_):
+    """reference: contrib/bilinear_resize.cc"""
+    b, c, h, w = data.shape
+    if scale_height is not None and mode != "size":
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    oh, ow = int(height), int(width)
+    if align_corners and oh > 1 and ow > 1:
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+    else:
+        ys = jnp.clip((jnp.arange(oh) + 0.5) * h / oh - 0.5, 0, h - 1)
+        xs = jnp.clip((jnp.arange(ow) + 0.5) * w / ow - 0.5, 0, w - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    a = data[:, :, y0][:, :, :, x0]
+    bq = data[:, :, y0][:, :, :, x1]
+    cq = data[:, :, y1][:, :, :, x0]
+    d = data[:, :, y1][:, :, :, x1]
+    return (a * (1 - wy) * (1 - wx) + bq * (1 - wy) * wx +
+            cq * wy * (1 - wx) + d * wy * wx).astype(data.dtype)
+
+
+@register("AdaptiveAvgPooling2D", aliases=("_contrib_AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling2d(data, output_size=(1, 1), **_):
+    """reference: contrib/adaptive_avg_pooling.cc"""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    if len(output_size) == 1:
+        output_size = (output_size[0], output_size[0])
+    oh, ow = int(output_size[0]), int(output_size[1])
+    b, c, h, w = data.shape
+    if h % oh == 0 and w % ow == 0:
+        return data.reshape(b, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    # general case: per output cell average over [floor(i*h/oh), ceil((i+1)h/oh))
+    ys = [(int(i * h // oh), int(-(-((i + 1) * h) // oh))) for i in range(oh)]
+    xs = [(int(j * w // ow), int(-(-((j + 1) * w) // ow))) for j in range(ow)]
+    rows = []
+    for y0, y1 in ys:
+        cols = [data[:, :, y0:y1, x0:x1].mean(axis=(2, 3)) for x0, x1 in xs]
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+# ----------------------------------------------------------------- masking
+
+
+@register("boolean_mask", aliases=("_contrib_boolean_mask",))
+def boolean_mask(data, index, axis=0, **_):
+    """Fixed-capacity boolean_mask (reference: contrib/boolean_mask.cc).
+
+    The reference output shape is data-dependent (#nonzero); XLA needs
+    static shapes, so selected rows are compacted to the front and the
+    buffer keeps its full length, padded with zeros — consumers mask by
+    the returned count convention (row i valid iff i < index.sum()).
+    """
+    ax = int(axis)
+    mask = index.astype(bool)
+    n = data.shape[ax]
+    moved = jnp.moveaxis(data, ax, 0)
+    # stable compaction permutation: selected indices first
+    order = jnp.argsort(~mask, stable=True)
+    compacted = moved[order]
+    valid = jnp.arange(n) < mask.sum()
+    shape = (n,) + (1,) * (compacted.ndim - 1)
+    out = jnp.where(valid.reshape(shape), compacted, 0)
+    return jnp.moveaxis(out, 0, ax)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0, **_):
+    """Tutorial op (reference: contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("arange_like", aliases=("_contrib_arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=data.dtype)
+        return out.reshape(data.shape)
+    n = data.shape[int(axis)]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("getnnz", aliases=("_contrib_getnnz",))
+def getnnz(data, axis=None, **_):
+    return (data != 0).sum(axis=axis).astype(jnp.int64)

@@ -1,0 +1,209 @@
+"""Control flow + contrib op tests (modeled on reference
+tests/python/unittest/test_contrib_control_flow.py and
+test_contrib_operator.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    init = mx.nd.zeros((3,))
+    outs, states = mx.nd.contrib.foreach(
+        lambda x, s: (x + s[0], [x + s[0]]), data, [init])
+    expect = np.cumsum(data.asnumpy(), axis=0)
+    assert_almost_equal(outs.asnumpy(), expect)
+    assert_almost_equal(states[0].asnumpy(), expect[-1])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body_fn(i, s):
+        return (s, (i + 1, s + i))
+
+    outs, vars_ = mx.nd.contrib.while_loop(
+        cond_fn, body_fn, [mx.nd.array([0.0]), mx.nd.array([1.0])],
+        max_iterations=8)
+    assert float(vars_[0].asscalar()) == 5
+    assert float(vars_[1].asscalar()) == 1 + 0 + 1 + 2 + 3 + 4
+
+
+def test_cond():
+    t = lambda: mx.nd.ones((2,))
+    f = lambda: mx.nd.zeros((2,))
+    r1 = mx.nd.contrib.cond(mx.nd.array([1.0]), t, f)
+    r0 = mx.nd.contrib.cond(mx.nd.array([0.0]), t, f)
+    assert (r1.asnumpy() == 1).all()
+    assert (r0.asnumpy() == 0).all()
+
+
+def test_box_iou():
+    a = mx.nd.array(np.array([[0, 0, 2, 2]], dtype="float32"))
+    b = mx.nd.array(np.array([[1, 1, 3, 3], [4, 4, 5, 5]], dtype="float32"))
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    assert abs(iou[0, 0] - 1.0 / 7.0) < 1e-5
+    assert iou[0, 1] == 0
+
+
+def test_box_nms_suppression():
+    boxes = np.array([[[0, 0.9, 0.10, 0.10, 0.50, 0.50],
+                       [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                       [1, 0.7, 0.60, 0.60, 0.90, 0.90]]], dtype="float32")
+    out = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                coord_start=2, score_index=1,
+                                id_index=0).asnumpy()
+    scores = out[0, :, 1]
+    # overlapping same-class box suppressed; different class kept
+    assert scores[0] == np.float32(0.9)
+    assert scores[1] == -1
+    assert scores[2] == np.float32(0.7)
+
+
+def test_box_nms_force_suppress():
+    boxes = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                       [1, 0.8, 0.1, 0.1, 0.5, 0.5]]], dtype="float32")
+    keep_cls = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                     coord_start=2, score_index=1,
+                                     id_index=0).asnumpy()
+    assert keep_cls[0, 1, 1] == np.float32(0.8)  # different class survives
+    forced = mx.nd.contrib.box_nms(mx.nd.array(boxes), overlap_thresh=0.5,
+                                   coord_start=2, score_index=1, id_index=0,
+                                   force_suppress=True).asnumpy()
+    assert forced[0, 1, 1] == -1
+
+
+def test_multibox_pipeline():
+    feat = mx.nd.zeros((1, 8, 4, 4))
+    anchors = mx.nd.contrib.MultiBoxPrior(feat, sizes=[0.5, 0.25],
+                                          ratios=[1, 2])
+    n = anchors.shape[1]
+    assert n == 4 * 4 * 3  # H*W*(S+R-1)
+    a = anchors.asnumpy()
+    assert a.shape == (1, n, 4)
+
+    label = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0]]], dtype="float32"))
+    cls_pred = mx.nd.zeros((1, 3, n))
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(anchors, label,
+                                                       cls_pred)
+    assert loc_t.shape == (1, n * 4)
+    assert cls_t.shape == (1, n)
+    assert float(cls_t.max().asscalar()) == 1.0  # class 0 → target 1
+    assert float((loc_m.sum() / 4).asscalar()) >= 1  # >= 1 positive anchor
+
+    cls_prob = mx.nd.array(np.random.rand(1, 3, n).astype("float32"))
+    loc_pred = mx.nd.zeros((1, n * 4))
+    det = mx.nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                          threshold=0.1)
+    assert det.shape == (1, n, 6)
+
+
+def test_roi_align_values():
+    data = mx.nd.array(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = mx.nd.array(np.array([[0, 0, 0, 3, 3]], dtype="float32"))
+    out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0, sample_ratio=1)
+    assert out.shape == (1, 1, 2, 2)
+    v = out.asnumpy()[0, 0]
+    assert v[0, 0] < v[0, 1] < v[1, 1]  # monotone ramp preserved
+
+
+def test_bilinear_resize_identity():
+    x = mx.nd.array(np.random.rand(1, 2, 5, 5).astype("float32"))
+    out = mx.nd.contrib.BilinearResize2D(x, height=5, width=5)
+    assert_almost_equal(out.asnumpy(), x.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_avg_pool():
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype("float32"))
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    expect = x.asnumpy().reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+    out2 = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=(3, 5))
+    assert out2.shape == (2, 3, 3, 5)
+
+
+def test_boolean_mask():
+    data = mx.nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    index = mx.nd.array(np.array([1, 0, 1, 0], dtype="float32"))
+    out = mx.nd.contrib.boolean_mask(data, index).asnumpy()
+    assert (out[0] == [0, 1, 2]).all()
+    assert (out[1] == [6, 7, 8]).all()
+    assert (out[2:] == 0).all()
+
+
+def test_quadratic_and_grad():
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0]))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0)
+    y.backward(mx.nd.ones((3,)))
+    assert_almost_equal(y.asnumpy(), np.array([6.0, 11.0, 18.0]))
+    assert_almost_equal(x.grad.asnumpy(), np.array([4.0, 6.0, 8.0]))
+
+
+def test_custom_op():
+    import mxnet_tpu.operator as op_mod
+
+    @op_mod.register("sq_test")
+    class SqProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Sq(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                in_data[0] * in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                2 * in_data[0] * out_grad[0])
+            return Sq()
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0]))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="sq_test")
+    y.backward(mx.nd.ones((3,)))
+    assert_almost_equal(y.asnumpy(), np.array([1.0, 4.0, 9.0]))
+    assert_almost_equal(x.grad.asnumpy(), np.array([2.0, 4.0, 6.0]))
+
+
+def test_image_pipeline(tmp_path):
+    import cv2
+
+    img = (np.random.rand(32, 48, 3) * 255).astype("uint8")
+    ok, buf = cv2.imencode(".jpg", img)
+    dec = mx.image.imdecode(buf.tobytes())
+    assert dec.shape == (32, 48, 3)
+    assert mx.image.imresize(dec, 24, 16).shape == (16, 24, 3)
+    assert mx.image.resize_short(dec, 20).shape == (20, 30, 3)
+    crop, rect = mx.image.center_crop(dec, (16, 16))
+    assert crop.shape == (16, 16, 3)
+
+    for i in range(4):
+        cv2.imwrite(str(tmp_path / ("img%d.jpg" % i)), img)
+    it = mx.image.ImageIter(
+        2, (3, 16, 16),
+        imglist=[(i % 2, "img%d.jpg" % i) for i in range(4)],
+        path_root=str(tmp_path))
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert batch.label[0].shape == (2,)
+
+
+def test_monitor():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    mon = mx.monitor.Monitor(1).install(net)
+    mon.tic()
+    net(mx.nd.ones((2, 3)))
+    stats = mon.toc()
+    assert len(stats) >= 1
+    assert all(np.isfinite(v) for _, _, v in stats)
