@@ -73,18 +73,53 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     stacked into a max_iterations-capacity buffer (rows past the actual
     iteration count are undefined in the reference; zeros here).
     """
+    import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax import lax
 
     if max_iterations is None:
         raise ValueError("max_iterations is required (static bound for XLA)")
+    max_iterations = int(max_iterations)
     ctx = loop_vars[0]._ctx
     init = [_unwrap(v) for v in loop_vars]
 
-    # trace one step to learn the output structure
-    probe_out, _ = func(*loop_vars)
-    probe_out = probe_out if isinstance(probe_out, (list, tuple)) else [probe_out]
-    bufs = [jnp.zeros((int(max_iterations),) + tuple(o.shape),
+    if not any(isinstance(v, jax.core.Tracer) for v in init):
+        # eager semantics (reference python/mxnet/ndarray/contrib.py
+        # while_loop): a plain Python loop — func runs only while cond
+        # holds; if cond is never satisfied, outputs are empty (the
+        # reference documents exactly this asymmetry vs symbolic mode)
+        vars_ = list(loop_vars)
+        rows = []
+        steps = 0
+        while steps < max_iterations and bool(np.asarray(_unwrap(cond(*vars_)))):
+            out, new_vars = func(*vars_)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            rows.append([_unwrap(o) for o in out])
+            new_vars = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
+            vars_ = [v if isinstance(v, NDArray) else _wrap(v, ctx)
+                     for v in new_vars]
+            steps += 1
+        outs = []
+        if rows:
+            for k in range(len(rows[0])):
+                buf = jnp.zeros((max_iterations,) + tuple(rows[0][k].shape),
+                                rows[0][k].dtype)
+                for i, row in enumerate(rows):
+                    buf = buf.at[i].set(row[k])
+                outs.append(_wrap(buf, ctx))
+        return outs, list(vars_)
+
+    # traced: output structure via abstract evaluation — func is never
+    # executed on real data (shapes only), then one lax.while_loop
+    def _probe(*vs):
+        out, _ = func(*_tree_wrap(list(vs), ctx))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [_unwrap(o) for o in out]
+
+    probe_out = jax.eval_shape(_probe, *init)
+
+    bufs = [jnp.zeros((max_iterations,) + tuple(o.shape),
                       dtype=o.dtype) for o in probe_out]
 
     def cond_fn(state):
@@ -107,11 +142,20 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
 
 
 def cond(pred, then_func, else_func):
-    """reference: contrib.cond / _cond op → lax.cond."""
+    """reference: contrib.cond / _cond op → lax.cond.
+
+    Eager (concrete pred): only the selected branch runs, matching the
+    reference's imperative semantics.  Traced: lax.cond.
+    """
+    import jax
+    import numpy as np
     from jax import lax
 
     p = _unwrap(pred)
     ctx = pred._ctx if isinstance(pred, NDArray) else None
+
+    if not isinstance(p, jax.core.Tracer):
+        return then_func() if bool(np.asarray(p)) else else_func()
 
     def t(_):
         return _tree_unwrap(then_func())
@@ -134,7 +178,10 @@ def _install_contrib_ops(namespace):
                       "MultiBoxDetection", "ROIAlign", "BilinearResize2D",
                       "AdaptiveAvgPooling2D", "boolean_mask", "quadratic",
                       "arange_like", "getnnz", "index_copy", "index_add",
-                      "adamw_update")]
+                      "adamw_update", "_contrib_flash_attention",
+                      "_contrib_div_sqrt_dim",
+                      "_contrib_interleaved_matmul_selfatt_qk",
+                      "_contrib_interleaved_matmul_selfatt_valatt")]
     _register.populate(namespace, names)
     return namespace
 

@@ -103,10 +103,22 @@ def _install_custom(reg_name, prop_cls):
     from .ops import registry as _reg
 
     def run_custom(*inputs, **kwargs):
+        import jax
+
         kwargs.pop("name", None)
         op_type = kwargs.pop("op_type", reg_name)
-        prop = _CUSTOM_REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
         in_nds = [x if isinstance(x, NDArray) else _nd_array(x) for x in inputs]
+        if any(isinstance(x._data, jax.core.Tracer) for x in in_nds):
+            # staged graph (hybridize / symbolic executor): run through
+            # the `Custom` registry op — pure_callback + custom_vjp
+            from .ops.registry import apply_op
+
+            res = apply_op("Custom", *[x._data for x in in_nds],
+                           op_type=op_type, **kwargs)
+            if isinstance(res, (tuple, list)):
+                return [NDArray(r) for r in res]
+            return NDArray(res)
+        prop = _CUSTOM_REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
         in_shapes = [x.shape for x in in_nds]
         _ins, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
         op = prop.create_operator(None, in_shapes,

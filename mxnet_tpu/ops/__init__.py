@@ -16,5 +16,7 @@ from . import init_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
+from . import attention  # noqa: F401
+from . import custom  # noqa: F401
 
 from .registry import apply_op, get, list_ops, register  # noqa: F401

@@ -159,7 +159,7 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     anchors = anchor[0]                                  # (N, 4)
     n = anchors.shape[0]
 
-    def one(lbl):
+    def one(lbl, cpred):
         gt_valid = lbl[:, 0] >= 0                        # (M,)
         gt_boxes = lbl[:, 1:5]
         ious = _corner_iou(anchors, gt_boxes)            # (N, M)
@@ -195,10 +195,31 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         loc_t = jnp.stack([tx, ty, tw, th], axis=-1)     # (N, 4)
         loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
         loc_m = jnp.repeat(pos.astype(jnp.float32), 4)
-        cls_t = jnp.where(pos, lbl[match, 0] + 1.0, 0.0)  # bg = 0
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference multibox_target.cc:181-239):
+            # candidates = non-positive anchors with best_iou below the
+            # mining threshold; ranked by ascending background softmax
+            # probability (hardest negatives first); top num_pos*ratio
+            # (but at least minimum_negative_samples) become background,
+            # everything else unmatched is ignore_label
+            bg_prob = jax.nn.softmax(cpred, axis=0)[0]   # (N,)
+            cand = (~pos) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(pos)
+            num_neg = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                int(minimum_negative_samples))
+            num_neg = jnp.minimum(num_neg, n - num_pos)
+            key = jnp.where(cand, bg_prob, jnp.inf)      # ascending sort
+            order = jnp.argsort(key)
+            rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n))
+            neg = cand & (rank < num_neg)
+            cls_t = jnp.where(pos, lbl[match, 0] + 1.0,
+                              jnp.where(neg, 0.0, float(ignore_label)))
+        else:
+            cls_t = jnp.where(pos, lbl[match, 0] + 1.0, 0.0)  # bg = 0
         return loc_t, loc_m, cls_t
 
-    loc_target, loc_mask, cls_target = jax.vmap(one)(label)
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
     return (loc_target.astype(anchor.dtype), loc_mask.astype(anchor.dtype),
             cls_target.astype(anchor.dtype))
 
@@ -294,7 +315,17 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
                 cq * wy[None, :, None] * (1 - wx)[None, None, :] +
                 d * wy[None, :, None] * wx[None, None, :])
         samp = samp.reshape(c, ph, sr, pw, sr)
-        return samp.mean(axis=(2, 4))                    # (C, ph, pw)
+        pooled = samp.mean(axis=(2, 4))                  # (C, ph, pw)
+        if position_sensitive:
+            # PS-ROIAlign (reference roi_align.cc position_sensitive):
+            # C = C_out * ph * pw; bin (i, j) of output channel k reads
+            # input channel k*ph*pw + i*pw + j
+            c_out = c // (ph * pw)
+            ps = pooled.reshape(c_out, ph, pw, ph, pw)
+            ii = jnp.arange(ph)
+            jj = jnp.arange(pw)
+            pooled = ps[:, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+        return pooled
 
     return jax.vmap(one_roi)(rois)
 
@@ -385,12 +416,14 @@ def quadratic(data, a=0.0, b=0.0, c=0.0, **_):
 
 @register("arange_like", aliases=("_contrib_arange_like",))
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
+    r = max(int(repeat), 1)
     if axis is None:
         n = data.size
-        out = start + step * jnp.arange(n, dtype=data.dtype)
+        # each value repeated `repeat` times (reference RangeLikeParam)
+        out = start + step * (jnp.arange(n) // r).astype(data.dtype)
         return out.reshape(data.shape)
     n = data.shape[int(axis)]
-    return start + step * jnp.arange(n, dtype=data.dtype)
+    return start + step * (jnp.arange(n) // r).astype(data.dtype)
 
 
 @register("getnnz", aliases=("_contrib_getnnz",))

@@ -1,0 +1,102 @@
+"""`Custom` as a first-class registry op — symbolic/staged custom ops.
+
+Reference: src/operator/custom/custom.cc:103 runs user Python callbacks
+on a dedicated thread pool so they compose with the async engine.  The
+TPU-native analog: the user's ``CustomOp.forward``/``backward`` run as
+``jax.pure_callback`` host calls inside the XLA program, wrapped in a
+``jax.custom_vjp`` so gradients route through the user's ``backward``.
+This makes ``mx.sym.Custom(..., op_type=...)`` and custom ops inside
+hybridized Gluon blocks work exactly like the eager ``mx.nd.Custom``.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .registry import register
+
+
+def _prop_for(op_type, kwargs):
+    from ..operator import get_custom_op
+
+    return get_custom_op(op_type)(**{k: str(v) for k, v in kwargs.items()})
+
+
+def _custom_nout(attrs):
+    attrs = dict(attrs)
+    op_type = attrs.pop("op_type", None)
+    if op_type is None:
+        return 1
+    try:
+        return len(_prop_for(op_type, attrs).list_outputs())
+    except Exception:
+        return 1
+
+
+@register("Custom", num_outputs=_custom_nout)
+def custom(*arrays, op_type=None, **kwargs):
+    import jax
+
+    from .. import ndarray as nd_mod
+    from ..base import MXNetError
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = _prop_for(op_type, kwargs)
+    in_shapes = [tuple(a.shape) for a in arrays]
+    in_types = [_np.dtype(a.dtype) for a in arrays]
+    _, out_shapes, aux_shapes = prop.infer_shape([list(s) for s in in_shapes])
+    try:
+        _, out_types, _ = prop.infer_type(list(in_types))
+    except Exception:
+        out_types = [in_types[0]] * len(out_shapes)
+    out_types = [_np.dtype(t) for t in out_types]
+    op = prop.create_operator(None, in_shapes, in_types)
+    n_in, n_out = len(arrays), len(out_shapes)
+
+    def _nds(np_arrays, shapes=None, dtypes=None):
+        if shapes is None:
+            return [nd_mod.array(_np.asarray(a)) for a in np_arrays]
+        return [nd_mod.zeros(tuple(s), dtype=t)
+                for s, t in zip(shapes, dtypes)]
+
+    def host_fwd(*np_ins):
+        in_nds = _nds(np_ins)
+        outs = _nds(None, out_shapes, out_types)
+        aux = _nds(None, aux_shapes, [in_types[0]] * len(aux_shapes))
+        op.forward(True, ["write"] * n_out, in_nds, outs, aux)
+        return tuple(_np.asarray(o.asnumpy(), dtype=t)
+                     for o, t in zip(outs, out_types))
+
+    fwd_spec = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                     for s, t in zip(out_shapes, out_types))
+
+    @jax.custom_vjp
+    def f(*arrs):
+        return jax.pure_callback(host_fwd, fwd_spec, *arrs)
+
+    def f_fwd(*arrs):
+        outs = jax.pure_callback(host_fwd, fwd_spec, *arrs)
+        return outs, (arrs, outs)
+
+    def f_bwd(res, gs):
+        arrs, outs = res
+
+        def host_bwd(*flat):
+            in_nds = _nds(flat[:n_in])
+            out_nds = _nds(flat[n_in:n_in + n_out])
+            grad_nds = _nds(flat[n_in + n_out:])
+            in_grads = _nds(None, in_shapes, in_types)
+            aux = _nds(None, aux_shapes, [in_types[0]] * len(aux_shapes))
+            op.backward(["write"] * n_in, grad_nds, in_nds, out_nds,
+                        in_grads, aux)
+            return tuple(_np.asarray(g.asnumpy(), dtype=t)
+                         for g, t in zip(in_grads, in_types))
+
+        bwd_spec = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                         for s, t in zip(in_shapes, in_types))
+        return jax.pure_callback(host_bwd, bwd_spec, *arrs, *outs, *gs)
+
+    f.defvjp(f_fwd, f_bwd)
+    res = f(*arrays)
+    return res if n_out > 1 else res[0]

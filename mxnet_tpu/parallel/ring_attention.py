@@ -1,0 +1,147 @@
+"""Long-context attention: ring (sequence-parallel) and Ulysses (all-to-all).
+
+The reference framework predates long-context training (SURVEY.md §5.7 —
+nothing shards the sequence dim).  On TPU, sequence/context parallelism
+is first-class here:
+
+- **Ring attention**: Q stays put, K/V shards rotate around the 'sp'
+  ring via ``lax.ppermute`` (ICI neighbour exchange).  Each step computes
+  block attention against the resident K/V shard and folds it into an
+  online-softmax accumulator (out, lse) — the distributed analog of the
+  flash-attention inner loop.  Peak memory per chip is O(s_local²)
+  scores, so total sequence length scales linearly with ring size.
+- **Ulysses / all-to-all**: heads are scattered and sequence gathered
+  with ``lax.all_to_all``, full-sequence attention runs locally on
+  seq-complete/head-sharded tensors, then the transpose is undone.
+  Cheaper when heads ≥ ring size; needs full-sequence activations.
+
+Both are pure jax functions differentiable end-to-end (ppermute /
+all_to_all have transfer-transposed gradients), usable inside any jitted
+shard_map over a mesh with an 'sp' axis.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # finite -inf: keeps the online-softmax combine NaN-free
+
+_SKIP, _FULL, _DIAG = 0, 1, 2
+
+
+def _block_attention(q, k, v, sm_scale, mode):
+    """Attention of local q against one K/V shard.
+
+    q: (b, h, sq, d); k, v: (b, h, sk, d).  mode: traced int32 —
+    _SKIP (fully masked), _FULL, or _DIAG (same-shard causal).
+    Returns (out, lse) with out normalised within the block and
+    lse = log-sum-exp of the scaled scores per query row.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    # mode-dependent masking kept arithmetic (not lax.switch): under
+    # shard_map the skip branch would be unvarying over the mesh axis and
+    # fail branch-type unification
+    row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    masked = (mode == _SKIP) | ((mode == _DIAG) & (col > row))
+    s = jnp.where(masked, _NEG, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, _NEG)                       # all-masked rows
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    o = o / jnp.where(l == 0.0, 1.0, l)
+    lse = (m + jnp.log(jnp.where(l == 0.0, 1.0, l)))[..., 0]
+    lse = jnp.where(l[..., 0] == 0.0, _NEG, lse)
+    return o, lse
+
+
+def _combine(out_acc, lse_acc, o_i, lse_i):
+    """Fold one block's (normalised out, lse) into the accumulator."""
+    m = jnp.maximum(lse_acc, lse_i)
+    ea = jnp.exp(lse_acc - m)
+    eb = jnp.exp(lse_i - m)
+    lse_new = m + jnp.log(ea + eb)
+    wa = jnp.exp(lse_acc - lse_new)[..., None]
+    wb = jnp.exp(lse_i - lse_new)[..., None]
+    return out_acc * wa + o_i * wb, lse_new
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+    """Ring attention over sequence shards.
+
+    Must be called inside shard_map/pjit with `axis_name` a mesh axis;
+    q, k, v are the local (batch, heads, seq_local, head_dim) shards,
+    sequence-sharded contiguously along the axis.  Returns the local
+    output shard.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    # ring: each step the resident K/V shard moves to the next device,
+    # so at step t device i holds shard (i - t) mod n
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, t):
+        out_acc, lse_acc, kk, vv = carry
+        src = (idx - t) % n                        # origin of resident K/V
+        if causal:
+            mode = jnp.where(src > idx, _SKIP,
+                             jnp.where(src == idx, _DIAG, _FULL))
+        else:
+            mode = jnp.int32(_FULL)
+        o_i, lse_i = _block_attention(q, kk, vv, sm_scale, mode)
+        out_acc, lse_acc = _combine(out_acc, lse_acc, o_i, lse_i)
+        # rotate (skip the final, unused rotation is harmless & keeps
+        # the loop body uniform)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (out_acc, lse_acc, kk, vv), None
+
+    b, h, sq, d = q.shape
+    # the fresh accumulators must carry the same varying-over-axis type
+    # as the rotating K/V shards for scan carry unification
+    if hasattr(lax, "pcast"):
+        _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    else:  # older jax
+        _vary = lambda x: lax.pvary(x, (axis_name,))
+    out0 = _vary(jnp.zeros((b, h, sq, d), jnp.float32))
+    lse0 = _vary(jnp.full((b, h, sq), _NEG, jnp.float32))
+    (out, _, _, _), _ = lax.scan(step, (out0, lse0, k, v), jnp.arange(n))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None,
+                      attn_fn=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Local shards (b, h, s_local, d) are transposed to (b, h_local, S, d)
+    with two all_to_alls, attention runs on the full sequence locally
+    (by default the fused flash/XLA path), and the layout is restored.
+    Requires heads % axis_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    # (b, h, s/n, d) -> split heads, gather seq -> (b, h/n, S, d)
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+        attn_fn = functools.partial(flash_attention, causal=causal,
+                                    sm_scale=sm_scale)
+    out = attn_fn(qh, kh, vh)
+    # back: split seq, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)

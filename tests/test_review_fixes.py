@@ -1,0 +1,137 @@
+"""Regression tests for behavior-parity fixes found in code review:
+negative mining, PS-ROIAlign, arange_like repeat, eager control flow
+semantics, and staged custom ops."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import apply_op
+
+
+def test_arange_like_repeat():
+    import jax.numpy as jnp
+
+    out = apply_op("arange_like", jnp.zeros((6,)), repeat=2)
+    np.testing.assert_allclose(np.asarray(out), [0, 0, 1, 1, 2, 2])
+
+
+def test_while_loop_body_not_run_when_cond_false():
+    calls = {"n": 0}
+
+    def func(x):
+        calls["n"] += 1
+        return x + 1, [x + 1]
+
+    out, final = mx.nd.contrib.while_loop(
+        cond=lambda x: x < 0, func=func, loop_vars=[mx.nd.array([5.0])],
+        max_iterations=4)
+    assert calls["n"] == 0
+    assert out == []                       # reference: outputs empty
+    np.testing.assert_allclose(final[0].asnumpy(), [5.0])
+
+
+def test_while_loop_eager_runs_correct_count():
+    calls = {"n": 0}
+
+    def func(x):
+        calls["n"] += 1
+        return x * 2, [x + 1]
+
+    out, final = mx.nd.contrib.while_loop(
+        cond=lambda x: x < 3, func=func, loop_vars=[mx.nd.array([0.0])],
+        max_iterations=10)
+    assert calls["n"] == 3
+    np.testing.assert_allclose(final[0].asnumpy(), [3.0])
+    np.testing.assert_allclose(out[0].asnumpy()[:3, 0], [0.0, 2.0, 4.0])
+    np.testing.assert_allclose(out[0].asnumpy()[3:, 0], np.zeros(7))
+
+
+def test_cond_runs_single_branch_eagerly():
+    fired = []
+
+    def then_f():
+        fired.append("then")
+        return mx.nd.array([1.0])
+
+    def else_f():
+        fired.append("else")
+        return mx.nd.array([2.0])
+
+    res = mx.nd.contrib.cond(mx.nd.array([0.0]), then_f, else_f)
+    assert fired == ["else"]
+    np.testing.assert_allclose(res.asnumpy(), [2.0])
+
+
+def test_multibox_target_negative_mining():
+    import jax.numpy as jnp
+
+    n = 8
+    # anchors tiled on a line; one gt matching anchor 0 exactly
+    anchors = jnp.stack([jnp.arange(n) * 0.1, jnp.zeros(n),
+                         jnp.arange(n) * 0.1 + 0.1, jnp.ones(n) * 0.1],
+                        axis=-1)[None]                   # (1, N, 4)
+    label = jnp.array([[[0.0, 0.0, 0.0, 0.1, 0.1],
+                        [-1, -1, -1, -1, -1]]])          # (1, 2, 5)
+    # cls_pred: (1, C+1, N); anchor 1 has the lowest background score →
+    # hardest negative
+    cp = np.zeros((1, 2, n), np.float32)
+    cp[0, 0, :] = 5.0          # background logit high everywhere...
+    cp[0, 0, 1] = -5.0         # ...except anchor 1
+    cp[0, 1, 1] = 5.0
+    loc_t, loc_m, cls_t = apply_op(
+        "MultiBoxTarget", anchors, label, jnp.asarray(cp),
+        overlap_threshold=0.5, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5, ignore_label=-1.0)
+    cls_t = np.asarray(cls_t)[0]
+    assert cls_t[0] == 1.0                 # positive (class 0 → target 1)
+    assert cls_t[1] == 0.0                 # mined hard negative
+    # exactly num_pos * ratio = 1 negative kept; everything else ignored
+    assert (cls_t == -1.0).sum() == n - 2
+
+
+def test_roi_align_position_sensitive():
+    import jax.numpy as jnp
+
+    ph = pw = 2
+    c_out = 3
+    c = c_out * ph * pw
+    # each channel constant = its own index → output bin (k,i,j) must
+    # read channel k*ph*pw + i*pw + j
+    data = jnp.broadcast_to(
+        jnp.arange(c, dtype=jnp.float32)[None, :, None, None], (1, c, 8, 8))
+    rois = jnp.array([[0.0, 0.0, 0.0, 7.0, 7.0]])
+    out = apply_op("ROIAlign", data, rois, pooled_size=(ph, pw),
+                   spatial_scale=1.0, sample_ratio=2,
+                   position_sensitive=True)
+    assert out.shape == (1, c_out, ph, pw)
+    want = np.arange(c, dtype=np.float32).reshape(c_out, ph, pw)
+    np.testing.assert_allclose(np.asarray(out)[0], want, atol=1e-5)
+
+
+def test_custom_op_in_hybridized_block():
+    import mxnet_tpu.operator as op_mod
+
+    @op_mod.register("plus_three")
+    class PlusThreeProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class PlusThree(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                mx.nd.array(in_data[0].asnumpy() + 3.0))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return PlusThree()
+
+    # eager
+    y = mx.nd.Custom(mx.nd.array([1.0, 2.0]), op_type="plus_three")
+    np.testing.assert_allclose(y.asnumpy(), [4.0, 5.0])
+
+    # symbolic path (mx.sym.Custom exists and executes)
+    x = mx.sym.Variable("x")
+    s = mx.sym.Custom(x, op_type="plus_three")
+    ex = s.bind(mx.cpu(), {"x": mx.nd.array([1.0, 2.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 5.0])
