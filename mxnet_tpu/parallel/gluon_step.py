@@ -78,8 +78,10 @@ class GluonTrainStep:
     """
 
     def __init__(self, block, loss_block, mesh=None, lr=0.1, momentum=0.9,
-                 wd=0.0, compute_dtype=None):
+                 wd=0.0, compute_dtype=None, param_spec_fn=None,
+                 data_spec=None, label_spec=None):
         import jax
+        from jax.sharding import NamedSharding
 
         from .mesh import (data_parallel_sharding, get_default_mesh,
                            replicated_sharding)
@@ -117,14 +119,30 @@ class GluonTrainStep:
             return loss, new_vals, new_state, new_aux
 
         repl = replicated_sharding(self.mesh)
-        batch_shard = data_parallel_sharding(self.mesh, 1)
+        if param_spec_fn is None:
+            tv_shard = aux_shard = repl
+        else:
+            # per-parameter shardings (tensor parallelism etc.) — the
+            # optimizer state mirrors the parameter sharding
+            tv_shard = tuple(
+                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
+                for p in self.trainable)
+            aux_shard = tuple(
+                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
+                for p in self.aux)
+        x_shard = (NamedSharding(self.mesh, data_spec) if data_spec is not None
+                   else data_parallel_sharding(self.mesh, 1))
+        y_shard = (NamedSharding(self.mesh, label_spec)
+                   if label_spec is not None else x_shard)
         self._step = jax.jit(
             step,
-            in_shardings=(repl, repl, repl, batch_shard, batch_shard, repl),
+            in_shardings=(tv_shard, tv_shard, aux_shard, x_shard, y_shard,
+                          repl),
             donate_argnums=(0, 1, 2),
         )
-        # place batch-sharded inputs via this sharding
-        self.batch_sharding = batch_shard
+        # place batch-sharded inputs via these shardings
+        self.batch_sharding = x_shard
+        self.label_sharding = y_shard
         self._repl = repl
 
     def put_batch(self, x, y):
@@ -132,7 +150,7 @@ class GluonTrainStep:
         import jax
 
         return (jax.device_put(_np.asarray(x), self.batch_sharding),
-                jax.device_put(_np.asarray(y), self.batch_sharding))
+                jax.device_put(_np.asarray(y), self.label_sharding))
 
     def __call__(self, x, y):
         """One training step on device arrays/numpy; returns loss (async)."""

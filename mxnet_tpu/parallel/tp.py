@@ -1,0 +1,81 @@
+"""Tensor-parallel partition rules — Megatron-style sharding via GSPMD.
+
+The reference has only manual model parallelism (group2ctx device
+placement, graph_executor.cc:1628).  TPU-native model parallelism is
+declarative: each parameter gets a ``PartitionSpec`` over the mesh and
+XLA inserts the all-reduces.  The rules below implement the canonical
+transformer sharding:
+
+- QKV / FFN-in projections: column-parallel (output dim over 'tp') —
+  FullyConnected weights are (out_units, in_units), so dim 0;
+- attention-out / FFN-out projections: row-parallel (input dim over
+  'tp'), whose matmul partial sums GSPMD combines with one psum;
+- token embedding and logits head: vocab-sharded over 'tp';
+- everything else (norms, biases of row-parallel layers, positions):
+  replicated.
+
+A rule is ``(regex, PartitionSpec)``; first match on the parameter name
+wins.  ``spec_for`` drops mesh axes of size 1 so the same rules work on
+any mesh shape.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["TRANSFORMER_RULES", "spec_for", "make_param_spec_fn"]
+
+
+def _P(*spec):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*spec)
+
+
+def TRANSFORMER_RULES():
+    return [
+        (r"qkv_weight$", _P("tp", None)),
+        (r"qkv_bias$", _P("tp")),
+        (r"proj_weight$", _P(None, "tp")),
+        (r"ffn1_weight$", _P("tp", None)),
+        (r"ffn1_bias$", _P("tp")),
+        (r"ffn2_weight$", _P(None, "tp")),
+        (r"logits_weight$", _P("tp", None)),
+        (r"embed_weight$", _P("tp", None)),
+    ]
+
+
+def spec_for(name, shape, rules=None, mesh=None):
+    """PartitionSpec for a parameter by name; replicated if no rule hits.
+
+    Axes missing from the mesh or of size 1 are dropped from the spec,
+    and axes whose shard count does not divide the dim are dropped, so
+    rules are safe across mesh shapes and odd layer sizes.
+    """
+    from jax.sharding import PartitionSpec
+
+    rules = TRANSFORMER_RULES() if rules is None else rules
+    for pat, spec in rules:
+        if re.search(pat, name):
+            if mesh is None:
+                return spec
+            cleaned = []
+            for dim, ax in enumerate(spec):
+                ok = (ax is not None and ax in mesh.shape
+                      and mesh.shape[ax] > 1
+                      and dim < len(shape)
+                      and shape[dim] % mesh.shape[ax] == 0)
+                cleaned.append(ax if ok else None)
+            while cleaned and cleaned[-1] is None:
+                cleaned.pop()
+            return PartitionSpec(*cleaned)
+    return PartitionSpec()
+
+
+def make_param_spec_fn(rules=None, mesh=None):
+    """-> fn(param_name, shape) -> PartitionSpec, for GluonTrainStep."""
+
+    def fn(name, shape):
+        return spec_for(name, shape, rules=rules, mesh=mesh)
+
+    return fn

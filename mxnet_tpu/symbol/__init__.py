@@ -6,6 +6,7 @@ from .register import populate as _populate
 _populate(globals())
 
 from . import random  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
 
 zeros = globals()["_zeros"]
 ones = globals()["_ones"]

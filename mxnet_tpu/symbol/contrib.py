@@ -1,0 +1,25 @@
+"""sym.contrib — contrib op namespace for symbols.
+
+Reference: python/mxnet/symbol/contrib.py.  The op set mirrors
+nd.contrib (ndarray/contrib.py); symbolic control flow (foreach /
+while_loop / cond) builds the corresponding graph nodes when the
+executor traces the graph — on this framework symbols execute by
+tracing into XLA, so the nd implementations are reused at bind time.
+"""
+
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .register import populate as _populate
+
+_CONTRIB_OPS = [
+    "box_nms", "box_iou", "MultiBoxPrior", "MultiBoxTarget",
+    "MultiBoxDetection", "ROIAlign", "BilinearResize2D",
+    "AdaptiveAvgPooling2D", "boolean_mask", "quadratic",
+    "arange_like", "getnnz", "index_copy", "index_add",
+    "adamw_update", "_contrib_flash_attention", "_contrib_div_sqrt_dim",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+]
+
+_populate(globals(), names=[n for n in _CONTRIB_OPS if n in _reg.list_ops()])

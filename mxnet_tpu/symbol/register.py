@@ -42,8 +42,8 @@ def _make_sym_func(op_name):
     return fn
 
 
-def populate(namespace):
-    for name in _reg.list_ops():
+def populate(namespace, names=None):
+    for name in (names if names is not None else _reg.list_ops()):
         op = _reg.get(name)
         f = _make_sym_func(name)
         namespace[name] = f
