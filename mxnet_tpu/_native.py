@@ -1,0 +1,116 @@
+"""ctypes bindings for the native host runtime (libmxtpu.so).
+
+The reference loads libmxnet once and wraps its C ABI with ctypes
+(python/mxnet/base.py:578 _LIB); same pattern here.  The library is built
+on demand from mxnet_tpu/native/ with `make` (g++, no external deps) and
+cached; every entry point degrades to a pure-Python fallback when the
+toolchain is unavailable, so the framework never hard-requires the .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmxtpu.so")
+
+_lib = None
+_lib_err = None
+_lock = threading.Lock()
+
+# Decode callback: (ctx, rec_ptr, rec_len, data_out, label_out) -> int
+DECODE_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_float))
+
+# Engine op callback: (ctx, op_id) -> int
+ENGINE_OP_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                                ctypes.c_uint64)
+
+
+def _build():
+    env = dict(os.environ)
+    subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True, env=env,
+                   capture_output=True)
+
+
+def _declare(lib):
+    u64 = ctypes.c_uint64
+    vp = ctypes.c_void_p
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    lib.MXTPUGetLastError.argtypes = []
+    sigs = {
+        "MXTPUEngineCreate": [ctypes.c_int, ctypes.c_int, ctypes.POINTER(vp)],
+        "MXTPUEngineFree": [vp],
+        "MXTPUEngineNewVar": [vp, ctypes.POINTER(u64)],
+        "MXTPUEngineDelVar": [vp, u64],
+        "MXTPUEnginePush": [vp, ENGINE_OP_FN, vp, ctypes.POINTER(u64),
+                            ctypes.c_int, ctypes.POINTER(u64), ctypes.c_int,
+                            ctypes.c_int, ctypes.c_char_p,
+                            ctypes.POINTER(u64)],
+        "MXTPUEngineOnComplete": [vp, u64],
+        "MXTPUEngineOnCompleteError": [vp, u64, ctypes.c_char_p],
+        "MXTPUEngineWaitForVar": [vp, u64],
+        "MXTPUEngineWaitAll": [vp],
+        "MXTPUEngineNumPending": [vp, ctypes.POINTER(ctypes.c_int64)],
+        "MXTPURecordReaderCreate": [ctypes.c_char_p, u64, ctypes.c_int,
+                                    ctypes.c_int, ctypes.POINTER(vp)],
+        "MXTPURecordReaderNext": [vp, ctypes.POINTER(
+            ctypes.POINTER(ctypes.c_uint8)), ctypes.POINTER(ctypes.c_uint32)],
+        "MXTPURecordReaderReset": [vp],
+        "MXTPURecordReaderFree": [vp],
+        "MXTPURecordWriterCreate": [ctypes.c_char_p, ctypes.POINTER(vp)],
+        "MXTPURecordWriterWrite": [vp, ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_uint32, ctypes.POINTER(u64)],
+        "MXTPURecordWriterFree": [vp],
+        "MXTPUPipelineCreate": [ctypes.c_char_p, u64, ctypes.c_int,
+                                ctypes.c_int, ctypes.c_int, u64, ctypes.c_int,
+                                ctypes.c_int, u64, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_int, DECODE_FN, vp,
+                                ctypes.POINTER(vp)],
+        "MXTPUPipelineNext": [vp, ctypes.POINTER(
+            ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int)],
+        "MXTPUPipelineRelease": [vp, ctypes.POINTER(ctypes.c_uint8),
+                                 ctypes.POINTER(ctypes.c_float)],
+        "MXTPUPipelineReset": [vp],
+        "MXTPUPipelineFree": [vp],
+    }
+    for name, argtypes in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = ctypes.c_int
+
+
+def get_lib():
+    """Load (building if needed) libmxtpu; returns None when unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH):
+                _build()
+            lib = ctypes.CDLL(_SO_PATH)
+            _declare(lib)
+            _lib = lib
+        except Exception as e:  # toolchain missing, etc.
+            _lib_err = e
+    return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+def check_call(ret):
+    if ret != 0:
+        raise RuntimeError(
+            get_lib().MXTPUGetLastError().decode("utf-8", "replace"))

@@ -1,0 +1,207 @@
+"""Async dependency engine (host side).
+
+Reference: include/mxnet/engine.h:115-314 Engine::{NewVariable,PushAsync,
+WaitForVar,WaitForAll} and src/engine/threaded_engine.h.  On TPU the
+device-side role of the reference engine — ordering CUDA kernels without
+blocking the user thread — is owned by XLA's async runtime (every jax op
+dispatches asynchronously already).  What still needs an engine is HOST
+work: data-pipeline stages, checkpoint writes, metric host syncs, custom
+Python ops.  This module exposes the reference Engine API backed by the
+native C++ engine (mxnet_tpu/native/src/engine.cc) with a synchronous
+pure-Python fallback (the NaiveEngine analog, src/engine/naive_engine.cc).
+
+Select with MXNET_ENGINE_TYPE=ThreadedEngine|NaiveEngine (reference env
+var; default ThreadedEngine when the native library is available).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from . import _native
+
+# FnProperty (reference include/mxnet/engine.h:73)
+NORMAL = 0
+IO = 1
+PRIORITY = 2
+ASYNC = 3
+
+
+class NaiveEngine:
+    """Synchronous fallback: ops run inline at Push (reference
+    src/engine/naive_engine.cc — also useful for debugging races)."""
+
+    def __init__(self):
+        self._versions = {}
+        self._next = 1
+        self._errors = {}
+
+    def new_variable(self):
+        v = self._next
+        self._next += 1
+        self._versions[v] = 0
+        return v
+
+    def delete_variable(self, var):
+        self._versions.pop(var, None)
+        self._errors.pop(var, None)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), prop=NORMAL, name=""):
+        try:
+            fn()
+        except Exception as e:  # record on written vars like the threaded engine
+            for v in mutable_vars:
+                self._errors[v] = e
+            return
+        for v in mutable_vars:
+            self._versions[v] = self._versions.get(v, 0) + 1
+            self._errors.pop(v, None)  # a clean write clears a stale error
+
+    def wait_for_var(self, var):
+        if var in self._errors:
+            raise self._errors[var]
+
+    def wait_all(self):
+        pass
+
+    @property
+    def num_pending(self):
+        return 0
+
+
+class ThreadedEngine:
+    """Native C++ threaded dependency engine via ctypes."""
+
+    def __init__(self, n_workers=None, io_workers=None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise RuntimeError("native engine unavailable")
+        self._lib = lib
+        if n_workers is None:
+            n_workers = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                           max(2, (os.cpu_count() or 4) // 2)))
+        if io_workers is None:
+            io_workers = int(os.environ.get("MXNET_CPU_IO_NTHREADS", 2))
+        h = ctypes.c_void_p()
+        _native.check_call(lib.MXTPUEngineCreate(n_workers, io_workers,
+                                                 ctypes.byref(h)))
+        self._h = h
+        # ONE persistent ffi trampoline for the engine's lifetime; per-op
+        # Python fns are looked up (and removed) by the integer key passed
+        # through the C `ctx` pointer.  Freeing per-op CFUNCTYPE closures
+        # from inside their own call would be a use-after-free.
+        self._fns = {}
+        self._next_key = 0
+        self._cb_lock = threading.Lock()
+        self._last_op_error = None
+        self._trampoline = _native.ENGINE_OP_FN(self._dispatch)
+
+    def _dispatch(self, ctx, op_id):
+        with self._cb_lock:
+            entry = self._fns.pop(ctx, None)
+        if entry is None:
+            return 1
+        fn, is_async = entry
+        try:
+            # kAsync ops receive their op id and must later call
+            # on_complete(op_id) / on_complete_error(op_id, msg).
+            fn(op_id) if is_async else fn()
+            return 0
+        except Exception:
+            import traceback
+            with self._cb_lock:
+                self._last_op_error = traceback.format_exc()
+            return 1
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXTPUEngineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def new_variable(self):
+        v = ctypes.c_uint64()
+        _native.check_call(self._lib.MXTPUEngineNewVar(self._h,
+                                                       ctypes.byref(v)))
+        return v.value
+
+    def delete_variable(self, var):
+        _native.check_call(self._lib.MXTPUEngineDelVar(self._h, var))
+
+    def push(self, fn, const_vars=(), mutable_vars=(), prop=NORMAL, name=""):
+        """Schedule fn() once all deps resolve; returns op id.
+
+        With prop=ASYNC, fn(op_id) only *initiates* the work; the var deps
+        stay held until on_complete(op_id)/on_complete_error(op_id, msg)
+        (reference: Engine::PushAsync + CallbackOnComplete)."""
+        with self._cb_lock:
+            self._next_key += 1
+            key = self._next_key
+            self._fns[key] = (fn, prop == ASYNC)
+        ncv = len(const_vars)
+        nmv = len(mutable_vars)
+        cv = (ctypes.c_uint64 * max(ncv, 1))(*const_vars)
+        mv = (ctypes.c_uint64 * max(nmv, 1))(*mutable_vars)
+        op_id = ctypes.c_uint64()
+        try:
+            _native.check_call(self._lib.MXTPUEnginePush(
+                self._h, self._trampoline, ctypes.c_void_p(key), cv, ncv,
+                mv, nmv, prop, name.encode(), ctypes.byref(op_id)))
+        except Exception:
+            with self._cb_lock:
+                self._fns.pop(key, None)
+            raise
+        return op_id.value
+
+    def on_complete(self, op_id):
+        """Complete an ASYNC op, releasing its var deps."""
+        _native.check_call(self._lib.MXTPUEngineOnComplete(self._h, op_id))
+
+    def on_complete_error(self, op_id, msg):
+        _native.check_call(self._lib.MXTPUEngineOnCompleteError(
+            self._h, op_id, str(msg).encode()))
+
+    def _raise_with_op_traceback(self, err):
+        with self._cb_lock:
+            tb, self._last_op_error = self._last_op_error, None
+        if tb:
+            raise RuntimeError("%s\nop traceback:\n%s" % (err, tb)) from None
+        raise err
+
+    def wait_for_var(self, var):
+        try:
+            _native.check_call(self._lib.MXTPUEngineWaitForVar(self._h, var))
+        except RuntimeError as e:
+            self._raise_with_op_traceback(e)
+
+    def wait_all(self):
+        _native.check_call(self._lib.MXTPUEngineWaitAll(self._h))
+
+    @property
+    def num_pending(self):
+        n = ctypes.c_int64()
+        _native.check_call(self._lib.MXTPUEngineNumPending(self._h,
+                                                           ctypes.byref(n)))
+        return n.value
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def get():
+    """Singleton engine (reference Engine::Get())."""
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                kind = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine")
+                if kind != "NaiveEngine" and _native.available():
+                    _engine = ThreadedEngine()
+                else:
+                    _engine = NaiveEngine()
+    return _engine

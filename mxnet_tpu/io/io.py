@@ -428,13 +428,21 @@ class ImageRecordIter(DataIter):
 
     Decodes a RecordIO file of packed images (recordio.py format),
     applies basic augmentation (crop/mirror/mean), assembles NCHW batches.
-    JPEG decode uses PIL if available, raw arrays otherwise.
+
+    Two execution paths, mirroring the reference's parser→batcher→prefetcher
+    chain:
+    - native (default when libmxtpu builds): C++ pipeline does chunked
+      sharded RecordIO reads, shuffle-buffer sampling, worker-pool decode
+      (JPEG via a Python callback into PIL; raw samples fully in C++) into
+      recycled batch buffers (mxnet_tpu/native/src/pipeline.cc).
+    - python fallback: load-all + per-batch decode.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size=1, label_width=1,
                  shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  rand_crop=False, rand_mirror=False, num_parts=1, part_index=0,
-                 preprocess_threads=4, **kwargs):
+                 preprocess_threads=4, shuffle_buffer=4096, seed=0,
+                 use_native=None, **kwargs):
         super().__init__(batch_size)
         from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 
@@ -443,6 +451,28 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
+        self._unpack_img = unpack_img
+        self.shuffle = shuffle
+        self._pipe = None
+        if use_native is None:
+            use_native = os.environ.get("MXNET_USE_NATIVE_ITER", "1") == "1"
+        if use_native:
+            try:
+                self._pipe = _NativePipeline(
+                    self, path_imgrec, batch_size=batch_size,
+                    sample_shape=self.data_shape, label_width=label_width,
+                    shuffle=shuffle_buffer if shuffle else 0, seed=seed,
+                    num_workers=preprocess_threads,
+                    part_index=part_index, num_parts=num_parts)
+            except (RuntimeError, OSError) as e:
+                # toolchain/build problems only; anything else propagates.
+                import warnings
+                warnings.warn(
+                    "ImageRecordIter: native pipeline unavailable (%s); "
+                    "falling back to the in-memory Python reader" % (e,))
+                self._pipe = None
+        if self._pipe is not None:
+            return
         self._records = []
         rec = MXRecordIO(path_imgrec, "r")
         while True:
@@ -453,11 +483,23 @@ class ImageRecordIter(DataIter):
         rec.close()
         if num_parts > 1:
             self._records = self._records[part_index::num_parts]
-        self._unpack_img = unpack_img
-        self.shuffle = shuffle
         self._order = _np.arange(len(self._records))
         self.cursor = 0
         self.reset()
+
+    def _decode_into(self, rec_bytes, data_out, label_out):
+        """Decode one packed record into flat float32 CHW + label slots
+        (called from C++ decode workers via ctypes)."""
+        header, img = self._unpack_img(rec_bytes)
+        img = self._augment(img)
+        data_out[:] = img.ravel()
+        label_out[:] = 0.0  # recycled buffer: clear all label slots first
+        lab = header.label
+        if _np.isscalar(lab) or getattr(lab, "ndim", 0) == 0:
+            label_out[0] = float(lab)
+        else:
+            label_out[:self.label_width] = _np.asarray(
+                lab, dtype=_np.float32)[:self.label_width]
 
     @property
     def provide_data(self):
@@ -470,6 +512,9 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", shape, _np.float32)]
 
     def reset(self):
+        if self._pipe is not None:
+            self._pipe.reset()
+            return
         if self.shuffle:
             _np.random.shuffle(self._order)
         self.cursor = 0
@@ -491,26 +536,147 @@ class ImageRecordIter(DataIter):
         return img.transpose(2, 0, 1)  # HWC→CHW
 
     def iter_next(self):
-        return self.cursor + self.batch_size <= len(self._records)
+        if self._pipe is not None:
+            return self._pipe.has_next()
+        return self.cursor < len(self._records)
 
     def next(self):
+        if self._pipe is not None:
+            data, label, count = self._pipe.next()
+            if self.label_width == 1:
+                label = label.reshape(-1)
+            return DataBatch(data=[array(data)], label=[array(label)],
+                             pad=self.batch_size - count,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         if not self.iter_next():
             raise StopIteration
+        # Final partial batch is zero-padded with pad set — identical to the
+        # native pipeline's last_batch_keep semantics.
+        count = min(self.batch_size, len(self._records) - self.cursor)
         datas = []
         labels = []
-        for i in range(self.batch_size):
+        for i in range(count):
             item = self._records[self._order[self.cursor + i]]
             header, img = self._unpack_img(item)
             datas.append(self._augment(img))
             lab = header.label
             labels.append(float(lab) if _np.isscalar(lab) or lab.ndim == 0
                           else _np.asarray(lab, dtype=_np.float32))
+        for _ in range(self.batch_size - count):
+            datas.append(_np.zeros(self.data_shape, dtype=_np.float32))
+            labels.append(0.0 if self.label_width == 1
+                          else _np.zeros(self.label_width, dtype=_np.float32))
         self.cursor += self.batch_size
         data = array(_np.stack(datas))
         label = array(_np.asarray(labels, dtype=_np.float32))
-        return DataBatch(data=[data], label=[label], pad=0,
+        return DataBatch(data=[data], label=[label],
+                         pad=self.batch_size - count,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class _NativePipeline:
+    """ctypes wrapper over the C++ prefetching batch pipeline
+    (mxnet_tpu/native/src/pipeline.cc).  Owns the decode callback: C++
+    workers call back into Python per record (PIL JPEG decode + augment),
+    writing straight into the recycled batch buffer."""
+
+    def __init__(self, owner, path, batch_size, sample_shape, label_width,
+                 shuffle, seed, num_workers, part_index, num_parts):
+        import ctypes
+
+        from .. import _native
+
+        lib = _native.get_lib()
+        if lib is None:
+            raise RuntimeError("native pipeline unavailable")
+        self._lib = lib
+        self._ct = ctypes
+        self.batch_size = batch_size
+        self.sample_shape = tuple(sample_shape)
+        self.label_width = label_width
+        self._sample_elems = int(_np.prod(self.sample_shape))
+        sample_bytes = self._sample_elems * 4  # float32
+
+        def _cb(_ctx, rec_ptr, rec_len, data_out, label_out):
+            try:
+                rec = ctypes.string_at(rec_ptr, rec_len)
+                d = _np.ctypeslib.as_array(data_out,
+                                           (self._sample_elems * 4,))
+                l = _np.ctypeslib.as_array(label_out, (label_width,))
+                owner._decode_into(rec, d.view(_np.float32), l)
+                return 0
+            except Exception:
+                import traceback
+                self._decode_error = traceback.format_exc()
+                return 1
+
+        self._cb = _native.DECODE_FN(_cb)  # keep alive
+        h = ctypes.c_void_p()
+        _native.check_call(lib.MXTPUPipelineCreate(
+            path.encode(), 8 << 20, part_index, num_parts, batch_size,
+            sample_bytes, label_width, shuffle, seed, num_workers, 0, 1,
+            self._cb, None, ctypes.byref(h)))
+        self._h = h
+        self._check = _native.check_call
+        self._peek = None
+        self._decode_error = None
+
+    def _fetch(self):
+        ct = self._ct
+        data_p = ct.POINTER(ct.c_uint8)()
+        label_p = ct.POINTER(ct.c_float)()
+        count = ct.c_int()
+        try:
+            self._check(self._lib.MXTPUPipelineNext(
+                self._h, ct.byref(data_p), ct.byref(label_p),
+                ct.byref(count)))
+        except RuntimeError as e:
+            # surface the Python traceback captured in the decode callback
+            tb, self._decode_error = self._decode_error, None
+            if tb:
+                raise RuntimeError(
+                    "%s\ndecode callback error:\n%s" % (e, tb)) from None
+            raise
+        if count.value < 0:
+            return None
+        flat = _np.ctypeslib.as_array(
+            data_p, (self.batch_size * self._sample_elems * 4,))
+        data = flat.view(_np.float32)[:self.batch_size * self._sample_elems] \
+            .reshape((self.batch_size,) + self.sample_shape).copy()
+        lab = _np.ctypeslib.as_array(
+            label_p, (self.batch_size * self.label_width,))
+        label = lab.reshape(self.batch_size, self.label_width).copy()
+        self._check(self._lib.MXTPUPipelineRelease(self._h, data_p, label_p))
+        return data, label, count.value
+
+    def has_next(self):
+        if self._peek is None:
+            self._peek = self._fetch()
+        return self._peek is not None
+
+    def next(self):
+        if self._peek is not None:
+            out, self._peek = self._peek, None
+            return out
+        out = self._fetch()
+        if out is None:
+            raise StopIteration
+        return out
+
+    def reset(self):
+        self._peek = None
+        self._decode_error = None
+        self._check(self._lib.MXTPUPipelineReset(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.MXTPUPipelineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
 
 
 def _center_fit(img, h, w):

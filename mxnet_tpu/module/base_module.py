@@ -93,7 +93,16 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
+            pad = eval_batch.pad or 0
+            if pad:
+                # exclude zero-padded tail samples of the final partial
+                # batch from the metric (reference: predict slices pad the
+                # same way, base_module.py iter_predict)
+                outputs = [o[0:o.shape[0] - pad] for o in self.get_outputs()]
+                labels = [l[0:l.shape[0] - pad] for l in eval_batch.label]
+                eval_metric.update(labels=labels, preds=outputs)
+            else:
+                self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
                 params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                        eval_metric=eval_metric, locals=locals())

@@ -1,0 +1,216 @@
+// C ABI over the native runtime (reference: src/c_api/ + c_api_error.cc —
+// every function returns 0/-1 with a thread-local error string, so any
+// language can bind via its FFI; Python binds with ctypes in
+// mxnet_tpu/_native.py).
+#include <cstring>
+#include <string>
+
+#include "common.h"
+#include "engine.h"
+#include "pipeline.h"
+#include "recordio.h"
+
+namespace mxtpu {
+static thread_local std::string g_last_error;
+void SetLastError(const std::string& msg) { g_last_error = msg; }
+const char* GetLastError() { return g_last_error.c_str(); }
+}  // namespace mxtpu
+
+using mxtpu::Engine;
+using mxtpu::FnProperty;
+using mxtpu::Pipeline;
+using mxtpu::PipelineConfig;
+using mxtpu::RecordReader;
+using mxtpu::RecordWriter;
+
+MXTPU_EXPORT const char* MXTPUGetLastError() { return mxtpu::GetLastError(); }
+
+// ---------------------------------------------------------------- engine --
+// Op body: runs on a worker thread; return !=0 to mark the op failed.
+typedef int (*EngineOpFn)(void* ctx, uint64_t op_id);
+
+MXTPU_EXPORT int MXTPUEngineCreate(int n_workers, int io_workers, void** out) {
+  MXTPU_API_BEGIN();
+  *out = new Engine(n_workers, io_workers);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineFree(void* h) {
+  MXTPU_API_BEGIN();
+  delete static_cast<Engine*>(h);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineNewVar(void* h, uint64_t* out) {
+  MXTPU_API_BEGIN();
+  *out = static_cast<Engine*>(h)->NewVariable();
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineDelVar(void* h, uint64_t var) {
+  MXTPU_API_BEGIN();
+  static_cast<Engine*>(h)->DeleteVariable(var);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEnginePush(void* h, EngineOpFn fn, void* ctx,
+                                 const uint64_t* cvars, int ncv,
+                                 const uint64_t* mvars, int nmv, int prop,
+                                 const char* name, uint64_t* out_op_id) {
+  MXTPU_API_BEGIN();
+  std::vector<uint64_t> cv(cvars, cvars + ncv), mv(mvars, mvars + nmv);
+  std::string nm = name ? name : "";
+  uint64_t id = static_cast<Engine*>(h)->PushAsync(
+      [fn, ctx, nm](Engine*, uint64_t op_id) {
+        if (fn(ctx, op_id) != 0)
+          throw std::runtime_error("engine op '" + nm + "' failed");
+      },
+      cv, mv, static_cast<FnProperty>(prop), nm);
+  if (out_op_id) *out_op_id = id;
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineOnComplete(void* h, uint64_t op_id) {
+  MXTPU_API_BEGIN();
+  static_cast<Engine*>(h)->OnComplete(op_id);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineOnCompleteError(void* h, uint64_t op_id,
+                                            const char* msg) {
+  MXTPU_API_BEGIN();
+  static_cast<Engine*>(h)->OnCompleteError(op_id, msg ? msg : "error");
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineWaitForVar(void* h, uint64_t var) {
+  MXTPU_API_BEGIN();
+  static_cast<Engine*>(h)->WaitForVar(var);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineWaitAll(void* h) {
+  MXTPU_API_BEGIN();
+  static_cast<Engine*>(h)->WaitForAll();
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUEngineNumPending(void* h, int64_t* out) {
+  MXTPU_API_BEGIN();
+  *out = static_cast<Engine*>(h)->num_pending();
+  MXTPU_API_END();
+}
+
+// -------------------------------------------------------------- recordio --
+MXTPU_EXPORT int MXTPURecordReaderCreate(const char* path, uint64_t chunk,
+                                         int part, int nparts, void** out) {
+  MXTPU_API_BEGIN();
+  *out = new RecordReader(path, chunk, part, nparts);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordReaderNext(void* h, const uint8_t** data,
+                                       uint32_t* size) {
+  MXTPU_API_BEGIN();
+  if (!static_cast<RecordReader*>(h)->NextRecord(data, size)) {
+    *data = nullptr;
+    *size = 0;
+  }
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordReaderReset(void* h) {
+  MXTPU_API_BEGIN();
+  static_cast<RecordReader*>(h)->Reset();
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordReaderFree(void* h) {
+  MXTPU_API_BEGIN();
+  delete static_cast<RecordReader*>(h);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordWriterCreate(const char* path, void** out) {
+  MXTPU_API_BEGIN();
+  *out = new RecordWriter(path);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordWriterWrite(void* h, const uint8_t* data,
+                                        uint32_t size, uint64_t* out_pos) {
+  MXTPU_API_BEGIN();
+  uint64_t pos = static_cast<RecordWriter*>(h)->Write(data, size);
+  if (out_pos) *out_pos = pos;
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordWriterFree(void* h) {
+  MXTPU_API_BEGIN();
+  delete static_cast<RecordWriter*>(h);
+  MXTPU_API_END();
+}
+
+// -------------------------------------------------------------- pipeline --
+MXTPU_EXPORT int MXTPUPipelineCreate(
+    const char* path, uint64_t chunk_bytes, int part_index, int num_parts,
+    int batch_size, uint64_t sample_bytes, int label_width, int shuffle,
+    uint64_t seed, int num_workers, int queue_depth, int last_batch_keep,
+    mxtpu::DecodeFn decode, void* decode_ctx, void** out) {
+  MXTPU_API_BEGIN();
+  PipelineConfig cfg;
+  cfg.path = path;
+  cfg.chunk_bytes = chunk_bytes;
+  cfg.part_index = part_index;
+  cfg.num_parts = num_parts;
+  cfg.batch_size = batch_size;
+  cfg.sample_bytes = sample_bytes;
+  cfg.label_width = label_width;
+  cfg.shuffle = shuffle;
+  cfg.seed = seed;
+  cfg.num_workers = num_workers;
+  cfg.queue_depth = queue_depth;
+  cfg.last_batch_keep = last_batch_keep;
+  cfg.decode = decode;
+  cfg.decode_ctx = decode_ctx;
+  *out = new Pipeline(cfg);
+  MXTPU_API_END();
+}
+
+// count is set to -1 at end of epoch.
+MXTPU_EXPORT int MXTPUPipelineNext(void* h, uint8_t** data, float** label,
+                                   int* count) {
+  MXTPU_API_BEGIN();
+  mxtpu::Batch b;
+  if (static_cast<Pipeline*>(h)->Next(&b)) {
+    *data = b.data;
+    *label = b.label;
+    *count = b.count;
+  } else {
+    *data = nullptr;
+    *label = nullptr;
+    *count = -1;
+  }
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUPipelineRelease(void* h, uint8_t* data, float* label) {
+  MXTPU_API_BEGIN();
+  mxtpu::Batch b;
+  b.data = data;
+  b.label = label;
+  static_cast<Pipeline*>(h)->Release(b);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUPipelineReset(void* h) {
+  MXTPU_API_BEGIN();
+  static_cast<Pipeline*>(h)->Reset();
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPUPipelineFree(void* h) {
+  MXTPU_API_BEGIN();
+  delete static_cast<Pipeline*>(h);
+  MXTPU_API_END();
+}

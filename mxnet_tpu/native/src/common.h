@@ -1,0 +1,42 @@
+// Common helpers for the native runtime.
+//
+// TPU-native runtime layer: the device side (compute, memory planning,
+// fusion) belongs to XLA; what stays native is the HOST side the reference
+// implements in C++ — an async dependency engine for host-side work
+// (reference: src/engine/threaded_engine.h), RecordIO data IO
+// (reference: src/io/, dmlc-core recordio), a prefetching batch pipeline
+// (reference: src/io/iter_prefetcher.h), and a recycled buffer pool
+// (reference: src/storage/ CPU managers).
+#ifndef MXTPU_COMMON_H_
+#define MXTPU_COMMON_H_
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#define MXTPU_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace mxtpu {
+
+// Thread-local last-error string (reference: src/c_api/c_api_error.cc).
+void SetLastError(const std::string& msg);
+const char* GetLastError();
+
+}  // namespace mxtpu
+
+// Wrap a C-ABI body: catch exceptions, record message, return -1 on error.
+#define MXTPU_API_BEGIN() try {
+#define MXTPU_API_END()                        \
+  }                                            \
+  catch (const std::exception& e) {            \
+    mxtpu::SetLastError(e.what());             \
+    return -1;                                 \
+  }                                            \
+  catch (...) {                                \
+    mxtpu::SetLastError("unknown C++ error");  \
+    return -1;                                 \
+  }                                            \
+  return 0;
+
+#endif  // MXTPU_COMMON_H_

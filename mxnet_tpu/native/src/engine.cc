@@ -1,0 +1,272 @@
+// See engine.h.  Semantics mirror the reference ThreadedEngine
+// (src/engine/threaded_engine.{h,cc}): per-var FIFO of pending ops, reads
+// share / writes exclusive, op fires when wait_count hits zero, errors
+// propagate to WaitForVar on the written vars.
+#include "engine.h"
+
+#include <cassert>
+
+namespace mxtpu {
+
+Engine::Engine(int n_workers, int io_workers) {
+  if (n_workers < 1) n_workers = 1;
+  if (io_workers < 1) io_workers = 1;
+  for (int i = 0; i < n_workers; ++i)
+    normal_.threads.emplace_back([this] { WorkerLoop(&normal_); });
+  for (int i = 0; i < io_workers; ++i)
+    io_.threads.emplace_back([this] { WorkerLoop(&io_); });
+  priority_.threads.emplace_back([this] { WorkerLoop(&priority_); });
+}
+
+Engine::~Engine() {
+  try {
+    WaitForAll();
+  } catch (...) {
+  }
+  shutdown_.store(true);
+  for (Pool* p : {&normal_, &io_, &priority_}) {
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->cv.notify_all();
+    }
+    for (auto& t : p->threads) t.join();
+  }
+}
+
+void Engine::WorkerLoop(Pool* pool) {
+  for (;;) {
+    Op* op = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(pool->mu);
+      pool->cv.wait(lk, [&] { return shutdown_.load() || !pool->q.empty(); });
+      if (pool->q.empty()) return;  // shutdown
+      op = pool->q.front();
+      pool->q.pop_front();
+    }
+    RunOp(op);
+  }
+}
+
+uint64_t Engine::NewVariable() {
+  std::lock_guard<std::mutex> lk(mu_);
+  uint64_t id = next_var_++;
+  vars_.emplace(id, std::unique_ptr<Var>(new Var(id)));
+  return id;
+}
+
+Var* Engine::GetVar(uint64_t id) {
+  auto it = vars_.find(id);
+  if (it == vars_.end()) throw std::runtime_error("engine: unknown var");
+  return it->second.get();
+}
+
+void Engine::DeleteVariable(uint64_t var) {
+  // Push a write op that only MARKS the var; CompleteOp erases it after it
+  // finishes touching the Var (erasing inline would free the Var while
+  // CompleteOp still dereferences it).  All earlier ops on the var are
+  // ordered before the marking write.
+  PushAsync(
+      [this, var](Engine*, uint64_t) {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = vars_.find(var);
+        if (it != vars_.end()) it->second->to_delete = true;
+      },
+      {}, {var}, FnProperty::kPriority, "delete_var");
+}
+
+void Engine::DependOn(Op* op, Var* v, bool write) {
+  // Called with mu_ held.  If the var is free for this access now, and
+  // nothing is queued ahead, take it; otherwise enqueue.
+  bool can_run_now =
+      v->queue.empty() &&
+      (write ? (!v->running_write && v->running_reads == 0)
+             : !v->running_write);
+  if (can_run_now) {
+    if (write)
+      v->running_write = true;
+    else
+      v->running_reads++;
+  } else {
+    v->queue.push_back(new Var::PendingOp{op, write});
+    op->wait_count.fetch_add(1);
+  }
+}
+
+uint64_t Engine::PushAsync(std::function<void(Engine*, uint64_t)> fn,
+                           const std::vector<uint64_t>& const_vars,
+                           const std::vector<uint64_t>& mutable_vars,
+                           FnProperty prop, const std::string& name) {
+  std::unique_ptr<Op> guard(new Op());
+  Op* op = guard.get();
+  op->fn = std::move(fn);
+  op->prop = prop;
+  op->name = name;
+  bool ready;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    // Resolve every var id BEFORE touching any dependency state, so an
+    // unknown id throws without leaking read/write shares or pending_.
+    for (uint64_t v : const_vars) op->const_vars.push_back(GetVar(v));
+    for (uint64_t v : mutable_vars) op->mutable_vars.push_back(GetVar(v));
+    op->id = next_op_++;
+    pending_.fetch_add(1);
+    op->wait_count.store(1);  // guard: resolved after all DependOn calls
+    for (Var* var : op->const_vars) DependOn(op, var, /*write=*/false);
+    for (Var* var : op->mutable_vars) DependOn(op, var, /*write=*/true);
+    ready = op->wait_count.fetch_sub(1) == 1;
+    guard.release();  // ownership passes to the engine (freed in CompleteOp)
+  }
+  if (ready) Enqueue(op);
+  return op->id;
+}
+
+void Engine::Enqueue(Op* op) {
+  Pool* pool = &normal_;
+  if (op->prop == FnProperty::kIO)
+    pool = &io_;
+  else if (op->prop == FnProperty::kPriority)
+    pool = &priority_;
+  // kAsync runs its body on the normal pool; completion comes via OnComplete.
+  std::lock_guard<std::mutex> lk(pool->mu);
+  pool->q.push_back(op);
+  pool->cv.notify_one();
+}
+
+void Engine::RunOp(Op* op) {
+  if (op->prop == FnProperty::kAsync) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      inflight_[op->id] = op;
+    }
+    try {
+      op->fn(this, op->id);  // initiates; completion via OnComplete(op_id)
+    } catch (const std::exception& e) {
+      OnCompleteError(op->id, e.what());
+    }
+    return;
+  }
+  std::string err;
+  bool failed = false;
+  try {
+    op->fn(this, op->id);
+  } catch (const std::exception& e) {
+    failed = true;
+    err = e.what();
+  } catch (...) {
+    failed = true;
+    err = "unknown error in engine op '" + op->name + "'";
+  }
+  CompleteOp(op, failed ? &err : nullptr);
+}
+
+void Engine::OnComplete(uint64_t op_id) {
+  Op* op;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = inflight_.find(op_id);
+    if (it == inflight_.end())
+      throw std::runtime_error("engine: OnComplete for unknown op");
+    op = it->second;
+    inflight_.erase(it);
+  }
+  CompleteOp(op, nullptr);
+}
+
+void Engine::OnCompleteError(uint64_t op_id, const std::string& msg) {
+  Op* op;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = inflight_.find(op_id);
+    if (it == inflight_.end())
+      throw std::runtime_error("engine: OnCompleteError for unknown op");
+    op = it->second;
+    inflight_.erase(it);
+  }
+  CompleteOp(op, &msg);
+}
+
+void Engine::CompleteOp(Op* op, const std::string* err) {
+  std::vector<Op*> to_run;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (Var* v : op->const_vars) {
+      v->running_reads--;
+      DrainVar(v);
+    }
+    for (Var* v : op->mutable_vars) {
+      v->running_write = false;
+      v->version++;
+      if (err)
+        v->error = std::make_shared<std::string>(*err);
+      else
+        v->error.reset();  // a clean write clears a stale error
+      DrainVar(v);
+      if (v->to_delete && v->queue.empty() && !v->running_write &&
+          v->running_reads == 0)
+        vars_.erase(v->id);  // frees v; must be the last touch
+    }
+    // Collect ops that became ready (wait_count for them was decremented
+    // inside DrainVar via the ready_ops_ scratch).
+    to_run.swap(ready_scratch_);
+  }
+  delete op;
+  for (Op* r : to_run) Enqueue(r);
+  if (pending_.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> lk(mu_);
+    all_done_.notify_all();
+  }
+}
+
+void Engine::DrainVar(Var* v) {
+  // With mu_ held: start queued accesses in FIFO order — a run of reads
+  // shares, a write is exclusive (reference ThreadedVar::CompleteReadDependency
+  // / CompleteWriteDependency logic).
+  while (!v->queue.empty()) {
+    Var::PendingOp* p = v->queue.front();
+    if (p->is_write) {
+      if (v->running_write || v->running_reads > 0) break;
+      v->running_write = true;
+    } else {
+      if (v->running_write) break;
+      v->running_reads++;
+    }
+    v->queue.pop_front();
+    if (p->op->wait_count.fetch_sub(1) == 1) ready_scratch_.push_back(p->op);
+    delete p;
+    if (v->running_write) break;  // write is exclusive; stop draining
+  }
+}
+
+void Engine::WaitForVar(uint64_t var) {
+  // Push a read op that signals a local latch, then wait on it.
+  std::mutex m;
+  std::condition_variable cv;
+  bool done = false;
+  std::shared_ptr<std::string> err;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    GetVar(var);  // validate
+  }
+  PushAsync(
+      [&](Engine*, uint64_t) {
+        std::lock_guard<std::mutex> lk(m);
+        done = true;
+        cv.notify_all();
+      },
+      {var}, {}, FnProperty::kPriority, "wait_for_var");
+  std::unique_lock<std::mutex> lk(m);
+  cv.wait(lk, [&] { return done; });
+  {
+    std::lock_guard<std::mutex> elk(mu_);
+    auto it = vars_.find(var);
+    if (it != vars_.end()) err = it->second->error;
+  }
+  if (err) throw std::runtime_error(*err);
+}
+
+void Engine::WaitForAll() {
+  std::unique_lock<std::mutex> lk(mu_);
+  all_done_.wait(lk, [&] { return pending_.load() == 0; });
+}
+
+}  // namespace mxtpu

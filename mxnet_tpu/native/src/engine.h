@@ -1,0 +1,151 @@
+// Async dependency engine for host-side work.
+//
+// A TPU-native re-design of the reference's ThreadedEngine
+// (src/engine/threaded_engine.h:66-269, threaded_engine_perdevice.cc:46):
+// versioned variables hold FIFO queues of pending reader/writer ops; an op
+// dispatches once every dependency is satisfied.  On TPU the device-side
+// scheduling this engine did for CUDA ops is owned by XLA's async runtime,
+// so this engine schedules the HOST side: data-pipeline stages, checkpoint
+// writes, metric syncs, custom Python ops — while preserving the reference's
+// semantics (read sharing, write exclusivity, version ordering, exception
+// propagation to WaitForVar, FnProperty queues).
+#ifndef MXTPU_ENGINE_H_
+#define MXTPU_ENGINE_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+// Mirrors reference FnProperty (include/mxnet/engine.h:73): which worker
+// pool an op runs on.  kAsync ops complete via an explicit callback.
+enum class FnProperty : int {
+  kNormal = 0,
+  kIO = 1,        // data-pipeline / disk work
+  kPriority = 2,  // latency-critical (parameter fetch)
+  kAsync = 3,     // completes out-of-band (e.g. Python callback thread)
+};
+
+class Engine;
+
+// A versioned variable (reference ThreadedVar, threaded_engine.h:115).
+// Pending ops queue on the var; reads share, writes are exclusive and
+// bump the version.
+struct Var {
+  uint64_t id;
+  uint64_t version{0};
+
+  // Dependency queue state (guarded by Engine::mu_ for simplicity; the
+  // reference uses a per-var spinlock — host-side op rates here are far
+  // below device-op rates, so one mutex is the better trade).
+  struct PendingOp;
+  std::deque<PendingOp*> queue;
+  int running_reads{0};
+  bool running_write{false};
+  // First error produced by an op that wrote this var; re-thrown at
+  // WaitForVar (reference: threaded_engine.h:179 exception_ptr).
+  std::shared_ptr<std::string> error;
+  // Set by DeleteVariable's marker op; CompleteOp erases the var once no
+  // access is running and nothing is queued.
+  bool to_delete{false};
+
+  explicit Var(uint64_t i) : id(i) {}
+};
+
+// An operation pushed to the engine (reference ThreadedOpr/OprBlock).
+struct Op {
+  std::function<void(Engine*, uint64_t op_id)> fn;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  FnProperty prop{FnProperty::kNormal};
+  std::string name;
+  uint64_t id{0};
+  std::atomic<int> wait_count{0};
+  bool temporary{true};  // delete after run (PushAsync one-shot)
+};
+
+struct Var::PendingOp {
+  Op* op;
+  bool is_write;
+};
+
+class Engine {
+ public:
+  // n_workers: kNormal pool size; io_workers: kIO pool; one kPriority worker.
+  // (reference env vars MXNET_CPU_WORKER_NTHREADS etc.)
+  Engine(int n_workers, int io_workers);
+  ~Engine();
+
+  uint64_t NewVariable();
+  // Schedules var deletion after all pending ops on it complete
+  // (reference: ThreadedEngine::DeleteVariable).
+  void DeleteVariable(uint64_t var);
+
+  // Push fn with dependencies; fn runs on a worker once deps resolve.
+  // Returns op id.  Read/write sets must be disjoint.
+  uint64_t PushAsync(std::function<void(Engine*, uint64_t)> fn,
+                     const std::vector<uint64_t>& const_vars,
+                     const std::vector<uint64_t>& mutable_vars,
+                     FnProperty prop, const std::string& name);
+
+  // For kAsync ops: mark op complete from an external thread.
+  void OnComplete(uint64_t op_id);
+  // Record an error for the op's mutable vars, then complete it.
+  void OnCompleteError(uint64_t op_id, const std::string& msg);
+
+  // Block until all ops writing `var` (pushed before this call) are done.
+  // Throws if any writer recorded an error (reference: WaitForVar rethrow).
+  void WaitForVar(uint64_t var);
+  void WaitForAll();
+
+  int64_t num_pending() const { return pending_.load(); }
+
+ private:
+  struct Worker;
+
+  Var* GetVar(uint64_t id);
+  void Schedule(Op* op);            // deps resolved -> queue to pool
+  void Enqueue(Op* op);             // push to the right worker queue
+  void RunOp(Op* op);
+  void CompleteOp(Op* op, const std::string* err);
+  // With mu_ held: try to start next pending ops on var.
+  void DrainVar(Var* v);
+  void DependOn(Op* op, Var* v, bool write);
+
+  mutable std::mutex mu_;
+  std::condition_variable all_done_;
+  std::unordered_map<uint64_t, std::unique_ptr<Var>> vars_;
+  std::unordered_map<uint64_t, Op*> inflight_;  // kAsync ops awaiting OnComplete
+  uint64_t next_var_{1};
+  uint64_t next_op_{1};
+  std::atomic<int64_t> pending_{0};
+  // Ops made ready by the current CompleteOp (with mu_ held); swapped out
+  // and enqueued after the lock is released.
+  std::vector<Op*> ready_scratch_;
+
+  // Worker pools.
+  struct Pool {
+    std::deque<Op*> q;
+    std::mutex mu;
+    std::condition_variable cv;
+    std::vector<std::thread> threads;
+  };
+  Pool normal_, io_, priority_;
+  std::atomic<bool> shutdown_{false};
+
+  void WorkerLoop(Pool* pool);
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_ENGINE_H_

@@ -1,0 +1,247 @@
+#include "pipeline.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace mxtpu {
+
+Pipeline::Pipeline(const PipelineConfig& cfg) : cfg_(cfg) {
+  if (cfg_.sample_bytes == 0)
+    throw std::runtime_error("pipeline: sample_bytes must be set");
+  if (cfg_.queue_depth <= 0) cfg_.queue_depth = 2 * cfg_.num_workers;
+  if (cfg_.queue_depth < 2) cfg_.queue_depth = 2;
+  data_bytes_ = cfg_.sample_bytes * cfg_.batch_size;
+  label_bytes_ = sizeof(float) * cfg_.label_width * cfg_.batch_size;
+  reader_.reset(new RecordReader(cfg_.path, cfg_.chunk_bytes, cfg_.part_index,
+                                 cfg_.num_parts));
+  StartThreads();
+}
+
+Pipeline::~Pipeline() {
+  StopThreads();
+  // Free buffers still sitting in the reorder queue.
+  for (auto& kv : done_) Release(kv.second);
+}
+
+void Pipeline::StartThreads() {
+  stop_.store(false);
+  io_done_ = false;
+  io_seq_ = 0;
+  next_out_ = 0;
+  outstanding_ = 0;
+  io_thread_ = std::thread([this] { IoLoop(); });
+  for (int i = 0; i < cfg_.num_workers; ++i)
+    workers_.emplace_back([this] { DecodeLoop(); });
+}
+
+void Pipeline::StopThreads() {
+  stop_.store(true);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    work_cv_.notify_all();
+    done_cv_.notify_all();
+    space_cv_.notify_all();
+  }
+  if (io_thread_.joinable()) io_thread_.join();
+  for (auto& t : workers_)
+    if (t.joinable()) t.join();
+  workers_.clear();
+}
+
+void Pipeline::Reset() {
+  StopThreads();
+  for (auto& kv : done_) Release(kv.second);
+  done_.clear();
+  while (!work_q_.empty()) work_q_.pop();
+  error_.clear();
+  epoch_++;
+  reader_->Reset();
+  StartThreads();
+}
+
+void Pipeline::IoLoop() {
+  // Shuffle buffer of records (reference: chunk-level + instance-level
+  // shuffling in ImageRecordIOParser2; here a reservoir-style buffer).
+  // Epoch counter mixed into the seed so each Reset() shuffles differently.
+  std::mt19937_64 rng((cfg_.seed ? cfg_.seed : 0x5DEECE66DULL) +
+                      0x9E3779B97F4A7C15ULL * epoch_);
+  std::vector<std::vector<uint8_t>> shuf;
+  shuf.reserve(cfg_.shuffle);
+  std::vector<std::vector<uint8_t>> cur;
+  cur.reserve(cfg_.batch_size);
+
+  auto emit_record = [&](std::vector<uint8_t>&& rec) {
+    cur.emplace_back(std::move(rec));
+    if (static_cast<int>(cur.size()) == cfg_.batch_size) {
+      std::unique_lock<std::mutex> lk(mu_);
+      space_cv_.wait(lk, [&] {
+        return stop_.load() || outstanding_ < cfg_.queue_depth;
+      });
+      if (stop_.load()) return false;
+      Work w;
+      w.recs = std::move(cur);
+      w.seq = io_seq_++;
+      outstanding_++;
+      work_q_.push(std::move(w));
+      work_cv_.notify_one();
+      cur.clear();
+      cur.reserve(cfg_.batch_size);
+    }
+    return true;
+  };
+
+  const uint8_t* data;
+  uint32_t size;
+  bool ok = true;
+  while (ok && !stop_.load() && reader_->NextRecord(&data, &size)) {
+    std::vector<uint8_t> rec(data, data + size);
+    if (cfg_.shuffle > 0) {
+      if (static_cast<int>(shuf.size()) < cfg_.shuffle) {
+        shuf.emplace_back(std::move(rec));
+      } else {
+        size_t j = rng() % shuf.size();
+        ok = emit_record(std::move(shuf[j]));
+        shuf[j] = std::move(rec);
+      }
+    } else {
+      ok = emit_record(std::move(rec));
+    }
+  }
+  // Drain shuffle buffer in random order.
+  while (ok && !stop_.load() && !shuf.empty()) {
+    size_t j = rng() % shuf.size();
+    std::swap(shuf[j], shuf.back());
+    ok = emit_record(std::move(shuf.back()));
+    shuf.pop_back();
+  }
+  // Partial final batch.
+  if (ok && !stop_.load() && !cur.empty() && cfg_.last_batch_keep) {
+    std::unique_lock<std::mutex> lk(mu_);
+    space_cv_.wait(lk, [&] {
+      return stop_.load() || outstanding_ < cfg_.queue_depth;
+    });
+    if (!stop_.load()) {
+      Work w;
+      w.recs = std::move(cur);
+      w.seq = io_seq_++;
+      outstanding_++;
+      work_q_.push(std::move(w));
+      work_cv_.notify_one();
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    io_done_ = true;
+    work_cv_.notify_all();
+    done_cv_.notify_all();
+  }
+}
+
+int Pipeline::DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data,
+                        float* label) {
+  // Built-in decoder for IRHeader-packed raw samples
+  // (format of python recordio.pack: flag u32, label f32, id u64, id2 u64,
+  // [flag>0: flag float32 labels], payload).  Payload must be exactly
+  // sample_bytes (raw tensor bytes).
+  if (len < 24) return -1;
+  uint32_t flag;
+  float slabel;
+  std::memcpy(&flag, rec, 4);
+  std::memcpy(&slabel, rec + 4, 4);
+  const uint8_t* p = rec + 24;
+  size_t remain = len - 24;
+  for (int i = 0; i < cfg_.label_width; ++i) label[i] = 0.f;
+  if (flag == 0) {
+    label[0] = slabel;
+  } else {
+    if (remain < flag * 4) return -2;
+    int n = static_cast<int>(flag) < cfg_.label_width
+                ? static_cast<int>(flag)
+                : cfg_.label_width;
+    std::memcpy(label, p, n * 4);
+    p += flag * 4;
+    remain -= flag * 4;
+  }
+  if (remain != cfg_.sample_bytes) return -3;
+  std::memcpy(data, p, cfg_.sample_bytes);
+  return 0;
+}
+
+void Pipeline::DecodeLoop() {
+  for (;;) {
+    Work w;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      work_cv_.wait(lk, [&] {
+        return stop_.load() || !work_q_.empty() || io_done_;
+      });
+      if (stop_.load()) return;
+      if (work_q_.empty()) {
+        if (io_done_) return;
+        continue;
+      }
+      w = std::move(work_q_.front());
+      work_q_.pop();
+    }
+    Batch b;
+    b.data = static_cast<uint8_t*>(pool_.Alloc(data_bytes_));
+    b.label = static_cast<float*>(pool_.Alloc(label_bytes_));
+    b.count = static_cast<int>(w.recs.size());
+    b.seq = w.seq;
+    std::string err;
+    for (size_t i = 0; i < w.recs.size(); ++i) {
+      uint8_t* d = b.data + i * cfg_.sample_bytes;
+      float* l = b.label + i * cfg_.label_width;
+      int rc = cfg_.decode
+                   ? cfg_.decode(cfg_.decode_ctx, w.recs[i].data(),
+                                 static_cast<uint32_t>(w.recs[i].size()), d, l)
+                   : DecodeRaw(w.recs[i].data(),
+                               static_cast<uint32_t>(w.recs[i].size()), d, l);
+      if (rc != 0) {
+        err = "pipeline: decode failed (rc=" + std::to_string(rc) + ")";
+        break;
+      }
+    }
+    // Zero unfilled tail of a partial batch so consumers see deterministic
+    // padding (reference BatchLoader pads with previous records; explicit
+    // zeros compose better with masking under jit).
+    if (b.count < cfg_.batch_size && err.empty()) {
+      std::memset(b.data + size_t(b.count) * cfg_.sample_bytes, 0,
+                  data_bytes_ - size_t(b.count) * cfg_.sample_bytes);
+      std::memset(b.label + size_t(b.count) * cfg_.label_width, 0,
+                  label_bytes_ - sizeof(float) * b.count * cfg_.label_width);
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!err.empty() && error_.empty()) error_ = err;
+      done_.emplace(b.seq, b);
+      done_cv_.notify_all();
+    }
+  }
+}
+
+bool Pipeline::Next(Batch* out) {
+  std::unique_lock<std::mutex> lk(mu_);
+  done_cv_.wait(lk, [&] {
+    return stop_.load() || !error_.empty() ||
+           done_.count(next_out_) > 0 ||
+           (io_done_ && work_q_.empty() && done_.empty() && outstanding_ == 0);
+  });
+  if (!error_.empty()) throw std::runtime_error(error_);
+  if (stop_.load()) return false;
+  auto it = done_.find(next_out_);
+  if (it == done_.end()) return false;  // epoch exhausted
+  *out = it->second;
+  done_.erase(it);
+  next_out_++;
+  outstanding_--;
+  space_cv_.notify_one();
+  return true;
+}
+
+void Pipeline::Release(const Batch& b) {
+  if (b.data) pool_.Free(b.data, data_bytes_);
+  if (b.label) pool_.Free(b.label, label_bytes_);
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,110 @@
+// Prefetching batch pipeline over RecordIO.
+//
+// TPU-native redesign of the reference's data path
+// (src/io/iter_image_recordio_2.cc ImageRecordIOParser2 +
+// iter_batchloader.h BatchLoader + iter_prefetcher.h PrefetcherIter):
+// one IO thread does chunked sharded RecordIO reads and shuffle-buffer
+// sampling; a decode worker pool fills preallocated batch buffers (via a
+// user decode callback — e.g. Python JPEG decode — or a built-in raw
+// decoder); completed batches flow through a bounded reorder queue so
+// consumers see deterministic order.  Buffers recycle through BufferPool,
+// so steady state is malloc-free; the consumer hands each buffer back
+// after the host→HBM transfer.
+#ifndef MXTPU_PIPELINE_H_
+#define MXTPU_PIPELINE_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "recordio.h"
+#include "storage.h"
+
+namespace mxtpu {
+
+// Decode one record into one sample slot.  Returns 0 on success.
+// data_out has sample_bytes bytes; label_out has label_width floats.
+typedef int (*DecodeFn)(void* ctx, const uint8_t* rec, uint32_t len,
+                        uint8_t* data_out, float* label_out);
+
+struct PipelineConfig {
+  std::string path;
+  size_t chunk_bytes = 8u << 20;
+  int part_index = 0;
+  int num_parts = 1;
+  int batch_size = 32;
+  size_t sample_bytes = 0;   // bytes per decoded sample
+  int label_width = 1;
+  int shuffle = 0;           // shuffle-buffer size in records; 0 = off
+  uint64_t seed = 0;
+  int num_workers = 4;
+  int queue_depth = 0;       // 0 -> 2*num_workers
+  int last_batch_keep = 1;   // keep partial final batch (count < batch_size)
+  DecodeFn decode = nullptr; // null -> built-in raw decoder
+  void* decode_ctx = nullptr;
+};
+
+struct Batch {
+  uint8_t* data{nullptr};   // batch_size * sample_bytes
+  float* label{nullptr};    // batch_size * label_width
+  int count{0};
+  uint64_t seq{0};
+};
+
+class Pipeline {
+ public:
+  explicit Pipeline(const PipelineConfig& cfg);
+  ~Pipeline();
+
+  // Blocks for the next batch.  Returns false at end of epoch (no batch).
+  bool Next(Batch* out);
+  // Return a batch's buffers to the pool.
+  void Release(const Batch& b);
+  // Rewind to the start of the shard for a new epoch.
+  void Reset();
+
+ private:
+  struct Work {                       // one undecoded batch
+    std::vector<std::vector<uint8_t>> recs;
+    uint64_t seq;
+  };
+
+  void IoLoop();
+  void DecodeLoop();
+  void PushDone(Batch b);
+  void StopThreads();
+  void StartThreads();
+  int DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data, float* label);
+
+  PipelineConfig cfg_;
+  size_t data_bytes_, label_bytes_;
+  BufferPool pool_;
+  std::unique_ptr<RecordReader> reader_;
+
+  std::mutex mu_;
+  std::condition_variable work_cv_, done_cv_, space_cv_;
+  std::queue<Work> work_q_;
+  std::map<uint64_t, Batch> done_;    // reorder buffer keyed by seq
+  uint64_t next_out_{0};              // next seq to hand to the consumer
+  uint64_t io_seq_{0};
+  uint64_t epoch_{0};
+  bool io_done_{false};
+  int outstanding_{0};                // batches in flight (work_q_ + decoding + done_)
+  std::atomic<bool> stop_{false};
+  std::string error_;
+
+  std::thread io_thread_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PIPELINE_H_

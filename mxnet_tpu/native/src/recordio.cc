@@ -1,0 +1,132 @@
+#include "recordio.h"
+
+#include <sys/stat.h>
+
+#include <algorithm>
+#include <cstring>
+#include <stdexcept>
+
+#include "common.h"
+
+namespace mxtpu {
+
+static size_t FileSize(const std::string& path) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0)
+    throw std::runtime_error("recordio: cannot stat " + path);
+  return static_cast<size_t>(st.st_size);
+}
+
+RecordReader::RecordReader(const std::string& path, size_t chunk_bytes,
+                           int part_index, int num_parts)
+    : path_(path), chunk_(chunk_bytes ? chunk_bytes : (8u << 20)) {
+  f_ = fopen(path.c_str(), "rb");
+  if (!f_) throw std::runtime_error("recordio: cannot open " + path);
+  size_t size = FileSize(path);
+  if (num_parts <= 1) {
+    begin_ = 0;
+    end_ = size;
+  } else {
+    size_t lo = size * part_index / num_parts;
+    size_t hi = size * (part_index + 1) / num_parts;
+    begin_ = SeekBoundary(lo);
+    end_ = (part_index + 1 == num_parts) ? size : SeekBoundary(hi);
+  }
+  Reset();
+}
+
+RecordReader::~RecordReader() {
+  if (f_) fclose(f_);
+}
+
+size_t RecordReader::SeekBoundary(size_t pos) {
+  // Records are 4-byte aligned and start with the magic word; scan aligned
+  // words until magic found and the length field is plausible.
+  size_t size = FileSize(path_);
+  pos = (pos + 3) & ~size_t(3);
+  std::vector<uint8_t> win(1 << 16);
+  while (pos < size) {
+    if (fseek(f_, static_cast<long>(pos), SEEK_SET) != 0) break;
+    size_t got = fread(win.data(), 1, win.size(), f_);
+    for (size_t i = 0; i + 8 <= got; i += 4) {
+      uint32_t magic, len;
+      std::memcpy(&magic, &win[i], 4);
+      std::memcpy(&len, &win[i + 4], 4);
+      if (magic == kRecMagic && pos + i + 8 + len <= size) return pos + i;
+    }
+    pos += got > 8 ? got - 8 : got;  // overlap so a boundary on the edge isn't missed
+    if (got < win.size()) break;
+  }
+  return size;
+}
+
+void RecordReader::Reset() {
+  file_pos_ = begin_;
+  buf_off_ = buf_len_ = 0;
+  if (fseek(f_, static_cast<long>(begin_), SEEK_SET) != 0)
+    throw std::runtime_error("recordio: seek failed in " + path_);
+}
+
+void RecordReader::FillBuffer() {
+  // Move unconsumed tail to front, then read one chunk.
+  size_t tail = buf_len_ - buf_off_;
+  if (buf_.size() < chunk_ + tail) buf_.resize(chunk_ + tail);
+  if (tail && buf_off_) std::memmove(buf_.data(), buf_.data() + buf_off_, tail);
+  buf_off_ = 0;
+  buf_len_ = tail;
+  size_t want = std::min(chunk_, end_ - file_pos_);
+  if (want == 0) return;
+  size_t got = fread(buf_.data() + buf_len_, 1, want, f_);
+  file_pos_ += got;
+  buf_len_ += got;
+}
+
+bool RecordReader::NextRecord(const uint8_t** data, uint32_t* size) {
+  if (buf_len_ - buf_off_ < 8) {
+    FillBuffer();
+    if (buf_len_ - buf_off_ < 8) return false;  // end of shard
+  }
+  uint32_t magic, len;
+  std::memcpy(&magic, buf_.data() + buf_off_, 4);
+  std::memcpy(&len, buf_.data() + buf_off_ + 4, 4);
+  if (magic != kRecMagic)
+    throw std::runtime_error("recordio: bad magic in " + path_);
+  size_t need = 8 + len + ((4 - len % 4) % 4);
+  while (buf_len_ - buf_off_ < need) {
+    size_t before = buf_len_ - buf_off_;
+    FillBuffer();
+    if (buf_len_ - buf_off_ == before)
+      throw std::runtime_error("recordio: truncated record in " + path_);
+  }
+  *data = buf_.data() + buf_off_ + 8;
+  *size = len;
+  buf_off_ += need;
+  return true;
+}
+
+RecordWriter::RecordWriter(const std::string& path) {
+  f_ = fopen(path.c_str(), "wb");
+  if (!f_) throw std::runtime_error("recordio: cannot open for write " + path);
+}
+
+RecordWriter::~RecordWriter() {
+  if (f_) fclose(f_);
+}
+
+uint64_t RecordWriter::Write(const uint8_t* data, uint32_t size) {
+  uint64_t at = pos_;
+  uint32_t head[2] = {kRecMagic, size};
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  if (fwrite(head, 1, 8, f_) != 8 ||
+      fwrite(data, 1, size, f_) != size)
+    throw std::runtime_error("recordio: write failed");
+  uint32_t pad = (4 - size % 4) % 4;
+  if (pad && fwrite(zeros, 1, pad, f_) != pad)
+    throw std::runtime_error("recordio: write failed");
+  pos_ += 8 + size + pad;
+  return at;
+}
+
+void RecordWriter::Flush() { fflush(f_); }
+
+}  // namespace mxtpu

@@ -1,0 +1,66 @@
+// RecordIO reader/writer (reference: dmlc-core recordio as used by
+// src/io/iter_image_recordio_2.cc; format shared with
+// python/mxnet/recordio.py): little-endian uint32 magic 0xced7230a,
+// uint32 length, payload, pad to 4-byte boundary.
+//
+// The reader does chunked sequential IO (one syscall per chunk, records
+// parsed out of the buffer) and supports part-of-N sharding by byte range
+// (reference: InputSplit semantics used for distributed data loading).
+#ifndef MXTPU_RECORDIO_H_
+#define MXTPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+constexpr uint32_t kRecMagic = 0xced7230a;
+
+class RecordReader {
+ public:
+  // part k of n: reader starts at the first record boundary at/after
+  // offset k*size/n and stops at the first boundary at/after (k+1)*size/n.
+  RecordReader(const std::string& path, size_t chunk_bytes, int part_index,
+               int num_parts);
+  ~RecordReader();
+
+  // Returns false at end of shard.  The returned pointer is valid until the
+  // next NextRecord/Reset call.
+  bool NextRecord(const uint8_t** data, uint32_t* size);
+  void Reset();
+
+ private:
+  void FillBuffer();
+  // Scan forward in the file from `pos` to the next magic-aligned record
+  // boundary; returns the boundary offset.
+  size_t SeekBoundary(size_t pos);
+
+  FILE* f_{nullptr};
+  std::string path_;
+  size_t chunk_{0};
+  size_t begin_{0}, end_{0};  // shard byte range (record-aligned)
+  size_t file_pos_{0};        // next unread file offset
+  std::vector<uint8_t> buf_;
+  size_t buf_off_{0};   // parse cursor within buf_
+  size_t buf_len_{0};   // valid bytes in buf_
+  std::vector<uint8_t> rec_;  // scratch for records spanning chunks
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  // Returns byte offset of the record start (for .idx files).
+  uint64_t Write(const uint8_t* data, uint32_t size);
+  void Flush();
+
+ private:
+  FILE* f_{nullptr};
+  uint64_t pos_{0};
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_RECORDIO_H_
