@@ -1,0 +1,11 @@
+"""mx.contrib — experimental subsystems (reference: python/mxnet/contrib/).
+
+Currently: quantization (INT8), onnx (import/export).
+"""
+
+from . import quantization  # noqa: F401
+
+try:  # onnx codec is self-contained but optional
+    from . import onnx  # noqa: F401
+except ImportError:
+    pass

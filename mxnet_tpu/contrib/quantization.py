@@ -1,0 +1,421 @@
+"""Model quantization (INT8) with calibration.
+
+Reference: python/mxnet/contrib/quantization.py (quantize_model,
+_quantize_params, _quantize_symbol via the C++ quantize_graph_pass.cc,
+calibration via _LayerOutputCollector / _get_optimal_thresholds) and
+src/operator/quantization/.
+
+TPU-native shape of the pass: instead of an nnvm rewrite producing long
+int8 chains, each quantizable layer L(data, weight, bias) becomes
+
+    quantize_v2(data) -> quantized_L (int32 accum on the MXU)
+      -> requantize (calibrated range when available) -> dequantize
+
+and everything else stays float32.  XLA fuses the dequantize into the
+consumer, so the float hops between layers cost one multiply — the int8
+matmul/conv (where the FLOPs are) is what matters.  Weights/biases are
+quantized OFFLINE into the returned qarg_params (same `_quantize` /
+`_quantize_min` / `_quantize_max` naming as the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from ..symbol.symbol import Symbol, _Node
+
+__all__ = ["quantize_model", "quantize_net", "quantize_graph"]
+
+# ops rewritten to int8 compute (reference pass quantizes conv/FC/pooling/
+# flatten/concat; pooling & reshaping stay float here — they are
+# bandwidth-bound, the MXU wins live in conv/FC)
+_QUANTIZABLE = {"Convolution", "FullyConnected"}
+
+
+def _real_range(arr):
+    return float(max(abs(float(arr.min())), abs(float(arr.max())), 1e-30))
+
+
+def _quantize_params(qsym, arg_params):
+    """Offline-quantize weights/biases consumed by quantized nodes
+    (reference: _quantize_params: <name>_quantize{,_min,_max})."""
+    out = {}
+    needed = set(qsym.list_arguments())
+    for name, nd in arg_params.items():
+        qname = name + "_quantize"
+        if qname in needed:
+            a = nd.asnumpy()
+            real = _real_range(a)
+            q = _np.clip(_np.rint(a * (127.0 / real)), -127, 127)
+            out[qname] = array(q.astype(_np.int8))
+            out[qname + "_min"] = array(_np.array([-real], _np.float32))
+            out[qname + "_max"] = array(_np.array([real], _np.float32))
+        if name in needed:
+            out[name] = nd
+    return out
+
+
+class _GraphBuilder:
+    """Rebuilds a Symbol DAG with quantized replacements node by node."""
+
+    def __init__(self, th_dict, quantized_dtype):
+        self.th_dict = th_dict or {}
+        self.dtype = quantized_dtype
+        self.mapping = {}  # id(old node) -> list of (new node, out_idx)
+        self._vars = {}    # name -> variable node (shared weights stay shared)
+        self._qcache = {}  # id(entry node), idx -> quantize_v2 entries
+
+    def mapped(self, old_entry):
+        node, idx = old_entry
+        return self.mapping[id(node)][idx]
+
+    def node(self, op, name, attrs, inputs, nout=1):
+        from ..ops import registry as _reg
+        if op is None:
+            # one variable node per name: tied weights must resolve to ONE
+            # argument slot, not N same-named duplicates
+            n = self._vars.get(name)
+            if n is None:
+                n = _Node(None, name, attrs, [], nout)
+                self._vars[name] = n
+            return n
+        canon = _reg.get(op).canonicalize_attrs(attrs)
+        return _Node(op, name, canon, list(inputs), nout)
+
+    def entry_name(self, entry):
+        """Calibration key for a graph entry (original-graph names)."""
+        node, idx = entry
+        if node.op is None:
+            return node.name
+        if node.num_outputs > 1:
+            return "%s_output%d" % (node.name, idx)
+        return node.name + "_output"
+
+    def quantize_entry(self, entry, key):
+        """float entry -> (int8 entry, min entry, max entry).  One
+        quantize_v2 per source tensor, shared by all consumers."""
+        ck = (id(entry[0]), entry[1])
+        cached = self._qcache.get(ck)
+        if cached is not None:
+            return cached
+        attrs = {"out_type": "int8"}
+        calib = self.th_dict.get(key)
+        if calib is not None:
+            attrs["min_calib_range"] = float(calib[0])
+            attrs["max_calib_range"] = float(calib[1])
+        n = self.node("_contrib_quantize_v2", key + "_quantize", attrs,
+                      [entry], nout=3)
+        out = ((n, 0), (n, 1), (n, 2))
+        self._qcache[ck] = out
+        return out
+
+    def rewrite(self, node):
+        """Return the replacement output entries for one original node."""
+        if node.op is None:
+            nn = self.node(None, node.name, {}, [])
+            nn.attr_dict = node.attr_dict
+            return [(nn, 0)]
+        new_inputs = [self.mapped(e) for e in node.inputs]
+        if node.op not in _QUANTIZABLE:
+            nn = self.node(node.op, node.name, node.attrs, new_inputs,
+                           node.num_outputs)
+            nn.attr_dict = node.attr_dict
+            return [(nn, i) for i in range(node.num_outputs)]
+        return self.rewrite_quantized(node, new_inputs)
+
+    def rewrite_quantized(self, node, new_inputs):
+        name = node.name
+        no_bias = bool(node.attrs.get("no_bias", False))
+        data = new_inputs[0]
+        # data: quantize dynamically or with calibrated range of the
+        # tensor feeding this node
+        dkey = self.entry_name(node.inputs[0])
+        qdata, dmin, dmax = self.quantize_entry(data, dkey)
+        # weights: offline-quantized parameter variables, named after the
+        # ORIGINAL weight/bias variables (reference _quantize_params naming)
+        wname = node.inputs[1][0].name
+        if not node.inputs[1][0].is_variable:
+            raise MXNetError("quantization requires %s's weight to be a "
+                             "variable" % name)
+        wvar = self.node(None, wname + "_quantize", {}, [])
+        wmin = self.node(None, wname + "_quantize_min", {}, [])
+        wmax = self.node(None, wname + "_quantize_max", {}, [])
+        ins = [qdata, (wvar, 0)]
+        if no_bias:
+            # keep arity: quantized op signature has bias slots; pass weight
+            # range scalars twice and flag no_bias
+            bvar = bmin = bmax = None
+        else:
+            bname = node.inputs[2][0].name
+            bvar = self.node(None, bname + "_quantize", {}, [])
+            bmin = self.node(None, bname + "_quantize_min", {}, [])
+            bmax = self.node(None, bname + "_quantize_max", {}, [])
+        qop = ("_contrib_quantized_conv" if node.op == "Convolution"
+               else "_contrib_quantized_fully_connected")
+        attrs = dict(node.attrs)
+        if no_bias:
+            ins = ins + [(wvar, 0)]  # dummy bias slot (unused under no_bias)
+            ins += [dmin, dmax, (wmin, 0), (wmax, 0), (wmin, 0), (wmax, 0)]
+        else:
+            ins = ins + [(bvar, 0)]
+            ins += [dmin, dmax, (wmin, 0), (wmax, 0), (bmin, 0), (bmax, 0)]
+        qnode = self.node(qop, name + "_quantize", attrs, ins, nout=3)
+        # requantize int32 -> int8, calibrated by this layer's output range
+        rattrs = {}
+        okey = name + "_output"
+        calib = self.th_dict.get(okey)
+        if calib is not None:
+            rattrs = {"min_calib_range": float(calib[0]),
+                      "max_calib_range": float(calib[1])}
+        rnode = self.node("_contrib_requantize", name + "_requantize", rattrs,
+                          [(qnode, 0), (qnode, 1), (qnode, 2)], nout=3)
+        dq = self.node("_contrib_dequantize", name + "_dequantize", {},
+                       [(rnode, 0), (rnode, 1), (rnode, 2)])
+        return [(dq, 0)]
+
+
+def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
+                   quantized_dtype="int8"):
+    """Rewrite a Symbol: quantizable layers -> int8 compute subgraphs."""
+    excluded = set(excluded_sym_names or ())
+    gb = _GraphBuilder(th_dict, quantized_dtype)
+    for node in sym._topo_nodes():
+        if node.op in _QUANTIZABLE and node.name in excluded:
+            new_inputs = [gb.mapped(e) for e in node.inputs]
+            nn = gb.node(node.op, node.name, node.attrs, new_inputs,
+                         node.num_outputs)
+            gb.mapping[id(node)] = [(nn, i) for i in range(node.num_outputs)]
+        else:
+            gb.mapping[id(node)] = gb.rewrite(node)
+    return Symbol([gb.mapped(e) for e in sym._outputs])
+
+
+# ------------------------------------------------------------ calibration
+
+def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
+                         data_names, label_names, num_calib_examples, keys):
+    """Run fp32 forward over calib batches; return {key: list of np arrays}
+    for every internal output named in `keys` (reference:
+    _LayerOutputCollector via set_monitor_callback)."""
+    internals = sym.get_internals()
+    wanted = [n for n in internals.list_outputs() if n in keys]
+    from ..symbol.symbol import Group
+    group = Group([internals[n] for n in wanted])
+
+    collected = {k: [] for k in wanted}
+    calib_data.reset()
+    seen = 0
+    exe = None
+    group_args = set(group.list_arguments())
+    for batch in calib_data:
+        feeds = {}
+        for dn, d in zip(data_names, batch.data):
+            feeds[dn] = d
+        for ln, l in zip(label_names, batch.label or []):
+            feeds[ln] = l
+        feeds = {k: v for k, v in feeds.items() if k in group_args}
+        if exe is None:
+            # ONE executor reused across batches — jit compiles once
+            args = dict(arg_params)
+            args.update(feeds)
+            exe = _make_eval_executor(group, args, aux_params)
+        outs = exe.forward(is_train=False, **feeds)
+        for k, o in zip(wanted, outs):
+            collected[k].append(o.asnumpy())
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return collected
+
+
+def _make_eval_executor(sym, args, aux_params):
+    """Inference-only Executor over a dict of NDArray inputs."""
+    from ..executor import Executor
+
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    missing = [n for n in arg_names if n not in args]
+    if missing:
+        raise MXNetError("calibration: missing inputs %s" % missing)
+    return Executor(sym, None, [args[n] for n in arg_names], {},
+                    {n: "null" for n in arg_names},
+                    [(aux_params or {})[n] for n in aux_names])
+
+
+def _naive_th(collected):
+    return {k: (min(float(a.min()) for a in v),
+                max(float(a.max()) for a in v))
+            for k, v in collected.items() if v}
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Move a little mass onto zero bins so KL is finite (the standard
+    smoothing from the KL-calibration literature; reference:
+    contrib/quantization.py _smooth_distribution)."""
+    is_zeros = p == 0
+    n_zeros = int(is_zeros.sum())
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    eps1 = eps * float(n_zeros) / float(n_nonzeros)
+    hist = p.astype(_np.float64).copy()
+    hist[is_zeros] = eps
+    hist[~is_zeros] -= eps1 * hist[~is_zeros]
+    return hist
+
+
+def _kl_divergence(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float(_np.sum(p[mask] * _np.log(p[mask] / q[mask])))
+
+
+def _optimal_threshold_kl(arr, num_bins=1001, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| for int8 (the histogram search of
+    the KL-calibration method; reference: _get_optimal_threshold).
+
+    For each candidate truncation point i, compare the clipped reference
+    distribution P (outliers folded into the edge bin) against its
+    255-bin-quantized reconstruction Q; keep the i minimizing KL(P||Q)."""
+    a = _np.abs(_np.concatenate([x.ravel() for x in arr]))
+    amax = float(a.max()) if a.size else 0.0
+    if amax < 1e-8:
+        return 1e-8
+    hist, edges = _np.histogram(a, bins=num_bins, range=(0, amax))
+    hist = hist.astype(_np.float64)
+    best_div, best_t = _np.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 128)):
+        p = hist[:i].copy()
+        if p.sum() == 0:
+            continue
+        p[-1] += hist[i:].sum()  # fold outliers into the edge bin
+        # build Q: collapse the i bins into 255 quantized levels, then
+        # expand back to i bins spreading each level over its nonzero bins
+        sliced = hist[:i]
+        factor = i / float(num_quantized_bins)
+        q = _np.zeros(i, dtype=_np.float64)
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = min(int(_np.ceil((j + 1) * factor)), i)
+            chunk = sliced[lo:hi]
+            nz = chunk != 0
+            cnt = int(nz.sum())
+            if cnt:
+                q[lo:hi][nz] = chunk[nz].sum() / cnt
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
+            continue
+        div = _kl_divergence(ps, qs)
+        if div < best_div:
+            best_div = div
+            best_t = float(edges[i]) if i < len(edges) else amax
+    return max(best_t, amax * 1e-3)
+
+
+def _entropy_th(collected):
+    th = {}
+    for k, v in collected.items():
+        if not v:
+            continue
+        t = _optimal_threshold_kl(v)
+        th[k] = (-t, t)
+    return th
+
+
+def _calib_keys(sym, excluded):
+    """Names whose ranges calibration must provide: inputs to and outputs
+    of every quantizable node."""
+    keys = set()
+    gb = _GraphBuilder({}, "int8")
+    for node in sym._topo_nodes():
+        if node.op in _QUANTIZABLE and node.name not in excluded:
+            keys.add(gb.entry_name(node.inputs[0]))
+            keys.add(node.name + "_output")
+    return keys
+
+
+# ------------------------------------------------------------- public API
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """reference: contrib/quantization.py quantize_model.
+
+    Returns (qsym, qarg_params, aux_params)."""
+    if quantized_dtype not in ("int8", "auto"):
+        raise NotImplementedError(
+            "quantized_dtype=%r: this build quantizes to int8 (symmetric, "
+            "MXU-native); uint8 affine compute is not implemented"
+            % (quantized_dtype,))
+    excluded = set(excluded_sym_names or ())
+    th_dict = {}
+    if calib_mode and calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_mode=%r requires calib_data" % calib_mode)
+        keys = _calib_keys(sym, excluded)
+        collected = _collect_layer_stats(
+            sym, arg_params, aux_params, calib_data, list(data_names),
+            list(label_names), num_calib_examples, keys)
+        if calib_mode == "naive":
+            th_dict = _naive_th(collected)
+        elif calib_mode == "entropy":
+            th_dict = _entropy_th(collected)
+        else:
+            raise ValueError("unknown calib_mode %r" % calib_mode)
+    qsym = quantize_graph(sym, excluded, th_dict, quantized_dtype)
+    qarg_params = _quantize_params(qsym, arg_params)
+    return qsym, qarg_params, dict(aux_params or {})
+
+
+def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
+                 calib_data=None, calib_mode="none", num_calib_examples=None,
+                 data_shapes=None, ctx=None, logger=None):
+    """Quantize a Gluon HybridBlock -> SymbolBlock (reference:
+    contrib/quantization.py quantize_net)."""
+    from .. import symbol as _sym_mod
+    from ..gluon.block import SymbolBlock
+
+    if data_shapes is None:
+        if calib_data is None:
+            raise ValueError("need data_shapes or calib_data")
+        batch = next(iter(calib_data))
+        data_shapes = [d.shape for d in batch.data]
+        calib_data.reset()
+    data_syms = [_sym_mod.var("data%d" % i if i else "data")
+                 for i in range(len(data_shapes))]
+    sym, params = _trace_block(network, data_syms, data_shapes)
+    arg_params = {k: v for k, v in params.items()}
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, {}, data_names=[s.name for s in data_syms],
+        excluded_sym_names=exclude_layers, calib_mode=calib_mode,
+        calib_data=calib_data, num_calib_examples=num_calib_examples,
+        quantized_dtype=quantized_dtype)
+    all_params = dict(qarg)
+    all_params.update(qaux)
+    return SymbolBlock(qsym, data_syms, params=all_params)
+
+
+def _trace_block(network, data_syms, data_shapes):
+    """Trace a HybridBlock into (Symbol, params-dict)."""
+    import numpy as np
+
+    from ..ndarray import zeros
+
+    # make sure params are materialized
+    args = [zeros(s) for s in data_shapes]
+    network(*args)
+    sym = network(*data_syms)
+    if isinstance(sym, (list, tuple)):
+        from ..symbol.symbol import Group
+        sym = Group(list(sym))
+    params = {}
+    for name, p in network.collect_params().items():
+        params[name] = p.data()
+    return sym, params

@@ -157,13 +157,24 @@ class Executor:
                          allow_extra_params=False):
         for name, array in arg_params.items():
             if name in self.arg_dict:
-                array.copyto(self.arg_dict[name])
+                if array.dtype != self.arg_dict[name].dtype:
+                    # adopt the source dtype (e.g. int8 quantized params
+                    # bound into default-float32 slots)
+                    self.arg_arrays[self._arg_names.index(name)] = array.copy()
+                    self._fwd_state = None
+                else:
+                    array.copyto(self.arg_dict[name])
             elif not allow_extra_params:
                 raise MXNetError("Found name %r not in arguments" % name)
         if aux_params:
             for name, array in aux_params.items():
                 if name in self.aux_dict:
-                    array.copyto(self.aux_dict[name])
+                    if array.dtype != self.aux_dict[name].dtype:
+                        self.aux_arrays[self._aux_names.index(name)] = \
+                            array.copy()
+                        self._fwd_state = None
+                    else:
+                        array.copyto(self.aux_dict[name])
                 elif not allow_extra_params:
                     raise MXNetError("Found name %r not in aux states" % name)
 

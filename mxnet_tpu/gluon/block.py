@@ -32,7 +32,7 @@ import threading
 
 import numpy as _np
 
-from .. import autograd, ndarray
+from .. import autograd, initializer, ndarray
 from .. import random as _random
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
@@ -605,7 +605,10 @@ class SymbolBlock(HybridBlock):
                 if clean in self._params._params:
                     p = self._params._params[clean]
                     p.shape = tuple(v.shape)
-                    p.initialize(ctx=v.context)
+                    p.dtype = v.dtype
+                    # values are set right below — zero-init avoids the
+                    # name-pattern initializer (e.g. *_quantize params)
+                    p.initialize(init=initializer.Zero(), ctx=v.context)
                     p.set_data(v)
         self._fn_cache = {}
 

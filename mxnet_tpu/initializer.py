@@ -70,6 +70,10 @@ class Initializer:
             self._init_zero(desc, arr)
         elif name.endswith("min") or name.endswith("max"):
             self._init_zero(desc, arr)
+        elif name.endswith("quantize"):
+            # offline-quantized params: values are always loaded, never
+            # trained from init (contrib/quantization.py _quantize_params)
+            self._init_zero(desc, arr)
         else:
             self._init_default(desc, arr)
 

@@ -192,11 +192,13 @@ class Module(BaseModule):
 
         if self._arg_params is None:
             self._arg_params = {
-                name: zeros(self._exec_group.execs[0].arg_dict[name].shape)
+                name: zeros(self._exec_group.execs[0].arg_dict[name].shape,
+                            dtype=self._exec_group.execs[0].arg_dict[name].dtype)
                 for name in self._param_names}
         if self._aux_params is None:
             self._aux_params = {
-                name: zeros(self._exec_group.execs[0].aux_dict[name].shape)
+                name: zeros(self._exec_group.execs[0].aux_dict[name].shape,
+                            dtype=self._exec_group.execs[0].aux_dict[name].dtype)
                 for name in self._aux_names}
 
         def _impl(name, arr, cache, desc):

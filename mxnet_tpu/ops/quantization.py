@@ -1,0 +1,240 @@
+"""INT8 quantization operators.
+
+Reference: src/operator/quantization/ — quantize{,-v2,-inl.h},
+dequantize, requantize, quantized_{conv,fully_connected,pooling,flatten,
+concat} and quantization_utils.h (zero-centered int8 / affine uint8
+mappings, QuantizationRangeForMultiplication).
+
+TPU-native design: int8 matmul/conv feed the MXU directly —
+``lax.dot_general``/``lax.conv_general_dilated`` on int8 operands with
+``preferred_element_type=int32`` accumulate in int32 exactly like the
+reference's DP4A/MKLDNN kernels.  Ranges ride as scalar float arrays
+(shape (1,)) alongside the quantized tensor, same 3-output convention
+(out, min_range, max_range) as the reference so the graph pass and the
+Python calibration API line up 1:1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import OP_INPUT_NAMES, register
+
+INT8_MAX = 127.0
+INT32_MAX = 2147483647.0
+
+
+def _zero_centered_quantize(x, real_range):
+    """float -> int8, symmetric (reference quantize_zero_centered)."""
+    real_range = jnp.maximum(real_range, 1e-30)
+    scale = INT8_MAX / real_range
+    q = jnp.clip(jnp.rint(x * scale), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8)
+
+
+def _affine_quantize_u8(x, mn, mx):
+    """float -> uint8 affine (reference quantize_unsigned)."""
+    rng = jnp.maximum(mx - mn, 1e-30)
+    scale = 255.0 / rng
+    q = jnp.clip(jnp.rint((x - mn) * scale), 0.0, 255.0)
+    return q.astype(jnp.uint8)
+
+
+def _s1(v):
+    return jnp.reshape(jnp.asarray(v, jnp.float32), (1,))
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3)
+def quantize(data, min_range, max_range, out_type="uint8", **_):
+    """(data, min, max) -> (q, out_min, out_max).
+
+    int8: zero-centered symmetric over max(|min|,|max|); uint8: affine.
+    Reference: quantize-inl.h QuantizeCompute."""
+    mn = jnp.reshape(min_range, ())
+    mx = jnp.reshape(max_range, ())
+    if out_type == "int8":
+        real = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return (_zero_centered_quantize(data, real), _s1(-real), _s1(real))
+    return (_affine_quantize_u8(data, mn, mx), _s1(mn), _s1(mx))
+
+
+@register("_contrib_quantize_v2", aliases=("quantize_v2",), num_outputs=3)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8", **_):
+    """Like quantize but derives the range from the data when no calib
+    range is given (reference: quantize_v2-inl.h)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(float(min_calib_range), jnp.float32)
+        mx = jnp.asarray(float(max_calib_range), jnp.float32)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    if out_type == "int8":
+        real = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return (_zero_centered_quantize(data, real), _s1(-real), _s1(real))
+    return (_affine_quantize_u8(data, mn, mx), _s1(mn), _s1(mx))
+
+
+@register("_contrib_dequantize", aliases=("dequantize",), num_outputs=1)
+def dequantize(data, min_range, max_range, out_type="float32", **_):
+    """int8/uint8/int32 -> float32 (reference: dequantize-inl.h)."""
+    mn = jnp.reshape(min_range, ())
+    mx = jnp.reshape(max_range, ())
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / 255.0
+        return data.astype(jnp.float32) * scale + mn
+    # zero-centered signed types: value = q * real_range / q_max
+    qmax = INT8_MAX if data.dtype == jnp.int8 else INT32_MAX
+    real = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return data.astype(jnp.float32) * (real / qmax)
+
+
+@register("_contrib_requantize", aliases=("requantize",), num_outputs=3)
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, **_):
+    """int32 (+its float range) -> int8.  With calib ranges, clips to the
+    calibrated real range (reference: requantize-inl.h RequantizeForward);
+    otherwise uses the actual min/max of the int32 data."""
+    mn = jnp.reshape(min_range, ())
+    mx = jnp.reshape(max_range, ())
+    in_real = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    as_float = data.astype(jnp.float32) * (in_real / INT32_MAX)
+    if min_calib_range is not None and max_calib_range is not None:
+        real = jnp.maximum(abs(float(min_calib_range)),
+                           abs(float(max_calib_range)))
+        real = jnp.asarray(real, jnp.float32)
+    else:
+        amax = jnp.max(jnp.abs(data)).astype(jnp.float32)
+        real = amax * (in_real / INT32_MAX)
+    return (_zero_centered_quantize(as_float, real), _s1(-real), _s1(real))
+
+
+def _mul_range(max_d, max_w):
+    """Float range represented by an int32 accumulator produced from two
+    zero-centered int8 operands (reference: quantization_utils.h
+    QuantizationRangeForMultiplication): one int32 unit = (range_d/127) *
+    (range_w/127); the representable range is ±INT32_MAX units."""
+    unit = (max_d / INT8_MAX) * (max_w / INT8_MAX)
+    real = unit * INT32_MAX
+    return -real, real
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias, max_bias,
+                              num_hidden=None, no_bias=False, flatten=True,
+                              **_):
+    """int8 data × int8 weight -> int32 (reference:
+    quantized_fully_connected.cc).  Bias (int8) is rescaled into the
+    accumulator's scale before adding, as the reference does."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    max_d = jnp.maximum(jnp.abs(jnp.reshape(min_data, ())),
+                        jnp.abs(jnp.reshape(max_data, ())))
+    max_w = jnp.maximum(jnp.abs(jnp.reshape(min_weight, ())),
+                        jnp.abs(jnp.reshape(max_weight, ())))
+    mn, mx = _mul_range(max_d, max_w)
+    if not no_bias and bias is not None:
+        # bias int8 in its own scale -> accumulator units
+        max_b = jnp.maximum(jnp.abs(jnp.reshape(min_bias, ())),
+                            jnp.abs(jnp.reshape(max_bias, ())))
+        acc_unit = jnp.maximum(mx / INT32_MAX, 1e-30)
+        bias_f = bias.astype(jnp.float32) * (max_b / INT8_MAX)
+        out = out + jnp.rint(bias_f / acc_unit).astype(jnp.int32)
+    return out, _s1(mn), _s1(mx)
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias, max_bias, kernel=None, stride=None,
+                   pad=None, dilate=None, num_filter=None, no_bias=False,
+                   layout="NCHW", **_):
+    """int8 NCHW conv -> int32 accumulator (reference: quantized_conv.cc).
+    XLA lowers integer conv onto the MXU with int32 accumulation."""
+    ndim = data.ndim - 2
+    stride = tuple(int(s) for s in (stride or (1,) * ndim))
+    pad = tuple(int(p) for p in (pad or (0,) * ndim))
+    dilate = tuple(int(d) for d in (dilate or (1,) * ndim))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if ndim == 2 else ("NCW", "OIW", "NCW"))
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8), stride,
+        [(p, p) for p in pad], rhs_dilation=dilate, dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    max_d = jnp.maximum(jnp.abs(jnp.reshape(min_data, ())),
+                        jnp.abs(jnp.reshape(max_data, ())))
+    max_w = jnp.maximum(jnp.abs(jnp.reshape(min_weight, ())),
+                        jnp.abs(jnp.reshape(max_weight, ())))
+    mn, mx = _mul_range(max_d, max_w)
+    if not no_bias and bias is not None:
+        max_b = jnp.maximum(jnp.abs(jnp.reshape(min_bias, ())),
+                            jnp.abs(jnp.reshape(max_bias, ())))
+        acc_unit = jnp.maximum(mx / INT32_MAX, 1e-30)
+        bias_f = bias.astype(jnp.float32) * (max_b / INT8_MAX)
+        bias_i = jnp.rint(bias_f / acc_unit).astype(jnp.int32)
+        out = out + bias_i.reshape((1, -1) + (1,) * ndim)
+    return out, _s1(mn), _s1(mx)
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def quantized_pooling(data, min_data, max_data, kernel=None, stride=None,
+                      pad=None, pool_type="max", global_pool=False, **_):
+    """Pooling on quantized data; range passes through unchanged
+    (reference: quantized_pooling.cc)."""
+    from .nn import pooling  # same lowering as the float op
+
+    out = pooling(data.astype(jnp.float32), kernel=kernel or (),
+                  stride=stride or (), pad=pad or (), pool_type=pool_type,
+                  global_pool=global_pool)
+    if pool_type == "max":
+        out = out.astype(data.dtype)
+    elif data.dtype == jnp.uint8:  # avg pooling rounds back in-range
+        out = jnp.clip(jnp.rint(out), 0, 255).astype(jnp.uint8)
+    else:
+        out = jnp.clip(jnp.rint(out), -128, 127).astype(jnp.int8)
+    return out, _s1(jnp.reshape(min_data, ())), _s1(jnp.reshape(max_data, ()))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def quantized_flatten(data, min_data, max_data, **_):
+    return (data.reshape(data.shape[0], -1),
+            _s1(jnp.reshape(min_data, ())), _s1(jnp.reshape(max_data, ())))
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def quantized_concat(*args, dim=1, num_args=None, **_):
+    """Concat int8 inputs: requantize all to the widest range first
+    (reference: quantized_concat.cc)."""
+    n = len(args) // 3
+    datas, mins, maxs = args[:n], args[n:2 * n], args[2 * n:]
+    reals = [jnp.maximum(jnp.abs(jnp.reshape(mn, ())),
+                         jnp.abs(jnp.reshape(mx, ())))
+             for mn, mx in zip(mins, maxs)]
+    real_out = jnp.stack(reals).max()
+    scaled = [jnp.clip(jnp.rint(d.astype(jnp.float32) * (r / real_out)),
+                       -INT8_MAX, INT8_MAX).astype(jnp.int8)
+              for d, r in zip(datas, reals)]
+    return (jnp.concatenate(scaled, axis=int(dim)),
+            _s1(-real_out), _s1(real_out))
+
+
+OP_INPUT_NAMES.update({
+    "_contrib_quantize": ("data", "min_range", "max_range"),
+    "_contrib_quantize_v2": ("data",),
+    "_contrib_dequantize": ("data", "min_range", "max_range"),
+    "_contrib_requantize": ("data", "min_range", "max_range"),
+    "_contrib_quantized_fully_connected": (
+        "data", "weight", "bias", "min_data", "max_data", "min_weight",
+        "max_weight", "min_bias", "max_bias"),
+    "_contrib_quantized_conv": (
+        "data", "weight", "bias", "min_data", "max_data", "min_weight",
+        "max_weight", "min_bias", "max_bias"),
+    "_contrib_quantized_pooling": ("data", "min_data", "max_data"),
+    "_contrib_quantized_flatten": ("data", "min_data", "max_data"),
+})

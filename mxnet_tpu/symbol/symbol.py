@@ -311,7 +311,12 @@ class Symbol:
         dtype = _np.float32
         if args:
             dtype = _np.dtype(args[0]) if args[0] is not None else _np.float32
-        return ([_np.dtype(dtype)] * len(arg_names),
+        # offline-quantized params ("<name>_quantize" by the contrib
+        # quantization pass naming) are int8 — the analog of the
+        # reference's per-op FInferType forcing kInt8 inputs
+        arg_types = [_np.dtype(_np.int8) if n.endswith("_quantize")
+                     else _np.dtype(dtype) for n in arg_names]
+        return (arg_types,
                 [_np.dtype(dtype)] * len(self._outputs),
                 [_np.dtype(dtype)] * len(self.list_auxiliary_states()))
 
@@ -335,8 +340,9 @@ class Symbol:
                 "simple_bind: cannot infer shapes for %s — provide input "
                 "shapes (e.g. data=(batch, ...))" % (missing,))
         type_dict = type_dict or {}
-        args = [zeros(s, ctx=ctx, dtype=type_dict.get(n, _np.float32))
-                for n, s in zip(arg_names, arg_shapes)]
+        arg_types, _, _ = self.infer_type()
+        args = [zeros(s, ctx=ctx, dtype=type_dict.get(n, t))
+                for n, s, t in zip(arg_names, arg_shapes, arg_types)]
         aux = [zeros(s, ctx=ctx) for s in aux_shapes]
         if isinstance(grad_req, str):
             reqs = {n: grad_req for n in arg_names}
@@ -676,6 +682,28 @@ def _solve_params(node, in_shapes, shapes):
                     setv(i, (cin, nf // ng) + k)
             elif nm == "bias":
                 setv(i, (nf,))
+    elif node.op in ("_contrib_quantized_fully_connected",
+                     "_contrib_quantized_conv"):
+        # int8 layers: weight/bias like their float twins + (1,) range
+        # scalars (reference: quantized_conv.cc / quantized_fully_connected.cc
+        # shape functions)
+        if node.op == "_contrib_quantized_fully_connected":
+            nh = int(a.get("num_hidden", 1))
+            flat = a.get("flatten", True)
+            in_dim = int(_np.prod(data_shape[1:])) if flat else data_shape[-1]
+            wshape, bshape = (nh, in_dim), (nh,)
+        else:
+            k = tuple(a.get("kernel", ()))
+            nf = int(a.get("num_filter", 1))
+            ng = int(a.get("num_group", 1))
+            wshape, bshape = (nf, data_shape[1] // ng) + k, (nf,)
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "weight":
+                setv(i, wshape)
+            elif nm == "bias":
+                setv(i, bshape)
+            elif nm.startswith(("min_", "max_")):
+                setv(i, (1,))
     elif node.op in ("BatchNorm",):
         ax = int(a.get("axis", 1)) % len(data_shape)
         c = data_shape[ax]

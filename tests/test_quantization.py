@@ -1,0 +1,235 @@
+"""Quantization tests (reference: tests/python/quantization/
+test_quantization.py — op-level checks vs float math, then end-to-end
+quantize_model accuracy parity)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.contrib import quantization as qz
+from mxnet_tpu.ops.registry import apply_op
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-3, 5, size=(4, 7)).astype(np.float32)
+    q, mn, mx_ = apply_op("_contrib_quantize_v2", x, out_type="int8")
+    assert np.asarray(q).dtype == np.int8
+    back = apply_op("_contrib_dequantize", np.asarray(q), np.asarray(mn),
+                    np.asarray(mx_))
+    # max quantization step = real_range/127
+    real = max(abs(x.min()), abs(x.max()))
+    assert np.abs(np.asarray(back) - x).max() <= real / 127.0 + 1e-6
+
+
+def test_quantize_uint8_affine():
+    x = np.linspace(0.0, 10.0, 11, dtype=np.float32)
+    q, mn, mx_ = apply_op("_contrib_quantize", x, np.array([0.0], np.float32),
+                          np.array([10.0], np.float32), out_type="uint8")
+    q = np.asarray(q)
+    assert q.dtype == np.uint8
+    assert q[0] == 0 and q[-1] == 255
+    back = apply_op("_contrib_dequantize", q, np.asarray(mn), np.asarray(mx_))
+    assert np.abs(np.asarray(back) - x).max() <= 10.0 / 255.0 + 1e-6
+
+
+def test_quantize_with_calib_range_clips():
+    x = np.array([-10.0, -1.0, 0.5, 1.0, 10.0], dtype=np.float32)
+    q, mn, mx_ = apply_op("_contrib_quantize_v2", x, out_type="int8",
+                          min_calib_range=-1.0, max_calib_range=1.0)
+    back = np.asarray(apply_op("_contrib_dequantize", np.asarray(q),
+                               np.asarray(mn), np.asarray(mx_)))
+    assert np.allclose(back[1:4], x[1:4], atol=1.0 / 127 + 1e-6)
+    assert abs(back[0] + 1.0) < 1e-5 and abs(back[-1] - 1.0) < 1e-5  # clipped
+
+
+def test_requantize_matches_float_path():
+    rng = np.random.RandomState(1)
+    # fabricate an int32 accumulator with a known float range
+    real_in = 4.0
+    vals = rng.randint(-2**30, 2**30, size=(3, 5)).astype(np.int32)
+    q, mn, mx_ = apply_op("_contrib_requantize", vals,
+                          np.array([-real_in], np.float32),
+                          np.array([real_in], np.float32))
+    as_float = vals.astype(np.float64) * (real_in / 2147483647.0)
+    back = np.asarray(apply_op("_contrib_dequantize", np.asarray(q),
+                               np.asarray(mn), np.asarray(mx_)))
+    step = float(np.asarray(mx_)[0]) / 127
+    assert np.abs(back - as_float).max() <= step + 1e-6
+
+
+def _qfc_vs_float(no_bias):
+    rng = np.random.RandomState(2)
+    x = rng.uniform(-1, 1, (8, 16)).astype(np.float32)
+    w = rng.uniform(-0.5, 0.5, (4, 16)).astype(np.float32)
+    b = rng.uniform(-0.2, 0.2, (4,)).astype(np.float32)
+    qx, xmn, xmx = [np.asarray(a) for a in
+                    apply_op("_contrib_quantize_v2", x, out_type="int8")]
+    qw, wmn, wmx = [np.asarray(a) for a in
+                    apply_op("_contrib_quantize_v2", w, out_type="int8")]
+    qb, bmn, bmx = [np.asarray(a) for a in
+                    apply_op("_contrib_quantize_v2", b, out_type="int8")]
+    out, omn, omx = apply_op(
+        "_contrib_quantized_fully_connected", qx, qw, qb, xmn, xmx, wmn, wmx,
+        bmn, bmx, num_hidden=4, no_bias=no_bias)
+    got = np.asarray(apply_op("_contrib_dequantize", np.asarray(out),
+                              np.asarray(omn), np.asarray(omx)))
+    want = x @ w.T + (0 if no_bias else b)
+    # int8 quantization error bound: ~|x|max*|w|max*K/127 per dot term
+    assert np.abs(got - want).max() < 0.15, np.abs(got - want).max()
+
+
+def test_quantized_fully_connected():
+    _qfc_vs_float(no_bias=False)
+    _qfc_vs_float(no_bias=True)
+
+
+def test_quantized_conv_vs_float():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rng.uniform(-0.3, 0.3, (5, 3, 3, 3)).astype(np.float32)
+    qx, xmn, xmx = [np.asarray(a) for a in
+                    apply_op("_contrib_quantize_v2", x, out_type="int8")]
+    qw, wmn, wmx = [np.asarray(a) for a in
+                    apply_op("_contrib_quantize_v2", w, out_type="int8")]
+    out, omn, omx = apply_op(
+        "_contrib_quantized_conv", qx, qw, qw, xmn, xmx, wmn, wmx, wmn, wmx,
+        kernel=(3, 3), num_filter=5, no_bias=True, stride=(1, 1), pad=(1, 1))
+    got = np.asarray(apply_op("_contrib_dequantize", np.asarray(out),
+                              np.asarray(omn), np.asarray(omx)))
+    want = np.asarray(apply_op("Convolution", x, w, np.zeros(5, np.float32),
+                               kernel=(3, 3), num_filter=5, stride=(1, 1),
+                               pad=(1, 1), no_bias=False))
+    assert got.shape == want.shape == (2, 5, 8, 8)
+    assert np.abs(got - want).max() < 0.2, np.abs(got - want).max()
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _fit_fp32(seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 2, 256).astype(np.float32)
+    x = rng.rand(256, 1, 8, 8).astype(np.float32) * 0.3
+    x[y == 1, :, :4, :] += 0.6  # strong class signal: fp32 must converge
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    return mod, it, x, y
+
+
+def test_quantize_model_accuracy_parity():
+    mod, it, x, y = _fit_fp32()
+    arg_params, aux_params = mod.get_params()
+    sym = _mlp_sym()
+
+    acc = mx.metric.Accuracy()
+    it.reset()
+    mod.score(it, acc)
+    fp32_acc = acc.get()[1]
+    # parity against an unconverged model proves nothing
+    assert fp32_acc > 0.9, "fp32 baseline did not converge: %s" % fp32_acc
+
+    for calib_mode in ("none", "naive", "entropy"):
+        it.reset()
+        qsym, qarg, qaux = qz.quantize_model(
+            sym, arg_params, aux_params, calib_mode=calib_mode,
+            calib_data=it, num_calib_examples=64,
+            excluded_sym_names=None)
+        # quantized params exist and are int8
+        assert qarg["fc1_weight_quantize"].dtype == np.int8
+        qmod = mx.mod.Module(qsym, context=mx.cpu(),
+                             label_names=("softmax_label",))
+        it.reset()
+        qmod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+                  for_training=False)
+        qmod.set_params(qarg, qaux, allow_missing=False)
+        qacc = mx.metric.Accuracy()
+        it.reset()
+        qmod.score(it, qacc)
+        q_acc = qacc.get()[1]
+        assert q_acc >= fp32_acc - 0.05, \
+            "calib=%s: int8 %.3f vs fp32 %.3f" % (calib_mode, q_acc, fp32_acc)
+
+
+def test_quantized_params_bound_as_int8():
+    """The executor must hold int8 weights — the MXU int8 path, not a
+    float32 re-run of the same math."""
+    mod, it, x, y = _fit_fp32(seed=2)
+    arg_params, aux_params = mod.get_params()
+    qsym, qarg, qaux = qz.quantize_model(_mlp_sym(), arg_params, aux_params,
+                                         calib_mode="none")
+    qmod = mx.mod.Module(qsym, context=mx.cpu(),
+                         label_names=("softmax_label",))
+    it.reset()
+    qmod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    qmod.set_params(qarg, qaux)
+    exe = qmod._exec_group.execs[0]
+    assert exe.arg_dict["fc1_weight_quantize"].dtype == np.int8
+    got = exe.arg_dict["fc1_weight_quantize"].asnumpy()
+    assert np.array_equal(got, qarg["fc1_weight_quantize"].asnumpy())
+
+
+def test_quantize_graph_tied_weight_single_arg():
+    """A weight shared by two layers must stay ONE argument after the pass."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    h = mx.sym.FullyConnected(data, weight=w, num_hidden=8, no_bias=True,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, weight=w, num_hidden=8, no_bias=True,
+                                name="fc2")
+    qsym = qz.quantize_graph(out)
+    args = qsym.list_arguments()
+    assert args.count("w_quantize") == 1, args
+    # and it evaluates: both layers see the same (real) weight
+    rng = np.random.RandomState(0)
+    wv = rng.uniform(-0.5, 0.5, (8, 8)).astype(np.float32)
+    xv = rng.uniform(-1, 1, (2, 8)).astype(np.float32)
+    qargs, _ = {}, None
+    qargs = qz._quantize_params(qsym, {"w": mx.nd.array(wv)})
+    exe_args = {"data": mx.nd.array(xv)}
+    exe_args.update(qargs)
+    exe = qz._make_eval_executor(qsym, exe_args, {})
+    got = exe.forward(is_train=False)[0].asnumpy()
+    want = np.maximum(xv @ wv.T, 0) @ wv.T
+    assert np.abs(got - want).max() < 0.2
+
+
+def test_quantize_model_excluded_layer():
+    mod, it, x, y = _fit_fp32(seed=1)
+    arg_params, aux_params = mod.get_params()
+    qsym, qarg, _ = qz.quantize_model(
+        _mlp_sym(), arg_params, aux_params,
+        excluded_sym_names=["fc2"], calib_mode="none")
+    # fc2 stays float: its original weight arg survives, no quantized copy
+    args = qsym.list_arguments()
+    assert "fc2_weight" in args
+    assert "fc2_weight_quantize" not in args
+    assert "fc1_weight_quantize" in args
+
+
+def test_quantize_net_gluon():
+    rng = np.random.RandomState(4)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(rng.rand(8, 12).astype(np.float32))
+    fp32_out = net(x).asnumpy()
+    qnet = qz.quantize_net(net, data_shapes=[(8, 12)], calib_mode="none")
+    qout = qnet(x)
+    qout = (qout[0] if isinstance(qout, (list, tuple)) else qout).asnumpy()
+    assert qout.shape == fp32_out.shape
+    scale = np.abs(fp32_out).max() + 1e-6
+    assert np.abs(qout - fp32_out).max() / scale < 0.1
