@@ -1,0 +1,75 @@
+"""ONNX import/export (reference: python/mxnet/contrib/onnx/ —
+onnx2mx.import_model, mx2onnx.export_model).
+
+Self-contained: serialization uses a minimal protobuf wire codec
+(_proto.py) instead of the onnx pip package, so it works in this image.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto
+from .mx2onnx import export_symbol
+from .onnx2mx import import_graph
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+def export_model(sym, params, input_shape, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol (or saved files) to ONNX (reference:
+    mx2onnx/export_model.py).  Returns the output path."""
+    from ... import symbol as _s
+    from ...ndarray import NDArray
+
+    if isinstance(sym, str):
+        sym = _s.load(sym)
+    if isinstance(params, str):
+        from ...ndarray import load as nd_load
+        params = nd_load(params)
+    np_params = {}
+    for k, v in (params or {}).items():
+        if k.startswith(("arg:", "aux:")):
+            k = k[4:]
+        np_params[k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+    if input_shape and not isinstance(input_shape[0], (list, tuple)):
+        input_shape = [input_shape]
+    data_names = [n for n in sym.list_arguments() if n not in np_params]
+    shapes = dict(zip(data_names, [tuple(s) for s in input_shape]))
+    model = export_symbol(sym, np_params, shapes, input_dtype=input_type)
+    payload = _proto.encode(model, "ModelProto")
+    with open(onnx_file_path, "wb") as f:
+        f.write(payload)
+    return onnx_file_path
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params) (reference:
+    onnx2mx/import_model.py)."""
+    with open(model_file, "rb") as f:
+        payload = f.read()
+    model = _proto.decode(payload, "ModelProto")
+    return import_graph(model["graph"])
+
+
+def get_model_metadata(model_file):
+    """Input/output descriptions of an ONNX model (reference:
+    onnx2mx/import_model.py get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        model = _proto.decode(f.read(), "ModelProto")
+    graph = model["graph"]
+    inits = {t["name"] for t in graph.get("initializer", [])}
+
+    def _shape(vi):
+        dims = vi.get("type", {}).get("tensor_type", {}) \
+            .get("shape", {}).get("dim", [])
+        return tuple(d.get("dim_value", 0) for d in dims)
+
+    return {
+        "input_tensor_data": [(vi["name"], _shape(vi))
+                              for vi in graph.get("input", [])
+                              if vi["name"] not in inits],
+        "output_tensor_data": [(vi["name"], _shape(vi))
+                               for vi in graph.get("output", [])],
+    }

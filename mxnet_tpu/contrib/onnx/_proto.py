@@ -1,0 +1,270 @@
+"""Minimal protobuf wire-format codec for ONNX messages.
+
+The ``onnx`` pip package (protobuf codegen) is not in this image, and ONNX
+support shouldn't require it: the wire format is stable and small.  This
+module implements the subset of protobuf (varint / 64-bit / length-
+delimited / 32-bit wire types, packed repeated numerics) needed for the
+ONNX ModelProto tree, driven by schema tables transcribed from the public
+onnx.proto3 specification.
+
+Messages are plain dicts; repeated fields are lists.  Unknown fields are
+skipped on decode (forward-compatible) and never emitted on encode.
+
+Reference parity: python/mxnet/contrib/onnx (mx2onnx/onnx2mx) uses the
+onnx package for the same ModelProto surface.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# ---------------------------------------------------------------- schemas
+# field number -> (name, kind); kind: varint | sint (zigzag unused by onnx)
+# | str | bytes | float | double | msg:<Name>; repeated fields end with '*'.
+SCHEMAS = {
+    "ModelProto": {
+        1: ("ir_version", "varint"),
+        2: ("producer_name", "str"),
+        3: ("producer_version", "str"),
+        4: ("domain", "str"),
+        5: ("model_version", "varint"),
+        6: ("doc_string", "str"),
+        7: ("graph", "msg:GraphProto"),
+        8: ("opset_import", "msg:OperatorSetIdProto*"),
+    },
+    "OperatorSetIdProto": {
+        1: ("domain", "str"),
+        2: ("version", "varint"),
+    },
+    "GraphProto": {
+        1: ("node", "msg:NodeProto*"),
+        2: ("name", "str"),
+        5: ("initializer", "msg:TensorProto*"),
+        10: ("doc_string", "str"),
+        11: ("input", "msg:ValueInfoProto*"),
+        12: ("output", "msg:ValueInfoProto*"),
+        13: ("value_info", "msg:ValueInfoProto*"),
+    },
+    "NodeProto": {
+        1: ("input", "str*"),
+        2: ("output", "str*"),
+        3: ("name", "str"),
+        4: ("op_type", "str"),
+        5: ("attribute", "msg:AttributeProto*"),
+        6: ("doc_string", "str"),
+        7: ("domain", "str"),
+    },
+    "AttributeProto": {
+        1: ("name", "str"),
+        2: ("f", "float"),
+        3: ("i", "varint"),
+        4: ("s", "bytes"),
+        5: ("t", "msg:TensorProto"),
+        6: ("g", "msg:GraphProto"),
+        7: ("floats", "float*"),
+        8: ("ints", "varint*"),
+        9: ("strings", "bytes*"),
+        10: ("tensors", "msg:TensorProto*"),
+        11: ("graphs", "msg:GraphProto*"),
+        20: ("type", "varint"),
+    },
+    "TensorProto": {
+        1: ("dims", "varint*"),
+        2: ("data_type", "varint"),
+        4: ("float_data", "float*"),
+        5: ("int32_data", "varint*"),
+        6: ("string_data", "bytes*"),
+        7: ("int64_data", "varint*"),
+        8: ("name", "str"),
+        9: ("raw_data", "bytes"),
+        10: ("double_data", "double*"),
+        11: ("uint64_data", "varint*"),
+    },
+    "ValueInfoProto": {
+        1: ("name", "str"),
+        2: ("type", "msg:TypeProto"),
+        3: ("doc_string", "str"),
+    },
+    "TypeProto": {
+        1: ("tensor_type", "msg:TypeProtoTensor"),
+    },
+    "TypeProtoTensor": {
+        1: ("elem_type", "varint"),
+        2: ("shape", "msg:TensorShapeProto"),
+    },
+    "TensorShapeProto": {
+        1: ("dim", "msg:TensorShapeDim*"),
+    },
+    "TensorShapeDim": {
+        1: ("dim_value", "varint"),
+        2: ("dim_param", "str"),
+    },
+}
+
+# ONNX TensorProto.DataType (public enum values)
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+
+# ONNX AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_GRAPH = 1, 2, 3, 4, 5
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------- encode
+def _enc_varint(v):
+    if v < 0:
+        v += 1 << 64  # two's-complement 64-bit (proto int64 negatives)
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field_num, wire):
+    return _enc_varint((field_num << 3) | wire)
+
+
+def _enc_scalar(num, kind, v):
+    if kind == "varint":
+        return _key(num, 0) + _enc_varint(int(v))
+    if kind == "float":
+        return _key(num, 5) + struct.pack("<f", float(v))
+    if kind == "double":
+        return _key(num, 1) + struct.pack("<d", float(v))
+    if kind in ("str", "bytes"):
+        b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        return _key(num, 2) + _enc_varint(len(b)) + b
+    raise ValueError(kind)
+
+
+def encode(msg, schema_name):
+    """dict -> wire bytes following SCHEMAS[schema_name]."""
+    schema = SCHEMAS[schema_name]
+    by_name = {name: (num, kind) for num, (name, kind) in schema.items()}
+    out = bytearray()
+    for name, value in msg.items():
+        if name not in by_name or value is None:
+            continue
+        num, kind = by_name[name]
+        repeated = kind.endswith("*")
+        base = kind[:-1] if repeated else kind
+        if base.startswith("msg:"):
+            sub = base[4:]
+            items = value if repeated else [value]
+            for item in items:
+                b = encode(item, sub)
+                out += _key(num, 2) + _enc_varint(len(b)) + b
+        elif repeated:
+            items = list(value)
+            if not items:
+                continue
+            if base == "varint":  # packed (proto3 default)
+                body = b"".join(_enc_varint(int(x)) for x in items)
+                out += _key(num, 2) + _enc_varint(len(body)) + body
+            elif base == "float":
+                body = struct.pack("<%df" % len(items),
+                                   *[float(x) for x in items])
+                out += _key(num, 2) + _enc_varint(len(body)) + body
+            elif base == "double":
+                body = struct.pack("<%dd" % len(items),
+                                   *[float(x) for x in items])
+                out += _key(num, 2) + _enc_varint(len(body)) + body
+            else:  # strings/bytes are never packed
+                for item in items:
+                    out += _enc_scalar(num, base, item)
+        else:
+            out += _enc_scalar(num, base, value)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- decode
+def _dec_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    if result >= 1 << 63:  # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def decode(buf, schema_name):
+    """wire bytes -> dict; unknown fields skipped."""
+    schema = SCHEMAS[schema_name]
+    msg = {}
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        keyv, pos = _dec_varint(buf, pos)
+        num, wire = keyv >> 3, keyv & 7
+        entry = schema.get(num)
+        if entry is None:  # skip unknown field
+            if wire == 0:
+                _, pos = _dec_varint(buf, pos)
+            elif wire == 1:
+                pos += 8
+            elif wire == 2:
+                ln, pos = _dec_varint(buf, pos)
+                pos += ln
+            elif wire == 5:
+                pos += 4
+            else:
+                raise ValueError("unsupported wire type %d" % wire)
+            continue
+        name, kind = entry
+        repeated = kind.endswith("*")
+        base = kind[:-1] if repeated else kind
+        if wire == 0:
+            v, pos = _dec_varint(buf, pos)
+        elif wire == 1:
+            v = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        elif wire == 5:
+            v = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        elif wire == 2:
+            ln, pos = _dec_varint(buf, pos)
+            chunk = buf[pos:pos + ln]
+            pos += ln
+            if base.startswith("msg:"):
+                v = decode(chunk, base[4:])
+            elif base == "str":
+                v = chunk.decode("utf-8", "replace")
+            elif base == "bytes":
+                v = bytes(chunk)
+            elif base in ("varint", "float", "double") and repeated:
+                # packed repeated numerics
+                vals = []
+                p = 0
+                if base == "varint":
+                    while p < len(chunk):
+                        x, p = _dec_varint(chunk, p)
+                        vals.append(x)
+                elif base == "float":
+                    vals = list(struct.unpack("<%df" % (len(chunk) // 4),
+                                              chunk))
+                else:
+                    vals = list(struct.unpack("<%dd" % (len(chunk) // 8),
+                                              chunk))
+                msg.setdefault(name, []).extend(vals)
+                continue
+            else:
+                raise ValueError("field %s: unexpected length-delimited "
+                                 "payload" % name)
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        if repeated:
+            msg.setdefault(name, []).append(v)
+        else:
+            msg[name] = v
+    return msg

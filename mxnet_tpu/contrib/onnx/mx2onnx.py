@@ -1,0 +1,297 @@
+"""Symbol graph -> ONNX ModelProto (reference:
+python/mxnet/contrib/onnx/mx2onnx/export_model.py + _op_translations.py).
+
+Each supported operator maps to standard ONNX ops (opset 12 semantics for
+the subset used).  Parameters become graph initializers.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+_DTYPE_TO_ONNX = {
+    _np.dtype(_np.float32): P.FLOAT,
+    _np.dtype(_np.float64): P.DOUBLE,
+    _np.dtype(_np.float16): P.FLOAT16,
+    _np.dtype(_np.int32): P.INT32,
+    _np.dtype(_np.int64): P.INT64,
+    _np.dtype(_np.int8): P.INT8,
+    _np.dtype(_np.uint8): P.UINT8,
+    _np.dtype(_np.bool_): P.BOOL,
+}
+
+
+def tensor_proto(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    return {"name": name, "dims": list(arr.shape),
+            "data_type": _DTYPE_TO_ONNX[arr.dtype],
+            "raw_data": arr.tobytes()}
+
+
+def _attr(name, value):
+    if isinstance(value, float):
+        return {"name": name, "f": value, "type": P.A_FLOAT}
+    if isinstance(value, (bool, int)):
+        return {"name": name, "i": int(value), "type": P.A_INT}
+    if isinstance(value, str):
+        return {"name": name, "s": value.encode(), "type": P.A_STRING}
+    if isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            return {"name": name, "floats": [float(v) for v in value],
+                    "type": P.A_FLOATS}
+        return {"name": name, "ints": [int(v) for v in value],
+                "type": P.A_INTS}
+    raise ValueError("unsupported attr %s=%r" % (name, value))
+
+
+def _node(op_type, inputs, outputs, name, **attrs):
+    return {"op_type": op_type, "input": list(inputs),
+            "output": list(outputs), "name": name,
+            "attribute": [_attr(k, v) for k, v in attrs.items()]}
+
+
+class _Exporter:
+    def __init__(self, params):
+        self.params = dict(params or {})
+        self.nodes = []
+        self.initializers = []
+        self.extra_inputs = []  # shape tensors etc.
+        self.counter = 0
+
+    def tmp(self, hint):
+        self.counter += 1
+        return "%s_tmp%d" % (hint, self.counter)
+
+    def const_i64(self, name, values):
+        self.initializers.append(tensor_proto(
+            name, _np.asarray(values, dtype=_np.int64)))
+        return name
+
+    def emit(self, *args, **kwargs):
+        self.nodes.append(_node(*args, **kwargs))
+
+
+def _entry_name(entry):
+    node, idx = entry
+    if node.op is None:
+        return node.name
+    if node.num_outputs > 1:
+        return "%s_output%d" % (node.name, idx)
+    return node.name + "_output"
+
+
+def _conv_attrs(a, ndim):
+    k = tuple(int(x) for x in a.get("kernel", ()))
+    s = tuple(int(x) for x in a.get("stride", ())) or (1,) * ndim
+    p = tuple(int(x) for x in a.get("pad", ())) or (0,) * ndim
+    d = tuple(int(x) for x in a.get("dilate", ())) or (1,) * ndim
+    return k, s, p, d
+
+
+def _export_node(ex, node, ins, out):
+    """Translate one mxnet-style node; ins/out are ONNX tensor names."""
+    op, a, name = node.op, node.attrs, node.name
+    if op == "FullyConnected":
+        data = ins[0]
+        if a.get("flatten", True):
+            flat = ex.tmp(name)
+            ex.emit("Flatten", [data], [flat], name + "_flat", axis=1)
+            data = flat
+        if a.get("no_bias", False):
+            # Gemm requires C; emit MatMul with transposed weight instead
+            wt = ex.tmp(name)
+            ex.emit("Transpose", [ins[1]], [wt], name + "_wT", perm=[1, 0])
+            ex.emit("MatMul", [data, wt], [out], name)
+        else:
+            ex.emit("Gemm", [data, ins[1], ins[2]], [out], name,
+                    alpha=1.0, beta=1.0, transA=0, transB=1)
+    elif op == "Convolution":
+        ndim = len(tuple(a.get("kernel", ()))) or 2
+        k, s, p, d = _conv_attrs(a, ndim)
+        ex.emit("Conv", ins, [out], name, kernel_shape=list(k),
+                strides=list(s), pads=list(p) * 2, dilations=list(d),
+                group=int(a.get("num_group", 1)))
+    elif op == "Deconvolution":
+        ndim = len(tuple(a.get("kernel", ()))) or 2
+        k, s, p, d = _conv_attrs(a, ndim)
+        ex.emit("ConvTranspose", ins, [out], name, kernel_shape=list(k),
+                strides=list(s), pads=list(p) * 2, dilations=list(d),
+                group=int(a.get("num_group", 1)))
+    elif op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus", "softsign": "Softsign"}[
+            a.get("act_type", "relu")]
+        ex.emit(act, ins, [out], name)
+    elif op == "LeakyReLU":
+        act_type = a.get("act_type", "leaky")
+        if act_type == "leaky":
+            ex.emit("LeakyRelu", ins[:1], [out], name,
+                    alpha=float(a.get("slope", 0.25)))
+        elif act_type == "elu":
+            ex.emit("Elu", ins[:1], [out], name,
+                    alpha=float(a.get("slope", 0.25)))
+        elif act_type == "prelu":
+            ex.emit("PRelu", ins, [out], name)
+        else:
+            raise NotImplementedError("LeakyReLU %s" % act_type)
+    elif op == "BatchNorm":
+        # ins: data gamma beta moving_mean moving_var.  fix_gamma=True
+        # (the mxnet default) means gamma is pinned to 1 — ONNX has no such
+        # flag, so export a ones initializer in gamma's place.
+        if a.get("fix_gamma", True):
+            gname = ins[1]
+            shape = _np.shape(ex.params.get(gname, ()))
+            if not shape:
+                shape = _np.shape(ex.params.get(ins[2], (1,)))
+            fixed = name + "_gamma_fixed"
+            ex.initializers.append(tensor_proto(
+                fixed, _np.ones(shape, dtype=_np.float32)))
+            ins = [ins[0], fixed] + list(ins[2:])
+        ex.emit("BatchNormalization", ins, [out], name,
+                epsilon=float(a.get("eps", 1e-3)),
+                momentum=float(a.get("momentum", 0.9)))
+    elif op == "Pooling":
+        k = tuple(int(x) for x in a.get("kernel", ()))
+        ndim = len(k) or 2
+        s = tuple(int(x) for x in a.get("stride", ())) or (1,) * ndim
+        p = tuple(int(x) for x in a.get("pad", ())) or (0,) * ndim
+        ptype = a.get("pool_type", "max")
+        if a.get("global_pool", False):
+            ex.emit({"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[
+                ptype], ins, [out], name)
+        else:
+            onnx_op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+            kw = dict(kernel_shape=list(k), strides=list(s),
+                      pads=list(p) * 2)
+            if ptype == "avg":
+                kw["count_include_pad"] = int(a.get("count_include_pad",
+                                                    True))
+            ex.emit(onnx_op, ins, [out], name, **kw)
+    elif op in ("softmax", "SoftmaxOutput", "SoftmaxActivation"):
+        axis = int(a.get("axis", -1)) if op == "softmax" else 1
+        ex.emit("Softmax", ins[:1], [out], name, axis=axis)
+    elif op == "LayerNorm":
+        ex.emit("LayerNormalization", ins, [out], name,
+                axis=int(a.get("axis", -1)),
+                epsilon=float(a.get("eps", 1e-5)))
+    elif op == "Concat":
+        ex.emit("Concat", ins, [out], name, axis=int(a.get("dim", 1)))
+    elif op == "Flatten":
+        ex.emit("Flatten", ins, [out], name, axis=1)
+    elif op in ("Reshape", "reshape"):
+        shape = [int(x) for x in a.get("shape", ())]
+        sname = ex.const_i64(ex.tmp(name + "_shape"), shape)
+        ex.emit("Reshape", [ins[0], sname], [out], name)
+    elif op == "transpose":
+        axes = [int(x) for x in a.get("axes", ())]
+        kw = {"perm": axes} if axes else {}
+        ex.emit("Transpose", ins, [out], name, **kw)
+    elif op == "Dropout":
+        ex.emit("Dropout", ins, [out], name)
+    elif op == "Embedding":
+        # onnx Gather(weight, indices); mxnet Embedding(data, weight)
+        idx = ex.tmp(name + "_idx")
+        ex.emit("Cast", [ins[0]], [idx], name + "_cast", to=P.INT64)
+        ex.emit("Gather", [ins[1], idx], [out], name, axis=0)
+    elif op in ("elemwise_add", "_plus", "broadcast_add", "_add"):
+        ex.emit("Add", ins, [out], name)
+    elif op in ("elemwise_sub", "broadcast_sub", "_sub"):
+        ex.emit("Sub", ins, [out], name)
+    elif op in ("elemwise_mul", "broadcast_mul", "_mul"):
+        ex.emit("Mul", ins, [out], name)
+    elif op in ("elemwise_div", "broadcast_div", "_div"):
+        ex.emit("Div", ins, [out], name)
+    elif op == "dot":
+        ex.emit("MatMul", ins, [out], name)
+    elif op == "relu":
+        ex.emit("Relu", ins, [out], name)
+    elif op == "sigmoid":
+        ex.emit("Sigmoid", ins, [out], name)
+    elif op == "tanh":
+        ex.emit("Tanh", ins, [out], name)
+    elif op == "exp":
+        ex.emit("Exp", ins, [out], name)
+    elif op == "log":
+        ex.emit("Log", ins, [out], name)
+    elif op == "sqrt":
+        ex.emit("Sqrt", ins, [out], name)
+    elif op == "negative":
+        ex.emit("Neg", ins, [out], name)
+    elif op in ("sum", "sum_axis"):
+        axes = a.get("axis", None)
+        kw = {}
+        if axes is not None and axes != ():
+            kw["axes"] = [int(x) for x in (axes if isinstance(
+                axes, (tuple, list)) else (axes,))]
+        ex.emit("ReduceSum", ins, [out], name,
+                keepdims=int(a.get("keepdims", False)), **kw)
+    elif op == "mean":
+        axes = a.get("axis", None)
+        kw = {}
+        if axes is not None and axes != ():
+            kw["axes"] = [int(x) for x in (axes if isinstance(
+                axes, (tuple, list)) else (axes,))]
+        ex.emit("ReduceMean", ins, [out], name,
+                keepdims=int(a.get("keepdims", False)), **kw)
+    elif op == "clip":
+        mn = ex.tmp(name + "_min")
+        mx = ex.tmp(name + "_max")
+        ex.initializers.append(tensor_proto(
+            mn, _np.asarray(float(a.get("a_min", 0.0)), _np.float32)))
+        ex.initializers.append(tensor_proto(
+            mx, _np.asarray(float(a.get("a_max", 1.0)), _np.float32)))
+        ex.emit("Clip", [ins[0], mn, mx], [out], name)
+    else:
+        raise NotImplementedError(
+            "ONNX export: operator %r not supported" % op)
+
+
+def export_symbol(sym, params, input_shapes, input_dtype=_np.float32,
+                  opset=12):
+    """-> ModelProto dict.  `params` maps arg/aux name -> numpy array."""
+    ex = _Exporter(params)
+    params = ex.params
+    topo = sym._topo_nodes()
+    out_names = []
+    for node in topo:
+        if node.op is None:
+            continue
+        ins = [_entry_name(e) for e in node.inputs]
+        outs = ["%s_output%d" % (node.name, i) for i in
+                range(node.num_outputs)] if node.num_outputs > 1 else \
+            [node.name + "_output"]
+        _export_node(ex, node, ins, outs[0] if len(outs) == 1 else outs)
+
+    graph_inputs = []
+    initializers = ex.initializers
+    shape_map = dict(input_shapes)
+    for node in topo:
+        if node.op is not None:
+            continue
+        if node.name in params:
+            initializers.append(tensor_proto(node.name,
+                                             _np.asarray(params[node.name])))
+        else:
+            shape = shape_map.get(node.name)
+            if shape is None:
+                raise ValueError("need input shape for %r" % node.name)
+            graph_inputs.append(_value_info(node.name, shape, input_dtype))
+
+    outputs = []
+    for e in sym._outputs:
+        out_names.append(_entry_name(e))
+        outputs.append({"name": out_names[-1]})
+    graph = {"node": ex.nodes, "name": "mxnet_tpu_graph",
+             "initializer": initializers, "input": graph_inputs,
+             "output": outputs}
+    return {"ir_version": 7, "producer_name": "mxnet_tpu",
+            "producer_version": "0.1", "graph": graph,
+            "opset_import": [{"domain": "", "version": opset}]}
+
+
+def _value_info(name, shape, dtype):
+    return {"name": name, "type": {"tensor_type": {
+        "elem_type": _DTYPE_TO_ONNX[_np.dtype(dtype)],
+        "shape": {"dim": [{"dim_value": int(d)} for d in shape]}}}}

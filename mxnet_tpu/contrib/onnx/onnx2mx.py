@@ -1,0 +1,242 @@
+"""ONNX ModelProto -> Symbol + params (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py + _op_translations.py).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+_ONNX_TO_DTYPE = {
+    P.FLOAT: _np.float32, P.DOUBLE: _np.float64, P.FLOAT16: _np.float16,
+    P.INT32: _np.int32, P.INT64: _np.int64, P.INT8: _np.int8,
+    P.UINT8: _np.uint8, P.BOOL: _np.bool_,
+}
+
+
+def tensor_to_numpy(t):
+    dtype = _np.dtype(_ONNX_TO_DTYPE[t["data_type"]])
+    dims = tuple(t.get("dims", ()))
+    if "raw_data" in t and t["raw_data"]:
+        arr = _np.frombuffer(t["raw_data"], dtype=dtype)
+    elif t.get("float_data"):
+        arr = _np.asarray(t["float_data"], dtype=dtype)
+    elif t.get("int64_data"):
+        arr = _np.asarray(t["int64_data"], dtype=dtype)
+    elif t.get("int32_data"):
+        arr = _np.asarray(t["int32_data"], dtype=dtype)
+    elif t.get("double_data"):
+        arr = _np.asarray(t["double_data"], dtype=dtype)
+    else:
+        arr = _np.zeros(dims, dtype=dtype)
+    return arr.reshape(dims).copy()
+
+
+def _attrs(node):
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == P.A_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == P.A_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == P.A_STRING:
+            out[a["name"]] = a.get("s", b"").decode("utf-8", "replace")
+        elif t == P.A_FLOATS:
+            out[a["name"]] = list(a.get("floats", []))
+        elif t == P.A_INTS:
+            out[a["name"]] = list(a.get("ints", []))
+        elif t == P.A_TENSOR:
+            out[a["name"]] = tensor_to_numpy(a["t"])
+        else:
+            out[a["name"]] = a
+    return out
+
+
+def _pads(attrs, ndim):
+    p = attrs.get("pads", [0] * (2 * ndim))
+    begin, end = p[:ndim], p[ndim:]
+    if list(begin) != list(end):
+        raise NotImplementedError("asymmetric ONNX pads %s" % (p,))
+    return tuple(int(x) for x in begin)
+
+
+def _import_node(sym_mod, node, env, consts):
+    """env: tensor name -> Symbol; consts: name -> numpy (initializers)."""
+    op = node["op_type"]
+    a = _attrs(node)
+    ins = [env[i] for i in node["input"] if i]
+    name = node.get("name") or node["output"][0]
+    S = sym_mod
+
+    def const_of(i):
+        return consts.get(node["input"][i])
+
+    if op == "Gemm":
+        assert a.get("transB", 0) == 1 and a.get("transA", 0) == 0, \
+            "only Gemm(transB=1) imported"
+        num_hidden = const_of(1).shape[0] if const_of(1) is not None else None
+        out = S.FullyConnected(ins[0], ins[1], ins[2],
+                               num_hidden=num_hidden, flatten=False,
+                               name=name)
+    elif op == "MatMul":
+        out = S.dot(ins[0], ins[1], name=name)
+    elif op == "Conv":
+        k = tuple(a.get("kernel_shape", ()))
+        out = S.Convolution(
+            *ins, kernel=k, num_filter=(const_of(1).shape[0]
+                                        if const_of(1) is not None else 1),
+            stride=tuple(a.get("strides", (1,) * len(k))),
+            pad=_pads(a, len(k)),
+            dilate=tuple(a.get("dilations", (1,) * len(k))),
+            num_group=int(a.get("group", 1)),
+            no_bias=len(ins) < 3, name=name)
+    elif op == "ConvTranspose":
+        k = tuple(a.get("kernel_shape", ()))
+        w = const_of(1)
+        out = S.Deconvolution(
+            *ins, kernel=k,
+            num_filter=(w.shape[1] * int(a.get("group", 1))
+                        if w is not None else 1),
+            stride=tuple(a.get("strides", (1,) * len(k))),
+            pad=_pads(a, len(k)),
+            num_group=int(a.get("group", 1)),
+            no_bias=len(ins) < 3, name=name)
+    elif op == "BatchNormalization":
+        out = S.BatchNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                          momentum=float(a.get("momentum", 0.9)),
+                          fix_gamma=False, name=name)
+    elif op in ("MaxPool", "AveragePool"):
+        k = tuple(a.get("kernel_shape", ()))
+        out = S.Pooling(
+            ins[0], kernel=k,
+            stride=tuple(a.get("strides", (1,) * len(k))),
+            pad=_pads(a, len(k)),
+            pool_type="max" if op == "MaxPool" else "avg",
+            count_include_pad=bool(a.get("count_include_pad", 1)),
+            name=name)
+    elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+        out = S.Pooling(ins[0], global_pool=True, kernel=(1, 1),
+                        pool_type="max" if op == "GlobalMaxPool" else "avg",
+                        name=name)
+    elif op == "Relu":
+        out = S.Activation(ins[0], act_type="relu", name=name)
+    elif op == "Sigmoid":
+        out = S.Activation(ins[0], act_type="sigmoid", name=name)
+    elif op == "Tanh":
+        out = S.Activation(ins[0], act_type="tanh", name=name)
+    elif op == "Softplus":
+        out = S.Activation(ins[0], act_type="softrelu", name=name)
+    elif op == "LeakyRelu":
+        out = S.LeakyReLU(ins[0], act_type="leaky",
+                          slope=float(a.get("alpha", 0.01)), name=name)
+    elif op == "Elu":
+        out = S.LeakyReLU(ins[0], act_type="elu",
+                          slope=float(a.get("alpha", 1.0)), name=name)
+    elif op == "PRelu":
+        out = S.LeakyReLU(ins[0], ins[1], act_type="prelu", name=name)
+    elif op == "Softmax":
+        out = S.softmax(ins[0], axis=int(a.get("axis", -1)), name=name)
+    elif op == "LayerNormalization":
+        out = S.LayerNorm(*ins, axis=int(a.get("axis", -1)),
+                          eps=float(a.get("epsilon", 1e-5)), name=name)
+    elif op == "Concat":
+        out = S.Concat(*ins, dim=int(a.get("axis", 1)), name=name)
+    elif op == "Flatten":
+        out = S.Flatten(ins[0], name=name)
+    elif op == "Reshape":
+        shape = const_of(1)
+        if shape is None:
+            raise NotImplementedError("dynamic Reshape shape")
+        out = S.reshape(ins[0], shape=tuple(int(x) for x in shape),
+                        name=name)
+    elif op == "Transpose":
+        out = S.transpose(ins[0], axes=tuple(a.get("perm", ())), name=name)
+    elif op == "Dropout":
+        out = S.Dropout(ins[0], name=name)
+    elif op == "Cast":
+        out = S.cast(ins[0],
+                     dtype=_np.dtype(_ONNX_TO_DTYPE[a["to"]]).name,
+                     name=name)
+    elif op == "Gather":
+        # Gather(weight, indices, axis=0) == Embedding(indices, weight)
+        w = const_of(0)
+        if int(a.get("axis", 0)) == 0 and w is not None:
+            out = S.Embedding(ins[1], ins[0], input_dim=w.shape[0],
+                              output_dim=w.shape[1], name=name)
+        else:
+            out = S.take(ins[0], ins[1], axis=int(a.get("axis", 0)),
+                         name=name)
+    elif op == "Add":
+        out = S.broadcast_add(ins[0], ins[1], name=name)
+    elif op == "Sub":
+        out = S.broadcast_sub(ins[0], ins[1], name=name)
+    elif op == "Mul":
+        out = S.broadcast_mul(ins[0], ins[1], name=name)
+    elif op == "Div":
+        out = S.broadcast_div(ins[0], ins[1], name=name)
+    elif op == "Exp":
+        out = S.exp(ins[0], name=name)
+    elif op == "Log":
+        out = S.log(ins[0], name=name)
+    elif op == "Sqrt":
+        out = S.sqrt(ins[0], name=name)
+    elif op == "Neg":
+        out = S.negative(ins[0], name=name)
+    elif op == "Clip":
+        a_min = const_of(1)
+        a_max = const_of(2)
+        out = S.clip(ins[0],
+                     a_min=float(a_min) if a_min is not None else -3.4e38,
+                     a_max=float(a_max) if a_max is not None else 3.4e38,
+                     name=name)
+    elif op == "ReduceSum":
+        out = S.sum(ins[0], axis=tuple(a.get("axes", ())) or None,
+                    keepdims=bool(a.get("keepdims", 1)), name=name)
+    elif op == "ReduceMean":
+        out = S.mean(ins[0], axis=tuple(a.get("axes", ())) or None,
+                     keepdims=bool(a.get("keepdims", 1)), name=name)
+    elif op == "Identity":
+        out = ins[0]
+    else:
+        raise NotImplementedError("ONNX import: op %r not supported" % op)
+
+    outputs = node["output"]
+    if len(outputs) == 1:
+        env[outputs[0]] = out
+    else:
+        for i, oname in enumerate(outputs):
+            if oname:
+                env[oname] = out[i]
+
+
+def import_graph(graph):
+    """GraphProto dict -> (Symbol, arg_params, aux_params)."""
+    from ... import symbol as S
+    from ...ndarray import array
+
+    consts = {t["name"]: tensor_to_numpy(t)
+              for t in graph.get("initializer", [])}
+    env = {}
+    for vi in graph.get("input", []):
+        name = vi["name"]
+        env[name] = S.Variable(name)
+    for cname in consts:
+        if cname not in env:
+            env[cname] = S.Variable(cname)
+    for node in graph.get("node", []):
+        _import_node(S, node, env, consts)
+    outs = [env[o["name"]] for o in graph.get("output", [])]
+    sym = outs[0] if len(outs) == 1 else S.Group(outs)
+
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {}
+    aux_params = {}
+    for name, arr in consts.items():
+        if name in aux_names:
+            aux_params[name] = array(arr)
+        elif name in arg_names:
+            arg_params[name] = array(arr)
+    return sym, arg_params, aux_params

@@ -186,6 +186,7 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
             new_inputs = [gb.mapped(e) for e in node.inputs]
             nn = gb.node(node.op, node.name, node.attrs, new_inputs,
                          node.num_outputs)
+            nn.attr_dict = node.attr_dict
             gb.mapping[id(node)] = [(nn, i) for i in range(node.num_outputs)]
         else:
             gb.mapping[id(node)] = gb.rewrite(node)

@@ -36,7 +36,9 @@ class NaiveEngine:
     def __init__(self):
         self._versions = {}
         self._next = 1
+        self._next_op = 1
         self._errors = {}
+        self._async_vars = {}  # op_id -> mutable var list
 
     def new_variable(self):
         v = self._next
@@ -49,15 +51,35 @@ class NaiveEngine:
         self._errors.pop(var, None)
 
     def push(self, fn, const_vars=(), mutable_vars=(), prop=NORMAL, name=""):
+        op_id = self._next_op
+        self._next_op += 1
         try:
+            if prop == ASYNC:
+                # same contract as ThreadedEngine: fn(op_id) initiates;
+                # on_complete(_error) finishes.  Synchronous engine cannot
+                # block on it — deps resolve eagerly (debug engine).
+                self._async_vars[op_id] = list(mutable_vars)
+                fn(op_id)
+                return op_id
             fn()
         except Exception as e:  # record on written vars like the threaded engine
             for v in mutable_vars:
                 self._errors[v] = e
-            return
+            return op_id
         for v in mutable_vars:
             self._versions[v] = self._versions.get(v, 0) + 1
             self._errors.pop(v, None)  # a clean write clears a stale error
+        return op_id
+
+    def on_complete(self, op_id):
+        for v in self._async_vars.pop(op_id, ()):
+            self._versions[v] = self._versions.get(v, 0) + 1
+            self._errors.pop(v, None)
+
+    def on_complete_error(self, op_id, msg):
+        err = RuntimeError(str(msg))
+        for v in self._async_vars.pop(op_id, ()):
+            self._errors[v] = err
 
     def wait_for_var(self, var):
         if var in self._errors:

@@ -159,8 +159,11 @@ class Executor:
             if name in self.arg_dict:
                 if array.dtype != self.arg_dict[name].dtype:
                     # adopt the source dtype (e.g. int8 quantized params
-                    # bound into default-float32 slots)
-                    self.arg_arrays[self._arg_names.index(name)] = array.copy()
+                    # bound into default-float32 slots), keeping the
+                    # executor's device placement
+                    dst = self.arg_dict[name]
+                    self.arg_arrays[self._arg_names.index(name)] = \
+                        array.as_in_context(dst.context)
                     self._fwd_state = None
                 else:
                     array.copyto(self.arg_dict[name])
@@ -170,8 +173,9 @@ class Executor:
             for name, array in aux_params.items():
                 if name in self.aux_dict:
                     if array.dtype != self.aux_dict[name].dtype:
+                        dst = self.aux_dict[name]
                         self.aux_arrays[self._aux_names.index(name)] = \
-                            array.copy()
+                            array.as_in_context(dst.context)
                         self._fwd_state = None
                     else:
                         array.copyto(self.aux_dict[name])

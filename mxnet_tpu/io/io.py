@@ -551,22 +551,22 @@ class ImageRecordIter(DataIter):
                              provide_label=self.provide_label)
         if not self.iter_next():
             raise StopIteration
-        # Final partial batch is zero-padded with pad set — identical to the
-        # native pipeline's last_batch_keep semantics.
+        # Final partial batch: pad with REAL wrapped records (reference
+        # round_batch semantics — fabricated samples would bias fit());
+        # pad counts the wrapped tail so score()/predict() trim it.
         count = min(self.batch_size, len(self._records) - self.cursor)
         datas = []
         labels = []
-        for i in range(count):
-            item = self._records[self._order[self.cursor + i]]
+        for i in range(self.batch_size):
+            pos = self.cursor + i
+            if pos >= len(self._records):
+                pos = pos % max(len(self._records), 1)
+            item = self._records[self._order[pos]]
             header, img = self._unpack_img(item)
             datas.append(self._augment(img))
             lab = header.label
             labels.append(float(lab) if _np.isscalar(lab) or lab.ndim == 0
                           else _np.asarray(lab, dtype=_np.float32))
-        for _ in range(self.batch_size - count):
-            datas.append(_np.zeros(self.data_shape, dtype=_np.float32))
-            labels.append(0.0 if self.label_width == 1
-                          else _np.zeros(self.label_width, dtype=_np.float32))
         self.cursor += self.batch_size
         data = array(_np.stack(datas))
         label = array(_np.asarray(labels, dtype=_np.float32))

@@ -90,11 +90,18 @@ void Pipeline::IoLoop() {
     return true;
   };
 
+  // First records of the epoch, kept to pad the final partial batch with
+  // REAL samples (reference BatchLoader round_batch semantics — training
+  // on fabricated zero samples would bias fit()).
+  std::vector<std::vector<uint8_t>> head;
+
   const uint8_t* data;
   uint32_t size;
   bool ok = true;
   while (ok && !stop_.load() && reader_->NextRecord(&data, &size)) {
     std::vector<uint8_t> rec(data, data + size);
+    if (static_cast<int>(head.size()) < cfg_.batch_size)
+      head.push_back(rec);
     if (cfg_.shuffle > 0) {
       if (static_cast<int>(shuf.size()) < cfg_.shuffle) {
         shuf.emplace_back(std::move(rec));
@@ -114,8 +121,12 @@ void Pipeline::IoLoop() {
     ok = emit_record(std::move(shuf.back()));
     shuf.pop_back();
   }
-  // Partial final batch.
+  // Partial final batch: count real samples, pad with wrapped records.
   if (ok && !stop_.load() && !cur.empty() && cfg_.last_batch_keep) {
+    int real = static_cast<int>(cur.size());
+    for (size_t i = 0; static_cast<int>(cur.size()) < cfg_.batch_size &&
+                       !head.empty(); ++i)
+      cur.push_back(head[i % head.size()]);
     std::unique_lock<std::mutex> lk(mu_);
     space_cv_.wait(lk, [&] {
       return stop_.load() || outstanding_ < cfg_.queue_depth;
@@ -123,6 +134,7 @@ void Pipeline::IoLoop() {
     if (!stop_.load()) {
       Work w;
       w.recs = std::move(cur);
+      w.real_count = real;
       w.seq = io_seq_++;
       outstanding_++;
       work_q_.push(std::move(w));
@@ -154,13 +166,16 @@ int Pipeline::DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data,
   if (flag == 0) {
     label[0] = slabel;
   } else {
-    if (remain < flag * 4) return -2;
+    // 64-bit guard: flag is untrusted record data; flag*4 in 32 bits can
+    // wrap and defeat the bounds check
+    uint64_t need = static_cast<uint64_t>(flag) * 4;
+    if (remain < need) return -2;
     int n = static_cast<int>(flag) < cfg_.label_width
                 ? static_cast<int>(flag)
                 : cfg_.label_width;
-    std::memcpy(label, p, n * 4);
-    p += flag * 4;
-    remain -= flag * 4;
+    std::memcpy(label, p, static_cast<size_t>(n) * 4);
+    p += need;
+    remain -= need;
   }
   if (remain != cfg_.sample_bytes) return -3;
   std::memcpy(data, p, cfg_.sample_bytes);
@@ -186,7 +201,8 @@ void Pipeline::DecodeLoop() {
     Batch b;
     b.data = static_cast<uint8_t*>(pool_.Alloc(data_bytes_));
     b.label = static_cast<float*>(pool_.Alloc(label_bytes_));
-    b.count = static_cast<int>(w.recs.size());
+    b.count = w.real_count >= 0 ? w.real_count
+                                : static_cast<int>(w.recs.size());
     b.seq = w.seq;
     std::string err;
     for (size_t i = 0; i < w.recs.size(); ++i) {
@@ -202,14 +218,14 @@ void Pipeline::DecodeLoop() {
         break;
       }
     }
-    // Zero unfilled tail of a partial batch so consumers see deterministic
-    // padding (reference BatchLoader pads with previous records; explicit
-    // zeros compose better with masking under jit).
-    if (b.count < cfg_.batch_size && err.empty()) {
-      std::memset(b.data + size_t(b.count) * cfg_.sample_bytes, 0,
-                  data_bytes_ - size_t(b.count) * cfg_.sample_bytes);
-      std::memset(b.label + size_t(b.count) * cfg_.label_width, 0,
-                  label_bytes_ - sizeof(float) * b.count * cfg_.label_width);
+    // Any slots not covered by records (only possible when the whole
+    // epoch has fewer than batch_size records) are zeroed.
+    size_t filled = w.recs.size();
+    if (filled < static_cast<size_t>(cfg_.batch_size) && err.empty()) {
+      std::memset(b.data + filled * cfg_.sample_bytes, 0,
+                  data_bytes_ - filled * cfg_.sample_bytes);
+      std::memset(b.label + filled * cfg_.label_width, 0,
+                  label_bytes_ - sizeof(float) * filled * cfg_.label_width);
     }
     {
       std::lock_guard<std::mutex> lk(mu_);

@@ -75,6 +75,7 @@ class Pipeline {
   struct Work {                       // one undecoded batch
     std::vector<std::vector<uint8_t>> recs;
     uint64_t seq;
+    int real_count{-1};  // <recs.size() when tail was padded by wrapping
   };
 
   void IoLoop();

@@ -11,17 +11,27 @@ def _make_sym_func(op_name):
     def fn(*args, **kwargs):
         name = kwargs.pop("name", None)
         kwargs.pop("out", None)
+        names = _reg.OP_INPUT_NAMES.get(op_name)
         inputs = []
+        nones = []  # positions passed as None — resolved by slot name below
         for a in args:
             if isinstance(a, Symbol):
                 inputs.append(a)
+            elif a is None:
+                # absent optional input: legal only when the slot can be
+                # identified by name (else later inputs would shift)
+                if names is None or len(inputs) >= len(names):
+                    raise TypeError(
+                        "%s: positional arg %d is None but the input slot "
+                        "is unknown" % (op_name, len(inputs)))
+                nones.append(names[len(inputs) + len(nones)])
+                inputs.append(None)
             elif isinstance(a, (list, tuple)) and a and isinstance(a[0], Symbol):
                 inputs.extend(a)
             else:
                 raise TypeError(
                     "%s: positional args must be Symbols; pass attrs as kwargs"
                     % op_name)
-        names = _reg.OP_INPUT_NAMES.get(op_name)
         if names:
             taken = len(inputs)
             for tn in names[taken:]:

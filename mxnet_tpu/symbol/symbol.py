@@ -502,12 +502,27 @@ def _create(op_name, input_syms, attrs, name=None):
     name = NameManager.current().get(name, hint)
     attr_dict = AttrScope.current().get({})
 
+    slot_names = OP_INPUT_NAMES.get(op.name, ())
     inputs = []
-    for s in input_syms:
+    for pos, s in enumerate(input_syms):
         if isinstance(s, Symbol):
             if len(s._outputs) != 1:
                 raise MXNetError("cannot use grouped symbol as single input")
             inputs.append(s._outputs[0])
+        elif s is None:
+            # named optional slot passed as None: omit if the attrs say the
+            # op runs without it, otherwise auto-create its variable
+            sname = slot_names[pos] if pos < len(slot_names) else None
+            if sname is None:
+                raise TypeError("%s: input %d is None" % (op.name, pos))
+            if sname == "bias" and attrs.get("no_bias", False) and \
+                    op.name in ("Convolution", "FullyConnected",
+                                "Deconvolution"):
+                # these op fns take bias as an optional trailing arg; for
+                # every other op the positional slot must stay occupied
+                continue
+            v = Variable("%s_%s" % (name, sname))
+            inputs.append(v._outputs[0])
         else:
             raise TypeError("symbol inputs must be Symbols")
 

@@ -1,0 +1,197 @@
+"""ONNX import/export tests (reference: tests/python-pytest/onnx/ —
+backend roundtrip tests).  No onnx package in this image: the codec is
+hand-rolled, so roundtrips run entirely in-framework."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import _proto
+
+
+def test_proto_roundtrip():
+    model = {
+        "ir_version": 7,
+        "producer_name": "mxnet_tpu",
+        "opset_import": [{"domain": "", "version": 12}],
+        "graph": {
+            "name": "g",
+            "node": [{"op_type": "Relu", "input": ["x"], "output": ["y"],
+                      "name": "relu0",
+                      "attribute": [{"name": "alpha", "f": 0.5,
+                                     "type": _proto.A_FLOAT},
+                                    {"name": "axes", "ints": [0, -2, 3],
+                                     "type": _proto.A_INTS}]}],
+            "initializer": [{"name": "w", "dims": [2, 3],
+                             "data_type": _proto.FLOAT,
+                             "raw_data": np.arange(6, dtype=np.float32)
+                             .tobytes()}],
+            "input": [{"name": "x", "type": {"tensor_type": {
+                "elem_type": 1,
+                "shape": {"dim": [{"dim_value": 2}, {"dim_value": 3}]}}}}],
+            "output": [{"name": "y"}],
+        },
+    }
+    blob = _proto.encode(model, "ModelProto")
+    back = _proto.decode(blob, "ModelProto")
+    assert back["ir_version"] == 7
+    assert back["graph"]["node"][0]["op_type"] == "Relu"
+    attrs = back["graph"]["node"][0]["attribute"]
+    assert attrs[0]["f"] == pytest.approx(0.5)
+    assert attrs[1]["ints"] == [0, -2, 3]
+    t = back["graph"]["initializer"][0]
+    assert t["dims"] == [2, 3]
+    assert np.frombuffer(t["raw_data"], np.float32).tolist() == \
+        list(range(6))
+    dims = back["graph"]["input"][0]["type"]["tensor_type"]["shape"]["dim"]
+    assert [d["dim_value"] for d in dims] == [2, 3]
+
+
+def _roundtrip(sym, arg_params, aux_params, data, tmp_path, atol=1e-4):
+    """Export -> import -> compare forward outputs."""
+    path = str(tmp_path / "m.onnx")
+    params = {}
+    params.update(arg_params)
+    params.update(aux_params)
+    onnx_mxnet.export_model(sym, params, [data.shape], np.float32, path)
+
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+
+    def run(s, a, x, aux):
+        args = dict(a)
+        dname = [n for n in s.list_arguments() if n not in args][0]
+        args[dname] = mx.nd.array(x)
+        shapes = {dname: x.shape}
+        shapes.update({k: v.shape for k, v in a.items()
+                       if k in s.list_arguments()})
+        exe = s.simple_bind(ctx=mx.cpu(), grad_req="null", **shapes)
+        exe.copy_params_from(args, aux, allow_extra_params=True)
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    y1 = run(sym, {k: v for k, v in arg_params.items()}, data, aux_params)
+    y2 = run(sym2, arg2, data, aux2)
+    assert y1.shape == y2.shape
+    assert np.allclose(y1, y2, atol=atol), np.abs(y1 - y2).max()
+    return sym2
+
+
+def test_onnx_roundtrip_mlp(tmp_path):
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    sym = mx.sym.softmax(h, name="prob")
+    args = {
+        "fc1_weight": mx.nd.array(rng.randn(16, 12) * 0.1),
+        "fc1_bias": mx.nd.array(rng.randn(16) * 0.1),
+        "fc2_weight": mx.nd.array(rng.randn(4, 16) * 0.1),
+        "fc2_bias": mx.nd.array(rng.randn(4) * 0.1),
+    }
+    x = rng.rand(3, 12).astype(np.float32)
+    _roundtrip(sym, args, {}, x, tmp_path)
+
+
+def test_onnx_roundtrip_convnet(tmp_path):
+    rng = np.random.RandomState(1)
+    data = mx.sym.Variable("data")
+    h = mx.sym.Convolution(data, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                           name="conv1")
+    h = mx.sym.BatchNorm(h, name="bn1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool1")
+    h = mx.sym.Flatten(h, name="flat")
+    sym = mx.sym.FullyConnected(h, num_hidden=3, name="fc1")
+    args = {
+        "conv1_weight": mx.nd.array(rng.randn(6, 2, 3, 3) * 0.2),
+        "conv1_bias": mx.nd.array(rng.randn(6) * 0.1),
+        "bn1_gamma": mx.nd.array(rng.rand(6) + 0.5),
+        "bn1_beta": mx.nd.array(rng.randn(6) * 0.1),
+        "fc1_weight": mx.nd.array(rng.randn(3, 6 * 4 * 4) * 0.1),
+        "fc1_bias": mx.nd.array(rng.randn(3) * 0.1),
+    }
+    aux = {
+        "bn1_moving_mean": mx.nd.array(rng.randn(6) * 0.1),
+        "bn1_moving_var": mx.nd.array(rng.rand(6) + 0.5),
+    }
+    x = rng.rand(2, 2, 8, 8).astype(np.float32)
+    _roundtrip(sym, args, aux, x, tmp_path, atol=1e-3)
+
+
+def test_onnx_roundtrip_elemwise_reshape(tmp_path):
+    rng = np.random.RandomState(2)
+    a = mx.sym.Variable("data")
+    h = mx.sym.reshape(a, shape=(0, -1), name="rs")
+    w = mx.sym.Variable("w")
+    h = mx.sym.broadcast_mul(h, w, name="bm")
+    sym = mx.sym.tanh(h, name="t")
+    args = {"w": mx.nd.array(rng.rand(1, 12).astype(np.float32))}
+    x = rng.rand(4, 3, 4).astype(np.float32)
+    # reshape(0, -1): mxnet 0 means "copy input dim"; export resolves to
+    # onnx Reshape which uses 0 the same way
+    _roundtrip(sym, args, {}, x, tmp_path)
+
+
+def test_onnx_roundtrip_resnet18(tmp_path):
+    """Model-zoo ResNet-18: residual adds, BN chains, global pool —
+    the widest export surface."""
+    from mxnet_tpu.contrib.quantization import _trace_block
+    from mxnet_tpu.gluon.block import SymbolBlock
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = rng.rand(1, 3, 32, 32).astype(np.float32)
+    want = net(mx.nd.array(x)).asnumpy()
+    sym, params = _trace_block(net, [mx.sym.Variable("data")],
+                               [(1, 3, 32, 32)])
+    path = str(tmp_path / "r18.onnx")
+    onnx_mxnet.export_model(sym, params, [(1, 3, 32, 32)], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    allp = dict(arg2)
+    allp.update(aux2)
+    net2 = SymbolBlock(sym2, [mx.sym.Variable("data")], params=allp)
+    got = net2(mx.nd.array(x))
+    got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    assert np.allclose(got, want, atol=1e-3), np.abs(got - want).max()
+
+
+def test_get_model_metadata(tmp_path):
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    rng = np.random.RandomState(0)
+    params = {"fc_weight": mx.nd.array(rng.randn(4, 6)),
+              "fc_bias": mx.nd.array(rng.randn(4))}
+    path = str(tmp_path / "m.onnx")
+    onnx_mxnet.export_model(sym, params, [(2, 6)], np.float32, path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 6))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_onnx_gluon_export_import(tmp_path):
+    """HybridBlock -> symbol -> onnx -> SymbolBlock roundtrip."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.quantization import _trace_block
+
+    rng = np.random.RandomState(3)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    x = rng.rand(4, 5).astype(np.float32)
+    want = net(mx.nd.array(x)).asnumpy()
+
+    sym, params = _trace_block(net, [mx.sym.Variable("data")], [(4, 5)])
+    path = str(tmp_path / "g.onnx")
+    onnx_mxnet.export_model(sym, params, [(4, 5)], np.float32, path)
+    sym2, arg2, aux2 = onnx_mxnet.import_model(path)
+    from mxnet_tpu.gluon.block import SymbolBlock
+    all_p = dict(arg2)
+    all_p.update(aux2)
+    net2 = SymbolBlock(sym2, [mx.sym.Variable("data")], params=all_p)
+    got = net2(mx.nd.array(x))
+    got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
