@@ -141,6 +141,14 @@ class Trainer:
                 continue
             for upd, data, grad in zip(self._updaters,
                                        p.list_data(), p.list_grad()):
+                if getattr(p, "_grad_stype", "default") == "row_sparse" \
+                        and getattr(self._optimizer, "lazy_update", False):
+                    # sparse_grad param (e.g. Embedding): wrap the dense
+                    # autograd result as row_sparse (device-side nonzero-row
+                    # scan) so the optimizer's lazy kernel touches only the
+                    # used rows; skipped for optimizers w/o lazy kernels
+                    from ..ndarray import sparse as _sp
+                    grad = _sp.cast_storage(grad, "row_sparse")
                 upd(i, grad, data)
 
     # ------------------------------------------------------------ states

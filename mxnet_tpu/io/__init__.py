@@ -1,4 +1,5 @@
 """``mx.io`` — data iterators (reference: python/mxnet/io/io.py, src/io/)."""
 
 from .io import (DataBatch, DataDesc, DataIter, MNISTIter, CSVIter,  # noqa: F401
-                 NDArrayIter, PrefetchingIter, ResizeIter, ImageRecordIter)
+                 LibSVMIter, NDArrayIter, PrefetchingIter, ResizeIter,
+                 ImageRecordIter)

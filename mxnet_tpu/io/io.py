@@ -423,6 +423,116 @@ class CSVIter(DataIter):
         return self._inner.iter_next()
 
 
+class LibSVMIter(DataIter):
+    """LibSVM text -> CSR batches (reference: src/io/iter_libsvm.cc).
+
+    Indices are zero-based (reference convention).  Data batches are
+    CSRNDArray; labels dense (or CSR when label_libsvm given with
+    multi-dim label_shape)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        feat_dim = int(_np.prod(self.data_shape))
+        self._data, labels_inline = self._parse(data_libsvm, feat_dim)
+        if label_libsvm is not None:
+            # separate libsvm label file: densify its sparse rows
+            ldim = int(_np.prod(self.label_shape))
+            lcsr, _ = self._parse(label_libsvm, ldim)
+            self._label = self._densify(lcsr)
+        else:
+            self._label = _np.asarray(labels_inline, dtype=_np.float32)
+        if num_parts > 1:
+            n = self._data["n"]
+            sel = _np.arange(part_index, n, num_parts)
+            self._data = self._subset(self._data, sel)
+            self._label = self._label[sel]
+        self.cursor = -batch_size
+        self.round_batch = round_batch
+
+    @staticmethod
+    def _densify(csr):
+        out = _np.zeros((csr["n"], csr["dim"]), _np.float32)
+        for r in range(csr["n"]):
+            lo, hi = csr["indptr"][r], csr["indptr"][r + 1]
+            out[r, csr["indices"][lo:hi]] = csr["data"][lo:hi]
+        return out
+
+    @staticmethod
+    def _parse(path, feat_dim):
+        data, indices, indptr, labels = [], [], [0], []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    data.append(float(v))
+                indptr.append(len(indices))
+        return {"data": _np.asarray(data, _np.float32),
+                "indices": _np.asarray(indices, _np.int64),
+                "indptr": _np.asarray(indptr, _np.int64),
+                "n": len(indptr) - 1, "dim": feat_dim}, labels
+
+    @staticmethod
+    def _subset(csr, sel):
+        data, indices, indptr = [], [], [0]
+        for r in sel:
+            lo, hi = csr["indptr"][r], csr["indptr"][r + 1]
+            data.extend(csr["data"][lo:hi])
+            indices.extend(csr["indices"][lo:hi])
+            indptr.append(len(indices))
+        return {"data": _np.asarray(data, _np.float32),
+                "indices": _np.asarray(indices, _np.int64),
+                "indptr": _np.asarray(indptr, _np.int64),
+                "n": len(sel), "dim": csr["dim"]}
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape,
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_shape == (1,) else \
+            (self.batch_size,) + self.label_shape
+        return [DataDesc("label", shape, _np.float32)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.round_batch:
+            return self.cursor < self._data["n"]
+        # round_batch=False: discard the final partial batch (same as
+        # CSVIter's last_batch_handle='discard' — never wrap silently)
+        return self.cursor + self.batch_size <= self._data["n"]
+
+    def next(self):
+        from ..ndarray.sparse import CSRNDArray
+        from ..ndarray import array as _arr
+
+        if not self.iter_next():
+            raise StopIteration
+        n = self._data["n"]
+        rows = [(self.cursor + i) % n for i in range(self.batch_size)]
+        pad = max(0, self.cursor + self.batch_size - n)
+        sub = self._subset(self._data, _np.asarray(rows))
+        data = CSRNDArray(sub["data"], sub["indices"], sub["indptr"],
+                          (self.batch_size, sub["dim"]))
+        label = _arr(self._label[_np.asarray(rows) % len(self._label)])
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (reference: src/io/iter_image_recordio_2.cc:748).
 

@@ -53,6 +53,30 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError("cast row_sparse→%s unsupported" % stype)
 
 
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(
+                other, BaseSparseNDArray):
+            if tuple(other.shape) != tuple(self.shape):
+                raise ValueError("copyto shape mismatch: %s vs %s"
+                                 % (self.shape, other.shape))
+            other._assign(self._data)
+            return other
+        return super().copyto(other)
+
+    @classmethod
+    def _from_dense(cls, dense_jax, idx_jax, ctx):
+        """Wrap an existing dense device array + row indices without any
+        host round-trip (device-side cast_storage fast path)."""
+        rsp = cls.__new__(cls)
+        NDArray.__init__(rsp, dense_jax, ctx)
+        rsp._stype = "row_sparse"
+        rsp._aux = (idx_jax, dense_jax[idx_jax])
+        return rsp
+
+
 class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, indptr, shape, ctx=None):
         import jax.numpy as jnp
@@ -86,6 +110,21 @@ class CSRNDArray(BaseSparseNDArray):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError("cast csr→%s unsupported" % stype)
+
+    def __getitem__(self, key):
+        """Row slicing keeps CSR (reference: sparse.py CSRNDArray.__getitem__)."""
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self.shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing requires step 1")
+            stop = max(stop, start)  # empty slice -> empty CSR, like numpy
+            d, ind, ptr = self._aux
+            lo, hi = int(ptr[start]), int(ptr[stop])
+            new_ptr = ptr[start:stop + 1] - ptr[start]
+            return CSRNDArray(d[lo:hi], ind[lo:hi], new_ptr,
+                              (stop - start,) + tuple(self.shape[1:]),
+                              self._ctx)
+        return super().__getitem__(key)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -125,9 +164,19 @@ def cast_storage(arr, stype):
     if stype == "default":
         return NDArray(arr._data, arr._ctx)
     if stype == "row_sparse":
-        dense = arr.asnumpy()
-        return row_sparse_array(dense, shape=dense.shape, ctx=arr._ctx,
-                                dtype=dense.dtype)
+        # device-side: nonzero-row scan runs on the accelerator; only the
+        # (small) index vector ever syncs (reference: cast_storage-inl.h
+        # CastStorageDnsRspImpl, also a device kernel)
+        import jax.numpy as jnp
+
+        data = arr._data
+        if data.ndim > 1:
+            mask = jnp.any(data != 0,
+                           axis=tuple(range(1, data.ndim)))
+        else:
+            mask = data != 0
+        idx = jnp.nonzero(mask)[0]
+        return RowSparseNDArray._from_dense(data, idx, arr._ctx)
     if stype == "csr":
         dense = arr.asnumpy()
         return csr_matrix(dense, shape=dense.shape, ctx=arr._ctx, dtype=dense.dtype)
@@ -139,3 +188,81 @@ def zeros(stype, shape, ctx=None, dtype=None):
         return _dense_zeros(shape, ctx=ctx, dtype=dtype)
     z = _np.zeros(shape, dtype=np_dtype(dtype))
     return cast_storage(array(z, ctx=ctx), stype)
+
+
+# -------------------------------------------------------------- operators
+# Reference: src/operator/tensor/ sparse FComputeEx kernels (dot, retain,
+# elemwise with stype inference).  Dense-backed arrays mean the math runs
+# on the MXU; what these preserve is the STORAGE-TYPE SEMANTICS — output
+# stypes follow the reference's storage-inference rules so downstream
+# sparse-aware code (kvstore row_sparse flows, lazy optimizers) keeps
+# working.
+
+def retain(rsp, indices):
+    """Keep only `indices` rows of a row_sparse array (reference:
+    _retain sparse_retain-inl.h)."""
+    if getattr(rsp, "stype", None) != "row_sparse":
+        raise MXNetError("retain expects a row_sparse array")
+    idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
+        else _np.asarray(indices, dtype=_np.int64)
+    old_idx = _np.asarray(rsp._aux[0])
+    old_val = _np.asarray(rsp._aux[1])
+    keep = _np.isin(old_idx, idx)
+    import jax.numpy as jnp
+
+    return RowSparseNDArray(jnp.asarray(old_val[keep]),
+                            jnp.asarray(old_idx[keep]), rsp.shape, rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
+
+    csr × dense -> dense; csrᵀ × dense -> row_sparse (the embedding-
+    gradient shape, reference DotCsrTransDnsRspImpl)."""
+    from ..ops.registry import apply_op
+
+    l_stype = getattr(lhs, "stype", "default")
+    out = apply_op("dot", lhs._data, rhs._data,
+                   transpose_a=transpose_a, transpose_b=transpose_b)
+    if l_stype == "csr" and transpose_a:
+        dense = NDArray(out, lhs._ctx)
+        return cast_storage(dense, "row_sparse")
+    return NDArray(out, lhs._ctx)
+
+
+def _ew(opname, lhs, rhs):
+    from ..ops.registry import apply_op
+
+    out = NDArray(apply_op(opname, lhs._data, rhs._data), lhs._ctx)
+    ls = getattr(lhs, "stype", "default")
+    rs = getattr(rhs, "stype", "default")
+    # reference storage inference: rsp⊕rsp -> rsp (add/sub); anything with
+    # dense -> dense
+    if ls == rs == "row_sparse" and opname in ("elemwise_add",
+                                               "elemwise_sub"):
+        return cast_storage(out, "row_sparse")
+    return out
+
+
+def add(lhs, rhs):
+    return _ew("elemwise_add", lhs, rhs)
+
+
+def subtract(lhs, rhs):
+    return _ew("elemwise_sub", lhs, rhs)
+
+
+def multiply(lhs, rhs):
+    return _ew("elemwise_mul", lhs, rhs)
+
+
+def elemwise_add(lhs, rhs):
+    return _ew("elemwise_add", lhs, rhs)
+
+
+def elemwise_sub(lhs, rhs):
+    return _ew("elemwise_sub", lhs, rhs)
+
+
+def elemwise_mul(lhs, rhs):
+    return _ew("elemwise_mul", lhs, rhs)

@@ -176,3 +176,46 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+# ------------------------------------------------- row_sparse lazy updates
+# Reference: optimizer_op.cc SGDUpdateRowSparse / AdamUpdateEx — with a
+# row_sparse gradient and lazy_update, ONLY the rows present in the
+# gradient are touched (weight rows and optimizer state rows).  TPU-native
+# form: XLA scatter on the dense parameter — one fused gather/update/
+# scatter per step, bandwidth proportional to the touched rows.
+
+@register("_sparse_sgd_update")
+def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, **_):
+    rows = weight[grad_idx]
+    g = _apply_wd_rescale(rows, grad_val, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None, wd)
+    return weight.at[grad_idx].set(rows - lr * g)
+
+
+@register("_sparse_sgd_mom_update", num_outputs=2)
+def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
+                          momentum=0.0, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0, **_):
+    rows = weight[grad_idx]
+    g = _apply_wd_rescale(rows, grad_val, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None, wd)
+    new_mom_rows = momentum * mom[grad_idx] - lr * g
+    return (weight.at[grad_idx].set(rows + new_mom_rows),
+            mom.at[grad_idx].set(new_mom_rows))
+
+
+@register("_sparse_adam_update", num_outputs=3)
+def sparse_adam_update(weight, grad_val, grad_idx, mean, var, lr=0.001,
+                       beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, **_):
+    rows = weight[grad_idx]
+    g = _apply_wd_rescale(rows, grad_val, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None, wd)
+    new_mean_rows = beta1 * mean[grad_idx] + (1.0 - beta1) * g
+    new_var_rows = beta2 * var[grad_idx] + (1.0 - beta2) * jnp.square(g)
+    new_rows = rows - lr * new_mean_rows / (jnp.sqrt(new_var_rows) + epsilon)
+    return (weight.at[grad_idx].set(new_rows),
+            mean.at[grad_idx].set(new_mean_rows),
+            var.at[grad_idx].set(new_var_rows))

@@ -157,16 +157,30 @@ class Optimizer:
 
 
 def _fused(name, index, weight, grad, states, opt, **extra):
-    """Run a fused update op and write results back in place."""
+    """Run a fused update op and write results back in place.
+
+    A row_sparse gradient with opt.lazy_update routes to the
+    `_sparse_<name>` lazy kernel (reference: optimizer_op.cc FComputeEx
+    storage dispatch) — only the gradient's rows are touched."""
     attrs = {"lr": opt._get_lr(index), "wd": opt._get_wd(index),
              "rescale_grad": opt.rescale_grad,
              "clip_gradient": opt.clip_gradient if opt.clip_gradient else -1.0}
     attrs.update(extra)
-    inputs = [weight, grad] + list(states)
+    name, inputs = _route_sparse(name, weight, grad, states,
+                                 getattr(opt, "lazy_update", False))
     outs = imperative_invoke(name, inputs, attrs)
     weight._assign(outs[0]._data)
     for st, new in zip(states, outs[1:]):
         st._assign(new._data)
+
+
+def _route_sparse(name, weight, grad, states, lazy):
+    """Storage dispatch shared by every fused update call site
+    (reference: optimizer_op.cc FComputeEx selection)."""
+    if getattr(grad, "stype", "default") == "row_sparse" and lazy:
+        return "_sparse_" + name, [weight, grad.data, grad.indices] + \
+            list(states)
+    return name, [weight, grad] + list(states)
 
 
 @register
@@ -345,7 +359,9 @@ class Adam(Optimizer):
                  "rescale_grad": self.rescale_grad,
                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
                  "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
-        outs = imperative_invoke("adam_update", [weight, grad, mean, var], attrs)
+        opname, inputs = _route_sparse("adam_update", weight, grad,
+                                       [mean, var], self.lazy_update)
+        outs = imperative_invoke(opname, inputs, attrs)
         weight._assign(outs[0]._data)
         mean._assign(outs[1]._data)
         var._assign(outs[2]._data)

@@ -1,0 +1,240 @@
+"""Sparse storage tests (reference: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py + test_io.py LibSVMIter).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def test_row_sparse_creation_and_tostype():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[4] = [4, 5, 6]
+    rsp = sp.row_sparse_array(dense, shape=dense.shape)
+    assert rsp.stype == "row_sparse"
+    assert rsp.indices.asnumpy().tolist() == [1, 4]
+    assert np.array_equal(rsp.asnumpy(), dense)
+    assert np.array_equal(rsp.tostype("default").asnumpy(), dense)
+    # (data, indices) construction
+    rsp2 = sp.row_sparse_array(
+        ([[1, 2, 3], [4, 5, 6]], [1, 4]), shape=(6, 3))
+    assert np.array_equal(rsp2.asnumpy(), dense)
+
+
+def test_csr_creation_slicing():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0], [4, 0, 0]],
+                     np.float32)
+    csr = sp.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert np.array_equal(csr.asnumpy(), dense)
+    assert csr.indptr.asnumpy().tolist() == [0, 1, 3, 3, 4]
+    sl = csr[1:3]
+    assert sl.stype == "csr"
+    assert np.array_equal(sl.asnumpy(), dense[1:3])
+
+
+def test_cast_storage_roundtrip():
+    rng = np.random.RandomState(0)
+    dense = rng.rand(5, 4).astype(np.float32)
+    dense[dense < 0.5] = 0
+    nd = mx.nd.array(dense)
+    for stype in ("row_sparse", "csr"):
+        cast = sp.cast_storage(nd, stype)
+        assert cast.stype == stype
+        assert np.array_equal(cast.asnumpy(), dense)
+        back = sp.cast_storage(cast, "default")
+        assert back.stype == "default"
+
+
+def test_retain():
+    rsp = sp.row_sparse_array(
+        ([[1, 1], [2, 2], [3, 3]], [0, 2, 4]), shape=(6, 2))
+    ret = sp.retain(rsp, [0, 4])
+    assert ret.indices.asnumpy().tolist() == [0, 4]
+    want = np.zeros((6, 2), np.float32)
+    want[0] = 1
+    want[4] = 3
+    assert np.array_equal(ret.asnumpy(), want)
+
+
+def test_sparse_dot():
+    rng = np.random.RandomState(1)
+    dense = rng.rand(4, 6).astype(np.float32)
+    dense[dense < 0.6] = 0
+    rhs = rng.rand(4, 3).astype(np.float32)
+    csr = sp.csr_matrix(dense)
+    # csr^T x dense -> row_sparse (embedding-gradient pattern)
+    out = sp.dot(csr, mx.nd.array(rhs), transpose_a=True)
+    assert out.stype == "row_sparse"
+    assert np.allclose(out.asnumpy(), dense.T @ rhs, atol=1e-5)
+    # csr x dense -> dense
+    rhs2 = rng.rand(6, 2).astype(np.float32)
+    out2 = sp.dot(csr, mx.nd.array(rhs2))
+    assert out2.stype == "default"
+    assert np.allclose(out2.asnumpy(), dense @ rhs2, atol=1e-5)
+
+
+def test_sparse_elemwise_stype_rules():
+    a = sp.row_sparse_array(([[1.0, 2.0]], [1]), shape=(4, 2))
+    b = sp.row_sparse_array(([[3.0, 4.0]], [2]), shape=(4, 2))
+    out = sp.elemwise_add(a, b)
+    assert out.stype == "row_sparse"
+    assert np.array_equal(out.asnumpy(), a.asnumpy() + b.asnumpy())
+    dense = mx.nd.ones((4, 2))
+    out2 = sp.elemwise_add(a, dense)
+    assert out2.stype == "default"
+
+
+def test_sparse_zeros():
+    z = sp.zeros("row_sparse", (3, 2))
+    assert z.stype == "row_sparse" and not z.asnumpy().any()
+    z2 = sp.zeros("csr", (3, 2))
+    assert z2.stype == "csr"
+
+
+def _dense_sgd(weight, grad, lr, wd):
+    return weight - lr * (grad + wd * weight)
+
+
+def test_lazy_sgd_touches_only_grad_rows():
+    rng = np.random.RandomState(2)
+    w = rng.rand(8, 3).astype(np.float32)
+    gval = rng.rand(2, 3).astype(np.float32)
+    gidx = np.array([1, 5])
+    grad = sp.row_sparse_array((gval, gidx), shape=w.shape)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           lazy_update=True)
+    weight = mx.nd.array(w)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    got = weight.asnumpy()
+    mom = state.asnumpy()
+    # untouched rows identical (incl. momentum state)
+    for r in range(8):
+        if r in (1, 5):
+            assert not np.allclose(got[r], w[r])
+        else:
+            assert np.array_equal(got[r], w[r])
+            assert not mom[r].any()
+
+
+def test_lazy_adam_touches_only_grad_rows():
+    rng = np.random.RandomState(3)
+    w = rng.rand(6, 2).astype(np.float32)
+    grad = sp.row_sparse_array((rng.rand(1, 2).astype(np.float32), [3]),
+                               shape=w.shape)
+    opt = mx.optimizer.Adam(learning_rate=0.1, lazy_update=True)
+    weight = mx.nd.array(w)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    got = weight.asnumpy()
+    for r in range(6):
+        if r == 3:
+            assert not np.allclose(got[r], w[r])
+        else:
+            assert np.array_equal(got[r], w[r])
+
+
+def test_embedding_sparse_grad_training():
+    """Embedding(sparse_grad=True) + Trainer: only used rows update."""
+    rng = np.random.RandomState(4)
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    x = mx.nd.array(np.array([1, 3, 3], np.float32))
+    with mx.autograd.record():
+        out = emb(x)
+        loss = (out * out).sum()
+    loss.backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = [r for r in range(10) if not np.allclose(w1[r], w0[r])]
+    assert sorted(changed) == [1, 3]
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "x.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:3.0\n")
+        f.write("1 0:4.0 2:5.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    want = np.array([[1.5, 0, 0, 2.0], [0, 3.0, 0, 0]], np.float32)
+    assert np.array_equal(b0.data[0].asnumpy(), want)
+    assert b0.label[0].asnumpy().tolist() == [1.0, 0.0]
+    # wrap-around final batch with pad
+    b1 = batches[1]
+    assert b1.pad == 1
+    assert b1.data[0].asnumpy()[1].tolist() == [1.5, 0, 0, 2.0]
+
+
+def test_libsvm_iter_label_file_and_discard(tmp_path):
+    dpath = str(tmp_path / "d.libsvm")
+    lpath = str(tmp_path / "l.libsvm")
+    with open(dpath, "w") as f:
+        f.write("0 0:1.0\n0 1:2.0\n0 2:3.0\n")
+    with open(lpath, "w") as f:  # 2-dim sparse labels
+        f.write("0 1:1.0\n0 0:2.0\n0 1:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=dpath, data_shape=(4,),
+                          label_libsvm=lpath, label_shape=(2,),
+                          batch_size=2, round_batch=False)
+    batches = list(it)
+    # round_batch=False discards the partial batch — no silent wrapping
+    assert len(batches) == 1
+    assert np.array_equal(batches[0].label[0].asnumpy(),
+                          np.array([[0, 1], [2, 0]], np.float32))
+    assert it.provide_label[0].shape == (2, 2)
+
+
+def test_csr_empty_slice():
+    csr = sp.csr_matrix(np.eye(4, dtype=np.float32))
+    empty = csr[3:1]
+    assert empty.shape[0] == 0
+
+
+def test_row_sparse_copyto_shape_check():
+    rsp = sp.row_sparse_array(np.ones((4, 2), np.float32))
+    with pytest.raises(ValueError):
+        rsp.copyto(mx.nd.zeros((3, 2)))
+
+
+def test_cast_storage_stays_on_device():
+    """row_sparse cast must not round-trip the dense array through host."""
+    nd = mx.nd.array(np.diag([1.0, 0.0, 2.0]).astype(np.float32))
+    called = {"n": 0}
+    orig = type(nd).asnumpy
+
+    def spy(self):
+        called["n"] += 1
+        return orig(self)
+
+    type(nd).asnumpy = spy
+    try:
+        rsp = sp.cast_storage(nd, "row_sparse")
+    finally:
+        type(nd).asnumpy = orig
+    assert called["n"] == 0
+    assert rsp.indices.asnumpy().tolist() == [0, 2]
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("w", mx.nd.array(w))
+    out = mx.nd.zeros((6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=mx.nd.array([1, 4]))
+    got = out.asnumpy()
+    assert np.array_equal(got[1], w[1]) and np.array_equal(got[4], w[4])
+    assert not got[0].any() and not got[5].any()
