@@ -1,0 +1,85 @@
+"""Data providers for the image-classification examples
+(reference: example/image-classification/common/data.py)."""
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, help="training RecordIO file")
+    data.add_argument("--data-val", type=str, help="validation RecordIO file")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="decode worker threads (native pipeline)")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Augmentation")
+    aug.add_argument("--random-crop", type=int, default=1)
+    aug.add_argument("--random-mirror", type=int, default=1)
+    return aug
+
+
+def get_mnist_iter(args, kv):
+    """MNIST iterators sharded by kvstore rank (reference:
+    train_mnist.py get_mnist_iter)."""
+    image_shape = (1, 28, 28) if not getattr(args, "flat", False) else (784,)
+    train = mx.io.MNISTIter(
+        image="data/train-images-idx3-ubyte",
+        label="data/train-labels-idx1-ubyte",
+        batch_size=args.batch_size, shuffle=True, flat=len(image_shape) == 1,
+        num_parts=kv.num_workers, part_index=kv.rank)
+    val = mx.io.MNISTIter(
+        image="data/t10k-images-idx3-ubyte",
+        label="data/t10k-labels-idx1-ubyte",
+        batch_size=args.batch_size, shuffle=False,
+        flat=len(image_shape) == 1,
+        num_parts=kv.num_workers, part_index=kv.rank)
+    return train, val
+
+
+def get_rec_iter(args, kv):
+    """ImageRecordIter pair over the native pipeline (reference:
+    common/data.py get_rec_iter)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    mean = [float(x) for x in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        rand_crop=bool(args.random_crop), rand_mirror=bool(args.random_mirror),
+        preprocess_threads=args.data_nthreads,
+        num_parts=kv.num_workers, part_index=kv.rank)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=False,
+            mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+            preprocess_threads=args.data_nthreads,
+            num_parts=kv.num_workers, part_index=kv.rank)
+    return train, val
+
+
+def synthetic_rec_file(path, num=256, classes=10, hw=32, seed=0):
+    """Write a synthetic-but-separable RecordIO image dataset (zero-egress
+    container: real ImageNet is unavailable; class k brightens row-band k)."""
+    from mxnet_tpu.recordio import IRHeader, MXRecordIO, pack_img
+
+    rng = np.random.RandomState(seed)
+    rec = MXRecordIO(path, "w")
+    band = hw // classes
+    for i in range(num):
+        lab = i % classes
+        img = (rng.rand(hw, hw, 3) * 80).astype(np.uint8)
+        img[lab * band:(lab + 1) * band] += 120
+        rec.write(pack_img(IRHeader(0, float(lab), i, 0), img))
+    rec.close()
+    return path

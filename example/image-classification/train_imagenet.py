@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Train ImageNet-style RecordIO datasets (reference:
+example/image-classification/train_imagenet.py).
+
+With --data-train synthetic (default), a synthetic separable RecordIO
+set is generated on the fly (zero-egress container).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from common import data as common_data
+from common import fit as common_fit
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    common_fit.add_fit_args(parser)
+    common_data.add_data_args(parser)
+    common_data.add_data_aug_args(parser)
+    parser.set_defaults(network="resnet50_v1", num_classes=1000,
+                        image_shape="3,224,224", batch_size=128,
+                        num_epochs=90, lr=0.1, lr_step_epochs="30,60,80")
+    args = parser.parse_args(argv)
+
+    if not args.data_train or args.data_train == "synthetic":
+        tmp = os.path.join(tempfile.gettempdir(), "synthetic_train.rec")
+        hw = int(args.image_shape.split(",")[1])
+        common_data.synthetic_rec_file(
+            tmp, num=min(args.num_examples, 512),
+            classes=min(args.num_classes, 10), hw=hw)
+        args.data_train = tmp
+        args.num_examples = min(args.num_examples, 512)
+        args.num_classes = min(args.num_classes, 10)
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = common_fit.get_network(args.network, args.num_classes, image_shape)
+    return common_fit.fit(args, net, common_data.get_rec_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Train on MNIST (reference: example/image-classification/train_mnist.py).
+
+Synthesises MNIST-like data when the idx files are absent (zero-egress
+container); networks: mlp | lenet.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import mxnet_tpu as mx
+from common import data as common_data
+from common import fit as common_fit
+
+
+def build_mlp(num_classes=10):
+    data = mx.sym.Variable("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=num_classes, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def build_lenet(num_classes=10):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = mx.sym.Flatten(p2)
+    f1 = mx.sym.FullyConnected(fl, num_hidden=500, name="fc1")
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a3, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="train MNIST",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--add_stn", action="store_true")
+    common_fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_classes=10, num_examples=6000,
+                        batch_size=64, num_epochs=10, lr=0.05,
+                        lr_step_epochs="10")
+    args = parser.parse_args(argv)
+
+    net = build_mlp(args.num_classes) if args.network == "mlp" \
+        else build_lenet(args.num_classes)
+    mod = common_fit.fit(args, net, common_data.get_mnist_iter)
+    return mod
+
+
+if __name__ == "__main__":
+    main()

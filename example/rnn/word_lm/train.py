@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Word-level language model (reference: example/rnn/word_lm/train.py —
+LSTM LM on PTB).  The LSTM layer lowers to lax.scan; the whole
+train step is one jitted XLA computation under hybridize.
+
+Uses a synthetic Zipf-ish corpus when no PTB text is given (zero-egress
+container); the model/loop structure matches the reference.
+"""
+
+import argparse
+import math
+import os
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.Block):
+    """Embedding -> LSTM -> tied-vocab decoder (reference: word_lm/model.py)."""
+
+    def __init__(self, vocab_size, num_embed, num_hidden, num_layers,
+                 dropout=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.drop = nn.Dropout(dropout)
+        self.encoder = nn.Embedding(vocab_size, num_embed)
+        self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                            layout="TNC")
+        self.decoder = nn.Dense(vocab_size, flatten=False)
+        self.num_hidden = num_hidden
+
+    def forward(self, inputs, hidden):
+        emb = self.drop(self.encoder(inputs))
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.num_hidden)))
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)
+
+
+def synthetic_corpus(num_tokens=20000, vocab=200, seed=0, noise=0.05):
+    """Low-entropy corpus: a fixed token cycle with occasional noise.
+    An LM that learns the cycle reaches low perplexity within a few
+    epochs — a convergence signal, like PTB for the reference."""
+    rng = np.random.RandomState(seed)
+    cycle = rng.permutation(vocab)
+    toks = np.tile(cycle, num_tokens // vocab + 1)[:num_tokens]
+    flip = rng.rand(num_tokens) < noise
+    toks[flip] = rng.randint(0, vocab, flip.sum())
+    return toks.astype(np.float32), vocab
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[:n * batch_size].reshape(batch_size, n).T  # (T, N)
+
+
+def detach(hidden):
+    if isinstance(hidden, (list, tuple)):
+        return [detach(h) for h in hidden]
+    return hidden.detach()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="word language model")
+    parser.add_argument("--data", type=str, default="synthetic")
+    parser.add_argument("--emsize", type=int, default=64)
+    parser.add_argument("--nhid", type=int, default=128)
+    parser.add_argument("--nlayers", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1.0)
+    parser.add_argument("--clip", type=float, default=0.2)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=20)
+    parser.add_argument("--bptt", type=int, default=35)
+    parser.add_argument("--dropout", type=float, default=0.2)
+    parser.add_argument("--log-interval", type=int, default=50)
+    parser.add_argument("--num-tokens", type=int, default=20000,
+                        help="synthetic corpus length")
+    parser.add_argument("--vocab", type=int, default=200,
+                        help="synthetic corpus vocabulary")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    args = parser.parse_args(argv)
+
+    if args.data == "synthetic":
+        corpus, vocab = synthetic_corpus(num_tokens=args.num_tokens,
+                                         vocab=args.vocab)
+    else:
+        with open(args.data) as f:
+            words = f.read().split()
+        idx = {}
+        corpus = np.asarray([idx.setdefault(w, len(idx)) for w in words],
+                            dtype=np.float32)
+        vocab = len(idx)
+
+    train_data = batchify(corpus, args.batch_size)
+    model = RNNModel(vocab, args.emsize, args.nhid, args.nlayers,
+                     args.dropout)
+    model.initialize(mx.init.Xavier())
+    opt_params = {"learning_rate": args.lr, "clip_gradient": args.clip}
+    trainer = gluon.Trainer(model.collect_params(), args.optimizer,
+                            opt_params)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ppls = []
+    for epoch in range(args.epochs):
+        total_L = 0.0
+        nbatch = 0
+        hidden = model.begin_state(func=mx.nd.zeros,
+                                   batch_size=args.batch_size)
+        tic = time.time()
+        for i in range(0, train_data.shape[0] - 1, args.bptt):
+            seq_len = min(args.bptt, train_data.shape[0] - 1 - i)
+            if seq_len < args.bptt:
+                break  # static shapes: keep every step the same length
+            data = mx.nd.array(train_data[i:i + seq_len])
+            target = mx.nd.array(train_data[i + 1:i + 1 + seq_len])
+            hidden = detach(hidden)
+            with mx.autograd.record():
+                output, hidden = model(data, hidden)
+                L = loss_fn(output, target.reshape((-1,)))
+            L.backward()
+            trainer.step(args.batch_size * seq_len)
+            total_L += float(L.mean().asnumpy())
+            nbatch += 1
+        ppl = math.exp(total_L / max(nbatch, 1))
+        wps = nbatch * args.bptt * args.batch_size / (time.time() - tic)
+        print("epoch %d: ppl %.1f, %.0f wps" % (epoch, ppl, wps))
+        ppls.append(ppl)
+    return ppls
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,31 @@ NCCL/ps-lite backends (kvstore='tpu' façade provided for parity).
 
 __version__ = "0.1.0"
 
+
+def _maybe_init_distributed():
+    """Join the jax.distributed process group when launched by
+    tools/launch.py (DMLC_* env contract, reference: ps-lite's
+    Postoffice::Start reading DMLC_ROLE/DMLC_PS_ROOT_*).  Must run at
+    import, before anything touches the XLA backend."""
+    import os
+
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if n <= 1 or os.environ.get("DMLC_ROLE", "worker") != "worker":
+        return
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # user script already joined the group
+    jax.distributed.initialize(
+        coordinator_address="%s:%s" % (
+            os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
+        num_processes=n,
+        process_id=int(os.environ.get("DMLC_WORKER_ID", "0")))
+
+
+_maybe_init_distributed()
+
 from .base import MXNetError, AttrScope, NameManager  # noqa: F401
 from .context import (Context, cpu, cpu_pinned, current_context, gpu,  # noqa: F401
                       num_gpus, num_tpus, tpu)

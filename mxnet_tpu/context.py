@@ -93,18 +93,20 @@ class Context:
 
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
+            # local_devices: a Context is per-PROCESS (multi-process runs
+            # must never place data on another rank's device)
             try:
-                return jax.devices("cpu")[self.device_id]
+                return jax.local_devices(backend="cpu")[self.device_id]
             except RuntimeError:
                 # no host platform registered (rare); fall back to default
-                return jax.devices()[self.device_id]
+                return jax.local_devices()[self.device_id]
         # tpu / gpu → whatever accelerator platform is present
         devs = _accelerator_devices()
         if not devs:
             # CPU-only process (tests): accelerator contexts fall back to the
             # host platform so models still run; this mirrors reference
             # behaviour of failing only on explicit device features.
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def empty_cache(self):
@@ -120,7 +122,7 @@ def _accelerator_devices():
 
     devs = []
     try:
-        all_devs = jax.devices()
+        all_devs = jax.local_devices()
     except RuntimeError:
         return devs
     for d in all_devs:

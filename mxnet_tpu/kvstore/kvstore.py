@@ -199,6 +199,11 @@ class DistKVStore(KVStore):
     def _init_process_group(self):
         import jax
 
+        # normally already joined at import (mxnet_tpu._maybe_init_distributed
+        # reads the same DMLC_* contract); handle direct construction too
+        if jax.distributed.is_initialized():
+            self._group = True
+            return
         coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
         try:
@@ -207,11 +212,8 @@ class DistKVStore(KVStore):
                 num_processes=self._num_workers,
                 process_id=self._rank)
             self._group = True
-        except Exception as e:  # already initialized or single-host fallback
-            if "already" in str(e).lower():
-                self._group = True
-            else:
-                raise MXNetError("dist kvstore init failed: %s" % e)
+        except Exception as e:
+            raise MXNetError("dist kvstore init failed: %s" % e)
 
     @property
     def rank(self):
@@ -241,6 +243,19 @@ class DistKVStore(KVStore):
                 self._updater(_key_int(k), merged, self._store[k])
             else:
                 self._store[k] += merged
+
+    def init(self, key, value):
+        """Init + broadcast rank 0's value so every replica starts from
+        identical weights (reference: dist kv.init stores on the server
+        once; workers pull the same tensor, kvstore_dist.h InitImpl)."""
+        super().init(key, value)
+        if self._num_workers > 1:
+            keys, _ = _key_value(key, value)
+            for k in keys:
+                v = self._store[k]
+                src = v if self._rank == 0 else \
+                    NDArray(v._data * 0, v._ctx)
+                self._store[k] = self._allreduce(src)
 
     def _allreduce(self, arr):
         """Cross-process sum over DCN via a tiny jitted psum."""

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Exact-value dist_sync kvstore checks, run as N local worker processes
+by tools/launch.py (reference: tests/nightly/dist_sync_kvstore.py run via
+`launch.py -n 3 python dist_sync_kvstore.py`)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    nworker = kv.num_workers
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"])
+
+    shape = (3, 4)
+    kv.init("w", mx.nd.zeros(shape))
+
+    # each worker pushes rank+1; sync semantics: pulled value must be the
+    # sum over ALL workers (reference: dist_sync_kvstore.py check_default_keys)
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(nworker))
+    got = out.asnumpy()
+    assert np.allclose(got, expect), (rank, got[0, 0], expect)
+
+    # second round on the same key accumulates again
+    kv.push("w", mx.nd.ones(shape))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), expect + nworker)
+
+    print("worker %d/%d: dist_sync_kvstore OK" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

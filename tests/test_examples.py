@@ -1,0 +1,130 @@
+"""Smoke tests for example/ scripts and tools/ (reference:
+tests/python/train + tests/nightly launch.py flows, scaled down)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXDIR = os.path.join(REPO, "example")
+
+
+def _run_example(relpath, argv):
+    """Import and run an example's main() in-process (fast: shares jax)."""
+    path = os.path.join(EXDIR, relpath)
+    sys.path.insert(0, os.path.dirname(path))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        os.path.basename(path)[:-3] + "_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        return mod.main(argv)
+    finally:
+        sys.path.pop(0)
+
+
+def test_train_mnist_mlp_converges():
+    mod = _run_example("image-classification/train_mnist.py",
+                       ["--num-epochs", "2", "--batch-size", "64",
+                        "--lr", "0.1", "--kv-store", "local"])
+    # synthetic MNIST is separable: 2 epochs must beat 0.9
+    import mxnet_tpu as mx
+    from mxnet_tpu.io.io import MNISTIter
+
+    val = MNISTIter(image="val", batch_size=64, shuffle=False)
+    acc = mx.metric.Accuracy()
+    mod.score(val, acc)
+    assert acc.get()[1] > 0.9, acc.get()
+
+
+def test_train_imagenet_synthetic_smoke():
+    mod = _run_example(
+        "image-classification/train_imagenet.py",
+        ["--num-epochs", "1", "--batch-size", "16", "--num-examples", "64",
+         "--network", "resnet18_v1", "--image-shape", "3,32,32",
+         "--kv-store", "local", "--num-classes", "4", "--lr", "0.05"])
+    assert mod is not None
+
+
+def test_benchmark_score_tiny():
+    res = _run_example(
+        "image-classification/benchmark_score.py",
+        ["--networks", "alexnet", "--batch-sizes", "2",
+         "--image-shape", "3,64,64", "--num-batches", "2"])
+    assert res and res[0][2] > 0
+
+
+def test_word_lm_ppl_decreases():
+    ppls = _run_example("rnn/word_lm/train.py",
+                        ["--epochs", "3", "--batch_size", "8",
+                         "--bptt", "16", "--nhid", "64", "--emsize", "32",
+                         "--lr", "0.01", "--optimizer", "adam",
+                         "--dropout", "0.0", "--num-tokens", "4000",
+                         "--vocab", "30", "--clip", "5.0"])
+    assert ppls[-1] < ppls[0] * 0.7, ppls  # learning happened
+    assert ppls[-1] < 5, ppls  # near the 5%-noise floor (vocab 30)
+
+
+def test_parse_log(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+
+    lines = [
+        "Node[0] INFO Epoch[0] Batch [20] Speed: 1000.0 samples/sec accuracy=0.5",
+        "Node[0] INFO Epoch[0] Train-accuracy=0.6",
+        "Node[0] INFO Epoch[0] Time cost=5.0",
+        "Node[0] INFO Epoch[0] Validation-accuracy=0.55",
+    ]
+    table = parse_log.parse(lines)
+    assert table == [(0, 0.6, 0.55, 1000.0, 5.0)]
+    sys.path.pop(0)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = (np.random.RandomState(i).rand(8, 8, 3) * 255
+                   ).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / ("%d.png" % i))
+    prefix = str(tmp_path / "data")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import im2rec
+
+    im2rec.main([prefix, str(root), "--list", "--shuffle", "0"])
+    im2rec.main([prefix, str(root)])
+    sys.path.pop(0)
+
+    import mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 8, 8), batch_size=2)
+    labels = []
+    for b in it:
+        labels.extend(b.label[0].asnumpy().astype(int).tolist()[:2 - b.pad])
+    assert sorted(labels) == [0, 0, 0, 1, 1, 1]
+
+
+@pytest.mark.slow
+def test_launch_dist_sync_kvstore():
+    """launch.py -n 2 runs the dist_sync exact-value checks in separate
+    processes over jax.distributed (reference: tests/nightly/test_all.sh)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(REPO, "tests", "dist", "dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("dist_sync_kvstore OK") == 2, r.stdout + r.stderr

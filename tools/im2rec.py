@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Build RecordIO datasets from image folders (reference: tools/im2rec.py).
+
+Two modes, same CLI shape as the reference:
+  --list: scan a directory -> .lst file (index \t label \t relpath)
+  default: .lst + image root -> .rec (+ .idx) via recordio.pack_img
+"""
+
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(args):
+    image_list = []
+    label = 0
+    labels = {}
+    for root, dirs, files in os.walk(args.root):
+        dirs.sort()  # deterministic traversal (and streaming, no buffering)
+        cat = os.path.relpath(root, args.root)
+        for f in sorted(files):
+            if f.lower().endswith(EXTS):
+                if cat not in labels:
+                    labels[cat] = label
+                    label += 1
+                image_list.append((os.path.join(cat, f), labels[cat]))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n_total = len(image_list)
+    chunk = n_total // args.chunks
+    for c in range(args.chunks):
+        suffix = "" if args.chunks == 1 else "_%d" % c
+        part = image_list[c * chunk:(c + 1) * chunk
+                          if c + 1 < args.chunks else n_total]
+        n_train = int(len(part) * args.train_ratio)
+        splits = [("train", part[:n_train]), ("val", part[n_train:])] \
+            if args.train_ratio < 1.0 else [("", part)]
+        for split_name, items in splits:
+            tag = (suffix + "_" + split_name) if split_name else suffix
+            path = args.prefix + tag + ".lst"
+            with open(path, "w") as f:
+                for i, (rel, lab) in enumerate(items):
+                    f.write("%d\t%f\t%s\n" % (i, lab, rel))
+            print("wrote", path, len(items), "items")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def im2rec(args):
+    try:
+        from PIL import Image
+    except ImportError:
+        raise SystemExit("im2rec needs PIL for image decode")
+    lst = args.prefix + ".lst"
+    rec = MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(lst):
+        img = Image.open(os.path.join(args.root, rel)).convert("RGB")
+        if args.resize:
+            w, h = img.size
+            scale = args.resize / min(w, h)
+            img = img.resize((int(w * scale), int(h * scale)))
+        arr = np.asarray(img)
+        rec.write_idx(idx, pack_img(IRHeader(0, label, idx, 0), arr,
+                                    quality=args.quality))
+        n += 1
+    rec.close()
+    print("wrote %s.rec (%d records)" % (args.prefix, n))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="create RecordIO image datasets")
+    parser.add_argument("prefix", help="output prefix (or .lst prefix)")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--quality", type=int, default=95)
+    args = parser.parse_args(argv)
+    if args.list:
+        make_list(args)
+    else:
+        im2rec(args)
+
+
+if __name__ == "__main__":
+    main()

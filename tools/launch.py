@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Cluster/process launcher (reference: tools/launch.py over the
+dmlc-core trackers — ssh/mpi/local).
+
+TPU-native shape: there are no parameter-server processes; workers form a
+jax.distributed process group (DCN collectives), so `-n N` launches N
+worker processes with the same DMLC_* env contract the reference sets
+(DMLC_ROLE/DMLC_WORKER_ID/DMLC_NUM_WORKER/DMLC_PS_ROOT_*), which
+DistKVStore reads (mxnet_tpu/kvstore/kvstore.py).  Only the local
+launcher is implemented; ssh/mpi cluster modes are host-scheduling
+concerns outside this container.
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job locally",
+        usage="launch.py -n 4 python train.py ...")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference CLI parity; the TPU "
+                             "backend has no server processes")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local"])
+    parser.add_argument("--sync-dst-dir", type=str, default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+
+    port = free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

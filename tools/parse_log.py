@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference: tools/parse_log.py).
+
+Understands the Speedometer/fit log lines:
+  Epoch[3] Batch [20]  Speed: 1234.5 samples/sec  accuracy=0.87
+  Epoch[3] Train-accuracy=0.91
+  Epoch[3] Time cost=12.3
+  Epoch[3] Validation-accuracy=0.88
+"""
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    rows = {}
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\]", line)
+        if not m:
+            continue
+        ep = int(m.group(1))
+        row = rows.setdefault(ep, {})
+        m2 = re.search(r"Speed: ([\d.]+)", line)
+        if m2:
+            row.setdefault("speed", []).append(float(m2.group(1)))
+        m2 = re.search(r"Train-([\w-]+)=([\d.]+)", line)
+        if m2:
+            row["train-" + m2.group(1)] = float(m2.group(2))
+        m2 = re.search(r"Validation-([\w-]+)=([\d.]+)", line)
+        if m2:
+            row["val-" + m2.group(1)] = float(m2.group(2))
+        m2 = re.search(r"Time cost=([\d.]+)", line)
+        if m2:
+            row["time"] = float(m2.group(1))
+    out = []
+    for ep in sorted(rows):
+        row = rows[ep]
+        speed = sum(row.get("speed", [0])) / max(len(row.get("speed", [1])), 1)
+        out.append((ep, row.get("train-accuracy"), row.get("val-accuracy"),
+                    speed, row.get("time")))
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile", nargs="?", default="-")
+    args = parser.parse_args(argv)
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    table = parse(lines)
+    print("epoch\ttrain-acc\tval-acc\tspeed\ttime")
+    for ep, tr, va, sp, t in table:
+        print("%d\t%s\t%s\t%.1f\t%s" % (ep, tr, va, sp, t))
+    return table
+
+
+if __name__ == "__main__":
+    main()
