@@ -2,10 +2,9 @@
 
 Headline metric (BASELINE.md): ResNet-50 training img/s — reference
 MXNet 1.2 on V100 fp32: 298.51 img/s @ bs=32, 363.69 img/s @ bs=128
-(docs/faq/perf.md:225-236).  vs_baseline divides our throughput (at
-this bench's own best batch, 256 by default) by the reference's BEST
-published training number (363.69 @ bs=128) — each side at its
-preferred batch size.
+(docs/faq/perf.md:225-236).  vs_baseline compares at the SAME batch
+size (128 default) against the bs=128 V100 number; pass a batch on the
+CLI to measure other configs (256 is this chip's throughput peak).
 
 The whole train step (fwd+bwd+SGD momentum+BN stat update) is one
 jitted XLA computation (parallel/gluon_step.py); compute in bfloat16
@@ -33,7 +32,7 @@ def main():
     from mxnet_tpu.parallel.gluon_step import GluonTrainStep
     from mxnet_tpu.parallel.mesh import create_mesh
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
 
     devices = jax.devices()[:1]  # single-chip benchmark
@@ -53,10 +52,8 @@ def main():
     y = rng.randint(0, 1000, (batch,)).astype(np.int32)
     x, y = step.put_batch(x, y)  # device-resident synthetic batch
 
-    # warmup (compile + 2 steps); a HOST FETCH is the completion barrier —
-    # on relayed TPU backends block_until_ready can return before the
-    # device work drains, so fetch the loss scalar like a real training
-    # loop's metric sync would
+    # warmup (compile + 2 steps); the loss host fetch is the completion
+    # barrier, matching what a real training loop's metric sync does
     for _ in range(3):
         l = step(x, y)
     float(np.asarray(l))

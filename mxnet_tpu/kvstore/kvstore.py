@@ -235,10 +235,13 @@ class DistKVStore(KVStore):
                 merged = imperative_invoke("add_n", list(vlist), {})[0]
             else:
                 merged = merged.copy()
+            if self._compression is not None:
+                # per-worker quantize BEFORE aggregation (reference:
+                # PushCompressed kvstore_dist.h:378 — each worker sends
+                # its own quantized gradient; residual stays worker-side)
+                merged = self._compression.compress_decompress(k, merged)
             if self._num_workers > 1:
                 merged = self._allreduce(merged)
-            if self._compression is not None:
-                merged = self._compression.compress_decompress(k, merged)
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
             else:

@@ -429,3 +429,23 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
 @register("getnnz", aliases=("_contrib_getnnz",))
 def getnnz(data, axis=None, **_):
     return (data != 0).sum(axis=axis).astype(jnp.int64)
+
+
+@register("_contrib_SyncBatchNorm", aliases=("SyncBatchNorm",),
+          num_outputs=1)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                    momentum=0.9, fix_gamma=True, use_global_stats=False,
+                    ndev=1, key="", axis_name=None, **_):
+    """Cross-device BatchNorm (reference: contrib/sync_batch_norm.cc:48 —
+    the op whose stats reduction is a communication barrier across GPUs).
+
+    Delegates to the ONE BatchNorm implementation (ops/nn.py) with
+    ``axis_name`` set: under GSPMD jit a plain BatchNorm over a
+    batch-sharded tensor already reduces globally, so the pmean matters
+    only for explicit per-device parallelism (shard_map/pmap)."""
+    from .nn import batch_norm
+
+    return batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                      momentum=momentum, fix_gamma=fix_gamma,
+                      use_global_stats=use_global_stats, axis=1,
+                      axis_name=axis_name)

@@ -320,7 +320,7 @@ def _bn_nout(attrs):
 @register("BatchNorm", num_outputs=_bn_nout)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
-               cudnn_off=False, **_):
+               cudnn_off=False, axis_name=None, **_):
     """Functional BatchNorm (reference: src/operator/nn/batch_norm.cc).
 
     Returns out, or (out, batch_mean, batch_var) when ``output_mean_var``.
@@ -331,6 +331,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     ax = int(axis) % data.ndim
     red = tuple(i for i in range(data.ndim) if i != ax)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # axis_name: cross-device statistics under EXPLICIT parallelism
+    # (shard_map/pmap) — the SyncBatchNorm contract (reference:
+    # contrib/sync_batch_norm.cc).  Under GSPMD jit a batch-sharded input
+    # already reduces globally without it.
     if use_global_stats:
         mean, var = moving_mean, moving_var
     elif data.dtype in (jnp.bfloat16, jnp.float16):
@@ -343,13 +347,20 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
         xf = data.astype(jnp.float32)
         mean = jnp.mean(xf, axis=red)
         meansq = jnp.mean(jnp.square(xf), axis=red)
+        if axis_name:
+            mean = lax.pmean(mean, axis_name)
+            meansq = lax.pmean(meansq, axis_name)
         var = jnp.maximum(meansq - jnp.square(mean), 0.0)
         mean = mean.astype(data.dtype)
         var = var.astype(data.dtype)
     else:
         mean = jnp.mean(data, axis=red)
+        if axis_name:
+            mean = lax.pmean(mean, axis_name)
         var = jnp.mean(jnp.square(data - _expand(mean, ax, data.ndim)),
                        axis=red)
+        if axis_name:
+            var = lax.pmean(var, axis_name)
     inv = lax.rsqrt(var + eps)
     out = (data - _expand(mean, ax, data.ndim)) * _expand(g * inv, ax, data.ndim) \
         + _expand(beta, ax, data.ndim)

@@ -36,6 +36,24 @@ def main():
     kv.pull("w", out=out)
     assert np.allclose(out.asnumpy(), expect + nworker)
 
+    # 2-bit gradient compression with error feedback (reference:
+    # dist_sync_kvstore.py compute_expected_2bit_quantization — each
+    # worker quantizes BEFORE aggregation, residual stays worker-side):
+    # push 1: every worker's 0.3 < threshold 0.5 -> quantizes to 0,
+    #         residual 0.3 kept; aggregate = 0.
+    # push 2: residual 0.3 + 0.3 = 0.6 >= 0.5 -> each worker emits +0.5;
+    #         aggregate = 0.5 * nworker.
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("c", mx.nd.zeros(shape))
+    kv2.push("c", mx.nd.ones(shape) * 0.3)
+    out2 = mx.nd.zeros(shape)
+    kv2.pull("c", out=out2)
+    assert np.allclose(out2.asnumpy(), 0.0), out2.asnumpy()[0, 0]
+    kv2.push("c", mx.nd.ones(shape) * 0.3)
+    kv2.pull("c", out=out2)
+    assert np.allclose(out2.asnumpy(), 0.5 * nworker), out2.asnumpy()[0, 0]
+
     print("worker %d/%d: dist_sync_kvstore OK" % (rank, nworker))
 
 
