@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""SSD object detection (reference: example/ssd — SSD-VGG16 on VOC,
+BASELINE.json config 4: multibox + NMS custom ops end-to-end).
+
+A scaled SSD: conv backbone + two feature scales, anchors from
+MultiBoxPrior, training targets from MultiBoxTarget, inference through
+MultiBoxDetection (decode + NMS).  Trains on synthetic single-object
+scenes (zero-egress container — no VOC); the op pipeline is exactly the
+reference's.  Anchors are static and the whole loss is jit-staged, so
+the hot path is MXU matmuls/convs.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import contrib as ndc
+
+
+class TinySSD(gluon.Block):
+    """Backbone + per-scale class/box heads (reference:
+    example/ssd/symbol/symbol_builder.py structure, scaled down)."""
+
+    SIZES = [(0.2, 0.27), (0.45, 0.55)]
+    RATIOS = [(1.0, 2.0, 0.5)] * 2
+
+    def __init__(self, num_classes=3, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.num_anchors = len(self.SIZES[0]) + len(self.RATIOS[0]) - 1
+        self.backbone = nn.Sequential()
+        for f in (16, 32):
+            self.backbone.add(nn.Conv2D(f, 3, padding=1),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(2))
+        self.scale1 = nn.Sequential()
+        self.scale1.add(nn.Conv2D(32, 3, padding=1), nn.BatchNorm(),
+                        nn.Activation("relu"))
+        self.down = nn.Sequential()
+        self.down.add(nn.Conv2D(32, 3, padding=1), nn.BatchNorm(),
+                      nn.Activation("relu"), nn.MaxPool2D(2))
+        a, c = self.num_anchors, num_classes
+        self.cls1 = nn.Conv2D(a * (c + 1), 3, padding=1)
+        self.loc1 = nn.Conv2D(a * 4, 3, padding=1)
+        self.cls2 = nn.Conv2D(a * (c + 1), 3, padding=1)
+        self.loc2 = nn.Conv2D(a * 4, 3, padding=1)
+
+    def forward(self, x):
+        feats = []
+        x = self.backbone(x)
+        f1 = self.scale1(x)
+        feats.append((f1, self.cls1, self.loc1, self.SIZES[0],
+                      self.RATIOS[0]))
+        f2 = self.down(f1)
+        feats.append((f2, self.cls2, self.loc2, self.SIZES[1],
+                      self.RATIOS[1]))
+        anchors, cls_preds, loc_preds = [], [], []
+        for f, cls_head, loc_head, sizes, ratios in feats:
+            anchors.append(ndc.MultiBoxPrior(f, sizes=sizes, ratios=ratios))
+            cp = cls_head(f)  # (B, A*(C+1), H, W)
+            b = cp.shape[0]
+            cp = cp.transpose((0, 2, 3, 1)).reshape(
+                (b, -1, self.num_classes + 1))
+            cls_preds.append(cp)
+            lp = loc_head(f).transpose((0, 2, 3, 1)).reshape((b, -1))
+            loc_preds.append(lp)
+        anchor = mx.nd.concat(*anchors, dim=1)          # (1, N, 4)
+        cls_pred = mx.nd.concat(*cls_preds, dim=1)       # (B, N, C+1)
+        loc_pred = mx.nd.concat(*loc_preds, dim=1)       # (B, N*4)
+        return anchor, cls_pred, loc_pred
+
+
+def synthetic_scene(rng, n, hw=64, num_classes=3):
+    """Images with ONE solid axis-aligned box; class = channel colour."""
+    x = rng.rand(n, 3, hw, hw).astype(np.float32) * 0.1
+    labels = np.full((n, 1, 5), -1.0, dtype=np.float32)
+    for i in range(n):
+        cls = rng.randint(num_classes)
+        w, h = rng.randint(hw // 4, hw // 2, 2)
+        x0 = rng.randint(0, hw - w)
+        y0 = rng.randint(0, hw - h)
+        x[i, cls, y0:y0 + h, x0:x0 + w] += 0.8
+        labels[i, 0] = [cls, x0 / hw, y0 / hw, (x0 + w) / hw, (y0 + h) / hw]
+    return x, labels
+
+
+def train(args):
+    rng = np.random.RandomState(0)
+    net = TinySSD(num_classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    l1 = gluon.loss.L1Loss()
+
+    def cls_loss_fn(cls_pred, cls_t):
+        """CE over valid anchors only (reference: SoftmaxOutput with
+        ignore_label=-1, normalization='valid').  Targets come from
+        hard-negative mining, so backgrounds don't drown positives."""
+        log_p = mx.nd.log_softmax(cls_pred, axis=-1)
+        ce = -mx.nd.pick(log_p, mx.nd.clip(cls_t, 0, 1e9), axis=-1)
+        valid = (cls_t >= 0).astype("float32")
+        return (ce * valid).sum() / mx.nd.clip(valid.sum(), 1.0, 1e18)
+
+    x_all, y_all = synthetic_scene(rng, args.num_examples, args.data_shape,
+                                   args.num_classes)
+    B = args.batch_size
+    for epoch in range(args.epochs):
+        tot_cls = tot_loc = nb = 0.0
+        tic = time.time()
+        for i in range(0, args.num_examples - B + 1, B):
+            data = mx.nd.array(x_all[i:i + B])
+            label = mx.nd.array(y_all[i:i + B])
+            with mx.autograd.record():
+                anchor, cls_pred, loc_pred = net(data)
+                loc_t, loc_m, cls_t = ndc.MultiBoxTarget(
+                    anchor, label, cls_pred.transpose((0, 2, 1)),
+                    negative_mining_ratio=3.0)
+                Lc = cls_loss_fn(cls_pred, cls_t)
+                Ll = l1(loc_pred * loc_m, loc_t * loc_m)
+                L = Lc + args.loc_weight * Ll
+            L.backward()
+            trainer.step(B)
+            tot_cls += float(Lc.mean().asnumpy())
+            tot_loc += float(Ll.mean().asnumpy())
+            nb += 1
+        print("epoch %d: cls %.4f loc %.4f (%.1fs)"
+              % (epoch, tot_cls / nb, tot_loc / nb, time.time() - tic))
+    return net
+
+
+def evaluate(net, args, n=32):
+    """Fraction of scenes whose top detection matches class @ IoU>=0.5."""
+    rng = np.random.RandomState(99)
+    x, y = synthetic_scene(rng, n, args.data_shape, args.num_classes)
+    anchor, cls_pred, loc_pred = net(mx.nd.array(x))
+    probs = mx.nd.softmax(cls_pred, axis=-1).transpose((0, 2, 1))
+    det = ndc.MultiBoxDetection(probs, loc_pred, anchor,
+                                nms_threshold=0.45)
+    det = det.asnumpy()  # (B, N, 6): [cls, score, x1, y1, x2, y2]
+    hits = 0
+    for i in range(n):
+        rows = det[i]
+        rows = rows[rows[:, 0] >= 0]
+        if not len(rows):
+            continue
+        best = rows[rows[:, 1].argmax()]
+        gt = y[i, 0]
+        ix1, iy1 = max(best[2], gt[1]), max(best[3], gt[2])
+        ix2, iy2 = min(best[4], gt[3]), min(best[5], gt[4])
+        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+        a1 = (best[4] - best[2]) * (best[5] - best[3])
+        a2 = (gt[3] - gt[1]) * (gt[4] - gt[2])
+        iou = inter / max(a1 + a2 - inter, 1e-9)
+        if int(best[0]) == int(gt[0]) and iou >= 0.5:
+            hits += 1
+    return hits / n
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="train SSD")
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--num-examples", type=int, default=256)
+    parser.add_argument("--data-shape", type=int, default=64)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    parser.add_argument("--loc-weight", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    net = train(args)
+    acc = evaluate(net, args)
+    print("detection accuracy (top-1 class @ IoU>=0.5): %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()
