@@ -4,6 +4,8 @@ Currently: quantization (INT8), onnx (import/export).
 """
 
 from . import quantization  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import text  # noqa: F401
 
 try:  # onnx codec is self-contained but optional
     from . import onnx  # noqa: F401

@@ -1,0 +1,126 @@
+"""SVRG (stochastic variance-reduced gradient) optimization (reference:
+python/mxnet/contrib/svrg_optimization/{svrg_module,svrg_optimizer}.py).
+
+SVRGModule wraps Module: every ``update_freq`` epochs it snapshots the
+parameters and computes the FULL-dataset gradient at the snapshot; each
+step then uses the variance-reduced gradient
+``g_i(w) - g_i(w_snap) + g_full(w_snap)``.
+
+TPU-native note: each of the three gradient terms is the same jitted
+fwd/bwd computation — the control variate is plain array arithmetic
+between executions, so everything stays on device.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..module.module import Module
+from ..ndarray import NDArray, array, zeros
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """reference: svrg_module.py SVRGModule."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = int(update_freq)
+        self._param_snapshot = None   # {name: NDArray} at snapshot
+        self._full_grads = None       # {name: NDArray} full grad at snapshot
+
+    # -------------------------------------------------------- snapshot
+    def update_full_grads(self, train_data):
+        """Snapshot params and accumulate the full-dataset gradient at the
+        snapshot (reference: svrg_module.update_full_grads)."""
+        arg_params, _ = self.get_params()
+        self._param_snapshot = {k: v.copy() for k, v in arg_params.items()}
+        sums = {k: zeros(v.shape) for k, v in arg_params.items()}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self.forward_backward(batch)
+            for name, grads in zip(self._exec_group.param_names,
+                                   self._exec_group.grad_arrays):
+                if grads and grads[0] is not None:
+                    sums[name] += grads[0]
+            nbatch += 1
+        train_data.reset()
+        self._full_grads = {k: v / max(nbatch, 1) for k, v in sums.items()}
+
+    def _snapshot_batch_grad(self, data_batch):
+        """Gradient of the CURRENT batch at the SNAPSHOT parameters."""
+        cur_ref, aux = self.get_params()
+        # deep copy: set_params writes THROUGH the cache objects
+        # get_params returns, so a reference would alias the snapshot
+        current = {k: v.copy() for k, v in cur_ref.items()}
+        self.set_params(self._param_snapshot, aux,
+                        allow_missing=False, force_init=True)
+        self.forward_backward(data_batch)
+        snap_grads = {
+            name: grads[0].copy()
+            for name, grads in zip(self._exec_group.param_names,
+                                   self._exec_group.grad_arrays)
+            if grads and grads[0] is not None}
+        self.set_params(current, aux, allow_missing=False, force_init=True)
+        return snap_grads
+
+    def update_svrg(self, data_batch):
+        """One variance-reduced step: fwd/bwd at w and at w_snap, combine,
+        then the normal optimizer update."""
+        assert self._full_grads is not None, "call update_full_grads first"
+        snap_grads = self._snapshot_batch_grad(data_batch)
+        self.forward_backward(data_batch)
+        for name, grads in zip(self._exec_group.param_names,
+                               self._exec_group.grad_arrays):
+            if not grads or grads[0] is None:
+                continue
+            g = grads[0]
+            vr = g - snap_grads[name] + self._full_grads[name]
+            g._assign(vr._data)
+        self.update()
+
+    # ------------------------------------------------------------- fit
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=None, optimizer="sgd", optimizer_params=None,
+            begin_epoch=0, initializer=None, epoch_end_callback=None,
+            batch_end_callback=None, validation_metric=None, **kwargs):
+        """Training loop with periodic full-gradient refresh
+        (reference: svrg_module.fit)."""
+        from .. import metric as _metric
+        from ..module.base_module import BatchEndParam, _as_list
+
+        if kwargs:
+            raise TypeError("SVRGModule.fit: unsupported arguments %s"
+                            % sorted(kwargs))
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        if not self.params_initialized:
+            self.init_params(initializer=initializer)
+        self.init_optimizer(optimizer=optimizer,
+                            optimizer_params=optimizer_params or
+                            {"learning_rate": 0.01})
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch or 1):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.update_svrg(batch)
+                self.update_metric(eval_metric, batch.label)
+                for cb in _as_list(batch_end_callback or []):
+                    cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                     eval_metric=eval_metric,
+                                     locals=locals()))
+            if eval_data is not None:
+                vm = validation_metric or eval_metric
+                self.score(eval_data, vm)
+            for cb in _as_list(epoch_end_callback or []):
+                arg_params, aux_params = self.get_params()
+                cb(epoch, self.symbol, arg_params, aux_params)
+        return self

@@ -1,0 +1,191 @@
+"""Text utilities: vocabulary + token embeddings (reference:
+python/mxnet/contrib/text/{vocab,embedding,utils}.py).
+
+Zero-egress container: pretrained GloVe/fastText downloads are gated
+behind CustomEmbedding (load from a local file) — the composition APIs
+(indexing, get_vecs_by_tokens, attaching to gluon.nn.Embedding) match
+the reference.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as _np
+
+from ..ndarray import NDArray, array
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str",
+           "register", "create"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """reference: text/utils.py count_tokens_from_str."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source_str.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with reserved tokens (reference:
+    text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        assert unknown_token not in reserved_tokens
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        self._reserved_tokens = reserved_tokens or None
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq or tok in self._token_to_idx:
+                    continue
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        if isinstance(tokens, str):
+            return self._token_to_idx.get(tokens, 0)
+        return [self._token_to_idx.get(t, 0) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            return self._idx_to_token[indices]
+        return [self._idx_to_token[i] for i in indices]
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base token embedding (reference: text/embedding.py
+    _TokenEmbedding): vocabulary + an (N, D) vector table."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        out = array(vecs)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        vecs = _np.array(self._idx_to_vec.asnumpy())  # writable copy
+        newv = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else _np.asarray(new_vectors)
+        newv = newv.reshape(len(tokens), -1)
+        for t, v in zip(tokens, newv):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not in the embedding" % t)
+            vecs[self._token_to_idx[t]] = v
+        self._idx_to_vec = array(vecs)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding loaded from a local text file of
+    '<token> <v0> <v1> ...' lines (reference: text/embedding.py
+    CustomEmbedding — the no-download path)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        tokens = []
+        vecs = []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for lineno, line in enumerate(f, 1):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                if lineno == 1 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
+                    continue  # fastText/word2vec '<count> <dim>' header
+                vec = [float(x) for x in parts[1:]]
+                if vecs and len(vec) != self._vec_len:
+                    raise ValueError(
+                        "%s:%d: vector has %d dims, expected %d"
+                        % (pretrained_file_path, lineno, len(vec),
+                           self._vec_len))
+                if not vecs:
+                    self._vec_len = len(vec)
+                tokens.append(parts[0])
+                vecs.append(vec)
+        if vocabulary is not None:
+            keep = [(t, v) for t, v in zip(tokens, vecs)
+                    if t in vocabulary.token_to_idx]
+        else:
+            keep = list(zip(tokens, vecs))
+        # zero rows for <unk> AND any reserved tokens already in the
+        # vocabulary, keeping idx_to_vec aligned with idx_to_token
+        table = [_np.zeros(self._vec_len, _np.float32)
+                 for _ in self._idx_to_token]
+        for t, v in keep:
+            if t in self._token_to_idx:
+                table[self._token_to_idx[t]] = _np.asarray(v, _np.float32)
+                continue
+            self._token_to_idx[t] = len(self._idx_to_token)
+            self._idx_to_token.append(t)
+            table.append(_np.asarray(v, _np.float32))
+        self._idx_to_vec = array(_np.stack(table))
+
+
+_EMBED_REGISTRY = {"CustomEmbedding": CustomEmbedding}
+
+
+def register(cls):
+    """reference: embedding.register."""
+    _EMBED_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """reference: embedding.create."""
+    if embedding_name not in _EMBED_REGISTRY:
+        raise KeyError(
+            "unknown embedding %r (pretrained downloads are unavailable in "
+            "this environment; use CustomEmbedding with a local file)"
+            % embedding_name)
+    return _EMBED_REGISTRY[embedding_name](**kwargs)

@@ -440,6 +440,20 @@ class NDArray:
     def __rsub__(self, o):
         return self._binop(o, "broadcast_sub", "_minus_scalar", reverse=True)
 
+    def __matmul__(self, o):
+        """numpy @ semantics: 2-D dot, batched matmul for higher ranks
+        (mx dot is a tensordot over last/first axes — different contract)."""
+        import jax.numpy as jnp
+
+        other = o._data if isinstance(o, NDArray) else jnp.asarray(o)
+        return NDArray(jnp.matmul(self._data, other), self._ctx)
+
+    def __rmatmul__(self, o):
+        import jax.numpy as jnp
+
+        other = o._data if isinstance(o, NDArray) else jnp.asarray(o)
+        return NDArray(jnp.matmul(other, self._data), self._ctx)
+
     def __mul__(self, o):
         return self._binop(o, "broadcast_mul", "_mul_scalar")
 

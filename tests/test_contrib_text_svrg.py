@@ -1,0 +1,152 @@
+"""contrib.text + SVRG tests (reference: test_contrib_text.py,
+test_contrib_svrg_module.py / test_contrib_svrg_optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def test_count_and_vocabulary():
+    counter = ctext.count_tokens_from_str("a b b c c c\nc a", to_lower=True)
+    assert counter["c"] == 4 and counter["b"] == 2
+    vocab = ctext.Vocabulary(counter, min_freq=2,
+                             reserved_tokens=["<pad>"])
+    # order: <unk>, reserved, then tokens by (-freq, token)
+    assert vocab.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert vocab.to_indices("c") == vocab.token_to_idx["c"]
+    assert vocab.to_indices("zzz") == 0  # unknown
+    assert vocab.to_tokens(vocab.to_indices(["a", "c"])) == ["a", "c"]
+    assert "b" in vocab.token_to_idx  # freq 2 kept
+
+
+def test_custom_embedding_roundtrip(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        f.write("hello 1.0 2.0 3.0\n")
+        f.write("world 4.0 5.0 6.0\n")
+    emb = ctext.CustomEmbedding(path)
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens(["hello", "world", "missing"]).asnumpy()
+    assert np.allclose(v[0], [1, 2, 3])
+    assert np.allclose(v[1], [4, 5, 6])
+    assert not v[2].any()  # unknown -> zeros
+    emb.update_token_vectors("hello", mx.nd.array([[9.0, 9.0, 9.0]]))
+    assert np.allclose(emb.get_vecs_by_tokens("hello").asnumpy(), 9.0)
+    # registry path
+    emb2 = ctext.create("CustomEmbedding", pretrained_file_path=path)
+    assert emb2.vec_len == 3
+
+
+def test_custom_embedding_feeds_gluon_embedding(tmp_path):
+    from mxnet_tpu import gluon
+
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        for i, tok in enumerate(["a", "b", "c"]):
+            f.write("%s %d %d\n" % (tok, i, i * 10))
+    emb = ctext.CustomEmbedding(path)
+    layer = gluon.nn.Embedding(len(emb), emb.vec_len)
+    layer.initialize()
+    layer.weight.set_data(emb.idx_to_vec)
+    idx = mx.nd.array(np.asarray(emb.to_indices(["b", "c"]), np.float32))
+    out = layer(idx).asnumpy()
+    assert np.allclose(out, [[1, 10], [2, 20]])
+
+
+def test_custom_embedding_reserved_tokens(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        f.write("hello 1.0 2.0\nworld 3.0 4.0\n")
+    emb = ctext.CustomEmbedding(path, reserved_tokens=["<pad>", "<bos>"])
+    # table aligned with vocab: unk + 2 reserved (zeros) + tokens
+    assert emb.idx_to_vec.shape == (5, 2)
+    v = emb.get_vecs_by_tokens(["<pad>", "hello", "world"]).asnumpy()
+    assert not v[0].any()
+    assert np.allclose(v[1], [1, 2]) and np.allclose(v[2], [3, 4])
+
+
+def test_custom_embedding_fasttext_header_and_ragged(tmp_path):
+    path = str(tmp_path / "emb.vec")
+    with open(path, "w") as f:
+        f.write("2 3\n")  # fastText header
+        f.write("a 1 2 3\nb 4 5 6\n")
+    emb = ctext.CustomEmbedding(path)
+    assert emb.vec_len == 3
+    assert np.allclose(emb.get_vecs_by_tokens("b").asnumpy(), [4, 5, 6])
+    bad = str(tmp_path / "bad.txt")
+    with open(bad, "w") as f:
+        f.write("a 1 2 3\nb 4 5\n")
+    with pytest.raises(ValueError, match="bad.txt:2"):
+        ctext.CustomEmbedding(bad)
+
+
+def test_matmul_operator_semantics():
+    rng = np.random.RandomState(0)
+    a2 = rng.rand(3, 4).astype(np.float32)
+    b2 = rng.rand(4, 5).astype(np.float32)
+    got = (mx.nd.array(a2) @ mx.nd.array(b2)).asnumpy()
+    assert np.allclose(got, a2 @ b2, atol=1e-5)
+    a3 = rng.rand(2, 3, 4).astype(np.float32)
+    b3 = rng.rand(2, 4, 5).astype(np.float32)
+    got3 = (mx.nd.array(a3) @ mx.nd.array(b3)).asnumpy()
+    assert np.allclose(got3, a3 @ b3, atol=1e-5)  # batched
+    gotr = (a2 @ mx.nd.array(b2)).asnumpy()
+    assert np.allclose(gotr, a2 @ b2, atol=1e-5)  # __rmatmul__
+
+
+def _lin_sym():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    return mx.sym.LinearRegressionOutput(out, name="lro")
+
+
+def test_svrg_module_converges():
+    """SVRG on least squares: loss must beat the start by a wide margin
+    (reference: test_contrib_svrg_module.py test_svrg_with_sgd)."""
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0, -3.0, 0.5]])
+    x = rng.rand(200, 3).astype(np.float32)
+    y = (x @ w_true.T).ravel() + rng.randn(200).astype(np.float32) * 0.01
+    it = mx.io.NDArrayIter(x, y, batch_size=20, shuffle=True,
+                           label_name="lro_label")
+    mod = SVRGModule(_lin_sym(), data_names=("data",),
+                     label_names=("lro_label",), update_freq=4,
+                     context=mx.cpu())
+    mod.fit(it, num_epoch=60, eval_metric="mse", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    arg, _ = mod.get_params()
+    got = arg["fc_weight"].asnumpy().ravel()
+    assert np.allclose(got, w_true.ravel(), atol=0.25), got
+
+
+def test_svrg_variance_reduced_gradient_exact():
+    """At the snapshot point the control variate must cancel exactly:
+    vr_grad == full_grad (reference: svrg_optimizer math)."""
+    rng = np.random.RandomState(1)
+    x = rng.rand(40, 2).astype(np.float32)
+    y = x.sum(axis=1)
+    it = mx.io.NDArrayIter(x, y, batch_size=10, label_name="lro_label")
+    mod = SVRGModule(_lin_sym(), data_names=("data",),
+                     label_names=("lro_label",), update_freq=1,
+                     context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.0})
+    mod.update_full_grads(it)
+    it.reset()
+    batch = next(it)
+    # lr=0 → params equal snapshot → g(w)-g(w_snap) == 0 → vr == full
+    snap = mod._snapshot_batch_grad(batch)
+    mod.forward_backward(batch)
+    for name, grads in zip(mod._exec_group.param_names,
+                           mod._exec_group.grad_arrays):
+        if grads and grads[0] is not None:
+            vr = grads[0].asnumpy() - snap[name].asnumpy() + \
+                mod._full_grads[name].asnumpy()
+            assert np.allclose(vr, mod._full_grads[name].asnumpy(),
+                               atol=1e-5)
