@@ -449,3 +449,16 @@ def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                       momentum=momentum, fix_gamma=fix_gamma,
                       use_global_stats=use_global_stats, axis=1,
                       axis_name=axis_name)
+
+
+@register("khatri_rao", aliases=("_contrib_krprod",))
+def khatri_rao(*matrices, **_):
+    """Column-wise Khatri-Rao product (reference: contrib/krprod.cc):
+    inputs (k_i, r) share the column count r; output is
+    (prod(k_i), r) with column j the Kronecker product of the
+    corresponding input columns."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        r = out.shape[1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, r)
+    return out

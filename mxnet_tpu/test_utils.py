@@ -111,6 +111,20 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
         location = dict(zip(arg_names, location))
     location = {k: (v if isinstance(v, NDArray) else array(v, dtype=dtype))
                 for k, v in location.items()}
+    # auto-fill parameter args the caller didn't supply (reference
+    # behaviour: missing args get random values)
+    missing = [n for n in sym.list_arguments() if n not in location]
+    if missing:
+        shapes, _, _ = sym.infer_shape_partial(
+            **{k: v.shape for k, v in location.items()})
+        by_name = dict(zip(sym.list_arguments(), shapes))
+        rng = _np.random.RandomState(0)
+        for n in missing:
+            if by_name.get(n) is None:
+                raise ValueError("cannot infer shape for %r; pass it in "
+                                 "location" % n)
+            location[n] = array(
+                rng.uniform(-0.5, 0.5, by_name[n]).astype(dtype))
     if grad_nodes is None:
         grad_nodes = list(location.keys())
 

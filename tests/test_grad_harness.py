@@ -1,0 +1,57 @@
+"""The numeric-gradient checking harness itself (reference:
+mxnet.test_utils.check_numeric_gradient — the backbone of
+test_operator.py) exercised across op families, plus check_consistency
+(eager vs staged execution) and the khatri_rao op."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, check_consistency)
+
+
+@pytest.mark.parametrize("build,shapes", [
+    (lambda d: mx.sym.Activation(d, act_type="tanh"), (3, 4)),
+    (lambda d: mx.sym.FullyConnected(d, num_hidden=5, name="fc"), (3, 4)),
+    (lambda d: mx.sym.Pooling(d, kernel=(2, 2), stride=(2, 2),
+                              pool_type="avg"), (2, 2, 6, 6)),
+    (lambda d: mx.sym.LayerNorm(d, name="ln"), (4, 6)),
+    # log_softmax: its output-sum is input-dependent (plain softmax
+    # sums to a constant, which would make this check vacuous)
+    (lambda d: mx.sym.log_softmax(d, axis=-1), (3, 7)),
+])
+def test_numeric_gradient_families(build, shapes):
+    data = mx.sym.Variable("data")
+    sym = build(data)
+    rng = np.random.RandomState(0)
+    loc = {"data": rng.uniform(-1, 1, shapes).astype(np.float64)}
+    # parameter inputs get random values from the harness itself
+    # large eps: loss_at evaluates in float32; central differences
+    # with tiny eps lose all precision there (curvature error ~eps^2)
+    check_numeric_gradient(sym, loc, numeric_eps=1e-2, rtol=0.05, atol=5e-3)
+
+
+def test_check_consistency_runs():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(mx.sym.Activation(data, act_type="relu"),
+                                num_hidden=3, name="fc")
+    check_consistency(sym, ctx_list=[{"ctx": mx.cpu(), "data": (4, 5)}])
+
+
+def test_assert_almost_equal_raises():
+    with pytest.raises(AssertionError):
+        assert_almost_equal(np.ones(3), np.zeros(3))
+
+
+def test_khatri_rao():
+    """The reference op's own documented example (contrib/krprod.cc):
+    column-wise Kronecker — A (2,2) x B (3,2) -> (6,2)."""
+    from mxnet_tpu.ops.registry import apply_op
+
+    a = np.array([[1.0, -1.0], [2.0, -3.0]])
+    b = np.array([[1.0, 4.0], [2.0, 5.0], [3.0, 6.0]])
+    got = np.asarray(apply_op("khatri_rao", a, b))
+    want = np.stack([np.kron(a[:, j], b[:, j]) for j in range(2)], axis=1)
+    assert got.shape == (6, 2)
+    assert np.array_equal(got, want)
