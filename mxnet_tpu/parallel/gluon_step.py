@@ -132,8 +132,27 @@ class GluonTrainStep:
                 for p in self.aux)
         x_shard = (NamedSharding(self.mesh, data_spec) if data_spec is not None
                    else data_parallel_sharding(self.mesh, 1))
-        y_shard = (NamedSharding(self.mesh, label_spec)
-                   if label_spec is not None else x_shard)
+        if label_spec is not None:
+            y_shard = NamedSharding(self.mesh, label_spec)
+        elif data_spec is not None:
+            # labels are rank-1: shard them along the data spec's batch axis
+            from jax.sharding import PartitionSpec as _P
+            y_shard = NamedSharding(self.mesh, _P(data_spec[0]))
+        else:
+            y_shard = x_shard
+        # place the functional state onto its shardings up front: committed
+        # single-device arrays cannot be implicitly resharded by jit, and
+        # this also avoids a first-step transfer
+        def _put(vals, shard):
+            if isinstance(shard, tuple):
+                return tuple(jax.device_put(v, s)
+                             for v, s in zip(vals, shard))
+            return tuple(jax.device_put(v, shard) for v in vals)
+
+        self.train_vals = _put(self.train_vals, tv_shard)
+        self.opt_state = _put(self.opt_state, tv_shard)
+        self.aux_vals = _put(self.aux_vals, aux_shard)
+
         self._step = jax.jit(
             step,
             in_shardings=(tv_shard, tv_shard, aux_shard, x_shard, y_shard,
