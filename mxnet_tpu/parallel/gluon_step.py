@@ -134,10 +134,12 @@ class GluonTrainStep:
                    else data_parallel_sharding(self.mesh, 1))
         if label_spec is not None:
             y_shard = NamedSharding(self.mesh, label_spec)
-        elif data_spec is not None:
+        elif data_spec is not None and len(data_spec):
             # labels are rank-1: shard them along the data spec's batch axis
             from jax.sharding import PartitionSpec as _P
             y_shard = NamedSharding(self.mesh, _P(data_spec[0]))
+        elif data_spec is not None:
+            y_shard = x_shard  # P(): replicated batch -> replicated labels
         else:
             y_shard = x_shard
         # place the functional state onto its shardings up front: committed

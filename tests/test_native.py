@@ -346,3 +346,26 @@ def test_image_record_iter_native_shuffle_covers_epoch(tmp_path):
         labels.extend(batch.label[0].asnumpy().astype(int).tolist())
     assert sorted(labels) == list(range(30))
     assert labels != list(range(30))  # actually shuffled
+
+
+@needs_native
+def test_c_abi_from_c(tmp_path):
+    """Compile and run a plain-C consumer of the libmxtpu ABI (the FFI
+    seam other language bindings use; reference: c_api.h consumers)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "tests", "native_c", "test_c_abi.c")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "test_c_abi")
+    cc = subprocess.run(
+        ["gcc", "-O1", "-o", exe, src, "-L" + so_dir, "-lmxtpu",
+         "-Wl,-rpath," + so_dir], capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+    r = subprocess.run([exe, str(tmp_path / "c.rec")], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all checks passed" in r.stdout
