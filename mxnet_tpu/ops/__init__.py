@@ -19,5 +19,7 @@ from . import contrib  # noqa: F401
 from . import attention  # noqa: F401
 from . import custom  # noqa: F401
 from . import quantization  # noqa: F401
+from . import linalg  # noqa: F401
+from . import extended  # noqa: F401
 
 from .registry import apply_op, get, list_ops, register  # noqa: F401

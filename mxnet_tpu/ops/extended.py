@@ -1,0 +1,630 @@
+"""Long-tail operators closing the registry diff with the reference:
+histogram/ravel/split_v2 (tensor), SVMOutput, image ops, fft/count_sketch,
+RCNN family (Proposal, PSROIPooling, DeformableConvolution), Correlation,
+aggregated multi-tensor SGD, group-adagrad.
+
+Reference files cited per op.  TPU-native stance as elsewhere: static
+shapes, masked fixed-capacity formulations for data-dependent outputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import OP_INPUT_NAMES, register
+
+# ---------------------------------------------------------------- tensor
+
+
+@register("histogram", aliases=("_histogram",), num_outputs=2)
+def histogram(data, bins=None, bin_cnt=10, range=None, **_):
+    """reference: src/operator/tensor/histogram.cc — returns
+    (counts, bin_edges); bins may be an explicit edge tensor."""
+    x = data.ravel().astype(jnp.float32)
+    if bins is not None and (hasattr(bins, "__len__") or
+                             getattr(bins, "ndim", 0) > 0):
+        # explicit (possibly non-uniform) edges: bin by searchsorted
+        # (attr canonicalization may deliver them as a tuple)
+        edges = jnp.asarray(bins, jnp.float32)
+        cnt = edges.shape[0] - 1
+    else:
+        cnt = int(bin_cnt)
+        lo, hi = (range if range else
+                  (jnp.min(x), jnp.max(x)))
+        edges = jnp.linspace(lo, hi, cnt + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, x, side="right") - 1, 0, cnt - 1)
+    in_range = (x >= edges[0]) & (x <= edges[-1])
+    counts = jnp.zeros(cnt, jnp.int64).at[idx].add(
+        in_range.astype(jnp.int64))
+    return counts, edges
+
+
+@register("ravel_multi_index", aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=(), **_):
+    """reference: tensor/ravel.cc — data (N, M) of N-d indices -> (M,)."""
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= int(s)
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return (data * strides[:, None]).sum(axis=0)
+
+
+@register("unravel_index", aliases=("_unravel_index",))
+def unravel_index(data, shape=(), **_):
+    out = []
+    rem = data.astype(jnp.int64)
+    acc = 1
+    for s in reversed(shape):
+        out.append(rem % int(s))
+        rem = rem // int(s)
+    return jnp.stack(list(reversed(out)), axis=0).astype(data.dtype)
+
+
+def _split_v2_nout(attrs):
+    iob = attrs.get("indices", ())
+    if attrs.get("sections", 0):
+        return int(attrs["sections"])
+    return len(tuple(iob)) + 1
+
+
+@register("split_v2", aliases=("_split_v2",), num_outputs=_split_v2_nout)
+def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0, **_):
+    """reference: tensor/matrix_op.cc split_v2 — split by sections or at
+    explicit indices."""
+    ax = int(axis)
+    if sections:
+        parts = jnp.split(data, int(sections), axis=ax)
+    else:
+        parts = jnp.split(data, [int(i) for i in indices], axis=ax)
+    if squeeze_axis:
+        parts = [p.squeeze(ax) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, **_):
+    """reference: src/operator/svm_output.cc — forward is identity; the
+    hinge(-squared) gradient flows in backward."""
+    margin = float(margin)
+    reg = float(regularization_coefficient)
+    use_linear = bool(use_linear)
+
+    @jax.custom_vjp
+    def f(x, y):
+        return x
+
+    def fwd(x, y):
+        return x, (x, y)
+
+    def bwd(res, g):
+        x, y = res
+        n = x.shape[1]
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), n, dtype=x.dtype)
+        # margin violation per class vs the true-class score
+        true_score = jnp.sum(x * onehot, axis=1, keepdims=True)
+        viol = (margin - (true_score - x)) > 0
+        if use_linear:  # L1-SVM: +-1 gradients
+            gx = jnp.where(viol, 1.0, 0.0) * (1 - onehot)
+            gx = gx - onehot * gx.sum(axis=1, keepdims=True)
+        else:  # L2-SVM
+            slack = jnp.maximum(margin - (true_score - x), 0.0) * (1 - onehot)
+            gx = 2.0 * slack
+            gx = gx - onehot * gx.sum(axis=1, keepdims=True)
+        return (reg * gx * g, jnp.zeros_like(y))
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+# ----------------------------------------------------------------- image
+
+
+@register("image_to_tensor", aliases=("_image_to_tensor",))
+def image_to_tensor(data, **_):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference:
+    src/operator/image/image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("image_normalize", aliases=("_image_normalize",))
+def image_normalize(data, mean=(0.0,), std=(1.0,), **_):
+    """CHW float normalize (reference: image_random.cc Normalize)."""
+    c = data.shape[-3]
+    mean = jnp.asarray(tuple(mean) * c if len(tuple(mean)) == 1 else mean,
+                       data.dtype)[:c]
+    std = jnp.asarray(tuple(std) * c if len(tuple(std)) == 1 else std,
+                      data.dtype)[:c]
+    shape = (c,) + (1,) * 2
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("image_resize", aliases=("_image_resize",))
+def image_resize(data, size=(), keep_ratio=False, interp=1, **_):
+    """HWC resize (reference: src/operator/image/resize.cc); bilinear."""
+    size = (int(size), int(size)) if isinstance(size, int) else \
+        tuple(int(s) for s in size)
+    w, h = size if len(size) == 2 else (size[0], size[0])
+    method = "nearest" if int(interp) == 0 else "bilinear"
+    if data.ndim == 3:
+        return jax.image.resize(data, (h, w, data.shape[2]), method=method)
+    return jax.image.resize(
+        data, (data.shape[0], h, w, data.shape[3]), method=method)
+
+
+# -------------------------------------------------------------- contrib
+
+
+@register("_contrib_fft", aliases=("fft",))
+def contrib_fft(data, compute_size=128, **_):
+    """reference: contrib/fft.cc — complex output interleaved as
+    (..., 2n) [re, im, re, im, ...]."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def contrib_ifft(data, compute_size=128, **_):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=0, **_):
+    """Count-sketch projection (reference: contrib/count_sketch.cc):
+    out[:, h[j]] += s[j] * data[:, j]."""
+    out_dim = int(out_dim)
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    vals = data * ss[None, :]
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., hh].add(vals)
+
+
+@register("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+          num_outputs=2)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1, **_):
+    """Greedy bipartite matching by score (reference:
+    contrib/bounding_box.cc BipartiteMatching): data (..., M, N) scores;
+    returns (row->col matches, col->row matches), unmatched = -1."""
+    shape = data.shape
+    m, n = shape[-2], shape[-1]
+    flat = data.reshape((-1, m, n))
+    sign = 1.0 if is_ascend else -1.0
+
+    def one(mat):
+        def body(_, carry):
+            rowm, colm, mat = carry
+            best = jnp.argmin(sign * mat)
+            i, j = best // n, best % n
+            ok = jnp.where(is_ascend, mat[i, j] <= threshold,
+                           mat[i, j] >= threshold)
+            rowm = jnp.where(ok & (rowm[i] < 0), rowm.at[i].set(j), rowm)
+            colm = jnp.where(ok & (colm[j] < 0), colm.at[j].set(i), colm)
+            inf = jnp.asarray(jnp.inf * sign, mat.dtype)
+            mat = mat.at[i, :].set(inf)
+            mat = mat.at[:, j].set(inf)
+            return rowm, colm, mat
+
+        k = min(m, n) if topk <= 0 else min(int(topk), min(m, n))
+        rowm = jnp.full((m,), -1.0, data.dtype)
+        colm = jnp.full((n,), -1.0, data.dtype)
+        rowm, colm, _ = lax.fori_loop(0, k, body, (rowm, colm, mat))
+        return rowm, colm
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(shape[:-2] + (m,)),
+            cols.reshape(shape[:-2] + (n,)))
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal",
+                                        "MultiProposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False, **_):
+    """RPN proposals (reference: contrib/proposal.cc / multi_proposal.cc):
+    anchor grid -> bbox-delta decode -> clip -> NMS -> top-N rois
+    (B*post_nms, 5) [batch_idx, x1, y1, x2, y2].  Fixed-capacity: always
+    returns post_nms rows per image, low-score rows repeat the best roi."""
+    from .contrib import box_nms
+
+    b, num_anchor_x2, h, w = cls_prob.shape
+    a = num_anchor_x2 // 2
+    stride = float(feature_stride)
+    # base anchors centered at origin
+    base = []
+    for r in ratios:
+        for s in scales:
+            size = stride * stride
+            size_r = size / float(r)
+            ws = jnp.sqrt(size_r)
+            hs = ws * float(r)
+            ws, hs = ws * float(s) / stride, hs * float(s) / stride
+            base.append([-(ws * stride - stride) / 2,
+                         -(hs * stride - stride) / 2,
+                         (ws * stride - stride) / 2 + stride - 1,
+                         (hs * stride - stride) / 2 + stride - 1])
+    base = jnp.asarray(base, cls_prob.dtype)          # (A, 4)
+    sx = jnp.arange(w, dtype=cls_prob.dtype) * stride
+    sy = jnp.arange(h, dtype=cls_prob.dtype) * stride
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([gx, gy, gx, gy], axis=-1).reshape(-1, 4)  # (HW, 4)
+    anchors = (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+
+    scores = cls_prob[:, a:, :, :].transpose(0, 2, 3, 1).reshape(b, -1)
+    deltas = bbox_pred.transpose(0, 2, 3, 1).reshape(b, -1, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    cx = deltas[..., 0] * aw + acx
+    cy = deltas[..., 1] * ah + acy
+    pw = jnp.exp(jnp.clip(deltas[..., 2], -10, 10)) * aw
+    ph = jnp.exp(jnp.clip(deltas[..., 3], -10, 10)) * ah
+    x1 = cx - 0.5 * pw
+    y1 = cy - 0.5 * ph
+    x2 = cx + 0.5 * pw
+    y2 = cy + 0.5 * ph
+    imh = im_info[:, 0:1]
+    imw = im_info[:, 1:2]
+    x1 = jnp.clip(x1, 0, imw - 1)
+    x2 = jnp.clip(x2, 0, imw - 1)
+    y1 = jnp.clip(y1, 0, imh - 1)
+    y2 = jnp.clip(y2, 0, imh - 1)
+    # min size scales with the image scale factor (reference proposal.cc:
+    # min_size * im_info[2])
+    min_size = float(rpn_min_size) * im_info[:, 2:3]
+    valid = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1) >= min_size)
+    scores = jnp.where(valid, scores, -1.0)
+
+    rows = jnp.stack([scores, x1, y1, x2, y2], axis=-1)  # (B, N, 5)
+    pre = min(int(rpn_pre_nms_top_n), rows.shape[1])
+    top = jax.vmap(lambda r: r[jnp.argsort(-r[:, 0])[:pre]])(rows)
+    kept = box_nms(top, overlap_thresh=float(threshold), coord_start=1,
+                   score_index=0, id_index=-1, topk=-1)
+    post = int(rpn_post_nms_top_n)
+
+    def finalize(r, bi):
+        order = jnp.argsort(-r[:, 0])
+        r = r[order][:post]
+        best = r[0]
+        ok = r[:, 0] > 0
+        r = jnp.where(ok[:, None], r, best[None, :])
+        idx = jnp.full((post, 1), bi, r.dtype)
+        return jnp.concatenate([idx, r[:, 1:5]], axis=-1), r[:, 0:1]
+
+    rois, scr = jax.vmap(finalize)(kept, jnp.arange(b, dtype=cls_prob.dtype))
+    rois = rois.reshape(-1, 5)
+    if output_score:
+        return rois, scr.reshape(-1, 1)
+    return rois
+
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=0.0625, output_dim=1,
+                  pooled_size=7, group_size=0, **_):
+    """Position-sensitive ROI pooling (reference: contrib/psroi_pooling.cc):
+    data (B, output_dim*g*g, H, W), rois (R, 5) -> (R, output_dim, g, g)."""
+    g = int(group_size) or int(pooled_size)
+    p = int(pooled_size)
+    od = int(output_dim)
+    bsz, _, hh, ww = data.shape
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        img = data[bi]                                  # (od*g*g, H, W)
+        out = jnp.zeros((od, p, p), data.dtype)
+        for py in range(p):
+            for px in range(p):
+                by1 = y1 + rh * py / p
+                by2 = y1 + rh * (py + 1) / p
+                bx1 = x1 + rw * px / p
+                bx2 = x1 + rw * (px + 1) / p
+                ymask = (jnp.arange(hh) >= jnp.floor(by1)) & \
+                        (jnp.arange(hh) < jnp.ceil(by2))
+                xmask = (jnp.arange(ww) >= jnp.floor(bx1)) & \
+                        (jnp.arange(ww) < jnp.ceil(bx2))
+                mask = ymask[:, None] & xmask[None, :]
+                cnt = jnp.maximum(mask.sum(), 1)
+                gy = min(py * g // p, g - 1)
+                gx = min(px * g // p, g - 1)
+                chans = img[(jnp.arange(od) * g + gy) * g + gx]  # (od,H,W)
+                pooled = (chans * mask[None]).sum(axis=(1, 2)) / cnt
+                out = out.at[:, py, px].set(pooled.astype(data.dtype))
+        return out
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=1, num_group=1,
+                           num_deformable_group=1, no_bias=False, **_):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc):
+    per-output-position learned sampling offsets, bilinear sampling,
+    then an ordinary conv contraction.  Implemented as gather+matmul —
+    the im2col form, which XLA maps onto the MXU."""
+    b, cin, h, w = data.shape
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    ndg = int(num_deformable_group)
+    ng = int(num_group)
+    assert cin % (ndg * 1) == 0 and cin % ng == 0
+
+    # sampling grid: base positions + per-deformable-group offsets
+    # (B, ndg*2*K, OH, OW), K=kh*kw
+    gy = jnp.arange(oh) * sh - ph
+    gx = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = gy[:, None, None, None] + ky[None, None, :, None]  # OH,1,kh,1
+    base_x = gx[None, :, None, None] + kx[None, None, None, :]  # 1,OW,1,kw
+    base_y = jnp.broadcast_to(base_y, (oh, ow, kh, kw)).astype(data.dtype)
+    base_x = jnp.broadcast_to(base_x, (oh, ow, kh, kw)).astype(data.dtype)
+    off = offset.reshape(b, ndg, kh * kw, 2, oh, ow)
+    oy = off[:, :, :, 0].transpose(0, 1, 3, 4, 2) \
+        .reshape(b, ndg, oh, ow, kh, kw)
+    ox = off[:, :, :, 1].transpose(0, 1, 3, 4, 2) \
+        .reshape(b, ndg, oh, ow, kh, kw)
+    sy = base_y[None, None] + oy                    # (B,ndg,OH,OW,kh,kw)
+    sx = base_x[None, None] + ox
+
+    def bilinear(img, yy, xx):
+        """img (C, H, W); yy/xx (...) -> (C, ...)"""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            vals = img[:, yc, xc]
+            return jnp.where(inside[None], vals, 0.0)
+
+        return (at(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+                at(y0, x0 + 1) * ((1 - wy) * wx)[None] +
+                at(y0 + 1, x0) * (wy * (1 - wx))[None] +
+                at(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+    def one(img, yy, xx):
+        # img (C, H, W); yy/xx (ndg, OH, OW, kh, kw): each deformable
+        # group samples its channel slice with its own offsets
+        parts = []
+        cpg = cin // ndg
+        for gi in range(ndg):
+            parts.append(bilinear(img[gi * cpg:(gi + 1) * cpg],
+                                  yy[gi], xx[gi]))
+        return jnp.concatenate(parts, axis=0)  # (C, OH, OW, kh, kw)
+
+    cols = jax.vmap(one)(data, sy, sx)        # (B, C, OH, OW, kh, kw)
+    nf = int(num_filter)
+    if ng == 1:
+        cols2 = cols.transpose(0, 2, 3, 1, 4, 5).reshape(
+            b * oh * ow, cin * kh * kw)
+        wmat = weight.reshape(nf, -1)
+        out = (cols2 @ wmat.T).reshape(b, oh, ow, nf)
+    else:
+        # grouped contraction: each filter group sees its channel slice
+        cpg = cin // ng
+        fpg = nf // ng
+        outs = []
+        for gi in range(ng):
+            sl = cols[:, gi * cpg:(gi + 1) * cpg]
+            sl = sl.transpose(0, 2, 3, 1, 4, 5).reshape(
+                b * oh * ow, cpg * kh * kw)
+            wmat = weight[gi * fpg:(gi + 1) * fpg].reshape(fpg, -1)
+            outs.append((sl @ wmat.T).reshape(b, oh, ow, fpg))
+        out = jnp.concatenate(outs, axis=-1)
+    out = out.transpose(0, 3, 1, 2)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, **_):
+    """FlowNet correlation layer (reference: src/operator/correlation.cc):
+    per-displacement patch products between two feature maps.  Boundary
+    handling is by masking invalid overlap to zero (the reference pads by
+    pad_size and correlates — masked-roll is the static-shape equivalent,
+    so pad_size does not change the output size here); kernel_size>1
+    aggregates products over the kernel window; stride1 subsamples the
+    output grid."""
+    b, c, h, w = data1.shape
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    ks = int(kernel_size)
+    disp = list(range(-md, md + 1, s2))
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(data2, (dy, dx), axis=(2, 3))
+            ymask = jnp.zeros((h,), bool).at[max(dy, 0):h + min(dy, 0)] \
+                .set(True)
+            xmask = jnp.zeros((w,), bool).at[max(dx, 0):w + min(dx, 0)] \
+                .set(True)
+            mask = (ymask[:, None] & xmask[None, :]).astype(data1.dtype)
+            if is_multiply:
+                prod = (data1 * shifted).mean(axis=1)
+            else:  # reference: positive sum of absolute differences
+                prod = jnp.abs(data1 - shifted).mean(axis=1)
+            prod = prod * mask[None]
+            if ks > 1:  # aggregate over the kernel window
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, ks, ks), (1, 1, 1), "SAME")
+            outs.append(prod)
+    out = jnp.stack(outs, axis=1)
+    if s1 > 1:
+        out = out[:, :, ::s1, ::s1]
+    return out
+
+
+# ------------------------------------------------ aggregated optimizers
+
+
+def _multi_nout(attrs):
+    return int(attrs.get("num_weights", 1))
+
+
+@register("multi_sgd_update", num_outputs=_multi_nout)
+def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1, **_):
+    """Aggregated SGD over many (weight, grad) pairs in one launch
+    (reference: optimizer_op.cc multi_sgd_update,
+    MXNET_OPTIMIZER_AGGREGATION_SIZE) — under jit XLA fuses the loop."""
+    n = int(num_weights)
+    out = []
+    for i in range(n):
+        w, g = args[2 * i], args[2 * i + 1]
+        g = g * rescale_grad
+        if clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        out.append(w - float(lrs[i]) * (g + float(wds[i]) * w))
+    return tuple(out) if n > 1 else out[0]
+
+
+@register("multi_sgd_mom_update", num_outputs=lambda a: 2 * int(
+    a.get("num_weights", 1)))
+def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1, **_):
+    n = int(num_weights)
+    new_w, new_m = [], []
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        g = g * rescale_grad
+        if clip_gradient >= 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        nm = momentum * m - float(lrs[i]) * (g + float(wds[i]) * w)
+        new_w.append(w + nm)
+        new_m.append(nm)
+    return tuple(new_w + new_m)
+
+
+@register("group_adagrad_update", aliases=("_contrib_group_adagrad_update",),
+          num_outputs=2)
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5, **_):
+    """Row-wise (grouped) AdaGrad (reference: contrib/optimizer_op.cc
+    GroupAdagradUpdate): history accumulates the mean squared gradient
+    per row."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    gsq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_hist = history + gsq
+    scale = lr / (jnp.sqrt(new_hist) + epsilon)
+    shape = (-1,) + (1,) * (g.ndim - 1)
+    return weight - scale.reshape(shape) * g, new_hist
+
+
+OP_INPUT_NAMES.update({
+    "SVMOutput": ("data", "label"),
+    "_contrib_Proposal": ("cls_prob", "bbox_pred", "im_info"),
+    "_contrib_PSROIPooling": ("data", "rois"),
+    "_contrib_DeformableConvolution": ("data", "offset", "weight", "bias"),
+    "Correlation": ("data1", "data2"),
+    "group_adagrad_update": ("weight", "grad", "history"),
+})
+
+
+@register("multi_mp_sgd_update", num_outputs=lambda a: 2 * int(
+    a.get("num_weights", 1)))
+def multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1, **_):
+    """Multi-tensor multi-precision SGD (reference: optimizer_op.cc
+    multi_mp_sgd_update): inputs are (weight, grad, weight32)*N; fp32
+    master weights take the update, the low-precision copy mirrors it."""
+    n = int(num_weights)
+    new_w, new_w32 = [], []
+    for i in range(n):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        gf = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient >= 0:
+            gf = jnp.clip(gf, -clip_gradient, clip_gradient)
+        nw32 = w32 - float(lrs[i]) * (gf + float(wds[i]) * w32)
+        new_w32.append(nw32)
+        new_w.append(nw32.astype(w.dtype))
+    return tuple(new_w + new_w32)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=lambda a: 3 * int(
+    a.get("num_weights", 1)))
+def multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1, **_):
+    n = int(num_weights)
+    new_w, new_m, new_w32 = [], [], []
+    for i in range(n):
+        w, g, m, w32 = (args[4 * i], args[4 * i + 1], args[4 * i + 2],
+                        args[4 * i + 3])
+        gf = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient >= 0:
+            gf = jnp.clip(gf, -clip_gradient, clip_gradient)
+        nm = momentum * m - float(lrs[i]) * (gf + float(wds[i]) * w32)
+        nw32 = w32 + nm
+        new_w.append(nw32.astype(w.dtype))
+        new_m.append(nm)
+        new_w32.append(nw32)
+    return tuple(new_w + new_m + new_w32)
+
+
+@register("cast_storage_op", aliases=("cast_storage",))
+def cast_storage_op(data, stype="default", **_):
+    """Storage-type cast op (reference: tensor/cast_storage.cc).  Dense
+    jax arrays are the only device representation — the NDArray-level
+    sparse wrappers live in ndarray/sparse.py cast_storage — so at op
+    level every stype shares the dense buffer: identity."""
+    return data
+
+
+@register("sparse_retain", aliases=("_sparse_retain",))
+def sparse_retain_op(data, indices, **_):
+    """Row retain (reference: sparse_retain.cc): zero every row of
+    `data` whose index is not in `indices` (dense formulation of the
+    row_sparse retain; ndarray/sparse.py retain keeps the aux form)."""
+    keep = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return data * keep.reshape(shape).astype(data.dtype)
+
+
+# v1 / contrib aliases resolving to the modern implementations
+from .registry import _OP_REGISTRY as _REG
+
+for _alias, _target in (("BatchNorm_v1", "BatchNorm"),
+                        ("Convolution_v1", "Convolution"),
+                        ("Pooling_v1", "Pooling"),
+                        ("CuDNNBatchNorm", "BatchNorm"),
+                        ("_contrib_adamw_update", "adamw_update"),
+                        ("_contrib_mp_adamw_update", "adamw_update"),
+                        ("_contrib_SparseEmbedding", "Embedding"),
+                        ("_contrib_index_copy", "index_copy")):
+    if _target in _REG:
+        _REG.setdefault(_alias, _REG[_target])

@@ -1,0 +1,34 @@
+"""Linear-algebra operator additions (reference: src/operator/tensor/
+la_op.cc).  The core set (gemm/gemm2/potrf/potri/trmm/trsm/syrk/
+sumlogdiag/extractdiag/makediag) lives in matrix.py; this module adds
+the two missing factorizations and the reference's underscore aliases
+(`_linalg_*`, the registered nnvm names)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import _OP_REGISTRY, register
+
+
+@register("linalg_syevd", aliases=("_linalg_syevd",), num_outputs=2)
+def linalg_syevd(A, **_):
+    """Symmetric eigendecomposition; returns (U, lambda) with
+    A = Uᵀ diag(lambda) U (reference syevd: rows of U are eigenvectors)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_gelqf", aliases=("_linalg_gelqf",), num_outputs=2)
+def linalg_gelqf(A, **_):
+    """LQ factorization A = L Q with Q orthonormal rows (reference
+    gelqf); computed via QR of Aᵀ."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+# underscore aliases for the core set registered in matrix.py
+for _name in ("linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_potri",
+              "linalg_trmm", "linalg_trsm", "linalg_syrk",
+              "linalg_sumlogdiag", "linalg_extractdiag", "linalg_makediag"):
+    _OP_REGISTRY.setdefault("_" + _name, _OP_REGISTRY[_name])

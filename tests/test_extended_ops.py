@@ -1,0 +1,256 @@
+"""Tests for the long-tail ops (reference: test_operator.py linalg/
+histogram/split sections, test_contrib_operator.py fft/proposal/
+deformable, svm tests)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import apply_op
+
+
+def _n(x):
+    return np.asarray(x)
+
+
+class TestLinalg:
+    rng = np.random.RandomState(0)
+
+    def _spd(self, n=4, b=()):
+        a = self.rng.rand(*(b + (n, n))).astype(np.float64)
+        return (a @ a.swapaxes(-1, -2) + n * np.eye(n)).astype(np.float32)
+
+    def test_gemm(self):
+        A = self.rng.rand(2, 3, 4).astype(np.float32)
+        B = self.rng.rand(2, 4, 5).astype(np.float32)
+        C = self.rng.rand(2, 3, 5).astype(np.float32)
+        got = _n(apply_op("linalg_gemm", A, B, C, alpha=2.0, beta=0.5))
+        assert np.allclose(got, 2 * A @ B + 0.5 * C, atol=1e-5)
+        got2 = _n(apply_op("linalg_gemm2", A.swapaxes(-1, -2), B,
+                           transpose_a=True))
+        assert np.allclose(got2, A @ B, atol=1e-5)
+
+    def test_potrf_potri(self):
+        A = self._spd()
+        L = _n(apply_op("linalg_potrf", A))
+        assert np.allclose(L @ L.T, A, atol=1e-4)
+        Ainv = _n(apply_op("linalg_potri", L))
+        assert np.allclose(Ainv, np.linalg.inv(A), atol=1e-4)
+
+    def test_trmm_trsm(self):
+        A = np.tril(self.rng.rand(4, 4).astype(np.float32)) + 2 * np.eye(
+            4, dtype=np.float32)
+        B = self.rng.rand(4, 3).astype(np.float32)
+        got = _n(apply_op("linalg_trmm", A, B))
+        assert np.allclose(got, np.tril(A) @ B, atol=1e-5)
+        X = _n(apply_op("linalg_trsm", A, B))
+        assert np.allclose(np.tril(A) @ X, B, atol=1e-4)
+
+    def test_syrk_syevd_gelqf_sumlogdiag(self):
+        A = self.rng.rand(3, 5).astype(np.float32)
+        assert np.allclose(_n(apply_op("linalg_syrk", A)), A @ A.T,
+                           atol=1e-5)
+        S = self._spd()
+        U, lam = apply_op("linalg_syevd", S)
+        U, lam = _n(U), _n(lam)
+        assert np.allclose(U.T @ np.diag(lam) @ U, S, atol=1e-3)
+        L, Q = apply_op("linalg_gelqf", A)
+        L, Q = _n(L), _n(Q)
+        assert np.allclose(L @ Q, A, atol=1e-5)
+        assert np.allclose(Q @ Q.T, np.eye(3), atol=1e-5)
+        tri = np.triu(self._spd())
+        want = np.log(np.diag(tri)).sum()
+        assert np.allclose(_n(apply_op("linalg_sumlogdiag", tri)), want,
+                           atol=1e-5)
+
+
+def test_histogram():
+    x = np.array([0.0, 0.1, 0.5, 0.9, 1.0, 2.0], np.float32)
+    counts, edges = apply_op("histogram", x, bin_cnt=4, range=(0.0, 1.0))
+    assert _n(counts).sum() == 5  # 2.0 out of range
+    want, _ = np.histogram(x, bins=4, range=(0, 1))
+    assert np.array_equal(_n(counts), want)
+
+
+def test_histogram_nonuniform_edges():
+    x = np.array([0.5, 2.0, 5.0, 9.0], np.float32)
+    edges = np.array([0.0, 1.0, 10.0], np.float32)
+    counts, _ = apply_op("histogram", x, bins=edges)
+    want, _ = np.histogram(x, bins=edges)
+    assert np.array_equal(_n(counts), want)
+
+
+def test_linalg_aliases_and_makediag_offset():
+    from mxnet_tpu.ops.registry import get
+
+    for name in ("_linalg_gemm2", "_linalg_potrf", "_linalg_syevd"):
+        get(name)  # registered
+    out = _n(apply_op("linalg_makediag", np.array([1.0, 2.0], np.float32),
+                      offset=1))
+    want = np.diag(np.array([1.0, 2.0]), k=1)
+    assert np.array_equal(out, want)
+
+
+def test_ravel_unravel():
+    shape = (3, 4, 5)
+    idx = np.array([[1, 2], [0, 3], [4, 1]], np.int64)
+    flat = _n(apply_op("ravel_multi_index", idx, shape=shape))
+    want = np.ravel_multi_index(tuple(idx), shape)
+    assert np.array_equal(flat, want)
+    back = _n(apply_op("unravel_index", flat, shape=shape))
+    assert np.array_equal(back, idx)
+
+
+def test_split_v2():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    parts = apply_op("split_v2", x, indices=(2, 5), axis=1)
+    assert [_n(p).shape[1] for p in parts] == [2, 3, 1]
+    parts2 = apply_op("split_v2", x, sections=2, axis=0)
+    assert np.array_equal(_n(parts2[0]), x[:2])
+
+
+def test_svm_output_grads():
+    """L2-SVM gradient: correct-class margin satisfied -> zero grad."""
+    import jax
+
+    x = np.array([[3.0, 0.0, 0.0], [0.0, 0.5, 1.0]], np.float32)
+    y = np.array([0, 2], np.float32)
+    from mxnet_tpu.ops.extended import svm_output
+
+    g = np.asarray(jax.grad(lambda x: svm_output(x, y).sum())(x))
+    assert np.allclose(g[0], 0)      # margin 1 met for row 0 (3 vs 0)
+    assert g[1].any()                # row 1 violates margin (1 vs 0.5)
+
+
+def test_image_ops():
+    img = (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8)
+    t = _n(apply_op("image_to_tensor", img))
+    assert t.shape == (3, 8, 6) and t.max() <= 1.0
+    norm = _n(apply_op("image_normalize", t, mean=(0.5,), std=(0.5,)))
+    assert np.allclose(norm, (t - 0.5) / 0.5, atol=1e-6)
+    r = _n(apply_op("image_resize", img, size=(3, 4)))
+    assert r.shape == (4, 3, 3)
+
+
+def test_fft_roundtrip():
+    x = np.random.RandomState(1).rand(2, 8).astype(np.float32)
+    f = apply_op("_contrib_fft", x)
+    back = _n(apply_op("_contrib_ifft", _n(f))) / 8
+    assert np.allclose(back, x, atol=1e-5)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1.0, -1.0, 1.0], np.float32)
+    out = _n(apply_op("_contrib_count_sketch", x, h, s, out_dim=2))
+    assert np.allclose(out, [[4.0, -2.0]])
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1], [0.8, 0.95]], np.float32)
+    rows, cols = apply_op("_contrib_bipartite_matching", score,
+                          threshold=0.5)
+    # greedy: (1,1)=0.95 first, then (0,0)=0.9
+    assert _n(rows).tolist() == [0.0, 1.0]
+    assert _n(cols).tolist() == [0.0, 1.0]
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    b, a, h, w = 2, 6, 4, 4  # 2 scales x 3 ratios... use scales/ratios->6
+    cls_prob = rng.rand(b, 2 * a, h, w).astype(np.float32)
+    bbox_pred = (rng.rand(b, 4 * a, h, w).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois = _n(apply_op("_contrib_Proposal", cls_prob, bbox_pred, im_info,
+                       scales=(4, 8), ratios=(0.5, 1, 2),
+                       rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                       feature_stride=16))
+    assert rois.shape == (20, 5)
+    assert set(rois[:, 0].astype(int)) == {0, 1}
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 63).all()
+
+
+def test_psroi_pooling():
+    b, od, g, h, w = 1, 2, 2, 8, 8
+    data = np.zeros((b, od * g * g, h, w), np.float32)
+    for c in range(od * g * g):
+        data[0, c] = c  # constant planes -> pooled value == channel index
+    rois = np.array([[0, 0, 0, 63, 63]], np.float32)  # whole image @ 1/8
+    out = _n(apply_op("_contrib_PSROIPooling", data, rois,
+                      spatial_scale=0.125, output_dim=od, pooled_size=g,
+                      group_size=g))
+    assert out.shape == (1, od, g, g)
+    # out[0, d, py, px] pools channel (d*g + gy)*g + gx
+    for d in range(od):
+        for py in range(g):
+            for px in range(g):
+                assert out[0, d, py, px] == (d * g + py) * g + px
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    wgt = rng.rand(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    got = _n(apply_op("_contrib_DeformableConvolution", x, offset, wgt,
+                      np.zeros(3, np.float32), kernel=(3, 3),
+                      num_filter=3, no_bias=True))
+    want = _n(apply_op("Convolution", x, wgt, np.zeros(3, np.float32),
+                       kernel=(3, 3), num_filter=3, no_bias=True))
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_correlation_self_identity():
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out = _n(apply_op("Correlation", x, x, max_displacement=1))
+    assert out.shape == (1, 9, 6, 6)
+    # center displacement (dy=dx=0) = mean over channels of x*x
+    center = out[0, 4]
+    assert np.allclose(center, (x[0] ** 2).mean(axis=0), atol=1e-5)
+    # shifted planes are masked to the valid overlap region
+    assert out[0, 0, -1, :].max() == 0.0  # dy=-1: wrapped last row masked
+
+
+def test_correlation_subtract_and_stride():
+    x = np.ones((1, 2, 4, 4), np.float32)
+    y = np.zeros((1, 2, 4, 4), np.float32)
+    out = _n(apply_op("Correlation", x, y, max_displacement=1,
+                      is_multiply=False))
+    # reference subtract mode: POSITIVE mean |a-b| (= 1 here, interior)
+    assert out[0, 4, 1, 1] == 1.0
+    strided = _n(apply_op("Correlation", x, x, max_displacement=1,
+                          stride1=2))
+    assert strided.shape == (1, 9, 2, 2)
+
+
+def test_deformable_conv_groups():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    wgt = rng.rand(4, 2, 3, 3).astype(np.float32)  # num_group=2
+    offset = np.zeros((1, 2 * 2 * 9, 4, 4), np.float32)  # ndg=2
+    got = _n(apply_op("_contrib_DeformableConvolution", x, offset, wgt,
+                      np.zeros(4, np.float32), kernel=(3, 3), num_filter=4,
+                      num_group=2, num_deformable_group=2, no_bias=True))
+    want = _n(apply_op("Convolution", x, wgt, np.zeros(4, np.float32),
+                       kernel=(3, 3), num_filter=4, num_group=2,
+                       no_bias=True))
+    assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_multi_sgd_and_group_adagrad():
+    rng = np.random.RandomState(0)
+    ws = [rng.rand(3, 2).astype(np.float32) for _ in range(2)]
+    gs = [rng.rand(3, 2).astype(np.float32) for _ in range(2)]
+    outs = apply_op("multi_sgd_update", ws[0], gs[0], ws[1], gs[1],
+                    lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    assert np.allclose(_n(outs[0]), ws[0] - 0.1 * gs[0], atol=1e-6)
+    assert np.allclose(_n(outs[1]), ws[1] - 0.2 * gs[1], atol=1e-6)
+
+    hist = np.zeros(3, np.float32)
+    new_w, new_h = apply_op("group_adagrad_update", ws[0], gs[0], hist,
+                            lr=0.1)
+    assert (_n(new_h) > 0).all()
+    scale = 0.1 / (np.sqrt((gs[0] ** 2).mean(axis=1)) + 1e-5)
+    assert np.allclose(_n(new_w), ws[0] - scale[:, None] * gs[0], atol=1e-5)
