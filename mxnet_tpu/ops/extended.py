@@ -36,8 +36,10 @@ def histogram(data, bins=None, bin_cnt=10, range=None, **_):
         edges = jnp.linspace(lo, hi, cnt + 1)
     idx = jnp.clip(jnp.searchsorted(edges, x, side="right") - 1, 0, cnt - 1)
     in_range = (x >= edges[0]) & (x <= edges[-1])
-    counts = jnp.zeros(cnt, jnp.int64).at[idx].add(
-        in_range.astype(jnp.int64))
+    # int32 counts: jax x64 is off framework-wide (the reference emits
+    # int64; values match, dtype differs)
+    counts = jnp.zeros(cnt, jnp.int32).at[idx].add(
+        in_range.astype(jnp.int32))
     return counts, edges
 
 
@@ -249,8 +251,10 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         for s in scales:
             size = stride * stride
             size_r = size / float(r)
-            ws = jnp.sqrt(size_r)
-            hs = ws * float(r)
+            # reference GenerateAnchors rounds w/h before scaling —
+            # pretrained RPNs decode against these exact anchors
+            ws = jnp.round(jnp.sqrt(size_r))
+            hs = jnp.round(ws * float(r))
             ws, hs = ws * float(s) / stride, hs * float(s) / stride
             base.append([-(ws * stride - stride) / 2,
                          -(hs * stride - stride) / 2,
@@ -464,10 +468,13 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     outs = []
     for dy in disp:
         for dx in disp:
-            shifted = jnp.roll(data2, (dy, dx), axis=(2, 3))
-            ymask = jnp.zeros((h,), bool).at[max(dy, 0):h + min(dy, 0)] \
+            # channel (dy, dx) correlates data1[y, x] with
+            # data2[y+dy, x+dx] (reference: x2 = x1 + displacement), so
+            # data2 rolls by the NEGATED displacement
+            shifted = jnp.roll(data2, (-dy, -dx), axis=(2, 3))
+            ymask = jnp.zeros((h,), bool).at[max(-dy, 0):h + min(-dy, 0)] \
                 .set(True)
-            xmask = jnp.zeros((w,), bool).at[max(dx, 0):w + min(dx, 0)] \
+            xmask = jnp.zeros((w,), bool).at[max(-dx, 0):w + min(-dx, 0)] \
                 .set(True)
             mask = (ymask[:, None] & xmask[None, :]).astype(data1.dtype)
             if is_multiply:
@@ -475,9 +482,10 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
             else:  # reference: positive sum of absolute differences
                 prod = jnp.abs(data1 - shifted).mean(axis=1)
             prod = prod * mask[None]
-            if ks > 1:  # aggregate over the kernel window
+            if ks > 1:  # aggregate + normalize over the kernel window
                 prod = lax.reduce_window(
-                    prod, 0.0, lax.add, (1, ks, ks), (1, 1, 1), "SAME")
+                    prod, 0.0, lax.add, (1, ks, ks), (1, 1, 1),
+                    "SAME") / float(ks * ks)
             outs.append(prod)
     out = jnp.stack(outs, axis=1)
     if s1 > 1:
@@ -615,15 +623,43 @@ def sparse_retain_op(data, indices, **_):
     return data * keep.reshape(shape).astype(data.dtype)
 
 
-# v1 / contrib aliases resolving to the modern implementations
+@register("_contrib_adamw_update", num_outputs=3)
+def contrib_adamw_update(weight, grad, mean, var, rescale_grad, lr=0.001,
+                         beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                         eta=1.0, clip_gradient=-1.0, **_):
+    """reference: contrib/adamw.cc — rescale_grad is a TENSOR input
+    (so loss-scaling can change per step without recompiling)."""
+    from .optimizer_ops import adamw_update
+
+    return adamw_update(weight, grad, mean, var, lr=lr, beta1=beta1,
+                        beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+                        rescale_grad=jnp.reshape(rescale_grad, ()),
+                        clip_gradient=clip_gradient)
+
+
+@register("_contrib_mp_adamw_update", num_outputs=4)
+def contrib_mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                            lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                            wd=0.0, eta=1.0, clip_gradient=-1.0, **_):
+    """Multi-precision AdamW: fp32 master weights take the update."""
+    from .optimizer_ops import adamw_update
+
+    nw32, nmean, nvar = adamw_update(
+        weight32, grad.astype(jnp.float32), mean, var, lr=lr, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, wd=wd, eta=eta,
+        rescale_grad=jnp.reshape(rescale_grad, ()).astype(jnp.float32),
+        clip_gradient=clip_gradient)
+    return nw32.astype(weight.dtype), nmean, nvar, nw32
+
+
+# v1 / contrib aliases resolving to the modern implementations (only
+# where the tensor-input arity actually matches)
 from .registry import _OP_REGISTRY as _REG
 
 for _alias, _target in (("BatchNorm_v1", "BatchNorm"),
                         ("Convolution_v1", "Convolution"),
                         ("Pooling_v1", "Pooling"),
                         ("CuDNNBatchNorm", "BatchNorm"),
-                        ("_contrib_adamw_update", "adamw_update"),
-                        ("_contrib_mp_adamw_update", "adamw_update"),
                         ("_contrib_SparseEmbedding", "Embedding"),
                         ("_contrib_index_copy", "index_copy")):
     if _target in _REG:

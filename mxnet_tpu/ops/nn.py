@@ -361,9 +361,14 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
                        axis=red)
         if axis_name:
             var = lax.pmean(var, axis_name)
-    inv = lax.rsqrt(var + eps)
-    out = (data - _expand(mean, ax, data.ndim)) * _expand(g * inv, ax, data.ndim) \
-        + _expand(beta, ax, data.ndim)
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    # scale/shift computed in fp32 (gamma/beta stay fp32 under mixed
+    # precision) then applied in the DATA dtype so bf16 activations do
+    # not get promoted back to fp32 downstream
+    scale = (g.astype(jnp.float32) * inv).astype(data.dtype)
+    shift = beta.astype(data.dtype)
+    out = (data - _expand(mean.astype(data.dtype), ax, data.ndim)) * \
+        _expand(scale, ax, data.ndim) + _expand(shift, ax, data.ndim)
     if output_mean_var:
         return out, mean, var
     return out

@@ -209,8 +209,45 @@ def test_correlation_self_identity():
     # center displacement (dy=dx=0) = mean over channels of x*x
     center = out[0, 4]
     assert np.allclose(center, (x[0] ** 2).mean(axis=0), atol=1e-5)
-    # shifted planes are masked to the valid overlap region
-    assert out[0, 0, -1, :].max() == 0.0  # dy=-1: wrapped last row masked
+    # shifted planes are masked to the valid overlap region: plane 0 is
+    # displacement (-1,-1) -> samples data2[y-1, x-1], invalid at y=0
+    assert out[0, 0, 0, :].max() == 0.0
+
+
+def test_correlation_displacement_direction():
+    """Channel for displacement d must correlate data1[x] with
+    data2[x+d] (reference x2 = x1 + displacement)."""
+    x1 = np.zeros((1, 1, 3, 3), np.float32)
+    x2 = np.zeros((1, 1, 3, 3), np.float32)
+    x1[0, 0, 1, 1] = 1.0
+    x2[0, 0, 1, 2] = 1.0  # feature one step RIGHT in the second image
+    out = _n(apply_op("Correlation", x1, x2, max_displacement=1))
+    # displacement (dy=0, dx=+1) is channel index 5 of the 3x3 grid
+    assert out[0, 5, 1, 1] == 1.0
+    assert out[0, 3, 1, 1] == 0.0  # (0,-1) must NOT fire
+
+
+def test_correlation_kernel_normalization():
+    x = np.ones((1, 2, 5, 5), np.float32)
+    out = _n(apply_op("Correlation", x, x, max_displacement=0,
+                      kernel_size=3))
+    # interior: mean over channels (1) aggregated over 3x3 / 9 = 1
+    assert np.allclose(out[0, 0, 2, 2], 1.0)
+
+
+def test_contrib_adamw_tensor_rescale():
+    w = np.ones((2, 2), np.float32)
+    g = np.ones((2, 2), np.float32) * 0.1
+    z = np.zeros((2, 2), np.float32)
+    out, m, v = apply_op("_contrib_adamw_update", w, g, z, z,
+                         np.array([1.0], np.float32), lr=0.01)
+    delta = np.abs(_n(out) - w).max()
+    assert 0 < delta < 0.2, delta  # a sane adam-sized step, not garbage
+    outs = apply_op("_contrib_mp_adamw_update", w.astype(np.float16),
+                    g.astype(np.float16), z, z, w,
+                    np.array([1.0], np.float32), lr=0.01)
+    assert outs[0].dtype == np.float16
+    assert np.allclose(_n(outs[3]), _n(outs[0]), atol=1e-3)
 
 
 def test_correlation_subtract_and_stride():
