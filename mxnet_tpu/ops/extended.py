@@ -67,10 +67,9 @@ def unravel_index(data, shape=(), **_):
 
 
 def _split_v2_nout(attrs):
-    iob = attrs.get("indices", ())
     if attrs.get("sections", 0):
         return int(attrs["sections"])
-    return len(tuple(iob)) + 1
+    return len(tuple(attrs.get("indices", ()))) + 1
 
 
 @register("split_v2", aliases=("_split_v2",), num_outputs=_split_v2_nout)
@@ -376,7 +375,7 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
 
     ndg = int(num_deformable_group)
     ng = int(num_group)
-    assert cin % (ndg * 1) == 0 and cin % ng == 0
+    assert cin % ndg == 0 and cin % ng == 0
 
     # sampling grid: base positions + per-deformable-group offsets
     # (B, ndg*2*K, OH, OW), K=kh*kw
@@ -654,7 +653,7 @@ def contrib_mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
 
 # v1 / contrib aliases resolving to the modern implementations (only
 # where the tensor-input arity actually matches)
-from .registry import _OP_REGISTRY as _REG
+from .registry import alias as _alias_op
 
 for _alias, _target in (("BatchNorm_v1", "BatchNorm"),
                         ("Convolution_v1", "Convolution"),
@@ -662,5 +661,4 @@ for _alias, _target in (("BatchNorm_v1", "BatchNorm"),
                         ("CuDNNBatchNorm", "BatchNorm"),
                         ("_contrib_SparseEmbedding", "Embedding"),
                         ("_contrib_index_copy", "index_copy")):
-    if _target in _REG:
-        _REG.setdefault(_alias, _REG[_target])
+    _alias_op(_alias, _target)

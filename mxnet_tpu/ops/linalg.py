@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import _OP_REGISTRY, register
+from .registry import alias, register
 
 
 @register("linalg_syevd", aliases=("_linalg_syevd",), num_outputs=2)
@@ -31,4 +31,4 @@ def linalg_gelqf(A, **_):
 for _name in ("linalg_gemm", "linalg_gemm2", "linalg_potrf", "linalg_potri",
               "linalg_trmm", "linalg_trsm", "linalg_syrk",
               "linalg_sumlogdiag", "linalg_extractdiag", "linalg_makediag"):
-    _OP_REGISTRY.setdefault("_" + _name, _OP_REGISTRY[_name])
+    alias("_" + _name, _name)

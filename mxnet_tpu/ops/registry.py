@@ -158,6 +158,15 @@ def get(name):
     return op
 
 
+def alias(name, target):
+    """Register `name` as another name for an existing op (no-op if taken
+    or if `target` is absent).  Use only when the tensor-input arity
+    matches — a mismatched alias silently mis-binds positional inputs."""
+    op = _OP_REGISTRY.get(target)
+    if op is not None:
+        _OP_REGISTRY.setdefault(name, op)
+
+
 def list_ops():
     return sorted(set(o.name for o in _OP_REGISTRY.values()))
 
