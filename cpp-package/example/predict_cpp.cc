@@ -1,0 +1,47 @@
+// C++ inference example over mxtpu-cpp (reference: cpp-package/example/
+// inference/).  Loads an exported model, runs a batch, prints outputs,
+// then reshapes to a new batch size.
+//
+//   g++ -std=c++17 predict_cpp.cc -I../include -L../../mxnet_tpu/native \
+//       -lmxtpu -Wl,-rpath,../../mxnet_tpu/native -o predict_cpp
+//   MXTPU_PYTHONPATH=<repo>:<site-packages...> ./predict_cpp \
+//       model-symbol.json model-0000.params
+#include <fstream>
+#include <iostream>
+#include <sstream>
+
+#include "mxtpu-cpp/mxtpu.hpp"
+
+static std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::cerr << "usage: " << argv[0] << " <symbol.json> <params>\n";
+    return 2;
+  }
+  try {
+    mxtpu::cpp::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                               {{"data", {2, 3}}});
+    std::vector<float> input{0.1f, 0.2f, 0.3f, 0.4f, 0.5f, 0.6f};
+    pred.SetInput("data", input);
+    pred.Forward();
+    for (float v : pred.GetOutput(0)) std::cout << v << " ";
+    std::cout << "\n";
+
+    auto big = pred.Reshape({{"data", {4, 3}}});
+    std::vector<float> input2(12, 0.5f);
+    big.SetInput("data", input2);
+    big.Forward();
+    std::cout << "reshaped output elements: " << big.GetOutput(0).size()
+              << "\n";
+  } catch (const mxtpu::cpp::Error& e) {
+    std::cerr << "mxtpu error: " << e.what() << "\n";
+    return 1;
+  }
+  return 0;
+}

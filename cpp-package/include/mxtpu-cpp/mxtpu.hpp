@@ -1,0 +1,247 @@
+// mxtpu-cpp — header-only C++ API over the libmxtpu C ABI.
+//
+// Reference: cpp-package/ (C++ bindings generated over include/mxnet/
+// c_api.h + c_predict_api.h).  TPU-native form: the tensor/compute API
+// lives in Python/jax (XLA is the runtime); what C++ consumers need is
+// the deployment predictor, the host dependency engine, and RecordIO —
+// exactly the libmxtpu surface, wrapped here with RAII + exceptions.
+//
+// Build: no dependencies beyond libmxtpu.so:
+//   g++ -std=c++17 app.cc -I cpp-package/include -L mxnet_tpu/native \
+//       -lmxtpu -Wl,-rpath,mxnet_tpu/native
+// For Predictor in a non-Python process, set MXTPU_PYTHONPATH (see
+// native/src/predict.cc).
+#ifndef MXTPU_CPP_MXTPU_HPP_
+#define MXTPU_CPP_MXTPU_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+const char* MXTPUGetLastError(void);
+int MXTPUEngineCreate(int n_workers, int io_workers, void** out);
+int MXTPUEngineFree(void* h);
+int MXTPUEngineNewVar(void* h, uint64_t* out);
+int MXTPUEngineDelVar(void* h, uint64_t var);
+typedef int (*MXTPUEngineOpFn)(void* ctx, uint64_t op_id);
+int MXTPUEnginePush(void* h, MXTPUEngineOpFn fn, void* ctx,
+                    const uint64_t* cvars, int ncv, const uint64_t* mvars,
+                    int nmv, int prop, const char* name, uint64_t* out_op_id);
+int MXTPUEngineOnComplete(void* h, uint64_t op_id);
+int MXTPUEngineOnCompleteError(void* h, uint64_t op_id, const char* msg);
+int MXTPUEngineWaitForVar(void* h, uint64_t var);
+int MXTPUEngineWaitAll(void* h);
+int MXTPURecordReaderCreate(const char* path, uint64_t chunk, int part,
+                            int nparts, void** out);
+int MXTPURecordReaderNext(void* h, const uint8_t** data, uint32_t* size);
+int MXTPURecordReaderReset(void* h);
+int MXTPURecordReaderFree(void* h);
+int MXTPURecordWriterCreate(const char* path, void** out);
+int MXTPURecordWriterWrite(void* h, const uint8_t* data, uint32_t size,
+                           uint64_t* out_pos);
+int MXTPURecordWriterFree(void* h);
+int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
+                    uint64_t param_size, int dev_type, int dev_id,
+                    uint32_t num_input_nodes, const char** input_keys,
+                    const uint32_t* input_shape_indptr,
+                    const uint32_t* input_shape_data, void** out);
+int MXTPUPredSetInput(void* h, const char* key, const float* data,
+                      uint64_t size);
+int MXTPUPredForward(void* h);
+int MXTPUPredGetOutputShape(void* h, uint32_t index,
+                            const uint32_t** shape_data, uint32_t* shape_ndim);
+int MXTPUPredGetOutput(void* h, uint32_t index, float* data, uint64_t size);
+int MXTPUPredReshape(uint32_t num_input_nodes, const char** input_keys,
+                     const uint32_t* input_shape_indptr,
+                     const uint32_t* input_shape_data, void* h, void** out);
+int MXTPUPredFree(void* h);
+}
+
+namespace mxtpu {
+namespace cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) throw Error(MXTPUGetLastError());
+}
+
+enum class Device : int { kCPU = 1, kTPU = 2 };
+
+// ------------------------------------------------------------- Predictor --
+// Loads an exported model (symbol JSON + params blob) and runs forward
+// passes.  Mirrors cpp-package's Predictor idiom over c_predict_api.h.
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const std::map<std::string, std::vector<uint32_t>>& input_shapes,
+            Device dev = Device::kCPU, int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, sdata;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(sdata.size()));
+    }
+    Check(MXTPUPredCreate(symbol_json.c_str(), param_bytes.data(),
+                          param_bytes.size(), static_cast<int>(dev), dev_id,
+                          static_cast<uint32_t>(keys.size()), keys.data(),
+                          indptr.data(), sdata.data(), &handle_));
+  }
+  ~Predictor() {
+    if (handle_) MXTPUPredFree(handle_);
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+
+  void SetInput(const std::string& key, const float* data, uint64_t size) {
+    Check(MXTPUPredSetInput(handle_, key.c_str(), data, size));
+  }
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    SetInput(key, data.data(), data.size());
+  }
+  void Forward() { Check(MXTPUPredForward(handle_)); }
+
+  std::vector<uint32_t> GetOutputShape(uint32_t index) const {
+    const uint32_t* dims = nullptr;
+    uint32_t ndim = 0;
+    Check(MXTPUPredGetOutputShape(handle_, index, &dims, &ndim));
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+  std::vector<float> GetOutput(uint32_t index) const {
+    auto shape = GetOutputShape(index);
+    uint64_t n = 1;
+    for (uint32_t d : shape) n *= d;
+    std::vector<float> out(n);
+    Check(MXTPUPredGetOutput(handle_, index, out.data(), n));
+    return out;
+  }
+  // New predictor over the same weights with different input shapes.
+  Predictor Reshape(
+      const std::map<std::string, std::vector<uint32_t>>& input_shapes) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, sdata;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(sdata.size()));
+    }
+    void* nh = nullptr;
+    Check(MXTPUPredReshape(static_cast<uint32_t>(keys.size()), keys.data(),
+                           indptr.data(), sdata.data(), handle_, &nh));
+    return Predictor(nh);
+  }
+
+ private:
+  explicit Predictor(void* h) : handle_(h) {}
+  void* handle_ = nullptr;
+};
+
+// --------------------------------------------------------------- Engine --
+// Host-side async dependency engine (reference: include/mxnet/engine.h).
+class Engine {
+ public:
+  explicit Engine(int n_workers = 4, int io_workers = 1) {
+    Check(MXTPUEngineCreate(n_workers, io_workers, &handle_));
+  }
+  ~Engine() {
+    if (handle_) MXTPUEngineFree(handle_);
+  }
+  Engine(const Engine&) = delete;
+  Engine& operator=(const Engine&) = delete;
+
+  uint64_t NewVariable() {
+    uint64_t v = 0;
+    Check(MXTPUEngineNewVar(handle_, &v));
+    return v;
+  }
+  void DeleteVariable(uint64_t var) { Check(MXTPUEngineDelVar(handle_, var)); }
+  // fn runs on a worker thread; nonzero return marks the op failed and the
+  // error propagates to the next WaitForVar on its mutated vars.
+  uint64_t Push(MXTPUEngineOpFn fn, void* ctx,
+                const std::vector<uint64_t>& const_vars,
+                const std::vector<uint64_t>& mutable_vars,
+                const std::string& name = "", int property = 0) {
+    uint64_t op_id = 0;
+    Check(MXTPUEnginePush(handle_, fn, ctx, const_vars.data(),
+                          static_cast<int>(const_vars.size()),
+                          mutable_vars.data(),
+                          static_cast<int>(mutable_vars.size()), property,
+                          name.c_str(), &op_id));
+    return op_id;
+  }
+  void OnComplete(uint64_t op_id) {
+    Check(MXTPUEngineOnComplete(handle_, op_id));
+  }
+  void WaitForVar(uint64_t var) { Check(MXTPUEngineWaitForVar(handle_, var)); }
+  void WaitAll() { Check(MXTPUEngineWaitAll(handle_)); }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+// -------------------------------------------------------------- RecordIO --
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path, uint64_t chunk = 1 << 20,
+                        int part = 0, int nparts = 1) {
+    Check(MXTPURecordReaderCreate(path.c_str(), chunk, part, nparts,
+                                  &handle_));
+  }
+  ~RecordReader() {
+    if (handle_) MXTPURecordReaderFree(handle_);
+  }
+  RecordReader(const RecordReader&) = delete;
+  RecordReader& operator=(const RecordReader&) = delete;
+
+  // False at end of stream; the view is valid until the next call.
+  bool Next(std::string* out) {
+    const uint8_t* data = nullptr;
+    uint32_t size = 0;
+    Check(MXTPURecordReaderNext(handle_, &data, &size));
+    if (!data) return false;
+    out->assign(reinterpret_cast<const char*>(data), size);
+    return true;
+  }
+  void Reset() { Check(MXTPURecordReaderReset(handle_)); }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path) {
+    Check(MXTPURecordWriterCreate(path.c_str(), &handle_));
+  }
+  ~RecordWriter() {
+    if (handle_) MXTPURecordWriterFree(handle_);
+  }
+  RecordWriter(const RecordWriter&) = delete;
+  RecordWriter& operator=(const RecordWriter&) = delete;
+
+  uint64_t Write(const std::string& record) {
+    uint64_t pos = 0;
+    Check(MXTPURecordWriterWrite(
+        handle_, reinterpret_cast<const uint8_t*>(record.data()),
+        static_cast<uint32_t>(record.size()), &pos));
+    return pos;
+  }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MXTPU_HPP_

@@ -38,6 +38,19 @@ def _build():
                    capture_output=True)
 
 
+def _stale():
+    """True when the .so is missing or older than any native source."""
+    if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    srcs = [os.path.join(_NATIVE_DIR, "Makefile")]
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    for f in os.listdir(src_dir):
+        srcs.append(os.path.join(src_dir, f))
+    return any(os.path.getmtime(s) > so_mtime for s in srcs
+               if os.path.exists(s))
+
+
 def _declare(lib):
     u64 = ctypes.c_uint64
     vp = ctypes.c_void_p
@@ -80,6 +93,28 @@ def _declare(lib):
                                  ctypes.POINTER(ctypes.c_float)],
         "MXTPUPipelineReset": [vp],
         "MXTPUPipelineFree": [vp],
+        # predict ABI (reference: c_predict_api.h MXPred*)
+        "MXTPUPredCreate": [ctypes.c_char_p, vp, u64, ctypes.c_int,
+                            ctypes.c_int, ctypes.c_uint32,
+                            ctypes.POINTER(ctypes.c_char_p),
+                            ctypes.POINTER(ctypes.c_uint32),
+                            ctypes.POINTER(ctypes.c_uint32),
+                            ctypes.POINTER(vp)],
+        "MXTPUPredSetInput": [vp, ctypes.c_char_p,
+                              ctypes.POINTER(ctypes.c_float), u64],
+        "MXTPUPredForward": [vp],
+        "MXTPUPredGetOutputShape": [vp, ctypes.c_uint32,
+                                    ctypes.POINTER(
+                                        ctypes.POINTER(ctypes.c_uint32)),
+                                    ctypes.POINTER(ctypes.c_uint32)],
+        "MXTPUPredGetOutput": [vp, ctypes.c_uint32,
+                               ctypes.POINTER(ctypes.c_float), u64],
+        "MXTPUPredReshape": [ctypes.c_uint32,
+                             ctypes.POINTER(ctypes.c_char_p),
+                             ctypes.POINTER(ctypes.c_uint32),
+                             ctypes.POINTER(ctypes.c_uint32), vp,
+                             ctypes.POINTER(vp)],
+        "MXTPUPredFree": [vp],
     }
     for name, argtypes in sigs.items():
         fn = getattr(lib, name)
@@ -96,7 +131,11 @@ def get_lib():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            if not os.path.exists(_SO_PATH):
+            # rebuild only when sources changed; a failed rebuild over an
+            # existing (but stale) .so must NOT fall through to loading
+            # it — _declare would reject missing symbols and silently
+            # disable the whole native runtime
+            if _stale():
                 _build()
             lib = ctypes.CDLL(_SO_PATH)
             _declare(lib)

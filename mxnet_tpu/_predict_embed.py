@@ -1,0 +1,152 @@
+"""Embedded-interpreter half of the C predict ABI (MXTPUPred*).
+
+`native/src/predict.cc` drives the jax runtime from plain C by embedding
+CPython (the TPU deployment analog of the reference's self-contained
+`c_predict_api.h` build: on TPU the inference runtime IS jax/XLA/PJRT,
+so the C ABI hosts an interpreter instead of a second engine).  All
+arguments cross the boundary as integer addresses; this module reads and
+writes those buffers with ctypes.  Every entry point is no-raise: errors
+are reported through the (status, errbuf) out-parameters.
+
+Reference: include/mxnet/c_predict_api.h, src/c_api/c_predict_api.cc.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import traceback
+
+_predictors = {}
+_next_id = [1]
+
+_MAX_NDIM = 16
+
+
+def _status(status_addr, err_addr, err_cap, code, msg=""):
+    if err_addr and msg:
+        raw = msg.encode("utf-8", "replace")[: max(0, err_cap - 1)] + b"\0"
+        ctypes.memmove(err_addr, raw, len(raw))
+    ctypes.cast(status_addr, ctypes.POINTER(ctypes.c_int64))[0] = code
+
+
+def _read_shapes(nkeys, keys_addr, indptr_addr, shapes_addr):
+    keys = ctypes.cast(keys_addr, ctypes.POINTER(ctypes.c_char_p))
+    indptr = ctypes.cast(indptr_addr, ctypes.POINTER(ctypes.c_uint32))
+    sdata = ctypes.cast(shapes_addr, ctypes.POINTER(ctypes.c_uint32))
+    shapes = {}
+    for i in range(nkeys):
+        name = keys[i].decode("utf-8")
+        shapes[name] = tuple(int(sdata[j])
+                             for j in range(indptr[i], indptr[i + 1]))
+    return shapes
+
+
+def c_create(json_addr, json_len, param_addr, param_len, dev_type, dev_id,
+             nkeys, keys_addr, indptr_addr, shapes_addr,
+             out_id_addr, status_addr, err_addr, err_cap):
+    try:
+        from .predictor import Predictor
+
+        json_str = ctypes.string_at(json_addr, json_len).decode("utf-8")
+        param_bytes = ctypes.string_at(param_addr, param_len)
+        shapes = _read_shapes(nkeys, keys_addr, indptr_addr, shapes_addr)
+        dev = {1: "cpu", 2: "tpu", 3: "cpu"}.get(dev_type, "cpu")
+        pred = Predictor(json_str, param_bytes, shapes, dev, dev_id)
+        pid = _next_id[0]
+        _next_id[0] += 1
+        _predictors[pid] = {"pred": pred, "inputs": {}}
+        ctypes.cast(out_id_addr, ctypes.POINTER(ctypes.c_uint64))[0] = pid
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_set_input(pid, key_addr, data_addr, size,
+                status_addr, err_addr, err_cap):
+    try:
+        import numpy as np
+
+        st = _predictors[pid]
+        key = ctypes.string_at(key_addr).decode("utf-8")
+        pred = st["pred"]
+        if key not in pred.get_input_names():
+            raise ValueError("unknown input '%s' (expected %s)"
+                             % (key, pred.get_input_names()))
+        shape = tuple(pred._exec.arg_dict[key].shape)
+        n = int(np.prod(shape)) if shape else 1
+        if int(size) != n:
+            raise ValueError("input '%s': got %d elements, bound shape %s "
+                             "needs %d" % (key, size, shape, n))
+        flat = np.ctypeslib.as_array(
+            ctypes.cast(data_addr, ctypes.POINTER(ctypes.c_float)), (n,))
+        st["inputs"][key] = flat.reshape(shape).copy()
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_forward(pid, status_addr, err_addr, err_cap):
+    try:
+        st = _predictors[pid]
+        st["pred"].forward(**st["inputs"])
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_get_output_shape(pid, index, out_dims_addr,
+                       status_addr, err_addr, err_cap):
+    """Writes [ndim, dim0, dim1, ...] into a uint32[1+_MAX_NDIM] buffer."""
+    try:
+        pred = _predictors[pid]["pred"]
+        shape = pred.get_output_shape(index)
+        if len(shape) > _MAX_NDIM:
+            raise ValueError("output ndim %d exceeds %d"
+                             % (len(shape), _MAX_NDIM))
+        buf = ctypes.cast(out_dims_addr, ctypes.POINTER(ctypes.c_uint32))
+        buf[0] = len(shape)
+        for i, d in enumerate(shape):
+            buf[1 + i] = d
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_get_output(pid, index, data_addr, size,
+                 status_addr, err_addr, err_cap):
+    try:
+        import numpy as np
+
+        pred = _predictors[pid]["pred"]
+        out = np.ascontiguousarray(pred.get_output(index),
+                                   dtype=np.float32)
+        if int(size) != out.size:
+            raise ValueError("output %d has %d elements, caller buffer %d"
+                             % (index, out.size, size))
+        ctypes.memmove(data_addr, out.ctypes.data, out.nbytes)
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_reshape(pid, nkeys, keys_addr, indptr_addr, shapes_addr,
+              out_id_addr, status_addr, err_addr, err_cap):
+    try:
+        st = _predictors[pid]
+        shapes = _read_shapes(nkeys, keys_addr, indptr_addr, shapes_addr)
+        new = st["pred"]._reshape_clone(shapes)
+        nid = _next_id[0]
+        _next_id[0] += 1
+        _predictors[nid] = {"pred": new, "inputs": {}}
+        ctypes.cast(out_id_addr, ctypes.POINTER(ctypes.c_uint64))[0] = nid
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())
+
+
+def c_free(pid, status_addr, err_addr, err_cap):
+    try:
+        _predictors.pop(pid, None)
+        _status(status_addr, err_addr, err_cap, 0)
+    except Exception:
+        _status(status_addr, err_addr, err_cap, -1, traceback.format_exc())

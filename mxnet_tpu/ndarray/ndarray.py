@@ -783,13 +783,35 @@ def _ensure_ext(fname):
     return fname
 
 
+def load_frombuffer(buf, ctx=None):
+    """Deserialize an in-memory `save` blob (reference:
+    MXNDArrayLoadFromBuffer, python/mxnet/ndarray/utils.py:185)."""
+    import io
+
+    return _load_npz(_np.load(io.BytesIO(bytes(buf)), allow_pickle=False),
+                     ctx)
+
+
 def load(fname, ctx=None):
-    data = _np.load(fname if _np.lib.format.read_magic else fname, allow_pickle=False)
+    return _load_npz(_np.load(fname, allow_pickle=False), ctx)
+
+
+def _parse_npz(data):
+    """Shared save-blob format parser → numpy ('list', [...]) or
+    ('dict', {...}).  Used by load/load_frombuffer and
+    predictor.load_ndarray_file."""
     try:
         fmt = str(data["__format__"])
     except KeyError:
         fmt = "dict"
     if fmt == "list":
         n = len([k for k in data.files if k.startswith("arr_")])
-        return [array(data["arr_%d" % i], ctx=ctx) for i in range(n)]
-    return {k: array(v, ctx=ctx) for k, v in data.items() if k != "__format__"}
+        return "list", [data["arr_%d" % i] for i in range(n)]
+    return "dict", {k: data[k] for k in data.files if k != "__format__"}
+
+
+def _load_npz(data, ctx):
+    fmt, parsed = _parse_npz(data)
+    if fmt == "list":
+        return [array(v, ctx=ctx) for v in parsed]
+    return {k: array(v, ctx=ctx) for k, v in parsed.items()}

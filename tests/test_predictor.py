@@ -1,0 +1,239 @@
+"""Predictor (deployment API) tests.
+
+Reference: tests/python/unittest/test_predictor.py — exported
+symbol+params loaded by the prediction-only API, forward/reshape/output
+parity with the Gluon block that produced them; load_ndarray_file.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.predictor import Predictor, load_ndarray_file
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _export_dense(tmp_path, prefix="test_predictor_simple_dense"):
+    block = gluon.nn.HybridSequential()
+    block.add(gluon.nn.Dense(7))
+    block.add(gluon.nn.Dense(3))
+    block.hybridize()
+    block.initialize()
+    out1 = block(nd.array(np.random.uniform(size=(1, 3))))  # shape resolve
+    path = str(tmp_path / prefix)
+    block.export(path)
+    return block, path
+
+
+def test_predictor(tmp_path):
+    block, path = _export_dense(tmp_path)
+    input1 = np.random.uniform(size=(1, 3)).astype(np.float32)
+    input2 = np.random.uniform(size=(3, 3)).astype(np.float32)
+    out1 = block(nd.array(input1))
+    out2 = block(nd.array(input2))
+
+    predictor = Predictor(open(path + "-symbol.json").read(),
+                          open(path + "-0000.params", "rb").read(),
+                          {"data": input1.shape})
+    predictor.forward(data=input1)
+    assert_almost_equal(out1.asnumpy(), predictor.get_output(0),
+                        rtol=1e-5, atol=1e-6)
+    assert predictor.get_output(0).shape == (1, 3)
+    assert predictor.num_outputs == 1
+    assert predictor.get_input_names() == ["data"]
+    assert predictor.get_output_shape(0) == (1, 3)
+
+    # reshape: new batch size, same weights
+    predictor.reshape({"data": input2.shape})
+    predictor.forward(data=input2)
+    assert_almost_equal(out2.asnumpy(), predictor.get_output(0),
+                        rtol=1e-5, atol=1e-6)
+    del predictor
+
+
+def test_predictor_shape_mismatch(tmp_path):
+    _, path = _export_dense(tmp_path)
+    predictor = Predictor(open(path + "-symbol.json").read(),
+                          open(path + "-0000.params", "rb").read(),
+                          {"data": (1, 3)})
+    with pytest.raises(ValueError):
+        predictor.forward(data=np.zeros((2, 3), np.float32))
+    with pytest.raises(ValueError):
+        Predictor(open(path + "-symbol.json").read(),
+                  open(path + "-0000.params", "rb").read(),
+                  {"not_an_input": (1, 3)})
+
+
+def test_load_ndarray(tmp_path):
+    nd_file = str(tmp_path / "test_predictor_load_ndarray.params")
+    a = nd.random.uniform(shape=(7, 3))
+    b = nd.random.uniform(shape=(7,))
+    nd_data = {"a": a, "b": b}
+    nd.save(nd_file, nd_data)
+
+    nd_load = load_ndarray_file(open(nd_file, "rb").read())
+    assert set(nd_data) == set(nd_load)
+    for k in nd_data:
+        assert_almost_equal(nd_data[k].asnumpy(), nd_load[k],
+                            rtol=1e-5, atol=1e-6)
+
+    # list round-trip + load_frombuffer parity
+    nd.save(nd_file, [a, b])
+    as_list = load_ndarray_file(open(nd_file, "rb").read())
+    assert isinstance(as_list, list) and len(as_list) == 2
+    buf_load = nd.load_frombuffer(open(nd_file, "rb").read())
+    assert_almost_equal(as_list[0], buf_load[0].asnumpy())
+
+
+def test_predict_c_abi(tmp_path):
+    """The native MXTPUPred* ABI (embedded-interpreter path), driven via
+    ctypes from this already-initialized process.  Reference:
+    c_predict_api.h used from amalgamation/python/mxnet_predict.py."""
+    import ctypes
+
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    lib = _native.get_lib()
+
+    block, path = _export_dense(tmp_path, "test_predict_c_abi")
+    input1 = np.random.uniform(size=(2, 3)).astype(np.float32)
+    expect = block(nd.array(input1)).asnumpy()
+
+    json_str = open(path + "-symbol.json").read().encode()
+    params = open(path + "-0000.params", "rb").read()
+    pbuf = (ctypes.c_char * len(params)).from_buffer_copy(params)
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    sdata = (ctypes.c_uint32 * 2)(2, 3)
+    handle = ctypes.c_void_p()
+    _native.check_call(lib.MXTPUPredCreate(
+        json_str, pbuf, len(params), 1, 0, 1, keys, indptr, sdata,
+        ctypes.byref(handle)))
+
+    flat = np.ascontiguousarray(input1.ravel())
+    _native.check_call(lib.MXTPUPredSetInput(
+        handle, b"data",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), flat.size))
+    _native.check_call(lib.MXTPUPredForward(handle))
+
+    shape_ptr = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    _native.check_call(lib.MXTPUPredGetOutputShape(
+        handle, 0, ctypes.byref(shape_ptr), ctypes.byref(ndim)))
+    shape = tuple(shape_ptr[i] for i in range(ndim.value))
+    assert shape == (2, 3)
+
+    out = np.empty(shape, np.float32)
+    _native.check_call(lib.MXTPUPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size))
+    assert_almost_equal(expect, out, rtol=1e-5, atol=1e-6)
+
+    # reshape to batch 4 → fresh handle sharing weights
+    indptr2 = (ctypes.c_uint32 * 2)(0, 2)
+    sdata2 = (ctypes.c_uint32 * 2)(4, 3)
+    h2 = ctypes.c_void_p()
+    _native.check_call(lib.MXTPUPredReshape(
+        1, keys, indptr2, sdata2, handle, ctypes.byref(h2)))
+    input2 = np.random.uniform(size=(4, 3)).astype(np.float32)
+    flat2 = np.ascontiguousarray(input2.ravel())
+    _native.check_call(lib.MXTPUPredSetInput(
+        h2, b"data", flat2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat2.size))
+    _native.check_call(lib.MXTPUPredForward(h2))
+    out2 = np.empty((4, 3), np.float32)
+    _native.check_call(lib.MXTPUPredGetOutput(
+        h2, 0, out2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out2.size))
+    assert_almost_equal(block(nd.array(input2)).asnumpy(), out2,
+                        rtol=1e-5, atol=1e-6)
+
+    # error surface: bad input name reports through MXTPUGetLastError
+    rc = lib.MXTPUPredSetInput(
+        h2, b"nope", flat2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        flat2.size)
+    assert rc != 0
+    assert b"unknown input" in lib.MXTPUGetLastError()
+
+    _native.check_call(lib.MXTPUPredFree(handle))
+    _native.check_call(lib.MXTPUPredFree(h2))
+
+
+def test_predict_from_pure_c(tmp_path):
+    """Compile and run a plain-C program against MXTPUPred*: the embedded
+    interpreter bootstraps jax inside a non-Python process (the TPU
+    deployment story for C/C++ apps; reference: a C app linking
+    libmxnet_predict.so)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _, path = _export_dense(tmp_path, "test_predict_pure_c")
+
+    src = os.path.join(repo, "tests", "native_c", "test_predict.c")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "test_predict")
+    cc = subprocess.run(
+        ["gcc", "-O1", "-o", exe, src, "-L" + so_dir, "-lmxtpu",
+         "-Wl,-rpath," + so_dir], capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    # keep the embedded interpreter on CPU and quiet
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+
+
+def test_cpp_package_example(tmp_path):
+    """Compile and run the cpp-package C++ example (RAII API over the C
+    ABI; reference: cpp-package/example/inference)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _, path = _export_dense(tmp_path, "test_cpp_package")
+
+    src = os.path.join(repo, "cpp-package", "example", "predict_cpp.cc")
+    inc = os.path.join(repo, "cpp-package", "include")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "predict_cpp")
+    cc = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-o", exe, src, "-I" + inc,
+         "-L" + so_dir, "-lmxtpu", "-Wl,-rpath," + so_dir],
+        capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "reshaped output elements: 12" in r.stdout
