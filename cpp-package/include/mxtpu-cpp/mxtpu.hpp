@@ -78,21 +78,31 @@ enum class Device : int { kCPU = 1, kTPU = 2 };
 // Loads an exported model (symbol JSON + params blob) and runs forward
 // passes.  Mirrors cpp-package's Predictor idiom over c_predict_api.h.
 class Predictor {
+  // CSR-flattened {name: shape} map for the C ABI's (keys, indptr, data)
+  // convention.
+  struct Shapes {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, sdata;
+    explicit Shapes(
+        const std::map<std::string, std::vector<uint32_t>>& input_shapes) {
+      for (const auto& kv : input_shapes) {
+        keys.push_back(kv.first.c_str());
+        sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
+        indptr.push_back(static_cast<uint32_t>(sdata.size()));
+      }
+    }
+    uint32_t n() const { return static_cast<uint32_t>(keys.size()); }
+  };
+
  public:
   Predictor(const std::string& symbol_json, const std::string& param_bytes,
             const std::map<std::string, std::vector<uint32_t>>& input_shapes,
             Device dev = Device::kCPU, int dev_id = 0) {
-    std::vector<const char*> keys;
-    std::vector<uint32_t> indptr{0}, sdata;
-    for (const auto& kv : input_shapes) {
-      keys.push_back(kv.first.c_str());
-      sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<uint32_t>(sdata.size()));
-    }
+    Shapes s(input_shapes);
     Check(MXTPUPredCreate(symbol_json.c_str(), param_bytes.data(),
                           param_bytes.size(), static_cast<int>(dev), dev_id,
-                          static_cast<uint32_t>(keys.size()), keys.data(),
-                          indptr.data(), sdata.data(), &handle_));
+                          s.n(), s.keys.data(), s.indptr.data(),
+                          s.sdata.data(), &handle_));
   }
   ~Predictor() {
     if (handle_) MXTPUPredFree(handle_);
@@ -128,16 +138,10 @@ class Predictor {
   // New predictor over the same weights with different input shapes.
   Predictor Reshape(
       const std::map<std::string, std::vector<uint32_t>>& input_shapes) {
-    std::vector<const char*> keys;
-    std::vector<uint32_t> indptr{0}, sdata;
-    for (const auto& kv : input_shapes) {
-      keys.push_back(kv.first.c_str());
-      sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<uint32_t>(sdata.size()));
-    }
+    Shapes s(input_shapes);
     void* nh = nullptr;
-    Check(MXTPUPredReshape(static_cast<uint32_t>(keys.size()), keys.data(),
-                           indptr.data(), sdata.data(), handle_, &nh));
+    Check(MXTPUPredReshape(s.n(), s.keys.data(), s.indptr.data(),
+                           s.sdata.data(), handle_, &nh));
     return Predictor(nh);
   }
 

@@ -88,6 +88,14 @@ class Optimizer:
             self.update(index, weight, grad, state)
 
     # ------------------------------------------------------------- lr/wd
+    @property
+    def learning_rate(self):
+        """Current lr — scheduler value when one is set (reference:
+        optimizer.py Optimizer.learning_rate)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise MXNetError("LRScheduler of the optimizer has already been defined")

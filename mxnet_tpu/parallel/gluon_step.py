@@ -144,8 +144,14 @@ class GluonTrainStep:
             y_shard = x_shard
         # place the functional state onto its shardings up front: committed
         # single-device arrays cannot be implicitly resharded by jit, and
-        # this also avoids a first-step transfer
+        # this also avoids a first-step transfer.  jnp.array(copy=True)
+        # first: device_put to an equivalent sharding aliases the source
+        # buffer, and the first donated step would then delete the Gluon
+        # Parameter's own array out from under the user
+        import jax.numpy as jnp
+
         def _put(vals, shard):
+            vals = tuple(jnp.array(v, copy=True) for v in vals)
             if isinstance(shard, tuple):
                 return tuple(jax.device_put(v, s)
                              for v, s in zip(vals, shard))
@@ -159,6 +165,10 @@ class GluonTrainStep:
             step,
             in_shardings=(tv_shard, tv_shard, aux_shard, x_shard, y_shard,
                           repl),
+            # pin outputs to the input layouts: the functional state must
+            # keep its sharding across steps (otherwise the compiler may
+            # re-shard e.g. a bias, and step 2's in_shardings reject it)
+            out_shardings=(repl, tv_shard, tv_shard, aux_shard),
             donate_argnums=(0, 1, 2),
         )
         # place batch-sharded inputs via these shardings

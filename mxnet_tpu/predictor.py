@@ -100,6 +100,10 @@ class Predictor:
             **input_shapes)
         self._exec.copy_params_from(self._arg_params, self._aux_params,
                                     allow_extra_params=True)
+        # output shapes are fixed by the bound input shapes; computed once
+        # (get_output_shape sits on the C ABI per-inference path)
+        _, out_shapes, _ = self._symbol.infer_shape(**input_shapes)
+        self._out_shapes = [tuple(s) for s in out_shapes]
         self._inputs = {}
         self._outputs = None
 
@@ -131,13 +135,10 @@ class Predictor:
 
     @property
     def num_outputs(self):
-        return len(self._symbol._outputs)
+        return len(self._symbol)
 
     def get_output_shape(self, index):
-        _, out_shapes, _ = self._symbol.infer_shape(
-            **{k: tuple(self._exec.arg_dict[k].shape)
-               for k in self._input_names})
-        return tuple(out_shapes[index])
+        return self._out_shapes[index]
 
     def get_input_names(self):
         return list(self._input_names)

@@ -1,0 +1,178 @@
+"""Gluon loss-function tests.
+
+Reference: tests/python/unittest/test_loss.py — value checks against
+closed-form numpy, sample_weight handling, hybridize parity, and a small
+convergence run.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.test_utils import assert_almost_equal
+
+B, D = 4, 5
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_l1_l2_loss():
+    pred, label = _rand(B, D, seed=1), _rand(B, D, seed=2)
+    l2 = gloss.L2Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(l2, (0.5 * (pred - label) ** 2).mean(axis=1),
+                        rtol=1e-5, atol=1e-6)
+    l1 = gloss.L1Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(l1, np.abs(pred - label).mean(axis=1),
+                        rtol=1e-5, atol=1e-6)
+    # sample_weight: per-sample mask
+    sw = np.array([1, 0, 1, 0], np.float32).reshape(B, 1)
+    l2w = gloss.L2Loss()(nd.array(pred), nd.array(label),
+                         nd.array(sw)).asnumpy()
+    assert_almost_equal(l2w, (0.5 * (pred - label) ** 2 * sw).mean(axis=1),
+                        rtol=1e-5, atol=1e-6)
+    assert l2w[1] == 0 and l2w[3] == 0
+
+
+def test_sigmoid_bce_loss():
+    pred, label = _rand(B, D, seed=3), (_rand(B, D, seed=4) > 0).astype(
+        np.float32)
+    # logits path vs explicit formula
+    got = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    sig = 1 / (1 + np.exp(-pred))
+    want = -(label * np.log(sig) + (1 - label) * np.log(1 - sig))
+    assert_almost_equal(got, want.mean(axis=1), rtol=1e-4, atol=1e-5)
+    # from_sigmoid path agrees
+    got2 = gloss.SigmoidBCELoss(from_sigmoid=True)(
+        nd.array(sig.astype(np.float32)), nd.array(label)).asnumpy()
+    assert_almost_equal(got2, want.mean(axis=1), rtol=1e-4, atol=1e-5)
+    # pos_weight upweights positive terms
+    pw = nd.array(np.full((1, D), 2.0, np.float32))
+    got3 = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label), None, pw).asnumpy()
+    want3 = -(2.0 * label * np.log(sig) + (1 - label) * np.log(1 - sig))
+    assert_almost_equal(got3, want3.mean(axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ce_loss():
+    pred = _rand(B, D, seed=5)
+    label = np.array([0, 2, 4, 1], np.float32)
+    got = gloss.SoftmaxCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    logp = pred - pred.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    want = -logp[np.arange(B), label.astype(int)]
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+    # dense (one-hot) label path matches sparse
+    onehot = np.eye(D, dtype=np.float32)[label.astype(int)]
+    got_dense = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(pred), nd.array(onehot)).asnumpy()
+    assert_almost_equal(got_dense, want, rtol=1e-5, atol=1e-6)
+    # from_logits skips the log_softmax
+    got_logits = gloss.SoftmaxCELoss(from_logits=True)(
+        nd.array(logp.astype(np.float32)), nd.array(label)).asnumpy()
+    assert_almost_equal(got_logits, want, rtol=1e-5, atol=1e-6)
+
+
+def test_kl_div_loss():
+    label = np.abs(_rand(B, D, seed=6)) + 0.1
+    label /= label.sum(1, keepdims=True)
+    logits = _rand(B, D, seed=7)
+    logp = logits - logits.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    want = (label * (np.log(label + 1e-12) - logp)).mean(axis=1)
+    got = gloss.KLDivLoss()(nd.array(logp.astype(np.float32)),
+                            nd.array(label.astype(np.float32))).asnumpy()
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+    got2 = gloss.KLDivLoss(from_logits=False)(
+        nd.array(logits), nd.array(label.astype(np.float32))).asnumpy()
+    assert_almost_equal(got2, want, rtol=1e-4, atol=1e-5)
+
+
+def test_huber_hinge_logistic():
+    pred, label = _rand(B, D, seed=8) * 2, _rand(B, D, seed=9) * 2
+    rho = 1.0
+    err = np.abs(pred - label)
+    want = np.where(err > rho, err - 0.5 * rho, 0.5 / rho * err ** 2)
+    got = gloss.HuberLoss(rho=rho)(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(got, want.mean(axis=1), rtol=1e-5, atol=1e-6)
+
+    sign = np.sign(_rand(B, D, seed=10) + 1e-3)
+    want_h = np.maximum(0, 1 - pred * sign)
+    got_h = gloss.HingeLoss()(nd.array(pred),
+                              nd.array(sign.astype(np.float32))).asnumpy()
+    assert_almost_equal(got_h, want_h.mean(axis=1), rtol=1e-5, atol=1e-6)
+    got_sh = gloss.SquaredHingeLoss()(
+        nd.array(pred), nd.array(sign.astype(np.float32))).asnumpy()
+    assert_almost_equal(got_sh, (want_h ** 2).mean(axis=1),
+                        rtol=1e-5, atol=1e-6)
+
+    # logistic, signed labels: log(1 + exp(-pred*label))
+    want_l = np.log1p(np.exp(-pred * sign))
+    got_l = gloss.LogisticLoss()(nd.array(pred),
+                                 nd.array(sign.astype(np.float32))).asnumpy()
+    assert_almost_equal(got_l, want_l.mean(axis=1), rtol=1e-4, atol=1e-5)
+    # binary {0,1} labels
+    lbl01 = (sign + 1) / 2
+    got_b = gloss.LogisticLoss(label_format="binary")(
+        nd.array(pred), nd.array(lbl01.astype(np.float32))).asnumpy()
+    assert_almost_equal(got_b, want_l.mean(axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_triplet_cosine_loss():
+    a, p, n = _rand(B, D, seed=11), _rand(B, D, seed=12), _rand(B, D, seed=13)
+    want = np.maximum(
+        0, ((a - p) ** 2).sum(1) - ((a - n) ** 2).sum(1) + 1.0)
+    got = gloss.TripletLoss()(nd.array(a), nd.array(p),
+                              nd.array(n)).asnumpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+    lbl = np.array([1, -1, 1, -1], np.float32)
+    cos = (a * p).sum(1) / (np.linalg.norm(a, axis=1) *
+                            np.linalg.norm(p, axis=1) + 1e-12)
+    want_c = np.where(lbl == 1, 1 - cos, np.maximum(0, cos))
+    got_c = gloss.CosineEmbeddingLoss()(
+        nd.array(a), nd.array(p), nd.array(lbl)).asnumpy()
+    assert_almost_equal(got_c, want_c, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_hybridize_and_grad():
+    """Losses run hybridized and produce gradients (reference: every loss
+    is a HybridBlock usable under autograd)."""
+    pred, label = _rand(B, D, seed=14), _rand(B, D, seed=15)
+    for L in (gloss.L2Loss(), gloss.HuberLoss(),
+              gloss.SigmoidBinaryCrossEntropyLoss()):
+        L.hybridize()
+        x = nd.array(pred)
+        x.attach_grad()
+        with autograd.record():
+            out = L(x, nd.array((label > 0).astype(np.float32))).sum()
+        out.backward()
+        g = x.grad.asnumpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ce_loss_convergence():
+    """Small logistic-regression convergence run (reference:
+    test_loss.py's fit-based checks)."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(100, 10).astype(np.float32)
+    w_true = rs.randn(10, 3).astype(np.float32)
+    Y = (X @ w_true).argmax(1).astype(np.float32)
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(X[:1]))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    for _ in range(60):
+        with autograd.record():
+            L = loss_fn(net(nd.array(X)), nd.array(Y)).mean()
+        L.backward()
+        trainer.step(1)
+    acc = (net(nd.array(X)).asnumpy().argmax(1) == Y).mean()
+    assert acc > 0.9, acc
