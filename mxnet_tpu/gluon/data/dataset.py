@@ -116,7 +116,8 @@ class RecordFileDataset(Dataset):
     def __init__(self, filename):
         self.idx_file = os.path.splitext(filename)[0] + ".idx"
         self.filename = filename
-        self._record = recordio.IndexedRecordIO(self.idx_file, self.filename, "r")
+        self._record = recordio.MXIndexedRecordIO(self.idx_file,
+                                                  self.filename, "r")
 
     def __getitem__(self, idx):
         return self._record.read_idx(self._record.keys[idx])

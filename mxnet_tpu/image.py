@@ -42,6 +42,11 @@ def _cv2():
 def imdecode(buf, flag=1, to_rgb=True, out=None):
     """Decode an image byte buffer to an NDArray HWC(BGR→RGB)
     (reference: image.py imdecode over cv::imdecode)."""
+    if bytes(buf[:4]) == b"IMG0":
+        # records written by earlier versions of this framework carried a
+        # format tag before the encoded bytes; no real image format
+        # starts with IMG0, so stripping it is unambiguous
+        buf = buf[4:]
     cv2 = _cv2()
     if cv2 is not None:
         arr = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8),
@@ -168,7 +173,12 @@ class Augmenter:
     """Image augmenter base (reference: image.py Augmenter)."""
 
     def __init__(self, **kwargs):
-        self._kwargs = kwargs
+        # array-valued kwargs (mean/std) become lists so dumps() emits
+        # plain json (reference: image.py Augmenter.__init__)
+        self._kwargs = {
+            k: (v.asnumpy().tolist() if isinstance(v, ndarray.NDArray)
+                else v.tolist() if isinstance(v, _np.ndarray) else v)
+            for k, v in kwargs.items()}
 
     def dumps(self):
         import json
@@ -441,7 +451,8 @@ class ImageIter(_io.DataIter):
         self.path_root = path_root
         if path_imgrec:
             idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
-            self.imgrec = recordio.IndexedRecordIO(idx_path, path_imgrec, "r")
+            self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                     "r")
             self.seq = list(self.imgrec.keys)
         elif path_imglist:
             with open(path_imglist) as f:

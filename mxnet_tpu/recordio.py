@@ -159,8 +159,11 @@ def unpack(s):
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Pack an image array (HWC uint8).  Uses PIL when available for JPEG;
-    otherwise stores raw npy bytes (format-tagged)."""
+    """Pack an image array (HWC uint8) as header + encoded image bytes —
+    the reference's wire format (recordio.pack_img), so records
+    interoperate with reference-built .rec files and `unpack` output
+    feeds `image.imdecode` directly.  Raw-tagged fallback only when no
+    encoder is available."""
     try:
         from io import BytesIO
 
@@ -169,7 +172,7 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         buff = BytesIO()
         fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
         Image.fromarray(img).save(buff, format=fmt, quality=quality)
-        return pack(header, b"IMG0" + buff.getvalue())
+        return pack(header, buff.getvalue())
     except ImportError:
         arr = _np.ascontiguousarray(img, dtype=_np.uint8)
         meta = struct.pack("<III", *((arr.shape + (1, 1, 1))[:3]))
@@ -184,17 +187,15 @@ def unpack_img(s, iscolor=-1):
         h, w, c = struct.unpack("<III", payload[4:16])
         img = _np.frombuffer(payload[16:16 + h * w * c], dtype=_np.uint8)
         img = img.reshape((h, w, c) if c > 1 else (h, w))
-    elif tag == b"IMG0":
-        from io import BytesIO
-
-        from PIL import Image
-
-        img = _np.asarray(Image.open(BytesIO(payload[4:])))
     else:
-        # assume raw JPEG from the reference's im2rec
+        # encoded image bytes (JPEG/PNG), the reference wire format;
+        # "IMG0"-tagged records from early versions of this framework
+        # are also accepted
         from io import BytesIO
 
         from PIL import Image
 
+        if tag == b"IMG0":
+            payload = payload[4:]
         img = _np.asarray(Image.open(BytesIO(payload)))
     return header, img

@@ -1,0 +1,165 @@
+"""mx.image tests: decode/resize/crop/normalize, augmenters, ImageIter
+over RecordIO, executor reshape, gluon utils.
+
+Reference: tests/python/unittest/test_image.py, test_gluon_utils.py,
+test_executor.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _img(seed=0, h=32, w=48):
+    return (np.random.RandomState(seed).rand(h, w, 3) * 255).astype("uint8")
+
+
+# ---------------------------------------------------------------- basics --
+def test_imdecode_imread(tmp_path):
+    img = _img()
+    ok, buf = cv2.imencode(".png", img)  # png: lossless round trip
+    dec = mx.image.imdecode(buf.tobytes())
+    # imdecode returns RGB; cv2 encodes BGR
+    assert_almost_equal(dec.asnumpy(), img[:, :, ::-1])
+    # grayscale flag
+    gray = mx.image.imdecode(buf.tobytes(), flag=0)
+    assert gray.shape[2] == 1
+    p = str(tmp_path / "img.png")
+    cv2.imwrite(p, img)
+    rd = mx.image.imread(p)
+    assert_almost_equal(rd.asnumpy(), img[:, :, ::-1])
+
+
+def test_resize_crop_normalize():
+    img = nd.array(_img().astype(np.float32))
+    assert mx.image.resize_short(img, 16).shape[:2] == (16, 24)
+    crop, rect = mx.image.fixed_crop(img, 4, 2, 20, 10), None
+    assert crop.shape == (10, 20, 3)
+    c, rect = mx.image.center_crop(img, (16, 12))
+    assert c.shape == (12, 16, 3)
+    x0, y0, w, h = rect
+    assert (w, h) == (16, 12)
+    rc, rrect = mx.image.random_crop(img, (8, 8))
+    assert rc.shape == (8, 8, 3)
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    norm = mx.image.color_normalize(img, nd.array(mean), nd.array(std))
+    assert_almost_equal(norm.asnumpy(), (img.asnumpy() - mean) / std,
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_augmenters():
+    img = nd.array(_img(seed=3).astype(np.float32))
+    auglist = mx.image.CreateAugmenter((3, 16, 16), resize=20,
+                                       rand_mirror=True, mean=True, std=True)
+    out = img
+    for aug in auglist:
+        out = aug(out)
+    assert out.shape == (16, 16, 3)
+    # dumps() round-trips to json (reference: Augmenter.dumps)
+    import json
+
+    for aug in auglist:
+        json.loads(aug.dumps())
+
+
+def test_image_iter_rec(tmp_path):
+    """ImageIter over an indexed .rec with labels, sharding, epochs
+    (reference: test_image.py ImageIter + ImageRecordIter)."""
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    n = 12
+    for i in range(n):
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, _img(seed=i), img_fmt=".png"))
+    rec.close()
+
+    it = mx.image.ImageIter(4, (3, 16, 16), path_imgrec=rec_path,
+                            rand_crop=False)
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        labels.extend(batch.label[0].asnumpy().tolist())
+    assert len(labels) == n
+    assert sorted(set(int(l) for l in labels)) == [0, 1, 2]
+    # second epoch after reset
+    it.reset()
+    assert sum(1 for _ in it) == n // 4
+
+    # sharding: num_parts views are disjoint and cover the set
+    it0 = mx.image.ImageIter(2, (3, 16, 16), path_imgrec=rec_path,
+                             part_index=0, num_parts=2)
+    it1 = mx.image.ImageIter(2, (3, 16, 16), path_imgrec=rec_path,
+                             part_index=1, num_parts=2)
+    assert len(it0.seq) + len(it1.seq) == n
+    assert not set(it0.seq) & set(it1.seq)
+
+
+def test_image_iter_imglist_shuffle(tmp_path):
+    for i in range(6):
+        cv2.imwrite(str(tmp_path / ("i%d.jpg" % i)), _img(seed=i))
+    it = mx.image.ImageIter(3, (3, 8, 8),
+                            imglist=[(i, "i%d.jpg" % i) for i in range(6)],
+                            path_root=str(tmp_path), shuffle=True)
+    seen = []
+    for batch in it:
+        seen.extend(batch.label[0].asnumpy().astype(int).tolist())
+    assert sorted(seen) == list(range(6))
+
+
+# ---------------------------------------------------------- gluon utils --
+def test_split_and_load():
+    data = nd.array(np.arange(24, dtype=np.float32).reshape(8, 3))
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    parts = gluon.utils.split_and_load(data, ctxs)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+    assert_almost_equal(np.concatenate([p.asnumpy() for p in parts]),
+                        data.asnumpy())
+    with pytest.raises(ValueError):
+        gluon.utils.split_data(data, 3, even_split=True)
+    uneven = gluon.utils.split_data(
+        nd.array(np.arange(10, dtype=np.float32)), 3, even_split=False)
+    assert sum(p.shape[0] for p in uneven) == 10
+
+
+def test_clip_global_norm():
+    arrays = [nd.array(np.ones((2, 2), np.float32) * 3),
+              nd.array(np.ones((3,), np.float32) * 4)]
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    ret = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert_almost_equal(np.array(float(ret)), np.array(total),
+                        rtol=1e-5, atol=1e-6)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_total - 1.0) < 1e-4
+
+
+# ------------------------------------------------------ executor reshape --
+def test_executor_reshape():
+    """reference: test_executor.py / executor.reshape — rebind to a new
+    batch size reusing weights."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = out.simple_bind(ctx=mx.cpu(), data=(2, 6))
+    ex.arg_dict["fc_weight"][:] = np.random.RandomState(0).randn(
+        4, 6).astype(np.float32)
+    ex.arg_dict["fc_bias"][:] = 0
+    x2 = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    y2 = ex.forward(is_train=False, data=x2)[0].asnumpy()
+
+    ex5 = ex.reshape(data=(5, 6))
+    assert ex5.arg_dict["data"].shape == (5, 6)
+    # weights shared (same arrays, not copies)
+    assert ex5.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    x5 = np.random.RandomState(2).randn(5, 6).astype(np.float32)
+    y5 = ex5.forward(is_train=False, data=x5)[0].asnumpy()
+    w = ex.arg_dict["fc_weight"].asnumpy()
+    assert_almost_equal(y5, x5 @ w.T, rtol=1e-5, atol=1e-5)
+    assert_almost_equal(y2, x2 @ w.T, rtol=1e-5, atol=1e-5)
