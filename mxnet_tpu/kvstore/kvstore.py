@@ -13,10 +13,13 @@ so Module/Trainer code written against the reference runs unchanged:
 - 'local' / 'device' / 'nccl' / 'tpu'  → in-process store; push sums
   across the per-device gradient copies (the reference's Comm::Reduce,
   comm.h:57) and runs the updater if set.
-- 'dist_sync' / 'dist_async' → multi-process via ``jax.distributed``
-  when launched under tools/launch.py (DMLC_* env parity); cross-worker
-  reduction uses a host-level allreduce over the process group.  On a
-  single process they degrade to 'local' with num_workers=1.
+- 'dist_sync' → multi-process via ``jax.distributed`` when launched
+  under tools/launch.py (DMLC_* env parity); cross-worker reduction uses
+  a host-level allreduce over the process group.  On a single process it
+  degrades to 'local' with num_workers=1.
+- 'dist_async' → true parameter-server mode: pushes apply immediately on
+  host-side PS processes (kvstore/ps.py, launched by
+  ``launch.py -s N``), the reference's Hogwild-style async semantics.
 """
 
 from __future__ import annotations
@@ -42,8 +45,10 @@ def create(name="local"):
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl", "tpu"):
         return KVStore(name)
-    if name in ("dist_sync", "dist_async", "dist_sync_device", "dist_device_sync"):
+    if name in ("dist", "dist_sync", "dist_sync_device", "dist_device_sync"):
         return DistKVStore(name)
+    if name == "dist_async":
+        return DistAsyncKVStore(name)
     raise MXNetError("unknown KVStore type %r" % name)
 
 
@@ -100,7 +105,11 @@ class KVStore:
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
             else:
-                self._store[k] += merged
+                # reference semantics (kvstore_local.h:213): without an
+                # updater the store holds the REDUCED value, replacing —
+                # this is what makes Trainer's push(grads)/pull(grads)
+                # return the cross-device gradient sum
+                self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored value (reference: Comm::Broadcast comm.h:62)."""
@@ -245,7 +254,9 @@ class DistKVStore(KVStore):
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
             else:
-                self._store[k] += merged
+                # replace with the reduced value (reference:
+                # kvstore_dist_server.h:360 CopyFromTo(merged, stored))
+                self._store[k] = merged
 
     def init(self, key, value):
         """Init + broadcast rank 0's value so every replica starts from
@@ -274,6 +285,128 @@ class DistKVStore(KVStore):
 
             # a zero-byte allreduce doubles as a barrier
             self._allreduce(array(_np.zeros(1, dtype=_np.float32)))
+
+
+class DistAsyncKVStore(KVStore):
+    """`dist_async`: true parameter-server mode over the host-side PS
+    (`kvstore/ps.py`).
+
+    Reference semantics (kvstore_dist_server.h async branch): each
+    worker's push is applied to the server weights IMMEDIATELY — no
+    cross-worker aggregation barrier — and pull returns whatever the
+    server currently holds, so workers run at their own pace with stale
+    weights (Hogwild-style).  The server runs the optimizer; workers
+    ship it once via set_optimizer (reference: kvstore.py
+    _send_command_to_servers).
+    """
+
+    def __init__(self, type_name="dist_async"):
+        super().__init__(type_name)
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", 0))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", 1))
+        launched = "DMLC_ROLE" in os.environ or \
+            "MXTPU_PS_PORTS" in os.environ
+        if not launched and self._num_workers == 1:
+            # no launcher env: degrade to an in-process store like the
+            # other dist types (a notebook `mx.kv.create('dist_async')`
+            # must not dial a nonexistent server)
+            self._client = None
+            return
+        from .ps import PSClient
+
+        try:
+            self._client = PSClient()
+        except OSError as e:
+            raise MXNetError(
+                "dist_async needs parameter-server processes — start the "
+                "job with `tools/launch.py -n <workers> -s <servers>` "
+                "(%s)" % e)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        """Rank 0's value becomes the server copy (reference: InitImpl
+        pushes init only from worker 0)."""
+        if self._client is None:
+            return super().init(key, value)
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if self._rank == 0:
+                self._client.init(k, v.asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        if self._client is None:
+            return super().push(key, value, priority)
+        keys, values = _key_value_list(key, value)
+        for k, vlist in zip(keys, values):
+            merged = vlist[0]
+            if len(vlist) > 1:
+                from ..ndarray import imperative_invoke
+
+                merged = imperative_invoke("add_n", list(vlist), {})[0]
+            if self._compression is not None:
+                merged = self._compression.compress_decompress(k, merged)
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._client is None:
+            return super().pull(key, out, priority, ignore_sparse)
+        assert out is not None
+        keys, outs = _key_value_list(key, out)
+        for k, olist in zip(keys, outs):
+            fetched = self._client.pull(k)
+            for o in olist:
+                o[:] = fetched
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._client is None:
+            return super().row_sparse_pull(key, out, priority, row_ids)
+        assert out is not None and row_ids is not None
+        keys, outs = _key_value_list(key, out)
+        for k, olist in zip(keys, outs):
+            full = self._client.pull(k)
+            rids = row_ids if isinstance(row_ids, list) \
+                else [row_ids] * len(olist)
+            for o, rid in zip(olist, rids):
+                idx = rid.asnumpy().astype(_np.int64) \
+                    if isinstance(rid, NDArray) \
+                    else _np.asarray(rid, dtype=_np.int64)
+                dense = _np.zeros_like(full)
+                dense[idx] = full[idx]
+                o[:] = dense
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the servers; the update runs
+        server-side (reference: server-side `Executor` running the
+        pickled optimizer, kvstore_dist_server.h:95)."""
+        import pickle
+
+        if self._client is None:
+            return super().set_optimizer(optimizer)
+        if not isinstance(optimizer, Optimizer):
+            raise TypeError("optimizer must be an Optimizer")
+        self._optimizer = optimizer
+        if self._rank == 0:
+            self._client.set_optimizer(
+                pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL))
+        self.barrier()
+
+    def barrier(self):
+        if self._client is not None:
+            self._client.barrier()
+
+    def stop_servers(self):
+        """Send the stop command (reference: scheduler 'stop' on
+        finalize)."""
+        if self._client is not None:
+            self._client.stop_servers()
 
 
 def _key_value(key, value):

@@ -1,0 +1,319 @@
+"""Host-side parameter server: the `dist_async` backend.
+
+Reference: ps-lite worker/server (`src/kvstore/kvstore_dist.h:44`,
+`kvstore_dist_server.h:155`).  The reference's async mode applies each
+worker's push to the server-side weights IMMEDIATELY (no aggregation
+barrier — `DataHandleEx` async path, `kvstore_dist_server.h:325`), and
+the server runs the optimizer on CPU.  That is already a host-side
+service, so the TPU-native form keeps the same shape: a TCP server
+process holding the weights, applying the (pickled, worker-provided)
+optimizer per push, with workers pulling the latest weights.  Device
+compute (the jitted train step) is untouched — async staleness is a
+coordination policy, not a device concern.
+
+Sharding: keys are distributed across `num_servers` processes by
+`int_key % num_servers` (the analog of ps-lite's `EncodeDefaultKey`
+server assignment, `kvstore_dist.h:245`).  Server addresses come from
+`MXTPU_PS_PORTS` (comma-separated, set by `tools/launch.py`) with
+`DMLC_PS_ROOT_URI` as the host, falling back to
+`DMLC_PS_ROOT_PORT` for a single server.
+
+Wire format: 8-byte big-endian length + pickle.  Like ps-lite's ZMQ
+transport, this is an unauthenticated intra-cluster protocol: only run
+it on trusted networks (the launcher binds loopback by default).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+__all__ = ["PSServer", "PSClient", "server_addresses", "run_server"]
+
+
+def key_to_int(key):
+    """Stable int for a kv key (updater index + shard assignment); int
+    keys pass through like ps-lite's EncodeDefaultKey, string keys (the
+    Gluon/Module path) hash via crc32."""
+    if isinstance(key, int):
+        return key
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        import zlib
+
+        return zlib.crc32(str(key).encode())
+
+
+# ------------------------------------------------------------- transport --
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = _recv_exact(sock, 8)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">Q", hdr)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def server_addresses():
+    """(host, [ports]) for the PS fleet from the DMLC_*/MXTPU_* env."""
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    ports = os.environ.get("MXTPU_PS_PORTS", "")
+    if ports:
+        return host, [int(p) for p in ports.split(",") if p]
+    return host, [int(os.environ.get("DMLC_PS_ROOT_PORT", "9092"))]
+
+
+# ---------------------------------------------------------------- server --
+class PSServer:
+    """One shard of the parameter server.
+
+    Handlers mirror kvstore_dist_server.h: init stores, push applies the
+    updater immediately (async semantics), pull returns current weights,
+    set_optimizer installs the worker-pickled optimizer, barrier counts
+    num_workers arrivals.
+    """
+
+    def __init__(self, port=0, host="127.0.0.1", num_workers=None):
+        self._store = {}
+        self._locks = {}
+        self._store_lock = threading.Lock()
+        # the updater (and its Optimizer) carries cross-key state
+        # (num_update, schedulers) — per-key locks are not enough
+        self._opt_lock = threading.Lock()
+        self._updater = None
+        self._num_workers = num_workers if num_workers is not None else \
+            int(os.environ.get("DMLC_NUM_WORKER", 1))
+        self._barrier_cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stop = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]
+
+    # -- handler plumbing --------------------------------------------------
+    def serve_forever(self):
+        """Accept loop; one thread per worker connection.  Returns when a
+        stop command arrives and all connections drain."""
+        self._sock.settimeout(0.5)
+        threads = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=5)
+        self._sock.close()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # error surfaces on the worker
+                    reply = ("err", "%s: %s" % (type(e).__name__, e))
+                _send_msg(conn, reply)
+                if msg[0] == "stop":
+                    return
+        finally:
+            conn.close()
+
+    def _key_lock(self, key):
+        with self._store_lock:
+            if key not in self._locks:
+                self._locks[key] = threading.Lock()
+            return self._locks[key]
+
+    # -- handlers ----------------------------------------------------------
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, arr = msg
+            with self._key_lock(key):
+                self._store[key] = arr.copy()
+            return ("ok", None)
+        if op == "push":
+            _, key, grad = msg
+            with self._key_lock(key):
+                if key not in self._store:
+                    raise KeyError("key %r not initialized" % (key,))
+                self._apply(key, grad)
+            return ("ok", None)
+        if op == "pull":
+            _, key = msg
+            with self._key_lock(key):
+                if key not in self._store:
+                    raise KeyError("key %r not initialized" % (key,))
+                return ("ok", self._store[key].copy())
+        if op == "set_optimizer":
+            _, blob = msg
+            self._set_optimizer(blob)
+            return ("ok", None)
+        if op == "barrier":
+            self._barrier()
+            return ("ok", None)
+        if op == "stop":
+            self._stop.set()
+            return ("ok", None)
+        raise ValueError("unknown op %r" % (op,))
+
+    def _apply(self, key, grad):
+        """Async update: every push applies immediately (reference:
+        kvstore_dist_server.h DataHandleDefault async branch)."""
+        if self._updater is None:
+            # reference: "Updater needs to be set for async mode"
+            # (kvstore_dist_server.h:358 CHECK(sync_mode_))
+            raise RuntimeError(
+                "set_optimizer must be called before push on dist_async")
+        from .. import ndarray as nd
+
+        weight = nd.array(self._store[key])
+        with self._opt_lock:
+            self._updater(key_to_int(key), nd.array(grad), weight)
+        self._store[key] = weight.asnumpy()
+
+    def _set_optimizer(self, blob):
+        from .. import optimizer as opt_mod
+
+        optimizer = pickle.loads(blob)
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def _barrier(self):
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self._num_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_cv.notify_all()
+                return
+            ok = self._barrier_cv.wait_for(
+                lambda: self._barrier_gen != gen, timeout=300)
+            if not ok:
+                # withdraw our arrival so a late worker cannot release
+                # the NEXT generation with this stale count, then fail
+                # loudly (a silent release desynchronizes every
+                # subsequent barrier)
+                if self._barrier_gen == gen and self._barrier_count > 0:
+                    self._barrier_count -= 1
+                raise RuntimeError(
+                    "barrier timed out after 300s waiting for %d workers"
+                    % self._num_workers)
+
+
+def run_server(port=None, num_workers=None):
+    """Blocking server entry (reference: kvstore_server.py server loop)."""
+    if port is None:
+        _, ports = server_addresses()
+        idx = int(os.environ.get("MXTPU_PS_SERVER_ID",
+                                 os.environ.get("DMLC_SERVER_ID", 0)))
+        port = ports[idx % len(ports)]
+    server = PSServer(port=port, num_workers=num_workers)
+    server.serve_forever()
+
+
+# ---------------------------------------------------------------- client --
+class PSClient:
+    """Worker-side connections to every server shard; key → shard by
+    int_key % num_servers (reference: EncodeDefaultKey)."""
+
+    def __init__(self, connect_timeout=60):
+        import time
+
+        host, ports = server_addresses()
+        self._socks = []
+        for p in ports:
+            # the launcher Popens servers and workers back-to-back; a
+            # server binds its port only after its (slow) import, so
+            # refused connections are a startup race, not an error —
+            # retry until the deadline
+            deadline = time.monotonic() + connect_timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, p), timeout=300)
+                    break
+                except ConnectionRefusedError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+        self._lock = threading.Lock()
+
+    def _shard(self, key):
+        return self._socks[key_to_int(key) % len(self._socks)]
+
+    def _call(self, sock, msg):
+        with self._lock:
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+        if reply is None:
+            raise ConnectionError("parameter server closed the connection")
+        status, payload = reply
+        if status != "ok":
+            from ..base import MXNetError
+
+            raise MXNetError("parameter server error: %s" % payload)
+        return payload
+
+    def init(self, key, arr):
+        self._call(self._shard(key), ("init", key, arr))
+
+    def push(self, key, grad):
+        self._call(self._shard(key), ("push", key, grad))
+
+    def pull(self, key):
+        return self._call(self._shard(key), ("pull", key))
+
+    def set_optimizer(self, blob):
+        for s in self._socks:
+            self._call(s, ("set_optimizer", blob))
+
+    def barrier(self):
+        # every server counts all workers; hitting each keeps shards in step
+        for s in self._socks:
+            self._call(s, ("barrier",))
+
+    def stop_servers(self):
+        for s in self._socks:
+            try:
+                self._call(s, ("stop",))
+            except Exception:
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -1,0 +1,33 @@
+"""Parameter-server process bootstrap.
+
+Reference: python/mxnet/kvstore_server.py:28 — when DMLC_ROLE=server the
+interpreter becomes a blocking PS server instead of running user code.
+Launched by `tools/launch.py -s N` (or run directly:
+`DMLC_ROLE=server python -m mxnet_tpu.kvstore_server`).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["init_server", "main"]
+
+
+def init_server():
+    """If this process's DMLC_ROLE is 'server', serve until stopped and
+    return True; otherwise return False (worker processes continue)."""
+    if os.environ.get("DMLC_ROLE") != "server":
+        return False
+    from .kvstore.ps import run_server
+
+    run_server()
+    return True
+
+
+def main():
+    os.environ.setdefault("DMLC_ROLE", "server")
+    init_server()
+
+
+if __name__ == "__main__":
+    main()

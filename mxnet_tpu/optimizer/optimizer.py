@@ -223,7 +223,8 @@ class Test(Optimizer):
         return zeros(weight.shape, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
-        weight[:] = weight - grad * self.rescale_grad
+        """w += rescale_grad * grad (reference: optimizer.py:1600)."""
+        weight[:] = weight + grad * self.rescale_grad
         state[:] = weight
 
 

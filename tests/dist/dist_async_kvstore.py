@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""dist_async parameter-server checks, run as N worker processes + M
+server processes by `tools/launch.py -n 2 -s 2` (reference:
+tests/nightly/dist_async_kvstore.py and the async branch of
+kvstore_dist_server.h DataHandleEx)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank = kv.rank
+    nworker = kv.num_workers
+    assert kv.type == "dist_async"
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"])
+
+    shape = (4, 3)
+    # string AND int keys → exercises server sharding (key_to_int % S)
+    kv.init("w", mx.nd.zeros(shape))
+    kv.init(3, mx.nd.ones(shape))
+
+    # async mode REQUIRES a server-side optimizer (reference:
+    # kvstore_dist_server.h:358 "Updater needs to be set for async mode")
+    try:
+        kv.push("w", mx.nd.ones(shape))
+        raise AssertionError("push without optimizer should fail")
+    except MXNetError:
+        pass
+    kv.barrier()  # all workers hit the error path before the optimizer lands
+
+    # ship the optimizer once; the update runs server-side per push
+    # (Test optimizer: w += rescale_grad * grad)
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=1.0))
+
+    # every worker pushes (rank+1); async semantics: each push applies
+    # immediately, no aggregation barrier — after an explicit barrier the
+    # value is the sum over all workers' pushes
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    kv.barrier()
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(nworker))
+    assert np.allclose(out.asnumpy(), expect), (rank, out.asnumpy()[0, 0],
+                                                expect)
+
+    # a lone push from one rank lands without anyone else participating
+    # (Hogwild: workers run at their own pace)
+    if rank == 0:
+        kv.push(3, mx.nd.ones(shape))
+    kv.barrier()
+    out3 = mx.nd.zeros(shape)
+    kv.pull(3, out=out3)
+    assert np.allclose(out3.asnumpy(), 2.0), out3.asnumpy()[0, 0]
+
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+    print("worker %d/%d: dist_async_kvstore OK" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

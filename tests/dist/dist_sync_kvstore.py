@@ -22,8 +22,9 @@ def main():
     shape = (3, 4)
     kv.init("w", mx.nd.zeros(shape))
 
-    # each worker pushes rank+1; sync semantics: pulled value must be the
-    # sum over ALL workers (reference: dist_sync_kvstore.py check_default_keys)
+    # no updater: the stored value is REPLACED by the cross-worker
+    # reduction of one push round (reference: kvstore_dist_server.h:360
+    # CopyFromTo(merged, stored))
     kv.push("w", mx.nd.ones(shape) * (rank + 1))
     out = mx.nd.zeros(shape)
     kv.pull("w", out=out)
@@ -31,10 +32,27 @@ def main():
     got = out.asnumpy()
     assert np.allclose(got, expect), (rank, got[0, 0], expect)
 
-    # second round on the same key accumulates again
+    # a second round replaces again — no accumulation without an updater
     kv.push("w", mx.nd.ones(shape))
     kv.pull("w", out=out)
-    assert np.allclose(out.asnumpy(), expect + nworker)
+    assert np.allclose(out.asnumpy(), nworker)
+
+    # with the Test optimizer (w += rate * grad, reference:
+    # optimizer.py:1600), repeated pushes accumulate exactly like the
+    # reference nightly's check_default_keys: init 1 + rate * sum over
+    # workers * repeats
+    rate = 2.0
+    kv_opt = mx.kv.create("dist_sync")
+    kv_opt.set_optimizer(mx.optimizer.create("test", rescale_grad=rate))
+    kv_opt.init("3", mx.nd.ones(shape))
+    val = mx.nd.zeros(shape)
+    nrepeat = 3
+    for i in range(nrepeat):
+        kv_opt.push("3", mx.nd.ones(shape) * (rank + 1))
+        kv_opt.pull("3", out=val)
+        num = (nworker + 1) * nworker * rate / 2 * (i + 1) + 1
+        assert np.allclose(val.asnumpy(), num), (rank, val.asnumpy()[0, 0],
+                                                 num)
 
     # 2-bit gradient compression with error feedback (reference:
     # dist_sync_kvstore.py compute_expected_2bit_quantization — each

@@ -149,3 +149,19 @@ def test_launch_dist_sync_kvstore():
         env=env, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("dist_sync_kvstore OK") == 2, r.stdout + r.stderr
+
+
+def test_launch_dist_async_kvstore():
+    """launch.py -n 2 -s 2 spawns parameter servers + workers; async PS
+    semantics checked exactly (reference: tests/nightly/
+    dist_async_kvstore.py)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "2", sys.executable,
+         os.path.join(REPO, "tests", "dist", "dist_async_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("dist_async_kvstore OK") == 2, r.stdout + r.stderr

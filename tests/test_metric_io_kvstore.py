@@ -172,7 +172,13 @@ def test_kvstore_push_pull():
     assert (out.asnumpy() == 1).all()
     kv.push("w", mx.nd.ones((2, 2)) * 2)
     kv.pull("w", out=out)
-    assert (out.asnumpy() == 3).all()  # no updater → accumulate
+    # no updater → store holds the reduced push, REPLACING the old value
+    # (reference: kvstore_local.h:213 `local = merged`); this is what
+    # makes Trainer's push/pull return reduced gradients
+    assert (out.asnumpy() == 2).all()
+    kv.push("w", [mx.nd.ones((2, 2)), mx.nd.ones((2, 2)) * 4])
+    kv.pull("w", out=out)
+    assert (out.asnumpy() == 5).all()
 
 
 def test_kvstore_multi_device_reduce():
@@ -228,12 +234,12 @@ def test_gradient_compression_2bit():
     out = mx.nd.zeros((4,))
     kv.pull("w", out=out)
     assert_almost_equal(out, np.array([0.5, -0.5, 0.0, 0.0]))
-    # error feedback: residual 0.1+0.2=0.3 short of threshold, next push adds
+    # error feedback: residuals [0.1, -0.1, 0.2, 0] carry into the next
+    # push, which REPLACES the stored value with its quantized result:
+    # [0.3+0.1, 0-0.1, 0.4+0.2, 0] → [0, 0, +0.5, 0]
     kv.push("w", mx.nd.array([0.3, 0.0, 0.4, 0.0]))
     kv.pull("w", out=out)
-    # 0.3+residual(0.1)=0.4 <0.5 → 0 ; 0.2+0.4=0.6 → +0.5
-    assert_almost_equal(out, np.array([0.5, -0.6 + 0.0 - -0.1 * 0, 0.5, 0.0]),
-                        atol=0.11)
+    assert_almost_equal(out, np.array([0.0, 0.0, 0.5, 0.0]))
 
 
 def test_kvstore_type_and_rank():

@@ -152,6 +152,47 @@ def test_trainer_allreduce_then_update():
                             rtol=1e-6, atol=1e-7)
 
 
+def test_trainer_multi_context_matches_single():
+    """Two-context Trainer: per-ctx grads are summed through the kvstore
+    (push replaces the store with the reduction — reference:
+    kvstore_local.h:213) and each replica steps with the total gradient;
+    must equal a single-ctx run on the concatenated batch."""
+    rs = np.random.RandomState(3)
+    x = rs.randn(8, 3).astype(np.float32)
+
+    ref = gluon.nn.Dense(2)
+    ref.initialize()
+    ref(nd.zeros((1, 3)))
+
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    net = gluon.nn.Dense(2)
+    net.initialize(ctx=ctxs)
+    net(nd.zeros((1, 3), ctx=ctxs[0]))
+    for p, q in zip(ref.collect_params().values(),
+                    net.collect_params().values()):
+        for c in ctxs:
+            p.data().copyto(q.data(c))
+
+    tr_ref = gluon.Trainer(ref.collect_params(), "sgd",
+                           {"learning_rate": 0.2})
+    with autograd.record():
+        ref(nd.array(x)).sum().backward()
+    tr_ref.step(8)
+
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.2})
+    halves = gluon.utils.split_and_load(nd.array(x), ctxs)
+    with autograd.record():
+        for part in halves:
+            net(part).sum().backward()
+    tr.step(8)
+
+    for p, q in zip(ref.collect_params().values(),
+                    net.collect_params().values()):
+        for c in ctxs:
+            assert_almost_equal(p.data().asnumpy(), q.data(c).asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
 def test_trainer_invalid_grad_req():
     net = gluon.nn.Dense(2)
     net.initialize()

@@ -2,11 +2,14 @@
 """Cluster/process launcher (reference: tools/launch.py over the
 dmlc-core trackers — ssh/mpi/local).
 
-TPU-native shape: there are no parameter-server processes; workers form a
-jax.distributed process group (DCN collectives), so `-n N` launches N
-worker processes with the same DMLC_* env contract the reference sets
-(DMLC_ROLE/DMLC_WORKER_ID/DMLC_NUM_WORKER/DMLC_PS_ROOT_*), which
-DistKVStore reads (mxnet_tpu/kvstore/kvstore.py).  Only the local
+TPU-native shape: for `dist_sync` there are no parameter-server
+processes — workers form a jax.distributed process group (DCN
+collectives), so `-n N` launches N worker processes with the same DMLC_*
+env contract the reference sets (DMLC_ROLE/DMLC_WORKER_ID/
+DMLC_NUM_WORKER/DMLC_PS_ROOT_*), which DistKVStore reads
+(mxnet_tpu/kvstore/kvstore.py).  For `dist_async`, `-s N` additionally
+spawns N host-side PS server processes (mxnet_tpu/kvstore_server.py);
+their ports are handed to workers via MXTPU_PS_PORTS.  Only the local
 launcher is implemented; ssh/mpi cluster modes are host-scheduling
 concerns outside this container.
 """
@@ -32,8 +35,8 @@ def main(argv=None):
         usage="launch.py -n 4 python train.py ...")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=0,
-                        help="accepted for reference CLI parity; the TPU "
-                             "backend has no server processes")
+                        help="parameter-server processes to spawn "
+                             "(dist_async; dist_sync needs none)")
     parser.add_argument("--launcher", type=str, default="local",
                         choices=["local"])
     parser.add_argument("--sync-dst-dir", type=str, default=None)
@@ -43,22 +46,55 @@ def main(argv=None):
         parser.error("no command given")
 
     port = free_port()
+    common = {
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+    }
+
+    server_procs = []
+    if args.num_servers > 0:
+        ports = [free_port() for _ in range(args.num_servers)]
+        common["MXTPU_PS_PORTS"] = ",".join(str(p) for p in ports)
+        for sid in range(args.num_servers):
+            env = dict(os.environ)
+            env.update(common)
+            env.update({"DMLC_ROLE": "server",
+                        "MXTPU_PS_SERVER_ID": str(sid),
+                        # the PS is numpy/host-side; keep jax off any
+                        # accelerator the workers may be using
+                        "JAX_PLATFORMS": "cpu"})
+            server_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env))
+
     procs = []
     for rank in range(args.num_workers):
         env = dict(os.environ)
-        env.update({
-            "DMLC_ROLE": "worker",
-            "DMLC_WORKER_ID": str(rank),
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_NUM_SERVER": str(args.num_servers),
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-        })
+        env.update(common)
+        env.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(rank)})
         procs.append(subprocess.Popen(args.command, env=env))
     rc = 0
     for p in procs:
         p.wait()
         rc = rc or p.returncode
+    for p in server_procs:
+        # servers exit on the workers' stop command; reap stragglers so
+        # no zombies outlive the launcher
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.terminate()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        else:
+            # a server that failed on its own (port bind, bad optimizer)
+            # is the real fault even when workers also errored
+            if p.returncode > 0:
+                rc = rc or p.returncode
     return rc
 
 
