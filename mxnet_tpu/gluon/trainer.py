@@ -78,13 +78,26 @@ class Trainer:
                          [_optimizer.get_updater(self._optimizer)]
 
     def _init_kvstore(self):
-        if len(self._contexts) > 1 and self._kvstore_type:
+        """reference: trainer.py _init_kvstore — dist stores are used even
+        with one local context (the other replicas are other processes);
+        update_on_kvstore routes the optimizer server-side."""
+        is_dist = isinstance(self._kvstore_type, str) and \
+            "dist" in self._kvstore_type
+        if self._kvstore_type and (len(self._contexts) > 1 or is_dist):
             kv = _kvstore.create(self._kvstore_type) \
                 if isinstance(self._kvstore_type, str) else self._kvstore_type
+            if self._update_on_kvstore is None:
+                # async PS REQUIRES server-side updates; sync dist and
+                # local reduce default to worker-side updates
+                self._update_on_kvstore = "async" in kv.type
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     kv.init(i, p.data(self._contexts[0]))
             self._kvstore = kv
+        else:
+            self._update_on_kvstore = False
         self._kv_initialized = True
 
     @property
@@ -102,10 +115,30 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads across devices, then update
         (reference: trainer.py step:302)."""
+        # rescale BEFORE the kvstore ships the optimizer server-side
+        # (reference: step() calls _check_and_rescale_grad first; changing
+        # batch_size after init would silently use the stale rescale)
+        new_rescale = self._scale / batch_size
+        if self._kv_initialized and self._update_on_kvstore and \
+                new_rescale != self._optimizer.rescale_grad:
+            import warnings
+
+            warnings.warn("batch_size change detected after kvstore "
+                          "init; server-side optimizer keeps the "
+                          "original rescale_grad")
+        self._optimizer.rescale_grad = new_rescale
         if not self._kv_initialized:
             self._contexts = self._contexts or self._check_contexts()
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kvstore:
+            # server-side update: push grads, pull back fresh WEIGHTS
+            # (reference: trainer.py _update with update_on_kvstore)
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                self._kvstore.push(i, p.list_grad())
+                self._kvstore.pull(i, out=p.list_data())
+            return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 

@@ -312,6 +312,14 @@ class DistAsyncKVStore(KVStore):
             # must not dial a nonexistent server)
             self._client = None
             return
+        if int(os.environ.get("DMLC_NUM_SERVER", "1")) == 0:
+            # launched with -n but not -s: without this check the client
+            # would dial the jax.distributed coordinator port (which IS
+            # listening) and hang in recv instead of failing fast
+            raise MXNetError(
+                "dist_async needs parameter-server processes — relaunch "
+                "with `tools/launch.py -n %d -s <servers>`"
+                % self._num_workers)
         from .ps import PSClient
 
         try:

@@ -1,0 +1,138 @@
+"""Mixture-of-experts with expert parallelism over the 'ep' mesh axis.
+
+Absent in the reference (SURVEY.md §2.3: EP/MoE — NO); provided here
+because expert parallelism shapes the core design of a TPU framework.
+
+TPU-native formulation (Mesh-TensorFlow / GShard style): routing is
+expressed as dense einsums against a one-hot dispatch tensor with a
+fixed per-expert capacity — static shapes, MXU-friendly, no
+data-dependent gather.  Sharding the expert axis of the weights and the
+dispatched activations over 'ep' makes GSPMD insert the all-to-alls;
+there is no hand-written communication here at all, which is exactly
+how EP should look under XLA.
+
+    moe = MoEFFN(d_model=512, d_hidden=2048, n_experts=8)
+    params = moe.init(rng)
+    y, aux_loss = moe.apply(params, x)          # x: (batch, seq, d)
+
+Shard with `moe.param_specs()` / data over 'dp' under jit; works
+unsharded on one device too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MoEFFN"]
+
+
+class MoEFFN:
+    """Top-2 gated expert feed-forward block (GShard routing rules).
+
+    capacity_factor bounds tokens per expert: C = ceil(cf * T * 2 / E)
+    per batch row; overflow tokens drop to the residual path (their
+    combine weight is 0) — the standard fixed-capacity formulation.
+    """
+
+    def __init__(self, d_model, d_hidden, n_experts, capacity_factor=1.25,
+                 axis="ep"):
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.axis = axis
+
+    def init(self, rng, dtype=jnp.float32):
+        import numpy as np
+
+        rs = np.random.RandomState(
+            int(jax.random.randint(rng, (), 0, 2**31 - 1)))
+        d, h, e = self.d_model, self.d_hidden, self.n_experts
+        s1 = (2.0 / (d + h)) ** 0.5
+        return {
+            "gate": jnp.asarray(rs.randn(d, e) * (1.0 / d) ** 0.5,
+                                dtype=dtype),
+            "wi": jnp.asarray(rs.randn(e, d, h) * s1, dtype=dtype),
+            "wo": jnp.asarray(rs.randn(e, h, d) * s1, dtype=dtype),
+        }
+
+    def param_specs(self):
+        """PartitionSpecs sharding the expert axis over 'ep'."""
+        from jax.sharding import PartitionSpec as P
+
+        return {"gate": P(), "wi": P(self.axis, None, None),
+                "wo": P(self.axis, None, None)}
+
+    def capacity(self, tokens_per_row):
+        import math
+
+        return max(1, math.ceil(self.capacity_factor * tokens_per_row * 2
+                                / self.n_experts))
+
+    def apply(self, params, x):
+        """x: (B, S, d) → (y, aux_loss).
+
+        aux_loss is the GShard load-balancing loss (mean over experts of
+        fraction_routed * mean_gate_prob * E); add it to the task loss.
+        """
+        B, S, d = x.shape
+        E = self.n_experts
+        C = self.capacity(S)
+
+        logits = jnp.einsum("bsd,de->bse", x, params["gate"])
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-2 expert choice per token
+        g1 = jnp.argmax(probs, axis=-1)                      # (B, S)
+        p1 = jnp.take_along_axis(probs, g1[..., None], -1)[..., 0]
+        masked = probs - jax.nn.one_hot(g1, E) * probs
+        g2 = jnp.argmax(masked, axis=-1)
+        p2 = jnp.take_along_axis(masked, g2[..., None], -1)[..., 0]
+
+        # position of each token in its expert's buffer (capacity C);
+        # tokens past C overflow (mask -> 0)
+        def positions(g):
+            onehot = jax.nn.one_hot(g, E)                    # (B, S, E)
+            pos = jnp.cumsum(onehot, axis=1) * onehot        # 1-based
+            return onehot, pos
+        oh1, pos1 = positions(g1)
+        # expert-1 claims count against expert-2's buffer too
+        oh2_raw = jax.nn.one_hot(g2, E)
+        used = jnp.sum(oh1, axis=1, keepdims=True)           # (B, 1, E)
+        pos2 = (jnp.cumsum(oh2_raw, axis=1) + used) * oh2_raw
+        oh2 = oh2_raw
+
+        keep1 = (pos1 > 0) & (pos1 <= C)
+        keep2 = (pos2 > 0) & (pos2 <= C)
+
+        # normalized combine weights; dropped tokens keep weight 0
+        denom = p1 + p2 + 1e-9
+        w1 = jnp.where(jnp.any(keep1, -1), p1 / denom, 0.0)
+        w2 = jnp.where(jnp.any(keep2, -1), p2 / denom, 0.0)
+
+        slot1 = jax.nn.one_hot(
+            (jnp.sum(pos1, -1) - 1).astype(jnp.int32), C)   # (B, S, C)
+        slot2 = jax.nn.one_hot(
+            (jnp.sum(pos2, -1) - 1).astype(jnp.int32), C)
+        # dispatch tensor (B, S, E, C): token s -> (expert, slot)
+        disp = (keep1[..., None] * oh1[..., None] * slot1[:, :, None, :] +
+                keep2[..., None] * oh2[..., None] * slot2[:, :, None, :])
+        comb = (w1[..., None, None] * keep1[..., None] * oh1[..., None] *
+                slot1[:, :, None, :] +
+                w2[..., None, None] * keep2[..., None] * oh2[..., None] *
+                slot2[:, :, None, :])
+
+        # all-to-all happens HERE under GSPMD: expert axis of `buf`
+        # is sharded over 'ep' while s is dp/sp-sharded
+        buf = jnp.einsum("bsec,bsd->becd", disp, x)          # (B, E, C, d)
+        hid = jax.nn.relu(jnp.einsum("becd,edh->bech", buf, params["wi"]))
+        out = jnp.einsum("bech,ehd->becd", hid, params["wo"])
+        y = jnp.einsum("bsec,becd->bsd", comb, out)
+
+        # load-balancing auxiliary loss (GShard eq. 4): encourages the
+        # top-1 routing fraction to match the mean gate probability
+        frac = jnp.mean(oh1, axis=(0, 1))                    # (E,)
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        aux = jnp.sum(frac * mean_prob) * E
+        return y, aux

@@ -60,6 +60,32 @@ def main():
     kv.pull(3, out=out3)
     assert np.allclose(out3.asnumpy(), 2.0), out3.asnumpy()[0, 0]
 
+    # ---- Gluon Trainer end-to-end over the async PS ----------------
+    # (reference: dist_async_kvstore.py test_gluon_trainer_type — here
+    # with exact-value verification of the server-side SGD update)
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(2, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    net(mx.nd.zeros((1, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0}, kvstore="dist_async")
+    x = mx.nd.ones((4, 3))
+    with autograd.record():
+        net(x).sum().backward()
+    g = net.weight.grad().asnumpy()
+    tr.step(4)          # ships SGD server-side, pushes grad, pulls weight
+    assert tr._update_on_kvstore is True
+    kv2_barrier = tr._kvstore
+    kv2_barrier.barrier()   # both workers' pushes applied
+    out_w = mx.nd.zeros(net.weight.shape)
+    kv2_barrier.pull(0, out=out_w)
+    # both workers pushed the same grad; server applied SGD twice:
+    # w = 0.5 - 1.0 * (g/4) * nworker
+    expect_w = 0.5 - (g / 4) * nworker
+    assert np.allclose(out_w.asnumpy(), expect_w, atol=1e-5), \
+        (out_w.asnumpy()[0, 0], expect_w[0, 0])
+
     kv.barrier()
     if rank == 0:
         kv.stop_servers()
