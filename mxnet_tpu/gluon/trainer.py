@@ -81,11 +81,17 @@ class Trainer:
         """reference: trainer.py _init_kvstore — dist stores are used even
         with one local context (the other replicas are other processes);
         update_on_kvstore routes the optimizer server-side."""
-        is_dist = isinstance(self._kvstore_type, str) and \
-            "dist" in self._kvstore_type
-        if self._kvstore_type and (len(self._contexts) > 1 or is_dist):
+        kv = None
+        if self._kvstore_type:
             kv = _kvstore.create(self._kvstore_type) \
                 if isinstance(self._kvstore_type, str) else self._kvstore_type
+        # a dist store synchronizes across PROCESSES, so one local
+        # context is the normal layout; local stores only matter with
+        # multiple local contexts
+        if kv is not None and "dist" not in kv.type and \
+                len(self._contexts) <= 1:
+            kv = None
+        if kv is not None:
             if self._update_on_kvstore is None:
                 # async PS REQUIRES server-side updates; sync dist and
                 # local reduce default to worker-side updates
@@ -145,6 +151,14 @@ class Trainer:
     def allreduce_grads(self):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            # reference: trainer.py raises — with a server-side optimizer
+            # a push already UPDATES, so the two-phase workflow would pull
+            # weights into gradient buffers and corrupt training
+            raise ValueError(
+                "allreduce_grads() is not supported when updates run on "
+                "the kvstore (update_on_kvstore=True); use step() or pass "
+                "update_on_kvstore=False")
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -159,6 +173,11 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            raise ValueError(
+                "update() is not supported when updates run on the "
+                "kvstore (update_on_kvstore=True); use step() or pass "
+                "update_on_kvstore=False")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 

@@ -174,6 +174,16 @@ class KVStore:
     def barrier(self):
         pass
 
+    def _send_command_to_servers(self, head, body):
+        """reference: MXKVStoreSendCommmandToServers.  In-process stores
+        have no server processes; failing loudly beats the silent no-op
+        (a 'server profiling' request that goes nowhere would surface
+        only as a mysteriously missing trace file later)."""
+        raise MXNetError(
+            "kvstore type %r has no server processes to command — server "
+            "commands need 'dist_async' under tools/launch.py -s N"
+            % self._type)
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not set"
         with open(fname, "wb") as f:
@@ -394,6 +404,7 @@ class DistAsyncKVStore(KVStore):
         """Ship the optimizer to the servers; the update runs
         server-side (reference: server-side `Executor` running the
         pickled optimizer, kvstore_dist_server.h:95)."""
+        import copy
         import pickle
 
         if self._client is None:
@@ -402,13 +413,33 @@ class DistAsyncKVStore(KVStore):
             raise TypeError("optimizer must be an Optimizer")
         self._optimizer = optimizer
         if self._rank == 0:
+            # strip param_dict before shipping: it holds live Parameters
+            # whose pickling embeds full weight tensors — the server only
+            # needs the per-index multipliers (reference: server gets the
+            # optimizer string, not the weights)
+            wire = copy.copy(optimizer)
+            wire.param_dict = {}
+            wire.lr_mult = dict(optimizer.lr_mult)
+            wire.wd_mult = dict(optimizer.wd_mult)
+            for idx, p in optimizer.param_dict.items():
+                if getattr(p, "lr_mult", 1.0) != 1.0:
+                    wire.lr_mult[idx] = p.lr_mult
+                if getattr(p, "wd_mult", 1.0) != 1.0:
+                    wire.wd_mult[idx] = p.wd_mult
             self._client.set_optimizer(
-                pickle.dumps(optimizer, protocol=pickle.HIGHEST_PROTOCOL))
+                pickle.dumps(wire, protocol=pickle.HIGHEST_PROTOCOL))
         self.barrier()
 
     def barrier(self):
         if self._client is not None:
             self._client.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        """Generic controller channel (reference: ps-lite server commands
+        — stop/set-optimizer/gradient-compression/profiler)."""
+        if self._client is None:
+            return super()._send_command_to_servers(head, body)  # raises
+        self._client.send_command(head, body)
 
     def stop_servers(self):
         """Send the stop command (reference: scheduler 'stop' on

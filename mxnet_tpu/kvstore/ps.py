@@ -164,21 +164,30 @@ class PSServer:
             return ("ok", None)
         if op == "push":
             _, key, grad = msg
-            with self._key_lock(key):
-                if key not in self._store:
-                    raise KeyError("key %r not initialized" % (key,))
-                self._apply(key, grad)
+            from .. import profiler
+
+            with profiler.scope("ps_push:%s" % (key,), "kvstore"):
+                with self._key_lock(key):
+                    if key not in self._store:
+                        raise KeyError("key %r not initialized" % (key,))
+                    self._apply(key, grad)
             return ("ok", None)
         if op == "pull":
             _, key = msg
-            with self._key_lock(key):
-                if key not in self._store:
-                    raise KeyError("key %r not initialized" % (key,))
-                return ("ok", self._store[key].copy())
+            from .. import profiler
+
+            with profiler.scope("ps_pull:%s" % (key,), "kvstore"):
+                with self._key_lock(key):
+                    if key not in self._store:
+                        raise KeyError("key %r not initialized" % (key,))
+                    return ("ok", self._store[key].copy())
         if op == "set_optimizer":
             _, blob = msg
             self._set_optimizer(blob)
             return ("ok", None)
+        if op == "command":
+            _, head, body = msg
+            return ("ok", self._command(head, body))
         if op == "barrier":
             self._barrier()
             return ("ok", None)
@@ -207,6 +216,35 @@ class PSServer:
 
         optimizer = pickle.loads(blob)
         self._updater = opt_mod.get_updater(optimizer)
+
+    def _command(self, head, body):
+        """Controller channel (reference: ps-lite server commands;
+        KVStoreServerProfilerCommand include/mxnet/kvstore.h:49).
+        'profiler' drives this server process's profiler so pushes can be
+        traced server-side (reference: tests/nightly/
+        test_server_profiling.py)."""
+        if head != "profiler":
+            raise ValueError("unknown server command %r" % (head,))
+        import json as _json
+
+        from .. import profiler
+
+        req = _json.loads(body)
+        fn, kwargs = req["fn"], req.get("kwargs", {})
+        if fn == "set_config":
+            if "filename" in kwargs:
+                # each server shard writes its own trace
+                base, ext = os.path.splitext(kwargs["filename"])
+                sid = os.environ.get("MXTPU_PS_SERVER_ID", "0")
+                kwargs["filename"] = "%s.server%s%s" % (base, sid, ext)
+            profiler.set_config(**kwargs)
+        elif fn == "set_state":
+            profiler.set_state(**kwargs)
+        elif fn == "dump":
+            return profiler.dump()
+        else:
+            raise ValueError("unknown profiler fn %r" % (fn,))
+        return None
 
     def _barrier(self):
         with self._barrier_cv:
@@ -298,6 +336,10 @@ class PSClient:
     def set_optimizer(self, blob):
         for s in self._socks:
             self._call(s, ("set_optimizer", blob))
+
+    def send_command(self, head, body):
+        for s in self._socks:
+            self._call(s, ("command", head, body))
 
     def barrier(self):
         # every server counts all workers; hitting each keeps shards in step

@@ -33,13 +33,41 @@ _state = {
 }
 
 
+_kvstore_handle = None
+
+
+def set_kvstore_handle(kv):
+    """Register the kvstore used to reach parameter-server processes
+    (reference: profiler.py set_kvstore_handle — enables
+    profile_process='server')."""
+    global _kvstore_handle
+    _kvstore_handle = kv
+
+
+def _server_command(fn, kwargs):
+    import json as _json
+
+    if _kvstore_handle is None:
+        raise ValueError("profile_process='server' needs "
+                         "profiler.set_kvstore_handle(kv) first")
+    _kvstore_handle._send_command_to_servers(
+        "profiler", _json.dumps({"fn": fn, "kwargs": kwargs}))
+
+
 def set_config(**kwargs):
-    """reference: profiler.py:33 set_config."""
+    """reference: profiler.py:33 set_config.  With
+    profile_process='server' the config is forwarded to every
+    parameter-server process (reference: KVStoreServerProfilerCommand,
+    include/mxnet/kvstore.h:49)."""
+    if kwargs.pop("profile_process", "worker") == "server":
+        return _server_command("set_config", kwargs)
     _state["config"].update(kwargs)
 
 
 def set_state(state="stop", profile_process="worker"):
     """'run' | 'stop' (reference: profiler.py:89)."""
+    if profile_process == "server":
+        return _server_command("set_state", {"state": state})
     if state == "run":
         _state["running"] = True
     elif state == "stop":
@@ -87,6 +115,8 @@ class scope:
 
 def dump(finished=True, profile_process="worker"):
     """Write chrome-tracing JSON (reference: profiler.py dump)."""
+    if profile_process == "server":
+        return _server_command("dump", {})
     fname = _state["config"].get("filename", "profile.json")
     with _state["lock"]:
         events = list(_state["events"])

@@ -86,6 +86,33 @@ def main():
     assert np.allclose(out_w.asnumpy(), expect_w, atol=1e-5), \
         (out_w.asnumpy()[0, 0], expect_w[0, 0])
 
+    # ---- server-side profiling over the command channel ------------
+    # (reference: tests/nightly/test_server_profiling.py,
+    # KVStoreServerProfilerCommand)
+    kv.barrier()
+    if rank == 0:
+        import glob
+        import json as _json
+
+        from mxnet_tpu import profiler
+
+        profiler.set_kvstore_handle(kv)
+        prof_base = "test_ps_profile_%d.json" % os.getpid()
+        profiler.set_config(profile_process="server", filename=prof_base)
+        profiler.set_state("run", profile_process="server")
+        kv.push("w", mx.nd.ones(shape))     # traced server-side
+        kv.pull("w", out=out)
+        profiler.set_state("stop", profile_process="server")
+        profiler.dump(profile_process="server")
+        traces = glob.glob(prof_base.replace(".json", ".server*.json"))
+        assert traces, "no server trace files written"
+        seen = []
+        for t in traces:
+            with open(t) as f:
+                seen += [e["name"] for e in _json.load(f)["traceEvents"]]
+            os.remove(t)
+        assert any(n.startswith("ps_push") for n in seen), seen
+
     kv.barrier()
     if rank == 0:
         kv.stop_servers()

@@ -1,0 +1,106 @@
+"""Thread-local state isolation.
+
+Reference: tests/python/unittest/test_thread_local.py — AttrScope,
+Context, NameManager, and autograd recording state must not leak across
+threads (each lives in a threading.local; reference: the thread-local
+`*_current` pointers in python/mxnet).
+"""
+
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.base import AttrScope, NameManager
+
+
+def _run_in_thread(fn):
+    out, err = [], []
+
+    def wrap():
+        try:
+            out.append(fn())
+        except BaseException as e:  # surface thread failures to the test
+            err.append(e)
+
+    t = threading.Thread(target=wrap)
+    t.start()
+    t.join(60)
+    if err:
+        raise err[0]
+    return out[0]
+
+
+def test_context_thread_local():
+    """The `with ctx:` default is per-thread (reference:
+    test_thread_local.py test_context)."""
+    with mx.Context("cpu", 1):
+        assert mx.current_context().device_id == 1
+
+        def other():
+            return mx.current_context().device_typeid if False else \
+                mx.current_context().device_id
+
+        # the spawned thread sees the process default, not this scope
+        assert _run_in_thread(other) == 0
+        assert mx.current_context().device_id == 1
+
+
+def test_attrscope_thread_local():
+    with AttrScope(group="g1"):
+        def other():
+            sym = mx.sym.Variable("x")
+            return (sym.attr("group") or "none")
+
+        assert _run_in_thread(other) == "none"
+        here = mx.sym.Variable("y")
+        assert here.attr("group") == "g1"
+
+
+def test_name_manager_thread_local():
+    """Auto-naming counters are per-thread-scope, so symbols created on
+    another thread do not consume this thread's names."""
+    def make():
+        return mx.sym.FullyConnected(mx.sym.Variable("d"),
+                                     num_hidden=2).name
+
+    n_main_1 = make()
+    n_other = _run_in_thread(make)
+    n_main_2 = make()
+    # the other thread's creation must not have advanced main's counter
+    # by more than one step
+    assert n_main_1 != n_main_2
+    assert isinstance(n_other, str)
+
+
+def test_autograd_recording_thread_local():
+    """record() on the main thread must not put other threads in
+    recording mode (reference: autograd is thread-local state)."""
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+
+    def other_is_recording():
+        return autograd.is_recording()
+
+    with autograd.record():
+        assert autograd.is_recording()
+        assert _run_in_thread(other_is_recording) is False
+        y = (x * 2).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2)
+    assert not autograd.is_recording()
+
+
+def test_blockscope_create_in_thread():
+    """Gluon blocks can be constructed and run on a worker thread
+    (reference: test_thread_local.py test_createblock/symbol_basic)."""
+    def build_and_run():
+        from mxnet_tpu import gluon
+
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        return net(nd.ones((2, 3))).shape
+
+    assert _run_in_thread(build_and_run) == (2, 4)
