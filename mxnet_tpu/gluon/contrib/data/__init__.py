@@ -1,0 +1,7 @@
+"""Gluon contrib data (reference: python/mxnet/gluon/contrib/data/):
+IntervalSampler + language-model datasets."""
+
+from __future__ import annotations
+
+from .sampler import IntervalSampler  # noqa: F401
+from .text import WikiText2, WikiText103  # noqa: F401

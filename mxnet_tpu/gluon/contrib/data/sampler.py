@@ -1,0 +1,29 @@
+"""Dataset samplers (reference: python/mxnet/gluon/contrib/data/
+sampler.py)."""
+
+from __future__ import annotations
+
+from ...data import sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(sampler.Sampler):
+    """Samples [0, length) at fixed intervals; with rollover, restarts
+    from each skipped offset until all items are visited (reference:
+    contrib/data/sampler.py IntervalSampler)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, \
+            "Interval %d must be <= length %d" % (interval, length)
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        return self._length

@@ -1,0 +1,103 @@
+"""Language-model datasets (reference: python/mxnet/gluon/contrib/data/
+text.py WikiText2/WikiText103).
+
+Zero-egress container: the reference downloads the corpora; here the
+constructor reads a LOCAL copy (``root/wiki.<segment>.tokens``) with the
+same tokenization/EOS/indexing semantics, and raises a clear error
+explaining how to provide the file when it is absent.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ....base import MXNetError
+from ... import data as _data
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(_data.dataset.Dataset):
+    _name = "wikitext"
+
+    def __init__(self, root, segment, seq_len):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self.vocabulary = None
+        self._get_data()
+
+    def _file_path(self):
+        return os.path.join(self._root,
+                            "wiki.%s.tokens" % self._segment)
+
+    def _get_data(self):
+        path = self._file_path()
+        if not os.path.exists(path):
+            raise MXNetError(
+                "%s: %s not found. Downloads are unavailable in this "
+                "environment — place the extracted %s corpus file at "
+                "that path (same format as the reference's "
+                "gluon/dataset/%s archive)."
+                % (type(self).__name__, path, self._name, self._name))
+        with io.open(path, "r", encoding="utf8") as fin:
+            content = fin.read()
+        self._build_vocab(content)
+        raw_data = [line for line in
+                    [x.strip().split() for x in content.splitlines()] if line]
+        for line in raw_data:
+            line.append(EOS_TOKEN)
+        flat = [x for line in raw_data for x in line if x]
+        idx = [self._vocab_map[t] for t in flat]
+        data, label = np.array(idx[0:-1], np.int32), np.array(idx[1:],
+                                                              np.int32)
+        n = (len(data) // self._seq_len) * self._seq_len
+        from ... import data as gdata  # noqa: F401 (package init ordering)
+        from .... import ndarray as nd
+
+        self._data = nd.array(data[:n].reshape(-1, self._seq_len),
+                              dtype="int32")
+        self._label = nd.array(label[:n].reshape(-1, self._seq_len),
+                               dtype="int32")
+
+    def _build_vocab(self, content):
+        tokens = sorted(set(content.split()) | {EOS_TOKEN})
+        self._vocab_map = {t: i for i, t in enumerate(tokens)}
+        try:
+            from ....contrib.text import Vocabulary
+
+            self.vocabulary = Vocabulary(
+                {t: 1 for t in tokens})
+        except Exception:
+            self.vocabulary = None
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference: contrib/data/text.py:105)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-2"),
+                 segment="train", seq_len=35):
+        self._name = "wikitext-2"
+        super().__init__(root, segment, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference: contrib/data/text.py:143)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-103"),
+                 segment="train", seq_len=35):
+        self._name = "wikitext-103"
+        super().__init__(root, segment, seq_len)

@@ -1,6 +1,6 @@
 """Gluon contrib nn layers (reference: python/mxnet/gluon/contrib/nn/
 basic_layers.py: Concurrent, HybridConcurrent, Identity, SparseEmbedding,
-SyncBatchNorm).
+SyncBatchNorm, PixelShuffle1D/2D/3D).
 """
 
 from __future__ import annotations
@@ -8,7 +8,9 @@ from __future__ import annotations
 from ...block import Block, HybridBlock
 from ...nn import BatchNorm, HybridSequential, Sequential
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
 
 
 class Concurrent(Sequential):
@@ -68,3 +70,104 @@ class SyncBatchNorm(BatchNorm):
                          running_mean_initializer=running_mean_initializer,
                          running_variance_initializer=running_variance_initializer,
                          in_channels=in_channels, **kwargs)
+
+
+class SparseEmbedding(Block):
+    """Embedding whose gradient is row_sparse — only looked-up rows are
+    touched by lazy optimizers (reference: basic_layers.py:118
+    SparseEmbedding; meant for very large vocabularies with
+    sparse-capable optimizers)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._dtype = dtype
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse")
+
+    def forward(self, x):
+        from .... import ndarray
+
+        return ndarray.Embedding(x, self.weight.data(x.context),
+                                 input_dim=self._input_dim,
+                                 output_dim=self._output_dim,
+                                 dtype=self._dtype, sparse_grad=True)
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d, %s)" % (
+            self._input_dim, self._output_dim, self._dtype)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Upsample (N, C*f, W) -> (N, C, W*f) (reference:
+    basic_layers.py:244)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        x = F.reshape(x, (0, -4, -1, f, 0))  # (N, C, f, W)
+        x = F.transpose(x, (0, 1, 3, 2))     # (N, C, W, f)
+        x = F.reshape(x, (0, 0, -3))         # (N, C, W*f)
+        return x
+
+    def __repr__(self):
+        return "PixelShuffle1D(%d)" % self._factor
+
+
+class PixelShuffle2D(HybridBlock):
+    """Upsample (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference:
+    basic_layers.py:292)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == 2
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        x = F.reshape(x, (0, -4, -1, f1 * f2, 0, 0))  # (N, C, f1*f2, H, W)
+        x = F.reshape(x, (0, 0, -4, f1, f2, 0, 0))    # (N, C, f1, f2, H, W)
+        x = F.transpose(x, (0, 1, 4, 2, 5, 3))        # (N, C, H, f1, W, f2)
+        x = F.reshape(x, (0, 0, -3, -3))              # (N, C, H*f1, W*f2)
+        return x
+
+    def __repr__(self):
+        return "PixelShuffle2D(%s)" % (self._factors,)
+
+
+class PixelShuffle3D(HybridBlock):
+    """Upsample (N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (reference: basic_layers.py:354)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        try:
+            self._factors = (int(factor),) * 3
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == 3
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        # (N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+        x = F.reshape(x, (0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.reshape(x, (0, 0, -4, f1, f2 * f3, 0, 0, 0))
+        x = F.reshape(x, (0, 0, 0, -4, f2, f3, 0, 0, 0))
+        # (N, C, f1, f2, f3, D, H, W) -> (N, C, D, f1, H, f2, W, f3)
+        x = F.transpose(x, (0, 1, 5, 2, 6, 3, 7, 4))
+        x = F.reshape(x, (0, 0, -3, -3, -3))
+        return x
+
+    def __repr__(self):
+        return "PixelShuffle3D(%s)" % (self._factors,)

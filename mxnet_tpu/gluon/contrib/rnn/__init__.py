@@ -1,13 +1,20 @@
 """Gluon contrib rnn (reference: python/mxnet/gluon/contrib/rnn/):
-Conv1DRNNCell-style cells and VariationalDropoutCell are represented by
-the core cells; LSTMPCell (projected LSTM) provided here.
+convolutional recurrent cells, VariationalDropoutCell, and LSTMPCell
+(projected LSTM).
 """
 
 from __future__ import annotations
 
 from ...rnn.rnn_cell import HybridRecurrentCell
+from .conv_rnn_cell import (Conv1DGRUCell, Conv1DLSTMCell, Conv1DRNNCell,
+                            Conv2DGRUCell, Conv2DLSTMCell, Conv2DRNNCell,
+                            Conv3DGRUCell, Conv3DLSTMCell, Conv3DRNNCell)
+from .rnn_cell import VariationalDropoutCell
 
-__all__ = ["LSTMPCell"]
+__all__ = ["LSTMPCell", "VariationalDropoutCell",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
 
 
 class LSTMPCell(HybridRecurrentCell):

@@ -1,0 +1,201 @@
+"""Convolutional recurrent cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py
+(Conv{1,2,3}D{RNN,LSTM,GRU}Cell) — recurrent cells whose input-to-hidden
+and hidden-to-hidden transforms are convolutions, keeping spatial
+structure in the state.  The h2h convolution must preserve the spatial
+shape (odd kernel, stride 1, pad = dilate*(k-1)//2), as the reference
+asserts.
+"""
+
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(val, n, name):
+    if isinstance(val, int):
+        return (val,) * n
+    val = tuple(val)
+    assert len(val) == n, "%s must have %d elements" % (name, n)
+    return val
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    """Shared machinery: i2h/h2h conv parameters + spatial state shape
+    inference (reference: conv_rnn_cell.py:37 _BaseConvRNNCell)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert conv_layout.startswith("NC"), (
+            "only channel-first layouts (NCW/NCHW/NCDHW) are supported, "
+            "got %r" % (conv_layout,))
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        self._i2h_kernel = _tup(i2h_kernel, dims, "i2h_kernel")
+        self._h2h_kernel = _tup(h2h_kernel, dims, "h2h_kernel")
+        assert all(k % 2 == 1 for k in self._h2h_kernel), (
+            "h2h_kernel must be odd to preserve the state shape, got %s"
+            % (self._h2h_kernel,))
+        self._i2h_pad = _tup(i2h_pad, dims, "i2h_pad")
+        self._i2h_dilate = _tup(i2h_dilate, dims, "i2h_dilate")
+        self._h2h_dilate = _tup(h2h_dilate, dims, "h2h_dilate")
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        in_c = self._input_shape[0]
+        spatial = self._input_shape[1:]
+        self._state_shape = (hidden_channels,) + tuple(
+            (s + 2 * p - d * (k - 1) - 1) + 1
+            for s, p, d, k in zip(spatial, self._i2h_pad, self._i2h_dilate,
+                                  self._i2h_kernel))
+
+        ng = self._num_gates
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * hidden_channels, in_c) +
+            self._i2h_kernel, init=i2h_weight_initializer,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(ng * hidden_channels, hidden_channels) +
+            self._h2h_kernel, init=h2h_weight_initializer,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hidden_channels,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hidden_channels,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}] * self._n_states
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        ng = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel,
+                            stride=(1,) * self._dims,
+                            pad=self._i2h_pad, dilate=self._i2h_dilate,
+                            num_filter=ng * self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel,
+                            stride=(1,) * self._dims,
+                            pad=self._h2h_pad, dilate=self._h2h_dilate,
+                            num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _gate_names = ("",)
+    _n_states = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _gate_names = ("_i", "_f", "_c", "_o")
+    _n_states = 2
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        in_gate, forget_gate, in_trans, out_gate = F.SliceChannel(
+            gates, num_outputs=4, axis=1)
+        in_gate = F.Activation(in_gate, act_type="sigmoid")
+        forget_gate = F.Activation(forget_gate, act_type="sigmoid")
+        in_trans = F.Activation(in_trans, act_type=self._activation)
+        out_gate = F.Activation(out_gate, act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * F.Activation(next_c, act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _gate_names = ("_r", "_z", "_o")
+    _n_states = 1
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_o = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_o = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        trans = F.Activation(i2h_o + reset * h2h_o,
+                             act_type=self._activation)
+        out = (1.0 - update) * trans + update * states[0]
+        return out, [out]
+
+
+def _make(cell_base, dims, layout, default_act):
+    class Cell(cell_base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=(0,) * dims, i2h_dilate=(1,) * dims,
+                     h2h_dilate=(1,) * dims, i2h_weight_initializer=None,
+                     h2h_weight_initializer=None,
+                     i2h_bias_initializer="zeros",
+                     h2h_bias_initializer="zeros", conv_layout=layout,
+                     activation=default_act, prefix=None, params=None):
+            super().__init__(input_shape=input_shape,
+                             hidden_channels=hidden_channels,
+                             i2h_kernel=i2h_kernel, h2h_kernel=h2h_kernel,
+                             i2h_pad=i2h_pad, i2h_dilate=i2h_dilate,
+                             h2h_dilate=h2h_dilate,
+                             i2h_weight_initializer=i2h_weight_initializer,
+                             h2h_weight_initializer=h2h_weight_initializer,
+                             i2h_bias_initializer=i2h_bias_initializer,
+                             h2h_bias_initializer=h2h_bias_initializer,
+                             dims=dims, conv_layout=conv_layout,
+                             activation=activation, prefix=prefix,
+                             params=params)
+
+    return Cell
+
+
+Conv1DRNNCell = _make(_ConvRNNCell, 1, "NCW", "tanh")
+Conv2DRNNCell = _make(_ConvRNNCell, 2, "NCHW", "tanh")
+Conv3DRNNCell = _make(_ConvRNNCell, 3, "NCDHW", "tanh")
+Conv1DLSTMCell = _make(_ConvLSTMCell, 1, "NCW", "tanh")
+Conv2DLSTMCell = _make(_ConvLSTMCell, 2, "NCHW", "tanh")
+Conv3DLSTMCell = _make(_ConvLSTMCell, 3, "NCDHW", "tanh")
+Conv1DGRUCell = _make(_ConvGRUCell, 1, "NCW", "tanh")
+Conv2DGRUCell = _make(_ConvGRUCell, 2, "NCHW", "tanh")
+Conv3DGRUCell = _make(_ConvGRUCell, 3, "NCDHW", "tanh")
+
+for _name, _cls in list(globals().items()):
+    if _name.startswith("Conv") and _name.endswith("Cell"):
+        _cls.__name__ = _name
+        _cls.__qualname__ = _name

@@ -1,0 +1,62 @@
+"""Contrib recurrent cells (reference: python/mxnet/gluon/contrib/rnn/
+rnn_cell.py: VariationalDropoutCell, LSTMPCell)."""
+
+from __future__ import annotations
+
+from ...rnn.rnn_cell import _ModifierCell
+
+__all__ = ["VariationalDropoutCell"]
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Variational (same-mask-across-time) dropout on a base cell
+    (reference: contrib/rnn/rnn_cell.py:27; Gal & Ghahramani 2016).
+
+    Masks for inputs/states/outputs are drawn on the first step after
+    ``reset()`` and reused for the rest of the sequence.
+    """
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = F.Dropout(F.ones_like(states[0]),
+                                              p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = F.Dropout(F.ones_like(inputs),
+                                              p=self.drop_inputs)
+        if self.drop_states:
+            states = list(states)
+            # h is always the first state channel
+            states[0] = states[0] * self.drop_states_mask
+        if self.drop_inputs:
+            inputs = inputs * self.drop_inputs_mask
+
+        next_output, next_states = self.base_cell(inputs, states)
+
+        if self.drop_outputs and self.drop_outputs_mask is None:
+            self.drop_outputs_mask = F.Dropout(F.ones_like(next_output),
+                                               p=self.drop_outputs)
+        if self.drop_outputs:
+            next_output = next_output * self.drop_outputs_mask
+        return next_output, next_states
+
+    def __repr__(self):
+        return "VariationalDropoutCell(%s)" % self.base_cell.name

@@ -1,0 +1,212 @@
+"""SequentialModule — chain modules, feeding each one's outputs to the
+next (reference: python/mxnet/module/sequential_module.py:28).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """Container chaining sub-modules; data flows through in order.
+
+    ``add(module, take_labels=True, auto_wiring=True)`` appends a module;
+    `take_labels` marks the module that consumes the training labels
+    (reference meta keys META_TAKE_LABELS / META_AUTO_WIRING).
+    """
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        for key in kwargs:
+            assert key in (self.META_TAKE_LABELS, self.META_AUTO_WIRING), \
+                "unknown meta %r" % (key,)
+        self._metas.append(kwargs)
+        # modifying the chain invalidates previous binding
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # ------------------------------------------------------------ props
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            arg, aux = module.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        for module in self._modules:
+            module.init_params(initializer=initializer,
+                               arg_params=arg_params, aux_params=aux_params,
+                               allow_missing=True, force_init=force_init,
+                               allow_extra=True)
+        self.params_initialized = True
+
+    # ---------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert shared_module is None, \
+            "shared_module not supported for SequentialModule"
+        assert self._modules, "add modules first"
+        self._label_shapes = label_shapes
+
+        my_data_shapes = data_shapes
+        anybody_ever_needs_label = False
+        for i_layer, (meta, module) in enumerate(zip(self._metas,
+                                                     self._modules)):
+            meta.setdefault(self.META_AUTO_WIRING, i_layer > 0)
+            if meta.get(self.META_TAKE_LABELS):
+                my_label_shapes = label_shapes
+                anybody_ever_needs_label = True
+            else:
+                my_label_shapes = None
+            my_inputs_need_grad = inputs_need_grad if i_layer == 0 else \
+                for_training
+            module.bind(data_shapes=my_data_shapes,
+                        label_shapes=my_label_shapes,
+                        for_training=for_training,
+                        inputs_need_grad=my_inputs_need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            if i_layer + 1 >= len(self._modules):
+                break
+            # compute this module's output shapes: via symbol inference
+            # when it has one, else the module's own output_shapes
+            # (PythonModule computes them from its bound data shapes)
+            if getattr(module, "symbol", None) is not None:
+                # entries may be (name, shape) tuples or DataDesc records
+                shape_kwargs = {d[0]: tuple(d[1]) for d in my_data_shapes}
+                _, out_shapes, _ = module.symbol.infer_shape(**shape_kwargs)
+                outs = list(zip(module.output_names, out_shapes))
+            else:
+                outs = list(module.output_shapes)
+            # auto_wiring on module i+1 = "rename my inputs from the
+            # previous module's outputs"; defaults True for non-first
+            # modules (they must get their data from somewhere)
+            next_meta = self._metas[i_layer + 1]
+            if next_meta.get(self.META_AUTO_WIRING, True):
+                # rename outputs to the consumer's data names
+                next_names = self._modules[i_layer + 1].data_names
+                assert len(next_names) == len(outs), (
+                    "module %d outputs %d arrays but module %d consumes %d"
+                    % (i_layer, len(outs), i_layer + 1, len(next_names)))
+                my_data_shapes = [(name, tuple(shape)) for name, (_, shape)
+                                  in zip(next_names, outs)]
+            else:
+                my_data_shapes = [(name, tuple(shape))
+                                  for name, shape in outs]
+        if not anybody_ever_needs_label:
+            self._label_shapes = None
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    # ---------------------------------------------------------- running
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        from ..io import DataBatch
+
+        batch = data_batch
+        for i_layer, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i_layer + 1 == len(self._modules):
+                break
+            out = module.get_outputs()
+            next_names = self._modules[i_layer + 1].data_names
+            batch = DataBatch(data=out,
+                              label=data_batch.label,
+                              pad=getattr(data_batch, "pad", 0))
+            batch.provide_data = [(n, o.shape)
+                                  for n, o in zip(next_names, out)]
+            batch.provide_label = getattr(data_batch, "provide_label", None)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for i_layer in range(len(self._modules) - 1, -1, -1):
+            module = self._modules[i_layer]
+            module.backward(out_grads=out_grads)
+            if i_layer == 0:
+                break
+            out_grads = module.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        assert self.binded and self.params_initialized
+        for meta, module in zip(self._metas, self._modules):
+            if meta.get(self.META_TAKE_LABELS):
+                module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -1,0 +1,237 @@
+"""gluon.contrib layers/cells/data and SequentialModule/PythonModule.
+
+Reference: tests/python/unittest/test_gluon_contrib.py (conv RNN cells,
+VariationalDropoutCell, PixelShuffle, Concurrent/Identity),
+test_module.py (SequentialModule), python_module usage.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import contrib
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ----------------------------------------------------------- conv cells --
+def test_conv_rnn_cells_shapes():
+    rs = np.random.RandomState(0)
+    cases = [
+        (contrib.rnn.Conv1DRNNCell, (3, 10), 1),
+        (contrib.rnn.Conv1DLSTMCell, (3, 10), 1),
+        (contrib.rnn.Conv1DGRUCell, (3, 10), 1),
+        (contrib.rnn.Conv2DRNNCell, (3, 8, 8), 2),
+        (contrib.rnn.Conv2DLSTMCell, (3, 8, 8), 2),
+        (contrib.rnn.Conv2DGRUCell, (3, 8, 8), 2),
+        (contrib.rnn.Conv3DRNNCell, (2, 4, 6, 6), 3),
+        (contrib.rnn.Conv3DLSTMCell, (2, 4, 6, 6), 3),
+        (contrib.rnn.Conv3DGRUCell, (2, 4, 6, 6), 3),
+    ]
+    for cls, in_shape, dims in cases:
+        cell = cls(input_shape=in_shape, hidden_channels=4,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = nd.array(rs.rand(2, *in_shape).astype(np.float32))
+        out, states = cell(x, cell.begin_state(2))
+        assert tuple(out.shape) == (2, 4) + in_shape[1:], (cls, out.shape)
+        n_states = 2 if "LSTM" in cls.__name__ else 1
+        assert len(states) == n_states
+
+
+def test_conv_rnn_cell_math():
+    """Conv1DRNNCell step equals the explicit conv formula."""
+    cell = contrib.rnn.Conv1DRNNCell(input_shape=(2, 6), hidden_channels=3,
+                                     i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.rand(2, 2, 6).astype(np.float32))
+    h0 = nd.array(rs.rand(2, 3, 6).astype(np.float32))
+    out, _ = cell(x, [h0])
+
+    i2h = nd.Convolution(x, cell.i2h_weight.data(), cell.i2h_bias.data(),
+                         kernel=(3,), stride=(1,), pad=(1,), num_filter=3)
+    h2h = nd.Convolution(h0, cell.h2h_weight.data(), cell.h2h_bias.data(),
+                         kernel=(3,), stride=(1,), pad=(1,), num_filter=3)
+    want = np.tanh(i2h.asnumpy() + h2h.asnumpy())
+    assert_almost_equal(out.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_lstm_unroll_and_grad():
+    cell = contrib.rnn.Conv2DLSTMCell(input_shape=(2, 5, 5),
+                                      hidden_channels=3, i2h_kernel=3,
+                                      h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(2).rand(4, 3, 2, 5, 5)
+                 .astype(np.float32))  # (B, T, C, H, W) NTC layout
+    with autograd.record():
+        outs, states = cell.unroll(3, x, merge_outputs=False)
+        loss = sum(o.sum() for o in outs)
+    loss.backward()
+    g = cell.i2h_weight.grad().asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_variational_dropout_mask_reuse():
+    """The dropout mask is fixed across time steps (the defining
+    property) and refreshed by reset()."""
+    base = gluon.rnn.RNNCell(6)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 4, 5), np.float32))
+    with autograd.record():  # dropout active in train mode
+        cell.reset()
+        outs, _ = cell.unroll(4, x, merge_outputs=False)
+    mask = cell.drop_inputs_mask.asnumpy()
+    assert set(np.unique(mask)).issubset({0.0, 2.0})
+    assert (mask == 0).any() or (mask == 2.0).any()
+    with autograd.record():
+        cell.reset()
+        cell.unroll(4, x, merge_outputs=False)
+    assert cell.drop_inputs_mask is not None
+
+
+# -------------------------------------------------------- pixel shuffle --
+def test_pixel_shuffle_values():
+    """PixelShuffle2D matches the torch/reference depth-to-space
+    semantics on an explicit example."""
+    ps = contrib.nn.PixelShuffle2D(2)
+    ps.initialize()
+    x = np.arange(1 * 4 * 2 * 2, dtype=np.float32).reshape(1, 4, 2, 2)
+    y = ps(nd.array(x)).asnumpy()
+    assert y.shape == (1, 1, 4, 4)
+    # output pixel (0,0) block comes from the 4 channels at (0,0)
+    assert_almost_equal(y[0, 0, :2, :2],
+                        np.array([[x[0, 0, 0, 0], x[0, 1, 0, 0]],
+                                  [x[0, 2, 0, 0], x[0, 3, 0, 0]]]))
+
+    ps1 = contrib.nn.PixelShuffle1D(3)
+    ps1.initialize()
+    x1 = np.arange(6, dtype=np.float32).reshape(1, 3, 2)
+    y1 = ps1(nd.array(x1)).asnumpy()
+    assert y1.shape == (1, 1, 6)
+    assert_almost_equal(y1[0, 0], np.array([0, 2, 4, 1, 3, 5], np.float32))
+
+    ps3 = contrib.nn.PixelShuffle3D((2, 1, 1))
+    ps3.initialize()
+    x3 = np.random.RandomState(0).rand(2, 4, 3, 4, 5).astype(np.float32)
+    assert ps3(nd.array(x3)).shape == (2, 2, 6, 4, 5)
+
+
+def test_sparse_embedding():
+    se = contrib.nn.SparseEmbedding(20, 8)
+    se.initialize()
+    idx = nd.array(np.array([1, 5, 5, 19], np.float32))
+    with autograd.record():
+        out = se(idx)
+        out.sum().backward()
+    assert out.shape == (4, 8)
+    assert se.weight._grad_stype == "row_sparse"
+    g = se.weight.grad()
+    assert np.abs(g.asnumpy()[5]).sum() > 0
+    assert np.abs(g.asnumpy()[0]).sum() == 0
+
+
+# ------------------------------------------------------------- sampler --
+def test_interval_sampler():
+    s = contrib.data.IntervalSampler(13, interval=3)
+    assert list(s) == [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    s2 = contrib.data.IntervalSampler(13, interval=3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9, 12]
+
+
+def test_wikitext_local(tmp_path):
+    """WikiText parses a local corpus file with reference tokenization
+    (EOS per line, next-token labels)."""
+    root = tmp_path
+    text = "the quick brown fox\njumps over the lazy dog\n"
+    (root / "wiki.train.tokens").write_text(text)
+    ds = contrib.data.WikiText2(root=str(root), segment="train", seq_len=5)
+    assert len(ds) >= 1
+    d, l = ds[0]
+    # label is data shifted by one token
+    assert d.shape == (5,) and l.shape == (5,)
+    assert_almost_equal(d.asnumpy()[1:], l.asnumpy()[:-1])
+    with pytest.raises(mx.MXNetError):
+        contrib.data.WikiText103(root=str(root), segment="test")
+
+
+# ----------------------------------------------- sequential & python mod --
+def test_sequential_module_trains():
+    """SequentialModule chains two symbol modules and fits (reference:
+    sequential_module.py; mirror of test_module.py usage)."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 10).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.float32)
+
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                 name="fc1")
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                 name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    mod1 = mx.mod.Module(net1, label_names=[])
+    mod2 = mx.mod.Module(net2, label_names=["softmax_label"])
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    from mxnet_tpu.io import NDArrayIter
+
+    it = NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.Accuracy()
+    for _ in range(10):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.update_metric(metric, batch.label)
+            seq.backward()
+            seq.update()
+    _, acc = metric.get()
+    assert acc > 0.8, acc
+
+
+def test_python_loss_module():
+    """PythonLossModule computes d(loss)/d(scores) in python and feeds
+    it back through a symbol module (reference: python_module.py
+    PythonLossModule with grad_func)."""
+    rs = np.random.RandomState(1)
+    X = rs.randn(32, 6).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.float32)
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=1,
+                                name="fc")
+    mod = mx.mod.Module(net, label_names=[])
+
+    def grad_func(scores, labels):
+        # d/ds of 0.5*(sigmoid(s) - y)^2-ish: use (sigmoid(s) - y)
+        s = 1 / (1 + np.exp(-scores.asnumpy()[:, 0]))
+        return ((s - labels.asnumpy()) / len(s)).reshape(-1, 1)
+
+    loss_mod = mx.mod.PythonLossModule(grad_func=grad_func)
+    seq = mx.mod.SequentialModule()
+    seq.add(mod).add(loss_mod, take_labels=True, auto_wiring=True)
+
+    from mxnet_tpu.io import NDArrayIter
+
+    it = NDArrayIter(X, Y, batch_size=32, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 10.0})
+    accs = []
+    for _ in range(150):
+        it.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+        scores = seq.get_outputs()[0].asnumpy()[:, 0]
+        accs.append(((scores > 0) == (Y > 0)).mean())
+    assert accs[-1] > 0.85, accs[-1]
