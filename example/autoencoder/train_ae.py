@@ -1,0 +1,81 @@
+"""Autoencoder with tied training loop (reference: example/autoencoder/
+— stacked autoencoder pretraining).  Runs on synthetic structured data
+(low-rank + noise) so it works without datasets; reports reconstruction
+error vs the PCA optimum.
+
+Usage: python train_ae.py [--epochs 40] [--code-dim 4]
+"""
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import ndarray as nd
+
+
+def make_data(rs, n=512, dim=32, rank=4):
+    basis = rs.randn(rank, dim).astype(np.float32)
+    codes = rs.randn(n, rank).astype(np.float32)
+    return codes @ basis + 0.05 * rs.randn(n, dim).astype(np.float32)
+
+
+class AutoEncoder(gluon.HybridBlock):
+    def __init__(self, dim, code_dim, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.enc = gluon.nn.HybridSequential()
+            self.enc.add(gluon.nn.Dense(16, activation="relu"),
+                         gluon.nn.Dense(code_dim))
+            self.dec = gluon.nn.HybridSequential()
+            self.dec.add(gluon.nn.Dense(16, activation="relu"),
+                         gluon.nn.Dense(dim))
+
+    def hybrid_forward(self, F, x):
+        return self.dec(self.enc(x))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=40)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--code-dim", type=int, default=4)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    rs = np.random.RandomState(args.seed)
+    X = make_data(rs, dim=32, rank=args.code_dim)
+    net = AutoEncoder(X.shape[1], args.code_dim)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    l2 = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    n = len(X)
+    for epoch in range(args.epochs):
+        perm = rs.permutation(n)
+        losses = []
+        for i in range(0, n, args.batch_size):
+            xb = nd.array(X[perm[i:i + args.batch_size]])
+            with autograd.record():
+                loss = l2(net(xb), xb).mean()
+            loss.backward()
+            trainer.step(xb.shape[0])
+            losses.append(float(loss.asnumpy()))
+        if epoch % 10 == 0 or epoch == args.epochs - 1:
+            print("epoch %d  recon_loss %.5f" % (epoch, np.mean(losses)))
+
+    # compare against the PCA floor for the same code size
+    Xc = X - X.mean(0)
+    _, s, _ = np.linalg.svd(Xc, full_matrices=False)
+    pca_floor = (s[args.code_dim:] ** 2).sum() / (2 * n * X.shape[1])
+    final = np.mean(losses)
+    print("final %.5f vs PCA floor %.5f" % (final, pca_floor))
+    return final, pca_floor
+
+
+if __name__ == "__main__":
+    main()

@@ -206,7 +206,13 @@ class NDArray:
         self._data = new_jax_value
         if self._writeback is not None:
             base, index = self._writeback
-            base._assign(base._data.at[index].set(new_jax_value))
+            if base._needs_i64():
+                import jax
+
+                with jax.enable_x64():
+                    base._assign(base._data.at[index].set(new_jax_value))
+            else:
+                base._assign(base._data.at[index].set(new_jax_value))
 
     def __setitem__(self, key, value):
         jnp = _jnp()
@@ -223,12 +229,40 @@ class NDArray:
                 v = jnp.asarray(v, dtype=self.dtype)
                 self._assign(jnp.broadcast_to(v, self.shape) + 0)
             return
+        if self._needs_i64():
+            import jax
+
+            key = _clean_index(key, _np.int64)
+            with jax.enable_x64():
+                self._assign(self._data.at[key].set(v))
+            return
         key = _clean_index(key)
         self._assign(self._data.at[key].set(v))
+
+    def _needs_i64(self):
+        """Arrays beyond int32 addressing need 64-bit gather/scatter
+        indices (reference: INT64_TENSOR_SIZE builds; nightly
+        test_large_array.py).  Host/CPU-backed arrays only: XLA's TPU
+        backend has no 64-bit scatter, and a single chip's HBM cannot
+        hold such a tensor anyway — on device, exceeding int32 addressing
+        means sharding over a mesh."""
+        return any(d > 2**31 - 1 for d in self._data.shape)
 
     def __getitem__(self, key):
         if key is None:
             return NDArray(self._data[None], self._ctx)
+        if self._needs_i64():
+            import jax
+
+            ck = _clean_index(key, _np.int64)
+            with jax.enable_x64():
+                out = self._data[ck]
+            if _is_basic_index(ck):
+                # keep the reference's Slice/At write-through views on
+                # the int64 path too (same program, same semantics,
+                # regardless of array size)
+                return NDArray(out, self._ctx, _writeback=(self, ck))
+            return NDArray(out, self._ctx)
         ck = _clean_index(key)
         if _is_basic_index(ck):
             # basic index → view with writeback (reference Slice/At views)
@@ -304,11 +338,13 @@ class NDArray:
     def flip(self, axis):
         return imperative_invoke("reverse", [self], {"axis": axis})[0]
 
-    def sum(self, axis=None, keepdims=False, **kw):
-        return imperative_invoke("sum", [self], {"axis": axis, "keepdims": keepdims})[0]
+    def sum(self, axis=None, keepdims=False, dtype=None, **kw):
+        return imperative_invoke("sum", [self], {"axis": axis, "keepdims": keepdims,
+                                                 "dtype": dtype})[0]
 
-    def mean(self, axis=None, keepdims=False, **kw):
-        return imperative_invoke("mean", [self], {"axis": axis, "keepdims": keepdims})[0]
+    def mean(self, axis=None, keepdims=False, dtype=None, **kw):
+        return imperative_invoke("mean", [self], {"axis": axis, "keepdims": keepdims,
+                                                  "dtype": dtype})[0]
 
     def max(self, axis=None, keepdims=False):
         return imperative_invoke("max", [self], {"axis": axis, "keepdims": keepdims})[0]
@@ -541,16 +577,20 @@ class NDArray:
 _SCALAR_REV = {"_rminus_scalar", "_rdiv_scalar", "_rmod_scalar", "_rpower_scalar"}
 
 
-def _clean_index(key):
-    """Convert NDArray indices inside a key to jax arrays."""
+def _clean_index(key, idx_dtype=_np.int32):
+    """Convert NDArray indices inside a key to jax/numpy arrays.
+
+    idx_dtype: int64 for arrays addressed beyond int32 (INT64_TENSOR_SIZE
+    paths) — truncating here would silently wrap large indices."""
     if isinstance(key, NDArray):
-        return key._data.astype("int32")
+        return key._data.astype(idx_dtype)
     if isinstance(key, tuple):
         return tuple(
-            k._data.astype("int32") if isinstance(k, NDArray) else k for k in key
+            k._data.astype(idx_dtype) if isinstance(k, NDArray) else k
+            for k in key
         )
     if isinstance(key, (list, _np.ndarray)):
-        return _np.asarray(key, dtype=_np.int32)
+        return _np.asarray(key, dtype=idx_dtype)
     return key
 
 

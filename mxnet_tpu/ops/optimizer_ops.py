@@ -27,16 +27,22 @@ def _apply_wd_rescale(weight, grad, rescale_grad, clip_gradient, wd):
 
 
 @register("sgd_update")
-def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
-               lazy_update=False, **_):
+def sgd_update(weight, grad, lr_t=None, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=False, **_):
+    # lr_t: optional traced scalar input — time-varying rates (schedulers,
+    # bias correction) must NOT be static attrs or every step recompiles
+    if lr_t is not None:
+        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     return weight - lr * g
 
 
 @register("sgd_mom_update", num_outputs=2)
-def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
-                   clip_gradient=-1.0, lazy_update=False, **_):
+def sgd_mom_update(weight, grad, mom, lr_t=None, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False, **_):
+    if lr_t is not None:
+        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mom = momentum * mom - lr * g
@@ -53,8 +59,11 @@ def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gra
 
 
 @register("adam_update", num_outputs=3)
-def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
-                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False, **_):
+def adam_update(weight, grad, mean, var, lr_t=None, lr=0.001, beta1=0.9,
+                beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=False, **_):
+    if lr_t is not None:
+        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mean = beta1 * mean + (1.0 - beta1) * g
@@ -186,8 +195,10 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0
 # scatter per step, bandwidth proportional to the touched rows.
 
 @register("_sparse_sgd_update")
-def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
+def sparse_sgd_update(weight, grad_val, grad_idx, lr_t=None, lr=0.01, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **_):
+    if lr_t is not None:
+        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
@@ -195,9 +206,11 @@ def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
 
 
 @register("_sparse_sgd_mom_update", num_outputs=2)
-def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
+def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr_t=None, lr=0.01,
                           momentum=0.0, wd=0.0, rescale_grad=1.0,
                           clip_gradient=-1.0, **_):
+    if lr_t is not None:
+        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
@@ -207,9 +220,11 @@ def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
 
 
 @register("_sparse_adam_update", num_outputs=3)
-def sparse_adam_update(weight, grad_val, grad_idx, mean, var, lr=0.001,
-                       beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+def sparse_adam_update(weight, grad_val, grad_idx, mean, var, lr_t=None,
+                       lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, **_):
+    if lr_t is not None:
+        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)

@@ -26,10 +26,23 @@ def _norm_axis(axis, ndim, exclude=False):
     return axes
 
 
+_DTYPE_REDUCES = ("sum", "mean", "prod", "nansum", "nanprod")
+
+
 def _make_reduce(name, jf):
     @register(name, aliases=("%s_axis" % name,))
-    def _op(x, axis=None, keepdims=False, exclude=False, **_):
+    def _op(x, axis=None, keepdims=False, exclude=False, dtype=None, **_):
         axes = _norm_axis(axis, x.ndim, exclude)
+        if dtype is not None and name in _DTYPE_REDUCES:
+            if jnp.dtype(dtype).itemsize == 8:
+                # 64-bit accumulation (reference: INT64_TENSOR_SIZE /
+                # dtype= on reductions over >2^31-element arrays)
+                import jax
+
+                with jax.enable_x64():
+                    return jf(x, axis=axes, keepdims=bool(keepdims),
+                              dtype=dtype)
+            return jf(x, axis=axes, keepdims=bool(keepdims), dtype=dtype)
         return jf(x, axis=axes, keepdims=bool(keepdims))
 
     return _op

@@ -164,18 +164,30 @@ class Optimizer:
         self.lr_scheduler = None
 
 
+# kernels that accept the learning rate as a TRACED scalar input (lr_t)
+# instead of a static attr — a scheduler- or bias-correction-varying lr
+# must not change the jit cache key or every step recompiles the update
+_DYN_LR_OPS = {"sgd_update", "sgd_mom_update", "adam_update"}
+
+
 def _fused(name, index, weight, grad, states, opt, **extra):
     """Run a fused update op and write results back in place.
 
     A row_sparse gradient with opt.lazy_update routes to the
     `_sparse_<name>` lazy kernel (reference: optimizer_op.cc FComputeEx
     storage dispatch) — only the gradient's rows are touched."""
-    attrs = {"lr": opt._get_lr(index), "wd": opt._get_wd(index),
+    lr = opt._get_lr(index)
+    attrs = {"wd": opt._get_wd(index),
              "rescale_grad": opt.rescale_grad,
              "clip_gradient": opt.clip_gradient if opt.clip_gradient else -1.0}
     attrs.update(extra)
+    base = name
     name, inputs = _route_sparse(name, weight, grad, states,
                                  getattr(opt, "lazy_update", False))
+    if base in _DYN_LR_OPS:
+        inputs = inputs + [_np.float32(lr)]
+    else:
+        attrs["lr"] = lr
     outs = imperative_invoke(name, inputs, attrs)
     weight._assign(outs[0]._data)
     for st, new in zip(states, outs[1:]):
@@ -364,13 +376,15 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr = lr * math.sqrt(coef2) / coef1
         mean, var = state
-        attrs = {"lr": lr, "wd": self._get_wd(index),
+        # bias-corrected lr varies EVERY step → traced input, not attr
+        # (a static attr would recompile the kernel each step)
+        attrs = {"wd": self._get_wd(index),
                  "rescale_grad": self.rescale_grad,
                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
                  "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
         opname, inputs = _route_sparse("adam_update", weight, grad,
                                        [mean, var], self.lazy_update)
-        outs = imperative_invoke(opname, inputs, attrs)
+        outs = imperative_invoke(opname, inputs + [_np.float32(lr)], attrs)
         weight._assign(outs[0]._data)
         mean._assign(outs[1]._data)
         var._assign(outs[2]._data)

@@ -1,0 +1,82 @@
+"""Saved-artifact format stability.
+
+Reference: tests/nightly/model_backwards_compatibility_check/ and the
+fixture files tests/python/unittest/{legacy_ndarray.v0, save_000800.json}
+— artifacts written by an earlier version of the framework must keep
+loading.  The files under tests/fixtures/ are committed outputs of
+`mx.nd.save`, `HybridBlock.export`, `Block.save_parameters`, and
+`Module.save_checkpoint`; these tests fail if a serialization change
+breaks old checkpoints (change the format only with a versioned reader).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.test_utils import assert_almost_equal
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def _p(name):
+    return os.path.join(FIX, name)
+
+
+def test_nd_save_artifacts_load():
+    d = nd.load(_p("arrays_dict.params"))
+    assert set(d) == {"a", "b"}
+    assert d["a"].shape == (3, 4)
+    assert_almost_equal(d["b"].asnumpy(), np.arange(5, dtype=np.float32))
+    lst = nd.load(_p("arrays_list.params"))
+    assert isinstance(lst, list) and lst[0].shape == (2, 2)
+
+
+def test_exported_model_loads_and_matches():
+    """Old export runs through Predictor AND SymbolBlock with recorded
+    outputs."""
+    x = np.load(_p("dense_v1_input.npy"))
+    want = np.load(_p("dense_v1_output.npy"))
+
+    pred = Predictor(open(_p("dense_v1-symbol.json")).read(),
+                     open(_p("dense_v1-0000.params"), "rb").read(),
+                     {"data": x.shape})
+    pred.forward(data=x)
+    assert_almost_equal(pred.get_output(0), want, rtol=1e-5, atol=1e-6)
+
+    net = gluon.SymbolBlock.imports(_p("dense_v1-symbol.json"), ["data"],
+                                    _p("dense_v1-0000.params"))
+    assert_almost_equal(net(nd.array(x)).asnumpy(), want,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_parameters_load():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net(nd.zeros((1, 6)))
+    net.load_parameters(_p("dense_v1_gluon.params"))
+    x = np.load(_p("dense_v1_input.npy"))
+    want = np.load(_p("dense_v1_output.npy"))
+    assert_almost_equal(net(nd.array(x)).asnumpy(), want,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_module_checkpoint_loads():
+    sym, arg, aux = mx.load_checkpoint(_p("mod_v1"), 0)
+    assert "fc_weight" in arg
+    assert_almost_equal(arg["fc_weight"].asnumpy(),
+                        np.load(_p("mod_v1_fcw.npy")))
+    mod = mx.mod.Module(sym, label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (2, 5))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.set_params(arg, aux)
+    from mxnet_tpu.io import DataBatch
+
+    mod.forward(DataBatch(data=[nd.zeros((2, 5))],
+                          label=[nd.zeros((2,))]), is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 3)
