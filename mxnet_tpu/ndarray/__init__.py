@@ -2,7 +2,7 @@
 
 from .. import ops as _ops  # registers all operators
 from .ndarray import (NDArray, array, arange, concatenate, empty, full, load,
-                      load_frombuffer,
+                      load_frombuffer, maximum, minimum,
                       moveaxis, ones, save, waitall, zeros,
                       imperative_invoke)
 from .register import populate as _populate

@@ -784,6 +784,31 @@ def moveaxis(tensor, source, destination):
     return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
 
 
+def maximum(lhs, rhs):
+    """Elementwise broadcast max of arrays/scalars (reference:
+    python/mxnet/ndarray/ndarray.py:3008 maximum)."""
+    return _scalar_or_broadcast(lhs, rhs, "broadcast_maximum",
+                                "_maximum_scalar", max)
+
+
+def minimum(lhs, rhs):
+    """reference: ndarray.py:3065 minimum."""
+    return _scalar_or_broadcast(lhs, rhs, "broadcast_minimum",
+                                "_minimum_scalar", min)
+
+
+def _scalar_or_broadcast(lhs, rhs, array_op, scalar_op, py_fn):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return imperative_invoke(array_op, [lhs, rhs], {})[0]
+    if isinstance(lhs, NDArray):
+        return imperative_invoke(scalar_op, [lhs],
+                                 {"scalar": float(rhs)})[0]
+    if isinstance(rhs, NDArray):
+        return imperative_invoke(scalar_op, [rhs],
+                                 {"scalar": float(lhs)})[0]
+    return py_fn(lhs, rhs)
+
+
 def waitall():
     """Block until all async computation completes
     (reference: MXNDArrayWaitAll)."""

@@ -185,7 +185,9 @@ def _fused(name, index, weight, grad, states, opt, **extra):
     name, inputs = _route_sparse(name, weight, grad, states,
                                  getattr(opt, "lazy_update", False))
     if base in _DYN_LR_OPS:
-        inputs = inputs + [_np.float32(lr)]
+        # python float → weak-typed traced scalar: no recompile across
+        # steps AND no dtype promotion of fp16/bf16 weights
+        inputs = inputs + [float(lr)]
     else:
         attrs["lr"] = lr
     outs = imperative_invoke(name, inputs, attrs)
@@ -384,7 +386,7 @@ class Adam(Optimizer):
                  "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
         opname, inputs = _route_sparse("adam_update", weight, grad,
                                        [mean, var], self.lazy_update)
-        outs = imperative_invoke(opname, inputs + [_np.float32(lr)], attrs)
+        outs = imperative_invoke(opname, inputs + [float(lr)], attrs)
         weight._assign(outs[0]._data)
         mean._assign(outs[1]._data)
         var._assign(outs[2]._data)

@@ -1,0 +1,142 @@
+// C++ stress test for the native dependency engine.
+//
+// Mirrors the reference's tests/cpp/engine/threaded_engine_test.cc:
+// random dependency patterns across many vars/ops, write-exclusivity /
+// read-sharing invariants, FIFO ordering per var, async completion, and
+// error propagation to WaitForVar.
+//
+// Build+run: tests/test_native.py::test_engine_stress_cpp
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+const char* MXTPUGetLastError(void);
+int MXTPUEngineCreate(int n_workers, int io_workers, void** out);
+int MXTPUEngineFree(void* h);
+int MXTPUEngineNewVar(void* h, uint64_t* out);
+int MXTPUEngineDelVar(void* h, uint64_t var);
+typedef int (*EngineOpFn)(void* ctx, uint64_t op_id);
+int MXTPUEnginePush(void* h, EngineOpFn fn, void* ctx, const uint64_t* cvars,
+                    int ncv, const uint64_t* mvars, int nmv, int prop,
+                    const char* name, uint64_t* out_op_id);
+int MXTPUEngineWaitForVar(void* h, uint64_t var);
+int MXTPUEngineWaitAll(void* h);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+struct Cell {
+  std::atomic<int64_t> value{0};
+  std::atomic<int> readers{0};
+  std::atomic<int> writers{0};
+  std::atomic<int> violations{0};
+};
+
+struct WriteCtx {
+  Cell* cell;
+  int64_t add;
+};
+
+static int write_op(void* ctx, uint64_t) {
+  WriteCtx* w = (WriteCtx*)ctx;
+  // write exclusivity: no other writer or reader may be active
+  if (w->cell->writers.fetch_add(1) != 0) w->cell->violations++;
+  if (w->cell->readers.load() != 0) w->cell->violations++;
+  int64_t v = w->cell->value.load();
+  for (volatile int i = 0; i < 50; ++i) {
+  }  // widen the race window
+  w->cell->value.store(v + w->add);
+  w->cell->writers.fetch_sub(1);
+  return 0;
+}
+
+struct ReadCtx {
+  Cell* cell;
+  std::atomic<int64_t>* sink;
+};
+
+static int read_op(void* ctx, uint64_t) {
+  ReadCtx* r = (ReadCtx*)ctx;
+  if (r->cell->writers.load() != 0) r->cell->violations++;
+  r->cell->readers.fetch_add(1);
+  for (volatile int i = 0; i < 20; ++i) {
+  }
+  r->sink->fetch_add(r->cell->value.load());
+  r->cell->readers.fetch_sub(1);
+  return 0;
+}
+
+static int fail_op(void*, uint64_t) { return 1; }
+
+int main() {
+  void* eng = nullptr;
+  CHECK(MXTPUEngineCreate(4, 2, &eng) == 0);
+
+  // ---- 1. per-var FIFO write ordering + exclusivity under load ------
+  const int kVars = 16, kOpsPerVar = 200;
+  std::vector<uint64_t> vars(kVars);
+  std::vector<Cell> cells(kVars);
+  for (int i = 0; i < kVars; ++i) CHECK(MXTPUEngineNewVar(eng, &vars[i]) == 0);
+
+  std::vector<WriteCtx> wctx;
+  wctx.reserve(kVars * kOpsPerVar);
+  for (int j = 0; j < kOpsPerVar; ++j) {
+    for (int i = 0; i < kVars; ++i) {
+      wctx.push_back({&cells[i], j + 1});
+      // every third op also READS a neighbour var (cross-var deps)
+      uint64_t cv = vars[(i + 1) % kVars];
+      int ncv = (j % 3 == 0) ? 1 : 0;
+      CHECK(MXTPUEnginePush(eng, write_op, &wctx.back(), &cv, ncv, &vars[i],
+                            1, j % 2 ? 0 : 2 /*priority*/, "w",
+                            nullptr) == 0);
+    }
+  }
+  CHECK(MXTPUEngineWaitAll(eng) == 0);
+  for (int i = 0; i < kVars; ++i) {
+    CHECK(cells[i].violations.load() == 0);
+    // sum 1..kOpsPerVar
+    CHECK(cells[i].value.load() == (int64_t)kOpsPerVar * (kOpsPerVar + 1) / 2);
+  }
+
+  // ---- 2. concurrent readers share; reads see the preceding write ---
+  std::atomic<int64_t> sink{0};
+  std::vector<ReadCtx> rctx;
+  rctx.reserve(64);
+  for (int j = 0; j < 64; ++j) {
+    rctx.push_back({&cells[0], &sink});
+    CHECK(MXTPUEnginePush(eng, read_op, &rctx.back(), &vars[0], 1, nullptr,
+                          0, 0, "r", nullptr) == 0);
+  }
+  CHECK(MXTPUEngineWaitForVar(eng, vars[0]) == 0);
+  CHECK(MXTPUEngineWaitAll(eng) == 0);
+  CHECK(cells[0].violations.load() == 0);
+  CHECK(sink.load() == 64 * (int64_t)kOpsPerVar * (kOpsPerVar + 1) / 2);
+
+  // ---- 3. error propagation to WaitForVar ---------------------------
+  uint64_t bad = 0;
+  CHECK(MXTPUEngineNewVar(eng, &bad) == 0);
+  CHECK(MXTPUEnginePush(eng, fail_op, nullptr, nullptr, 0, &bad, 1, 0,
+                        "boom", nullptr) == 0);
+  CHECK(MXTPUEngineWaitForVar(eng, bad) != 0);
+  CHECK(strlen(MXTPUGetLastError()) > 0);
+  // the engine keeps working after an error
+  wctx.push_back({&cells[1], 5});
+  CHECK(MXTPUEnginePush(eng, write_op, &wctx.back(), nullptr, 0, &vars[1], 1,
+                        0, "after", nullptr) == 0);
+  CHECK(MXTPUEngineWaitForVar(eng, vars[1]) == 0);
+
+  for (int i = 0; i < kVars; ++i) CHECK(MXTPUEngineDelVar(eng, vars[i]) == 0);
+  CHECK(MXTPUEngineFree(eng) == 0);
+  printf("engine stress: all checks passed\n");
+  return 0;
+}

