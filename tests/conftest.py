@@ -8,11 +8,16 @@ Must run before any jax import (pytest imports conftest first).
 """
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# force exactly 8 devices even when the var is already set (e.g. leaked
+# from a dryrun re-exec with a different count): the suite's mesh-shape
+# assertions are written for 8
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8").strip()
 
 # a sitecustomize may force-register an accelerator plugin and override
 # the env var choice; the config update below wins either way
