@@ -92,6 +92,14 @@ class Trainer:
                 len(self._contexts) <= 1:
             kv = None
         if kv is not None:
+            if "async" in kv.type and self._update_on_kvstore is False:
+                # reference trainer.py raises the same way: async pushes
+                # are applied by the server optimizer, so worker-side
+                # updates are not expressible
+                raise ValueError(
+                    "Please set update_on_kvstore=True when training "
+                    "with dist_async; updates must run on the kvstore "
+                    "servers")
             if self._update_on_kvstore is None:
                 # async PS REQUIRES server-side updates; sync dist and
                 # local reduce default to worker-side updates

@@ -175,14 +175,17 @@ class KVStore:
         pass
 
     def _send_command_to_servers(self, head, body):
-        """reference: MXKVStoreSendCommmandToServers.  In-process stores
-        have no server processes; failing loudly beats the silent no-op
-        (a 'server profiling' request that goes nowhere would surface
-        only as a mysteriously missing trace file later)."""
-        raise MXNetError(
-            "kvstore type %r has no server processes to command — server "
-            "commands need 'dist_async' under tools/launch.py -s N"
-            % self._type)
+        """reference: MXKVStoreSendCommmandToServers, a silent no-op on
+        non-dist stores.  We keep the no-op for parity (reference scripts
+        issue server commands unconditionally) but warn, so a 'server
+        profiling' request that goes nowhere doesn't surface only as a
+        mysteriously missing trace file later."""
+        import warnings
+
+        warnings.warn(
+            "kvstore type %r has no server processes to command — the "
+            "request is ignored (server commands need 'dist_async' under "
+            "tools/launch.py -s N)" % self._type, stacklevel=2)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not set"
@@ -438,7 +441,7 @@ class DistAsyncKVStore(KVStore):
         """Generic controller channel (reference: ps-lite server commands
         — stop/set-optimizer/gradient-compression/profiler)."""
         if self._client is None:
-            return super()._send_command_to_servers(head, body)  # raises
+            return super()._send_command_to_servers(head, body)  # warns
         self._client.send_command(head, body)
 
     def stop_servers(self):

@@ -18,13 +18,22 @@ server assignment, `kvstore_dist.h:245`).  Server addresses come from
 `DMLC_PS_ROOT_URI` as the host, falling back to
 `DMLC_PS_ROOT_PORT` for a single server.
 
-Wire format: 8-byte big-endian length + pickle.  Like ps-lite's ZMQ
-transport, this is an unauthenticated intra-cluster protocol: only run
-it on trusted networks (the launcher binds loopback by default).
+Wire format: 8-byte big-endian length + restricted pickle.  Like
+ps-lite's ZMQ transport this is an unauthenticated intra-cluster
+protocol (only run it on trusted networks; the launcher binds loopback
+by default) — but data messages are decoded with an unpickler that
+admits only builtins and numpy array/dtype reconstruction, so a rogue
+peer cannot execute code via the data plane.  The one deliberately
+code-executing payload is the ``set_optimizer`` blob: it travels as
+opaque bytes inside a data message and is full-unpickled only inside
+the explicit set_optimizer handler (the reference has the same trust
+shape: the worker ships a pickled Optimizer to the server,
+python/mxnet/kvstore.py set_optimizer).
 """
 
 from __future__ import annotations
 
+import io
 import os
 import pickle
 import socket
@@ -32,6 +41,30 @@ import struct
 import threading
 
 __all__ = ["PSServer", "PSClient", "server_addresses", "run_server"]
+
+
+# modules/names a data message may reference: enough to rebuild numpy
+# arrays, scalars, and dtypes — nothing that executes user code
+_SAFE_PICKLE_GLOBALS = {
+    ("numpy", ("ndarray", "dtype")),
+    ("numpy.core.multiarray", ("_reconstruct", "scalar")),
+    ("numpy._core.multiarray", ("_reconstruct", "scalar")),
+    ("numpy.core.numeric", ("_frombuffer",)),
+    ("numpy._core.numeric", ("_frombuffer",)),
+}
+
+
+class _DataUnpickler(pickle.Unpickler):
+    """Unpickler for wire messages: numpy + builtins containers only."""
+
+    def find_class(self, module, name):
+        for mod, names in _SAFE_PICKLE_GLOBALS:
+            if module == mod and name in names:
+                return super().find_class(module, name)
+        if module == "numpy.dtypes":  # numpy>=1.25 dtype classes
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            "wire message references forbidden global %s.%s" % (module, name))
 
 
 def key_to_int(key):
@@ -62,7 +95,7 @@ def _recv_msg(sock):
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
-    return pickle.loads(payload)
+    return _DataUnpickler(io.BytesIO(payload)).load()
 
 
 def _recv_exact(sock, n):
@@ -214,6 +247,8 @@ class PSServer:
     def _set_optimizer(self, blob):
         from .. import optimizer as opt_mod
 
+        # full pickle by design: the worker ships its Optimizer instance,
+        # exactly like the reference's kv.set_optimizer pickled blob
         optimizer = pickle.loads(blob)
         self._updater = opt_mod.get_updater(optimizer)
 
@@ -304,6 +339,11 @@ class PSClient:
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(0.2)
+            # create_connection's timeout is only for the dial; a blocking
+            # protocol op (barrier chains, large pulls, slow server-side
+            # optimizer) may legitimately exceed it, and a mid-protocol
+            # socket.timeout would desynchronize the framed stream
+            s.settimeout(None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks.append(s)
         self._lock = threading.Lock()

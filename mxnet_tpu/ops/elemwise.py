@@ -212,7 +212,9 @@ _SCALAR = {
 
 
 def _register_scalar(name, f):
-    @register(name)
+    # scalar is traced: eager `x * python_float` with a per-step value
+    # (scheduler lr in composite optimizer loops) must not recompile
+    @register(name, traced_attrs=("scalar",))
     def _op(x, scalar=0.0, **_):
         return f(x, scalar)
 
@@ -223,7 +225,7 @@ for _n, _f in _SCALAR.items():
     _register_scalar(_n, _f)
 
 
-@register("smooth_l1")
+@register("smooth_l1", traced_attrs=("scalar",))
 def smooth_l1(x, scalar=1.0, **_):
     # reference: mshadow_op::smooth_l1_loss with sigma=scalar
     s2 = scalar * scalar
@@ -253,13 +255,3 @@ def where(condition, x, y, **_):
         shape = (condition.shape[0],) + (1,) * (x.ndim - 1)
         condition = condition.reshape(shape)
     return jnp.where(condition != 0, x, y)
-
-
-@register("_maximum")
-def _maximum(a, b, **_):
-    return jnp.maximum(a, b)
-
-
-@register("_minimum")
-def _minimum(a, b, **_):
-    return jnp.minimum(a, b)

@@ -228,16 +228,6 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False, **_):
     return jnp.matmul(a, b)
 
 
-@register("khatri_rao")
-def khatri_rao(*mats, **_):
-    if len(mats) == 1 and isinstance(mats[0], (list, tuple)):
-        mats = tuple(mats[0])
-    out = mats[0]
-    for m in mats[1:]:
-        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
-    return out
-
-
 # ---------------------------------------------------------------- ordering
 
 
@@ -305,8 +295,8 @@ def take(a, indices, axis=0, mode="clip", **_):
     return jnp.take(a, indices.astype(jnp.int32), axis=int(axis), mode=jmode)
 
 
-@register("batch_take", aliases=("pick",))
-def pick(x, index, axis=-1, keepdims=False, mode="clip", **_):
+@register("batch_take")
+def batch_take(x, index, axis=-1, keepdims=False, mode="clip", **_):
     ax = int(axis) % x.ndim
     idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[ax] - 1)
     out = jnp.take_along_axis(x, jnp.expand_dims(idx, ax), axis=ax)
@@ -534,7 +524,7 @@ def swapaxes(data, dim1=0, dim2=0, **_):
     return jnp.swapaxes(data, int(dim1), int(dim2))
 
 
-@register("Crop", aliases=("crop",))
+@register("Crop")
 def crop_like(data, *like, offset=(), h_w=(), center_crop=False, num_args=1, **_):
     """Crop data to the spatial size of a second input or explicit h_w
     (reference: src/operator/crop.cc)."""

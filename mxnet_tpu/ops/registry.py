@@ -99,12 +99,19 @@ class Op:
     num_outputs : static output count, or a callable(attrs)->int.
     """
 
-    def __init__(self, name, fn, num_outputs=1, aliases=(), defaults=None):
+    def __init__(self, name, fn, num_outputs=1, aliases=(), defaults=None,
+                 traced_attrs=()):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.aliases = tuple(aliases)
         self.defaults = dict(defaults or {})
+        # attrs traced as jit ARGUMENTS instead of baked into the cache
+        # key: a value that varies per call (scheduler lr, bias-correction
+        # t, eager `x * python_scalar`) must not trigger a recompile per
+        # step.  Only safe for attrs the op fn uses purely in math — an
+        # attr the fn branches on in Python must stay static.
+        self.traced_attrs = frozenset(traced_attrs)
         self._jit_cache = {}
 
     def __repr__(self):
@@ -121,13 +128,40 @@ class Op:
         return functools.partial(fn, **attrs)
 
     def jitted(self, attrs):
-        """Compiled entry point for eager dispatch, cached per attr-set."""
-        key = tuple(sorted(attrs.items()))
+        """Compiled entry point for eager dispatch, cached per attr-set.
+
+        Attrs named in ``traced_attrs`` (when numeric) are fed to the
+        compiled fn as weak-typed scalar arguments — the cache key holds
+        only their *names*, so a changing value reuses the executable."""
+        traced = {k: v for k, v in attrs.items()
+                  if k in self.traced_attrs
+                  and isinstance(v, (int, float))
+                  and not isinstance(v, bool)}
+        if not traced:
+            key = tuple(sorted(attrs.items()))
+            entry = self._jit_cache.get(key)
+            if entry is None:
+                entry = jax.jit(self.bind_attrs(attrs))
+                self._jit_cache[key] = entry
+            return entry
+        static = {k: v for k, v in attrs.items() if k not in traced}
+        tnames = tuple(sorted(traced))
+        key = (tuple(sorted(static.items())), tnames)
         entry = self._jit_cache.get(key)
         if entry is None:
-            entry = jax.jit(self.bind_attrs(attrs))
+            fn = self.fn
+
+            def call(arrays, tvals):
+                kw = dict(static)
+                kw.update(zip(tnames, tvals))
+                return fn(*arrays, **kw)
+
+            entry = jax.jit(call)
             self._jit_cache[key] = entry
-        return entry
+        # python floats stay weak-typed under tracing: no recompile across
+        # values AND no dtype promotion of bf16/fp16 tensors
+        tvals = tuple(float(traced[k]) for k in tnames)
+        return functools.partial(_call_traced, entry, tvals)
 
     def nout(self, attrs):
         if callable(self.num_outputs):
@@ -135,17 +169,26 @@ class Op:
         return self.num_outputs
 
 
-def register(name, num_outputs=1, aliases=(), **defaults):
+def _call_traced(entry, tvals, *arrays):
+    return entry(arrays, tvals)
+
+
+def register(name, num_outputs=1, aliases=(), traced_attrs=(), **defaults):
     """Decorator: register a pure jax function as an operator.
 
     ``@register("dot", aliases=["Dot"])``
     """
 
     def deco(fn):
-        op = Op(name, fn, num_outputs=num_outputs, aliases=aliases, defaults=defaults)
-        _OP_REGISTRY[name] = op
-        for a in aliases:
-            _OP_REGISTRY[a] = op
+        op = Op(name, fn, num_outputs=num_outputs, aliases=aliases,
+                defaults=defaults, traced_attrs=traced_attrs)
+        for n in (name,) + op.aliases:
+            prev = _OP_REGISTRY.get(n)
+            if prev is not None and prev.fn is not fn:
+                raise MXNetError(
+                    "Operator name %r is already registered (to %r); use "
+                    "alias() to share an implementation explicitly" % (n, prev.name))
+            _OP_REGISTRY[n] = op
         return fn
 
     return deco
@@ -159,12 +202,29 @@ def get(name):
 
 
 def alias(name, target):
-    """Register `name` as another name for an existing op (no-op if taken
-    or if `target` is absent).  Use only when the tensor-input arity
-    matches — a mismatched alias silently mis-binds positional inputs."""
+    """Register `name` as another name for the existing op `target`.
+
+    Raises when `target` is not registered, when `name` is already bound
+    to a different op, or when the two names carry conflicting
+    tensor-input arities in ``OP_INPUT_NAMES`` (a mismatched alias would
+    silently mis-bind positional inputs)."""
     op = _OP_REGISTRY.get(target)
-    if op is not None:
-        _OP_REGISTRY.setdefault(name, op)
+    if op is None:
+        raise MXNetError(
+            "alias(%r, %r): target operator is not registered" % (name, target))
+    prev = _OP_REGISTRY.get(name)
+    if prev is not None:
+        if prev is op:
+            return
+        raise MXNetError(
+            "alias(%r, %r): name is already registered (to %r)"
+            % (name, target, prev.name))
+    n_in, t_in = OP_INPUT_NAMES.get(name), OP_INPUT_NAMES.get(op.name)
+    if n_in is not None and t_in is not None and len(n_in) != len(t_in):
+        raise MXNetError(
+            "alias(%r, %r): tensor-input arity mismatch (%d vs %d)"
+            % (name, target, len(n_in), len(t_in)))
+    _OP_REGISTRY[name] = op
 
 
 def list_ops():

@@ -97,8 +97,11 @@ class MoEFFN:
             pos = jnp.cumsum(onehot, axis=1) * onehot        # 1-based
             return onehot, pos
         oh1, pos1 = positions(g1)
-        # expert-1 claims count against expert-2's buffer too
-        oh2_raw = jax.nn.one_hot(g2, E)
+        # expert-1 claims count against expert-2's buffer too.  A
+        # zero-probability runner-up (top-1 prob ~1.0 leaves `masked`
+        # all-zero, argmax falls back to expert 0) is masked out here so
+        # it neither dispatches nor consumes a capacity slot
+        oh2_raw = jax.nn.one_hot(g2, E) * (p2 > 0)[..., None]
         used = jnp.sum(oh1, axis=1, keepdims=True)           # (B, 1, E)
         pos2 = (jnp.cumsum(oh2_raw, axis=1) + used) * oh2_raw
         oh2 = oh2_raw

@@ -1,0 +1,188 @@
+"""Registry failure modes + advisor-fix regression tests.
+
+Reference analog: the nnvm registry CHECKs duplicate op names at
+registration (dmlc::Registry __REGISTER__ "Entry ... already registered").
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops import registry
+
+
+def _unregister(*names):
+    for n in names:
+        registry._OP_REGISTRY.pop(n, None)
+
+
+def test_register_rejects_duplicate_name():
+    @registry.register("_test_dup_op")
+    def _f(a, **_):
+        return a
+
+    try:
+        with pytest.raises(MXNetError, match="already registered"):
+            @registry.register("_test_dup_op")
+            def _g(a, **_):
+                return a + 1
+    finally:
+        _unregister("_test_dup_op")
+
+
+def test_register_rejects_alias_collision():
+    @registry.register("_test_op_a")
+    def _f(a, **_):
+        return a
+
+    try:
+        with pytest.raises(MXNetError, match="already registered"):
+            @registry.register("_test_op_b", aliases=("_test_op_a",))
+            def _g(a, **_):
+                return a
+    finally:
+        _unregister("_test_op_a", "_test_op_b")
+
+
+def test_reregister_same_fn_is_idempotent():
+    def _f(a, **_):
+        return a
+
+    try:
+        registry.register("_test_idem")(_f)
+        registry.register("_test_idem")(_f)  # same fn object: allowed
+    finally:
+        _unregister("_test_idem")
+
+
+def test_alias_raises_on_absent_target():
+    with pytest.raises(MXNetError, match="not registered"):
+        registry.alias("_test_alias_x", "_no_such_op_xyz")
+
+
+def test_alias_raises_on_taken_name():
+    with pytest.raises(MXNetError, match="already registered"):
+        registry.alias("dot", "batch_dot")
+
+
+def test_alias_same_op_idempotent():
+    registry.alias("_linalg_gemm", "linalg_gemm")  # already aliased: ok
+    assert registry.get("_linalg_gemm") is registry.get("linalg_gemm")
+
+
+def test_alias_rejects_arity_mismatch():
+    @registry.register("_test_unary_arity")
+    def _f(a, **_):
+        return a
+
+    registry.OP_INPUT_NAMES["_test_arity_alias"] = ("lhs", "rhs")
+    registry.OP_INPUT_NAMES["_test_unary_arity"] = ("data",)
+    try:
+        with pytest.raises(MXNetError, match="arity mismatch"):
+            registry.alias("_test_arity_alias", "_test_unary_arity")
+    finally:
+        registry.OP_INPUT_NAMES.pop("_test_arity_alias", None)
+        registry.OP_INPUT_NAMES.pop("_test_unary_arity", None)
+        _unregister("_test_unary_arity")
+
+
+def test_deduped_ops_still_work():
+    """_maximum/_minimum/pick/batch_take/Crop survived dedup with the
+    right semantics."""
+    a = mx.nd.array(np.array([[1.0, 5.0], [3.0, 2.0]]))
+    b = mx.nd.array(np.array([[4.0, 0.0], [1.0, 6.0]]))
+    np.testing.assert_allclose(mx.nd.maximum(a, b).asnumpy(),
+                               [[4.0, 5.0], [3.0, 6.0]])
+    np.testing.assert_allclose(mx.nd.minimum(a, b).asnumpy(),
+                               [[1.0, 0.0], [1.0, 2.0]])
+    # pick with explicit axis + keepdims (the general reference op)
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = mx.nd.array(np.array([0, 2, 3], dtype=np.float32))
+    got = mx.nd.pick(data, idx, axis=1)
+    np.testing.assert_allclose(got.asnumpy(), [0.0, 6.0, 11.0])
+    got = mx.nd.batch_take(data, mx.nd.array(np.array([1, 0, 2])), axis=1)
+    np.testing.assert_allclose(got.asnumpy(), [1.0, 4.0, 10.0])
+
+
+# ---------------------------------------------------------- advisor fixes --
+def test_ps_wire_rejects_code_executing_pickle():
+    """Data-plane messages must not unpickle arbitrary globals."""
+    import io
+    import pickle
+
+    from mxnet_tpu.kvstore.ps import _DataUnpickler
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("1+1",))
+
+    payload = pickle.dumps(("push", 0, Evil()))
+    with pytest.raises(pickle.UnpicklingError, match="forbidden"):
+        _DataUnpickler(io.BytesIO(payload)).load()
+
+
+def test_ps_wire_roundtrips_numpy_messages():
+    import io
+    import pickle
+
+    from mxnet_tpu.kvstore.ps import _DataUnpickler
+
+    msg = ("push", "w_3", np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = _DataUnpickler(io.BytesIO(pickle.dumps(msg))).load()
+    assert out[0] == "push" and out[1] == "w_3"
+    np.testing.assert_array_equal(out[2], msg[2])
+    # numpy scalars and dtype objects also cross the wire
+    msg2 = ("ok", np.float32(1.5))
+    out2 = _DataUnpickler(io.BytesIO(pickle.dumps(msg2))).load()
+    assert out2[1] == np.float32(1.5)
+
+
+def test_trainer_rejects_async_with_worker_updates():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2)
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.zeros((1, 3), ctx=mx.cpu()))
+
+    class FakeAsyncKV:
+        type = "dist_async"
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            kvstore=FakeAsyncKV(),
+                            update_on_kvstore=False)
+    with pytest.raises(ValueError, match="update_on_kvstore"):
+        trainer._init_kvstore()
+
+
+def test_local_kvstore_server_command_warns_not_raises():
+    kv = mx.kv.create("local")
+    with pytest.warns(UserWarning, match="ignored"):
+        kv._send_command_to_servers("profiler", "{}")
+
+
+def test_moe_confident_router_wastes_no_capacity():
+    """A token whose top-1 prob is ~1.0 must not burn an expert-0 slot
+    on its zero-probability runner-up."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.moe import MoEFFN
+
+    d, E = 4, 2
+    moe = MoEFFN(d_model=d, d_hidden=8, n_experts=E, capacity_factor=1.0)
+    params = moe.init(jax.random.PRNGKey(0))
+    # gate forcing expert 1 with near-certainty for every token: the
+    # masked runner-up distribution is ~all-zero, argmax falls back to
+    # expert 0
+    params["gate"] = jnp.array(
+        [[-200.0, 200.0]] * d, jnp.float32)
+    S = 4  # capacity at factor 1.0 is ceil(2*S/E) slots per expert
+    x = jnp.asarray(np.random.RandomState(0).randn(1, S, d), jnp.float32)
+    y, _ = moe.apply(params, x)
+    # every token routed to expert 1 with weight ~1; nothing lands in
+    # expert 0's buffer, so output is just expert 1's FFN of x
+    buf_w1 = jnp.einsum("bsd,dh->bsh", x, params["wi"][1])
+    want = jnp.einsum("bsh,hd->bsd", jax.nn.relu(buf_w1), params["wo"][1])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               atol=1e-5)
