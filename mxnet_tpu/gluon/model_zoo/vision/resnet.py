@@ -1,9 +1,18 @@
 """ResNet v1/v2 (reference: python/mxnet/gluon/model_zoo/vision/resnet.py).
 
+By-spec reproduction notice: the network topology (block kinds, layer
+counts, channel widths, stride/downsample placement) and the parameter
+naming scheme are reproduced from the papers ("Deep Residual Learning
+for Image Recognition" / "Identity Mappings in Deep Residual Networks")
+and the reference's Gluon module, because both the architecture and the
+param names ARE the compatibility contract — checkpoints written by the
+reference must load here (tests/test_backwards_compat.py).  Structural
+similarity to the reference file is therefore expected; the compute
+underneath is this repo's own (NCHW lax convs on the MXU, XLA
+conv+bn+relu fusion under ``hybridize()``).
+
 ResNet-50 v1 is the flagship benchmark model (BASELINE.md: ResNet-50
-ImageNet img/s).  All convs are NCHW lax convs on the MXU; under
-``hybridize()`` the whole network stages into one XLA module with
-conv+bn+relu fusion done by XLA.
+ImageNet img/s).
 """
 
 from __future__ import annotations
