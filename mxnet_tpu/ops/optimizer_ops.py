@@ -26,30 +26,24 @@ def _apply_wd_rescale(weight, grad, rescale_grad, clip_gradient, wd):
     return g + wd * weight
 
 
-@register("sgd_update")
-def sgd_update(weight, grad, lr_t=None, lr=0.01, wd=0.0, rescale_grad=1.0,
+@register("sgd_update", traced_attrs=("lr", "wd"))
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=False, **_):
-    # lr_t: optional traced scalar input — time-varying rates (schedulers,
-    # bias correction) must NOT be static attrs or every step recompiles
-    if lr_t is not None:
-        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     return weight - lr * g
 
 
-@register("sgd_mom_update", num_outputs=2)
-def sgd_mom_update(weight, grad, mom, lr_t=None, lr=0.01, momentum=0.0, wd=0.0,
+@register("sgd_mom_update", num_outputs=2, traced_attrs=("lr", "wd"))
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False, **_):
-    if lr_t is not None:
-        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mom = momentum * mom - lr * g
     return weight + new_mom, new_mom
 
 
-@register("nag_mom_update", num_outputs=2)
+@register("nag_mom_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, **_):
     g = _apply_wd_rescale(weight, grad, rescale_grad,
@@ -58,12 +52,10 @@ def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gra
     return weight - lr * (g + momentum * new_mom), new_mom
 
 
-@register("adam_update", num_outputs=3)
-def adam_update(weight, grad, mean, var, lr_t=None, lr=0.001, beta1=0.9,
+@register("adam_update", num_outputs=3, traced_attrs=("lr", "wd"))
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9,
                 beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=False, **_):
-    if lr_t is not None:
-        lr = lr_t
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mean = beta1 * mean + (1.0 - beta1) * g
@@ -72,7 +64,7 @@ def adam_update(weight, grad, mean, var, lr_t=None, lr=0.001, beta1=0.9,
     return new_w, new_mean, new_var
 
 
-@register("adamw_update", num_outputs=3)
+@register("adamw_update", num_outputs=3, traced_attrs=("lr", "wd", "eta"))
 def adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
     """reference: src/operator/contrib/adamw.cc (decoupled weight decay)."""
@@ -85,7 +77,7 @@ def adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return new_w, new_mean, new_var
 
 
-@register("rmsprop_update", num_outputs=2)
+@register("rmsprop_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0, **_):
     g = _apply_wd_rescale(weight, grad, rescale_grad,
@@ -97,7 +89,7 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
     return new_w, new_n
 
 
-@register("rmspropalex_update", num_outputs=4)
+@register("rmspropalex_update", num_outputs=4, traced_attrs=("lr", "wd"))
 def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, **_):
@@ -109,7 +101,7 @@ def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
     return weight + new_delta, new_n, new_g, new_delta
 
 
-@register("signsgd_update")
+@register("signsgd_update", traced_attrs=("lr", "wd"))
 def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
     g = grad * rescale_grad
     if clip_gradient >= 0:
@@ -117,7 +109,7 @@ def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradien
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_outputs=2)
+@register("signum_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, wd_lh=0.0, **_):
     g = grad * rescale_grad
@@ -128,7 +120,7 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad
     return new_w, new_mom
 
 
-@register("ftrl_update", num_outputs=3)
+@register("ftrl_update", num_outputs=3, traced_attrs=("lr", "wd"))
 def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0, **_):
     g = grad * rescale_grad
@@ -145,7 +137,7 @@ def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
     return new_w, new_z, new_n
 
 
-@register("ftml_update", num_outputs=3)
+@register("ftml_update", num_outputs=3, traced_attrs=("lr", "wd", "t"))
 def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
                 wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1, **_):
     g = grad * rescale_grad + wd * weight
@@ -166,7 +158,48 @@ from .registry import get as _get  # noqa: E402
 _get("ftml_update").num_outputs = 4
 
 
-@register("mp_sgd_update", num_outputs=2)
+@register("adamax_update", num_outputs=3,
+          traced_attrs=("lr", "wd", "t"))
+def adamax_update(weight, grad, m, u, lr=0.002, beta1=0.9, beta2=0.999,
+                  wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, t=1, **_):
+    """Fused Adamax (reference computes this as a python composite,
+    optimizer.py Adamax.update; fusing it is the TPU-native choice —
+    one XLA kernel instead of ~8 eager dispatches).  The t-dependent
+    bias correction is a traced scalar so steps never recompile."""
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    lr_c = lr / (1.0 - jnp.power(beta1, t))
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_u = jnp.maximum(beta2 * u, jnp.abs(g))
+    return weight - lr_c * new_m / (new_u + 1e-8), new_m, new_u
+
+
+@register("nadam_update", num_outputs=3,
+          traced_attrs=("lr", "wd", "t", "m_schedule", "momentum_t",
+                        "momentum_t_1"))
+def nadam_update(weight, grad, m, v, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 t=1, m_schedule=1.0, momentum_t=0.9, momentum_t_1=0.9, **_):
+    """Fused Nadam (reference: optimizer.py Nadam.update python
+    composite).  ``m_schedule`` is the product *including* this step's
+    momentum_t (the host tracks it across steps); the schedule scalars
+    are traced so per-step values never recompile."""
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m_schedule_next = m_schedule * momentum_t_1
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    g_prime = g / (1.0 - m_schedule)
+    m_prime = new_m / (1.0 - m_schedule_next)
+    v_prime = new_v / (1.0 - jnp.power(beta2, t))
+    m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+    new_w = weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon)
+    return new_w, new_m, new_v
+
+
+@register("mp_sgd_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, **_):
     """Multi-precision SGD: fp32 master weights, low-precision model weights
@@ -177,7 +210,7 @@ def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
     return new_w32.astype(weight.dtype), new_w32
 
 
-@register("mp_sgd_mom_update", num_outputs=3)
+@register("mp_sgd_mom_update", num_outputs=3, traced_attrs=("lr", "wd"))
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **_):
     g = _apply_wd_rescale(weight32, grad.astype(jnp.float32), rescale_grad,
@@ -194,23 +227,19 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0
 # form: XLA scatter on the dense parameter — one fused gather/update/
 # scatter per step, bandwidth proportional to the touched rows.
 
-@register("_sparse_sgd_update")
-def sparse_sgd_update(weight, grad_val, grad_idx, lr_t=None, lr=0.01, wd=0.0,
+@register("_sparse_sgd_update", traced_attrs=("lr", "wd"))
+def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **_):
-    if lr_t is not None:
-        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     return weight.at[grad_idx].set(rows - lr * g)
 
 
-@register("_sparse_sgd_mom_update", num_outputs=2)
-def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr_t=None, lr=0.01,
+@register("_sparse_sgd_mom_update", num_outputs=2, traced_attrs=("lr", "wd"))
+def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
                           momentum=0.0, wd=0.0, rescale_grad=1.0,
                           clip_gradient=-1.0, **_):
-    if lr_t is not None:
-        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
@@ -219,12 +248,10 @@ def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr_t=None, lr=0.01,
             mom.at[grad_idx].set(new_mom_rows))
 
 
-@register("_sparse_adam_update", num_outputs=3)
-def sparse_adam_update(weight, grad_val, grad_idx, mean, var, lr_t=None,
+@register("_sparse_adam_update", num_outputs=3, traced_attrs=("lr", "wd"))
+def sparse_adam_update(weight, grad_val, grad_idx, mean, var,
                        lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, **_):
-    if lr_t is not None:
-        lr = lr_t
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)

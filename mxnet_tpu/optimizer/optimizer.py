@@ -164,32 +164,24 @@ class Optimizer:
         self.lr_scheduler = None
 
 
-# kernels that accept the learning rate as a TRACED scalar input (lr_t)
-# instead of a static attr — a scheduler- or bias-correction-varying lr
-# must not change the jit cache key or every step recompiles the update
-_DYN_LR_OPS = {"sgd_update", "sgd_mom_update", "adam_update"}
-
-
 def _fused(name, index, weight, grad, states, opt, **extra):
     """Run a fused update op and write results back in place.
+
+    Per-step scalars (scheduler lr, bias-correction t, ...) are declared
+    ``traced_attrs`` on the kernels, so the registry feeds them to the
+    compiled update as weak-typed traced arguments — steady-state steps
+    never recompile, and bf16/fp16 weights are not promoted.
 
     A row_sparse gradient with opt.lazy_update routes to the
     `_sparse_<name>` lazy kernel (reference: optimizer_op.cc FComputeEx
     storage dispatch) — only the gradient's rows are touched."""
-    lr = opt._get_lr(index)
-    attrs = {"wd": opt._get_wd(index),
+    attrs = {"lr": opt._get_lr(index),
+             "wd": opt._get_wd(index),
              "rescale_grad": opt.rescale_grad,
              "clip_gradient": opt.clip_gradient if opt.clip_gradient else -1.0}
     attrs.update(extra)
-    base = name
     name, inputs = _route_sparse(name, weight, grad, states,
                                  getattr(opt, "lazy_update", False))
-    if base in _DYN_LR_OPS:
-        # python float → weak-typed traced scalar: no recompile across
-        # steps AND no dtype promotion of fp16/bf16 weights
-        inputs = inputs + [float(lr)]
-    else:
-        attrs["lr"] = lr
     outs = imperative_invoke(name, inputs, attrs)
     weight._assign(outs[0]._data)
     for st, new in zip(states, outs[1:]):
@@ -488,16 +480,10 @@ class Adamax(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
-        wd = self._get_wd(index)
-        g = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient:
-            g = g.clip(-self.clip_gradient, self.clip_gradient)
         m, u = state
-        m[:] = self.beta1 * m + (1.0 - self.beta1) * g
-        u[:] = imperative_invoke("_maximum", [u * self.beta2, g.abs()], {})[0]
-        weight[:] = weight - lr * m / (u + 1e-8)
+        _fused("adamax_update", index, weight, grad, [m, u], self,
+               beta1=self.beta1, beta2=self.beta2,
+               t=self._index_update_count[index])
 
 
 @register
@@ -520,23 +506,15 @@ class Nadam(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         t = self._index_update_count[index]
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        g = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient:
-            g = g.clip(-self.clip_gradient, self.clip_gradient)
         momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        # the cross-step schedule product stays host-tracked in float64
         self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
         m, v = state
-        g_prime = g / (1.0 - self.m_schedule)
-        m[:] = self.beta1 * m + (1.0 - self.beta1) * g
-        v[:] = self.beta2 * v + (1.0 - self.beta2) * g * g
-        m_prime = m / (1.0 - m_schedule_next)
-        v_prime = v / (1.0 - self.beta2 ** t)
-        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
-        weight[:] = weight - lr * m_bar / ((v_prime ** 0.5) + self.epsilon)
+        _fused("nadam_update", index, weight, grad, [m, v], self,
+               beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+               t=t, m_schedule=self.m_schedule, momentum_t=momentum_t,
+               momentum_t_1=momentum_t_1)
 
 
 @register

@@ -1,0 +1,136 @@
+"""Steady-state optimizer steps must not recompile.
+
+VERDICT r1 weak-spot 7: t-dependent optimizers (Nadam/FTML/Adamax) and
+any scheduler-driven lr recompiled per step in eager loops.  The fix
+routes per-step scalars (lr, wd, t, schedule products, eager
+`x * python_scalar`) through traced jit arguments (Op.traced_attrs).
+
+The assertion is structural, not timing-based: after a warmup step, the
+total number of compiled entries across every op's jit cache must stay
+flat while lr (FactorScheduler per-step decay) and t keep changing.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.lr_scheduler import FactorScheduler
+from mxnet_tpu.ops import registry
+
+
+def _total_jit_entries():
+    return sum(len(op._jit_cache)
+               for op in {id(o): o for o in
+                          registry._OP_REGISTRY.values()}.values())
+
+
+OPTIMIZERS = [
+    ("sgd", {"momentum": 0.9}),
+    ("nag", {"momentum": 0.9}),
+    ("adam", {}),
+    ("adamax", {}),
+    ("nadam", {}),
+    ("ftml", {}),
+    ("ftrl", {}),
+    ("rmsprop", {}),
+    ("adagrad", {}),
+    ("adadelta", {}),
+    ("signum", {"momentum": 0.9}),
+    ("dcasgd", {}),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", OPTIMIZERS,
+                         ids=[n for n, _ in OPTIMIZERS])
+def test_no_steady_state_recompile(name, kwargs):
+    # factor<1 with step=1 changes lr EVERY update; t advances every
+    # update too — neither may grow the jit caches once warm
+    sched = FactorScheduler(step=1, factor=0.99)
+    optimizer = opt.create(name, learning_rate=0.1, lr_scheduler=sched,
+                           **kwargs)
+    updater = opt.get_updater(optimizer)
+    rs = np.random.RandomState(0)
+    weights = [mx.nd.array(rs.randn(4, 3).astype(np.float32)),
+               mx.nd.array(rs.randn(7,).astype(np.float32))]
+
+    def step():
+        for i, w in enumerate(weights):
+            g = mx.nd.array(rs.randn(*w.shape).astype(np.float32))
+            updater(i, g, w)
+
+    for _ in range(3):  # warmup: first-call compiles happen here
+        step()
+    before = _total_jit_entries()
+    for _ in range(5):
+        step()
+    after = _total_jit_entries()
+    assert after == before, (
+        "%s recompiled in steady state: %d -> %d jit entries"
+        % (name, before, after))
+    for w in weights:
+        assert np.all(np.isfinite(w.asnumpy()))
+
+
+def test_traced_scalar_binop_no_recompile():
+    """Eager `x * python_scalar` with a changing scalar reuses one
+    executable (the generic fix behind every composite optimizer)."""
+    x = mx.nd.ones((3, 3))
+    _ = x * 0.5  # warm
+    mul_op = registry.get("_mul_scalar")
+    before = len(mul_op._jit_cache)
+    for s in (0.1, 0.2, 0.3, 1.7, 2.5):
+        _ = x * s
+    assert len(mul_op._jit_cache) == before
+    np.testing.assert_allclose((x * 2.5).asnumpy(), np.full((3, 3), 2.5))
+
+
+def test_fused_adamax_nadam_match_reference_composite():
+    """The new fused kernels must reproduce the reference's python
+    composite numerics (python/mxnet/optimizer/optimizer.py
+    Adamax.update / Nadam.update)."""
+    rs = np.random.RandomState(3)
+    w0 = rs.randn(5, 4).astype(np.float32)
+    grads = [rs.randn(5, 4).astype(np.float32) for _ in range(4)]
+
+    # ---- adamax vs hand-rolled reference loop
+    lr, b1, b2 = 0.002, 0.9, 0.999
+    w = w0.copy()
+    m = np.zeros_like(w)
+    u = np.zeros_like(w)
+    for t, g in enumerate(grads, start=1):
+        lr_c = lr / (1.0 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        w = w - lr_c * m / (u + 1e-8)
+    o = opt.create("adamax", learning_rate=lr, rescale_grad=1.0, wd=0.0)
+    upd = opt.get_updater(o)
+    wn = mx.nd.array(w0.copy())
+    for g in grads:
+        upd(0, mx.nd.array(g), wn)
+    np.testing.assert_allclose(wn.asnumpy(), w, rtol=2e-5, atol=2e-6)
+
+    # ---- nadam vs hand-rolled reference loop
+    lr, b1, b2, eps, sd = 0.001, 0.9, 0.999, 1e-8, 0.004
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    msch = 1.0
+    for t, g in enumerate(grads, start=1):
+        mom_t = b1 * (1.0 - 0.5 * 0.96 ** (t * sd))
+        mom_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * sd))
+        msch = msch * mom_t
+        msch_next = msch * mom_t1
+        gp = g / (1.0 - msch)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mp = m / (1.0 - msch_next)
+        vp = v / (1.0 - b2 ** t)
+        mbar = (1.0 - mom_t) * gp + mom_t1 * mp
+        w = w - lr * mbar / (np.sqrt(vp) + eps)
+    o = opt.create("nadam", learning_rate=lr, rescale_grad=1.0, wd=0.0)
+    upd = opt.get_updater(o)
+    wn = mx.nd.array(w0.copy())
+    for g in grads:
+        upd(0, mx.nd.array(g), wn)
+    np.testing.assert_allclose(wn.asnumpy(), w, rtol=2e-5, atol=2e-6)
