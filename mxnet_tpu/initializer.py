@@ -251,11 +251,54 @@ class LSTMBias(Initializer):
         self.forget_bias = forget_bias
 
     def _init_weight(self, name, arr):
-        arr[:] = 0.0
+        import numpy as _np
+
         num_hidden = arr.shape[0] // 4
-        a = arr.asnumpy()
+        a = _np.zeros(arr.shape, dtype=_np.float32)
         a[num_hidden:2 * num_hidden] = self.forget_bias
         arr[:] = a
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's packed parameter vector by unpacking
+    it, initializing each per-gate piece (forget-gate biases get
+    ``forget_bias``), and packing back (reference: initializer.py
+    FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = create(init)
+        super().__init__(
+            init=init.dumps() if init is not None else None,
+            num_hidden=num_hidden, num_layers=num_layers, mode=mode,
+            bidirectional=bidirectional, forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        pieces = cell.unpack_weights({"parameters": arr})
+        for name, piece in pieces.items():
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                piece[:] = self._forget_bias
+                continue
+            sub_init = self._init
+            if sub_init is None:
+                sub_init = getattr(desc, "global_init", None) or Uniform(0.1)
+            sub_desc = InitDesc(name)
+            sub_desc.global_init = getattr(desc, "global_init", None)
+            sub_init(sub_desc, piece)
+        arr[:] = cell.pack_weights(pieces)["parameters"]
 
 
 class Mixed:

@@ -139,8 +139,13 @@ def rnn(key, data, parameters, state, state_cell=None, state_size=0,
         dir_outs = []
         for d in range(dirs):
             idx = layer * dirs + d
-            h0 = state[idx]
-            c0 = state_cell[idx] if mode == "lstm" else None
+            # a batch-1 begin state broadcasts to the data batch (the
+            # symbolic cells' concrete stand-in for the reference's
+            # deferred batch dim; scan carries need the full shape)
+            bcast = (data.shape[1], state_size)
+            h0 = jnp.broadcast_to(state[idx], bcast)
+            c0 = jnp.broadcast_to(state_cell[idx], bcast) \
+                if mode == "lstm" else None
             w_i2h, w_h2h, b_i2h, b_h2h = ws[layer][d]
             carry, ys = _run_direction(
                 x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, state_size,

@@ -1,0 +1,148 @@
+"""Bucketed sequence data (reference: python/mxnet/rnn/io.py).
+
+``BucketSentenceIter`` groups variable-length sentences into a small
+set of fixed lengths.  On TPU this is the shape-bucketing strategy:
+each bucket length is one static-shape XLA executable (the
+BucketingModule keeps one compiled module per bucket key), so a corpus
+runs with a handful of compiles instead of per-length recompilation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import random
+
+import numpy as np
+
+from ..io import DataBatch, DataDesc, DataIter
+from .. import ndarray
+
+__all__ = ["encode_sentences", "BucketSentenceIter"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0, unknown_token=None):
+    """Map token sentences to int ids, growing the vocabulary as needed
+    (reference: io.py encode_sentences)."""
+    grow = vocab is None
+    if grow:
+        vocab = {invalid_key: invalid_label}
+    next_id = start_label
+    encoded = []
+    for sent in sentences:
+        ids = []
+        for token in sent:
+            if token not in vocab:
+                if not (grow or unknown_token):
+                    raise ValueError("unknown token %r with a frozen "
+                                     "vocabulary" % (token,))
+                if unknown_token:
+                    token = unknown_token
+                if token not in vocab:
+                    if next_id == invalid_label:
+                        next_id += 1
+                    vocab[token] = next_id
+                    next_id += 1
+            ids.append(vocab[token])
+        encoded.append(ids)
+    return encoded, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Language-model iterator: each batch is one bucket's fixed length,
+    label = data shifted left by one token
+    (reference: io.py BucketSentenceIter).
+
+    Yields DataBatch with ``bucket_key`` set, for BucketingModule.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NT"):
+        super().__init__(batch_size=batch_size)
+        self.batch_size = batch_size
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.invalid_label = invalid_label
+        self.layout = layout
+        self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
+            raise ValueError("layout must be 'NT' (batch-major) or 'TN' "
+                             "(time-major), got %r" % (layout,))
+
+        if not buckets:
+            # default buckets: every length with enough sentences to fill
+            # at least one batch
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [length for length, c in enumerate(counts)
+                       if c >= batch_size]
+        buckets = sorted(buckets)
+
+        per_bucket = [[] for _ in buckets]
+        discarded = 0
+        for sent in sentences:
+            slot = bisect.bisect_left(buckets, len(sent))
+            if slot == len(buckets):
+                discarded += 1
+                continue
+            row = np.full((buckets[slot],), invalid_label, dtype=dtype)
+            row[:len(sent)] = sent
+            per_bucket[slot].append(row)
+        if discarded:
+            logging.warning("BucketSentenceIter: discarded %d sentences "
+                            "longer than the largest bucket", discarded)
+        # drop empty buckets
+        kept = [(b, rows) for b, rows in zip(buckets, per_bucket) if rows]
+        self.buckets = [b for b, _ in kept]
+        self.data = [np.asarray(rows, dtype=dtype) for _, rows in kept]
+        if not self.buckets:
+            raise ValueError("no bucket holds a full batch; lower "
+                             "batch_size or pass explicit buckets")
+        self.default_bucket_key = max(self.buckets)
+
+        shape = (batch_size, self.default_bucket_key) \
+            if self.major_axis == 0 else \
+            (self.default_bucket_key, batch_size)
+        self.provide_data = [DataDesc(name=data_name, shape=shape,
+                                      layout=layout)]
+        self.provide_label = [DataDesc(name=label_name, shape=shape,
+                                       layout=layout)]
+
+        self.idx = []
+        self.nddata = []
+        self.ndlabel = []
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        # shuffle batch order across buckets AND rows within buckets
+        self.idx = [(i, j) for i, rows in enumerate(self.data)
+                    for j in range(0, len(rows) - self.batch_size + 1,
+                                   self.batch_size)]
+        random.shuffle(self.idx)
+        self.nddata, self.ndlabel = [], []
+        for rows in self.data:
+            np.random.shuffle(rows)
+            label = np.full_like(rows, self.invalid_label)
+            label[:, :-1] = rows[:, 1:]
+            self.nddata.append(ndarray.array(rows, dtype=self.dtype))
+            self.ndlabel.append(ndarray.array(label, dtype=self.dtype))
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        data = self.nddata[i][j:j + self.batch_size]
+        label = self.ndlabel[i][j:j + self.batch_size]
+        if self.major_axis == 1:
+            data, label = data.T, label.T
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[i],
+            provide_data=[DataDesc(name=self.data_name, shape=data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(name=self.label_name, shape=label.shape,
+                                    layout=self.layout)])

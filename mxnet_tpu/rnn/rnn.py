@@ -1,0 +1,58 @@
+"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py).
+
+Checkpoints are stored UNPACKED (per-gate names) so they interchange
+between fused and unfused cells and remain inspectable; loading packs
+them back into whatever layout the given cells consume.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from ..model import load_checkpoint, save_checkpoint
+from .rnn_cell import BaseRNNCell
+
+__all__ = ["rnn_unroll", "save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def _as_cell_list(cells):
+    cells = [cells] if isinstance(cells, BaseRNNCell) else list(cells)
+    return cells
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC"):
+    """Deprecated alias kept for reference parity; call cell.unroll."""
+    warnings.warn("rnn_unroll is deprecated; call cell.unroll directly")
+    return cell.unroll(length=length, inputs=inputs,
+                       begin_state=begin_state, layout=layout)
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Save symbol+params with every cell's weights unpacked
+    (reference: rnn.py save_rnn_checkpoint)."""
+    for cell in _as_cell_list(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load a checkpoint saved by :func:`save_rnn_checkpoint`, re-packing
+    weights for the given cells (reference: rnn.py load_rnn_checkpoint)."""
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    for cell in _as_cell_list(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback variant of :func:`save_rnn_checkpoint`
+    (reference: rnn.py do_rnn_checkpoint)."""
+    period = max(1, int(period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,814 @@
+"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py:1-1437).
+
+API-parity reimplementation in this repo's idiom.  The cells build
+Symbol graphs step-by-step (python loop over time → one staged XLA
+module at bind), while :class:`FusedRNNCell` emits the monolithic
+``RNN`` op, which lowers to a ``lax.scan`` per layer/direction with the
+input projection hoisted into one MXU matmul (ops/rnn.py — the
+TPU-native counterpart of the reference's cuDNN path,
+src/operator/cudnn_rnn-inl.h).
+
+Parameter-name contract (checkpoints must round-trip with the
+reference): packed names are ``{prefix}i2h_weight`` / ``i2h_bias`` /
+``h2h_weight`` / ``h2h_bias``; per-gate unpacked names insert the gate
+suffix (``{prefix}i2h{gate}_weight`` with gates ``_i,_f,_c,_o`` for
+lstm, ``_r,_z,_o`` for gru).  The fused cell's single vector is
+``{prefix}parameters`` in the gates-major cuDNN layout of ops/rnn.py.
+
+One conscious divergence: the reference writes unknown batch as 0 in
+``begin_state`` shapes and resolves it at bind; XLA needs concrete
+shapes, so default begin states are zeros with batch dim **1** and
+every consumer broadcasts (B,H)⊕(1,H).  Feeding real states of shape
+(B,H) works unchanged.
+"""
+
+from __future__ import annotations
+
+from .. import symbol
+from ..base import MXNetError
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+# gate suffix tables, fused-op (cuDNN) order; ops/rnn.py slices in this
+# order, and the unfused cells compute in this order, so one table
+# serves both
+_GATES = {
+    "rnn_relu": ("",),
+    "rnn_tanh": ("",),
+    "lstm": ("_i", "_f", "_c", "_o"),
+    "gru": ("_r", "_z", "_o"),
+}
+
+
+class RNNParams:
+    """Shared container of symbolic variables, keyed by prefixed name
+    (reference: rnn_cell.py RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        full = self._prefix + name
+        try:
+            return self._params[full]
+        except KeyError:
+            v = symbol.Variable(full, **kwargs)
+            self._params[full] = v
+            return v
+
+
+def _sum_states(cells, member, *args, **kwargs):
+    """Concatenate a per-cell list-valued member across cells."""
+    out = []
+    for c in cells:
+        v = getattr(c, member)
+        out.extend(v(*args, **kwargs) if callable(v) else v)
+    return out
+
+
+def _chain_dicts(cells, member, args):
+    for c in cells:
+        args = getattr(c, member)(args)
+    return args
+
+
+def _as_steps(inputs, length, layout):
+    """Inputs → list of per-step (B, ...) symbols + the time axis."""
+    t_axis = layout.find("T")
+    if isinstance(inputs, symbol.Symbol):
+        if len(inputs.list_outputs()) != 1:
+            raise MXNetError("unroll: grouped symbols are ambiguous; pass "
+                             "a list of per-step symbols instead")
+        steps = list(symbol.SliceChannel(inputs, axis=t_axis,
+                                         num_outputs=length,
+                                         squeeze_axis=1))
+        return steps, t_axis
+    if length is not None and len(inputs) != length:
+        raise MXNetError("unroll: got %d inputs for length=%d"
+                         % (len(inputs), length))
+    return list(inputs), t_axis
+
+
+def _as_merged(outputs, t_axis):
+    """Per-step symbols → one (.., T, ..) symbol stacked on t_axis."""
+    expanded = [symbol.expand_dims(o, axis=t_axis) for o in outputs]
+    return symbol.Concat(*expanded, dim=t_axis)
+
+
+def _shape_outputs(outputs, length, layout, merge):
+    """Apply the merge_outputs contract to a list or merged symbol."""
+    t_axis = layout.find("T")
+    is_merged = isinstance(outputs, symbol.Symbol)
+    if merge is None:
+        return outputs
+    if merge and not is_merged:
+        return _as_merged(outputs, t_axis)
+    if not merge and is_merged:
+        return list(symbol.SliceChannel(outputs, axis=t_axis,
+                                        num_outputs=length, squeeze_axis=1))
+    return outputs
+
+
+class BaseRNNCell:
+    """Abstract symbolic cell: step with ``__call__``, iterate with
+    ``unroll`` (reference: rnn_cell.py BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        self._own_params = params is None
+        self._params = RNNParams(prefix) if params is None else params
+        self._prefix = prefix
+        self._modified = False
+        self.reset()
+
+    # -- bookkeeping -------------------------------------------------------
+    def reset(self):
+        """Forget step/state counters so the cell can build a new graph."""
+        self._counter = -1
+        self._init_counter = -1
+        for c in getattr(self, "_cells", ()):
+            c.reset()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    # -- state contract ----------------------------------------------------
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, **kwargs):
+        if self._modified:
+            raise MXNetError(
+                "cell was wrapped by a modifier (Zoneout/Residual/...); "
+                "request begin_state from the modifier instead")
+        func = func or symbol.zeros
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            kw = dict(kwargs)
+            if info is not None:
+                kw.update(info)
+            # concrete-batch stand-in for the reference's deferred 0
+            if "shape" in kw:
+                kw["shape"] = tuple(1 if d == 0 else d for d in kw["shape"])
+            kw.pop("__layout__", None)
+            states.append(func(
+                name="%sbegin_state_%d" % (self._prefix, self._init_counter),
+                **kw))
+        return states
+
+    # -- weight layout -----------------------------------------------------
+    def unpack_weights(self, args):
+        """Split packed gate matrices into per-gate entries
+        (reference semantics: BaseRNNCell.unpack_weights)."""
+        gates = self._gate_names
+        if not gates:
+            return dict(args)
+        out = dict(args)
+        h = self._num_hidden
+        for part in ("i2h", "h2h"):
+            w = out.pop("%s%s_weight" % (self._prefix, part))
+            b = out.pop("%s%s_bias" % (self._prefix, part))
+            for j, g in enumerate(gates):
+                out["%s%s%s_weight" % (self._prefix, part, g)] = \
+                    w[j * h:(j + 1) * h].copy()
+                out["%s%s%s_bias" % (self._prefix, part, g)] = \
+                    b[j * h:(j + 1) * h].copy()
+        return out
+
+    def pack_weights(self, args):
+        """Inverse of :meth:`unpack_weights`."""
+        gates = self._gate_names
+        if not gates:
+            return dict(args)
+        from .. import ndarray as nd
+
+        out = dict(args)
+        for part in ("i2h", "h2h"):
+            ws, bs = [], []
+            for g in gates:
+                ws.append(out.pop("%s%s%s_weight" % (self._prefix, part, g)))
+                bs.append(out.pop("%s%s%s_bias" % (self._prefix, part, g)))
+            out["%s%s_weight" % (self._prefix, part)] = nd.concatenate(ws)
+            out["%s%s_bias" % (self._prefix, part)] = nd.concatenate(bs)
+        return out
+
+    # -- stepping ----------------------------------------------------------
+    def __call__(self, inputs, states):
+        """One step: (B, in), [states] → output (B, H), [new states]."""
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Python-loop unroll; the whole DAG stages into one XLA module
+        at bind, so there is no per-step dispatch at runtime."""
+        self.reset()
+        steps, t_axis = _as_steps(inputs, length, layout)
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
+        outputs = []
+        for x in steps:
+            out, states = self(x, states)
+            outputs.append(out)
+        if merge_outputs:
+            return _as_merged(outputs, t_axis), states
+        return outputs, states
+
+    def _activate(self, x, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(x, act_type=activation, **kwargs)
+        return activation(x, **kwargs)
+
+
+class _SingleGateSetCell(BaseRNNCell):
+    """Shared plumbing for cells with one fused i2h/h2h matmul pair."""
+
+    def __init__(self, num_hidden, prefix, params, i2h_bias_init=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        p = self.params
+        self._w = {"i2h_weight": p.get("i2h_weight"),
+                   "h2h_weight": p.get("h2h_weight"),
+                   "h2h_bias": p.get("h2h_bias"),
+                   "i2h_bias": p.get("i2h_bias", init=i2h_bias_init)
+                   if i2h_bias_init is not None else p.get("i2h_bias")}
+
+    def _projections(self, inputs, h_prev, step_name):
+        n = self._num_hidden * len(self._gate_names)
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._w["i2h_weight"],
+            bias=self._w["i2h_bias"], num_hidden=n,
+            name="%si2h" % step_name)
+        h2h = symbol.FullyConnected(
+            data=h_prev, weight=self._w["h2h_weight"],
+            bias=self._w["h2h_bias"], num_hidden=n,
+            name="%sh2h" % step_name)
+        return i2h, h2h
+
+    def _step_name(self):
+        self._counter += 1
+        return "%st%d_" % (self._prefix, self._counter)
+
+
+class RNNCell(_SingleGateSetCell):
+    """Elman cell: h' = act(W_x x + W_h h + b)
+    (reference: rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(num_hidden, prefix, params)
+        self._activation = activation
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        i2h, h2h = self._projections(inputs, states[0], name)
+        out = self._activate(i2h + h2h, self._activation,
+                             name="%sout" % name)
+        return out, [out]
+
+
+class LSTMCell(_SingleGateSetCell):
+    """LSTM cell, gates (i, f, c, o), forget bias folded into i2h_bias
+    init (reference: rnn_cell.py LSTMCell, Jozefowicz et al. 2015)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        from ..initializer import LSTMBias
+
+        super().__init__(num_hidden, prefix, params,
+                         i2h_bias_init=LSTMBias(forget_bias=forget_bias))
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        i2h, h2h = self._projections(inputs, states[0], name)
+        g_i, g_f, g_c, g_o = symbol.SliceChannel(
+            i2h + h2h, num_outputs=4, name="%sslice" % name)
+        i = symbol.Activation(g_i, act_type="sigmoid", name="%si" % name)
+        f = symbol.Activation(g_f, act_type="sigmoid", name="%sf" % name)
+        c_tilde = symbol.Activation(g_c, act_type="tanh", name="%sc" % name)
+        o = symbol.Activation(g_o, act_type="sigmoid", name="%so" % name)
+        next_c = symbol.elemwise_add(f * states[1], i * c_tilde,
+                                     name="%sstate" % name)
+        next_h = symbol.elemwise_mul(
+            o, symbol.Activation(next_c, act_type="tanh"),
+            name="%sout" % name)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_SingleGateSetCell):
+    """GRU cell in the cuDNN formulation (reset gate applied to the h2h
+    projection; reference: rnn_cell.py GRUCell)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(num_hidden, prefix, params)
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        h_prev = states[0]
+        i2h, h2h = self._projections(inputs, h_prev, name)
+        xr, xz, xn = symbol.SliceChannel(i2h, num_outputs=3,
+                                         name="%s_i2h_slice" % name)
+        hr, hz, hn = symbol.SliceChannel(h2h, num_outputs=3,
+                                         name="%s_h2h_slice" % name)
+        r = symbol.Activation(xr + hr, act_type="sigmoid",
+                              name="%s_r_act" % name)
+        z = symbol.Activation(xz + hz, act_type="sigmoid",
+                              name="%s_z_act" % name)
+        cand = symbol.Activation(xn + r * hn, act_type="tanh",
+                                 name="%s_h_act" % name)
+        next_h = symbol.elemwise_add((1.0 - z) * cand, z * h_prev,
+                                     name="%sout" % name)
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-stack cell over the monolithic ``RNN`` op
+    (reference: rnn_cell.py FusedRNNCell; TPU impl ops/rnn.py).
+
+    The single packed parameter vector uses the gates-major cuDNN
+    layout; :meth:`unpack_weights` yields the same per-layer,
+    per-direction names the reference produces, so fused↔unfused
+    checkpoints interchange."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        from ..initializer import FusedRNN
+
+        prefix = "%s_" % mode if prefix is None else prefix
+        super().__init__(prefix=prefix, params=params)
+        if mode not in _GATES:
+            raise MXNetError("unknown RNN mode %r" % (mode,))
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = ("l", "r") if bidirectional else ("l",)
+        self._parameter = self.params.get(
+            "parameters", init=FusedRNN(None, num_hidden, num_layers, mode,
+                                        bidirectional, forget_bias))
+
+    @property
+    def state_info(self):
+        depth = len(self._directions) * self._num_layers
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": (depth, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return _GATES[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    # -- packed-vector layout (must mirror ops/rnn.py _unpack) -------------
+    def _walk_slices(self, num_input):
+        """Yield (unpacked_name, offset, shape) triples over the packed
+        vector in the exact order ops/rnn.py consumes it: all weights
+        (layer → direction → i2h gates → h2h gates), then all biases."""
+        h = self._num_hidden
+        b = len(self._directions)
+        pos = 0
+
+        def cell_pieces(stem, kind, in_dim):
+            nonlocal pos
+            shape = (h, in_dim) if kind.endswith("weight") else (h,)
+            n = h * in_dim if kind.endswith("weight") else h
+            for g in self._gate_names:
+                start = pos
+                pos += n
+                yield "%s%s%s_%s" % (stem, kind[:3], g,
+                                     kind[4:]), start, shape
+
+        for layer in range(self._num_layers):
+            in_dim = num_input if layer == 0 else h * b
+            for d in self._directions:
+                stem = "%s%s%d_" % (self._prefix, d, layer)
+                yield from cell_pieces(stem, "i2h_weight", in_dim)
+                yield from cell_pieces(stem, "h2h_weight", h)
+        for layer in range(self._num_layers):
+            for d in self._directions:
+                stem = "%s%s%d_" % (self._prefix, d, layer)
+                yield from cell_pieces(stem, "i2h_bias", 1)
+                yield from cell_pieces(stem, "h2h_bias", 1)
+
+    def _infer_num_input(self, total):
+        h, b, m = self._num_hidden, len(self._directions), self._num_gates
+        return total // (b * h * m) - (self._num_layers - 1) * (h + b * h + 2) \
+            - h - 2
+
+    def unpack_weights(self, args):
+        out = dict(args)
+        vec = out.pop(self._parameter.name)
+        ni = self._infer_num_input(vec.size)
+        consumed = 0
+        for name, start, shape in self._walk_slices(ni):
+            n = 1
+            for d in shape:
+                n *= d
+            out[name] = vec[start:start + n].reshape(shape).copy()
+            consumed += n
+        if consumed != vec.size:
+            raise MXNetError("packed parameter size %d does not match the "
+                             "cell spec" % vec.size)
+        return out
+
+    def pack_weights(self, args):
+        import numpy as _np
+
+        from ..ndarray import array
+
+        out = dict(args)
+        w0 = out["%sl0_i2h%s_weight" % (self._prefix, self._gate_names[0])]
+        ni = w0.shape[1]
+        h, b, m = self._num_hidden, len(self._directions), self._num_gates
+        total = (ni + h + 2) * h * m * b + \
+            (self._num_layers - 1) * m * h * (h + b * h + 2) * b
+        # assemble host-side, one device upload at the end
+        flat = _np.zeros((total,), dtype=_np.float32)
+        for name, start, shape in self._walk_slices(ni):
+            piece = out.pop(name)
+            piece = piece.asnumpy() if hasattr(piece, "asnumpy") \
+                else _np.asarray(piece)
+            flat[start:start + piece.size] = piece.reshape(-1)
+        out[self._parameter.name] = array(flat, ctx=w0.context,
+                                          dtype=w0.dtype)
+        return out
+
+    # -- graph building ----------------------------------------------------
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell has no per-step form; use unroll() "
+                         "or unfuse()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        t_axis = layout.find("T")
+        if not isinstance(inputs, symbol.Symbol):
+            inputs = _as_merged(list(inputs), t_axis)
+        if t_axis == 1:  # RNN op is time-major
+            inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
+        state_kw = {"state": states[0]}
+        if self._mode == "lstm":
+            state_kw["state_cell"] = states[1]
+        rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+                         state_size=self._num_hidden,
+                         num_layers=self._num_layers,
+                         bidirectional=self._bidirectional,
+                         p=self._dropout,
+                         state_outputs=self._get_next_state,
+                         mode=self._mode, name=self._prefix + "rnn",
+                         **state_kw)
+        if not self._get_next_state:
+            outputs, out_states = rnn, []
+        else:
+            n_state = 2 if self._mode == "lstm" else 1
+            outputs = rnn[0]
+            out_states = [rnn[1 + i] for i in range(n_state)]
+            for s in out_states:
+                s._set_attr(__layout__="LNC")
+        if t_axis == 1:
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+        outputs = _shape_outputs(outputs, length, layout, merge_outputs)
+        return outputs, out_states
+
+    def unfuse(self):
+        """Equivalent stack of single-layer cells sharing the unpacked
+        naming scheme (reference: FusedRNNCell.unfuse)."""
+        make = {
+            "rnn_relu": lambda pre: RNNCell(self._num_hidden,
+                                            activation="relu", prefix=pre),
+            "rnn_tanh": lambda pre: RNNCell(self._num_hidden,
+                                            activation="tanh", prefix=pre),
+            "lstm": lambda pre: LSTMCell(self._num_hidden, prefix=pre),
+            "gru": lambda pre: GRUCell(self._num_hidden, prefix=pre),
+        }[self._mode]
+        stack = SequentialRNNCell()
+        for layer in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    make("%sl%d_" % (self._prefix, layer)),
+                    make("%sr%d_" % (self._prefix, layer)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, layer)))
+            else:
+                stack.add(make("%sl%d_" % (self._prefix, layer)))
+            if self._dropout > 0 and layer != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_"
+                                      % (self._prefix, layer)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Vertical stack of cells (reference: SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            if not cell._own_params:
+                raise MXNetError("give params to the stack or to the "
+                                 "child cells, not both")
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return _sum_states(self._cells, "state_info")
+
+    def begin_state(self, **kwargs):
+        if self._modified:
+            raise MXNetError("request begin_state from the modifier cell")
+        return _sum_states(self._cells, "begin_state", **kwargs)
+
+    def unpack_weights(self, args):
+        return _chain_dicts(self._cells, "unpack_weights", args)
+
+    def pack_weights(self, args):
+        return _chain_dicts(self._cells, "pack_weights", args)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        pos = 0
+        for cell in self._cells:
+            if isinstance(cell, BidirectionalCell):
+                raise MXNetError("BidirectionalCell cannot be stepped "
+                                 "inside a stack; use unroll")
+            n = len(cell.state_info)
+            inputs, sub = cell(inputs, states[pos:pos + n])
+            pos += n
+            next_states.extend(sub)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
+        pos = 0
+        next_states = []
+        last = len(self._cells) - 1
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            inputs, sub = cell.unroll(
+                length, inputs=inputs, begin_state=states[pos:pos + n],
+                layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            pos += n
+            next_states.extend(sub)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Stateless dropout-on-input cell (reference: DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        if not isinstance(dropout, (int, float)):
+            raise MXNetError("dropout probability must be numeric")
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if isinstance(inputs, symbol.Symbol) and merge_outputs is not False:
+            # dropout is elementwise: apply once to the merged sequence
+            return self(inputs, [])
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs)
+
+
+class ModifierCell(BaseRNNCell):
+    """Wraps a cell and alters its stepping; parameters stay with the
+    base cell (reference: ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        if self._modified:
+            raise MXNetError("request begin_state from the outermost "
+                             "modifier cell")
+        self.base_cell._modified = False
+        try:
+            return self.base_cell.begin_state(func=func, **kwargs)
+        finally:
+            self.base_cell._modified = True
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout: randomly hold previous outputs/states
+    (reference: ZoneoutCell; Krueger et al. 2016)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        if isinstance(base_cell, FusedRNNCell):
+            raise MXNetError("unfuse() the cell before applying zoneout")
+        if isinstance(base_cell, BidirectionalCell):
+            raise MXNetError("apply zoneout to the cells inside the "
+                             "BidirectionalCell instead")
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+
+        def held(p, new, old):
+            keep = symbol.Dropout(symbol.ones_like(new), p=p)
+            return symbol.where(keep, new, old)
+
+        if self.zoneout_outputs > 0.0:
+            prev = self._prev_output
+            if prev is None:
+                prev = symbol.zeros(shape=(1, 1))
+            out = held(self.zoneout_outputs, out, prev)
+        if self.zoneout_states > 0.0:
+            next_states = [held(self.zoneout_states, n, o)
+                           for n, o in zip(next_states, states)]
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    """output = base(output) + input (reference: ResidualCell;
+    Wu et al. 2016)."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        out = symbol.elemwise_add(out, inputs,
+                                  name="%s_plus_residual" % out.name)
+        return out, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        try:
+            outputs, states = self.base_cell.unroll(
+                length, inputs=inputs, begin_state=begin_state,
+                layout=layout, merge_outputs=merge_outputs)
+        finally:
+            self.base_cell._modified = True
+        merged = isinstance(outputs, symbol.Symbol) \
+            if merge_outputs is None else merge_outputs
+        t_axis = layout.find("T")
+        if merged:
+            if not isinstance(inputs, symbol.Symbol):
+                inputs = _as_merged(list(inputs), t_axis)
+            outputs = symbol.elemwise_add(
+                outputs, inputs, name="%s_plus_residual" % outputs.name)
+        else:
+            steps, _ = _as_steps(inputs, length, layout)
+            outputs = [symbol.elemwise_add(o, x,
+                                           name="%s_plus_residual" % o.name)
+                       for o, x in zip(outputs, steps)]
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Runs one cell forward and one backward over the sequence and
+    concatenates per-step outputs (reference: BidirectionalCell).
+
+    Divergence note: unroll returns the states as one flat list
+    ``l_states + r_states`` (matching begin_state's layout) rather than
+    the reference's nested ``[l_states, r_states]``."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            if not (l_cell._own_params and r_cell._own_params):
+                raise MXNetError("give params to the BidirectionalCell or "
+                                 "to the child cells, not both")
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    @property
+    def state_info(self):
+        return _sum_states(self._cells, "state_info")
+
+    def begin_state(self, **kwargs):
+        if self._modified:
+            raise MXNetError("request begin_state from the modifier cell")
+        return _sum_states(self._cells, "begin_state", **kwargs)
+
+    def unpack_weights(self, args):
+        return _chain_dicts(self._cells, "unpack_weights", args)
+
+    def pack_weights(self, args):
+        return _chain_dicts(self._cells, "pack_weights", args)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell sees the whole sequence; "
+                         "use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        steps, t_axis = _as_steps(inputs, length, layout)
+        states = begin_state if begin_state is not None else \
+            self.begin_state()
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_out, l_states = l_cell.unroll(length, inputs=steps,
+                                        begin_state=states[:n_l],
+                                        layout=layout, merge_outputs=False)
+        r_out, r_states = r_cell.unroll(length,
+                                        inputs=list(reversed(steps)),
+                                        begin_state=states[n_l:],
+                                        layout=layout, merge_outputs=False)
+        r_out = list(reversed(r_out))
+        outputs = [symbol.Concat(lo, ro, dim=1,
+                                 name="%st%d" % (self._output_prefix, i))
+                   for i, (lo, ro) in enumerate(zip(l_out, r_out))]
+        if merge_outputs:
+            outputs = _as_merged(outputs, t_axis)
+        return outputs, l_states + r_states

@@ -740,6 +740,22 @@ def _solve_params(node, in_shapes, shapes):
         for i, nm in enumerate(names[:len(node.inputs)]):
             if nm == "weight":
                 setv(i, (int(a.get("input_dim", 1)), int(a.get("output_dim", 1))))
+    elif node.op == "RNN":
+        # data (T, B, in) fixes the packed vector and state shapes
+        # (reference: rnn-inl.h RNNShape)
+        from ..ops.rnn import rnn_param_size
+
+        h = int(a.get("state_size", 0))
+        layers = int(a.get("num_layers", 1))
+        dirs = 2 if a.get("bidirectional") else 1
+        t, b, din = data_shape
+        for i, nm in enumerate(names[:len(node.inputs)]):
+            if nm == "parameters":
+                setv(i, (rnn_param_size(layers, din, h,
+                                        bool(a.get("bidirectional")),
+                                        a.get("mode", "lstm")),))
+            elif nm in ("state", "state_cell"):
+                setv(i, (layers * dirs, b, h))
     elif node.op == "LeakyReLU" and a.get("act_type") == "prelu":
         if len(node.inputs) > 1:
             setv(1, (data_shape[1],))

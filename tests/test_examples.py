@@ -204,3 +204,14 @@ def test_launch_dist_async_kvstore():
         env=env, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("dist_async_kvstore OK") == 2, r.stdout + r.stderr
+
+
+def test_bucketed_lstm_lm_converges():
+    """The canonical symbolic RNN path: BucketSentenceIter +
+    BucketingModule + stacked LSTMCell.unroll (reference:
+    example/rnn/bucketing/lstm_bucketing.py; BASELINE config 3)."""
+    ppl = _run_example("rnn/bucketing/lstm_bucketing.py",
+                       ["--num-epochs", "3"])
+    # synthetic ring corpus: uniform ppl is 16; the LSTM must learn the
+    # transition structure
+    assert ppl < 5.0, "val perplexity %.3f did not converge" % ppl

@@ -1,0 +1,235 @@
+"""mx.rnn symbolic package tests.
+
+Reference analog: tests/python/unittest/test_rnn.py — fused/unfused
+equivalence via pack_weights, unroll shapes, bucketed iterator
+semantics, RNN checkpoint round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bind_forward(sym, shapes, args=None):
+    ex = sym.simple_bind(ctx=mx.cpu(), **shapes)
+    if args:
+        for k, v in args.items():
+            ex.arg_dict[k][:] = v
+    ex.forward()
+    return ex
+
+
+# ------------------------------------------------------------- basic cells --
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    assert sorted(outputs.list_arguments()) == [
+        "data", "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias",
+        "rnn_i2h_weight"]
+    ex = _bind_forward(outputs, {"data": (2, 3, 7)})
+    assert ex.outputs[0].shape == (2, 3, 10)
+
+
+def test_lstm_cell_matches_numpy():
+    h = 4
+    cell = mx.rnn.LSTMCell(h, prefix="lstm_")
+    out, states = cell.unroll(2, inputs=mx.sym.Variable("data"),
+                              merge_outputs=True)
+    rs = np.random.RandomState(0)
+    x = rs.randn(3, 2, 5).astype(np.float32)
+    wi = rs.randn(4 * h, 5).astype(np.float32) * 0.3
+    wh = rs.randn(4 * h, h).astype(np.float32) * 0.3
+    bi = rs.randn(4 * h).astype(np.float32) * 0.1
+    bh = rs.randn(4 * h).astype(np.float32) * 0.1
+    ex = _bind_forward(out, {"data": x.shape},
+                       {"data": x, "lstm_i2h_weight": wi,
+                        "lstm_h2h_weight": wh, "lstm_i2h_bias": bi,
+                        "lstm_h2h_bias": bh})
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    hh = np.zeros((3, h), np.float32)
+    cc = np.zeros((3, h), np.float32)
+    want = []
+    for t in range(2):
+        g = x[:, t] @ wi.T + bi + hh @ wh.T + bh
+        i, f, c_t, o = np.split(g, 4, axis=1)
+        cc = sigmoid(f) * cc + sigmoid(i) * np.tanh(c_t)
+        hh = sigmoid(o) * np.tanh(cc)
+        want.append(hh)
+    want = np.stack(want, axis=1)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "rnn_relu", "lstm", "gru"])
+def test_fused_matches_unfused(mode):
+    """Pack the unfused stack's weights into the fused vector; outputs
+    must agree (the layout contract of ops/rnn.py)."""
+    T, B, D, H, L = 4, 3, 5, 6, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_")
+    stack = fused.unfuse()
+
+    fo, _ = fused.unroll(T, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    so, _ = stack.unroll(T, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+
+    rs = np.random.RandomState(1)
+    x = rs.randn(B, T, D).astype(np.float32)
+    # random unfused params -> pack into the fused vector
+    sex = so.simple_bind(ctx=mx.cpu(), data=(B, T, D))
+    args = {}
+    for name, arr in sex.arg_dict.items():
+        if name == "data":
+            continue
+        args[name] = mx.nd.array(
+            rs.randn(*arr.shape).astype(np.float32) * 0.2)
+        sex.arg_dict[name][:] = args[name]
+    sex.arg_dict["data"][:] = x
+    sex.forward()
+
+    packed = fused.pack_weights(stack.unpack_weights(args))
+    fex = fo.simple_bind(ctx=mx.cpu(), data=(B, T, D))
+    fex.arg_dict["f_parameters"][:] = packed["f_parameters"]
+    fex.arg_dict["data"][:] = x
+    fex.forward()
+
+    np.testing.assert_allclose(fex.outputs[0].asnumpy(),
+                               sex.outputs[0].asnumpy(), atol=2e-5)
+
+
+def test_fused_unpack_pack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(5, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="blstm_")
+    n = sum(np.prod(s) for s in [])  # placeholder to keep flake quiet
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    total = rnn_param_size(2, 3, 5, True, "lstm")
+    vec = mx.nd.array(np.random.RandomState(2).randn(total)
+                      .astype(np.float32))
+    unpacked = fused.unpack_weights({"blstm_parameters": vec})
+    assert "blstm_l0_i2h_i_weight" in unpacked
+    assert "blstm_r1_h2h_o_bias" in unpacked
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["blstm_parameters"].asnumpy(),
+                               vec.asnumpy(), atol=0)
+
+
+def test_bidirectional_cell():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="l_"),
+                                  mx.rnn.LSTMCell(4, prefix="r_"))
+    out, states = bi.unroll(3, inputs=mx.sym.Variable("data"),
+                            merge_outputs=True)
+    ex = _bind_forward(out, {"data": (2, 3, 5)})
+    assert ex.outputs[0].shape == (2, 3, 8)
+    assert len(states) == 4  # flat [l_h, l_c, r_h, r_c]
+
+
+def test_residual_and_dropout_cells():
+    base = mx.rnn.RNNCell(6, prefix="res_")
+    res = mx.rnn.ResidualCell(base)
+    out, _ = res.unroll(3, inputs=mx.sym.Variable("data"),
+                        merge_outputs=True)
+    ex = _bind_forward(out, {"data": (2, 3, 6)})
+    assert ex.outputs[0].shape == (2, 3, 6)
+
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(6, prefix="s0_"))
+    stack.add(mx.rnn.DropoutCell(0.3, prefix="do_"))
+    stack.add(mx.rnn.LSTMCell(6, prefix="s1_"))
+    out, _ = stack.unroll(3, inputs=mx.sym.Variable("data"),
+                          merge_outputs=True)
+    ex = _bind_forward(out, {"data": (2, 3, 6)})
+    assert ex.outputs[0].shape == (2, 3, 6)
+
+
+def test_zoneout_cell_runs():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="z_"),
+                              zoneout_outputs=0.3, zoneout_states=0.2)
+    out, _ = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    ex = _bind_forward(out, {"data": (2, 3, 4)})
+    assert ex.outputs[0].shape == (2, 3, 4)
+
+
+def test_gru_stack_trains():
+    """Gradients flow through an unrolled GRU via the executor."""
+    cell = mx.rnn.GRUCell(5, prefix="g_")
+    out, _ = cell.unroll(4, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    loss = mx.sym.make_loss(mx.sym.sum(out * out))
+    ex = loss.simple_bind(ctx=mx.cpu(), data=(2, 4, 3))
+    for k, v in ex.arg_dict.items():
+        v[:] = np.random.RandomState(0).randn(*v.shape).astype(np.float32) * 0.2
+    ex.forward(is_train=True)
+    ex.backward()
+    gnorm = sum(float((g.asnumpy() ** 2).sum())
+                for k, g in ex.grad_dict.items() if k != "data")
+    assert gnorm > 0
+
+
+# ------------------------------------------------------------ io + buckets --
+def test_encode_sentences():
+    sents = [["a", "b", "c"], ["b", "c"], ["a", "d"]]
+    enc, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) == 5  # 4 tokens + invalid '\n'
+    assert enc[0][0] == enc[2][0]  # same token, same id
+    # frozen vocab rejects unknowns
+    with pytest.raises(ValueError):
+        mx.rnn.encode_sentences([["zzz"]], vocab=dict(vocab))
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(1, 20, size=n))
+             for n in rs.choice([4, 7, 11], size=60)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4,
+                                   buckets=[4, 7, 11], invalid_label=0)
+    assert it.default_bucket_key == 11
+    seen = set()
+    count = 0
+    for batch in it:
+        count += 1
+        seen.add(batch.bucket_key)
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (4, batch.bucket_key)
+        # label is data shifted one step left
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        assert np.all(label[:, -1] == 0)
+    assert count >= 3 and len(seen) >= 2
+    # reset reshuffles but keeps batch count
+    it.reset()
+    assert sum(1 for _ in it) == count
+
+
+def test_time_major_layout():
+    rs = np.random.RandomState(1)
+    sents = [list(rs.randint(1, 9, size=5)) for _ in range(8)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[5],
+                                   layout="TN")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 2)
+
+
+# -------------------------------------------------------------- checkpoint --
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "fused_lstm")
+    fused = mx.rnn.FusedRNNCell(4, num_layers=1, mode="lstm", prefix="ck_")
+    out, _ = fused.unroll(3, inputs=mx.sym.Variable("data"),
+                          merge_outputs=True)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    vec = mx.nd.array(np.random.RandomState(3).randn(
+        rnn_param_size(1, 6, 4, False, "lstm")).astype(np.float32))
+    args = {"ck_parameters": vec}
+    mx.rnn.save_rnn_checkpoint(fused, prefix, 7, out, args, {})
+    # saved file holds UNPACKED per-gate names
+    loaded_raw = mx.nd.load("%s-%04d.params" % (prefix, 7))
+    assert any("i2h_f_weight" in k for k in loaded_raw)
+    sym, arg, aux = mx.rnn.load_rnn_checkpoint(fused, prefix, 7)
+    np.testing.assert_allclose(arg["ck_parameters"].asnumpy(),
+                               vec.asnumpy(), atol=0)
