@@ -254,6 +254,74 @@ def check_consistency(sym, ctx_list=None, scale=1.0, rtol=1e-4, atol=1e-4,
     return results
 
 
+def check_op_consistency(op_name, arrays, attrs=None, rtol=1e-4, atol=1e-4,
+                         shard_axis=0):
+    """Run one op THREE ways and compare outputs:
+
+    1. eager — the imperative NDArray dispatch (per-op jit cache);
+    2. staged — a Symbol graph through the Executor (whole-graph jit);
+    3. sharded — the pure fn jitted with its first input sharded over
+       every available device (GSPMD partitions the computation).
+
+    The TPU analog of the reference's cpu-vs-gpu ``check_consistency``
+    (python/mxnet/test_utils.py): instead of two device backends, the
+    three execution paths that must agree on this framework.
+    Returns the eager outputs as numpy arrays.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from . import symbol as sym_mod
+    from .ndarray import array
+    from .ops import registry
+
+    attrs = dict(attrs or {})
+    op = registry.get(op_name)
+
+    # 1. eager
+    nd_in = [array(a) for a in arrays]
+    from .ndarray.ndarray import imperative_invoke
+
+    eager = [o.asnumpy() for o in imperative_invoke(op_name, nd_in, dict(attrs))]
+
+    # 2. staged via symbol executor (aux inputs — e.g. BatchNorm moving
+    # stats — bind as aux states, not arguments)
+    variables = [sym_mod.Variable("in%d" % i) for i in range(len(arrays))]
+    out_sym = getattr(sym_mod, op_name)(*variables, **attrs)
+    by_name = {"in%d" % i: array(a) for i, a in enumerate(arrays)}
+    args = {n: by_name[n] for n in out_sym.list_arguments()}
+    aux = {n: by_name[n] for n in out_sym.list_auxiliary_states()}
+    ex = out_sym.bind(cpu(), args, aux_states=aux)
+    staged = [o.asnumpy() for o in ex.forward()]
+
+    # 3. sharded over all devices (skipped when the axis doesn't divide)
+    devices = jax.devices()
+    n = len(devices)
+    sharded = None
+    if n > 1 and arrays and arrays[0].ndim > shard_axis and \
+            arrays[0].shape[shard_axis] % n == 0:
+        mesh = Mesh(_np.array(devices), ("dp",))
+        spec = [None] * arrays[0].ndim
+        spec[shard_axis] = "dp"
+        shardings = [NamedSharding(mesh, PartitionSpec(*spec))] + \
+            [NamedSharding(mesh, PartitionSpec())] * (len(arrays) - 1)
+        fn = op.bind_attrs(op.canonicalize_attrs(attrs))
+        jitted = jax.jit(fn, in_shardings=shardings)
+        out = jitted(*[jnp.asarray(a) for a in arrays])
+        out = out if isinstance(out, tuple) else (out,)
+        sharded = [_np.asarray(o) for o in out]
+
+    for name, res in (("staged", staged), ("sharded", sharded)):
+        if res is None:
+            continue
+        assert len(res) == len(eager), (op_name, name)
+        for a, b in zip(eager, res):
+            assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                names=("eager", name))
+    return eager
+
+
 def simple_forward(sym, ctx=None, is_train=False, **inputs):
     ex = sym.bind(ctx or current_context(),
                   {k: array(v) for k, v in inputs.items()})

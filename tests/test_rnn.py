@@ -104,7 +104,6 @@ def test_fused_matches_unfused(mode):
 def test_fused_unpack_pack_roundtrip():
     fused = mx.rnn.FusedRNNCell(5, num_layers=2, mode="lstm",
                                 bidirectional=True, prefix="blstm_")
-    n = sum(np.prod(s) for s in [])  # placeholder to keep flake quiet
     from mxnet_tpu.ops.rnn import rnn_param_size
 
     total = rnn_param_size(2, 3, 5, True, "lstm")
