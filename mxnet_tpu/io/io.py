@@ -552,7 +552,7 @@ class ImageRecordIter(DataIter):
                  shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  rand_crop=False, rand_mirror=False, num_parts=1, part_index=0,
                  preprocess_threads=4, shuffle_buffer=4096, seed=0,
-                 use_native=None, **kwargs):
+                 use_native=None, raw_records=False, **kwargs):
         super().__init__(batch_size)
         from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 
@@ -563,6 +563,17 @@ class ImageRecordIter(DataIter):
         self.mean = _np.array([mean_r, mean_g, mean_b], dtype=_np.float32)
         self._unpack_img = unpack_img
         self.shuffle = shuffle
+        # raw_records: payloads are raw float32 tensor bytes, decoded by
+        # the C++ builtin (pipeline.cc DecodeRaw) with no Python in the
+        # worker loop — the no-augment high-throughput path
+        self._raw_records = raw_records
+        if raw_records and (rand_crop or rand_mirror
+                            or mean_r or mean_g or mean_b):
+            import warnings
+            warnings.warn(
+                "ImageRecordIter(raw_records=True): augmentation arguments "
+                "(rand_crop/rand_mirror/mean_*) are ignored on the raw "
+                "memcpy path", stacklevel=2)
         self._pipe = None
         if use_native is None:
             use_native = os.environ.get("MXNET_USE_NATIVE_ITER", "1") == "1"
@@ -573,7 +584,8 @@ class ImageRecordIter(DataIter):
                     sample_shape=self.data_shape, label_width=label_width,
                     shuffle=shuffle_buffer if shuffle else 0, seed=seed,
                     num_workers=preprocess_threads,
-                    part_index=part_index, num_parts=num_parts)
+                    part_index=part_index, num_parts=num_parts,
+                    use_builtin_decode=raw_records)
             except (RuntimeError, OSError) as e:
                 # toolchain/build problems only; anything else propagates.
                 import warnings
@@ -600,6 +612,14 @@ class ImageRecordIter(DataIter):
     def _decode_into(self, rec_bytes, data_out, label_out):
         """Decode one packed record into flat float32 CHW + label slots
         (called from C++ decode workers via ctypes)."""
+        if self._raw_records:  # python-fallback twin of DecodeRaw
+            from ..recordio import unpack
+
+            header, payload = unpack(rec_bytes)
+            data_out[:] = _np.frombuffer(payload, dtype=_np.float32)
+            label_out[:] = 0.0
+            label_out[0] = float(header.label)
+            return
         header, img = self._unpack_img(rec_bytes)
         img = self._augment(img)
         data_out[:] = img.ravel()
@@ -672,6 +692,17 @@ class ImageRecordIter(DataIter):
             if pos >= len(self._records):
                 pos = pos % max(len(self._records), 1)
             item = self._records[self._order[pos]]
+            if self._raw_records:
+                from ..recordio import unpack
+
+                header, payload = unpack(item)
+                datas.append(_np.frombuffer(payload, dtype=_np.float32)
+                             .reshape(self.data_shape))
+                lab = header.label
+                labels.append(float(lab) if _np.isscalar(lab)
+                              or getattr(lab, "ndim", 0) == 0
+                              else _np.asarray(lab, dtype=_np.float32))
+                continue
             header, img = self._unpack_img(item)
             datas.append(self._augment(img))
             lab = header.label
@@ -693,7 +724,8 @@ class _NativePipeline:
     writing straight into the recycled batch buffer."""
 
     def __init__(self, owner, path, batch_size, sample_shape, label_width,
-                 shuffle, seed, num_workers, part_index, num_parts):
+                 shuffle, seed, num_workers, part_index, num_parts,
+                 use_builtin_decode=False):
         import ctypes
 
         from .. import _native
@@ -709,20 +741,25 @@ class _NativePipeline:
         self._sample_elems = int(_np.prod(self.sample_shape))
         sample_bytes = self._sample_elems * 4  # float32
 
-        def _cb(_ctx, rec_ptr, rec_len, data_out, label_out):
-            try:
-                rec = ctypes.string_at(rec_ptr, rec_len)
-                d = _np.ctypeslib.as_array(data_out,
-                                           (self._sample_elems * 4,))
-                l = _np.ctypeslib.as_array(label_out, (label_width,))
-                owner._decode_into(rec, d.view(_np.float32), l)
-                return 0
-            except Exception:
-                import traceback
-                self._decode_error = traceback.format_exc()
-                return 1
+        if use_builtin_decode:
+            # NULL fn pointer: C++ workers memcpy records directly via
+            # the builtin DecodeRaw — zero Python in the loop
+            self._cb = _native.DECODE_FN()
+        else:
+            def _cb(_ctx, rec_ptr, rec_len, data_out, label_out):
+                try:
+                    rec = ctypes.string_at(rec_ptr, rec_len)
+                    d = _np.ctypeslib.as_array(data_out,
+                                               (self._sample_elems * 4,))
+                    l = _np.ctypeslib.as_array(label_out, (label_width,))
+                    owner._decode_into(rec, d.view(_np.float32), l)
+                    return 0
+                except Exception:
+                    import traceback
+                    self._decode_error = traceback.format_exc()
+                    return 1
 
-        self._cb = _native.DECODE_FN(_cb)  # keep alive
+            self._cb = _native.DECODE_FN(_cb)  # keep alive
         h = ctypes.c_void_p()
         _native.check_call(lib.MXTPUPipelineCreate(
             path.encode(), 8 << 20, part_index, num_parts, batch_size,

@@ -393,3 +393,61 @@ def test_c_abi_from_c(tmp_path):
                        text=True, timeout=60)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "all checks passed" in r.stdout
+
+
+@pytest.mark.parametrize("use_native", [True, False],
+                         ids=["native", "py-fallback"])
+def test_image_record_iter_raw_records(tmp_path, use_native):
+    """raw_records=True routes to the C++ builtin DecodeRaw (no Python
+    in the worker loop) — or the equivalent numpy path when the native
+    lib is unavailable; values and labels must round-trip on both."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+    from mxnet_tpu.recordio import IRHeader, MXRecordIO, pack
+
+    if use_native and _native.get_lib() is None:
+        pytest.skip("native lib not built")
+    path = str(tmp_path / "raw.rec")
+    rs = np.random.RandomState(0)
+    samples = []
+    rec = MXRecordIO(path, "w")
+    for i in range(12):
+        arr = rs.rand(2, 4, 4).astype(np.float32)
+        samples.append((float(i % 5), arr))
+        rec.write(pack(IRHeader(0, float(i % 5), i, 0), arr.tobytes()))
+    rec.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(2, 4, 4),
+                               batch_size=4, shuffle=False,
+                               preprocess_threads=2, raw_records=True,
+                               use_native=use_native)
+    assert (it._pipe is not None) == use_native
+    seen = 0
+    for batch in it:
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy().ravel()
+        for j in range(4):
+            want_label, want_arr = samples[seen]
+            np.testing.assert_allclose(data[j], want_arr, atol=0)
+            assert label[j] == want_label
+            seen += 1
+    assert seen == 12
+
+
+def test_raw_records_warns_on_dropped_augmentation(tmp_path):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.recordio import IRHeader, MXRecordIO, pack
+
+    path = str(tmp_path / "raw2.rec")
+    rec = MXRecordIO(path, "w")
+    rec.write(pack(IRHeader(0, 0.0, 0, 0),
+                   np.zeros((2, 4, 4), np.float32).tobytes()))
+    rec.close()
+    with pytest.warns(UserWarning, match="augmentation"):
+        mx.io.ImageRecordIter(path_imgrec=path, data_shape=(2, 4, 4),
+                              batch_size=1, rand_mirror=True,
+                              raw_records=True, use_native=False)
