@@ -46,10 +46,14 @@ class _Conv(HybridBlock):
             "no_bias": not use_bias, "layout": layout}
         if adj is not None:
             self._kwargs["adj"] = _tup(adj, nd)
+        self._channel_last = layout.endswith("C")
         with self.name_scope():
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + tuple(kernel_size)
+                in_c = in_channels // groups if in_channels else 0
+                # channel-last layouts use the reference's OHWI weight
+                wshape = (channels,) + tuple(kernel_size) + (in_c,) \
+                    if self._channel_last \
+                    else (channels, in_c) + tuple(kernel_size)
             else:  # Deconvolution: (in_c, out_c/groups, *k)
                 wshape = (in_channels if in_channels else 0, channels // groups) \
                     + tuple(kernel_size)
@@ -63,10 +67,14 @@ class _Conv(HybridBlock):
             if activation is not None else None
 
     def infer_shape(self, x, *args):
-        in_c = x.shape[1]
+        in_c = x.shape[-1] if getattr(self, "_channel_last", False) \
+            else x.shape[1]
         groups = self._kwargs["num_group"]
         k = tuple(self._kwargs["kernel"])
         if self._op_name == "Convolution":
+            if getattr(self, "_channel_last", False):
+                self.weight.shape = (self._channels,) + k + (in_c // groups,)
+                return
             self.weight.shape = (self._channels, in_c // groups) + k
         else:
             self.weight.shape = (in_c, self._channels // groups) + k

@@ -612,14 +612,6 @@ class ImageRecordIter(DataIter):
     def _decode_into(self, rec_bytes, data_out, label_out):
         """Decode one packed record into flat float32 CHW + label slots
         (called from C++ decode workers via ctypes)."""
-        if self._raw_records:  # python-fallback twin of DecodeRaw
-            from ..recordio import unpack
-
-            header, payload = unpack(rec_bytes)
-            data_out[:] = _np.frombuffer(payload, dtype=_np.float32)
-            label_out[:] = 0.0
-            label_out[0] = float(header.label)
-            return
         header, img = self._unpack_img(rec_bytes)
         img = self._augment(img)
         data_out[:] = img.ravel()

@@ -239,3 +239,32 @@ def test_split_and_load():
     assert parts[0].shape == (4, 3)
     got = np.concatenate([p.asnumpy() for p in parts])
     assert_almost_equal(got, x.asnumpy())
+
+
+def test_conv2d_nhwc_layout():
+    """Gluon Conv2D with layout='NHWC' allocates OHWI weights and
+    matches the NCHW twin."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 5, 5, 3).astype(np.float32)
+
+    cl = nn.Conv2D(4, kernel_size=3, padding=1, layout="NHWC")
+    cl.initialize()
+    out = cl(nd.array(x))
+    assert out.shape == (2, 5, 5, 4)
+    assert cl.weight.shape == (4, 3, 3, 3)  # OHWI
+
+    cf = nn.Conv2D(4, kernel_size=3, padding=1)
+    cf.initialize()
+    cf(nd.array(x.transpose(0, 3, 1, 2)))
+    # copy OHWI -> OIHW and compare
+    cf.weight.set_data(nd.array(
+        cl.weight.data().asnumpy().transpose(0, 3, 1, 2)))
+    cf.bias.set_data(cl.bias.data())
+    want = cf(nd.array(x.transpose(0, 3, 1, 2))).asnumpy()
+    np.testing.assert_allclose(out.asnumpy().transpose(0, 3, 1, 2), want,
+                               rtol=1e-4, atol=1e-4)

@@ -835,3 +835,22 @@ def test_registry_fully_covered():
     for op, path in ELSEWHERE.items():
         assert os.path.exists(os.path.join(os.path.dirname(here), path)), \
             "%s points at missing %s" % (op, path)
+
+
+def test_conv_nhwc_layout_matches_nchw():
+    """layout='NHWC' (channel-last data, OHWI weight — the reference's
+    NHWC weight convention) must equal the NCHW result transposed
+    (BENCH_NOTES layout experiment: ~+7% on the conv trunk on TPU)."""
+    x = _f32(2, 3, 6, 6)
+    w = _f32(4, 3, 3, 3, seed=1)
+    b = _f32(4, seed=2)
+    want = np.asarray(_run("Convolution", [x, w, b],
+                           {"kernel": (3, 3), "num_filter": 4,
+                            "pad": (1, 1)})[0])
+    got = np.asarray(_run("Convolution",
+                          [x.transpose(0, 2, 3, 1),
+                           w.transpose(0, 2, 3, 1), b],
+                          {"kernel": (3, 3), "num_filter": 4,
+                           "pad": (1, 1), "layout": "NHWC"})[0])
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               rtol=1e-4, atol=1e-4)

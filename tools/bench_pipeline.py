@@ -85,16 +85,17 @@ def bench_pipeline(batch, steps, hw, nthreads, raw=False, epochs=2):
     # wrapping into device NDArrays belongs to the e2e number — on a
     # tunneled dev chip it costs a relay round-trip per batch and would
     # hide the pipeline's own rate
+    if it._pipe is None:
+        raise RuntimeError(
+            "pipeline mode measures the native C++ pipe at the host "
+            "boundary; the Python fallback would wrap every batch in a "
+            "device NDArray and measure the upload link instead")
     t0 = time.perf_counter()
     done = 0
     for _ in range(epochs):
-        if it._pipe is not None:
-            while it._pipe.has_next():
-                it._pipe.next()
-                done += 1
-        else:
-            for b in it:
-                done += 1
+        while it._pipe.has_next():
+            it._pipe.next()
+            done += 1
         it.reset()
     dt = time.perf_counter() - t0
     return done * batch / dt
