@@ -47,6 +47,10 @@ class _Conv(HybridBlock):
         if adj is not None:
             self._kwargs["adj"] = _tup(adj, nd)
         self._channel_last = layout.endswith("C")
+        if self._channel_last and op_name != "Convolution":
+            raise NotImplementedError(
+                "channel-last layout %r is only supported for Convolution "
+                "layers (Deconvolution is NC*-only)" % (layout,))
         with self.name_scope():
             if op_name == "Convolution":
                 in_c = in_channels // groups if in_channels else 0

@@ -4,10 +4,13 @@ Reference: include/mxnet/ndarray.h:61 (NDArrayStorageType),
 python/mxnet/ndarray/sparse.py, src/operator/tensor/cast_storage-inl.h.
 
 TPU-native stance: XLA has no first-class sparse buffers; row_sparse is
-represented as (indices, values) host-side metadata over dense jax
-arrays and converts to dense at op boundaries (XLA scatter/gather).
-This gives API parity for embedding/optimizer flows
-(``row_sparse_pull``); kernels stay dense-MXU friendly.
+a REAL (indices, values) pair on device — the dense view is LAZY and
+materializes only when a dense consumer touches it (XLA scatter at that
+boundary).  The embedding-scale flows the type exists for (reference:
+kvstore_dist.h:470 PullRowSparse; lazy optimizer rows) run entirely on
+the (indices, values) pair, so a gradient over a 10M-row table costs
+memory proportional to the touched rows, not the table.  CSR keeps the
+r1 dense-backed layout (its reference uses are small matrices).
 """
 
 from __future__ import annotations
@@ -27,15 +30,93 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """row_sparse: (indices into dim0, values for those rows)."""
+    """row_sparse: a device (indices into dim0, values for those rows)
+    pair.  The dense view is lazy — see module docstring."""
+
+    __slots__ = ("_dense_cache", "_rs_shape")
 
     def __init__(self, data, indices, shape, ctx=None):
-        import jax.numpy as jnp
-
-        dense = jnp.zeros(shape, dtype=data.dtype).at[indices].set(data)
-        super().__init__(dense, ctx)
+        # deliberately NOT NDArray.__init__: no dense materialization
+        self._dense_cache = None
+        self._rs_shape = tuple(int(d) for d in shape)
+        self._ctx = ctx
+        self._ag_node = None
+        self._writeback = None
         self._stype = "row_sparse"
         self._aux = (indices, data)
+
+    # -- lazy dense view ---------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            import jax.numpy as jnp
+
+            idx, vals = self._aux
+            self._dense_cache = jnp.zeros(
+                self._rs_shape, dtype=vals.dtype).at[idx].set(vals)
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):  # _assign() writes through here
+        # keep the sparse view consistent: re-derive (indices, values)
+        # from the new dense content (device-side nonzero-row scan, the
+        # cast_storage kernel); the caller already holds the dense array
+        import jax.numpy as jnp
+
+        self._dense_cache = value
+        if value.ndim > 1:
+            mask = jnp.any(value != 0, axis=tuple(range(1, value.ndim)))
+        else:
+            mask = value != 0
+        idx = jnp.nonzero(mask)[0]
+        self._aux = (idx, value[idx])
+
+    @property
+    def densified(self):
+        """Whether the dense view has been materialized (diagnostic)."""
+        return self._dense_cache is not None
+
+    # shape/dtype must not force materialization
+    @property
+    def shape(self):
+        return self._rs_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._aux[1].dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self._rs_shape:
+            n *= d
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._rs_shape)
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        from .ndarray import NDArray as _ND
+
+        return _ND(self._aux[1], self._ctx).context
+
+    ctx = context
+
+    def wait_to_read(self):
+        self._aux[1].block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def astype(self, dtype, copy=True):
+        d = np_dtype(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return RowSparseNDArray(self._aux[1].astype(d), self._aux[0],
+                                self._rs_shape, self._ctx)
 
     @property
     def indices(self):
@@ -51,7 +132,6 @@ class RowSparseNDArray(BaseSparseNDArray):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError("cast row_sparse→%s unsupported" % stype)
-
 
     def retain(self, indices):
         return retain(self, indices)
@@ -70,10 +150,8 @@ class RowSparseNDArray(BaseSparseNDArray):
     def _from_dense(cls, dense_jax, idx_jax, ctx):
         """Wrap an existing dense device array + row indices without any
         host round-trip (device-side cast_storage fast path)."""
-        rsp = cls.__new__(cls)
-        NDArray.__init__(rsp, dense_jax, ctx)
-        rsp._stype = "row_sparse"
-        rsp._aux = (idx_jax, dense_jax[idx_jax])
+        rsp = cls(dense_jax[idx_jax], idx_jax, dense_jax.shape, ctx)
+        rsp._dense_cache = dense_jax  # already materialized by caller
         return rsp
 
 
@@ -186,6 +264,14 @@ def cast_storage(arr, stype):
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "default":
         return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse":
+        # all-zero rsp = empty (indices, values): allocates nothing
+        import jax.numpy as jnp
+
+        dt = np_dtype(dtype)
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), dtype=dt),
+            jnp.zeros((0,), dtype=jnp.int32), shape, ctx)
     z = _np.zeros(shape, dtype=np_dtype(dtype))
     return cast_storage(array(z, ctx=ctx), stype)
 
@@ -200,17 +286,18 @@ def zeros(stype, shape, ctx=None, dtype=None):
 
 def retain(rsp, indices):
     """Keep only `indices` rows of a row_sparse array (reference:
-    _retain sparse_retain-inl.h)."""
+    _retain sparse_retain-inl.h).  Touches only the (indices, values)
+    pair — never the dense view."""
     if getattr(rsp, "stype", None) != "row_sparse":
         raise MXNetError("retain expects a row_sparse array")
     idx = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
         else _np.asarray(indices, dtype=_np.int64)
     old_idx = _np.asarray(rsp._aux[0])
-    old_val = _np.asarray(rsp._aux[1])
-    keep = _np.isin(old_idx, idx)
+    old_val = rsp._aux[1]
+    keep = _np.where(_np.isin(old_idx, idx))[0]
     import jax.numpy as jnp
 
-    return RowSparseNDArray(jnp.asarray(old_val[keep]),
+    return RowSparseNDArray(old_val[jnp.asarray(keep)],
                             jnp.asarray(old_idx[keep]), rsp.shape, rsp._ctx)
 
 
