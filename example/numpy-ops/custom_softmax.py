@@ -1,0 +1,88 @@
+"""Train an MNIST MLP whose softmax loss is a user-defined CustomOp
+(reference: example/numpy-ops/custom_softmax.py — the canonical
+custom-op-bridge example).
+
+The op runs numpy on the host inside the training graph: forward is a
+stable softmax, backward implements d(CE)/dx = p - onehot(label)
+directly (need_top_grad=False, loss-style op).
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(e / e.sum(axis=1, keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().ravel().astype(np.int64)
+        p = out_data[0].asnumpy().copy()
+        p[np.arange(label.shape[0]), label] -= 1.0
+        # no batch division here: the optimizer's rescale_grad handles
+        # it (reference custom_softmax.py does the same)
+        self.assign(in_grad[0], req[0], mx.nd.array(p))
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        return [data_shape, (data_shape[0],)], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def build_mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    return mx.sym.Custom(data=h, name="softmax", op_type="numpy_softmax")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    logging.basicConfig(level=logging.INFO)
+    train = MNISTIter(image="train", batch_size=args.batch_size)
+    val = MNISTIter(image="val", batch_size=args.batch_size, shuffle=False)
+
+    mod = mx.mod.Module(build_mlp(), context=mx.context.current_context())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+
+    metric = mx.metric.Accuracy()
+    mod.score(val, metric)
+    acc = metric.get()[1]
+    print("custom-softmax val accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

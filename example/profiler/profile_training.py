@@ -1,0 +1,75 @@
+"""Profile NDArray ops and a small training loop into a chrome trace
+(reference: example/profiler/profiler_ndarray.py + profiler_matmul.py).
+
+Demonstrates the profiler client API end-to-end: set_config →
+set_state('run') → scoped domains/tasks around user code → dump, then
+sanity-checks the emitted chrome://tracing JSON.
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    out = args.out or os.path.join(tempfile.gettempdir(),
+                                   "profile_training.json")
+    profiler.set_config(profile_all=True, aggregate_stats=True,
+                        filename=out)
+    profiler.set_state("run")
+
+    # -- phase 1: raw NDArray ops (reference: profiler_ndarray.py)
+    a = mx.nd.array(np.random.rand(256, 256).astype(np.float32))
+    b = mx.nd.array(np.random.rand(256, 256).astype(np.float32))
+    with profiler.scope("matmul_loop", "ndarray"):
+        for _ in range(args.iters):
+            c = mx.nd.dot(a, b)
+        c.wait_to_read()
+
+    # -- phase 2: a tiny training loop under its own domain
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.array(np.random.rand(16, 4).astype(np.float32))
+    y = mx.nd.array(np.random.rand(16, 8).astype(np.float32))
+    with profiler.scope("train_loop", "training"):
+        for _ in range(5):
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(16)
+        loss.wait_to_read()
+
+    profiler.set_state("stop")
+    stats = profiler.dumps()  # aggregate table (aggregate_stats=True)
+    if stats:
+        print(stats[:400])
+    trace_path = profiler.dump()  # write the chrome trace file
+    assert trace_path is None or str(trace_path)
+
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert "matmul_loop" in names and "train_loop" in names, sorted(names)[:20]
+    print("chrome trace written to %s (%d events)" % (out, len(events)))
+    return out
+
+
+if __name__ == "__main__":
+    main()

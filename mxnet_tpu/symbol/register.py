@@ -12,6 +12,16 @@ def _make_sym_func(op_name):
         name = kwargs.pop("name", None)
         kwargs.pop("out", None)
         names = _reg.OP_INPUT_NAMES.get(op_name)
+        if op_name == "Custom" and "op_type" in kwargs:
+            # a custom op's tensor slots come from its prop, so Symbol
+            # kwargs bind BY NAME in the prop's declared order (else
+            # dict insertion order would silently miswire inputs)
+            from ..ops.custom import _prop_for
+
+            extra = {k: v for k, v in kwargs.items()
+                     if k != "op_type" and not isinstance(v, Symbol)}
+            names = tuple(_prop_for(kwargs["op_type"],
+                                    extra).list_arguments())
         inputs = []
         nones = []  # positions passed as None — resolved by slot name below
         for a in args:

@@ -527,6 +527,19 @@ def _create(op_name, input_syms, attrs, name=None):
             raise TypeError("symbol inputs must be Symbols")
 
     # auto-create missing parameter variables (reference autogen behaviour)
+    if op.name == "Custom" and attrs.get("op_type"):
+        # a custom op declares its own argument list; unprovided tails
+        # become "<name>_<arg>" variables (reference: custom.cc wiring
+        # label-style args, e.g. mx.sym.Custom(data=..., name='softmax')
+        # growing 'softmax_label').  Errors (unknown op_type, prop
+        # __init__ rejecting kwargs) surface HERE, at creation time.
+        from ..ops.custom import _prop_for
+
+        extra = {k: v for k, v in attrs.items() if k != "op_type"}
+        prop = _prop_for(attrs["op_type"], extra)
+        for iname in tuple(prop.list_arguments())[len(inputs):]:
+            v = Variable("%s_%s" % (name, iname))
+            inputs.append(v._outputs[0])
     needed = OP_INPUT_NAMES.get(op.name, ())
     if needed and len(inputs) < len(needed):
         no_bias = attrs.get("no_bias", False)
@@ -664,6 +677,31 @@ _RANDOMISH = {"Dropout"}
 
 def _solve_params(node, in_shapes, shapes):
     """Derive parameter shapes for common layers (FC/conv/BN/embedding)."""
+    if node.op == "Custom" and in_shapes and in_shapes[0] is not None:
+        # the prop's infer_shape derives the remaining argument shapes
+        # from the known ones (reference: CustomOpProp.infer_shape).
+        # User infer_shape code may assume fully-known inputs, so only
+        # partially-known calls guard; errors on fully-known shapes are
+        # the user's bug and propagate.
+        from ..ops.custom import _prop_for
+
+        a = node.attrs
+        prop = _prop_for(a["op_type"],
+                         {k: v for k, v in a.items() if k != "op_type"})
+        arg_list = [list(s) if s is not None else None for s in in_shapes]
+        if any(s is None for s in arg_list):
+            try:
+                solved, _, _ = prop.infer_shape(arg_list)
+            except Exception:
+                return
+        else:
+            solved, _, _ = prop.infer_shape(arg_list)
+        for i, s2 in enumerate(solved[:len(node.inputs)]):
+            if s2 is not None:
+                inp, _ = node.inputs[i]
+                if inp.is_variable and inp.name not in shapes:
+                    shapes[inp.name] = tuple(int(x) for x in s2)
+        return
     names = OP_INPUT_NAMES.get(node.op, ())
     if not names or in_shapes[0] is None:
         return

@@ -215,3 +215,31 @@ def test_bucketed_lstm_lm_converges():
     # synthetic ring corpus: uniform ppl is 16; the LSTM must learn the
     # transition structure
     assert ppl < 5.0, "val perplexity %.3f did not converge" % ppl
+
+
+def test_custom_numpy_softmax_converges():
+    """Custom-op bridge in anger (reference: example/numpy-ops/
+    custom_softmax.py): a host-numpy softmax loss op trains an MNIST
+    MLP through Module.fit."""
+    acc = _run_example("numpy-ops/custom_softmax.py", ["--num-epochs", "2"])
+    assert acc > 0.9, acc
+
+
+def test_profiler_example_writes_trace():
+    """Profiler client end-to-end (reference: example/profiler/):
+    chrome trace with the user scopes present."""
+    import json
+
+    path = _run_example("profiler/profile_training.py", [])
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    assert len(events) >= 2
+
+
+def test_reinforce_gridworld_learns():
+    """RL training loop (reference: example/reinforcement-learning/):
+    REINFORCE reaches the optimal return on the toy gridworld."""
+    ret = _run_example("reinforcement-learning/reinforce_gridworld.py",
+                      ["--episodes", "250"])
+    assert ret > 1.0, ret  # optimal 3.0; random policy is deeply negative
