@@ -1,0 +1,82 @@
+"""Fast Gradient Sign Method adversarial examples on MNIST
+(reference: example/adversary/adversary_generation.ipynb).
+
+The API this family exercises: gradients **with respect to the input
+data**, not the parameters — `x.attach_grad()` + `autograd.record` +
+`x.grad` — then perturbing along sign(grad) and measuring the accuracy
+drop.
+"""
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def train_classifier(train_iter, epochs=2, lr=0.1):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(128, activation="relu"))
+        net.add(gluon.nn.Dense(64, activation="relu"))
+        net.add(gluon.nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(epochs):
+        train_iter.reset()
+        for batch in train_iter:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+    return net, loss_fn
+
+
+def accuracy(net, x, y):
+    pred = net(x).asnumpy().argmax(1)
+    return float(np.mean(pred == y.asnumpy().ravel()))
+
+
+def fgsm_attack(net, loss_fn, x, y, epsilon):
+    """Perturb x by epsilon * sign(dL/dx)."""
+    x = x.copy() if hasattr(x, "copy") else x
+    x.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    return mx.nd.clip(x + epsilon * mx.nd.sign(x.grad), 0.0, 1.0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--epsilon", type=float, default=0.15)
+    p.add_argument("--batch-size", type=int, default=128)
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    train = MNISTIter(image="train", batch_size=args.batch_size, flat=True)
+    net, loss_fn = train_classifier(train, epochs=args.epochs)
+
+    val = MNISTIter(image="val", batch_size=256, shuffle=False, flat=True)
+    batch = next(iter(val))
+    x, y = batch.data[0], batch.label[0]
+
+    clean_acc = accuracy(net, x, y)
+    x_adv = fgsm_attack(net, loss_fn, x, y, args.epsilon)
+    adv_acc = accuracy(net, x_adv, y)
+    # perturbation is bounded by epsilon in L-inf
+    linf = float(np.abs((x_adv - x).asnumpy()).max())
+    print("clean acc %.3f -> adversarial acc %.3f (eps=%.2f, Linf=%.3f)"
+          % (clean_acc, adv_acc, args.epsilon, linf))
+    assert linf <= args.epsilon + 1e-5
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    main()

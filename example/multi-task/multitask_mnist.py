@@ -1,0 +1,101 @@
+"""Multi-task training: one trunk, two heads, joint loss
+(reference: example/multi-task/example_multi_task.py).
+
+The API this family exercises: a Group symbol with TWO outputs bound
+through one Module, per-head labels via label_names, and a composite
+metric evaluating both tasks (digit class + even/odd parity).
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    digit = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=10, name="fc_digit"),
+        mx.sym.Variable("digit_label"), name="digit")
+    parity = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name="fc_parity"),
+        mx.sym.Variable("parity_label"), name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wrap MNIST with a second (parity) label stream."""
+
+    def __init__(self, inner):
+        super().__init__(inner.batch_size)
+        self._inner = inner
+        self.provide_data = inner.provide_data
+        lab = inner.provide_label[0]
+        self.provide_label = [
+            mx.io.DataDesc("digit_label", lab.shape, lab.dtype),
+            mx.io.DataDesc("parity_label", lab.shape, lab.dtype)]
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        batch = self._inner.next()
+        digit = batch.label[0]
+        parity = mx.nd.array(digit.asnumpy() % 2)
+        return mx.io.DataBatch(batch.data, [digit, parity], pad=batch.pad,
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+
+class MultiTaskAccuracy(mx.metric.EvalMetric):
+    """Mean of per-task accuracies (reference example's MultiAccuracy)."""
+
+    def __init__(self):
+        super().__init__("multi_accuracy")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            hit = (pred.asnumpy().argmax(1) ==
+                   label.asnumpy().ravel()).sum()
+            self.sum_metric += hit / label.shape[0]
+            self.num_inst += 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    logging.basicConfig(level=logging.INFO)
+    train = MultiTaskIter(MNISTIter(image="train",
+                                    batch_size=args.batch_size, flat=True))
+    val = MultiTaskIter(MNISTIter(image="val", batch_size=args.batch_size,
+                                  shuffle=False, flat=True))
+
+    mod = mx.mod.Module(build_net(), context=mx.context.current_context(),
+                        label_names=("digit_label", "parity_label"))
+    metric = MultiTaskAccuracy()
+    mod.fit(train, eval_data=val, eval_metric=metric,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.epochs)
+
+    metric.reset()
+    mod.score(val, metric)
+    acc = metric.get()[1]
+    print("multi-task mean accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -36,3 +36,177 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy estimator-style trainer (reference: model.py FeedForward,
+    deprecated upstream in favor of Module).
+
+    Implemented as a thin adapter over :class:`mxnet_tpu.module.Module`
+    — the reference's own migration advice — so era scripts written
+    against ``mx.model.FeedForward(...)`` keep running.  Accepts numpy
+    arrays, NDArrays, or DataIters for X/y.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        import warnings
+
+        warnings.warn("mxnet.model.FeedForward is deprecated; use "
+                      "mxnet.mod.Module instead", DeprecationWarning,
+                      stacklevel=2)
+        from .context import cpu
+        from .initializer import Uniform
+
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else \
+            [ctx] if ctx is not None else [cpu()]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = dict(arg_params) if arg_params else None
+        self.aux_params = dict(aux_params) if aux_params else None
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # ------------------------------------------------------------ plumbing
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io.io import DataIter, NDArrayIter
+        from .ndarray import NDArray
+
+        if isinstance(X, DataIter):
+            return X
+        data = X.asnumpy() if isinstance(X, NDArray) else X
+        label = y.asnumpy() if isinstance(y, NDArray) else y
+        batch = min(self.numpy_batch_size, len(data))
+        return NDArrayIter(data=data, label=label, batch_size=batch,
+                           shuffle=shuffle)
+
+    def _bind(self, it, for_training):
+        from .module.module import Module
+
+        if self._module is None:
+            self._module = Module(self.symbol, context=self.ctx)
+        mod = self._module
+        shapes = [tuple(d.shape) for d in it.provide_data]
+        signature = (for_training, shapes)
+        if getattr(self, "_bind_signature", None) != signature:
+            # keep learned params across rebinds (predict after fit,
+            # new batch size, train after predict)
+            if mod.binded and mod.params_initialized:
+                self.arg_params, self.aux_params = mod.get_params()
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label if for_training else None,
+                     for_training=for_training, force_rebind=True)
+            self._bind_signature = signature
+            if self.allow_extra_params and self.arg_params:
+                names = set(self.symbol.list_arguments())
+                self.arg_params = {k: v for k, v in self.arg_params.items()
+                                   if k in names}
+            mod.init_params(initializer=self.initializer,
+                            arg_params=self.arg_params,
+                            aux_params=self.aux_params,
+                            allow_missing=self.arg_params is not None)
+        return mod
+
+    # ------------------------------------------------------------- training
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        if self.num_epoch is None:
+            raise ValueError("FeedForward.fit: num_epoch was not set "
+                             "(reference requires it)")
+        if logger is not None:
+            import logging as _logging
+
+            logger.setLevel(getattr(logger, "level", _logging.INFO))
+        train = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod = self._bind(train, for_training=True)
+        opt_kwargs = dict(self.kwargs)
+        mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=opt_kwargs,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch, monitor=monitor,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+
+        it = self._as_iter(X)
+        if reset:
+            it.reset()
+        mod = self._bind(it, for_training=False)
+        outs, datas, labels = [], [], []
+        for i, batch in enumerate(it):
+            if num_batch is not None and i >= num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            n = batch.data[0].shape[0] - batch.pad
+            outs.append(mod.get_outputs()[0].asnumpy()[:n])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:n])
+                if batch.label:
+                    labels.append(batch.label[0].asnumpy()[:n])
+        out = _np.concatenate(outs) if outs else _np.empty((0,))
+        if return_data:
+            return (out, _np.concatenate(datas),
+                    _np.concatenate(labels) if labels else None)
+        return out
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        from . import metric as metric_mod
+
+        it = self._as_iter(X)
+        if reset:
+            it.reset()
+        mod = self._bind(it, for_training=False)
+        metric = metric_mod.create(eval_metric)
+        mod.score(it, metric, num_batch=num_batch)
+        return metric.get()[1]
+
+    # ----------------------------------------------------------- checkpoint
+    def save(self, prefix, epoch=None):
+        epoch = self.num_epoch if epoch is None else epoch
+        save_checkpoint(prefix, epoch or 0, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train a new model from scratch (reference: FeedForward.create)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

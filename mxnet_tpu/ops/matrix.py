@@ -289,17 +289,51 @@ def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", *
 # ---------------------------------------------------------------- indexing
 
 
+# int32 offsets overflow inside XLA gather/scatter once an operand
+# crosses 2^31 elements (the large-tensor regime, reference:
+# tests/nightly/test_large_array.py); int64 indices force 64-bit offset
+# arithmetic on device (emulated on TPU, correct if slower).
+_INT32_SAFE_ELEMS = 2 ** 31 - 1
+
+
+def _gather_index_dtype(a):
+    """Index dtype for gathers into `a`: int64 past the int32 offset
+    range (requires x64 tracing so the dtype is not truncated)."""
+    if a.size > _INT32_SAFE_ELEMS:
+        return jnp.int64
+    return jnp.int32
+
+
+def _index_ctx(*operands):
+    """Context for tracing an indexing op on `operands`: x64 when any
+    operand is past the int32 offset range, so the WHOLE gather/scatter
+    (including jnp-internal clipping and the autodiff transpose) keeps
+    64-bit index arithmetic; a no-op otherwise."""
+    import contextlib
+
+    if any(op.size > _INT32_SAFE_ELEMS for op in operands):
+        return jax.enable_x64()
+    return contextlib.nullcontext()
+
+
+def _as_gather_indices(a, indices):
+    return indices.astype(_gather_index_dtype(a))
+
+
 @register("take")
 def take(a, indices, axis=0, mode="clip", **_):
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
-    return jnp.take(a, indices.astype(jnp.int32), axis=int(axis), mode=jmode)
+    with _index_ctx(a):
+        return jnp.take(a, _as_gather_indices(a, indices), axis=int(axis),
+                        mode=jmode)
 
 
 @register("batch_take")
 def batch_take(x, index, axis=-1, keepdims=False, mode="clip", **_):
     ax = int(axis) % x.ndim
-    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[ax] - 1)
-    out = jnp.take_along_axis(x, jnp.expand_dims(idx, ax), axis=ax)
+    with _index_ctx(x):
+        idx = jnp.clip(_as_gather_indices(x, index), 0, x.shape[ax] - 1)
+        out = jnp.take_along_axis(x, jnp.expand_dims(idx, ax), axis=ax)
     if not keepdims:
         out = jnp.squeeze(out, axis=ax)
     return out
@@ -320,27 +354,28 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
     """reference: src/operator/tensor/indexing_op.cc Embedding — a gather
     feeding the MXU-friendly dense path; sparse_grad maps to the same dense
     gather on TPU (XLA scatter handles the grad)."""
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    with _index_ctx(weight):
+        return jnp.take(weight, _as_gather_indices(weight, data), axis=0)
 
 
 @register("gather_nd")
 def gather_nd(data, indices, **_):
-    idx = tuple(indices.astype(jnp.int32))
-    return data[idx]
+    with _index_ctx(data):
+        return data[tuple(_as_gather_indices(data, indices))]
 
 
 @register("scatter_nd")
 def scatter_nd(data, indices, shape=(), **_):
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
-    return out.at[idx].set(data)
+    with _index_ctx(out):
+        return out.at[tuple(_as_gather_indices(out, indices))].set(data)
 
 
 @register("_backward_gather_nd", aliases=("gather_nd_accumulate",))
 def gather_nd_accumulate(data, indices, shape=(), **_):
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
-    idx = tuple(indices.astype(jnp.int32))
-    return out.at[idx].add(data)
+    with _index_ctx(out):
+        return out.at[tuple(_as_gather_indices(out, indices))].add(data)
 
 
 @register("where_nd", aliases=("boolean_mask_unsupported",))
@@ -353,12 +388,14 @@ def where_nd(cond, **_):
 
 @register("index_copy")
 def index_copy(old, index, new_tensor, **_):
-    return old.at[index.astype(jnp.int32)].set(new_tensor)
+    with _index_ctx(old):
+        return old.at[_as_gather_indices(old, index)].set(new_tensor)
 
 
 @register("index_add")
 def index_add(old, index, new_tensor, **_):
-    return old.at[index.astype(jnp.int32)].add(new_tensor)
+    with _index_ctx(old):
+        return old.at[_as_gather_indices(old, index)].add(new_tensor)
 
 
 # ---------------------------------------------------------------- linalg

@@ -243,3 +243,19 @@ def test_reinforce_gridworld_learns():
     ret = _run_example("reinforcement-learning/reinforce_gridworld.py",
                       ["--episodes", "250"])
     assert ret > 1.0, ret  # optimal 3.0; random policy is deeply negative
+
+
+def test_fgsm_adversary_example():
+    """Gradient-w.r.t.-input API family (reference: example/adversary):
+    the FGSM attack must dent a trained classifier's accuracy while
+    staying inside the L-inf ball."""
+    clean, adv = _run_example("adversary/fgsm_mnist.py", ["--epochs", "2"])
+    assert clean > 0.9, clean
+    assert adv < clean - 0.2, (clean, adv)
+
+
+def test_multitask_example_converges():
+    """Group-symbol multi-head training (reference: example/multi-task):
+    joint digit+parity heads both learn through one Module."""
+    acc = _run_example("multi-task/multitask_mnist.py", ["--epochs", "2"])
+    assert acc > 0.9, acc

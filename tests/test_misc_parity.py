@@ -170,3 +170,41 @@ def test_gluon_dataloader_workers():
                                    num_workers=nw)
         got = np.concatenate([b[0].asnumpy() for b in dl])
         assert np.array_equal(got, x), nw
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """Legacy mx.model.FeedForward trains, predicts, scores, and
+    round-trips through save/load (reference: model.py FeedForward)."""
+    import warnings
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(200, 10).astype(np.float32)
+    w = rs.randn(10).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        model = mx.model.FeedForward(net, num_epoch=12, learning_rate=0.3,
+                                     numpy_batch_size=50)
+        model.fit(x, y)
+        acc = model.score(mx.io.NDArrayIter(x, y, batch_size=50))
+        assert acc > 0.9, acc
+        pred = model.predict(x)
+        assert pred.shape == (200, 2)
+        assert np.mean(pred.argmax(1) == y) > 0.9
+
+        prefix = str(tmp_path / "ff")
+        model.save(prefix, 12)
+        loaded = mx.model.FeedForward.load(prefix, 12)
+        pred2 = loaded.predict(x)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-5, atol=1e-6)
