@@ -100,3 +100,44 @@ def custom(*arrays, op_type=None, **kwargs):
     f.defvjp(f_fwd, f_bwd)
     res = f(*arrays)
     return res if n_out > 1 else res[0]
+
+
+_SUBGRAPH_CACHE = {}
+
+
+def _subgraph_nout(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+@register("_subgraph_exec", num_outputs=_subgraph_nout)
+def subgraph_exec(*arrays, subgraph_json=None, num_outputs=1, **_):
+    """Execute a captured region as one staged callee (reference:
+    subgraph ops created by CreateSubgraphNode; here the region stages
+    through the jit cache and XLA fuses it).  Positional inputs bind to
+    the serialized sub-symbol's arguments in declaration order."""
+    from ..base import MXNetError
+
+    if subgraph_json is None:
+        raise MXNetError("_subgraph_exec requires subgraph_json=")
+    entry = _SUBGRAPH_CACHE.get(subgraph_json)
+    if entry is None:
+        from ..executor import make_eval_fn
+        from ..symbol import load_json
+
+        sub = load_json(subgraph_json)
+        fn = make_eval_fn(sub, is_train=False)
+        fn = fn[0] if isinstance(fn, tuple) else fn
+        # positional inputs arrive in list_inputs() order (the wrapper's
+        # contract); the callee wants (args, aux) split by name.  The
+        # default partitioner only captures pure ops, so aux lists are
+        # normally empty — the split handles custom wrappers that carry
+        # aux-feeding placeholders anyway.
+        entry = (fn, sub.list_inputs(), sub.list_arguments(),
+                 sub.list_auxiliary_states())
+        _SUBGRAPH_CACHE[subgraph_json] = entry
+    fn, in_names, arg_names, aux_names = entry
+    by_name = dict(zip(in_names, arrays))
+    outs, _aux = fn([by_name[n] for n in arg_names],
+                    [by_name[n] for n in aux_names], 0)
+    outs = tuple(outs)
+    return outs if len(outs) > 1 else outs[0]

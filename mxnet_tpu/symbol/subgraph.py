@@ -1,0 +1,330 @@
+"""Pluggable graph partitioning (reference: src/operator/subgraph/
+subgraph_property.h:93 SubgraphProperty + partition_graph.cc:735).
+
+The reference grows subgraphs from seed nodes with a SubgraphSelector,
+replaces each region with a subgraph op, and activates backends via
+``MXNET_SUBGRAPH_BACKEND``.  This is the same framework over this
+repo's Symbol DAG, TPU-first in one way: the default replacement op
+(``_subgraph_exec``) stages its region through the jit cache as ONE
+compiled callee — the CachedOp-style encapsulation the reference uses
+subgraphs for, with XLA doing the actual fusion inside.
+
+API:
+  class MySelector(SubgraphSelector): select / select_input / ...
+  class MyProperty(SubgraphProperty): create_selector /
+      create_subgraph_node
+  register_subgraph_property("MY_BACKEND", MyProperty)
+  new_sym = partition_graph(sym, "MY_BACKEND")
+``Symbol.simple_bind`` honors MXNET_SUBGRAPH_BACKEND.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+from .symbol import Symbol, Variable, _Node
+
+__all__ = ["SubgraphSelector", "SubgraphProperty",
+           "register_subgraph_property", "list_subgraph_properties",
+           "partition_graph"]
+
+_PROPERTIES: dict[str, type] = {}
+
+
+class SubgraphSelector:
+    """Decides which nodes join a region (reference:
+    subgraph_property.h SubgraphSelector).  Traversal starts at a seed
+    where ``select`` is true and grows along inputs/outputs gated by
+    ``select_input`` / ``select_output``."""
+
+    def select(self, node):
+        raise NotImplementedError
+
+    def select_input(self, cur_node, input_node):
+        return False
+
+    def select_output(self, cur_node, output_node):
+        return False
+
+    def filter(self, candidates):
+        """Post-process a grown region; return the nodes to keep."""
+        return candidates
+
+
+class SubgraphProperty:
+    """Partitioning policy: selection + replacement
+    (reference: subgraph_property.h SubgraphProperty)."""
+
+    def create_selector(self):
+        raise NotImplementedError
+
+    def create_subgraph_node(self, subgraph_sym, subgraph_id=0):
+        """Return the Symbol replacing a matched region.  Its outputs
+        must line up 1:1 with ``subgraph_sym``'s outputs, and its
+        arguments must keep the sub-symbol's argument names (they are
+        re-wired to the original producers by name).
+
+        Default: wrap the region in one ``_subgraph_exec`` node."""
+        return _wrap_subgraph(subgraph_sym, subgraph_id)
+
+
+def register_subgraph_property(name, prop_cls):
+    """Register under the MXNET_SUBGRAPH_BACKEND name
+    (reference: MXNET_REGISTER_SUBGRAPH_PROPERTY)."""
+    if not isinstance(name, str) or not name:
+        raise MXNetError("subgraph property name must be a non-empty str")
+    _PROPERTIES[name] = prop_cls
+    return prop_cls
+
+
+def list_subgraph_properties():
+    return sorted(_PROPERTIES)
+
+
+def _get_property(prop):
+    if isinstance(prop, SubgraphProperty):
+        return prop
+    if isinstance(prop, str):
+        try:
+            return _PROPERTIES[prop]()
+        except KeyError:
+            raise MXNetError(
+                "unknown subgraph backend %r (registered: %s)"
+                % (prop, ", ".join(list_subgraph_properties()) or "none"))
+    if isinstance(prop, type):
+        return prop()
+    raise MXNetError("expected property name/class/instance, got %r"
+                     % (prop,))
+
+
+# ---------------------------------------------------------------- wrapping --
+def _wrap_subgraph(sub_sym, subgraph_id):
+    """Default replacement: one ``_subgraph_exec`` node carrying the
+    region as JSON; evaluation stages the region through the jit cache
+    as a single compiled callee.  Node inputs are ALL leaf variables in
+    ``list_inputs()`` order — the order _subgraph_exec rebinds by."""
+    from . import symbol as sym_api
+
+    variables = [Variable(n) for n in sub_sym.list_inputs()]
+    json_str = sub_sym.tojson()
+    return sym_api._create(
+        "_subgraph_exec", variables,
+        {"subgraph_json": json_str, "num_outputs": len(sub_sym._outputs)},
+        name="subgraph%d" % subgraph_id)
+
+
+# ------------------------------------------------------------- partitioning --
+def _capturable(node):
+    """The default machinery captures PURE ops only: no PRNG consumers,
+    no auxiliary state (BatchNorm moving stats and friends) — a
+    captured region must be correct regardless of train/eval mode and
+    must not need aux plumbing.  Properties wanting stateful capture
+    own that complexity in a custom create_subgraph_node."""
+    from ..ndarray.ndarray import RANDOM_OPS
+    from ..ops.registry import OP_AUX_INPUTS
+
+    return (not node.is_variable and node.op not in RANDOM_OPS
+            and node.op not in OP_AUX_INPUTS and node.op != "Dropout")
+
+
+def _grow_region(seed, selector, consumers, claimed):
+    """Grow one candidate region from `seed` by the selector's rules."""
+    region = {id(seed): seed}
+    frontier = [seed]
+    while frontier:
+        cur = frontier.pop()
+        for inp, _ in cur.inputs:
+            if inp.is_variable or id(inp) in region or id(inp) in claimed \
+                    or not _capturable(inp):
+                continue
+            if selector.select_input(cur, inp):
+                region[id(inp)] = inp
+                frontier.append(inp)
+        for out in consumers.get(id(cur), ()):
+            if id(out) in region or id(out) in claimed \
+                    or not _capturable(out):
+                continue
+            if selector.select_output(cur, out):
+                region[id(out)] = out
+                frontier.append(out)
+    kept = selector.filter(list(region.values()))
+    return {id(n): n for n in kept}
+
+
+def _region_is_convex(region):
+    """A region is splice-able iff no path leaves it and re-enters: with
+    nodes in topo order, every external input of a region node must come
+    before every region node that feeds an external consumer... the
+    cheap sufficient check: for each region node, every non-region
+    ancestor on a path from another region node would violate order.
+    We check directly: no region node has a non-region ancestor that
+    itself has a region ancestor."""
+    region_ids = set(region)
+    # compute, for each node in the induced ancestor cone, whether it
+    # has a region ancestor
+    memo = {}
+
+    def has_region_anc(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        memo[id(node)] = False  # cycle-safe default (DAG anyway)
+        res = False
+        for inp, _ in node.inputs:
+            if id(inp) in region_ids or has_region_anc(inp):
+                res = True
+                break
+        memo[id(node)] = res
+        return res
+
+    for n in region.values():
+        for inp, _ in n.inputs:
+            if id(inp) in region_ids:
+                continue
+            if has_region_anc(inp):
+                return False
+    return True
+
+
+def _extract_subgraph(region, topo):
+    """Clone a region into a standalone DAG with named placeholders.
+
+    Returns ``(ordered, clones, ext_inputs, placeholder_names)`` where
+    ``ext_inputs`` are the original external (node, idx) entries and
+    ``placeholder_names[i]`` is the Variable name standing in for
+    ``ext_inputs[i]`` — rewiring binds BY NAME, never by position."""
+    region_ids = set(region)
+    ext_inputs = []  # original entries, deduped in first-seen order
+    ext_names = []
+    ext_index = {}
+    clones = {}
+    placeholder = {}
+
+    def entry_to_clone(inp, idx):
+        if id(inp) in region_ids:
+            return (clones[id(inp)], idx)
+        key = (id(inp), idx)
+        if key not in ext_index:
+            ext_index[key] = len(ext_inputs)
+            name = inp.name if inp.is_variable and idx == 0 else \
+                "%s_out%d" % (inp.name, idx)
+            pname = "_sg_in%d_%s" % (len(ext_inputs), name)
+            placeholder[key] = Variable(pname)._outputs[0][0]
+            ext_inputs.append((inp, idx))
+            ext_names.append(pname)
+        return (placeholder[key], 0)
+
+    ordered = [n for n in topo if id(n) in region_ids]
+    for node in ordered:
+        new_inputs = [entry_to_clone(inp, idx) for inp, idx in node.inputs]
+        clones[id(node)] = _Node(node.op, node.name, node.attrs, new_inputs,
+                                 node.num_outputs, dict(node.attr_dict))
+    return ordered, clones, ext_inputs, ext_names
+
+
+def partition_graph(sym, prop):
+    """Replace every region the property selects (reference:
+    partition_graph.cc PartitionGraph).  Returns a new Symbol; the
+    input is untouched."""
+    prop = _get_property(prop)
+    topo = sym._topo_nodes()
+
+    consumers = {}
+    for n in topo:
+        for inp, _ in n.inputs:
+            consumers.setdefault(id(inp), []).append(n)
+
+    # ---- select regions
+    claimed = set()
+    regions = []
+    for node in topo:
+        if node.is_variable or id(node) in claimed or not _capturable(node):
+            continue
+        selector = prop.create_selector()
+        if not selector.select(node):
+            continue
+        region = _grow_region(node, selector, consumers, claimed)
+        if not region or not _region_is_convex(region):
+            continue
+        claimed.update(region)
+        regions.append(region)
+    if not regions:
+        return sym
+
+    node_region = {}
+    for rid, region in enumerate(regions):
+        for nid in region:
+            node_region[nid] = rid
+    # a region is spliced in when its LAST member is reached, so every
+    # external input (all of which precede that point in topo order)
+    # is already mapped
+    last_member = {}
+    for i, n in enumerate(topo):
+        rid = node_region.get(id(n))
+        if rid is not None:
+            last_member[rid] = id(n)
+
+    # ---- rebuild the graph, splicing replacements in
+    entry_map = {}  # (id(old node), idx) -> (new node, idx)
+
+    def mapped(inp, idx):
+        if inp.is_variable:
+            return entry_map.setdefault(
+                (id(inp), idx),
+                (_Node(None, inp.name, {}, [], 1, dict(inp.attr_dict)), 0))
+        return entry_map[(id(inp), idx)]
+
+    def emit_region(rid):
+        region = regions[rid]
+        ordered, clones, ext_inputs, ext_names = _extract_subgraph(
+            region, topo)
+        # region outputs: entries used outside the region or as heads
+        out_entries = []
+        for n in ordered:
+            external = any(id(c) not in region
+                           for c in consumers.get(id(n), ()))
+            for idx in range(n.num_outputs):
+                is_head = any(hn is n and hidx == idx
+                              for hn, hidx in sym._outputs)
+                if external or is_head:
+                    out_entries.append((n, idx))
+        sub_sym = Symbol([(clones[id(n)], idx) for n, idx in out_entries])
+        replacement = prop.create_subgraph_node(sub_sym, rid)
+        if len(replacement._outputs) != len(out_entries):
+            raise MXNetError(
+                "subgraph property returned %d outputs for a region "
+                "with %d" % (len(replacement._outputs), len(out_entries)))
+        # rewire the replacement's placeholder variables BY NAME
+        arg_map = {pname: mapped(inp, idx)
+                   for pname, (inp, idx) in zip(ext_names, ext_inputs)}
+        _rewire_arguments(replacement, arg_map)
+        for k, (n, idx) in enumerate(out_entries):
+            entry_map[(id(n), idx)] = replacement._outputs[k]
+
+    for node in topo:
+        if node.is_variable:
+            mapped(node, 0)
+            continue
+        rid = node_region.get(id(node))
+        if rid is not None:
+            if last_member[rid] == id(node):
+                emit_region(rid)
+            continue
+        new_inputs = [mapped(inp, idx) for inp, idx in node.inputs]
+        new_node = _Node(node.op, node.name, node.attrs, new_inputs,
+                         node.num_outputs, dict(node.attr_dict))
+        for idx in range(node.num_outputs):
+            entry_map[(id(node), idx)] = (new_node, idx)
+
+    return Symbol([entry_map[(id(n), idx)] for n, idx in sym._outputs])
+
+
+def _rewire_arguments(replacement, arg_map):
+    """Point the replacement symbol's named variable leaves at mapped
+    original entries."""
+    for node in replacement._topo_nodes():
+        new_inputs = []
+        for inp, idx in node.inputs:
+            if inp.is_variable and inp.name in arg_map:
+                new_inputs.append(arg_map[inp.name])
+            else:
+                new_inputs.append((inp, idx))
+        node.inputs = new_inputs

@@ -330,6 +330,21 @@ class Symbol:
         from ..executor import Executor
         from ..ndarray import zeros
 
+        import os
+
+        backend = os.environ.get("MXNET_SUBGRAPH_BACKEND")
+        if backend:
+            # reference: bind-time partitioning when
+            # MXNET_SUBGRAPH_BACKEND selects a registered property.
+            # Partition ONCE and fall through — recursing would re-run
+            # the pass per bind and could loop if a property's
+            # replacement matches its own selector.
+            from .subgraph import list_subgraph_properties, partition_graph
+
+            if backend in list_subgraph_properties():
+                part = partition_graph(self, backend)
+                if part is not self:
+                    self = part
         ctx = ctx or current_context()
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()

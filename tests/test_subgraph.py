@@ -1,0 +1,241 @@
+"""Pluggable subgraph partitioning framework
+(reference: tests/python/unittest/test_subgraph*.py over
+src/operator/subgraph/).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.symbol import subgraph as sg
+
+ELEMWISE = {"elemwise_add", "elemwise_mul", "Activation",
+            "_mul_scalar", "_plus_scalar"}
+
+
+class ChainSelector(sg.SubgraphSelector):
+    def select(self, node):
+        return node.op in ELEMWISE
+
+    def select_input(self, cur, inp):
+        return inp.op in ELEMWISE
+
+    def select_output(self, cur, out):
+        return out.op in ELEMWISE
+
+
+class ChainProperty(sg.SubgraphProperty):
+    def create_selector(self):
+        return ChainSelector()
+
+
+sg.register_subgraph_property("TEST_CHAIN", ChainProperty)
+
+
+def _mlp_with_chain():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=6, name="fc")
+    act = mx.sym.Activation(fc, act_type="tanh")
+    out = (act * 2.0 + 1.0) * act
+    return mx.sym.FullyConnected(out, num_hidden=3, name="fc2")
+
+
+def _rand_args(sym, batch=4, din=5, seed=0):
+    rs = np.random.RandomState(seed)
+    shapes = {"data": (batch, din), "fc_weight": (6, din), "fc_bias": (6,),
+              "fc2_weight": (3, 6), "fc2_bias": (3,)}
+    return {n: mx.nd.array(rs.randn(*shapes[n]).astype(np.float32))
+            for n in sym.list_arguments()}
+
+
+def test_partition_preserves_forward():
+    sym = _mlp_with_chain()
+    part = sg.partition_graph(sym, "TEST_CHAIN")
+    ops = [n.op for n in part._topo_nodes() if not n.is_variable]
+    assert "_subgraph_exec" in ops
+    assert not any(o in ELEMWISE for o in ops), ops  # chain fully captured
+    # argument surface unchanged
+    assert part.list_arguments() == sym.list_arguments()
+    args = _rand_args(sym)
+    a = sym.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    b = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_partition_preserves_gradients():
+    """The _subgraph_exec callee is jax-traceable, so autodiff flows
+    straight through the captured region."""
+    sym = _mlp_with_chain()
+    part = sg.partition_graph(sym, "TEST_CHAIN")
+    args = _rand_args(sym, seed=3)
+    grads = {}
+    for tag, s in (("orig", sym), ("part", part)):
+        ex = s.simple_bind(ctx=mx.cpu(), data=(4, 5), grad_req="write")
+        for k, v in args.items():
+            ex.arg_dict[k][:] = v
+        ex.forward(is_train=True)
+        ex.backward()
+        grads[tag] = {k: g.asnumpy().copy()
+                      for k, g in ex.grad_dict.items()}
+    for k in grads["orig"]:
+        np.testing.assert_allclose(grads["orig"][k], grads["part"][k],
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg="grad mismatch for %s" % k)
+
+
+def test_no_match_returns_same_symbol():
+    data = mx.sym.Variable("data")
+    only_fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    assert sg.partition_graph(only_fc, "TEST_CHAIN") is only_fc
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(MXNetError, match="unknown subgraph backend"):
+        sg.partition_graph(_mlp_with_chain(), "NOPE")
+
+
+def test_env_var_activation(monkeypatch):
+    """MXNET_SUBGRAPH_BACKEND partitions at simple_bind, like the
+    reference's bind-time activation."""
+    sym = _mlp_with_chain()
+    args = _rand_args(sym, seed=1)
+    want = sym.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "TEST_CHAIN")
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(4, 5))
+    for k, v in args.items():
+        ex.arg_dict[k][:] = v
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_replacement_node():
+    """A property may emit its own replacement instead of the default
+    wrapper (reference: CreateSubgraphNode customization)."""
+
+    class ScalarChainSelector(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op == "_mul_scalar"
+
+    class CollapseProperty(sg.SubgraphProperty):
+        def create_selector(self):
+            return ScalarChainSelector()
+
+        def create_subgraph_node(self, sub_sym, subgraph_id=0):
+            # replace x * s with x + s (observable rewrite)
+            (node, _), = sub_sym._outputs
+            arg = mx.sym.Variable(sub_sym.list_arguments()[0])
+            return mx.sym._plus_scalar(arg,
+                                       scalar=node.attrs.get("scalar"))
+
+    data = mx.sym.Variable("data")
+    sym = mx.sym._mul_scalar(data, scalar=3.0)
+    part = sg.partition_graph(sym, CollapseProperty())
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    got = part.bind(mx.cpu(), {"data": x}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, np.full((2, 2), 4.0))  # 1+3, not 1*3
+
+
+def test_non_convex_region_is_skipped():
+    """A region whose path exits and re-enters through a non-selected
+    node must not be captured (it cannot be spliced)."""
+    data = mx.sym.Variable("data")
+    a = mx.sym.Activation(data, act_type="tanh")     # selected
+    f = mx.sym.FullyConnected(a, num_hidden=5, name="mid")  # NOT selected
+    b = a + mx.sym.Activation(f, act_type="tanh")    # selected, uses both
+    part = sg.partition_graph(b, "TEST_CHAIN")
+    args = {n: mx.nd.array(np.random.RandomState(0)
+                           .randn(*s).astype(np.float32))
+            for n, s in {"data": (2, 5), "mid_weight": (5, 5),
+                         "mid_bias": (5,)}.items()}
+    want = b.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multiple_external_inputs_bind_by_name():
+    """Review repro: a region with several external producers must wire
+    each placeholder to ITS producer, not positionally."""
+
+    class AddSelector(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op in ("elemwise_add", "Activation")
+
+        def select_input(self, cur, inp):
+            return inp.op in ("elemwise_add", "Activation")
+
+    class AddProperty(sg.SubgraphProperty):
+        def create_selector(self):
+            return AddSelector()
+
+    data = mx.sym.Variable("data")
+    fca = mx.sym.FullyConnected(data, num_hidden=4, name="fca")
+    fcb = mx.sym.FullyConnected(data, num_hidden=4, name="fcb")
+    m = mx.sym.Activation(fca, act_type="tanh")
+    out = fcb + m  # region {m, out}: two external inputs fca, fcb
+    part = sg.partition_graph(out, AddProperty())
+    ops = [n.op for n in part._topo_nodes() if not n.is_variable]
+    assert "_subgraph_exec" in ops
+    rs = np.random.RandomState(0)
+    args = {"data": mx.nd.array(rs.randn(3, 5).astype(np.float32))}
+    for n in ("fca_weight", "fcb_weight"):
+        args[n] = mx.nd.array(rs.randn(4, 5).astype(np.float32))
+    for n in ("fca_bias", "fcb_bias"):
+        args[n] = mx.nd.array(rs.randn(4).astype(np.float32))
+    want = out.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_grown_region_emits_after_inputs():
+    """Review repro: a region grown FORWARD (select_output) whose later
+    member consumes a node that topologically follows the seed."""
+
+    class FwdSelector(sg.SubgraphSelector):
+        def select(self, node):
+            return node.op == "Activation"
+
+        def select_output(self, cur, out):
+            return out.op == "elemwise_add"
+
+    class FwdProperty(sg.SubgraphProperty):
+        def create_selector(self):
+            return FwdSelector()
+
+    data = mx.sym.Variable("data")
+    a = mx.sym.Activation(data, act_type="tanh")             # seed
+    b = mx.sym.FullyConnected(data, num_hidden=5, name="ind")  # independent
+    c = a + b                                                # joins via output
+    part = sg.partition_graph(c, FwdProperty())
+    rs = np.random.RandomState(1)
+    args = {"data": mx.nd.array(rs.randn(2, 5).astype(np.float32)),
+            "ind_weight": mx.nd.array(rs.randn(5, 5).astype(np.float32)),
+            "ind_bias": mx.nd.array(rs.randn(5).astype(np.float32))}
+    want = c.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_stateful_ops_never_captured_by_default():
+    """Dropout/BatchNorm (RNG/aux state) stay outside default regions so
+    train/eval semantics cannot silently change."""
+
+    class GreedySelector(sg.SubgraphSelector):
+        def select(self, node):
+            return True
+
+        def select_input(self, cur, inp):
+            return True
+
+    class GreedyProperty(sg.SubgraphProperty):
+        def create_selector(self):
+            return GreedySelector()
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(data, act_type="tanh")
+    h = mx.sym.Dropout(h, p=0.5)
+    h = mx.sym.BatchNorm(h, name="bn")
+    out = mx.sym.Activation(h, act_type="relu")
+    part = sg.partition_graph(out, GreedyProperty())
+    kept = [n.op for n in part._topo_nodes() if not n.is_variable]
+    assert "Dropout" in kept and "BatchNorm" in kept
