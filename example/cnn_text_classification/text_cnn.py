@@ -1,0 +1,83 @@
+"""Text CNN for sentence classification
+(reference: example/cnn_text_classification/text_cnn.py, Kim 2014).
+
+API family: Embedding → parallel Convolution branches with different
+kernel widths over the token axis → max-pool-over-time → Concat →
+classifier, all as one Symbol.  Data is a synthetic sentiment task
+(presence of "positive" token ids near the front decides the label) so
+the pipeline is self-contained.
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+VOCAB = 60
+SEQ_LEN = 24
+POS_TOKENS = set(range(5, 15))
+
+
+def synthetic_sentences(n, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randint(15, VOCAB, size=(n, SEQ_LEN)).astype(np.float32)
+    y = rs.randint(0, 2, size=n).astype(np.float32)
+    for i in range(n):
+        if y[i] == 1:  # plant positive tokens
+            pos = rs.choice(SEQ_LEN, 3, replace=False)
+            x[i, pos] = rs.choice(sorted(POS_TOKENS), 3)
+    return x, y
+
+
+def build_text_cnn(num_embed=16, filter_widths=(2, 3, 4), num_filter=8):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=num_embed,
+                             name="embed")
+    # (B, T, E) -> (B, 1, T, E): one "image" channel, conv over time
+    x = mx.sym.Reshape(embed, shape=(0, 1, SEQ_LEN, num_embed))
+    branches = []
+    for w in filter_widths:
+        conv = mx.sym.Convolution(x, kernel=(w, num_embed),
+                                  num_filter=num_filter,
+                                  name="conv%d" % w)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, kernel=(SEQ_LEN - w + 1, 1),
+                              pool_type="max")
+        branches.append(mx.sym.Flatten(pool))
+    h = mx.sym.Concat(*branches, dim=1, num_args=len(branches))
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="cls")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=50)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    xtr, ytr = synthetic_sentences(1000)
+    xva, yva = synthetic_sentences(300, seed=1)
+    train = mx.io.NDArrayIter(xtr, ytr, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(xva, yva, batch_size=args.batch_size)
+
+    mod = mx.mod.Module(build_text_cnn(),
+                        context=mx.context.current_context())
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.initializer.Xavier(),
+            num_epoch=args.num_epochs)
+    metric = mx.metric.Accuracy()
+    mod.score(val, metric)
+    acc = metric.get()[1]
+    print("text-cnn val accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Bernoulli RBM trained with contrastive divergence (CD-1)
+(reference: example/restricted-boltzmann-machine/binary_rbm*.py).
+
+API family: a training paradigm with NO autograd and no loss symbol —
+parameters update from the difference of data-phase and model-phase
+statistics, built from raw NDArray ops and the explicit-seed sampler.
+"""
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class BinaryRBM:
+    def __init__(self, n_visible, n_hidden, lr=0.05, seed=0):
+        rs = np.random.RandomState(seed)
+        self.w = mx.nd.array(
+            rs.normal(0, 0.05, (n_visible, n_hidden)).astype(np.float32))
+        self.bv = mx.nd.zeros((n_visible,))
+        self.bh = mx.nd.zeros((n_hidden,))
+        self.lr = lr
+
+    def _h_given_v(self, v):
+        return mx.nd.sigmoid(mx.nd.dot(v, self.w) + self.bh)
+
+    def _v_given_h(self, h):
+        return mx.nd.sigmoid(mx.nd.dot(h, self.w.T) + self.bv)
+
+    @staticmethod
+    def _sample(p):
+        return (mx.nd.random.uniform(shape=p.shape) < p).astype("float32")
+
+    def cd1_update(self, v0):
+        """One CD-1 step; returns the batch reconstruction error."""
+        batch = v0.shape[0]
+        ph0 = self._h_given_v(v0)
+        h0 = self._sample(ph0)
+        v1 = self._v_given_h(h0)  # mean-field reconstruction
+        ph1 = self._h_given_v(v1)
+
+        pos = mx.nd.dot(v0.T, ph0)
+        neg = mx.nd.dot(v1.T, ph1)
+        self.w += self.lr / batch * (pos - neg)
+        self.bv += self.lr * mx.nd.mean(v0 - v1, axis=0)
+        self.bh += self.lr * mx.nd.mean(ph0 - ph1, axis=0)
+        err = mx.nd.mean(mx.nd.square(v0 - v1))
+        return float(err.asnumpy())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--n-hidden", type=int, default=64)
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    train = MNISTIter(image="train", batch_size=args.batch_size, flat=True)
+    rbm = BinaryRBM(28 * 28, args.n_hidden)
+
+    first_err = last_err = None
+    for epoch in range(args.epochs):
+        train.reset()
+        errs = []
+        for batch in train:
+            v = (batch.data[0] > 0.5).astype("float32")
+            errs.append(rbm.cd1_update(v))
+        if first_err is None:
+            first_err = errs[0]
+        last_err = float(np.mean(errs[-10:]))
+        print("epoch %d: recon error %.4f" % (epoch, last_err))
+
+    print("reconstruction error %.4f -> %.4f" % (first_err, last_err))
+    return first_err, last_err
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,19 @@ def test_multitask_example_converges():
     joint digit+parity heads both learn through one Module."""
     acc = _run_example("multi-task/multitask_mnist.py", ["--epochs", "2"])
     assert acc > 0.9, acc
+
+
+def test_text_cnn_converges():
+    """Multi-branch conv-over-time Symbol (reference:
+    example/cnn_text_classification)."""
+    acc = _run_example("cnn_text_classification/text_cnn.py",
+                      ["--num-epochs", "4"])
+    assert acc > 0.9, acc
+
+
+def test_binary_rbm_learns():
+    """Autograd-free CD-1 training paradigm (reference:
+    example/restricted-boltzmann-machine)."""
+    first, last = _run_example("restricted-boltzmann-machine/binary_rbm.py",
+                              ["--epochs", "2"])
+    assert last < first * 0.2, (first, last)
