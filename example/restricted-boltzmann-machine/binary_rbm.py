@@ -15,6 +15,7 @@ import mxnet_tpu as mx
 
 class BinaryRBM:
     def __init__(self, n_visible, n_hidden, lr=0.05, seed=0):
+        mx.random.seed(seed)  # the Gibbs sampler draws from this stream
         rs = np.random.RandomState(seed)
         self.w = mx.nd.array(
             rs.normal(0, 0.05, (n_visible, n_hidden)).astype(np.float32))
