@@ -1,5 +1,11 @@
 """DenseNet 121/161/169/201 (reference: python/mxnet/gluon/model_zoo/
-vision/densenet.py)."""
+vision/densenet.py).
+By-spec reproduction notice: the topology tables and parameter naming
+follow the paper and the reference's Gluon module — param names are the
+checkpoint-compatibility contract, so structural similarity to the
+reference file is expected; the compute underneath is this repo's own
+(lax convs/matmuls on the MXU, XLA fusion under ``hybridize()``).
+"""
 
 from __future__ import annotations
 

