@@ -1,8 +1,9 @@
 """Symbolic RNN package (reference: python/mxnet/rnn/)."""
 
-from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,  # noqa: F401
-                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
-                       RNNCell, RNNParams, ResidualCell,
+from .rnn_cell import (BaseConvRNNCell, BaseRNNCell, BidirectionalCell,  # noqa: F401
+                       ConvGRUCell, ConvLSTMCell, ConvRNNCell,
+                       DropoutCell, FusedRNNCell, GRUCell, LSTMCell,
+                       ModifierCell, RNNCell, RNNParams, ResidualCell,
                        SequentialRNNCell, ZoneoutCell)
 from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint, rnn_unroll,  # noqa: F401
                   save_rnn_checkpoint)

@@ -30,7 +30,8 @@ from ..base import MXNetError
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
            "ModifierCell", "ZoneoutCell", "ResidualCell",
-           "BidirectionalCell"]
+           "BidirectionalCell", "BaseConvRNNCell", "ConvRNNCell",
+           "ConvLSTMCell", "ConvGRUCell"]
 
 # gate suffix tables, fused-op (cuDNN) order; ops/rnn.py slices in this
 # order, and the unfused cells compute in this order, so one table
@@ -233,6 +234,10 @@ class BaseRNNCell:
             return symbol.Activation(x, act_type=activation, **kwargs)
         return activation(x, **kwargs)
 
+    def _step_name(self):
+        self._counter += 1
+        return "%st%d_" % (self._prefix, self._counter)
+
 
 class _SingleGateSetCell(BaseRNNCell):
     """Shared plumbing for cells with one fused i2h/h2h matmul pair."""
@@ -258,10 +263,6 @@ class _SingleGateSetCell(BaseRNNCell):
             bias=self._w["h2h_bias"], num_hidden=n,
             name="%sh2h" % step_name)
         return i2h, h2h
-
-    def _step_name(self):
-        self._counter += 1
-        return "%st%d_" % (self._prefix, self._counter)
 
 
 class RNNCell(_SingleGateSetCell):
@@ -812,3 +813,190 @@ class BidirectionalCell(BaseRNNCell):
         if merge_outputs:
             outputs = _as_merged(outputs, t_axis)
         return outputs, l_states + r_states
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Convolutional recurrence: i2h and h2h are Convolutions over
+    spatial state maps (reference: rnn_cell.py BaseConvRNNCell).  The
+    h2h kernel must be odd so SAME padding preserves the state shape."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                 i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                 i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, activation,
+                 prefix="", params=None, conv_layout="NCHW"):
+        super().__init__(prefix=prefix, params=params)
+        if h2h_kernel[0] % 2 != 1 or h2h_kernel[1] % 2 != 1:
+            raise MXNetError("h2h_kernel must be odd (SAME padding), got %s"
+                             % (h2h_kernel,))
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        self._num_hidden = num_hidden
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+
+        # state spatial shape comes from the i2h conv on one timestep
+        probe = symbol.Convolution(
+            symbol.Variable("data"), num_filter=num_hidden,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate, layout=conv_layout)
+        _, out_shapes, _ = probe.infer_shape(data=self._input_shape)
+        self._state_shape = (0,) + tuple(out_shapes[0][1:])
+
+        p = self.params
+        self._w = {
+            "i2h_weight": p.get("i2h_weight", init=i2h_weight_initializer),
+            "h2h_weight": p.get("h2h_weight", init=h2h_weight_initializer),
+            "i2h_bias": p.get("i2h_bias", init=i2h_bias_initializer),
+            "h2h_bias": p.get("h2h_bias", init=h2h_bias_initializer),
+        }
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def _conv_projections(self, inputs, h_prev, step_name):
+        n = self._num_hidden * self._num_gates
+        i2h = symbol.Convolution(
+            data=inputs, weight=self._w["i2h_weight"],
+            bias=self._w["i2h_bias"], num_filter=n,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            layout=self._conv_layout, name="%si2h" % step_name)
+        h2h = symbol.Convolution(
+            data=h_prev, weight=self._w["h2h_weight"],
+            bias=self._w["h2h_bias"], num_filter=n,
+            kernel=self._h2h_kernel, stride=(1, 1), pad=self._h2h_pad,
+            dilate=self._h2h_dilate, layout=self._conv_layout,
+            name="%sh2h" % step_name)
+        return i2h, h2h
+
+
+def _leaky(x, name=None):
+    return symbol.LeakyReLU(x, act_type="leaky", slope=0.2, name=name)
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """h' = act(conv(x) + conv(h)) (reference: rnn_cell.py ConvRNNCell)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation=_leaky, prefix="ConvRNN_", params=None,
+                 conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        i2h, h2h = self._conv_projections(inputs, states[0], name)
+        out = self._activate(i2h + h2h, self._activation,
+                             name="%sout" % name)
+        return out, [out]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Convolutional LSTM (reference: rnn_cell.py ConvLSTMCell;
+    Xingjian et al. 2015)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation=_leaky, prefix="ConvLSTM_", params=None,
+                 conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape,
+                 "__layout__": self._conv_layout},
+                {"shape": self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        i2h, h2h = self._conv_projections(inputs, states[0], name)
+        c_axis = self._conv_layout.find("C")
+        g_i, g_f, g_c, g_o = symbol.SliceChannel(
+            i2h + h2h, num_outputs=4, axis=c_axis, name="%sslice" % name)
+        i = symbol.Activation(g_i, act_type="sigmoid", name="%si" % name)
+        f = symbol.Activation(g_f, act_type="sigmoid", name="%sf" % name)
+        c_tilde = self._activate(g_c, self._activation, name="%sc" % name)
+        o = symbol.Activation(g_o, act_type="sigmoid", name="%so" % name)
+        next_c = symbol.elemwise_add(f * states[1], i * c_tilde,
+                                     name="%sstate" % name)
+        next_h = symbol.elemwise_mul(
+            o, self._activate(next_c, self._activation),
+            name="%sout" % name)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Convolutional GRU (reference: rnn_cell.py ConvGRUCell)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation=_leaky, prefix="ConvGRU_", params=None,
+                 conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        name = self._step_name()
+        h_prev = states[0]
+        i2h, h2h = self._conv_projections(inputs, h_prev, name)
+        c_axis = self._conv_layout.find("C")
+        xr, xz, xn = symbol.SliceChannel(i2h, num_outputs=3, axis=c_axis,
+                                         name="%s_i2h_slice" % name)
+        hr, hz, hn = symbol.SliceChannel(h2h, num_outputs=3, axis=c_axis,
+                                         name="%s_h2h_slice" % name)
+        r = symbol.Activation(xr + hr, act_type="sigmoid",
+                              name="%s_r_act" % name)
+        z = symbol.Activation(xz + hz, act_type="sigmoid",
+                              name="%s_z_act" % name)
+        cand = self._activate(xn + r * hn, self._activation,
+                              name="%s_h_act" % name)
+        next_h = symbol.elemwise_add((1.0 - z) * cand, z * h_prev,
+                                     name="%sout" % name)
+        return next_h, [next_h]

@@ -566,6 +566,10 @@ def _create(op_name, input_syms, attrs, name=None):
                 continue
             if iname in ("data_lengths", "label_lengths"):
                 continue
+            if iname == "gamma" and op.name == "LeakyReLU" and \
+                    attrs.get("act_type", "leaky") != "prelu":
+                # only prelu carries a learned slope parameter
+                continue
             v = Variable("%s_%s" % (name, iname))
             inputs.append(v._outputs[0])
 

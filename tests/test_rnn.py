@@ -232,3 +232,58 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     sym, arg, aux = mx.rnn.load_rnn_checkpoint(fused, prefix, 7)
     np.testing.assert_allclose(arg["ck_parameters"].asnumpy(),
                                vec.asnumpy(), atol=0)
+
+
+@pytest.mark.parametrize("cls,n_states", [
+    ("ConvRNNCell", 1), ("ConvLSTMCell", 2), ("ConvGRUCell", 1)])
+def test_conv_rnn_cells(cls, n_states):
+    """Symbolic convolutional cells (reference: rnn_cell.py
+    BaseConvRNNCell family): unroll preserves the spatial state map."""
+    cell = getattr(mx.rnn, cls)(input_shape=(1, 3, 8, 8), num_hidden=5,
+                                prefix="%s_" % cls.lower())
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=False)
+    assert len(outputs) == 3 and len(states) == n_states
+    ex = outputs[-1].simple_bind(ctx=mx.cpu(), data=(2, 3, 3, 8, 8))
+    # per-step input is (B, C, H, W); unroll splits the T axis=1
+    ex.forward()
+    assert ex.outputs[0].shape == (2, 5, 8, 8)
+
+
+def test_conv_lstm_matches_dense_lstm_on_1x1():
+    """A ConvLSTM with 1x1 spatial extent and 1x1 kernels degenerates to
+    the dense LSTMCell (same math, conv == matmul)."""
+    h = 4
+    conv = mx.rnn.ConvLSTMCell(input_shape=(1, 3, 1, 1), num_hidden=h,
+                               h2h_kernel=(1, 1), i2h_kernel=(1, 1),
+                               i2h_pad=(0, 0), activation="tanh",
+                               prefix="cl_")
+    dense = mx.rnn.LSTMCell(h, prefix="dl_")
+    T, B = 3, 2
+    co, _ = conv.unroll(T, inputs=mx.sym.Variable("data"),
+                        merge_outputs=True)
+    do, _ = dense.unroll(T, inputs=mx.sym.Variable("data"),
+                         merge_outputs=True)
+    rs = np.random.RandomState(0)
+    x = rs.randn(B, T, 3).astype(np.float32)
+    wi = rs.randn(4 * h, 3).astype(np.float32) * 0.4
+    wh = rs.randn(4 * h, h).astype(np.float32) * 0.4
+    bi = rs.randn(4 * h).astype(np.float32) * 0.1
+    bh = rs.randn(4 * h).astype(np.float32) * 0.1
+    cex = co.simple_bind(ctx=mx.cpu(), data=(B, T, 3, 1, 1))
+    cex.arg_dict["data"][:] = x.reshape(B, T, 3, 1, 1)
+    cex.arg_dict["cl_i2h_weight"][:] = wi.reshape(4 * h, 3, 1, 1)
+    cex.arg_dict["cl_h2h_weight"][:] = wh.reshape(4 * h, h, 1, 1)
+    cex.arg_dict["cl_i2h_bias"][:] = bi
+    cex.arg_dict["cl_h2h_bias"][:] = bh
+    cex.forward()
+    dex = do.simple_bind(ctx=mx.cpu(), data=(B, T, 3))
+    dex.arg_dict["data"][:] = x
+    dex.arg_dict["dl_i2h_weight"][:] = wi
+    dex.arg_dict["dl_h2h_weight"][:] = wh
+    dex.arg_dict["dl_i2h_bias"][:] = bi
+    dex.arg_dict["dl_h2h_bias"][:] = bh
+    dex.forward()
+    np.testing.assert_allclose(
+        cex.outputs[0].asnumpy().reshape(B, T, h),
+        dex.outputs[0].asnumpy(), rtol=1e-5, atol=1e-5)
