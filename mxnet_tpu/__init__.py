@@ -57,7 +57,8 @@ _OPTIONAL = [
     "symbol", "io", "recordio", "gluon", "module", "kvstore", "executor",
     "cached_op", "profiler", "runtime", "test_utils", "visualization",
     "parallel", "contrib", "model", "image", "operator", "monitor",
-    "executor_manager", "rtc", "engine", "predictor", "rnn",
+    "executor_manager", "rtc", "engine", "predictor", "rnn", "log",
+    "util", "name", "attribute",
 ]
 
 

@@ -1,0 +1,7 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py).
+
+The implementation lives in ``mxnet_tpu.base``; this module keeps the
+reference import path ``from mxnet.attribute import AttrScope``.
+"""
+
+from .base import AttrScope  # noqa: F401

@@ -1,0 +1,66 @@
+"""Colored logging helpers (reference: python/mxnet/log.py).
+
+``get_logger`` attaches a glog-style formatter: one colored severity
+letter + timestamp + pid + source location, then the message.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger",
+           "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG", "NOTSET"]
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_COLORS = ((logging.WARNING, "\x1b[31m"), (logging.INFO, "\x1b[32m"),
+           (logging.NOTSET, "\x1b[34m"))
+_LABELS = {logging.CRITICAL: "C", logging.ERROR: "E", logging.WARNING: "W",
+           logging.INFO: "I", logging.DEBUG: "D"}
+
+
+class _GlogFormatter(logging.Formatter):
+    def __init__(self):
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        color = next(c for lvl, c in _COLORS if record.levelno >= lvl)
+        label = _LABELS.get(record.levelno, "U")
+        self._style._fmt = (
+            color + label +
+            "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+            "]\x1b[0m %(message)s")
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with the glog-style formatter attached once
+    (reference: log.py get_logger)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_log_init", False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_GlogFormatter())
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_log_init = True
+    return logger
+
+
+def getLogger(name=None, filename=None, filemode=None, level=WARNING):
+    """Deprecated alias of :func:`get_logger` (reference parity)."""
+    import warnings
+
+    warnings.warn("getLogger is deprecated; use get_logger",
+                  DeprecationWarning, stacklevel=2)
+    return get_logger(name, filename, filemode, level)

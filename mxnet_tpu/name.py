@@ -1,0 +1,19 @@
+"""Name-scope management (reference: python/mxnet/name.py).
+
+The implementation lives in ``mxnet_tpu.base``; this module keeps the
+reference import path ``from mxnet.name import NameManager, Prefix``.
+"""
+
+from .base import NameManager  # noqa: F401
+
+
+class Prefix(NameManager):
+    """Prepends a fixed prefix to every auto-generated name
+    (reference: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)

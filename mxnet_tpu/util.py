@@ -1,0 +1,13 @@
+"""Small shared utilities (reference: python/mxnet/util.py)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["makedirs"]
+
+
+def makedirs(d):
+    """Recursively create directories, tolerating existing ones
+    (reference: util.py makedirs)."""
+    os.makedirs(d, exist_ok=True)

@@ -208,3 +208,33 @@ def test_feedforward_legacy_api(tmp_path):
         loaded = mx.model.FeedForward.load(prefix, 12)
         pred2 = loaded.predict(x)
     np.testing.assert_allclose(pred, pred2, rtol=1e-5, atol=1e-6)
+
+
+def test_log_util_name_attribute_modules(tmp_path):
+    """Small reference modules: mx.log.get_logger (glog formatter),
+    mx.util.makedirs, mx.name.Prefix, mx.attribute.AttrScope."""
+    import logging
+    import os
+
+    import mxnet_tpu as mx
+
+    logger = mx.log.get_logger("mxtpu_test_logger", level=mx.log.INFO)
+    assert logger.level == logging.INFO
+    assert any("Glog" in type(h.formatter).__name__
+               for h in logger.handlers)
+    logger2 = mx.log.get_logger("mxtpu_test_logger")
+    assert logger2.handlers == logger.handlers  # no duplicate handlers
+
+    d = str(tmp_path / "a" / "b")
+    mx.util.makedirs(d)
+    mx.util.makedirs(d)  # idempotent
+    assert os.path.isdir(d)
+
+    with mx.name.Prefix("myprefix_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2)
+    assert s.name.startswith("myprefix_")
+
+    from mxnet_tpu.attribute import AttrScope
+    with AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("x")
+    assert v.attr("ctx_group") == "dev1"
