@@ -1,0 +1,85 @@
+"""MNIST classification with a margin loss head — SVMOutput
+(reference: example/svm_mnist/svm_mnist.py).
+
+API family: the SVMOutput op (L1/L2 hinge loss on one-vs-rest margins)
+instead of softmax cross-entropy, with predictions taken as the argmax
+of the raw scores.
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net(use_linear=False):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SVMOutput(h, name="svm",
+                            use_linear=bool(use_linear))
+
+
+class ScoreAccuracy(mx.metric.EvalMetric):
+    """argmax over raw margins (SVM scores are not probabilities)."""
+
+    def __init__(self):
+        super().__init__("score_acc")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            hit = (pred.asnumpy().argmax(1) ==
+                   label.asnumpy().ravel()).sum()
+            self.sum_metric += hit / label.shape[0]
+            self.num_inst += 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--l1-svm", action="store_true",
+                   help="linear (L1) hinge instead of squared (L2)")
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    logging.basicConfig(level=logging.INFO)
+
+    def relabeled(which, shuffle):
+        # the SVM head's label variable is 'svm_label'
+        inner = MNISTIter(image=which, batch_size=args.batch_size,
+                          shuffle=shuffle, flat=True)
+        inner.reset()
+        datas, labs = [], []
+        for b in inner:  # one pass: collect then rewrap under svm_label
+            datas.append(b.data[0].asnumpy())
+            labs.append(b.label[0].asnumpy())
+        data, lab = np.concatenate(datas), np.concatenate(labs)
+        return mx.io.NDArrayIter(data, lab, batch_size=args.batch_size,
+                                 shuffle=shuffle, label_name="svm_label")
+
+    train = relabeled("train", True)
+    val = relabeled("val", False)
+
+    mod = mx.mod.Module(build_net(args.l1_svm),
+                        context=mx.context.current_context(),
+                        label_names=("svm_label",))
+    metric = ScoreAccuracy()
+    mod.fit(train, eval_data=val, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            num_epoch=args.num_epochs)
+    metric.reset()
+    mod.score(val, metric)
+    acc = metric.get()[1]
+    print("svm-mnist val accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

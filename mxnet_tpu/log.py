@@ -53,6 +53,8 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     handler.setFormatter(_GlogFormatter())
     logger.addHandler(handler)
     logger.setLevel(level)
+    if name is not None:  # don't double-print through the root handler
+        logger.propagate = False
     logger._mxtpu_log_init = True
     return logger
 

@@ -16,4 +16,5 @@ class Prefix(NameManager):
         self._prefix = prefix
 
     def get(self, name, hint):
-        return name if name else self._prefix + super().get(None, hint)
+        # the reference prefixes EXPLICIT names too
+        return self._prefix + super().get(name, hint)

@@ -552,7 +552,6 @@ def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
 
 
 OP_INPUT_NAMES.update({
-    "SVMOutput": ("data", "label"),
     "_contrib_Proposal": ("cls_prob", "bbox_pred", "im_info"),
     "_contrib_PSROIPooling": ("data", "rois"),
     "_contrib_DeformableConvolution": ("data", "offset", "weight", "bias"),

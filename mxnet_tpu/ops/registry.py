@@ -47,6 +47,7 @@ OP_INPUT_NAMES = {
     "Embedding": ("data", "weight"),
     "LeakyReLU": ("data", "gamma"),
     "SoftmaxOutput": ("data", "label"),
+    "SVMOutput": ("data", "label"),
     "LinearRegressionOutput": ("data", "label"),
     "MAERegressionOutput": ("data", "label"),
     "LogisticRegressionOutput": ("data", "label"),
@@ -72,7 +73,7 @@ OP_AUX_INPUTS = {
 }
 
 # ops whose label-ish inputs get auto-created as "<name>_label" variables
-OP_LABEL_INPUTS = {"SoftmaxOutput", "LinearRegressionOutput",
+OP_LABEL_INPUTS = {"SoftmaxOutput", "SVMOutput", "LinearRegressionOutput",
                    "MAERegressionOutput", "LogisticRegressionOutput", "CTCLoss"}
 
 

@@ -817,10 +817,10 @@ def _solve_params(node, in_shapes, shapes):
         if len(node.inputs) > 1:
             setv(1, (data_shape[1],))
     elif node.op in OP_LABEL_INPUTS:
-        # label shape mirrors data minus class axis for SoftmaxOutput
+        # label shape mirrors data minus class axis for classifier heads
         for i, nm in enumerate(names[:len(node.inputs)]):
             if nm == "label":
-                if node.op == "SoftmaxOutput":
+                if node.op in ("SoftmaxOutput", "SVMOutput"):
                     if a.get("multi_output"):
                         setv(i, (data_shape[0],) + data_shape[2:])
                     else:

@@ -275,3 +275,10 @@ def test_binary_rbm_learns():
     first, last = _run_example("restricted-boltzmann-machine/binary_rbm.py",
                               ["--epochs", "2"])
     assert last < first * 0.2, (first, last)
+
+
+def test_svm_mnist_converges():
+    """Margin-loss head family (reference: example/svm_mnist): SVMOutput
+    trains to high accuracy with argmax-of-scores predictions."""
+    acc = _run_example("svm_mnist/svm_mnist.py", ["--num-epochs", "2"])
+    assert acc > 0.9, acc

@@ -223,7 +223,8 @@ def test_log_util_name_attribute_modules(tmp_path):
     assert any("Glog" in type(h.formatter).__name__
                for h in logger.handlers)
     logger2 = mx.log.get_logger("mxtpu_test_logger")
-    assert logger2.handlers == logger.handlers  # no duplicate handlers
+    assert logger2 is logger and len(logger.handlers) == 1
+    assert logger.propagate is False
 
     d = str(tmp_path / "a" / "b")
     mx.util.makedirs(d)
@@ -232,7 +233,10 @@ def test_log_util_name_attribute_modules(tmp_path):
 
     with mx.name.Prefix("myprefix_"):
         s = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2)
+        s2 = mx.sym.FullyConnected(mx.sym.Variable("d2"), num_hidden=2,
+                                   name="fc9")
     assert s.name.startswith("myprefix_")
+    assert s2.name == "myprefix_fc9"  # explicit names are prefixed too
 
     from mxnet_tpu.attribute import AttrScope
     with AttrScope(ctx_group="dev1"):
