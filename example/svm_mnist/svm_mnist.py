@@ -74,8 +74,7 @@ def main(argv=None):
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
                               "wd": 1e-5},
             num_epoch=args.num_epochs)
-    metric.reset()
-    mod.score(val, metric)
+    mod.score(val, metric)  # score() resets the metric itself
     acc = metric.get()[1]
     print("svm-mnist val accuracy: %.3f" % acc)
     return acc

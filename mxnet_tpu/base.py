@@ -80,15 +80,26 @@ class _ThreadLocalScope:
     _state = None  # subclass sets a threading.local
 
     @classmethod
+    def _stack_owner(cls):
+        """The class that owns the thread-local stack: subclasses like
+        name.Prefix share their base's stack, and the bootstrap default
+        must be that base (a subclass may require constructor args)."""
+        for klass in cls.__mro__:
+            if klass.__dict__.get("_state") is not None:
+                return klass
+        return cls
+
+    @classmethod
     def current(cls):
         if not hasattr(cls._state, "value") or not cls._state.value:
-            cls._state.value = [cls()]
+            cls._state.value = [cls._stack_owner()()]
         return cls._state.value[-1]
 
     def __enter__(self):
-        if not hasattr(type(self)._state, "value") or not type(self)._state.value:
-            type(self)._state.value = [type(self)()]
-        type(self)._state.value.append(self)
+        cls = type(self)
+        if not hasattr(cls._state, "value") or not cls._state.value:
+            cls._state.value = [cls._stack_owner()()]
+        cls._state.value.append(self)
         return self
 
     def __exit__(self, ptype, value, trace):

@@ -451,27 +451,34 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=Fal
     """reference: src/operator/nn/pooling.cc — max/avg/sum/lp pooling,
     'valid' (floor) vs 'full' (ceil) conventions, global pooling."""
     nd = data.ndim - 2
+    channel_last = layout is not None and str(layout).endswith("C")
+    spatial0 = 1 if channel_last else 2  # first spatial axis
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[spatial0:spatial0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     kernel = _tup(kernel, nd)
     stride = _tup(stride, nd) if stride else (1,) * nd
     pad = _tup(pad, nd) if pad else (0,) * nd
 
-    padding = [(0, 0), (0, 0)]
+    spatial_padding = []
     for i in range(nd):
         lo = hi = pad[i]
         if pooling_convention == "full":
             # ceil convention: possibly extra padding on the high side
-            size = data.shape[2 + i]
+            size = data.shape[spatial0 + i]
             out_sz = -(-(size + 2 * pad[i] - kernel[i]) // stride[i]) + 1
             needed = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
             hi = max(needed, pad[i])
-        padding.append((lo, hi))
-
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
+        spatial_padding.append((lo, hi))
+    if channel_last:
+        padding = [(0, 0)] + spatial_padding + [(0, 0)]
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        padding = [(0, 0), (0, 0)] + spatial_padding
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
 
     if pool_type == "max":
         init = -jnp.inf

@@ -854,3 +854,25 @@ def test_conv_nhwc_layout_matches_nchw():
                            "pad": (1, 1), "layout": "NHWC"})[0])
     np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pool_nhwc_layout_matches_nchw(pool_type):
+    """Pooling layout='NHWC' equals the NCHW result transposed —
+    completes the channel-last op pair with Convolution."""
+    x = _f32(2, 3, 6, 6)
+    attrs = {"kernel": (2, 2), "stride": (2, 2), "pool_type": pool_type}
+    want = np.asarray(_run("Pooling", [x], attrs)[0])
+    got = np.asarray(_run("Pooling", [x.transpose(0, 2, 3, 1)],
+                          {**attrs, "layout": "NHWC"})[0])
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               rtol=1e-5, atol=1e-6)
+    # global pooling too
+    wantg = np.asarray(_run("Pooling", [x],
+                            {"pool_type": pool_type,
+                             "global_pool": True})[0])
+    gotg = np.asarray(_run("Pooling", [x.transpose(0, 2, 3, 1)],
+                           {"pool_type": pool_type, "global_pool": True,
+                            "layout": "NHWC"})[0])
+    np.testing.assert_allclose(gotg.transpose(0, 3, 1, 2), wantg,
+                               rtol=1e-5, atol=1e-6)
