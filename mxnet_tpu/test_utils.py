@@ -347,3 +347,45 @@ class DummyIter:
         return self.the_batch
 
     __next__ = next
+
+
+def get_mnist():
+    """MNIST arrays dict (reference: test_utils.get_mnist — downloads;
+    here the zero-egress container serves MNISTIter's deterministic
+    synthetic digits through the same contract)."""
+    from .io.io import MNISTIter
+
+    def _collect(which):
+        it = MNISTIter(image=which, batch_size=100, shuffle=False)
+        it.reset()
+        data, label = [], []
+        for b in it:
+            data.append(b.data[0].asnumpy())
+            label.append(b.label[0].asnumpy())
+        return _np.concatenate(data), _np.concatenate(label)
+
+    train_img, train_lbl = _collect("train")
+    test_img, test_lbl = _collect("val")
+    return {"train_data": train_img, "train_label": train_lbl,
+            "test_data": test_img, "test_label": test_lbl}
+
+
+def get_mnist_iterator(batch_size, input_shape, num_parts=1, part_index=0):
+    """(train_iter, val_iter) pair (reference: get_mnist_iterator)."""
+    from .io.io import MNISTIter
+
+    flat = len(input_shape) == 1
+    train = MNISTIter(image="train", batch_size=batch_size, shuffle=True,
+                      flat=flat, num_parts=num_parts, part_index=part_index)
+    val = MNISTIter(image="val", batch_size=batch_size, shuffle=False,
+                    flat=flat)
+    return train, val
+
+
+def download(url, fname=None, dirname=None, overwrite=False, retries=5):
+    """Reference-parity stub: this container has no network egress, so
+    downloads must fail loudly instead of hanging (reference:
+    test_utils.download fetches over HTTP)."""
+    raise RuntimeError(
+        "test_utils.download(%r): network egress is unavailable in this "
+        "environment; stage files locally instead" % (url,))

@@ -242,3 +242,19 @@ def test_log_util_name_attribute_modules(tmp_path):
     with AttrScope(ctx_group="dev1"):
         v = mx.sym.Variable("x")
     assert v.attr("ctx_group") == "dev1"
+
+
+def test_get_mnist_helpers():
+    import numpy as np
+    import pytest as _pytest
+
+    from mxnet_tpu import test_utils as tu
+
+    mnist = tu.get_mnist()
+    assert mnist["train_data"].shape[1:] == (1, 28, 28)
+    assert len(mnist["train_data"]) == len(mnist["train_label"])
+    train, val = tu.get_mnist_iterator(batch_size=50, input_shape=(784,))
+    b = next(iter(train))
+    assert b.data[0].shape == (50, 784)
+    with _pytest.raises(RuntimeError, match="egress"):
+        tu.download("http://example.com/x")
