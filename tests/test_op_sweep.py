@@ -489,6 +489,7 @@ CREATION = {
 # the sweep still asserts the name is registered
 ELSEWHERE = {
     "RNN": "tests/test_rnn.py",
+    "_subgraph_exec": "tests/test_subgraph.py",
     "Custom": "tests/test_review_fixes.py",
     "CTCLoss": "tests/test_operator.py",
     "SpatialTransformer": "tests/test_extended_ops.py",
