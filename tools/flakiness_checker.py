@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Run one test repeatedly under fresh seeds to expose flakiness
+(reference: tools/flakiness_checker.py, adapted from nose to pytest).
+
+Usage:
+    python tools/flakiness_checker.py tests/test_operator.py::test_softmax
+    python tools/flakiness_checker.py test_operator.test_softmax -n 100
+"""
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def normalize_target(spec):
+    """Accept pytest (file::test) or nose (module.test) specs."""
+    if "::" in spec or spec.endswith(".py"):
+        return spec
+    if "." in spec:
+        module, test = spec.rsplit(".", 1)
+        path = os.path.join("tests", module.replace(".", os.sep) + ".py")
+        return "%s::%s" % (path, test)
+    return spec
+
+
+def run_trials(target, trials, seed=None, verbose=False):
+    rng = random.Random(seed)
+    failures = 0
+    for trial in range(trials):
+        trial_seed = rng.randrange(2 ** 31)
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(trial_seed)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x", target],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        if res.returncode != 0:
+            failures += 1
+            print("trial %d FAILED (seed %d)" % (trial, trial_seed))
+            if verbose:
+                print(res.stdout[-2000:])
+        elif verbose:
+            print("trial %d passed (seed %d)" % (trial, trial_seed))
+    return failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="check a test for flakiness")
+    p.add_argument("test", help="pytest file::test or module.test spec")
+    p.add_argument("-n", "--num-trials", type=int, default=20)
+    p.add_argument("-s", "--seed", type=int, default=None)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    target = normalize_target(args.test)
+    failures = run_trials(target, args.num_trials, args.seed, args.verbose)
+    print("%d/%d trials failed for %s"
+          % (failures, args.num_trials, target))
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Kill stray training processes on every host of a cluster
+(reference: tools/kill-mxnet.py — the cleanup tool for launch.py jobs).
+
+Usage: python tools/kill-mxnet.py <hostfile> <user> <prog>
+A hostfile of "localhost" lines (or a missing file) kills locally.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def kill_command(user, prog):
+    return (
+        "ps aux | grep -v grep | grep '%s' | "
+        "awk '{if($1==\"%s\") print $2}' | xargs -r kill -9" % (prog, user))
+
+
+def main(argv):
+    if len(argv) != 4:
+        print("usage: %s <hostfile> <user> <prog>" % argv[0])
+        return 1
+    host_file, user, prog = argv[1:4]
+    cmd = kill_command(user, prog)
+
+    hosts = ["localhost"]
+    if os.path.exists(host_file):
+        with open(host_file) as f:
+            hosts = [h.strip() for h in f if h.strip()] or hosts
+
+    for host in hosts:
+        if host in ("localhost", "127.0.0.1"):
+            subprocess.call(cmd, shell=True)
+        else:
+            subprocess.call(["ssh", "-o", "StrictHostKeyChecking=no",
+                             host, cmd])
+        print("killed %r processes of %s on %s" % (prog, user, host))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
