@@ -282,3 +282,11 @@ def test_svm_mnist_converges():
     trains to high accuracy with argmax-of-scores predictions."""
     acc = _run_example("svm_mnist/svm_mnist.py", ["--num-epochs", "2"])
     assert acc > 0.9, acc
+
+
+def test_fcn_segmentation_learns():
+    """Deconvolution + Crop skip-connection family (reference:
+    example/fcn-xs): per-pixel softmax must clearly beat the ~0.86
+    all-background baseline (i.e. actually segment the blobs)."""
+    acc = _run_example("fcn-xs/fcn_segmentation.py", ["--num-epochs", "10"])
+    assert acc > 0.95, acc

@@ -17,13 +17,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def normalize_target(spec):
-    """Accept pytest (file::test) or nose (module.test) specs."""
+    """Accept pytest (file::test) or nose (module[.Class].test) specs."""
     if "::" in spec or spec.endswith(".py"):
         return spec
-    if "." in spec:
-        module, test = spec.rsplit(".", 1)
-        path = os.path.join("tests", module.replace(".", os.sep) + ".py")
-        return "%s::%s" % (path, test)
+    parts = spec.split(".")
+    # the module is the longest leading prefix whose file exists; the
+    # rest (Class and/or test) becomes pytest :: selectors
+    for i in range(len(parts) - 1, 0, -1):
+        path = os.path.join("tests", os.sep.join(parts[:i]) + ".py")
+        if os.path.exists(os.path.join(REPO, path)):
+            return path + "".join("::" + q for q in parts[i:])
     return spec
 
 

@@ -7,14 +7,18 @@ A hostfile of "localhost" lines (or a missing file) kills locally.
 """
 
 import os
+import shlex
 import subprocess
 import sys
 
 
 def kill_command(user, prog):
+    # quote user input (it rides a shell pipeline, locally and over
+    # ssh) and exclude this script itself from the match
     return (
-        "ps aux | grep -v grep | grep '%s' | "
-        "awk '{if($1==\"%s\") print $2}' | xargs -r kill -9" % (prog, user))
+        "ps aux | grep -v grep | grep -v kill-mxnet | grep %s | "
+        "awk -v u=%s '{if($1==u) print $2}' | xargs -r kill -9"
+        % (shlex.quote(prog), shlex.quote(user)))
 
 
 def main(argv):
