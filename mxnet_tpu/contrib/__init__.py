@@ -5,6 +5,7 @@ Currently: quantization (INT8), onnx (import/export).
 
 from . import quantization  # noqa: F401
 from . import svrg_optimization  # noqa: F401
+from . import tensorboard  # noqa: F401
 from . import text  # noqa: F401
 
 try:  # onnx codec is self-contained but optional

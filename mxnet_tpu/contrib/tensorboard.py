@@ -1,0 +1,148 @@
+"""TensorBoard metric logging (reference: contrib/tensorboard.py).
+
+The reference delegates to the external ``mxboard`` package; this
+container is zero-egress, so a self-contained writer emits the
+TFRecord/tfevents wire format directly, reusing the schema-driven
+protobuf codec from ``contrib/onnx/_proto.py``.  Files are readable by
+standard TensorBoard: ``tensorboard --logdir=<logging_dir>``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+from .onnx import _proto
+
+__all__ = ["LogMetricsCallback", "SummaryWriter"]
+
+# Event/Summary wire schemas (public tensorflow event.proto /
+# summary.proto field numbers), registered alongside the ONNX tables
+_proto.SCHEMAS.setdefault("TBSummaryValue", {
+    1: ("tag", "str"),
+    2: ("simple_value", "float"),
+})
+_proto.SCHEMAS.setdefault("TBSummary", {
+    1: ("value", "msg:TBSummaryValue*"),
+})
+_proto.SCHEMAS.setdefault("TBEvent", {
+    1: ("wall_time", "double"),
+    2: ("step", "varint"),
+    3: ("file_version", "str"),
+    5: ("summary", "msg:TBSummary"),
+})
+
+
+# ------------------------------------------------------------- crc32c -----
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC_TABLE = _crc32c_table()
+
+
+def _crc32c(data):
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+_WRITER_SEQ = 0
+
+
+class SummaryWriter:
+    """Append-only tfevents writer (the mxboard subset the reference
+    callback uses: ``add_scalar``)."""
+
+    def __init__(self, logging_dir):
+        global _WRITER_SEQ
+
+        os.makedirs(logging_dir, exist_ok=True)
+        _WRITER_SEQ += 1
+        # hostname+pid+seq keeps concurrent writers in one logdir apart
+        fname = "events.out.tfevents.%d.%s.%d.%d" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            _WRITER_SEQ)
+        self._path = os.path.join(logging_dir, fname)
+        self._f = open(self._path, "ab")
+        # standard tfevents header: v2 purge semantics for readers
+        self._write_event({"wall_time": time.time(), "step": 0,
+                           "file_version": "brain.Event:2"})
+
+    def _write_event(self, event_dict):
+        ev = _proto.encode(event_dict, "TBEvent")
+        header = struct.pack("<Q", len(ev))
+        self._f.write(header + struct.pack("<I", _masked_crc(header)))
+        self._f.write(ev + struct.pack("<I", _masked_crc(ev)))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_event({
+            "wall_time": time.time(),
+            "step": int(global_step),
+            "summary": {"value": [{"tag": tag,
+                                   "simple_value": float(value)}]},
+        })
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch/epoch-end callback writing every metric as a TensorBoard
+    scalar (reference: contrib/tensorboard.py LogMetricsCallback).
+
+    Steps are a monotonic per-callback counter so batch-end usage plots
+    within-epoch progress instead of stacking a whole epoch at one x
+    value (mxboard's own global_step default)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = SummaryWriter(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value,
+                                           global_step=self._step)
+
+
+def read_events(path):
+    """Parse a tfevents file back into a list of Event dicts — the
+    verification twin of the writer (and a debugging aid)."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (n,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise ValueError("corrupt tfevents header")
+            data = f.read(n)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if dcrc != _masked_crc(data):
+                raise ValueError("corrupt tfevents record")
+            out.append(_proto.decode(data, "TBEvent"))
+    return out

@@ -258,3 +258,40 @@ def test_get_mnist_helpers():
     assert b.data[0].shape == (50, 784)
     with _pytest.raises(RuntimeError, match="egress"):
         tu.download("http://example.com/x")
+
+
+def test_tensorboard_callback_writes_real_tfevents(tmp_path):
+    """contrib.tensorboard writes TFRecord-framed Event protos (CRC32C
+    verified) that round-trip through the module's own reader."""
+    from collections import namedtuple
+
+    from mxnet_tpu.contrib import tensorboard as tb
+
+    logdir = str(tmp_path / "logs")
+    cb = tb.LogMetricsCallback(logdir, prefix="train")
+
+    import mxnet_tpu as mx
+
+    metric = mx.metric.Accuracy()
+    metric.sum_metric, metric.num_inst = 3.0, 4
+    BP = namedtuple("BP", ["epoch", "nbatch", "eval_metric"])
+    cb(BP(2, 10, metric))
+    cb(BP(3, 20, metric))
+    cb.summary_writer.close()
+
+    files = [f for f in __import__("os").listdir(logdir)
+             if f.startswith("events.out.tfevents")]
+    assert len(files) == 1
+    events = tb.read_events(cb.summary_writer._path)
+    assert events[0]["file_version"] == "brain.Event:2"
+    scalars = [(e["step"], e["summary"]["value"]) for e in events
+               if "summary" in e]
+    assert [(s, v[0]["tag"], round(v[0]["simple_value"], 4))
+            for s, v in scalars] == [(1, "train-accuracy", 0.75),
+                                     (2, "train-accuracy", 0.75)]
+    # two writers in the same second/logdir get distinct files
+    w2 = tb.SummaryWriter(logdir)
+    assert w2._path != cb.summary_writer._path
+    w2.close()
+    # known-answer CRC32C check (RFC 3720 test vector)
+    assert tb._crc32c(b"123456789") == 0xE3069283
