@@ -4,22 +4,35 @@ BASELINE.json config 4: multibox + NMS custom ops end-to-end).
 
 A scaled SSD: conv backbone + two feature scales, anchors from
 MultiBoxPrior, training targets from MultiBoxTarget, inference through
-MultiBoxDetection (decode + NMS).  Trains on synthetic single-object
-scenes (zero-egress container — no VOC); the op pipeline is exactly the
-reference's.  Anchors are static and the whole loss is jit-staged, so
-the hot path is MXU matmuls/convs.
+MultiBoxDetection (decode + NMS).  The data path is the reference's
+real workflow (example/ssd/train.py + image/detection.py): scenes are
+written to disk as JPEG files with a VOC-style detection .lst, packed
+into a .rec by tools/im2rec.py --pack-label, and consumed through
+ImageDetIter with label-aware augmentation.  (Zero-egress container —
+the scenes themselves are synthetic single/two-object images, but every
+byte flows through the record + det-augmenter pipeline.)  Anchors are
+static and the whole loss is jit-staged, so the hot path is MXU
+matmuls/convs.
 """
 
 import argparse
+import importlib.util
+import os
+import sys
+import tempfile
 import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
 import mxnet_tpu as mx
 from mxnet_tpu import gluon
 from mxnet_tpu.gluon import nn
+from mxnet_tpu.image import ImageDetIter
 from mxnet_tpu.ndarray import contrib as ndc
-
 
 class TinySSD(gluon.Block):
     """Backbone + per-scale class/box heads (reference:
@@ -74,22 +87,69 @@ class TinySSD(gluon.Block):
         return anchor, cls_pred, loc_pred
 
 
-def synthetic_scene(rng, n, hw=64, num_classes=3):
-    """Images with ONE solid axis-aligned box; class = channel colour."""
-    x = rng.rand(n, 3, hw, hw).astype(np.float32) * 0.1
-    labels = np.full((n, 1, 5), -1.0, dtype=np.float32)
-    for i in range(n):
-        cls = rng.randint(num_classes)
-        w, h = rng.randint(hw // 4, hw // 2, 2)
-        x0 = rng.randint(0, hw - w)
-        y0 = rng.randint(0, hw - h)
-        x[i, cls, y0:y0 + h, x0:x0 + w] += 0.8
-        labels[i, 0] = [cls, x0 / hw, y0 / hw, (x0 + w) / hw, (y0 + h) / hw]
-    return x, labels
+# ------------------------------------------------------------ data path
 
 
-def train(args):
-    rng = np.random.RandomState(0)
+def make_scenes(rng, n, hw, num_classes, max_objs=1):
+    """Synthetic scenes as uint8 HWC images + [cls,x1,y1,x2,y2] rows.
+    Class = which colour channel the solid box brightens."""
+    scenes = []
+    for _ in range(n):
+        img = (rng.rand(hw, hw, 3) * 40).astype(np.uint8)
+        rows = []
+        placed = []
+        for _ in range(rng.randint(1, max_objs + 1)):
+            cls = rng.randint(num_classes)
+            w, h = rng.randint(hw // 4, hw // 2, 2)
+            x0 = rng.randint(0, hw - w)
+            y0 = rng.randint(0, hw - h)
+            # keep boxes disjoint so class colours stay unambiguous
+            if any(x0 < px1 and px0 < x0 + w and y0 < py1 and py0 < y0 + h
+                   for px0, py0, px1, py1 in placed):
+                continue
+            img[y0:y0 + h, x0:x0 + w, cls] = 230
+            placed.append((x0, y0, x0 + w, y0 + h))
+            rows.append([cls, x0 / hw, y0 / hw, (x0 + w) / hw, (y0 + h) / hw])
+        scenes.append((img, rows))
+    return scenes
+
+
+def write_rec(dirpath, prefix, scenes, quality=95):
+    """JPEG files + detection .lst -> .rec via tools/im2rec.py
+    --pack-label (the reference's real packing workflow)."""
+    from PIL import Image
+
+    root = os.path.join(dirpath, prefix + "_images")
+    os.makedirs(root, exist_ok=True)
+    lst_prefix = os.path.join(dirpath, prefix)
+    with open(lst_prefix + ".lst", "w") as lst:
+        for i, (img, rows) in enumerate(scenes):
+            fname = "%s_%05d.jpg" % (prefix, i)
+            Image.fromarray(img).save(os.path.join(root, fname),
+                                      quality=quality)
+            flat = [2, 5]  # header_width, obj_width
+            for row in rows:
+                flat.extend(row)
+            cols = "\t".join("%.6f" % v for v in flat)
+            lst.write("%d\t%s\t%s\n" % (i, cols, fname))
+    spec = importlib.util.spec_from_file_location(
+        "im2rec_tool", os.path.join(REPO, "tools", "im2rec.py"))
+    im2rec = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(im2rec)
+    im2rec.main([lst_prefix, root, "--pack-label"])
+    return lst_prefix + ".rec"
+
+
+def det_iter(rec_path, batch_size, hw, train):
+    kwargs = dict(rand_mirror=True, shuffle=True) if train else {}
+    return ImageDetIter(batch_size=batch_size, data_shape=(3, hw, hw),
+                        path_imgrec=rec_path, mean=True, std=True, **kwargs)
+
+
+# ------------------------------------------------------------ training
+
+
+def train(args, train_rec):
     net = TinySSD(num_classes=args.num_classes)
     net.initialize(mx.init.Xavier())
     trainer = gluon.Trainer(net.collect_params(), "adam",
@@ -105,15 +165,15 @@ def train(args):
         valid = (cls_t >= 0).astype("float32")
         return (ce * valid).sum() / mx.nd.clip(valid.sum(), 1.0, 1e18)
 
-    x_all, y_all = synthetic_scene(rng, args.num_examples, args.data_shape,
-                                   args.num_classes)
-    B = args.batch_size
+    it = det_iter(train_rec, args.batch_size, args.data_shape, train=True)
     for epoch in range(args.epochs):
         tot_cls = tot_loc = nb = 0.0
         tic = time.time()
-        for i in range(0, args.num_examples - B + 1, B):
-            data = mx.nd.array(x_all[i:i + B])
-            label = mx.nd.array(y_all[i:i + B])
+        it.reset()
+        for batch in it:
+            if batch.pad:
+                continue
+            data, label = batch.data[0], batch.label[0]
             with mx.autograd.record():
                 anchor, cls_pred, loc_pred = net(data)
                 loc_t, loc_m, cls_t = ndc.MultiBoxTarget(
@@ -123,7 +183,7 @@ def train(args):
                 Ll = l1(loc_pred * loc_m, loc_t * loc_m)
                 L = Lc + args.loc_weight * Ll
             L.backward()
-            trainer.step(B)
+            trainer.step(args.batch_size)
             tot_cls += float(Lc.mean().asnumpy())
             tot_loc += float(Ll.mean().asnumpy())
             nb += 1
@@ -132,11 +192,13 @@ def train(args):
     return net
 
 
-def evaluate(net, args, n=32):
-    """Fraction of scenes whose top detection matches class @ IoU>=0.5."""
-    rng = np.random.RandomState(99)
-    x, y = synthetic_scene(rng, n, args.data_shape, args.num_classes)
-    anchor, cls_pred, loc_pred = net(mx.nd.array(x))
+def evaluate(net, args, val_rec, n=32):
+    """Fraction of scenes whose top detection matches class @ IoU>=0.5;
+    ground truth read back through the same ImageDetIter."""
+    it = det_iter(val_rec, n, args.data_shape, train=False)
+    batch = next(iter(it))
+    data, labels = batch.data[0], batch.label[0].asnumpy()
+    anchor, cls_pred, loc_pred = net(data)
     probs = mx.nd.softmax(cls_pred, axis=-1).transpose((0, 2, 1))
     det = ndc.MultiBoxDetection(probs, loc_pred, anchor,
                                 nms_threshold=0.45)
@@ -148,7 +210,7 @@ def evaluate(net, args, n=32):
         if not len(rows):
             continue
         best = rows[rows[:, 1].argmax()]
-        gt = y[i, 0]
+        gt = labels[i, 0]  # single-object val scenes: row 0 is the object
         ix1, iy1 = max(best[2], gt[1]), max(best[3], gt[2])
         ix2, iy2 = min(best[4], gt[3]), min(best[5], gt[4])
         inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
@@ -169,9 +231,25 @@ def main(argv=None):
     parser.add_argument("--epochs", type=int, default=10)
     parser.add_argument("--lr", type=float, default=2e-3)
     parser.add_argument("--loc-weight", type=float, default=5.0)
+    parser.add_argument("--max-objs", type=int, default=2,
+                        help="max objects per training scene")
+    parser.add_argument("--data-dir", default=None,
+                        help="where to build the .rec dataset "
+                             "(default: a fresh temp dir)")
     args = parser.parse_args(argv)
-    net = train(args)
-    acc = evaluate(net, args)
+
+    rng = np.random.RandomState(0)
+    data_dir = args.data_dir or tempfile.mkdtemp(prefix="ssd_data_")
+    train_rec = write_rec(data_dir, "train",
+                          make_scenes(rng, args.num_examples,
+                                      args.data_shape, args.num_classes,
+                                      max_objs=args.max_objs))
+    val_rec = write_rec(data_dir, "val",
+                        make_scenes(np.random.RandomState(99), 32,
+                                    args.data_shape, args.num_classes,
+                                    max_objs=1))
+    net = train(args, train_rec)
+    acc = evaluate(net, args, val_rec)
     print("detection accuracy (top-1 class @ IoU>=0.5): %.3f" % acc)
     return acc
 

@@ -26,8 +26,8 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "CenterCropAug", "HorizontalFlipAug", "CastAug",
            "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "HueJitterAug", "LightingAug",
-           "ColorJitterAug", "RandomOrderAug", "SequentialAug",
-           "CreateAugmenter", "ImageIter"]
+           "ColorJitterAug", "RandomOrderAug", "RandomGrayAug",
+           "SequentialAug", "CreateAugmenter", "ImageIter"]
 
 
 def _cv2():
@@ -39,9 +39,34 @@ def _cv2():
         return None
 
 
-def imdecode(buf, flag=1, to_rgb=True, out=None):
-    """Decode an image byte buffer to an NDArray HWC(BGR→RGB)
-    (reference: image.py imdecode over cv::imdecode)."""
+class _HostArray(_np.ndarray):
+    """numpy view that also answers the NDArray read surface augmenters
+    use (`asnumpy`), so user augmenters written against the documented
+    NDArray contract keep working on the host-numpy fast path."""
+
+    def asnumpy(self):
+        return _np.asarray(self)
+
+
+def _to_host(src):
+    """NDArray|numpy -> numpy view on host.  The whole augmentation
+    chain runs on host numpy (one HBM transfer per *batch*, not per
+    sample/op — a per-op device round-trip costs ~15-20 ms through a
+    TPU relay and a fresh XLA compile per crop shape)."""
+    return src.asnumpy() if isinstance(src, ndarray.NDArray) else src
+
+
+def _like(out, ref):
+    """Wrap a host array to match the caller's container type, so the
+    public augmenter API stays NDArray->NDArray (reference behavior)
+    while iterators feed host arrays through the same objects."""
+    if isinstance(ref, ndarray.NDArray):
+        return ndarray.array(out)
+    return out.view(_HostArray) if isinstance(out, _np.ndarray) else out
+
+
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    """Decode an image byte buffer to a host HWC uint8 numpy array."""
     if bytes(buf[:4]) == b"IMG0":
         # records written by earlier versions of this framework carried a
         # format tag before the encoded bytes; no real image format
@@ -69,7 +94,14 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
             arr = arr[:, :, None]
         elif not to_rgb:
             arr = arr[:, :, ::-1]
-    return ndarray.array(_np.ascontiguousarray(arr), dtype="uint8")
+    return _np.ascontiguousarray(arr)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer to an NDArray HWC(BGR→RGB)
+    (reference: image.py imdecode over cv::imdecode)."""
+    return ndarray.array(_imdecode_np(buf, flag=flag, to_rgb=to_rgb),
+                         dtype="uint8")
 
 
 def imread(filename, flag=1, to_rgb=True):
@@ -78,8 +110,9 @@ def imread(filename, flag=1, to_rgb=True):
 
 
 def imresize(src, w, h, interp=1):
-    """Resize HWC image (reference: image.py imresize)."""
-    arr = src.asnumpy() if isinstance(src, ndarray.NDArray) else src
+    """Resize HWC image (reference: image.py imresize).  Type-preserving:
+    numpy in -> numpy out, NDArray in -> NDArray out."""
+    arr = _to_host(src)
     cv2 = _cv2()
     if cv2 is not None:
         out = cv2.resize(arr, (int(w), int(h)),
@@ -90,7 +123,7 @@ def imresize(src, w, h, interp=1):
         from .gluon.data.vision.transforms import _resize_np
 
         out = _resize_np(arr, (int(w), int(h)))
-    return ndarray.array(out, dtype=arr.dtype)
+    return _like(out.astype(arr.dtype, copy=False), src)
 
 
 def _cv2_interp(interp):
@@ -113,7 +146,9 @@ def resize_short(src, size, interp=2):
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
-    out = src[y0:y0 + h, x0:x0 + w]
+    # crop on host: NDArray slicing would trace one XLA program per
+    # distinct crop shape
+    out = _like(_to_host(src)[y0:y0 + h, x0:x0 + w], src)
     if size is not None and (w, h) != size:
         out = imresize(out, size[0], size[1], interp)
     return out
@@ -157,13 +192,14 @@ def random_size_crop(src, size, area, ratio, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    arr = src.asnumpy().astype(_np.float32) if isinstance(src, ndarray.NDArray) \
-        else src.astype(_np.float32)
-    mean = _np.asarray(mean, dtype=_np.float32)
-    arr = arr - mean
+    """(src - mean) / std; either stat may be None (reference:
+    image.py color_normalize tolerates std-only / mean-only)."""
+    arr = _to_host(src).astype(_np.float32)
+    if mean is not None:
+        arr = arr - _np.asarray(mean, dtype=_np.float32)
     if std is not None:
         arr = arr / _np.asarray(std, dtype=_np.float32)
-    return ndarray.array(arr)
+    return _like(arr, src)
 
 
 # ------------------------------------------------------------- augmenters
@@ -236,7 +272,7 @@ class HorizontalFlipAug(Augmenter):
 
     def __call__(self, src):
         if _np.random.rand() < self.p:
-            return ndarray.array(src.asnumpy()[:, ::-1].copy())
+            return _like(_to_host(src)[:, ::-1].copy(), src)
         return src
 
 
@@ -246,7 +282,9 @@ class CastAug(Augmenter):
         self.typ = typ
 
     def __call__(self, src):
-        return src.astype(self.typ)
+        if isinstance(src, ndarray.NDArray):
+            return src.astype(self.typ)
+        return src.astype(self.typ, copy=False)
 
 
 class ColorNormalizeAug(Augmenter):
@@ -266,7 +304,7 @@ class BrightnessJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
-        return ndarray.array(src.asnumpy().astype(_np.float32) * alpha)
+        return _like(_to_host(src).astype(_np.float32) * alpha, src)
 
 
 class ContrastJitterAug(Augmenter):
@@ -278,9 +316,9 @@ class ContrastJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
-        arr = src.asnumpy().astype(_np.float32)
+        arr = _to_host(src).astype(_np.float32)
         gray = (arr * self._coef).sum() * (3.0 / arr.size)
-        return ndarray.array(arr * alpha + gray * (1.0 - alpha))
+        return _like(arr * alpha + gray * (1.0 - alpha), src)
 
 
 class SaturationJitterAug(Augmenter):
@@ -292,9 +330,9 @@ class SaturationJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
-        arr = src.asnumpy().astype(_np.float32)
+        arr = _to_host(src).astype(_np.float32)
         gray = (arr * self._coef).sum(axis=2, keepdims=True)
-        return ndarray.array(arr * alpha + gray * (1.0 - alpha))
+        return _like(arr * alpha + gray * (1.0 - alpha), src)
 
 
 class HueJitterAug(Augmenter):
@@ -313,8 +351,8 @@ class HueJitterAug(Augmenter):
         ityiq = _np.array([[1.0, 0.956, 0.621], [1.0, -0.272, -0.647],
                            [1.0, -1.107, 1.705]])
         t = _np.dot(_np.dot(ityiq, bt), tyiq).T
-        arr = src.asnumpy().astype(_np.float32)
-        return ndarray.array(_np.dot(arr, t).astype(_np.float32))
+        arr = _to_host(src).astype(_np.float32)
+        return _like(_np.dot(arr, t).astype(_np.float32), src)
 
 
 class LightingAug(Augmenter):
@@ -327,7 +365,7 @@ class LightingAug(Augmenter):
     def __call__(self, src):
         alpha = _np.random.normal(0, self.alphastd, size=(3,))
         rgb = _np.dot(self.eigvec * alpha, self.eigval)
-        return ndarray.array(src.asnumpy().astype(_np.float32) + rgb)
+        return _like(_to_host(src).astype(_np.float32) + rgb, src)
 
 
 class ColorJitterAug(Augmenter):
@@ -345,6 +383,25 @@ class ColorJitterAug(Augmenter):
     def __call__(self, src):
         for i in _np.random.permutation(len(self.augs)):
             src = self.augs[i](src)
+        return src
+
+
+class RandomGrayAug(Augmenter):
+    """Convert to 3-channel grayscale with probability p (reference:
+    image.py RandomGrayAug)."""
+
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], dtype=_np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            arr = _to_host(src).astype(_np.float32)
+            gray = (arr * self._coef).sum(axis=2, keepdims=True)
+            return _like(_np.broadcast_to(
+                gray, gray.shape[:2] + (3,)).copy(), src)
         return src
 
 
@@ -404,6 +461,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:
         std = _np.array([58.395, 57.12, 57.375])
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is not None and len(_np.atleast_1d(mean)) > 0:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
@@ -517,11 +576,12 @@ class ImageIter(_io.DataIter):
         try:
             while i < self.batch_size:
                 label, buf = self.next_sample()
-                img = imdecode(buf)
+                # whole chain on host numpy; _HostArray keeps the
+                # NDArray read surface for user-supplied augmenters
+                img = _imdecode_np(buf).view(_HostArray)
                 for aug in self.auglist:
                     img = aug(img)
-                arr = img.asnumpy()
-                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_data[i] = _to_host(img).transpose(2, 0, 1)
                 batch_label[i] = _np.atleast_1d(label)[:self.label_width]
                 i += 1
         except StopIteration:
@@ -533,3 +593,16 @@ class ImageIter(_io.DataIter):
             data=[ndarray.array(batch_data)],
             label=[ndarray.array(batch_label)],
             pad=self.batch_size - i)
+
+
+# detection-aware augmenters + ImageDetIter live in image_detection.py;
+# surfaced here to match the reference's mx.image namespace
+from .image_detection import (  # noqa: E402
+    CreateDetAugmenter, CreateMultiRandCropAugmenter, DetAugmenter,
+    DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    DetRandomSelectAug, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+            "ImageDetIter"]

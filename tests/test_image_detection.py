@@ -1,0 +1,314 @@
+"""Detection data path: DetAugmenters, ImageDetIter, im2rec --pack-label
+(reference: python/mxnet/image/detection.py, tests/python/unittest
+test_image.py TestImageDetIter sections)."""
+
+import importlib.util
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import ndarray as nd  # noqa: E402
+from mxnet_tpu.image import (CreateDetAugmenter, DetBorrowAug,  # noqa: E402
+                             DetHorizontalFlipAug, DetRandomCropAug,
+                             DetRandomPadAug, DetRandomSelectAug,
+                             HorizontalFlipAug, ImageDetIter)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _im2rec():
+    spec = importlib.util.spec_from_file_location(
+        "im2rec_tool", os.path.join(REPO, "tools", "im2rec.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_det_dataset(tmp_path, scenes, prefix="train"):
+    """scenes: list of (HWC uint8 image, [[cls,x1,y1,x2,y2], ...])."""
+    from PIL import Image
+
+    root = tmp_path / (prefix + "_img")
+    root.mkdir(exist_ok=True)
+    lst_path = tmp_path / (prefix + ".lst")
+    with open(lst_path, "w") as f:
+        for i, (img, rows) in enumerate(scenes):
+            fname = "s%04d.png" % i  # png: lossless, exact pixel checks
+            Image.fromarray(img).save(root / fname)
+            flat = [2, 5]
+            for r in rows:
+                flat.extend(r)
+            f.write("%d\t%s\t%s\n"
+                    % (i, "\t".join("%.6f" % v for v in flat), fname))
+    _im2rec().main([str(tmp_path / prefix), str(root), "--pack-label",
+                    "--quality", "100"])
+    return str(tmp_path / (prefix + ".rec"))
+
+
+def _scene(hw=32, boxes=((0, 0.25, 0.25, 0.75, 0.75),)):
+    img = np.zeros((hw, hw, 3), np.uint8)
+    rows = []
+    for cls, x1, y1, x2, y2 in boxes:
+        img[int(y1 * hw):int(y2 * hw), int(x1 * hw):int(x2 * hw),
+            int(cls) % 3] = 200
+        rows.append([cls, x1, y1, x2, y2])
+    return img, rows
+
+
+# ------------------------------------------------------- wire format
+
+
+def test_label_wire_roundtrip(tmp_path):
+    """Pins the packed label layout: [2, 5, cls, x1, y1, x2, y2, ...]
+    through im2rec --pack-label -> .rec -> ImageDetIter batches."""
+    scenes = [
+        _scene(boxes=[(0, 0.25, 0.25, 0.75, 0.75)]),
+        _scene(boxes=[(1, 0.0, 0.0, 0.5, 0.5), (2, 0.5, 0.5, 1.0, 1.0)]),
+        _scene(boxes=[(2, 0.125, 0.25, 0.5, 0.875)]),
+    ]
+    rec = _write_det_dataset(tmp_path, scenes)
+    it = ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                      path_imgrec=rec)  # no random augs by default
+    # label shape = epoch max objects (2) x obj width (5)
+    assert it.label_shape == (2, 5)
+    assert it.provide_label[0].shape == (3, 2, 5)
+    batch = next(iter(it))
+    label = batch.label[0].asnumpy()
+    assert label.shape == (3, 2, 5)
+    for i, (_, rows) in enumerate(scenes):
+        got = label[i]
+        for j, row in enumerate(rows):
+            np.testing.assert_allclose(got[j], row, atol=1e-5)
+        for j in range(len(rows), 2):
+            assert (got[j] == -1).all()  # -1 row padding
+    # data went through force-resize + cast, stays CHW float
+    assert batch.data[0].shape == (3, 3, 32, 32)
+
+
+def test_parse_label_rejects_garbage(tmp_path):
+    scenes = [_scene()]
+    rec = _write_det_dataset(tmp_path, scenes)
+    it = ImageDetIter(batch_size=1, data_shape=(3, 32, 32), path_imgrec=rec)
+    with pytest.raises(RuntimeError):
+        it._parse_label(np.array([2.0, 5.0, 0.0]))  # too short
+    with pytest.raises(RuntimeError):
+        # size - header not divisible by obj_width
+        it._parse_label(np.array([2.0, 5.0, 0, 0.1, 0.1, 0.9, 0.9, 1.0]))
+    with pytest.raises(RuntimeError):
+        # only degenerate boxes
+        it._parse_label(np.array([2.0, 5.0, 0, 0.5, 0.5, 0.5, 0.5]))
+
+
+# ------------------------------------------------------- augmenters
+
+
+def test_horizontal_flip_flips_boxes():
+    random.seed(0)
+    img, rows = _scene(boxes=[(1, 0.125, 0.25, 0.5, 0.75)])
+    label = np.array(rows, np.float32)
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, out_label = aug(nd.array(img), label)
+    np.testing.assert_allclose(out.asnumpy(), img[:, ::-1])
+    np.testing.assert_allclose(out_label[0], [1, 0.5, 0.25, 0.875, 0.75],
+                               atol=1e-6)
+    # flipping twice restores the original
+    out2, out_label2 = aug(out, out_label)
+    np.testing.assert_allclose(out2.asnumpy(), img)
+    np.testing.assert_allclose(out_label2, label, atol=1e-6)
+
+
+def test_random_crop_respects_constraints():
+    random.seed(3)
+    img, rows = _scene(hw=64, boxes=[(0, 0.3, 0.3, 0.7, 0.7)])
+    label = np.array(rows, np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.8, area_range=(0.3, 0.9),
+                           min_eject_coverage=0.3, max_attempts=200)
+    hits = 0
+    for _ in range(30):
+        out, out_label = aug(nd.array(img), label)
+        assert out_label.shape[1] == 5
+        # surviving boxes are valid and normalized
+        assert (out_label[:, 3] > out_label[:, 1]).all()
+        assert (out_label[:, 4] > out_label[:, 2]).all()
+        assert (out_label[:, 1:5] >= 0).all() and (out_label[:, 1:5] <= 1).all()
+        if out.shape[:2] != img.shape[:2]:
+            hits += 1
+            # cropped area within the requested range
+            frac = (out.shape[0] * out.shape[1]) / float(64 * 64)
+            assert 0.25 <= frac <= 0.95  # rounding slack around (0.3, 0.9)
+            # the object survives: its pixels are in the crop
+            arr = out.asnumpy()
+            assert (arr[:, :, 0] == 200).any()
+    assert hits > 0  # the crop actually fired
+
+
+def test_random_crop_ejects_uncovered_objects():
+    """A crop window covering only one of two distant objects must drop
+    the other from the label."""
+    random.seed(11)
+    img, rows = _scene(hw=64, boxes=[(0, 0.05, 0.05, 0.3, 0.3),
+                                     (1, 0.7, 0.7, 0.95, 0.95)])
+    label = np.array(rows, np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.9, area_range=(0.1, 0.2),
+                           min_eject_coverage=0.5, max_attempts=500)
+    saw_single = False
+    for _ in range(50):
+        _, out_label = aug(nd.array(img), label)
+        if out_label.shape[0] == 1:
+            saw_single = True
+            break
+    assert saw_single
+
+
+def test_random_pad_shrinks_boxes():
+    random.seed(5)
+    img, rows = _scene(hw=32, boxes=[(2, 0.25, 0.25, 0.75, 0.75)])
+    label = np.array(rows, np.float32)
+    aug = DetRandomPadAug(area_range=(2.0, 3.0), max_attempts=100,
+                          pad_val=(7, 7, 7))
+    out, out_label = aug(nd.array(img), label)
+    assert out.shape[0] >= 32 and out.shape[1] >= 32
+    assert out.shape[0] * out.shape[1] > 32 * 32  # actually padded
+    # the box shrank in normalized coords but describes the same pixels
+    ow = (out_label[0, 3] - out_label[0, 1]) * out.shape[1]
+    oh = (out_label[0, 4] - out_label[0, 2]) * out.shape[0]
+    np.testing.assert_allclose([ow, oh], [16, 16], atol=1.0)
+    # pad pixels carry pad_val
+    arr = out.asnumpy()
+    assert (arr == 7).any()
+
+
+def test_borrow_and_select():
+    img, rows = _scene()
+    label = np.array(rows, np.float32)
+    borrow = DetBorrowAug(HorizontalFlipAug(0.0))  # p=0: identity
+    out, out_label = borrow(nd.array(img), label)
+    np.testing.assert_allclose(out.asnumpy(), img)
+    np.testing.assert_allclose(out_label, label)
+    assert isinstance(borrow.dumps(), list)
+    with pytest.raises(TypeError):
+        DetBorrowAug("not an augmenter")
+    with pytest.raises(ValueError):
+        DetRandomSelectAug(["nope"])
+    sel = DetRandomSelectAug([borrow], skip_prob=1.0)
+    out, _ = sel(nd.array(img), label)
+    np.testing.assert_allclose(out.asnumpy(), img)
+
+
+def test_create_det_augmenter_stack():
+    augs = CreateDetAugmenter((3, 64, 64), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True,
+                              brightness=0.1, contrast=0.1, saturation=0.1,
+                              hue=0.1, pca_noise=0.05, rand_gray=0.1)
+    kinds = [type(a).__name__ for a in augs]
+    # geometry (select/flip) before the force-resize, photometrics after
+    assert "DetRandomSelectAug" in kinds
+    assert "DetHorizontalFlipAug" in kinds
+    assert kinds.count("DetBorrowAug") >= 5
+    for a in augs:
+        a.dumps()  # all serializable
+    # runs end to end on a sample
+    random.seed(0)
+    img, rows = _scene(hw=48)
+    out, out_label = img, np.array(rows, np.float32)
+    out = nd.array(out)
+    for a in augs:
+        out, out_label = a(out, out_label)
+    assert tuple(out.shape[:2]) == (64, 64)
+    assert out_label.shape[1] == 5
+
+
+def test_std_only_normalize_is_finite(tmp_path):
+    """std without mean must not NaN the batch (color_normalize
+    tolerates either stat being None)."""
+    rec = _write_det_dataset(tmp_path, [_scene()], "stdonly")
+    it = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                      path_imgrec=rec, std=True)
+    data = next(iter(it)).data[0].asnumpy()
+    assert np.isfinite(data).all()
+    assert data.max() > 0
+
+
+def test_user_augmenter_ndarray_contract(tmp_path):
+    """User augmenters written against the NDArray contract (calling
+    .asnumpy()) keep working on the host-numpy fast path."""
+    from mxnet_tpu.image import Augmenter, ImageIter
+    from mxnet_tpu.image_detection import DetAugmenter
+
+    calls = []
+
+    class MyAug(Augmenter):
+        def __call__(self, src):
+            calls.append(src.asnumpy().shape)
+            return src
+
+    class MyDetAug(DetAugmenter):
+        def __call__(self, src, label):
+            calls.append(src.asnumpy().shape)
+            return src, label
+
+    rec = _write_det_dataset(tmp_path, [_scene()], "user")
+    it = ImageIter(batch_size=1, data_shape=(3, 32, 32), path_imgrec=rec,
+                   label_width=7, aug_list=[MyAug()])
+    next(iter(it))
+    det_it = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                          path_imgrec=rec, aug_list=[MyDetAug()])
+    next(iter(det_it))
+    assert calls == [(32, 32, 3), (32, 32, 3)]
+
+
+# ------------------------------------------------------- iterator API
+
+
+def test_reshape_and_sync_label_shape(tmp_path):
+    rec_a = _write_det_dataset(
+        tmp_path, [_scene(boxes=[(0, 0.1, 0.1, 0.6, 0.6)])], "a")
+    rec_b = _write_det_dataset(
+        tmp_path, [_scene(boxes=[(0, 0.0, 0.0, 0.4, 0.4),
+                                 (1, 0.5, 0.5, 0.9, 0.9)])], "b")
+    it_a = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                        path_imgrec=rec_a)
+    it_b = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                        path_imgrec=rec_b)
+    assert it_a.label_shape == (1, 5) and it_b.label_shape == (2, 5)
+    it_b2 = it_a.sync_label_shape(it_b)
+    assert it_a.label_shape == (2, 5) and it_b2.label_shape == (2, 5)
+    batch = next(iter(it_a))
+    assert batch.label[0].shape == (1, 2, 5)
+    with pytest.raises(ValueError):
+        it_a.reshape(label_shape=(1, 5))  # cannot shrink
+    with pytest.raises(ValueError):
+        it_a.reshape(label_shape=(3, 6))  # width mismatch
+    it_a.reshape(data_shape=(3, 16, 16))
+    it_a.reset()
+    batch = next(iter(it_a))
+    assert batch.data[0].shape == (1, 3, 16, 16)
+
+
+def test_det_iter_augmented_epoch(tmp_path):
+    """A full epoch through the default SSD-style augmentation chain
+    keeps every batch shape static and every label row valid."""
+    random.seed(0)
+    scenes = [_scene(hw=40, boxes=[(i % 3, 0.2, 0.2, 0.8, 0.8)])
+              for i in range(8)]
+    rec = _write_det_dataset(tmp_path, scenes)
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32), path_imgrec=rec,
+                      rand_crop=0.5, rand_pad=0.5, rand_mirror=True,
+                      shuffle=True, mean=True, std=True)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert batch.label[0].shape == (4,) + it.label_shape
+        lab = batch.label[0].asnumpy()
+        real = lab[lab[:, :, 0] >= 0]
+        assert len(real)  # every image kept at least one object
+        assert (real[:, 3] > real[:, 1]).all()
+        assert (real[:, 4] > real[:, 2]).all()
+        n += 1
+    assert n == 2

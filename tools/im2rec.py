@@ -54,13 +54,21 @@ def make_list(args):
             print("wrote", path, len(items), "items")
 
 
-def read_list(path):
+def read_list(path, pack_label=False):
+    """Yield (index, label, relpath) from a .lst file.  With pack_label
+    the label is the full float vector of the middle columns (detection
+    lists carry [header_width, obj_width, header..., objects...] there);
+    otherwise it is the single scalar in column 1."""
     with open(path) as f:
         for line in f:
             parts = line.strip().split("\t")
             if len(parts) < 3:
                 continue
-            yield int(parts[0]), float(parts[1]), parts[-1]
+            if pack_label:
+                label = np.array(parts[1:-1], dtype=np.float32)
+            else:
+                label = float(parts[1])
+            yield int(parts[0]), label, parts[-1]
 
 
 def im2rec(args):
@@ -71,7 +79,7 @@ def im2rec(args):
     lst = args.prefix + ".lst"
     rec = MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
     n = 0
-    for idx, label, rel in read_list(lst):
+    for idx, label, rel in read_list(lst, pack_label=args.pack_label):
         img = Image.open(os.path.join(args.root, rel)).convert("RGB")
         if args.resize:
             w, h = img.size
@@ -96,6 +104,9 @@ def main(argv=None):
     parser.add_argument("--train-ratio", type=float, default=1.0)
     parser.add_argument("--resize", type=int, default=0)
     parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--pack-label", action="store_true",
+                        help="pack the full multi-column .lst label vector "
+                             "(detection lists) instead of a scalar")
     args = parser.parse_args(argv)
     if args.list:
         make_list(args)
