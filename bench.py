@@ -9,18 +9,51 @@ CLI to measure other configs (256 is this chip's throughput peak).
 The whole train step (fwd+bwd+SGD momentum+BN stat update) is one
 jitted XLA computation (parallel/gluon_step.py); compute in bfloat16
 with fp32 master weights (MXU-native mixed precision, the analog of the
-reference's multi-precision SGD).
+reference's multi-precision SGD).  The model runs channel-last
+(layout="NHWC"): measured faster than NCHW on this chip because the
+layout maps directly onto MXU tiling with fewer HBM relayout bytes
+(tools/bench_layout_experiment.py; BENCH_NOTES).  Pass a third CLI arg
+"NCHW" to measure the reference-layout path.
+
+Throughput is the median of 3 timed reps (each `steps` steps).  A
+regression gate compares against the newest recorded BENCH_r*.json and
+exits non-zero on a >10% drop, so a real regression fails the round
+instead of being silently recorded.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Usage: python bench.py [batch] [steps] [NHWC|NCHW]
 """
 
+import glob
 import json
+import os
+import statistics
 import sys
 import time
 
 import numpy as np
 
 BASELINE_IMG_S = 363.69  # ResNet-50 training bs=128, V100 fp32 (docs/faq/perf.md)
+REGRESSION_TOLERANCE = 0.10
+
+
+def prior_round_value():
+    """Newest recorded driver bench (file, value, metric), if any round
+    ran before."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    newest = None
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            value = rec.get("parsed", {}).get("value")
+            if value:
+                newest = (os.path.basename(path), float(value),
+                          rec["parsed"].get("metric", ""))
+        except (OSError, ValueError):
+            continue
+    return newest
 
 
 def main():
@@ -34,21 +67,25 @@ def main():
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    layout = sys.argv[3] if len(sys.argv) > 3 else "NHWC"
 
     devices = jax.devices()[:1]  # single-chip benchmark
     mesh = create_mesh({"dp": 1}, devices=devices)
 
-    net = vision.resnet50_v1()
+    net = vision.resnet50_v1(layout=layout)
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    probe_shape = (1, 3, 32, 32) if layout == "NCHW" else (1, 32, 32, 3)
     with ctx:
         net.initialize(ctx=ctx)
-        net(mx.nd.zeros((1, 3, 32, 32), ctx=ctx))  # resolve deferred shapes
+        net(mx.nd.zeros(probe_shape, ctx=ctx))  # resolve deferred shapes
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9,
                           wd=1e-4, compute_dtype="bfloat16")
 
     rng = np.random.RandomState(0)
-    x = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    data_shape = (batch, 3, 224, 224) if layout == "NCHW" \
+        else (batch, 224, 224, 3)
+    x = rng.rand(*data_shape).astype(np.float32)
     y = rng.randint(0, 1000, (batch,)).astype(np.int32)
     x, y = step.put_batch(x, y)  # device-resident synthetic batch
 
@@ -58,20 +95,32 @@ def main():
         l = step(x, y)
     float(np.asarray(l))
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        l = step(x, y)
-    float(np.asarray(l))
-    dt = time.perf_counter() - t0
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = step(x, y)
+        float(np.asarray(l))
+        rates.append(steps * batch / (time.perf_counter() - t0))
+    img_s = statistics.median(rates)
 
-    img_s = steps * batch / dt
     print(json.dumps({
-        "metric": "resnet50_v1 training img/s (bs=%d, bf16 compute, 1 chip)"
-                  % batch,
+        "metric": "resnet50_v1 training img/s (bs=%d, bf16 compute, %s, "
+                  "1 chip, median of 3)" % (batch, layout),
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }))
+
+    prior = prior_round_value()
+    # only gate like-for-like: a `bench.py 32` exploration run must not
+    # trip against the recorded bs=128 headline
+    comparable = prior is not None and ("(bs=%d" % batch) in prior[2]
+    if comparable and img_s < (1.0 - REGRESSION_TOLERANCE) * prior[1]:
+        print("REGRESSION: %.1f img/s is >%d%% below %s (%.1f img/s)"
+              % (img_s, int(REGRESSION_TOLERANCE * 100), prior[0], prior[1]),
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

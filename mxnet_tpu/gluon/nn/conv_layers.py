@@ -182,6 +182,8 @@ class _Pooling(HybridBlock):
             "pad": _tup(padding, nd), "global_pool": global_pool,
             "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 

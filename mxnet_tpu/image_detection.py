@@ -151,10 +151,13 @@ class DetHorizontalFlipAug(DetAugmenter):
 
 
 class DetRandomCropAug(DetAugmenter):
-    """Constrained random crop: the crop must cover at least
-    min_object_covered of some object, and objects keeping less than
-    min_eject_coverage of their area are dropped from the label
-    (reference: detection.py DetRandomCropAug).
+    """Constrained random crop (reference: detection.py
+    DetRandomCropAug): a proposal is accepted when EVERY object it
+    overlaps keeps more than min_object_covered of its area (the
+    reference's np.amin over positive coverages — overlap-a-sliver
+    proposals are rejected rather than silently eating an object), and
+    after the crop, objects keeping less than min_eject_coverage of
+    their area are dropped from the label.
 
     Proposal sampling is re-designed: instead of the reference's
     height-first search we sample a target area uniformly in area_range
@@ -456,17 +459,26 @@ class ImageDetIter(ImageIter):
 
     def _scan_label_shape(self):
         """One pass over the epoch to find the max object count — the
-        static label shape (reference: _estimate_label_shape)."""
+        static label shape (reference: _estimate_label_shape).  Samples
+        with unparsable labels are skipped, matching next()'s skip
+        behavior (the reference crashes here; tolerating stragglers at
+        both sites is strictly more useful)."""
         max_objs, width = 0, 5
         self.reset()
         try:
             while True:
                 raw, _ = self.next_sample()
-                rows = self._parse_label(raw)
+                try:
+                    rows = self._parse_label(raw)
+                except RuntimeError as e:
+                    logging.debug("label scan skipping bad sample: %s", e)
+                    continue
                 max_objs = max(max_objs, rows.shape[0])
                 width = rows.shape[1]
         except StopIteration:
             pass
+        if max_objs == 0:
+            raise RuntimeError("no sample carries a valid detection label")
         self.reset()
         return (max_objs, width)
 

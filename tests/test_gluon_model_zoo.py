@@ -45,3 +45,48 @@ def test_resnet50_structure():
                    for p in net.collect_params().values())
     # ~25.6M params at 1000 classes; at 13 classes fc shrinks
     assert 23_000_000 < n_params < 26_000_000
+
+
+def test_resnet_nhwc_matches_nchw():
+    """layout="NHWC" (TPU-fast channel-last option) computes the same
+    function as the reference-layout NCHW net once conv weights are
+    relaid OIHW->OHWI."""
+    for ctor in (vision.resnet18_v1, vision.resnet18_v2):
+        a = ctor(classes=5)
+        b = ctor(classes=5, layout="NHWC")
+        a.initialize()
+        b.initialize()
+        x = mx.nd.array(np.random.rand(2, 3, 32, 32).astype("float32"))
+        x_cl = mx.nd.array(x.asnumpy().transpose(0, 2, 3, 1))
+        a(x)
+        b(x_cl)  # resolve deferred shapes
+        pa, pb = a.collect_params(), b.collect_params()
+        for ka, kb in zip(sorted(pa.keys()), sorted(pb.keys())):
+            w = pa[ka].data().asnumpy()
+            tgt = tuple(pb[kb].data().shape)
+            if w.ndim == 4 and w.shape != tgt:
+                w = w.transpose(0, 2, 3, 1)  # OIHW -> OHWI
+            assert w.shape == tgt, (ka, kb, w.shape, tgt)
+            pb[kb].set_data(mx.nd.array(w))
+        assert_almost_equal(a(x).asnumpy(), b(x_cl).asnumpy(),
+                            rtol=1e-3, atol=1e-4)
+
+
+def test_pooling_layer_honors_nhwc():
+    """Gluon pooling layers pass layout through to the op (a dropped
+    layout here silently pools the wrong axes)."""
+    from mxnet_tpu.gluon import nn
+
+    x = np.random.rand(2, 8, 8, 4).astype("float32")
+    pool = nn.MaxPool2D(2, 2, layout="NHWC")
+    pool.initialize()
+    out = pool(mx.nd.array(x))
+    assert out.shape == (2, 4, 4, 4)
+    ref = x.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6, atol=1e-6)
+    gap = nn.GlobalAvgPool2D(layout="NHWC")
+    gap.initialize()
+    out = gap(mx.nd.array(x))
+    assert out.shape == (2, 1, 1, 4)
+    assert_almost_equal(out.asnumpy().reshape(2, 4), x.mean(axis=(1, 2)),
+                        rtol=1e-5, atol=1e-6)
