@@ -115,7 +115,7 @@ def main():
     prior = prior_round_value()
     # only gate like-for-like: a `bench.py 32` exploration run must not
     # trip against the recorded bs=128 headline
-    comparable = prior is not None and ("(bs=%d" % batch) in prior[2]
+    comparable = prior is not None and ("(bs=%d," % batch) in prior[2]
     if comparable and img_s < (1.0 - REGRESSION_TOLERANCE) * prior[1]:
         print("REGRESSION: %.1f img/s is >%d%% below %s (%.1f img/s)"
               % (img_s, int(REGRESSION_TOLERANCE * 100), prior[0], prior[1]),

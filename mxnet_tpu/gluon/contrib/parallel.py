@@ -1,0 +1,285 @@
+"""Gluon-level pipeline and expert parallelism.
+
+Framework API over the jax-level schedules in parallel/pp.py (GPipe
+ring over the 'pp' mesh axis) and parallel/moe.py (GShard top-2 routing
+over 'ep').  The reference has neither (SURVEY.md §2.3: PP/EP absent in
+MXNet; its closest capability is manual group2ctx model parallelism,
+src/executor/graph_executor.cc:1628) — these make both reachable from
+ordinary Gluon models driven by GluonTrainStep, the same way dp/tp are.
+
+    stages = [make_transformer_block() for _ in range(4)]
+    for s in stages:
+        s.initialize()
+        s(probe)                       # resolve deferred shapes
+    net = nn.HybridSequential()
+    net.add(embed, PipelineBlock(stages), head)
+    ...
+    step = GluonTrainStep(net, loss, mesh=mesh,
+                          param_spec_fn=param_spec_fn_for(net))
+
+    moe = MoE(d_model=64, d_hidden=256, n_experts=8)   # a Gluon Block
+    # anywhere in a model; add collect_moe_aux(net) to the task loss
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import initializer as _init
+from ...ndarray import NDArray, array as _nd_array
+from ..block import Block, _StagingScope
+from ..parameter import param_override
+
+__all__ = ["PipelineBlock", "MoE", "collect_moe_aux", "param_spec_fn_for"]
+
+
+class PipelineBlock(Block):
+    """Run a stack of architecturally-identical Gluon stages as a GPipe
+    pipeline over the 'pp' mesh axis.
+
+    Construction consumes the (already initialized) per-stage blocks:
+    their parameter values are stacked into this block's own Parameters
+    with a leading stage axis, which is what makes per-stage placement
+    expressible as a sharding (PartitionSpec('pp', ...) on dim 0) —
+    separate per-stage arrays cannot be pinned to single mesh ranks.
+
+    Without a mesh (or on a mesh whose 'pp' axis is 1) the block runs
+    the stages sequentially — identical math, so models build and debug
+    single-device and shard by calling ``attach_mesh``.
+
+    Stages must be shape-homogeneous (activation in == activation out),
+    and, in pipelined mode, must not update aux state (BatchNorm
+    running stats) — the standard stacked-transformer-block case.
+    """
+
+    def __init__(self, stages, n_microbatches=None, axis="pp", **kwargs):
+        super().__init__(**kwargs)
+        if not stages:
+            raise ValueError("PipelineBlock needs at least one stage")
+        self._n_stages = len(stages)
+        self._axis = axis
+        self._n_micro = n_microbatches
+        self._gpipe = None
+        self._mesh = None
+        # held outside __setattr__ registration: the template provides
+        # the stage computation; its own params are shadowed by
+        # param_override on every call
+        self.__dict__["_template"] = stages[0]
+
+        tmpl = stages[0]._collect_params_with_prefix()
+        names = sorted(tmpl)
+        for s in stages[1:]:
+            if sorted(s._collect_params_with_prefix()) != names:
+                raise ValueError("pipeline stages must share one "
+                                 "parameter structure")
+        self.__dict__["_tmpl_params"] = {}
+        self._safe_names = []
+        for name in names:
+            p0 = tmpl[name]
+            if p0._data is None:
+                raise ValueError(
+                    "stage parameter %s is uninitialized — initialize() "
+                    "each stage (and run a probe batch if shapes are "
+                    "deferred) before building the PipelineBlock" % name)
+            stacked = _np.stack(
+                [s._collect_params_with_prefix()[name].data().asnumpy()
+                 for s in stages])
+            safe = "stage_" + name.replace(".", "_")
+            param = self.params.get(safe, shape=stacked.shape,
+                                    dtype=p0.dtype)
+            setattr(self, safe, param)     # registers in _reg_params
+            param.initialize(init=_init.Constant(0))
+            param.set_data(_nd_array(stacked))
+            self._safe_names.append(safe)
+            self._tmpl_params[safe] = p0
+
+    # -- mesh plumbing
+
+    def attach_mesh(self, mesh, n_microbatches=None):
+        """Enable the GPipe schedule on ``mesh`` (its '{axis}' size must
+        equal the stage count); pass mesh=None to fall back to
+        sequential execution."""
+        if mesh is None or mesh.shape.get(self._axis, 1) == 1:
+            self._mesh, self._gpipe = None, None
+            return self
+        if mesh.shape[self._axis] != self._n_stages:
+            raise ValueError("mesh %s axis size %d != %d stages"
+                             % (self._axis, mesh.shape[self._axis],
+                                self._n_stages))
+        from ...parallel.pp import GPipe
+
+        self._mesh = mesh
+        self._gpipe = GPipe(self._jax_stage_fn, mesh,
+                            n_microbatches or self._n_micro,
+                            axis=self._axis)
+        return self
+
+    def param_spec(self, name, shape):
+        """PartitionSpec for one of this block's stacked params (dim 0
+        over the pp axis), or None for foreign params."""
+        from jax.sharding import PartitionSpec as P
+
+        if name in {self._reg_params[s].name for s in self._safe_names}:
+            return P(self._axis, *([None] * (len(shape) - 1)))
+        return None
+
+    # -- execution
+
+    def _override_for(self, sliced):
+        return {self._tmpl_params[s]: v for s, v in sliced.items()}
+
+    def _jax_stage_fn(self, tree, x):
+        """One stage applied functionally (runs per-rank inside
+        shard_map; tree = this rank's stage slice)."""
+        override = {self._tmpl_params[s]: NDArray(v)
+                    for s, v in tree.items()}
+        scope = _StagingScope()
+        with param_override(override), scope:
+            y = self._template(NDArray(x))
+        if scope.aux_updates:
+            raise RuntimeError(
+                "pipeline stages must not update aux state (BatchNorm "
+                "running stats) in pipelined mode; freeze the stats or "
+                "use LayerNorm")
+        return y._data
+
+    def forward(self, x):
+        stacked = {s: self._reg_params[s].data() for s in self._safe_names}
+        if self._gpipe is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # place onto the mesh shardings shard_map expects: a no-op
+            # when GluonTrainStep already sharded the params over 'pp',
+            # and the eager-call migration path otherwise
+            tree = {
+                s: jax.device_put(
+                    v._data,
+                    NamedSharding(self._mesh, P(
+                        self._axis, *([None] * (v._data.ndim - 1)))))
+                for s, v in stacked.items()}
+            xj = jax.device_put(x._data, NamedSharding(self._mesh, P()))
+            return NDArray(self._gpipe(tree, xj))
+        # sequential fallback: same math, one stage after another.  Aux
+        # updates are rejected here too — they would key on the shadowed
+        # template parameter, not the stacked per-stage Parameters, so
+        # silently dropping them would corrupt BatchNorm stats the
+        # moment the model switched to inference
+        for i in range(self._n_stages):
+            override = self._override_for(
+                {s: NDArray(v._data[i]) for s, v in stacked.items()})
+            scope = _StagingScope()
+            with param_override(override), scope:
+                x = self._template(x)
+            if scope.aux_updates:
+                raise RuntimeError(
+                    "pipeline stages must not update aux state (BatchNorm "
+                    "running stats); freeze the stats or use LayerNorm")
+        return x
+
+
+class MoE(Block):
+    """Drop-in mixture-of-experts feed-forward Gluon block (GShard top-2
+    routing with fixed capacity; parallel/moe.py MoEFFN underneath).
+
+    Input (B, S, d_model) -> output (B, S, d_model).  The expert axis of
+    ``wi``/``wo`` shards over the 'ep' mesh axis via ``param_spec``;
+    GSPMD inserts the dispatch/combine all-to-alls.  After each forward,
+    ``aux_loss`` holds the load-balancing loss — add
+    ``collect_moe_aux(net)`` (times a small factor) to the task loss.
+    """
+
+    def __init__(self, d_model, d_hidden, n_experts, capacity_factor=1.25,
+                 axis="ep", **kwargs):
+        super().__init__(**kwargs)
+        from ...parallel.moe import MoEFFN
+
+        self.__dict__["_ffn"] = MoEFFN(d_model, d_hidden, n_experts,
+                                       capacity_factor=capacity_factor,
+                                       axis=axis)
+        self._axis = axis
+        s1 = (2.0 / (d_model + d_hidden)) ** 0.5
+        with self.name_scope():
+            # *_weight suffixes route the name-dispatched initializer
+            # to its weight filler (initializer.py Initializer.__call__)
+            self.gate = self.params.get(
+                "gate_weight", shape=(d_model, n_experts),
+                init=_init.Normal((1.0 / d_model) ** 0.5))
+            self.wi = self.params.get(
+                "wi_weight", shape=(n_experts, d_model, d_hidden),
+                init=_init.Normal(s1))
+            self.wo = self.params.get(
+                "wo_weight", shape=(n_experts, d_hidden, d_model),
+                init=_init.Normal(s1))
+        self._last_aux = None
+
+    def param_spec(self, name, shape):
+        from jax.sharding import PartitionSpec as P
+
+        if name == self.wi.name or name == self.wo.name:
+            return P(self._axis, *([None] * (len(shape) - 1)))
+        if name == self.gate.name:
+            return P()
+        return None
+
+    @property
+    def aux_loss(self):
+        """Load-balancing aux loss from the most recent forward.
+
+        Trace-local: read it inside the same staged step the forward
+        ran in (that is what ``collect_moe_aux`` does when the loss
+        block calls it), or after an eager forward.  Reading it after a
+        jitted GluonTrainStep call hands back a dead tracer and jax
+        raises its leaked-tracer error on use — log the balancing loss
+        by returning it from the loss instead.
+        """
+        if self._last_aux is None:
+            raise RuntimeError("MoE.aux_loss read before any forward")
+        return self._last_aux
+
+    def forward(self, x):
+        y, aux = self._ffn.apply(
+            {"gate": self.gate.data()._data, "wi": self.wi.data()._data,
+             "wo": self.wo.data()._data}, x._data)
+        self._last_aux = NDArray(aux)
+        return NDArray(y)
+
+
+def collect_moe_aux(block):
+    """Sum aux_loss over every MoE in a block tree (call after the
+    forward, inside the same autograd/staging scope)."""
+    total = None
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        if isinstance(b, MoE):
+            aux = b.aux_loss
+            total = aux if total is None else total + aux
+        stack.extend(b._children.values())
+    if total is None:
+        raise ValueError("no MoE blocks found under %r" % (block,))
+    return total
+
+
+def param_spec_fn_for(net, default=None):
+    """Build a GluonTrainStep ``param_spec_fn`` by asking every block in
+    the tree that exposes ``param_spec`` (PipelineBlock: 'pp', MoE:
+    'ep'); everything else gets ``default`` (replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    providers = []
+    stack = [net]
+    while stack:
+        b = stack.pop()
+        if hasattr(b, "param_spec"):
+            providers.append(b)
+        stack.extend(b._children.values())
+
+    def spec_fn(name, shape):
+        for p in providers:
+            spec = p.param_spec(name, shape)
+            if spec is not None:
+                return spec
+        return default if default is not None else P()
+
+    return spec_fn
