@@ -84,7 +84,14 @@ class PipelineBlock(Block):
             stacked = _np.stack(
                 [s._collect_params_with_prefix()[name].data().asnumpy()
                  for s in stages])
-            safe = "stage_" + name.replace(".", "_")
+            safe = "stage_" + name.replace(".", "__")
+            if safe in self._tmpl_params:
+                # '__'-escaping is not injective against names that
+                # already contain '__'; refuse rather than silently
+                # dropping a parameter from the override map
+                raise ValueError(
+                    "stage parameter names %r collide after mangling; "
+                    "rename the layer" % name)
             param = self.params.get(safe, shape=stacked.shape,
                                     dtype=p0.dtype)
             setattr(self, safe, param)     # registers in _reg_params

@@ -3,14 +3,15 @@
 Reference: include/mxnet/ndarray.h:61 (NDArrayStorageType),
 python/mxnet/ndarray/sparse.py, src/operator/tensor/cast_storage-inl.h.
 
-TPU-native stance: XLA has no first-class sparse buffers; row_sparse is
-a REAL (indices, values) pair on device — the dense view is LAZY and
+TPU-native stance: XLA has no first-class sparse buffers; both storage
+types are REAL device aux-array tuples with a LAZY dense view that
 materializes only when a dense consumer touches it (XLA scatter at that
-boundary).  The embedding-scale flows the type exists for (reference:
-kvstore_dist.h:470 PullRowSparse; lazy optimizer rows) run entirely on
-the (indices, values) pair, so a gradient over a 10M-row table costs
-memory proportional to the touched rows, not the table.  CSR keeps the
-r1 dense-backed layout (its reference uses are small matrices).
+boundary).  row_sparse is an (indices, values) pair — the embedding-
+scale flows it exists for (reference: kvstore_dist.h:470 PullRowSparse;
+lazy optimizer rows) cost memory proportional to the touched rows.
+CSR (r3) is a (data, indices, indptr) triple; `sparse.dot` runs
+gather + segment-sum kernels over it in O(nnz·k), so a LibSVM-scale
+design matrix never allocates its m×n dense form.
 """
 
 from __future__ import annotations
@@ -22,28 +23,89 @@ from .ndarray import NDArray, array, imperative_invoke, zeros as _dense_zeros
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_stype", "_aux")
+    """Shared surface of the lazy-dense sparse arrays: aux device
+    arrays + logical shape, with every shape/dtype/sync accessor
+    guaranteed not to force dense materialization."""
+
+    __slots__ = ("_stype", "_aux", "_dense_cache", "_sp_shape")
+
+    def _init_sparse(self, stype, aux, shape, ctx):
+        # deliberately NOT NDArray.__init__: no dense materialization
+        self._dense_cache = None
+        self._sp_shape = tuple(int(d) for d in shape)
+        self._ctx = ctx
+        self._ag_node = None
+        self._writeback = None
+        self._stype = stype
+        self._aux = aux
+
+    def _values(self):
+        """The values aux array (subclass-specific position)."""
+        raise NotImplementedError
 
     @property
     def stype(self):
         return self._stype
+
+    @property
+    def densified(self):
+        """Whether the dense view has been materialized (diagnostic)."""
+        return self._dense_cache is not None
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values().dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self._sp_shape:
+            n *= d
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        return NDArray(self._values(), self._ctx).context
+
+    ctx = context
+
+    def wait_to_read(self):
+        self._values().block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(
+                other, BaseSparseNDArray):
+            if tuple(other.shape) != tuple(self.shape):
+                raise ValueError("copyto shape mismatch: %s vs %s"
+                                 % (self.shape, other.shape))
+            other._assign(self._data)
+            return other
+        return super().copyto(other)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
     """row_sparse: a device (indices into dim0, values for those rows)
     pair.  The dense view is lazy — see module docstring."""
 
-    __slots__ = ("_dense_cache", "_rs_shape")
+    __slots__ = ()
 
     def __init__(self, data, indices, shape, ctx=None):
-        # deliberately NOT NDArray.__init__: no dense materialization
-        self._dense_cache = None
-        self._rs_shape = tuple(int(d) for d in shape)
-        self._ctx = ctx
-        self._ag_node = None
-        self._writeback = None
-        self._stype = "row_sparse"
-        self._aux = (indices, data)
+        self._init_sparse("row_sparse", (indices, data), shape, ctx)
+
+    def _values(self):
+        return self._aux[1]
 
     # -- lazy dense view ---------------------------------------------------
     @property
@@ -53,7 +115,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
             idx, vals = self._aux
             self._dense_cache = jnp.zeros(
-                self._rs_shape, dtype=vals.dtype).at[idx].set(vals)
+                self._sp_shape, dtype=vals.dtype).at[idx].set(vals)
         return self._dense_cache
 
     @_data.setter
@@ -71,52 +133,12 @@ class RowSparseNDArray(BaseSparseNDArray):
         idx = jnp.nonzero(mask)[0]
         self._aux = (idx, value[idx])
 
-    @property
-    def densified(self):
-        """Whether the dense view has been materialized (diagnostic)."""
-        return self._dense_cache is not None
-
-    # shape/dtype must not force materialization
-    @property
-    def shape(self):
-        return self._rs_shape
-
-    @property
-    def dtype(self):
-        return _np.dtype(self._aux[1].dtype)
-
-    @property
-    def size(self):
-        n = 1
-        for d in self._rs_shape:
-            n *= d
-        return n
-
-    @property
-    def ndim(self):
-        return len(self._rs_shape)
-
-    @property
-    def context(self):
-        if self._ctx is not None:
-            return self._ctx
-        from .ndarray import NDArray as _ND
-
-        return _ND(self._aux[1], self._ctx).context
-
-    ctx = context
-
-    def wait_to_read(self):
-        self._aux[1].block_until_ready()
-
-    wait_to_write = wait_to_read
-
     def astype(self, dtype, copy=True):
         d = np_dtype(dtype)
         if not copy and self.dtype == d:
             return self
         return RowSparseNDArray(self._aux[1].astype(d), self._aux[0],
-                                self._rs_shape, self._ctx)
+                                self._sp_shape, self._ctx)
 
     @property
     def indices(self):
@@ -136,16 +158,6 @@ class RowSparseNDArray(BaseSparseNDArray):
     def retain(self, indices):
         return retain(self, indices)
 
-    def copyto(self, other):
-        if isinstance(other, NDArray) and not isinstance(
-                other, BaseSparseNDArray):
-            if tuple(other.shape) != tuple(self.shape):
-                raise ValueError("copyto shape mismatch: %s vs %s"
-                                 % (self.shape, other.shape))
-            other._assign(self._data)
-            return other
-        return super().copyto(other)
-
     @classmethod
     def _from_dense(cls, dense_jax, idx_jax, ctx):
         """Wrap an existing dense device array + row indices without any
@@ -156,31 +168,91 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
+    """CSR: a REAL device (data, indices, indptr) triple (r3; reference:
+    python/mxnet/ndarray/sparse.py:287 CSRNDArray over the same three
+    aux arrays).  Like RowSparseNDArray, the dense view is LAZY — it
+    materializes only when a dense consumer touches ``_data``, so a
+    LibSVM-scale matrix (say 2^17 × 2^17, nnz ≪ m·n) lives on device in
+    O(nnz) memory and `sparse.dot` runs without ever allocating m·n."""
+
+    __slots__ = ()
+
     def __init__(self, data, indices, indptr, shape, ctx=None):
         import jax.numpy as jnp
 
-        dense = _np.zeros(shape, dtype=_np.asarray(data).dtype)
-        d = _np.asarray(data)
-        ind = _np.asarray(indices).astype(_np.int64)
-        ptr = _np.asarray(indptr).astype(_np.int64)
-        for row in range(shape[0]):
-            lo, hi = ptr[row], ptr[row + 1]
-            dense[row, ind[lo:hi]] = d[lo:hi]
-        super().__init__(jnp.asarray(dense), ctx)
-        self._stype = "csr"
-        self._aux = (d, ind, ptr)
+        def dev(x, want_int=False):
+            # accept numpy / lists / NDArray / jax arrays uniformly
+            x = getattr(x, "_data", x)
+            x = jnp.asarray(_np.asarray(x, dtype=_np.int32)
+                            if want_int and not hasattr(x, "devices")
+                            else x)
+            return x.astype(jnp.int32) if want_int and x.dtype not in (
+                jnp.int32, jnp.int64) else x
+
+        d = dev(data)
+        ind = dev(indices, want_int=True)
+        ptr = dev(indptr, want_int=True)
+        if int(ptr.shape[0]) != int(shape[0]) + 1:
+            raise MXNetError("indptr length %d != rows+1 (%d)"
+                             % (int(ptr.shape[0]), int(shape[0]) + 1))
+        self._init_sparse("csr", (d, ind, ptr), shape, ctx)
+
+    def _values(self):
+        return self._aux[0]
+
+    def _row_ids(self):
+        """Row id of every stored value: the CSR expansion
+        searchsorted(indptr, k, 'right')-1 — static-shaped, runs on
+        device."""
+        import jax.numpy as jnp
+
+        d, _, ptr = self._aux
+        nnz = int(d.shape[0])
+        return jnp.searchsorted(ptr, jnp.arange(nnz, dtype=ptr.dtype),
+                                side="right") - 1
+
+    # -- lazy dense view ---------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            import jax.numpy as jnp
+
+            d, ind, _ = self._aux
+            self._dense_cache = jnp.zeros(
+                self._sp_shape, dtype=d.dtype).at[self._row_ids(), ind].add(d)
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):  # _assign() writes through here
+        # device-side re-derivation (mirrors the RowSparse setter):
+        # jnp.nonzero syncs only the nnz count, not the dense payload
+        import jax.numpy as jnp
+
+        self._dense_cache = value
+        rows, cols = jnp.nonzero(value)
+        counts = jnp.bincount(rows, length=value.shape[0])
+        ptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(counts).astype(jnp.int32)])
+        self._aux = (value[rows, cols], cols.astype(jnp.int32), ptr)
+
+    def astype(self, dtype, copy=True):
+        d = np_dtype(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return CSRNDArray(self._aux[0].astype(d), self._aux[1],
+                          self._aux[2], self._sp_shape, self._ctx)
 
     @property
     def data(self):
-        return array(self._aux[0], ctx=self._ctx)
+        return NDArray(self._aux[0], self._ctx)
 
     @property
     def indices(self):
-        return array(self._aux[1], ctx=self._ctx)
+        return NDArray(self._aux[1], self._ctx)
 
     @property
     def indptr(self):
-        return array(self._aux[2], ctx=self._ctx)
+        return NDArray(self._aux[2], self._ctx)
 
     def tostype(self, stype):
         if stype == "csr":
@@ -190,7 +262,10 @@ class CSRNDArray(BaseSparseNDArray):
         raise MXNetError("cast csr→%s unsupported" % stype)
 
     def __getitem__(self, key):
-        """Row slicing keeps CSR (reference: sparse.py CSRNDArray.__getitem__)."""
+        """Row slicing keeps CSR (reference: sparse.py CSRNDArray.__getitem__).
+
+        The slice bounds sync two indptr scalars to host (variable nnz
+        — inherently data-dependent, same as the reference)."""
         if isinstance(key, slice):
             start, stop, step = key.indices(self.shape[0])
             if step != 1:
@@ -198,11 +273,21 @@ class CSRNDArray(BaseSparseNDArray):
             stop = max(stop, start)  # empty slice -> empty CSR, like numpy
             d, ind, ptr = self._aux
             lo, hi = int(ptr[start]), int(ptr[stop])
-            new_ptr = ptr[start:stop + 1] - ptr[start]
+            new_ptr = ptr[start:stop + 1] - lo
             return CSRNDArray(d[lo:hi], ind[lo:hi], new_ptr,
                               (stop - start,) + tuple(self.shape[1:]),
                               self._ctx)
         return super().__getitem__(key)
+
+
+def _csr_parts_from_dense(dense):
+    """Host CSR expansion of a dense numpy array (vectorized)."""
+    rows, cols = _np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _np.zeros(dense.shape[0] + 1, dtype=_np.int32)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr).astype(_np.int32)
+    return (data, cols.astype(_np.int32), indptr)
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
@@ -226,16 +311,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         data, indices, indptr = arg1
         return CSRNDArray(data, indices, indptr, shape, ctx)
     dense = _np.asarray(arg1, dtype=np_dtype(dtype))
-    indptr = [0]
-    indices = []
-    data = []
-    for row in dense:
-        nz = _np.where(row != 0)[0]
-        indices.extend(nz.tolist())
-        data.extend(row[nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(_np.asarray(data, dtype=dense.dtype),
-                      _np.asarray(indices), _np.asarray(indptr), dense.shape, ctx)
+    data, indices, indptr = _csr_parts_from_dense(dense)
+    return CSRNDArray(data, indices, indptr, dense.shape, ctx)
 
 
 def cast_storage(arr, stype):
@@ -256,8 +333,10 @@ def cast_storage(arr, stype):
         idx = jnp.nonzero(mask)[0]
         return RowSparseNDArray._from_dense(data, idx, arr._ctx)
     if stype == "csr":
-        dense = arr.asnumpy()
-        return csr_matrix(dense, shape=dense.shape, ctx=arr._ctx, dtype=dense.dtype)
+        host = arr.asnumpy()
+        csr = csr_matrix(host, ctx=arr._ctx, dtype=host.dtype)
+        csr._dense_cache = arr._data  # already materialized by caller
+        return csr
     raise MXNetError("unknown stype %r" % stype)
 
 
@@ -304,17 +383,58 @@ def retain(rsp, indices):
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
 
-    csr × dense -> dense; csrᵀ × dense -> row_sparse (the embedding-
-    gradient shape, reference DotCsrTransDnsRspImpl)."""
+    csr × dense -> dense and csrᵀ × dense -> row_sparse (the embedding-
+    gradient shape, reference DotCsrDnsDnsImpl / DotCsrTransDnsRspImpl)
+    run REAL sparse kernels on the (data, indices, indptr) triple —
+    gather + segment-sum, O(nnz·k) work, never materializing the m×n
+    dense lhs.  Static shapes throughout (nnz is the array's stored
+    size), so XLA compiles one program per CSR geometry."""
+    l_stype = getattr(lhs, "stype", "default")
+    if l_stype == "csr":
+        return _dot_csr(lhs, rhs, transpose_a, transpose_b)
     from ..ops.registry import apply_op
 
-    l_stype = getattr(lhs, "stype", "default")
     out = apply_op("dot", lhs._data, rhs._data,
                    transpose_a=transpose_a, transpose_b=transpose_b)
-    if l_stype == "csr" and transpose_a:
-        dense = NDArray(out, lhs._ctx)
-        return cast_storage(dense, "row_sparse")
     return NDArray(out, lhs._ctx)
+
+
+def _dot_csr(lhs, rhs, transpose_a, transpose_b):
+    import jax
+    import jax.numpy as jnp
+
+    d, ind, _ = lhs._aux
+    rows = lhs._row_ids()
+    r = rhs._data
+    if transpose_b:
+        r = r.T
+    vec = r.ndim == 1
+    if vec:
+        r = r[:, None]   # matvec: compute as (n, 1) and squeeze
+    if r.ndim != 2:
+        raise MXNetError("csr dot needs a 1-D or 2-D rhs")
+    m, n = lhs.shape
+    if not transpose_a:
+        if int(r.shape[0]) != n:
+            raise MXNetError("csr dot shape mismatch: %s x %s"
+                             % (lhs.shape, r.shape))
+        # y[row] += data[k] * rhs[col(k)]  (gather rows of rhs, segment-
+        # sum by CSR row id; reference DotCsrDnsDnsImpl)
+        contrib = d[:, None] * r[ind]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=m)
+        return NDArray(out[:, 0] if vec else out, lhs._ctx)
+    if int(r.shape[0]) != m:
+        raise MXNetError("csr^T dot shape mismatch: %s^T x %s"
+                         % (lhs.shape, r.shape))
+    # out[col(k)] += data[k] * rhs[row(k)] — scatter-add into the (n, k)
+    # gradient; row_sparse result (reference DotCsrTransDnsRspImpl)
+    contrib = d[:, None] * r[rows]
+    out = jnp.zeros((n, r.shape[1]), dtype=contrib.dtype).at[ind].add(contrib)
+    if vec:
+        return NDArray(out[:, 0], lhs._ctx)
+    touched = jnp.zeros((n,), dtype=jnp.bool_).at[ind].set(True)
+    idx = jnp.nonzero(touched)[0]
+    return RowSparseNDArray._from_dense(out, idx, lhs._ctx)
 
 
 def _ew(opname, lhs, rhs):

@@ -303,3 +303,105 @@ def test_row_sparse_dense_view_still_correct():
     want = np.zeros((5, 2), np.float32)
     want[idx] = vals
     np.testing.assert_array_equal(dense, want)
+
+
+def test_csr_is_lazy_triple():
+    """r3: CSR is a real (data, indices, indptr) device triple; nothing
+    dense exists until a dense consumer touches it."""
+    csr = sp.csr_matrix((np.array([1.0, 2.0, 3.0], np.float32),
+                         np.array([1, 0, 2]), np.array([0, 1, 3, 3])),
+                        shape=(3, 4))
+    assert not csr.densified
+    assert csr.shape == (3, 4) and csr.dtype == np.float32  # no force
+    assert csr.indices.asnumpy().tolist() == [1, 0, 2]
+    assert csr.indptr.asnumpy().tolist() == [0, 1, 3, 3]
+    assert not csr.densified
+    want = np.zeros((3, 4), np.float32)
+    want[0, 1], want[1, 0], want[1, 2] = 1, 2, 3
+    assert np.array_equal(csr.asnumpy(), want)  # lazy view materializes
+    assert csr.densified
+
+
+def test_csr_dot_matches_dense_kernels():
+    """The gather+segment-sum kernels match dense matmul on random CSR
+    geometry, both directions."""
+    rs = np.random.RandomState(0)
+    dense = rs.rand(17, 23).astype(np.float32)
+    dense[dense < 0.8] = 0  # ~20% nnz
+    csr = sp.csr_matrix(dense)
+    rhs = rs.rand(23, 5).astype(np.float32)
+    out = sp.dot(csr, mx.nd.array(rhs))
+    assert np.allclose(out.asnumpy(), dense @ rhs, atol=1e-5)
+    assert not csr.densified  # the kernel never touched the dense view
+    rhs2 = rs.rand(17, 4).astype(np.float32)
+    outT = sp.dot(csr, mx.nd.array(rhs2), transpose_a=True)
+    assert outT.stype == "row_sparse"
+    assert np.allclose(outT.asnumpy(), dense.T @ rhs2, atol=1e-5)
+    assert not csr.densified
+    # empty rows at the tail: indptr handles them
+    dense2 = np.zeros((6, 8), np.float32)
+    dense2[0, 3] = 2.0
+    csr2 = sp.csr_matrix(dense2)
+    out2 = sp.dot(csr2, mx.nd.array(np.eye(8, dtype=np.float32)))
+    assert np.allclose(out2.asnumpy(), dense2)
+
+
+def test_csr_libsvm_scale_memory():
+    """VERDICT r3 task #7 'done' criterion: a CSR workload at a shape
+    where the dense form is >=10x the sparse memory, running dot
+    without ever materializing dense (dense here would be 6.7 GB;
+    sparse is ~3 MB — 2000x)."""
+    rs = np.random.RandomState(1)
+    m, n, k, nnz = 100_000, 16_384, 8, 262_144
+    rows = np.sort(rs.randint(0, m, nnz)).astype(np.int32)
+    cols = rs.randint(0, n, nnz).astype(np.int32)
+    vals = rs.rand(nnz).astype(np.float32)
+    indptr = np.zeros(m + 1, np.int32)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int32)
+    csr = sp.csr_matrix((vals, cols, indptr), shape=(m, n))
+    dense_bytes = m * n * 4
+    sparse_bytes = vals.nbytes + cols.nbytes + indptr.nbytes
+    assert dense_bytes >= 10 * sparse_bytes
+
+    rhs = rs.rand(n, k).astype(np.float32)
+    out = sp.dot(csr, mx.nd.array(rhs))
+    assert out.shape == (m, k)
+    assert not csr.densified  # 6.7 GB never allocated
+    # spot-check a few rows against the host expansion
+    for r in [0, 12_345, m - 1]:
+        lo, hi = indptr[r], indptr[r + 1]
+        want = (vals[lo:hi, None] * rhs[cols[lo:hi]]).sum(axis=0) \
+            if hi > lo else np.zeros(k, np.float32)
+        assert np.allclose(out.asnumpy()[r], want, atol=1e-4), r
+
+
+def test_csr_review_fixes():
+    """r3 review: dtype preserved through cast_storage; NDArray aux
+    accepted; matvec works; slice syncs scalars only."""
+    # dtype preservation
+    a = mx.nd.array(np.array([[1, 0], [0, 2]]), dtype="int32")
+    csr = sp.cast_storage(a, "csr")
+    assert csr.dtype == np.int32
+    assert csr.asnumpy().dtype == np.int32
+    # NDArray aux arrays (reference csr_matrix API accepts NDArray)
+    csr2 = sp.csr_matrix((mx.nd.array([1.0, 2.0]), mx.nd.array([0, 1]),
+                          mx.nd.array([0, 1, 2])), shape=(2, 3))
+    csr2.wait_to_read()
+    out = sp.dot(csr2, mx.nd.array(np.eye(3, dtype=np.float32)))
+    assert np.allclose(out.asnumpy(), [[1, 0, 0], [0, 2, 0]])
+    # 1-D rhs matvec, both directions
+    dense = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+    csr3 = sp.csr_matrix(dense)
+    v = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    assert np.allclose(sp.dot(csr3, v).asnumpy(), dense @ [1, 2, 3])
+    v2 = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    assert np.allclose(sp.dot(csr3, v2, transpose_a=True).asnumpy(),
+                       dense.T @ [1, 2])
+    # dense write-through re-derives the triple on device
+    c = sp.zeros("csr", (2, 3))
+    c._assign(mx.nd.array(dense[:, :3]).data_jax
+              if hasattr(mx.nd.array(dense), "data_jax")
+              else mx.nd.array(dense)._data)
+    assert c.indptr.asnumpy().tolist() == [0, 2, 3]
+    assert c.indices.asnumpy().tolist() == [0, 2, 1]
