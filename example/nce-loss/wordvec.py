@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Word embeddings with NCE loss (reference: example/nce-loss/wordvec.py
+— word2vec-style training where the full-vocab softmax is replaced by
+noise-contrastive estimation against K sampled negatives).
+
+Synthetic corpus (zero-egress container): the vocabulary is split into
+topical clusters and sentences draw words from one cluster, so
+co-occurrence structure is known.  Training maximizes
+log sigma(s(center, ctx)) + sum_k log sigma(-s(center, noise_k)) — the
+NCE objective — with all K+1 scores batched into one MXU matmul.  The
+test asserts the learned geometry: intra-cluster cosine similarity
+must beat inter-cluster by a margin.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+class NCEEmbedding(gluon.Block):
+    """center/context embedding pair scored by dot product."""
+
+    def __init__(self, vocab, dim, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.center = nn.Embedding(vocab, dim)
+            self.context = nn.Embedding(vocab, dim)
+
+    def forward(self, center, ctx_and_noise):
+        """center: (B,); ctx_and_noise: (B, 1+K) — column 0 is the true
+        context, the rest are noise samples.  Returns (B, 1+K) scores."""
+        c = self.center(center)                 # (B, D)
+        w = self.context(ctx_and_noise)         # (B, 1+K, D)
+        return (w * c.reshape((c.shape[0], 1, c.shape[1]))).sum(axis=2)
+
+
+def nce_loss(scores):
+    """-log sigma(s_pos) - sum log sigma(-s_neg) (reference:
+    example/nce-loss/nce.py NceOutput semantics)."""
+    pos = scores[:, 0:1]
+    neg = scores[:, 1:]
+    eps = 1e-7
+    lp = mx.nd.log(mx.nd.sigmoid(pos) + eps)
+    ln = mx.nd.log(1.0 - mx.nd.sigmoid(neg) + eps).sum(axis=1, keepdims=True)
+    return -(lp + ln).reshape((-1,))
+
+
+def make_corpus(rng, n_pairs, vocab, n_clusters):
+    """(center, context) pairs drawn within clusters."""
+    per = vocab // n_clusters
+    centers = np.empty(n_pairs, np.int32)
+    contexts = np.empty(n_pairs, np.int32)
+    for i in range(n_pairs):
+        c = rng.randint(n_clusters)
+        centers[i] = c * per + rng.randint(per)
+        contexts[i] = c * per + rng.randint(per)
+    return centers, contexts
+
+
+def cluster_similarity(emb, vocab, n_clusters):
+    """(mean intra-cluster cosine, mean inter-cluster cosine)."""
+    w = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9)
+    sims = w @ w.T
+    per = vocab // n_clusters
+    cluster = np.arange(vocab) // per
+    same = cluster[:, None] == cluster[None, :]
+    off_diag = ~np.eye(vocab, dtype=bool)
+    return (float(sims[same & off_diag].mean()),
+            float(sims[~same].mean()))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="NCE word embeddings")
+    p.add_argument("--vocab", type=int, default=200)
+    p.add_argument("--clusters", type=int, default=8)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--num-negatives", type=int, default=8)
+    p.add_argument("--num-pairs", type=int, default=8192)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args(argv)
+    mx.random.seed(42)  # deterministic init regardless of process history
+
+    rng = np.random.RandomState(0)
+    centers, contexts = make_corpus(rng, args.num_pairs, args.vocab,
+                                    args.clusters)
+
+    net = NCEEmbedding(args.vocab, args.dim)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    B, K = args.batch_size, args.num_negatives
+    for epoch in range(args.epochs):
+        tot = nb = 0.0
+        for i in range(0, args.num_pairs - B + 1, B):
+            noise = rng.randint(0, args.vocab, (B, K))  # unigram noise
+            cn = np.concatenate([contexts[i:i + B, None], noise], axis=1)
+            c = mx.nd.array(centers[i:i + B], dtype="int32")
+            w = mx.nd.array(cn, dtype="int32")
+            with mx.autograd.record():
+                L = nce_loss(net(c, w))
+            L.backward()
+            trainer.step(B)
+            tot += float(L.mean().asnumpy())
+            nb += 1
+        intra, inter = cluster_similarity(
+            net.center.weight.data().asnumpy(), args.vocab, args.clusters)
+        print("epoch %d: nce loss %.4f, cosine intra %.3f vs inter %.3f"
+              % (epoch, tot / nb, intra, inter))
+    return intra, inter
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Two-stage detection: RPN + Proposal + ROIAlign + classifier head
+(reference: example/rcnn — Faster R-CNN, where the Proposal op turns
+trained RPN outputs into NMS'd ROIs and ROI pooling feeds the region
+classifier; symbol_resnet.py get_resnet_train wiring, scaled down).
+
+Synthetic single-object scenes (class = colour channel of one solid
+box).  The RPN trains against numpy-side anchor targets (IoU-assigned,
+the reference's AnchorLoader role); the Proposal op (anchor decode +
+clip + NMS + top-N, ops/extended.py) then produces ROIs, ROIAlign
+pools backbone features under them, and a Dense head classifies the
+region — gradients from the head flow through ROIAlign back into the
+backbone.  Eval: top-proposal IoU hit-rate and region class accuracy.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import contrib as ndc
+
+STRIDE = 4
+SCALES = (3, 4)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+
+
+def gen_anchors(h, w):
+    """Anchor grid matching ops/extended.py proposal (reference
+    GenerateAnchors rounding included) so numpy targets and the op
+    decode against identical boxes."""
+    base = []
+    for r in RATIOS:
+        for s in SCALES:
+            size = STRIDE * STRIDE
+            ws = round((size / r) ** 0.5)
+            hs = round(ws * r)
+            ws, hs = ws * s / STRIDE, hs * s / STRIDE
+            base.append([-(ws * STRIDE - STRIDE) / 2,
+                         -(hs * STRIDE - STRIDE) / 2,
+                         (ws * STRIDE - STRIDE) / 2 + STRIDE - 1,
+                         (hs * STRIDE - STRIDE) / 2 + STRIDE - 1])
+    base = np.asarray(base, np.float32)                    # (A, 4)
+    sx = np.arange(w, dtype=np.float32) * STRIDE
+    sy = np.arange(h, dtype=np.float32) * STRIDE
+    gy, gx = np.meshgrid(sy, sx, indexing="ij")
+    shifts = np.stack([gx, gy, gx, gy], -1).reshape(-1, 4)  # (HW, 4)
+    return (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+
+
+def iou(anchors, box):
+    ix1 = np.maximum(anchors[:, 0], box[0])
+    iy1 = np.maximum(anchors[:, 1], box[1])
+    ix2 = np.minimum(anchors[:, 2], box[2])
+    iy2 = np.minimum(anchors[:, 3], box[3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    aa = (anchors[:, 2] - anchors[:, 0] + 1) * (anchors[:, 3] - anchors[:, 1] + 1)
+    ab = (box[2] - box[0] + 1) * (box[3] - box[1] + 1)
+    return inter / (aa + ab - inter)
+
+
+def anchor_targets(anchors, gt_boxes):
+    """Per-image RPN targets (reference: rcnn AnchorLoader / proposal
+    target assignment): IoU>=0.5 or best anchor -> fg, <0.3 -> bg,
+    else ignore; bbox deltas for fg anchors."""
+    B = len(gt_boxes)
+    N = anchors.shape[0]
+    labels = np.full((B, N), -1.0, np.float32)
+    deltas = np.zeros((B, N, 4), np.float32)
+    for i, gt in enumerate(gt_boxes):
+        overlaps = iou(anchors, gt)
+        labels[i, overlaps < 0.3] = 0.0
+        pos = overlaps >= 0.5
+        pos[int(overlaps.argmax())] = True
+        labels[i, pos] = 1.0
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + 0.5 * aw
+        acy = anchors[:, 1] + 0.5 * ah
+        gw = gt[2] - gt[0] + 1
+        gh = gt[3] - gt[1] + 1
+        deltas[i, :, 0] = (gt[0] + 0.5 * gw - acx) / aw
+        deltas[i, :, 1] = (gt[1] + 0.5 * gh - acy) / ah
+        deltas[i, :, 2] = np.log(gw / aw)
+        deltas[i, :, 3] = np.log(gh / ah)
+    return labels, deltas
+
+
+class RCNN(gluon.Block):
+    def __init__(self, num_classes, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.backbone = nn.Sequential()
+            self.backbone.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+                              nn.MaxPool2D(2),
+                              nn.Conv2D(16, 3, padding=1, activation="relu"),
+                              nn.MaxPool2D(2))
+            self.rpn_score = nn.Conv2D(2 * A, 1)
+            self.rpn_delta = nn.Conv2D(4 * A, 1)
+            # head classifies num_classes + background (reference:
+            # proposal_target.py assigns label 0 = background)
+            self.head = nn.Sequential()
+            # LayerNorm conditions the pooled features: the RPN-trained
+            # backbone's activations are sparse/skewed (62% zeros) and
+            # the head stalls without it
+            self.head.add(nn.Flatten(), nn.LayerNorm(in_channels=16 * 3 * 3),
+                          nn.Dense(32, activation="relu",
+                                   in_units=16 * 3 * 3),
+                          nn.Dense(num_classes + 1, in_units=32))
+
+    def feats(self, x):
+        return self.backbone(x)
+
+    def rpn(self, feat):
+        return self.rpn_score(feat), self.rpn_delta(feat)
+
+    def classify(self, feat, rois):
+        pooled = ndc.ROIAlign(feat, rois, pooled_size=(3, 3),
+                              spatial_scale=1.0 / STRIDE)
+        return self.head(pooled)
+
+
+def make_scenes(rng, n, hw, num_classes):
+    x = (rng.rand(n, 3, hw, hw) * 0.2).astype(np.float32)
+    boxes = np.zeros((n, 4), np.float32)
+    cls = rng.randint(0, num_classes, n).astype(np.int32)
+    for i in range(n):
+        w, h = rng.randint(hw // 3, hw // 2, 2)
+        x0 = rng.randint(0, hw - w)
+        y0 = rng.randint(0, hw - h)
+        x[i, cls[i], y0:y0 + h, x0:x0 + w] += 0.9
+        boxes[i] = [x0, y0, x0 + w - 1, y0 + h - 1]
+    return x, boxes, cls
+
+
+def propose(net, data, hw):
+    """RPN forward -> Proposal op -> (R, 5) rois (no grad)."""
+    feat = net.feats(data)
+    score, delta = net.rpn(feat)
+    b, _, h, w = score.shape
+    pairs = score.reshape((b, 2, A, h, w))
+    prob = mx.nd.softmax(pairs, axis=1).reshape((b, 2 * A, h, w))
+    im_info = mx.nd.array(np.tile([hw, hw, 1.0], (b, 1)).astype(np.float32))
+    return ndc.Proposal(prob, delta, im_info, rpn_pre_nms_top_n=64,
+                        rpn_post_nms_top_n=4, threshold=0.7,
+                        rpn_min_size=4, scales=SCALES, ratios=RATIOS,
+                        feature_stride=STRIDE), feat
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="scaled Faster R-CNN")
+    p.add_argument("--num-classes", type=int, default=3)
+    p.add_argument("--num-examples", type=int, default=192)
+    p.add_argument("--hw", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=192)
+    p.add_argument("--epochs-rpn", type=int, default=80)
+    p.add_argument("--epochs-head", type=int, default=220)
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--lr-head", type=float, default=1e-2)
+    args = p.parse_args(argv)
+    mx.random.seed(42)  # deterministic init regardless of process history
+
+    rng = np.random.RandomState(0)
+    x, boxes, cls = make_scenes(rng, args.num_examples, args.hw,
+                                args.num_classes)
+    xv, boxv, clsv = make_scenes(np.random.RandomState(99), 64, args.hw,
+                                 args.num_classes)
+    fh = args.hw // STRIDE
+    anchors = gen_anchors(fh, fh)
+
+    net = RCNN(args.num_classes)
+    net.initialize(mx.init.Xavier())
+    # per-phase trainers: one optimizer step must only apply gradients
+    # the phase's backward produced (a shared trainer would re-apply the
+    # other phase's stale grad buffers)
+    all_params = net.collect_params()
+    rpn_params = {k: v for k, v in all_params.items() if "dense" not in k}
+    # phase 2 trains the region head ONLY: updating the shared backbone
+    # there would shift features out from under the frozen RPN heads
+    # (the reference's alternating scheme re-trains the RPN afterwards;
+    # one alternation is enough at this scale)
+    head_params = {k: v for k, v in all_params.items()
+                   if "dense" in k or "layernorm" in k}
+    trainer_rpn = gluon.Trainer(rpn_params, "adam",
+                                {"learning_rate": args.lr})
+    trainer_head = gluon.Trainer(head_params, "adam",
+                                 {"learning_rate": args.lr_head})
+    B = args.batch_size
+    # batch-size defaults to the full dataset: at this scale full-batch
+    # steps are the stable recipe for both phases (mini-batch proposal
+    # labels near the IoU threshold make the head oscillate)
+    # --- phase 1: RPN (objectness CE + smooth-L1 on fg deltas), the
+    # reference's alternating-training first stage
+    for epoch in range(args.epochs_rpn):
+        tot_rpn = nb = 0.0
+        for i in range(0, args.num_examples - B + 1, B):
+            data = mx.nd.array(x[i:i + B])
+            lab_np, dl_np = anchor_targets(anchors, boxes[i:i + B])
+            lab = mx.nd.array(lab_np)
+            dl = mx.nd.array(dl_np)
+            with mx.autograd.record():
+                feat = net.feats(data)
+                score, delta = net.rpn(feat)
+                b, _, h, w = score.shape
+                # (pos-major, anchor-minor) ordering to match
+                # gen_anchors / the Proposal op's flattening
+                sc = score.reshape((b, 2, A, h, w)) \
+                    .transpose((0, 3, 4, 2, 1)).reshape((b, -1, 2))
+                logp = mx.nd.log_softmax(sc, axis=-1)
+                ce = -mx.nd.pick(logp, mx.nd.clip(lab, 0, 1), axis=-1)
+                mask = (lab >= 0).astype("float32")
+                Lr = (ce * mask).sum() / mx.nd.clip(mask.sum(), 1, 1e9)
+                dd = delta.transpose((0, 2, 3, 1)).reshape((b, -1, 4))
+                diff = dd - dl
+                l1 = mx.nd.smooth_l1(diff, scalar=3.0)
+                fg = (lab == 1).astype("float32").reshape((b, -1, 1))
+                Lb = (l1 * fg).sum() / mx.nd.clip(fg.sum() * 4, 1, 1e9)
+                Lrpn = Lr + Lb
+            Lrpn.backward()
+            trainer_rpn.step(B)
+            tot_rpn += float(Lrpn.asnumpy())
+            nb += 1
+        print("rpn epoch %d: loss %.4f" % (epoch, tot_rpn / nb))
+
+    # --- phase 2: region head over Proposal ROIs (constant wrt grad).
+    # ROI labels follow the reference's ProposalTarget rule: class+1
+    # when the roi overlaps the gt box (IoU >= 0.5), else 0 = background
+    for epoch in range(args.epochs_head):
+        tot_cls = nb = 0.0
+        for i in range(0, args.num_examples - B + 1, B):
+            data = mx.nd.array(x[i:i + B])
+            rois, _ = propose(net, data, args.hw)
+            rois_np = rois.asnumpy()  # detach from any graph
+            labels_np = np.zeros(len(rois_np), np.float32)
+            for r in range(len(rois_np)):
+                img_i = i + int(rois_np[r, 0])
+                if iou(rois_np[r:r + 1, 1:], boxes[img_i])[0] >= 0.5:
+                    labels_np[r] = cls[img_i] + 1
+            with mx.autograd.record():
+                feat = net.feats(data)
+                out = net.classify(feat, mx.nd.array(rois_np))
+                Lc = gluon.loss.SoftmaxCrossEntropyLoss()(
+                    out, mx.nd.array(labels_np))
+            Lc.backward()
+            trainer_head.step(B)
+            tot_cls += float(Lc.mean().asnumpy())
+            nb += 1
+        print("head epoch %d: cls %.4f" % (epoch, tot_cls / nb))
+
+    # --- eval: top proposal IoU hit-rate + region classification
+    rois, feat = propose(net, mx.nd.array(xv), args.hw)
+    rois_np = rois.asnumpy().reshape(len(xv), 4, 5)
+    hits = 0
+    for i in range(len(xv)):
+        top = rois_np[i, 0, 1:]
+        hits += int(iou(top[None, :], boxv[i])[0] >= 0.5)
+    iou_rate = hits / len(xv)
+    out = net.classify(feat, mx.nd.array(rois_np.reshape(-1, 5)))
+    # foreground argmax of the top proposal (background = column 0)
+    pred = out.asnumpy().reshape(len(xv), 4, -1)[:, 0, 1:].argmax(axis=1)
+    cls_acc = float((pred == clsv).mean())
+    print("top-proposal IoU>=0.5 rate %.3f | region class acc %.3f"
+          % (iou_rate, cls_acc))
+    return iou_rate, cls_acc
+
+
+if __name__ == "__main__":
+    main()

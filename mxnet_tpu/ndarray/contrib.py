@@ -175,7 +175,10 @@ def _install_contrib_ops(namespace):
 
     names = [n for n in _reg.list_ops()
              if n in ("box_nms", "box_iou", "MultiBoxPrior", "MultiBoxTarget",
-                      "MultiBoxDetection", "ROIAlign", "BilinearResize2D",
+                      "MultiBoxDetection", "ROIAlign", "_contrib_Proposal",
+                      "_contrib_PSROIPooling",
+                      "_contrib_DeformableConvolution",
+                      "BilinearResize2D",
                       "AdaptiveAvgPooling2D", "boolean_mask", "quadratic",
                       "arange_like", "getnnz", "index_copy", "index_add",
                       "adamw_update", "_contrib_flash_attention",

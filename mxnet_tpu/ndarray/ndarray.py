@@ -248,27 +248,73 @@ class NDArray:
         means sharding over a mesh."""
         return any(d > 2**31 - 1 for d in self._data.shape)
 
+    def _on_tape(self):
+        """Whether gradients can flow through this array: it was
+        attach_grad()ed or produced by a recorded op."""
+        return self._ag_node is not None
+
     def __getitem__(self, key):
+        from .. import autograd as _ag
+
         if key is None:
             return NDArray(self._data[None], self._ctx)
+        record = _ag.is_recording() and self._on_tape()
         if self._needs_i64():
             import jax
 
             ck = _clean_index(key, _np.int64)
-            with jax.enable_x64():
-                out = self._data[ck]
             if _is_basic_index(ck):
+                if record:
+                    from ..ops.matrix import encode_basic_index
+
+                    return imperative_invoke(
+                        "_basic_index", [self],
+                        {"key": encode_basic_index(ck)})[0]
+                with jax.enable_x64():
+                    out = self._data[ck]
+                if isinstance(ck, tuple) and any(k is None for k in ck):
+                    return NDArray(out, self._ctx)  # no scatter target
                 # keep the reference's Slice/At write-through views on
                 # the int64 path too (same program, same semantics,
                 # regardless of array size)
                 return NDArray(out, self._ctx, _writeback=(self, ck))
-            return NDArray(out, self._ctx)
+            with jax.enable_x64():
+                return NDArray(self._data[ck], self._ctx)
         ck = _clean_index(key)
         if _is_basic_index(ck):
+            if record:
+                # an on-tape read through a view would fall off the tape
+                # — route through the registered _basic_index op so it
+                # joins the autograd graph (reference: record-able
+                # Slice/At views, src/ndarray/ndarray.cc:234,267)
+                from ..ops.matrix import encode_basic_index
+
+                return imperative_invoke(
+                    "_basic_index", [self],
+                    {"key": encode_basic_index(ck)})[0]
+            if isinstance(ck, tuple) and any(k is None for k in ck):
+                # newaxis views have no scatter target — plain copy
+                return NDArray(self._data[ck], self._ctx)
             # basic index → view with writeback (reference Slice/At views)
             return NDArray(self._data[ck], self._ctx, _writeback=(self, ck))
         if isinstance(ck, NDArray):
             ck = ck._data.astype("int32")
+        if record:
+            if not isinstance(ck, tuple) \
+                    and getattr(ck, "ndim", None) is not None:
+                # single integer-array index of an on-tape array = a row
+                # gather; route through `take` so it joins the tape.
+                # `take` clamps, so resolve negative indices first
+                jnp = _jnp()
+                arr = ck if hasattr(ck, "devices") else jnp.asarray(ck)
+                arr = jnp.where(arr < 0, arr + self._data.shape[0], arr)
+                return imperative_invoke("take", [self, NDArray(arr,
+                                                                self._ctx)],
+                                         {"axis": 0, "mode": "clip"})[0]
+            raise MXNetError(
+                "advanced indexing with %r is not differentiable here; "
+                "read it outside autograd.record() / via .detach(), or "
+                "use take/gather_nd ops" % (key,))
         return NDArray(self._data[ck], self._ctx)
 
     def slice(self, begin, end, step=None):
@@ -598,7 +644,8 @@ def _is_basic_index(key):
     if isinstance(key, (int, slice)) or key is Ellipsis:
         return True
     if isinstance(key, tuple):
-        return all(isinstance(k, (int, slice)) or k is Ellipsis for k in key)
+        return all(isinstance(k, (int, slice)) or k is Ellipsis or k is None
+                   for k in key)
     return False
 
 

@@ -575,3 +575,43 @@ def crop_like(data, *like, offset=(), h_w=(), center_crop=False, num_args=1, **_
     else:  # reference default: top-left at `offset` (crop-inl.h:130)
         oh, ow = offset if offset else (0, 0)
     return data[:, :, oh:oh + th, ow:ow + tw]
+
+
+def encode_basic_index(ck):
+    """Normalize a cleaned basic index into a hashable attr for
+    _basic_index (slices become ('s', start, stop, step) tags)."""
+    items = ck if isinstance(ck, tuple) else (ck,)
+    out = []
+    for it in items:
+        if isinstance(it, builtins_slice):
+            out.append(("s", it.start, it.stop, it.step))
+        elif it is None:
+            out.append(("n",))
+        elif it is Ellipsis:
+            out.append(("e",))
+        else:
+            out.append(("i", int(it)))
+    return tuple(out)
+
+
+def _decode_basic_index(key):
+    out = []
+    for it in key:
+        if it[0] == "s":
+            out.append(builtins_slice(it[1], it[2], it[3]))
+        elif it[0] == "n":
+            out.append(None)
+        elif it[0] == "e":
+            out.append(Ellipsis)
+        else:
+            out.append(it[1])
+    return tuple(out)
+
+
+@register("_basic_index")
+def basic_index(x, key=(), **_):
+    """Differentiable basic indexing: NDArray.__getitem__ routes here
+    while autograd records, so slices/int-indexing join the tape (the
+    reference's record-able Slice/At views, ndarray.cc Slice/At); the
+    VJP is jax's own gather transpose (scatter into zeros)."""
+    return x[_decode_basic_index(key)]

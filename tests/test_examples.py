@@ -290,3 +290,65 @@ def test_fcn_segmentation_learns():
     all-background baseline (i.e. actually segment the blobs)."""
     acc = _run_example("fcn-xs/fcn_segmentation.py", ["--num-epochs", "10"])
     assert acc > 0.95, acc
+
+
+def test_sparse_linear_classification():
+    """CSR LibSVM batches + row_sparse gradients + lazy SGD (reference:
+    example/sparse/linear_classification)."""
+    acc = _run_example("sparse/linear_classification.py",
+                       ["--epochs", "20", "--num-examples", "384"])
+    assert acc >= 0.85, acc
+
+
+def test_sparse_matrix_factorization():
+    """row_sparse embedding gradients through Trainer's lazy adam
+    (reference: example/sparse/matrix_factorization)."""
+    rmses = _run_example("sparse/matrix_factorization.py",
+                         ["--epochs", "8"])
+    assert rmses[-1] < 0.35 * rmses[0], rmses
+    assert rmses[-1] < 0.6, rmses
+
+
+def test_ctc_ocr_converges():
+    """CTC alignment learning end-to-end, greedy-decoded (reference:
+    example/ctc; the CTC forward+grad are torch-checked in
+    tests/test_loss.py)."""
+    acc = _run_example("ctc/lstm_ocr.py",
+                       ["--model", "dense", "--target-acc", "0.9"])
+    assert acc >= 0.75, acc
+
+
+def test_nce_wordvec_learns_clusters():
+    """NCE objective pulls intra-cluster embeddings together
+    (reference: example/nce-loss/wordvec.py)."""
+    intra, inter = _run_example("nce-loss/wordvec.py", ["--epochs", "6"])
+    assert intra - inter >= 0.25, (intra, inter)
+
+
+def test_neural_style_optimizes_image():
+    """Autograd to the INPUT image through a fixed extractor + Gram
+    losses (reference: example/neural-style/nstyle.py)."""
+    history = _run_example("neural-style/neural_style.py",
+                           ["--iters", "80"])
+    assert history[-1] < 0.05 * history[0], (history[0], history[-1])
+
+
+def test_quantization_calibrated_int8():
+    """Full calibration flow: stats -> thresholds -> int8 graph ->
+    accuracy parity (reference: example/quantization)."""
+    fp32_acc, int8_acc = _run_example(
+        "quantization/quantize_cnn.py",
+        ["--epochs", "4", "--calib-mode", "naive"])
+    assert fp32_acc >= 0.9, fp32_acc
+    assert int8_acc >= fp32_acc - 0.05, (fp32_acc, int8_acc)
+
+
+def test_rcnn_proposal_roialign_pipeline():
+    """Two-stage detection: RPN -> Proposal (NMS'd ROIs) -> ROIAlign ->
+    region head (reference: example/rcnn Faster R-CNN)."""
+    iou_rate, cls_acc = _run_example(
+        "rcnn/train_rcnn.py",
+        ["--num-examples", "96", "--batch-size", "96",
+         "--epochs-rpn", "60", "--epochs-head", "220"])
+    assert iou_rate >= 0.6, iou_rate
+    assert cls_acc >= 0.8, cls_acc

@@ -177,3 +177,50 @@ def test_sparse_facades():
     csr = mx.nd.sparse.csr_matrix(dense, shape=dense.shape)
     assert csr.stype == "csr"
     assert_almost_equal(csr.tostype("default"), dense)
+
+
+def test_basic_index_autograd():
+    """Basic indexing joins the autograd tape while recording (the
+    _basic_index op path; reference: record-able Slice/At views)."""
+    x = mx.nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+    x.attach_grad()
+    with mx.autograd.record():
+        L = (x[:, 0:1] * 2).sum() + (x[:, 1:] * 3).sum() \
+            + x[0, 2] + (x[1] * 5).sum() + (x[None, 2, ::2] * 7).sum()
+    L.backward()
+    want = np.full((4, 5), 3.0)
+    want[:, 0] = 2
+    want[0, 2] += 1
+    want[1] += 5
+    want[2, ::2] += 7
+    assert np.array_equal(x.grad.asnumpy(), want)
+    # outside recording, basic indexing still returns write-through views
+    v = x[1:3]
+    v[:] = -1.0
+    assert (x.asnumpy()[1:3] == -1).all()
+
+
+def test_index_autograd_review_fixes():
+    """r3 review: negative array indices resolve before take; non-tape
+    arrays keep views inside record; on-tape tuple-advanced indexing
+    fails loudly instead of silently dropping gradients."""
+    x = mx.nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x[mx.nd.array(np.array([-1, 0], np.float32))]
+        L = (y * 2).sum()
+    assert np.array_equal(y.asnumpy(), x.asnumpy()[[-1, 0]])
+    L.backward()
+    g = x.grad.asnumpy()
+    assert g[3].sum() == 10 and g[0].sum() == 10 and g[1:3].sum() == 0
+    # a NON-tape array indexed inside record still gives a view with
+    # write-through (and costs no trace)
+    data = mx.nd.array(np.ones((4, 5), np.float32))
+    with mx.autograd.record():
+        v = data[1:3]
+    v[:] = 0
+    assert data.asnumpy()[1:3].sum() == 0
+    # on-tape advanced-tuple indexing: loud error, not silent zero grads
+    with mx.autograd.record():
+        with pytest.raises(mx.base.MXNetError, match="not differentiable"):
+            x[mx.nd.array(np.array([0, 2], np.float32)), 1]

@@ -489,6 +489,7 @@ CREATION = {
 # the sweep still asserts the name is registered
 ELSEWHERE = {
     "RNN": ("tests/test_rnn.py", "FusedRNNCell"),
+    "_basic_index": ("tests/test_ndarray.py", "_basic_index"),
     "_subgraph_exec": ("tests/test_subgraph.py", "_subgraph_exec"),
     "Custom": ("tests/test_review_fixes.py", "Custom"),
     "CTCLoss": ("tests/test_operator.py", "CTCLoss"),
