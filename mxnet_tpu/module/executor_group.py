@@ -55,6 +55,7 @@ class DataParallelExecutorGroup:
         self.slices = _split_input_slice(self.batch_size, self.workload)
         self.execs = []
         self._default_execs = None
+        self._shared_group = shared_group
         self.grad_req = {}
         for name in self.arg_names:
             if name in self.param_names:
@@ -78,6 +79,25 @@ class DataParallelExecutorGroup:
                 shapes[name] = (n,) + tuple(shape[1:])
             ex = self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
                                          **shapes)
+            if self._shared_group is not None \
+                    and i < len(self._shared_group.execs):
+                # share param STORAGE with the other group (reference:
+                # executor_group shared_group / bucketing memory
+                # sharing): the executors point at the same NDArray
+                # objects, so updates through either module are visible
+                # to both
+                src = self._shared_group.execs[i]
+                src_args = src.arg_dict
+                src_aux = src.aux_dict
+                for j, name in enumerate(ex._arg_names):
+                    if name in self.param_names and name in src_args and \
+                            tuple(src_args[name].shape) == \
+                            tuple(ex.arg_arrays[j].shape):
+                        ex.arg_arrays[j] = src_args[name]
+                for j, name in enumerate(ex._aux_names):
+                    if name in src_aux and tuple(src_aux[name].shape) == \
+                            tuple(ex.aux_arrays[j].shape):
+                        ex.aux_arrays[j] = src_aux[name]
             self.execs.append(ex)
         self.shared_data_arrays = [{} for _ in self.contexts]
 

@@ -272,6 +272,8 @@ class Module(BaseModule):
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad,
+            shared_group=(shared_module._exec_group
+                          if shared_module is not None else None),
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
             state_names=self._state_names)
         if self.params_initialized:

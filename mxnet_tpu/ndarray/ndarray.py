@@ -256,9 +256,15 @@ class NDArray:
     def __getitem__(self, key):
         from .. import autograd as _ag
 
-        if key is None:
-            return NDArray(self._data[None], self._ctx)
         record = _ag.is_recording() and self._on_tape()
+        if key is None:
+            if record:
+                from ..ops.matrix import encode_basic_index
+
+                return imperative_invoke(
+                    "_basic_index", [self],
+                    {"key": encode_basic_index((None,))})[0]
+            return NDArray(self._data[None], self._ctx)
         if self._needs_i64():
             import jax
 
@@ -278,6 +284,11 @@ class NDArray:
                 # the int64 path too (same program, same semantics,
                 # regardless of array size)
                 return NDArray(out, self._ctx, _writeback=(self, ck))
+            if record:
+                raise MXNetError(
+                    "advanced indexing of an int64-addressed array is "
+                    "not differentiable; read it outside "
+                    "autograd.record() or via .detach()")
             with jax.enable_x64():
                 return NDArray(self._data[ck], self._ctx)
         ck = _clean_index(key)

@@ -124,7 +124,11 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
         dimension_numbers=dn,
         feature_group_count=int(num_group),
     )
-    if bias is not None and not no_bias:
+    if bias is not None:
+        # a supplied bias wins over the no_bias flag: the reference's
+        # default no_bias=True governs how many inputs it EXPECTS
+        # (deconvolution-inl.h), not whether a provided bias is applied
+        # — silently dropping a passed bias was a real bug (r3)
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
 
@@ -493,7 +497,9 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=Fal
             return summed / denom
         ones = jnp.ones_like(data)
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
-        return summed / counts
+        # a ceil-convention window can land entirely in padding; its
+        # count is 0 and 0/0 would poison the batch with NaN — emit 0
+        return summed / jnp.maximum(counts, 1.0)
     if pool_type == "lp":
         p = float(p_value)
         powed = lax.reduce_window(jnp.power(jnp.abs(data), p), 0.0, lax.add,

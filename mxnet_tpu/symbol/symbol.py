@@ -557,7 +557,21 @@ def _create(op_name, input_syms, attrs, name=None):
             inputs.append(v._outputs[0])
     needed = OP_INPUT_NAMES.get(op.name, ())
     if needed and len(inputs) < len(needed):
-        no_bias = attrs.get("no_bias", False)
+        # per-op no_bias default: Deconvolution defaults to NO bias in
+        # the reference (deconvolution-inl.h set_default(true)), unlike
+        # Convolution/FullyConnected — auto-creating a live bias there
+        # would grow a trainable param reference checkpoints lack.  The
+        # op fn's signature default IS the reference default
+        import inspect
+
+        default_no_bias = False
+        try:
+            sig_p = inspect.signature(op.fn).parameters.get("no_bias")
+            if sig_p is not None and sig_p.default is not inspect.Parameter.empty:
+                default_no_bias = bool(sig_p.default)
+        except (TypeError, ValueError):
+            pass
+        no_bias = attrs.get("no_bias", default_no_bias)
         use_seq = attrs.get("use_sequence_length", False)
         for iname in needed[len(inputs):]:
             if iname == "bias" and no_bias:
