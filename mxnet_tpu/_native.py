@@ -85,6 +85,15 @@ def _declare(lib):
                                 ctypes.c_int, u64, ctypes.c_int, ctypes.c_int,
                                 ctypes.c_int, DECODE_FN, vp,
                                 ctypes.POINTER(vp)],
+        "MXTPUPipelineCreateJpeg": [ctypes.c_char_p, u64, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int, u64,
+                                    ctypes.c_int, ctypes.c_int, u64,
+                                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_float, ctypes.c_float,
+                                    ctypes.c_float, ctypes.POINTER(vp)],
+        "MXTPUPipelineHasJpeg": [],
         "MXTPUPipelineNext": [vp, ctypes.POINTER(
             ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),

@@ -543,8 +543,10 @@ class ImageRecordIter(DataIter):
     chain:
     - native (default when libmxtpu builds): C++ pipeline does chunked
       sharded RecordIO reads, shuffle-buffer sampling, worker-pool decode
-      (JPEG via a Python callback into PIL; raw samples fully in C++) into
-      recycled batch buffers (mxnet_tpu/native/src/pipeline.cc).
+      (JPEG fully in C++ via libjpeg when available — pipeline.cc
+      DecodeJpeg, zero Python in the loop; PIL callback fallback; raw
+      samples via the builtin memcpy) into recycled batch buffers
+      (mxnet_tpu/native/src/pipeline.cc).
     - python fallback: load-all + per-batch decode.
     """
 
@@ -579,13 +581,19 @@ class ImageRecordIter(DataIter):
             use_native = os.environ.get("MXNET_USE_NATIVE_ITER", "1") == "1"
         if use_native:
             try:
+                jpeg_cfg = None
+                if not raw_records and _records_are_jpeg(path_imgrec) \
+                        and _native_has_jpeg():
+                    jpeg_cfg = {"rand_crop": rand_crop,
+                                "rand_mirror": rand_mirror,
+                                "mean": (mean_r, mean_g, mean_b)}
                 self._pipe = _NativePipeline(
                     self, path_imgrec, batch_size=batch_size,
                     sample_shape=self.data_shape, label_width=label_width,
                     shuffle=shuffle_buffer if shuffle else 0, seed=seed,
                     num_workers=preprocess_threads,
                     part_index=part_index, num_parts=num_parts,
-                    use_builtin_decode=raw_records)
+                    use_builtin_decode=raw_records, builtin_jpeg=jpeg_cfg)
             except (RuntimeError, OSError) as e:
                 # toolchain/build problems only; anything else propagates.
                 import warnings
@@ -709,6 +717,33 @@ class ImageRecordIter(DataIter):
                          provide_label=self.provide_label)
 
 
+def _native_has_jpeg():
+    """Whether libmxtpu carries the in-worker JPEG decoder."""
+    from .. import _native
+
+    lib = _native.get_lib()
+    try:
+        return bool(lib is not None and lib.MXTPUPipelineHasJpeg())
+    except AttributeError:  # stale prebuilt library
+        return False
+
+
+def _records_are_jpeg(path):
+    """Peek at the first record's payload magic (JPEG = FF D8)."""
+    from ..recordio import MXRecordIO, unpack
+
+    try:
+        rec = MXRecordIO(path, "r")
+        raw = rec.read()
+        rec.close()
+        if raw is None:
+            return False
+        _, payload = unpack(raw)
+        return bytes(payload[:2]) == b"\xff\xd8"
+    except Exception:
+        return False
+
+
 class _NativePipeline:
     """ctypes wrapper over the C++ prefetching batch pipeline
     (mxnet_tpu/native/src/pipeline.cc).  Owns the decode callback: C++
@@ -717,7 +752,7 @@ class _NativePipeline:
 
     def __init__(self, owner, path, batch_size, sample_shape, label_width,
                  shuffle, seed, num_workers, part_index, num_parts,
-                 use_builtin_decode=False):
+                 use_builtin_decode=False, builtin_jpeg=None):
         import ctypes
 
         from .. import _native
@@ -732,6 +767,28 @@ class _NativePipeline:
         self.label_width = label_width
         self._sample_elems = int(_np.prod(self.sample_shape))
         sample_bytes = self._sample_elems * 4  # float32
+
+        if builtin_jpeg is not None:
+            # fully-native JPEG route: decode + augment inside the C++
+            # worker pool (pipeline.cc DecodeJpeg) — zero Python in the
+            # loop, like the raw path
+            c, h, w = self.sample_shape
+            mean = builtin_jpeg.get("mean", (0.0, 0.0, 0.0))
+            hnd = ctypes.c_void_p()
+            _native.check_call(lib.MXTPUPipelineCreateJpeg(
+                path.encode(), 8 << 20, part_index, num_parts, batch_size,
+                sample_bytes, label_width, shuffle, seed, num_workers, 0, 1,
+                int(h), int(w), int(c),
+                int(bool(builtin_jpeg.get("rand_crop"))),
+                int(bool(builtin_jpeg.get("rand_mirror"))),
+                float(mean[0]), float(mean[1]), float(mean[2]),
+                ctypes.byref(hnd)))
+            self._h = hnd
+            self._cb = None
+            self._check = _native.check_call
+            self._peek = None
+            self._decode_error = None
+            return
 
         if use_builtin_decode:
             # NULL fn pointer: C++ workers memcpy records directly via

@@ -177,6 +177,51 @@ MXTPU_EXPORT int MXTPUPipelineCreate(
   MXTPU_API_END();
 }
 
+// Extended create: built-in JPEG decode+augment in the worker pool
+// (img_* / rand_* / mean describe the python _augment chain).
+MXTPU_EXPORT int MXTPUPipelineCreateJpeg(
+    const char* path, uint64_t chunk_bytes, int part_index, int num_parts,
+    int batch_size, uint64_t sample_bytes, int label_width, int shuffle,
+    uint64_t seed, int num_workers, int queue_depth, int last_batch_keep,
+    int img_h, int img_w, int img_c, int rand_crop, int rand_mirror,
+    float mean_r, float mean_g, float mean_b, void** out) {
+  MXTPU_API_BEGIN();
+  PipelineConfig cfg;
+  cfg.path = path;
+  cfg.chunk_bytes = chunk_bytes;
+  cfg.part_index = part_index;
+  cfg.num_parts = num_parts;
+  cfg.batch_size = batch_size;
+  cfg.sample_bytes = sample_bytes;
+  cfg.label_width = label_width;
+  cfg.shuffle = shuffle;
+  cfg.seed = seed;
+  cfg.num_workers = num_workers;
+  cfg.queue_depth = queue_depth;
+  cfg.last_batch_keep = last_batch_keep;
+  cfg.builtin_jpeg = 1;
+  cfg.img_h = img_h;
+  cfg.img_w = img_w;
+  cfg.img_c = img_c;
+  cfg.rand_crop = rand_crop;
+  cfg.rand_mirror = rand_mirror;
+  cfg.mean[0] = mean_r;
+  cfg.mean[1] = mean_g;
+  cfg.mean[2] = mean_b;
+  *out = new Pipeline(cfg);
+  MXTPU_API_END();
+}
+
+// 1 when libmxtpu was built against libjpeg (the builtin JPEG worker
+// path is available), else 0.
+MXTPU_EXPORT int MXTPUPipelineHasJpeg() {
+#ifdef MXTPU_USE_LIBJPEG
+  return 1;
+#else
+  return 0;
+#endif
+}
+
 // count is set to -1 at end of epoch.
 MXTPU_EXPORT int MXTPUPipelineNext(void* h, uint8_t** data, float** label,
                                    int* count) {

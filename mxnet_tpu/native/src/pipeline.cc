@@ -1,5 +1,22 @@
 #include "pipeline.h"
 
+#include <csetjmp>
+#include <functional>
+
+#ifdef MXTPU_USE_LIBJPEG
+#include <cstdio>
+#include <jpeglib.h>
+
+namespace {
+struct JpegErr {
+  jmp_buf jb;
+};
+void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(static_cast<JpegErr*>(cinfo->client_data)->jb, 1);
+}
+}  // namespace
+#endif
+
 #include <cstring>
 #include <stdexcept>
 
@@ -31,7 +48,7 @@ void Pipeline::StartThreads() {
   outstanding_ = 0;
   io_thread_ = std::thread([this] { IoLoop(); });
   for (int i = 0; i < cfg_.num_workers; ++i)
-    workers_.emplace_back([this] { DecodeLoop(); });
+    workers_.emplace_back([this, i] { DecodeLoop(i); });
 }
 
 void Pipeline::StopThreads() {
@@ -149,12 +166,10 @@ void Pipeline::IoLoop() {
   }
 }
 
-int Pipeline::DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data,
-                        float* label) {
-  // Built-in decoder for IRHeader-packed raw samples
-  // (format of python recordio.pack: flag u32, label f32, id u64, id2 u64,
-  // [flag>0: flag float32 labels], payload).  Payload must be exactly
-  // sample_bytes (raw tensor bytes).
+int Pipeline::ParseHeader(const uint8_t* rec, uint32_t len, float* label,
+                          const uint8_t** payload, size_t* payload_len) {
+  // IRHeader (format of python recordio.pack: flag u32, label f32,
+  // id u64, id2 u64, [flag>0: flag float32 labels], payload).
   if (len < 24) return -1;
   uint32_t flag;
   float slabel;
@@ -177,12 +192,127 @@ int Pipeline::DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data,
     p += need;
     remain -= need;
   }
+  *payload = p;
+  *payload_len = remain;
+  return 0;
+}
+
+int Pipeline::DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data,
+                        float* label) {
+  // Built-in decoder for raw samples: payload must be exactly
+  // sample_bytes (raw tensor bytes).
+  const uint8_t* p = nullptr;
+  size_t remain = 0;
+  int rc = ParseHeader(rec, len, label, &p, &remain);
+  if (rc != 0) return rc;
   if (remain != cfg_.sample_bytes) return -3;
   std::memcpy(data, p, cfg_.sample_bytes);
   return 0;
 }
 
-void Pipeline::DecodeLoop() {
+#ifdef MXTPU_USE_LIBJPEG
+int Pipeline::DecodeJpeg(const uint8_t* rec, uint32_t len, uint8_t* data,
+                         float* label, std::mt19937* rng) {
+  // Built-in JPEG decode + augment (reference:
+  // src/io/iter_image_recordio_2.cc OpenCV decode +
+  // image_aug_default.cc, done here with libjpeg).  Output: float32 CHW
+  // minus per-channel mean, crop-or-center-fit to (img_h, img_w),
+  // optional horizontal mirror — the exact python _augment semantics so
+  // both paths produce identical batches.
+  const uint8_t* p = nullptr;
+  size_t remain = 0;
+  int rc = ParseHeader(rec, len, label, &p, &remain);
+  if (rc != 0) return rc;
+  if (remain < 4 || p[0] != 0xFF || p[1] != 0xD8) return -10;  // not JPEG
+
+  // declared BEFORE setjmp: a longjmp must not skip construction of a
+  // non-trivial object (UB + leak per corrupt record otherwise)
+  std::vector<uint8_t> img;
+  jpeg_decompress_struct cinfo;
+  jpeg_error_mgr jerr;
+  JpegErr err_state;
+  cinfo.err = jpeg_std_error(&jerr);
+  jerr.error_exit = JpegErrExit;
+  cinfo.client_data = &err_state;
+  jpeg_create_decompress(&cinfo);
+  if (setjmp(err_state.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -11;  // corrupt stream
+  }
+  jpeg_mem_src(&cinfo, p, remain);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -12;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int W = static_cast<int>(cinfo.output_width);
+  const int H = static_cast<int>(cinfo.output_height);
+  const int C = 3;
+  img.resize(static_cast<size_t>(W) * H * C);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = img.data() + static_cast<size_t>(cinfo.output_scanline) * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  const int th = cfg_.img_h, tw = cfg_.img_w;
+  if (cfg_.img_c != 3) return -13;
+  if (cfg_.sample_bytes != static_cast<size_t>(C) * th * tw * 4) return -14;
+
+  // source/dest offsets (python _augment: random crop when both dims
+  // large enough, else centered crop-or-pad)
+  int sy, sx, dy = 0, dx = 0;
+  if (cfg_.rand_crop && H >= th && W >= tw) {
+    sy = H > th ? static_cast<int>((*rng)() % (H - th + 1)) : 0;
+    sx = W > tw ? static_cast<int>((*rng)() % (W - tw + 1)) : 0;
+  } else {
+    sy = H > th ? (H - th) / 2 : 0;
+    sx = W > tw ? (W - tw) / 2 : 0;
+    dy = th > H ? (th - H) / 2 : 0;
+    dx = tw > W ? (tw - W) / 2 : 0;
+  }
+  const int ch = H < th ? H : th;
+  const int cw = W < tw ? W : tw;
+  const bool mirror = cfg_.rand_mirror && ((*rng)() & 1u);
+
+  float* out = reinterpret_cast<float*>(data);
+  for (int c = 0; c < C; ++c) {
+    const float m = cfg_.mean[c];
+    float* plane = out + static_cast<size_t>(c) * th * tw;
+    // python _augment order: center-fit pads ZEROS, mirrors the whole
+    // fitted canvas, THEN subtracts mean — so pad pixels are -mean and
+    // the mirrored crop lands at column tw - dx - cw
+    for (size_t i = 0; i < static_cast<size_t>(th) * tw; ++i) plane[i] = -m;
+    const int dst_x0 = mirror ? (tw - dx - cw) : dx;
+    for (int y = 0; y < ch; ++y) {
+      const uint8_t* src = img.data() +
+          (static_cast<size_t>(sy + y) * W + sx) * C + c;
+      float* dst = plane + static_cast<size_t>(dy + y) * tw + dst_x0;
+      if (mirror) {
+        for (int x = 0; x < cw; ++x)
+          dst[cw - 1 - x] = static_cast<float>(src[static_cast<size_t>(x) * C]) - m;
+      } else {
+        for (int x = 0; x < cw; ++x)
+          dst[x] = static_cast<float>(src[static_cast<size_t>(x) * C]) - m;
+      }
+    }
+  }
+  return 0;
+}
+#else
+int Pipeline::DecodeJpeg(const uint8_t*, uint32_t, uint8_t*, float*,
+                         std::mt19937*) {
+  return -20;  // built without libjpeg
+}
+#endif
+
+void Pipeline::DecodeLoop(int worker_idx) {
+  // per-worker rng: cfg seed + worker index — crops/mirrors differ
+  // across workers yet reproduce exactly for a fixed seed
+  std::mt19937 rng(static_cast<uint32_t>(
+      cfg_.seed * 2654435761u + 0x9E3779B9u * (worker_idx + 1)));
   for (;;) {
     Work w;
     {
@@ -208,11 +338,17 @@ void Pipeline::DecodeLoop() {
     for (size_t i = 0; i < w.recs.size(); ++i) {
       uint8_t* d = b.data + i * cfg_.sample_bytes;
       float* l = b.label + i * cfg_.label_width;
-      int rc = cfg_.decode
-                   ? cfg_.decode(cfg_.decode_ctx, w.recs[i].data(),
-                                 static_cast<uint32_t>(w.recs[i].size()), d, l)
-                   : DecodeRaw(w.recs[i].data(),
-                               static_cast<uint32_t>(w.recs[i].size()), d, l);
+      int rc;
+      if (cfg_.decode) {
+        rc = cfg_.decode(cfg_.decode_ctx, w.recs[i].data(),
+                         static_cast<uint32_t>(w.recs[i].size()), d, l);
+      } else if (cfg_.builtin_jpeg) {
+        rc = DecodeJpeg(w.recs[i].data(),
+                        static_cast<uint32_t>(w.recs[i].size()), d, l, &rng);
+      } else {
+        rc = DecodeRaw(w.recs[i].data(),
+                       static_cast<uint32_t>(w.recs[i].size()), d, l);
+      }
       if (rc != 0) {
         err = "pipeline: decode failed (rc=" + std::to_string(rc) + ")";
         break;

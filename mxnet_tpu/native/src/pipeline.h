@@ -50,6 +50,15 @@ struct PipelineConfig {
   int last_batch_keep = 1;   // keep partial final batch (count < batch_size)
   DecodeFn decode = nullptr; // null -> built-in raw decoder
   void* decode_ctx = nullptr;
+  // built-in JPEG decode+augment (zero Python in the worker loop);
+  // active when decode == nullptr and builtin_jpeg != 0.  Mirrors the
+  // python _augment chain: decode -> random/center crop-or-pad to
+  // (img_h, img_w) -> optional mirror -> float32 CHW minus mean.
+  int builtin_jpeg = 0;
+  int img_h = 0, img_w = 0, img_c = 3;
+  int rand_crop = 0;
+  int rand_mirror = 0;
+  float mean[3] = {0.f, 0.f, 0.f};
 };
 
 struct Batch {
@@ -79,11 +88,15 @@ class Pipeline {
   };
 
   void IoLoop();
-  void DecodeLoop();
+  void DecodeLoop(int worker_idx);
   void PushDone(Batch b);
   void StopThreads();
   void StartThreads();
   int DecodeRaw(const uint8_t* rec, uint32_t len, uint8_t* data, float* label);
+  int DecodeJpeg(const uint8_t* rec, uint32_t len, uint8_t* data,
+                 float* label, std::mt19937* rng);
+  int ParseHeader(const uint8_t* rec, uint32_t len, float* label,
+                  const uint8_t** payload, size_t* payload_len);
 
   PipelineConfig cfg_;
   size_t data_bytes_, label_bytes_;

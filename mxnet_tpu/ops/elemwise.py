@@ -66,6 +66,7 @@ _UNARY = {
     "erfinv": jax.scipy.special.erfinv,
     "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
     "gammaln": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
     "reciprocal": lambda x: 1.0 / x,
     "negative": jnp.negative,
     "logical_not": lambda x: (x == 0).astype(x.dtype),

@@ -352,3 +352,26 @@ def test_rcnn_proposal_roialign_pipeline():
          "--epochs-rpn", "60", "--epochs-head", "220"])
     assert iou_rate >= 0.6, iou_rate
     assert cls_acc >= 0.8, cls_acc
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    """Rebuilt .idx drives random access (reference: tools/rec2idx.py)."""
+    from mxnet_tpu.recordio import MXIndexedRecordIO, MXRecordIO
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+
+    import rec2idx
+
+    importlib.reload(rec2idx)
+    rec_path = str(tmp_path / "x.rec")
+    w = MXRecordIO(rec_path, "w")
+    payloads = [bytes([i]) * max(1, i * 3) for i in range(10)]
+    for pl in payloads:
+        w.write(pl)
+    w.close()
+    assert rec2idx.main([rec_path]) == 10
+    r = MXIndexedRecordIO(str(tmp_path / "x.idx"), rec_path, "r")
+    for i in (0, 3, 9, 5):
+        assert r.read_idx(i) == payloads[i]
+    sys.path.pop(0)

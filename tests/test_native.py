@@ -451,3 +451,59 @@ def test_raw_records_warns_on_dropped_augmentation(tmp_path):
         mx.io.ImageRecordIter(path_imgrec=path, data_shape=(2, 4, 4),
                               batch_size=1, rand_mirror=True,
                               raw_records=True, use_native=False)
+
+
+def test_native_jpeg_pipeline_matches_python(tmp_path):
+    """The in-worker C++ JPEG decoder (pipeline.cc DecodeJpeg) produces
+    the same batches as the Python-callback path — labels exactly,
+    pixels within decoder rounding (r3; closes the GIL-bet in
+    BENCH_NOTES' multi-core scaling story)."""
+    pytest.importorskip("PIL")
+    from mxnet_tpu.io.io import ImageRecordIter, _native_has_jpeg
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    if not _native_has_jpeg():
+        pytest.skip("libmxtpu built without libjpeg")
+    rng = np.random.RandomState(0)
+    rec = MXIndexedRecordIO(str(tmp_path / "j.idx"), str(tmp_path / "j.rec"),
+                            "w")
+    for i in range(24):
+        img = (rng.rand(40, 40, 3) * 255).astype(np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 5), i, 0), img,
+                                  quality=95))
+    rec.close()
+    nat = ImageRecordIter(str(tmp_path / "j.rec"), (3, 32, 32), batch_size=8,
+                          mean_r=10.0, mean_g=20.0, mean_b=30.0)
+    assert nat._pipe is not None and nat._pipe._cb is None, \
+        "builtin JPEG path not selected"
+    py = ImageRecordIter(str(tmp_path / "j.rec"), (3, 32, 32), batch_size=8,
+                         mean_r=10.0, mean_g=20.0, mean_b=30.0,
+                         use_native=False)
+    n = 0
+    for b_nat, b_py in zip(nat, py):
+        np.testing.assert_array_equal(b_nat.label[0].asnumpy(),
+                                      b_py.label[0].asnumpy())
+        diff = np.abs(b_nat.data[0].asnumpy() - b_py.data[0].asnumpy())
+        assert diff.max() <= 1.0, diff.max()  # IDCT rounding slack
+        n += 1
+    assert n == 3
+
+    # pad case (image smaller than data_shape): the centered canvas and
+    # its -mean padding must match the python _center_fit path exactly
+    rec = MXIndexedRecordIO(str(tmp_path / "p.idx"), str(tmp_path / "p.rec"),
+                            "w")
+    for i in range(8):
+        img = (rng.rand(24, 24, 3) * 255).astype(np.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img,
+                                  quality=95))
+    rec.close()
+    natp = ImageRecordIter(str(tmp_path / "p.rec"), (3, 32, 32),
+                           batch_size=8, mean_r=100.0, mean_g=50.0,
+                           mean_b=25.0)
+    pyp = ImageRecordIter(str(tmp_path / "p.rec"), (3, 32, 32), batch_size=8,
+                          mean_r=100.0, mean_g=50.0, mean_b=25.0,
+                          use_native=False)
+    bn = next(iter(natp)).data[0].asnumpy()
+    bp = next(iter(pyp)).data[0].asnumpy()
+    assert np.abs(bn - bp).max() <= 1.0
+    assert bn[0, 0, 0, 0] == -100.0 and bn[0, 1, 0, 0] == -50.0

@@ -19,7 +19,20 @@ Tiers (reference model: tests/python/unittest/test_operator.py — the
   so coverage claims stay auditable.
 """
 
+import math
+
 import numpy as np
+
+try:
+    import scipy.special  # noqa: F401
+    _HAVE_SCIPY = True
+except ImportError:
+    _HAVE_SCIPY = False
+
+
+def _digamma_ref(x, eps=1e-5):
+    # central difference of lgamma: accurate to ~1e-6 for x in [0.5, 3]
+    return (math.lgamma(x + eps) - math.lgamma(x - eps)) / (2 * eps)
 import pytest
 
 import mxnet_tpu as mx
@@ -68,6 +81,9 @@ UNARY = {
               True),
     "gammaln": (lambda x: np.vectorize(__import__("math").lgamma)(x), 0.5, 3,
                 True),
+    "digamma": (lambda x: __import__("scipy.special", fromlist=["digamma"])
+                .digamma(x) if _HAVE_SCIPY
+                else np.vectorize(_digamma_ref)(x), 0.5, 3, True),
     "log": (np.log, 0.1, 3, True),
     "log10": (np.log10, 0.1, 3, True),
     "log1p": (np.log1p, -0.5, 3, True),

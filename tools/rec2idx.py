@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Rebuild the .idx for an existing RecordIO .rec file (reference:
+tools/rec2idx.py — sequential scan recording each record's byte
+offset, so indexed/partitioned readers work on .rec files that shipped
+without their index)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.recordio import MXRecordIO
+
+
+def rec2idx(rec_path, idx_path):
+    reader = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as idx:
+        while True:
+            pos = reader.tell()
+            buf = reader.read()
+            if buf is None:
+                break
+            idx.write("%d\t%d\n" % (n, pos))
+            n += 1
+    reader.close()
+    return n
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="generate an index file for a RecordIO file")
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", nargs="?", default=None,
+                   help="output .idx path (default: alongside the .rec)")
+    args = p.parse_args(argv)
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = rec2idx(args.record, idx)
+    print("wrote %s (%d records)" % (idx, n))
+    return n
+
+
+if __name__ == "__main__":
+    main()
