@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Gluon walkthrough (reference: example/gluon/mnist.py — imperative
+define-by-run training with autograd + Trainer, then hybridize())."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Gluon example")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--no-hybridize", action="store_true",
+                   help="stay on the imperative define-by-run path")
+    args = p.parse_args(argv)
+    mx.random.seed(7)
+
+    from mxnet_tpu.io.io import MNISTIter
+
+    train = MNISTIter(image="train", batch_size=args.batch_size)
+    val = MNISTIter(image="val", batch_size=args.batch_size, shuffle=False)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    if not args.no_hybridize:
+        net.hybridize()   # stage the whole forward into one XLA program
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        train.reset()
+        tot = nb = 0.0
+        for batch in train:
+            data, label = batch.data[0], batch.label[0]
+            with mx.autograd.record():
+                L = ce(net(data), label)
+            L.backward()
+            trainer.step(args.batch_size)
+            tot += float(L.mean().asnumpy())
+            nb += 1
+        print("epoch %d: loss %.4f" % (epoch, tot / nb))
+
+    acc = hits = n = 0
+    val.reset()
+    for batch in val:
+        out = net(batch.data[0]).asnumpy()
+        hits += int((out.argmax(1) == batch.label[0].asnumpy()).sum())
+        n += out.shape[0]
+    acc = hits / n
+    print("val accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

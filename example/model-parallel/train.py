@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Model parallelism (reference: example/model-parallel — manual layer
+placement via group2ctx; here the TPU-native form: per-parameter
+PartitionSpecs over a device mesh, GSPMD inserting the collectives).
+See docs/faq/model_parallel.md."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.gluon_step import GluonTrainStep
+from mxnet_tpu.parallel.mesh import create_mesh
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="tensor/model parallel example")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel ranks (default: all visible "
+                        "devices; run under XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8 "
+                        "JAX_PLATFORMS=cpu to simulate a mesh)")
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--steps", type=int, default=25)
+    p.add_argument("--lr", type=float, default=0.2)
+    args = p.parse_args(argv)
+    mx.random.seed(7)
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if not args.tp:
+        args.tp = len(jax.devices())
+    mesh = create_mesh({"tp": args.tp})
+    net = nn.HybridSequential(prefix="mp_")
+    with net.name_scope():
+        net.add(nn.Dense(args.hidden, activation="relu", in_units=16),
+                nn.Dense(args.hidden, activation="relu",
+                         in_units=args.hidden),
+                nn.Dense(4, in_units=args.hidden))
+    net.initialize(mx.init.Xavier())
+
+    def spec_fn(name, shape):
+        # row-shard every big (out, in) weight over 'tp'; GSPMD inserts
+        # the all-gathers (the group2ctx analog)
+        if name.endswith("weight") and len(shape) == 2 \
+                and shape[0] % args.tp == 0:
+            return P("tp", None)
+        return P()
+
+    step = GluonTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, lr=args.lr, momentum=0.9,
+                          param_spec_fn=spec_fn, data_spec=P())
+    sharded = [p_ for p_, v in zip(step.trainable, step.train_vals)
+               if "tp" in str(getattr(v.sharding, "spec", ""))]
+    print("tp-sharded params:", [q.name for q in sharded])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = (x @ rng.randn(16, 4)).argmax(1).astype(np.int32)
+    losses = []
+    for _ in range(args.steps):
+        losses.append(float(np.asarray(step(x, y))))
+    print("loss %.4f -> %.4f" % (losses[0], losses[-1]))
+    step.sync_to_params()   # checkpoint through the normal Gluon API
+    return losses
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Stochastic depth (reference: example/stochastic-depth — residual
+blocks randomly skipped during training, all active at inference with
+survival-probability scaling; Huang et al. 2016)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+class StochasticResidual(gluon.Block):
+    """Residual block skipped with probability 1 - p_survive in train
+    mode; output scaled by p_survive at inference."""
+
+    def __init__(self, units, p_survive, **kwargs):
+        super().__init__(**kwargs)
+        self.p_survive = float(p_survive)
+        with self.name_scope():
+            self.body = nn.Dense(units, activation="relu", flatten=False,
+                                 in_units=units)
+
+    def forward(self, x):
+        if mx.autograd.is_training():
+            if np.random.rand() < self.p_survive:
+                return x + self.body(x)
+            return x
+        return x + self.p_survive * self.body(x)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="stochastic depth")
+    p.add_argument("--depth", type=int, default=6)
+    p.add_argument("--units", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=80)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args(argv)
+    mx.random.seed(7)
+    np.random.seed(7)
+
+    net = nn.Sequential()
+    net.add(nn.Dense(args.units, activation="relu", in_units=12))
+    # linearly decaying survival probability (the paper's schedule)
+    for i in range(args.depth):
+        p_surv = 1.0 - 0.5 * (i + 1) / args.depth
+        net.add(StochasticResidual(args.units, p_surv))
+    net.add(nn.Dense(3, in_units=args.units))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 12).astype(np.float32)
+    y = (x @ rng.randn(12, 3)).argmax(1).astype(np.float32)
+    xs, ys = mx.nd.array(x), mx.nd.array(y)
+    for epoch in range(args.epochs):
+        with mx.autograd.record():
+            L = ce(net(xs), ys)
+        L.backward()
+        trainer.step(len(x))
+    out = net(xs).asnumpy()          # inference: all blocks, scaled
+    acc = float((out.argmax(1) == y).mean())
+    print("train accuracy (full-depth inference) %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

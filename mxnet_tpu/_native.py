@@ -92,7 +92,8 @@ def _declare(lib):
                                     ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                     ctypes.c_int, ctypes.c_int,
                                     ctypes.c_float, ctypes.c_float,
-                                    ctypes.c_float, ctypes.POINTER(vp)],
+                                    ctypes.c_float, DECODE_FN, vp,
+                                    ctypes.POINTER(vp)],
         "MXTPUPipelineHasJpeg": [],
         "MXTPUPipelineNext": [vp, ctypes.POINTER(
             ctypes.POINTER(ctypes.c_uint8)),

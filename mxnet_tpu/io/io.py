@@ -771,9 +771,25 @@ class _NativePipeline:
         if builtin_jpeg is not None:
             # fully-native JPEG route: decode + augment inside the C++
             # worker pool (pipeline.cc DecodeJpeg) — zero Python in the
-            # loop, like the raw path
+            # loop.  A Python callback rides along as the per-record
+            # fallback for non-JPEG payloads in mixed .rec files
             c, h, w = self.sample_shape
             mean = builtin_jpeg.get("mean", (0.0, 0.0, 0.0))
+
+            def _fb(_ctx, rec_ptr, rec_len, data_out, label_out):
+                try:
+                    rec = ctypes.string_at(rec_ptr, rec_len)
+                    dv = _np.ctypeslib.as_array(data_out,
+                                                (self._sample_elems * 4,))
+                    lv = _np.ctypeslib.as_array(label_out, (label_width,))
+                    owner._decode_into(rec, dv.view(_np.float32), lv)
+                    return 0
+                except Exception:
+                    import traceback
+                    self._decode_error = traceback.format_exc()
+                    return 1
+
+            self._fallback_cb = _native.DECODE_FN(_fb)  # keep alive
             hnd = ctypes.c_void_p()
             _native.check_call(lib.MXTPUPipelineCreateJpeg(
                 path.encode(), 8 << 20, part_index, num_parts, batch_size,
@@ -782,6 +798,7 @@ class _NativePipeline:
                 int(bool(builtin_jpeg.get("rand_crop"))),
                 int(bool(builtin_jpeg.get("rand_mirror"))),
                 float(mean[0]), float(mean[1]), float(mean[2]),
+                self._fallback_cb, None,
                 ctypes.byref(hnd)))
             self._h = hnd
             self._cb = None

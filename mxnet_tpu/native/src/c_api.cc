@@ -184,7 +184,8 @@ MXTPU_EXPORT int MXTPUPipelineCreateJpeg(
     int batch_size, uint64_t sample_bytes, int label_width, int shuffle,
     uint64_t seed, int num_workers, int queue_depth, int last_batch_keep,
     int img_h, int img_w, int img_c, int rand_crop, int rand_mirror,
-    float mean_r, float mean_g, float mean_b, void** out) {
+    float mean_r, float mean_g, float mean_b, mxtpu::DecodeFn fallback,
+    void* fallback_ctx, void** out) {
   MXTPU_API_BEGIN();
   PipelineConfig cfg;
   cfg.path = path;
@@ -208,6 +209,8 @@ MXTPU_EXPORT int MXTPUPipelineCreateJpeg(
   cfg.mean[0] = mean_r;
   cfg.mean[1] = mean_g;
   cfg.mean[2] = mean_b;
+  cfg.jpeg_fallback = fallback;
+  cfg.decode_ctx = fallback_ctx;
   *out = new Pipeline(cfg);
   MXTPU_API_END();
 }

@@ -309,10 +309,12 @@ int Pipeline::DecodeJpeg(const uint8_t*, uint32_t, uint8_t*, float*,
 #endif
 
 void Pipeline::DecodeLoop(int worker_idx) {
-  // per-worker rng: cfg seed + worker index — crops/mirrors differ
-  // across workers yet reproduce exactly for a fixed seed
+  // per-worker rng: cfg seed + worker index + epoch — crops/mirrors
+  // differ across workers AND epochs (like the shuffle rng in IoLoop)
+  // yet reproduce exactly for a fixed seed
   std::mt19937 rng(static_cast<uint32_t>(
-      cfg_.seed * 2654435761u + 0x9E3779B9u * (worker_idx + 1)));
+      cfg_.seed * 2654435761u + 0x9E3779B9u * (worker_idx + 1) +
+      0x85EBCA6Bu * epoch_));
   for (;;) {
     Work w;
     {
@@ -345,6 +347,13 @@ void Pipeline::DecodeLoop(int worker_idx) {
       } else if (cfg_.builtin_jpeg) {
         rc = DecodeJpeg(w.recs[i].data(),
                         static_cast<uint32_t>(w.recs[i].size()), d, l, &rng);
+        if (rc == -10 && cfg_.jpeg_fallback) {
+          // non-JPEG payload (e.g. a PNG in a mixed .rec): route this
+          // record through the Python callback instead of failing
+          rc = cfg_.jpeg_fallback(cfg_.decode_ctx, w.recs[i].data(),
+                                  static_cast<uint32_t>(w.recs[i].size()),
+                                  d, l);
+        }
       } else {
         rc = DecodeRaw(w.recs[i].data(),
                        static_cast<uint32_t>(w.recs[i].size()), d, l);

@@ -55,6 +55,7 @@ struct PipelineConfig {
   // python _augment chain: decode -> random/center crop-or-pad to
   // (img_h, img_w) -> optional mirror -> float32 CHW minus mean.
   int builtin_jpeg = 0;
+  DecodeFn jpeg_fallback = nullptr;  // called for non-JPEG payloads
   int img_h = 0, img_w = 0, img_c = 3;
   int rand_crop = 0;
   int rand_mirror = 0;

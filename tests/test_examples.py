@@ -375,3 +375,23 @@ def test_rec2idx_roundtrip(tmp_path):
     for i in (0, 3, 9, 5):
         assert r.read_idx(i) == payloads[i]
     sys.path.pop(0)
+
+
+def test_module_api_walkthrough():
+    acc = _run_example("module/mnist_mlp.py", ["--epochs", "2"])
+    assert acc > 0.9, acc
+
+
+def test_gluon_walkthrough():
+    acc = _run_example("gluon/mnist.py", ["--epochs", "2"])
+    assert acc > 0.9, acc
+
+
+def test_model_parallel_example():
+    losses = _run_example("model-parallel/train.py", ["--steps", "20"])
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_stochastic_depth_example():
+    acc = _run_example("stochastic-depth/train.py", ["--epochs", "60"])
+    assert acc > 0.85, acc

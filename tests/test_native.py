@@ -507,3 +507,46 @@ def test_native_jpeg_pipeline_matches_python(tmp_path):
     bp = next(iter(pyp)).data[0].asnumpy()
     assert np.abs(bn - bp).max() <= 1.0
     assert bn[0, 0, 0, 0] == -100.0 and bn[0, 1, 0, 0] == -50.0
+
+
+def test_native_jpeg_mixed_records_fallback(tmp_path):
+    """A mixed .rec (JPEG + PNG payloads) on the builtin JPEG path
+    routes non-JPEG records through the Python fallback callback
+    per-record instead of failing mid-epoch (r3 review)."""
+    PIL = pytest.importorskip("PIL")
+    from io import BytesIO
+
+    from PIL import Image
+
+    from mxnet_tpu.io.io import ImageRecordIter, _native_has_jpeg
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack, pack_img
+
+    if not _native_has_jpeg():
+        pytest.skip("libmxtpu built without libjpeg")
+    rng = np.random.RandomState(0)
+    rec = MXIndexedRecordIO(str(tmp_path / "m.idx"), str(tmp_path / "m.rec"),
+                            "w")
+    imgs = []
+    for i in range(8):
+        img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        if i % 2 == 0:
+            rec.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img,
+                                      quality=100))
+        else:  # PNG payload in the same file
+            buff = BytesIO()
+            Image.fromarray(img).save(buff, format="PNG")
+            rec.write_idx(i, pack(IRHeader(0, float(i), i, 0),
+                                  buff.getvalue()))
+    rec.close()
+    it = ImageRecordIter(str(tmp_path / "m.rec"), (3, 32, 32), batch_size=8)
+    assert it._pipe is not None and it._pipe._cb is None  # builtin selected
+    batch = next(iter(it))
+    labels = batch.label[0].asnumpy()
+    np.testing.assert_array_equal(np.sort(labels), np.arange(8.0))
+    data = batch.data[0].asnumpy()
+    # PNG records are lossless: their pixels must match exactly
+    for i in range(1, 8, 2):
+        row = np.where(labels == i)[0][0]
+        np.testing.assert_array_equal(
+            data[row], imgs[i].astype(np.float32).transpose(2, 0, 1))
