@@ -23,12 +23,12 @@ ps-lite's ZMQ transport this is an unauthenticated intra-cluster
 protocol (only run it on trusted networks; the launcher binds loopback
 by default) — but data messages are decoded with an unpickler that
 admits only builtins and numpy array/dtype reconstruction, so a rogue
-peer cannot execute code via the data plane.  The one deliberately
-code-executing payload is the ``set_optimizer`` blob: it travels as
-opaque bytes inside a data message and is full-unpickled only inside
-the explicit set_optimizer handler (the reference has the same trust
-shape: the worker ships a pickled Optimizer to the server,
-python/mxnet/kvstore.py set_optimizer).
+peer cannot execute code via the data plane.  The ``set_optimizer``
+blob (r3) is decoded by an ALLOWLISTED unpickler that admits only
+classes from this framework's optimizer/lr_scheduler modules plus the
+numpy reconstructors — the worker still ships its configured Optimizer
+instance like the reference (python/mxnet/kvstore.py set_optimizer),
+but a rogue peer can no longer reach arbitrary globals through it.
 """
 
 from __future__ import annotations
@@ -54,6 +54,27 @@ _SAFE_PICKLE_GLOBALS = {
 }
 
 
+class _OptimizerUnpickler(pickle.Unpickler):
+    """Unpickler for the set_optimizer blob: admits optimizer and
+    lr-scheduler classes from THIS framework (the worker legitimately
+    ships its configured Optimizer instance), numpy reconstruction, and
+    builtin containers — nothing else, so no os/subprocess/… gadgets."""
+
+    _ALLOWED_PREFIXES = ("mxnet_tpu.optimizer", "mxnet_tpu.lr_scheduler")
+
+    def find_class(self, module, name):
+        if module.startswith(self._ALLOWED_PREFIXES):
+            return super().find_class(module, name)
+        for mod, names in _SAFE_PICKLE_GLOBALS:
+            if module == mod and name in names:
+                return super().find_class(module, name)
+        if module == "numpy.dtypes":
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            "optimizer blob references forbidden global %s.%s"
+            % (module, name))
+
+
 class _DataUnpickler(pickle.Unpickler):
     """Unpickler for wire messages: numpy + builtins containers only."""
 
@@ -65,6 +86,41 @@ class _DataUnpickler(pickle.Unpickler):
             return super().find_class(module, name)
         raise pickle.UnpicklingError(
             "wire message references forbidden global %s.%s" % (module, name))
+
+
+class _OptimizerUnpickler(_DataUnpickler):
+    """Unpickler for the set_optimizer blob: extends the data-message
+    allowlist with optimizer and lr-scheduler CLASSES from this
+    framework (the worker legitimately ships its configured Optimizer
+    instance).  Every framework-module global must (a) be a plain name
+    — dotted names would let proto-4 getattr traversal reach an allowed
+    module's imports (e.g. ``pickle.loads``), which is exactly the
+    bypass this class exists to prevent — and (b) resolve to an
+    Optimizer or LRScheduler subclass.  Operators running custom
+    optimizers over dist_async list the defining modules in
+    MXTPU_PS_OPTIMIZER_MODULES (comma-separated; same class checks
+    apply) — the reference has the same trust shape, where the server
+    process must import the user's optimizer module to unpickle it."""
+
+    _PREFIXES = ("mxnet_tpu.optimizer", "mxnet_tpu.lr_scheduler")
+
+    def find_class(self, module, name):
+        extra = tuple(m for m in os.environ.get(
+            "MXTPU_PS_OPTIMIZER_MODULES", "").split(",") if m)
+        allowed = any(module == p or module.startswith(p + ".")
+                      for p in self._PREFIXES + extra)
+        if allowed and "." not in name:
+            obj = super(_DataUnpickler, self).find_class(module, name)
+            from ..lr_scheduler import LRScheduler
+            from ..optimizer import Optimizer
+
+            if isinstance(obj, type) and issubclass(
+                    obj, (Optimizer, LRScheduler)):
+                return obj
+            raise pickle.UnpicklingError(
+                "optimizer blob global %s.%s is not an Optimizer/"
+                "LRScheduler class" % (module, name))
+        return super().find_class(module, name)
 
 
 def key_to_int(key):
@@ -247,9 +303,11 @@ class PSServer:
     def _set_optimizer(self, blob):
         from .. import optimizer as opt_mod
 
-        # full pickle by design: the worker ships its Optimizer instance,
-        # exactly like the reference's kv.set_optimizer pickled blob
-        optimizer = pickle.loads(blob)
+        # the worker ships its Optimizer instance like the reference's
+        # kv.set_optimizer pickled blob, but decoding is allowlisted to
+        # this framework's optimizer/scheduler classes (r3; closes the
+        # r2 residual wire caveat)
+        optimizer = _OptimizerUnpickler(io.BytesIO(blob)).load()
         self._updater = opt_mod.get_updater(optimizer)
 
     def _command(self, head, body):

@@ -256,3 +256,42 @@ def test_kvstore_errors():
     kv.init("a", mx.nd.ones((1,)))
     with pytest.raises(mx.MXNetError):
         kv.init("a", mx.nd.ones((1,)))
+
+
+def test_ps_optimizer_blob_allowlisted():
+    """The dist_async set_optimizer wire blob admits framework
+    optimizer/scheduler classes but rejects arbitrary globals (r3;
+    closes the r2 review's residual PS-wire caveat)."""
+    import pickle
+
+    from mxnet_tpu.kvstore.ps import _OptimizerUnpickler
+    import io as _io
+
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    blob = pickle.dumps(opt)
+    out = _OptimizerUnpickler(_io.BytesIO(blob)).load()
+    assert isinstance(out, mx.optimizer.Adam)
+    assert out.lr == 0.01
+    # scheduler classes are on the allowlist too
+    sched = pickle.dumps(mx.lr_scheduler.FactorScheduler(step=10, factor=0.9))
+    assert _OptimizerUnpickler(_io.BytesIO(sched)).load().factor == 0.9
+
+    class Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    with pytest.raises(pickle.UnpicklingError, match="forbidden"):
+        _OptimizerUnpickler(_io.BytesIO(pickle.dumps(Evil()))).load()
+
+    # the proto-4 dotted-name traversal bypass (resolving an allowed
+    # module's own imports, e.g. pickle.loads) must be rejected
+    mod, name = b"mxnet_tpu.optimizer.optimizer", b"pickle.loads"
+    bypass = (b"\x80\x04" + b"\x8c" + bytes([len(mod)]) + mod
+              + b"\x8c" + bytes([len(name)]) + name + b"\x93" + b".")
+    with pytest.raises(pickle.UnpicklingError, match="forbidden"):
+        _OptimizerUnpickler(_io.BytesIO(bypass)).load()
+    # non-class globals from allowed modules are rejected too
+    direct = pickle.dumps(mx.optimizer.get_updater)  # a function
+    with pytest.raises(pickle.UnpicklingError):
+        _OptimizerUnpickler(_io.BytesIO(direct)).load()
