@@ -54,27 +54,6 @@ _SAFE_PICKLE_GLOBALS = {
 }
 
 
-class _OptimizerUnpickler(pickle.Unpickler):
-    """Unpickler for the set_optimizer blob: admits optimizer and
-    lr-scheduler classes from THIS framework (the worker legitimately
-    ships its configured Optimizer instance), numpy reconstruction, and
-    builtin containers — nothing else, so no os/subprocess/… gadgets."""
-
-    _ALLOWED_PREFIXES = ("mxnet_tpu.optimizer", "mxnet_tpu.lr_scheduler")
-
-    def find_class(self, module, name):
-        if module.startswith(self._ALLOWED_PREFIXES):
-            return super().find_class(module, name)
-        for mod, names in _SAFE_PICKLE_GLOBALS:
-            if module == mod and name in names:
-                return super().find_class(module, name)
-        if module == "numpy.dtypes":
-            return super().find_class(module, name)
-        raise pickle.UnpicklingError(
-            "optimizer blob references forbidden global %s.%s"
-            % (module, name))
-
-
 class _DataUnpickler(pickle.Unpickler):
     """Unpickler for wire messages: numpy + builtins containers only."""
 
@@ -105,8 +84,8 @@ class _OptimizerUnpickler(_DataUnpickler):
     _PREFIXES = ("mxnet_tpu.optimizer", "mxnet_tpu.lr_scheduler")
 
     def find_class(self, module, name):
-        extra = tuple(m for m in os.environ.get(
-            "MXTPU_PS_OPTIMIZER_MODULES", "").split(",") if m)
+        extra = tuple(m.strip() for m in os.environ.get(
+            "MXTPU_PS_OPTIMIZER_MODULES", "").split(",") if m.strip())
         allowed = any(module == p or module.startswith(p + ".")
                       for p in self._PREFIXES + extra)
         if allowed and "." not in name:

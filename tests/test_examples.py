@@ -395,3 +395,8 @@ def test_model_parallel_example():
 def test_stochastic_depth_example():
     acc = _run_example("stochastic-depth/train.py", ["--epochs", "60"])
     assert acc > 0.85, acc
+
+
+def test_svrg_example_converges():
+    mses = _run_example("svrg_module/train.py", ["--epochs", "10"])
+    assert mses[-1] < 0.01 * mses[0], mses
