@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Capsule network with dynamic routing (reference: example/capsnet —
+Sabour et al. 2017: primary capsules -> routing-by-agreement to digit
+capsules, margin loss on capsule lengths).
+
+Scaled for CI: small conv trunk, 2 routing iterations, synthetic
+quadrant-blob images (class = bright quadrant).  The routing loop is
+a fixed-iteration jax-friendly computation (no data-dependent control
+flow), so the whole forward stages into one XLA program.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def squash(s, axis=-1):
+    """v = |s|^2/(1+|s|^2) * s/|s| (the capsule nonlinearity)."""
+    sq = (s ** 2).sum(axis=axis, keepdims=True)
+    norm = mx.nd.sqrt(sq + 1e-9)
+    return (sq / (1.0 + sq)) * (s / norm)
+
+
+class CapsNet(gluon.Block):
+    def __init__(self, num_classes=4, prim_caps=8, prim_dim=4,
+                 digit_dim=8, routing_iters=2, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.prim_dim = prim_dim
+        self.digit_dim = digit_dim
+        if routing_iters < 1:
+            raise ValueError("routing_iters must be >= 1")
+        self.routing_iters = routing_iters
+        with self.name_scope():
+            self.conv = nn.Conv2D(16, 5, strides=2, activation="relu")
+            self.primary = nn.Conv2D(prim_caps * prim_dim, 3, strides=2)
+            # transformation matrices u_hat = W u: one (prim_dim,
+            # digit_dim) map per class, applied to every primary capsule
+            # (weight-shared routing, the memory-light CapsNet variant)
+            self.route_w = nn.Dense(num_classes * digit_dim,
+                                    flatten=False)
+
+    def forward(self, x):
+        h = self.conv(x)
+        p = self.primary(h)                       # (B, C*D, H, W)
+        B = p.shape[0]
+        prim = p.reshape((B, self.prim_dim, -1)).transpose((0, 2, 1))
+        prim = squash(prim)                       # (B, N, prim_dim)
+        N = prim.shape[1]
+        # u_hat: (B, N, classes, digit_dim)
+        u_hat = self.route_w(prim).reshape((B, N, self.num_classes,
+                                            self.digit_dim))
+
+        # routing by agreement (fixed iterations, softmax over classes);
+        # the final iteration skips the agreement update, whose result
+        # would be discarded
+        b_logits = mx.nd.zeros((B, N, self.num_classes))
+        for it in range(self.routing_iters):
+            c = mx.nd.softmax(b_logits, axis=2)   # coupling coefficients
+            s = (c.reshape((B, N, self.num_classes, 1)) * u_hat).sum(axis=1)
+            v = squash(s)                         # (B, classes, digit_dim)
+            if it < self.routing_iters - 1:
+                agree = (u_hat * v.reshape((B, 1, self.num_classes,
+                                            self.digit_dim))).sum(axis=3)
+                b_logits = b_logits + agree
+        return mx.nd.sqrt((v ** 2).sum(axis=2) + 1e-9)  # capsule lengths
+
+
+def margin_loss(lengths, label, num_classes, m_pos=0.9, m_neg=0.1,
+                lam=0.5):
+    onehot = mx.nd.one_hot(label, num_classes)
+    pos = onehot * mx.nd.clip(m_pos - lengths, 0, 1e9) ** 2
+    neg = lam * (1 - onehot) * mx.nd.clip(lengths - m_neg, 0, 1e9) ** 2
+    return (pos + neg).sum(axis=1)
+
+
+def make_data(rng, n, hw=16, num_classes=4):
+    x = (rng.rand(n, 1, hw, hw) * 0.2).astype(np.float32)
+    y = rng.randint(0, num_classes, n).astype(np.float32)
+    h = hw // 2
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        x[i, 0, r * h:(r + 1) * h, c * h:(c + 1) * h] += 0.8
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="capsule network")
+    p.add_argument("--num-examples", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--routing-iters", type=int, default=2)
+    args = p.parse_args(argv)
+    args.batch_size = min(args.batch_size, args.num_examples)
+    mx.random.seed(7)
+
+    rng = np.random.RandomState(0)
+    x, y = make_data(rng, args.num_examples)
+    xv, yv = make_data(np.random.RandomState(99), 128)
+
+    net = CapsNet(routing_iters=args.routing_iters)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    B = args.batch_size
+    for epoch in range(args.epochs):
+        tot = nb = 0.0
+        for i in range(0, args.num_examples - B + 1, B):
+            data = mx.nd.array(x[i:i + B])
+            label = mx.nd.array(y[i:i + B])
+            with mx.autograd.record():
+                lengths = net(data)
+                L = margin_loss(lengths, label, net.num_classes).mean()
+            L.backward()
+            trainer.step(B)
+            tot += float(L.asnumpy())
+            nb += 1
+        print("epoch %d: margin loss %.4f" % (epoch, tot / nb))
+
+    pred = net(mx.nd.array(xv)).asnumpy().argmax(axis=1)
+    acc = float((pred == yv).mean())
+    print("val accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

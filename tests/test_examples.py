@@ -400,3 +400,10 @@ def test_stochastic_depth_example():
 def test_svrg_example_converges():
     mses = _run_example("svrg_module/train.py", ["--epochs", "10"])
     assert mses[-1] < 0.01 * mses[0], mses
+
+
+def test_capsnet_routing_converges():
+    """Dynamic routing-by-agreement + margin loss (reference:
+    example/capsnet, Sabour et al. 2017)."""
+    acc = _run_example("capsnet/train.py", ["--epochs", "16"])
+    assert acc >= 0.85, acc
