@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Sequence tagging with a BiLSTM (reference:
+example/named_entity_recognition — token-level classification with
+padded variable-length sentences, per-timestep softmax and masked
+loss/metrics).
+
+Synthetic NER (zero-egress container): sentences draw filler tokens
+plus entity spans from a designated vocab range; an entity token is
+tagged B/I by position in its span, everything else O.  Variable
+lengths are padded to one static shape and masked — the TPU-idiomatic
+bucketing alternative (docs/faq/bucketing.md).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, rnn
+
+VOCAB = 50
+ENTITY_LO = 40            # tokens >= ENTITY_LO form entity spans
+TAGS = 3                  # O=0, B=1, I=2
+SEQ = 20
+
+
+def make_data(rng, n):
+    x = np.zeros((n, SEQ), np.int32)
+    tags = np.zeros((n, SEQ), np.float32)
+    lengths = rng.randint(SEQ // 2, SEQ + 1, n).astype(np.float32)
+    for i in range(n):
+        L = int(lengths[i])
+        x[i, :L] = rng.randint(1, ENTITY_LO, L)
+        t = 0
+        while t < L:
+            if rng.rand() < 0.2:                  # start an entity span
+                span = min(rng.randint(1, 4), L - t)
+                x[i, t:t + span] = rng.randint(ENTITY_LO, VOCAB, span)
+                tags[i, t] = 1                     # B
+                tags[i, t + 1:t + span] = 2        # I
+                t += span
+            else:
+                t += 1
+    return x, tags, lengths
+
+
+class Tagger(gluon.Block):
+    def __init__(self, hidden=32, emb=16, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embed = nn.Embedding(VOCAB, emb)
+            self.lstm = rnn.LSTM(hidden, layout="NTC", bidirectional=True)
+            self.out = nn.Dense(TAGS, flatten=False)
+
+    def forward(self, tokens):
+        return self.out(self.lstm(self.embed(tokens)))  # (N, T, TAGS)
+
+
+def masked_loss(logits, tags, lengths):
+    logp = mx.nd.log_softmax(logits, axis=-1)
+    ce = -mx.nd.pick(logp, tags, axis=-1)               # (N, T)
+    # valid-position mask from lengths (the SequenceMask semantics)
+    steps = mx.nd.arange(0, SEQ).reshape((1, SEQ))
+    mask = (steps < lengths.reshape((-1, 1))).astype("float32")
+    return (ce * mask).sum() / mask.sum()
+
+
+def tag_f1(net, x, tags, lengths):
+    pred = net(mx.nd.array(x, dtype="int32")).asnumpy().argmax(-1)
+    steps = np.arange(SEQ)[None, :]
+    mask = steps < lengths[:, None]
+    tp = ((pred > 0) & (tags > 0) & (pred == tags) & mask).sum()
+    fp = ((pred > 0) & ((tags == 0) | (pred != tags)) & mask).sum()
+    fn = ((tags > 0) & ((pred == 0) | (pred != tags)) & mask).sum()
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    return 2 * prec * rec / max(prec + rec, 1e-9)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="BiLSTM sequence tagger")
+    p.add_argument("--num-examples", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--lr", type=float, default=1e-2)
+    args = p.parse_args(argv)
+    args.batch_size = min(args.batch_size, args.num_examples)
+    mx.random.seed(7)
+
+    rng = np.random.RandomState(0)
+    x, tags, lengths = make_data(rng, args.num_examples)
+    xv, tagv, lenv = make_data(np.random.RandomState(99), 128)
+
+    net = Tagger()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    B = args.batch_size
+    for epoch in range(args.epochs):
+        tot = nb = 0.0
+        for i in range(0, args.num_examples - B + 1, B):
+            tok = mx.nd.array(x[i:i + B], dtype="int32")
+            tg = mx.nd.array(tags[i:i + B])
+            ln = mx.nd.array(lengths[i:i + B])
+            with mx.autograd.record():
+                L = masked_loss(net(tok), tg, ln)
+            L.backward()
+            trainer.step(B)
+            tot += float(L.asnumpy())
+            nb += 1
+        f1 = tag_f1(net, xv, tagv, lenv)
+        print("epoch %d: masked ce %.4f, val entity F1 %.3f"
+              % (epoch, tot / nb, f1))
+    return f1
+
+
+if __name__ == "__main__":
+    main()

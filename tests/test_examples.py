@@ -407,3 +407,11 @@ def test_capsnet_routing_converges():
     example/capsnet, Sabour et al. 2017)."""
     acc = _run_example("capsnet/train.py", ["--epochs", "16"])
     assert acc >= 0.85, acc
+
+
+def test_ner_tagger_f1():
+    """Masked BiLSTM sequence tagging (reference:
+    example/named_entity_recognition)."""
+    f1 = _run_example("named_entity_recognition/train.py",
+                      ["--epochs", "10"])
+    assert f1 >= 0.8, f1
