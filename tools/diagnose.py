@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Diagnose script: OS / hardware / python / pip / framework / TPU / network.
+
+Role parity with the reference's ``tools/diagnose.py`` (180 lines: prints
+platform, pip, mxnet build features, CPU info, and timed URL reachability
+so bug reports carry the environment).  This version reports the things
+that matter for a TPU/XLA deployment instead of a CUDA one: the jax
+backend and device inventory, XLA/JAX environment flags, and the
+framework's own feature set from ``mxnet_tpu.runtime``.
+
+Usage::
+
+    python tools/diagnose.py                 # everything except network
+    python tools/diagnose.py --network 1     # include URL timing checks
+"""
+import argparse
+import os
+import platform
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), '..'))
+
+URLS = {
+    'PYPI': 'https://pypi.python.org/pypi/pip',
+    'JAX releases': 'https://storage.googleapis.com/jax-releases/jax_releases.html',
+}
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+        description='Diagnose the current system for bug reports.')
+    for choice in ('python', 'pip', 'framework', 'os', 'hardware', 'environment'):
+        p.add_argument('--' + choice, default=1, type=int,
+                       help='Diagnose {}.'.format(choice))
+    p.add_argument('--network', default=0, type=int,
+                   help='Diagnose network (off by default: many TPU pods have no egress).')
+    p.add_argument('--timeout', default=10, type=int,
+                   help='Connection test timeout in seconds, 0 to disable.')
+    return p.parse_args()
+
+
+def _section(title):
+    print('----------' + title + '----------')
+
+
+def check_python():
+    _section('Python Info')
+    print('Version      :', platform.python_version())
+    print('Compiler     :', platform.python_compiler())
+    print('Build        :', platform.python_build())
+    print('Arch         :', platform.architecture())
+
+
+def check_pip():
+    _section('Pip Info')
+    try:
+        import pip
+        print('Version      :', pip.__version__)
+        print('Directory    :', os.path.dirname(pip.__file__))
+    except ImportError:
+        print('No corresponding pip install for current python.')
+
+
+def check_framework():
+    _section('Framework Info')
+    try:
+        t0 = time.time()
+        import mxnet_tpu as mx
+        print('Version      :', getattr(mx, '__version__', 'unknown'))
+        print('Directory    :', os.path.dirname(mx.__file__))
+        print('Import time  : %.3f s' % (time.time() - t0,))
+        try:
+            from mxnet_tpu.runtime import Features
+            feats = Features()
+            enabled = sorted(n for n in feats.keys() if feats.is_enabled(n))
+            print('Features     :', ', '.join(enabled))
+        except Exception as e:  # pragma: no cover - informational only
+            print('Features     : <unavailable: %s>' % (e,))
+    except ImportError as e:
+        print('No framework installed:', e)
+        return
+    try:
+        import jax
+        print('jax          :', jax.__version__)
+        print('Backend      :', jax.default_backend())
+        devs = jax.devices()
+        print('Devices      : %d x %s' % (len(devs), devs[0].platform if devs else '?'))
+        for d in devs[:8]:
+            print('  -', d)
+        if len(devs) > 8:
+            print('  ... and %d more' % (len(devs) - 8,))
+    except Exception as e:
+        print('jax          : <unavailable: %s>' % (e,))
+
+
+def check_os():
+    _section('Platform Info')
+    print('Platform     :', platform.platform())
+    print('system       :', platform.system())
+    print('node         :', platform.node())
+    print('release      :', platform.release())
+    print('version      :', platform.version())
+
+
+def check_hardware():
+    _section('Hardware Info')
+    print('machine      :', platform.machine())
+    print('processor    :', platform.processor())
+    try:
+        if sys.platform.startswith('linux'):
+            subprocess.call(['lscpu'])
+        elif sys.platform.startswith('darwin'):
+            subprocess.call(['sysctl', '-n', 'machdep.cpu.brand_string'])
+    except OSError as e:
+        print('CPU info     : <unavailable: %s>' % (e,))
+
+
+def check_environment():
+    _section('Environment')
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(('MXNET_', 'MXTPU_', 'XLA_', 'JAX_', 'TPU_', 'OMP_',
+                         'KMP_', 'LD_LIBRARY_PATH', 'DMLC_')):
+            print('%-32s: %s' % (k, v))
+
+
+def test_connection(name, url, timeout=10):
+    try:
+        from urllib.request import urlopen
+        from urllib.parse import urlparse
+    except ImportError:  # py2, not supported but keep the message sane
+        print('urllib unavailable'); return
+    urlinfo = urlparse(url)
+    start = time.time()
+    try:
+        socket.gethostbyname(urlinfo.hostname)
+    except Exception as e:
+        print('Error resolving DNS for {}: {}, {}'.format(name, url, e))
+        return
+    dns_elapsed = time.time() - start
+    start = time.time()
+    try:
+        urlopen(url, timeout=timeout if timeout > 0 else None)
+    except Exception as e:
+        print('Error open {}: {}, {}, DNS finished in {} sec.'.format(
+            name, url, e, dns_elapsed))
+        return
+    load_elapsed = time.time() - start
+    print('Timing for {}: {}, DNS: {:.4f} sec, LOAD: {:.4f} sec.'.format(
+        name, url, dns_elapsed, load_elapsed))
+
+
+def check_network(timeout):
+    _section('Network Test')
+    if timeout > 0:
+        print('Setting timeout: {}'.format(timeout))
+        socket.setdefaulttimeout(timeout)
+    for name, url in sorted(URLS.items()):
+        test_connection(name, url, timeout)
+
+
+def main():
+    args = parse_args()
+    if args.hardware:
+        check_hardware()
+    if args.os:
+        check_os()
+    if args.environment:
+        check_environment()
+    if args.python:
+        check_python()
+    if args.pip:
+        check_pip()
+    if args.framework:
+        check_framework()
+    if args.network:
+        check_network(args.timeout)
+
+
+if __name__ == '__main__':
+    main()
