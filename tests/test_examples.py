@@ -415,3 +415,12 @@ def test_ner_tagger_f1():
     f1 = _run_example("named_entity_recognition/train.py",
                       ["--epochs", "10"])
     assert f1 >= 0.8, f1
+
+
+def test_bi_lstm_sort_learns():
+    """Character-level sorting with a bidirectional LSTM (reference:
+    example/bi-lstm-sort/bi-lstm-sort.ipynb)."""
+    acc = _run_example("bi-lstm-sort/sort_lstm.py",
+                       ["--epochs", "14", "--dataset-size", "2000",
+                        "--hidden", "64"])
+    assert acc >= 0.7, acc

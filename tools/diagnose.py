@@ -128,11 +128,8 @@ def check_environment():
 
 
 def test_connection(name, url, timeout=10):
-    try:
-        from urllib.request import urlopen
-        from urllib.parse import urlparse
-    except ImportError:  # py2, not supported but keep the message sane
-        print('urllib unavailable'); return
+    from urllib.request import urlopen
+    from urllib.parse import urlparse
     urlinfo = urlparse(url)
     start = time.time()
     try:
