@@ -1,0 +1,321 @@
+#!/usr/bin/env python
+"""Bayesian learning demos: SGLD, HMC, and Bayesian Dark Knowledge
+(reference: example/bayesian-methods/{algos.py,bdk_demo.py,utils.py} —
+[ICML2011] Stochastic Gradient Langevin Dynamics and [NIPS2015]
+Bayesian Dark Knowledge).
+
+Four modes, mirroring the reference demo's flows on its two datasets:
+
+* ``toy-sgld``       — SGLD posterior sampling of an MLP on the BDK toy
+                       regression; predictive mean averaged over thinned
+                       post-burn-in samples.
+* ``toy-hmc``        — full-batch Hamiltonian Monte Carlo with leapfrog
+                       integration and Metropolis correction on the same
+                       model (reference algos.py:52 step_HMC).
+* ``toy-distilled``  — DistilledSGLD: a student MLP distills the
+                       teacher's SGLD predictive mean at perturbed
+                       inputs (reference algos.py:231).
+* ``synthetic``      — the Welling–Teh bimodal mixture posterior.  The
+                       reference runs a 1,000,000-iteration Python loop
+                       (bdk_demo.py:316 run_synthetic_SGLD); here the
+                       whole chain is ONE ``mx.nd.contrib.foreach`` scan
+                       — minibatch indices, injected noise, and the
+                       polynomial step-size schedule are precomputed
+                       arrays scanned over, so the chain compiles to a
+                       single XLA While loop (TPU-idiomatic: no
+                       per-iteration dispatch).
+
+Data is generated in-process (zero-egress container): the toy set is
+the BDK paper's ``y = x + 0.3 sin(2 pi x) + eps``.
+"""
+
+import argparse
+import math
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class SGLDScheduler(mx.lr_scheduler.LRScheduler):
+    """Polynomial decay eps_t = a (b + t)^-factor hitting begin/end rates
+    (reference utils.py:29)."""
+
+    def __init__(self, begin_rate, end_rate, total_iter_num, factor):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError("factor must be < 1 to make lr decay")
+        self.b = (total_iter_num - 1.0) / (
+            (begin_rate / end_rate) ** (1.0 / factor) - 1.0)
+        self.a = begin_rate / (self.b ** (-factor))
+        self.factor = factor
+
+    def __call__(self, num_update):
+        return self.a * ((self.b + num_update) ** (-self.factor))
+
+
+def load_toy(rng, n_train=400, n_test=200):
+    def f(x):
+        return x + 0.3 * np.sin(2 * np.pi * x)
+
+    x = rng.uniform(0.0, 1.0, (n_train, 1))
+    y = f(x) + rng.normal(0, 0.05, x.shape)
+    x_test = np.linspace(0.0, 1.0, n_test).reshape(n_test, 1)
+    return (x.astype(np.float32), y.astype(np.float32),
+            x_test.astype(np.float32), f(x_test).astype(np.float32))
+
+
+def make_mlp(hidden=64):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(1))
+    return net
+
+
+def _rmse(pred, truth):
+    return float(np.sqrt(np.mean((pred - truth) ** 2)))
+
+
+def run_toy_SGLD(args, rng):
+    """SGLD over MLP weights; returns predictive-mean RMSE vs the true
+    function (reference algos.py:171 SGLD, 'regression' task)."""
+    X, Y, X_test, Y_truth = load_toy(rng)
+    n = len(X)
+    noise_precision = 1.0 / (0.05 ** 2)
+    net = make_mlp()
+    net.initialize(mx.init.Uniform(0.07))
+    sched = SGLDScheduler(args.lr, args.lr / 10, args.iters, 0.55)
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgld",
+        {"learning_rate": args.lr, "lr_scheduler": sched,
+         "wd": args.prior_precision})
+
+    pred_sum = np.zeros_like(Y_truth)
+    n_samples = 0
+    for it in range(args.iters):
+        idx = rng.randint(0, n, args.batch_size)
+        data, label = mx.nd.array(X[idx]), mx.nd.array(Y[idx])
+        with autograd.record():
+            out = net(data)
+            # U(w) = noise_prec/2 * N/m * sum minibatch SE  (prior via wd)
+            loss = (noise_precision / 2.0) * (n / args.batch_size) \
+                * ((out - label) ** 2).sum()
+        loss.backward()
+        # grad is d U; SGLD updater adds eps/2 * grad + N(0, eps) noise
+        trainer.step(1)
+        if it >= args.burn_in and (it - args.burn_in) % args.thin == 0:
+            pred_sum += net(mx.nd.array(X_test)).asnumpy()
+            n_samples += 1
+    rmse = _rmse(pred_sum / max(n_samples, 1), Y_truth)
+    print("toy-sgld: %d posterior samples, predictive RMSE %.4f"
+          % (n_samples, rmse))
+    return rmse
+
+
+def _potential(net, params, X, Y, noise_precision, prior_precision):
+    out = net(X)
+    nll = (noise_precision / 2.0) * ((out - Y) ** 2).sum()
+    prior = sum((prior_precision / 2.0) * (p.data() ** 2).sum()
+                for p in params)
+    return nll + prior
+
+
+def run_toy_HMC(args, rng):
+    """Full-batch HMC with L leapfrog steps + Metropolis correction
+    (reference algos.py:52 step_HMC / :103 HMC)."""
+    X, Y, X_test, Y_truth = load_toy(rng)
+    noise_precision = 1.0 / (0.05 ** 2)
+    prior_precision = 1.0
+    net = make_mlp(hidden=32)
+    net.initialize(mx.init.Uniform(0.07))
+    data, label = mx.nd.array(X), mx.nd.array(Y)
+    net(data)                       # materialize deferred-init shapes
+    params = list(net.collect_params().values())
+    L, eps = args.hmc_L, args.hmc_eps
+
+    def grads():
+        with autograd.record():
+            U = _potential(net, params, data, label,
+                           noise_precision, prior_precision)
+        U.backward()
+        return U
+
+    accepted = 0
+    pred_sum = np.zeros_like(Y_truth)
+    n_samples = 0
+    U0 = float(_potential(net, params, data, label,
+                          noise_precision, prior_precision).asscalar())
+    for it in range(args.iters):
+        w0 = [p.data().copy() for p in params]
+        mom = [mx.nd.array(rng.normal(0, 1, p.shape).astype(np.float32))
+               for p in params]
+        K0 = sum(float((m ** 2).sum().asscalar()) for m in mom) / 2.0
+        # leapfrog: half-step momentum, L full position steps
+        grads()
+        mom = [m - (eps / 2) * p.grad() for m, p in zip(mom, params)]
+        for l in range(L):
+            for p, m in zip(params, mom):
+                p.set_data(p.data() + eps * m)
+            grads()
+            if l < L - 1:
+                mom = [m - eps * p.grad() for m, p in zip(mom, params)]
+        mom = [m - (eps / 2) * p.grad() for m, p in zip(mom, params)]
+        U1 = float(_potential(net, params, data, label,
+                              noise_precision, prior_precision).asscalar())
+        K1 = sum(float((m ** 2).sum().asscalar()) for m in mom) / 2.0
+        dH = (U0 + K0) - (U1 + K1)
+        # divergent (non-finite) proposals are always rejected
+        if math.isfinite(dH) and rng.rand() < math.exp(min(0.0, dH)):
+            accepted += 1
+            U0 = U1
+        else:
+            for p, w in zip(params, w0):
+                p.set_data(w)
+        if it >= args.burn_in:
+            pred_sum += net(mx.nd.array(X_test)).asnumpy()
+            n_samples += 1
+    rate = accepted / float(args.iters)
+    rmse = _rmse(pred_sum / max(n_samples, 1), Y_truth)
+    print("toy-hmc: accept rate %.2f, predictive RMSE %.4f" % (rate, rmse))
+    return rmse, rate
+
+
+def run_toy_DistilledSGLD(args, rng):
+    """Teacher SGLD chain distilled online into a student MLP evaluated
+    at Gaussian-perturbed minibatch inputs (reference algos.py:231)."""
+    X, Y, X_test, Y_truth = load_toy(rng)
+    n = len(X)
+    noise_precision = 1.0 / (0.05 ** 2)
+    teacher, student = make_mlp(), make_mlp()
+    teacher.initialize(mx.init.Uniform(0.07))
+    student.initialize(mx.init.Uniform(0.07))
+    t_sched = SGLDScheduler(args.lr, args.lr / 10, args.iters, 0.55)
+    t_trainer = gluon.Trainer(
+        teacher.collect_params(), "sgld",
+        {"learning_rate": args.lr, "lr_scheduler": t_sched,
+         "wd": args.prior_precision})
+    s_trainer = gluon.Trainer(student.collect_params(), "adam",
+                              {"learning_rate": 1e-2})
+    s_loss = gluon.loss.L2Loss()
+
+    for it in range(args.iters):
+        idx = rng.randint(0, n, args.batch_size)
+        data, label = mx.nd.array(X[idx]), mx.nd.array(Y[idx])
+        with autograd.record():
+            out = teacher(data)
+            loss = (noise_precision / 2.0) * (n / args.batch_size) \
+                * ((out - label) ** 2).sum()
+        loss.backward()
+        t_trainer.step(1)
+        if it >= args.burn_in:
+            # student regresses on the teacher sample's prediction at
+            # perturbed inputs (perturb_deviation=0.1 in the reference)
+            pdata = mx.nd.array(
+                X[idx] + rng.normal(0, 0.1, (args.batch_size, 1))
+                .astype(np.float32))
+            t_pred = teacher(pdata)
+            with autograd.record():
+                l = s_loss(student(pdata), t_pred)
+            l.backward()
+            s_trainer.step(args.batch_size)
+    rmse = _rmse(student(mx.nd.array(X_test)).asnumpy(), Y_truth)
+    print("toy-distilled: student predictive RMSE %.4f" % rmse)
+    return rmse
+
+
+# The two modes of p(theta|X): (0, 1) and roughly (1, -1).
+SYN_MODES = np.array([[0.0, 1.0], [1.0, -1.0]])
+
+
+def run_synthetic_SGLD(args, rng):
+    """Welling–Teh mixture posterior, the WHOLE chain as one foreach
+    scan (reference bdk_demo.py:316 loops 1e6 times in Python and
+    recomputes the analytic gradient in numpy each step;
+    bdk_demo.py:121 synthetic_grad)."""
+    theta1, theta2 = 0.0, 1.0
+    sigma1, sigma2, sigmax = math.sqrt(10), 1.0, math.sqrt(2)
+    n = 100
+    flag = rng.randint(0, 2, n)
+    X_np = (flag * rng.normal(theta1, sigmax, n)
+            + (1 - flag) * rng.normal(theta1 + theta2, sigmax, n))
+
+    T = args.iters
+    sched = SGLDScheduler(0.01, 0.0001, T, 0.55)
+    lrs = np.array([sched(t) for t in range(T)], np.float32)
+    idxs = rng.randint(0, n, T).astype(np.float32)
+    noise = rng.normal(0, 1, (T, 2)).astype(np.float32)
+
+    Xd = mx.nd.array(X_np.astype(np.float32))
+    v1, v2, vx = sigma1 ** 2, sigma2 ** 2, sigmax ** 2
+
+    def body(step, states):
+        lr_t, ind, eta = step
+        theta = states[0]
+        x = mx.nd.take(Xd, ind)                      # minibatch of one
+        t1 = mx.nd.slice_axis(theta, axis=0, begin=0, end=1)
+        t2 = mx.nd.slice_axis(theta, axis=0, begin=1, end=2)
+        e1 = mx.nd.exp(-((x - t1) ** 2) / (2 * vx))
+        e2 = mx.nd.exp(-((x - t1 - t2) ** 2) / (2 * vx))
+        den = e1 + e2
+        # d/dtheta of -log p, minibatch-rescaled by n (reference math)
+        g1 = -float(n) * ((e1 * (x - t1) / vx
+                           + e2 * (x - t1 - t2) / vx) / den) + t1 / v1
+        g2 = -float(n) * ((e2 * (x - t1 - t2) / vx) / den) + t2 / v2
+        grad = mx.nd.concat(g1, g2, dim=0)
+        new_theta = theta - lr_t / 2 * grad + mx.nd.sqrt(lr_t) * eta
+        return new_theta, [new_theta]
+
+    theta0 = mx.nd.array(rng.normal(0, 1, 2).astype(np.float32))
+    samples, _ = mx.nd.contrib.foreach(
+        body,
+        [mx.nd.array(lrs), mx.nd.array(idxs), mx.nd.array(noise)],
+        [theta0])
+    samples = samples.asnumpy()[args.burn_in:]
+    d = np.minimum(
+        ((samples - SYN_MODES[0]) ** 2).sum(1),
+        ((samples - SYN_MODES[1]) ** 2).sum(1))
+    mean_mode_dist = float(np.sqrt(d).mean())
+    print("synthetic: %d samples, mean distance to nearest mode %.3f, "
+          "theta std (%.3f, %.3f)"
+          % (len(samples), mean_mode_dist,
+             samples[:, 0].std(), samples[:, 1].std()))
+    return mean_mode_dist, samples
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", default="toy-sgld",
+                   choices=["toy-sgld", "toy-hmc", "toy-distilled",
+                            "synthetic"])
+    p.add_argument("--iters", type=int, default=2000)
+    p.add_argument("--burn-in", type=int, default=300)
+    p.add_argument("--thin", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=4e-6)
+    p.add_argument("--prior-precision", type=float, default=1.0)
+    p.add_argument("--hmc-L", type=int, default=10)
+    p.add_argument("--hmc-eps", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=100)
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    if args.mode == "toy-sgld":
+        return run_toy_SGLD(args, rng)
+    if args.mode == "toy-hmc":
+        return run_toy_HMC(args, rng)
+    if args.mode == "toy-distilled":
+        return run_toy_DistilledSGLD(args, rng)
+    return run_synthetic_SGLD(args, rng)
+
+
+if __name__ == "__main__":
+    main()

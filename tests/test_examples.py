@@ -417,6 +417,44 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_bayesian_sgld_toy_posterior():
+    """SGLD posterior predictive on the BDK toy regression (reference:
+    example/bayesian-methods, algos.py SGLD)."""
+    rmse = _run_example("bayesian-methods/bdk_demo.py",
+                        ["--mode", "toy-sgld", "--iters", "800",
+                         "--burn-in", "300"])
+    assert rmse < 0.25, rmse
+
+
+def test_bayesian_hmc_toy():
+    """Leapfrog HMC with Metropolis correction (reference:
+    example/bayesian-methods, algos.py step_HMC/HMC)."""
+    rmse, rate = _run_example("bayesian-methods/bdk_demo.py",
+                              ["--mode", "toy-hmc", "--iters", "100",
+                               "--burn-in", "40"])
+    assert rmse < 0.25, rmse
+    assert 0.3 < rate <= 1.0, rate
+
+
+def test_bayesian_distilled_sgld():
+    """Bayesian Dark Knowledge distillation (reference:
+    example/bayesian-methods, algos.py DistilledSGLD)."""
+    rmse = _run_example("bayesian-methods/bdk_demo.py",
+                        ["--mode", "toy-distilled", "--iters", "1200",
+                         "--burn-in", "300"])
+    assert rmse < 0.25, rmse
+
+
+def test_bayesian_synthetic_sgld_scan():
+    """Welling-Teh mixture posterior as ONE foreach scan (reference:
+    example/bayesian-methods bdk_demo.py run_synthetic_SGLD)."""
+    dist, samples = _run_example("bayesian-methods/bdk_demo.py",
+                                 ["--mode", "synthetic", "--iters", "4000",
+                                  "--burn-in", "500"])
+    assert dist < 0.8, dist           # chain stays in high-probability region
+    assert samples.std(axis=0).min() > 0.02   # and actually moves
+
+
 def test_bi_lstm_sort_learns():
     """Character-level sorting with a bidirectional LSTM (reference:
     example/bi-lstm-sort/bi-lstm-sort.ipynb)."""
