@@ -84,12 +84,11 @@ def _rmse(pred, truth):
     return float(np.sqrt(np.mean((pred - truth) ** 2)))
 
 
-def run_toy_SGLD(args, rng):
-    """SGLD over MLP weights; returns predictive-mean RMSE vs the true
-    function (reference algos.py:171 SGLD, 'regression' task)."""
-    X, Y, X_test, Y_truth = load_toy(rng)
-    n = len(X)
-    noise_precision = 1.0 / (0.05 ** 2)
+NOISE_PRECISION = 1.0 / (0.05 ** 2)     # matches load_toy's noise sd
+
+
+def _make_sgld_teacher(args):
+    """MLP + SGLD trainer shared by the toy-sgld and distilled modes."""
     net = make_mlp()
     net.initialize(mx.init.Uniform(0.07))
     sched = SGLDScheduler(args.lr, args.lr / 10, args.iters, 0.55)
@@ -97,24 +96,45 @@ def run_toy_SGLD(args, rng):
         net.collect_params(), "sgld",
         {"learning_rate": args.lr, "lr_scheduler": sched,
          "wd": args.prior_precision})
+    return net, trainer
+
+
+def _sgld_step(net, trainer, X, Y, idx, n, batch_size):
+    """One SGLD draw: grad of U(w) = noise_prec/2 * N/m * minibatch SE
+    (prior enters via wd); the SGLD updater adds eps/2 * grad and the
+    N(0, eps) injected noise."""
+    data, label = mx.nd.array(X[idx]), mx.nd.array(Y[idx])
+    with autograd.record():
+        out = net(data)
+        loss = (NOISE_PRECISION / 2.0) * (n / batch_size) \
+            * ((out - label) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+
+
+def _predictive_mean(pred_sum, n_samples):
+    if n_samples == 0:
+        raise ValueError("no posterior samples collected: "
+                         "burn-in >= iters")
+    return pred_sum / n_samples
+
+
+def run_toy_SGLD(args, rng):
+    """SGLD over MLP weights; returns predictive-mean RMSE vs the true
+    function (reference algos.py:171 SGLD, 'regression' task)."""
+    X, Y, X_test, Y_truth = load_toy(rng)
+    n = len(X)
+    net, trainer = _make_sgld_teacher(args)
 
     pred_sum = np.zeros_like(Y_truth)
     n_samples = 0
     for it in range(args.iters):
         idx = rng.randint(0, n, args.batch_size)
-        data, label = mx.nd.array(X[idx]), mx.nd.array(Y[idx])
-        with autograd.record():
-            out = net(data)
-            # U(w) = noise_prec/2 * N/m * sum minibatch SE  (prior via wd)
-            loss = (noise_precision / 2.0) * (n / args.batch_size) \
-                * ((out - label) ** 2).sum()
-        loss.backward()
-        # grad is d U; SGLD updater adds eps/2 * grad + N(0, eps) noise
-        trainer.step(1)
+        _sgld_step(net, trainer, X, Y, idx, n, args.batch_size)
         if it >= args.burn_in and (it - args.burn_in) % args.thin == 0:
             pred_sum += net(mx.nd.array(X_test)).asnumpy()
             n_samples += 1
-    rmse = _rmse(pred_sum / max(n_samples, 1), Y_truth)
+    rmse = _rmse(_predictive_mean(pred_sum, n_samples), Y_truth)
     print("toy-sgld: %d posterior samples, predictive RMSE %.4f"
           % (n_samples, rmse))
     return rmse
@@ -132,7 +152,7 @@ def run_toy_HMC(args, rng):
     """Full-batch HMC with L leapfrog steps + Metropolis correction
     (reference algos.py:52 step_HMC / :103 HMC)."""
     X, Y, X_test, Y_truth = load_toy(rng)
-    noise_precision = 1.0 / (0.05 ** 2)
+    noise_precision = NOISE_PRECISION
     prior_precision = 1.0
     net = make_mlp(hidden=32)
     net.initialize(mx.init.Uniform(0.07))
@@ -151,31 +171,29 @@ def run_toy_HMC(args, rng):
     accepted = 0
     pred_sum = np.zeros_like(Y_truth)
     n_samples = 0
-    U0 = float(_potential(net, params, data, label,
-                          noise_precision, prior_precision).asscalar())
     for it in range(args.iters):
         w0 = [p.data().copy() for p in params]
         mom = [mx.nd.array(rng.normal(0, 1, p.shape).astype(np.float32))
                for p in params]
         K0 = sum(float((m ** 2).sum().asscalar()) for m in mom) / 2.0
-        # leapfrog: half-step momentum, L full position steps
-        grads()
+        # leapfrog: half-step momentum, L full position steps; each
+        # grads() call also returns U at the current position, giving
+        # U0 (start) and U1 (end) without extra potential evaluations
+        U0 = float(grads().asscalar())
         mom = [m - (eps / 2) * p.grad() for m, p in zip(mom, params)]
+        U1 = U0
         for l in range(L):
             for p, m in zip(params, mom):
                 p.set_data(p.data() + eps * m)
-            grads()
+            U1 = float(grads().asscalar())
             if l < L - 1:
                 mom = [m - eps * p.grad() for m, p in zip(mom, params)]
         mom = [m - (eps / 2) * p.grad() for m, p in zip(mom, params)]
-        U1 = float(_potential(net, params, data, label,
-                              noise_precision, prior_precision).asscalar())
         K1 = sum(float((m ** 2).sum().asscalar()) for m in mom) / 2.0
         dH = (U0 + K0) - (U1 + K1)
         # divergent (non-finite) proposals are always rejected
         if math.isfinite(dH) and rng.rand() < math.exp(min(0.0, dH)):
             accepted += 1
-            U0 = U1
         else:
             for p, w in zip(params, w0):
                 p.set_data(w)
@@ -183,7 +201,7 @@ def run_toy_HMC(args, rng):
             pred_sum += net(mx.nd.array(X_test)).asnumpy()
             n_samples += 1
     rate = accepted / float(args.iters)
-    rmse = _rmse(pred_sum / max(n_samples, 1), Y_truth)
+    rmse = _rmse(_predictive_mean(pred_sum, n_samples), Y_truth)
     print("toy-hmc: accept rate %.2f, predictive RMSE %.4f" % (rate, rmse))
     return rmse, rate
 
@@ -193,28 +211,16 @@ def run_toy_DistilledSGLD(args, rng):
     at Gaussian-perturbed minibatch inputs (reference algos.py:231)."""
     X, Y, X_test, Y_truth = load_toy(rng)
     n = len(X)
-    noise_precision = 1.0 / (0.05 ** 2)
-    teacher, student = make_mlp(), make_mlp()
-    teacher.initialize(mx.init.Uniform(0.07))
+    teacher, t_trainer = _make_sgld_teacher(args)
+    student = make_mlp()
     student.initialize(mx.init.Uniform(0.07))
-    t_sched = SGLDScheduler(args.lr, args.lr / 10, args.iters, 0.55)
-    t_trainer = gluon.Trainer(
-        teacher.collect_params(), "sgld",
-        {"learning_rate": args.lr, "lr_scheduler": t_sched,
-         "wd": args.prior_precision})
     s_trainer = gluon.Trainer(student.collect_params(), "adam",
                               {"learning_rate": 1e-2})
     s_loss = gluon.loss.L2Loss()
 
     for it in range(args.iters):
         idx = rng.randint(0, n, args.batch_size)
-        data, label = mx.nd.array(X[idx]), mx.nd.array(Y[idx])
-        with autograd.record():
-            out = teacher(data)
-            loss = (noise_precision / 2.0) * (n / args.batch_size) \
-                * ((out - label) ** 2).sum()
-        loss.backward()
-        t_trainer.step(1)
+        _sgld_step(teacher, t_trainer, X, Y, idx, n, args.batch_size)
         if it >= args.burn_in:
             # student regresses on the teacher sample's prediction at
             # perturbed inputs (perturb_deviation=0.1 in the reference)

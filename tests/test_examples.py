@@ -417,6 +417,14 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_lstnet_forecast_beats_mean():
+    """LSTNet CNN+GRU+skip-GRU+AR forecaster (reference:
+    example/multivariate_time_series/src/lstnet.py)."""
+    score = _run_example("multivariate_time_series/lstnet.py",
+                         ["--num-epochs", "3", "--t-len", "1200"])
+    assert score < 0.5, score
+
+
 def test_bayesian_sgld_toy_posterior():
     """SGLD posterior predictive on the BDK toy regression (reference:
     example/bayesian-methods, algos.py SGLD)."""
