@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""VAE-GAN: autoencoding beyond pixels with a learned similarity metric
+(reference: example/vae-gan/vaegan_mxnet.py — Larsen et al. 2016).
+
+Three networks trained jointly, as in the reference:
+
+* encoder E:    conv net -> (mu, log_var); z sampled by the
+                reparameterization trick.
+* generator G:  transposed-conv net decoding z to an image.
+* discriminator D: split like the reference's discriminator1 /
+                discriminator2 — a conv feature trunk l(x) and a
+                real/fake head on top of it.
+
+Losses (reference vaegan_mxnet.py:161-211):
+
+* KL(q(z|x) || N(0,1))                               -> E
+* Gaussian log-density of l(x) under l(G(E(x)))      -> E, G
+  (the "learned similarity" feature-matching term)
+* standard GAN BCE on real / G(E(x)) / G(z_prior)    -> D, G
+
+Data is an in-process shapes corpus (zero-egress container): 16x16
+one-channel images of axis-aligned bright rectangles on dark noise, so
+reconstruction quality is measurable against a known structure.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+IMG = 16
+
+
+def make_shapes(rng, n):
+    """Bright rectangles on dark noise."""
+    x = rng.uniform(0.0, 0.15, (n, 1, IMG, IMG)).astype(np.float32)
+    for i in range(n):
+        h, w = rng.randint(4, 10, 2)
+        r, c = rng.randint(0, IMG - h), rng.randint(0, IMG - w)
+        x[i, 0, r:r + h, c:c + w] = rng.uniform(0.75, 1.0)
+    return x
+
+
+class Encoder(gluon.HybridBlock):
+    def __init__(self, nef=16, z_dim=16, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.trunk = nn.HybridSequential()
+            self.trunk.add(
+                nn.Conv2D(nef, 4, strides=2, padding=1, activation="relu"),
+                nn.Conv2D(nef * 2, 4, strides=2, padding=1,
+                          activation="relu"),
+                nn.Flatten())
+            self.mu = nn.Dense(z_dim)
+            self.log_var = nn.Dense(z_dim)
+
+    def hybrid_forward(self, F, x):
+        h = self.trunk(x)
+        return self.mu(h), self.log_var(h)
+
+
+def make_generator(ngf=16):
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        net.add(nn.Dense(ngf * 2 * 4 * 4),
+                nn.HybridLambda(
+                    lambda F, x: F.reshape(x, (-1, ngf * 2, 4, 4))),
+                nn.Conv2DTranspose(ngf, 4, strides=2, padding=1,
+                                   activation="relu"),
+                nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                   activation="sigmoid"))
+    return net
+
+
+class Discriminator(gluon.HybridBlock):
+    """Feature trunk l(x) + real/fake head, mirroring the reference's
+    discriminator1/discriminator2 split so the feature-matching loss
+    can read l(x)."""
+
+    def __init__(self, ndf=16, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential()
+            self.features.add(
+                nn.Conv2D(ndf, 4, strides=2, padding=1,
+                          activation="relu"),
+                nn.Conv2D(ndf * 2, 4, strides=2, padding=1,
+                          activation="relu"),
+                nn.Flatten(), nn.Dense(64, activation="relu"))
+            self.head = nn.Dense(1)
+
+    def hybrid_forward(self, F, x):
+        l = self.features(x)
+        return self.head(l), l
+
+
+def kl_loss(mu, log_var):
+    """KL(q(z|x)||N(0,1)) (reference KLDivergenceLoss)."""
+    return -0.5 * (1 + log_var - mu ** 2
+                   - mx.nd.exp(log_var)).sum(axis=1).mean()
+
+
+def gaussian_ll_loss(feat_real, feat_recon):
+    """-log N(l(x); l(G(z)), I) up to a constant (reference
+    GaussianLogDensity with unit variance)."""
+    return 0.5 * ((feat_real - feat_recon) ** 2).sum(axis=1).mean()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-train", type=int, default=1024)
+    p.add_argument("--z-dim", type=int, default=16)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--g-dl-weight", type=float, default=0.1,
+                   help="weight of the GAN term against the "
+                        "feature-matching term in the G update")
+    p.add_argument("--seed", type=int, default=3)
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    X = make_shapes(rng, args.n_train)
+
+    enc = Encoder(z_dim=args.z_dim)
+    gen = make_generator()
+    disc = Discriminator()
+    for net in (enc, gen, disc):
+        net.initialize(mx.init.Xavier())
+    opts = {"learning_rate": args.lr, "beta1": args.beta1}
+    t_enc = gluon.Trainer(enc.collect_params(), "adam", opts)
+    t_gen = gluon.Trainer(gen.collect_params(), "adam", opts)
+    t_disc = gluon.Trainer(disc.collect_params(), "adam", opts)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    def recon_mse():
+        data = mx.nd.array(X[:256])
+        mu, _ = enc(data)
+        return float(((gen(mu) - data) ** 2).mean().asscalar())
+
+    mse0 = recon_mse()
+    nb = args.n_train // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(args.n_train)
+        d_sum = g_sum = kl_sum = 0.0
+        for b in range(nb):
+            data = mx.nd.array(X[perm[b * args.batch_size:
+                                      (b + 1) * args.batch_size]])
+            eps = mx.nd.array(rng.normal(
+                0, 1, (args.batch_size, args.z_dim)).astype(np.float32))
+            zp = mx.nd.array(rng.normal(
+                0, 1, (args.batch_size, args.z_dim)).astype(np.float32))
+            ones = mx.nd.ones((args.batch_size, 1))
+            zeros = mx.nd.zeros((args.batch_size, 1))
+
+            # --- D step: real vs reconstruction vs prior sample
+            mu, log_var = enc(data)
+            z = mu + mx.nd.exp(0.5 * log_var) * eps
+            recon, prior = gen(z), gen(zp)
+            with autograd.record():
+                out_r, _ = disc(data)
+                out_f, _ = disc(recon)
+                out_p, _ = disc(prior)
+                d_loss = (bce(out_r, ones) + bce(out_f, zeros)
+                          + bce(out_p, zeros)).mean()
+            d_loss.backward()
+            t_disc.step(1)
+
+            # --- G step: fool D + match D features of the real batch
+            _, feat_real = disc(data)
+            with autograd.record():
+                recon = gen(z)
+                prior = gen(zp)
+                out_f, feat_recon = disc(recon)
+                out_p, _ = disc(prior)
+                g_gan = (bce(out_f, ones) + bce(out_p, ones)).mean()
+                g_dl = gaussian_ll_loss(feat_real, feat_recon)
+                g_loss = args.g_dl_weight * g_gan + g_dl
+            g_loss.backward()
+            t_gen.step(1)
+
+            # --- E step: KL + feature-matching through the sampler
+            with autograd.record():
+                mu, log_var = enc(data)
+                z = mu + mx.nd.exp(0.5 * log_var) * eps
+                recon = gen(z)
+                _, feat_recon = disc(recon)
+                kl = kl_loss(mu, log_var)
+                e_loss = kl + gaussian_ll_loss(feat_real, feat_recon)
+            e_loss.backward()
+            t_enc.step(1)
+
+            d_sum += float(d_loss.asscalar())
+            g_sum += float(g_loss.asscalar())
+            kl_sum += float(kl.asscalar())
+        print("Epoch [%d] D %.3f G %.3f KL %.3f"
+              % (epoch, d_sum / nb, g_sum / nb, kl_sum / nb))
+
+    mse1 = recon_mse()
+    print("Reconstruction MSE %.4f -> %.4f" % (mse0, mse1))
+    return mse0, mse1
+
+
+if __name__ == "__main__":
+    main()

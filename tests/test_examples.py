@@ -417,6 +417,24 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_dec_clustering_refines_kmeans():
+    """Deep Embedded Clustering: layerwise-pretrained autoencoder,
+    k-means init, KL(p||q) refinement (reference:
+    example/deep-embedded-clustering/dec.py)."""
+    acc_kmeans, acc_dec = _run_example("deep-embedded-clustering/dec.py",
+                                       [])
+    assert acc_dec >= acc_kmeans, (acc_kmeans, acc_dec)
+    assert acc_dec > 0.8, acc_dec
+
+
+def test_vaegan_reconstruction_improves():
+    """VAE-GAN with discriminator-feature similarity loss (reference:
+    example/vae-gan/vaegan_mxnet.py, Larsen et al. 2016)."""
+    mse0, mse1 = _run_example("vae-gan/vaegan.py",
+                              ["--epochs", "6", "--n-train", "512"])
+    assert mse1 < 0.7 * mse0, (mse0, mse1)
+
+
 def test_lstnet_forecast_beats_mean():
     """LSTNet CNN+GRU+skip-GRU+AR forecaster (reference:
     example/multivariate_time_series/src/lstnet.py)."""
