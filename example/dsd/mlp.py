@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Dense-Sparse-Dense training of an MLP (reference: example/dsd/mlp.py).
+
+Phase D: ordinary SGD.  Phase S: SparseSGD prunes the smallest-magnitude
+weights each epoch and keeps them at zero.  Phase D2: sparsity drops to
+0 and the surviving topology is re-densified.  The point (Han et al.
+2017) is that D2 recovers or beats the original dense accuracy after
+escaping the sparse phase's saddle.
+
+Runs on the synthetic MNIST used across this repo's examples.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+from sparse_sgd import SparseSGD, sparsity_of
+
+
+def build_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(10))
+    return net
+
+
+def evaluate(net, X, y):
+    pred = net(mx.nd.array(X)).argmax(axis=1).asnumpy()
+    return float((pred == y).mean())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs-per-phase", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--sparsity", type=float, default=80.0,
+                   help="percent of weights pruned in the S phase")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.test_utils import get_mnist
+    mnist = get_mnist()
+    X, y = mnist["train_data"].reshape(-1, 784), mnist["train_label"]
+    Xv, yv = mnist["test_data"].reshape(-1, 784), mnist["test_label"]
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(X[:2]))
+    loss_fn = gluon.loss.SoftmaxCELoss()
+
+    n = len(X)
+    nb = n // args.batch_size
+    E = args.epochs_per_phase
+    # one optimizer drives all three phases: sparsity schedule
+    # [0 (D), sparsity (S), 0 (D2)] switching at epochs E and 2E
+    opt = SparseSGD(pruning_switch_epoch=[E, 2 * E], batches_per_epoch=nb,
+                    weight_sparsity=[0.0, args.sparsity, 0.0],
+                    bias_sparsity=[0.0, 0.0, 0.0],
+                    learning_rate=args.lr, momentum=args.momentum)
+    trainer = gluon.Trainer(net.collect_params(), opt)
+
+    stats = {}
+    for epoch in range(3 * E):
+        perm = rng.permutation(n)
+        for b in range(nb):
+            idx = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            data, label = mx.nd.array(X[idx]), mx.nd.array(y[idx])
+            with autograd.record():
+                l = loss_fn(net(data), label)
+            l.backward()
+            trainer.step(args.batch_size)
+        phase = "DSD"[min(epoch // E, 2)]
+        acc = evaluate(net, Xv, yv)
+        sp = sparsity_of(net)
+        print("Epoch %2d [%s] val acc %.4f sparsity %.3f"
+              % (epoch, phase, acc, sp))
+        if epoch == E - 1:
+            stats["dense_acc"] = acc
+        elif epoch == 2 * E - 1:
+            stats["sparse_acc"], stats["sparse_sparsity"] = acc, sp
+        elif epoch == 3 * E - 1:
+            stats["final_acc"] = acc
+    return stats
+
+
+if __name__ == "__main__":
+    print(main())

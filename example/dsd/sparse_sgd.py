@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""SGD with scheduled magnitude pruning for DSD training (reference:
+example/dsd/sparse_sgd.py — Han et al. 2017, "DSD: Dense-Sparse-Dense
+Training for Deep Neural Networks").
+
+The optimizer is plain SGD(+momentum) with a preprocessing step: when
+the epoch crosses an entry of ``pruning_switch_epoch`` the per-weight
+mask is recomputed (keep the largest (100-sparsity)% weights by
+magnitude, or threshold by absolute value), and on every update the
+weight, gradient, and momentum state are multiplied by the mask so
+pruned connections stay dead through the sparse phase.  A sparsity of
+0 restores dense training — the final D phase of DSD.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.optimizer import Optimizer, SGD, register
+
+
+@register
+class SparseSGD(SGD):
+    """SGD preprocessed by pruning masks on a per-epoch schedule."""
+
+    def __init__(self, pruning_switch_epoch, batches_per_epoch,
+                 weight_sparsity=None, bias_sparsity=None,
+                 weight_threshold=None, bias_threshold=None, **kwargs):
+        super().__init__(**kwargs)
+        self.masks = {}
+        self.epoch = 0
+        self.phase = 0                       # index into the schedules
+        self.pruning_switch_epoch = list(pruning_switch_epoch)
+        self.batches_per_epoch = batches_per_epoch
+        self.batch_count = 0
+        self.weight_sparsity = weight_sparsity
+        self.bias_sparsity = bias_sparsity
+        self.weight_threshold = weight_threshold
+        self.bias_threshold = bias_threshold
+        if weight_sparsity is not None:
+            assert len(weight_sparsity) == len(bias_sparsity), \
+                "weight and bias sparsity schedules must align"
+        else:
+            assert len(weight_threshold) == len(bias_threshold), \
+                "weight and bias threshold schedules must align"
+
+    def _is_bias(self, index):
+        p = getattr(self, "param_dict", {}).get(index)
+        name = p.name if p is not None else self.idx2name.get(
+            index, str(index))
+        return name.endswith("bias")
+
+    def _compute_mask(self, index, weight):
+        """Magnitude mask for the current phase (reference sparse_sgd.py
+        get_masks): sparsity% smallest |w| pruned, or |w| < threshold."""
+        wabs = mx.nd.abs(weight)
+        if self.weight_sparsity is not None:
+            sched = (self.bias_sparsity if self._is_bias(index)
+                     else self.weight_sparsity)
+            sparsity = sched[self.phase]
+            if sparsity <= 0:
+                return None                   # dense phase: no mask
+            keep = max(1, int(round(weight.size * (100.0 - sparsity)
+                                    / 100.0)))
+            flat = wabs.reshape((-1,))
+            kth = float(mx.nd.topk(flat, k=keep, ret_typ="value")
+                        .asnumpy()[-1])
+            return (wabs >= kth).astype(weight.dtype)
+        sched = (self.bias_threshold if self._is_bias(index)
+                 else self.weight_threshold)
+        thr = sched[self.phase]
+        if thr <= 0:
+            return None
+        return (wabs >= thr).astype(weight.dtype)
+
+    def _advance_epoch(self):
+        """Advance the batch/epoch counters and the pruning phase.
+        Runs at the START of each batch (before any masking) so every
+        parameter in a batch sees the same phase — advancing after the
+        first parameter's update would let the rest of that batch slip
+        into the next phase early."""
+        self.batch_count += 1
+        if self.batch_count > 1 \
+                and (self.batch_count - 1) % self.batches_per_epoch == 0:
+            self.epoch += 1
+            while (self.phase < len(self.pruning_switch_epoch)
+                   and self.epoch >= self.pruning_switch_epoch[self.phase]):
+                self.phase += 1
+                self.masks.clear()            # recompute at new sparsity
+
+    def update(self, index, weight, grad, state):
+        # tie the batch counter to the first index ever seen: it recurs
+        # exactly once per batch
+        if not hasattr(self, "_epoch_index"):
+            self._epoch_index = index
+        if index == self._epoch_index:
+            self._advance_epoch()
+        if index not in self.masks:
+            self.masks[index] = self._compute_mask(index, weight)
+        mask = self.masks[index]
+        if mask is not None:
+            weight[:] = weight * mask
+            grad[:] = grad * mask
+            if state is not None:
+                state[:] = state * mask
+        super().update(index, weight, grad, state)
+
+
+def sparsity_of(net):
+    """Fraction of exactly-zero weights across a Gluon net's params."""
+    zeros = total = 0
+    for p in net.collect_params().values():
+        a = p.data().asnumpy()
+        zeros += (a == 0).sum()
+        total += a.size
+    return zeros / float(total)

@@ -417,6 +417,23 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_captcha_whole_string_accuracy():
+    """Multi-digit captcha CNN with per-digit softmax heads (reference:
+    example/captcha/mxnet_captcha.R)."""
+    acc = _run_example("captcha/captcha_net.py",
+                       ["--epochs", "5", "--n-train", "2000"])
+    assert acc >= 0.8, acc
+
+
+def test_dsd_prune_and_redensify():
+    """Dense-Sparse-Dense training via a pruning SGD subclass
+    (reference: example/dsd/sparse_sgd.py, Han et al. 2017)."""
+    stats = _run_example("dsd/mlp.py", ["--epochs-per-phase", "2"])
+    assert stats["sparse_sparsity"] > 0.7, stats
+    assert stats["sparse_acc"] > 0.9, stats      # prune survives
+    assert stats["final_acc"] >= 0.95, stats     # D2 recovers dense
+
+
 def test_dec_clustering_refines_kmeans():
     """Deep Embedded Clustering: layerwise-pretrained autoencoder,
     k-means init, KL(p||q) refinement (reference:
