@@ -127,11 +127,10 @@ def main(argv=None):
             l.backward()
             trainer.step(args.batch_size)
             tot += float(l.mean().asscalar())
-        logits = net(mx.nd.array(Xt)).asnumpy()
-        acc = captcha_accuracy(logits, Yt)
+        acc = captcha_accuracy(net(mx.nd.array(Xt)).asnumpy(), Yt)
         print("Epoch [%d] loss %.4f captcha acc %.4f"
               % (epoch, tot / nb, acc))
-    return acc
+    return captcha_accuracy(net(mx.nd.array(Xt)).asnumpy(), Yt)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Character-level CNN text classification with pre-trained embeddings
+and a highway layer (reference:
+example/cnn_chinese_text_classification/text_cnn.py — the Chinese
+variant of Kim 2014: sentences tokenized to characters, embedded by a
+pre-trained word2vec table fed to the net as DENSE VECTORS, multi-width
+convolutions, then a highway network before the classifier).
+
+The two API-distinct pieces vs example/cnn_text_classification:
+
+* ``pre_trained_word2vec`` path: data enters as (N, 1, T, E) float
+  vectors — no Embedding layer in the graph (reference
+  build_input_data_with_word2vec / sym_gen's pre_trained_word2vec
+  branch);
+* ``highway()``: g = relu(W_h x + b_h); t = sigmoid(W_t x + b_t);
+  out = g * t + x * (1 - t) (reference text_cnn.py:79).
+
+The corpus is synthetic (zero-egress): a fixed random embedding table
+over a 500-"character" vocabulary; a sentence's class is decided by
+which character cluster dominates it.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+
+VOCAB = 500
+SEQ_LEN = 32
+NUM_EMBED = 24
+N_CLASSES = 4
+CLUSTER = VOCAB // N_CLASSES
+
+
+def make_corpus(rng, n):
+    """Class c's sentences oversample characters from cluster c."""
+    table = rng.normal(0, 1, (VOCAB, NUM_EMBED)).astype(np.float32)
+    y = rng.randint(0, N_CLASSES, n)
+    x_ids = rng.randint(0, VOCAB, (n, SEQ_LEN))
+    for i in range(n):
+        k = rng.randint(8, 16)
+        pos = rng.choice(SEQ_LEN, k, replace=False)
+        x_ids[i, pos] = rng.randint(y[i] * CLUSTER,
+                                    (y[i] + 1) * CLUSTER, k)
+    # the pre-trained-word2vec input path: embed on the host, feed vectors
+    x_vec = table[x_ids].reshape(n, 1, SEQ_LEN, NUM_EMBED)
+    return x_vec.astype(np.float32), y.astype(np.float32)
+
+
+def highway(data, num_hidden):
+    """Highway network block (reference text_cnn.py:79); num_hidden
+    must equal the input width so the carry gate can mix identity."""
+    g = mx.sym.FullyConnected(data, num_hidden=num_hidden,
+                              name="highway_g")
+    g = mx.sym.Activation(g, act_type="relu")
+    t = mx.sym.FullyConnected(data, num_hidden=num_hidden,
+                              name="highway_t")
+    t = mx.sym.Activation(t, act_type="sigmoid")
+    return g * t + data * (1.0 - t)
+
+
+def sym_gen(filter_widths=(2, 3, 4), num_filter=64, dropout=0.3):
+    data = mx.sym.Variable("data")          # (N, 1, T, E) vectors
+    label = mx.sym.Variable("softmax_label")
+    pooled = []
+    for width in filter_widths:
+        conv = mx.sym.Convolution(data, kernel=(width, NUM_EMBED),
+                                  num_filter=num_filter)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(
+            act, pool_type="max",
+            kernel=(SEQ_LEN - width + 1, 1)))
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h_pool = mx.sym.Reshape(concat,
+                            shape=(-1, num_filter * len(filter_widths)))
+    h_pool = highway(h_pool, num_filter * len(filter_widths))
+    if dropout > 0:
+        h_pool = mx.sym.Dropout(h_pool, p=dropout)
+    fc = mx.sym.FullyConnected(h_pool, num_hidden=N_CLASSES)
+    return mx.sym.SoftmaxOutput(fc, label=label, name="softmax")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--n-test", type=int, default=512)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--dropout", type=float, default=0.3)
+    p.add_argument("--seed", type=int, default=2)
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    X, y = make_corpus(rng, args.n_train + args.n_test)
+    Xt, yt = X[args.n_train:], y[args.n_train:]
+    X, y = X[:args.n_train], y[:args.n_train]
+
+    train_iter = mx.io.NDArrayIter(data=X, label=y,
+                                   batch_size=args.batch_size,
+                                   shuffle=True)
+    module = mx.mod.Module(sym_gen(dropout=args.dropout),
+                           data_names=("data",),
+                           label_names=("softmax_label",))
+    module.fit(train_iter, eval_metric="acc", optimizer="adam",
+               optimizer_params={"learning_rate": args.lr},
+               initializer=mx.init.Xavier(),
+               num_epoch=args.epochs)
+
+    test_iter = mx.io.NDArrayIter(data=Xt, label=yt,
+                                  batch_size=args.batch_size)
+    pred = module.predict(test_iter).asnumpy()[:len(yt)].argmax(1)
+    acc = float((pred == yt).mean())
+    print("Test accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -2,7 +2,8 @@
 """Dense-Sparse-Dense training of an MLP (reference: example/dsd/mlp.py).
 
 Phase D: ordinary SGD.  Phase S: SparseSGD prunes the smallest-magnitude
-weights each epoch and keeps them at zero.  Phase D2: sparsity drops to
+weights (mask fixed at the phase switch) and keeps them at zero.  Phase
+D2: sparsity drops to
 0 and the surviving topology is re-densified.  The point (Han et al.
 2017) is that D2 recovers or beats the original dense accuracy after
 escaping the sparse phase's saddle.

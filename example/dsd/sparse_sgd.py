@@ -43,11 +43,15 @@ class SparseSGD(SGD):
         self.weight_threshold = weight_threshold
         self.bias_threshold = bias_threshold
         if weight_sparsity is not None:
-            assert len(weight_sparsity) == len(bias_sparsity), \
-                "weight and bias sparsity schedules must align"
+            if bias_sparsity is None \
+                    or len(weight_sparsity) != len(bias_sparsity):
+                raise ValueError(
+                    "weight and bias sparsity schedules must align")
         else:
-            assert len(weight_threshold) == len(bias_threshold), \
-                "weight and bias threshold schedules must align"
+            if bias_threshold is None or weight_threshold is None \
+                    or len(weight_threshold) != len(bias_threshold):
+                raise ValueError(
+                    "weight and bias threshold schedules must align")
 
     def _is_bias(self, index):
         p = getattr(self, "param_dict", {}).get(index)

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""DeepSpeech-style speech recognition with CTC (reference:
+example/speech_recognition/ — arch_deepspeech.py's conv front-end +
+stacked recurrent layers + per-frame FC, trained with warp-CTC
+(stt_layer_warpctc.py) and scored by CER (stt_metric.py EvalSTTMetric)).
+
+Scaled to the container: the "speech" corpus is synthesized in-process
+(zero-egress) — each utterance is a sequence of phoneme tokens, each
+rendered as a variable-duration band of spectral energy in a mel-like
+filterbank with noise, coarticulation blur, and silence gaps.  The
+model is the same shape as the reference's: Conv2D over
+(time x frequency) patches, bidirectional LSTM, per-frame Dense, CTC.
+
+Greedy CTC decoding + edit-distance CER mirror stt_metric.py.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+
+N_PHONES = 8                 # alphabet (blank is index N_PHONES)
+N_MEL = 20                   # filterbank bins
+FRAMES_PER_TOKEN = 6
+
+
+def synth_utterance(rng, tokens):
+    """Render a token sequence to a (T, N_MEL) 'spectrogram'."""
+    frames = []
+    for tok in tokens:
+        dur = FRAMES_PER_TOKEN + rng.randint(-2, 3)
+        center = 2 + tok * 2
+        profile = np.exp(-0.5 * ((np.arange(N_MEL) - center) / 1.3) ** 2)
+        seg = profile[None, :] * rng.uniform(0.8, 1.2, (dur, 1))
+        frames.append(seg)
+        if rng.rand() < 0.3:                      # silence gap
+            frames.append(np.zeros((rng.randint(1, 3), N_MEL)))
+    spec = np.concatenate(frames, 0)
+    spec += rng.normal(0, 0.12, spec.shape)       # noise floor
+    # coarticulation blur along time
+    spec = 0.25 * np.roll(spec, 1, 0) + 0.5 * spec \
+        + 0.25 * np.roll(spec, -1, 0)
+    return spec.astype(np.float32)
+
+
+def make_data(rng, n, min_len=3, max_len=6, max_frames=60):
+    """Padded batch of utterances + padded labels + lengths."""
+    X = np.zeros((n, max_frames, N_MEL), np.float32)
+    Y = np.full((n, max_len), N_PHONES, np.float32)   # pad with blank
+    xlen = np.zeros(n, np.float32)
+    ylen = np.zeros(n, np.float32)
+    for i in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        tokens = rng.randint(0, N_PHONES, L)
+        spec = synth_utterance(rng, tokens)[:max_frames]
+        X[i, :len(spec)] = spec
+        Y[i, :L] = tokens
+        xlen[i], ylen[i] = len(spec), L
+    return X, Y, xlen, ylen
+
+
+class DeepSpeech(gluon.HybridBlock):
+    """Conv front-end + BiLSTM + per-frame head (reference
+    arch_deepspeech.py, downscaled)."""
+
+    def __init__(self, hidden=64, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv = nn.Conv2D(16, kernel_size=(5, N_MEL),
+                                  padding=(2, 0), activation="relu")
+            self.lstm = rnn.LSTM(hidden, layout="NTC",
+                                 bidirectional=True)
+            self.head = nn.Dense(N_PHONES + 1, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        # (N, T, F) -> (N, 1, T, F) -> conv -> (N, C, T, 1) -> (N, T, C)
+        h = self.conv(F.expand_dims(x, axis=1))
+        h = F.transpose(F.squeeze(h, axis=3), axes=(0, 2, 1))
+        return self.head(self.lstm(h))
+
+
+def greedy_decode(logits, xlen):
+    """Per-frame argmax, collapse repeats, drop blanks (reference
+    stt_metric.py ctc_greedy_decode)."""
+    out = []
+    for i in range(len(logits)):
+        path = logits[i, :int(xlen[i])].argmax(-1)
+        seq, prev = [], -1
+        for s in path:
+            if s != prev and s != N_PHONES:
+                seq.append(int(s))
+            prev = s
+        out.append(seq)
+    return out
+
+
+def edit_distance(a, b):
+    dp = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        prev, dp[0] = dp[0], i
+        for j in range(1, len(b) + 1):
+            cur = min(dp[j] + 1, dp[j - 1] + 1,
+                      prev + (a[i - 1] != b[j - 1]))
+            prev, dp[j] = dp[j], cur
+    return int(dp[-1])
+
+
+def cer(decoded, Y, ylen):
+    errs = chars = 0
+    for i, seq in enumerate(decoded):
+        truth = [int(t) for t in Y[i, :int(ylen[i])]]
+        errs += edit_distance(seq, truth)
+        chars += len(truth)
+    return errs / max(chars, 1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--n-test", type=int, default=256)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=9)
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+    X, Y, xlen, ylen = make_data(rng, args.n_train)
+    Xt, Yt, xlent, ylent = make_data(rng, args.n_test)
+
+    net = DeepSpeech(hidden=args.hidden)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+
+    nb = args.n_train // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(args.n_train)
+        tot = 0.0
+        for b in range(nb):
+            idx = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            data = mx.nd.array(X[idx])
+            label = mx.nd.array(Y[idx])
+            with autograd.record():
+                logits = net(data)
+                l = ctc(logits, label, mx.nd.array(xlen[idx]),
+                        mx.nd.array(ylen[idx]))
+            l.backward()
+            trainer.step(args.batch_size)
+            tot += float(l.mean().asscalar())
+        print("Epoch [%d] ctc loss %.4f" % (epoch, tot / nb))
+
+    logits = net(mx.nd.array(Xt)).asnumpy()
+    rate = cer(greedy_decode(logits, xlent), Yt, ylent)
+    print("Test CER %.4f" % rate)
+    return rate
+
+
+if __name__ == "__main__":
+    main()

@@ -417,6 +417,22 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_chinese_text_cnn_highway():
+    """Char-CNN with pre-trained-embedding input path + highway layer
+    (reference: example/cnn_chinese_text_classification/text_cnn.py)."""
+    acc = _run_example("cnn_chinese_text_classification/text_cnn.py",
+                       ["--epochs", "6"])
+    assert acc >= 0.75, acc
+
+
+def test_deepspeech_ctc_cer():
+    """Conv+BiLSTM+CTC speech model, greedy decode + CER (reference:
+    example/speech_recognition arch_deepspeech.py / stt_metric.py)."""
+    rate = _run_example("speech_recognition/deepspeech.py",
+                        ["--epochs", "12", "--n-train", "1024"])
+    assert rate < 0.25, rate
+
+
 def test_captcha_whole_string_accuracy():
     """Multi-digit captcha CNN with per-digit softmax heads (reference:
     example/captcha/mxnet_captcha.R)."""
