@@ -114,6 +114,7 @@ def main(argv=None):
     loss_fn = gluon.loss.SoftmaxCELoss()
 
     nb = args.n_train // args.batch_size
+    acc = captcha_accuracy(net(mx.nd.array(Xt)).asnumpy(), Yt)
     for epoch in range(args.epochs):
         perm = rng.permutation(args.n_train)
         tot = 0.0
@@ -130,7 +131,7 @@ def main(argv=None):
         acc = captcha_accuracy(net(mx.nd.array(Xt)).asnumpy(), Yt)
         print("Epoch [%d] loss %.4f captcha acc %.4f"
               % (epoch, tot / nb, acc))
-    return captcha_accuracy(net(mx.nd.array(Xt)).asnumpy(), Yt)
+    return acc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Generate the plankton image corpus + stratified train/val lists
+(reference: example/kaggle-ndsb1/gen_img_list.py — walks the class
+directories, writes shuffled .lst files with a per-class split).
+
+The National Data Science Bowl corpus cannot be downloaded in this
+zero-egress container, so the class directories are synthesized:
+each of the 6 "plankton taxa" is a distinct silhouette (disc, ring,
+rod, cross, blob pair, crescent) rendered with rotation/scale jitter
+on a noisy background — shape-only classes, like real plankton.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+SIZE = 24
+CLASSES = ["disc", "ring", "rod", "cross", "pair", "crescent"]
+
+
+def draw(cls, rng):
+    img = rng.normal(0.12, 0.05, (SIZE, SIZE))
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    cy, cx = SIZE / 2 + rng.uniform(-3, 3, 2)
+    r = rng.uniform(5, 8)
+    th = rng.uniform(0, np.pi)
+    u = (yy - cy) * np.cos(th) + (xx - cx) * np.sin(th)
+    v = -(yy - cy) * np.sin(th) + (xx - cx) * np.cos(th)
+    d2 = u ** 2 + v ** 2
+    if cls == "disc":
+        m = d2 <= r * r
+    elif cls == "ring":
+        m = (d2 <= r * r) & (d2 >= (0.55 * r) ** 2)
+    elif cls == "rod":
+        m = (np.abs(u) <= r) & (np.abs(v) <= 1.6)
+    elif cls == "cross":
+        m = ((np.abs(u) <= r) & (np.abs(v) <= 1.6)) | \
+            ((np.abs(v) <= r) & (np.abs(u) <= 1.6))
+    elif cls == "pair":
+        m = ((u - r / 2) ** 2 + v ** 2 <= (0.45 * r) ** 2) | \
+            ((u + r / 2) ** 2 + v ** 2 <= (0.45 * r) ** 2)
+    else:                                   # crescent
+        m = (d2 <= r * r) & ((u - 0.4 * r) ** 2 + v ** 2 >= (0.75 * r) ** 2)
+    img[m] = rng.uniform(0.7, 1.0)
+    return (np.clip(img, 0, 1) * 255).astype(np.uint8)
+
+
+def main(argv=None):
+    from PIL import Image
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--per-class", type=int, default=80)
+    p.add_argument("--train-frac", type=float, default=0.8)
+    p.add_argument("--seed", type=int, default=8)
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    root = os.path.join(args.out_dir, "train")
+    entries = []                             # (relpath, label)
+    for label, cls in enumerate(CLASSES):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(args.per_class):
+            name = "%s_%03d.png" % (cls, i)
+            Image.fromarray(draw(cls, rng)).convert("RGB").save(
+                os.path.join(d, name))
+            entries.append((os.path.join(cls, name), label))
+
+    # stratified shuffled split, one line per image: idx \t label \t path
+    train_lines, val_lines = [], []
+    for label in range(len(CLASSES)):
+        rows = [e for e in entries if e[1] == label]
+        rng.shuffle(rows)
+        cut = int(len(rows) * args.train_frac)
+        train_lines += rows[:cut]
+        val_lines += rows[cut:]
+    rng.shuffle(train_lines)
+    rng.shuffle(val_lines)
+    for split, rows in (("train", train_lines), ("val", val_lines)):
+        with open(os.path.join(args.out_dir, "%s.lst" % split), "w") as f:
+            for i, (path, label) in enumerate(rows):
+                f.write("%d\t%d\t%s\n" % (i, label, path))
+    print("wrote %d train / %d val entries under %s"
+          % (len(train_lines), len(val_lines), args.out_dir))
+    return root
+
+
+if __name__ == "__main__":
+    main()

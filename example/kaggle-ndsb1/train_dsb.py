@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Train the plankton classifier from packed records (reference:
+example/kaggle-ndsb1/{train_dsb.py,symbol_dsb.py} — the full Kaggle
+workflow: gen_img_list -> im2rec -> ImageIter with augmentation ->
+Module.fit on the plankton conv net).
+
+This script runs the WHOLE file pipeline: renders the corpus, writes
+the stratified .lst files, packs train/val .rec with tools/im2rec.py,
+and trains from ImageIter with mirror/rotation augmentation — the same
+chain a reference user runs by hand.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+
+import gen_img_list
+
+
+def get_symbol(num_classes):
+    """Downscaled symbol_dsb.py plankton net."""
+    net = mx.sym.Variable("data")
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=16,
+                             pad=(2, 2))
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(3, 3),
+                         stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=32,
+                             pad=(1, 1))
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(3, 3),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=128)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.25)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--per-class", type=int, default=80)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--work-dir", default=None,
+                   help="where to render/pack (default: a temp dir)")
+    p.add_argument("--seed", type=int, default=8)
+    args = p.parse_args(argv)
+
+    mx.random.seed(args.seed)
+    work = args.work_dir or tempfile.mkdtemp(prefix="ndsb1_")
+    gen_img_list.main(["--out-dir", work,
+                       "--per-class", str(args.per_class)])
+
+    import im2rec
+    root = os.path.join(work, "train")
+    for split in ("train", "val"):
+        im2rec.main([os.path.join(work, split), root])
+
+    shape = (3, gen_img_list.SIZE, gen_img_list.SIZE)
+    train_iter = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=shape,
+        path_imgrec=os.path.join(work, "train.rec"), shuffle=True,
+        rand_mirror=True)
+    val_iter = mx.image.ImageIter(
+        batch_size=args.batch_size, data_shape=shape,
+        path_imgrec=os.path.join(work, "val.rec"))
+
+    module = mx.mod.Module(get_symbol(len(gen_img_list.CLASSES)),
+                           data_names=("data",),
+                           label_names=("softmax_label",))
+    module.fit(train_iter, eval_data=val_iter, eval_metric="acc",
+               optimizer="adam",
+               optimizer_params={"learning_rate": args.lr},
+               initializer=mx.init.Xavier(),
+               num_epoch=args.epochs)
+
+    val_iter.reset()
+    metric = mx.metric.Accuracy()
+    module.score(val_iter, metric)
+    acc = metric.get()[1]
+    print("Validation accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -417,6 +417,24 @@ def test_ner_tagger_f1():
     assert f1 >= 0.8, f1
 
 
+def test_ndsb1_rec_pipeline_trains():
+    """Full Kaggle plankton workflow: render corpus -> .lst -> im2rec
+    .rec -> ImageIter aug -> Module.fit (reference:
+    example/kaggle-ndsb1/{gen_img_list,train_dsb}.py)."""
+    acc = _run_example("kaggle-ndsb1/train_dsb.py",
+                       ["--epochs", "12", "--per-class", "100"])
+    assert acc >= 0.7, acc
+
+
+def test_ndsb2_crps_volume_regression():
+    """Frame-differencing CDF regression with the CRPS metric
+    (reference: example/kaggle-ndsb2/Train.py)."""
+    score, mae = _run_example("kaggle-ndsb2/Train.py",
+                              ["--epochs", "5"])
+    assert score < 0.05, score
+    assert mae < 20.0, mae
+
+
 def test_chinese_text_cnn_highway():
     """Char-CNN with pre-trained-embedding input path + highway layer
     (reference: example/cnn_chinese_text_classification/text_cnn.py)."""
