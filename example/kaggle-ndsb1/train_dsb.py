@@ -6,7 +6,7 @@ Module.fit on the plankton conv net).
 
 This script runs the WHOLE file pipeline: renders the corpus, writes
 the stratified .lst files, packs train/val .rec with tools/im2rec.py,
-and trains from ImageIter with mirror/rotation augmentation — the same
+and trains from ImageIter with random-mirror augmentation — the same
 chain a reference user runs by hand.
 """
 

@@ -87,11 +87,7 @@ def get_lenet():
 def CRPS(label, pred):
     """Continuous Ranked Probability Score over the CDF bins
     (reference Train.py:57)."""
-    pred = np.array(pred)          # metric may hand us a read-only view
-    for i in range(pred.shape[0]):
-        for j in range(pred.shape[1] - 1):
-            if pred[i, j] > pred[i, j + 1]:
-                pred[i, j + 1] = pred[i, j]   # enforce monotone CDF
+    pred = np.maximum.accumulate(np.asarray(pred), axis=1)  # monotone CDF
     return np.sum(np.square(label - pred)) / label.size
 
 
@@ -127,7 +123,7 @@ def main(argv=None):
     test_iter = mx.io.NDArrayIter(data=Xt, label=encode_label(vst),
                                   batch_size=args.batch_size)
     pred = module.predict(test_iter).asnumpy()[:len(vst)]
-    score = CRPS(encode_label(vst), pred.copy())
+    score = CRPS(encode_label(vst), pred)
     # predicted volume = number of bins with CDF < 0.5
     vol_pred = (pred < 0.5).sum(axis=1)
     mae = float(np.abs(vol_pred - vst).mean())
