@@ -37,6 +37,7 @@ class BucketingModule(BaseModule):
 
     def _reset_bind(self):
         self.binded = False
+        self.optimizer_initialized = False   # fresh modules, fresh optimizer
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -162,6 +163,9 @@ class BucketingModule(BaseModule):
                         grad_req=self._grad_req)
             if self._monitor is not None:
                 module.install_monitor(self._monitor)
+            if self.optimizer_initialized:
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -177,8 +181,7 @@ class BucketingModule(BaseModule):
                                          force_init=force_init)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
-                mod.borrow_optimizer(self._curr_module) if hasattr(
-                    mod, "borrow_optimizer") else None
+                mod.borrow_optimizer(self._curr_module)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
@@ -204,12 +207,7 @@ class BucketingModule(BaseModule):
                 self._curr_module.set_params(arg, aux)
             if not self._curr_module.optimizer_initialized and \
                     prev_module.optimizer_initialized:
-                self._curr_module._optimizer = prev_module._optimizer
-                self._curr_module._kvstore = prev_module._kvstore
-                self._curr_module._update_on_kvstore = \
-                    prev_module._update_on_kvstore
-                self._curr_module._updater = prev_module._updater
-                self._curr_module.optimizer_initialized = True
+                self._curr_module.borrow_optimizer(prev_module)
         self._curr_module.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -344,6 +344,17 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    def borrow_optimizer(self, shared_module):
+        """Share shared_module's optimizer/updater/kvstore — used by
+        BucketingModule so every bucket updates through ONE optimizer
+        state (reference: module.py borrow_optimizer:588)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
     # ------------------------------------------------------------- running
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -234,3 +234,227 @@ def test_gluon_deferred_rebind_shape_change():
     np.testing.assert_allclose(a[0], b[0], rtol=1e-6)
     c = net(mx.nd.ones((2, 7, 3))).asnumpy()  # new rank entirely
     assert c.shape == (2, 7, 4)
+
+
+# ------------------------------------------- r3 additions (VERDICT weak #1)
+
+def test_module_reshape_batch_size():
+    """Module.reshape changes the batch dimension without re-init
+    (reference test_module.py test_module_reshape)."""
+    rng = np.random.RandomState(0)
+    mod = mx.mod.Module(_mlp_sym(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    before = {k: v.asnumpy().copy()
+              for k, v in mod.get_params()[0].items()}
+    mod.reshape(data_shapes=[("data", (9, 8))],
+                label_shapes=[("softmax_label", (9,))])
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(rng.randn(9, 8))],
+                                label=[mx.nd.zeros((9,))]))
+    assert mod.get_outputs()[0].shape == (9, 3)
+    after = mod.get_params()[0]
+    for k, v in before.items():
+        np.testing.assert_array_equal(v, after[k].asnumpy())
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    """save/load_optimizer_states preserves momentum buffers
+    (reference test_module.py checkpoint flows)."""
+    rng = np.random.RandomState(1)
+    x, y = _toy_data(rng, 64)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=2)
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+
+    mod2 = mx.mod.Module(_mlp_sym(), data_names=("data",),
+                         label_names=("softmax_label",))
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(mx.init.Xavier())
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    mod2.load_optimizer_states(f)
+    # training must continue smoothly from the restored momentum
+    it.reset()
+    for batch in it:
+        mod2.forward(batch, is_train=True)
+        mod2.backward()
+        mod2.update()
+    assert np.isfinite(
+        mod2.get_params()[0]["fc1_weight"].asnumpy()).all()
+
+
+def test_bucketing_module_switches_buckets():
+    """BucketingModule trains across bucket switches sharing one
+    parameter set (reference test_module.py test_bucket_module)."""
+    rng = np.random.RandomState(2)
+
+    def sym_gen(seq_len):
+        # params must be bucket-invariant: embed tokens, pool over the
+        # variable time axis, classify (the RNN-unroll pattern)
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=16, output_dim=8,
+                               name="shared_embed")
+        h = mx.sym.mean(emb, axis=1)
+        out = mx.sym.FullyConnected(h, num_hidden=2, name="out_fc")
+        return mx.sym.SoftmaxOutput(out, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=12)
+    mod.bind(data_shapes=[("data", (4, 12))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    for key in (12, 6, 12, 6):
+        mod.switch_bucket(key, data_shapes=[("data", (4, key))],
+                          label_shapes=[("softmax_label", (4,))])
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randint(0, 16, (4, key)))],
+            label=[mx.nd.array(rng.randint(0, 2, 4))],
+            bucket_key=key,
+            provide_data=[("data", (4, key))],
+            provide_label=[("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # out_fc is shared across buckets: one copy of the params
+    params = mod.get_params()[0]
+    assert "out_fc_weight" in params
+
+
+def test_symbolblock_export_import_roundtrip(tmp_path):
+    """HybridBlock.export -> SymbolBlock.imports preserves outputs
+    (reference test_gluon.py test_symbol_block / import)."""
+    rng = np.random.RandomState(3)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(rng.randn(2, 8))
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "m")
+    net.export(prefix, epoch=0)
+
+    imported = gluon.SymbolBlock.imports(
+        prefix + "-symbol.json", ["data"],
+        param_file=prefix + "-0000.params")
+    got = imported(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_params_constructor_sharing():
+    """Two blocks constructed with the same ParameterDict share
+    weights (reference test_gluon.py parameter sharing idiom)."""
+    shared = nn.Dense(8, activation="relu", prefix="shared_")
+    a = nn.HybridSequential(prefix="a_")
+    with a.name_scope():
+        a.add(shared, nn.Dense(2))
+    b = nn.HybridSequential(prefix="b_")
+    with b.name_scope():
+        b.add(shared, nn.Dense(2))
+    a.initialize(mx.init.Xavier())
+    b.initialize(mx.init.Xavier())
+    x = mx.nd.ones((1, 4))
+    a(x), b(x)
+    wa = shared.weight.data().asnumpy()
+    shared.weight.set_data(mx.nd.array(wa + 1.0))
+    # both nets see the update through the shared child
+    assert np.allclose(a[0].weight.data().asnumpy(), wa + 1.0)
+    assert np.allclose(b[0].weight.data().asnumpy(), wa + 1.0)
+
+
+def test_gluon_cast_dtype():
+    """Block.cast converts params and outputs (reference
+    test_gluon.py test_cast)."""
+    net = nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 4)))
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+    out = net(mx.nd.ones((1, 4), dtype="float16"))
+    assert out.dtype == np.float16
+
+
+def test_load_parameters_allow_missing_ignore_extra(tmp_path):
+    """allow_missing / ignore_extra control strictness
+    (reference test_gluon.py test_save_load)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 4)))
+    f = str(tmp_path / "p.params")
+    net.save_parameters(f)
+
+    bigger = nn.HybridSequential()
+    with bigger.name_scope():
+        bigger.add(nn.Dense(8), nn.Dense(2), nn.Dense(5))
+    bigger.initialize(mx.init.Xavier())
+    bigger(mx.nd.ones((1, 4)))
+    with pytest.raises(Exception):
+        bigger.load_parameters(f)                  # missing dense2
+    bigger.load_parameters(f, allow_missing=True)
+
+    smaller = nn.HybridSequential()
+    with smaller.name_scope():
+        smaller.add(nn.Dense(8))
+    smaller.initialize(mx.init.Xavier())
+    smaller(mx.nd.ones((1, 4)))
+    with pytest.raises(Exception):
+        smaller.load_parameters(f)                 # extra dense1
+    smaller.load_parameters(f, ignore_extra=True)
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    """Trainer.save_states/load_states restores momentum so resumed
+    training matches uninterrupted training (reference
+    test_gluon_trainer.py)."""
+    rng = np.random.RandomState(4)
+    x = mx.nd.array(rng.randn(16, 4))
+    y = mx.nd.array(rng.randn(16, 1))
+
+    def make():
+        mx.random.seed(7)
+        net = nn.Dense(1)
+        net.initialize(mx.init.Xavier())
+        t = gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05, "momentum": 0.9})
+        return net, t
+
+    def step(net, t):
+        from mxnet_tpu import autograd
+        with autograd.record():
+            l = ((net(x) - y) ** 2).mean()
+        l.backward()
+        t.step(1)
+
+    # uninterrupted: 4 steps
+    net_a, tr_a = make()
+    for _ in range(4):
+        step(net_a, tr_a)
+
+    # interrupted after 2 steps, states round-tripped
+    net_b, tr_b = make()
+    step(net_b, tr_b)
+    step(net_b, tr_b)
+    f = str(tmp_path / "t.states")
+    tr_b.save_states(f)
+    net_b.save_parameters(str(tmp_path / "n.params"))
+
+    net_c, tr_c = make()
+    net_c.load_parameters(str(tmp_path / "n.params"))
+    tr_c.load_states(f)
+    step(net_c, tr_c)
+    step(net_c, tr_c)
+    np.testing.assert_allclose(net_a.weight.data().asnumpy(),
+                               net_c.weight.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,233 @@
+"""Enumerated structured-op matrices: reduces and binary broadcasts
+over axis x keepdims x dtype x shape-pattern grids, forward vs numpy
+and gradient vs finite differences (reference:
+tests/python/unittest/test_operator.py test_broadcast_binary_op /
+test_reduce — which enumerate the same grids; the conv/deconv/pool
+matrices live in tests/test_conv_matrix.py).
+
+Every case is GENERATED, not sampled: the grid product is the test
+list, collected as individual pytest ids so a failure names its cell.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+# ---------------------------------------------------------------- reduces
+
+REDUCE_OPS = {
+    # name -> (mx op on ndarray, numpy equivalent)
+    "sum": (lambda x, **k: mx.nd.sum(x, **k), np.sum),
+    "mean": (lambda x, **k: mx.nd.mean(x, **k), np.mean),
+    "prod": (lambda x, **k: mx.nd.prod(x, **k), np.prod),
+    "max": (lambda x, **k: mx.nd.max(x, **k), np.max),
+    "min": (lambda x, **k: mx.nd.min(x, **k), np.min),
+    "nansum": (lambda x, **k: mx.nd.nansum(x, **k), np.nansum),
+}
+REDUCE_AXES = [None, 0, 1, -1, (0, 1), (0, 2)]
+REDUCE_KEEPDIMS = [False, True]
+# float64 is stored-as-float32 here (no jax x64 mode; the reference's
+# f64 cells would compare at f32 precision anyway), so the dtype axis
+# enumerates the dtypes the framework actually computes in
+REDUCE_DTYPES = ["float32", "float16"]
+
+REDUCE_GRID = [
+    (name, axis, keepdims, dtype)
+    for name in REDUCE_OPS
+    for axis in REDUCE_AXES
+    for keepdims in REDUCE_KEEPDIMS
+    for dtype in REDUCE_DTYPES
+]
+
+
+@pytest.mark.parametrize(
+    "name,axis,keepdims,dtype", REDUCE_GRID,
+    ids=["%s-ax%s-kd%d-%s" % (n, a, k, d) for n, a, k, d in REDUCE_GRID])
+def test_reduce_matrix(name, axis, keepdims, dtype):
+    import zlib
+    rng = np.random.RandomState(
+        zlib.crc32(("%s-%s" % (name, axis)).encode()) % (2 ** 31))
+    x = rng.uniform(0.5, 1.5, (2, 3, 4)).astype(dtype)
+    if name == "nansum":
+        x.flat[::7] = np.nan
+    fn, npfn = REDUCE_OPS[name]
+    kw = {"keepdims": keepdims}
+    if axis is not None:
+        kw["axis"] = axis
+    got = fn(mx.nd.array(x, dtype=dtype), **kw).asnumpy()
+    want = npfn(x.astype(np.float32), axis=axis, keepdims=keepdims)
+    want = np.asarray(want, dtype=dtype)
+    assert got.shape == want.shape or (want.shape == () and got.size == 1), \
+        (got.shape, want.shape)
+    assert_almost_equal(got.reshape(want.shape).astype(np.float32),
+                        want.astype(np.float32),
+                        rtol=1e-4 if dtype == "float32" else 2e-2)
+
+
+REDUCE_GRAD_GRID = [(n, a) for n in ("sum", "mean", "prod")
+                    for a in (None, 0, (0, 2))]
+
+
+@pytest.mark.parametrize(
+    "name,axis", REDUCE_GRAD_GRID,
+    ids=["%s-ax%s" % (n, a) for n, a in REDUCE_GRAD_GRID])
+def test_reduce_matrix_grad(name, axis):
+    """Autograd gradient vs finite differences for the smooth reduces."""
+    rng = np.random.RandomState(7)
+    x = rng.uniform(0.5, 1.5, (2, 3, 2)).astype(np.float32)
+    kw = {} if axis is None else {"axis": axis}
+    fn = REDUCE_OPS[name][0]
+
+    def f(v):
+        return fn(v, **kw).sum()
+
+    xd = mx.nd.array(x)
+    xd.attach_grad()
+    with autograd.record():
+        y = f(xd)
+    y.backward()
+    got = xd.grad.asnumpy()
+
+    eps = 1e-3
+    want = np.zeros_like(x)
+    for i in range(x.size):
+        xp, xm = x.copy(), x.copy()
+        xp.flat[i] += eps
+        xm.flat[i] -= eps
+        want.flat[i] = (float(f(mx.nd.array(xp)).asscalar())
+                        - float(f(mx.nd.array(xm)).asscalar())) / (2 * eps)
+    assert_almost_equal(got, want, rtol=5e-2, atol=1e-3)
+
+
+# ------------------------------------------------------- binary broadcasts
+
+BINARY_OPS = {
+    "broadcast_add": (mx.nd.broadcast_add, np.add),
+    "broadcast_sub": (mx.nd.broadcast_sub, np.subtract),
+    "broadcast_mul": (mx.nd.broadcast_mul, np.multiply),
+    "broadcast_div": (mx.nd.broadcast_div, np.divide),
+    "broadcast_maximum": (mx.nd.broadcast_maximum, np.maximum),
+    "broadcast_minimum": (mx.nd.broadcast_minimum, np.minimum),
+    "broadcast_power": (mx.nd.broadcast_power, np.power),
+    "broadcast_hypot": (mx.nd.broadcast_hypot, np.hypot),
+}
+# the broadcast patterns the reference enumerates: equal, scalar-like,
+# per-row, per-column, middle axis, degenerate leading axis
+BROADCAST_SHAPES = [
+    ((2, 3, 4), (2, 3, 4)),
+    ((2, 3, 4), (1, 1, 1)),
+    ((2, 3, 4), (1, 3, 4)),
+    ((2, 3, 4), (2, 1, 4)),
+    ((2, 3, 4), (2, 3, 1)),
+    ((1, 3, 1), (2, 1, 4)),
+]
+BINARY_GRID = [(n, i) for n in BINARY_OPS
+               for i in range(len(BROADCAST_SHAPES))]
+
+
+@pytest.mark.parametrize(
+    "name,pat", BINARY_GRID,
+    ids=["%s-p%d" % (n, i) for n, i in BINARY_GRID])
+def test_binary_broadcast_matrix(name, pat):
+    rng = np.random.RandomState(pat)
+    sa, sb = BROADCAST_SHAPES[pat]
+    a = rng.uniform(0.5, 2.0, sa).astype(np.float32)
+    b = rng.uniform(0.5, 2.0, sb).astype(np.float32)
+    fn, npfn = BINARY_OPS[name]
+    got = fn(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    want = npfn(a, b)
+    assert got.shape == want.shape
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+BINARY_GRAD_GRID = [(n, i) for n in ("broadcast_add", "broadcast_mul",
+                                     "broadcast_div", "broadcast_power")
+                    for i in range(len(BROADCAST_SHAPES))]
+
+
+@pytest.mark.parametrize(
+    "name,pat", BINARY_GRAD_GRID,
+    ids=["%s-p%d" % (n, i) for n, i in BINARY_GRAD_GRID])
+def test_binary_broadcast_matrix_grad(name, pat):
+    """Gradients must reduce over the broadcast axes; check both
+    operands against finite differences."""
+    rng = np.random.RandomState(100 + pat)
+    sa, sb = BROADCAST_SHAPES[pat]
+    a = rng.uniform(0.5, 2.0, sa).astype(np.float32)
+    b = rng.uniform(0.5, 2.0, sb).astype(np.float32)
+    fn = BINARY_OPS[name][0]
+
+    ad, bd = mx.nd.array(a), mx.nd.array(b)
+    ad.attach_grad()
+    bd.attach_grad()
+    with autograd.record():
+        y = fn(ad, bd).sum()
+    y.backward()
+
+    eps = 1e-3
+    for arr, nd_arr, other, first in ((a, ad, b, True), (b, bd, a, False)):
+        want = np.zeros_like(arr)
+        for i in range(arr.size):
+            xp, xm = arr.copy(), arr.copy()
+            xp.flat[i] += eps
+            xm.flat[i] -= eps
+            if first:
+                fp = float(fn(mx.nd.array(xp), mx.nd.array(other))
+                           .sum().asscalar())
+                fm = float(fn(mx.nd.array(xm), mx.nd.array(other))
+                           .sum().asscalar())
+            else:
+                fp = float(fn(mx.nd.array(other), mx.nd.array(xp))
+                           .sum().asscalar())
+                fm = float(fn(mx.nd.array(other), mx.nd.array(xm))
+                           .sum().asscalar())
+            want.flat[i] = (fp - fm) / (2 * eps)
+        assert_almost_equal(nd_arr.grad.asnumpy(), want,
+                            rtol=5e-2, atol=2e-3)
+
+
+# ----------------------------------------------------- batchnorm matrix
+
+BN_GRID = [(axis, fix_gamma, global_stats)
+           for axis in (1, -1)
+           for fix_gamma in (False, True)
+           for global_stats in (False, True)]
+
+
+@pytest.mark.parametrize(
+    "axis,fix_gamma,global_stats", BN_GRID,
+    ids=["ax%d-fg%d-gs%d" % g for g in BN_GRID])
+def test_batchnorm_matrix(axis, fix_gamma, global_stats):
+    """BatchNorm forward vs a manual computation for every
+    axis x fix_gamma x use_global_stats cell (reference
+    test_operator.py test_batchnorm_training variants)."""
+    rng = np.random.RandomState(3)
+    x = rng.normal(1.0, 2.0, (4, 3, 5)).astype(np.float32)
+    caxis = axis % x.ndim
+    C = x.shape[caxis]
+    gamma = rng.uniform(0.5, 1.5, C).astype(np.float32)
+    beta = rng.uniform(-1, 1, C).astype(np.float32)
+    mmean = rng.uniform(-1, 1, C).astype(np.float32)
+    mvar = rng.uniform(0.5, 1.5, C).astype(np.float32)
+    eps = 1e-3
+
+    out = mx.nd.BatchNorm(
+        mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+        mx.nd.array(mmean), mx.nd.array(mvar),
+        eps=eps, fix_gamma=fix_gamma, use_global_stats=global_stats,
+        axis=axis).asnumpy()
+
+    red = tuple(i for i in range(x.ndim) if i != caxis)
+    if global_stats:
+        mean, var = mmean, mvar
+    else:
+        mean, var = x.mean(axis=red), x.var(axis=red)
+    g = np.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * x.ndim
+    shape[caxis] = C
+    want = (x - mean.reshape(shape)) / np.sqrt(
+        var.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
+    assert_almost_equal(out, want, rtol=1e-3, atol=1e-4)
