@@ -196,10 +196,11 @@ class BucketingModule(BaseModule):
                 prev_module.params_initialized:
             # share params across buckets
             arg, aux = prev_module.get_params()
-            self._curr_module.bind(data_batch.provide_data,
-                                   data_batch.provide_label,
-                                   self.for_training, self.inputs_need_grad) \
-                if not self._curr_module.binded else None
+            if not self._curr_module.binded:
+                self._curr_module.bind(data_batch.provide_data,
+                                       data_batch.provide_label,
+                                       self.for_training,
+                                       self.inputs_need_grad)
             if not self._curr_module.params_initialized:
                 self._curr_module.init_params(arg_params=arg, aux_params=aux,
                                               allow_missing=False)

@@ -281,7 +281,28 @@ def test_module_optimizer_states_roundtrip(tmp_path):
                         optimizer_params={"learning_rate": 0.1,
                                           "momentum": 0.9})
     mod2.load_optimizer_states(f)
-    # training must continue smoothly from the restored momentum
+    # the restored updater must hold mod's exact momentum buffers
+    def _flatten(x, out):
+        if x is None:
+            return out
+        if isinstance(x, (tuple, list)):
+            for e in x:
+                _flatten(e, out)
+        else:
+            out.append(x.asnumpy())
+        return out
+
+    states_saved = mod._updater.states
+    states_loaded = mod2._updater.states
+    assert set(states_saved) == set(states_loaded)
+    flat_s, flat_l = [], []
+    for k in states_saved:
+        _flatten(states_saved[k], flat_s)
+        _flatten(states_loaded[k], flat_l)
+    assert flat_s, "momentum SGD must have state to compare"
+    for a, b in zip(flat_s, flat_l):
+        np.testing.assert_array_equal(a, b)
+    # and training continues smoothly from it
     it.reset()
     for batch in it:
         mod2.forward(batch, is_train=True)
@@ -325,9 +346,18 @@ def test_bucketing_module_switches_buckets():
         mod.forward(batch, is_train=True)
         mod.backward()
         mod.update()
-    # out_fc is shared across buckets: one copy of the params
-    params = mod.get_params()[0]
-    assert "out_fc_weight" in params
+    # out_fc is genuinely shared: the values trained on the last
+    # bucket (6) must be what a bucket-12 forward computes with
+    trained = mod.get_params()[0]["out_fc_weight"].asnumpy().copy()
+    batch12 = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randint(0, 16, (4, 12)))],
+        label=[mx.nd.array(rng.randint(0, 2, 4))],
+        bucket_key=12,
+        provide_data=[("data", (4, 12))],
+        provide_label=[("softmax_label", (4,))])
+    mod.forward(batch12, is_train=False)
+    w12 = mod._buckets[12]._arg_params["out_fc_weight"].asnumpy()
+    np.testing.assert_array_equal(trained, w12)
 
 
 def test_symbolblock_export_import_roundtrip(tmp_path):

@@ -1,0 +1,253 @@
+"""Optimizer numerics matrix: every deterministic optimizer's 6-step
+trajectory vs an independent numpy mirror, enumerated over
+wd x clip_gradient (reference: tests/python/unittest/test_optimizer.py,
+which pins each optimizer against a PyOp reference implementation the
+same way; SGLD is excluded — its injected noise makes trajectories
+non-comparable and it is distribution-tested in test_op_sweep.py).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 5)
+STEPS = 6
+LR = 0.05
+
+
+def _prep(g, w, wd, clip, rescale=1.0, with_wd=True):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    return g + wd * w if with_wd else g
+
+
+# Each mirror: (create_kwargs, n_aux, step(w, g, aux, t, wd, clip) -> w)
+# aux is a dict the mirror owns.
+
+def sgd_mirror(momentum):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        if momentum == 0.0:
+            return w - LR * g
+        aux.setdefault("mom", np.zeros_like(w))
+        aux["mom"] = momentum * aux["mom"] - LR * g
+        return w + aux["mom"]
+    return step
+
+
+def nag_mirror(momentum):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        aux.setdefault("mom", np.zeros_like(w))
+        aux["mom"] = momentum * aux["mom"] + g
+        return w - LR * (g + momentum * aux["mom"])
+    return step
+
+
+def adam_mirror(beta1=0.9, beta2=0.999, eps=1e-8):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        aux.setdefault("m", np.zeros_like(w))
+        aux.setdefault("v", np.zeros_like(w))
+        lr_t = LR * np.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+        aux["m"] = beta1 * aux["m"] + (1 - beta1) * g
+        aux["v"] = beta2 * aux["v"] + (1 - beta2) * g * g
+        return w - lr_t * aux["m"] / (np.sqrt(aux["v"]) + eps)
+    return step
+
+
+def signum_mirror(momentum=0.9, wd_lh=0.0):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, 0.0, clip, with_wd=False)
+        aux.setdefault("mom", np.zeros_like(w))
+        aux["mom"] = momentum * aux["mom"] - (1 - momentum) * (g + wd * w)
+        return (1 - LR * wd_lh) * w + LR * np.sign(aux["mom"])
+    return step
+
+
+def adagrad_mirror(eps=1e-7):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        aux.setdefault("h", np.zeros_like(w))
+        aux["h"] = aux["h"] + g * g
+        return w - LR * g / (np.sqrt(aux["h"]) + eps)
+    return step
+
+
+def rmsprop_mirror(gamma1=0.9, eps=1e-8):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        aux.setdefault("n", np.zeros_like(w))
+        aux["n"] = (1 - gamma1) * g * g + gamma1 * aux["n"]
+        return w - LR * g / np.sqrt(aux["n"] + eps)
+    return step
+
+
+def rmsprop_centered_mirror(gamma1=0.95, gamma2=0.9, eps=1e-8):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, wd, clip)
+        for k in ("n", "g", "d"):
+            aux.setdefault(k, np.zeros_like(w))
+        aux["n"] = (1 - gamma1) * g * g + gamma1 * aux["n"]
+        aux["g"] = (1 - gamma1) * g + gamma1 * aux["g"]
+        aux["d"] = gamma2 * aux["d"] - LR * g / np.sqrt(
+            aux["n"] - aux["g"] ** 2 + eps)
+        return w + aux["d"]
+    return step
+
+
+def adadelta_mirror(rho=0.9, eps=1e-5):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, 0.0, clip, with_wd=False)
+        aux.setdefault("ag", np.zeros_like(w))
+        aux.setdefault("ad", np.zeros_like(w))
+        aux["ag"] = rho * aux["ag"] + (1 - rho) * g * g
+        delta = np.sqrt(aux["ad"] + eps) / np.sqrt(aux["ag"] + eps) * g
+        aux["ad"] = rho * aux["ad"] + (1 - rho) * delta * delta
+        return w - delta - wd * w
+    return step
+
+
+def ftrl_mirror(lamda1=0.01, beta=1.0):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, 0.0, clip, with_wd=False)
+        aux.setdefault("z", np.zeros_like(w))
+        aux.setdefault("n", np.zeros_like(w))
+        new_n = aux["n"] + g * g
+        sigma = (np.sqrt(new_n) - np.sqrt(aux["n"])) / LR
+        aux["z"] = aux["z"] + g - sigma * w
+        aux["n"] = new_n
+        return np.where(
+            np.abs(aux["z"]) > lamda1,
+            -(aux["z"] - np.sign(aux["z"]) * lamda1)
+            / ((beta + np.sqrt(aux["n"])) / LR + wd),
+            0.0)
+    return step
+
+
+def ftml_mirror(beta1=0.6, beta2=0.999, eps=1e-8):
+    def step(w, g, aux, t, wd, clip):
+        g = g + wd * w
+        if clip is not None:
+            g = np.clip(g, -clip, clip)
+        for k in ("d", "v", "z"):
+            aux.setdefault(k, np.zeros_like(w))
+        aux["v"] = beta2 * aux["v"] + (1 - beta2) * g * g
+        d_t = (1 - beta1 ** t) / LR * (
+            np.sqrt(aux["v"] / (1 - beta2 ** t)) + eps)
+        sigma = d_t - beta1 * aux["d"]
+        aux["z"] = beta1 * aux["z"] + (1 - beta1) * g - sigma * w
+        aux["d"] = d_t
+        return -aux["z"] / d_t
+    return step
+
+
+def adamax_mirror(beta1=0.9, beta2=0.999):
+    def step(w, g, aux, t, wd, clip):
+        g = g + wd * w
+        if clip is not None:
+            g = np.clip(g, -clip, clip)
+        aux.setdefault("m", np.zeros_like(w))
+        aux.setdefault("u", np.zeros_like(w))
+        lr_t = LR / (1 - beta1 ** t)
+        aux["m"] = beta1 * aux["m"] + (1 - beta1) * g
+        aux["u"] = np.maximum(beta2 * aux["u"], np.abs(g))
+        return w - lr_t * aux["m"] / (aux["u"] + 1e-8)
+    return step
+
+
+def nadam_mirror(beta1=0.9, beta2=0.999, eps=1e-8, schedule_decay=0.004):
+    def step(w, g, aux, t, wd, clip):
+        g = g + wd * w
+        if clip is not None:
+            g = np.clip(g, -clip, clip)
+        aux.setdefault("m", np.zeros_like(w))
+        aux.setdefault("v", np.zeros_like(w))
+        aux.setdefault("sched", 1.0)
+        mom_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+        mom_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+        aux["sched"] = aux["sched"] * mom_t
+        sched_next = aux["sched"] * mom_t1
+        aux["m"] = beta1 * aux["m"] + (1 - beta1) * g
+        aux["v"] = beta2 * aux["v"] + (1 - beta2) * g * g
+        g_p = g / (1 - aux["sched"])
+        m_p = aux["m"] / (1 - sched_next)
+        v_p = aux["v"] / (1 - beta2 ** t)
+        m_bar = (1 - mom_t) * g_p + mom_t1 * m_p
+        return w - LR * m_bar / (np.sqrt(v_p) + eps)
+    return step
+
+
+def dcasgd_mirror(momentum=0.0, lamda=0.04):
+    def step(w, g, aux, t, wd, clip):
+        g = _prep(g, w, 0.0, clip, with_wd=False)
+        aux.setdefault("prev", w.copy())
+        comp = g + lamda * g * g * (w - aux["prev"])
+        if momentum != 0.0:
+            aux.setdefault("mom", np.zeros_like(w))
+            aux["mom"] = momentum * aux["mom"] - LR * (comp + wd * w)
+            step_v = aux["mom"]
+        else:
+            step_v = -LR * (comp + wd * w)
+        aux["prev"] = w.copy()
+        return w + step_v
+    return step
+
+
+CASES = {
+    "sgd": ({}, sgd_mirror(0.0)),
+    "sgd-mom": ({"momentum": 0.9}, sgd_mirror(0.9)),
+    "nag": ({"momentum": 0.9}, nag_mirror(0.9)),
+    "adam": ({}, adam_mirror()),
+    "signum": ({"momentum": 0.9, "wd_lh": 0.01}, signum_mirror(0.9, 0.01)),
+    "adagrad": ({}, adagrad_mirror()),
+    "rmsprop": ({}, rmsprop_mirror()),
+    "rmsprop-centered": ({"centered": True, "gamma1": 0.95, "gamma2": 0.9},
+                         rmsprop_centered_mirror()),
+    "adadelta": ({}, adadelta_mirror()),
+    "ftrl": ({}, ftrl_mirror()),
+    "ftml": ({}, ftml_mirror()),
+    "adamax": ({}, adamax_mirror()),
+    "nadam": ({}, nadam_mirror()),
+    "dcasgd": ({"momentum": 0.9}, dcasgd_mirror(0.9)),
+}
+WD_GRID = [0.0, 0.05]
+CLIP_GRID = [None, 0.5]
+GRID = [(n, wd, clip) for n in CASES for wd in WD_GRID
+        for clip in CLIP_GRID]
+
+
+@pytest.mark.parametrize(
+    "name,wd,clip", GRID,
+    ids=["%s-wd%g-clip%s" % (n, w, c) for n, w, c in GRID])
+def test_optimizer_trajectory_matches_numpy(name, wd, clip):
+    import zlib
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    w0 = rng.uniform(-1, 1, SHAPE).astype(np.float32)
+    grads = [rng.uniform(-2, 2, SHAPE).astype(np.float32)
+             for _ in range(STEPS)]
+
+    create_kwargs, mirror = CASES[name]
+    kwargs = dict(create_kwargs)
+    kwargs.update(learning_rate=LR, wd=wd, rescale_grad=1.0)
+    if clip is not None:
+        kwargs["clip_gradient"] = clip
+    optimizer = opt.create(name.split("-")[0],
+                           **kwargs)
+    updater = opt.get_updater(optimizer)
+
+    w_mx = mx.nd.array(w0)
+    for g in grads:
+        updater(0, mx.nd.array(g), w_mx)
+
+    w_np = w0.astype(np.float32).copy()
+    aux = {}
+    for t, g in enumerate(grads, start=1):
+        w_np = mirror(w_np, g, aux, t, wd, clip).astype(np.float32)
+
+    assert_almost_equal(w_mx.asnumpy(), w_np, rtol=1e-4, atol=1e-5,
+                        names=("framework", "numpy-mirror"))
