@@ -300,6 +300,7 @@ def test_module_optimizer_states_roundtrip(tmp_path):
         _flatten(states_saved[k], flat_s)
         _flatten(states_loaded[k], flat_l)
     assert flat_s, "momentum SGD must have state to compare"
+    assert len(flat_s) == len(flat_l)
     for a, b in zip(flat_s, flat_l):
         np.testing.assert_array_equal(a, b)
     # and training continues smoothly from it

@@ -1,0 +1,135 @@
+"""Loss-function oracle matrix: every gluon loss with a torch
+equivalent vs torch on identical inputs, value AND input gradient
+(reference: tests/python/unittest/test_loss.py, which checks losses by
+training to convergence; torch gives an exact independent oracle).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+N, D = 6, 5
+
+
+def _compare(mx_loss_fn, torch_loss_fn, pred, label,
+             rtol=1e-4, atol=1e-5):
+    pd = mx.nd.array(pred)
+    pd.attach_grad()
+    with autograd.record():
+        l = mx_loss_fn(pd, mx.nd.array(label))
+        total = l.sum()
+    total.backward()
+
+    pt = torch.from_numpy(pred).requires_grad_(True)
+    lt = torch_loss_fn(pt, torch.from_numpy(label))
+    lt.sum().backward()
+
+    assert_almost_equal(np.asarray([float(total.asscalar())]),
+                        np.asarray([float(lt.sum())]),
+                        rtol=rtol, atol=atol, names=("mx", "torch"))
+    assert_almost_equal(pd.grad.asnumpy(), pt.grad.numpy(),
+                        rtol=rtol, atol=atol,
+                        names=("mx-grad", "torch-grad"))
+
+
+def test_l2_matches_torch():
+    rng = np.random.RandomState(0)
+    pred = rng.randn(N, D).astype(np.float32)
+    label = rng.randn(N, D).astype(np.float32)
+    # gluon L2 = 0.5 * mean-over-batch of sum square / D ... exact def:
+    # L = 0.5 * (pred - label)^2, then mean over all but batch axis
+    _compare(gluon.loss.L2Loss(),
+             lambda p, t: 0.5 * ((p - t) ** 2).mean(dim=1),
+             pred, label)
+
+
+def test_l1_matches_torch():
+    rng = np.random.RandomState(1)
+    pred = rng.randn(N, D).astype(np.float32)
+    label = rng.randn(N, D).astype(np.float32)
+    _compare(gluon.loss.L1Loss(),
+             lambda p, t: (p - t).abs().mean(dim=1),
+             pred, label)
+
+
+def test_softmax_ce_matches_torch():
+    rng = np.random.RandomState(2)
+    pred = rng.randn(N, D).astype(np.float32)
+    label = rng.randint(0, D, N).astype(np.float32)
+    _compare(gluon.loss.SoftmaxCrossEntropyLoss(),
+             lambda p, t: F.cross_entropy(p, t.long(), reduction="none"),
+             pred, label)
+
+
+def test_sigmoid_bce_matches_torch():
+    rng = np.random.RandomState(3)
+    pred = rng.randn(N, D).astype(np.float32)
+    label = (rng.rand(N, D) > 0.5).astype(np.float32)
+    _compare(gluon.loss.SigmoidBinaryCrossEntropyLoss(),
+             lambda p, t: F.binary_cross_entropy_with_logits(
+                 p, t, reduction="none").mean(dim=1),
+             pred, label)
+
+
+def test_kldiv_matches_torch():
+    rng = np.random.RandomState(4)
+    logits = rng.randn(N, D).astype(np.float32)
+    target = rng.rand(N, D).astype(np.float32)
+    target /= target.sum(1, keepdims=True)
+    # gluon KLDiv (from_logits=False): applies log_softmax to pred
+    _compare(gluon.loss.KLDivLoss(from_logits=False),
+             lambda p, t: F.kl_div(F.log_softmax(p, dim=1), t,
+                                   reduction="none").mean(dim=1),
+             logits, target)
+
+
+def test_huber_matches_torch():
+    rng = np.random.RandomState(5)
+    pred = rng.randn(N, D).astype(np.float32) * 3
+    label = rng.randn(N, D).astype(np.float32)
+    rho = 1.0
+    _compare(gluon.loss.HuberLoss(rho=rho),
+             lambda p, t: F.smooth_l1_loss(
+                 p, t, reduction="none", beta=rho).mean(dim=1),
+             pred, label)
+
+
+def test_hinge_matches_torch():
+    rng = np.random.RandomState(6)
+    pred = rng.randn(N, 1).astype(np.float32)
+    label = np.where(rng.rand(N, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    _compare(gluon.loss.HingeLoss(),
+             lambda p, t: torch.clamp(1 - p * t, min=0).mean(dim=1),
+             pred, label)
+
+
+def test_triplet_matches_torch():
+    rng = np.random.RandomState(7)
+    anchor = rng.randn(N, D).astype(np.float32)
+    pos = rng.randn(N, D).astype(np.float32)
+    neg = rng.randn(N, D).astype(np.float32)
+
+    ad = mx.nd.array(anchor)
+    ad.attach_grad()
+    with autograd.record():
+        l = gluon.loss.TripletLoss(margin=1.0)(
+            ad, mx.nd.array(pos), mx.nd.array(neg))
+        total = l.sum()
+    total.backward()
+
+    at = torch.from_numpy(anchor).requires_grad_(True)
+    # gluon triplet: SUM over feature axes of (|a-p|^2 - |a-n|^2) + m
+    lt = torch.clamp(((at - torch.from_numpy(pos)) ** 2
+                      - (at - torch.from_numpy(neg)) ** 2).sum(dim=1)
+                     + 1.0, min=0)
+    lt.sum().backward()
+    assert_almost_equal(np.asarray([float(total.asscalar())]),
+                        np.asarray([float(lt.sum())]), rtol=1e-4)
+    assert_almost_equal(ad.grad.asnumpy(), at.grad.numpy(),
+                        rtol=1e-4, atol=1e-5)

@@ -18,11 +18,15 @@ STEPS = 6
 LR = 0.05
 
 
-def _prep(g, w, wd, clip, rescale=1.0, with_wd=True):
+def _prep(g, w, wd, clip, rescale=1.0, with_wd=True, wd_before_clip=False):
     g = g * rescale
+    if wd_before_clip and with_wd:
+        g = g + wd * w
     if clip is not None:
         g = np.clip(g, -clip, clip)
-    return g + wd * w if with_wd else g
+    if not wd_before_clip and with_wd:
+        g = g + wd * w
+    return g
 
 
 # Each mirror: (create_kwargs, n_aux, step(w, g, aux, t, wd, clip) -> w)
@@ -131,9 +135,7 @@ def ftrl_mirror(lamda1=0.01, beta=1.0):
 
 def ftml_mirror(beta1=0.6, beta2=0.999, eps=1e-8):
     def step(w, g, aux, t, wd, clip):
-        g = g + wd * w
-        if clip is not None:
-            g = np.clip(g, -clip, clip)
+        g = _prep(g, w, wd, clip, wd_before_clip=True)
         for k in ("d", "v", "z"):
             aux.setdefault(k, np.zeros_like(w))
         aux["v"] = beta2 * aux["v"] + (1 - beta2) * g * g
@@ -148,9 +150,7 @@ def ftml_mirror(beta1=0.6, beta2=0.999, eps=1e-8):
 
 def adamax_mirror(beta1=0.9, beta2=0.999):
     def step(w, g, aux, t, wd, clip):
-        g = g + wd * w
-        if clip is not None:
-            g = np.clip(g, -clip, clip)
+        g = _prep(g, w, wd, clip, wd_before_clip=True)
         aux.setdefault("m", np.zeros_like(w))
         aux.setdefault("u", np.zeros_like(w))
         lr_t = LR / (1 - beta1 ** t)
@@ -162,9 +162,7 @@ def adamax_mirror(beta1=0.9, beta2=0.999):
 
 def nadam_mirror(beta1=0.9, beta2=0.999, eps=1e-8, schedule_decay=0.004):
     def step(w, g, aux, t, wd, clip):
-        g = g + wd * w
-        if clip is not None:
-            g = np.clip(g, -clip, clip)
+        g = _prep(g, w, wd, clip, wd_before_clip=True)
         aux.setdefault("m", np.zeros_like(w))
         aux.setdefault("v", np.zeros_like(w))
         aux.setdefault("sched", 1.0)

@@ -1,0 +1,85 @@
+"""RNN layer oracle matrix: gluon.rnn.{RNN,LSTM,GRU} vs torch's
+cuDNN-semantics CPU implementation with identical weights, over
+mode x num_layers x bidirectional, checking outputs AND input
+gradients (reference: tests/python/unittest/test_gluon_rnn.py
+test_rnn_layers, which checks against the fused RNN op; torch is the
+independent oracle here since both implement the cuDNN layout).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+N, T, C, H = 3, 5, 4, 6
+
+MODES = {
+    "rnn_relu": (lambda **kw: rnn.RNN(H, activation="relu", **kw),
+                 lambda **kw: torch.nn.RNN(C, H, nonlinearity="relu",
+                                           batch_first=True, **kw)),
+    "rnn_tanh": (lambda **kw: rnn.RNN(H, activation="tanh", **kw),
+                 lambda **kw: torch.nn.RNN(C, H, nonlinearity="tanh",
+                                           batch_first=True, **kw)),
+    "lstm": (lambda **kw: rnn.LSTM(H, **kw),
+             lambda **kw: torch.nn.LSTM(C, H, batch_first=True, **kw)),
+    "gru": (lambda **kw: rnn.GRU(H, **kw),
+            lambda **kw: torch.nn.GRU(C, H, batch_first=True, **kw)),
+}
+GRID = [(m, nl, bi) for m in MODES for nl in (1, 2)
+        for bi in (False, True)]
+
+
+def _copy_weights(mx_layer, t_layer, num_layers, bidirectional):
+    """Copy gluon params into torch (both use the cuDNN gate order)."""
+    dirs = ("l", "r") if bidirectional else ("l",)
+    with torch.no_grad():
+        for i in range(num_layers):
+            for j in dirs:
+                suffix = "_reverse" if j == "r" else ""
+                for kind, tname in (("weight", "weight"), ("bias", "bias")):
+                    for src, dst in (("i2h", "ih"), ("h2h", "hh")):
+                        arr = getattr(mx_layer, "%s%d_%s_%s"
+                                      % (j, i, src, kind)).data().asnumpy()
+                        getattr(t_layer, "%s_%s_l%d%s"
+                                % (tname, dst, i, suffix)).copy_(
+                            torch.from_numpy(arr))
+
+
+@pytest.mark.parametrize(
+    "mode,num_layers,bidirectional", GRID,
+    ids=["%s-l%d-bi%d" % g for g in GRID])
+def test_rnn_layer_matches_torch(mode, num_layers, bidirectional):
+    rng = np.random.RandomState(0)
+    x = rng.randn(N, T, C).astype(np.float32)
+
+    make_mx, make_torch = MODES[mode]
+    mx_layer = make_mx(num_layers=num_layers, layout="NTC",
+                       bidirectional=bidirectional, input_size=C)
+    mx_layer.initialize(mx.init.Xavier())
+    t_layer = make_torch(num_layers=num_layers,
+                         bidirectional=bidirectional)
+    _copy_weights(mx_layer, t_layer, num_layers, bidirectional)
+
+    # forward
+    xd = mx.nd.array(x)
+    xd.attach_grad()
+    with autograd.record():
+        out = mx_layer(xd)
+        loss = (out * out).sum()
+    loss.backward()
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    out_t, _ = t_layer(xt)
+    (out_t * out_t).sum().backward()
+
+    assert_almost_equal(out.asnumpy(), out_t.detach().numpy(),
+                        rtol=1e-4, atol=1e-5,
+                        names=("mxnet_tpu", "torch"))
+    assert_almost_equal(xd.grad.asnumpy(), xt.grad.numpy(),
+                        rtol=1e-3, atol=1e-4,
+                        names=("mxnet_tpu-grad", "torch-grad"))
