@@ -42,6 +42,8 @@ RANDOM_OPS = {
     "_random_poisson", "_random_negative_binomial",
     "_random_generalized_negative_binomial", "_random_randint",
     "_sample_multinomial", "_sample_uniform", "_sample_normal", "_sample_gamma",
+    "_sample_exponential", "_sample_poisson", "_sample_negative_binomial",
+    "_sample_generalized_negative_binomial",
     "_shuffle", "_sample_unique_zipfian", "RNN",
 }
 

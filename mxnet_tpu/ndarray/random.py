@@ -7,14 +7,33 @@ from .ndarray import NDArray, imperative_invoke
 
 
 def _sample(opname, shape, dtype, ctx, kwargs, tensors=()):
-    attrs = {"shape": (shape,) if isinstance(shape, int) else tuple(shape or (1,)),
+    # shape=() means "no tail" for the tensor-parameter _sample_* ops
+    # (output shape == param shape, the reference default); only a None
+    # shape falls back to the scalar-parameter default of (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    attrs = {"shape": tuple(shape) if shape is not None else (1,),
              "dtype": dtype or "float32"}
     attrs.update(kwargs)
     return imperative_invoke(opname, list(tensors), attrs)[0]
 
 
+def _check_pair(name, a, b):
+    """Tensor-parameter sampling requires ALL params as NDArrays
+    (reference frontend raises the same error)."""
+    if not isinstance(b, NDArray):
+        raise ValueError(
+            "Distribution parameters must all have the same type: %s got "
+            "an NDArray and a %s" % (name, type(b).__name__))
+    if isinstance(a, NDArray) and a.shape != b.shape:
+        raise ValueError("Distribution parameter shapes must match: "
+                         "%s vs %s" % (a.shape, b.shape))
+    return b
+
+
 def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
     if isinstance(low, NDArray):
+        _check_pair("uniform", low, high)
         return _sample("_sample_uniform", shape if shape != (1,) else (), dtype, ctx,
                        {}, tensors=(low, high))
     return _sample("_random_uniform", shape, dtype, ctx, {"low": low, "high": high})
@@ -22,6 +41,7 @@ def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwa
 
 def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
     if isinstance(loc, NDArray):
+        _check_pair("normal", loc, scale)
         return _sample("_sample_normal", shape if shape != (1,) else (), dtype, ctx,
                        {}, tensors=(loc, scale))
     return _sample("_random_normal", shape, dtype, ctx, {"loc": loc, "scale": scale})
@@ -32,25 +52,42 @@ randn = normal
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
     if isinstance(alpha, NDArray):
+        _check_pair("gamma", alpha, beta)
         return _sample("_sample_gamma", shape if shape != (1,) else (), dtype, ctx,
                        {}, tensors=(alpha, beta))
     return _sample("_random_gamma", shape, dtype, ctx, {"alpha": alpha, "beta": beta})
 
 
 def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    if isinstance(scale, NDArray):
+        return _sample("_sample_exponential", shape if shape != (1,) else (),
+                       dtype, ctx, {}, tensors=(1.0 / scale,))
     return _sample("_random_exponential", shape, dtype, ctx, {"lam": 1.0 / scale})
 
 
 def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    if isinstance(lam, NDArray):
+        return _sample("_sample_poisson", shape if shape != (1,) else (),
+                       dtype, ctx, {}, tensors=(lam,))
     return _sample("_random_poisson", shape, dtype, ctx, {"lam": lam})
 
 
 def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    if isinstance(k, NDArray):
+        _check_pair("negative_binomial", k, p)
+        return _sample("_sample_negative_binomial",
+                       shape if shape != (1,) else (), dtype, ctx, {},
+                       tensors=(k, p))
     return _sample("_random_negative_binomial", shape, dtype, ctx, {"k": k, "p": p})
 
 
 def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype=None, ctx=None,
                                   **kwargs):
+    if isinstance(mu, NDArray):
+        _check_pair("generalized_negative_binomial", mu, alpha)
+        return _sample("_sample_generalized_negative_binomial",
+                       shape if shape != (1,) else (), dtype, ctx, {},
+                       tensors=(mu, alpha))
     return _sample("_random_generalized_negative_binomial", shape, dtype, ctx,
                    {"mu": mu, "alpha": alpha})
 

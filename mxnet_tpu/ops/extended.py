@@ -661,3 +661,71 @@ for _alias, _target in (("BatchNorm_v1", "BatchNorm"),
                         ("_contrib_SparseEmbedding", "Embedding"),
                         ("_contrib_index_copy", "index_copy")):
     _alias_op(_alias, _target)
+
+
+# ------------------------------------------------- gradient-side ops (r3)
+
+@register("gradientmultiplier", aliases=("_contrib_gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0, **_):
+    """Identity forward; backward multiplies the gradient by ``scalar``
+    (reference: src/operator/contrib/gradient_multiplier_op.cc — the
+    gradient-reversal layer of DANN when scalar < 0)."""
+    scalar = float(scalar)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (scalar * g,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("IdentityAttachKLSparseReg", num_outputs=2)
+def identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9, **_):
+    """Identity forward that attaches a KL sparseness penalty to the
+    gradient (reference: src/operator/identity_attach_KL_sparse_reg-inl.h
+    — regularizes mean sigmoid activation toward ``sparseness_target``;
+    the running mean activation is the aux state, updated once per
+    backward there and once per forward here, the same once-per-step
+    cadence under jit).  Returns (out, new_moving_avg)."""
+    t = float(sparseness_target)
+    pen = float(penalty)
+    mom = float(momentum)
+
+    new_moving = mom * moving_avg + (1.0 - mom) * data.mean(axis=0)
+
+    @jax.custom_vjp
+    def f(x, avg):
+        return x
+
+    def fwd(x, avg):
+        return x, avg
+
+    def bwd(avg, g):
+        kl = pen * (-t / avg + (1.0 - t) / (1.0 - avg))
+        return (g + jnp.broadcast_to(kl, g.shape), jnp.zeros_like(avg))
+
+    f.defvjp(fwd, bwd)
+    return f(data, new_moving), new_moving
+
+
+@register("_square_sum", aliases=("square_sum",))
+def square_sum(data, axis=None, keepdims=False, exclude=False, **_):
+    """sum(square(x)) as one op (reference:
+    src/operator/tensor/square_sum-inl.h — fused so a row_sparse
+    input's gradient 2*x*g stays row-sparse; here XLA fuses the dense
+    form and the sparse layer routes row_sparse through retained rows)."""
+    if axis is not None and not isinstance(axis, (tuple, list)):
+        axis = (int(axis),)
+    if exclude and axis is not None:
+        axis = tuple(i for i in range(data.ndim) if i not in
+                     tuple(a % data.ndim for a in axis))
+    return jnp.sum(jnp.square(data), axis=None if axis is None
+                   else tuple(axis), keepdims=bool(keepdims))

@@ -166,6 +166,48 @@ def sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
     return g * beta
 
 
+@register("_sample_exponential", aliases=("sample_exponential",))
+def sample_exponential(key, lam, shape=(), dtype="float32", **_):
+    d = np_dtype(dtype)
+    tail = tuple(shape) if shape else ()
+    e = jax.random.exponential(key, lam.shape + tail, dtype=d)
+    return e / lam.reshape(lam.shape + (1,) * len(tail))
+
+
+def _bcast_tail(arr, tail):
+    return jnp.broadcast_to(arr.reshape(arr.shape + (1,) * len(tail)),
+                            arr.shape + tail)
+
+
+@register("_sample_poisson", aliases=("sample_poisson",))
+def sample_poisson(key, lam, shape=(), dtype="float32", **_):
+    tail = tuple(shape) if shape else ()
+    return jax.random.poisson(key, _bcast_tail(lam, tail)).astype(
+        np_dtype(dtype))
+
+
+@register("_sample_negative_binomial", aliases=("sample_negative_binomial",))
+def sample_negative_binomial(key, k, p, shape=(), dtype="float32", **_):
+    k1, k2 = jax.random.split(key)
+    tail = tuple(shape) if shape else ()
+    k_b = _bcast_tail(k.astype(jnp.float32), tail)
+    p_b = _bcast_tail(p, tail)
+    lam = jax.random.gamma(k1, k_b) * ((1.0 - p_b) / p_b)
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",))
+def sample_gen_negative_binomial(key, mu, alpha, shape=(), dtype="float32",
+                                 **_):
+    k1, k2 = jax.random.split(key)
+    tail = tuple(shape) if shape else ()
+    r = 1.0 / _bcast_tail(alpha, tail)
+    p = r / (r + _bcast_tail(mu, tail))
+    lam = jax.random.gamma(k1, r) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
 @register("_shuffle", aliases=("shuffle",))
 def shuffle(key, data, **_):
     return jax.random.permutation(key, data, axis=0)

@@ -248,6 +248,21 @@ def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
             mom.at[grad_idx].set(new_mom_rows))
 
 
+@register("_sparse_adagrad_update", num_outputs=2, traced_attrs=("lr", "wd"))
+def sparse_adagrad_update(weight, grad_val, grad_idx, history, lr=0.01,
+                          epsilon=1e-7, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0, **_):
+    """AdaGrad touching only the gradient's rows (reference:
+    src/operator/optimizer_op.cc _sparse_adagrad_update)."""
+    rows = weight[grad_idx]
+    g = _apply_wd_rescale(rows, grad_val, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None, wd)
+    new_hist_rows = history[grad_idx] + jnp.square(g)
+    new_rows = rows - lr * g / (jnp.sqrt(new_hist_rows) + epsilon)
+    return (weight.at[grad_idx].set(new_rows),
+            history.at[grad_idx].set(new_hist_rows))
+
+
 @register("_sparse_adam_update", num_outputs=3, traced_attrs=("lr", "wd"))
 def sparse_adam_update(weight, grad_val, grad_idx, mean, var,
                        lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,

@@ -532,6 +532,19 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if getattr(grad, "stype", "default") == "row_sparse":
+            # touch only the gradient's rows (reference
+            # _sparse_adagrad_update)
+            attrs = {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
+                     "rescale_grad": self.rescale_grad,
+                     "clip_gradient": self.clip_gradient
+                     if self.clip_gradient else -1.0}
+            outs = imperative_invoke(
+                "_sparse_adagrad_update",
+                [weight, grad.data, grad.indices, state], attrs)
+            weight._assign(outs[0]._data)
+            state._assign(outs[1]._data)
+            return
         g = grad * self.rescale_grad
         if self.clip_gradient:
             g = g.clip(-self.clip_gradient, self.clip_gradient)

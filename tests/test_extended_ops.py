@@ -291,3 +291,108 @@ def test_multi_sgd_and_group_adagrad():
     assert (_n(new_h) > 0).all()
     scale = 0.1 / (np.sqrt((gs[0] ** 2).mean(axis=1)) + 1e-5)
     assert np.allclose(_n(new_w), ws[0] - scale[:, None] * gs[0], atol=1e-5)
+
+
+# --------------------------------------------------- r3 op additions
+
+def test_gradientmultiplier_reverses_gradient():
+    """Forward identity, backward scaled by scalar (reference:
+    contrib/gradient_multiplier_op.cc; DANN gradient reversal)."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.gradientmultiplier(x, scalar=-0.25)
+        z = (y * mx.nd.array(np.full((2, 3), 2.0, np.float32))).sum()
+    z.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((2, 3), -0.5, np.float32))
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Identity fwd; bwd carries the KL sparsity penalty computed from
+    the momentum-updated mean activation (reference:
+    identity_attach_KL_sparse_reg-inl.h)."""
+    x = mx.nd.array(np.full((4, 3), 0.2, np.float32))
+    x.attach_grad()
+    avg = mx.nd.zeros((3,))
+    with mx.autograd.record():
+        out, new_avg = mx.nd.IdentityAttachKLSparseReg(
+            x, avg, sparseness_target=0.1, penalty=0.001, momentum=0.9)
+        out.sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), 0.2)
+    np.testing.assert_allclose(new_avg.asnumpy(), 0.02, rtol=1e-6)
+    a = 0.02
+    expect = 1.0 + 0.001 * (-0.1 / a + 0.9 / (1 - a))
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_square_sum_matches_numpy():
+    """_square_sum = sum(x^2) with axis/keepdims (reference:
+    tensor/square_sum-inl.h)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    xd = mx.nd.array(x)
+    np.testing.assert_allclose(
+        mx.nd.square_sum(xd).asnumpy(), (x ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.square_sum(xd, axis=1, keepdims=True).asnumpy(),
+        (x ** 2).sum(axis=1, keepdims=True), rtol=1e-5)
+    xd.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.square_sum(xd)
+    y.backward()
+    np.testing.assert_allclose(xd.grad.asnumpy(), 2 * x, rtol=1e-5)
+
+
+def test_sparse_adagrad_update_touches_only_grad_rows():
+    """reference: optimizer_op.cc _sparse_adagrad_update."""
+    from mxnet_tpu import optimizer as opt
+
+    w = mx.nd.array(np.ones((6, 3), np.float32))
+    h = mx.nd.zeros((6, 3))
+    o = opt.create("adagrad", learning_rate=0.5)
+    g = mx.nd.sparse.row_sparse_array(
+        (np.full((2, 3), 2.0, np.float32), np.array([1, 4])),
+        shape=(6, 3))
+    o.update(0, w, g, h)
+    wn, hn = w.asnumpy(), h.asnumpy()
+    assert np.allclose(wn[[0, 2, 3, 5]], 1.0)
+    assert np.allclose(hn[[0, 2, 3, 5]], 0.0)
+    # w -= lr * g / (sqrt(g^2) + eps) = 1 - 0.5 * 2/2 = 0.5
+    np.testing.assert_allclose(wn[[1, 4]], 0.5, rtol=1e-5)
+    np.testing.assert_allclose(hn[[1, 4]], 4.0, rtol=1e-6)
+
+
+def test_sample_distribution_families():
+    """Per-parameter-array _sample_* ops: empirical means match the
+    distribution means at 8 sigma (reference: the _sample_* family in
+    tensor/multisample_op.cc)."""
+    mx.random.seed(42)
+    n = 20000
+
+    lam = np.array([1.0, 6.0], np.float32)
+    s = mx.nd.random.poisson(lam=mx.nd.array(lam), shape=(n,)).asnumpy()
+    assert s.shape == (2, n)
+    for i, l in enumerate(lam):
+        assert abs(s[i].mean() - l) < 8 * np.sqrt(l / n), (i, s[i].mean())
+
+    scale = np.array([2.0, 0.5], np.float32)
+    e = mx.nd.random.exponential(scale=mx.nd.array(scale),
+                                 shape=(n,)).asnumpy()
+    for i, sc in enumerate(scale):
+        assert abs(e[i].mean() - sc) < 8 * sc / np.sqrt(n)
+
+    k, p = np.array([3.0], np.float32), np.array([0.4], np.float32)
+    nb = mx.nd.random.negative_binomial(
+        k=mx.nd.array(k), p=mx.nd.array(p), shape=(n,)).asnumpy()
+    mean_nb = k[0] * (1 - p[0]) / p[0]
+    var_nb = mean_nb / p[0]
+    assert abs(nb.mean() - mean_nb) < 8 * np.sqrt(var_nb / n), nb.mean()
+
+    mu, alpha = np.array([2.0], np.float32), np.array([0.5], np.float32)
+    gnb = mx.nd.random.generalized_negative_binomial(
+        mu=mx.nd.array(mu), alpha=mx.nd.array(alpha),
+        shape=(n,)).asnumpy()
+    var_gnb = mu[0] + alpha[0] * mu[0] ** 2
+    assert abs(gnb.mean() - mu[0]) < 8 * np.sqrt(var_gnb / n), gnb.mean()

@@ -505,6 +505,21 @@ CREATION = {
 # the sweep still asserts the name is registered
 ELSEWHERE = {
     "RNN": ("tests/test_rnn.py", "FusedRNNCell"),
+    "gradientmultiplier": ("tests/test_extended_ops.py",
+                           "gradientmultiplier"),
+    "IdentityAttachKLSparseReg": ("tests/test_extended_ops.py",
+                                  "IdentityAttachKLSparseReg"),
+    "_square_sum": ("tests/test_extended_ops.py", "square_sum"),
+    "_sparse_adagrad_update": ("tests/test_extended_ops.py",
+                               "sparse_adagrad_update"),
+    "_sample_exponential": ("tests/test_extended_ops.py",
+                            "sample_distribution_families"),
+    "_sample_poisson": ("tests/test_extended_ops.py",
+                        "sample_distribution_families"),
+    "_sample_negative_binomial": ("tests/test_extended_ops.py",
+                                  "sample_distribution_families"),
+    "_sample_generalized_negative_binomial": (
+        "tests/test_extended_ops.py", "sample_distribution_families"),
     "_basic_index": ("tests/test_ndarray.py", "_basic_index"),
     "_subgraph_exec": ("tests/test_subgraph.py", "_subgraph_exec"),
     "Custom": ("tests/test_review_fixes.py", "Custom"),
