@@ -17,7 +17,7 @@ from ...nn import HybridSequential, Sequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting", "RandomColorJitter"]
+           "RandomHue", "RandomLighting", "RandomColorJitter"]
 
 
 def _as_np(x):
@@ -208,6 +208,31 @@ class RandomSaturation(_RandomJitter):
         return ndarray.array(gray + (arr - gray) * self._alpha())
 
 
+class RandomHue(Block):
+    """YIQ hue rotation by a random angle in [-hue, hue] (reference:
+    transforms.py RandomHue over image.py HueJitterAug)."""
+
+    _tyiq = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], dtype=_np.float32)
+    _ityiq = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], dtype=_np.float32)
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        alpha = _np.random.uniform(-self._hue, self._hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       dtype=_np.float32)
+        t = (self._ityiq @ bt @ self._tyiq).T
+        arr = _as_np(x).astype(_np.float32)
+        return ndarray.array(arr @ t)
+
+
 class RandomLighting(Block):
     """AlexNet-style PCA lighting noise (reference: transforms.py)."""
 
@@ -237,6 +262,8 @@ class RandomColorJitter(Block):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         order = _np.random.permutation(len(self._ts))

@@ -78,6 +78,17 @@ def test_transforms():
     assert c.shape == (4, 6, 3)
     f = transforms.RandomFlipLeftRight()(img)
     assert f.shape == img.shape
+    # hue=0 angle must be near-identity (the published YIQ constants
+    # invert only to ~0.3% of the 0-255 scale); nonzero preserves shape
+    h0 = transforms.RandomHue(0.0)(img.astype("float32"))
+    np.testing.assert_allclose(h0.asnumpy(), img.asnumpy().astype(np.float32),
+                               atol=1.5)
+    h = transforms.RandomHue(0.5)(img.astype("float32"))
+    assert h.shape == img.shape
+    j = transforms.RandomColorJitter(brightness=0.1, contrast=0.1,
+                                     saturation=0.1, hue=0.1)(
+        img.astype("float32"))
+    assert j.shape == img.shape
 
 
 def test_last_batch_rollover():
