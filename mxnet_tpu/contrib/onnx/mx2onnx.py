@@ -24,8 +24,11 @@ _DTYPE_TO_ONNX = {
 
 
 def tensor_proto(name, arr):
-    arr = _np.ascontiguousarray(arr)
-    return {"name": name, "dims": list(arr.shape),
+    # ascontiguousarray promotes 0-d to (1,); keep the true shape so
+    # scalar initializers (Clip bounds, Pad value) stay ONNX scalars
+    shape = _np.shape(arr)
+    arr = _np.ascontiguousarray(arr).reshape(shape)
+    return {"name": name, "dims": list(shape),
             "data_type": _DTYPE_TO_ONNX[arr.dtype],
             "raw_data": arr.tobytes()}
 
@@ -219,22 +222,126 @@ def _export_node(ex, node, ins, out):
         ex.emit("Sqrt", ins, [out], name)
     elif op == "negative":
         ex.emit("Neg", ins, [out], name)
-    elif op in ("sum", "sum_axis"):
+    elif op in ("abs",):
+        ex.emit("Abs", ins, [out], name)
+    elif op in ("floor",):
+        ex.emit("Floor", ins, [out], name)
+    elif op in ("ceil",):
+        ex.emit("Ceil", ins, [out], name)
+    elif op in ("reciprocal",):
+        ex.emit("Reciprocal", ins, [out], name)
+    elif op in ("broadcast_power", "_power", "elemwise_power", "_Power"):
+        ex.emit("Pow", ins, [out], name)
+    elif op in ("broadcast_maximum", "_maximum", "maximum"):
+        ex.emit("Max", ins, [out], name)
+    elif op in ("broadcast_minimum", "_minimum", "minimum"):
+        ex.emit("Min", ins, [out], name)
+    elif op == "hard_sigmoid":
+        ex.emit("HardSigmoid", ins, [out], name,
+                alpha=float(a.get("alpha", 0.2)),
+                beta=float(a.get("beta", 0.5)))
+    elif op == "LRN":
+        ex.emit("LRN", ins, [out], name,
+                alpha=float(a.get("alpha", 1e-4)),
+                beta=float(a.get("beta", 0.75)),
+                bias=float(a.get("knorm", 2.0)),
+                size=int(a.get("nsize", 5)))
+    elif op == "InstanceNorm":
+        ex.emit("InstanceNormalization", ins, [out], name,
+                epsilon=float(a.get("eps", 1e-3)))
+    elif op == "argmax":
+        if a.get("axis") is None:
+            # axis=None means argmax over the FLATTENED array; ONNX
+            # ArgMax has no such mode
+            raise NotImplementedError(
+                "ONNX export: argmax without axis (flatten semantics)")
+        # mxnet argmax returns float32; ONNX ArgMax emits int64 — cast
+        # back so typed consumers line up
+        raw = ex.tmp(name + "_i64")
+        ex.emit("ArgMax", ins, [raw], name,
+                axis=int(a["axis"]),
+                keepdims=int(a.get("keepdims", False)))
+        ex.emit("Cast", [raw], [out], name + "_cast", to=P.FLOAT)
+    elif op in ("sum", "sum_axis", "mean", "max", "min", "prod"):
+        onnx_op = {"sum": "ReduceSum", "sum_axis": "ReduceSum",
+                   "mean": "ReduceMean", "max": "ReduceMax",
+                   "min": "ReduceMin", "prod": "ReduceProd"}[op]
+        if a.get("exclude"):
+            raise NotImplementedError(
+                "ONNX export: reduce with exclude=True")
         axes = a.get("axis", None)
         kw = {}
         if axes is not None and axes != ():
             kw["axes"] = [int(x) for x in (axes if isinstance(
                 axes, (tuple, list)) else (axes,))]
-        ex.emit("ReduceSum", ins, [out], name,
+        ex.emit(onnx_op, ins, [out], name,
                 keepdims=int(a.get("keepdims", False)), **kw)
-    elif op == "mean":
+    elif op == "squeeze":
         axes = a.get("axis", None)
         kw = {}
         if axes is not None and axes != ():
             kw["axes"] = [int(x) for x in (axes if isinstance(
                 axes, (tuple, list)) else (axes,))]
-        ex.emit("ReduceMean", ins, [out], name,
-                keepdims=int(a.get("keepdims", False)), **kw)
+        ex.emit("Squeeze", ins, [out], name, **kw)
+    elif op == "expand_dims":
+        ex.emit("Unsqueeze", ins, [out], name,
+                axes=[int(a.get("axis", 0))])
+    elif op == "slice_axis":
+        ax = int(a.get("axis", 0))
+        begin = int(a.get("begin", 0))
+        end = a.get("end", None)
+        end = int(end) if end is not None else _np.iinfo(_np.int64).max
+        starts = ex.const_i64(ex.tmp(name + "_starts"), [begin])
+        ends = ex.const_i64(ex.tmp(name + "_ends"), [end])
+        axes_t = ex.const_i64(ex.tmp(name + "_axes"), [ax])
+        ex.emit("Slice", [ins[0], starts, ends, axes_t], [out], name)
+    elif op in ("pad", "Pad"):
+        pw = [int(x) for x in a.get("pad_width", ())]
+        ndim = len(pw) // 2
+        pads = [pw[2 * i] for i in range(ndim)] + \
+               [pw[2 * i + 1] for i in range(ndim)]
+        pname = ex.const_i64(ex.tmp(name + "_pads"), pads)
+        vname = ex.tmp(name + "_value")
+        ex.initializers.append(tensor_proto(
+            vname, _np.asarray(float(a.get("constant_value", 0.0)),
+                               _np.float32)))
+        if a.get("mode", "constant") != "constant":
+            raise NotImplementedError("ONNX export: pad mode %r"
+                                      % a.get("mode"))
+        ex.emit("Pad", [ins[0], pname, vname], [out], name,
+                mode="constant")
+    elif op == "SliceChannel":
+        outs = out if isinstance(out, list) else [out]
+        ex.emit("Split", ins, outs, name, axis=int(a.get("axis", 1)))
+    elif op in ("_mul_scalar", "_plus_scalar", "_minus_scalar",
+                "_rminus_scalar", "_div_scalar", "_rdiv_scalar",
+                "_power_scalar", "_rpower_scalar", "_maximum_scalar",
+                "_minimum_scalar"):
+        onnx_op, reversed_ = {
+            "_mul_scalar": ("Mul", False), "_plus_scalar": ("Add", False),
+            "_minus_scalar": ("Sub", False), "_rminus_scalar": ("Sub", True),
+            "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+            "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+            "_maximum_scalar": ("Max", False),
+            "_minimum_scalar": ("Min", False)}[op]
+        sname = ex.tmp(name + "_scalar")
+        ex.initializers.append(tensor_proto(
+            sname, _np.asarray(float(a.get("scalar", 0.0)), _np.float32)))
+        pair = [sname, ins[0]] if reversed_ else [ins[0], sname]
+        ex.emit(onnx_op, pair, [out], name)
+    elif op == "UpSampling":
+        if a.get("sample_type", "nearest") != "nearest":
+            raise NotImplementedError("ONNX export: UpSampling %r"
+                                      % a.get("sample_type"))
+        scale = float(a.get("scale", 2))
+        roi = ex.tmp(name + "_roi")
+        ex.initializers.append(tensor_proto(
+            roi, _np.zeros((0,), _np.float32)))
+        scales = ex.tmp(name + "_scales")
+        ex.initializers.append(tensor_proto(
+            scales, _np.asarray([1.0, 1.0, scale, scale], _np.float32)))
+        ex.emit("Resize", [ins[0], roi, scales], [out], name,
+                mode="nearest")
     elif op == "clip":
         mn = ex.tmp(name + "_min")
         mx = ex.tmp(name + "_max")

@@ -187,10 +187,13 @@ def _import_node(sym_mod, node, env, consts):
     elif op == "Clip":
         a_min = const_of(1)
         a_max = const_of(2)
-        out = S.clip(ins[0],
-                     a_min=float(a_min) if a_min is not None else -3.4e38,
-                     a_max=float(a_max) if a_max is not None else 3.4e38,
-                     name=name)
+        out = S.clip(
+            ins[0],
+            a_min=(float(_np.asarray(a_min).ravel()[0])
+                   if a_min is not None else -3.4e38),
+            a_max=(float(_np.asarray(a_max).ravel()[0])
+                   if a_max is not None else 3.4e38),
+            name=name)
     elif op == "ReduceSum":
         out = S.sum(ins[0], axis=tuple(a.get("axes", ())) or None,
                     keepdims=bool(a.get("keepdims", 1)), name=name)
@@ -199,6 +202,133 @@ def _import_node(sym_mod, node, env, consts):
                      keepdims=bool(a.get("keepdims", 1)), name=name)
     elif op == "Identity":
         out = ins[0]
+    elif op == "Pow":
+        out = S.broadcast_power(ins[0], ins[1], name=name)
+    elif op in ("Max", "Min"):
+        fn = S.broadcast_maximum if op == "Max" else S.broadcast_minimum
+        out = ins[0]
+        for other in ins[1:]:
+            out = fn(out, other)
+    elif op == "Abs":
+        out = S.abs(ins[0], name=name)
+    elif op == "Floor":
+        out = S.floor(ins[0], name=name)
+    elif op == "Ceil":
+        out = S.ceil(ins[0], name=name)
+    elif op == "Reciprocal":
+        out = S.reciprocal(ins[0], name=name)
+    elif op == "HardSigmoid":
+        out = S.hard_sigmoid(ins[0], alpha=float(a.get("alpha", 0.2)),
+                             beta=float(a.get("beta", 0.5)), name=name)
+    elif op == "LRN":
+        out = S.LRN(ins[0], alpha=float(a.get("alpha", 1e-4)),
+                    beta=float(a.get("beta", 0.75)),
+                    knorm=float(a.get("bias", 1.0)),
+                    nsize=int(a["size"]), name=name)
+    elif op == "InstanceNormalization":
+        out = S.InstanceNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                             name=name)
+    elif op == "ArgMax":
+        out = S.argmax(ins[0], axis=int(a.get("axis", 0)),
+                       keepdims=bool(a.get("keepdims", 1)), name=name)
+    elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+        fn = {"ReduceMax": S.max, "ReduceMin": S.min,
+              "ReduceProd": S.prod}[op]
+        out = fn(ins[0], axis=tuple(a.get("axes", ())) or None,
+                 keepdims=bool(a.get("keepdims", 1)), name=name)
+    elif op == "Squeeze":
+        axes = a.get("axes")
+        if axes is None and len(node["input"]) > 1:
+            axes = [int(x) for x in const_of(1)]
+        out = S.squeeze(ins[0], axis=tuple(axes) if axes else None,
+                        name=name)
+    elif op == "Unsqueeze":
+        axes = a.get("axes")
+        if axes is None and len(node["input"]) > 1:
+            axes = [int(x) for x in const_of(1)]
+        out = ins[0]
+        for ax in sorted(int(x) for x in axes):
+            out = S.expand_dims(out, axis=ax)
+    elif op == "Slice":
+        if "starts" in a:                      # opset < 10: attributes
+            starts, ends = a["starts"], a["ends"]
+            axes = a.get("axes", list(range(len(starts))))
+        else:                                  # opset >= 10: const inputs
+            starts = [int(x) for x in const_of(1)]
+            ends = [int(x) for x in const_of(2)]
+            axes = ([int(x) for x in const_of(3)]
+                    if len(node["input"]) > 3 and const_of(3) is not None
+                    else list(range(len(starts))))
+            if len(node["input"]) > 4 and const_of(4) is not None and \
+                    any(int(x) != 1 for x in const_of(4)):
+                raise NotImplementedError("ONNX Slice with steps != 1")
+        out = ins[0]
+        big = int(_np.iinfo(_np.int64).max)
+        for ax, b, e in zip(axes, starts, ends):
+            out = S.slice_axis(out, axis=int(ax), begin=int(b),
+                               end=None if int(e) >= big or int(e) == 2147483647
+                               else int(e))
+    elif op == "Split":
+        axis = int(a.get("axis", 0))
+        n_out = len(node["output"])
+        sections = a.get("split")
+        if sections is None and len(node["input"]) > 1:
+            sections = [int(x) for x in const_of(1)]
+        if sections and len(set(int(s) for s in sections)) > 1:
+            # uneven split: a chain of slice_axis, one per section
+            bounds = _np.cumsum([0] + [int(s) for s in sections])
+            out = [S.slice_axis(ins[0], axis=axis, begin=int(b),
+                                end=int(e))
+                   for b, e in zip(bounds[:-1], bounds[1:])]
+        else:
+            out = S.SliceChannel(ins[0], num_outputs=n_out, axis=axis,
+                                 name=name)
+    elif op == "Pad":
+        if "pads" in a:
+            pads = [int(x) for x in a["pads"]]
+        else:
+            pads = [int(x) for x in const_of(1)]
+        mode = a.get("mode", "constant")
+        if mode not in ("constant",):
+            raise NotImplementedError("ONNX Pad mode %r" % mode)
+        ndim = len(pads) // 2
+        value = float(a.get("value", 0.0))
+        if len(node["input"]) > 2 and const_of(2) is not None:
+            value = float(_np.asarray(const_of(2)).ravel()[0])
+        pad_width = []
+        for i in range(ndim):
+            pad_width += [pads[i], pads[ndim + i]]
+        out = S.pad(ins[0], mode="constant", pad_width=tuple(pad_width),
+                    constant_value=value, name=name)
+    elif op == "Constant":
+        arr = a.get("value")
+        if arr is None:
+            raise NotImplementedError("ONNX Constant without tensor value")
+        consts[node["output"][0]] = arr
+        out = S.Variable(node["output"][0])
+    elif op in ("Upsample", "Resize"):
+        mode = a.get("mode", "nearest")
+        if mode != "nearest":
+            raise NotImplementedError("ONNX %s mode %r" % (op, mode))
+        scales = a.get("scales")
+        if scales is None:
+            # Upsample (opset 9): input 1 is scales.  Resize: input 2 is
+            # scales; input 3 would be `sizes`, which is NOT supported —
+            # never read it as scales.
+            idx = 1 if op == "Upsample" else 2
+            c = const_of(idx) if len(node["input"]) > idx else None
+            if c is not None and len(c):
+                scales = [float(x) for x in c]
+            elif op == "Resize" and len(node["input"]) > 3 and \
+                    const_of(3) is not None and len(const_of(3)):
+                raise NotImplementedError("ONNX Resize by `sizes`")
+        if not scales or len(scales) < 4 or scales[2] != scales[3]:
+            raise NotImplementedError("ONNX resize scales %r" % (scales,))
+        if scales[2] != int(scales[2]):
+            raise NotImplementedError(
+                "ONNX resize: non-integer scale %r" % (scales[2],))
+        out = S.UpSampling(ins[0], scale=int(scales[2]),
+                           sample_type="nearest", name=name)
     else:
         raise NotImplementedError("ONNX import: op %r not supported" % op)
 

@@ -195,3 +195,51 @@ def test_onnx_gluon_export_import(tmp_path):
     got = net2(mx.nd.array(x))
     got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
     assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
+
+
+def test_onnx_roundtrip_tensor_manipulation(tmp_path):
+    """r3 converters: Pad, Slice, Unsqueeze/Squeeze, Pow, Max/Min,
+    ReduceMax, HardSigmoid."""
+    rng = np.random.RandomState(3)
+    data = mx.sym.Variable("data")            # (2, 3, 4, 6)
+    h = mx.sym.pad(data, mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 1, 2, 2),
+                   constant_value=0.5, name="pd")
+    h = mx.sym.slice_axis(h, axis=2, begin=1, end=5, name="sl")
+    h = mx.sym.squeeze(mx.sym.expand_dims(h, axis=0, name="ed"), axis=0,
+                       name="sq")
+    w = mx.sym.Variable("w")
+    h = mx.sym.broadcast_power(h, w, name="pw")
+    h = mx.sym.broadcast_maximum(h, w, name="mx_")
+    h = mx.sym.broadcast_minimum(h, 3.0 * w, name="mn")
+    h = mx.sym.max(h, axis=1, keepdims=True, name="rmax")
+    sym = mx.sym.hard_sigmoid(h, name="hs")
+    args = {"w": mx.nd.array(np.full((1, 1, 1, 1), 1.3, np.float32))}
+    x = (rng.rand(2, 3, 4, 6).astype(np.float32) + 0.2)
+    _roundtrip(sym, args, {}, x, tmp_path, atol=1e-4)
+
+
+def test_onnx_roundtrip_norm_upsample(tmp_path):
+    """r3 converters: LRN, InstanceNorm, UpSampling(nearest)."""
+    rng = np.random.RandomState(4)
+    data = mx.sym.Variable("data")            # (1, 4, 5, 5)
+    h = mx.sym.LRN(data, nsize=3, name="lrn")
+    h = mx.sym.InstanceNorm(h, mx.sym.Variable("g"), mx.sym.Variable("b"),
+                            eps=1e-4, name="inorm")
+    sym = mx.sym.UpSampling(h, scale=2, sample_type="nearest", name="up")
+    args = {"g": mx.nd.array(rng.rand(4).astype(np.float32) + 0.5),
+            "b": mx.nd.array(rng.randn(4).astype(np.float32) * 0.1)}
+    x = rng.rand(1, 4, 5, 5).astype(np.float32)
+    _roundtrip(sym, args, {}, x, tmp_path, atol=1e-3)
+
+
+def test_onnx_roundtrip_split(tmp_path):
+    """r3 converters: SliceChannel <-> Split (multi-output)."""
+    rng = np.random.RandomState(5)
+    data = mx.sym.Variable("data")            # (2, 6)
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1, name="sp")
+    sym = mx.sym.Concat(mx.sym.relu(parts[0], name="r0"),
+                        mx.sym.negative(parts[1], name="n1"),
+                        dim=1, name="cc")
+    x = rng.randn(2, 6).astype(np.float32)
+    _roundtrip(sym, {}, {}, x, tmp_path)
