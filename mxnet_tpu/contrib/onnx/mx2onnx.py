@@ -312,7 +312,14 @@ def _export_node(ex, node, ins, out):
                 mode="constant")
     elif op == "SliceChannel":
         outs = out if isinstance(out, list) else [out]
-        ex.emit("Split", ins, outs, name, axis=int(a.get("axis", 1)))
+        axis = int(a.get("axis", 1))
+        if a.get("squeeze_axis"):
+            raws = [ex.tmp(o) for o in outs]
+            ex.emit("Split", ins, raws, name, axis=axis)
+            for raw, o in zip(raws, outs):
+                ex.emit("Squeeze", [raw], [o], o + "_sq", axes=[axis])
+        else:
+            ex.emit("Split", ins, outs, name, axis=axis)
     elif op in ("_mul_scalar", "_plus_scalar", "_minus_scalar",
                 "_rminus_scalar", "_div_scalar", "_rdiv_scalar",
                 "_power_scalar", "_rpower_scalar", "_maximum_scalar",

@@ -246,6 +246,11 @@ def _import_node(sym_mod, node, env, consts):
         axes = a.get("axes")
         if axes is None and len(node["input"]) > 1:
             axes = [int(x) for x in const_of(1)]
+        if any(int(ax) < 0 for ax in axes):
+            # negative axes index the OUTPUT rank, which we cannot know
+            # without shape inference here
+            raise NotImplementedError(
+                "ONNX Unsqueeze with negative axes %s" % (axes,))
         out = ins[0]
         for ax in sorted(int(x) for x in axes):
             out = S.expand_dims(out, axis=ax)
@@ -322,7 +327,8 @@ def _import_node(sym_mod, node, env, consts):
             elif op == "Resize" and len(node["input"]) > 3 and \
                     const_of(3) is not None and len(const_of(3)):
                 raise NotImplementedError("ONNX Resize by `sizes`")
-        if not scales or len(scales) < 4 or scales[2] != scales[3]:
+        if not scales or len(scales) < 4 or scales[2] != scales[3] \
+                or scales[0] != 1.0 or scales[1] != 1.0:
             raise NotImplementedError("ONNX resize scales %r" % (scales,))
         if scales[2] != int(scales[2]):
             raise NotImplementedError(
