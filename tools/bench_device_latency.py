@@ -64,13 +64,18 @@ def main(argv=None):
                     .astype(args.dtype if args.dtype != "float32"
                             else np.float32))
 
+    def _wait(arr):
+        # through the axon relay block_until_ready returns EARLY; only
+        # a host fetch is a true completion barrier (BENCH_NOTES r3)
+        return float(jnp.sum(arr.astype(jnp.float32)))
+
     # --- host-dispatched: one call per forward
     jf = jax.jit(forward)
-    jf(pvals, x).block_until_ready()
+    _wait(jf(pvals, x))
     t0 = time.perf_counter()
     for _ in range(args.outer):
         out = jf(pvals, x)
-    out.block_until_ready()
+    _wait(out)
     host_ms = (time.perf_counter() - t0) / args.outer * 1000
 
     # --- device-only: K chained forwards in one computation; feed a
@@ -84,9 +89,9 @@ def main(argv=None):
             return carry + bump
         return lax.fori_loop(0, args.inner, body, x)
 
-    chained(pvals, x).block_until_ready()
+    _wait(chained(pvals, x))
     t0 = time.perf_counter()
-    chained(pvals, x).block_until_ready()
+    _wait(chained(pvals, x))
     dev_ms = (time.perf_counter() - t0) / args.inner * 1000
 
     print(json.dumps({
