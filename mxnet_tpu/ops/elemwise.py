@@ -32,7 +32,7 @@ _UNARY = {
     "ceil": jnp.ceil,
     "floor": jnp.floor,
     "trunc": jnp.trunc,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,      # fix == round-toward-zero; jnp.fix is deprecated
     "round": jnp.round,
     "square": jnp.square,
     "sqrt": jnp.sqrt,
