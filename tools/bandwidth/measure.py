@@ -33,11 +33,16 @@ def measure(size_mb=64, iters=10, dtype="float32"):
         return shard_map(lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
                          in_specs=P("dp", None), out_specs=P("dp", None))(x)
 
-    allreduce(x).block_until_ready()  # compile + warmup
+    def _wait(arr):
+        # through the axon relay block_until_ready can resolve before
+        # the device finishes; a scalar host fetch is the true barrier
+        return float(jnp.sum(arr[:, :1].astype(jnp.float32)))
+
+    _wait(allreduce(x))               # compile + warmup
     tic = time.time()
     for _ in range(iters):
         out = allreduce(x)
-    out.block_until_ready()
+    _wait(out)
     dt = (time.time() - tic) / iters
     # ring all-reduce moves 2*(n-1)/n of the buffer per chip
     bytes_moved = 2 * (n - 1) / max(n, 1) * elems * np.dtype(dtype).itemsize
