@@ -295,3 +295,48 @@ def test_ps_optimizer_blob_allowlisted():
     direct = pickle.dumps(mx.optimizer.get_updater)  # a function
     with pytest.raises(pickle.UnpicklingError):
         _OptimizerUnpickler(_io.BytesIO(direct)).load()
+
+
+def test_metric_sklearn_oracle():
+    """F1 / MCC / PearsonCorrelation vs sklearn & scipy on random data
+    (reference: tests/python/unittest/test_metric.py, which checks the
+    same metrics against hand-rolled references)."""
+    scipy_stats = pytest.importorskip("scipy.stats")
+    sk = pytest.importorskip("sklearn.metrics")
+    pearsonr = scipy_stats.pearsonr
+    f1_score, matthews_corrcoef = sk.f1_score, sk.matthews_corrcoef
+
+    rng = np.random.RandomState(0)
+    n = 200
+    labels = rng.randint(0, 2, n).astype(np.float32)
+    # probabilistic 2-class predictions, imbalanced on purpose
+    p1 = np.clip(labels * 0.6 + rng.rand(n) * 0.5, 0, 1)
+    preds = np.stack([1 - p1, p1], axis=1).astype(np.float32)
+    hard = preds.argmax(1)
+
+    m = mx.metric.F1()
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert abs(m.get()[1] - f1_score(labels, hard)) < 1e-6
+
+    m = mx.metric.MCC()
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    assert abs(m.get()[1] - matthews_corrcoef(labels, hard)) < 1e-6
+
+    x = rng.randn(n).astype(np.float32)
+    y = (0.7 * x + 0.3 * rng.randn(n)).astype(np.float32)
+    m = mx.metric.PearsonCorrelation()
+    m.update([mx.nd.array(y)], [mx.nd.array(x)])
+    assert abs(m.get()[1] - pearsonr(x, y)[0]) < 1e-5
+
+
+def test_metric_nll():
+    """NegativeLogLikelihood matches -mean(log p_true) (reference
+    metric.py NegativeLogLikelihood)."""
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 4, 50).astype(np.float32)
+    preds = rng.dirichlet(np.ones(4), 50).astype(np.float32)
+    m = mx.metric.NegativeLogLikelihood()
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    want = -np.mean(np.log(preds[np.arange(50), labels.astype(int)]
+                           + 1e-12))
+    assert abs(m.get()[1] - want) < 1e-4

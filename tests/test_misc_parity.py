@@ -295,3 +295,40 @@ def test_tensorboard_callback_writes_real_tfevents(tmp_path):
     w2.close()
     # known-answer CRC32C check (RFC 3720 test vector)
     assert tb._crc32c(b"123456789") == 0xE3069283
+
+
+def test_initializer_variance_matrix():
+    """Xavier/MSRAPrelu variances match their formulas per
+    factor_type x magnitude; Orthogonal produces orthonormal rows
+    (reference: initializer.py docstrings / test_init.py)."""
+    shape = (256, 512)
+    fan_in, fan_out = shape[1], shape[0]
+    for factor, denom in (("in", fan_in), ("out", fan_out),
+                          ("avg", (fan_in + fan_out) / 2.0)):
+        for mag in (2.0, 3.0):
+            init = mx.init.Xavier(rnd_type="uniform", factor_type=factor,
+                                  magnitude=mag)
+            arr = mx.nd.zeros(shape)
+            init(mx.init.InitDesc("w_weight"), arr)
+            a = arr.asnumpy()
+            scale = np.sqrt(mag / denom)
+            assert abs(a.max() - scale) / scale < 0.05, (factor, mag)
+            assert abs(a.min() + scale) / scale < 0.05
+            # uniform(-s, s) variance = s^2/3
+            assert abs(a.var() - scale ** 2 / 3) / (scale ** 2 / 3) < 0.1
+
+    init = mx.init.MSRAPrelu(factor_type="in", slope=0.25)
+    arr = mx.nd.zeros(shape)
+    init(mx.init.InitDesc("w_weight"), arr)
+    a = arr.asnumpy()
+    # MSRAPrelu is gaussian with var = magnitude/denom
+    want_var = (2.0 / (1 + 0.25 ** 2)) / fan_in
+    assert abs(a.var() - want_var) / want_var < 0.1
+
+    init = mx.init.Orthogonal()
+    arr = mx.nd.zeros((64, 256))
+    init(mx.init.InitDesc("w_weight"), arr)
+    a = arr.asnumpy()
+    gram = a @ a.T
+    np.testing.assert_allclose(gram, np.eye(64) * gram[0, 0],
+                               atol=1e-4 * abs(gram[0, 0]) + 1e-5)
