@@ -163,3 +163,71 @@ def test_executor_reshape():
     w = ex.arg_dict["fc_weight"].asnumpy()
     assert_almost_equal(y5, x5 @ w.T, rtol=1e-5, atol=1e-5)
     assert_almost_equal(y2, x2 @ w.T, rtol=1e-5, atol=1e-5)
+
+
+def test_augmenter_semantics_matrix():
+    """Each augmenter's output vs a manual numpy computation on a fixed
+    image (reference: test_image.py test_augmenters — semantic checks,
+    not just shape checks)."""
+    rng = np.random.RandomState(7)
+    img_np = (rng.rand(12, 10, 3) * 255).astype(np.float32)
+    img = nd.array(img_np)
+
+    # CenterCropAug: crop the centered (w, h) region
+    out = mx.image.CenterCropAug((6, 8))(img).asnumpy()
+    y0, x0 = (12 - 8) // 2, (10 - 6) // 2
+    np.testing.assert_allclose(out, img_np[y0:y0 + 8, x0:x0 + 6])
+
+    # HorizontalFlipAug(p=1): width axis reversed
+    out = mx.image.HorizontalFlipAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img_np[:, ::-1])
+
+    # CastAug: dtype change only
+    out = mx.image.CastAug("float32")(nd.array(
+        img_np.astype(np.uint8)))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(
+        out.asnumpy(), img_np.astype(np.uint8).astype(np.float32))
+
+    # ColorNormalizeAug: (x - mean) / std
+    mean = np.array([10.0, 20.0, 30.0], np.float32)
+    std = np.array([2.0, 3.0, 4.0], np.float32)
+    out = mx.image.ColorNormalizeAug(nd.array(mean),
+                                     nd.array(std))(img).asnumpy()
+    np.testing.assert_allclose(out, (img_np - mean) / std, rtol=1e-6)
+
+    # BrightnessJitterAug with zero jitter is identity
+    out = mx.image.BrightnessJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img_np, rtol=1e-6)
+
+    # ContrastJitterAug(0): identity (alpha == 1)
+    out = mx.image.ContrastJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img_np, rtol=1e-5, atol=1e-2)
+
+    # SaturationJitterAug(0): identity
+    out = mx.image.SaturationJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img_np, rtol=1e-5, atol=1e-2)
+
+    # HueJitterAug(0): near-identity (YIQ constants invert to ~0.3%)
+    out = mx.image.HueJitterAug(0.0)(img).asnumpy()
+    np.testing.assert_allclose(out, img_np, atol=1.5)
+
+    # RandomGrayAug(p=1): all channels equal the luma
+    out = mx.image.RandomGrayAug(1.0)(img).asnumpy()
+    assert np.allclose(out[..., 0], out[..., 1], atol=1e-3)
+    assert np.allclose(out[..., 1], out[..., 2], atol=1e-3)
+    luma = (img_np * np.array([0.299, 0.587, 0.114],
+                              np.float32)).sum(-1)
+    np.testing.assert_allclose(out[..., 0], luma, rtol=1e-3, atol=0.5)
+
+    # LightingAug with zero alphastd is identity
+    out = mx.image.LightingAug(0.0, nd.array(np.ones(3)),
+                               nd.array(np.eye(3)))(img).asnumpy()
+    np.testing.assert_allclose(out, img_np, rtol=1e-5, atol=1e-3)
+
+    # SequentialAug applies in order
+    seq = mx.image.SequentialAug([mx.image.HorizontalFlipAug(1.0),
+                                  mx.image.CenterCropAug((6, 8))])
+    out = seq(img).asnumpy()
+    np.testing.assert_allclose(out, img_np[:, ::-1][y0:y0 + 8,
+                                                    x0:x0 + 6])
