@@ -101,3 +101,64 @@ def test_lr_wd_mult():
     o.set_wd_mult({})
     assert o._get_lr(0) == pytest.approx(0.1)
     assert o._get_lr(1) == pytest.approx(1.0)
+
+
+def test_lr_scheduler_formula_matrix():
+    """Every scheduler's full trajectory vs its closed-form formula,
+    with and without linear warmup (reference:
+    tests/python/unittest/test_lr_scheduler.py)."""
+    import math
+
+    from mxnet_tpu import lr_scheduler as lrs
+
+    base, warm = 0.4, 5
+
+    def warmup_lr(t):
+        return 0.1 + (base - 0.1) * t / warm
+
+    # Factor: base * factor^(t // step)
+    s = lrs.FactorScheduler(step=4, factor=0.5, base_lr=base,
+                            stop_factor_lr=1e-3)
+    for t in range(20):
+        # reference semantics: decay after k COMPLETE periods — the
+        # rate drops at t = step+1, not at t = step
+        want = max(base * 0.5 ** (max(0, t - 1) // 4), 1e-3)
+        assert abs(s(t) - want) < 1e-9, (t, s(t), want)
+
+    # MultiFactor: drop at each milestone
+    s = lrs.MultiFactorScheduler(step=[6, 10, 14], factor=0.1,
+                                 base_lr=base)
+    for t in range(20):
+        want = base * 0.1 ** sum(t > m for m in (6, 10, 14))
+        assert abs(s(t) - want) < 1e-9, (t, s(t), want)
+
+    # Poly with warmup: (1 - progress)^pwr over the post-warmup span
+    s = lrs.PolyScheduler(max_update=25, base_lr=base, pwr=2,
+                          final_lr=0.01, warmup_steps=warm,
+                          warmup_begin_lr=0.1)
+    for t in range(30):
+        if t < warm:
+            want = warmup_lr(t)
+        else:
+            frac = min(t - warm, 25 - warm) / float(25 - warm)
+            want = 0.01 + (base - 0.01) * (1 - frac) ** 2
+        assert abs(s(t) - want) < 1e-9, (t, s(t), want)
+
+    # Cosine with warmup
+    s = lrs.CosineScheduler(max_update=25, base_lr=base, final_lr=0.02,
+                            warmup_steps=warm, warmup_begin_lr=0.1)
+    for t in range(30):
+        if t < warm:
+            want = warmup_lr(t)
+        else:
+            frac = min(t - warm, 25 - warm) / float(25 - warm)
+            want = 0.02 + (base - 0.02) * (1 + math.cos(math.pi * frac)) / 2
+        assert abs(s(t) - want) < 1e-9, (t, s(t), want)
+
+    # constant warmup mode holds warmup_begin_lr flat
+    s = lrs.FactorScheduler(step=100, factor=0.9, base_lr=base,
+                            warmup_steps=warm, warmup_begin_lr=0.1,
+                            warmup_mode="constant")
+    for t in range(warm):
+        assert s(t) == 0.1
+    assert abs(s(warm) - base) < 1e-9
