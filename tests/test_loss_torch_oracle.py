@@ -133,3 +133,57 @@ def test_triplet_matches_torch():
                         np.asarray([float(lt.sum())]), rtol=1e-4)
     assert_almost_equal(ad.grad.asnumpy(), at.grad.numpy(),
                         rtol=1e-4, atol=1e-5)
+
+
+# ----------------------------------------------------- activation oracles
+
+ACTS = {
+    # mx op name -> (mx fn, torch fn)
+    "relu": (lambda x: mx.nd.relu(x), lambda t: torch.relu(t)),
+    "sigmoid": (lambda x: mx.nd.sigmoid(x), lambda t: torch.sigmoid(t)),
+    "tanh": (lambda x: mx.nd.tanh(x), lambda t: torch.tanh(t)),
+    "softrelu": (lambda x: mx.nd.Activation(x, act_type="softrelu"),
+                 lambda t: F.softplus(t)),
+    "softsign": (lambda x: mx.nd.Activation(x, act_type="softsign"),
+                 lambda t: F.softsign(t)),
+    "elu": (lambda x: mx.nd.LeakyReLU(x, act_type="elu", slope=1.0),
+            lambda t: F.elu(t, alpha=1.0)),
+    "leaky": (lambda x: mx.nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+              lambda t: F.leaky_relu(t, negative_slope=0.1)),
+    "gelu": (lambda x: mx.nd.LeakyReLU(x, act_type="gelu"),
+             lambda t: F.gelu(t, approximate="none")),
+    "selu": (lambda x: mx.nd.LeakyReLU(x, act_type="selu"),
+             lambda t: F.selu(t)),
+    "log_softmax": (lambda x: mx.nd.log_softmax(x, axis=-1),
+                    lambda t: F.log_softmax(t, dim=-1)),
+    "softmax": (lambda x: mx.nd.softmax(x, axis=-1),
+                lambda t: F.softmax(t, dim=-1)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ACTS),
+                         ids=sorted(ACTS))
+def test_activation_matches_torch(name):
+    """Forward and input gradient vs torch for every activation
+    (reference: test_operator.py test_activation / test_leaky_relu
+    numeric-gradient sections; torch is the independent oracle)."""
+    mx_fn, t_fn = ACTS[name]
+    rng = np.random.RandomState(11)
+    x = rng.randn(4, 7).astype(np.float32) * 2
+
+    xd = mx.nd.array(x)
+    xd.attach_grad()
+    with autograd.record():
+        y = mx_fn(xd)
+        s = (y * y).sum()
+    s.backward()
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    yt = t_fn(xt)
+    (yt * yt).sum().backward()
+
+    assert_almost_equal(y.asnumpy(), yt.detach().numpy(),
+                        rtol=1e-5, atol=1e-6, names=("mx", "torch"))
+    assert_almost_equal(xd.grad.asnumpy(), xt.grad.numpy(),
+                        rtol=1e-4, atol=1e-5,
+                        names=("mx-grad", "torch-grad"))
