@@ -1,7 +1,8 @@
-"""Loss-function oracle matrix: every gluon loss with a torch
-equivalent vs torch on identical inputs, value AND input gradient
-(reference: tests/python/unittest/test_loss.py, which checks losses by
-training to convergence; torch gives an exact independent oracle).
+"""Loss-function AND activation oracle matrices: every gluon loss and
+every activation with a torch equivalent vs torch on identical inputs,
+value AND input gradient (reference: tests/python/unittest/test_loss.py
++ test_operator.py activation sections; torch is the independent
+oracle).
 """
 
 import numpy as np
@@ -161,8 +162,7 @@ ACTS = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(ACTS),
-                         ids=sorted(ACTS))
+@pytest.mark.parametrize("name", sorted(ACTS))
 def test_activation_matches_torch(name):
     """Forward and input gradient vs torch for every activation
     (reference: test_operator.py test_activation / test_leaky_relu
