@@ -35,7 +35,12 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 363.69  # ResNet-50 training bs=128, V100 fp32 (docs/faq/perf.md)
-REGRESSION_TOLERANCE = 0.10
+# 0.15, not 0.10: the SAME code measured 2,455 img/s at midday and
+# 2,226 in the evening (r3) — the relay's per-step overhead drifts
+# ~10% by time of day, while the device-only step held 2,336-2,385
+# (tools/bench_pipeline.py --mode synthetic).  A real regression still
+# trips this; relay weather no longer can.
+REGRESSION_TOLERANCE = 0.15
 
 
 def prior_round_value():
