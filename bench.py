@@ -10,17 +10,23 @@ The whole train step (fwd+bwd+SGD momentum+BN stat update) is one
 jitted XLA computation (parallel/gluon_step.py); compute in bfloat16
 with fp32 master weights (MXU-native mixed precision, the analog of the
 reference's multi-precision SGD).  The model runs channel-last
-(layout="NHWC"): measured faster than NCHW on this chip because the
-layout maps directly onto MXU tiling with fewer HBM relayout bytes
-(tools/bench_layout_experiment.py; BENCH_NOTES).  Pass a third CLI arg
-"NCHW" to measure the reference-layout path.
+(layout="NHWC"); pass a third CLI arg "NCHW" for the reference layout.
 
-Throughput is the median of 3 timed reps (each `steps` steps).  A
-regression gate compares against the newest recorded BENCH_r*.json and
-exits non-zero on a >10% drop, so a real regression fails the round
-instead of being silently recorded.
+Two numbers are measured and recorded in the ONE printed JSON line:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- ``value``        — through-relay headline: a Python loop of step()
+  dispatches with a loss fetch per rep, what a real training loop sees
+  on this container.  The relay's per-call overhead drifts ~±5% by time
+  of day (BENCH_NOTES "Relay variance, quantified"), so this number is
+  gated loosely (15%) and is informational.
+- ``device_value`` — device-only: ``steps`` training steps chained into
+  ONE jitted computation (lax.fori_loop via GluonTrainStep.make_chained)
+  so the relay is paid once per chain, with a host fetch as the
+  completion barrier.  Variance ~2%; THIS is the regression-gated
+  metric (5%): a real kernel slowdown trips it, relay weather cannot.
+
+Gating compares against the newest recorded BENCH_r*.json (falling back
+to the committed r4 floor for device_value) and exits non-zero.
 
 Usage: python bench.py [batch] [steps] [NHWC|NCHW]
 """
@@ -35,30 +41,55 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 363.69  # ResNet-50 training bs=128, V100 fp32 (docs/faq/perf.md)
-# 0.15, not 0.10: the SAME code measured 2,455 img/s at midday and
-# 2,226 in the evening (r3) — the relay's per-step overhead drifts
-# ~10% by time of day, while the device-only step held 2,336-2,385
-# (tools/bench_pipeline.py --mode synthetic).  A real regression still
-# trips this; relay weather no longer can.
-REGRESSION_TOLERANCE = 0.15
+# Through-relay headline: ±5% time-of-day drift measured r3 (same code:
+# 2,455 midday, 2,226 evening) -> loose gate, informational only.
+RELAY_TOLERANCE = 0.15
+# Device-only chained metric: ~2% variance -> tight gate.  This is the
+# number that detects a real kernel regression.
+DEVICE_TOLERANCE = 0.05
+# r4-measured device-only floor (chained, bs=128 NHWC bf16, steps=20:
+# 2,497 img/s) for the first gated round, before a BENCH_r*.json
+# records device_value.  Keyed by (batch, layout, steps): NCHW is
+# measurably slower than NHWC, and the chained rate depends on chain
+# depth (the one dispatch is amortized over `steps`), so neither may be
+# judged against this floor.
+DEVICE_FLOOR_IMG_S = {(128, "NHWC", 20): 2490.0}
 
 
-def prior_round_value():
-    """Newest recorded driver bench (file, value, metric), if any round
-    ran before."""
+def prior_round_values(batch, layout, steps):
+    """Newest comparable recorded driver bench: (file, headline,
+    device_value) — device_value is None for rounds before r4 or when
+    the recorded chain depth differs (not like-for-like)."""
     here = os.path.dirname(os.path.abspath(__file__))
     newest = None
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
             with open(path) as f:
-                rec = json.load(f)
-            value = rec.get("parsed", {}).get("value")
-            if value:
-                newest = (os.path.basename(path), float(value),
-                          rec["parsed"].get("metric", ""))
+                parsed = json.load(f).get("parsed", {})
+            value = parsed.get("value")
+            # only gate like-for-like: a `bench.py 32` exploration run
+            # or an NCHW comparison run must not trip against the
+            # recorded bs=128 NHWC headline
+            metric = parsed.get("metric", "")
+            if value and ("(bs=%d," % batch) in metric \
+                    and (", %s," % layout) in metric:
+                device = parsed.get("device_value")
+                if ("(%d steps" % steps) not in \
+                        parsed.get("device_metric", ""):
+                    device = None  # different chain depth: incomparable
+                newest = (os.path.basename(path), float(value), device)
         except (OSError, ValueError):
             continue
     return newest
+
+
+def check_regression(name, value, prior, tolerance):
+    """True (and a stderr report) when value regressed past tolerance."""
+    if prior is None or value >= (1.0 - tolerance) * prior:
+        return False
+    print("REGRESSION(%s): %.1f img/s is >%d%% below the prior %.1f img/s"
+          % (name, value, int(tolerance * 100), prior), file=sys.stderr)
+    return True
 
 
 def main():
@@ -66,6 +97,7 @@ def main():
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
+    from mxnet_tpu import random as mxrandom
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel.gluon_step import GluonTrainStep
     from mxnet_tpu.parallel.mesh import create_mesh
@@ -94,12 +126,21 @@ def main():
     y = rng.randint(0, 1000, (batch,)).astype(np.int32)
     x, y = step.put_batch(x, y)  # device-resident synthetic batch
 
-    # warmup (compile + 2 steps); the loss host fetch is the completion
-    # barrier, matching what a real training loop's metric sync does
+    # ---- device-only chained metric (the gated one) ------------------
+    chained = step.make_chained(steps)
+    key = mxrandom.next_key()
+    float(np.asarray(chained(x, y, key)))  # compile + warm
+    device_rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(np.asarray(chained(x, y, key)))  # fetch = completion barrier
+        device_rates.append(steps * batch / (time.perf_counter() - t0))
+    device_img_s = statistics.median(device_rates)
+
+    # ---- through-relay headline (what a live loop on this box sees) --
     for _ in range(3):
         l = step(x, y)
     float(np.asarray(l))
-
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -115,16 +156,22 @@ def main():
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "device_value": round(device_img_s, 2),
+        "device_metric": "device-only img/s (%d steps chained in one jit, "
+                         "host-fetch barrier, median of 3)" % steps,
     }))
 
-    prior = prior_round_value()
-    # only gate like-for-like: a `bench.py 32` exploration run must not
-    # trip against the recorded bs=128 headline
-    comparable = prior is not None and ("(bs=%d," % batch) in prior[2]
-    if comparable and img_s < (1.0 - REGRESSION_TOLERANCE) * prior[1]:
-        print("REGRESSION: %.1f img/s is >%d%% below %s (%.1f img/s)"
-              % (img_s, int(REGRESSION_TOLERANCE * 100), prior[0], prior[1]),
-              file=sys.stderr)
+    prior = prior_round_values(batch, layout, steps)
+    prior_headline = prior[1] if prior else None
+    prior_device = (prior[2] if prior and prior[2]
+                    else DEVICE_FLOOR_IMG_S.get((batch, layout, steps)))
+    failed = check_regression("device-only", device_img_s, prior_device,
+                              DEVICE_TOLERANCE)
+    # headline stays a gate of last resort: only a drop too big for
+    # relay weather (>15%) fails the round on this metric
+    failed |= check_regression("through-relay", img_s, prior_headline,
+                               RELAY_TOLERANCE)
+    if failed:
         sys.exit(1)
 
 

@@ -56,12 +56,13 @@ def _node(op_type, inputs, outputs, name, **attrs):
 
 
 class _Exporter:
-    def __init__(self, params):
+    def __init__(self, params, dtype=_np.float32):
         self.params = dict(params or {})
         self.nodes = []
         self.initializers = []
         self.extra_inputs = []  # shape tensors etc.
         self.counter = 0
+        self.dtype = _np.dtype(dtype)  # the graph's tensor type T
 
     def tmp(self, hint):
         self.counter += 1
@@ -70,6 +71,15 @@ class _Exporter:
     def const_i64(self, name, values):
         self.initializers.append(tensor_proto(
             name, _np.asarray(values, dtype=_np.int64)))
+        return name
+
+    def const_t(self, name, values):
+        """Constant initializer in the graph dtype T — ONNX binary/
+        variadic ops (Mul/Add/Pow/Min/Max/Pad/Clip) require both inputs
+        to share T, so scalar operands must follow the exported graph's
+        dtype rather than a hardcoded float32."""
+        self.initializers.append(tensor_proto(
+            name, _np.asarray(values, dtype=self.dtype)))
         return name
 
     def emit(self, *args, **kwargs):
@@ -150,7 +160,7 @@ def _export_node(ex, node, ins, out):
                 shape = _np.shape(ex.params.get(ins[2], (1,)))
             fixed = name + "_gamma_fixed"
             ex.initializers.append(tensor_proto(
-                fixed, _np.ones(shape, dtype=_np.float32)))
+                fixed, _np.ones(shape, dtype=ex.dtype)))
             ins = [ins[0], fixed] + list(ins[2:])
         ex.emit("BatchNormalization", ins, [out], name,
                 epsilon=float(a.get("eps", 1e-3)),
@@ -261,7 +271,8 @@ def _export_node(ex, node, ins, out):
         ex.emit("ArgMax", ins, [raw], name,
                 axis=int(a["axis"]),
                 keepdims=int(a.get("keepdims", False)))
-        ex.emit("Cast", [raw], [out], name + "_cast", to=P.FLOAT)
+        ex.emit("Cast", [raw], [out], name + "_cast",
+                to=_DTYPE_TO_ONNX[ex.dtype])
     elif op in ("sum", "sum_axis", "mean", "max", "min", "prod"):
         onnx_op = {"sum": "ReduceSum", "sum_axis": "ReduceSum",
                    "mean": "ReduceMean", "max": "ReduceMax",
@@ -301,10 +312,8 @@ def _export_node(ex, node, ins, out):
         pads = [pw[2 * i] for i in range(ndim)] + \
                [pw[2 * i + 1] for i in range(ndim)]
         pname = ex.const_i64(ex.tmp(name + "_pads"), pads)
-        vname = ex.tmp(name + "_value")
-        ex.initializers.append(tensor_proto(
-            vname, _np.asarray(float(a.get("constant_value", 0.0)),
-                               _np.float32)))
+        vname = ex.const_t(ex.tmp(name + "_value"),
+                           float(a.get("constant_value", 0.0)))
         if a.get("mode", "constant") != "constant":
             raise NotImplementedError("ONNX export: pad mode %r"
                                       % a.get("mode"))
@@ -331,9 +340,8 @@ def _export_node(ex, node, ins, out):
             "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
             "_maximum_scalar": ("Max", False),
             "_minimum_scalar": ("Min", False)}[op]
-        sname = ex.tmp(name + "_scalar")
-        ex.initializers.append(tensor_proto(
-            sname, _np.asarray(float(a.get("scalar", 0.0)), _np.float32)))
+        sname = ex.const_t(ex.tmp(name + "_scalar"),
+                           float(a.get("scalar", 0.0)))
         pair = [sname, ins[0]] if reversed_ else [ins[0], sname]
         ex.emit(onnx_op, pair, [out], name)
     elif op == "UpSampling":
@@ -350,12 +358,8 @@ def _export_node(ex, node, ins, out):
         ex.emit("Resize", [ins[0], roi, scales], [out], name,
                 mode="nearest")
     elif op == "clip":
-        mn = ex.tmp(name + "_min")
-        mx = ex.tmp(name + "_max")
-        ex.initializers.append(tensor_proto(
-            mn, _np.asarray(float(a.get("a_min", 0.0)), _np.float32)))
-        ex.initializers.append(tensor_proto(
-            mx, _np.asarray(float(a.get("a_max", 1.0)), _np.float32)))
+        mn = ex.const_t(ex.tmp(name + "_min"), float(a.get("a_min", 0.0)))
+        mx = ex.const_t(ex.tmp(name + "_max"), float(a.get("a_max", 1.0)))
         ex.emit("Clip", [ins[0], mn, mx], [out], name)
     else:
         raise NotImplementedError(
@@ -365,7 +369,7 @@ def _export_node(ex, node, ins, out):
 def export_symbol(sym, params, input_shapes, input_dtype=_np.float32,
                   opset=12):
     """-> ModelProto dict.  `params` maps arg/aux name -> numpy array."""
-    ex = _Exporter(params)
+    ex = _Exporter(params, dtype=input_dtype)
     params = ex.params
     topo = sym._topo_nodes()
     out_names = []

@@ -315,6 +315,23 @@ def _import_node(sym_mod, node, env, consts):
         mode = a.get("mode", "nearest")
         if mode != "nearest":
             raise NotImplementedError("ONNX %s mode %r" % (op, mode))
+        # UpSampling maps output pixel i -> input floor(i/s).  For
+        # integer scales that equals half_pixel with the round_prefer_*
+        # rounding (ties never occur: (i+0.5)/s-0.5 is q+(r+0.5-s/2)/s
+        # with the fraction strictly inside (-0.5, 0.5)) and asymmetric
+        # with floor rounding.  Every other (coord, nearest_mode) pair
+        # diverges for some integer scale (e.g. asymmetric +
+        # round_prefer_floor at s=3 maps output 2 -> input 1, not 0) —
+        # refuse rather than silently resample wrong.
+        coord = a.get("coordinate_transformation_mode", "half_pixel")
+        nearest = a.get("nearest_mode", "round_prefer_floor")
+        ok = (coord == "half_pixel" and
+              nearest in ("round_prefer_floor", "round_prefer_ceil")) or \
+             (coord == "asymmetric" and nearest == "floor")
+        if op == "Resize" and not ok:
+            raise NotImplementedError(
+                "ONNX Resize coordinate_transformation_mode %r with "
+                "nearest_mode %r" % (coord, nearest))
         scales = a.get("scales")
         if scales is None:
             # Upsample (opset 9): input 1 is scales.  Resize: input 2 is

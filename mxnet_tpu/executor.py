@@ -60,8 +60,15 @@ def make_eval_fn(symbol, is_train):
         new_aux = dict(aux_map)
         values = {}
 
+        from . import autograd as _ag
+
         key = jax.random.PRNGKey(seed)
-        with TraceRNG(key):
+        # set the autograd train scope for the whole trace: ops that
+        # branch on autograd.is_training() at trace time (e.g. the KL
+        # sparse-reg aux update) see the executor's is_train, and the
+        # executor's jit cache is already keyed on it (_get_fns)
+        mode = _ag.train_mode() if is_train else _ag.predict_mode()
+        with TraceRNG(key), mode:
             from .random import next_key
 
             for node in nodes:

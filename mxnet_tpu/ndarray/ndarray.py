@@ -698,6 +698,13 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
         # inference pass disables inter-layer dropout (reference: cuDNN RNN
         # forward-inference path, src/operator/cudnn_rnn-inl.h)
         attrs = dict(attrs, p=0.0)
+    if op_name == "IdentityAttachKLSparseReg":
+        # the aux moving-average updates only in the training pass
+        # (reference updates it in Backward,
+        # identity_attach_KL_sparse_reg-inl.h).  Resolved HERE so the
+        # flag lands in the jit cache key — a Python branch inside the
+        # op fn would be baked in by whichever mode compiled first.
+        attrs = dict(attrs, _train=_ag.is_training())
     if op_name == "Dropout":
         # training-mode gate (reference: dropout.cc runs only in train pass)
         if attrs.get("mode", "training") == "always" or _ag.is_training():

@@ -321,9 +321,11 @@ def _bwd_pallas(q, k, v, o, lse, do, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 def _use_pallas(q, k, v, block_q, block_k, interpret):
-    if interpret:
-        return True
-    if jax.default_backend() != "tpu":
+    # interpret mode bypasses only the backend check: the kernel's grid
+    # still assumes the blocks tile the sequence exactly, so a ragged
+    # seq (e.g. 300 with 256-blocks) would leave trailing rows unwritten
+    # in interpret mode just as on hardware
+    if not interpret and jax.default_backend() != "tpu":
         return False
     sq, sk = q.shape[2], k.shape[2]
     return sq % block_q == 0 and sk % block_k == 0

@@ -688,18 +688,31 @@ def gradientmultiplier(data, scalar=1.0, **_):
 
 @register("IdentityAttachKLSparseReg", num_outputs=2)
 def identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
-                                  penalty=0.001, momentum=0.9, **_):
+                                  penalty=0.001, momentum=0.9, _train=None,
+                                  **_):
     """Identity forward that attaches a KL sparseness penalty to the
     gradient (reference: src/operator/identity_attach_KL_sparse_reg-inl.h
     — regularizes mean sigmoid activation toward ``sparseness_target``;
     the running mean activation is the aux state, updated once per
-    backward there and once per forward here, the same once-per-step
-    cadence under jit).  Returns (out, new_moving_avg)."""
+    backward there; here the update happens once per *training-mode*
+    forward — the same once-per-step cadence under jit, while
+    inference forwards leave the aux untouched exactly as the
+    reference's Forward does).  Returns (out, new_moving_avg)."""
+    from .. import autograd as _autograd
+
     t = float(sparseness_target)
     pen = float(penalty)
     mom = float(momentum)
 
-    new_moving = mom * moving_avg + (1.0 - mom) * data.mean(axis=0)
+    # _train is resolved at the dispatch layer (imperative_invoke) so it
+    # participates in the jit cache key; the symbolic path leaves it
+    # None and the trace-time scope decides (the executor re-traces per
+    # is_train)
+    training = _autograd.is_training() if _train is None else _train
+    if training:
+        new_moving = mom * moving_avg + (1.0 - mom) * data.mean(axis=0)
+    else:
+        new_moving = moving_avg
 
     @jax.custom_vjp
     def f(x, avg):

@@ -161,6 +161,7 @@ class GluonTrainStep:
         self.opt_state = _put(self.opt_state, tv_shard)
         self.aux_vals = _put(self.aux_vals, aux_shard)
 
+        self._step_py = step  # un-jitted; composed by make_chained()
         self._step = jax.jit(
             step,
             in_shardings=(tv_shard, tv_shard, aux_shard, x_shard, y_shard,
@@ -175,6 +176,50 @@ class GluonTrainStep:
         self.batch_sharding = x_shard
         self.label_sharding = y_shard
         self._repl = repl
+
+    def make_chained(self, n_steps):
+        """Jit n_steps training steps as ONE device computation.
+
+        One host dispatch covers the whole chain (lax.fori_loop carrying
+        the functional state), so per-call host/relay overhead is paid
+        once per n_steps instead of once per step — the device-only
+        timing primitive bench.py's regression gate is built on (the
+        same chaining trick as tools/bench_device_latency.py, extended
+        to the full fwd+bwd+update+BN-stat step).  The per-iteration RNG
+        key is fold_in(key, i), so chained(n) visits the same key
+        sequence regardless of chain depth.
+
+        Returns fn(x, y, key) -> (last_loss, updated GluonTrainStep
+        state is NOT written back — the chain is a measurement primitive,
+        not a training API; use __call__ for real training loops).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = self._step_py
+
+        def chained(train_vals, opt_state, aux_vals, x, y, key):
+            def body(i, carry):
+                tv, os_, av, _ = carry
+                loss, tv, os_, av = step(tv, os_, av, x, y,
+                                         jax.random.fold_in(key, i))
+                # fp32 carry regardless of compute dtype (bf16 steps
+                # return a bf16 loss; the carry structure must be fixed)
+                return (tv, os_, av, loss.astype(jnp.float32))
+
+            init = (train_vals, opt_state, aux_vals,
+                    jnp.zeros((), jnp.float32))
+            _, _, _, loss = lax.fori_loop(0, n_steps, body, init)
+            return loss
+
+        jitted = jax.jit(chained)
+
+        def run(x, y, key):
+            return jitted(self.train_vals, self.opt_state, self.aux_vals,
+                          x, y, key)
+
+        return run
 
     def put_batch(self, x, y):
         """Place a host batch onto the mesh with the dp sharding."""

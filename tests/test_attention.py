@@ -109,3 +109,19 @@ def test_interleaved_matmul_selfatt():
     kk = x[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(b * heads, s, d)
     want = np.einsum("zqd,zkd->zqk", q, kk)
     np.testing.assert_allclose(np.asarray(scores), want, rtol=1e-4, atol=1e-4)
+
+
+def test_flash_interpret_ragged_seq_falls_back_correctly():
+    """ADVICE r3: interpret mode must apply the same divisibility check
+    as hardware — a ragged seq (300 with 256/512 default blocks) would
+    otherwise leave trailing output rows unwritten.  The public entry
+    must produce correct values for ANY seq length."""
+    b, h, s, d = 1, 2, 300, 32
+    q, k, v = (_rand((b, h, s, d), seed=20 + i) for i in range(3))
+    ref = att.mha_reference(q, k, v)
+    out = att.flash_attention(q, k, v, interpret=True)  # default blocks
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    # and _use_pallas itself refuses ragged shapes in interpret mode
+    assert not att._use_pallas(q, k, v, 256, 512, True)
+    assert not att._use_pallas(q, k, v, 128, 128, True)

@@ -1,0 +1,98 @@
+"""bench.py regression-gate semantics + the chained device metric.
+
+VERDICT r3 weak-spot 2: the gate must trip on a real kernel regression
+(device-side, ~2% variance, 5% tolerance) while relay weather (±5%
+time-of-day drift on the through-relay headline) must not fail the
+round.  These tests pin the gate arithmetic and the correctness of the
+chained measurement primitive (GluonTrainStep.make_chained), which the
+gated number is produced by.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_deliberate_10pct_device_slowdown_trips_gate(capsys):
+    """The VERDICT-prescribed dry run: a 10% device-side regression must
+    fail under the 5% device tolerance."""
+    bench = _load_bench()
+    prior = 2497.0
+    assert bench.check_regression("device-only", prior * 0.90, prior,
+                                  bench.DEVICE_TOLERANCE)
+    assert "REGRESSION(device-only)" in capsys.readouterr().err
+
+
+def test_relay_weather_does_not_trip_gate(capsys):
+    """±5% through-relay drift (BENCH_NOTES 'Relay variance,
+    quantified': 2,455 midday vs 2,226 evening ≈ −9% peak-to-peak) must
+    pass the 15% headline tolerance."""
+    bench = _load_bench()
+    assert not bench.check_regression("through-relay", 2226.0, 2455.0,
+                                      bench.RELAY_TOLERANCE)
+    # and a genuine collapse still fails even the loose headline gate
+    assert bench.check_regression("through-relay", 1900.0, 2455.0,
+                                  bench.RELAY_TOLERANCE)
+    capsys.readouterr()
+
+
+def test_small_device_noise_passes_device_gate():
+    bench = _load_bench()
+    prior = 2497.0
+    assert not bench.check_regression("device-only", prior * 0.98, prior,
+                                      bench.DEVICE_TOLERANCE)
+
+
+def test_gate_skips_without_prior():
+    bench = _load_bench()
+    assert not bench.check_regression("device-only", 100.0, None, 0.05)
+
+
+def test_make_chained_matches_sequential_steps():
+    """chained(n) must compute the same loss trajectory as n sequential
+    _step calls with the same fold_in key schedule — the measurement
+    primitive must measure the real training computation."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.gluon_step import GluonTrainStep
+    from mxnet_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.zeros((1, 6), ctx=mx.cpu()))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9)
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 6).astype(np.float32)
+    y = rs.randint(0, 4, (8,)).astype(np.int32)
+    x, y = step.put_batch(x, y)
+    key = jax.random.PRNGKey(7)
+
+    # reference trajectory: the un-jitted step fn, eagerly, same keys
+    tv, os_, av = step.train_vals, step.opt_state, step.aux_vals
+    for i in range(3):
+        want, tv, os_, av = step._step_py(tv, os_, av, x, y,
+                                          jax.random.fold_in(key, i))
+
+    orig_train_vals = step.train_vals
+    got = step.make_chained(3)(x, y, key)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+    # and the chain must not have written back into the step's state
+    assert step.train_vals is orig_train_vals

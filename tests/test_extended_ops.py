@@ -396,3 +396,40 @@ def test_sample_distribution_families():
         shape=(n,)).asnumpy()
     var_gnb = mu[0] + alpha[0] * mu[0] ** 2
     assert abs(gnb.mean() - mu[0]) < 8 * np.sqrt(var_gnb / n), gnb.mean()
+
+
+def test_identity_attach_kl_sparse_reg_eval_leaves_aux_untouched():
+    """ADVICE r3: the reference updates the moving average only in
+    Backward — inference-only forwards must not drift the aux state."""
+    x = mx.nd.array(np.full((4, 3), 0.2, np.float32))
+    avg = mx.nd.array(np.full((3,), 0.05, np.float32))
+    out, new_avg = mx.nd.IdentityAttachKLSparseReg(
+        x, avg, sparseness_target=0.1, penalty=0.001, momentum=0.9)
+    np.testing.assert_allclose(out.asnumpy(), 0.2)
+    np.testing.assert_allclose(new_avg.asnumpy(), 0.05)  # unchanged
+    # training-mode forward does update (once-per-step cadence)
+    with mx.autograd.record():
+        _, new_avg2 = mx.nd.IdentityAttachKLSparseReg(
+            x, avg, sparseness_target=0.1, penalty=0.001, momentum=0.9)
+    np.testing.assert_allclose(new_avg2.asnumpy(),
+                               0.9 * 0.05 + 0.1 * 0.2, rtol=1e-6)
+
+
+def test_identity_attach_kl_sparse_reg_symbolic_train_updates_aux():
+    """The executor's jit trace must see the train scope: symbolic
+    forward(is_train=True) updates the moving average, is_train=False
+    leaves it (review r4; reference updates it only in Backward)."""
+    data = mx.sym.Variable("data")
+    avg = mx.sym.Variable("avg")
+    sym = mx.sym.IdentityAttachKLSparseReg(
+        data, avg, sparseness_target=0.1, penalty=0.001, momentum=0.9,
+        name="klreg")
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                          data=(4, 3), avg=(3,))
+    exe.arg_dict["data"][:] = 0.2
+    exe.arg_dict["avg"][:] = 0.05
+    out_train = exe.forward(is_train=True)
+    np.testing.assert_allclose(out_train[1].asnumpy(),
+                               0.9 * 0.05 + 0.1 * 0.2, rtol=1e-6)
+    out_eval = exe.forward(is_train=False)
+    np.testing.assert_allclose(out_eval[1].asnumpy(), 0.05)

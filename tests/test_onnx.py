@@ -243,3 +243,61 @@ def test_onnx_roundtrip_split(tmp_path):
                         dim=1, name="cc")
     x = rng.randn(2, 6).astype(np.float32)
     _roundtrip(sym, {}, {}, x, tmp_path)
+
+
+def test_export_fp16_scalar_initializers_follow_graph_dtype():
+    """ADVICE r3: ONNX Mul/Add/Pow/Min/Max/Pad/Clip require both inputs
+    to share the tensor type T — exporting a float16 graph must emit
+    float16 scalar initializers, not hardcoded float32."""
+    from mxnet_tpu.contrib.onnx.mx2onnx import export_symbol
+
+    data = mx.sym.Variable("data")
+    h = data * 2.0                                   # _mul_scalar
+    h = mx.sym.pad(h, mode="constant", pad_width=(0, 0, 1, 1),
+                   constant_value=0.5, name="p")
+    sym = mx.sym.clip(h, a_min=0.0, a_max=1.0, name="c")
+    model = export_symbol(sym, {}, [("data", (2, 3))],
+                          input_dtype=np.float16)
+    inits = model["graph"]["initializer"]
+    # pads stay int64; every float-typed operand must be FLOAT16
+    float_inits = [t for t in inits
+                   if t["data_type"] in (_proto.FLOAT, _proto.FLOAT16)]
+    assert float_inits, "expected scalar/pad/clip initializers"
+    assert all(t["data_type"] == _proto.FLOAT16 for t in float_inits), \
+        [(t["name"], t["data_type"]) for t in float_inits]
+
+
+def test_import_resize_align_corners_refused():
+    """ADVICE r3: align_corners does not coincide with the asymmetric
+    nearest mapping UpSampling implements — import must refuse, not
+    silently produce different pixel mappings."""
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_graph
+
+    scales = {"name": "s", "dims": [4], "data_type": _proto.FLOAT,
+              "raw_data": np.asarray([1, 1, 2, 2],
+                                     np.float32).tobytes()}
+    node = {"op_type": "Resize", "name": "rz",
+            "input": ["data", "", "s"], "output": ["out"],
+            "attribute": [
+                {"name": "mode", "type": _proto.A_STRING, "s": b"nearest"},
+                {"name": "coordinate_transformation_mode",
+                 "type": _proto.A_STRING, "s": b"align_corners"}]}
+    graph = {"node": [node], "initializer": [scales],
+             "input": [{"name": "data"}],
+             "output": [{"name": "out"}]}
+    with pytest.raises(NotImplementedError, match="align_corners"):
+        import_graph(graph)
+    # asymmetric with the default round_prefer_floor also diverges
+    # (s=3 maps output 2 -> input 1, UpSampling gives 0): refused
+    node["attribute"][1]["s"] = b"asymmetric"
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        import_graph(graph)
+    # the two mode pairs that DO equal UpSampling's floor map import
+    node["attribute"][1]["s"] = b"half_pixel"
+    sym, _, _ = import_graph(graph)
+    assert sym is not None
+    node["attribute"][1]["s"] = b"asymmetric"
+    node["attribute"].append({"name": "nearest_mode",
+                              "type": _proto.A_STRING, "s": b"floor"})
+    sym, _, _ = import_graph(graph)
+    assert sym is not None
