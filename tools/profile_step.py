@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Hardware-measured roofline audit of the flagship training step.
+
+VERDICT r3 weak-spot 1: the r2/r3 perf narrative rested on
+``compiled.cost_analysis()["bytes accessed"]``, which counts bytes that
+never cross HBM (fusion-internal reads) — at the r3 headline the
+implied bandwidth exceeded the chip's physical peak, so the "we're
+bandwidth-bound, nothing left" conclusion was unproven.
+
+This tool replaces that instrument with the real one: a device trace
+(``jax.profiler``, which the axon relay supports) of N flagship
+training steps.  Every device event carries its measured
+``device_duration_ps``, the HLO instruction (operand shapes → an
+*analytic lower bound* on HBM bytes: each operand read once + output
+written once), the cost-model ``bytes_accessed`` for comparison, and
+``model_flops``.  Per fused region we report:
+
+- measured time (µs/step, averaged over the traced steps)
+- analytic min HBM bytes and the implied GB/s (cannot exceed physics)
+- the roofline bound: max(min_bytes/BW_PEAK, flops/MXU_PEAK) — the
+  fastest this fusion could possibly run; headroom = time − bound
+- the Python source line the fusion traces to (per-layer attribution)
+
+Output: a JSON summary + markdown table (``--md``), sorted by
+headroom, so "where does the remaining time go" has a measured answer.
+
+Usage: python tools/profile_step.py [--batch 128] [--steps 4]
+       [--top 40] [--md BENCH_ROOFLINE.md] [--trace-dir DIR]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# v5e (TPU v5 lite) public peaks: 394 TFLOP/s bf16, 197 fp32-equivalent
+# via bf16x3 passes; 819 GB/s HBM.
+BW_PEAK = 819e9
+MXU_PEAK_BF16 = 394e12
+
+_DTYPE_BYTES = {
+    "f32": 4, "f16": 2, "bf16": 2, "f64": 8, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "pred": 1,
+}
+
+# a typed shape literal with its layout braces, e.g.
+#   bf16[128,56,56,256]{3,0,2,1:T(8,128)(2,1)S(1)}
+# S(1) in the layout = memory space 1 (VMEM): XLA's memory-space
+# assignment pre-staged that buffer with an async copy-start/copy-done
+# pair, so reading it inside the fusion does NOT cross HBM — counting
+# it is exactly the overcounting that made the r3 cost-model roofline
+# exceed the chip's physical bandwidth.
+_SHAPE_RE = re.compile(r"\b(f32|f16|bf16|f64|s32|u32|s64|u64|s8|u8|s16|"
+                       r"u16|pred)\[([0-9,]*)\](\{[^}]*\})?")
+
+
+def shapes_bytes(text, hbm_only=True):
+    """Analytic bytes of the typed shape literals in an HLO string;
+    ``hbm_only`` skips buffers laid out in memory space 1 (VMEM)."""
+    return split_bytes(text)[0] if hbm_only else sum(split_bytes(text))
+
+
+def split_bytes(text):
+    """(space0_bytes, space1_bytes) over the shape literals in text."""
+    s0 = s1 = 0
+    for dt, dims, layout in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        if layout and "S(" in layout:
+            s1 += n * _DTYPE_BYTES[dt]
+        else:
+            s0 += n * _DTYPE_BYTES[dt]
+    return s0, s1
+
+
+def moved_bytes(long_name):
+    """HBM bytes moved by an async copy/slice: a copy moves its full
+    buffer (src space0 == dst S(1) size); a sliced prefetch reads only
+    the slice (the S(1) side), not the full space-0 source.  min() of
+    the two sides is both at once."""
+    s0, s1 = split_bytes(long_name)
+    return min(s0, s1) if s1 else s0
+
+
+def min_hbm_bytes(long_name):
+    """Lower bound on this instruction's own HBM traffic: every
+    HBM-resident (space 0) operand read once + every space-0 output
+    written once.  VMEM-resident (S(1)) operands were paid for by an
+    earlier overlapped prefetch copy — their HBM crossing is accounted
+    on that copy, not here."""
+    return shapes_bytes(long_name, hbm_only=True)
+
+
+def capture(batch, steps, trace_dir):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.gluon_step import GluonTrainStep
+    from mxnet_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    net = vision.resnet50_v1(layout="NHWC")
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    with ctx:
+        net.initialize(ctx=ctx)
+        net(mx.nd.zeros((1, 32, 32, 3), ctx=ctx))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9,
+                          wd=1e-4, compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 224, 224, 3).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    x, y = step.put_batch(x, y)
+    for _ in range(3):
+        l = step(x, y)
+    float(np.asarray(l))
+
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(steps):
+        l = step(x, y)
+    float(np.asarray(l))
+    jax.profiler.stop_trace()
+    return sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))[-1]
+
+
+def parse(trace_path, steps):
+    with gzip.open(trace_path) as f:
+        t = json.load(f)
+    events = t["traceEvents"]
+    pids = {e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tids = {(e["pid"], e["tid"]): e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    rows = collections.defaultdict(lambda: {
+        "us": 0.0, "n": 0, "xla_bytes": 0, "flops": 0, "min_bytes": 0,
+        "source": "", "long_name": ""})
+    step_us = 0.0
+    prefetch_bytes = 0
+    prefetch_us = 0.0
+    for e in events:
+        if e.get("ph") != "X" or not pids.get(e["pid"], "").startswith(
+                "/device"):
+            continue
+        name, args = e.get("name", ""), e.get("args") or {}
+        line = tids.get((e["pid"], e["tid"]), "")
+        if name.startswith("jit_step"):
+            step_us += e["dur"]
+            continue
+        if "long_name" not in args:
+            continue  # grouping spans (step markers), not HLO leaves
+        if line == "Async XLA Ops" or name.startswith(
+                ("copy-start", "copy-done", "slice-start", "slice-done",
+                 "dynamic-slice-start", "dynamic-slice-done")):
+            # memory-space-assignment prefetches: HBM<->VMEM transfers
+            # that OVERLAP compute.  These bytes belong to the
+            # whole-step HBM floor but not to any one fusion's bound.
+            # *-start events are counted ( *-done pairs carry the same
+            # long_name; counting both would double the traffic).
+            if name.split(".")[0].endswith("-start"):
+                prefetch_bytes += moved_bytes(args.get("long_name", ""))
+            prefetch_us += e["dur"]
+            continue
+        r = rows[name]
+        r["us"] += e["dur"]
+        r["n"] += 1
+        r["xla_bytes"] += int(args.get("raw_bytes_accessed",
+                                       args.get("bytes_accessed", 0)))
+        r["flops"] += int(args.get("model_flops", 0) or 0)
+        if not r["long_name"]:
+            r["long_name"] = args.get("long_name", "")
+            r["source"] = args.get("source", "")
+            r["min_bytes"] = min_hbm_bytes(r["long_name"])
+    out = []
+    for name, r in rows.items():
+        us = r["us"] / steps
+        calls = r["n"] / steps
+        # min_bytes is per CALL (parsed once from the instruction);
+        # scale by calls/step so rows invoked multiple times per step
+        # (e.g. inside a loop) keep bytes, flops and time in the same
+        # per-step units
+        mb = r["min_bytes"] * calls
+        fl = r["flops"] / steps
+        # a row whose operand-sum implies more than the physical
+        # bandwidth is a strided conv (1x1 stride-2 downsamples read a
+        # quarter of the operand the instruction lists) — clamp its
+        # byte estimate to what the measured time could move and FLAG
+        # it, so no row and no aggregate can claim impossible traffic
+        phys = BW_PEAK * us * 1e-6
+        strided = us > 0 and mb > phys
+        eff = min(mb, phys)
+        bound_us = max(eff / BW_PEAK, fl / MXU_PEAK_BF16) * 1e6
+        out.append({
+            "name": name,
+            "us_per_step": round(us, 1),
+            "min_hbm_mb": round(eff / 1e6, 2),
+            "strided_clamp": strided,
+            "implied_gbps": round(eff / (us * 1e-6) / 1e9, 1) if us else 0,
+            "xla_gbps": round((r["xla_bytes"] / steps) / (us * 1e-6) / 1e9,
+                              1) if us else 0,
+            "gflops": round(fl / 1e9, 2),
+            "mxu_pct": round(fl / (us * 1e-6) / MXU_PEAK_BF16 * 100, 1)
+            if us else 0,
+            "bound_us": round(bound_us, 1),
+            "headroom_us": round(us - bound_us, 1),
+            "calls_per_step": calls,
+            "source": r["source"],
+        })
+    out.sort(key=lambda r: -r["headroom_us"])
+    prefetch = {"bytes_per_step": prefetch_bytes / steps,
+                "us_per_step": prefetch_us / steps}
+    return out, step_us / steps, prefetch
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--top", type=int, default=40)
+    p.add_argument("--md", default=None)
+    p.add_argument("--trace-dir",
+                   default=os.path.join(tempfile.gettempdir(),
+                                        "mxtpu_roofline_trace"))
+    p.add_argument("--parse-only", default=None,
+                   help="parse an existing trace.json.gz instead of "
+                        "capturing")
+    args = p.parse_args(argv)
+
+    trace = args.parse_only or capture(args.batch, args.steps,
+                                       args.trace_dir)
+    rows, step_us, prefetch = parse(trace, args.steps)
+    total_us = sum(r["us_per_step"] for r in rows)
+    total_bound = sum(r["bound_us"] for r in rows)
+    hbm_gb = (sum(r["min_hbm_mb"] for r in rows) / 1000
+              + prefetch["bytes_per_step"] / 1e9)
+    summary = {
+        "batch": args.batch,
+        "jit_step_ms": round(step_us / 1000, 2),
+        "sum_hlo_ms": round(total_us / 1000, 2),
+        "roofline_bound_ms": round(total_bound / 1000, 2),
+        "headroom_pct": round((total_us - total_bound) / total_us * 100, 1),
+        "img_s_device": round(args.batch / (step_us * 1e-6), 1),
+        "hbm_gb_per_step": round(hbm_gb, 2),
+        "prefetch_gb_per_step": round(prefetch["bytes_per_step"] / 1e9, 2),
+        # the physics check the r3 instrument failed: must be <= 819
+        "implied_gbps_whole_step": round(
+            hbm_gb * 1e9 / (step_us * 1e-6) / 1e9, 1),
+    }
+    print(json.dumps(summary))
+    for r in rows[:args.top]:
+        print("%8.1f us  bound %7.1f  %6.1f GB/s  mxu %5.1f%%  %-28s %s"
+              % (r["us_per_step"], r["bound_us"], r["implied_gbps"],
+                 r["mxu_pct"], r["name"][:28],
+                 (r["source"] or "").split("/")[-1]))
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write("# Measured roofline: flagship step (bs=%d)\n\n"
+                    % args.batch)
+            f.write("`%s`\n\n" % json.dumps(summary))
+            f.write("| region | us/step | bound us | min HBM MB | "
+                    "implied GB/s | MXU %% | headroom us | source |\n")
+            f.write("|---|---|---|---|---|---|---|---|\n")
+            for r in rows[:args.top]:
+                f.write("| %s | %.1f | %.1f | %.2f | %.1f | %.1f | %.1f "
+                        "| %s |\n"
+                        % (r["name"], r["us_per_step"], r["bound_us"],
+                           r["min_hbm_mb"], r["implied_gbps"],
+                           r["mxu_pct"], r["headroom_us"],
+                           (r["source"] or "").split("/")[-1]))
+    return summary, rows
+
+
+if __name__ == "__main__":
+    main()
