@@ -4,6 +4,7 @@ vision/__init__.py get_model registry)."""
 from .alexnet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .inception import *  # noqa: F401,F403
+from .inception_bn import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
 from .resnet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
@@ -26,6 +27,7 @@ def get_model(name, **kwargs):
         "densenet169": densenet169, "densenet201": densenet201,
         "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
         "inceptionv3": inception_v3,
+        "inception_bn": inception_bn, "inception-bn": inception_bn,
         "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
         "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
         "mobilenetv2_1.0": mobilenet_v2_1_0,

@@ -1,0 +1,119 @@
+"""Inception-BN (GoogLeNet v2: Ioffe & Szegedy 2015).
+
+Reference: example/image-classification/symbols/inception-bn.py — the
+network behind the Inception-BN column of the reference's published
+perf tables (docs/faq/perf.md:60,171).  The reference defines it only
+as a symbol graph; here it is a Gluon block (hybridizable, layout-
+aware) so it plugs into the same zoo/benchmark machinery as the other
+five published networks.  Topology constants (filter counts per
+inception module, avg/max pool choice per stage) follow that file; the
+compute underneath is this repo's own lax/XLA path.
+"""
+
+from __future__ import annotations
+
+from ...contrib.nn import HybridConcurrent
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Flatten,
+                   HybridSequential, MaxPool2D)
+
+__all__ = ["InceptionBN", "inception_bn"]
+
+_BN_EPS = 1e-10 + 1e-5  # reference inception-bn.py:31
+
+
+def _bn_axis(layout):
+    return 3 if layout == "NHWC" else 1
+
+
+def _conv_bn_relu(channels, kernel, stride=1, padding=0, layout="NCHW"):
+    out = HybridSequential(prefix="")
+    out.add(Conv2D(channels, kernel_size=kernel, strides=stride,
+                   padding=padding, layout=layout))
+    out.add(BatchNorm(axis=_bn_axis(layout), epsilon=_BN_EPS, momentum=0.9))
+    out.add(Activation("relu"))
+    return out
+
+
+def _inception_a(num_1x1, num_3x3red, num_3x3, num_d3x3red, num_d3x3,
+                 pool, proj, layout):
+    """InceptionFactoryA: 1x1 | 1x1->3x3 | 1x1->3x3->3x3 | pool->1x1."""
+    out = HybridConcurrent(axis=_bn_axis(layout), prefix="")
+    b1 = HybridSequential(prefix="")
+    b1.add(_conv_bn_relu(num_1x1, 1, layout=layout))
+    b2 = HybridSequential(prefix="")
+    b2.add(_conv_bn_relu(num_3x3red, 1, layout=layout))
+    b2.add(_conv_bn_relu(num_3x3, 3, padding=1, layout=layout))
+    b3 = HybridSequential(prefix="")
+    b3.add(_conv_bn_relu(num_d3x3red, 1, layout=layout))
+    b3.add(_conv_bn_relu(num_d3x3, 3, padding=1, layout=layout))
+    b3.add(_conv_bn_relu(num_d3x3, 3, padding=1, layout=layout))
+    b4 = HybridSequential(prefix="")
+    pool_cls = AvgPool2D if pool == "avg" else MaxPool2D
+    b4.add(pool_cls(pool_size=3, strides=1, padding=1, layout=layout))
+    b4.add(_conv_bn_relu(proj, 1, layout=layout))
+    for b in (b1, b2, b3, b4):
+        out.add(b)
+    return out
+
+
+def _inception_b(num_3x3red, num_3x3, num_d3x3red, num_d3x3, layout):
+    """InceptionFactoryB (downsample): 1x1->3x3/2 | 1x1->3x3->3x3/2 |
+    maxpool/2."""
+    out = HybridConcurrent(axis=_bn_axis(layout), prefix="")
+    b1 = HybridSequential(prefix="")
+    b1.add(_conv_bn_relu(num_3x3red, 1, layout=layout))
+    b1.add(_conv_bn_relu(num_3x3, 3, stride=2, padding=1, layout=layout))
+    b2 = HybridSequential(prefix="")
+    b2.add(_conv_bn_relu(num_d3x3red, 1, layout=layout))
+    b2.add(_conv_bn_relu(num_d3x3, 3, padding=1, layout=layout))
+    b2.add(_conv_bn_relu(num_d3x3, 3, stride=2, padding=1, layout=layout))
+    b3 = HybridSequential(prefix="")
+    b3.add(MaxPool2D(pool_size=3, strides=2, padding=1, layout=layout))
+    for b in (b1, b2, b3):
+        out.add(b)
+    return out
+
+
+class InceptionBN(HybridBlock):
+    """224x224 Inception-BN classifier (reference
+    symbols/inception-bn.py get_symbol, height > 28 path)."""
+
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
+        super().__init__(**kwargs)
+        self.layout = layout
+        with self.name_scope():
+            f = self.features = HybridSequential(prefix="")
+            # stage 1
+            f.add(_conv_bn_relu(64, 7, stride=2, padding=3, layout=layout))
+            f.add(MaxPool2D(pool_size=3, strides=2, layout=layout))
+            # stage 2
+            f.add(_conv_bn_relu(64, 1, layout=layout))
+            f.add(_conv_bn_relu(192, 3, padding=1, layout=layout))
+            f.add(MaxPool2D(pool_size=3, strides=2, layout=layout))
+            # stage 3
+            f.add(_inception_a(64, 64, 64, 64, 96, "avg", 32, layout))
+            f.add(_inception_a(64, 64, 96, 64, 96, "avg", 64, layout))
+            f.add(_inception_b(128, 160, 64, 96, layout))
+            # stage 4
+            f.add(_inception_a(224, 64, 96, 96, 128, "avg", 128, layout))
+            f.add(_inception_a(192, 96, 128, 96, 128, "avg", 128, layout))
+            f.add(_inception_a(160, 128, 160, 128, 160, "avg", 128, layout))
+            f.add(_inception_a(96, 128, 192, 160, 192, "avg", 128, layout))
+            f.add(_inception_b(128, 192, 192, 256, layout))
+            # stage 5
+            f.add(_inception_a(352, 192, 320, 160, 224, "avg", 128, layout))
+            f.add(_inception_a(352, 192, 320, 192, 224, "max", 128, layout))
+            f.add(AvgPool2D(pool_size=7, strides=1, layout=layout))
+            f.add(Flatten())
+            self.output = Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_bn(pretrained=False, ctx=None, root=None, **kwargs):
+    if pretrained:
+        raise ValueError("no pretrained inception_bn weights ship with "
+                         "this framework")
+    return InceptionBN(**kwargs)

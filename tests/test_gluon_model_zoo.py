@@ -90,3 +90,40 @@ def test_pooling_layer_honors_nhwc():
     assert out.shape == (2, 1, 1, 4)
     assert_almost_equal(out.asnumpy().reshape(2, 4), x.mean(axis=(1, 2)),
                         rtol=1e-5, atol=1e-6)
+
+
+def test_inception_bn_forward_and_param_count():
+    """Inception-BN (r4: the sixth network of the reference's published
+    perf matrix, symbols/inception-bn.py).  11.3M params at 1000
+    classes pins the topology constants."""
+    net = vision.get_model("inception_bn", classes=10)
+    net.initialize()
+    out = net(mx.nd.array(np.random.rand(1, 3, 224, 224).astype("float32")))
+    assert out.shape == (1, 10)
+    full = vision.inception_bn()
+    full.initialize()
+    full(mx.nd.zeros((1, 3, 224, 224)))
+    n = sum(int(np.prod(p.shape))
+            for p in full.collect_params().values())
+    assert abs(n - 11_315_272) < 1000, n
+
+
+def test_inception_bn_nhwc_matches_nchw():
+    a = vision.inception_bn(classes=5)
+    b = vision.inception_bn(classes=5, layout="NHWC")
+    a.initialize()
+    b.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 224, 224).astype("float32"))
+    x_cl = mx.nd.array(x.asnumpy().transpose(0, 2, 3, 1))
+    a(x)
+    b(x_cl)
+    pa, pb = a.collect_params(), b.collect_params()
+    for ka, kb in zip(sorted(pa.keys()), sorted(pb.keys())):
+        w = pa[ka].data().asnumpy()
+        tgt = tuple(pb[kb].data().shape)
+        if w.ndim == 4 and w.shape != tgt:
+            w = w.transpose(0, 2, 3, 1)  # OIHW -> OHWI
+        assert w.shape == tgt, (ka, kb, w.shape, tgt)
+        pb[kb].set_data(mx.nd.array(w))
+    assert_almost_equal(a(x).asnumpy(), b(x_cl).asnumpy(),
+                        rtol=1e-3, atol=1e-4)

@@ -42,6 +42,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 # v5e (TPU v5 lite) public peaks: 394 TFLOP/s bf16, 197 fp32-equivalent
 # via bf16x3 passes; 819 GB/s HBM.
@@ -106,25 +107,9 @@ def min_hbm_bytes(long_name):
 def capture(batch, steps, trace_dir):
     import jax
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.parallel.gluon_step import GluonTrainStep
-    from mxnet_tpu.parallel.mesh import create_mesh
+    from bench_common import build_train_step
 
-    mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
-    net = vision.resnet50_v1(layout="NHWC")
-    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
-    with ctx:
-        net.initialize(ctx=ctx)
-        net(mx.nd.zeros((1, 32, 32, 3), ctx=ctx))
-    loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9,
-                          wd=1e-4, compute_dtype="bfloat16")
-    rng = np.random.RandomState(0)
-    x = rng.rand(batch, 224, 224, 3).astype(np.float32)
-    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
-    x, y = step.put_batch(x, y)
+    step, x, y, _, _ = build_train_step("resnet50_v1", batch)
     for _ in range(3):
         l = step(x, y)
     float(np.asarray(l))
@@ -272,7 +257,7 @@ def main(argv=None):
                     % args.batch)
             f.write("`%s`\n\n" % json.dumps(summary))
             f.write("| region | us/step | bound us | min HBM MB | "
-                    "implied GB/s | MXU %% | headroom us | source |\n")
+                    "implied GB/s | MXU % | headroom us | source |\n")
             f.write("|---|---|---|---|---|---|---|---|\n")
             for r in rows[:args.top]:
                 f.write("| %s | %.1f | %.1f | %.2f | %.1f | %.1f | %.1f "
