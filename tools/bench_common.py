@@ -30,10 +30,17 @@ def build_train_step(network, batch, hw=None, dtype="bfloat16",
 
     hw = hw or NETWORK_HW.get(network, 224)
     mesh = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    ctor = getattr(vision, network)
     try:
-        net = getattr(vision, network)(classes=classes, layout=layout)
-    except TypeError:  # nets without a layout option (alexnet: NCHW-only)
-        net = getattr(vision, network)(classes=classes)
+        net = ctor(classes=classes, layout=layout)
+    except TypeError as e:
+        # only the "no layout option" signature error falls back to
+        # NCHW (alexnet etc.); any other TypeError from a
+        # layout-supporting constructor must surface, not be silently
+        # rebuilt and mislabeled as NCHW
+        if "layout" not in str(e):
+            raise
+        net = ctor(classes=classes)
         layout = "NCHW"
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     # probe at FULL size: flatten-tailed nets (alexnet, vgg) resolve
