@@ -27,7 +27,7 @@ import numpy as _np
 
 from ... import initializer as _init
 from ...ndarray import NDArray, array as _nd_array
-from ..block import Block, _StagingScope
+from ..block import Block, _StagingScope, update_aux_state
 from ..parameter import param_override
 
 __all__ = ["PipelineBlock", "MoE", "collect_moe_aux", "param_spec_fn_for"]
@@ -47,9 +47,13 @@ class PipelineBlock(Block):
     the stages sequentially — identical math, so models build and debug
     single-device and shard by calling ``attach_mesh``.
 
-    Stages must be shape-homogeneous (activation in == activation out),
-    and, in pipelined mode, must not update aux state (BatchNorm
-    running stats) — the standard stacked-transformer-block case.
+    Stages must be shape-homogeneous (activation in == activation out).
+    Aux state (BatchNorm running stats) is supported (r4): each stage's
+    aux stacks into a grad_req='null' Parameter sharded over 'pp' like
+    the weights, and updates accumulate per microbatch (the EMA applies
+    once per microbatch a stage actually processes — the semantics of
+    training with microbatch-sized batches, the standard GPipe
+    BatchNorm contract; fill/drain ticks never touch the stats).
     """
 
     def __init__(self, stages, n_microbatches=None, axis="pp", **kwargs):
@@ -74,6 +78,7 @@ class PipelineBlock(Block):
                                  "parameter structure")
         self.__dict__["_tmpl_params"] = {}
         self._safe_names = []
+        self._aux_safe_names = []
         for name in names:
             p0 = tmpl[name]
             if p0._data is None:
@@ -93,11 +98,14 @@ class PipelineBlock(Block):
                     "stage parameter names %r collide after mangling; "
                     "rename the layer" % name)
             param = self.params.get(safe, shape=stacked.shape,
-                                    dtype=p0.dtype)
+                                    dtype=p0.dtype,
+                                    grad_req=p0.grad_req)
             setattr(self, safe, param)     # registers in _reg_params
             param.initialize(init=_init.Constant(0))
             param.set_data(_nd_array(stacked))
             self._safe_names.append(safe)
+            if p0.grad_req == "null":      # aux state (BN running stats)
+                self._aux_safe_names.append(safe)
             self._tmpl_params[safe] = p0
 
     # -- mesh plumbing
@@ -116,9 +124,14 @@ class PipelineBlock(Block):
         from ...parallel.pp import GPipe
 
         self._mesh = mesh
-        self._gpipe = GPipe(self._jax_stage_fn, mesh,
-                            n_microbatches or self._n_micro,
-                            axis=self._axis)
+        if self._aux_safe_names:
+            self._gpipe = GPipe(self._jax_stage_fn_aux, mesh,
+                                n_microbatches or self._n_micro,
+                                axis=self._axis, has_aux=True)
+        else:
+            self._gpipe = GPipe(self._jax_stage_fn, mesh,
+                                n_microbatches or self._n_micro,
+                                axis=self._axis)
         return self
 
     def param_spec(self, name, shape):
@@ -143,15 +156,42 @@ class PipelineBlock(Block):
         scope = _StagingScope()
         with param_override(override), scope:
             y = self._template(NDArray(x))
-        if scope.aux_updates:
+        if scope.aux_updates:  # unreachable when _aux_safe_names is
+            # empty unless a stage mutates aux outside its Parameters
             raise RuntimeError(
-                "pipeline stages must not update aux state (BatchNorm "
-                "running stats) in pipelined mode; freeze the stats or "
-                "use LayerNorm")
+                "stage produced aux updates for parameters not owned by "
+                "the PipelineBlock — register the aux state as stage "
+                "parameters")
         return y._data
 
+    def _jax_stage_fn_aux(self, tree, x, aux_tree):
+        """has_aux stage fn: aux_tree is this rank's stage aux slice;
+        returns (y, new_aux_tree) with the template's BatchNorm-style
+        updates routed back to their stacked slots."""
+        override = {self._tmpl_params[s]: NDArray(v)
+                    for s, v in tree.items()}
+        override.update({self._tmpl_params[s]: NDArray(v)
+                         for s, v in aux_tree.items()})
+        scope = _StagingScope()
+        with param_override(override), scope:
+            y = self._template(NDArray(x))
+        new_aux = {}
+        for s in self._aux_safe_names:
+            upd = scope.aux_updates.pop(self._tmpl_params[s], None)
+            new_aux[s] = upd if upd is not None else aux_tree[s]
+        if scope.aux_updates:
+            raise RuntimeError(
+                "stage produced aux updates for parameters not owned by "
+                "the PipelineBlock — register the aux state as stage "
+                "parameters")
+        return y._data, new_aux
+
     def forward(self, x):
-        stacked = {s: self._reg_params[s].data() for s in self._safe_names}
+        aux_names = set(self._aux_safe_names)
+        train_names = [s for s in self._safe_names if s not in aux_names]
+        stacked = {s: self._reg_params[s].data() for s in train_names}
+        stacked_aux = {s: self._reg_params[s].data()
+                       for s in self._aux_safe_names}
         if self._gpipe is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -159,29 +199,67 @@ class PipelineBlock(Block):
             # place onto the mesh shardings shard_map expects: a no-op
             # when GluonTrainStep already sharded the params over 'pp',
             # and the eager-call migration path otherwise
-            tree = {
-                s: jax.device_put(
-                    v._data,
-                    NamedSharding(self._mesh, P(
-                        self._axis, *([None] * (v._data.ndim - 1)))))
-                for s, v in stacked.items()}
+            def put(tree):
+                return {
+                    s: jax.device_put(
+                        v._data,
+                        NamedSharding(self._mesh, P(
+                            self._axis, *([None] * (v._data.ndim - 1)))))
+                    for s, v in tree.items()}
+
+            tree = put(stacked)
             xj = jax.device_put(x._data, NamedSharding(self._mesh, P()))
+            if self._aux_safe_names:
+                y, new_aux = self._gpipe(tree, xj, put(stacked_aux))
+                for s, v in new_aux.items():
+                    update_aux_state(self._reg_params[s], NDArray(v))
+                return NDArray(y)
             return NDArray(self._gpipe(tree, xj))
-        # sequential fallback: same math, one stage after another.  Aux
-        # updates are rejected here too — they would key on the shadowed
-        # template parameter, not the stacked per-stage Parameters, so
-        # silently dropping them would corrupt BatchNorm stats the
-        # moment the model switched to inference
+        # sequential fallback: same math as the pipelined schedule.
+        # Aux-free stages run the full batch at once.  Aux-bearing
+        # stages run per MICROBATCH with the aux chained across chunks
+        # — exactly what each GPipe rank computes (per-microbatch BN
+        # statistics, one EMA step per microbatch) — so attaching or
+        # detaching the mesh never changes numerics.
+        import jax.numpy as jnp
+
+        aux_set = set(self._aux_safe_names)
+        n_micro = (self._n_micro or self._n_stages) if aux_set else 1
+        if x.shape[0] % n_micro:
+            raise ValueError(
+                "batch %d not divisible by %d microbatches"
+                % (x.shape[0], n_micro))
+        new_aux_rows = {s: [] for s in self._aux_safe_names}
         for i in range(self._n_stages):
             override = self._override_for(
                 {s: NDArray(v._data[i]) for s, v in stacked.items()})
-            scope = _StagingScope()
-            with param_override(override), scope:
-                x = self._template(x)
-            if scope.aux_updates:
-                raise RuntimeError(
-                    "pipeline stages must not update aux state (BatchNorm "
-                    "running stats); freeze the stats or use LayerNorm")
+            aux_i = {s: v._data[i] for s, v in stacked_aux.items()}
+            chunks = []
+            for m in range(n_micro):
+                lo = m * (x.shape[0] // n_micro)
+                hi = lo + x.shape[0] // n_micro
+                override.update(self._override_for(
+                    {s: NDArray(v) for s, v in aux_i.items()}))
+                scope = _StagingScope()
+                with param_override(override), scope:
+                    chunks.append(self._template(x[lo:hi] if n_micro > 1
+                                                 else x))
+                for s in self._aux_safe_names:
+                    upd = scope.aux_updates.pop(self._tmpl_params[s],
+                                                None)
+                    if upd is not None:
+                        aux_i[s] = upd
+                if scope.aux_updates:
+                    raise RuntimeError(
+                        "stage produced aux updates for parameters not "
+                        "owned by the PipelineBlock — register the aux "
+                        "state as stage parameters")
+            x = (chunks[0] if n_micro == 1
+                 else NDArray(jnp.concatenate([c._data for c in chunks])))
+            for s in self._aux_safe_names:
+                new_aux_rows[s].append(aux_i[s])
+        for s, rows in new_aux_rows.items():
+            update_aux_state(self._reg_params[s], NDArray(jnp.stack(rows)))
         return x
 
 

@@ -58,35 +58,59 @@ class GPipe:
 
     Parameters
     ----------
-    stage_fn : (stage_params, x) -> y with y.shape == x.shape
+    stage_fn : (stage_params, x) -> y with y.shape == x.shape; with
+        ``has_aux`` the signature is (stage_params, x, aux) ->
+        (y, new_aux) where aux is this stage's mutable state (BatchNorm
+        running stats), threaded through the schedule per rank
     mesh : jax Mesh with a 'pp' axis covering all its devices' stages
     n_microbatches : how many microbatches the global batch splits into
         (≥ n_stages keeps the bubble fraction at (P-1)/(M+P-1))
     axis : mesh axis name
+    has_aux : stages carry aux state.  Aux updates chain across the
+        stage's microbatches (EMA applied once per VALID tick — fill
+        and drain ticks, where a rank chews zero-padding, leave the aux
+        untouched), so the semantics match training with
+        microbatch-sized batches — the standard GPipe BatchNorm
+        contract.
 
-    Call with (stacked_params, x) where stacked params have a leading
-    stage axis and x is the GLOBAL batch (dim 0 divisible by
-    n_microbatches); returns the same global batch transformed.
+    Call with (stacked_params, x) — or (stacked_params, x, stacked_aux)
+    with ``has_aux`` — where stacked trees have a leading stage axis and
+    x is the GLOBAL batch (dim 0 divisible by n_microbatches); returns
+    the transformed global batch (plus the updated stacked aux).
     """
 
-    def __init__(self, stage_fn, mesh, n_microbatches=None, axis="pp"):
-        self.stage_fn = stage_fn
+    def __init__(self, stage_fn, mesh, n_microbatches=None, axis="pp",
+                 has_aux=False):
         self.mesh = mesh
         self.axis = axis
         self.n_stages = mesh.shape[axis]
         self.n_micro = n_microbatches or self.n_stages
+        self.has_aux = has_aux
+        # ONE schedule implementation: aux-free stage fns are adapted to
+        # the (params, x, aux) -> (y, aux) signature with an empty aux
+        # tree, so the subtle fill/steady/drain logic exists once
+        if has_aux:
+            self.stage_fn = stage_fn
+        else:
+            self.stage_fn = lambda p, x, aux: (stage_fn(p, x), aux)
 
         from jax.sharding import PartitionSpec as P
 
         self._fn = _shard_map(
             self._device_program, mesh=mesh,
-            in_specs=(P(axis), P()), out_specs=P())
+            in_specs=(P(axis), P(), P(axis)),
+            out_specs=(P(), P(axis)))
 
-    def _device_program(self, params, x):
-        """Runs per-device: params carry a leading stage axis of size 1
-        (this rank's stage); x is the full global batch."""
+    def _device_program(self, params, x, aux):
+        """Runs per-device: params/aux carry a leading stage axis of
+        size 1 (this rank's stage); x is the full global batch.  Aux
+        rides the scan carry; a tick's update is kept only when the
+        tick processed one of this rank's M real microbatches (rank i
+        is valid for i <= t <= i + M - 1) — fill/drain ticks chew
+        zero-padding and must not touch stage state."""
         axis, M = self.axis, self.n_micro
         params = jax.tree_util.tree_map(lambda a: a[0], params)
+        aux0 = jax.tree_util.tree_map(lambda a: a[0], aux)
         i = lax.axis_index(axis)
         P = self.n_stages
 
@@ -99,25 +123,33 @@ class GPipe:
         outs = jnp.zeros_like(micro)
 
         def tick(carry, t):
-            state, outs = carry
+            state, outs, aux = carry
             # stage 0 ingests microbatch t during the fill phase
             inp = micro[jnp.clip(t, 0, M - 1)]
             cur = jnp.where(i == 0, jnp.where(t < M, inp, state), state)
-            y = self.stage_fn(params, cur)
+            y, new_aux = self.stage_fn(params, cur, aux)
+            valid = (t >= i) & (t <= i + M - 1)
+            aux = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(valid, n, o), new_aux, aux)
             # the last stage emits microbatch m = t - (P - 1)
             m = t - (P - 1)
             written = lax.dynamic_update_index_in_dim(
                 outs, y, jnp.clip(m, 0, M - 1), 0)
             outs = jnp.where((i == P - 1) & (m >= 0), written, outs)
             state = lax.ppermute(y, axis, perm)
-            return (state, outs), None
+            return (state, outs, aux), None
 
-        (_, outs), _ = lax.scan(tick, (state, outs),
-                                jnp.arange(M + P - 1))
+        (_, outs, aux_f), _ = lax.scan(tick, (state, outs, aux0),
+                                       jnp.arange(M + P - 1))
         # result lives on the last rank; make it mesh-invariant
         outs = lax.psum(jnp.where(i == P - 1, outs, jnp.zeros_like(outs)),
                         axis)
-        return outs.reshape((gb,) + x.shape[1:])
+        return (outs.reshape((gb,) + x.shape[1:]),
+                jax.tree_util.tree_map(lambda a: a[None], aux_f))
 
-    def __call__(self, stacked_params, x):
-        return self._fn(stacked_params, x)
+    def __call__(self, stacked_params, x, stacked_aux=None):
+        out, aux = self._fn(stacked_params, x,
+                            {} if stacked_aux is None else stacked_aux)
+        if self.has_aux:
+            return out, aux
+        return out
