@@ -124,6 +124,11 @@ class PipelineBlock(Block):
         from ...parallel.pp import GPipe
 
         self._mesh = mesh
+        # remember the effective microbatch count: the sequential
+        # fallback must chunk BN stages into the SAME microbatches, or
+        # detaching the mesh would change numerics
+        if n_microbatches is not None:
+            self._n_micro = n_microbatches
         if self._aux_safe_names:
             self._gpipe = GPipe(self._jax_stage_fn_aux, mesh,
                                 n_microbatches or self._n_micro,
@@ -223,8 +228,14 @@ class PipelineBlock(Block):
         # detaching the mesh never changes numerics.
         import jax.numpy as jnp
 
+        from ... import autograd as _autograd
+
         aux_set = set(self._aux_safe_names)
-        n_micro = (self._n_micro or self._n_stages) if aux_set else 1
+        # chunking matters only when BN stats are being UPDATED: eval
+        # forwards normalize with the running stats, so microbatching
+        # changes nothing and odd inference batches must keep working
+        chunk = bool(aux_set) and _autograd.is_training()
+        n_micro = (self._n_micro or self._n_stages) if chunk else 1
         if x.shape[0] % n_micro:
             raise ValueError(
                 "batch %d not divisible by %d microbatches"

@@ -80,7 +80,7 @@ class GPipe:
     """
 
     def __init__(self, stage_fn, mesh, n_microbatches=None, axis="pp",
-                 has_aux=False):
+                 has_aux=False, batch_spec=None, param_specs=None):
         self.mesh = mesh
         self.axis = axis
         self.n_stages = mesh.shape[axis]
@@ -96,10 +96,20 @@ class GPipe:
 
         from jax.sharding import PartitionSpec as P
 
+        # batch_spec: how x (and the output) is laid over the OTHER
+        # mesh axes — e.g. P('dp', None) composes the pipeline with
+        # data parallelism (each dp slice streams its own microbatches).
+        # param_specs: a pytree(-prefix) of specs for the stacked stage
+        # params when stage weights also shard over other axes (e.g.
+        # P('pp', None, 'tp') for Megatron column-parallel stages); the
+        # default P(axis) shards the stage dim only.
         self._fn = _shard_map(
             self._device_program, mesh=mesh,
-            in_specs=(P(axis), P(), P(axis)),
-            out_specs=(P(), P(axis)))
+            in_specs=(P(axis) if param_specs is None else param_specs,
+                      P() if batch_spec is None else batch_spec,
+                      P(axis)),
+            out_specs=(P() if batch_spec is None else batch_spec,
+                       P(axis)))
 
     def _device_program(self, params, x, aux):
         """Runs per-device: params/aux carry a leading stage axis of

@@ -319,3 +319,14 @@ def test_gluon_moe_trains_on_mesh():
     for _ in range(40):
         losses.append(float(np.asarray(step(x, y))))
     assert losses[-1] < 0.6 * losses[0], losses
+
+
+def test_pipeline_bn_eval_accepts_odd_batches():
+    """Eval forwards normalize with running stats — no chunking needed,
+    so inference batches need not divide the microbatch count (review
+    r4)."""
+    stages = [_probe(_make_bn_stage(60 + i)) for i in range(2)]
+    pipe = PipelineBlock(stages, n_microbatches=4)
+    out = pipe(mx.nd.ones((1, D)))  # eval mode: no record scope
+    assert out.shape == (1, D)
+    assert np.isfinite(out.asnumpy()).all()
