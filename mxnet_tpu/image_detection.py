@@ -411,7 +411,8 @@ class ImageDetIter(ImageIter):
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root=None, shuffle=False,
                  part_index=0, num_parts=1, aug_list=None, imglist=None,
-                 data_name="data", label_name="label", **kwargs):
+                 data_name="data", label_name="label",
+                 preprocess_threads=0, **kwargs):
         super().__init__(batch_size=batch_size, data_shape=data_shape,
                          path_imgrec=path_imgrec, path_imglist=path_imglist,
                          path_root=path_root, shuffle=shuffle,
@@ -420,6 +421,19 @@ class ImageDetIter(ImageIter):
                          label_name=label_name)
         self.auglist = (CreateDetAugmenter(data_shape, **kwargs)
                         if aug_list is None else aug_list)
+        # optional thread pool for the per-sample decode+augment chain
+        # (reference: iter_image_det_recordio.cc runs it in the worker
+        # threads; here PIL's decode/resize release the GIL, so threads
+        # overlap the heavy pixel work while record reads stay on the
+        # calling thread).  Threads share numpy's global RNG — sample
+        # augment draws interleave nondeterministically across threads,
+        # the same property the reference's worker pool has.
+        self._executor = None
+        if preprocess_threads and int(preprocess_threads) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=int(preprocess_threads))
         self.label_shape = self._scan_label_shape()
 
     # -- label plumbing
@@ -531,34 +545,87 @@ class ImageDetIter(ImageIter):
             data, label = aug(data, label)
         return data, label
 
-    def next(self):
-        from .image import _HostArray, _imdecode_np, _to_host
+    # a bad sample is skipped, not fatal: RuntimeError covers label/
+    # augment validation and cv2-backed decode (MXNetError), OSError
+    # covers PIL's UnidentifiedImageError on the no-cv2 fallback, and
+    # ValueError covers malformed buffers in either decoder
+    _SKIP_ERRORS = (RuntimeError, OSError, ValueError)
 
+    def close(self):
+        """Release the preprocess thread pool (also runs on GC)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _load_one(self, raw, buf):
+        """Per-sample decode + joint augment (thread-pool work item)."""
+        from .image import _HostArray, _imdecode_np
+
+        rows = self._parse_label(raw)
+        # the whole per-sample path stays on host numpy; HBM sees one
+        # transfer per batch
+        img = _imdecode_np(buf).view(_HostArray)
+        img, rows = self.augmentation_transform(img, rows)
+        self._check_valid_label(rows)
+        return img, rows
+
+    def _write_slot(self, batch_data, batch_label, i, img, rows):
+        from .image import _to_host
+
+        batch_data[i] = _to_host(img).transpose(2, 0, 1)
+        n = min(rows.shape[0], self.label_shape[0])
+        batch_label[i, :n] = rows[:n]
+
+    def next(self):
         c_h_w = (self.data_shape[0],) + tuple(self.data_shape[1:])
         batch_data = _np.zeros((self.batch_size,) + c_h_w, dtype=_np.float32)
         batch_label = _np.full((self.batch_size,) + self.label_shape, -1.0,
                                dtype=_np.float32)
         i = 0
+        exhausted = False
         try:
-            while i < self.batch_size:
-                raw, buf = self.next_sample()
-                try:
-                    rows = self._parse_label(raw)
-                    # the whole per-sample path stays on host numpy; HBM
-                    # sees one transfer per batch
-                    img = _imdecode_np(buf).view(_HostArray)
-                    img, rows = self.augmentation_transform(img, rows)
-                    self._check_valid_label(rows)
-                except RuntimeError as e:
-                    logging.debug("skipping invalid det sample: %s", e)
+            while i < self.batch_size and not exhausted:
+                if self._executor is None:
+                    raw, buf = self.next_sample()  # may StopIteration
+                    try:
+                        img, rows = self._load_one(raw, buf)
+                    except self._SKIP_ERRORS as e:
+                        logging.debug("skipping invalid det sample: %s", e)
+                        continue
+                    self._write_slot(batch_data, batch_label, i, img, rows)
+                    i += 1
                     continue
-                batch_data[i] = _to_host(img).transpose(2, 0, 1)
-                n = min(rows.shape[0], self.label_shape[0])
-                batch_label[i, :n] = rows[:n]
-                i += 1
+                # threaded: record reads stay on this thread (recordio
+                # handles are not thread-safe); decode+augment fans out
+                samples = []
+                while len(samples) < self.batch_size - i:
+                    try:
+                        samples.append(self.next_sample())
+                    except StopIteration:
+                        exhausted = True
+                        break
+                if not samples:
+                    break
+                futures = [self._executor.submit(self._load_one, raw, buf)
+                           for raw, buf in samples]
+                for f in futures:
+                    try:
+                        img, rows = f.result()
+                    except self._SKIP_ERRORS as e:
+                        logging.debug("skipping invalid det sample: %s", e)
+                        continue
+                    self._write_slot(batch_data, batch_label, i, img, rows)
+                    i += 1
         except StopIteration:
-            if i == 0:
-                raise
+            exhausted = True
+        if i == 0:
+            raise StopIteration
         return _io.DataBatch(data=[ndarray.array(batch_data)],
                              label=[ndarray.array(batch_label)],
                              pad=self.batch_size - i)

@@ -76,12 +76,21 @@ class MXRecordIO:
     def read(self):
         assert not self.writable
         head = self.handle.read(8)
+        if not head:
+            return None  # clean EOF at a record boundary
         if len(head) < 8:
-            return None
+            # a partial header is file corruption, not EOF — surfacing
+            # it beats silently dropping the tail of a dataset
+            raise IOError("truncated RecordIO header in %s (%d trailing "
+                          "bytes)" % (self.uri, len(head)))
         magic, length = struct.unpack("<II", head)
         if magic != _MAGIC:
             raise IOError("invalid RecordIO magic in %s" % self.uri)
         buf = self.handle.read(length)
+        if len(buf) < length:
+            raise IOError(
+                "truncated RecordIO payload in %s (record wants %d "
+                "bytes, file has %d)" % (self.uri, length, len(buf)))
         pad = (4 - length % 4) % 4
         if pad:
             self.handle.read(pad)

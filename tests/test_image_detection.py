@@ -312,3 +312,130 @@ def test_det_iter_augmented_epoch(tmp_path):
         assert (real[:, 4] > real[:, 2]).all()
         n += 1
     assert n == 2
+
+
+# ------------------------------------------------- corruption behavior
+
+
+def _write_plain_det_rec(tmp_path, n=4):
+    """Packed det .rec written directly (JPEG payloads)."""
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    p = str(tmp_path / "c.rec")
+    rec = MXIndexedRecordIO(str(tmp_path / "c.idx"), p, "w")
+    img = np.random.RandomState(0).randint(0, 255, (32, 32, 3), np.uint8)
+    for i in range(n):
+        rec.write_idx(i, pack_img(
+            IRHeader(2, np.array([2, 5, 0, .1, .1, .9, .9], np.float32),
+                     i, 0), img, quality=90))
+    rec.close()
+    return p
+
+
+def test_truncated_rec_raises_not_silently_drops(tmp_path):
+    """VERDICT r3 task #6: a .rec cut mid-record must raise a clear
+    IOError (silently dropping the tail hides dataset corruption); a
+    clean EOF still returns None."""
+    from mxnet_tpu.recordio import MXRecordIO
+
+    p = _write_plain_det_rec(tmp_path)
+    data = open(p, "rb").read()
+
+    # clean file: reads all records then None
+    r = MXRecordIO(p, "r")
+    n = 0
+    while r.read() is not None:
+        n += 1
+    assert n == 4
+
+    # mid-payload truncation
+    pt = str(tmp_path / "trunc.rec")
+    open(pt, "wb").write(data[:len(data) - 100])
+    r = MXRecordIO(pt, "r")
+    with pytest.raises(IOError, match="truncated"):
+        while r.read() is not None:
+            pass
+
+    # mid-header truncation AFTER valid records: cut 3 bytes into the
+    # last record's header (its offset comes from the .idx) — the
+    # reader must hand back the three whole records, then raise
+    from mxnet_tpu.recordio import MXIndexedRecordIO
+
+    idx = MXIndexedRecordIO(str(tmp_path / "c.idx"), p, "r")
+    last_pos = idx.idx[idx.keys[-1]]
+    idx.close()
+    ph = str(tmp_path / "trunch.rec")
+    open(ph, "wb").write(data[:last_pos + 3])
+    r = MXRecordIO(ph, "r")
+    for _ in range(3):
+        assert r.read() is not None
+    with pytest.raises(IOError, match="truncated"):
+        r.read()
+
+
+def test_corrupt_jpeg_record_is_skipped_not_fatal(tmp_path):
+    """A record whose JPEG payload is garbage is skipped with a log,
+    like the reference worker's per-sample error handling — the epoch
+    completes with the remaining samples."""
+    from mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO, pack,
+                                    unpack)
+
+    p = _write_plain_det_rec(tmp_path)
+    # rewrite record 1 with a corrupted payload, same label
+    rec = MXIndexedRecordIO(str(tmp_path / "c.idx"), p, "r")
+    bufs = [rec.read_idx(k) for k in rec.keys]
+    rec.close()
+    p2 = str(tmp_path / "mix.rec")
+    out = MXIndexedRecordIO(str(tmp_path / "mix.idx"), p2, "w")
+    for i, b in enumerate(bufs):
+        if i == 1:
+            hdr, _ = unpack(b)
+            b = pack(hdr, b"\xff\xd8\xff" + b"garbage" * 20)
+        out.write_idx(i, b)
+    out.close()
+
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      path_imgrec=p2)
+    batch = next(iter(it))
+    assert batch.pad == 1  # 3 good samples of 4
+    assert np.isfinite(batch.data[0].asnumpy()).all()
+
+
+def test_malformed_det_label_is_skipped(tmp_path):
+    """A record whose packed label violates the wire format is skipped
+    at scan AND iteration time; good records still flow."""
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    p = str(tmp_path / "bad.rec")
+    rec = MXIndexedRecordIO(str(tmp_path / "bad.idx"), p, "w")
+    img = np.random.RandomState(1).randint(0, 255, (32, 32, 3), np.uint8)
+    labels = [
+        np.array([2, 5, 0, .1, .1, .9, .9], np.float32),      # good
+        np.array([2, 5, 0, .1], np.float32),                  # too short
+        np.array([2, 5, 0, .5, .5, .5, .5], np.float32),      # degenerate
+        np.array([2, 5, 1, .2, .2, .8, .8], np.float32),      # good
+    ]
+    for i, lb in enumerate(labels):
+        rec.write_idx(i, pack_img(IRHeader(2, lb, i, 0), img, quality=90))
+    rec.close()
+
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32), path_imgrec=p)
+    batch = next(iter(it))
+    assert batch.pad == 2  # the two malformed samples skipped
+    lbl = batch.label[0].asnumpy()
+    assert lbl[0, 0, 0] == 0 and lbl[1, 0, 0] == 1
+
+
+def test_det_iter_preprocess_threads_matches_single(tmp_path):
+    """The thread-pool path produces the same samples (deterministic
+    augs) and the same skip semantics as the single-thread path."""
+    p = _write_plain_det_rec(tmp_path, n=6)
+    kw = dict(batch_size=3, data_shape=(3, 32, 32), path_imgrec=p)
+    a = ImageDetIter(**kw)
+    b = ImageDetIter(preprocess_threads=4, **kw)
+    for ba, bb in zip(iter(a), iter(b)):
+        np.testing.assert_allclose(ba.data[0].asnumpy(),
+                                   bb.data[0].asnumpy())
+        np.testing.assert_allclose(ba.label[0].asnumpy(),
+                                   bb.label[0].asnumpy())
+        assert ba.pad == bb.pad
