@@ -165,6 +165,35 @@ class Block:
                          for k, v in self._children.items())
         return s.format(name=self.__class__.__name__, body=body)
 
+    def collect_aux_losses(self):
+        """Sum the ``aux_loss`` of every descendant block that exposes
+        one (MoE load-balancing losses today; any block may publish an
+        ``aux_loss`` property holding its most recent forward's
+        auxiliary loss).
+
+        Call after the forward, inside the same autograd/staging scope
+        — or let ``GluonTrainStep(aux_loss_weight=w)`` do both the
+        collection and the weighting for you.  Raises if no descendant
+        publishes an aux loss (a silent 0.0 would hide a wiring bug).
+        """
+        total = None
+        stack = [self]
+        seen = set()  # a shared block reachable twice contributes once
+        while stack:
+            b = stack.pop()
+            if id(b) in seen:
+                continue
+            seen.add(id(b))
+            aux = getattr(type(b), "aux_loss", None)
+            if aux is not None:
+                val = b.aux_loss
+                total = val if total is None else total + val
+            stack.extend(b._children.values())
+        if total is None:
+            raise ValueError(
+                "no descendant of %r publishes an aux_loss" % (self,))
+        return total
+
     # ------------------------------------------------------------ params
     def collect_params(self, select=None):
         """All Parameters of this block and its descendants, optionally

@@ -343,11 +343,16 @@ class MoE(Block):
 
 def collect_moe_aux(block):
     """Sum aux_loss over every MoE in a block tree (call after the
-    forward, inside the same autograd/staging scope)."""
+    forward, inside the same autograd/staging scope).  Compat spelling
+    of ``Block.collect_aux_losses`` restricted to MoE blocks."""
     total = None
     stack = [block]
+    seen = set()  # a shared block reachable twice contributes once
     while stack:
         b = stack.pop()
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
         if isinstance(b, MoE):
             aux = b.aux_loss
             total = aux if total is None else total + aux

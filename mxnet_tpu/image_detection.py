@@ -551,6 +551,18 @@ class ImageDetIter(ImageIter):
     # ValueError covers malformed buffers in either decoder
     _SKIP_ERRORS = (RuntimeError, OSError, ValueError)
 
+    def _log_skip(self, err):
+        """Per-sample data loss must be OBSERVABLE at default log
+        level: warn for the first few skips (and periodically after),
+        count all of them (``self.skipped_samples``)."""
+        self.skipped_samples = getattr(self, "skipped_samples", 0) + 1
+        n = self.skipped_samples
+        if n <= 20 or n % 1000 == 0:
+            logging.warning("skipping invalid det sample (%d skipped so "
+                            "far): %s", n, err)
+        else:
+            logging.debug("skipping invalid det sample: %s", err)
+
     def close(self):
         """Release the preprocess thread pool (also runs on GC)."""
         if self._executor is not None:
@@ -596,7 +608,7 @@ class ImageDetIter(ImageIter):
                     try:
                         img, rows = self._load_one(raw, buf)
                     except self._SKIP_ERRORS as e:
-                        logging.debug("skipping invalid det sample: %s", e)
+                        self._log_skip(e)
                         continue
                     self._write_slot(batch_data, batch_label, i, img, rows)
                     i += 1
@@ -618,7 +630,7 @@ class ImageDetIter(ImageIter):
                     try:
                         img, rows = f.result()
                     except self._SKIP_ERRORS as e:
-                        logging.debug("skipping invalid det sample: %s", e)
+                        self._log_skip(e)
                         continue
                     self._write_slot(batch_data, batch_label, i, img, rows)
                     i += 1

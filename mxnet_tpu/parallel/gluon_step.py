@@ -23,8 +23,14 @@ from ..ndarray import NDArray
 __all__ = ["GluonTrainStep", "sgd_momentum_init", "sgd_momentum_update"]
 
 
-def _pure_loss_builder(block, loss_block, trainable, aux):
-    """Build loss(train_vals, aux_vals, x, y, key) -> (loss, new_aux)."""
+def _pure_loss_builder(block, loss_block, trainable, aux,
+                       aux_loss_weight=None):
+    """Build loss(train_vals, aux_vals, x, y, key) -> (loss, new_aux).
+
+    aux_loss_weight: when set, ``weight * block.collect_aux_losses()``
+    (MoE load-balancing etc.) is added to the task loss INSIDE the
+    staged step — the ergonomic channel replacing hand-written loss
+    Blocks that stash the net to reach its aux losses."""
 
     def pure_loss(train_vals, aux_vals, x, y, key):
         override = {p: NDArray(v) for p, v in zip(trainable, train_vals)}
@@ -35,6 +41,8 @@ def _pure_loss_builder(block, loss_block, trainable, aux):
             out = block(NDArray(x))
             loss = loss_block(out, NDArray(y))
             loss = loss.mean()
+            if aux_loss_weight is not None:
+                loss = loss + aux_loss_weight * block.collect_aux_losses()
         new_aux = tuple(
             scope.aux_updates.get(p, override[p]._data) for p in aux)
         return loss._data, new_aux
@@ -79,7 +87,7 @@ class GluonTrainStep:
 
     def __init__(self, block, loss_block, mesh=None, lr=0.1, momentum=0.9,
                  wd=0.0, compute_dtype=None, param_spec_fn=None,
-                 data_spec=None, label_spec=None):
+                 data_spec=None, label_spec=None, aux_loss_weight=None):
         import jax
         from jax.sharding import NamedSharding
 
@@ -97,7 +105,8 @@ class GluonTrainStep:
         self._update = sgd_momentum_update(lr, momentum, wd)
         self._compute_dtype = compute_dtype
         pure_loss = _pure_loss_builder(block, loss_block, self.trainable,
-                                       self.aux)
+                                       self.aux,
+                                       aux_loss_weight=aux_loss_weight)
 
         cast = compute_dtype
 

@@ -290,20 +290,14 @@ def test_gluon_moe_trains_on_mesh():
     net = MoENet(prefix="moenet_")
     net.initialize(mx.init.Xavier())
 
-    class MoELoss(gluon.Block):
-        """Task CE + load-balancing aux, read inside the staged step."""
-
-        def __init__(self, net, **kw):
-            super().__init__(**kw)
-            self.__dict__["_net"] = net
-            self.__dict__["_ce"] = gluon.loss.SoftmaxCrossEntropyLoss()
-
-        def forward(self, out, label):
-            return self._ce(out, label) + 0.01 * collect_moe_aux(self._net)
-
-    loss = MoELoss(net)
-    step = GluonTrainStep(net, loss, mesh=mesh, lr=0.1, momentum=0.9,
-                          param_spec_fn=param_spec_fn_for(net))
+    # r4 ergonomics (VERDICT r3 task #10): the aux-loss channel is a
+    # GluonTrainStep argument — no custom loss Block, no private-attr
+    # stashing; the step collects net.collect_aux_losses() inside the
+    # staged computation
+    step = GluonTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, lr=0.1, momentum=0.9,
+                          param_spec_fn=param_spec_fn_for(net),
+                          aux_loss_weight=0.01)
 
     ep_sharded = [v for p, v in zip(step.trainable, step.train_vals)
                   if p.name in (net.moe.wi.name, net.moe.wo.name)]
@@ -330,3 +324,40 @@ def test_pipeline_bn_eval_accepts_odd_batches():
     out = pipe(mx.nd.ones((1, D)))  # eval mode: no record scope
     assert out.shape == (1, D)
     assert np.isfinite(out.asnumpy()).all()
+
+
+def test_collect_aux_losses_generic():
+    """Block.collect_aux_losses sums every descendant aux_loss (r4
+    ergonomics API); collect_moe_aux remains as the MoE-specific
+    compat spelling and agrees with it."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, flatten=False, in_units=8))
+    moe = MoE(d_model=8, d_hidden=16, n_experts=4)
+    net.add(moe)
+    net.initialize()
+    with pytest.raises(ValueError):
+        nn.Dense(2).collect_aux_losses()  # no aux publishers
+    x = mx.nd.array(np.random.RandomState(7).randn(2, 4, 8)
+                    .astype(np.float32))
+    net(x)
+    a = float(np.asarray(net.collect_aux_losses()._data))
+    b = float(np.asarray(collect_moe_aux(net)._data))
+    assert a == b
+
+
+def test_collect_aux_losses_shared_block_counted_once():
+    """A weight-shared block reachable via two tree paths contributes
+    its aux_loss once (review r4)."""
+    moe = MoE(d_model=8, d_hidden=16, n_experts=4)
+    outer = nn.HybridSequential()
+    inner = nn.HybridSequential()
+    inner.add(moe)
+    outer.add(moe)     # same instance via two paths
+    outer.add(inner)
+    outer.initialize()
+    x = mx.nd.array(np.random.RandomState(8).randn(1, 4, 8)
+                    .astype(np.float32))
+    outer(x)
+    total = float(np.asarray(outer.collect_aux_losses()._data))
+    single = float(np.asarray(moe.aux_loss._data))
+    assert total == single

@@ -179,21 +179,31 @@ def bench_det(batch, hw, epochs=2):
         _imdecode_np(buf)
     decode = len(bufs) / (time.perf_counter() - t0)
 
-    # geometry-only rate: det augmenters on a resident decoded image
+    # augment-only rate: det augmenters on a resident decoded image
+    # (pixel + bbox work together)
     img = _imdecode_np(bufs[0])
     label = np.array([[3, 0.2, 0.2, 0.7, 0.8],
                       [1, 0.1, 0.5, 0.4, 0.9]], np.float32)
-    augs = CreateDetAugmenter((3, hw, hw), rand_crop=1, rand_pad=1,
-                              rand_mirror=True)
-    n_geo = 2000
-    t0 = time.perf_counter()
-    for _ in range(n_geo):
-        im, lb = img, label
-        for aug in augs:
-            im, lb = aug(im, lb)
-    geometry = n_geo / (time.perf_counter() - t0)
+
+    def aug_rate(image, shape, n):
+        augs = CreateDetAugmenter(shape, rand_crop=1, rand_pad=1,
+                                  rand_mirror=True)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            im, lb = image, label
+            for aug in augs:
+                im, lb = aug(im, lb)
+        return n / (time.perf_counter() - t0)
+
+    augment = aug_rate(img, (3, hw, hw), 2000)
+    # bbox geometry alone: an 8x8 image makes the pixel work ~free, so
+    # this isolates the host-numpy box arithmetic — the number that
+    # answers "should geometry move into the C++ workers?"
+    tiny = np.zeros((8, 8, 3), np.uint8)
+    geometry = aug_rate(tiny, (3, 8, 8), 20000)
     return {"det_pipeline": full, "det_pipeline_4threads": full4,
-            "det_decode_only": decode, "det_augment_only": geometry}
+            "det_decode_only": decode, "det_augment_only": augment,
+            "det_bbox_geometry_only": geometry}
 
 
 def _train_step(batch, hw):
