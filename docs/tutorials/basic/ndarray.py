@@ -1,0 +1,61 @@
+"""NDArray — imperative tensors on CPU/TPU.
+
+Runnable tutorial (reference: docs/tutorials/basic/ndarray.md).  The
+NDArray is the imperative workhorse: create, compute, inspect — every
+op dispatches to a jit-cached XLA executable, so a steady-state loop
+runs compiled code even without `hybridize()`.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# --- creating arrays -----------------------------------------------------
+# From Python lists / numpy, or with fill constructors.
+a = mx.nd.array([[1, 2, 3], [4, 5, 6]])
+b = mx.nd.ones((2, 3))
+c = mx.nd.full((2, 3), 7.0)
+z = mx.nd.zeros((2, 3))
+assert a.shape == (2, 3) and a.dtype == np.float32
+
+# Random constructors mirror the reference's mx.nd.random namespace.
+r = mx.nd.random.uniform(0, 1, shape=(2, 3))
+n = mx.nd.random.normal(0, 1, shape=(2, 3))
+
+# --- arithmetic ----------------------------------------------------------
+# Operators are elementwise; broadcasting follows numpy rules.
+d = a * b + c
+assert (d.asnumpy() == a.asnumpy() + 7).all()
+e = a * mx.nd.array([10.0, 100.0, 1000.0])   # broadcast over rows
+assert e[1, 2].asscalar() == 6000.0
+
+# Matrix product via nd.dot:
+f = mx.nd.dot(a, a.T)
+assert f.shape == (2, 2)
+
+# --- dtype control -------------------------------------------------------
+# astype converts; float16/bfloat16 are first-class on TPU.
+h = a.astype("float16")
+assert h.dtype == np.float16
+
+# --- device context ------------------------------------------------------
+# Arrays live on a Context: mx.cpu() or mx.tpu(i).  copyto / as_in_context
+# move data; ops run where their inputs live.
+x_cpu = mx.nd.ones((2, 2), ctx=mx.cpu())
+assert x_cpu.context == mx.cpu()
+if mx.context.num_tpus():
+    x_tpu = x_cpu.as_in_context(mx.tpu())
+    assert x_tpu.context.device_type == "tpu"
+
+# --- conversion ----------------------------------------------------------
+# .asnumpy() materializes on the host (a synchronization point);
+# .asscalar() for size-1 arrays.
+assert isinstance(d.asnumpy(), np.ndarray)
+assert mx.nd.array([3.5]).asscalar() == 3.5
+
+# --- in-place and views --------------------------------------------------
+g = mx.nd.zeros((3,))
+g[:] = 5          # in-place assign
+g += 1
+assert (g.asnumpy() == 6).all()
+
+print("ndarray tutorial: OK")

@@ -1,0 +1,39 @@
+"""NDArray indexing — slices, steps, fancy and boolean indexing.
+
+Runnable tutorial (reference: docs/tutorials/basic/ndarray_indexing.md).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+x = mx.nd.arange(24).reshape((2, 3, 4))
+
+# Basic slicing mirrors numpy, including negative indices and steps.
+assert x[1].shape == (3, 4)
+assert x[1, 2].shape == (4,)
+assert x[-1, -1, -1].asscalar() == 23.0
+assert (x[0, :, 1::2].asnumpy() == np.arange(24).reshape(2, 3, 4)[0, :, 1::2]).all()
+
+# Slice assignment writes through.
+y = x.copy()
+y[0, 0] = -1
+assert (y[0, 0].asnumpy() == -1).all()
+y[1, :, ::2] = 0
+assert y[1, 2, 2].asscalar() == 0.0
+
+# Integer-array (fancy) indexing gathers rows.
+idx = mx.nd.array([1, 0], dtype="int32")
+taken = mx.nd.take(x, idx, axis=0)
+assert (taken[0].asnumpy() == x[1].asnumpy()).all()
+
+# Boolean masks select elements (flattened result, like numpy).
+v = mx.nd.array([1.0, -2.0, 3.0, -4.0])
+mask = v > 0
+positives = v.asnumpy()[mask.asnumpy().astype(bool)]
+assert (positives == [1.0, 3.0]).all()
+
+# where() keeps everything on-device for conditional selection.
+clipped = mx.nd.where(v > 0, v, mx.nd.zeros_like(v))
+assert (clipped.asnumpy() == [1.0, 0.0, 3.0, 0.0]).all()
+
+print("ndarray_indexing tutorial: OK")

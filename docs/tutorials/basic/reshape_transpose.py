@@ -1,0 +1,41 @@
+"""Reshape vs transpose — layout changes that are (and aren't) free.
+
+Runnable tutorial (reference: docs/tutorials/basic/reshape_transpose.md).
+reshape reinterprets the same row-major buffer; transpose permutes
+axes and therefore reorders data.  Under XLA both become layout
+operations the compiler can often fuse away — but semantically they
+are different functions, easy to confuse.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+x = mx.nd.arange(6).reshape((2, 3))
+
+# reshape: same element ORDER, new shape.
+r = x.reshape((3, 2))
+assert (r.asnumpy().ravel() == np.arange(6)).all()
+
+# transpose: rows become columns — different element order.
+t = x.T
+assert t.shape == (3, 2)
+assert not (t.asnumpy() == r.asnumpy()).all()
+assert (t.asnumpy() == np.arange(6).reshape(2, 3).T).all()
+
+# Special reshape codes from the reference API:
+#   0  copy the input dimension
+#  -1  infer from the remaining elements
+y = mx.nd.zeros((4, 5, 6))
+assert y.reshape((0, -1)).shape == (4, 30)
+assert y.reshape((-1, 6)).shape == (20, 6)
+
+# A common real case: NCHW <-> NHWC needs transpose, NOT reshape.
+img = mx.nd.random.uniform(shape=(1, 3, 4, 4))       # NCHW
+nhwc = img.transpose((0, 2, 3, 1))
+assert nhwc.shape == (1, 4, 4, 3)
+back = nhwc.transpose((0, 3, 1, 2))
+assert np.allclose(back.asnumpy(), img.asnumpy())
+wrong = img.reshape((1, 4, 4, 3))                     # legal, but scrambled
+assert not np.allclose(wrong.asnumpy(), nhwc.asnumpy())
+
+print("reshape_transpose tutorial: OK")

@@ -1,0 +1,52 @@
+"""Symbol — the declarative graph API.
+
+Runnable tutorial (reference: docs/tutorials/basic/symbol.md).  A
+Symbol describes computation without running it; `bind` pairs it with
+argument arrays into an Executor.  On TPU the whole bound graph
+compiles to ONE XLA computation — the reference's GraphExecutor
+machinery (memory planning, fusion) is owned by the compiler.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# --- composing symbols ---------------------------------------------------
+a = mx.sym.Variable("a")
+b = mx.sym.Variable("b")
+c = a + b * 2
+assert sorted(c.list_arguments()) == ["a", "b"]
+
+# A small MLP; layer ops auto-create their weight/bias variables.
+data = mx.sym.Variable("data")
+h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+h = mx.sym.Activation(h, act_type="relu", name="relu1")
+net = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+assert "fc1_weight" in net.list_arguments()
+
+# --- shape/type inference ------------------------------------------------
+arg_shapes, out_shapes, _ = net.infer_shape(data=(4, 10))
+assert out_shapes[0] == (4, 3)
+
+# --- binding and running -------------------------------------------------
+rng = np.random.RandomState(0)
+exe = net.simple_bind(ctx=mx.cpu(), data=(4, 10))
+exe.arg_dict["data"][:] = rng.rand(4, 10)
+for name in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+    exe.arg_dict[name][:] = rng.rand(*exe.arg_dict[name].shape) * 0.1
+out = exe.forward(is_train=False)[0]
+assert out.shape == (4, 3)
+
+# --- gradients through the executor -------------------------------------
+exe2 = net.simple_bind(ctx=mx.cpu(), data=(4, 10), grad_req="write")
+for k, v in exe.arg_dict.items():
+    v.copyto(exe2.arg_dict[k])
+exe2.forward(is_train=True)
+exe2.backward(mx.nd.ones((4, 3)))
+assert exe2.grad_dict["fc1_weight"].shape == exe2.arg_dict["fc1_weight"].shape
+
+# --- serialization -------------------------------------------------------
+js = net.tojson()
+net2 = mx.sym.load_json(js)
+assert net2.list_arguments() == net.list_arguments()
+
+print("symbol tutorial: OK")

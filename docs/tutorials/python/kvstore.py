@@ -1,0 +1,56 @@
+"""KVStore — the shared parameter store behind data parallelism.
+
+Runnable tutorial (reference: docs/tutorials/python/kvstore.md).  On
+TPU meshes, gradient aggregation usually rides GSPMD all-reduces
+(docs/faq/distributed_training.md); the KVStore API remains for
+reference-style training loops and the dist_* process modes.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# --- init / push / pull --------------------------------------------------
+kv = mx.kv.create("local")
+shape = (2, 3)
+kv.init(3, mx.nd.ones(shape))
+
+out = mx.nd.zeros(shape)
+kv.pull(3, out=out)
+assert (out.asnumpy() == 1).all()
+
+# push aggregates (sums) what workers send before the next pull.
+kv.push(3, mx.nd.ones(shape) * 8)
+kv.pull(3, out=out)
+assert (out.asnumpy() == 8).all()
+
+# A list push aggregates all entries: the data-parallel gradient sum.
+kv.push(3, [mx.nd.ones(shape) * w for w in (1, 2, 3)])
+kv.pull(3, out=out)
+assert (out.asnumpy() == 6).all()
+
+# --- updaters ------------------------------------------------------------
+# set_updater installs the merge rule applied at push time — this is
+# where a server-side optimizer hooks in.
+kv2 = mx.kv.create("local")
+kv2.init("w", mx.nd.zeros(shape))
+
+
+def sgd_update(key, grad, weight):
+    weight[:] = weight - 0.1 * grad
+
+
+kv2.set_updater(sgd_update)
+kv2.push("w", mx.nd.ones(shape))
+kv2.pull("w", out=out)
+assert np.allclose(out.asnumpy(), -0.1)
+
+# --- string keys and multiple tensors -----------------------------------
+kv3 = mx.kv.create("local")
+kv3.init(["a", "b"], [mx.nd.ones((2,)), mx.nd.zeros((2,))])
+outs = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+kv3.pull(["a", "b"], out=outs)
+assert outs[0].asnumpy().sum() == 2
+
+# Gradient compression (2-bit with error feedback) switches on per
+# kvstore: kv.set_gradient_compression({"type": "2bit", "threshold": .5})
+print("kvstore tutorial: OK")

@@ -1,0 +1,46 @@
+"""Linear regression — the smallest end-to-end symbolic model.
+
+Runnable tutorial (reference: docs/tutorials/python/linear-regression.md).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+rng = np.random.RandomState(0)
+
+# y = 2*x0 - 3.4*x1 + 4.2 + noise
+n = 400
+x = rng.rand(n, 2).astype(np.float32)
+w_true, b_true = np.array([2.0, -3.4], np.float32), 4.2
+y = x @ w_true + b_true + rng.randn(n).astype(np.float32) * 0.01
+
+train_iter = mx.io.NDArrayIter(x[:300], y[:300], batch_size=25,
+                               shuffle=True, label_name="lin_reg_label")
+eval_iter = mx.io.NDArrayIter(x[300:], y[300:], batch_size=25,
+                              label_name="lin_reg_label")
+
+# The model: one FullyConnected(1) + an L2 regression head.
+data = mx.sym.Variable("data")
+label = mx.sym.Variable("lin_reg_label")
+pred = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+net = mx.sym.LinearRegressionOutput(pred, label, name="lro")
+
+mod = mx.mod.Module(net, data_names=["data"],
+                    label_names=["lin_reg_label"], context=mx.cpu())
+mod.fit(train_iter, eval_data=eval_iter, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+        eval_metric="mse", num_epoch=20)
+
+# The learned parameters recover the generating ones.
+args, _ = mod.get_params()
+w = args["fc_weight"].asnumpy().ravel()
+b = args["fc_bias"].asnumpy()[0]
+assert np.allclose(w, w_true, atol=0.1), w
+assert abs(b - b_true) < 0.1, b
+
+eval_iter.reset()
+mse = mod.score(eval_iter, mx.metric.MSE())[0][1]
+assert mse < 1e-2, mse
+
+print("linear_regression tutorial: OK (w=%s b=%.2f mse=%.4f)"
+      % (np.round(w, 2), b, mse))

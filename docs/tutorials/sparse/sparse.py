@@ -1,0 +1,63 @@
+"""Sparse NDArrays — row_sparse and CSR.
+
+Runnable tutorial (reference: docs/tutorials/sparse/*.md).
+row_sparse holds (indices, values) for a few touched rows of a huge
+logical array (embedding gradients); CSR holds (data, indices, indptr)
+for general sparsity (bag-of-words features).  Neither materializes
+its dense form unless a dense consumer forces it.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# --- row_sparse ----------------------------------------------------------
+dense_shape = (1000, 4)
+idx = mx.nd.array([3, 497], dtype="int64")
+vals = mx.nd.array([[1, 1, 1, 1], [2, 2, 2, 2]], dtype="float32")
+rs = mx.nd.sparse.row_sparse_array((vals, idx), shape=dense_shape)
+assert rs.stype == "row_sparse"
+assert (rs.indices.asnumpy() == [3, 497]).all()
+
+# retain() selects a subset of rows without densifying.
+kept = mx.nd.sparse.retain(rs, mx.nd.array([497], dtype="int64"))
+assert kept.indices.asnumpy().tolist() == [497]
+
+# Conversion to dense happens only on demand.
+dense = rs.tostype("default")
+assert dense.shape == dense_shape and dense[3, 0].asscalar() == 1.0
+
+# Optimizers consume row_sparse gradients lazily: with
+# lazy_update=True, SGD touches ONLY the gradient's rows — the
+# embedding-table update path (Trainer does this automatically for
+# Embedding(sparse_grad=True)).
+w = mx.nd.ones(dense_shape)
+g = mx.nd.sparse.row_sparse_array(
+    (mx.nd.ones((1, 4)), mx.nd.array([3], dtype="int64")),
+    shape=dense_shape)
+opt = mx.optimizer.SGD(learning_rate=0.5, lazy_update=True)
+state = opt.create_state(0, w)
+opt.update(0, w, g, state)
+assert w[3, 0].asscalar() == 0.5 and w[4, 0].asscalar() == 1.0
+
+# --- CSR -----------------------------------------------------------------
+# (data, indices, indptr): row i's nonzeros live at data[indptr[i]:
+# indptr[i+1]] in columns indices[...].
+data = np.array([10, 20, 30], np.float32)
+indices = np.array([0, 2, 1], np.int64)
+indptr = np.array([0, 2, 2, 3], np.int64)
+csr = mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+assert csr.stype == "csr"
+want = np.array([[10, 0, 20], [0, 0, 0], [0, 30, 0]], np.float32)
+assert (csr.tostype("default").asnumpy() == want).all()
+
+# Sparse-dense dot runs O(nnz * k) gather + segment-sum kernels — the
+# dense (m, n) product is never materialized.
+rhs = mx.nd.array(np.arange(6).reshape(3, 2).astype(np.float32))
+prod = mx.nd.sparse.dot(csr, rhs)
+assert np.allclose(prod.asnumpy(), want @ rhs.asnumpy())
+
+# Round-trip through scipy-style construction from a dense array:
+csr2 = mx.nd.array(want).tostype("csr")
+assert (csr2.indptr.asnumpy() == indptr).all()
+
+print("sparse tutorial: OK")

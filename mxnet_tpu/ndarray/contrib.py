@@ -94,7 +94,10 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         steps = 0
         while steps < max_iterations and bool(np.asarray(_unwrap(cond(*vars_)))):
             out, new_vars = func(*vars_)
-            out = out if isinstance(out, (list, tuple)) else [out]
+            # func may carry no per-step outputs (the reference accepts
+            # an empty list; None is the natural Python spelling)
+            out = ([] if out is None
+                   else out if isinstance(out, (list, tuple)) else [out])
             rows.append([_unwrap(o) for o in out])
             new_vars = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
             vars_ = [v if isinstance(v, NDArray) else _wrap(v, ctx)
@@ -114,7 +117,8 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     # executed on real data (shapes only), then one lax.while_loop
     def _probe(*vs):
         out, _ = func(*_tree_wrap(list(vs), ctx))
-        out = out if isinstance(out, (list, tuple)) else [out]
+        out = ([] if out is None
+               else out if isinstance(out, (list, tuple)) else [out])
         return [_unwrap(o) for o in out]
 
     probe_out = jax.eval_shape(_probe, *init)
@@ -131,7 +135,8 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     def body_fn(state):
         i, vars_, bufs_ = state
         out, new_vars = func(*_tree_wrap(list(vars_), ctx))
-        out = out if isinstance(out, (list, tuple)) else [out]
+        out = ([] if out is None
+               else out if isinstance(out, (list, tuple)) else [out])
         new_bufs = tuple(b.at[i].set(_unwrap(o)) for b, o in zip(bufs_, out))
         return (i + 1, tuple(_unwrap(v) for v in new_vars), new_bufs)
 

@@ -787,9 +787,15 @@ def array(source, ctx=None, dtype=None):
     else:
         src = _np.asarray(source)
     if dtype is None:
-        dtype = src.dtype if src.dtype != _np.float64 else _np.float32
-        if src.dtype == _np.int64 and not isinstance(source, _np.ndarray):
-            dtype = src.dtype
+        if isinstance(source, (NDArray, _np.ndarray)):
+            # typed sources keep their dtype (float64 narrows: the
+            # framework is fp32-native, reference does the same)
+            dtype = src.dtype if src.dtype != _np.float64 else _np.float32
+        else:
+            # python lists/scalars default to float32 — the reference's
+            # documented nd.array semantics (python/mxnet/ndarray/
+            # utils.py array: dtype = float32 when source has no dtype)
+            dtype = _np.float32
     src = src.astype(np_dtype(dtype))
     ctx = ctx or current_context()
     return NDArray(jax.device_put(src, ctx.jax_device), ctx)

@@ -1,0 +1,45 @@
+"""Smoke tier for the executable tutorials (VERDICT r3 task #8).
+
+Reference precedent: tests/tutorials/test_tutorials.py runs every doc
+notebook.  Here each tutorial is a plain Python script with its own
+assertions; running it in a clean namespace IS the test.  A tutorial
+that drifts from the API fails the suite, so the docs cannot rot.
+"""
+
+import os
+import runpy
+
+import pytest
+
+TUTORIAL_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "tutorials")
+
+
+def _discover():
+    out = []
+    for dirpath, _, files in os.walk(TUTORIAL_ROOT):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                path = os.path.join(dirpath, f)
+                out.append(os.path.relpath(path, TUTORIAL_ROOT))
+    return sorted(out)
+
+
+TUTORIALS = _discover()
+
+
+def test_tutorial_tier_is_complete():
+    """The index lists every tutorial and >= 12 exist (the r3 verdict's
+    'done' bar)."""
+    assert len(TUTORIALS) >= 12, TUTORIALS
+    index = open(os.path.join(TUTORIAL_ROOT, "index.md")).read()
+    missing = [t for t in TUTORIALS if t.replace(os.sep, "/") not in index]
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("rel", TUTORIALS)
+def test_tutorial_runs(rel, capsys):
+    runpy.run_path(os.path.join(TUTORIAL_ROOT, rel), run_name="__main__")
+    out = capsys.readouterr().out
+    assert "OK" in out, "tutorial %s did not report OK" % rel
