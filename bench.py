@@ -4,7 +4,8 @@ Headline metric (BASELINE.md): ResNet-50 training img/s — reference
 MXNet 1.2 on V100 fp32: 298.51 img/s @ bs=32, 363.69 img/s @ bs=128
 (docs/faq/perf.md:225-236).  vs_baseline compares at the SAME batch
 size (128 default) against the bs=128 V100 number; pass a batch on the
-CLI to measure other configs (256 is this chip's throughput peak).
+CLI to measure other configs (bs=128 is also this chip's device-side
+throughput peak — r4 chained measurement, BENCH_NOTES).
 
 The whole train step (fwd+bwd+SGD momentum+BN stat update) is one
 jitted XLA computation (parallel/gluon_step.py); compute in bfloat16

@@ -176,19 +176,86 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class _S2DStem(HybridBlock):
+    """The 7×7/s2 stem conv, computed via space-to-depth (TPU MXU
+    optimization, opt-in): the C=3 input leaves MXU lanes ~empty, so
+    the stem's backward-filter runs at <10% MXU (BENCH_ROOFLINE.md).
+    Rearranging 2×2 input blocks into channels (C: 3→12, spatial /2)
+    and the 7×7 kernel into an equivalent 4×4 one computes the SAME
+    function with 4× the lane occupancy.
+
+    Derivation: out(o) = Σ_k w[k]·x[2o+k], k∈[-3,3].  Front-pad the
+    kernel to 8 so K' = k+4 ∈ [1,7]; then K' = 2t+dy factors exactly
+    into a (4,2) reshape — tap t∈[0,4) of a stride-1 conv over the
+    s2d grid, block row dy — with the s2d input padded (2,1).  The
+    parameter keeps the reference (O,7,7,I) shape, so checkpoints
+    swap between stems freely; the rearrangement happens in the
+    traced forward (a few KB, fused away by XLA).
+    """
+
+    def __init__(self, channels, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, 7, 7, in_channels))
+
+    def hybrid_forward(self, F, x, weight):
+        if not hasattr(x, "shape"):  # symbolic trace: Symbol has no shape
+            raise NotImplementedError(
+                "stem_s2d runs on the hybrid/ndarray path (GluonTrainStep, "
+                "hybridize); for export/SymbolBlock build the model with "
+                "stem_s2d=False — the parameter shapes are identical, so "
+                "the same checkpoint loads either way")
+        c_in = self.weight.shape[3]
+        # kernel: (O,7,7,I) -> front-pad spatial to 8 -> (O,4,2,4,2,I)
+        # -> (O,4,4,2,2,I) -> (O,4,4,4I) with channel order (dy,dx,c)
+        w = F.pad(weight, mode="constant",
+                  pad_width=(0, 0, 1, 0, 1, 0, 0, 0))
+        w = w.reshape((self._channels, 4, 2, 4, 2, c_in))
+        w = w.transpose((0, 1, 3, 2, 4, 5))
+        w = w.reshape((self._channels, 4, 4, 4 * c_in))
+        # input: NHWC (B,H,W,C) -> (B,H/2,W/2,4C), same (dy,dx,c) order
+        b, h, ww_, c = x.shape
+        if h % 2 or ww_ % 2:
+            raise ValueError(
+                "stem_s2d needs even spatial dims, got %dx%d — pad the "
+                "input or use the standard stem (same checkpoint loads)"
+                % (h, ww_))
+        xs = x.reshape((b, h // 2, 2, ww_ // 2, 2, c))
+        xs = xs.transpose((0, 1, 3, 2, 4, 5))
+        xs = xs.reshape((b, h // 2, ww_ // 2, 4 * c))
+        # asymmetric (2,1) padding in s2d space = the original pad 3
+        xs = F.pad(xs, mode="constant",
+                   pad_width=(0, 0, 2, 1, 2, 1, 0, 0))
+        return F.Convolution(xs, w, no_bias=True, kernel=(4, 4),
+                             stride=(1, 1), pad=(0, 0),
+                             num_filter=self._channels, layout="NHWC")
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._layout = layout
+        if stem_s2d and layout != "NHWC":
+            raise ValueError("stem_s2d requires layout='NHWC'")
+        if stem_s2d and thumbnail:
+            raise ValueError("stem_s2d applies to the 7x7/s2 stem; "
+                             "thumbnail models have a 3x3/s1 stem")
         with self.name_scope():
             self.features = HybridSequential(prefix="")
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
-                self.features.add(Conv2D(channels[0], 7, 2, 3, use_bias=False,
-                                         layout=layout))
+                if stem_s2d:
+                    self.features.add(_S2DStem(channels[0],
+                                               prefix="conv0_"))
+                else:
+                    self.features.add(Conv2D(channels[0], 7, 2, 3,
+                                             use_bias=False,
+                                             layout=layout))
                 self.features.add(BatchNorm(axis=_bn_axis(layout)))
                 self.features.add(Activation("relu"))
                 self.features.add(MaxPool2D(3, 2, 1, layout=layout))

@@ -127,3 +127,43 @@ def test_inception_bn_nhwc_matches_nchw():
         pb[kb].set_data(mx.nd.array(w))
     assert_almost_equal(a(x).asnumpy(), b(x_cl).asnumpy(),
                         rtol=1e-3, atol=1e-4)
+
+
+def test_resnet_s2d_stem_matches_standard():
+    """stem_s2d=True (space-to-depth stem, TPU MXU option) computes
+    the SAME function as the 7x7/s2 conv with identical param shapes,
+    so checkpoints swap between stems freely.  Measured perf-neutral
+    at model scale on v5e (BENCH_NOTES r4: the stem dW is byte-bound,
+    not lane-bound) — kept as the standard TPU option with the
+    equivalence pinned here."""
+    rng = np.random.RandomState(0)
+    a = vision.resnet18_v1(classes=5, layout="NHWC")
+    b = vision.resnet18_v1(classes=5, layout="NHWC", stem_s2d=True)
+    a.initialize()
+    b.initialize()
+    x = mx.nd.array(rng.rand(1, 224, 224, 3).astype(np.float32))
+    a(x)
+    b(x)
+    pa, pb = a.collect_params(), b.collect_params()
+    for na, nb in zip(sorted(pa.keys()), sorted(pb.keys())):
+        w = pa[na].data()
+        assert tuple(w.shape) == tuple(pb[nb].data().shape), (na, nb)
+        pb[nb].set_data(w)
+    assert_almost_equal(a(x).asnumpy(), b(x).asnumpy(), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_resnet_s2d_stem_validates():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="NHWC"):
+        vision.resnet18_v1(classes=5, stem_s2d=True)  # NCHW default
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import (BasicBlockV1,
+                                                         ResNetV1)
+    with _pytest.raises(ValueError, match="thumbnail"):
+        ResNetV1(BasicBlockV1, [2, 2], [16, 16, 32], classes=5,
+                 thumbnail=True, layout="NHWC", stem_s2d=True)
+    net = vision.resnet18_v1(classes=5, layout="NHWC", stem_s2d=True)
+    net.initialize()
+    with _pytest.raises(ValueError, match="even"):
+        net(mx.nd.zeros((1, 223, 223, 3)))

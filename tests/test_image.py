@@ -231,3 +231,31 @@ def test_augmenter_semantics_matrix():
     out = seq(img).asnumpy()
     np.testing.assert_allclose(out, img_np[:, ::-1][y0:y0 + 8,
                                                     x0:x0 + 6])
+
+
+def test_native_pipeline_corrupt_jpeg_raises_cleanly(tmp_path):
+    """r4 fuzz tier: a corrupt JPEG payload in the NATIVE (C++ worker)
+    classification pipeline surfaces as a clear RuntimeError from the
+    worker's decode (pipeline.cc rc=-11), never a crash or hang."""
+    from mxnet_tpu.recordio import (IRHeader, MXIndexedRecordIO, pack,
+                                    pack_img, unpack)
+
+    p = str(tmp_path / "c.rec")
+    rec = MXIndexedRecordIO(str(tmp_path / "c.idx"), p, "w")
+    img = np.random.RandomState(0).randint(0, 255, (32, 32, 3), np.uint8)
+    for i in range(8):
+        if i == 5:
+            hdr = IRHeader(0, float(i), i, 0)
+            rec.write_idx(i, pack(hdr, b"\xff\xd8\xff" + b"junk" * 40))
+        else:
+            rec.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img,
+                                      quality=90))
+    rec.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=p, data_shape=(3, 32, 32),
+                               batch_size=4, preprocess_threads=1)
+    if it._pipe is None:
+        pytest.skip("native pipeline unavailable in this build")
+    with pytest.raises(RuntimeError):
+        for _ in range(4):  # drain past the corrupt record
+            next(it)
