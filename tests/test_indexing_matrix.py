@@ -1,0 +1,222 @@
+"""Indexing/gather/scatter oracle matrix vs numpy (r4 test-depth).
+
+The reference's unittest tier hammers these ops across axes, modes and
+dtypes (tests/python/unittest/test_operator.py test_take:4540,
+test_one_hot, test_gather_nd/scatter_nd); the existing suite here has
+single-case coverage (test_op_sweep) — this file is the enumerated
+matrix: every (op, axis/mode, dtype, shape) cell checks forward
+against a straight numpy computation, and take/Embedding check the
+gradient's scatter-accumulation semantics (duplicate indices must
+ADD).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+FLOAT_DTYPES = ["float32", "float16"]
+
+
+def _rand(shape, dtype, seed):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*shape) * 4 - 2).astype(dtype)
+
+
+# ------------------------------------------------------------ take
+
+
+@pytest.mark.parametrize("axis", [0, 1, 2, -1])
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_take_matrix(axis, mode, dtype):
+    data = _rand((4, 5, 6), dtype, 1)
+    # indices beyond range exercise the mode semantics
+    idx = np.array([[0, 2, -1], [5, 1, 7]], np.int32)
+    got = mx.nd.take(mx.nd.array(data, dtype=dtype),
+                     mx.nd.array(idx, dtype="int32"),
+                     axis=axis, mode=mode).asnumpy()
+    n = data.shape[axis]
+    if mode == "clip":
+        eff = np.clip(idx, 0, n - 1)
+    else:
+        eff = np.mod(idx, n)
+    want = np.take(data, eff, axis=axis)
+    np.testing.assert_allclose(got, want)
+
+
+def test_take_grad_accumulates_duplicates():
+    """d(data) scatter-ADDS over duplicate indices (reference:
+    take backward accumulation)."""
+    data = mx.nd.array(np.zeros((3, 2), np.float32))
+    data.attach_grad()
+    idx = mx.nd.array([1, 1, 1, 0], dtype="int32")
+    with mx.autograd.record():
+        out = mx.nd.take(data, idx, axis=0)
+    out.backward(mx.nd.ones((4, 2)))
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               [[1, 1], [3, 3], [0, 0]])
+
+
+# ------------------------------------------------------------ one_hot
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "int32"])
+@pytest.mark.parametrize("on_off", [(1.0, 0.0), (5.0, -1.0)])
+def test_one_hot_matrix(dtype, on_off):
+    on, off = on_off
+    idx = np.array([[0, 2], [3, 1], [2, 0]], np.int32)
+    got = mx.nd.one_hot(mx.nd.array(idx, dtype="int32"), depth=4,
+                        on_value=on, off_value=off,
+                        dtype=dtype).asnumpy()
+    want = np.full(idx.shape + (4,), off)
+    for pos in np.ndindex(idx.shape):
+        want[pos + (idx[pos],)] = on
+    np.testing.assert_allclose(got.astype(np.float64), want)
+    assert got.dtype == np.dtype(dtype)
+
+
+# ------------------------------------------------------------ gather_nd
+
+
+@pytest.mark.parametrize("index_ndim", [1, 2])
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_gather_nd_matrix(index_ndim, dtype):
+    data = _rand((4, 5, 6), dtype, 2)
+    rng = np.random.RandomState(3)
+    if index_ndim == 1:
+        idx = rng.randint(0, 4, (1, 7)).astype(np.int32)   # over dim 0
+        want = data[idx[0]]
+    else:
+        idx = np.stack([rng.randint(0, 4, 7),
+                        rng.randint(0, 5, 7)]).astype(np.int32)
+        want = data[idx[0], idx[1]]
+    got = mx.nd.gather_nd(mx.nd.array(data, dtype=dtype),
+                          mx.nd.array(idx, dtype="int32")).asnumpy()
+    np.testing.assert_allclose(got, want)
+
+
+# ------------------------------------------------------------ scatter_nd
+
+
+def test_scatter_nd_matrix():
+    vals = np.array([9.0, 8.0, 7.0], np.float32)
+    idx = np.array([[0, 2, 0], [1, 3, 4]], np.int32)
+    got = mx.nd.scatter_nd(mx.nd.array(vals),
+                           mx.nd.array(idx, dtype="int32"),
+                           shape=(3, 5)).asnumpy()
+    want = np.zeros((3, 5), np.float32)
+    for k in range(3):
+        want[idx[0, k], idx[1, k]] = vals[k]
+    np.testing.assert_allclose(got, want)
+
+
+# ------------------------------------------------------------ Embedding
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_embedding_forward_and_dup_grad(dtype):
+    table = _rand((6, 3), dtype, 4)
+    w = mx.nd.array(table, dtype=dtype)
+    w.attach_grad()
+    idx = mx.nd.array([[1, 1], [4, 0]], dtype="int32")
+    with mx.autograd.record():
+        out = mx.nd.Embedding(idx, w, input_dim=6, output_dim=3)
+        loss = out.sum()
+    np.testing.assert_allclose(out.asnumpy(),
+                               table[[[1, 1], [4, 0]]])
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert g[1].tolist() == [2, 2, 2]   # duplicate row accumulated
+    assert g[4].tolist() == [1, 1, 1] and g[5].tolist() == [0, 0, 0]
+
+
+# ------------------------------------------------------------ slice family
+
+
+@pytest.mark.parametrize("case", [
+    dict(begin=(1, None), end=(3, None), step=None,
+         ref=lambda a: a[1:3]),
+    dict(begin=(None, 1), end=(None, 4), step=(None, 2),
+         ref=lambda a: a[:, 1:4:2]),
+    dict(begin=(3, None), end=(0, None), step=(-1, None),
+         ref=lambda a: a[3:0:-1]),
+])
+def test_slice_matrix(case):
+    a = _rand((5, 6), "float32", 5)
+    kw = {"begin": case["begin"], "end": case["end"]}
+    if case["step"] is not None:
+        kw["step"] = case["step"]
+    got = mx.nd.slice(mx.nd.array(a), **kw).asnumpy()
+    np.testing.assert_allclose(got, case["ref"](a))
+
+
+@pytest.mark.parametrize("axis,begin,end", [(0, 1, 4), (1, 0, 3),
+                                            (-1, 2, None)])
+def test_slice_axis_matrix(axis, begin, end):
+    a = _rand((5, 6), "float32", 6)
+    got = mx.nd.slice_axis(mx.nd.array(a), axis=axis, begin=begin,
+                           end=end).asnumpy()
+    sl = [slice(None)] * 2
+    sl[axis] = slice(begin, end)
+    np.testing.assert_allclose(got, a[tuple(sl)])
+
+
+# ------------------------------------------------------------ sequence ops
+# single-case coverage lives in test_operator.py; this is the
+# enumerated (op x use_sequence_length x value) matrix vs numpy
+# (reference: test_operator.py test_sequence_mask/last/reverse)
+
+
+def _seq_data(T=4, B=3, D=2, seed=7):
+    return _rand((T, B, D), "float32", seed), np.array([2, 4, 1],
+                                                       np.float32)
+
+
+@pytest.mark.parametrize("use_len", [False, True])
+@pytest.mark.parametrize("value", [0.0, -1e9])
+def test_sequence_mask_matrix(use_len, value):
+    x, lens = _seq_data()
+    kw = dict(use_sequence_length=use_len, value=value)
+    args = [mx.nd.array(x)]
+    if use_len:
+        args.append(mx.nd.array(lens))
+    got = mx.nd.SequenceMask(*args, **kw).asnumpy()
+    want = x.copy()
+    if use_len:
+        for b, n in enumerate(lens.astype(int)):
+            want[n:, b] = value
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize("use_len", [False, True])
+def test_sequence_last_matrix(use_len):
+    x, lens = _seq_data(seed=8)
+    args = [mx.nd.array(x)]
+    if use_len:
+        args.append(mx.nd.array(lens))
+    got = mx.nd.SequenceLast(*args,
+                             use_sequence_length=use_len).asnumpy()
+    if use_len:
+        want = np.stack([x[int(n) - 1, b]
+                         for b, n in enumerate(lens)])
+    else:
+        want = x[-1]
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize("use_len", [False, True])
+def test_sequence_reverse_matrix(use_len):
+    x, lens = _seq_data(seed=9)
+    args = [mx.nd.array(x)]
+    if use_len:
+        args.append(mx.nd.array(lens))
+    got = mx.nd.SequenceReverse(*args,
+                                use_sequence_length=use_len).asnumpy()
+    want = x.copy()
+    if use_len:
+        for b, n in enumerate(lens.astype(int)):
+            want[:n, b] = x[:n, b][::-1]
+    else:
+        want = x[::-1]
+    np.testing.assert_allclose(got, want)
