@@ -1,0 +1,2032 @@
+"""Embedded-interpreter half of the tensor-runtime C ABI (mxtpu/c_api.h).
+
+`native/src/c_api_tensor.cc` is a logic-free transport: each extern
+formats its raw argument addresses into a call on this module, which
+performs ALL marshalling — reading C arrays, writing out-parameters,
+pinning returned storage — with ctypes.  The semantics are the Python
+package's own (NDArray, Symbol, Executor, KVStore, ...), so the C ABI
+and the Python API can never drift apart.
+
+Conventions (see the header for the consumer-facing contract):
+  * handles are uint64 ids into `_handles`; 0 is never valid;
+  * every entry point is no-raise: the @capi decorator reports errors
+    through the trailing (status, errbuf, errcap) out-parameters that
+    embed.cc appends to every call;
+  * pointers returned to C (strings, arrays, nested shape data) point
+    into per-thread pinned ctypes buffers kept alive for the next 256
+    ABI calls on that thread (reference analog: the per-thread
+    MXAPIThreadLocalEntry return store, invalidated by the next call).
+
+Reference: include/mxnet/c_api.h (196 functions), src/c_api/*.cc.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import ctypes
+import functools
+import threading
+import traceback
+
+_handles: dict[int, object] = {}
+_next_id = [1]
+
+_PIN_CAP = 256
+_tls = threading.local()
+
+
+# ------------------------------------------------------------- registry --
+_handle_lock = threading.Lock()
+
+
+def _new_handle(obj) -> int:
+    with _handle_lock:  # concurrent C threads must never share an id
+        hid = _next_id[0]
+        _next_id[0] += 1
+    _handles[hid] = obj
+    return hid
+
+
+def _obj(hid):
+    try:
+        return _handles[int(hid)]
+    except KeyError:
+        raise ValueError("invalid or freed MXTPUHandle %d" % hid) from None
+
+
+def _free_handle(hid):
+    _handles.pop(int(hid), None)
+
+
+# ------------------------------------------------------------ pin store --
+# One deque entry per ABI *call* (a list of that call's buffers), so the
+# documented "valid for 256 further ABI calls" contract holds no matter
+# how many buffers a single call pins (InferShape on a 400-arg net pins
+# one per shape).
+def _pin(buf):
+    group = getattr(_tls, "call_pins", None)
+    if group is not None:
+        group.append(buf)
+        return buf
+    store = getattr(_tls, "pins", None)
+    if store is None:
+        store = _tls.pins = collections.deque(maxlen=_PIN_CAP)
+    store.append([buf])
+    return buf
+
+
+def _pin_bytes(b: bytes) -> int:
+    buf = _pin(ctypes.create_string_buffer(b, len(b) + 1))
+    return ctypes.addressof(buf)
+
+
+def _pin_str(s: str) -> int:
+    return _pin_bytes(s.encode("utf-8"))
+
+
+def _pin_str_array(strs) -> int:
+    bufs = [ctypes.create_string_buffer(s.encode("utf-8")) for s in strs]
+    arr = (ctypes.c_char_p * max(1, len(strs)))()
+    for i, b in enumerate(bufs):
+        arr[i] = ctypes.cast(b, ctypes.c_char_p)
+    _pin(bufs)
+    _pin(arr)
+    return ctypes.addressof(arr)
+
+
+def _pin_array(ctype, vals) -> int:
+    arr = (ctype * max(1, len(vals)))(*vals)
+    _pin(arr)
+    return ctypes.addressof(arr)
+
+
+# ------------------------------------------------------- read/write raw --
+def _read_u32_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_uint32))
+    return [int(p[i]) for i in range(n)]
+
+
+def _read_i32_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_int32))
+    return [int(p[i]) for i in range(n)]
+
+
+def _read_i64_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_int64))
+    return [int(p[i]) for i in range(n)]
+
+
+def _read_u64_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_uint64))
+    return [int(p[i]) for i in range(n)]
+
+
+def _read_f32_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_float))
+    return [float(p[i]) for i in range(n)]
+
+
+def _read_str(addr):
+    return ctypes.string_at(int(addr)).decode("utf-8") if addr else None
+
+
+def _read_str_array(addr, n):
+    if not addr or not n:
+        return []
+    p = ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_char_p))
+    return [p[i].decode("utf-8") if p[i] is not None else None
+            for i in range(n)]
+
+
+def _write(ctype, addr, val):
+    if addr:
+        ctypes.cast(int(addr), ctypes.POINTER(ctype))[0] = val
+
+
+def _write_u64(addr, val):
+    _write(ctypes.c_uint64, addr, int(val))
+
+
+def _write_u32(addr, val):
+    _write(ctypes.c_uint32, addr, int(val))
+
+
+def _write_i32(addr, val):
+    _write(ctypes.c_int32, addr, int(val))
+
+
+def _read_i32(addr):
+    return int(ctypes.cast(int(addr), ctypes.POINTER(ctypes.c_int32))[0])
+
+
+# -------------------------------------------------------- capi decorator --
+def _status(status_addr, err_addr, err_cap, code, msg=""):
+    if err_addr and msg:
+        raw = msg.encode("utf-8", "replace")[: max(0, err_cap - 1)] + b"\0"
+        ctypes.memmove(int(err_addr), raw, len(raw))
+    ctypes.cast(int(status_addr),
+                ctypes.POINTER(ctypes.c_int64))[0] = code
+
+
+def capi(fn):
+    """No-raise wrapper: strip the trailing (status, errbuf, errcap)
+    appended by embed.cc, report exceptions through them."""
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        status_addr, err_addr, err_cap = args[-3:]
+        group = _tls.call_pins = []
+        try:
+            fn(*args[:-3])
+            _status(status_addr, err_addr, err_cap, 0)
+        except BaseException:
+            _status(status_addr, err_addr, err_cap, -1,
+                    traceback.format_exc())
+        finally:
+            _tls.call_pins = None
+            store = getattr(_tls, "pins", None)
+            if store is None:
+                store = _tls.pins = collections.deque(maxlen=_PIN_CAP)
+            if group:
+                store.append(group)
+
+    return wrapper
+
+
+# ------------------------------------------------------- value parsing  --
+def _parse_param(s):
+    """C params arrive as strings (reference convention); recover python
+    values: numbers, tuples, lists, booleans; bare words stay strings."""
+    if s is None:
+        return None
+    low = s.strip().lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _parse_params(num, keys_addr, vals_addr):
+    keys = _read_str_array(keys_addr, num)
+    vals = _read_str_array(vals_addr, num)
+    return {k: _parse_param(v) for k, v in zip(keys, vals)}
+
+
+def _ctx(dev_type, dev_id):
+    from . import context as _context
+
+    if dev_type == 2:
+        return _context.tpu(dev_id)
+    if dev_type == 3:
+        return _context.cpu_pinned(dev_id)
+    return _context.cpu(dev_id)
+
+
+def _dev_code(ctx):
+    return {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3}.get(
+        ctx.device_type, 1)
+
+
+def _np_dtype_of_code(code):
+    from .base import _DTYPE_MX_TO_NP
+
+    return _DTYPE_MX_TO_NP[int(code)]
+
+
+def _code_of_np_dtype(dt):
+    from .base import _DTYPE_NP_TO_MX, np_dtype
+
+    return _DTYPE_NP_TO_MX[np_dtype(dt)]
+
+
+class _EmptyND:
+    """Placeholder behind MXTPUNDArrayCreateNone until first write
+    (reference: an empty NDArray filled by imperative ops)."""
+
+
+def _write_into(hid, val):
+    """Write a result into a caller-provided NDArray handle, preserving
+    Python-object aliasing the way the Python package's x[:] = v does."""
+    dst = _handles[int(hid)]
+    if isinstance(dst, _EmptyND):
+        _handles[int(hid)] = val
+    else:
+        dst[:] = val
+
+
+def _nd_mod():
+    from . import ndarray
+
+    return ndarray
+
+
+# ================================================================== base --
+@capi
+def get_version(out_addr):
+    from . import __version__
+
+    parts = (__version__.split("+")[0].split(".") + ["0", "0"])[:3]
+    _write_i32(out_addr, int(parts[0]) * 10000 + int(parts[1]) * 100 +
+               int(parts[2]))
+
+
+@capi
+def random_seed(seed):
+    from . import random as _random
+
+    _random.seed(int(seed))
+
+
+@capi
+def random_seed_context(seed, dev_type, dev_id):
+    from . import random as _random
+
+    _random.seed(int(seed), ctx=_ctx(dev_type, dev_id))
+
+
+@capi
+def notify_shutdown():
+    _nd_mod().waitall()
+
+
+_omp_threads = [0]
+
+
+@capi
+def set_num_omp_threads(n):
+    # XLA owns device threading; record the host hint (reference:
+    # MXSetNumOMPThreads → omp_set_num_threads).
+    import os
+
+    _omp_threads[0] = int(n)
+    os.environ["OMP_NUM_THREADS"] = str(int(n))
+
+
+_bulk_size = [15]  # reference default MXNET_ENGINE_BULK_EXEC_MAX_NODE
+
+
+@capi
+def engine_set_bulk_size(size, prev_addr):
+    _write_i32(prev_addr, _bulk_size[0])
+    _bulk_size[0] = int(size)
+
+
+@capi
+def get_device_count(out_addr):
+    import jax
+
+    n = sum(1 for d in jax.devices() if d.platform != "cpu")
+    _write_i32(out_addr, n)
+
+
+@capi
+def get_device_memory_information(dev_id, free_addr, total_addr):
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    stats = {}
+    try:
+        stats = devs[int(dev_id)].memory_stats() or {}
+    except Exception:
+        pass
+    total = int(stats.get("bytes_limit", 0))
+    used = int(stats.get("bytes_in_use", 0))
+    _write(ctypes.c_uint64, free_addr, max(0, total - used))
+    _write(ctypes.c_uint64, total_addr, total)
+
+
+@capi
+def lib_info_features(out_names_addr, out_enabled_addr, out_size_addr):
+    from . import runtime
+
+    feats = runtime.feature_list()
+    _write_u64(out_names_addr, _pin_str_array([f.name for f in feats]))
+    _write_u64(out_enabled_addr,
+               _pin_array(ctypes.c_int32, [int(f.enabled) for f in feats]))
+    _write(ctypes.c_uint64, out_size_addr, len(feats))
+
+
+# =============================================================== ndarray --
+@capi
+def nd_create_none(out_addr):
+    _write_u64(out_addr, _new_handle(_EmptyND()))
+
+
+@capi
+def nd_create(shape_addr, ndim, dev_type, dev_id, delay_alloc, dtype,
+              out_addr):
+    del delay_alloc  # XLA/PJRT allocates lazily by construction
+    shape = tuple(_read_u32_array(shape_addr, ndim))
+    arr = _nd_mod().zeros(shape, ctx=_ctx(dev_type, dev_id),
+                          dtype=_np_dtype_of_code(dtype))
+    _write_u64(out_addr, _new_handle(arr))
+
+
+@capi
+def nd_free(hid):
+    _free_handle(hid)
+
+
+@capi
+def nd_get_shape(hid, out_ndim_addr, out_pdata_addr):
+    o = _obj(hid)
+    shape = () if isinstance(o, _EmptyND) else tuple(o.shape)
+    _write_u32(out_ndim_addr, len(shape))
+    _write_u64(out_pdata_addr, _pin_array(ctypes.c_uint32, list(shape)))
+
+
+@capi
+def nd_get_dtype(hid, out_addr):
+    o = _obj(hid)
+    if isinstance(o, _EmptyND):
+        _write_i32(out_addr, -1)
+    else:
+        _write_i32(out_addr, _code_of_np_dtype(o.dtype))
+
+
+@capi
+def nd_get_context(hid, out_dev_type_addr, out_dev_id_addr):
+    o = _obj(hid)
+    ctx = o.context
+    _write_i32(out_dev_type_addr, _dev_code(ctx))
+    _write_i32(out_dev_id_addr, ctx.device_id)
+
+
+@capi
+def nd_get_data(hid, out_addr):
+    import numpy as np
+
+    o = _obj(hid)
+    snap = _pin(np.ascontiguousarray(o.asnumpy()))
+    _write_u64(out_addr, snap.ctypes.data)
+
+
+@capi
+def nd_sync_copy_from_cpu(hid, data_addr, size):
+    import numpy as np
+
+    o = _obj(hid)
+    if isinstance(o, _EmptyND):
+        raise ValueError("SyncCopyFromCPU: array has no shape yet "
+                         "(created with CreateNone)")
+    dt = np.dtype(o.dtype)
+    n = int(size)
+    if n != int(np.prod(o.shape, dtype=np.int64)):
+        raise ValueError("SyncCopyFromCPU: size %d != array elements %d"
+                         % (n, int(np.prod(o.shape, dtype=np.int64))))
+    raw = ctypes.string_at(int(data_addr), n * dt.itemsize)
+    vals = np.frombuffer(raw, dtype=dt).reshape(o.shape)
+    o[:] = vals
+
+
+@capi
+def nd_sync_copy_to_cpu(hid, data_addr, size):
+    import numpy as np
+
+    o = _obj(hid)
+    vals = np.ascontiguousarray(o.asnumpy())
+    n = int(size)
+    if n != vals.size:
+        raise ValueError("SyncCopyToCPU: size %d != array elements %d"
+                         % (n, vals.size))
+    ctypes.memmove(int(data_addr), vals.ctypes.data, vals.nbytes)
+
+
+@capi
+def nd_sync_copy_from_ndarray(dst_hid, src_hid, i):
+    src = _obj(src_hid)
+    if int(i) >= 0:
+        src = _aux_ndarray(src, int(i))
+    _write_into(dst_hid, src)
+
+
+@capi
+def nd_slice(hid, begin, end, out_addr):
+    o = _obj(hid)
+    _write_u64(out_addr, _new_handle(o[int(begin):int(end)]))
+
+
+@capi
+def nd_at(hid, idx, out_addr):
+    o = _obj(hid)
+    _write_u64(out_addr, _new_handle(o[int(idx)]))
+
+
+@capi
+def nd_reshape(hid, ndim, dims_addr, reverse, out_addr):
+    o = _obj(hid)
+    dims = tuple(_read_i32_array(dims_addr, ndim))
+    out = (o.reshape(dims, reverse=True) if reverse
+           else o.reshape(dims))
+    _write_u64(out_addr, _new_handle(out))
+
+
+@capi
+def nd_reshape64(hid, ndim, dims_addr, reverse, out_addr):
+    o = _obj(hid)
+    dims = tuple(_read_i64_array(dims_addr, ndim))
+    out = (o.reshape(dims, reverse=True) if reverse
+           else o.reshape(dims))
+    _write_u64(out_addr, _new_handle(out))
+
+
+@capi
+def nd_detach(hid, out_addr):
+    _write_u64(out_addr, _new_handle(_obj(hid).detach()))
+
+
+@capi
+def nd_set_grad_state(hid, state):
+    # "fresh gradient" marker (reference: NDArray::set_fresh_out_grad)
+    _obj(hid)._fresh_grad = bool(state)
+
+
+@capi
+def nd_get_grad_state(hid, out_addr):
+    _write_i32(out_addr, int(getattr(_obj(hid), "_fresh_grad", False)))
+
+
+@capi
+def nd_get_grad(hid, out_addr):
+    g = getattr(_obj(hid), "grad", None)
+    _write_u64(out_addr, _new_handle(g) if g is not None else 0)
+
+
+@capi
+def nd_wait_to_read(hid):
+    _obj(hid).wait_to_read()
+
+
+@capi
+def nd_wait_to_write(hid):
+    _obj(hid).wait_to_read()
+
+
+@capi
+def nd_wait_all():
+    _nd_mod().waitall()
+
+
+@capi
+def nd_save(fname_addr, num, args_addr, keys_addr):
+    handles = _read_u64_array(args_addr, num)
+    arrs = [_obj(h) for h in handles]
+    keys = _read_str_array(keys_addr, num) if keys_addr else None
+    data = dict(zip(keys, arrs)) if keys else arrs
+    _nd_mod().save(_read_str(fname_addr), data)
+
+
+def _return_loaded(loaded, out_size_addr, out_arr_addr, out_name_size_addr,
+                   out_names_addr):
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        arrs = [loaded[k] for k in names]
+    else:
+        names = []
+        arrs = list(loaded)
+    hids = [_new_handle(a) for a in arrs]
+    _write_u32(out_size_addr, len(hids))
+    _write_u64(out_arr_addr, _pin_array(ctypes.c_uint64, hids))
+    _write_u32(out_name_size_addr, len(names))
+    _write_u64(out_names_addr, _pin_str_array(names))
+
+
+@capi
+def nd_load(fname_addr, out_size_addr, out_arr_addr, out_name_size_addr,
+            out_names_addr):
+    _return_loaded(_nd_mod().load(_read_str(fname_addr)), out_size_addr,
+                   out_arr_addr, out_name_size_addr, out_names_addr)
+
+
+@capi
+def nd_load_from_buffer(buf_addr, size, out_size_addr, out_arr_addr,
+                        out_name_size_addr, out_names_addr):
+    buf = ctypes.string_at(int(buf_addr), int(size))
+    _return_loaded(_nd_mod().load_frombuffer(buf), out_size_addr,
+                   out_arr_addr, out_name_size_addr, out_names_addr)
+
+
+@capi
+def nd_save_raw_bytes(hid, out_size_addr, out_buf_addr):
+    # Single-array serialization reuses the container format with one
+    # positional entry (this framework's raw-bytes format; the
+    # reference's is likewise its own binary layout).
+    import io as _io
+
+    import numpy as np
+
+    o = _obj(hid)
+    bio = _io.BytesIO()
+    np.savez(bio, data=o.asnumpy())
+    raw = bio.getvalue()
+    _write(ctypes.c_uint64, out_size_addr, len(raw))
+    _write_u64(out_buf_addr, _pin_bytes(raw))
+
+
+@capi
+def nd_load_from_raw_bytes(buf_addr, size, out_addr):
+    import io as _io
+
+    import numpy as np
+
+    raw = ctypes.string_at(int(buf_addr), int(size))
+    with np.load(_io.BytesIO(raw)) as z:
+        arr = _nd_mod().array(z["data"])
+    _write_u64(out_addr, _new_handle(arr))
+
+
+_STYPE_CODES = {"default": 0, "row_sparse": 1, "csr": 2}
+
+
+@capi
+def nd_get_storage_type(hid, out_addr):
+    o = _obj(hid)
+    st = getattr(o, "stype", "default")
+    _write_i32(out_addr, _STYPE_CODES.get(st, 0))
+
+
+@capi
+def nd_create_sparse(storage_type, shape_addr, ndim, dev_type, dev_id,
+                     delay_alloc, dtype, num_aux, aux_type_addr,
+                     aux_ndims_addr, aux_shape_addr, out_addr):
+    del delay_alloc, num_aux, aux_type_addr, aux_ndims_addr, aux_shape_addr
+    from .ndarray import sparse as _sparse
+
+    shape = tuple(_read_u32_array(shape_addr, ndim))
+    stype = {1: "row_sparse", 2: "csr"}.get(int(storage_type))
+    if stype is None:
+        raise ValueError("CreateSparseEx: storage_type %d is not sparse"
+                         % storage_type)
+    arr = _sparse.zeros(stype, shape, ctx=_ctx(dev_type, dev_id),
+                        dtype=_np_dtype_of_code(dtype))
+    _write_u64(out_addr, _new_handle(arr))
+
+
+def _aux_ndarray(o, i):
+    st = getattr(o, "stype", "default")
+    if st == "row_sparse":
+        order = [o.indices]
+    elif st == "csr":
+        order = [o.indptr, o.indices]
+    else:
+        raise ValueError("dense NDArray has no aux array %d" % i)
+    return order[i]
+
+
+@capi
+def nd_get_aux_type(hid, i, out_addr):
+    aux = _aux_ndarray(_obj(hid), int(i))
+    _write_i32(out_addr, _code_of_np_dtype(aux.dtype))
+
+
+@capi
+def nd_get_aux_ndarray(hid, i, out_addr):
+    _write_u64(out_addr, _new_handle(_aux_ndarray(_obj(hid), int(i))))
+
+
+@capi
+def nd_get_data_ndarray(hid, out_addr):
+    o = _obj(hid)
+    if getattr(o, "stype", "default") == "default":
+        raise ValueError("GetDataNDArray: dense NDArray has no data aux")
+    _write_u64(out_addr, _new_handle(o.data))
+
+
+@capi
+def nd_sync_check_format(hid, full_check):
+    o = _obj(hid)
+    fn = getattr(o, "check_format", None)
+    if fn is not None:
+        fn(full_check=bool(full_check))
+
+
+# DLPack structs (dlpack/dlpack.h v0.x ABI, as the reference exports)
+class _DLDevice(ctypes.Structure):
+    _fields_ = [("device_type", ctypes.c_int32),
+                ("device_id", ctypes.c_int32)]
+
+
+class _DLDataType(ctypes.Structure):
+    _fields_ = [("code", ctypes.c_uint8), ("bits", ctypes.c_uint8),
+                ("lanes", ctypes.c_uint16)]
+
+
+class _DLTensor(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p), ("device", _DLDevice),
+                ("ndim", ctypes.c_int32), ("dtype", _DLDataType),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("strides", ctypes.POINTER(ctypes.c_int64)),
+                ("byte_offset", ctypes.c_uint64)]
+
+
+class _DLManagedTensor(ctypes.Structure):
+    pass
+
+
+_DLDeleterFn = ctypes.CFUNCTYPE(None, ctypes.POINTER(_DLManagedTensor))
+_DLManagedTensor._fields_ = [("dl_tensor", _DLTensor),
+                             ("manager_ctx", ctypes.c_void_p),
+                             ("deleter", _DLDeleterFn)]
+
+_dlpack_exports: dict[int, tuple] = {}
+
+
+def _dl_deleter(mt_ptr):
+    _dlpack_exports.pop(ctypes.addressof(mt_ptr.contents), None)
+
+
+_dl_deleter_c = _DLDeleterFn(_dl_deleter)
+
+_DL_CODE_OF_KIND = {"i": 0, "u": 1, "f": 2, "b": 1}
+
+
+@capi
+def nd_to_dlpack(hid, out_addr):
+    import numpy as np
+
+    o = _obj(hid)
+    snap = np.ascontiguousarray(o.asnumpy())
+    dt = snap.dtype
+    shape_arr = (ctypes.c_int64 * max(1, snap.ndim))(*snap.shape)
+    mt = _DLManagedTensor()
+    mt.dl_tensor.data = snap.ctypes.data
+    mt.dl_tensor.device = _DLDevice(1, 0)  # kDLCPU (host snapshot)
+    mt.dl_tensor.ndim = snap.ndim
+    mt.dl_tensor.dtype = _DLDataType(_DL_CODE_OF_KIND[dt.kind],
+                                     dt.itemsize * 8, 1)
+    mt.dl_tensor.shape = shape_arr
+    mt.dl_tensor.strides = None
+    mt.dl_tensor.byte_offset = 0
+    mt.manager_ctx = None
+    mt.deleter = _dl_deleter_c
+    addr = ctypes.addressof(mt)
+    _dlpack_exports[addr] = (mt, snap, shape_arr)  # keep alive until deleter
+    _write_u64(out_addr, addr)
+
+
+@capi
+def nd_from_dlpack(mt_addr, out_addr):
+    import numpy as np
+
+    mt = ctypes.cast(int(mt_addr),
+                     ctypes.POINTER(_DLManagedTensor)).contents
+    t = mt.dl_tensor
+    if t.device.device_type not in (1, 3):  # kDLCPU / kDLCPUPinned
+        raise ValueError("FromDLPack: only host DLTensors are supported")
+    shape = [t.shape[i] for i in range(t.ndim)]
+    kind = {0: "i", 1: "u", 2: "f", 4: "V"}.get(t.dtype.code)
+    if kind is None or t.dtype.lanes != 1:
+        raise ValueError("FromDLPack: unsupported dtype code %d/lanes %d"
+                         % (t.dtype.code, t.dtype.lanes))
+    dt = np.dtype("%s%d" % (kind, t.dtype.bits // 8))
+    if t.strides:
+        strides = [t.strides[i] * dt.itemsize for i in range(t.ndim)]
+    else:
+        strides = None
+    if strides:
+        # a strided view can span a larger parent buffer: copy the full
+        # extent [0, sum((dim-1)*stride) + itemsize) before re-striding
+        extent = dt.itemsize + sum((d - 1) * st
+                                   for d, st in zip(shape, strides) if d > 0)
+        raw = ctypes.string_at(t.data + t.byte_offset, max(1, extent))
+        # gather element bytes through a byte-level strided view (the
+        # copied extent may be misaligned for dt at stride boundaries)
+        vals = np.lib.stride_tricks.as_strided(
+            np.frombuffer(raw, dtype=np.uint8),
+            shape=tuple(shape) + (dt.itemsize,),
+            strides=tuple(strides) + (1,)).copy().view(dt).reshape(shape)
+    else:
+        n_bytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        raw = ctypes.string_at(t.data + t.byte_offset, max(1, n_bytes))
+        vals = np.frombuffer(raw, dtype=dt).reshape(shape)
+    arr = _nd_mod().array(vals)
+    if mt.deleter:
+        mt.deleter(ctypes.cast(int(mt_addr),
+                               ctypes.POINTER(_DLManagedTensor)))
+    _write_u64(out_addr, _new_handle(arr))
+
+
+@capi
+def nd_call_dlpack_deleter(mt_addr):
+    mt = ctypes.cast(int(mt_addr),
+                     ctypes.POINTER(_DLManagedTensor)).contents
+    if mt.deleter:
+        mt.deleter(ctypes.cast(int(mt_addr),
+                               ctypes.POINTER(_DLManagedTensor)))
+
+
+_shm_exports: dict[int, object] = {}
+_shm_next = [1]
+
+
+def _shm_cleanup():
+    for shm in _shm_exports.values():
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+    _shm_exports.clear()
+
+
+import atexit  # noqa: E402  (co-located with the registry it empties)
+
+atexit.register(_shm_cleanup)
+
+
+@capi
+def nd_get_shared_mem_handle(hid, pid_addr, id_addr):
+    # Copy-out into POSIX shm (reference shares the buffer zero-copy;
+    # PJRT owns ours, so the shared segment is a synced snapshot).
+    import os
+    from multiprocessing import shared_memory
+
+    import numpy as np
+
+    o = _obj(hid)
+    snap = np.ascontiguousarray(o.asnumpy())
+    sid = _shm_next[0]
+    _shm_next[0] += 1
+    shm = shared_memory.SharedMemory(
+        name="mxtpu_%d_%d" % (os.getpid(), sid), create=True,
+        size=max(1, snap.nbytes))
+    shm.buf[: snap.nbytes] = snap.tobytes()
+    _shm_exports[sid] = shm  # keep mapped; freed at process exit
+    _write_i32(pid_addr, os.getpid())
+    _write_i32(id_addr, sid)
+
+
+@capi
+def nd_create_from_shared_mem(shared_pid, shared_id, shape_addr, ndim, dtype,
+                              out_addr):
+    from multiprocessing import shared_memory
+
+    import numpy as np
+
+    shape = tuple(_read_u32_array(shape_addr, ndim))
+    dt = np.dtype(_np_dtype_of_code(dtype))
+    shm = shared_memory.SharedMemory(
+        name="mxtpu_%d_%d" % (int(shared_pid), int(shared_id)))
+    try:
+        n = int(np.prod(shape, dtype=np.int64))
+        vals = np.frombuffer(shm.buf, dtype=dt, count=n).reshape(shape).copy()
+    finally:
+        shm.close()
+    _write_u64(out_addr, _new_handle(_nd_mod().array(vals)))
+
+
+# ================================================== ops & imperative call --
+def _registry():
+    from .ops import registry
+
+    return registry
+
+
+@capi
+def list_all_op_names(out_size_addr, out_array_addr):
+    names = sorted(_registry().list_ops())
+    _write_u32(out_size_addr, len(names))
+    _write_u64(out_array_addr, _pin_str_array(names))
+
+
+_op_handles: dict[str, int] = {}
+
+
+def _op_handle(name):
+    if name not in _op_handles:
+        _op_handles[name] = _new_handle(_registry().get(name))
+    return _op_handles[name]
+
+
+@capi
+def get_op_handle(name_addr, out_addr):
+    name = _read_str(name_addr)
+    _registry().get(name)  # raises for unknown ops
+    _write_u64(out_addr, _op_handle(name))
+
+
+@capi
+def list_functions(out_size_addr, out_array_addr):
+    names = sorted(_registry().list_ops())
+    hids = [_op_handle(n) for n in names]
+    _write_u32(out_size_addr, len(hids))
+    _write_u64(out_array_addr, _pin_array(ctypes.c_uint64, hids))
+
+
+def _op_info(op):
+    name = op.name
+    doc = (getattr(op.fn, "__doc__", None) or "").strip()
+    desc = doc.split("\n")[0] if doc else ""
+    args = list(getattr(op, "defaults", {}) or {})
+    types = []
+    for k in args:
+        d = op.defaults[k]
+        types.append("required" if d is None else "optional, default=%r" % (d,))
+    descs = ["" for _ in args]
+    return name, desc, args, types, descs
+
+
+@capi
+def get_op_info(op_hid, name_addr, desc_addr, num_args_addr, arg_names_addr,
+                arg_types_addr, arg_descs_addr, return_type_addr):
+    name, desc, args, types, descs = _op_info(_obj(op_hid))
+    _write_u64(name_addr, _pin_str(name))
+    _write_u64(desc_addr, _pin_str(desc))
+    _write_u32(num_args_addr, len(args))
+    _write_u64(arg_names_addr, _pin_str_array(args))
+    _write_u64(arg_types_addr, _pin_str_array(types))
+    _write_u64(arg_descs_addr, _pin_str_array(descs))
+    _write_u64(return_type_addr, _pin_str("NDArray-or-Symbol"))
+
+
+def _invoke_op(op, inputs, attrs):
+    """Invoke through the nd-level registered function when it exists
+    (keeps autograd recording identical to Python users), falling back
+    to the raw registry."""
+    nd = _nd_mod()
+    fn = getattr(nd, op.name, None)
+    if fn is None and op.name.startswith("_"):
+        fn = getattr(nd, op.name.lstrip("_"), None)
+    if fn is not None and callable(fn):
+        res = fn(*inputs, **attrs)
+    else:
+        res = _registry().apply_op(op.name, *inputs, **attrs)
+    return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+@capi
+def imperative_invoke(op_hid, num_inputs, inputs_addr, num_outputs_addr,
+                      outputs_addr, num_params, keys_addr, vals_addr):
+    op = _obj(op_hid)
+    inputs = [_obj(h) for h in _read_u64_array(inputs_addr, num_inputs)]
+    attrs = _parse_params(num_params, keys_addr, vals_addr)
+    attrs.pop("name", None)  # graph-name hint, meaningless imperatively
+    outs = _invoke_op(op, inputs, attrs)
+    n_req = _read_i32(num_outputs_addr)
+    if n_req == 0 or not outputs_addr:
+        hids = [_new_handle(o) for o in outs]
+        _write_i32(num_outputs_addr, len(hids))
+        _write_u64(outputs_addr, _pin_array(ctypes.c_uint64, hids))
+    else:
+        if n_req != len(outs):
+            raise ValueError("ImperativeInvoke: op %s produced %d outputs, "
+                             "caller provided %d" % (op.name, len(outs),
+                                                     n_req))
+        dst_arr_addr = int(
+            ctypes.cast(int(outputs_addr),
+                        ctypes.POINTER(ctypes.c_uint64))[0])
+        dst = _read_u64_array(dst_arr_addr, n_req)
+        for h, o in zip(dst, outs):
+            _write_into(h, o)
+
+
+@capi
+def func_invoke(op_hid, use_addr, scalar_addr, mutate_addr, num_use,
+                num_scalar, num_mutate, num_params, keys_addr, vals_addr):
+    op = _obj(op_hid)
+    inputs = [_obj(h) for h in _read_u64_array(use_addr, num_use)]
+    attrs = _parse_params(num_params, keys_addr, vals_addr)
+    scalars = _read_f32_array(scalar_addr, num_scalar)
+    if scalars:
+        takes_scalar = ("scalar" in (op.defaults or {}) or
+                        "scalar" in (getattr(op, "traced_attrs", ()) or ()))
+        if len(scalars) == 1 and takes_scalar:
+            attrs.setdefault("scalar", scalars[0])
+        else:
+            raise ValueError("FuncInvoke: op %s does not take %d scalar "
+                             "args" % (op.name, len(scalars)))
+    outs = _invoke_op(op, inputs, attrs)
+    muts = _read_u64_array(mutate_addr, num_mutate)
+    if len(muts) != len(outs):
+        raise ValueError("FuncInvoke: op %s produced %d outputs, caller "
+                         "provided %d mutate vars" % (op.name, len(outs),
+                                                      len(muts)))
+    for h, o in zip(muts, outs):
+        _write_into(h, o)
+
+
+# =============================================================== autograd --
+def _autograd():
+    from . import autograd
+
+    return autograd
+
+
+@capi
+def autograd_set_is_recording(flag, prev_addr):
+    ag = _autograd()
+    _write_i32(prev_addr, int(ag.is_recording()))
+    ag.set_recording(bool(flag))
+
+
+@capi
+def autograd_set_is_training(flag, prev_addr):
+    ag = _autograd()
+    _write_i32(prev_addr, int(ag.is_training()))
+    ag.set_training(bool(flag))
+
+
+@capi
+def autograd_is_recording(out_addr):
+    _write_i32(out_addr, int(_autograd().is_recording()))
+
+
+@capi
+def autograd_is_training(out_addr):
+    _write_i32(out_addr, int(_autograd().is_training()))
+
+
+_GRAD_REQ_NAMES = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+@capi
+def autograd_mark_variables(num, var_addr, reqs_addr, grad_addr):
+    variables = [_obj(h) for h in _read_u64_array(var_addr, num)]
+    grads = [_obj(h) for h in _read_u64_array(grad_addr, num)]
+    reqs = [_GRAD_REQ_NAMES[c] for c in _read_u32_array(reqs_addr, num)]
+    _autograd().mark_variables(variables, grads, reqs)
+
+
+@capi
+def autograd_backward(num_output, outputs_addr, ograds_addr, num_variables,
+                      vars_addr, retain_graph, create_graph, is_train,
+                      grad_handles_addr, grad_stypes_addr):
+    ag = _autograd()
+    heads = [_obj(h) for h in _read_u64_array(outputs_addr, num_output)]
+    ograd_ids = _read_u64_array(ograds_addr, num_output)
+    ograds = ([None if h == 0 else _obj(h) for h in ograd_ids]
+              if ograd_ids else None)
+    if num_variables:
+        variables = [_obj(h) for h in _read_u64_array(vars_addr,
+                                                      num_variables)]
+        grads = ag.grad(heads, variables, head_grads=ograds,
+                        retain_graph=bool(retain_graph),
+                        create_graph=bool(create_graph),
+                        train_mode=bool(is_train))
+        hids = [_new_handle(g) for g in grads]
+        _write_u64(grad_handles_addr, _pin_array(ctypes.c_uint64, hids))
+        _write_u64(grad_stypes_addr,
+                   _pin_array(ctypes.c_int32, [0] * len(hids)))
+    else:
+        ag.backward(heads, head_grads=ograds,
+                    retain_graph=bool(retain_graph),
+                    train_mode=bool(is_train))
+
+
+@capi
+def autograd_get_symbol(hid, out_addr):
+    del hid, out_addr
+    # The tape records vjp closures, not named graph nodes; recover the
+    # graph through the symbolic executor instead (PARITY.md §C-ABI).
+    raise NotImplementedError(
+        "AutogradGetSymbol: the jax tape does not retain a symbolic "
+        "graph; build the graph with the Symbol API (or hybridize and "
+        "export) to obtain one")
+
+
+# ================================================================= symbol --
+def _sym_mod():
+    from . import symbol
+
+    return symbol
+
+
+class _AtomicSymbol:
+    """Uncomposed op symbol: CreateAtomicSymbol output, becomes a real
+    Symbol when Compose provides its inputs (reference two-phase
+    protocol: MXSymbolCreateAtomicSymbol then MXSymbolCompose)."""
+
+    def __init__(self, op, attrs):
+        self.op = op
+        self.attrs = attrs
+
+
+@capi
+def sym_get_atomic_symbol_name(creator_hid, name_addr):
+    _write_u64(name_addr, _pin_str(_obj(creator_hid).name))
+
+
+@capi
+def sym_get_atomic_symbol_info(creator_hid, name_addr, desc_addr,
+                               num_args_addr, arg_names_addr, arg_types_addr,
+                               arg_descs_addr, key_var_num_args_addr,
+                               return_type_addr):
+    name, desc, args, types, descs = _op_info(_obj(creator_hid))
+    _write_u64(name_addr, _pin_str(name))
+    _write_u64(desc_addr, _pin_str(desc))
+    _write_u32(num_args_addr, len(args))
+    _write_u64(arg_names_addr, _pin_str_array(args))
+    _write_u64(arg_types_addr, _pin_str_array(types))
+    _write_u64(arg_descs_addr, _pin_str_array(descs))
+    _write_u64(key_var_num_args_addr, _pin_str(""))
+    _write_u64(return_type_addr, _pin_str("NDArray-or-Symbol"))
+
+
+@capi
+def sym_create_atomic_symbol(creator_hid, num_param, keys_addr, vals_addr,
+                             out_addr):
+    op = _obj(creator_hid)
+    attrs = _parse_params(num_param, keys_addr, vals_addr)
+    _write_u64(out_addr, _new_handle(_AtomicSymbol(op, attrs)))
+
+
+@capi
+def sym_create_variable(name_addr, out_addr):
+    v = _sym_mod().Variable(_read_str(name_addr))
+    _write_u64(out_addr, _new_handle(v))
+
+
+@capi
+def sym_create_group(num, symbols_addr, out_addr):
+    syms = [_obj(h) for h in _read_u64_array(symbols_addr, num)]
+    _write_u64(out_addr, _new_handle(_sym_mod().Group(syms)))
+
+
+@capi
+def sym_create_from_file(fname_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_sym_mod().load(_read_str(fname_addr))))
+
+
+@capi
+def sym_create_from_json(json_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_sym_mod().load_json(_read_str(json_addr))))
+
+
+@capi
+def sym_save_to_file(hid, fname_addr):
+    _obj(hid).save(_read_str(fname_addr))
+
+
+@capi
+def sym_save_to_json(hid, out_addr):
+    _write_u64(out_addr, _pin_str(_obj(hid).tojson()))
+
+
+@capi
+def sym_free(hid):
+    _free_handle(hid)
+
+
+@capi
+def sym_copy(hid, out_addr):
+    import copy
+
+    _write_u64(out_addr, _new_handle(copy.deepcopy(_obj(hid))))
+
+
+@capi
+def sym_print(hid, out_addr):
+    _write_u64(out_addr, _pin_str(repr(_obj(hid))))
+
+
+@capi
+def sym_get_name(hid, out_addr, success_addr):
+    name = _obj(hid).name
+    if name is None:
+        _write_i32(success_addr, 0)
+    else:
+        _write_u64(out_addr, _pin_str(name))
+        _write_i32(success_addr, 1)
+
+
+@capi
+def sym_get_attr(hid, key_addr, out_addr, success_addr):
+    val = _obj(hid).attr(_read_str(key_addr))
+    if val is None:
+        _write_i32(success_addr, 0)
+    else:
+        _write_u64(out_addr, _pin_str(str(val)))
+        _write_i32(success_addr, 1)
+
+
+@capi
+def sym_set_attr(hid, key_addr, val_addr):
+    _obj(hid)._set_attr(**{_read_str(key_addr): _read_str(val_addr)})
+
+
+@capi
+def sym_list_attr(hid, shallow, out_size_addr, out_addr):
+    s = _obj(hid)
+    if shallow:
+        attrs = dict(s.list_attr())
+    else:
+        # deep walk: node-name-prefixed "node$key" pairs (reference
+        # MXSymbolListAttr recursive format)
+        attrs = {}
+        for node, node_attrs in s.attr_dict().items():
+            for k, v in node_attrs.items():
+                attrs["%s$%s" % (node, k)] = v
+    flat = []
+    for k in sorted(attrs):
+        flat += [k, str(attrs[k])]
+    _write_u32(out_size_addr, len(flat) // 2)
+    _write_u64(out_addr, _pin_str_array(flat))
+
+
+def _write_str_list(strs, out_size_addr, out_addr):
+    _write_u32(out_size_addr, len(strs))
+    _write_u64(out_addr, _pin_str_array(strs))
+
+
+@capi
+def sym_list_arguments(hid, out_size_addr, out_addr):
+    _write_str_list(_obj(hid).list_arguments(), out_size_addr, out_addr)
+
+
+@capi
+def sym_list_outputs(hid, out_size_addr, out_addr):
+    _write_str_list(_obj(hid).list_outputs(), out_size_addr, out_addr)
+
+
+@capi
+def sym_list_auxiliary_states(hid, out_size_addr, out_addr):
+    _write_str_list(_obj(hid).list_auxiliary_states(), out_size_addr,
+                    out_addr)
+
+
+@capi
+def sym_get_num_outputs(hid, out_addr):
+    _write_u32(out_addr, len(_obj(hid).list_outputs()))
+
+
+@capi
+def sym_get_internals(hid, out_addr):
+    _write_u64(out_addr, _new_handle(_obj(hid).get_internals()))
+
+
+@capi
+def sym_get_children(hid, out_addr):
+    c = _obj(hid).get_children()
+    _write_u64(out_addr, _new_handle(c) if c is not None else 0)
+
+
+@capi
+def sym_get_output(hid, index, out_addr):
+    _write_u64(out_addr, _new_handle(_obj(hid)[int(index)]))
+
+
+@capi
+def sym_get_input_symbols(hid, out_handles_addr, out_size_addr):
+    s = _obj(hid)
+    names = s.list_inputs()
+    hids = [_new_handle(_sym_mod().Variable(n)) for n in names]
+    _write_u64(out_handles_addr, _pin_array(ctypes.c_uint64, hids))
+    _write_u32(out_size_addr, len(hids))
+
+
+@capi
+def sym_compose(hid, name_addr, num_args, keys_addr, args_addr):
+    target = _handles[int(hid)]
+    name = _read_str(name_addr)
+    keys = _read_str_array(keys_addr, num_args) if keys_addr else None
+    args = [_obj(h) for h in _read_u64_array(args_addr, num_args)]
+    if isinstance(target, _AtomicSymbol):
+        fn = getattr(_sym_mod(), target.op.name, None)
+        if fn is None and target.op.name.startswith("_"):
+            fn = getattr(_sym_mod(), target.op.name.lstrip("_"), None)
+        if fn is None:
+            raise ValueError("Compose: op %s has no symbol constructor"
+                             % target.op.name)
+        kwargs = dict(target.attrs)
+        if name:
+            kwargs["name"] = name
+        if keys:
+            kwargs.update(dict(zip(keys, args)))
+            composed = fn(**kwargs)
+        else:
+            composed = fn(*args, **kwargs)
+        _handles[int(hid)] = composed  # compose mutates, per reference
+    else:
+        # _compose is pure input substitution; node names were fixed at
+        # creation, so the name arg only applies to the atomic path.
+        if keys:
+            target._compose(**dict(zip(keys, args)))
+        else:
+            target._compose(*args)
+
+
+def _provided_shapes(num_args, keys_addr, ind_ptr_addr, shape_data_addr,
+                     arg_names):
+    ind = _read_u32_array(ind_ptr_addr, num_args + 1)
+    flat = _read_u32_array(shape_data_addr, ind[-1] if ind else 0)
+    shapes = [tuple(flat[ind[i]:ind[i + 1]]) for i in range(num_args)]
+    if keys_addr:
+        keys = _read_str_array(keys_addr, num_args)
+        return dict(zip(keys, shapes))
+    return dict(zip(arg_names, shapes))
+
+
+def _pin_shape_group(shapes):
+    """Pin one (size, ndim[], data[][]) triple for InferShape results."""
+    shapes = [tuple(s) if s is not None else () for s in shapes]
+    ndims = [len(s) for s in shapes]
+    dim_addrs = [_pin_array(ctypes.c_uint32, list(s)) for s in shapes]
+    data = _pin_array(ctypes.c_uint64, dim_addrs)
+    return len(shapes), _pin_array(ctypes.c_uint32, ndims), data
+
+
+@capi
+def sym_infer_shape(hid, partial, num_args, keys_addr, ind_ptr_addr,
+                    shape_data_addr, in_size_addr, in_ndim_addr, in_data_addr,
+                    out_size_addr, out_ndim_addr, out_data_addr,
+                    aux_size_addr, aux_ndim_addr, aux_data_addr,
+                    complete_addr):
+    s = _obj(hid)
+    kwargs = _provided_shapes(num_args, keys_addr, ind_ptr_addr,
+                              shape_data_addr, s.list_arguments())
+    kwargs = {k: v for k, v in kwargs.items() if v}
+    if partial:
+        arg_shapes, out_shapes, aux_shapes = s.infer_shape_partial(**kwargs)
+    else:
+        arg_shapes, out_shapes, aux_shapes = s.infer_shape(**kwargs)
+    groups = []
+    for shapes, size_a, ndim_a, data_a in (
+            (arg_shapes, in_size_addr, in_ndim_addr, in_data_addr),
+            (out_shapes, out_size_addr, out_ndim_addr, out_data_addr),
+            (aux_shapes, aux_size_addr, aux_ndim_addr, aux_data_addr)):
+        shapes = shapes or []
+        n, ndim_ptr, data_ptr = _pin_shape_group(shapes)
+        _write_u32(size_a, n)
+        _write_u64(ndim_a, ndim_ptr)
+        _write_u64(data_a, data_ptr)
+        groups.append(shapes)
+    complete = all(s is not None and all(d > 0 for d in s)
+                   for grp in groups for s in grp)
+    _write_i32(complete_addr, int(complete))
+
+
+@capi
+def sym_infer_type(hid, num_args, keys_addr, types_addr, in_size_addr,
+                   in_data_addr, out_size_addr, out_data_addr, aux_size_addr,
+                   aux_data_addr, complete_addr):
+    s = _obj(hid)
+    codes = _read_i32_array(types_addr, num_args)
+    if keys_addr:
+        keys = _read_str_array(keys_addr, num_args)
+    else:
+        keys = s.list_arguments()[:num_args]
+    kwargs = {k: _np_dtype_of_code(c) for k, c in zip(keys, codes)
+              if c >= 0}
+    arg_types, out_types, aux_types = s.infer_type(**kwargs)
+
+    def codes_of(types):
+        return [(_code_of_np_dtype(t) if t is not None else -1)
+                for t in (types or [])]
+
+    for types, size_a, data_a in ((arg_types, in_size_addr, in_data_addr),
+                                  (out_types, out_size_addr, out_data_addr),
+                                  (aux_types, aux_size_addr, aux_data_addr)):
+        cs = codes_of(types)
+        _write_u32(size_a, len(cs))
+        _write_u64(data_a, _pin_array(ctypes.c_int32, cs))
+    complete = all(t is not None for t in (arg_types or [])) and \
+        all(t is not None for t in (out_types or []))
+    _write_i32(complete_addr, int(complete))
+
+
+_qsym_meta: dict[int, tuple] = {}
+
+
+@capi
+def quantize_symbol(hid, out_addr, num_excluded, excluded_addr, qdtype_addr):
+    from .contrib import quantization as q
+
+    sym = _obj(hid)
+    excluded = _read_str_array(excluded_addr, num_excluded)
+    qdtype = _read_str(qdtype_addr) or "int8"
+    qsym = q.quantize_graph(sym, excluded_sym_names=excluded)
+    hid_out = _new_handle(qsym)
+    _qsym_meta[hid_out] = (sym, tuple(excluded), qdtype)
+    _write_u64(out_addr, hid_out)
+
+
+@capi
+def set_calib_table_to_quantized_symbol(qsym_hid, num_layers, names_addr,
+                                        low_addr, high_addr, out_addr):
+    from .contrib import quantization as q
+
+    meta = _qsym_meta.get(int(qsym_hid))
+    if meta is None:
+        raise ValueError("SetCalibTable: handle was not produced by "
+                         "QuantizeSymbol")
+    sym, excluded, _ = meta
+    names = _read_str_array(names_addr, num_layers)
+    lows = _read_f32_array(low_addr, num_layers)
+    highs = _read_f32_array(high_addr, num_layers)
+    th_dict = {n: (lo, hi) for n, lo, hi in zip(names, lows, highs)}
+    qsym = q.quantize_graph(sym, excluded_sym_names=list(excluded),
+                            th_dict=th_dict)
+    _write_u64(out_addr, _new_handle(qsym))
+
+
+@capi
+def gen_backend_subgraph(hid, backend_addr, out_addr):
+    from .symbol.subgraph import partition_graph
+
+    part = partition_graph(_obj(hid), _read_str(backend_addr))
+    _write_u64(out_addr, _new_handle(part))
+
+
+# =============================================================== executor --
+_exec_syms: dict[int, object] = {}
+
+
+def _executor_arrays(executor):
+    args = [_new_handle(a) for a in executor.arg_arrays]
+    grads = [(_new_handle(g) if g is not None else 0)
+             for g in executor.grad_arrays]
+    auxs = [_new_handle(a) for a in executor.aux_arrays]
+    return args, grads, auxs
+
+
+@capi
+def exec_free(hid):
+    _exec_syms.pop(int(hid), None)
+    _free_handle(hid)
+
+
+@capi
+def exec_print(hid, out_addr):
+    _write_u64(out_addr, _pin_str(_obj(hid).debug_str()))
+
+
+@capi
+def exec_forward(hid, is_train):
+    _obj(hid).forward(is_train=bool(is_train))
+
+
+@capi
+def exec_backward(hid, length, head_grads_addr, is_train):
+    ids = _read_u64_array(head_grads_addr, length)
+    ograds = [_obj(h) for h in ids] if ids else None
+    _obj(hid).backward(out_grads=ograds, is_train=bool(is_train))
+
+
+@capi
+def exec_outputs(hid, out_size_addr, out_addr):
+    outs = [_new_handle(o) for o in _obj(hid).outputs]
+    _write_u32(out_size_addr, len(outs))
+    _write_u64(out_addr, _pin_array(ctypes.c_uint64, outs))
+
+
+@capi
+def exec_bind(sym_hid, dev_type, dev_id, length, in_args_addr, grads_addr,
+              reqs_addr, aux_len, aux_addr, shared_exec, out_addr):
+    del shared_exec  # binding is jit-cached; sharing is automatic
+    sym = _obj(sym_hid)
+    ctx = _ctx(dev_type, dev_id)
+    args = [_obj(h) for h in _read_u64_array(in_args_addr, length)]
+    grad_ids = _read_u64_array(grads_addr, length)
+    names = sym.list_arguments()
+    grads = {n: _obj(h) for n, h in zip(names, grad_ids) if h}
+    reqs = [_GRAD_REQ_NAMES[c] for c in _read_u32_array(reqs_addr, length)] \
+        if reqs_addr else ["write"] * length
+    aux = [_obj(h) for h in _read_u64_array(aux_addr, aux_len)]
+    executor = sym.bind(ctx, args, args_grad=grads,
+                        grad_req=dict(zip(names, reqs)), aux_states=aux)
+    hid = _new_handle(executor)
+    _exec_syms[hid] = sym
+    _write_u64(out_addr, hid)
+
+
+@capi
+def exec_simple_bind(sym_hid, dev_type, dev_id, num_reqs, req_names_addr,
+                     req_types_addr, num_shapes, shape_names_addr,
+                     shape_data_addr, shape_idx_addr, num_dtypes,
+                     dtype_names_addr, dtypes_addr, num_stypes,
+                     stype_names_addr, stypes_addr, num_shared_arg_names,
+                     shared_arg_names_addr, shared_buffer_len_addr,
+                     shared_buffer_names_addr, shared_buffer_handles_addr,
+                     upd_shared_buffer_names_addr,
+                     upd_shared_buffer_handles_addr, num_in_args_addr,
+                     in_args_addr, arg_grads_addr, num_aux_addr, aux_addr,
+                     shared_exec, out_addr):
+    del num_shared_arg_names, shared_arg_names_addr, shared_exec
+    sym = _obj(sym_hid)
+    ctx = _ctx(dev_type, dev_id)
+    # provided shapes: CSR packing over names
+    idx = _read_u32_array(shape_idx_addr, num_shapes + 1)
+    flat = _read_u32_array(shape_data_addr, idx[-1] if idx else 0)
+    shape_names = _read_str_array(shape_names_addr, num_shapes)
+    kwargs = {n: tuple(flat[idx[i]:idx[i + 1]])
+              for i, n in enumerate(shape_names)}
+    type_dict = {n: _np_dtype_of_code(c)
+                 for n, c in zip(_read_str_array(dtype_names_addr,
+                                                 num_dtypes),
+                                 _read_i32_array(dtypes_addr, num_dtypes))}
+    stype_dict = {n: {0: "default", 1: "row_sparse", 2: "csr"}[c]
+                  for n, c in zip(_read_str_array(stype_names_addr,
+                                                  num_stypes),
+                                  _read_i32_array(stypes_addr, num_stypes))}
+    if num_reqs:
+        grad_req = dict(zip(_read_str_array(req_names_addr, num_reqs),
+                            _read_str_array(req_types_addr, num_reqs)))
+    else:
+        grad_req = "write"
+    executor = sym.simple_bind(ctx, grad_req=grad_req,
+                               type_dict=type_dict or None,
+                               stype_dict=stype_dict or None, **kwargs)
+    hid = _new_handle(executor)
+    _exec_syms[hid] = sym
+    args, grads, auxs = _executor_arrays(executor)
+    _write_u32(num_in_args_addr, len(args))
+    _write_u64(in_args_addr, _pin_array(ctypes.c_uint64, args))
+    _write_u64(arg_grads_addr, _pin_array(ctypes.c_uint64, grads))
+    _write_u32(num_aux_addr, len(auxs))
+    _write_u64(aux_addr, _pin_array(ctypes.c_uint64, auxs))
+    # shared buffer passes through unchanged (XLA owns memory reuse)
+    if shared_buffer_len_addr:
+        n = _read_i32(shared_buffer_len_addr)
+        if n > 0:
+            _write_u64(upd_shared_buffer_names_addr,
+                       int(shared_buffer_names_addr))
+            _write_u64(upd_shared_buffer_handles_addr,
+                       int(shared_buffer_handles_addr))
+    _write_u64(out_addr, hid)
+
+
+@capi
+def exec_reshape(partial_shaping, allow_up_sizing, dev_type, dev_id,
+                 num_shapes, shape_names_addr, shape_data_addr,
+                 shape_idx_addr, num_in_args_addr, in_args_addr,
+                 arg_grads_addr, num_aux_addr, aux_addr, shared_exec_hid,
+                 out_addr):
+    del dev_type, dev_id
+    src = _obj(shared_exec_hid)
+    idx = _read_u32_array(shape_idx_addr, num_shapes + 1)
+    flat = _read_u32_array(shape_data_addr, idx[-1] if idx else 0)
+    names = _read_str_array(shape_names_addr, num_shapes)
+    kwargs = {n: tuple(flat[idx[i]:idx[i + 1]]) for i, n in enumerate(names)}
+    executor = src.reshape(partial_shaping=bool(partial_shaping),
+                           allow_up_sizing=bool(allow_up_sizing), **kwargs)
+    hid = _new_handle(executor)
+    _exec_syms[hid] = _exec_syms.get(int(shared_exec_hid))
+    args, grads, auxs = _executor_arrays(executor)
+    _write_u32(num_in_args_addr, len(args))
+    _write_u64(in_args_addr, _pin_array(ctypes.c_uint64, args))
+    _write_u64(arg_grads_addr, _pin_array(ctypes.c_uint64, grads))
+    _write_u32(num_aux_addr, len(auxs))
+    _write_u64(aux_addr, _pin_array(ctypes.c_uint64, auxs))
+    _write_u64(out_addr, hid)
+
+
+@capi
+def exec_get_optimized_symbol(hid, out_addr):
+    sym = _exec_syms.get(int(hid))
+    if sym is None:
+        sym = _obj(hid)._symbol
+    _write_u64(out_addr, _new_handle(sym))
+
+
+_MonitorCB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_uint64,
+                              ctypes.c_void_p)
+
+
+@capi
+def exec_set_monitor_callback(hid, cb_addr, cb_ctx, monitor_all):
+    executor = _obj(hid)
+    cfn = _MonitorCB(int(cb_addr))
+
+    def py_cb(name, arr):
+        h = _new_handle(arr)
+        try:
+            nm = name if isinstance(name, bytes) else str(name).encode()
+            cfn(nm, h, cb_ctx)
+        finally:
+            _free_handle(h)
+
+    executor.set_monitor_callback(py_cb, monitor_all=bool(monitor_all))
+
+
+# ============================================================== cached op --
+class _CCachedOp:
+    """C-ABI CachedOp: a symbol plus a shape/dtype-keyed executor cache
+    (reference: src/imperative/cached_op.cc; here the jit cache under
+    simple_bind already gives the op-graph reuse)."""
+
+    def __init__(self, sym, flags):
+        self.sym = sym
+        self.flags = flags
+        self._cache = {}
+
+    def invoke(self, inputs):
+        names = self.sym.list_arguments()
+        if len(inputs) != len(names):
+            raise ValueError("InvokeCachedOp: expected %d inputs (%s), got "
+                             "%d" % (len(names), names, len(inputs)))
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        executor = self._cache.get(key)
+        if executor is None:
+            shapes = {n: tuple(a.shape) for n, a in zip(names, inputs)}
+            types = {n: a.dtype for n, a in zip(names, inputs)}
+            executor = self.sym.simple_bind(inputs[0].context,
+                                            grad_req="null",
+                                            type_dict=types, **shapes)
+            self._cache[key] = executor
+        return executor.forward(is_train=False,
+                                **dict(zip(names, inputs)))
+
+
+@capi
+def create_cached_op(sym_hid, num_flags, keys_addr, vals_addr, out_addr):
+    flags = _parse_params(num_flags, keys_addr, vals_addr)
+    _write_u64(out_addr, _new_handle(_CCachedOp(_obj(sym_hid), flags)))
+
+
+@capi
+def free_cached_op(hid):
+    _free_handle(hid)
+
+
+@capi
+def invoke_cached_op(hid, num_inputs, inputs_addr, num_outputs_addr,
+                     outputs_addr, out_stypes_addr):
+    op = _obj(hid)
+    inputs = [_obj(h) for h in _read_u64_array(inputs_addr, num_inputs)]
+    outs = op.invoke(inputs)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    hids = [_new_handle(o) for o in outs]
+    _write_i32(num_outputs_addr, len(hids))
+    _write_u64(outputs_addr, _pin_array(ctypes.c_uint64, hids))
+    if out_stypes_addr:
+        codes = [_STYPE_CODES.get(getattr(o, "stype", "default"), 0)
+                 for o in outs]
+        _write_u64(out_stypes_addr, _pin_array(ctypes.c_int32, codes))
+
+
+# ============================================================== data iter --
+def _iter_creators():
+    from . import io as _io
+    from .image import ImageIter
+    from .image_detection import ImageDetIter
+
+    return [_io.MNISTIter, _io.CSVIter, _io.LibSVMIter, _io.ImageRecordIter,
+            ImageIter, ImageDetIter]
+
+
+_iter_creator_handles: list[int] = []
+
+
+@capi
+def list_data_iters(out_size_addr, out_array_addr):
+    if not _iter_creator_handles:
+        _iter_creator_handles.extend(_new_handle(c)
+                                     for c in _iter_creators())
+    _write_u32(out_size_addr, len(_iter_creator_handles))
+    _write_u64(out_array_addr,
+               _pin_array(ctypes.c_uint64, _iter_creator_handles))
+
+
+@capi
+def data_iter_get_iter_info(creator_hid, name_addr, desc_addr, num_args_addr,
+                            arg_names_addr, arg_types_addr, arg_descs_addr):
+    import inspect
+
+    cls = _obj(creator_hid)
+    sig = inspect.signature(cls.__init__)
+    params = [p for p in sig.parameters.values()
+              if p.name not in ("self", "args", "kwargs")]
+    names = [p.name for p in params]
+    types = [("required" if p.default is inspect.Parameter.empty
+              else "optional, default=%r" % (p.default,)) for p in params]
+    _write_u64(name_addr, _pin_str(cls.__name__))
+    _write_u64(desc_addr,
+               _pin_str((cls.__doc__ or "").strip().split("\n")[0]))
+    _write_u32(num_args_addr, len(names))
+    _write_u64(arg_names_addr, _pin_str_array(names))
+    _write_u64(arg_types_addr, _pin_str_array(types))
+    _write_u64(arg_descs_addr, _pin_str_array(["" for _ in names]))
+
+
+class _IterState:
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+@capi
+def data_iter_create(creator_hid, num_param, keys_addr, vals_addr, out_addr):
+    cls = _obj(creator_hid)
+    kwargs = _parse_params(num_param, keys_addr, vals_addr)
+    _write_u64(out_addr, _new_handle(_IterState(cls(**kwargs))))
+
+
+@capi
+def data_iter_free(hid):
+    _free_handle(hid)
+
+
+@capi
+def data_iter_next(hid, out_addr):
+    st = _obj(hid)
+    try:
+        st.batch = st.it.next()
+        _write_i32(out_addr, 1)
+    except StopIteration:
+        st.batch = None
+        _write_i32(out_addr, 0)
+
+
+@capi
+def data_iter_before_first(hid):
+    st = _obj(hid)
+    st.it.reset()
+    st.batch = None
+
+
+def _batch_of(hid):
+    st = _obj(hid)
+    if st.batch is None:
+        raise ValueError("DataIter: call Next before reading the batch")
+    return st.batch
+
+
+@capi
+def data_iter_get_data(hid, out_addr):
+    _write_u64(out_addr, _new_handle(_batch_of(hid).data[0]))
+
+
+@capi
+def data_iter_get_label(hid, out_addr):
+    _write_u64(out_addr, _new_handle(_batch_of(hid).label[0]))
+
+
+@capi
+def data_iter_get_index(hid, out_index_addr, out_size_addr):
+    idx = _batch_of(hid).index
+    vals = [int(v) for v in (idx if idx is not None else [])]
+    _write_u64(out_index_addr, _pin_array(ctypes.c_uint64, vals))
+    _write(ctypes.c_uint64, out_size_addr, len(vals))
+
+
+@capi
+def data_iter_get_pad_num(hid, out_addr):
+    _write_i32(out_addr, int(_batch_of(hid).pad or 0))
+
+
+# ================================================================ kvstore --
+def _kv_mod():
+    from . import kvstore as _kv
+
+    return _kv
+
+
+@capi
+def kv_create(type_addr, out_addr):
+    kv = _kv_mod().create(_read_str(type_addr) or "local")
+    _write_u64(out_addr, _new_handle(kv))
+
+
+@capi
+def kv_free(hid):
+    _free_handle(hid)
+
+
+def _kv_keys(num, keys_addr, str_keys):
+    if str_keys:
+        return _read_str_array(keys_addr, num)
+    return _read_i32_array(keys_addr, num)
+
+
+@capi
+def kv_init(hid, num, keys_addr, str_keys, vals_addr):
+    kv = _obj(hid)
+    keys = _kv_keys(num, keys_addr, str_keys)
+    vals = [_obj(h) for h in _read_u64_array(vals_addr, num)]
+    kv.init(keys if len(keys) > 1 else keys[0],
+            vals if len(vals) > 1 else vals[0])
+
+
+@capi
+def kv_push(hid, num, keys_addr, str_keys, vals_addr, priority):
+    kv = _obj(hid)
+    keys = _kv_keys(num, keys_addr, str_keys)
+    vals = [_obj(h) for h in _read_u64_array(vals_addr, num)]
+    kv.push(keys if len(keys) > 1 else keys[0],
+            vals if len(vals) > 1 else vals[0], priority=priority)
+
+
+@capi
+def kv_pull(hid, num, keys_addr, str_keys, vals_addr, priority,
+            ignore_sparse):
+    kv = _obj(hid)
+    keys = _kv_keys(num, keys_addr, str_keys)
+    outs = [_obj(h) for h in _read_u64_array(vals_addr, num)]
+    kv.pull(keys if len(keys) > 1 else keys[0],
+            out=outs if len(outs) > 1 else outs[0], priority=priority,
+            ignore_sparse=bool(ignore_sparse))
+
+
+@capi
+def kv_pull_row_sparse(hid, num, keys_addr, str_keys, vals_addr,
+                       row_ids_addr, priority):
+    kv = _obj(hid)
+    keys = _kv_keys(num, keys_addr, str_keys)
+    outs = [_obj(h) for h in _read_u64_array(vals_addr, num)]
+    row_ids = [_obj(h) for h in _read_u64_array(row_ids_addr, num)]
+    kv.row_sparse_pull(keys if len(keys) > 1 else keys[0],
+                       out=outs if len(outs) > 1 else outs[0],
+                       priority=priority,
+                       row_ids=row_ids if len(row_ids) > 1 else row_ids[0])
+
+
+_KVUpdater = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_uint64,
+                              ctypes.c_uint64, ctypes.c_void_p)
+_KVStrUpdater = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint64, ctypes.c_void_p)
+
+
+@capi
+def kv_set_updater(hid, updater_addr, str_updater_addr, updater_ctx):
+    kv = _obj(hid)
+    int_fn = _KVUpdater(int(updater_addr)) if updater_addr else None
+    str_fn = (_KVStrUpdater(int(str_updater_addr))
+              if str_updater_addr else None)
+
+    def py_updater(key, recv, local):
+        hr, hl = _new_handle(recv), _new_handle(local)
+        try:
+            if isinstance(key, str):
+                if str_fn is None:
+                    raise ValueError("string key %r but no str updater set"
+                                     % key)
+                str_fn(key.encode(), hr, hl, updater_ctx)
+            else:
+                int_fn(int(key), hr, hl, updater_ctx)
+        finally:
+            _free_handle(hr)
+            _free_handle(hl)
+
+    kv.set_updater(py_updater)
+
+
+@capi
+def kv_get_type(hid, out_addr):
+    _write_u64(out_addr, _pin_str(_obj(hid).type))
+
+
+@capi
+def kv_get_rank(hid, out_addr):
+    _write_i32(out_addr, int(_obj(hid).rank))
+
+
+@capi
+def kv_get_group_size(hid, out_addr):
+    _write_i32(out_addr, int(_obj(hid).num_workers))
+
+
+@capi
+def kv_barrier(hid):
+    kv = _obj(hid)
+    fn = getattr(kv, "_barrier", None) or getattr(kv, "barrier", None)
+    if fn is not None:
+        fn()
+
+
+def _role():
+    import os
+
+    return os.environ.get("DMLC_ROLE", "worker")
+
+
+@capi
+def kv_is_worker_node(out_addr):
+    _write_i32(out_addr, int(_role() == "worker"))
+
+
+@capi
+def kv_is_server_node(out_addr):
+    _write_i32(out_addr, int(_role() == "server"))
+
+
+@capi
+def kv_is_scheduler_node(out_addr):
+    _write_i32(out_addr, int(_role() == "scheduler"))
+
+
+_KVController = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_char_p,
+                                 ctypes.c_void_p)
+
+
+@capi
+def kv_run_server(hid, controller_addr, controller_ctx):
+    if _role() != "server":
+        raise RuntimeError("RunServer: DMLC_ROLE is %r, not 'server'"
+                           % _role())
+    from . import kvstore_server
+
+    del hid
+    cfn = _KVController(int(controller_addr)) if controller_addr else None
+    if cfn is not None:
+        # surface server commands to the C controller as the reference
+        # does before entering the serving loop
+        kvstore_server._c_controller = lambda head, body: cfn(
+            int(head), str(body).encode(), controller_ctx)
+    kvstore_server.init_server()
+
+
+@capi
+def kv_send_command_to_servers(hid, cmd_id, body_addr):
+    _obj(hid)._send_command_to_servers(int(cmd_id), _read_str(body_addr)
+                                       or "")
+
+
+@capi
+def kv_set_barrier_before_exit(hid, do_barrier):
+    _obj(hid)._barrier_before_exit = bool(do_barrier)
+
+
+@capi
+def kv_get_num_dead_node(hid, node_id, out_addr, timeout_sec):
+    del node_id, timeout_sec
+    kv = _obj(hid)
+    _write_i32(out_addr, int(getattr(kv, "num_dead_nodes", 0)))
+
+
+@capi
+def kv_set_gradient_compression(hid, num, keys_addr, vals_addr):
+    kv = _obj(hid)
+    kv.set_gradient_compression(_parse_params(num, keys_addr, vals_addr))
+
+
+@capi
+def init_ps_env(num, keys_addr, vals_addr):
+    import os
+
+    keys = _read_str_array(keys_addr, num)
+    vals = _read_str_array(vals_addr, num)
+    os.environ.update(dict(zip(keys, vals)))
+
+
+# =============================================================== profiler --
+def _profiler():
+    from . import profiler
+
+    return profiler
+
+
+@capi
+def profiler_set_config(num, keys_addr, vals_addr, kvstore_hid):
+    params = _parse_params(num, keys_addr, vals_addr)
+    if kvstore_hid:
+        _profiler().set_kvstore_handle(_obj(kvstore_hid))
+    _profiler().set_config(**params)
+
+
+@capi
+def profiler_set_state(state, profile_process):
+    kw = {}
+    if profile_process:
+        kw["profile_process"] = ("server" if profile_process == 1
+                                 else "worker")
+    _profiler().set_state("run" if state else "stop", **kw)
+
+
+@capi
+def profiler_dump(finished, profile_process):
+    kw = {}
+    if profile_process:
+        kw["profile_process"] = ("server" if profile_process == 1
+                                 else "worker")
+    _profiler().dump(finished=bool(finished), **kw)
+
+
+@capi
+def profiler_aggregate_stats_print(out_addr, reset):
+    _write_u64(out_addr, _pin_str(_profiler().dumps(reset=bool(reset))))
+
+
+@capi
+def profiler_pause(paused, profile_process):
+    kw = {}
+    if profile_process:
+        kw["profile_process"] = ("server" if profile_process == 1
+                                 else "worker")
+    if paused:
+        _profiler().pause(**kw)
+    else:
+        _profiler().resume(**kw)
+
+
+@capi
+def profile_create_domain(name_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_profiler().Domain(_read_str(name_addr))))
+
+
+@capi
+def profile_create_task(domain_hid, name_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_obj(domain_hid).new_task(_read_str(name_addr))))
+
+
+@capi
+def profile_create_frame(domain_hid, name_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_obj(domain_hid).new_frame(_read_str(name_addr))))
+
+
+@capi
+def profile_create_event(name_addr, out_addr):
+    _write_u64(out_addr,
+               _new_handle(_profiler().Event(_read_str(name_addr))))
+
+
+@capi
+def profile_create_counter(domain_hid, name_addr, out_addr):
+    _write_u64(out_addr, _new_handle(
+        _obj(domain_hid).new_counter(_read_str(name_addr))))
+
+
+@capi
+def profile_destroy_handle(hid):
+    _free_handle(hid)
+
+
+@capi
+def profile_duration_start(hid):
+    _obj(hid).start()
+
+
+@capi
+def profile_duration_stop(hid):
+    _obj(hid).stop()
+
+
+@capi
+def profile_set_counter(hid, value):
+    _obj(hid).set_value(int(value))
+
+
+@capi
+def profile_adjust_counter(hid, delta):
+    _obj(hid).increment(int(delta))
+
+
+@capi
+def profile_set_marker(domain_hid, name_addr, scope_addr):
+    marker = _obj(domain_hid).new_marker(_read_str(name_addr))
+    scope = _read_str(scope_addr) or "process"
+    mark = getattr(marker, "mark", None)
+    if mark is not None:
+        mark(scope)

@@ -1,0 +1,630 @@
+/* mxtpu/c_api.h — the public C ABI of libmxtpu.
+ *
+ * TPU-native counterpart of the reference's include/mxnet/c_api.h (196
+ * MXNET_DLL functions): the FFI seam every non-Python language binds
+ * against.  Two layers back it:
+ *   - the native host runtime (engine, RecordIO, data pipeline), linked
+ *     directly into libmxtpu — see the Engine/Record/Pipeline groups;
+ *   - the jax/XLA tensor runtime, reached through an embedded CPython
+ *     interpreter (src/embed.cc) that drives the mxnet_tpu package —
+ *     see the NDArray/Symbol/Executor/KVStore/... groups.  On TPU the
+ *     tensor engine IS jax/XLA/PJRT, so the ABI hosts the interpreter
+ *     instead of maintaining a second compute engine.
+ *
+ * Conventions (mirroring the reference):
+ *   - every function returns 0 on success, -1 on failure; the failure
+ *     message is retrieved with MXTPUGetLastError() (thread-local);
+ *   - tensor-runtime handles (MXTPUHandle) are opaque uint64 ids owned
+ *     by a registry inside the embedded interpreter — NOT pointers; 0
+ *     is never a valid handle;
+ *   - out-pointers to strings/arrays point into per-thread pinned
+ *     storage owned by the runtime, valid until 256 further ABI calls
+ *     are made on the same thread (the reference's thread-local return
+ *     store has the same next-call invalidation contract; copy out if
+ *     you need longer lifetime);
+ *   - dev_type uses the reference encoding: 1=cpu, 2=gpu(accelerator →
+ *     TPU here), 3=cpu_pinned; dtype uses the reference type codes
+ *     (0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64);
+ *   - grad_req: 0=null 1=write 3=add (reference: include/mxnet/
+ *     op_attr_types.h OpReqType);
+ *   - storage types: 0=default(dense) 1=row_sparse 2=csr.
+ *
+ * First call from a non-Python process initializes the interpreter;
+ * set MXTPU_PYTHONPATH so mxnet_tpu and jax resolve (see embed.cc).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* All ABI functions are exported with default visibility even when the
+ * library builds with -fvisibility=hidden. */
+#ifndef MXTPU_DLL
+#ifdef __GNUC__
+#define MXTPU_DLL __attribute__((visibility("default")))
+#else
+#define MXTPU_DLL
+#endif
+#endif
+
+/* Opaque tensor-runtime handle (NDArray, Symbol, Executor, DataIter,
+ * KVStore, CachedOp, Op/creator, profiler object). */
+typedef uint64_t MXTPUHandle;
+
+/* ------------------------------------------------------------------ base */
+/* Thread-local message for the last failed call on this thread. */
+MXTPU_DLL extern const char* MXTPUGetLastError(void);
+/* Library version as major*10000 + minor*100 + patch
+ * (reference: MXGetVersion). */
+MXTPU_DLL extern int MXTPUGetVersion(int* out);
+/* Seed every device RNG (reference: MXRandomSeed). */
+MXTPU_DLL extern int MXTPURandomSeed(int seed);
+/* Seed the RNG of one context (reference: MXRandomSeedContext). */
+MXTPU_DLL extern int MXTPURandomSeedContext(int seed, int dev_type, int dev_id);
+/* Flush pending async work before process exit
+ * (reference: MXNotifyShutdown). */
+MXTPU_DLL extern int MXTPUNotifyShutdown(void);
+/* Host-thread hint; recorded, XLA owns threading
+ * (reference: MXSetNumOMPThreads). */
+MXTPU_DLL extern int MXTPUSetNumOMPThreads(int nthreads);
+/* Engine op-bulking hint; returns previous size
+ * (reference: MXEngineSetBulkSize). */
+MXTPU_DLL extern int MXTPUEngineSetBulkSize(int bulk_size, int* prev_bulk_size);
+/* Number of visible accelerator devices (reference: MXGetGPUCount). */
+MXTPU_DLL extern int MXTPUGetDeviceCount(int* out);
+/* Free/total device memory in bytes
+ * (reference: MXGetGPUMemoryInformation64). */
+MXTPU_DLL extern int MXTPUGetDeviceMemoryInformation(int dev_id, uint64_t* free_mem,
+                                           uint64_t* total_mem);
+/* Runtime feature names + enabled flags as parallel arrays
+ * (reference: MXLibInfoFeatures). */
+MXTPU_DLL extern int MXTPULibInfoFeatures(const char*** out_names,
+                                const int** out_enabled, uint64_t* out_size);
+
+/* --------------------------------------------------------------- ndarray */
+/* (reference: MXNDArrayCreateNone .. MXNDArrayGetGrad,
+ *  src/c_api/c_api.cc) */
+MXTPU_DLL extern int MXTPUNDArrayCreateNone(MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayCreate(const uint32_t* shape, uint32_t ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayCreateEx(const uint32_t* shape, uint32_t ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayFree(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUNDArrayGetShape(MXTPUHandle handle, uint32_t* out_ndim,
+                                const uint32_t** out_pdata);
+MXTPU_DLL extern int MXTPUNDArrayGetDType(MXTPUHandle handle, int* out);
+MXTPU_DLL extern int MXTPUNDArrayGetContext(MXTPUHandle handle, int* out_dev_type,
+                                  int* out_dev_id);
+/* Pointer to a host snapshot of the contents (row-major, dtype above);
+ * valid under the pinned-storage contract.  The reference returns the
+ * live CPU buffer; device arrays here live in PJRT, so this is a read
+ * snapshot — write through MXTPUNDArraySyncCopyFromCPU. */
+MXTPU_DLL extern int MXTPUNDArrayGetData(MXTPUHandle handle, void** out_pdata);
+MXTPU_DLL extern int MXTPUNDArraySyncCopyFromCPU(MXTPUHandle handle, const void* data,
+                                       uint64_t size);
+MXTPU_DLL extern int MXTPUNDArraySyncCopyToCPU(MXTPUHandle handle, void* data,
+                                     uint64_t size);
+/* Copy src into dst (dst keeps its dtype/context).  i selects an aux
+ * array of src when >= 0 (reference: MXNDArraySyncCopyFromNDArray). */
+MXTPU_DLL extern int MXTPUNDArraySyncCopyFromNDArray(MXTPUHandle dst, MXTPUHandle src,
+                                           int i);
+MXTPU_DLL extern int MXTPUNDArraySlice(MXTPUHandle handle, uint32_t slice_begin,
+                             uint32_t slice_end, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayAt(MXTPUHandle handle, uint32_t idx, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayReshape(MXTPUHandle handle, int ndim, const int* dims,
+                               MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayReshape64(MXTPUHandle handle, int ndim,
+                                 const int64_t* dims, int reverse,
+                                 MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayDetach(MXTPUHandle handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArraySetGradState(MXTPUHandle handle, int state);
+MXTPU_DLL extern int MXTPUNDArrayGetGradState(MXTPUHandle handle, int* out);
+/* *out = 0 when no gradient buffer is attached. */
+MXTPU_DLL extern int MXTPUNDArrayGetGrad(MXTPUHandle handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayWaitToRead(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUNDArrayWaitToWrite(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUNDArrayWaitAll(void);
+/* Serialization (reference .params container format, MXNDArraySave /
+ * MXNDArrayLoad / MXNDArrayLoadFromBuffer / Save-LoadRawBytes). keys
+ * may be NULL to save positionally. */
+MXTPU_DLL extern int MXTPUNDArraySave(const char* fname, uint32_t num_args,
+                            const MXTPUHandle* args, const char** keys);
+MXTPU_DLL extern int MXTPUNDArrayLoad(const char* fname, uint32_t* out_size,
+                            MXTPUHandle** out_arr, uint32_t* out_name_size,
+                            const char*** out_names);
+MXTPU_DLL extern int MXTPUNDArrayLoadFromBuffer(const void* ndarray_buffer,
+                                      uint64_t size, uint32_t* out_size,
+                                      MXTPUHandle** out_arr,
+                                      uint32_t* out_name_size,
+                                      const char*** out_names);
+MXTPU_DLL extern int MXTPUNDArraySaveRawBytes(MXTPUHandle handle, uint64_t* out_size,
+                                    const char** out_buf);
+MXTPU_DLL extern int MXTPUNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                        MXTPUHandle* out);
+/* Sparse (reference: MXNDArrayCreateSparseEx, GetStorageType, GetAux*,
+ * GetDataNDArray, SyncCheckFormat).  storage_type/aux layout follows
+ * the reference: row_sparse aux0=indices; csr aux0=indptr aux1=indices. */
+MXTPU_DLL extern int MXTPUNDArrayGetStorageType(MXTPUHandle handle, int* out);
+MXTPU_DLL extern int MXTPUNDArrayCreateSparseEx(
+    int storage_type, const uint32_t* shape, uint32_t ndim, int dev_type,
+    int dev_id, int delay_alloc, int dtype, uint32_t num_aux,
+    const int* aux_type, const uint32_t* aux_ndims, const uint32_t* aux_shape,
+    MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayGetAuxType(MXTPUHandle handle, uint32_t i, int* out);
+MXTPU_DLL extern int MXTPUNDArrayGetAuxNDArray(MXTPUHandle handle, uint32_t i,
+                                     MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayGetDataNDArray(MXTPUHandle handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArraySyncCheckFormat(MXTPUHandle handle, int full_check);
+/* DLPack interop (reference: MXNDArrayToDLPack/FromDLPack/
+ * CallDLPackDeleter).  ToDLPack exports a host snapshot as a
+ * DLManagedTensor*; the consumer must call its deleter (or
+ * MXTPUNDArrayCallDLPackDeleter).  FromDLPack copies out of the tensor
+ * and calls its deleter. */
+MXTPU_DLL extern int MXTPUNDArrayToDLPack(MXTPUHandle handle, void** out_dlmanaged);
+MXTPU_DLL extern int MXTPUNDArrayFromDLPack(void* dlmanaged, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUNDArrayCallDLPackDeleter(void* dlmanaged);
+/* POSIX shared-memory interop (reference: MXNDArrayGetSharedMemHandle /
+ * MXNDArrayCreateFromSharedMem, used by the multiprocess DataLoader). */
+MXTPU_DLL extern int MXTPUNDArrayGetSharedMemHandle(MXTPUHandle handle, int* shared_pid,
+                                          int* shared_id);
+MXTPU_DLL extern int MXTPUNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                           const uint32_t* shape,
+                                           uint32_t ndim, int dtype,
+                                           MXTPUHandle* out);
+
+/* ------------------------------------------------- ops & imperative call */
+/* (reference: MXListAllOpNames, NNGetOpHandle, MXSymbolGetAtomicSymbolInfo,
+ *  MXImperativeInvoke; also backs the legacy MXFunc* surface) */
+MXTPU_DLL extern int MXTPUListAllOpNames(uint32_t* out_size, const char*** out_array);
+MXTPU_DLL extern int MXTPUGetOpHandle(const char* op_name, MXTPUHandle* out);
+/* Full signature info for an op/creator handle.  arg_types are python
+ * repr strings of the default ("<required>" when none). */
+MXTPU_DLL extern int MXTPUGetOpInfo(MXTPUHandle op, const char** name,
+                          const char** description, uint32_t* num_args,
+                          const char*** arg_names, const char*** arg_types,
+                          const char*** arg_descriptions,
+                          const char** return_type);
+/* Invoke an op on NDArray inputs.  If *num_outputs==0 the runtime
+ * allocates outputs and returns new handles in *outputs (pinned array);
+ * if the caller provides *num_outputs>0 and *outputs, results are
+ * written into those arrays in place (reference: MXImperativeInvoke). */
+MXTPU_DLL extern int MXTPUImperativeInvoke(MXTPUHandle op, int num_inputs,
+                                 const MXTPUHandle* inputs, int* num_outputs,
+                                 MXTPUHandle** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals);
+/* Legacy function surface (reference: MXListFunctions/MXGetFunction/
+ * MXFuncGetInfo/MXFuncInvokeEx): functions ARE op handles here. */
+MXTPU_DLL extern int MXTPUListFunctions(uint32_t* out_size, MXTPUHandle** out_array);
+MXTPU_DLL extern int MXTPUGetFunction(const char* name, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUFuncGetInfo(MXTPUHandle fun, const char** name,
+                            const char** description, uint32_t* num_args,
+                            const char*** arg_names, const char*** arg_types,
+                            const char*** arg_descriptions,
+                            const char** return_type);
+/* use_vars are inputs, mutate_vars receive the outputs; a single scalar
+ * arg is passed to the op's scalar parameter (reference semantics for
+ * the *_scalar family). */
+MXTPU_DLL extern int MXTPUFuncInvoke(MXTPUHandle fun, const MXTPUHandle* use_vars,
+                           const float* scalar_args,
+                           const MXTPUHandle* mutate_vars, int num_use,
+                           int num_scalar, int num_mutate);
+MXTPU_DLL extern int MXTPUFuncInvokeEx(MXTPUHandle fun, const MXTPUHandle* use_vars,
+                             const float* scalar_args,
+                             const MXTPUHandle* mutate_vars, int num_use,
+                             int num_scalar, int num_mutate, int num_params,
+                             const char** param_keys,
+                             const char** param_vals);
+
+/* -------------------------------------------------------------- autograd */
+/* (reference: MXAutogradSetIsRecording .. MXAutogradGetSymbol) */
+MXTPU_DLL extern int MXTPUAutogradSetIsRecording(int is_recording, int* prev);
+MXTPU_DLL extern int MXTPUAutogradSetIsTraining(int is_training, int* prev);
+MXTPU_DLL extern int MXTPUAutogradIsRecording(int* curr);
+MXTPU_DLL extern int MXTPUAutogradIsTraining(int* curr);
+/* reqs use grad_req codes (0 null / 1 write / 3 add). */
+MXTPU_DLL extern int MXTPUAutogradMarkVariables(uint32_t num_var,
+                                      const MXTPUHandle* var_handles,
+                                      const uint32_t* reqs_array,
+                                      const MXTPUHandle* grad_handles);
+MXTPU_DLL extern int MXTPUAutogradBackward(uint32_t num_output,
+                                 const MXTPUHandle* output_handles,
+                                 const MXTPUHandle* ograd_handles,
+                                 int retain_graph);
+/* With num_variables>0 returns the gradients w.r.t. those variables in
+ * *grad_handles (+ storage types); otherwise gradients accumulate into
+ * the marked variables' grad buffers. */
+MXTPU_DLL extern int MXTPUAutogradBackwardEx(uint32_t num_output,
+                                   const MXTPUHandle* output_handles,
+                                   const MXTPUHandle* ograd_handles,
+                                   uint32_t num_variables,
+                                   const MXTPUHandle* var_handles,
+                                   int retain_graph, int create_graph,
+                                   int is_train, MXTPUHandle** grad_handles,
+                                   const int** grad_stypes);
+MXTPU_DLL extern int MXTPUAutogradComputeGradient(uint32_t num_output,
+                                        const MXTPUHandle* output_handles);
+MXTPU_DLL extern int MXTPUAutogradGetSymbol(MXTPUHandle ndhandle, MXTPUHandle* out);
+
+/* ---------------------------------------------------------------- symbol */
+/* (reference: MXSymbolListAtomicSymbolCreators .. MXSymbolInferType,
+ *  src/c_api/c_api_symbolic.cc) */
+MXTPU_DLL extern int MXTPUSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                               MXTPUHandle** out_array);
+MXTPU_DLL extern int MXTPUSymbolGetAtomicSymbolName(MXTPUHandle creator,
+                                          const char** name);
+MXTPU_DLL extern int MXTPUSymbolGetAtomicSymbolInfo(
+    MXTPUHandle creator, const char** name, const char** description,
+    uint32_t* num_args, const char*** arg_names, const char*** arg_types,
+    const char*** arg_descriptions, const char** key_var_num_args,
+    const char** return_type);
+MXTPU_DLL extern int MXTPUSymbolCreateAtomicSymbol(MXTPUHandle creator,
+                                         uint32_t num_param,
+                                         const char** keys, const char** vals,
+                                         MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolCreateVariable(const char* name, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolCreateGroup(uint32_t num_symbols,
+                                  const MXTPUHandle* symbols,
+                                  MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolCreateFromFile(const char* fname, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolCreateFromJSON(const char* json, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolSaveToFile(MXTPUHandle symbol, const char* fname);
+MXTPU_DLL extern int MXTPUSymbolSaveToJSON(MXTPUHandle symbol, const char** out_json);
+MXTPU_DLL extern int MXTPUSymbolFree(MXTPUHandle symbol);
+MXTPU_DLL extern int MXTPUSymbolCopy(MXTPUHandle symbol, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolPrint(MXTPUHandle symbol, const char** out_str);
+MXTPU_DLL extern int MXTPUSymbolGetName(MXTPUHandle symbol, const char** out,
+                              int* success);
+MXTPU_DLL extern int MXTPUSymbolGetAttr(MXTPUHandle symbol, const char* key,
+                              const char** out, int* success);
+MXTPU_DLL extern int MXTPUSymbolSetAttr(MXTPUHandle symbol, const char* key,
+                              const char* value);
+/* key/value pairs flattened as [k0, v0, k1, v1, ...] (out_size = number
+ * of pairs), deep (ListAttr) or node-local (ListAttrShallow). */
+MXTPU_DLL extern int MXTPUSymbolListAttr(MXTPUHandle symbol, uint32_t* out_size,
+                               const char*** out);
+MXTPU_DLL extern int MXTPUSymbolListAttrShallow(MXTPUHandle symbol, uint32_t* out_size,
+                                      const char*** out);
+MXTPU_DLL extern int MXTPUSymbolListArguments(MXTPUHandle symbol, uint32_t* out_size,
+                                    const char*** out_str_array);
+MXTPU_DLL extern int MXTPUSymbolListOutputs(MXTPUHandle symbol, uint32_t* out_size,
+                                  const char*** out_str_array);
+MXTPU_DLL extern int MXTPUSymbolListAuxiliaryStates(MXTPUHandle symbol,
+                                          uint32_t* out_size,
+                                          const char*** out_str_array);
+MXTPU_DLL extern int MXTPUSymbolGetNumOutputs(MXTPUHandle symbol, uint32_t* output_count);
+MXTPU_DLL extern int MXTPUSymbolGetInternals(MXTPUHandle symbol, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolGetChildren(MXTPUHandle symbol, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolGetOutput(MXTPUHandle symbol, uint32_t index,
+                                MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUSymbolGetInputSymbols(MXTPUHandle symbol,
+                                      MXTPUHandle** out_handles,
+                                      uint32_t* out_size);
+/* Compose positionally (keys NULL) or by name. */
+MXTPU_DLL extern int MXTPUSymbolCompose(MXTPUHandle symbol, const char* name,
+                              uint32_t num_args, const char** keys,
+                              const MXTPUHandle* args);
+/* Shape inference.  Provided shapes keyed (keys!=NULL) or positional;
+ * CSR-style (arg_ind_ptr, arg_shape_data) packing.  Results come back
+ * as three pinned (size, ndims[], data[][]) triples for arguments /
+ * outputs / aux states (reference: MXSymbolInferShape). */
+MXTPU_DLL extern int MXTPUSymbolInferShape(
+    MXTPUHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete);
+MXTPU_DLL extern int MXTPUSymbolInferShapePartial(
+    MXTPUHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete);
+MXTPU_DLL extern int MXTPUSymbolInferType(MXTPUHandle sym, uint32_t num_args,
+                                const char** keys, const int* arg_type_data,
+                                uint32_t* in_type_size,
+                                const int** in_type_data,
+                                uint32_t* out_type_size,
+                                const int** out_type_data,
+                                uint32_t* aux_type_size,
+                                const int** aux_type_data, int* complete);
+/* Graph passes (reference: MXQuantizeSymbol,
+ * MXSetCalibTableToQuantizedSymbol, MXGenBackendSubgraph). */
+MXTPU_DLL extern int MXTPUQuantizeSymbol(MXTPUHandle sym, MXTPUHandle* out,
+                               uint32_t num_excluded,
+                               const char** excluded_op_names,
+                               const char* quantized_dtype);
+MXTPU_DLL extern int MXTPUSetCalibTableToQuantizedSymbol(
+    MXTPUHandle qsym, uint32_t num_layers, const char** layer_names,
+    const float* low_quantiles, const float* high_quantiles, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUGenBackendSubgraph(MXTPUHandle sym, const char* backend,
+                                   MXTPUHandle* out);
+
+/* -------------------------------------------------------------- executor */
+/* (reference: MXExecutorBind .. MXExecutorSetMonitorCallbackEX,
+ *  src/c_api/c_api_executor.cc) */
+typedef void (*MXTPUExecutorMonitorCallback)(const char* name,
+                                             MXTPUHandle ndarray,
+                                             void* callback_ctx);
+MXTPU_DLL extern int MXTPUExecutorFree(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUExecutorPrint(MXTPUHandle handle, const char** out_str);
+MXTPU_DLL extern int MXTPUExecutorForward(MXTPUHandle handle, int is_train);
+MXTPU_DLL extern int MXTPUExecutorBackward(MXTPUHandle handle, uint32_t len,
+                                 const MXTPUHandle* head_grads);
+MXTPU_DLL extern int MXTPUExecutorBackwardEx(MXTPUHandle handle, uint32_t len,
+                                   const MXTPUHandle* head_grads,
+                                   int is_train);
+MXTPU_DLL extern int MXTPUExecutorOutputs(MXTPUHandle handle, uint32_t* out_size,
+                                MXTPUHandle** out);
+/* grad_req_type uses grad_req codes; arg_grad_store entries may be 0
+ * for no-gradient arguments. */
+MXTPU_DLL extern int MXTPUExecutorBind(MXTPUHandle symbol_handle, int dev_type,
+                             int dev_id, uint32_t len,
+                             const MXTPUHandle* in_args,
+                             const MXTPUHandle* arg_grad_store,
+                             const uint32_t* grad_req_type, uint32_t aux_len,
+                             const MXTPUHandle* aux_states, MXTPUHandle* out);
+/* Group-to-context variants: the maps are accepted and recorded; XLA
+ * owns placement on the single-process device, so they do not change
+ * execution (documented narrowing). */
+MXTPU_DLL extern int MXTPUExecutorBindX(MXTPUHandle symbol_handle, int dev_type,
+                              int dev_id, uint32_t num_map_keys,
+                              const char** map_keys,
+                              const int* map_dev_types,
+                              const int* map_dev_ids, uint32_t len,
+                              const MXTPUHandle* in_args,
+                              const MXTPUHandle* arg_grad_store,
+                              const uint32_t* grad_req_type,
+                              uint32_t aux_len,
+                              const MXTPUHandle* aux_states,
+                              MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUExecutorBindEX(MXTPUHandle symbol_handle, int dev_type,
+                               int dev_id, uint32_t num_map_keys,
+                               const char** map_keys,
+                               const int* map_dev_types,
+                               const int* map_dev_ids, uint32_t len,
+                               const MXTPUHandle* in_args,
+                               const MXTPUHandle* arg_grad_store,
+                               const uint32_t* grad_req_type,
+                               uint32_t aux_len,
+                               const MXTPUHandle* aux_states,
+                               MXTPUHandle shared_exec, MXTPUHandle* out);
+/* Allocate-and-bind: shapes/dtypes/stypes/grad-reqs provided by name;
+ * returns the allocated in_args/arg_grads/aux_states handle arrays
+ * (pinned).  g2c maps and shared-buffer params are accepted for ABI
+ * parity; sharing is keyed by shared_exec (reference:
+ * MXExecutorSimpleBindEx). */
+MXTPU_DLL extern int MXTPUExecutorSimpleBind(
+    MXTPUHandle symbol_handle, int dev_type, int dev_id,
+    uint32_t num_g2c_keys, const char** g2c_keys, const int* g2c_dev_types,
+    const int* g2c_dev_ids, uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    uint32_t num_provided_arg_shapes, const char** provided_arg_shape_names,
+    const uint32_t* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx, uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    uint32_t num_provided_arg_stypes, const char** provided_arg_stype_names,
+    const int* provided_arg_stypes, uint32_t num_shared_arg_names,
+    const char** shared_arg_name_list, int* shared_buffer_len,
+    const char** shared_buffer_name_list,
+    const MXTPUHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    MXTPUHandle** updated_shared_buffer_handle_list, uint32_t* num_in_args,
+    MXTPUHandle** in_args, MXTPUHandle** arg_grads, uint32_t* num_aux_states,
+    MXTPUHandle** aux_states, MXTPUHandle shared_exec_handle,
+    MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUExecutorReshape(int partial_shaping, int allow_up_sizing,
+                                int dev_type, int dev_id,
+                                uint32_t num_map_keys, const char** map_keys,
+                                const int* map_dev_types,
+                                const int* map_dev_ids,
+                                uint32_t num_provided_arg_shapes,
+                                const char** provided_arg_shape_names,
+                                const uint32_t* provided_arg_shape_data,
+                                const uint32_t* provided_arg_shape_idx,
+                                uint32_t* num_in_args, MXTPUHandle** in_args,
+                                MXTPUHandle** arg_grads,
+                                uint32_t* num_aux_states,
+                                MXTPUHandle** aux_states,
+                                MXTPUHandle shared_exec, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUExecutorGetOptimizedSymbol(MXTPUHandle handle,
+                                           MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUExecutorSetMonitorCallback(MXTPUHandle handle,
+                                           MXTPUExecutorMonitorCallback cb,
+                                           void* callback_ctx);
+MXTPU_DLL extern int MXTPUExecutorSetMonitorCallbackEX(MXTPUHandle handle,
+                                             MXTPUExecutorMonitorCallback cb,
+                                             void* callback_ctx,
+                                             int monitor_all);
+
+/* ------------------------------------------------------------- cached op */
+/* (reference: MXCreateCachedOp(Ex)/MXInvokeCachedOp(Ex)/MXFreeCachedOp) */
+MXTPU_DLL extern int MXTPUCreateCachedOp(MXTPUHandle sym_handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUCreateCachedOpEx(MXTPUHandle sym_handle, int num_flags,
+                                 const char** keys, const char** vals,
+                                 MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUFreeCachedOp(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUInvokeCachedOp(MXTPUHandle handle, int num_inputs,
+                               const MXTPUHandle* inputs, int* num_outputs,
+                               MXTPUHandle** outputs);
+MXTPU_DLL extern int MXTPUInvokeCachedOpEx(MXTPUHandle handle, int num_inputs,
+                                 const MXTPUHandle* inputs, int* num_outputs,
+                                 MXTPUHandle** outputs,
+                                 const int** out_stypes);
+
+/* -------------------------------------------------------------- data iter */
+/* (reference: MXListDataIters .. MXDataIterGetPadNum,
+ *  src/c_api/c_api.cc io section) */
+MXTPU_DLL extern int MXTPUListDataIters(uint32_t* out_size, MXTPUHandle** out_array);
+MXTPU_DLL extern int MXTPUDataIterGetIterInfo(MXTPUHandle creator, const char** name,
+                                    const char** description,
+                                    uint32_t* num_args,
+                                    const char*** arg_names,
+                                    const char*** arg_types,
+                                    const char*** arg_descriptions);
+MXTPU_DLL extern int MXTPUDataIterCreateIter(MXTPUHandle creator, uint32_t num_param,
+                                   const char** keys, const char** vals,
+                                   MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUDataIterFree(MXTPUHandle handle);
+/* *out = 1 while more batches remain, 0 at epoch end. */
+MXTPU_DLL extern int MXTPUDataIterNext(MXTPUHandle handle, int* out);
+MXTPU_DLL extern int MXTPUDataIterBeforeFirst(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUDataIterGetData(MXTPUHandle handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUDataIterGetLabel(MXTPUHandle handle, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUDataIterGetIndex(MXTPUHandle handle, uint64_t** out_index,
+                                 uint64_t* out_size);
+MXTPU_DLL extern int MXTPUDataIterGetPadNum(MXTPUHandle handle, int* pad);
+
+/* --------------------------------------------------------------- kvstore */
+/* (reference: MXKVStoreCreate .. MXKVStoreGetNumDeadNode, MXInitPSEnv,
+ *  src/c_api/c_api.cc kvstore section) */
+typedef void (*MXTPUKVStoreUpdater)(int key, MXTPUHandle recv,
+                                    MXTPUHandle local, void* handle);
+typedef void (*MXTPUKVStoreStrUpdater)(const char* key, MXTPUHandle recv,
+                                       MXTPUHandle local, void* handle);
+typedef void (*MXTPUKVStoreServerController)(int head, const char* body,
+                                             void* controller_handle);
+MXTPU_DLL extern int MXTPUKVStoreCreate(const char* type, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUKVStoreFree(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUKVStoreInit(MXTPUHandle handle, uint32_t num, const int* keys,
+                            const MXTPUHandle* vals);
+MXTPU_DLL extern int MXTPUKVStoreInitEx(MXTPUHandle handle, uint32_t num,
+                              const char** keys, const MXTPUHandle* vals);
+MXTPU_DLL extern int MXTPUKVStorePush(MXTPUHandle handle, uint32_t num, const int* keys,
+                            const MXTPUHandle* vals, int priority);
+MXTPU_DLL extern int MXTPUKVStorePushEx(MXTPUHandle handle, uint32_t num,
+                              const char** keys, const MXTPUHandle* vals,
+                              int priority);
+MXTPU_DLL extern int MXTPUKVStorePull(MXTPUHandle handle, uint32_t num, const int* keys,
+                            MXTPUHandle* vals, int priority);
+MXTPU_DLL extern int MXTPUKVStorePullEx(MXTPUHandle handle, uint32_t num,
+                              const char** keys, MXTPUHandle* vals,
+                              int priority);
+MXTPU_DLL extern int MXTPUKVStorePullWithSparse(MXTPUHandle handle, uint32_t num,
+                                      const int* keys, MXTPUHandle* vals,
+                                      int priority, int ignore_sparse);
+MXTPU_DLL extern int MXTPUKVStorePullWithSparseEx(MXTPUHandle handle, uint32_t num,
+                                        const char** keys, MXTPUHandle* vals,
+                                        int priority, int ignore_sparse);
+MXTPU_DLL extern int MXTPUKVStorePullRowSparse(MXTPUHandle handle, uint32_t num,
+                                     const int* keys, MXTPUHandle* vals,
+                                     const MXTPUHandle* row_ids,
+                                     int priority);
+MXTPU_DLL extern int MXTPUKVStorePullRowSparseEx(MXTPUHandle handle, uint32_t num,
+                                       const char** keys, MXTPUHandle* vals,
+                                       const MXTPUHandle* row_ids,
+                                       int priority);
+MXTPU_DLL extern int MXTPUKVStoreSetUpdater(MXTPUHandle handle,
+                                  MXTPUKVStoreUpdater updater,
+                                  void* updater_handle);
+MXTPU_DLL extern int MXTPUKVStoreSetUpdaterEx(MXTPUHandle handle,
+                                    MXTPUKVStoreUpdater updater,
+                                    MXTPUKVStoreStrUpdater str_updater,
+                                    void* updater_handle);
+MXTPU_DLL extern int MXTPUKVStoreGetType(MXTPUHandle handle, const char** type);
+MXTPU_DLL extern int MXTPUKVStoreGetRank(MXTPUHandle handle, int* rank);
+MXTPU_DLL extern int MXTPUKVStoreGetGroupSize(MXTPUHandle handle, int* size);
+MXTPU_DLL extern int MXTPUKVStoreBarrier(MXTPUHandle handle);
+MXTPU_DLL extern int MXTPUKVStoreIsWorkerNode(int* out);
+MXTPU_DLL extern int MXTPUKVStoreIsServerNode(int* out);
+MXTPU_DLL extern int MXTPUKVStoreIsSchedulerNode(int* out);
+MXTPU_DLL extern int MXTPUKVStoreRunServer(MXTPUHandle handle,
+                                 MXTPUKVStoreServerController controller,
+                                 void* controller_handle);
+MXTPU_DLL extern int MXTPUKVStoreSendCommmandToServers(MXTPUHandle handle, int cmd_id,
+                                             const char* cmd_body);
+MXTPU_DLL extern int MXTPUKVStoreSetBarrierBeforeExit(MXTPUHandle handle,
+                                            int do_barrier);
+MXTPU_DLL extern int MXTPUKVStoreGetNumDeadNode(MXTPUHandle handle, int node_id,
+                                      int* number, int timeout_sec);
+MXTPU_DLL extern int MXTPUKVStoreSetGradientCompression(MXTPUHandle handle,
+                                              uint32_t num_params,
+                                              const char** keys,
+                                              const char** vals);
+MXTPU_DLL extern int MXTPUInitPSEnv(uint32_t num_vars, const char** keys,
+                          const char** vals);
+
+/* -------------------------------------------------------------- profiler */
+/* (reference: MXSetProfilerConfig .. MXProfileSetMarker,
+ *  src/c_api/c_api_profile.cc) */
+MXTPU_DLL extern int MXTPUSetProfilerConfig(int num_params, const char** keys,
+                                  const char** vals);
+MXTPU_DLL extern int MXTPUSetProcessProfilerConfig(int num_params, const char** keys,
+                                         const char** vals,
+                                         MXTPUHandle kvstore_handle);
+/* state: 0 stop, 1 run. */
+MXTPU_DLL extern int MXTPUSetProfilerState(int state);
+MXTPU_DLL extern int MXTPUSetProcessProfilerState(int state, int profile_process);
+MXTPU_DLL extern int MXTPUDumpProfile(int finished);
+MXTPU_DLL extern int MXTPUDumpProcessProfile(int finished, int profile_process);
+MXTPU_DLL extern int MXTPUAggregateProfileStatsPrint(const char** out_str, int reset);
+MXTPU_DLL extern int MXTPUProfilePause(int paused);
+MXTPU_DLL extern int MXTPUProcessProfilePause(int paused, int profile_process);
+MXTPU_DLL extern int MXTPUProfileCreateDomain(const char* domain, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUProfileCreateTask(MXTPUHandle domain, const char* task_name,
+                                  MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUProfileCreateFrame(MXTPUHandle domain, const char* frame_name,
+                                   MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUProfileCreateEvent(const char* event_name, MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUProfileCreateCounter(MXTPUHandle domain,
+                                     const char* counter_name,
+                                     MXTPUHandle* out);
+MXTPU_DLL extern int MXTPUProfileDestroyHandle(MXTPUHandle frame_handle);
+MXTPU_DLL extern int MXTPUProfileDurationStart(MXTPUHandle duration_handle);
+MXTPU_DLL extern int MXTPUProfileDurationStop(MXTPUHandle duration_handle);
+MXTPU_DLL extern int MXTPUProfileSetCounter(MXTPUHandle counter_handle, uint64_t value);
+MXTPU_DLL extern int MXTPUProfileAdjustCounter(MXTPUHandle counter_handle,
+                                     int64_t delta);
+MXTPU_DLL extern int MXTPUProfileSetMarker(MXTPUHandle domain, const char* instant_name,
+                                 const char* scope);
+
+/* ------------------------------------------------- native host runtime  */
+/* Engine / RecordIO / Pipeline groups: direct C++ (no interpreter) —
+ * declarations kept in sync with src/c_api.cc.  Reference analogs:
+ * engine push/wait (include/mxnet/engine.h), MXRecordIO*
+ * (include/mxnet/c_api.h), and the ImageRecordIter worker pipeline. */
+typedef int (*MXTPUEngineOpFn)(void* ctx, uint64_t op_id);
+MXTPU_DLL extern int MXTPUEngineCreate(int n_workers, int io_workers, void** out);
+MXTPU_DLL extern int MXTPUEngineFree(void* h);
+MXTPU_DLL extern int MXTPUEngineNewVar(void* h, uint64_t* out);
+MXTPU_DLL extern int MXTPUEngineDelVar(void* h, uint64_t var);
+MXTPU_DLL extern int MXTPUEnginePush(void* h, MXTPUEngineOpFn fn, void* ctx,
+                           const uint64_t* cvars, int ncv,
+                           const uint64_t* mvars, int nmv, int prop,
+                           const char* name, uint64_t* out_op_id);
+MXTPU_DLL extern int MXTPUEngineOnComplete(void* h, uint64_t op_id);
+MXTPU_DLL extern int MXTPUEngineOnCompleteError(void* h, uint64_t op_id,
+                                      const char* msg);
+MXTPU_DLL extern int MXTPUEngineWaitForVar(void* h, uint64_t var);
+MXTPU_DLL extern int MXTPUEngineWaitAll(void* h);
+MXTPU_DLL extern int MXTPUEngineNumPending(void* h, int64_t* out);
+MXTPU_DLL extern int MXTPURecordReaderCreate(const char* path, uint64_t chunk, int part,
+                                   int nparts, void** out);
+MXTPU_DLL extern int MXTPURecordReaderNext(void* h, const uint8_t** data,
+                                 uint32_t* size);
+MXTPU_DLL extern int MXTPURecordReaderReset(void* h);
+MXTPU_DLL extern int MXTPURecordReaderSeek(void* h, uint64_t pos);
+MXTPU_DLL extern int MXTPURecordReaderTell(void* h, uint64_t* pos);
+MXTPU_DLL extern int MXTPURecordReaderFree(void* h);
+MXTPU_DLL extern int MXTPURecordWriterCreate(const char* path, void** out);
+MXTPU_DLL extern int MXTPURecordWriterWrite(void* h, const uint8_t* data, uint32_t size,
+                                  uint64_t* out_pos);
+MXTPU_DLL extern int MXTPURecordWriterTell(void* h, uint64_t* pos);
+MXTPU_DLL extern int MXTPURecordWriterFree(void* h);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

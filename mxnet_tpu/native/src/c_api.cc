@@ -119,6 +119,18 @@ MXTPU_EXPORT int MXTPURecordReaderNext(void* h, const uint8_t** data,
   MXTPU_API_END();
 }
 
+MXTPU_EXPORT int MXTPURecordReaderSeek(void* h, uint64_t pos) {
+  MXTPU_API_BEGIN();
+  static_cast<RecordReader*>(h)->Seek(pos);
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordReaderTell(void* h, uint64_t* pos) {
+  MXTPU_API_BEGIN();
+  *pos = static_cast<RecordReader*>(h)->Tell();
+  MXTPU_API_END();
+}
+
 MXTPU_EXPORT int MXTPURecordReaderReset(void* h) {
   MXTPU_API_BEGIN();
   static_cast<RecordReader*>(h)->Reset();
@@ -142,6 +154,12 @@ MXTPU_EXPORT int MXTPURecordWriterWrite(void* h, const uint8_t* data,
   MXTPU_API_BEGIN();
   uint64_t pos = static_cast<RecordWriter*>(h)->Write(data, size);
   if (out_pos) *out_pos = pos;
+  MXTPU_API_END();
+}
+
+MXTPU_EXPORT int MXTPURecordWriterTell(void* h, uint64_t* pos) {
+  MXTPU_API_BEGIN();
+  *pos = static_cast<RecordWriter*>(h)->Tell();
   MXTPU_API_END();
 }
 

@@ -1,0 +1,1058 @@
+// Tensor-runtime C ABI (NDArray / op / autograd / Symbol / Executor /
+// CachedOp / DataIter / KVStore / profiler groups of mxtpu/c_api.h).
+//
+// Reference: src/c_api/{c_api.cc,c_api_symbolic.cc,c_api_executor.cc,
+// c_api_ndarray.cc,c_api_profile.cc} — there the C layer calls the C++
+// runtime directly.  Here the tensor runtime is jax/XLA reached through
+// the embedded interpreter (embed.h): each extern formats its raw
+// argument addresses into a call on mxnet_tpu._c_embed, which performs
+// ALL marshalling (reading C arrays, writing out-params, pinning
+// returned storage) with ctypes.  This file stays logic-free by design:
+// one semantic implementation lives in Python, the ABI is a transport.
+#include <string>
+
+#include "../include/mxtpu/c_api.h"
+#include "common.h"
+#include "embed.h"
+
+using mxtpu::EmbedArgs;
+
+namespace {
+void TCall(const char* fn, const EmbedArgs& a) {
+  mxtpu::EmbedCall("_c_embed", fn, a.str());
+}
+}  // namespace
+
+#define MXTPU_TCALL(fn, body)    \
+  MXTPU_API_BEGIN();             \
+  EmbedArgs a;                   \
+  body;                          \
+  TCall(fn, a);                  \
+  MXTPU_API_END()
+
+/* ------------------------------------------------------------------ base */
+
+int MXTPUGetVersion(int* out) {
+  MXTPU_TCALL("get_version", a.p(out));
+}
+
+int MXTPURandomSeed(int seed) {
+  MXTPU_TCALL("random_seed", a.i(seed));
+}
+
+int MXTPURandomSeedContext(int seed, int dev_type, int dev_id) {
+  MXTPU_TCALL("random_seed_context", a.i(seed).i(dev_type).i(dev_id));
+}
+
+int MXTPUNotifyShutdown(void) {
+  MXTPU_TCALL("notify_shutdown", (void)a);
+}
+
+int MXTPUSetNumOMPThreads(int nthreads) {
+  MXTPU_TCALL("set_num_omp_threads", a.i(nthreads));
+}
+
+int MXTPUEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  MXTPU_TCALL("engine_set_bulk_size", a.i(bulk_size).p(prev_bulk_size));
+}
+
+int MXTPUGetDeviceCount(int* out) {
+  MXTPU_TCALL("get_device_count", a.p(out));
+}
+
+int MXTPUGetDeviceMemoryInformation(int dev_id, uint64_t* free_mem,
+                                    uint64_t* total_mem) {
+  MXTPU_TCALL("get_device_memory_information",
+              a.i(dev_id).p(free_mem).p(total_mem));
+}
+
+int MXTPULibInfoFeatures(const char*** out_names, const int** out_enabled,
+                         uint64_t* out_size) {
+  MXTPU_TCALL("lib_info_features", a.p(out_names).p(out_enabled).p(out_size));
+}
+
+/* --------------------------------------------------------------- ndarray */
+
+int MXTPUNDArrayCreateNone(MXTPUHandle* out) {
+  MXTPU_TCALL("nd_create_none", a.p(out));
+}
+
+int MXTPUNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                       int dev_id, int delay_alloc, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_create", a.p(shape).u(ndim).i(dev_type).i(dev_id)
+                               .i(delay_alloc).i(0).p(out));
+}
+
+int MXTPUNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                         int dev_id, int delay_alloc, int dtype,
+                         MXTPUHandle* out) {
+  MXTPU_TCALL("nd_create", a.p(shape).u(ndim).i(dev_type).i(dev_id)
+                               .i(delay_alloc).i(dtype).p(out));
+}
+
+int MXTPUNDArrayFree(MXTPUHandle handle) {
+  MXTPU_TCALL("nd_free", a.u(handle));
+}
+
+int MXTPUNDArrayGetShape(MXTPUHandle handle, uint32_t* out_ndim,
+                         const uint32_t** out_pdata) {
+  MXTPU_TCALL("nd_get_shape", a.u(handle).p(out_ndim).p(out_pdata));
+}
+
+int MXTPUNDArrayGetDType(MXTPUHandle handle, int* out) {
+  MXTPU_TCALL("nd_get_dtype", a.u(handle).p(out));
+}
+
+int MXTPUNDArrayGetContext(MXTPUHandle handle, int* out_dev_type,
+                           int* out_dev_id) {
+  MXTPU_TCALL("nd_get_context", a.u(handle).p(out_dev_type).p(out_dev_id));
+}
+
+int MXTPUNDArrayGetData(MXTPUHandle handle, void** out_pdata) {
+  MXTPU_TCALL("nd_get_data", a.u(handle).p(out_pdata));
+}
+
+int MXTPUNDArraySyncCopyFromCPU(MXTPUHandle handle, const void* data,
+                                uint64_t size) {
+  MXTPU_TCALL("nd_sync_copy_from_cpu", a.u(handle).p(data).u(size));
+}
+
+int MXTPUNDArraySyncCopyToCPU(MXTPUHandle handle, void* data, uint64_t size) {
+  MXTPU_TCALL("nd_sync_copy_to_cpu", a.u(handle).p(data).u(size));
+}
+
+int MXTPUNDArraySyncCopyFromNDArray(MXTPUHandle dst, MXTPUHandle src, int i) {
+  MXTPU_TCALL("nd_sync_copy_from_ndarray", a.u(dst).u(src).i(i));
+}
+
+int MXTPUNDArraySlice(MXTPUHandle handle, uint32_t slice_begin,
+                      uint32_t slice_end, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_slice", a.u(handle).u(slice_begin).u(slice_end).p(out));
+}
+
+int MXTPUNDArrayAt(MXTPUHandle handle, uint32_t idx, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_at", a.u(handle).u(idx).p(out));
+}
+
+int MXTPUNDArrayReshape(MXTPUHandle handle, int ndim, const int* dims,
+                        MXTPUHandle* out) {
+  MXTPU_TCALL("nd_reshape", a.u(handle).i(ndim).p(dims).i(0).p(out));
+}
+
+int MXTPUNDArrayReshape64(MXTPUHandle handle, int ndim, const int64_t* dims,
+                          int reverse, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_reshape64", a.u(handle).i(ndim).p(dims).i(reverse).p(out));
+}
+
+int MXTPUNDArrayDetach(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_detach", a.u(handle).p(out));
+}
+
+int MXTPUNDArraySetGradState(MXTPUHandle handle, int state) {
+  MXTPU_TCALL("nd_set_grad_state", a.u(handle).i(state));
+}
+
+int MXTPUNDArrayGetGradState(MXTPUHandle handle, int* out) {
+  MXTPU_TCALL("nd_get_grad_state", a.u(handle).p(out));
+}
+
+int MXTPUNDArrayGetGrad(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_get_grad", a.u(handle).p(out));
+}
+
+int MXTPUNDArrayWaitToRead(MXTPUHandle handle) {
+  MXTPU_TCALL("nd_wait_to_read", a.u(handle));
+}
+
+int MXTPUNDArrayWaitToWrite(MXTPUHandle handle) {
+  MXTPU_TCALL("nd_wait_to_write", a.u(handle));
+}
+
+int MXTPUNDArrayWaitAll(void) {
+  MXTPU_TCALL("nd_wait_all", (void)a);
+}
+
+int MXTPUNDArraySave(const char* fname, uint32_t num_args,
+                     const MXTPUHandle* args, const char** keys) {
+  MXTPU_TCALL("nd_save", a.p(fname).u(num_args).p(args).p(keys));
+}
+
+int MXTPUNDArrayLoad(const char* fname, uint32_t* out_size,
+                     MXTPUHandle** out_arr, uint32_t* out_name_size,
+                     const char*** out_names) {
+  MXTPU_TCALL("nd_load",
+              a.p(fname).p(out_size).p(out_arr).p(out_name_size).p(out_names));
+}
+
+int MXTPUNDArrayLoadFromBuffer(const void* ndarray_buffer, uint64_t size,
+                               uint32_t* out_size, MXTPUHandle** out_arr,
+                               uint32_t* out_name_size,
+                               const char*** out_names) {
+  MXTPU_TCALL("nd_load_from_buffer", a.p(ndarray_buffer).u(size).p(out_size)
+                                         .p(out_arr).p(out_name_size)
+                                         .p(out_names));
+}
+
+int MXTPUNDArraySaveRawBytes(MXTPUHandle handle, uint64_t* out_size,
+                             const char** out_buf) {
+  MXTPU_TCALL("nd_save_raw_bytes", a.u(handle).p(out_size).p(out_buf));
+}
+
+int MXTPUNDArrayLoadFromRawBytes(const void* buf, uint64_t size,
+                                 MXTPUHandle* out) {
+  MXTPU_TCALL("nd_load_from_raw_bytes", a.p(buf).u(size).p(out));
+}
+
+int MXTPUNDArrayGetStorageType(MXTPUHandle handle, int* out) {
+  MXTPU_TCALL("nd_get_storage_type", a.u(handle).p(out));
+}
+
+int MXTPUNDArrayCreateSparseEx(int storage_type, const uint32_t* shape,
+                               uint32_t ndim, int dev_type, int dev_id,
+                               int delay_alloc, int dtype, uint32_t num_aux,
+                               const int* aux_type, const uint32_t* aux_ndims,
+                               const uint32_t* aux_shape, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_create_sparse",
+              a.i(storage_type).p(shape).u(ndim).i(dev_type).i(dev_id)
+                  .i(delay_alloc).i(dtype).u(num_aux).p(aux_type)
+                  .p(aux_ndims).p(aux_shape).p(out));
+}
+
+int MXTPUNDArrayGetAuxType(MXTPUHandle handle, uint32_t i, int* out) {
+  MXTPU_TCALL("nd_get_aux_type", a.u(handle).u(i).p(out));
+}
+
+int MXTPUNDArrayGetAuxNDArray(MXTPUHandle handle, uint32_t i,
+                              MXTPUHandle* out) {
+  MXTPU_TCALL("nd_get_aux_ndarray", a.u(handle).u(i).p(out));
+}
+
+int MXTPUNDArrayGetDataNDArray(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_get_data_ndarray", a.u(handle).p(out));
+}
+
+int MXTPUNDArraySyncCheckFormat(MXTPUHandle handle, int full_check) {
+  MXTPU_TCALL("nd_sync_check_format", a.u(handle).i(full_check));
+}
+
+int MXTPUNDArrayToDLPack(MXTPUHandle handle, void** out_dlmanaged) {
+  MXTPU_TCALL("nd_to_dlpack", a.u(handle).p(out_dlmanaged));
+}
+
+int MXTPUNDArrayFromDLPack(void* dlmanaged, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_from_dlpack", a.p(dlmanaged).p(out));
+}
+
+int MXTPUNDArrayCallDLPackDeleter(void* dlmanaged) {
+  MXTPU_TCALL("nd_call_dlpack_deleter", a.p(dlmanaged));
+}
+
+int MXTPUNDArrayGetSharedMemHandle(MXTPUHandle handle, int* shared_pid,
+                                   int* shared_id) {
+  MXTPU_TCALL("nd_get_shared_mem_handle",
+              a.u(handle).p(shared_pid).p(shared_id));
+}
+
+int MXTPUNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                    const uint32_t* shape, uint32_t ndim,
+                                    int dtype, MXTPUHandle* out) {
+  MXTPU_TCALL("nd_create_from_shared_mem",
+              a.i(shared_pid).i(shared_id).p(shape).u(ndim).i(dtype).p(out));
+}
+
+/* ------------------------------------------------- ops & imperative call */
+
+int MXTPUListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  MXTPU_TCALL("list_all_op_names", a.p(out_size).p(out_array));
+}
+
+int MXTPUGetOpHandle(const char* op_name, MXTPUHandle* out) {
+  MXTPU_TCALL("get_op_handle", a.p(op_name).p(out));
+}
+
+int MXTPUGetOpInfo(MXTPUHandle op, const char** name,
+                   const char** description, uint32_t* num_args,
+                   const char*** arg_names, const char*** arg_types,
+                   const char*** arg_descriptions, const char** return_type) {
+  MXTPU_TCALL("get_op_info", a.u(op).p(name).p(description).p(num_args)
+                                 .p(arg_names).p(arg_types)
+                                 .p(arg_descriptions).p(return_type));
+}
+
+int MXTPUImperativeInvoke(MXTPUHandle op, int num_inputs,
+                          const MXTPUHandle* inputs, int* num_outputs,
+                          MXTPUHandle** outputs, int num_params,
+                          const char** param_keys, const char** param_vals) {
+  MXTPU_TCALL("imperative_invoke",
+              a.u(op).i(num_inputs).p(inputs).p(num_outputs).p(outputs)
+                  .i(num_params).p(param_keys).p(param_vals));
+}
+
+int MXTPUListFunctions(uint32_t* out_size, MXTPUHandle** out_array) {
+  MXTPU_TCALL("list_functions", a.p(out_size).p(out_array));
+}
+
+int MXTPUGetFunction(const char* name, MXTPUHandle* out) {
+  MXTPU_TCALL("get_op_handle", a.p(name).p(out));
+}
+
+int MXTPUFuncGetInfo(MXTPUHandle fun, const char** name,
+                     const char** description, uint32_t* num_args,
+                     const char*** arg_names, const char*** arg_types,
+                     const char*** arg_descriptions,
+                     const char** return_type) {
+  MXTPU_TCALL("get_op_info", a.u(fun).p(name).p(description).p(num_args)
+                                 .p(arg_names).p(arg_types)
+                                 .p(arg_descriptions).p(return_type));
+}
+
+int MXTPUFuncInvoke(MXTPUHandle fun, const MXTPUHandle* use_vars,
+                    const float* scalar_args, const MXTPUHandle* mutate_vars,
+                    int num_use, int num_scalar, int num_mutate) {
+  MXTPU_TCALL("func_invoke", a.u(fun).p(use_vars).p(scalar_args)
+                                 .p(mutate_vars).i(num_use).i(num_scalar)
+                                 .i(num_mutate).i(0).u(0).u(0));
+}
+
+int MXTPUFuncInvokeEx(MXTPUHandle fun, const MXTPUHandle* use_vars,
+                      const float* scalar_args, const MXTPUHandle* mutate_vars,
+                      int num_use, int num_scalar, int num_mutate,
+                      int num_params, const char** param_keys,
+                      const char** param_vals) {
+  MXTPU_TCALL("func_invoke", a.u(fun).p(use_vars).p(scalar_args)
+                                 .p(mutate_vars).i(num_use).i(num_scalar)
+                                 .i(num_mutate).i(num_params).p(param_keys)
+                                 .p(param_vals));
+}
+
+/* -------------------------------------------------------------- autograd */
+
+int MXTPUAutogradSetIsRecording(int is_recording, int* prev) {
+  MXTPU_TCALL("autograd_set_is_recording", a.i(is_recording).p(prev));
+}
+
+int MXTPUAutogradSetIsTraining(int is_training, int* prev) {
+  MXTPU_TCALL("autograd_set_is_training", a.i(is_training).p(prev));
+}
+
+int MXTPUAutogradIsRecording(int* curr) {
+  MXTPU_TCALL("autograd_is_recording", a.p(curr));
+}
+
+int MXTPUAutogradIsTraining(int* curr) {
+  MXTPU_TCALL("autograd_is_training", a.p(curr));
+}
+
+int MXTPUAutogradMarkVariables(uint32_t num_var,
+                               const MXTPUHandle* var_handles,
+                               const uint32_t* reqs_array,
+                               const MXTPUHandle* grad_handles) {
+  MXTPU_TCALL("autograd_mark_variables",
+              a.u(num_var).p(var_handles).p(reqs_array).p(grad_handles));
+}
+
+int MXTPUAutogradBackward(uint32_t num_output,
+                          const MXTPUHandle* output_handles,
+                          const MXTPUHandle* ograd_handles, int retain_graph) {
+  MXTPU_TCALL("autograd_backward",
+              a.u(num_output).p(output_handles).p(ograd_handles).u(0).u(0)
+                  .i(retain_graph).i(0).i(1).u(0).u(0));
+}
+
+int MXTPUAutogradBackwardEx(uint32_t num_output,
+                            const MXTPUHandle* output_handles,
+                            const MXTPUHandle* ograd_handles,
+                            uint32_t num_variables,
+                            const MXTPUHandle* var_handles, int retain_graph,
+                            int create_graph, int is_train,
+                            MXTPUHandle** grad_handles,
+                            const int** grad_stypes) {
+  MXTPU_TCALL("autograd_backward",
+              a.u(num_output).p(output_handles).p(ograd_handles)
+                  .u(num_variables).p(var_handles).i(retain_graph)
+                  .i(create_graph).i(is_train).p(grad_handles)
+                  .p(grad_stypes));
+}
+
+int MXTPUAutogradComputeGradient(uint32_t num_output,
+                                 const MXTPUHandle* output_handles) {
+  MXTPU_TCALL("autograd_backward",
+              a.u(num_output).p(output_handles).u(0).u(0).u(0).i(0).i(0).i(1)
+                  .u(0).u(0));
+}
+
+int MXTPUAutogradGetSymbol(MXTPUHandle ndhandle, MXTPUHandle* out) {
+  MXTPU_TCALL("autograd_get_symbol", a.u(ndhandle).p(out));
+}
+
+/* ---------------------------------------------------------------- symbol */
+
+int MXTPUSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                        MXTPUHandle** out_array) {
+  MXTPU_TCALL("list_functions", a.p(out_size).p(out_array));
+}
+
+int MXTPUSymbolGetAtomicSymbolName(MXTPUHandle creator, const char** name) {
+  MXTPU_TCALL("sym_get_atomic_symbol_name", a.u(creator).p(name));
+}
+
+int MXTPUSymbolGetAtomicSymbolInfo(MXTPUHandle creator, const char** name,
+                                   const char** description,
+                                   uint32_t* num_args,
+                                   const char*** arg_names,
+                                   const char*** arg_types,
+                                   const char*** arg_descriptions,
+                                   const char** key_var_num_args,
+                                   const char** return_type) {
+  MXTPU_TCALL("sym_get_atomic_symbol_info",
+              a.u(creator).p(name).p(description).p(num_args).p(arg_names)
+                  .p(arg_types).p(arg_descriptions).p(key_var_num_args)
+                  .p(return_type));
+}
+
+int MXTPUSymbolCreateAtomicSymbol(MXTPUHandle creator, uint32_t num_param,
+                                  const char** keys, const char** vals,
+                                  MXTPUHandle* out) {
+  MXTPU_TCALL("sym_create_atomic_symbol",
+              a.u(creator).u(num_param).p(keys).p(vals).p(out));
+}
+
+int MXTPUSymbolCreateVariable(const char* name, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_create_variable", a.p(name).p(out));
+}
+
+int MXTPUSymbolCreateGroup(uint32_t num_symbols, const MXTPUHandle* symbols,
+                           MXTPUHandle* out) {
+  MXTPU_TCALL("sym_create_group", a.u(num_symbols).p(symbols).p(out));
+}
+
+int MXTPUSymbolCreateFromFile(const char* fname, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_create_from_file", a.p(fname).p(out));
+}
+
+int MXTPUSymbolCreateFromJSON(const char* json, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_create_from_json", a.p(json).p(out));
+}
+
+int MXTPUSymbolSaveToFile(MXTPUHandle symbol, const char* fname) {
+  MXTPU_TCALL("sym_save_to_file", a.u(symbol).p(fname));
+}
+
+int MXTPUSymbolSaveToJSON(MXTPUHandle symbol, const char** out_json) {
+  MXTPU_TCALL("sym_save_to_json", a.u(symbol).p(out_json));
+}
+
+int MXTPUSymbolFree(MXTPUHandle symbol) {
+  MXTPU_TCALL("sym_free", a.u(symbol));
+}
+
+int MXTPUSymbolCopy(MXTPUHandle symbol, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_copy", a.u(symbol).p(out));
+}
+
+int MXTPUSymbolPrint(MXTPUHandle symbol, const char** out_str) {
+  MXTPU_TCALL("sym_print", a.u(symbol).p(out_str));
+}
+
+int MXTPUSymbolGetName(MXTPUHandle symbol, const char** out, int* success) {
+  MXTPU_TCALL("sym_get_name", a.u(symbol).p(out).p(success));
+}
+
+int MXTPUSymbolGetAttr(MXTPUHandle symbol, const char* key, const char** out,
+                       int* success) {
+  MXTPU_TCALL("sym_get_attr", a.u(symbol).p(key).p(out).p(success));
+}
+
+int MXTPUSymbolSetAttr(MXTPUHandle symbol, const char* key,
+                       const char* value) {
+  MXTPU_TCALL("sym_set_attr", a.u(symbol).p(key).p(value));
+}
+
+int MXTPUSymbolListAttr(MXTPUHandle symbol, uint32_t* out_size,
+                        const char*** out) {
+  MXTPU_TCALL("sym_list_attr", a.u(symbol).i(0).p(out_size).p(out));
+}
+
+int MXTPUSymbolListAttrShallow(MXTPUHandle symbol, uint32_t* out_size,
+                               const char*** out) {
+  MXTPU_TCALL("sym_list_attr", a.u(symbol).i(1).p(out_size).p(out));
+}
+
+int MXTPUSymbolListArguments(MXTPUHandle symbol, uint32_t* out_size,
+                             const char*** out_str_array) {
+  MXTPU_TCALL("sym_list_arguments", a.u(symbol).p(out_size).p(out_str_array));
+}
+
+int MXTPUSymbolListOutputs(MXTPUHandle symbol, uint32_t* out_size,
+                           const char*** out_str_array) {
+  MXTPU_TCALL("sym_list_outputs", a.u(symbol).p(out_size).p(out_str_array));
+}
+
+int MXTPUSymbolListAuxiliaryStates(MXTPUHandle symbol, uint32_t* out_size,
+                                   const char*** out_str_array) {
+  MXTPU_TCALL("sym_list_auxiliary_states",
+              a.u(symbol).p(out_size).p(out_str_array));
+}
+
+int MXTPUSymbolGetNumOutputs(MXTPUHandle symbol, uint32_t* output_count) {
+  MXTPU_TCALL("sym_get_num_outputs", a.u(symbol).p(output_count));
+}
+
+int MXTPUSymbolGetInternals(MXTPUHandle symbol, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_get_internals", a.u(symbol).p(out));
+}
+
+int MXTPUSymbolGetChildren(MXTPUHandle symbol, MXTPUHandle* out) {
+  MXTPU_TCALL("sym_get_children", a.u(symbol).p(out));
+}
+
+int MXTPUSymbolGetOutput(MXTPUHandle symbol, uint32_t index,
+                         MXTPUHandle* out) {
+  MXTPU_TCALL("sym_get_output", a.u(symbol).u(index).p(out));
+}
+
+int MXTPUSymbolGetInputSymbols(MXTPUHandle symbol, MXTPUHandle** out_handles,
+                               uint32_t* out_size) {
+  MXTPU_TCALL("sym_get_input_symbols", a.u(symbol).p(out_handles).p(out_size));
+}
+
+int MXTPUSymbolCompose(MXTPUHandle symbol, const char* name,
+                       uint32_t num_args, const char** keys,
+                       const MXTPUHandle* args) {
+  MXTPU_TCALL("sym_compose", a.u(symbol).p(name).u(num_args).p(keys).p(args));
+}
+
+int MXTPUSymbolInferShape(MXTPUHandle sym, uint32_t num_args,
+                          const char** keys, const uint32_t* arg_ind_ptr,
+                          const uint32_t* arg_shape_data,
+                          uint32_t* in_shape_size,
+                          const uint32_t** in_shape_ndim,
+                          const uint32_t*** in_shape_data,
+                          uint32_t* out_shape_size,
+                          const uint32_t** out_shape_ndim,
+                          const uint32_t*** out_shape_data,
+                          uint32_t* aux_shape_size,
+                          const uint32_t** aux_shape_ndim,
+                          const uint32_t*** aux_shape_data, int* complete) {
+  MXTPU_TCALL("sym_infer_shape",
+              a.u(sym).i(0).u(num_args).p(keys).p(arg_ind_ptr)
+                  .p(arg_shape_data).p(in_shape_size).p(in_shape_ndim)
+                  .p(in_shape_data).p(out_shape_size).p(out_shape_ndim)
+                  .p(out_shape_data).p(aux_shape_size).p(aux_shape_ndim)
+                  .p(aux_shape_data).p(complete));
+}
+
+int MXTPUSymbolInferShapePartial(
+    MXTPUHandle sym, uint32_t num_args, const char** keys,
+    const uint32_t* arg_ind_ptr, const uint32_t* arg_shape_data,
+    uint32_t* in_shape_size, const uint32_t** in_shape_ndim,
+    const uint32_t*** in_shape_data, uint32_t* out_shape_size,
+    const uint32_t** out_shape_ndim, const uint32_t*** out_shape_data,
+    uint32_t* aux_shape_size, const uint32_t** aux_shape_ndim,
+    const uint32_t*** aux_shape_data, int* complete) {
+  MXTPU_TCALL("sym_infer_shape",
+              a.u(sym).i(1).u(num_args).p(keys).p(arg_ind_ptr)
+                  .p(arg_shape_data).p(in_shape_size).p(in_shape_ndim)
+                  .p(in_shape_data).p(out_shape_size).p(out_shape_ndim)
+                  .p(out_shape_data).p(aux_shape_size).p(aux_shape_ndim)
+                  .p(aux_shape_data).p(complete));
+}
+
+int MXTPUSymbolInferType(MXTPUHandle sym, uint32_t num_args,
+                         const char** keys, const int* arg_type_data,
+                         uint32_t* in_type_size, const int** in_type_data,
+                         uint32_t* out_type_size, const int** out_type_data,
+                         uint32_t* aux_type_size, const int** aux_type_data,
+                         int* complete) {
+  MXTPU_TCALL("sym_infer_type",
+              a.u(sym).u(num_args).p(keys).p(arg_type_data).p(in_type_size)
+                  .p(in_type_data).p(out_type_size).p(out_type_data)
+                  .p(aux_type_size).p(aux_type_data).p(complete));
+}
+
+int MXTPUQuantizeSymbol(MXTPUHandle sym, MXTPUHandle* out,
+                        uint32_t num_excluded,
+                        const char** excluded_op_names,
+                        const char* quantized_dtype) {
+  MXTPU_TCALL("quantize_symbol", a.u(sym).p(out).u(num_excluded)
+                                     .p(excluded_op_names)
+                                     .p(quantized_dtype));
+}
+
+int MXTPUSetCalibTableToQuantizedSymbol(MXTPUHandle qsym, uint32_t num_layers,
+                                        const char** layer_names,
+                                        const float* low_quantiles,
+                                        const float* high_quantiles,
+                                        MXTPUHandle* out) {
+  MXTPU_TCALL("set_calib_table_to_quantized_symbol",
+              a.u(qsym).u(num_layers).p(layer_names).p(low_quantiles)
+                  .p(high_quantiles).p(out));
+}
+
+int MXTPUGenBackendSubgraph(MXTPUHandle sym, const char* backend,
+                            MXTPUHandle* out) {
+  MXTPU_TCALL("gen_backend_subgraph", a.u(sym).p(backend).p(out));
+}
+
+/* -------------------------------------------------------------- executor */
+
+int MXTPUExecutorFree(MXTPUHandle handle) {
+  MXTPU_TCALL("exec_free", a.u(handle));
+}
+
+int MXTPUExecutorPrint(MXTPUHandle handle, const char** out_str) {
+  MXTPU_TCALL("exec_print", a.u(handle).p(out_str));
+}
+
+int MXTPUExecutorForward(MXTPUHandle handle, int is_train) {
+  MXTPU_TCALL("exec_forward", a.u(handle).i(is_train));
+}
+
+int MXTPUExecutorBackward(MXTPUHandle handle, uint32_t len,
+                          const MXTPUHandle* head_grads) {
+  MXTPU_TCALL("exec_backward", a.u(handle).u(len).p(head_grads).i(1));
+}
+
+int MXTPUExecutorBackwardEx(MXTPUHandle handle, uint32_t len,
+                            const MXTPUHandle* head_grads, int is_train) {
+  MXTPU_TCALL("exec_backward", a.u(handle).u(len).p(head_grads).i(is_train));
+}
+
+int MXTPUExecutorOutputs(MXTPUHandle handle, uint32_t* out_size,
+                         MXTPUHandle** out) {
+  MXTPU_TCALL("exec_outputs", a.u(handle).p(out_size).p(out));
+}
+
+int MXTPUExecutorBind(MXTPUHandle symbol_handle, int dev_type, int dev_id,
+                      uint32_t len, const MXTPUHandle* in_args,
+                      const MXTPUHandle* arg_grad_store,
+                      const uint32_t* grad_req_type, uint32_t aux_len,
+                      const MXTPUHandle* aux_states, MXTPUHandle* out) {
+  MXTPU_TCALL("exec_bind",
+              a.u(symbol_handle).i(dev_type).i(dev_id).u(len).p(in_args)
+                  .p(arg_grad_store).p(grad_req_type).u(aux_len)
+                  .p(aux_states).u(0).p(out));
+}
+
+int MXTPUExecutorBindX(MXTPUHandle symbol_handle, int dev_type, int dev_id,
+                       uint32_t num_map_keys, const char** map_keys,
+                       const int* map_dev_types, const int* map_dev_ids,
+                       uint32_t len, const MXTPUHandle* in_args,
+                       const MXTPUHandle* arg_grad_store,
+                       const uint32_t* grad_req_type, uint32_t aux_len,
+                       const MXTPUHandle* aux_states, MXTPUHandle* out) {
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types; (void)map_dev_ids;
+  return MXTPUExecutorBind(symbol_handle, dev_type, dev_id, len, in_args,
+                           arg_grad_store, grad_req_type, aux_len, aux_states,
+                           out);
+}
+
+int MXTPUExecutorBindEX(MXTPUHandle symbol_handle, int dev_type, int dev_id,
+                        uint32_t num_map_keys, const char** map_keys,
+                        const int* map_dev_types, const int* map_dev_ids,
+                        uint32_t len, const MXTPUHandle* in_args,
+                        const MXTPUHandle* arg_grad_store,
+                        const uint32_t* grad_req_type, uint32_t aux_len,
+                        const MXTPUHandle* aux_states, MXTPUHandle shared_exec,
+                        MXTPUHandle* out) {
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types; (void)map_dev_ids;
+  MXTPU_TCALL("exec_bind",
+              a.u(symbol_handle).i(dev_type).i(dev_id).u(len).p(in_args)
+                  .p(arg_grad_store).p(grad_req_type).u(aux_len)
+                  .p(aux_states).u(shared_exec).p(out));
+}
+
+int MXTPUExecutorSimpleBind(
+    MXTPUHandle symbol_handle, int dev_type, int dev_id,
+    uint32_t num_g2c_keys, const char** g2c_keys, const int* g2c_dev_types,
+    const int* g2c_dev_ids, uint32_t provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types, uint32_t num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const uint32_t* provided_arg_shape_data,
+    const uint32_t* provided_arg_shape_idx, uint32_t num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    uint32_t num_provided_arg_stypes, const char** provided_arg_stype_names,
+    const int* provided_arg_stypes, uint32_t num_shared_arg_names,
+    const char** shared_arg_name_list, int* shared_buffer_len,
+    const char** shared_buffer_name_list,
+    const MXTPUHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    MXTPUHandle** updated_shared_buffer_handle_list, uint32_t* num_in_args,
+    MXTPUHandle** in_args, MXTPUHandle** arg_grads, uint32_t* num_aux_states,
+    MXTPUHandle** aux_states, MXTPUHandle shared_exec_handle,
+    MXTPUHandle* out) {
+  (void)num_g2c_keys; (void)g2c_keys; (void)g2c_dev_types; (void)g2c_dev_ids;
+  MXTPU_TCALL("exec_simple_bind",
+              a.u(symbol_handle).i(dev_type).i(dev_id)
+                  .u(provided_grad_req_list_len).p(provided_grad_req_names)
+                  .p(provided_grad_req_types).u(num_provided_arg_shapes)
+                  .p(provided_arg_shape_names).p(provided_arg_shape_data)
+                  .p(provided_arg_shape_idx).u(num_provided_arg_dtypes)
+                  .p(provided_arg_dtype_names).p(provided_arg_dtypes)
+                  .u(num_provided_arg_stypes).p(provided_arg_stype_names)
+                  .p(provided_arg_stypes).u(num_shared_arg_names)
+                  .p(shared_arg_name_list).p(shared_buffer_len)
+                  .p(shared_buffer_name_list).p(shared_buffer_handle_list)
+                  .p(updated_shared_buffer_name_list)
+                  .p(updated_shared_buffer_handle_list).p(num_in_args)
+                  .p(in_args).p(arg_grads).p(num_aux_states).p(aux_states)
+                  .u(shared_exec_handle).p(out));
+}
+
+int MXTPUExecutorReshape(int partial_shaping, int allow_up_sizing,
+                         int dev_type, int dev_id, uint32_t num_map_keys,
+                         const char** map_keys, const int* map_dev_types,
+                         const int* map_dev_ids,
+                         uint32_t num_provided_arg_shapes,
+                         const char** provided_arg_shape_names,
+                         const uint32_t* provided_arg_shape_data,
+                         const uint32_t* provided_arg_shape_idx,
+                         uint32_t* num_in_args, MXTPUHandle** in_args,
+                         MXTPUHandle** arg_grads, uint32_t* num_aux_states,
+                         MXTPUHandle** aux_states, MXTPUHandle shared_exec,
+                         MXTPUHandle* out) {
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types; (void)map_dev_ids;
+  MXTPU_TCALL("exec_reshape",
+              a.i(partial_shaping).i(allow_up_sizing).i(dev_type).i(dev_id)
+                  .u(num_provided_arg_shapes).p(provided_arg_shape_names)
+                  .p(provided_arg_shape_data).p(provided_arg_shape_idx)
+                  .p(num_in_args).p(in_args).p(arg_grads).p(num_aux_states)
+                  .p(aux_states).u(shared_exec).p(out));
+}
+
+int MXTPUExecutorGetOptimizedSymbol(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("exec_get_optimized_symbol", a.u(handle).p(out));
+}
+
+int MXTPUExecutorSetMonitorCallback(MXTPUHandle handle,
+                                    MXTPUExecutorMonitorCallback cb,
+                                    void* callback_ctx) {
+  MXTPU_TCALL("exec_set_monitor_callback",
+              a.u(handle).p((void*)cb).p(callback_ctx).i(0));
+}
+
+int MXTPUExecutorSetMonitorCallbackEX(MXTPUHandle handle,
+                                      MXTPUExecutorMonitorCallback cb,
+                                      void* callback_ctx, int monitor_all) {
+  MXTPU_TCALL("exec_set_monitor_callback",
+              a.u(handle).p((void*)cb).p(callback_ctx).i(monitor_all));
+}
+
+/* ------------------------------------------------------------- cached op */
+
+int MXTPUCreateCachedOp(MXTPUHandle sym_handle, MXTPUHandle* out) {
+  MXTPU_TCALL("create_cached_op", a.u(sym_handle).i(0).u(0).u(0).p(out));
+}
+
+int MXTPUCreateCachedOpEx(MXTPUHandle sym_handle, int num_flags,
+                          const char** keys, const char** vals,
+                          MXTPUHandle* out) {
+  MXTPU_TCALL("create_cached_op",
+              a.u(sym_handle).i(num_flags).p(keys).p(vals).p(out));
+}
+
+int MXTPUFreeCachedOp(MXTPUHandle handle) {
+  MXTPU_TCALL("free_cached_op", a.u(handle));
+}
+
+int MXTPUInvokeCachedOp(MXTPUHandle handle, int num_inputs,
+                        const MXTPUHandle* inputs, int* num_outputs,
+                        MXTPUHandle** outputs) {
+  MXTPU_TCALL("invoke_cached_op",
+              a.u(handle).i(num_inputs).p(inputs).p(num_outputs).p(outputs)
+                  .u(0));
+}
+
+int MXTPUInvokeCachedOpEx(MXTPUHandle handle, int num_inputs,
+                          const MXTPUHandle* inputs, int* num_outputs,
+                          MXTPUHandle** outputs, const int** out_stypes) {
+  MXTPU_TCALL("invoke_cached_op",
+              a.u(handle).i(num_inputs).p(inputs).p(num_outputs).p(outputs)
+                  .p(out_stypes));
+}
+
+/* ------------------------------------------------------------- data iter */
+
+int MXTPUListDataIters(uint32_t* out_size, MXTPUHandle** out_array) {
+  MXTPU_TCALL("list_data_iters", a.p(out_size).p(out_array));
+}
+
+int MXTPUDataIterGetIterInfo(MXTPUHandle creator, const char** name,
+                             const char** description, uint32_t* num_args,
+                             const char*** arg_names, const char*** arg_types,
+                             const char*** arg_descriptions) {
+  MXTPU_TCALL("data_iter_get_iter_info",
+              a.u(creator).p(name).p(description).p(num_args).p(arg_names)
+                  .p(arg_types).p(arg_descriptions));
+}
+
+int MXTPUDataIterCreateIter(MXTPUHandle creator, uint32_t num_param,
+                            const char** keys, const char** vals,
+                            MXTPUHandle* out) {
+  MXTPU_TCALL("data_iter_create",
+              a.u(creator).u(num_param).p(keys).p(vals).p(out));
+}
+
+int MXTPUDataIterFree(MXTPUHandle handle) {
+  MXTPU_TCALL("data_iter_free", a.u(handle));
+}
+
+int MXTPUDataIterNext(MXTPUHandle handle, int* out) {
+  MXTPU_TCALL("data_iter_next", a.u(handle).p(out));
+}
+
+int MXTPUDataIterBeforeFirst(MXTPUHandle handle) {
+  MXTPU_TCALL("data_iter_before_first", a.u(handle));
+}
+
+int MXTPUDataIterGetData(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("data_iter_get_data", a.u(handle).p(out));
+}
+
+int MXTPUDataIterGetLabel(MXTPUHandle handle, MXTPUHandle* out) {
+  MXTPU_TCALL("data_iter_get_label", a.u(handle).p(out));
+}
+
+int MXTPUDataIterGetIndex(MXTPUHandle handle, uint64_t** out_index,
+                          uint64_t* out_size) {
+  MXTPU_TCALL("data_iter_get_index", a.u(handle).p(out_index).p(out_size));
+}
+
+int MXTPUDataIterGetPadNum(MXTPUHandle handle, int* pad) {
+  MXTPU_TCALL("data_iter_get_pad_num", a.u(handle).p(pad));
+}
+
+/* --------------------------------------------------------------- kvstore */
+
+int MXTPUKVStoreCreate(const char* type, MXTPUHandle* out) {
+  MXTPU_TCALL("kv_create", a.p(type).p(out));
+}
+
+int MXTPUKVStoreFree(MXTPUHandle handle) {
+  MXTPU_TCALL("kv_free", a.u(handle));
+}
+
+int MXTPUKVStoreInit(MXTPUHandle handle, uint32_t num, const int* keys,
+                     const MXTPUHandle* vals) {
+  MXTPU_TCALL("kv_init", a.u(handle).u(num).p(keys).i(0).p(vals));
+}
+
+int MXTPUKVStoreInitEx(MXTPUHandle handle, uint32_t num, const char** keys,
+                       const MXTPUHandle* vals) {
+  MXTPU_TCALL("kv_init", a.u(handle).u(num).p(keys).i(1).p(vals));
+}
+
+int MXTPUKVStorePush(MXTPUHandle handle, uint32_t num, const int* keys,
+                     const MXTPUHandle* vals, int priority) {
+  MXTPU_TCALL("kv_push", a.u(handle).u(num).p(keys).i(0).p(vals).i(priority));
+}
+
+int MXTPUKVStorePushEx(MXTPUHandle handle, uint32_t num, const char** keys,
+                       const MXTPUHandle* vals, int priority) {
+  MXTPU_TCALL("kv_push", a.u(handle).u(num).p(keys).i(1).p(vals).i(priority));
+}
+
+int MXTPUKVStorePull(MXTPUHandle handle, uint32_t num, const int* keys,
+                     MXTPUHandle* vals, int priority) {
+  MXTPU_TCALL("kv_pull",
+              a.u(handle).u(num).p(keys).i(0).p(vals).i(priority).i(1));
+}
+
+int MXTPUKVStorePullEx(MXTPUHandle handle, uint32_t num, const char** keys,
+                       MXTPUHandle* vals, int priority) {
+  MXTPU_TCALL("kv_pull",
+              a.u(handle).u(num).p(keys).i(1).p(vals).i(priority).i(1));
+}
+
+int MXTPUKVStorePullWithSparse(MXTPUHandle handle, uint32_t num,
+                               const int* keys, MXTPUHandle* vals,
+                               int priority, int ignore_sparse) {
+  MXTPU_TCALL("kv_pull", a.u(handle).u(num).p(keys).i(0).p(vals).i(priority)
+                             .i(ignore_sparse));
+}
+
+int MXTPUKVStorePullWithSparseEx(MXTPUHandle handle, uint32_t num,
+                                 const char** keys, MXTPUHandle* vals,
+                                 int priority, int ignore_sparse) {
+  MXTPU_TCALL("kv_pull", a.u(handle).u(num).p(keys).i(1).p(vals).i(priority)
+                             .i(ignore_sparse));
+}
+
+int MXTPUKVStorePullRowSparse(MXTPUHandle handle, uint32_t num,
+                              const int* keys, MXTPUHandle* vals,
+                              const MXTPUHandle* row_ids, int priority) {
+  MXTPU_TCALL("kv_pull_row_sparse",
+              a.u(handle).u(num).p(keys).i(0).p(vals).p(row_ids).i(priority));
+}
+
+int MXTPUKVStorePullRowSparseEx(MXTPUHandle handle, uint32_t num,
+                                const char** keys, MXTPUHandle* vals,
+                                const MXTPUHandle* row_ids, int priority) {
+  MXTPU_TCALL("kv_pull_row_sparse",
+              a.u(handle).u(num).p(keys).i(1).p(vals).p(row_ids).i(priority));
+}
+
+int MXTPUKVStoreSetUpdater(MXTPUHandle handle, MXTPUKVStoreUpdater updater,
+                           void* updater_handle) {
+  MXTPU_TCALL("kv_set_updater",
+              a.u(handle).p((void*)updater).u(0).p(updater_handle));
+}
+
+int MXTPUKVStoreSetUpdaterEx(MXTPUHandle handle, MXTPUKVStoreUpdater updater,
+                             MXTPUKVStoreStrUpdater str_updater,
+                             void* updater_handle) {
+  MXTPU_TCALL("kv_set_updater", a.u(handle).p((void*)updater)
+                                    .p((void*)str_updater).p(updater_handle));
+}
+
+int MXTPUKVStoreGetType(MXTPUHandle handle, const char** type) {
+  MXTPU_TCALL("kv_get_type", a.u(handle).p(type));
+}
+
+int MXTPUKVStoreGetRank(MXTPUHandle handle, int* rank) {
+  MXTPU_TCALL("kv_get_rank", a.u(handle).p(rank));
+}
+
+int MXTPUKVStoreGetGroupSize(MXTPUHandle handle, int* size) {
+  MXTPU_TCALL("kv_get_group_size", a.u(handle).p(size));
+}
+
+int MXTPUKVStoreBarrier(MXTPUHandle handle) {
+  MXTPU_TCALL("kv_barrier", a.u(handle));
+}
+
+int MXTPUKVStoreIsWorkerNode(int* out) {
+  MXTPU_TCALL("kv_is_worker_node", a.p(out));
+}
+
+int MXTPUKVStoreIsServerNode(int* out) {
+  MXTPU_TCALL("kv_is_server_node", a.p(out));
+}
+
+int MXTPUKVStoreIsSchedulerNode(int* out) {
+  MXTPU_TCALL("kv_is_scheduler_node", a.p(out));
+}
+
+int MXTPUKVStoreRunServer(MXTPUHandle handle,
+                          MXTPUKVStoreServerController controller,
+                          void* controller_handle) {
+  MXTPU_TCALL("kv_run_server",
+              a.u(handle).p((void*)controller).p(controller_handle));
+}
+
+int MXTPUKVStoreSendCommmandToServers(MXTPUHandle handle, int cmd_id,
+                                      const char* cmd_body) {
+  MXTPU_TCALL("kv_send_command_to_servers", a.u(handle).i(cmd_id).p(cmd_body));
+}
+
+int MXTPUKVStoreSetBarrierBeforeExit(MXTPUHandle handle, int do_barrier) {
+  MXTPU_TCALL("kv_set_barrier_before_exit", a.u(handle).i(do_barrier));
+}
+
+int MXTPUKVStoreGetNumDeadNode(MXTPUHandle handle, int node_id, int* number,
+                               int timeout_sec) {
+  MXTPU_TCALL("kv_get_num_dead_node",
+              a.u(handle).i(node_id).p(number).i(timeout_sec));
+}
+
+int MXTPUKVStoreSetGradientCompression(MXTPUHandle handle,
+                                       uint32_t num_params, const char** keys,
+                                       const char** vals) {
+  MXTPU_TCALL("kv_set_gradient_compression",
+              a.u(handle).u(num_params).p(keys).p(vals));
+}
+
+int MXTPUInitPSEnv(uint32_t num_vars, const char** keys, const char** vals) {
+  MXTPU_TCALL("init_ps_env", a.u(num_vars).p(keys).p(vals));
+}
+
+/* -------------------------------------------------------------- profiler */
+
+int MXTPUSetProfilerConfig(int num_params, const char** keys,
+                           const char** vals) {
+  MXTPU_TCALL("profiler_set_config", a.i(num_params).p(keys).p(vals).u(0));
+}
+
+int MXTPUSetProcessProfilerConfig(int num_params, const char** keys,
+                                  const char** vals,
+                                  MXTPUHandle kvstore_handle) {
+  MXTPU_TCALL("profiler_set_config",
+              a.i(num_params).p(keys).p(vals).u(kvstore_handle));
+}
+
+int MXTPUSetProfilerState(int state) {
+  MXTPU_TCALL("profiler_set_state", a.i(state).i(0));
+}
+
+int MXTPUSetProcessProfilerState(int state, int profile_process) {
+  MXTPU_TCALL("profiler_set_state", a.i(state).i(profile_process));
+}
+
+int MXTPUDumpProfile(int finished) {
+  MXTPU_TCALL("profiler_dump", a.i(finished).i(0));
+}
+
+int MXTPUDumpProcessProfile(int finished, int profile_process) {
+  MXTPU_TCALL("profiler_dump", a.i(finished).i(profile_process));
+}
+
+int MXTPUAggregateProfileStatsPrint(const char** out_str, int reset) {
+  MXTPU_TCALL("profiler_aggregate_stats_print", a.p(out_str).i(reset));
+}
+
+int MXTPUProfilePause(int paused) {
+  MXTPU_TCALL("profiler_pause", a.i(paused).i(0));
+}
+
+int MXTPUProcessProfilePause(int paused, int profile_process) {
+  MXTPU_TCALL("profiler_pause", a.i(paused).i(profile_process));
+}
+
+int MXTPUProfileCreateDomain(const char* domain, MXTPUHandle* out) {
+  MXTPU_TCALL("profile_create_domain", a.p(domain).p(out));
+}
+
+int MXTPUProfileCreateTask(MXTPUHandle domain, const char* task_name,
+                           MXTPUHandle* out) {
+  MXTPU_TCALL("profile_create_task", a.u(domain).p(task_name).p(out));
+}
+
+int MXTPUProfileCreateFrame(MXTPUHandle domain, const char* frame_name,
+                            MXTPUHandle* out) {
+  MXTPU_TCALL("profile_create_frame", a.u(domain).p(frame_name).p(out));
+}
+
+int MXTPUProfileCreateEvent(const char* event_name, MXTPUHandle* out) {
+  MXTPU_TCALL("profile_create_event", a.p(event_name).p(out));
+}
+
+int MXTPUProfileCreateCounter(MXTPUHandle domain, const char* counter_name,
+                              MXTPUHandle* out) {
+  MXTPU_TCALL("profile_create_counter", a.u(domain).p(counter_name).p(out));
+}
+
+int MXTPUProfileDestroyHandle(MXTPUHandle frame_handle) {
+  MXTPU_TCALL("profile_destroy_handle", a.u(frame_handle));
+}
+
+int MXTPUProfileDurationStart(MXTPUHandle duration_handle) {
+  MXTPU_TCALL("profile_duration_start", a.u(duration_handle));
+}
+
+int MXTPUProfileDurationStop(MXTPUHandle duration_handle) {
+  MXTPU_TCALL("profile_duration_stop", a.u(duration_handle));
+}
+
+int MXTPUProfileSetCounter(MXTPUHandle counter_handle, uint64_t value) {
+  MXTPU_TCALL("profile_set_counter", a.u(counter_handle).u(value));
+}
+
+int MXTPUProfileAdjustCounter(MXTPUHandle counter_handle, int64_t delta) {
+  MXTPU_TCALL("profile_adjust_counter", a.u(counter_handle).i(delta));
+}
+
+int MXTPUProfileSetMarker(MXTPUHandle domain, const char* instant_name,
+                          const char* scope) {
+  MXTPU_TCALL("profile_set_marker", a.u(domain).p(instant_name).p(scope));
+}

@@ -1,0 +1,162 @@
+// Embedded-CPython bridge implementation (see embed.h).  Hosting modes:
+//  * loaded into an existing Python process (ctypes): Py_IsInitialized()
+//    is true; we only take the GIL around each call.
+//  * linked/dlopen'd from a plain C program: first call initializes the
+//    interpreter; MXTPU_PYTHONPATH (colon-separated) is appended to
+//    sys.path so the venv's jax and this package resolve.
+#include "embed.h"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <vector>
+
+namespace mxtpu {
+namespace {
+
+constexpr int kErrCap = 8192;
+
+typedef int (*Fn_IsInitialized)();
+typedef void (*Fn_InitializeEx)(int);
+typedef int (*Fn_GILEnsure)();
+typedef void (*Fn_GILRelease)(int);
+typedef void* (*Fn_SaveThread)();
+typedef int (*Fn_RunSimpleString)(const char*);
+
+struct PyRuntime {
+  Fn_IsInitialized is_initialized = nullptr;
+  Fn_InitializeEx initialize_ex = nullptr;
+  Fn_GILEnsure gil_ensure = nullptr;
+  Fn_GILRelease gil_release = nullptr;
+  Fn_SaveThread save_thread = nullptr;
+  Fn_RunSimpleString run_simple_string = nullptr;
+  bool ok = false;
+  std::string error;
+};
+
+PyRuntime* LoadPyRuntime() {
+  static PyRuntime rt;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    void* h = dlopen(nullptr, RTLD_NOW | RTLD_GLOBAL);  // host process first
+    if (!h || !dlsym(h, "Py_IsInitialized")) {
+      const char* env = getenv("MXTPU_LIBPYTHON");
+      std::vector<std::string> names;
+      if (env && env[0]) names.push_back(env);
+      for (const char* n :
+           {"libpython3.12.so.1.0", "libpython3.13.so.1.0",
+            "libpython3.11.so.1.0", "libpython3.10.so.1.0", "libpython3.so"})
+        names.push_back(n);
+      h = nullptr;
+      for (const auto& n : names) {
+        h = dlopen(n.c_str(), RTLD_NOW | RTLD_GLOBAL);
+        if (h && dlsym(h, "Py_IsInitialized")) break;
+        h = nullptr;
+      }
+    }
+    if (!h) {
+      rt.error = "mxtpu embed: cannot locate libpython (set MXTPU_LIBPYTHON)";
+      return;
+    }
+    rt.is_initialized = (Fn_IsInitialized)dlsym(h, "Py_IsInitialized");
+    rt.initialize_ex = (Fn_InitializeEx)dlsym(h, "Py_InitializeEx");
+    rt.gil_ensure = (Fn_GILEnsure)dlsym(h, "PyGILState_Ensure");
+    rt.gil_release = (Fn_GILRelease)dlsym(h, "PyGILState_Release");
+    rt.save_thread = (Fn_SaveThread)dlsym(h, "PyEval_SaveThread");
+    rt.run_simple_string = (Fn_RunSimpleString)dlsym(h, "PyRun_SimpleString");
+    if (!rt.is_initialized || !rt.initialize_ex || !rt.gil_ensure ||
+        !rt.gil_release || !rt.save_thread || !rt.run_simple_string) {
+      rt.error = "mxtpu embed: libpython found but symbols missing";
+      return;
+    }
+    if (!rt.is_initialized()) {
+      rt.initialize_ex(0);
+      // Make the venv / repo importable inside the embedded interpreter.
+      rt.run_simple_string(
+          "import sys, os\n"
+          "for _p in reversed(os.environ.get('MXTPU_PYTHONPATH', '')"
+          ".split(':')):\n"
+          "    if _p and _p not in sys.path:\n"
+          "        sys.path.insert(0, _p)\n");
+      rt.save_thread();  // release the GIL; every call re-takes it
+    }
+    rt.ok = true;
+  });
+  return &rt;
+}
+
+struct CallBuf {
+  int64_t status = -2;
+  char err[kErrCap];
+  CallBuf() { err[0] = '\0'; }
+};
+
+}  // namespace
+
+EmbedArgs& EmbedArgs::p(const void* ptr) {
+  return u((unsigned long long)(uintptr_t)ptr);
+}
+
+EmbedArgs& EmbedArgs::u(unsigned long long v) {
+  Sep();
+  char b[24];
+  std::snprintf(b, sizeof(b), "%llu", v);
+  s_ += b;
+  return *this;
+}
+
+EmbedArgs& EmbedArgs::i(long long v) {
+  Sep();
+  char b[24];
+  std::snprintf(b, sizeof(b), "%lld", v);
+  s_ += b;
+  return *this;
+}
+
+void EmbedArgs::Sep() {
+  if (!s_.empty()) s_ += ", ";
+}
+
+void EmbedCall(const char* module, const char* fn, const std::string& args) {
+  PyRuntime* rt = LoadPyRuntime();
+  if (!rt->ok) throw std::runtime_error(rt->error);
+  CallBuf buf;
+  // All sources share __main__'s globals; name temporaries after this
+  // call's stack buffer so concurrent failing calls on other threads
+  // can't cross-contaminate error buffers between statements.
+  unsigned long long uniq = (unsigned long long)(uintptr_t)&buf;
+  char tail[768];
+  std::snprintf(tail, sizeof(tail),
+                "%s%llu, %llu, %d)\n"
+                "except BaseException:\n"
+                "    import ctypes as _ct_%llx, traceback as _tb_%llx\n"
+                "    _m_%llx = _tb_%llx.format_exc().encode()[:%d] + b'\\0'\n"
+                "    _ct_%llx.memmove(%llu, _m_%llx, len(_m_%llx))\n"
+                "    _ct_%llx.cast(%llu, _ct_%llx.POINTER("
+                "_ct_%llx.c_int64))[0] = -1\n",
+                args.empty() ? "" : ", ",
+                (unsigned long long)(uintptr_t)&buf.status,
+                (unsigned long long)(uintptr_t)buf.err, kErrCap - 1, uniq,
+                uniq, uniq, uniq, kErrCap - 1, uniq,
+                (unsigned long long)(uintptr_t)buf.err, uniq, uniq, uniq,
+                (unsigned long long)(uintptr_t)&buf.status, uniq, uniq);
+  std::string src = std::string("try:\n    import mxnet_tpu.") + module +
+                    " as _pe\n    _pe." + fn + "(" + args + tail;
+  int gil = rt->gil_ensure();
+  int rc = rt->run_simple_string(src.c_str());
+  rt->gil_release(gil);
+  if (rc != 0 && buf.status == -2)
+    throw std::runtime_error(
+        std::string("mxtpu embed: interpreter failure in ") + fn +
+        " (see stderr)");
+  if (buf.status != 0)
+    throw std::runtime_error(buf.err[0]
+                                 ? std::string(buf.err)
+                                 : std::string("mxtpu embed: ") + fn +
+                                       " failed");
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,41 @@
+// Embedded-CPython bridge shared by the C ABIs that drive the jax runtime
+// from plain C (predict.cc, c_api_tensor.cc).
+//
+// On TPU the tensor runtime IS jax/XLA/PJRT, so instead of maintaining a
+// second compute engine the C ABI hosts a CPython interpreter (dlopen'd
+// lazily, never a link-time dependency) and calls into a marshalling
+// module inside mxnet_tpu.  All data crosses the boundary as integer
+// addresses formatted into interpreter source — no CPython API types
+// appear in libmxtpu, so it builds with no Python headers.
+// Reference analog: src/c_api/*.cc calling into the C++ runtime directly.
+#ifndef MXTPU_EMBED_H_
+#define MXTPU_EMBED_H_
+
+#include <string>
+
+namespace mxtpu {
+
+// Comma-joined integer argument list for EmbedCall.  Pointers and
+// integers only — wider types are passed by address.
+class EmbedArgs {
+ public:
+  EmbedArgs& p(const void* ptr);       // pointer → integer literal
+  EmbedArgs& u(unsigned long long v);  // unsigned integer literal
+  EmbedArgs& i(long long v);           // signed integer literal
+  const std::string& str() const { return s_; }
+
+ private:
+  void Sep();
+  std::string s_;
+};
+
+// Run mxnet_tpu.<module>.<fn>(<args>, &status, errbuf, errcap) inside the
+// embedded interpreter (GIL taken around the call).  The Python callee is
+// no-raise by contract: it reports failure through the (status, errbuf)
+// out-parameters, which this function surfaces as std::runtime_error —
+// caught by MXTPU_API_END into the thread-local error string.
+void EmbedCall(const char* module, const char* fn, const std::string& args);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_EMBED_H_

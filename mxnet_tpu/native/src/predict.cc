@@ -2,148 +2,22 @@
 //
 // Reference: include/mxnet/c_predict_api.h (MXPredCreate/SetInput/Forward/
 // GetOutputShape/GetOutput/Reshape/Free) backed by a self-contained C++
-// inference engine.  TPU-native form: on TPU the inference runtime IS
-// jax/XLA/PJRT, so instead of maintaining a second compute engine this ABI
-// hosts a CPython interpreter (dlopen'd lazily, never a link-time
-// dependency) and drives mxnet_tpu._predict_embed, which stages the
-// exported graph through the same jit path Python users get.  All data
-// crosses the boundary as raw addresses formatted into interpreter
-// source — no CPython API types appear in this file, so libmxtpu builds
-// with no Python headers.
-//
-// Two hosting modes:
-//  * loaded into an existing Python process (ctypes): Py_IsInitialized()
-//    is true; we only take the GIL around each call.
-//  * linked/dlopen'd from a plain C program: first call initializes the
-//    interpreter; MXTPU_PYTHONPATH (colon-separated) is appended to
-//    sys.path so the venv's jax and this package resolve.
-#include <dlfcn.h>
-
+// inference engine.  TPU-native form: the embedded-interpreter bridge
+// (embed.h) drives mxnet_tpu._predict_embed, which stages the exported
+// graph through the same jit path Python users get.
 #include <cstdio>
 #include <cstring>
-#include <mutex>
 #include <string>
-#include <vector>
 
 #include "common.h"
+#include "embed.h"
 
 namespace {
 
 constexpr int kMaxNdim = 16;
-constexpr int kErrCap = 8192;
 
-// -------------------------------------------------------- libpython glue --
-typedef int (*Fn_IsInitialized)();
-typedef void (*Fn_InitializeEx)(int);
-typedef int (*Fn_GILEnsure)();
-typedef void (*Fn_GILRelease)(int);
-typedef void* (*Fn_SaveThread)();
-typedef int (*Fn_RunSimpleString)(const char*);
-
-struct PyRuntime {
-  Fn_IsInitialized is_initialized = nullptr;
-  Fn_InitializeEx initialize_ex = nullptr;
-  Fn_GILEnsure gil_ensure = nullptr;
-  Fn_GILRelease gil_release = nullptr;
-  Fn_SaveThread save_thread = nullptr;
-  Fn_RunSimpleString run_simple_string = nullptr;
-  bool ok = false;
-  std::string error;
-};
-
-PyRuntime* LoadPyRuntime() {
-  static PyRuntime rt;
-  static std::once_flag once;
-  std::call_once(once, []() {
-    void* h = dlopen(nullptr, RTLD_NOW | RTLD_GLOBAL);  // host process first
-    if (!h || !dlsym(h, "Py_IsInitialized")) {
-      const char* env = getenv("MXTPU_LIBPYTHON");
-      std::vector<std::string> names;
-      if (env && env[0]) names.push_back(env);
-      for (const char* n :
-           {"libpython3.12.so.1.0", "libpython3.13.so.1.0",
-            "libpython3.11.so.1.0", "libpython3.10.so.1.0", "libpython3.so"})
-        names.push_back(n);
-      h = nullptr;
-      for (const auto& n : names) {
-        h = dlopen(n.c_str(), RTLD_NOW | RTLD_GLOBAL);
-        if (h && dlsym(h, "Py_IsInitialized")) break;
-        h = nullptr;
-      }
-    }
-    if (!h) {
-      rt.error = "MXTPUPred: cannot locate libpython (set MXTPU_LIBPYTHON)";
-      return;
-    }
-    rt.is_initialized = (Fn_IsInitialized)dlsym(h, "Py_IsInitialized");
-    rt.initialize_ex = (Fn_InitializeEx)dlsym(h, "Py_InitializeEx");
-    rt.gil_ensure = (Fn_GILEnsure)dlsym(h, "PyGILState_Ensure");
-    rt.gil_release = (Fn_GILRelease)dlsym(h, "PyGILState_Release");
-    rt.save_thread = (Fn_SaveThread)dlsym(h, "PyEval_SaveThread");
-    rt.run_simple_string = (Fn_RunSimpleString)dlsym(h, "PyRun_SimpleString");
-    if (!rt.is_initialized || !rt.initialize_ex || !rt.gil_ensure ||
-        !rt.gil_release || !rt.save_thread || !rt.run_simple_string) {
-      rt.error = "MXTPUPred: libpython found but symbols missing";
-      return;
-    }
-    if (!rt.is_initialized()) {
-      rt.initialize_ex(0);
-      // Make the venv / repo importable inside the embedded interpreter.
-      rt.run_simple_string(
-          "import sys, os\n"
-          "for _p in reversed(os.environ.get('MXTPU_PYTHONPATH', '')"
-          ".split(':')):\n"
-          "    if _p and _p not in sys.path:\n"
-          "        sys.path.insert(0, _p)\n");
-      rt.save_thread();  // release the GIL; every call re-takes it
-    }
-    rt.ok = true;
-  });
-  return &rt;
-}
-
-// One embedded call: format source invoking _predict_embed.<fn>(args...),
-// run it under the GIL, surface (status, errbuf) back as a C++ exception.
-struct CallBuf {
-  int64_t status = -2;
-  char err[kErrCap];
-  CallBuf() { err[0] = '\0'; }
-};
-
-void EmbedCall(const std::string& fn, const std::string& args) {
-  PyRuntime* rt = LoadPyRuntime();
-  if (!rt->ok) throw std::runtime_error(rt->error);
-  CallBuf buf;
-  // All sources share __main__'s globals; name temporaries after this
-  // call's stack buffer so concurrent failing calls on other threads
-  // can't cross-contaminate error buffers between statements.
-  unsigned long long uniq = (unsigned long long)(uintptr_t)&buf;
-  char src[1280];
-  std::snprintf(src, sizeof(src),
-                "try:\n"
-                "    import mxnet_tpu._predict_embed as _pe\n"
-                "    _pe.%s(%s%s%llu, %llu, %d)\n"
-                "except BaseException:\n"
-                "    import ctypes as _ct_%llx, traceback as _tb_%llx\n"
-                "    _m_%llx = _tb_%llx.format_exc().encode()[:%d] + b'\\0'\n"
-                "    _ct_%llx.memmove(%llu, _m_%llx, len(_m_%llx))\n"
-                "    _ct_%llx.cast(%llu, _ct_%llx.POINTER("
-                "_ct_%llx.c_int64))[0] = -1\n",
-                fn.c_str(), args.c_str(), args.empty() ? "" : ", ",
-                (unsigned long long)(uintptr_t)&buf.status,
-                (unsigned long long)(uintptr_t)buf.err, kErrCap - 1, uniq,
-                uniq, uniq, uniq, kErrCap - 1, uniq,
-                (unsigned long long)(uintptr_t)buf.err, uniq, uniq, uniq,
-                (unsigned long long)(uintptr_t)&buf.status, uniq, uniq);
-  int gil = rt->gil_ensure();
-  int rc = rt->run_simple_string(src);
-  rt->gil_release(gil);
-  if (rc != 0 && buf.status == -2)
-    throw std::runtime_error("MXTPUPred: embedded interpreter failure in " +
-                             fn + " (see stderr)");
-  if (buf.status != 0)
-    throw std::runtime_error(buf.err[0] ? buf.err
-                                        : "MXTPUPred: " + fn + " failed");
+void PredCall(const std::string& fn, const std::string& args) {
+  mxtpu::EmbedCall("_predict_embed", fn.c_str(), args);
 }
 
 struct Predictor {
@@ -183,7 +57,7 @@ MXTPU_EXPORT int MXTPUPredCreate(const char* symbol_json,
                           input_shape_data)
                     .c_str(),
                 (unsigned long long)(uintptr_t)&pid);
-  EmbedCall("c_create", a);
+  PredCall("c_create", a);
   auto* p = new Predictor();
   p->id = pid;
   *out = p;
@@ -200,14 +74,14 @@ MXTPU_EXPORT int MXTPUPredSetInput(void* handle, const char* key,
                 (unsigned long long)(uintptr_t)key,
                 (unsigned long long)(uintptr_t)data,
                 (unsigned long long)size);
-  EmbedCall("c_set_input", a);
+  PredCall("c_set_input", a);
   MXTPU_API_END();
 }
 
 MXTPU_EXPORT int MXTPUPredForward(void* handle) {
   MXTPU_API_BEGIN();
   auto* p = static_cast<Predictor*>(handle);
-  EmbedCall("c_forward", std::to_string(p->id));
+  PredCall("c_forward", std::to_string(p->id));
   MXTPU_API_END();
 }
 
@@ -219,7 +93,7 @@ MXTPU_EXPORT int MXTPUPredGetOutputShape(void* handle, uint32_t index,
   char a[128];
   std::snprintf(a, sizeof(a), "%llu, %u, %llu", (unsigned long long)p->id,
                 index, (unsigned long long)(uintptr_t)p->out_shape);
-  EmbedCall("c_get_output_shape", a);
+  PredCall("c_get_output_shape", a);
   *shape_ndim = p->out_shape[0];
   *shape_data = p->out_shape + 1;
   MXTPU_API_END();
@@ -233,7 +107,7 @@ MXTPU_EXPORT int MXTPUPredGetOutput(void* handle, uint32_t index, float* data,
   std::snprintf(a, sizeof(a), "%llu, %u, %llu, %llu",
                 (unsigned long long)p->id, index,
                 (unsigned long long)(uintptr_t)data, (unsigned long long)size);
-  EmbedCall("c_get_output", a);
+  PredCall("c_get_output", a);
   MXTPU_API_END();
 }
 
@@ -251,7 +125,7 @@ MXTPU_EXPORT int MXTPUPredReshape(uint32_t num_input_nodes,
                           input_shape_data)
                     .c_str(),
                 (unsigned long long)(uintptr_t)&nid);
-  EmbedCall("c_reshape", a);
+  PredCall("c_reshape", a);
   auto* np = new Predictor();
   np->id = nid;
   *out = np;
@@ -261,7 +135,7 @@ MXTPU_EXPORT int MXTPUPredReshape(uint32_t num_input_nodes,
 MXTPU_EXPORT int MXTPUPredFree(void* handle) {
   MXTPU_API_BEGIN();
   auto* p = static_cast<Predictor*>(handle);
-  if (p->id) EmbedCall("c_free", std::to_string(p->id));
+  if (p->id) PredCall("c_free", std::to_string(p->id));
   delete p;
   MXTPU_API_END();
 }

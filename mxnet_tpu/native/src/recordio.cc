@@ -23,6 +23,8 @@ RecordReader::RecordReader(const std::string& path, size_t chunk_bytes,
   f_ = fopen(path.c_str(), "rb");
   if (!f_) throw std::runtime_error("recordio: cannot open " + path);
   size_t size = FileSize(path);
+  sharded_ = num_parts > 1;
+  num_parts_ = num_parts;
   if (num_parts <= 1) {
     begin_ = 0;
     end_ = size;
@@ -64,6 +66,22 @@ void RecordReader::Reset() {
   file_pos_ = begin_;
   buf_off_ = buf_len_ = 0;
   if (fseek(f_, static_cast<long>(begin_), SEEK_SET) != 0)
+    throw std::runtime_error("recordio: seek failed in " + path_);
+}
+
+void RecordReader::Seek(uint64_t pos) {
+  // Random access (.idx offsets are whole-file) and byte-range sharding
+  // (sequential) are different access patterns; mixing them would let a
+  // part-k reader return records another shard owns.
+  if (sharded_)
+    throw std::runtime_error(
+        "recordio: Seek is only supported on unsharded readers (" + path_ +
+        " was opened as part of " + std::to_string(num_parts_) + ")");
+  if (pos > end_)
+    throw std::runtime_error("recordio: seek past end of file in " + path_);
+  file_pos_ = static_cast<size_t>(pos);
+  buf_off_ = buf_len_ = 0;
+  if (fseek(f_, static_cast<long>(file_pos_), SEEK_SET) != 0)
     throw std::runtime_error("recordio: seek failed in " + path_);
 }
 

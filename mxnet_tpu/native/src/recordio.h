@@ -30,6 +30,13 @@ class RecordReader {
   // next NextRecord/Reset call.
   bool NextRecord(const uint8_t** data, uint32_t* size);
   void Reset();
+  // File offset of the next record NextRecord will return (pairs with
+  // RecordWriter::Write's returned offset, for .idx-based random access).
+  uint64_t Tell() const { return file_pos_ - (buf_len_ - buf_off_); }
+  // Reposition to an absolute record offset (from Tell or a .idx file).
+  // Unsharded readers only: .idx offsets are whole-file, shard windows
+  // are a sequential-read pattern — mixing them would cross shards.
+  void Seek(uint64_t pos);
 
  private:
   void FillBuffer();
@@ -40,6 +47,8 @@ class RecordReader {
   FILE* f_{nullptr};
   std::string path_;
   size_t chunk_{0};
+  bool sharded_{false};
+  int num_parts_{1};
   size_t begin_{0}, end_{0};  // shard byte range (record-aligned)
   size_t file_pos_{0};        // next unread file offset
   std::vector<uint8_t> buf_;
@@ -55,6 +64,7 @@ class RecordWriter {
   // Returns byte offset of the record start (for .idx files).
   uint64_t Write(const uint8_t* data, uint32_t size);
   void Flush();
+  uint64_t Tell() const { return pos_; }
 
  private:
   FILE* f_{nullptr};

@@ -1,0 +1,43 @@
+"""Tensor-runtime C ABI test: compile tests/native_c/test_c_tensor_abi.c
+against libmxtpu and run it as a plain C process (embedded-interpreter
+hosting mode).
+
+Reference: the consumers of include/mxnet/c_api.h — every non-Python
+binding drives the runtime through exactly this seam; the C program
+exercises NDArray/imperative/autograd/Symbol/Executor/CachedOp/DataIter/
+KVStore/profiler/RecordIO groups end-to-end.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import _native
+
+
+def test_c_tensor_abi(tmp_path):
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "tests", "native_c", "test_c_tensor_abi.c")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "test_c_tensor_abi")
+    cc = subprocess.run(
+        ["gcc", "-O1", "-o", exe, src, "-L" + so_dir, "-lmxtpu", "-lm",
+         "-Wl,-rpath," + so_dir], capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    # keep the embedded interpreter on CPU and quiet
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
