@@ -1,0 +1,109 @@
+// Train linear regression from pure C++ — no Python source in the app.
+//
+// Reference: cpp-package/example/{lenet,mlp}.cpp train loops over
+// mxnet-cpp (Symbol::SimpleBind, Executor::Forward/Backward, per-param
+// sgd_update).  Same idioms here over the mxtpu tensor C ABI: build the
+// graph (Variable → FullyConnected → LinearRegressionOutput), simple-
+// bind, stream synthetic batches, update weights with the sgd_update
+// operator, and require the loss to collapse.
+//
+// Usage: train_cpp (MXTPU_PYTHONPATH must resolve mxnet_tpu + jax for
+// the embedded interpreter; see include/mxtpu-cpp/mxtpu.hpp).
+#include <cmath>
+#include <cstdio>
+#include <map>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxtpu-cpp/mxtpu.hpp"
+
+using mxtpu::cpp::Context;
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Op;
+using mxtpu::cpp::Symbol;
+
+int main() {
+  try {
+    const int kBatch = 16, kFeat = 4, kSteps = 60;
+    // ground truth: y = x . (1, -2, 3, 0.5) + 0.25
+    const std::vector<float> w_true = {1.f, -2.f, 3.f, 0.5f};
+    const float b_true = 0.25f;
+
+    Symbol data = Symbol::Variable("data");
+    Symbol label = Symbol::Variable("label");
+    Symbol fc = Symbol::CreateOp("FullyConnected", "fc",
+                                 {{"data", &data}},
+                                 {{"num_hidden", "1"}});
+    Symbol net = Symbol::CreateOp("LinearRegressionOutput", "lro",
+                                  {{"data", &fc}, {"label", &label}}, {});
+
+    std::vector<std::string> args = net.ListArguments();
+    // expected order: data, fc weight, fc bias, label
+    if (args.size() != 4) {
+      fprintf(stderr, "unexpected arg count %zu\n", args.size());
+      return 1;
+    }
+
+    Context ctx;
+    Executor ex = net.SimpleBind(
+        ctx, {{"data", {kBatch, kFeat}}, {"label", {kBatch}}});
+
+    std::mt19937 rng(7);
+    std::normal_distribution<float> dist(0.f, 1.f);
+    Op sgd("sgd_update");
+
+    float first_loss = -1.f, last_loss = -1.f;
+    for (int step = 0; step < kSteps; ++step) {
+      std::vector<float> x(kBatch * kFeat), y(kBatch);
+      for (int i = 0; i < kBatch; ++i) {
+        float acc = b_true;
+        for (int j = 0; j < kFeat; ++j) {
+          x[i * kFeat + j] = dist(rng);
+          acc += x[i * kFeat + j] * w_true[j];
+        }
+        y[i] = acc;
+      }
+      ex.arg_arrays[0].SyncCopyFromCPU(x);
+      ex.arg_arrays[3].SyncCopyFromCPU(y);
+
+      ex.Forward(true);
+      ex.Backward();
+
+      // per-parameter sgd (weight = arg 1, bias = arg 2)
+      for (int p = 1; p <= 2; ++p) {
+        sgd.Invoke({&ex.arg_arrays[p], &ex.grad_arrays[p]},
+                   {&ex.arg_arrays[p]}, {{"lr", "0.1"}, {"wd", "0.0"}});
+      }
+
+      std::vector<float> pred = ex.Outputs()[0].SyncCopyToCPU();
+      float loss = 0.f;
+      for (int i = 0; i < kBatch; ++i)
+        loss += (pred[i] - y[i]) * (pred[i] - y[i]);
+      loss /= kBatch;
+      if (step == 0) first_loss = loss;
+      last_loss = loss;
+    }
+
+    std::vector<float> w = ex.arg_arrays[1].SyncCopyToCPU();
+    printf("first loss %.4f -> last loss %.6f\n", first_loss, last_loss);
+    printf("learned w: %.3f %.3f %.3f %.3f (true 1 -2 3 0.5)\n", w[0], w[1],
+           w[2], w[3]);
+    if (!(last_loss < first_loss * 0.05f) || !(last_loss < 0.05f)) {
+      fprintf(stderr, "loss did not collapse\n");
+      return 1;
+    }
+    for (int j = 0; j < kFeat; ++j) {
+      if (std::fabs(w[j] - w_true[j]) > 0.15f) {
+        fprintf(stderr, "w[%d]=%.3f off from %.3f\n", j, w[j], w_true[j]);
+        return 1;
+      }
+    }
+    printf("trained in pure C++: PASS\n");
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "EXCEPTION: %s\n", e.what());
+    return 1;
+  }
+}

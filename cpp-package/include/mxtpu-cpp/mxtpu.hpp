@@ -7,7 +7,8 @@
 // exactly the libmxtpu surface, wrapped here with RAII + exceptions.
 //
 // Build: no dependencies beyond libmxtpu.so:
-//   g++ -std=c++17 app.cc -I cpp-package/include -L mxnet_tpu/native \
+//   g++ -std=c++17 app.cc -I cpp-package/include \
+//       -I mxnet_tpu/native/include -L mxnet_tpu/native \
 //       -lmxtpu -Wl,-rpath,mxnet_tpu/native
 // For Predictor in a non-Python process, set MXTPU_PYTHONPATH (see
 // native/src/predict.cc).
@@ -20,45 +21,9 @@
 #include <string>
 #include <vector>
 
-extern "C" {
-const char* MXTPUGetLastError(void);
-int MXTPUEngineCreate(int n_workers, int io_workers, void** out);
-int MXTPUEngineFree(void* h);
-int MXTPUEngineNewVar(void* h, uint64_t* out);
-int MXTPUEngineDelVar(void* h, uint64_t var);
-typedef int (*MXTPUEngineOpFn)(void* ctx, uint64_t op_id);
-int MXTPUEnginePush(void* h, MXTPUEngineOpFn fn, void* ctx,
-                    const uint64_t* cvars, int ncv, const uint64_t* mvars,
-                    int nmv, int prop, const char* name, uint64_t* out_op_id);
-int MXTPUEngineOnComplete(void* h, uint64_t op_id);
-int MXTPUEngineOnCompleteError(void* h, uint64_t op_id, const char* msg);
-int MXTPUEngineWaitForVar(void* h, uint64_t var);
-int MXTPUEngineWaitAll(void* h);
-int MXTPURecordReaderCreate(const char* path, uint64_t chunk, int part,
-                            int nparts, void** out);
-int MXTPURecordReaderNext(void* h, const uint8_t** data, uint32_t* size);
-int MXTPURecordReaderReset(void* h);
-int MXTPURecordReaderFree(void* h);
-int MXTPURecordWriterCreate(const char* path, void** out);
-int MXTPURecordWriterWrite(void* h, const uint8_t* data, uint32_t size,
-                           uint64_t* out_pos);
-int MXTPURecordWriterFree(void* h);
-int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
-                    uint64_t param_size, int dev_type, int dev_id,
-                    uint32_t num_input_nodes, const char** input_keys,
-                    const uint32_t* input_shape_indptr,
-                    const uint32_t* input_shape_data, void** out);
-int MXTPUPredSetInput(void* h, const char* key, const float* data,
-                      uint64_t size);
-int MXTPUPredForward(void* h);
-int MXTPUPredGetOutputShape(void* h, uint32_t index,
-                            const uint32_t** shape_data, uint32_t* shape_ndim);
-int MXTPUPredGetOutput(void* h, uint32_t index, float* data, uint64_t size);
-int MXTPUPredReshape(uint32_t num_input_nodes, const char** input_keys,
-                     const uint32_t* input_shape_indptr,
-                     const uint32_t* input_shape_data, void* h, void** out);
-int MXTPUPredFree(void* h);
-}
+// Real ABI headers (compiler-enforced consistency with libmxtpu).
+#include <mxtpu/c_api.h>
+#include <mxtpu/c_predict_api.h>
 
 namespace mxtpu {
 namespace cpp {
@@ -243,6 +208,380 @@ class RecordWriter {
 
  private:
   void* handle_ = nullptr;
+};
+
+// ================= training-capable tensor API (r4) ======================
+// NDArray / Symbol / Executor / KVStore with RAII + exceptions over the
+// full tensor C ABI — the same classes the reference's cpp-package
+// builds over include/mxnet/c_api.h (mxnet-cpp/{ndarray,symbol,
+// executor,kvstore}.h).  Training from pure C++ with zero Python source
+// is exercised by cpp-package/example/train_cpp.cc.
+
+class Context {
+ public:
+  explicit Context(Device dev = Device::kCPU, int id = 0)
+      : dev_(static_cast<int>(dev)), id_(id) {}
+  int dev_type() const { return dev_; }
+  int dev_id() const { return id_; }
+
+ private:
+  int dev_;
+  int id_;
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<uint32_t>& shape, const Context& ctx = Context(),
+          int dtype = 0) {
+    Check(MXTPUNDArrayCreateEx(shape.data(),
+                               static_cast<uint32_t>(shape.size()),
+                               ctx.dev_type(), ctx.dev_id(), 0, dtype,
+                               &handle_));
+  }
+  NDArray(const std::vector<uint32_t>& shape, const std::vector<float>& vals,
+          const Context& ctx = Context())
+      : NDArray(shape, ctx, 0) {
+    SyncCopyFromCPU(vals);
+  }
+  // Adopt a handle minted by the C ABI (e.g. SimpleBind outputs).
+  static NDArray Own(MXTPUHandle h) {
+    NDArray a;
+    a.handle_ = h;
+    return a;
+  }
+  ~NDArray() { reset(); }
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_) { o.handle_ = 0; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      reset();
+      handle_ = o.handle_;
+      o.handle_ = 0;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+
+  MXTPUHandle handle() const { return handle_; }
+  bool empty() const { return handle_ == 0; }
+
+  std::vector<uint32_t> Shape() const {
+    uint32_t ndim = 0;
+    const uint32_t* data = nullptr;
+    Check(MXTPUNDArrayGetShape(handle_, &ndim, &data));
+    return std::vector<uint32_t>(data, data + ndim);
+  }
+  uint64_t Size() const {
+    uint64_t n = 1;
+    for (uint32_t d : Shape()) n *= d;
+    return n;
+  }
+  void SyncCopyFromCPU(const std::vector<float>& vals) {
+    Check(MXTPUNDArraySyncCopyFromCPU(handle_, vals.data(), vals.size()));
+  }
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXTPUNDArraySyncCopyToCPU(handle_, out.data(), out.size()));
+    return out;
+  }
+  NDArray Slice(uint32_t begin, uint32_t end) const {
+    MXTPUHandle h = 0;
+    Check(MXTPUNDArraySlice(handle_, begin, end, &h));
+    return Own(h);
+  }
+  NDArray Reshape(const std::vector<int>& dims) const {
+    MXTPUHandle h = 0;
+    Check(MXTPUNDArrayReshape(handle_, static_cast<int>(dims.size()),
+                              dims.data(), &h));
+    return Own(h);
+  }
+  void WaitToRead() const { Check(MXTPUNDArrayWaitToRead(handle_)); }
+
+  static void Save(const std::string& fname,
+                   const std::map<std::string, NDArray*>& arrays) {
+    std::vector<MXTPUHandle> hs;
+    std::vector<const char*> keys;
+    for (const auto& kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      hs.push_back(kv.second->handle());
+    }
+    Check(MXTPUNDArraySave(fname.c_str(),
+                           static_cast<uint32_t>(hs.size()), hs.data(),
+                           keys.data()));
+  }
+  static std::map<std::string, NDArray> Load(const std::string& fname) {
+    uint32_t n = 0, n_names = 0;
+    MXTPUHandle* hs = nullptr;
+    const char** names = nullptr;
+    Check(MXTPUNDArrayLoad(fname.c_str(), &n, &hs, &n_names, &names));
+    std::map<std::string, NDArray> out;
+    for (uint32_t i = 0; i < n; ++i)
+      out[n_names == n ? names[i] : std::to_string(i)] = Own(hs[i]);
+    return out;
+  }
+
+ private:
+  void reset() {
+    if (handle_) MXTPUNDArrayFree(handle_);
+    handle_ = 0;
+  }
+  MXTPUHandle handle_ = 0;
+};
+
+// Invoke a registered operator imperatively: Op("broadcast_add")(a, b).
+class Op {
+ public:
+  explicit Op(const std::string& name) {
+    Check(MXTPUGetOpHandle(name.c_str(), &handle_));
+  }
+  std::vector<NDArray> operator()(
+      const std::vector<const NDArray*>& inputs,
+      const std::map<std::string, std::string>& params = {}) const {
+    std::vector<MXTPUHandle> in;
+    for (const NDArray* a : inputs) in.push_back(a->handle());
+    std::vector<const char*> keys, vals;
+    for (const auto& kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = 0;
+    MXTPUHandle* outs = nullptr;
+    Check(MXTPUImperativeInvoke(handle_, static_cast<int>(in.size()),
+                                in.data(), &n_out, &outs,
+                                static_cast<int>(keys.size()), keys.data(),
+                                vals.data()));
+    std::vector<NDArray> result;
+    for (int i = 0; i < n_out; ++i) result.push_back(NDArray::Own(outs[i]));
+    return result;
+  }
+  // In-place update form: outputs written into existing arrays
+  // (optimizer updates: sgd_update(w, g) -> w).
+  void Invoke(const std::vector<const NDArray*>& inputs,
+              const std::vector<NDArray*>& outputs,
+              const std::map<std::string, std::string>& params = {}) const {
+    std::vector<MXTPUHandle> in;
+    for (const NDArray* a : inputs) in.push_back(a->handle());
+    std::vector<MXTPUHandle> out;
+    for (NDArray* a : outputs) out.push_back(a->handle());
+    std::vector<const char*> keys, vals;
+    for (const auto& kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = static_cast<int>(out.size());
+    MXTPUHandle* outs = out.data();
+    Check(MXTPUImperativeInvoke(handle_, static_cast<int>(in.size()),
+                                in.data(), &n_out, &outs,
+                                static_cast<int>(keys.size()), keys.data(),
+                                vals.data()));
+  }
+
+ private:
+  MXTPUHandle handle_ = 0;
+};
+
+class Executor;
+
+class Symbol {
+ public:
+  Symbol() = default;
+  static Symbol Variable(const std::string& name) {
+    MXTPUHandle h = 0;
+    Check(MXTPUSymbolCreateVariable(name.c_str(), &h));
+    return Own(h);
+  }
+  // One-step atomic-create + compose (the reference cpp-package's
+  // generated per-op constructors reduce to exactly this).
+  static Symbol CreateOp(const std::string& op_name, const std::string& name,
+                         const std::map<std::string, Symbol*>& inputs,
+                         const std::map<std::string, std::string>& params) {
+    MXTPUHandle creator = 0;
+    Check(MXTPUGetOpHandle(op_name.c_str(), &creator));
+    std::vector<const char*> pkeys, pvals;
+    for (const auto& kv : params) {
+      pkeys.push_back(kv.first.c_str());
+      pvals.push_back(kv.second.c_str());
+    }
+    MXTPUHandle h = 0;
+    Check(MXTPUSymbolCreateAtomicSymbol(
+        creator, static_cast<uint32_t>(pkeys.size()), pkeys.data(),
+        pvals.data(), &h));
+    std::vector<const char*> ikeys;
+    std::vector<MXTPUHandle> iargs;
+    for (const auto& kv : inputs) {
+      ikeys.push_back(kv.first.c_str());
+      iargs.push_back(kv.second->handle());
+    }
+    Check(MXTPUSymbolCompose(h, name.c_str(),
+                             static_cast<uint32_t>(ikeys.size()),
+                             ikeys.data(), iargs.data()));
+    return Own(h);
+  }
+  static Symbol FromJSON(const std::string& json) {
+    MXTPUHandle h = 0;
+    Check(MXTPUSymbolCreateFromJSON(json.c_str(), &h));
+    return Own(h);
+  }
+  static Symbol Own(MXTPUHandle h) {
+    Symbol s;
+    s.handle_ = h;
+    return s;
+  }
+  ~Symbol() {
+    if (handle_) MXTPUSymbolFree(handle_);
+  }
+  Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = 0; }
+  Symbol& operator=(Symbol&& o) noexcept {
+    if (this != &o) {
+      if (handle_) MXTPUSymbolFree(handle_);
+      handle_ = o.handle_;
+      o.handle_ = 0;
+    }
+    return *this;
+  }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+
+  MXTPUHandle handle() const { return handle_; }
+  std::string ToJSON() const {
+    const char* json = nullptr;
+    Check(MXTPUSymbolSaveToJSON(handle_, &json));
+    return json;
+  }
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXTPUSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXTPUSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXTPUSymbolListAuxiliaryStates);
+  }
+  inline Executor SimpleBind(
+      const Context& ctx,
+      const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
+      const std::string& grad_req = "write") const;
+
+ private:
+  using ListFn = int (*)(MXTPUHandle, uint32_t*, const char***);
+  std::vector<std::string> StrList(ListFn fn) const {
+    uint32_t n = 0;
+    const char** arr = nullptr;
+    Check(fn(handle_, &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+  MXTPUHandle handle_ = 0;
+};
+
+class Executor {
+ public:
+  ~Executor() {
+    if (handle_) MXTPUExecutorFree(handle_);
+  }
+  Executor(Executor&& o) noexcept
+      : handle_(o.handle_), arg_arrays(std::move(o.arg_arrays)),
+        grad_arrays(std::move(o.grad_arrays)),
+        aux_arrays(std::move(o.aux_arrays)) {
+    o.handle_ = 0;
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+
+  void Forward(bool is_train) {
+    Check(MXTPUExecutorForward(handle_, is_train ? 1 : 0));
+  }
+  void Backward(const std::vector<const NDArray*>& head_grads = {}) {
+    std::vector<MXTPUHandle> hs;
+    for (const NDArray* a : head_grads) hs.push_back(a->handle());
+    Check(MXTPUExecutorBackward(handle_,
+                                static_cast<uint32_t>(hs.size()),
+                                hs.empty() ? nullptr : hs.data()));
+  }
+  std::vector<NDArray> Outputs() const {
+    uint32_t n = 0;
+    MXTPUHandle* hs = nullptr;
+    Check(MXTPUExecutorOutputs(handle_, &n, &hs));
+    std::vector<NDArray> out;
+    for (uint32_t i = 0; i < n; ++i) out.push_back(NDArray::Own(hs[i]));
+    return out;
+  }
+
+  std::vector<NDArray> arg_arrays;   // bound parameter/input buffers
+  std::vector<NDArray> grad_arrays; // empty() where grad_req is null
+  std::vector<NDArray> aux_arrays;
+
+ private:
+  friend class Symbol;
+  Executor() = default;
+  MXTPUHandle handle_ = 0;
+};
+
+inline Executor Symbol::SimpleBind(
+    const Context& ctx,
+    const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
+    const std::string& grad_req) const {
+  std::vector<const char*> names;
+  std::vector<uint32_t> idx{0}, data;
+  for (const auto& kv : arg_shapes) {
+    names.push_back(kv.first.c_str());
+    data.insert(data.end(), kv.second.begin(), kv.second.end());
+    idx.push_back(static_cast<uint32_t>(data.size()));
+  }
+  std::vector<std::string> arg_names = ListArguments();
+  std::vector<const char*> req_names;
+  std::vector<const char*> req_types;
+  for (const std::string& n : arg_names) req_names.push_back(n.c_str());
+  for (size_t i = 0; i < arg_names.size(); ++i)
+    req_types.push_back(grad_req.c_str());
+  uint32_t num_in = 0, num_aux = 0;
+  MXTPUHandle* in_arr = nullptr;
+  MXTPUHandle* grad_arr = nullptr;
+  MXTPUHandle* aux_arr = nullptr;
+  Executor ex;
+  Check(MXTPUExecutorSimpleBind(
+      handle_, ctx.dev_type(), ctx.dev_id(), 0, nullptr, nullptr, nullptr,
+      static_cast<uint32_t>(req_names.size()), req_names.data(),
+      req_types.data(), static_cast<uint32_t>(names.size()), names.data(),
+      data.data(), idx.data(), 0, nullptr, nullptr, 0, nullptr, nullptr, 0,
+      nullptr, nullptr, nullptr, nullptr, nullptr, nullptr, &num_in, &in_arr,
+      &grad_arr, &num_aux, &aux_arr, 0, &ex.handle_));
+  for (uint32_t i = 0; i < num_in; ++i)
+    ex.arg_arrays.push_back(NDArray::Own(in_arr[i]));
+  for (uint32_t i = 0; i < num_in; ++i)
+    ex.grad_arrays.push_back(grad_arr[i] ? NDArray::Own(grad_arr[i])
+                                         : NDArray());
+  for (uint32_t i = 0; i < num_aux; ++i)
+    ex.aux_arrays.push_back(NDArray::Own(aux_arr[i]));
+  return ex;
+}
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    Check(MXTPUKVStoreCreate(type.c_str(), &handle_));
+  }
+  ~KVStore() {
+    if (handle_) MXTPUKVStoreFree(handle_);
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+
+  void Init(int key, const NDArray& val) {
+    MXTPUHandle h = val.handle();
+    Check(MXTPUKVStoreInit(handle_, 1, &key, &h));
+  }
+  void Push(int key, const NDArray& val, int priority = 0) {
+    MXTPUHandle h = val.handle();
+    Check(MXTPUKVStorePush(handle_, 1, &key, &h, priority));
+  }
+  void Pull(int key, NDArray* out, int priority = 0) {
+    MXTPUHandle h = out->handle();
+    Check(MXTPUKVStorePull(handle_, 1, &key, &h, priority));
+  }
+
+ private:
+  MXTPUHandle handle_ = 0;
 };
 
 }  // namespace cpp

@@ -1353,7 +1353,8 @@ def quantize_symbol(hid, out_addr, num_excluded, excluded_addr, qdtype_addr):
     sym = _obj(hid)
     excluded = _read_str_array(excluded_addr, num_excluded)
     qdtype = _read_str(qdtype_addr) or "int8"
-    qsym = q.quantize_graph(sym, excluded_sym_names=excluded)
+    qsym = q.quantize_graph(sym, excluded_sym_names=excluded,
+                            quantized_dtype=qdtype)
     hid_out = _new_handle(qsym)
     _qsym_meta[hid_out] = (sym, tuple(excluded), qdtype)
     _write_u64(out_addr, hid_out)
@@ -1368,13 +1369,13 @@ def set_calib_table_to_quantized_symbol(qsym_hid, num_layers, names_addr,
     if meta is None:
         raise ValueError("SetCalibTable: handle was not produced by "
                          "QuantizeSymbol")
-    sym, excluded, _ = meta
+    sym, excluded, qdtype = meta
     names = _read_str_array(names_addr, num_layers)
     lows = _read_f32_array(low_addr, num_layers)
     highs = _read_f32_array(high_addr, num_layers)
     th_dict = {n: (lo, hi) for n, lo, hi in zip(names, lows, highs)}
     qsym = q.quantize_graph(sym, excluded_sym_names=list(excluded),
-                            th_dict=th_dict)
+                            th_dict=th_dict, quantized_dtype=qdtype)
     _write_u64(out_addr, _new_handle(qsym))
 
 
@@ -1812,6 +1813,9 @@ def kv_set_updater(hid, updater_addr, str_updater_addr, updater_ctx):
                                      % key)
                 str_fn(key.encode(), hr, hl, updater_ctx)
             else:
+                if int_fn is None:
+                    raise ValueError("int key %r but no int updater set"
+                                     % key)
                 int_fn(int(key), hr, hl, updater_ctx)
         finally:
             _free_handle(hr)
@@ -1877,12 +1881,11 @@ def kv_run_server(hid, controller_addr, controller_ctx):
 
     del hid
     cfn = _KVController(int(controller_addr)) if controller_addr else None
+    controller = None
     if cfn is not None:
-        # surface server commands to the C controller as the reference
-        # does before entering the serving loop
-        kvstore_server._c_controller = lambda head, body: cfn(
-            int(head), str(body).encode(), controller_ctx)
-    kvstore_server.init_server()
+        def controller(head, body):
+            cfn(int(head), str(body).encode(), controller_ctx)
+    kvstore_server.init_server(controller=controller)
 
 
 @capi

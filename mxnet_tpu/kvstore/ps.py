@@ -40,7 +40,19 @@ import socket
 import struct
 import threading
 
-__all__ = ["PSServer", "PSClient", "server_addresses", "run_server"]
+__all__ = ["PSServer", "PSClient", "server_addresses", "run_server",
+           "set_app_controller"]
+
+# App-level server controller (reference: KVStore::RunServer(controller)):
+# receives (head, body) for every non-framework command a worker sends via
+# _send_command_to_servers; its return value travels back to the sender.
+_app_controller = [None]
+
+
+def set_app_controller(fn):
+    """Register fn(head, body) to handle app-level server commands;
+    pass None to clear."""
+    _app_controller[0] = fn
 
 
 # modules/names a data message may reference: enough to rebuild numpy
@@ -294,8 +306,12 @@ class PSServer:
         KVStoreServerProfilerCommand include/mxnet/kvstore.h:49).
         'profiler' drives this server process's profiler so pushes can be
         traced server-side (reference: tests/nightly/
-        test_server_profiling.py)."""
+        test_server_profiling.py).  Any other head goes to the
+        app-level controller when one is registered (reference:
+        KVStore::RunServer's controller argument)."""
         if head != "profiler":
+            if _app_controller[0] is not None:
+                return _app_controller[0](head, body)
             raise ValueError("unknown server command %r" % (head,))
         import json as _json
 

@@ -13,13 +13,18 @@ import os
 __all__ = ["init_server", "main"]
 
 
-def init_server():
+def init_server(controller=None):
     """If this process's DMLC_ROLE is 'server', serve until stopped and
-    return True; otherwise return False (worker processes continue)."""
+    return True; otherwise return False (worker processes continue).
+
+    controller(head, body), when given, handles app-level server
+    commands (reference: KVStore::RunServer's controller argument)."""
     if os.environ.get("DMLC_ROLE") != "server":
         return False
-    from .kvstore.ps import run_server
+    from .kvstore.ps import run_server, set_app_controller
 
+    if controller is not None:
+        set_app_controller(controller)
     run_server()
     return True
 

@@ -622,6 +622,35 @@ MXTPU_DLL extern int MXTPURecordWriterWrite(void* h, const uint8_t* data, uint32
                                   uint64_t* out_pos);
 MXTPU_DLL extern int MXTPURecordWriterTell(void* h, uint64_t* pos);
 MXTPU_DLL extern int MXTPURecordWriterFree(void* h);
+/* Prefetching batch pipeline over a .rec shard (worker pool + reorder
+ * queue; reference: src/io/iter_image_recordio_2.cc).  decode fills one
+ * sample slot from one record, returning 0 on success; NULL selects the
+ * built-in raw decoder. */
+typedef int (*MXTPUDecodeFn)(void* ctx, const uint8_t* rec, uint32_t len,
+                             uint8_t* data_out, float* label_out);
+MXTPU_DLL extern int MXTPUPipelineCreate(
+    const char* path, uint64_t chunk_bytes, int part_index, int num_parts,
+    int batch_size, uint64_t sample_bytes, int label_width, int shuffle,
+    uint64_t seed, int num_workers, int queue_depth, int last_batch_keep,
+    MXTPUDecodeFn decode, void* decode_ctx, void** out);
+/* In-worker JPEG decode + augment variant (the img, rand, and mean
+ * params describe the augment chain; fallback handles non-JPEG
+ * payloads). */
+MXTPU_DLL extern int MXTPUPipelineCreateJpeg(
+    const char* path, uint64_t chunk_bytes, int part_index, int num_parts,
+    int batch_size, uint64_t sample_bytes, int label_width, int shuffle,
+    uint64_t seed, int num_workers, int queue_depth, int last_batch_keep,
+    int img_h, int img_w, int img_c, int rand_crop, int rand_mirror,
+    float mean_r, float mean_g, float mean_b, MXTPUDecodeFn fallback,
+    void* fallback_ctx, void** out);
+/* 1 when libmxtpu was built against libjpeg. */
+MXTPU_DLL extern int MXTPUPipelineHasJpeg(void);
+/* count is set to -1 at end of epoch. */
+MXTPU_DLL extern int MXTPUPipelineNext(void* h, uint8_t** data, float** label,
+                                       int* count);
+MXTPU_DLL extern int MXTPUPipelineRelease(void* h, uint8_t* data, float* label);
+MXTPU_DLL extern int MXTPUPipelineReset(void* h);
+MXTPU_DLL extern int MXTPUPipelineFree(void* h);
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -1,0 +1,58 @@
+/* mxtpu/c_predict_api.h — deployment (inference-only) C ABI.
+ *
+ * Counterpart of the reference's include/mxnet/c_predict_api.h
+ * (MXPredCreate/SetInput/Forward/GetOutputShape/GetOutput/Reshape/Free),
+ * kept in a separate header exactly as the reference does: a deployment
+ * consumer needs only these seven functions plus MXTPUGetLastError.
+ * Backed by src/predict.cc over the embedded-interpreter bridge — see
+ * mxtpu/c_api.h for conventions (0/-1 returns, thread-local errors,
+ * MXTPU_PYTHONPATH for non-Python hosts).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#ifndef MXTPU_DLL
+#ifdef __GNUC__
+#define MXTPU_DLL __attribute__((visibility("default")))
+#endif
+#endif
+
+MXTPU_DLL extern const char* MXTPUGetLastError(void);
+
+/* Load an exported model: symbol JSON + .params blob.  Input shapes are
+ * CSR-packed over input_keys (indptr of length num_input_nodes+1).
+ * dev_type: 1=cpu, 2=accelerator(TPU). */
+MXTPU_DLL extern int MXTPUPredCreate(const char* symbol_json,
+                                     const void* param_bytes,
+                                     uint64_t param_size, int dev_type,
+                                     int dev_id, uint32_t num_input_nodes,
+                                     const char** input_keys,
+                                     const uint32_t* input_shape_indptr,
+                                     const uint32_t* input_shape_data,
+                                     void** out);
+MXTPU_DLL extern int MXTPUPredSetInput(void* handle, const char* key,
+                                       const float* data, uint64_t size);
+MXTPU_DLL extern int MXTPUPredForward(void* handle);
+MXTPU_DLL extern int MXTPUPredGetOutputShape(void* handle, uint32_t index,
+                                             const uint32_t** shape_data,
+                                             uint32_t* shape_ndim);
+MXTPU_DLL extern int MXTPUPredGetOutput(void* handle, uint32_t index,
+                                        float* data, uint64_t size);
+MXTPU_DLL extern int MXTPUPredReshape(uint32_t num_input_nodes,
+                                      const char** input_keys,
+                                      const uint32_t* input_shape_indptr,
+                                      const uint32_t* input_shape_data,
+                                      void* handle, void** out);
+MXTPU_DLL extern int MXTPUPredFree(void* handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -5,6 +5,7 @@
 #include <cstring>
 #include <string>
 
+#include "../include/mxtpu/c_api.h"  // compiler-checked ABI declarations
 #include "common.h"
 #include "engine.h"
 #include "pipeline.h"

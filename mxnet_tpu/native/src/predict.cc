@@ -9,6 +9,7 @@
 #include <cstring>
 #include <string>
 
+#include "../include/mxtpu/c_predict_api.h"  // compiler-checked ABI decls
 #include "common.h"
 #include "embed.h"
 

@@ -340,3 +340,26 @@ def test_metric_nll():
     want = -np.mean(np.log(preds[np.arange(50), labels.astype(int)]
                            + 1e-12))
     assert abs(m.get()[1] - want) < 1e-4
+
+
+def test_ps_server_app_controller():
+    """App-level server commands route to the registered controller and
+    its return value travels back; unknown commands without a controller
+    still error (reference: KVStore::RunServer's controller argument +
+    MXKVStoreSendCommandToServers)."""
+    from mxnet_tpu.kvstore.ps import PSServer, set_app_controller
+
+    srv = PSServer(num_workers=1)
+    seen = []
+    try:
+        set_app_controller(lambda head, body: seen.append((head, body))
+                           or "ack:%s" % body)
+        assert srv._command(7, "hello") == "ack:hello"
+        assert seen == [(7, "hello")]
+        # framework command still handled by the framework, not the app
+        import pytest as _pytest
+        set_app_controller(None)
+        with _pytest.raises(ValueError):
+            srv._command(7, "hello")
+    finally:
+        set_app_controller(None)

@@ -222,10 +222,11 @@ def test_cpp_package_example(tmp_path):
 
     src = os.path.join(repo, "cpp-package", "example", "predict_cpp.cc")
     inc = os.path.join(repo, "cpp-package", "include")
+    abi_inc = os.path.join(repo, "mxnet_tpu", "native", "include")
     so_dir = os.path.join(repo, "mxnet_tpu", "native")
     exe = str(tmp_path / "predict_cpp")
     cc = subprocess.run(
-        ["g++", "-std=c++17", "-O1", "-o", exe, src, "-I" + inc,
+        ["g++", "-std=c++17", "-O1", "-o", exe, src, "-I" + inc, "-I" + abi_inc,
          "-L" + so_dir, "-lmxtpu", "-Wl,-rpath," + so_dir],
         capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
@@ -237,3 +238,40 @@ def test_cpp_package_example(tmp_path):
                        capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "reshaped output elements: 12" in r.stdout
+
+
+def test_cpp_package_training_example(tmp_path):
+    """Compile and run the pure-C++ training example: Symbol build,
+    SimpleBind, Forward/Backward, sgd_update — zero Python source in the
+    app (reference: cpp-package/example/mlp.cpp train loop)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "cpp-package", "example", "train_cpp.cc")
+    inc = os.path.join(repo, "cpp-package", "include")
+    abi_inc = os.path.join(repo, "mxnet_tpu", "native", "include")
+    so_dir = os.path.join(repo, "mxnet_tpu", "native")
+    exe = str(tmp_path / "train_cpp")
+    cc = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-o", exe, src, "-I" + inc, "-I" + abi_inc,
+         "-L" + so_dir, "-lmxtpu", "-Wl,-rpath," + so_dir],
+        capture_output=True, text=True)
+    assert cc.returncode == 0, cc.stderr
+
+    env = dict(os.environ)
+    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trained in pure C++: PASS" in r.stdout
