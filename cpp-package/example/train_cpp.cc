@@ -47,8 +47,11 @@ int main() {
     }
 
     Context ctx;
+    // gradients only where the optimizer needs them; pure inputs stay
+    // gradient-free
     Executor ex = net.SimpleBind(
-        ctx, {{"data", {kBatch, kFeat}}, {"label", {kBatch}}});
+        ctx, {{"data", {kBatch, kFeat}}, {"label", {kBatch}}},
+        {{"data", "null"}, {"label", "null"}, {"*", "write"}});
 
     std::mt19937 rng(7);
     std::normal_distribution<float> dist(0.f, 1.f);

@@ -331,6 +331,27 @@ class NDArray {
 
 // Invoke a registered operator imperatively: Op("broadcast_add")(a, b).
 class Op {
+  // shared marshalling for both invoke forms
+  struct Call {
+    std::vector<MXTPUHandle> in;
+    std::vector<const char*> keys, vals;
+    Call(const std::vector<const NDArray*>& inputs,
+         const std::map<std::string, std::string>& params) {
+      for (const NDArray* a : inputs) in.push_back(a->handle());
+      for (const auto& kv : params) {
+        keys.push_back(kv.first.c_str());
+        vals.push_back(kv.second.c_str());
+      }
+    }
+  };
+
+  void Run(Call& c, int* n_out, MXTPUHandle** outs) const {
+    Check(MXTPUImperativeInvoke(handle_, static_cast<int>(c.in.size()),
+                                c.in.data(), n_out, outs,
+                                static_cast<int>(c.keys.size()),
+                                c.keys.data(), c.vals.data()));
+  }
+
  public:
   explicit Op(const std::string& name) {
     Check(MXTPUGetOpHandle(name.c_str(), &handle_));
@@ -338,19 +359,10 @@ class Op {
   std::vector<NDArray> operator()(
       const std::vector<const NDArray*>& inputs,
       const std::map<std::string, std::string>& params = {}) const {
-    std::vector<MXTPUHandle> in;
-    for (const NDArray* a : inputs) in.push_back(a->handle());
-    std::vector<const char*> keys, vals;
-    for (const auto& kv : params) {
-      keys.push_back(kv.first.c_str());
-      vals.push_back(kv.second.c_str());
-    }
+    Call c(inputs, params);
     int n_out = 0;
     MXTPUHandle* outs = nullptr;
-    Check(MXTPUImperativeInvoke(handle_, static_cast<int>(in.size()),
-                                in.data(), &n_out, &outs,
-                                static_cast<int>(keys.size()), keys.data(),
-                                vals.data()));
+    Run(c, &n_out, &outs);
     std::vector<NDArray> result;
     for (int i = 0; i < n_out; ++i) result.push_back(NDArray::Own(outs[i]));
     return result;
@@ -360,21 +372,12 @@ class Op {
   void Invoke(const std::vector<const NDArray*>& inputs,
               const std::vector<NDArray*>& outputs,
               const std::map<std::string, std::string>& params = {}) const {
-    std::vector<MXTPUHandle> in;
-    for (const NDArray* a : inputs) in.push_back(a->handle());
+    Call c(inputs, params);
     std::vector<MXTPUHandle> out;
     for (NDArray* a : outputs) out.push_back(a->handle());
-    std::vector<const char*> keys, vals;
-    for (const auto& kv : params) {
-      keys.push_back(kv.first.c_str());
-      vals.push_back(kv.second.c_str());
-    }
     int n_out = static_cast<int>(out.size());
     MXTPUHandle* outs = out.data();
-    Check(MXTPUImperativeInvoke(handle_, static_cast<int>(in.size()),
-                                in.data(), &n_out, &outs,
-                                static_cast<int>(keys.size()), keys.data(),
-                                vals.data()));
+    Run(c, &n_out, &outs);
   }
 
  private:
@@ -458,6 +461,13 @@ class Symbol {
   std::vector<std::string> ListAuxiliaryStates() const {
     return StrList(&MXTPUSymbolListAuxiliaryStates);
   }
+  // Per-argument gradient requests ("write"/"add"/"null"); arguments
+  // absent from the map default to "write" (reference: cpp-package
+  // Symbol::SimpleBind grad_req_type map).
+  inline Executor SimpleBind(
+      const Context& ctx,
+      const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
+      const std::map<std::string, std::string>& grad_req_map) const;
   inline Executor SimpleBind(
       const Context& ctx,
       const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
@@ -521,6 +531,14 @@ inline Executor Symbol::SimpleBind(
     const Context& ctx,
     const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
     const std::string& grad_req) const {
+  return SimpleBind(ctx, arg_shapes,
+                    std::map<std::string, std::string>{{"*", grad_req}});
+}
+
+inline Executor Symbol::SimpleBind(
+    const Context& ctx,
+    const std::map<std::string, std::vector<uint32_t>>& arg_shapes,
+    const std::map<std::string, std::string>& grad_req_map) const {
   std::vector<const char*> names;
   std::vector<uint32_t> idx{0}, data;
   for (const auto& kv : arg_shapes) {
@@ -529,11 +547,18 @@ inline Executor Symbol::SimpleBind(
     idx.push_back(static_cast<uint32_t>(data.size()));
   }
   std::vector<std::string> arg_names = ListArguments();
+  auto star = grad_req_map.find("*");
+  const std::string fallback =
+      star != grad_req_map.end() ? star->second : std::string("write");
+  std::vector<std::string> req_store;
+  for (const std::string& n : arg_names) {
+    auto it = grad_req_map.find(n);
+    req_store.push_back(it != grad_req_map.end() ? it->second : fallback);
+  }
   std::vector<const char*> req_names;
   std::vector<const char*> req_types;
   for (const std::string& n : arg_names) req_names.push_back(n.c_str());
-  for (size_t i = 0; i < arg_names.size(); ++i)
-    req_types.push_back(grad_req.c_str());
+  for (const std::string& r : req_store) req_types.push_back(r.c_str());
   uint32_t num_in = 0, num_aux = 0;
   MXTPUHandle* in_arr = nullptr;
   MXTPUHandle* grad_arr = nullptr;

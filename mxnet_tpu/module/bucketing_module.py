@@ -225,6 +225,14 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized
         return self._curr_module.get_outputs(merge_multi_context)
 
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.set_states(states=states, value=value)
+
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
         return self._curr_module.get_input_grads(merge_multi_context)

@@ -77,6 +77,18 @@ class DataParallelExecutorGroup:
                 shapes[name] = (n,) + tuple(shape[1:])
             for name, shape in (self.label_shapes or []):
                 shapes[name] = (n,) + tuple(shape[1:])
+            if self.state_names:
+                # state inputs ride the data batch (reference: deferred
+                # batch dim 0 in begin_state; our cells emit a concrete
+                # stand-in of 1, re-batched here at bind time)
+                for node in self.symbol._topo_nodes():
+                    if node.is_variable and node.name in self.state_names \
+                            and "__shape__" in node.attr_dict:
+                        from ..symbol.symbol import _parse_attr_value
+
+                        tail = tuple(_parse_attr_value(
+                            node.attr_dict["__shape__"]))[1:]
+                        shapes[node.name] = (n,) + tail
             ex = self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
                                          **shapes)
             if self._shared_group is not None \
@@ -154,6 +166,37 @@ class DataParallelExecutorGroup:
                 sl = self.slices[i]
                 og = [g[sl] for g in out_grads]
             ex.backward(out_grads=og)
+
+    # --------------------------------------------------------------- states
+    def get_states(self, merge_multi_context=True):
+        """Current values of the state inputs (reference:
+        executor_group.get_states; states are the symbol arguments named
+        in state_names, carried across forwards by the caller)."""
+        per_state = [[ex.arg_dict[n] for ex in self.execs]
+                     for n in self.state_names]
+        if not merge_multi_context:
+            return per_state
+        return [arrs[0] if len(arrs) == 1 else concatenate(arrs, axis=0)
+                for arrs in per_state]
+
+    def set_states(self, states=None, value=None):
+        """Set state inputs from a states list (merged NDArray per state,
+        or per-device lists as returned by get_outputs/get_states with
+        merge_multi_context=False) or broadcast a scalar value
+        (reference: executor_group.set_states)."""
+        if (states is None) == (value is None):
+            raise ValueError("set_states: exactly one of states/value")
+        for si, name in enumerate(self.state_names):
+            for di, ex in enumerate(self.execs):
+                dst = ex.arg_dict[name]
+                if value is not None:
+                    dst[:] = value
+                else:
+                    src = states[si]
+                    if isinstance(src, (list, tuple)):
+                        dst[:] = src[di]
+                    else:
+                        dst[:] = src[self.slices[di]]
 
     def get_outputs(self, merge_multi_context=True):
         if not merge_multi_context or len(self.execs) == 1:

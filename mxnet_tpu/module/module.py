@@ -390,6 +390,17 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and self.inputs_need_grad
         return self._exec_group.get_input_grads(merge_multi_context)
 
+    def get_states(self, merge_multi_context=True):
+        """Values of the state inputs named by state_names (reference:
+        module.py get_states — stateful RNN serving feeds these back
+        through set_states between batches)."""
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.set_states(states=states, value=value)
+
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._exec_group.update_metric(eval_metric, labels, pre_sliced)
 

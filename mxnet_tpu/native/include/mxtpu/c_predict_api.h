@@ -20,6 +20,8 @@ extern "C" {
 #ifndef MXTPU_DLL
 #ifdef __GNUC__
 #define MXTPU_DLL __attribute__((visibility("default")))
+#else
+#define MXTPU_DLL
 #endif
 #endif
 

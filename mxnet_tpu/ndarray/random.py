@@ -47,7 +47,12 @@ def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwa
     return _sample("_random_normal", shape, dtype, ctx, {"loc": loc, "scale": scale})
 
 
-randn = normal
+def randn(*shape, **kwargs):
+    """numpy-style positional shape (reference: ndarray/random.py:170
+    ``randn(*shape, loc=, scale=, ...)``; distinct from ``normal``,
+    whose first positionals are loc/scale)."""
+    return normal(kwargs.pop("loc", 0.0), kwargs.pop("scale", 1.0),
+                  shape=shape if shape else (1,), **kwargs)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
@@ -99,7 +104,9 @@ def randint(low, high, shape=(1,), dtype="int32", ctx=None, **kwargs):
 def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
     attrs = {"shape": (shape,) if isinstance(shape, int) else tuple(shape),
              "get_prob": get_prob, "dtype": dtype}
-    return imperative_invoke("_sample_multinomial", [data], attrs)[0]
+    res = imperative_invoke("_sample_multinomial", [data], attrs)
+    # reference returns [samples, log_likelihood] when get_prob=True
+    return res if get_prob else res[0]
 
 
 def shuffle(data, **kwargs):

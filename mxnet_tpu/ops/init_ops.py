@@ -122,8 +122,10 @@ def random_randint(key, low=0, high=1, shape=(1,), dtype="int32", **_):
                               dtype=np_dtype(dtype))
 
 
-@register("_sample_multinomial", aliases=("sample_multinomial",))
-def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32", **_):
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
+def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32",
+                       **_):
     n = int(shape[0]) if shape else 1
     logits = jnp.log(jnp.maximum(data, 1e-37))
     if data.ndim == 1:
@@ -131,9 +133,25 @@ def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32", **_):
     else:
         out = jax.random.categorical(key, logits[:, None, :], axis=-1,
                                      shape=(data.shape[0], n))
+    if get_prob:
+        # log-likelihood of each drawn class (reference: second output
+        # of sample_multinomial when get_prob=True, used for REINFORCE).
+        # Gather before the no-shape squeeze so 2-D data with the
+        # default shape=() takes the same take_along_axis path.
+        logp = logits - jax.scipy.special.logsumexp(logits, axis=-1,
+                                                    keepdims=True)
+        if data.ndim == 1:
+            ll = logp[out.astype(jnp.int32)]
+        else:
+            ll = jnp.take_along_axis(logp, out.astype(jnp.int32), axis=-1)
     if not shape:
         out = out.squeeze(-1) if out.ndim > 1 else out[0]
-    return out.astype(np_dtype(dtype))
+        if get_prob:
+            ll = ll.squeeze(-1) if ll.ndim > 1 else ll[0]
+    out = out.astype(np_dtype(dtype))
+    if not get_prob:
+        return out
+    return out, ll.astype(jnp.float32)
 
 
 @register("_sample_uniform", aliases=("sample_uniform",))

@@ -555,6 +555,25 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
     return jnp.squeeze(out, axis=ax)
 
 
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs, **_):
+    """Legacy 2-D row-wise pick: out[i] = lhs[i, rhs[i]] (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc
+    choose_element_0index, the deprecated alias of pick axis=1)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs, **_):
+    """Legacy 2-D row-wise fill: out = lhs with out[i, rhs[i]] = mhs[i]
+    (reference: fill_element_0index, the in-place companion of
+    choose_element_0index)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.astype(lhs.dtype))
+
+
 @register("SwapAxis", aliases=("swapaxes", "swapaxis"))
 def swapaxes(data, dim1=0, dim2=0, **_):
     """reference: src/operator/swapaxis.cc"""

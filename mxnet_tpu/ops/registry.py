@@ -47,6 +47,8 @@ OP_INPUT_NAMES = {
     "Embedding": ("data", "weight"),
     "LeakyReLU": ("data", "gamma"),
     "SoftmaxOutput": ("data", "label"),
+    "choose_element_0index": ("lhs", "rhs"),
+    "fill_element_0index": ("lhs", "mhs", "rhs"),
     "SVMOutput": ("data", "label"),
     "LinearRegressionOutput": ("data", "label"),
     "MAERegressionOutput": ("data", "label"),

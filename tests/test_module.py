@@ -135,3 +135,47 @@ def test_bucketing_module():
     mod.update()
     out = mod.get_outputs()[0]
     assert out.shape == (20, 4) or out.shape == (20, 8)
+
+
+def test_module_states():
+    """Stateful serving: state inputs named by state_names are readable
+    via get_states, settable via set_states(value=) or by feeding
+    outputs back (reference: test_module.py:248 test_module_states)."""
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(2):
+        stack.add(mx.rnn.LSTMCell(num_hidden=20, prefix="lstm_l%d_" % i))
+    begin_state = stack.begin_state(func=mx.sym.Variable)
+    _, states = stack.unroll(10, begin_state=begin_state,
+                             inputs=mx.sym.Variable("data"))
+
+    state_names = [i.name for i in begin_state]
+    mod = mx.mod.Module(mx.sym.Group(states), label_names=None,
+                        state_names=state_names)
+    mod.bind(data_shapes=[("data", (5, 10))], label_shapes=None,
+             for_training=False)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.zeros((5, 10))], label=[])
+
+    mod.set_states(value=1)
+    st = mod.get_states(merge_multi_context=True)
+    assert len(st) == len(state_names)
+    assert all((s.asnumpy() == 1).all() for s in st)
+
+    mod.forward(batch)
+    out = mod.get_outputs(merge_multi_context=False)
+    out1 = mod.get_outputs(merge_multi_context=True)
+
+    # feeding the produced states back changes the next forward
+    mod.set_states(states=out)
+    mod.forward(batch)
+    out2 = mod.get_outputs(merge_multi_context=True)
+
+    for x1, x2 in zip(out1, out2):
+        assert not np.allclose(x1.asnumpy(), x2.asnumpy(), rtol=1e-3)
+
+    # get_states reflects what set_states wrote
+    mod.set_states(states=[o[0] if isinstance(o, list) else o
+                           for o in out])
+    st2 = mod.get_states()
+    for s, o in zip(st2, out1):
+        assert np.allclose(s.asnumpy(), o.asnumpy())

@@ -505,6 +505,10 @@ CREATION = {
 # the sweep still asserts the name is registered
 ELSEWHERE = {
     "RNN": ("tests/test_rnn.py", "FusedRNNCell"),
+    "choose_element_0index": ("tests/test_operator.py",
+                              "test_choose_and_fill_element_0index"),
+    "fill_element_0index": ("tests/test_operator.py",
+                            "test_choose_and_fill_element_0index"),
     "gradientmultiplier": ("tests/test_extended_ops.py",
                            "gradientmultiplier"),
     "IdentityAttachKLSparseReg": ("tests/test_extended_ops.py",

@@ -286,3 +286,28 @@ def test_gather_scatter():
                           mx.nd.array([[0, 1], [2, 0]], dtype="int32"),
                           shape=(3, 4))
     assert sc.asnumpy()[0, 2] == 1.0 and sc.asnumpy()[1, 0] == 2.0
+
+
+def test_choose_and_fill_element_0index():
+    """Legacy row-wise pick/fill pair (reference: test_ndarray.py
+    test_ndarray_choose / test_ndarray_fill over
+    choose_element_0index / fill_element_0index)."""
+    rng = np.random.RandomState(3)
+    lhs = rng.randn(6, 5).astype(np.float32)
+    idx = rng.randint(0, 5, 6).astype(np.float32)
+    mhs = rng.randn(6).astype(np.float32)
+
+    got = mx.nd.choose_element_0index(mx.nd.array(lhs),
+                                      mx.nd.array(idx)).asnumpy()
+    want = lhs[np.arange(6), idx.astype(int)]
+    assert np.allclose(got, want)
+
+    filled = mx.nd.fill_element_0index(mx.nd.array(lhs), mx.nd.array(mhs),
+                                       mx.nd.array(idx)).asnumpy()
+    want2 = lhs.copy()
+    want2[np.arange(6), idx.astype(int)] = mhs
+    assert np.allclose(filled, want2)
+    # out-of-range indices clip (pick-family mode="clip" default)
+    oob = mx.nd.choose_element_0index(mx.nd.array(lhs),
+                                      mx.nd.array(np.full(6, 99.0))).asnumpy()
+    assert np.allclose(oob, lhs[:, 4])
