@@ -1,0 +1,85 @@
+"""Connectionist Temporal Classification — alignment-free sequence
+labeling.
+
+Runnable tutorial (reference: docs/tutorials/speech_recognition/ctc.md
+and the reference's warp-CTC example: an acoustic model emits one
+distribution per frame, CTC sums over all alignments of the label
+sequence, so no frame-level alignment is needed).
+
+Here the "speech" is synthetic: each label id leaves a distinctive
+pattern across a stretch of frames, and a BiLSTM + CTC learns to read
+the label sequence out.  Training uses the fused
+``parallel.GluonTrainStep`` — forward, CTC, backward, and the optimizer
+update compile into ONE program, which is the TPU-native way to run a
+train loop (and ~500x faster than eager stepping for a small RNN).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.parallel import GluonTrainStep
+
+rng = np.random.RandomState(1)
+T, B, N_CLASS, L = 16, 16, 5, 4   # frames, batch, classes, label seq len
+BLANK = N_CLASS - 1               # gluon CTCLoss: blank is the LAST class
+
+def make_batch():
+    feats = rng.randn(T, B, 8).astype(np.float32) * 0.1
+    # no adjacent repeats: a greedy frame-wise decode collapses repeated
+    # labels unless the model emits a separating blank, which this toy
+    # task gives it no reason to learn
+    labels = np.zeros((B, L), np.float32)
+    for b in range(B):
+        seq = [rng.randint(0, BLANK)]
+        while len(seq) < L:
+            c = rng.randint(0, BLANK)
+            if c != seq[-1]:
+                seq.append(c)
+        labels[b] = seq
+    frames_per = T // L
+    for b in range(B):
+        for i in range(L):
+            # each label imprints its id as a bias on its frame stretch
+            sl = slice(i * frames_per, (i + 1) * frames_per)
+            feats[sl, b, int(labels[b, i])] += 3.0
+    return feats, labels
+
+
+# acoustic model: BiLSTM over frames, per-frame class scores
+net = gluon.nn.HybridSequential()
+net.add(gluon.rnn.LSTM(12, bidirectional=True),
+        gluon.nn.Dense(N_CLASS, flatten=False))
+net.initialize(mx.init.Xavier())
+net(mx.nd.zeros((T, B, 8)))  # resolve deferred shapes before staging
+
+ctc = gluon.loss.CTCLoss(layout="TNC", label_layout="NT")
+step = GluonTrainStep(net, ctc, lr=0.05, momentum=0.9)
+
+first = last = None
+for _ in range(400):
+    feats, labels = make_batch()
+    cur = float(np.asarray(step(feats, labels)))
+    first = cur if first is None else first
+    last = cur
+
+# write the trained jax params back into the Gluon Parameters so the
+# normal imperative API (and save_parameters) sees them
+step.sync_to_params()
+
+# greedy decode: argmax per frame, collapse repeats, drop blanks
+feats, labels = make_batch()
+pred = net(mx.nd.array(feats)).argmax(axis=2).asnumpy().T  # (B, T)
+correct = 0
+for b in range(B):
+    seq, prev = [], -1
+    for t in range(T):
+        c = int(pred[b, t])
+        if c != prev and c != BLANK:
+            seq.append(c)
+        prev = c
+    if seq == [int(v) for v in labels[b]]:
+        correct += 1
+assert last < first * 0.1, (first, last)
+assert correct >= B * 3 // 4, correct
+print("OK CTC: loss %.2f -> %.2f; exact decode on %d/%d sequences"
+      % (first, last, correct, B))

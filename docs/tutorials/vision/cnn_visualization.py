@@ -1,0 +1,74 @@
+"""Visualizing what a CNN looks at — Grad-CAM saliency.
+
+Runnable tutorial (reference: docs/tutorials/vision/cnn_visualization.md,
+which applies Grad-CAM to VGG on real photos; here a tiny convnet on a
+synthetic two-class image task so it runs in seconds).
+
+Grad-CAM: the class score's gradient w.r.t. a conv layer's activations,
+spatially pooled, weights those activation maps — highlighting the
+pixels that drove the prediction.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+# --- a task where the evidence has a location ---------------------------
+# Class 1 images carry a bright square in the TOP-LEFT quadrant; class 0
+# in the BOTTOM-RIGHT.  A faithful saliency map must light up the
+# correct quadrant.
+def make_batch(n, rng):
+    x = rng.uniform(0, 0.1, (n, 1, 16, 16)).astype(np.float32)
+    y = rng.randint(0, 2, n)
+    for i, lbl in enumerate(y):
+        if lbl == 1:
+            x[i, 0, 2:6, 2:6] += 1.0
+        else:
+            x[i, 0, 10:14, 10:14] += 1.0
+    return mx.nd.array(x), mx.nd.array(y)
+
+
+rng = np.random.RandomState(7)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+        gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+        gluon.nn.GlobalAvgPool2D(),
+        gluon.nn.Dense(2))
+net.initialize(mx.init.Xavier())
+
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.005})
+for _ in range(40):
+    x, y = make_batch(64, rng)
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(64)
+
+# --- Grad-CAM ------------------------------------------------------------
+# Split the net at the last conv: features = conv part, head = the rest.
+features = gluon.nn.HybridSequential()
+head = gluon.nn.HybridSequential()
+features.add(net[0], net[1])
+head.add(net[2], net[3])
+
+x, y = make_batch(8, rng)
+acts = features(x)
+acts.attach_grad()
+with autograd.record():
+    score = head(acts).pick(y)  # the true-class logit per image
+score.backward()
+
+# channel weights = spatial mean of the gradients; CAM = weighted sum
+weights = acts.grad.mean(axis=(2, 3), keepdims=True)
+cam = mx.nd.relu((weights * acts).sum(axis=1)).asnumpy()  # (n, 16, 16)
+
+correct_side = 0
+for i, lbl in enumerate(y.asnumpy().astype(int)):
+    tl = cam[i, :8, :8].sum()
+    br = cam[i, 8:, 8:].sum()
+    if (lbl == 1 and tl > br) or (lbl == 0 and br > tl):
+        correct_side += 1
+assert correct_side >= 6, correct_side  # saliency points at the evidence
+print("OK Grad-CAM localized the evidence in %d/8 images" % correct_side)

@@ -417,6 +417,11 @@ class NDArray:
     def argmax(self, axis=None, keepdims=False):
         return imperative_invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})[0]
 
+    def pick(self, index, axis=-1, keepdims=False, mode="clip"):
+        return imperative_invoke("pick", [self, index],
+                                 {"axis": axis, "keepdims": keepdims,
+                                  "mode": mode})[0]
+
     def argmin(self, axis=None, keepdims=False):
         return imperative_invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})[0]
 

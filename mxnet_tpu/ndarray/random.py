@@ -51,8 +51,13 @@ def randn(*shape, **kwargs):
     """numpy-style positional shape (reference: ndarray/random.py:170
     ``randn(*shape, loc=, scale=, ...)``; distinct from ``normal``,
     whose first positionals are loc/scale)."""
+    if "shape" in kwargs:  # pre-r4 alias-of-normal callers
+        if shape:
+            raise TypeError("randn: pass the shape positionally OR as "
+                            "shape=, not both")
+        shape = kwargs.pop("shape")
     return normal(kwargs.pop("loc", 0.0), kwargs.pop("scale", 1.0),
-                  shape=shape if shape else (1,), **kwargs)
+                  shape=tuple(shape) if shape else (1,), **kwargs)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):

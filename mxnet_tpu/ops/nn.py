@@ -652,6 +652,12 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     data: (seq, batch, alphabet) activations (pre-softmax).
     Uses a lax.scan forward algorithm in log space.
     """
+    # The reference op contracts its input list by the use_* flags
+    # (ctc_loss.cc ListArguments): when only label_lengths is in use, it
+    # is the THIRD input.  Positional callers (gluon CTCLoss passes
+    # pred_lengths=None) therefore land it in the data_lengths slot.
+    if use_label_lengths and not use_data_lengths and label_lengths is None:
+        label_lengths, data_lengths = data_lengths, None
     seq_len, batch, alphabet = data.shape
     logp = jax.nn.log_softmax(data, axis=-1)
     blank = 0 if blank_label == "first" else alphabet - 1

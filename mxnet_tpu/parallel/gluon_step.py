@@ -249,10 +249,19 @@ class GluonTrainStep:
         return loss
 
     def sync_to_params(self):
-        """Write functional values back into the Gluon Parameters."""
+        """Write functional values back into the Gluon Parameters.
+
+        Values are gathered off the mesh first: the Parameters feed the
+        normal eager API afterwards, and a mesh-committed array mixed
+        with default-device eager operands is a placement error on
+        multi-device hosts."""
+        import jax.numpy as jnp
+
         for p, v in zip(self.trainable, self.train_vals):
+            host = jnp.asarray(_np.asarray(v))
             for d in p._data:
-                d._assign(v)
+                d._assign(host)
         for p, v in zip(self.aux, self.aux_vals):
+            host = jnp.asarray(_np.asarray(v))
             for d in p._data:
-                d._assign(v)
+                d._assign(host)

@@ -187,3 +187,49 @@ def test_activation_matches_torch(name):
     assert_almost_equal(xd.grad.asnumpy(), xt.grad.numpy(),
                         rtol=1e-4, atol=1e-5,
                         names=("mx-grad", "torch-grad"))
+
+
+@pytest.mark.parametrize("blank_label", ["first", "last"])
+def test_ctc_loss_torch_oracle(blank_label):
+    """CTC against torch's native CTC over random activations, both
+    blank conventions, full and variable label lengths — including the
+    gluon wrapper's contracted-input call (label_lengths without
+    pred_lengths), which the reference op handles by shrinking its
+    input list (ctc_loss.cc ListArguments)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    T, B, C, L = 10, 4, 5, 3
+    data = rng.randn(T, B, C).astype(np.float32)
+    blank = 0 if blank_label == "first" else C - 1
+    lo, hi = (1, C) if blank_label == "first" else (0, C - 1)
+    labels = rng.randint(lo, hi, (B, L)).astype(np.float32)
+
+    t_logp = torch.log_softmax(torch.tensor(data), dim=-1)
+
+    def torch_ctc(label_lens):
+        return torch.nn.functional.ctc_loss(
+            t_logp, torch.tensor(labels, dtype=torch.long),
+            torch.full((B,), T, dtype=torch.long),
+            torch.tensor(label_lens, dtype=torch.long),
+            blank=blank, reduction="none").numpy()
+
+    got = mx.nd.ctc_loss(mx.nd.array(data), mx.nd.array(labels),
+                         blank_label=blank_label).asnumpy()
+    assert np.allclose(got, torch_ctc([L] * B), atol=1e-4)
+
+    # variable label lengths, positionally contracted (no data_lengths)
+    ll = np.array([1, 2, 3, 2], np.float32)
+    got2 = mx.nd.ctc_loss(mx.nd.array(data), mx.nd.array(labels), None,
+                          mx.nd.array(ll), use_label_lengths=True,
+                          blank_label=blank_label).asnumpy()
+    assert np.allclose(got2, torch_ctc(ll.astype(int)), atol=1e-4)
+
+    # gluon wrapper end-to-end (blank is always 'last' there)
+    if blank_label == "last":
+        from mxnet_tpu import gluon
+
+        lfn = gluon.loss.CTCLoss(layout="TNC", label_layout="NT")
+        got3 = lfn(mx.nd.array(data), mx.nd.array(labels), None,
+                   mx.nd.array(ll)).asnumpy()
+        assert np.allclose(got3, torch_ctc(ll.astype(int)), atol=1e-4)

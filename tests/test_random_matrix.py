@@ -46,8 +46,6 @@ CONTINUOUS = [
 @pytest.mark.parametrize("name,kwargs,dist",
                          CONTINUOUS, ids=[c[0] for c in CONTINUOUS])
 def test_continuous_sampler_ks(name, kwargs, dist):
-    fn = getattr(nd.random, name.split("_")[0].replace("uniform01",
-                                                       "uniform"))
     fn = getattr(nd.random, "uniform" if name.startswith("uniform")
                  else name.split("_")[0])
     x = _draw(fn, **kwargs)
