@@ -11,8 +11,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
 
 # --- a synthetic sentiment corpus ---------------------------------------
-# Vocabulary of 50 tokens; class 1 sentences are biased toward "positive"
-# tokens (ids 0-9), class 0 toward ids 40-49.  A real pipeline would use
+# Vocabulary of 50 tokens; class 1 sentences draw from the "positive"
+# half (ids 0-24), class 0 from ids 25-49.  A real pipeline would use
 # a tokenizer + vocabulary; the model is identical.
 VOCAB, SEQ_LEN, N = 50, 20, 256
 rng = np.random.RandomState(0)

@@ -42,13 +42,48 @@ def foreach(body, data, init_states):
     data, stacking outputs (reference: contrib.foreach / _foreach op).
 
     Lowers to one lax.scan — the whole loop compiles to a single XLA
-    While with the body fused.
+    While with the body fused.  Under autograd.record() it runs as an
+    eager Python loop instead, so every op (including uses of
+    closed-over Parameters) lands on the tape — exactly the
+    reference's imperative foreach (python/mxnet/ndarray/contrib.py),
+    whose eager path is a plain for loop.
     """
     import jax
     from jax import lax
 
+    from .. import autograd as _ag
+
     single_data = isinstance(data, NDArray)
     ctx = (data if single_data else data[0])._ctx
+
+    if _ag.is_recording():
+        from . import stack as _stack
+
+        def tree_slice(d, i):
+            if isinstance(d, (list, tuple)):
+                return [tree_slice(v, i) for v in d]
+            return d[i]
+
+        def tree_stack(rows_):
+            if isinstance(rows_[0], (list, tuple)):
+                return [tree_stack([r[k] for r in rows_])
+                        for k in range(len(rows_[0]))]
+            return _stack(*rows_, axis=0)
+
+        def first_leaf(d):
+            while isinstance(d, (list, tuple)):
+                d = d[0]
+            return d
+
+        n = first_leaf(data).shape[0]
+        states = init_states
+        rows = []
+        for i in range(n):
+            out, states = body(tree_slice(data, i), states)
+            rows.append(out)
+        if not rows:
+            return [], states
+        return tree_stack(rows), states
 
     xs = _tree_unwrap(data)
     init = _tree_unwrap(init_states)
@@ -89,6 +124,9 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         # while_loop): a plain Python loop — func runs only while cond
         # holds; if cond is never satisfied, outputs are empty (the
         # reference documents exactly this asymmetry vs symbolic mode)
+        from .. import autograd as _ag
+
+        recording = _ag.is_recording()
         vars_ = list(loop_vars)
         rows = []
         steps = 0
@@ -98,13 +136,27 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
             # an empty list; None is the natural Python spelling)
             out = ([] if out is None
                    else out if isinstance(out, (list, tuple)) else [out])
-            rows.append([_unwrap(o) for o in out])
+            # keep NDArray rows when recording so the stacked outputs
+            # stay on the tape; raw values otherwise
+            rows.append(list(out) if recording
+                        else [_unwrap(o) for o in out])
             new_vars = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
             vars_ = [v if isinstance(v, NDArray) else _wrap(v, ctx)
                      for v in new_vars]
             steps += 1
         outs = []
-        if rows:
+        if rows and recording:
+            from . import stack as _stack
+            from . import zeros as _zeros
+
+            for k in range(len(rows[0])):
+                row_k = [r[k] if isinstance(r[k], NDArray)
+                         else _wrap(r[k], ctx) for r in rows]
+                pad = [_zeros(tuple(row_k[0].shape), ctx=ctx,
+                              dtype=row_k[0].dtype)
+                       for _ in range(max_iterations - steps)]
+                outs.append(_stack(*(row_k + pad), axis=0))
+        elif rows:
             for k in range(len(rows[0])):
                 buf = jnp.zeros((max_iterations,) + tuple(rows[0][k].shape),
                                 rows[0][k].dtype)

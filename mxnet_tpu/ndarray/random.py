@@ -55,9 +55,9 @@ def randn(*shape, **kwargs):
         if shape:
             raise TypeError("randn: pass the shape positionally OR as "
                             "shape=, not both")
-        shape = kwargs.pop("shape")
+        shape = kwargs.pop("shape")  # int or sequence; normal normalizes
     return normal(kwargs.pop("loc", 0.0), kwargs.pop("scale", 1.0),
-                  shape=tuple(shape) if shape else (1,), **kwargs)
+                  shape=shape if shape else (1,), **kwargs)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):

@@ -207,3 +207,116 @@ def test_monitor():
     stats = mon.toc()
     assert len(stats) >= 1
     assert all(np.isfinite(v) for _, _, v in stats)
+
+
+def test_foreach_gradients():
+    """Gradients flow through foreach — through the scanned data, the
+    carried state, AND closed-over arrays (reference:
+    test_contrib_control_flow.py test_foreach: the imperative path is
+    an eager loop, so every op is recorded)."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    w = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    w.attach_grad()
+    with mx.autograd.record():
+        outs, states = mx.nd.contrib.foreach(
+            lambda d, s: (d * w + s[0], [s[0] + d.sum()]),
+            x, [mx.nd.zeros((2,))])
+        loss = outs.sum() + states[0].sum()
+    loss.backward()
+    # out_i = w*x_i + s_i with s_i a 2-vector of sum_{j<i} x_j.sum();
+    # d loss/d w = sum_i x_i
+    assert np.allclose(w.grad.asnumpy(), x.asnumpy().sum(axis=0))
+    # d loss/d x_i = w (direct) + 2*(rows after i, via the 2-vector
+    # state in outs) + 2 (final state, also a 2-vector)
+    want_x = np.stack([w.asnumpy() + 2 * (2 - i) + 2 for i in range(3)])
+    assert np.allclose(x.grad.asnumpy(), want_x)
+
+
+def test_foreach_rnn_cell_gradients():
+    """RNN-style foreach: a GRUCell stepped by foreach produces the
+    same outputs AND weight gradients as the cell's own unroll
+    (reference: test_contrib_control_flow.py test_foreach_rnn)."""
+    T, B, H = 4, 2, 3
+    cell = mx.gluon.rnn.GRUCell(H, input_size=H)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(T, B, H))
+    begin = [mx.nd.zeros((B, H))]
+
+    with mx.autograd.record():
+        outs, _ = mx.nd.contrib.foreach(
+            lambda d, s: cell(d, s), x, begin)
+        loss1 = (outs ** 2).sum()
+    loss1.backward()
+    g1 = {k: p.grad().asnumpy().copy()
+          for k, p in cell.collect_params().items()}
+    o1 = outs.asnumpy()
+
+    with mx.autograd.record():
+        outs2, _ = cell.unroll(T, x, begin, layout="TNC",
+                               merge_outputs=True)
+        loss2 = (outs2 ** 2).sum()
+    loss2.backward()
+    g2 = {k: p.grad().asnumpy() for k, p in cell.collect_params().items()}
+
+    assert np.allclose(o1, outs2.asnumpy(), atol=1e-5)
+    for k in g1:
+        assert np.allclose(g1[k], g2[k], atol=1e-5), k
+
+
+def test_foreach_nested_record():
+    """Nested foreach under record: gradients through both levels
+    (reference: test_contrib_control_flow.py test_foreach_nested)."""
+    x = mx.nd.random.uniform(shape=(2, 3, 4))
+    x.attach_grad()
+
+    def outer(d, s):
+        inner, _ = mx.nd.contrib.foreach(
+            lambda dd, ss: (dd * 2, ss), d, [])
+        return inner, s
+
+    with mx.autograd.record():
+        o, _ = mx.nd.contrib.foreach(outer, x, [])
+        loss = o.sum()
+    loss.backward()
+    assert o.shape == (2, 3, 4)
+    assert np.allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_while_loop_gradients():
+    """Gradients flow through while_loop's stacked outputs and final
+    loop vars; zero-padding rows carry no gradient (reference:
+    test_contrib_control_flow.py test_while_loop_for_foreach)."""
+    a = mx.nd.array([1.0])
+    a.attach_grad()
+
+    def cond_fn(i, s):
+        return i < 3
+
+    def body_fn(i, s):
+        return s * 2, [i + 1, s * 2]
+
+    with mx.autograd.record():
+        outs, vars_ = mx.nd.contrib.while_loop(
+            cond_fn, body_fn, [mx.nd.array([0.0]), a], max_iterations=5)
+        loss = outs[0].sum() + vars_[1].sum()
+    loss.backward()
+    # outs rows: 2a, 4a, 8a (+2 zero pads); final var 8a
+    assert outs[0].shape == (5, 1)
+    assert np.allclose(outs[0].asnumpy().ravel(), [2, 4, 8, 0, 0])
+    assert abs(float(a.grad.asnumpy()) - (2 + 4 + 8 + 8)) < 1e-5
+
+
+def test_cond_gradients():
+    """Only the taken branch contributes gradient (reference:
+    test_contrib_control_flow.py cond tests)."""
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        r = mx.nd.contrib.cond(x.sum() > 1, lambda: x * 3, lambda: x * 7)
+        r.backward()
+    assert np.allclose(x.grad.asnumpy(), 3.0)
+    with mx.autograd.record():
+        r = mx.nd.contrib.cond(x.sum() > 5, lambda: x * 3, lambda: x * 7)
+        r.backward()
+    assert np.allclose(x.grad.asnumpy(), 7.0)
