@@ -260,7 +260,12 @@ class Executor:
             return
         arg_vals, aux_vals, seed, is_train = self._fwd_state
         fwd, _bwd, _d = self._get_fns(is_train)
-        outs, new_aux = fwd(arg_vals, aux_vals, seed)
+        try:
+            outs, new_aux = fwd(arg_vals, aux_vals, seed)
+        except (TypeError, ValueError) as e:
+            # surface graph-execution failures as MXNetError (reference:
+            # engine errors reach WaitForVar/asnumpy as MXNetError)
+            raise MXNetError("executor forward: %s" % e) from e
         self._set_outputs(outs, new_aux)
 
     def _set_outputs(self, outs, new_aux):
@@ -292,7 +297,10 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             ogs = [g._data if isinstance(g, NDArray) else g for g in out_grads]
-        outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
+        try:
+            outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
+        except (TypeError, ValueError) as e:
+            raise MXNetError("executor backward: %s" % e) from e
         if self._outputs is None:
             self._set_outputs(outs, new_aux)
         for i, g in zip(diff_idx, dargs):

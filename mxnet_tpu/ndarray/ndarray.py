@@ -676,6 +676,27 @@ def _as_nd(x, ctx=None):
 # ----------------------------------------------------------------- dispatch
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _op_errors(op_name, arrays):
+    """Surface op failures as MXNetError (reference: every imperative
+    error crosses the C API as MXNetError, src/c_api/c_api_error.cc).
+    Under jit tracing the original jax error types are kept — hybrid
+    callers and jax itself dispatch on them."""
+    try:
+        yield
+    except (TypeError, ValueError) as e:
+        if isinstance(e, ValueError) and "incompatible devices" in str(e):
+            raise  # handled by the cross-device retry in the caller
+        import jax
+
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            raise
+        raise MXNetError("%s: %s" % (op_name, e)) from e
+
+
 def imperative_invoke(op_name, inputs, attrs, out=None):
     """The imperative dispatch path.
 
@@ -727,10 +748,11 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
         import jax
 
         fn = op.bind_attrs(attrs)
-        if needs_key:
-            outv, vjp_fn = _vjp_with_aux(fn, arrays)
-        else:
-            outv, vjp_fn = jax.vjp(fn, *arrays)
+        with _op_errors(op_name, arrays):
+            if needs_key:
+                outv, vjp_fn = _vjp_with_aux(fn, arrays)
+            else:
+                outv, vjp_fn = jax.vjp(fn, *arrays)
         result = outv if isinstance(outv, tuple) else (outv,)
         out_nds = _wrap_outputs(result, ctx, out)
         _ag.record_op(inputs, out_nds, vjp_fn)
@@ -739,10 +761,12 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
     if needs_key:
         # keys vary per call → bypass the static jit cache (jax still
         # compiles the underlying primitives)
-        result = op.bind_attrs(attrs)(*arrays)
+        with _op_errors(op_name, arrays):
+            result = op.bind_attrs(attrs)(*arrays)
     else:
         try:
-            result = op.jitted(attrs)(*arrays)
+            with _op_errors(op_name, arrays):
+                result = op.jitted(attrs)(*arrays)
         except ValueError as e:
             if "incompatible devices" not in str(e):
                 raise

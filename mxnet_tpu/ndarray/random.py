@@ -69,6 +69,10 @@ def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
 
 
 def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    if not isinstance(scale, NDArray) and scale <= 0:
+        from ..base import MXNetError
+
+        raise MXNetError("random_exponential: invalid scale=%r" % (scale,))
     if isinstance(scale, NDArray):
         return _sample("_sample_exponential", shape if shape != (1,) else (),
                        dtype, ctx, {}, tensors=(1.0 / scale,))

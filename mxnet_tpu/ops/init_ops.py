@@ -69,6 +69,18 @@ def eye(N=1, M=0, k=0, dtype="float32", **_):
 # All samplers: fn(key, [dist-param tensors...], shape=..., dtype=...)
 
 
+def _check_param(op, name, value, ok):
+    """Reject invalid SCALAR distribution parameters at dispatch, like
+    the reference kernels' CHECK macros (src/operator/random/
+    sample_op.h; surfaced there as a deferred engine error, here
+    synchronously).  Array-valued params are validated nowhere cheap —
+    same as feeding NaNs: garbage in, garbage out."""
+    if isinstance(value, (int, float)) and not ok(value):
+        from ..base import MXNetError
+
+        raise MXNetError("%s: invalid %s=%r" % (op, name, value))
+
+
 @register("_random_uniform", aliases=("random_uniform", "uniform"))
 def random_uniform(key, low=0.0, high=1.0, shape=(1,), dtype="float32", **_):
     d = np_dtype(dtype)
@@ -77,30 +89,37 @@ def random_uniform(key, low=0.0, high=1.0, shape=(1,), dtype="float32", **_):
 
 @register("_random_normal", aliases=("random_normal", "normal"))
 def random_normal(key, loc=0.0, scale=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_normal", "scale", scale, lambda v: v >= 0)
     d = np_dtype(dtype)
     return jax.random.normal(key, tuple(shape), dtype=d) * scale + loc
 
 
 @register("_random_gamma", aliases=("random_gamma",))
 def random_gamma(key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_gamma", "alpha", alpha, lambda v: v > 0)
+    _check_param("random_gamma", "beta", beta, lambda v: v > 0)
     d = np_dtype(dtype)
     return jax.random.gamma(key, alpha, tuple(shape), dtype=d) * beta
 
 
 @register("_random_exponential", aliases=("random_exponential",))
 def random_exponential(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_exponential", "lam", lam, lambda v: v > 0)
     d = np_dtype(dtype)
     return jax.random.exponential(key, tuple(shape), dtype=d) / lam
 
 
 @register("_random_poisson", aliases=("random_poisson",))
 def random_poisson(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_poisson", "lam", lam, lambda v: v >= 0)
     out = jax.random.poisson(key, lam, tuple(shape))
     return out.astype(np_dtype(dtype))
 
 
 @register("_random_negative_binomial", aliases=("random_negative_binomial",))
 def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_negative_binomial", "k", k, lambda v: v > 0)
+    _check_param("random_negative_binomial", "p", p, lambda v: 0 < v <= 1)
     k1, k2 = jax.random.split(key)
     lam = jax.random.gamma(k1, float(k), tuple(shape)) * ((1.0 - p) / p)
     return jax.random.poisson(k2, lam, tuple(shape)).astype(np_dtype(dtype))
@@ -109,6 +128,10 @@ def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype="float32", **_):
 @register("_random_generalized_negative_binomial",
           aliases=("random_generalized_negative_binomial",))
 def random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", **_):
+    _check_param("random_generalized_negative_binomial", "mu", mu,
+                 lambda v: v > 0)
+    _check_param("random_generalized_negative_binomial", "alpha", alpha,
+                 lambda v: v > 0)
     k1, k2 = jax.random.split(key)
     r = 1.0 / alpha
     p = r / (r + mu)
