@@ -443,11 +443,13 @@ def _ew(opname, lhs, rhs):
     out = NDArray(apply_op(opname, lhs._data, rhs._data), lhs._ctx)
     ls = getattr(lhs, "stype", "default")
     rs = getattr(rhs, "stype", "default")
-    # reference storage inference: rsp⊕rsp -> rsp (add/sub); anything with
-    # dense -> dense
-    if ls == rs == "row_sparse" and opname in ("elemwise_add",
-                                               "elemwise_sub"):
-        return cast_storage(out, "row_sparse")
+    # reference storage inference (ElemwiseStorageType): same sparse
+    # stype in -> same stype out for add/sub/mul; anything with a dense
+    # operand -> dense.  (mul of two sparse is sparse since the product
+    # vanishes wherever either operand does.)
+    if ls == rs and ls in ("row_sparse", "csr") and opname in (
+            "elemwise_add", "elemwise_sub", "elemwise_mul"):
+        return cast_storage(out, ls)
     return out
 
 

@@ -115,6 +115,13 @@ class Symbol:
     def __iter__(self):
         return (Symbol([out]) for out in self._outputs)
 
+    def __bool__(self):
+        # reference: symbol.py __bool__ → NotImplementedForSymbol — a
+        # symbol has no truth value; data-dependent branches belong in
+        # control-flow ops
+        raise MXNetError("Symbol has no truth value: use mx.sym.contrib "
+                         "control-flow ops for data-dependent branching")
+
     def __len__(self):
         return len(self._outputs)
 

@@ -405,3 +405,72 @@ def test_csr_review_fixes():
               else mx.nd.array(dense)._data)
     assert c.indptr.asnumpy().tolist() == [0, 2, 3]
     assert c.indices.asnumpy().tolist() == [0, 2, 1]
+
+
+def test_sparse_nd_slice_matches_dense():
+    """Row slicing of row_sparse and CSR matches the dense oracle
+    (reference: test_sparse_ndarray.py test_sparse_nd_slice)."""
+    rng = np.random.RandomState(0)
+    dense = np.zeros((7, 4), np.float32)
+    rows = [1, 3, 6]
+    dense[rows] = rng.randn(3, 4)
+    rsp = mx.nd.sparse.row_sparse_array(
+        (dense[rows], np.array(rows)), shape=(7, 4))
+    for sl in (slice(0, 4), slice(2, 7), slice(3, 4)):
+        assert np.allclose(rsp[sl].asnumpy(), dense[sl])
+    indptr = np.array([0, 2, 2, 5, 6])
+    indices = np.array([0, 3, 1, 2, 3, 0])
+    data = rng.randn(6).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix((data, indices, indptr), shape=(4, 4))
+    want = csr.asnumpy()
+    for sl in (slice(0, 2), slice(1, 4)):
+        got = csr[sl]
+        assert got.stype == "csr"
+        assert np.allclose(got.asnumpy(), want[sl])
+
+
+def test_sparse_nd_elemwise_stypes():
+    """elemwise add/mul keep or densify storage per the reference's
+    stype rules (test_sparse_operator.py test_elemwise_binary_ops):
+    rsp+rsp -> rsp, rsp+dense -> dense."""
+    rows = np.array([0, 2])
+    vals = np.ones((2, 3), np.float32)
+    a = mx.nd.sparse.row_sparse_array((vals, rows), shape=(4, 3))
+    b = mx.nd.sparse.row_sparse_array((2 * vals, rows), shape=(4, 3))
+    s = mx.nd.elemwise_add(a, b)
+    assert s.stype == "row_sparse"
+    assert np.allclose(s.asnumpy(), a.asnumpy() + b.asnumpy())
+    m = mx.nd.elemwise_mul(a, b)
+    assert m.stype == "row_sparse"
+    assert np.allclose(m.asnumpy(), a.asnumpy() * b.asnumpy())
+    d = mx.nd.elemwise_add(a, mx.nd.ones((4, 3)))
+    assert d.stype == "default"
+    assert np.allclose(d.asnumpy(), a.asnumpy() + 1)
+    # out= and autograd recording fall back to the dense path: out is
+    # honored and gradients record (review r4)
+    buf = mx.nd.zeros((4, 3))
+    r = mx.nd.elemwise_add(a, b, out=buf)
+    assert np.allclose(buf.asnumpy(), a.asnumpy() + b.asnumpy())
+    w = mx.nd.ones((3, 2))
+    w.attach_grad()
+    csr = a.tostype("default")  # dense for grad; csr lhs grad path below
+    from mxnet_tpu.ndarray import sparse as _sp
+    c = _sp.csr_matrix(a.asnumpy(), shape=(4, 3))
+    with mx.autograd.record():
+        y = mx.nd.dot(c, w)
+        loss = y.sum()
+    loss.backward()
+    assert np.allclose(w.grad.asnumpy(),
+                       a.asnumpy().sum(axis=0)[:, None].repeat(2, 1))
+
+
+def test_sparse_nd_comparison_densifies():
+    """Comparison ops on sparse inputs produce correct dense results
+    (reference: test_sparse_nd_equal/not_equal/greater)."""
+    rows = np.array([1])
+    a = mx.nd.sparse.row_sparse_array(
+        (np.full((1, 3), 2.0, np.float32), rows), shape=(3, 3))
+    dense = a.asnumpy()
+    assert np.array_equal((a == 2).asnumpy(), (dense == 2).astype(np.float32))
+    assert np.array_equal((a != 0).asnumpy(), (dense != 0).astype(np.float32))
+    assert np.array_equal((a > 1).asnumpy(), (dense > 1).astype(np.float32))

@@ -144,3 +144,38 @@ def test_save_load_file(tmp_path):
     mlp.save(fname)
     loaded = mx.sym.load(fname)
     assert loaded.list_arguments() == mlp.list_arguments()
+
+
+def test_symbol_pickle_roundtrip():
+    """Symbols pickle (reference: test_symbol.py test_symbol_pickle):
+    structure, names, and attrs survive, and the unpickled graph
+    executes identically."""
+    import pickle
+
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b", lr_mult=2.0)
+    out = mx.sym.FullyConnected(a + b, num_hidden=3, name="fc")
+    out2 = pickle.loads(pickle.dumps(out))
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    assert out2.tojson() == out.tojson()
+    args = {n: mx.nd.ones(s) for n, s in
+            zip(out.list_arguments(),
+                out.infer_shape(a=(2, 4), b=(2, 4))[0])}
+    e1 = out.bind(mx.cpu(), dict(args))
+    e2 = out2.bind(mx.cpu(), dict(args))
+    assert np.allclose(e1.forward()[0].asnumpy(), e2.forward()[0].asnumpy())
+
+
+def test_symbol_bool_raises():
+    """A Symbol has no truth value (reference: test_symbol_bool —
+    NotImplementedForSymbol); `if sym:` is always a bug."""
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    with _pytest.raises(MXNetError):
+        bool(mx.sym.Variable("x"))
+    with _pytest.raises(MXNetError):
+        if mx.sym.Variable("x") == mx.sym.Variable("y"):
+            pass
