@@ -1032,13 +1032,8 @@ def autograd_backward(num_output, outputs_addr, ograds_addr, num_variables,
 
 @capi
 def autograd_get_symbol(hid, out_addr):
-    del hid, out_addr
-    # The tape records vjp closures, not named graph nodes; recover the
-    # graph through the symbolic executor instead (PARITY.md §C-ABI).
-    raise NotImplementedError(
-        "AutogradGetSymbol: the jax tape does not retain a symbolic "
-        "graph; build the graph with the Symbol API (or hybridize and "
-        "export) to obtain one")
+    sym = _autograd().get_symbol(_obj(hid))
+    _write_u64(out_addr, _new_handle(sym))
 
 
 # ================================================================= symbol --

@@ -48,13 +48,21 @@ class AGNode:
 
 
 class _Entry:
-    __slots__ = ("in_nodes", "out_nodes", "vjp_fn", "out_avals")
+    __slots__ = ("in_nodes", "out_nodes", "vjp_fn", "out_avals",
+                 "op_name", "attrs", "in_arrays")
 
-    def __init__(self, in_nodes, out_nodes, vjp_fn, out_avals):
+    def __init__(self, in_nodes, out_nodes, vjp_fn, out_avals,
+                 op_name=None, attrs=None, in_arrays=None):
         self.in_nodes = in_nodes
         self.out_nodes = out_nodes
         self.vjp_fn = vjp_fn
         self.out_avals = out_avals
+        # graph metadata for get_symbol (reference: each AGNode holds
+        # the nnvm op so Imperative::GetDeferredComputeSymbol can
+        # rebuild the graph); None for custom grad_function records
+        self.op_name = op_name
+        self.attrs = attrs
+        self.in_arrays = in_arrays
 
 
 # ---------------------------------------------------------------- scopes
@@ -144,7 +152,7 @@ def _any_recorded(inputs):
     return any(isinstance(a, NDArray) and a._ag_node is not None for a in inputs)
 
 
-def record_op(inputs, outputs, vjp_fn):
+def record_op(inputs, outputs, vjp_fn, op_name=None, attrs=None):
     """Append one op application to the tape (reference: RecordOp)."""
     from .ndarray.ndarray import NDArray
 
@@ -155,7 +163,14 @@ def record_op(inputs, outputs, vjp_fn):
         o._ag_node = node
         out_nodes.append(node)
     out_avals = [(o.shape, o.dtype) for o in outputs]
-    _st()["tape"].append(_Entry(in_nodes, out_nodes, vjp_fn, out_avals))
+    # array refs kept ONLY for constant inputs (no tape node) — that is
+    # all get_symbol needs for identity-keying leaves, and pinning every
+    # input would raise the step's memory high-water mark for nothing
+    in_arrays = [a if isinstance(a, NDArray) and n is None else None
+                 for a, n in zip(inputs, in_nodes)]
+    _st()["tape"].append(_Entry(in_nodes, out_nodes, vjp_fn, out_avals,
+                                op_name=op_name, attrs=attrs,
+                                in_arrays=in_arrays))
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -173,6 +188,96 @@ def get_grad(x):
     if node is None or not node.is_variable:
         return None
     return node.grad
+
+
+def get_symbol(x):
+    """Rebuild the recorded computation that produced ``x`` as a Symbol
+    (reference: MXAutogradGetSymbol / Imperative::GetDeferredComputeSymbol
+    — used to export an imperatively-defined graph).
+
+    Leaves become Variables: arrays marked with attach_grad/
+    mark_variables are named ``var0, var1, ...`` in first-use order;
+    un-recorded constant inputs are named ``const0, ...``.  Ops
+    recorded through a custom grad_function carry no op identity and
+    cannot be exported (clear error).  Export BEFORE backward():
+    backward without retain_graph releases the tape, after which the
+    array reads as an un-recorded constant."""
+    from .base import MXNetError
+    from .ndarray.ndarray import NDArray
+    from . import symbol as sym_mod
+
+    if not isinstance(x, NDArray) or getattr(x, "_ag_node", None) is None:
+        raise MXNetError("get_symbol: array is not in a recorded graph "
+                         "(is autograd.record() active?)")
+    tape = _st()["tape"]
+    producers = {}
+    for entry in tape:
+        for i, on in enumerate(entry.out_nodes):
+            producers[id(on)] = (entry, i)
+
+    entry_syms = {}       # id(entry) -> composed (possibly multi-out) Symbol
+    leaf_syms = {}        # id(AGNode or NDArray) -> Symbol
+    counters = {"var": 0, "const": 0}
+
+    def leaf(kind, key):
+        if key not in leaf_syms:
+            name = "%s%d" % (kind, counters[kind])
+            counters[kind] += 1
+            leaf_syms[key] = sym_mod.Variable(name)
+        return leaf_syms[key]
+
+    def sym_for(node, arr):
+        prod = producers.get(id(node)) if node is not None else None
+        if prod is not None:
+            entry, idx = prod  # built already: tape order is topological
+            s = entry_syms[id(entry)]
+            return s[idx] if len(entry.out_nodes) > 1 else s
+        if node is not None and node.is_variable:
+            return leaf("var", id(node))
+        # constant input (not recorded): keyed by array identity when
+        # available so repeated uses share one Variable
+        return leaf("const", id(arr) if arr is not None else id(node))
+
+    # iterative reachability, then build in tape order — a deep chain
+    # (unrolled RNN) must not hit the Python recursion limit (backward
+    # walks the same tape iteratively)
+    needed = set()
+    seen = set()
+    stack = [x._ag_node]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        prod = producers.get(id(node))
+        if prod is None:
+            continue
+        entry = prod[0]
+        if id(entry) in needed:
+            continue
+        needed.add(id(entry))
+        stack.extend(entry.in_nodes)
+
+    for entry in tape:
+        if id(entry) not in needed:
+            continue
+        if entry.op_name is None:
+            raise MXNetError(
+                "get_symbol: the graph contains a custom grad_function "
+                "record with no op identity; export via hybridize() "
+                "instead")
+        in_syms = [sym_for(n, a)
+                   for n, a in zip(entry.in_nodes, entry.in_arrays or
+                                   [None] * len(entry.in_nodes))]
+        fn = getattr(sym_mod, entry.op_name, None)
+        if fn is None and entry.op_name.startswith("_"):
+            fn = getattr(sym_mod, entry.op_name.lstrip("_"), None)
+        if fn is None:
+            raise MXNetError("get_symbol: op %r has no symbol "
+                             "constructor" % entry.op_name)
+        entry_syms[id(entry)] = fn(*in_syms, **dict(entry.attrs or {}))
+
+    return sym_for(x._ag_node, x)
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):

@@ -755,7 +755,7 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
                 outv, vjp_fn = jax.vjp(fn, *arrays)
         result = outv if isinstance(outv, tuple) else (outv,)
         out_nds = _wrap_outputs(result, ctx, out)
-        _ag.record_op(inputs, out_nds, vjp_fn)
+        _ag.record_op(inputs, out_nds, vjp_fn, op_name=op_name, attrs=attrs)
         return out_nds
 
     if needs_key:

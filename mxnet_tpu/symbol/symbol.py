@@ -49,19 +49,22 @@ class Symbol:
 
     # ---------------------------------------------------------- topology
     def _topo_nodes(self):
-        seen = {}
+        # iterative post-order DFS: deep chains (unrolled RNNs,
+        # get_symbol exports) must not hit the Python recursion limit
+        seen = set()
         order = []
-
-        def visit(node):
+        stack = [(node, False) for node, _ in reversed(self._outputs)]
+        while stack:
+            node, expanded = stack.pop()
+            if expanded:
+                order.append(node)
+                continue
             if id(node) in seen:
-                return
-            seen[id(node)] = node
-            for inp, _ in node.inputs:
-                visit(inp)
-            order.append(node)
-
-        for node, _ in self._outputs:
-            visit(node)
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp, _ in reversed(node.inputs):
+                stack.append((inp, False))
         return order
 
     def list_arguments(self):

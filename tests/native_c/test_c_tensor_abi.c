@@ -272,6 +272,16 @@ static int section_autograd(void) {
   CHECK_OK(MXTPUAutogradSetIsRecording(0, &prev));
   CHECK(prev == 1);
 
+  /* export the recorded graph as a symbol (before backward releases
+   * the tape) */
+  MXTPUHandle rec_sym = 0;
+  CHECK_OK(MXTPUAutogradGetSymbol(y, &rec_sym));
+  uint32_t rs_args = 0;
+  const char** rs_names = NULL;
+  CHECK_OK(MXTPUSymbolListArguments(rec_sym, &rs_args, &rs_names));
+  CHECK(rs_args == 1 && strcmp(rs_names[0], "var0") == 0);
+  CHECK_OK(MXTPUSymbolFree(rec_sym));
+
   MXTPUHandle heads[1] = {y};
   CHECK_OK(MXTPUAutogradBackward(1, heads, NULL, 0));
 

@@ -116,3 +116,66 @@ def test_dropout_respects_modes():
     with ag.record(train_mode=True):
         y = mx.nd.Dropout(x, p=0.5)
     assert (y.asnumpy() == 0).any()
+
+
+def test_get_symbol_exports_recorded_graph():
+    """ag.get_symbol rebuilds the recorded computation as a
+    Symbol that executes identically (reference: MXAutogradGetSymbol /
+    GetDeferredComputeSymbol)."""
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 4).astype(np.float32))
+    w = mx.nd.array(rng.randn(3, 4).astype(np.float32))
+    b = mx.nd.zeros((3,)) + 0.5
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = mx.nd.FullyConnected(x, w, b, num_hidden=3)
+        z = mx.nd.relu(y) * 2 + b.sum()
+    sym = ag.get_symbol(z)
+    # marked arrays become var*; the un-marked bias is const0, and
+    # b.sum() — computed on an UN-recorded array, hence not on the tape
+    # — enters as the precomputed constant const1 (reference tapes only
+    # record ops whose inputs are recorded)
+    names = sym.list_arguments()
+    assert names == ["var0", "var1", "const0", "const1"], names
+    ex = sym.bind(mx.cpu(), {"var0": x, "var1": w, "const0": b,
+                             "const1": b.sum()})
+    assert np.allclose(ex.forward()[0].asnumpy(), z.asnumpy(), atol=1e-5)
+    # the export is side-effect free: backward still works afterwards
+    z.backward()
+    assert w.grad is not None
+
+
+def test_get_symbol_multi_output_op():
+    """Indexed outputs of multi-output ops resolve to the right slot."""
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(2, 6))
+    x.attach_grad()
+    with ag.record():
+        parts = mx.nd.split(x, num_outputs=3, axis=1)
+        z = parts[2] * 10
+    sym = ag.get_symbol(z)
+    ex = sym.bind(mx.cpu(), {"var0": x})
+    assert np.allclose(ex.forward()[0].asnumpy(), z.asnumpy())
+
+
+def test_get_symbol_errors():
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    with _pytest.raises(MXNetError):
+        ag.get_symbol(mx.nd.ones((2,)))  # never recorded
+
+
+def test_get_symbol_deep_chain():
+    """Deep recorded chains export without hitting the recursion limit
+    (get_symbol and Symbol._topo_nodes both walk iteratively, like
+    backward)."""
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    with ag.record():
+        z = x
+        for _ in range(1500):
+            z = mx.nd.relu(z)
+    sym = ag.get_symbol(z)
+    assert sym.list_arguments() == ["var0"]
