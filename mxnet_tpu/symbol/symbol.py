@@ -664,12 +664,298 @@ def load(fname):
         return load_json(f.read())
 
 
+# ops whose inputs[0] and output share a shape exactly (for the partial
+# unification pass; broadcast variants are excluded — not invertible)
+_UNIFY_UNARY = {"relu", "sigmoid", "tanh", "softsign", "Activation",
+                "softmax", "log_softmax", "BatchNorm", "LeakyReLU",
+                "Dropout", "identity", "negative", "LayerNorm"}
+_UNIFY_ELEMWISE = {"elemwise_add", "elemwise_sub", "elemwise_mul",
+                   "elemwise_div"}
+
+
+def _propagate_partial(symbol, known):
+    """Bidirectional fixpoint over PARTIAL shapes (0 = unknown dim in a
+    Variable's shape attr — reference: test_infer_shape.py
+    test_incomplete_infer_*, src/executor/infer_graph_attr_pass.cc's
+    forward/backward iterations).  Returns {var_name: complete tuple}
+    for every variable the unification resolves; structural rules cover
+    elemwise, shape-preserving unaries, FullyConnected, Convolution
+    (stride-1 backward), SliceChannel, and Concat."""
+    nodes = symbol._topo_nodes()
+    var_shapes = {}
+    out_shapes = {}
+
+    def vec_of(shape):
+        return [None if int(d) == 0 else int(d) for d in shape]
+
+    for node in nodes:
+        if node.is_variable:
+            if node.name in known:
+                var_shapes[node.name] = vec_of(known[node.name])
+            elif "__shape__" in node.attr_dict:
+                var_shapes[node.name] = vec_of(
+                    _parse_attr_value(node.attr_dict["__shape__"]))
+
+    state = {"changed": False}
+
+    def get(inp, idx):
+        if inp.is_variable:
+            return var_shapes.get(inp.name)
+        return out_shapes.get((id(inp), idx))
+
+    def unify(a, b, what):
+        if a is None:
+            return list(b) if b is not None else None
+        if b is None:
+            return list(a)
+        if len(a) != len(b):
+            raise MXNetError("infer_shape: rank mismatch at %s: %r vs %r"
+                             % (what, a, b))
+        out = []
+        for x, y in zip(a, b):
+            if x is not None and y is not None and x != y:
+                raise MXNetError("infer_shape: dim mismatch at %s: %r vs %r"
+                                 % (what, a, b))
+            out.append(x if x is not None else y)
+        return out
+
+    def _merge(store, key, vec, what):
+        merged = unify(store.get(key), vec, what)
+        if merged != store.get(key):
+            store[key] = merged
+            state["changed"] = True
+
+    def put(inp, idx, vec, what):
+        if vec is None:
+            return
+        if inp.is_variable:
+            _merge(var_shapes, inp.name, vec, what)
+        else:
+            _merge(out_shapes, (id(inp), idx), vec, what)
+
+    def put_out(node, idx, vec):
+        if vec is not None:
+            _merge(out_shapes, (id(node), idx), vec, node.name)
+
+    def ival(attrs, key, default=None):
+        v = attrs.get(key, default)
+        if isinstance(v, str):
+            v = _parse_attr_value(v)
+        return v
+
+    def step(node):
+        a = node.attrs
+        ins = node.inputs
+        op = node.op
+        me = lambda: out_shapes.get((id(node), 0))
+        if op in _UNIFY_ELEMWISE:
+            merged = me()
+            for inp, idx in ins:
+                merged = unify(merged, get(inp, idx), node.name)
+            for inp, idx in ins:
+                put(inp, idx, merged, node.name)
+            put_out(node, 0, merged)
+        elif op in _UNIFY_UNARY and ins:
+            inp, idx = ins[0]
+            merged = unify(me(), get(inp, idx), node.name)
+            put(inp, idx, merged, node.name)
+            put_out(node, 0, merged)
+        elif op == "Flatten" and ins:
+            # out = (batch, prod(rest)); the batch dim unifies both ways
+            data = get(*ins[0])
+            out = me()
+            batch = data[0] if data is not None else None
+            if batch is None and out is not None:
+                batch = out[0]
+            tail = None
+            if data is not None and all(d is not None for d in data[1:]):
+                tail = 1
+                for d in data[1:]:
+                    tail *= d
+            put_out(node, 0, [batch, tail])
+            if data is not None:
+                put(ins[0][0], ins[0][1], [batch] + data[1:], node.name)
+        elif op == "FullyConnected":
+            nh = ival(a, "num_hidden")
+            if nh is None:
+                return
+            nh = int(nh)
+            flatten = bool(ival(a, "flatten", True))
+            data = get(*ins[0])
+            out = me()
+            batch = None
+            if data is not None:
+                batch = data[0]
+            if out is not None:
+                batch = out[0] if batch is None else batch
+            if flatten:
+                put_out(node, 0, [batch, nh])
+            elif data is not None:
+                # flatten=False: only the last axis projects
+                put_out(node, 0, [batch] + data[1:-1] + [nh])
+            elif out is not None:
+                put_out(node, 0, [batch] + out[1:-1] + [nh])
+            if data is not None:
+                lead = ([batch] + data[1:] if flatten else
+                        [batch] + data[1:])
+                # non-batch data dims also flow back from out when
+                # flatten=False (they pass through unchanged)
+                if not flatten and out is not None and \
+                        len(out) == len(data):
+                    lead = [batch] + [
+                        d if d is not None else o
+                        for d, o in zip(data[1:-1], out[1:-1])] + [data[-1]]
+                put(ins[0][0], ins[0][1], lead, node.name)
+                rest = data[1:] if flatten else data[-1:]
+                if all(d is not None for d in rest) and len(ins) > 1:
+                    in_dim = 1
+                    for d in rest:
+                        in_dim *= d
+                    put(ins[1][0], ins[1][1], [nh, in_dim], node.name)
+        elif op == "Convolution" and ival(a, "layout", "NCHW") == "NCHW":
+            k = tuple(ival(a, "kernel", ()))
+            nf = ival(a, "num_filter")
+            if len(k) != 2 or nf is None:
+                return
+            nf = int(nf)
+            s = tuple(ival(a, "stride", (1, 1)) or (1, 1))
+            p = tuple(ival(a, "pad", (0, 0)) or (0, 0))
+            dl = tuple(ival(a, "dilate", (1, 1)) or (1, 1))
+            data = get(*ins[0])
+            out = me()
+            if (data is not None and len(data) != 4) or \
+                    (out is not None and len(out) != 4):
+                raise MXNetError("infer_shape: Convolution at %s expects "
+                                 "rank-4 NCHW shapes" % node.name)
+            batch = (data[0] if data is not None else None)
+            if batch is None and out is not None:
+                batch = out[0]
+            fwd = [batch, nf, None, None]
+            bwd_sp = [None, None]
+            for i in range(2):
+                din = data[2 + i] if data is not None else None
+                dout = out[2 + i] if out is not None else None
+                eff = dl[i] * (k[i] - 1)
+                if din is not None:
+                    fwd[2 + i] = (din + 2 * p[i] - eff - 1) // s[i] + 1
+                if dout is not None and s[i] == 1:
+                    # s=1: out = in + 2p - eff, exactly invertible
+                    bwd_sp[i] = dout - 2 * p[i] + eff
+            put_out(node, 0, fwd)
+            if data is not None:
+                put(ins[0][0], ins[0][1],
+                    [batch, data[1], bwd_sp[0] if data[2] is None else data[2],
+                     bwd_sp[1] if data[3] is None else data[3]], node.name)
+        elif op == "SliceChannel":
+            n = ival(a, "num_outputs")
+            if n is None:
+                return
+            n = int(n)
+            ax = int(ival(a, "axis", 1))
+            squeeze = bool(ival(a, "squeeze_axis", False))
+            data = get(*ins[0])
+            for i in range(node.num_outputs):
+                out_i = out_shapes.get((id(node), i))
+                if data is not None:
+                    ax_ = ax % len(data)
+                    if squeeze:
+                        vec = data[:ax_] + data[ax_ + 1:]
+                    else:
+                        vec = list(data)
+                        vec[ax_] = (None if data[ax_] is None
+                                    else data[ax_] // n)
+                    put_out(node, i, vec)
+                if out_i is not None:
+                    if squeeze:
+                        ax_in = ax % (len(out_i) + 1)
+                        back = out_i[:ax_in] + [n] + out_i[ax_in:]
+                    else:
+                        back = list(out_i)
+                        back[ax % len(out_i)] = (
+                            None if out_i[ax % len(out_i)] is None
+                            else out_i[ax % len(out_i)] * n)
+                    put(ins[0][0], ins[0][1], back, node.name)
+        elif op == "Concat":
+            dim = int(ival(a, "dim", 1))
+            vecs = [get(inp, idx) for inp, idx in ins]
+            out = me()
+            rank = next((len(v) for v in vecs if v is not None),
+                        len(out) if out is not None else None)
+            if rank is None:
+                return
+            d = dim % rank
+            # unify non-concat axes across everything
+            proto = [None] * rank
+            for v in vecs + [out]:
+                if v is None:
+                    continue
+                if len(v) != rank:
+                    raise MXNetError("infer_shape: concat rank mismatch "
+                                     "at %s" % node.name)
+                for i in range(rank):
+                    if i != d and v[i] is not None:
+                        if proto[i] is not None and proto[i] != v[i]:
+                            raise MXNetError(
+                                "infer_shape: concat dim mismatch at %s"
+                                % node.name)
+                        proto[i] = v[i]
+            for (inp, idx), v in zip(ins, vecs):
+                vec = list(proto)
+                vec[d] = v[d] if v is not None else None
+                put(inp, idx, vec, node.name)
+            dims = [v[d] if v is not None else None for v in vecs]
+            out_d = (sum(dims) if all(x is not None for x in dims)
+                     else None)
+            if out_d is None and out is not None and out[d] is not None \
+                    and sum(x is None for x in dims) == 1:
+                missing = out[d] - sum(x for x in dims if x is not None)
+                i = dims.index(None)
+                vec = list(proto)
+                vec[d] = missing
+                put(ins[i][0], ins[i][1], vec, node.name)
+                out_d = out[d]
+            outv = list(proto)
+            outv[d] = out_d
+            put_out(node, 0, outv)
+
+    for _ in range(100):
+        state["changed"] = False
+        for node in nodes:
+            if not node.is_variable:
+                step(node)
+        if not state["changed"]:
+            break
+
+    return {name: tuple(v) for name, v in var_shapes.items()
+            if v is not None and all(d is not None for d in v)}
+
+
 def _infer_param_shapes(symbol, known):
     """Forward shape propagation through the DAG, solving parameter
     shapes from op semantics (the TPU analog of the reference's shape
     inference attributes, src/executor/infer_graph_attr_pass.cc:325)."""
     shapes = dict(known)
     node_out_shapes = {}
+
+    def _has_partial():
+        for v in shapes.values():
+            if v is not None and any(int(d) == 0 for d in v):
+                return True
+        for node in symbol._topo_nodes():
+            if node.is_variable and node.name not in shapes \
+                    and "__shape__" in node.attr_dict:
+                s = _parse_attr_value(node.attr_dict["__shape__"])
+                if any(int(d) == 0 for d in s):
+                    return True
+        return False
+
+    if _has_partial():
+        # bidirectional unification resolves 0-marked dims first; only
+        # fully-resolved variables feed the (complete-shape) main pass
+        solved = _propagate_partial(symbol, known)
+        shapes = {k: v for k, v in shapes.items()
+                  if v is None or not any(int(d) == 0 for d in v)}
+        shapes.update(solved)
 
     def get_in_shapes(node):
         res = []
@@ -686,8 +972,9 @@ def _infer_param_shapes(symbol, known):
     for node in symbol._topo_nodes():
         if node.is_variable:
             if node.name not in shapes and "__shape__" in node.attr_dict:
-                shapes[node.name] = tuple(
-                    _parse_attr_value(node.attr_dict["__shape__"]))
+                s = tuple(_parse_attr_value(node.attr_dict["__shape__"]))
+                if not any(int(d) == 0 for d in s):  # partials solved above
+                    shapes[node.name] = s
             continue
         in_shapes = get_in_shapes(node)
         # solve unknown parameter-variable shapes from op semantics

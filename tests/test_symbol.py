@@ -179,3 +179,124 @@ def test_symbol_bool_raises():
     with _pytest.raises(MXNetError):
         if mx.sym.Variable("x") == mx.sym.Variable("y"):
             pass
+
+
+def test_incomplete_infer_elemwise():
+    """0-marked dims in Variable shapes resolve bidirectionally
+    (reference: test_infer_shape.py test_incomplete_infer_elewise)."""
+    a = mx.sym.Variable("a", shape=(0, 10))
+    b = mx.sym.Variable("b", shape=(12, 0))
+    c = a + b
+    arg_shapes, _, _ = c.infer_shape()
+    got = dict(zip(c.list_arguments(), arg_shapes))
+    assert got["a"] == (12, 10)
+    assert got["b"] == (12, 10)
+
+
+def test_incomplete_infer_mlp():
+    """(reference: test_incomplete_infer_mlp) — the batch dim flows
+    backward through FullyConnected from a downstream add."""
+    a = mx.sym.Variable("a", shape=(0, 10))
+    b = mx.sym.FullyConnected(data=a, num_hidden=21)
+    c = mx.sym.Variable("c", shape=(5, 0))
+    d = b + c
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["a"] == (5, 10)
+    assert got["c"] == (5, 21)
+
+
+def test_incomplete_infer_slicechannel():
+    """(reference: test_incomplete_infer_slicechannel) — both squeeze
+    modes, dims flowing backward through the split."""
+    a = mx.sym.Variable("a", shape=(0, 10))
+    b = mx.sym.SliceChannel(data=a, num_outputs=10, axis=1,
+                            squeeze_axis=True)
+    c = mx.sym.Variable("c", shape=(5,))
+    d = b[1] + c
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["a"] == (5, 10)
+
+    a = mx.sym.Variable("a2", shape=(0, 15, 0))
+    b = mx.sym.SliceChannel(data=a, num_outputs=3, squeeze_axis=False)
+    c = mx.sym.Variable("c2", shape=(3, 5, 2))
+    d = b[1] + c
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["a2"] == (3, 15, 2)
+
+
+def test_incomplete_infer_convolution():
+    """(reference: test_incomplete_infer_convolution) — stride-1
+    spatial dims invert through the conv."""
+    a = mx.sym.Variable("a", shape=(0, 10, 0, 0))
+    b = mx.sym.Convolution(data=a, num_filter=21, kernel=(3, 3),
+                           dilate=(1, 1), pad=(1, 1))
+    c = mx.sym.Variable("c", shape=(5, 21, 32, 32))
+    d = b + c
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["a"] == (5, 10, 32, 32)
+
+
+def test_incomplete_infer_concat():
+    """(reference: test_incomplete_infer_concat) — the concat axis
+    splits backward into its inputs."""
+    a = mx.sym.Variable("a", shape=(0, 10))
+    b = mx.sym.Variable("b", shape=(0, 5))
+    c = mx.sym.Concat(a, b, num_args=2, dim=1)
+    d = mx.sym.Variable("d", shape=(2, 0))
+    out = d + c
+    arg_shapes, _, _ = out.infer_shape()
+    got = dict(zip(out.list_arguments(), arg_shapes))
+    assert got["a"] == (2, 10)
+    assert got["b"] == (2, 5)
+    assert got["d"] == (2, 15)
+
+
+def test_incomplete_infer_edge_cases():
+    """Review-r4 repros: flatten=False FullyConnected, negative-axis
+    squeeze SliceChannel, and rank validation errors."""
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    # flatten=False: only the last axis projects
+    a = mx.sym.Variable("a", shape=(0, 5, 10))
+    b = mx.sym.FullyConnected(data=a, num_hidden=7, flatten=False)
+    d = b + mx.sym.Variable("c", shape=(4, 5, 7))
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["a"] == (4, 5, 10)
+
+    # negative split axis with squeeze
+    a2 = mx.sym.Variable("a2", shape=(0, 10, 0))
+    b2 = mx.sym.SliceChannel(data=a2, num_outputs=4, axis=-1,
+                             squeeze_axis=True)
+    d2 = b2[0] + mx.sym.Variable("c2", shape=(5, 10))
+    arg_shapes, _, _ = d2.infer_shape()
+    got = dict(zip(d2.list_arguments(), arg_shapes))
+    assert got["a2"] == (5, 10, 4)
+
+    # wrong-rank conv input errors as MXNetError, not IndexError
+    a3 = mx.sym.Variable("a3", shape=(0, 10, 0))
+    b3 = mx.sym.Convolution(data=a3, num_filter=4, kernel=(3, 3))
+    with _pytest.raises(MXNetError):
+        (b3 + mx.sym.Variable("c3", shape=(2, 4, 8, 8))).infer_shape()
+
+
+def test_incomplete_infer_through_conv_flatten_fc():
+    """Batch flows backward through FC and Flatten while spatials flow
+    forward through the conv — the full declare-what-you-know
+    workflow."""
+    data = mx.sym.Variable("data", shape=(0, 3, 24, 24))
+    net = mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                             pad=(1, 1))
+    net = mx.sym.FullyConnected(mx.sym.flatten(net), num_hidden=10)
+    head = net + mx.sym.Variable("bias_like", shape=(32, 0))
+    args, outs, _ = head.infer_shape()
+    got = dict(zip(head.list_arguments(), args))
+    assert got["data"] == (32, 3, 24, 24)
+    assert got["bias_like"] == (32, 10)
+    assert outs == [(32, 10)]
