@@ -163,8 +163,12 @@ class BaseRNNCell:
             kw = dict(kwargs)
             if info is not None:
                 kw.update(info)
-            # concrete-batch stand-in for the reference's deferred 0
-            if "shape" in kw:
+            # Variables keep the reference's deferred-0 batch dim — the
+            # partial-shape unification pass resolves it at bind time
+            # (r4); concrete creators (zeros/...) need real dims, so a
+            # batch-1 stand-in remains there (broadcasting restores the
+            # true batch on first use)
+            if "shape" in kw and func is not symbol.Variable:
                 kw["shape"] = tuple(1 if d == 0 else d for d in kw["shape"])
             kw.pop("__layout__", None)
             states.append(func(

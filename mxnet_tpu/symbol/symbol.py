@@ -749,6 +749,18 @@ def _propagate_partial(symbol, known):
         op = node.op
         me = lambda: out_shapes.get((id(node), 0))
         if op in _UNIFY_ELEMWISE:
+            # reference elemwise_* requires identical shapes, so dims
+            # unify across operands and result.  This runtime tolerates
+            # broadcasting; when a known dim is 1 against a larger dim
+            # the node is broadcast-style — skip it (no raise, no
+            # back-fill) rather than force the same-shape contract.
+            vecs = [me()] + [get(inp, idx) for inp, idx in ins]
+            known = [v for v in vecs if v is not None]
+            if any(len(a) == len(b) and any(
+                    x is not None and y is not None and x != y and
+                    1 in (x, y) for x, y in zip(a, b))
+                   for i, a in enumerate(known) for b in known[i + 1:]):
+                return
             merged = me()
             for inp, idx in ins:
                 merged = unify(merged, get(inp, idx), node.name)
@@ -796,8 +808,7 @@ def _propagate_partial(symbol, known):
             elif out is not None:
                 put_out(node, 0, [batch] + out[1:-1] + [nh])
             if data is not None:
-                lead = ([batch] + data[1:] if flatten else
-                        [batch] + data[1:])
+                lead = [batch] + data[1:]
                 # non-batch data dims also flow back from out when
                 # flatten=False (they pass through unchanged)
                 if not flatten and out is not None and \
@@ -918,11 +929,17 @@ def _propagate_partial(symbol, known):
             outv[d] = out_d
             put_out(node, 0, outv)
 
+    op_nodes = [n for n in nodes if not n.is_variable]
     for _ in range(100):
         state["changed"] = False
-        for node in nodes:
-            if not node.is_variable:
-                step(node)
+        # forward then reverse half-sweeps: backward information crosses
+        # the whole graph per iteration, so deep chains (100+-step
+        # unrolled RNNs) converge in a handful of sweeps instead of one
+        # node per sweep
+        for node in op_nodes:
+            step(node)
+        for node in reversed(op_nodes):
+            step(node)
         if not state["changed"]:
             break
 

@@ -300,3 +300,26 @@ def test_incomplete_infer_through_conv_flatten_fc():
     assert got["data"] == (32, 3, 24, 24)
     assert got["bias_like"] == (32, 10)
     assert outs == [(32, 10)]
+
+
+def test_incomplete_infer_broadcast_tolerance_and_depth():
+    """Review-r4 repros: a broadcast-style add (known dim 1 vs larger)
+    must not make inference raise, and backward info crosses deep
+    chains (120-step unrolled graphs) within the sweep budget."""
+    # broadcast-style node: skipped, not raised on
+    a = mx.sym.Variable("a", shape=(1, 10))
+    b = mx.sym.Variable("b", shape=(12, 0))
+    c = a + b
+    arg_shapes, _, _ = c.infer_shape_partial()
+    got = dict(zip(c.list_arguments(), arg_shapes))
+    assert got["a"] == (1, 10)  # declared shapes untouched
+
+    # deep chain: head shape flows back 120 levels
+    x = mx.sym.Variable("x", shape=(0, 10))
+    z = x
+    for _ in range(120):
+        z = mx.sym.relu(z)
+    d = z + mx.sym.Variable("head", shape=(5, 10))
+    arg_shapes, _, _ = d.infer_shape()
+    got = dict(zip(d.list_arguments(), arg_shapes))
+    assert got["x"] == (5, 10)
