@@ -274,6 +274,10 @@ class Symbol:
         here shape propagation is exact tracing, no fixpoint needed)."""
         try:
             return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError as e:
+            if "inconsistent shape" in str(e):
+                raise  # deterministic user error — retrying cannot help
+            return self.infer_shape_partial(*args, **kwargs)
         except Exception:
             # partial infer falls back to the same impl with skips
             return self.infer_shape_partial(*args, **kwargs)
@@ -1055,10 +1059,24 @@ def _solve_params(node, in_shapes, shapes):
     data_shape = in_shapes[0]
     a = node.attrs
 
-    def setv(i, shape):
+    def setv(i, shape, strict=True):
         inp, _ = node.inputs[i]
-        if inp.is_variable and inp.name not in shapes:
-            shapes[inp.name] = tuple(int(x) for x in shape)
+        if not inp.is_variable:
+            return
+        want = tuple(int(x) for x in shape)
+        have = shapes.get(inp.name)
+        if have is None:
+            shapes[inp.name] = want
+        elif strict and tuple(have) != want:
+            # a provided shape contradicting a STRUCTURAL op constraint
+            # (weight/bias dims) is an error, not a silent override
+            # (reference: InferShape consistency, test_mlp2_infer_error).
+            # Heuristic hints (label mirroring) pass strict=False — the
+            # ops accept broadcastable label shapes at runtime.
+            raise MXNetError(
+                "infer_shape: inconsistent shape for %r: provided %r, "
+                "op semantics of %r require %r"
+                % (inp.name, tuple(have), node.name, want))
 
     if node.op == "FullyConnected":
         nh = int(a.get("num_hidden", 1))
@@ -1073,13 +1091,20 @@ def _solve_params(node, in_shapes, shapes):
         k = tuple(a.get("kernel", ()))
         nf = int(a.get("num_filter", 1))
         ng = int(a.get("num_group", 1))
-        cin = data_shape[1]
+        layout = a.get("layout") or "NCHW"
+        channel_last = layout.endswith("C")
+        cin = data_shape[-1] if channel_last else data_shape[1]
         for i, nm in enumerate(names[:len(node.inputs)]):
             if nm == "weight":
                 if node.op == "Convolution":
-                    setv(i, (nf, cin // ng) + k)
+                    # OIHW for channel-first, OHWI for channel-last
+                    want = ((nf,) + k + (cin // ng,) if channel_last
+                            else (nf, cin // ng) + k)
+                    setv(i, want)
                 else:
-                    setv(i, (cin, nf // ng) + k)
+                    want = ((cin,) + k + (nf // ng,) if channel_last
+                            else (cin, nf // ng) + k)
+                    setv(i, want)
             elif nm == "bias":
                 setv(i, (nf,))
     elif node.op in ("_contrib_quantized_fully_connected",
@@ -1150,8 +1175,9 @@ def _solve_params(node, in_shapes, shapes):
             if nm == "label":
                 if node.op in ("SoftmaxOutput", "SVMOutput"):
                     if a.get("multi_output"):
-                        setv(i, (data_shape[0],) + data_shape[2:])
+                        setv(i, (data_shape[0],) + data_shape[2:],
+                             strict=False)
                     else:
-                        setv(i, data_shape[:-1])
+                        setv(i, data_shape[:-1], strict=False)
                 else:
-                    setv(i, data_shape)
+                    setv(i, data_shape, strict=False)

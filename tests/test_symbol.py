@@ -323,3 +323,58 @@ def test_incomplete_infer_broadcast_tolerance_and_depth():
     arg_shapes, _, _ = d.infer_shape()
     got = dict(zip(d.list_arguments(), arg_shapes))
     assert got["x"] == (5, 10)
+
+
+def test_fc_infer_type():
+    """dtype propagation through FullyConnected (reference:
+    test_infer_shape.py test_fc_infer_type)."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    arg_types, out_types, aux_types = out.infer_type(data=np.float32)
+    got = dict(zip(out.list_arguments(), arg_types))
+    assert len(out_types) == 1 and out_types[0] == np.float32
+    assert got["fc1_weight"] == np.float32
+    assert got["fc1_bias"] == np.float32
+    assert aux_types == []
+
+
+def test_mlp2_infer_shape_and_error():
+    """Two-layer MLP shape inference + the inconsistent-provided-shape
+    error (reference: test_mlp2_infer_shape / test_mlp2_infer_error)."""
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    net = mx.sym.Activation(net, act_type="relu")
+    out = mx.sym.FullyConnected(data=net, name="fc2", num_hidden=10)
+
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    got = dict(zip(out.list_arguments(), arg_shapes))
+    assert out_shapes == [(100, 10)]
+    assert got["fc1_weight"] == (1000, 100)
+    assert got["fc1_bias"] == (1000,)
+    assert got["fc2_weight"] == (10, 1000)
+    assert got["fc2_bias"] == (10,)
+
+    with _pytest.raises(MXNetError):
+        out.infer_shape(data=(100, 100), fc1_weight=(1, 100))
+
+
+def test_infer_shape_channel_last_conv_weight():
+    """Review-r4 repro: a consistent NHWC (OHWI) weight passes the
+    strict check; an inconsistent one errors directly without the
+    partial-infer retry."""
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                              layout="NHWC", name="conv")
+    args, outs, _ = conv.infer_shape(data=(1, 32, 32, 16),
+                                     conv_weight=(8, 3, 3, 16))
+    assert outs == [(1, 30, 30, 8)]
+    with _pytest.raises(MXNetError, match="inconsistent shape"):
+        conv.infer_shape(data=(1, 32, 32, 16), conv_weight=(8, 16, 3, 3))
