@@ -142,7 +142,21 @@ class AttrScope(_ThreadLocalScope):
     _state = threading.local()
 
     def __init__(self, **kwargs):
-        self._attr = {str(k): str(v) for k, v in kwargs.items()}
+        self._own = {str(k): str(v) for k, v in kwargs.items()}
+        self._attr = dict(self._own)
+
+    def __enter__(self):
+        # nested scopes compose AT ENTRY (reference: attribute.py
+        # __enter__ merges with the currently-active scope), so a scope
+        # object built elsewhere still inherits whatever encloses the
+        # `with`.  Recomputed per entry from _own, so re-entry is sound.
+        # Read the raw stack — current() lazily constructs the default
+        # scope, which would recurse through __init__.
+        stack = getattr(AttrScope._state, "value", None)
+        base = getattr(stack[-1], "_attr", None) if stack else None
+        self._attr = dict(base or {})
+        self._attr.update(self._own)
+        return super().__enter__()
 
     def get(self, attr):
         if self._attr:

@@ -378,3 +378,54 @@ def test_infer_shape_channel_last_conv_weight():
     assert outs == [(1, 30, 30, 8)]
     with _pytest.raises(MXNetError, match="inconsistent shape"):
         conv.infer_shape(data=(1, 32, 32, 16), conv_weight=(8, 16, 3, 3))
+
+
+def test_attr_basic_scope_override_and_pickle():
+    """Explicit attrs override the enclosing AttrScope; scope attrs
+    apply to scope-created variables; attrs survive pickling
+    (reference: test_attr.py test_attr_basic)."""
+    import pickle as pkl
+
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data", "group": "1"},
+                               lr_mult=1)
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"  # explicit wins over scope
+    assert data.attr("__lr_mult__") == "1"
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr("dtype") == data2.attr("dtype")
+
+
+def test_attr_nested_scopes_on_operators():
+    """Nested AttrScopes compose onto op nodes; JSON survives pickle
+    (reference: test_attr.py test_operator)."""
+    import pickle as pkl
+
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(__group__="4", __data__="great"):
+        fc1 = mx.sym.Activation(data, act_type="relu")
+        with mx.AttrScope(__init_bias__="0.0"):
+            fc2 = mx.sym.FullyConnected(fc1, num_hidden=10, name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    assert fc2.get_internals()["fc2_weight"] is not None
+
+
+def test_attr_scope_merges_at_entry():
+    """A pre-built scope inherits whatever encloses the `with`, not the
+    construction site, and re-entry recomputes (reference:
+    attribute.py __enter__ merge; review-r4 repro)."""
+    s = mx.AttrScope(__b__="2")
+    with mx.AttrScope(__a__="1"):
+        with s:
+            v = mx.sym.Variable("attrx")
+    assert v.attr("__a__") == "1"
+    assert v.attr("__b__") == "2"
+    with s:  # outer scope gone: only own attrs apply
+        w = mx.sym.Variable("attry")
+    assert w.attr("__a__") is None
+    assert w.attr("__b__") == "2"
