@@ -194,6 +194,11 @@ def test_predict_from_pure_c(tmp_path):
 
     env = dict(os.environ)
     env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    # hermetic embedded interpreter: the session PYTHONPATH may carry a
+    # site hook that dials a TPU relay at startup — a wedged relay then
+    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
+    # carries everything the embedded interpreter needs
+    env.pop("PYTHONPATH", None)
     # keep the embedded interpreter on CPU and quiet
     env.setdefault("JAX_PLATFORMS", "cpu")
     r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
@@ -233,6 +238,11 @@ def test_cpp_package_example(tmp_path):
 
     env = dict(os.environ)
     env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    # hermetic embedded interpreter: the session PYTHONPATH may carry a
+    # site hook that dials a TPU relay at startup — a wedged relay then
+    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
+    # carries everything the embedded interpreter needs
+    env.pop("PYTHONPATH", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
     r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
                        capture_output=True, text=True, timeout=300, env=env)
@@ -270,6 +280,11 @@ def test_cpp_package_training_example(tmp_path):
 
     env = dict(os.environ)
     env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
+    # hermetic embedded interpreter: the session PYTHONPATH may carry a
+    # site hook that dials a TPU relay at startup — a wedged relay then
+    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
+    # carries everything the embedded interpreter needs
+    env.pop("PYTHONPATH", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
     r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
                        env=env)
