@@ -40,3 +40,21 @@ def _seed():
     mx.random.seed(seed)
     np.random.seed(seed)
     yield
+
+
+def hermetic_subprocess_env(repo=None):
+    """Environment for spawning C/embedded-interpreter consumers:
+    MXTPU_PYTHONPATH carries everything the embedded interpreter needs,
+    the session PYTHONPATH is dropped (its site hook dials the TPU
+    relay at startup — a wedged relay hangs the child), and jax stays
+    on CPU."""
+    import sys as _sys
+
+    env = dict(os.environ)
+    if repo is None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["MXTPU_PYTHONPATH"] = ":".join([repo] +
+                                       [p for p in _sys.path if p])
+    env.pop("PYTHONPATH", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env

@@ -33,15 +33,9 @@ def test_c_tensor_abi(tmp_path):
          "-Wl,-rpath," + so_dir], capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
 
-    env = dict(os.environ)
-    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
-    # hermetic embedded interpreter: the session PYTHONPATH may carry a
-    # site hook that dials a TPU relay at startup — a wedged relay then
-    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
-    # carries everything the embedded interpreter needs
-    env.pop("PYTHONPATH", None)
-    # keep the embedded interpreter on CPU and quiet
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(repo)
     r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
                        env=env)
     assert r.returncode == 0, r.stdout + r.stderr

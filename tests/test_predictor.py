@@ -192,15 +192,9 @@ def test_predict_from_pure_c(tmp_path):
          "-Wl,-rpath," + so_dir], capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
 
-    env = dict(os.environ)
-    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
-    # hermetic embedded interpreter: the session PYTHONPATH may carry a
-    # site hook that dials a TPU relay at startup — a wedged relay then
-    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
-    # carries everything the embedded interpreter needs
-    env.pop("PYTHONPATH", None)
-    # keep the embedded interpreter on CPU and quiet
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(repo)
     r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
                        capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
@@ -236,14 +230,9 @@ def test_cpp_package_example(tmp_path):
         capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
 
-    env = dict(os.environ)
-    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
-    # hermetic embedded interpreter: the session PYTHONPATH may carry a
-    # site hook that dials a TPU relay at startup — a wedged relay then
-    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
-    # carries everything the embedded interpreter needs
-    env.pop("PYTHONPATH", None)
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(repo)
     r = subprocess.run([exe, path + "-symbol.json", path + "-0000.params"],
                        capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
@@ -278,14 +267,9 @@ def test_cpp_package_training_example(tmp_path):
         capture_output=True, text=True)
     assert cc.returncode == 0, cc.stderr
 
-    env = dict(os.environ)
-    env["MXTPU_PYTHONPATH"] = ":".join([repo] + [p for p in sys.path if p])
-    # hermetic embedded interpreter: the session PYTHONPATH may carry a
-    # site hook that dials a TPU relay at startup — a wedged relay then
-    # hangs the C process (observed r4); MXTPU_PYTHONPATH already
-    # carries everything the embedded interpreter needs
-    env.pop("PYTHONPATH", None)
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(repo)
     r = subprocess.run([exe], capture_output=True, text=True, timeout=600,
                        env=env)
     assert r.returncode == 0, r.stdout + r.stderr
