@@ -107,6 +107,26 @@ def check_regression(name, value, prior, tolerance):
 
 
 def main():
+    # Watchdog around device acquisition: the TPU relay is this
+    # container's only device path, and killed jax clients can wedge it
+    # server-side (observed r4: every process then hangs inside
+    # jax.devices() in non-interruptible C code — SIGALRM cannot break
+    # it).  Probe in a KILLABLE child first so a wedged relay surfaces
+    # as a clear failure instead of an eternal hang.
+    import subprocess
+
+    try:
+        subprocess.run([sys.executable, "-c",
+                        "import jax; jax.devices()"],
+                       timeout=600, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        raise SystemExit(
+            "bench: TPU relay unreachable within 600s (wedged relay — "
+            "killed jax clients hold the single session server-side; "
+            "see BENCH_NOTES 'Relay variance'). Re-run once the relay "
+            "recovers; the last recorded numbers are in BENCH_r*.json.")
+
     import jax
 
     import mxnet_tpu as mx
