@@ -262,9 +262,12 @@ class Executor:
         fwd, _bwd, _d = self._get_fns(is_train)
         try:
             outs, new_aux = fwd(arg_vals, aux_vals, seed)
-        except (TypeError, ValueError) as e:
+        except (TypeError, ValueError, RuntimeError) as e:
             # surface graph-execution failures as MXNetError (reference:
-            # engine errors reach WaitForVar/asnumpy as MXNetError)
+            # engine errors reach WaitForVar/asnumpy as MXNetError).
+            # RuntimeError covers the device side: jaxlib's
+            # XlaRuntimeError subclasses it, so compile- and run-time
+            # XLA failures wrap too, not just trace-time errors.
             raise MXNetError("executor forward: %s" % e) from e
         self._set_outputs(outs, new_aux)
 
@@ -299,7 +302,7 @@ class Executor:
             ogs = [g._data if isinstance(g, NDArray) else g for g in out_grads]
         try:
             outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
-        except (TypeError, ValueError) as e:
+        except (TypeError, ValueError, RuntimeError) as e:
             raise MXNetError("executor backward: %s" % e) from e
         if self._outputs is None:
             self._set_outputs(outs, new_aux)

@@ -13,6 +13,7 @@ split (OpenCV on CPU workers → device copy in the executor).
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as _np
 
@@ -163,11 +164,42 @@ def center_crop(src, size, interp=2):
     return out, (x0, y0, new_w, new_h)
 
 
+class _SampleScopedStream:
+    """RNG facade for augmenter draws over any random-module-like
+    fallback (np.random here; the Python `random` module for the det
+    augmenters in image_detection.py).
+
+    By default every attribute resolves to the fallback's global
+    stream, so single-threaded augmentation reproduces under
+    np.random.seed/random.seed exactly as before.  A preprocess worker
+    thread installs a per-sample generator (seeded by a draw the
+    CALLING thread made from the global stream), so
+    preprocess_threads>1 keeps sample contents reproducible no matter
+    which pool thread runs which sample — the property the reference
+    gets from per-worker seeded RNGs
+    (src/io/iter_image_recordio_2.cc kRandMagic).  ADVICE r4 #3.
+    """
+
+    def __init__(self, fallback):
+        self._fallback = fallback
+        self._local = threading.local()
+
+    def set_sample_rng(self, rng):
+        self._local.rng = rng
+
+    def __getattr__(self, name):
+        rng = getattr(self._local, "rng", None)
+        return getattr(self._fallback if rng is None else rng, name)
+
+
+_nprand = _SampleScopedStream(_np.random)
+
+
 def random_crop(src, size, interp=2):
     h, w = src.shape[:2]
     new_w, new_h = min(size[0], w), min(size[1], h)
-    x0 = _np.random.randint(0, w - new_w + 1)
-    y0 = _np.random.randint(0, h - new_h + 1)
+    x0 = _nprand.randint(0, w - new_w + 1)
+    y0 = _nprand.randint(0, h - new_h + 1)
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
 
@@ -178,14 +210,14 @@ def random_size_crop(src, size, area, ratio, interp=2):
     if isinstance(area, (int, float)):
         area = (area, 1.0)
     for _ in range(10):
-        target_area = _np.random.uniform(*area) * src_area
+        target_area = _nprand.uniform(*area) * src_area
         log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
-        aspect = _np.exp(_np.random.uniform(*log_ratio))
+        aspect = _np.exp(_nprand.uniform(*log_ratio))
         new_w = int(round(_np.sqrt(target_area * aspect)))
         new_h = int(round(_np.sqrt(target_area / aspect)))
         if new_w <= w and new_h <= h:
-            x0 = _np.random.randint(0, w - new_w + 1)
-            y0 = _np.random.randint(0, h - new_h + 1)
+            x0 = _nprand.randint(0, w - new_w + 1)
+            y0 = _nprand.randint(0, h - new_h + 1)
             out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
             return out, (x0, y0, new_w, new_h)
     return center_crop(src, size, interp)
@@ -271,7 +303,7 @@ class HorizontalFlipAug(Augmenter):
         self.p = p
 
     def __call__(self, src):
-        if _np.random.rand() < self.p:
+        if _nprand.rand() < self.p:
             return _like(_to_host(src)[:, ::-1].copy(), src)
         return src
 
@@ -303,7 +335,7 @@ class BrightnessJitterAug(Augmenter):
         self.brightness = brightness
 
     def __call__(self, src):
-        alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
+        alpha = 1.0 + _nprand.uniform(-self.brightness, self.brightness)
         return _like(_to_host(src).astype(_np.float32) * alpha, src)
 
 
@@ -315,7 +347,7 @@ class ContrastJitterAug(Augmenter):
         self.contrast = contrast
 
     def __call__(self, src):
-        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        alpha = 1.0 + _nprand.uniform(-self.contrast, self.contrast)
         arr = _to_host(src).astype(_np.float32)
         gray = (arr * self._coef).sum() * (3.0 / arr.size)
         return _like(arr * alpha + gray * (1.0 - alpha), src)
@@ -329,7 +361,7 @@ class SaturationJitterAug(Augmenter):
         self.saturation = saturation
 
     def __call__(self, src):
-        alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+        alpha = 1.0 + _nprand.uniform(-self.saturation, self.saturation)
         arr = _to_host(src).astype(_np.float32)
         gray = (arr * self._coef).sum(axis=2, keepdims=True)
         return _like(arr * alpha + gray * (1.0 - alpha), src)
@@ -342,7 +374,7 @@ class HueJitterAug(Augmenter):
 
     def __call__(self, src):
         # yiq rotation (reference: image.py HueJitterAug)
-        alpha = _np.random.uniform(-self.hue, self.hue)
+        alpha = _nprand.uniform(-self.hue, self.hue)
         u = _np.cos(alpha * _np.pi)
         w = _np.sin(alpha * _np.pi)
         bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
@@ -363,7 +395,7 @@ class LightingAug(Augmenter):
         self.eigvec = _np.asarray(eigvec, dtype=_np.float32)
 
     def __call__(self, src):
-        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        alpha = _nprand.normal(0, self.alphastd, size=(3,))
         rgb = _np.dot(self.eigvec * alpha, self.eigval)
         return _like(_to_host(src).astype(_np.float32) + rgb, src)
 
@@ -381,7 +413,7 @@ class ColorJitterAug(Augmenter):
             self.augs.append(SaturationJitterAug(saturation))
 
     def __call__(self, src):
-        for i in _np.random.permutation(len(self.augs)):
+        for i in _nprand.permutation(len(self.augs)):
             src = self.augs[i](src)
         return src
 
@@ -397,7 +429,7 @@ class RandomGrayAug(Augmenter):
         self.p = p
 
     def __call__(self, src):
-        if _np.random.rand() < self.p:
+        if _nprand.rand() < self.p:
             arr = _to_host(src).astype(_np.float32)
             gray = (arr * self._coef).sum(axis=2, keepdims=True)
             return _like(_np.broadcast_to(
@@ -411,7 +443,7 @@ class RandomOrderAug(Augmenter):
         self.ts = ts
 
     def __call__(self, src):
-        for i in _np.random.permutation(len(self.ts)):
+        for i in _nprand.permutation(len(self.ts)):
             src = self.ts[i](src)
         return src
 

@@ -35,12 +35,19 @@ from . import io as _io
 from . import ndarray
 from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
                     ForceResizeAug, HueJitterAug, ImageIter, LightingAug,
-                    RandomGrayAug, ResizeAug, _like, _to_host, fixed_crop)
+                    RandomGrayAug, ResizeAug, _SampleScopedStream, _like,
+                    _to_host, fixed_crop)
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
            "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
            "ImageDetIter"]
+
+
+# Python-random twin of image.py's _nprand: det augmenters draw from
+# the module-global `random` stream unless a preprocess worker installed
+# a per-sample generator (see _SampleScopedStream).
+_rand = _SampleScopedStream(random)
 
 
 # ------------------------------------------------------ box geometry
@@ -127,9 +134,9 @@ class DetRandomSelectAug(DetAugmenter):
                 [a.dumps() for a in self.aug_list]]
 
     def __call__(self, src, label):
-        if random.random() < self.skip_prob:
+        if _rand.random() < self.skip_prob:
             return src, label
-        return random.choice(self.aug_list)(src, label)
+        return _rand.choice(self.aug_list)(src, label)
 
 
 class DetHorizontalFlipAug(DetAugmenter):
@@ -141,7 +148,7 @@ class DetHorizontalFlipAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
-        if random.random() < self.p:
+        if _rand.random() < self.p:
             src = _like(_to_host(src)[:, ::-1].copy(), src)
             label = label.copy()
             x1, x2 = label[:, 1].copy(), label[:, 3].copy()
@@ -232,14 +239,14 @@ class DetRandomCropAug(DetAugmenter):
             return None
         full = float(height * width)
         for _ in range(self.max_attempts):
-            area = random.uniform(*self.area_range) * full
-            ratio = random.uniform(*self.aspect_ratio_range)
+            area = _rand.uniform(*self.area_range) * full
+            ratio = _rand.uniform(*self.aspect_ratio_range)
             cw = int(round((area * ratio) ** 0.5))
             ch = int(round((area / ratio) ** 0.5))
             if cw < 1 or ch < 1 or cw > width or ch > height or cw * ch < 2:
                 continue
-            x0 = random.randint(0, width - cw)
-            y0 = random.randint(0, height - ch)
+            x0 = _rand.randint(0, width - cw)
+            y0 = _rand.randint(0, height - ch)
             if not self._crop_satisfies(label, x0 / width, y0 / height,
                                         (x0 + cw) / width, (y0 + ch) / height,
                                         width, height):
@@ -302,16 +309,16 @@ class DetRandomPadAug(DetAugmenter):
         full = float(height * width)
         lo = max(1.0, self.area_range[0])
         for _ in range(self.max_attempts):
-            area = random.uniform(lo, self.area_range[1]) * full
-            ratio = random.uniform(*self.aspect_ratio_range)
+            area = _rand.uniform(lo, self.area_range[1]) * full
+            ratio = _rand.uniform(*self.aspect_ratio_range)
             cw = int(round((area * ratio) ** 0.5))
             ch = int(round((area / ratio) ** 0.5))
             # the canvas must strictly contain the image, with enough
             # margin for the pad to matter
             if cw - width < 2 or ch - height < 2:
                 continue
-            x0 = random.randint(0, cw - width)
-            y0 = random.randint(0, ch - height)
+            x0 = _rand.randint(0, cw - width)
+            y0 = _rand.randint(0, ch - height)
             return x0, y0, cw, ch, self._relabel(label, x0, y0, cw, ch,
                                                  height, width)
         return None
@@ -425,9 +432,11 @@ class ImageDetIter(ImageIter):
         # (reference: iter_image_det_recordio.cc runs it in the worker
         # threads; here PIL's decode/resize release the GIL, so threads
         # overlap the heavy pixel work while record reads stay on the
-        # calling thread).  Threads share numpy's global RNG — sample
-        # augment draws interleave nondeterministically across threads,
-        # the same property the reference's worker pool has.
+        # calling thread).  Augment randomness stays reproducible under
+        # random.seed/np.random.seed: each sample's seed is drawn on
+        # the calling thread and workers draw from per-sample
+        # generators (_SampleScopedRandom), so pool scheduling cannot
+        # change batch content.
         self._executor = None
         if preprocess_threads and int(preprocess_threads) > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -575,17 +584,31 @@ class ImageDetIter(ImageIter):
         except Exception:
             pass
 
-    def _load_one(self, raw, buf):
-        """Per-sample decode + joint augment (thread-pool work item)."""
-        from .image import _HostArray, _imdecode_np
+    def _load_one(self, raw, buf, seed=None):
+        """Per-sample decode + joint augment (thread-pool work item).
 
-        rows = self._parse_label(raw)
-        # the whole per-sample path stays on host numpy; HBM sees one
-        # transfer per batch
-        img = _imdecode_np(buf).view(_HostArray)
-        img, rows = self.augmentation_transform(img, rows)
-        self._check_valid_label(rows)
-        return img, rows
+        `seed` is a calling-thread draw from the global RNG: when set,
+        every augmenter draw for THIS sample comes from generators
+        seeded with it, so threaded batches reproduce under
+        random.seed/np.random.seed regardless of which pool thread runs
+        the sample (ADVICE r4 #3)."""
+        from .image import _HostArray, _imdecode_np, _nprand
+
+        if seed is not None:
+            _rand.set_sample_rng(random.Random(seed))
+            _nprand.set_sample_rng(_np.random.RandomState(seed & 0xffffffff))
+        try:
+            rows = self._parse_label(raw)
+            # the whole per-sample path stays on host numpy; HBM sees
+            # one transfer per batch
+            img = _imdecode_np(buf).view(_HostArray)
+            img, rows = self.augmentation_transform(img, rows)
+            self._check_valid_label(rows)
+            return img, rows
+        finally:
+            if seed is not None:
+                _rand.set_sample_rng(None)
+                _nprand.set_sample_rng(None)
 
     def _write_slot(self, batch_data, batch_label, i, img, rows):
         from .image import _to_host
@@ -624,7 +647,11 @@ class ImageDetIter(ImageIter):
                         break
                 if not samples:
                     break
-                futures = [self._executor.submit(self._load_one, raw, buf)
+                # per-sample seeds drawn HERE, on the calling thread, so
+                # the global stream advances deterministically in sample
+                # order and thread scheduling cannot change batch content
+                futures = [self._executor.submit(self._load_one, raw, buf,
+                                                 random.getrandbits(63))
                            for raw, buf in samples]
                 for f in futures:
                     try:

@@ -56,34 +56,46 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(name, param_on_devs, priority=-idx)
 
 
-def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+def _live_params(param_names, param_arrays, grad_arrays):
+    """Yield (position, name, per-device weights, per-device grads) for
+    every parameter that was bound with a gradient — fixed params carry
+    grad None and take no optimizer step."""
+    for pos, name in enumerate(param_names):
+        grads = grad_arrays[pos]
+        if grads and grads[0] is not None:
+            yield pos, name, param_arrays[pos], grads
 
 
-def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
-                   param_names=None):
-    updates = [[] for _ in range(num_device)]
-    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if not grad_list or grad_list[0] is None:
-            continue
-        index = i
-        if kvstore:
-            name = param_names[index]
-            kvstore.push(name, grad_list, priority=-index)
-            kvstore.pull(name, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updates[k].append((index * num_device + k, g, w))
-    for dev_updates in updates:
-        for index, g, w in dev_updates:
-            updater(index, g, w)
+def _grad_sync_through_kvstore(kvstore, param_names, param_arrays,
+                               grad_arrays):
+    """update_on_kvstore step: the store owns the optimizer, so one
+    push(grads) / pull(weights) round-trip per parameter IS the update.
+    Priority -position lets an async store overlap transfers in
+    registration order — the wire protocol the reference's trainer
+    speaks (python/mxnet/model.py _update_params_on_kvstore), kept
+    because dist servers schedule by it."""
+    for pos, name, weights, grads in _live_params(
+            param_names, param_arrays, grad_arrays):
+        kvstore.push(name, grads, priority=-pos)
+        kvstore.pull(name, weights, priority=-pos)
+
+
+def _local_update(updater, num_device, param_names, param_arrays,
+                  grad_arrays, kvstore=None):
+    """Host-side optimizer step.  A kvstore here only aggregates (push
+    grads, pull back the sum); the updater then steps every (param,
+    device) slot.  Slot keys pack as ``position * num_device + device``
+    — optimizer state from save_optimizer_states/set_states is keyed by
+    these ints (reference: python/mxnet/model.py _update_params), so
+    the packing is observable API and pinned by the state round-trip
+    tests."""
+    for pos, name, weights, grads in _live_params(
+            param_names, param_arrays, grad_arrays):
+        if kvstore is not None:
+            kvstore.push(name, grads, priority=-pos)
+            kvstore.pull(name, grads, priority=-pos)
+        for dev, (w, g) in enumerate(zip(weights, grads)):
+            updater(pos * num_device + dev, g, w)
 
 
 class Module(BaseModule):
@@ -369,18 +381,15 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        group = self._exec_group
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore,
-                                      self._exec_group.param_names)
+            _grad_sync_through_kvstore(self._kvstore, group.param_names,
+                                       group.param_arrays,
+                                       group.grad_arrays)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
-                           num_device=len(self._context),
-                           kvstore=self._kvstore,
-                           param_names=self._exec_group.param_names)
+            _local_update(self._updater, len(self._context),
+                          group.param_names, group.param_arrays,
+                          group.grad_arrays, kvstore=self._kvstore)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import numpy as _np
+
 from .ndarray import NDArray, imperative_invoke
 
 
@@ -56,6 +58,13 @@ def randn(*shape, **kwargs):
             raise TypeError("randn: pass the shape positionally OR as "
                             "shape=, not both")
         shape = kwargs.pop("shape")  # int or sequence; normal normalizes
+    elif not all(isinstance(d, (int, _np.integer)) for d in shape):
+        # a legacy randn(loc, scale) caller from the alias-of-normal era
+        # must fail loudly, not sample a (loc, scale)-shaped array
+        raise TypeError(
+            "randn: positional args are shape dims and must be ints "
+            "(got %r); pass distribution parameters as loc=/scale="
+            % (shape,))
     return normal(kwargs.pop("loc", 0.0), kwargs.pop("scale", 1.0),
                   shape=shape if shape else (1,), **kwargs)
 

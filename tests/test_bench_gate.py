@@ -96,3 +96,74 @@ def test_make_chained_matches_sequential_steps():
                                rtol=2e-5, atol=2e-6)
     # and the chain must not have written back into the step's state
     assert step.train_vals is orig_train_vals
+
+
+def test_prior_round_values_skips_other_platform_records(tmp_path, monkeypatch):
+    """A record captured on another backend (platform field != tpu) must
+    not become the gate's comparison point (ADVICE r4 #4)."""
+    import json
+
+    bench = _load_bench()
+    rec = {"parsed": {"metric": "resnet50_v1 training img/s (bs=128, "
+                      "bf16 compute, NHWC, 1 chip, median of 3)",
+                      "value": 55.0, "device_value": 60.0,
+                      "device_metric": "device-only img/s (50 steps chained"
+                      " in one jit, host-fetch barrier, median of 3)",
+                      "platform": "cpu"}}
+    p = tmp_path / "BENCH_r09.json"
+    p.write_text(json.dumps(rec))
+    monkeypatch.setattr(bench.glob, "glob", lambda pat: [str(p)])
+    assert bench.prior_round_values(128, "NHWC") is None
+    # same record marked tpu IS eligible
+    rec["parsed"]["platform"] = "tpu"
+    p.write_text(json.dumps(rec))
+    got = bench.prior_round_values(128, "NHWC")
+    assert got == ("BENCH_r09.json", 55.0, 60.0)
+
+
+def test_count_real_devices_survives_wedged_probe(monkeypatch):
+    """MULTICHIP r4 post-mortem: a wedged relay blocks jax.devices() in
+    non-interruptible C code.  The probe child must be killed at its
+    timeout and report 0 devices, sending the dryrun down the
+    self-provisioned CPU path instead of hanging the parent."""
+    import importlib.util
+    import subprocess
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+
+    def hang(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=kw["timeout"])
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    assert ge._count_real_devices(timeout=1) == 0
+
+
+def test_provision_devices_delegates_without_touching_jax(monkeypatch):
+    """With too few (or unprobeable) real devices, _provision_devices
+    must delegate to the CPU re-exec subprocess — with the virtual
+    device count forced in its env — and never call jax.devices() in
+    the parent."""
+    import importlib.util
+    import subprocess
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+
+    monkeypatch.setattr(ge, "_count_real_devices", lambda *a, **kw: 0)
+    monkeypatch.delenv("_MXTPU_DRYRUN_REEXEC", raising=False)
+    seen = {}
+
+    def fake_call(cmd, env=None):
+        seen["cmd"], seen["env"] = cmd, env
+        return 0
+
+    monkeypatch.setattr(subprocess, "call", fake_call)
+    assert ge._provision_devices(8) is None
+    assert seen["env"]["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in seen["env"]["XLA_FLAGS"]
+    assert seen["env"]["_MXTPU_DRYRUN_REEXEC"] == "1"

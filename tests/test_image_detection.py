@@ -468,3 +468,31 @@ def test_recordio_random_byte_corruption_never_hangs(tmp_path):
         finally:
             r.close()
         assert n < 100, "reader produced unbounded records"
+
+
+def test_threaded_random_augs_reproduce_under_seed(tmp_path):
+    """ADVICE r4 #3: with preprocess_threads>1 and RANDOM augmenters,
+    two runs from the same random.seed/np.random.seed must produce
+    identical batches — per-sample seeds are drawn on the calling
+    thread, so pool scheduling cannot change batch content."""
+    scenes = [_scene(hw=40, boxes=[(i % 3, 0.2, 0.2, 0.8, 0.8)])
+              for i in range(8)]
+    rec = _write_det_dataset(tmp_path, scenes)
+    kw = dict(batch_size=4, data_shape=(3, 32, 32), path_imgrec=rec,
+              rand_crop=0.5, rand_pad=0.5, rand_mirror=True,
+              preprocess_threads=4)
+
+    def run():
+        random.seed(7)
+        np.random.seed(7)
+        it = ImageDetIter(**kw)
+        out = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+               for b in it]
+        it.close()
+        return out
+
+    a, b = run(), run()
+    assert len(a) == len(b) == 2
+    for (da, la), (db, lb) in zip(a, b):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)

@@ -135,3 +135,39 @@ def test_custom_op_in_hybridized_block():
     ex = s.bind(mx.cpu(), {"x": mx.nd.array([1.0, 2.0])})
     out = ex.forward()[0]
     np.testing.assert_allclose(out.asnumpy(), [4.0, 5.0])
+
+
+def test_randn_rejects_float_positional_args():
+    """ADVICE r4 #2: a legacy alias-of-normal caller randn(0.0, 1.0)
+    must fail loudly, not sample a (0.0, 1.0)-shaped array."""
+    import pytest
+
+    with pytest.raises(TypeError, match="must be ints"):
+        mx.nd.random.randn(0.0, 1.0)
+    # int dims still work, as does the kwarg spelling
+    assert mx.nd.random.randn(2, 3).shape == (2, 3)
+    assert mx.nd.random.randn(shape=(2, 3), loc=1.0).shape == (2, 3)
+
+
+def test_executor_wraps_device_runtime_errors():
+    """ADVICE r4 #1: device-side failures (XlaRuntimeError subclasses
+    RuntimeError) must surface as MXNetError from executor forward, not
+    as raw jax exceptions."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.executor import Executor
+
+    class Boom(RuntimeError):
+        pass
+
+    x = mx.sym.Variable("x")
+    y = x + 1.0
+    exe = y.bind(mx.cpu(), {"x": mx.nd.zeros((2,))})
+
+    def boom_fwd(*a, **kw):
+        raise Boom("device exploded")
+
+    exe._get_fns = lambda is_train: (boom_fwd, None, None)
+    with pytest.raises(MXNetError, match="executor forward: device exploded"):
+        exe.forward(is_train=True)
