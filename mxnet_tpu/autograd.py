@@ -49,10 +49,10 @@ class AGNode:
 
 class _Entry:
     __slots__ = ("in_nodes", "out_nodes", "vjp_fn", "out_avals",
-                 "op_name", "attrs", "in_arrays")
+                 "op_name", "attrs", "in_arrays", "replay_fn")
 
     def __init__(self, in_nodes, out_nodes, vjp_fn, out_avals,
-                 op_name=None, attrs=None, in_arrays=None):
+                 op_name=None, attrs=None, in_arrays=None, replay_fn=None):
         self.in_nodes = in_nodes
         self.out_nodes = out_nodes
         self.vjp_fn = vjp_fn
@@ -63,6 +63,11 @@ class _Entry:
         self.op_name = op_name
         self.attrs = attrs
         self.in_arrays = in_arrays
+        # pure jax fn(*input_vals) -> output_vals for entries that carry
+        # no registry op identity (the grad-of-grad entries recorded by
+        # grad(create_graph=True)); lets _replay_function differentiate
+        # through them for third and higher orders
+        self.replay_fn = replay_fn
 
 
 # ---------------------------------------------------------------- scopes
@@ -152,7 +157,8 @@ def _any_recorded(inputs):
     return any(isinstance(a, NDArray) and a._ag_node is not None for a in inputs)
 
 
-def record_op(inputs, outputs, vjp_fn, op_name=None, attrs=None):
+def record_op(inputs, outputs, vjp_fn, op_name=None, attrs=None,
+              replay_fn=None):
     """Append one op application to the tape (reference: RecordOp)."""
     from .ndarray.ndarray import NDArray
 
@@ -170,7 +176,7 @@ def record_op(inputs, outputs, vjp_fn, op_name=None, attrs=None):
                  for a, n in zip(inputs, in_nodes)]
     _st()["tape"].append(_Entry(in_nodes, out_nodes, vjp_fn, out_avals,
                                 op_name=op_name, attrs=attrs,
-                                in_arrays=in_arrays))
+                                in_arrays=in_arrays, replay_fn=replay_fn))
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -238,29 +244,7 @@ def get_symbol(x):
         # available so repeated uses share one Variable
         return leaf("const", id(arr) if arr is not None else id(node))
 
-    # iterative reachability, then build in tape order — a deep chain
-    # (unrolled RNN) must not hit the Python recursion limit (backward
-    # walks the same tape iteratively)
-    needed = set()
-    seen = set()
-    stack = [x._ag_node]
-    while stack:
-        node = stack.pop()
-        if node is None or id(node) in seen:
-            continue
-        seen.add(id(node))
-        prod = producers.get(id(node))
-        if prod is None:
-            continue
-        entry = prod[0]
-        if id(entry) in needed:
-            continue
-        needed.add(id(entry))
-        stack.extend(entry.in_nodes)
-
-    for entry in tape:
-        if id(entry) not in needed:
-            continue
+    for entry in _reachable_entries(tape, [x._ag_node]):
         if entry.op_name is None:
             raise MXNetError(
                 "get_symbol: the graph contains a custom grad_function "
@@ -286,20 +270,188 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     Reference: MXAutogradBackwardEx → Imperative::Backward
     (src/imperative/imperative.cc:278).
     """
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
     _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars=True)
+
+
+def _reachable_entries(tape, head_nodes):
+    """Tape entries (in tape order) the head nodes depend on — the same
+    iterative walk get_symbol uses (deep chains must not recurse)."""
+    producers = {}
+    for entry in tape:
+        for on in entry.out_nodes:
+            producers[id(on)] = entry
+    needed = set()
+    stack = list(head_nodes)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        entry = producers.get(id(node))
+        if entry is None or id(entry) in needed:
+            continue
+        needed.add(id(entry))
+        stack.extend(entry.in_nodes)
+    return [e for e in tape if id(e) in needed]
+
+
+def _replay_function(heads, variables):
+    """Build a pure jax function ``f(*var_vals) -> tuple(head_vals)``
+    that re-executes the recorded subgraph from the registry's op
+    functions.  This is what makes grad(create_graph=True) work: jax
+    can differentiate the replay to any order, where the stored vjp
+    closures are one-shot linearizations.
+
+    Returns ``(f, all_vars)`` where all_vars = the requested variables
+    followed by every OTHER marked variable the subgraph touches — f
+    takes values for all of them, so later backward() through the
+    recorded grad entry can deliver cotangents to variables that were
+    not in the requested list (their first-order grads are simply not
+    returned, but d(grad)/d(other_var) must flow).
+
+    Reference: autograd.py:270 accepts create_graph; the reference
+    rebuilds a differentiable backward *graph* for the same reason."""
+    from .ndarray.ndarray import RANDOM_OPS
+    from .ops import registry as _reg
+
+    tape = _st()["tape"]
+    var_nodes = [v._ag_node for v in variables]
+    for v, n in zip(variables, var_nodes):
+        if n is None or not n.is_variable:
+            raise MXNetError(
+                "grad(create_graph=True): every variable must be marked "
+                "via attach_grad()/mark_variables before recording")
+    head_nodes = []
+    for h in heads:
+        if h._ag_node is None:
+            raise MXNetError(
+                "cannot differentiate: array is not in a recorded graph "
+                "(is autograd.record() active and attach_grad called?)")
+        head_nodes.append(h._ag_node)
+    entries = _reachable_entries(tape, head_nodes)
+
+    fns = []
+    for entry in entries:
+        if entry.replay_fn is not None:
+            fns.append(entry.replay_fn)
+            continue
+        if entry.op_name is None:
+            raise MXNetError(
+                "grad(create_graph=True): the graph contains a custom "
+                "grad_function record that cannot be replayed; compose "
+                "through hybridize() instead")
+        if entry.op_name in RANDOM_OPS or entry.op_name == "Dropout":
+            raise MXNetError(
+                "grad(create_graph=True): op %r draws a PRNG key and is "
+                "not replayable; take higher-order grads through "
+                "hybridize() + jax.grad composition" % entry.op_name)
+        fns.append(_reg.get(entry.op_name).bind_attrs(
+            dict(entry.attrs or {})))
+
+    # every marked variable feeding the subgraph is an input of f —
+    # requested ones first, the rest in first-encounter order
+    all_nodes = list(var_nodes)
+    all_vars = list(variables)
+    seen_vars = {id(n) for n in var_nodes}
+    for entry in entries:
+        for n in entry.in_nodes:
+            if (n is not None and n.is_variable and id(n) not in seen_vars
+                    and n.array_ref is not None):
+                seen_vars.add(id(n))
+                all_nodes.append(n)
+                all_vars.append(n.array_ref)
+
+    def f(*var_vals):
+        env = {id(n): val for n, val in zip(all_nodes, var_vals)}
+        for entry, fn in zip(entries, fns):
+            in_vals = []
+            for n, arr in zip(entry.in_nodes,
+                              entry.in_arrays or
+                              [None] * len(entry.in_nodes)):
+                if n is not None and id(n) in env:
+                    in_vals.append(env[id(n)])
+                elif arr is not None:
+                    in_vals.append(arr._data)
+                else:
+                    raise MXNetError(
+                        "grad(create_graph=True): a recorded input's "
+                        "producer is no longer on the tape (was "
+                        "backward() already run without retain_graph?)")
+            outs = fn(*in_vals)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for on, val in zip(entry.out_nodes, outs):
+                env[id(on)] = val
+        missing = [i for i, n in enumerate(head_nodes) if id(n) not in env]
+        if missing:
+            raise MXNetError(
+                "grad(create_graph=True): head %d was not produced by "
+                "the recorded graph" % missing[0])
+        return tuple(env[id(n)] for n in head_nodes)
+
+    return f, all_vars
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """First-order grads computed by differentiating the tape REPLAY,
+    recorded back onto the tape so they are differentiable again
+    (grad-of-grad and beyond).  The entry is recorded whether or not a
+    record() scope is active: create_graph *is* the request to record
+    the gradient computation (the reference re-enables recording during
+    the backward pass for exactly this flag)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    f, all_vars = _replay_function(heads, variables)
+    n_req = len(variables)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    hg_vals = tuple(
+        hg._data if isinstance(hg, NDArray)
+        else hg if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        for h, hg in zip(heads, head_grads))
+
+    def g_fn(*var_vals):
+        _outs, vjp = jax.vjp(f, *var_vals)
+        # g_fn depends on ALL participating variables; only the
+        # requested ones' first-order grads are outputs
+        return vjp(hg_vals)[:n_req]
+
+    var_vals = tuple(v._data for v in all_vars)
+    grads, g_vjp = jax.vjp(g_fn, *var_vals)
+    out_nds = [NDArray(g, v._ctx) for g, v in zip(grads, variables)]
+
+    def vjp_fn(cts):
+        cts = cts if isinstance(cts, tuple) else (cts,)
+        return g_vjp(tuple(cts))
+
+    record_op(list(all_vars), out_nds, vjp_fn, replay_fn=g_fn)
+    return out_nds
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Functional-style gradient (reference: autograd.grad)."""
-    if create_graph:
-        raise NotImplementedError(
-            "higher-order imperative grad: use hybridize() + jax.grad composition"
-        )
+    """Functional-style gradient (reference: autograd.grad).
+
+    ``create_graph=True`` records the gradient computation back onto
+    the tape (via a differentiable replay of the recorded ops), so the
+    returned grads support backward()/grad() again — grad-of-grad for
+    the registry-op subset (elemwise/FC/conv/...); PRNG-key ops and
+    custom grad_functions raise with a redirect to hybridize()."""
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
     if not isinstance(variables, (list, tuple)):
         variables = [variables]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads)
     if retain_graph is None:
         retain_graph = create_graph
     cts = _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars=False,

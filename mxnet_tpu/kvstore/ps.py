@@ -190,11 +190,15 @@ class PSServer:
         self._stop = threading.Event()
         self._sock = socket.create_server((host, port))
         self.port = self._sock.getsockname()[1]
+        self._conns = set()
+        self._conns_lock = threading.Lock()
 
     # -- handler plumbing --------------------------------------------------
     def serve_forever(self):
         """Accept loop; one thread per worker connection.  Returns when a
-        stop command arrives and all connections drain."""
+        stop command arrives; open worker connections are closed so
+        shutdown is observable client-side (a worker's next protocol
+        read raises instead of blocking on a half-dead server)."""
         self._sock.settimeout(0.5)
         threads = []
         while not self._stop.is_set():
@@ -204,10 +208,18 @@ class PSServer:
                 continue
             except OSError:
                 break
+            with self._conns_lock:
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
+        with self._conns_lock:
+            for conn in list(self._conns):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
         for t in threads:
             t.join(timeout=5)
         self._sock.close()
@@ -215,17 +227,31 @@ class PSServer:
     def _serve_conn(self, conn):
         try:
             while True:
-                msg = _recv_msg(conn)
+                try:
+                    msg = _recv_msg(conn)
+                except Exception:
+                    # a peer that cannot speak the framed-pickle
+                    # protocol (or trips the restricted unpickler) is
+                    # dropped; decode failures must neither execute
+                    # anything nor kill the server thread loudly
+                    return
                 if msg is None:
                     return
                 try:
                     reply = self._handle(msg)
                 except Exception as e:  # error surfaces on the worker
                     reply = ("err", "%s: %s" % (type(e).__name__, e))
-                _send_msg(conn, reply)
+                try:
+                    _send_msg(conn, reply)
+                except OSError:
+                    # shutdown race: serve_forever closed this conn
+                    # while the reply was in flight — drop quietly
+                    return
                 if msg[0] == "stop":
                     return
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _key_lock(self, key):

@@ -20,6 +20,8 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -74,18 +76,76 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
     else:
         dn = _conv_dn(nd)
         bias_shape = (1, -1) + (1,) * nd
-    out = lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=int(num_group),
-        preferred_element_type=None,
-    )
+    if (channel_last and nd == 2 and _pallas_dw_enabled()
+            and all(d == 1 for d in dilate)):
+        # backward-filter via the Pallas kernel (pallas_conv.py) where
+        # supported; forward and dX keep XLA's lowering bit-for-bit
+        out = _nhwc_conv2d_pallas_dw(stride, pad, int(num_group))(
+            data, weight)
+    else:
+        out = lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=int(num_group),
+            preferred_element_type=None,
+        )
     if bias is not None and not no_bias:
         out = out + bias.reshape(bias_shape)
     return out
+
+
+def _pallas_dw_enabled():
+    import os
+
+    return os.environ.get("MXTPU_PALLAS_CONV_DW", "0") == "1"
+
+
+@functools.lru_cache(maxsize=None)
+def _nhwc_conv2d_pallas_dw(stride, pad, groups):
+    """NHWC 2-D conv whose weight-gradient routes to the Pallas dW
+    kernel (MXTPU_PALLAS_CONV_DW=1).  Forward and data-gradient are
+    jax.vjp of the plain lax conv — identical lowerings to the default
+    path — so only the measured backward-filter changes."""
+    import jax
+
+    from . import pallas_conv
+
+    dn = lax.conv_dimension_numbers((0, 0, 0, 0), (0, 0, 0, 0),
+                                    ("NHWC", "OHWI", "NHWC"))
+
+    def raw(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad],
+            dimension_numbers=dn, feature_group_count=groups)
+
+    @jax.custom_vjp
+    def conv(x, w):
+        return raw(x, w)
+
+    def fwd(x, w):
+        return raw(x, w), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        _, vjp_x = jax.vjp(lambda xx: raw(xx, w), x)
+        (dx,) = vjp_x(dy)
+        kernel = w.shape[1:3]
+        if pallas_conv.supported(x.shape, dy.shape, kernel, stride, pad,
+                                 (1, 1), groups,
+                                 ebytes=x.dtype.itemsize):
+            dw = pallas_conv.conv_dw_nhwc(x, dy, kernel, stride,
+                                          pad).astype(w.dtype)
+        else:
+            _, vjp_w = jax.vjp(lambda ww: raw(x, ww), w)
+            (dw,) = vjp_w(dy)
+        return dx, dw
+
+    conv.defvjp(fwd, bwd)
+    return conv
 
 
 @register("Deconvolution")
@@ -213,9 +273,6 @@ def softmax_activation(data, mode="instance", **_):
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=None)

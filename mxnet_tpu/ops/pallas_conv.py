@@ -1,0 +1,232 @@
+"""Pallas TPU kernel for the convolution backward-filter (dW) pass.
+
+Why this exists (BENCH_ROOFLINE.md, r4): in the flagship ResNet-50
+step, XLA's backward-filter lowering runs the conv-dW fusion family at
+16–44% MXU and 160–500 GB/s — neither compute- nor byte-bound — for
+~9 ms of the 48 ms step.  The dW contraction is really a batched
+matmul: for every filter tap (r, s),
+
+    dW[r, s, i, o] = sum_{n, y, x} Xp[n, y*sy + r, x*sx + s, i]
+                                  * dY[n, y, x, o]
+
+so the TPU-native formulation tiles images through VMEM and issues one
+(I × R̂) @ (R̂ × O) MXU contraction per tap per image-block, with the
+f32 accumulator resident in VMEM across the sequential image grid
+(the flash-attention pattern, attention.py).
+
+Layouts: data NHWC, weight OHWI — the bench model's channel-last
+layout (ops/nn.py convolution, layout="NHWC").  Reference analog: the
+cuDNN wgrad algos behind src/operator/nn/convolution.cc; here the
+kernel IS the algorithm choice.
+
+Two formulations, selected per shape:
+* per-tap (kh·kw matmuls of M=I): best when I >= 128 fills the MXU;
+* im2col (one matmul of M=kh·kw·I): pays a VMEM concat to raise M for
+  narrow layers (I < 128, e.g. ResNet conv2_x I=64 → M=576).
+
+`conv_dw_nhwc` is the public entry; `supported()` reports whether a
+shape/config routes to the kernel (else callers fall back to XLA's
+lowering).  Integration behind MXTPU_PALLAS_CONV_DW in ops/nn.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas import deferred-safe: CPU-only environments still import
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - pallas always present in-tree
+    _HAS_PALLAS = False
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16 MiB/core
+
+
+def supported(x_shape, dy_shape, kernel, stride, pad, dilate, groups,
+              ebytes=2):
+    """True when conv_dw_nhwc handles this configuration (including the
+    VMEM fit of a single-image block — callers fall back to XLA's
+    lowering otherwise, so an oversized shape must never reach
+    pallas_call)."""
+    if not _HAS_PALLAS or groups != 1:
+        return False
+    if any(d != 1 for d in dilate):
+        return False
+    if len(kernel) != 2:
+        return False
+    if x_shape[-1] < 8:
+        # the stem's I=3 pads the lane dim 128/3x in VMEM; its dW is
+        # byte-bound anyway (BENCH_NOTES space-to-depth entry) — XLA
+        return False
+    n, h, w, _c = dy_shape
+    # output spatial must match the conv arithmetic exactly
+    hp = x_shape[1] + 2 * pad[0]
+    wp = x_shape[2] + 2 * pad[1]
+    if (hp - kernel[0]) // stride[0] + 1 != h:
+        return False
+    if (wp - kernel[1]) // stride[1] + 1 != w:
+        return False
+    per_image, out_bytes = _sizing(
+        (hp, wp, x_shape[-1]), (h, w, dy_shape[-1]), kernel,
+        # the auto formulation choice (conv_dw_nhwc) mirrors this
+        "im2col" if x_shape[-1] < 128 else "pertap", ebytes)
+    return per_image + out_bytes <= _VMEM_BUDGET
+
+
+def _pad_to(v, m):
+    return -(-int(v) // m) * m
+
+
+def _sizing(xp_hwc, dy_hwc, kernel, formulation, ebytes):
+    """(per-image VMEM bytes, accumulator bytes) with TPU vreg padding:
+    the minor dim tiles to 128 lanes, the second-minor to 8 sublanes —
+    a C=64 operand costs 2x its logical bytes in VMEM."""
+    hp, wp, ci = xp_hwc
+    oh, ow, co = dy_hwc
+    kh, kw = kernel
+    per_image = (hp * _pad_to(wp, 8) * _pad_to(ci, 128) +
+                 oh * _pad_to(ow, 8) * _pad_to(co, 128)) * ebytes
+    if formulation == "im2col":
+        per_image += (oh * _pad_to(ow, 8) *
+                      _pad_to(kh * kw * ci, 128) * ebytes)
+    out_bytes = kh * kw * _pad_to(ci, 8) * _pad_to(co, 128) * 4
+    return per_image, out_bytes
+
+
+def _block_images(n, per_image_bytes, out_bytes):
+    """Largest power-of-two image-block fitting the VMEM budget."""
+    nb = 1
+    while (nb * 2 <= n and n % (nb * 2) == 0 and
+           (nb * 2) * per_image_bytes + out_bytes <= _VMEM_BUDGET):
+        nb *= 2
+    return nb
+
+
+def _dw_kernel_pertap(x_ref, dy_ref, out_ref, *, kh, kw, sy, sx, oh, ow):
+    """One image-block step: kh*kw MXU contractions accumulated into the
+    full (kh, kw, I, O) output, which stays VMEM-resident across the
+    sequential image grid."""
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    dy = dy_ref[:]
+    dyf = dy.reshape(-1, dy.shape[-1])  # (nb*oh*ow, O)
+    for r in range(kh):
+        for s in range(kw):
+            xs = x_ref[:, r:r + sy * oh:sy, s:s + sx * ow:sx, :]
+            xsf = xs.reshape(-1, xs.shape[-1])  # (nb*oh*ow, I)
+            acc = lax.dot_general(
+                xsf, dyf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (I, O)
+            out_ref[r, s] += acc
+
+
+def _dw_kernel_im2col(x_ref, dy_ref, out_ref, *, kh, kw, sy, sx, oh, ow):
+    """One image-block step: a single (kh*kw*I × R̂) @ (R̂ × O)
+    contraction — the concat buys MXU rows for narrow-channel layers."""
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    dy = dy_ref[:]
+    dyf = dy.reshape(-1, dy.shape[-1])
+    taps = []
+    for r in range(kh):
+        for s in range(kw):
+            taps.append(x_ref[:, r:r + sy * oh:sy, s:s + sx * ow:sx, :])
+    xcat = jnp.concatenate(taps, axis=-1)          # (nb, oh, ow, kh*kw*I)
+    xsf = xcat.reshape(-1, xcat.shape[-1])
+    acc = lax.dot_general(xsf, dyf, (((0,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    out_ref[:] += acc                              # (kh*kw*I, O)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kernel", "stride", "pad", "interpret",
+                                    "formulation"))
+def conv_dw_nhwc(x, dy, kernel, stride=(1, 1), pad=(0, 0), interpret=False,
+                 formulation=None):
+    """Backward-filter for NHWC conv with OHWI weights.
+
+    x: (N, H, W, I) forward input; dy: (N, OH, OW, O) output cotangent.
+    Returns dW with shape (O, kh, kw, I) in fp32 (the caller casts to
+    the weight dtype — matching XLA's fp32 conv accumulation).
+    formulation: None (auto), 'pertap', or 'im2col'.
+    """
+    kh, kw = kernel
+    sy, sx = stride
+    n, _h, _w, ci = x.shape
+    _, oh, ow, co = dy.shape
+    if not interpret:
+        # CPU/virtual-mesh runs (the test suite) execute the same kernel
+        # through the pallas interpreter; Mosaic compiles only on TPU
+        interpret = jax.default_backend() != "tpu"
+    xp = jnp.pad(x, ((0, 0), (pad[0], pad[0]), (pad[1], pad[1]), (0, 0)))
+    hp, wp = xp.shape[1], xp.shape[2]
+
+    if formulation is None:
+        # narrow-channel layers waste MXU rows per tap; buy rows with
+        # the im2col concat
+        formulation = "im2col" if ci < 128 else "pertap"
+
+    per_image, out_bytes = _sizing((hp, wp, ci), (oh, ow, co), kernel,
+                                   formulation, x.dtype.itemsize)
+    nb = _block_images(n, per_image, out_bytes)
+
+    if formulation == "im2col":
+        kern = functools.partial(_dw_kernel_im2col, kh=kh, kw=kw, sy=sy,
+                                 sx=sx, oh=oh, ow=ow)
+        out_shape = jax.ShapeDtypeStruct((kh * kw * ci, co), jnp.float32)
+        out_spec = pl.BlockSpec((kh * kw * ci, co), lambda g: (0, 0))
+    else:
+        kern = functools.partial(_dw_kernel_pertap, kh=kh, kw=kw, sy=sy,
+                                 sx=sx, oh=oh, ow=ow)
+        out_shape = jax.ShapeDtypeStruct((kh, kw, ci, co), jnp.float32)
+        out_spec = pl.BlockSpec((kh, kw, ci, co), lambda g: (0, 0, 0, 0))
+
+    dw = pl.pallas_call(
+        kern,
+        grid=(n // nb,),
+        in_specs=[
+            pl.BlockSpec((nb, hp, wp, ci), lambda g: (g, 0, 0, 0)),
+            pl.BlockSpec((nb, oh, ow, co), lambda g: (g, 0, 0, 0)),
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(xp, dy)
+
+    dw = dw.reshape(kh, kw, ci, co)
+    return jnp.transpose(dw, (3, 0, 1, 2))  # OHWI
+
+
+def conv_dw_xla(x, dy, kernel, stride=(1, 1), pad=(0, 0)):
+    """XLA's own backward-filter lowering for the same NHWC/OHWI conv —
+    the baseline the Pallas kernel must beat (tools/bench_conv_dw.py)
+    and the numerical oracle for its tests."""
+    dn = lax.conv_dimension_numbers(
+        x.shape, (dy.shape[-1], kernel[0], kernel[1], x.shape[-1]),
+        ("NHWC", "OHWI", "NHWC"))
+
+    def fwd(w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            dimension_numbers=dn)
+
+    w0 = jnp.zeros((dy.shape[-1], kernel[0], kernel[1], x.shape[-1]),
+                   x.dtype)
+    _, vjp = jax.vjp(fwd, w0)
+    (dw,) = vjp(dy)
+    return dw

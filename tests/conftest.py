@@ -28,6 +28,27 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+if os.environ.get("MXTPU_COV"):
+    # dependency-free line coverage (tools/coverage_lite.py): hits are
+    # dumped to $MXTPU_COV at exit; report with
+    # `python tools/coverage_lite.py report <json>`
+    import sys as _sys
+
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _sys.path.insert(0, os.path.join(_repo, "tools"))
+    import coverage_lite
+
+    coverage_lite.start(os.path.join(_repo, "mxnet_tpu"),
+                        os.environ["MXTPU_COV"])
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests (multi-device subprocess dryruns, "
+        "tutorial/example sweeps); deselect with -m 'not slow' for a "
+        "<20-minute tier")
+
 
 @pytest.fixture(autouse=True)
 def _seed():

@@ -179,3 +179,156 @@ def test_get_symbol_deep_chain():
             z = mx.nd.relu(z)
     sym = ag.get_symbol(z)
     assert sym.list_arguments() == ["var0"]
+
+
+# ----------------------------------------------- higher-order (r5)
+# Reference accepts create_graph (python/mxnet/autograd.py:270); here
+# first-order grads are computed by differentiating a pure REPLAY of
+# the tape, recorded back so they differentiate again.
+
+
+def test_grad_of_grad_via_backward():
+    """y = x^3: d2y/dx2 = 6x delivered through backward() on the
+    first-order grads."""
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        (dx,) = ag.grad(y, [x], create_graph=True)
+        assert_almost_equal(dx.asnumpy(), 3 * np.array([1.0, 4.0, 9.0]))
+        dx.backward()
+    assert_almost_equal(x.grad.asnumpy(), 6 * np.array([1.0, 2.0, 3.0]))
+
+
+def test_third_order_grad():
+    """x^4 differentiated three times -> 24x."""
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x * x
+        (d1,) = ag.grad(y, [x], create_graph=True)
+        (d2,) = ag.grad(d1, [x], create_graph=True)
+        (d3,) = ag.grad(d2, [x])
+    assert_almost_equal(d3.asnumpy(), np.array([48.0]))
+
+
+def test_second_order_matches_jax_oracle():
+    """Elemwise chain exp(x)*x checked against jax.grad(jax.grad(f))."""
+    import jax
+    import jax.numpy as jnp
+
+    x = mx.nd.array([0.5, 1.5])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x) * x
+        (d1,) = ag.grad(y, [x], create_graph=True)
+        (d2,) = ag.grad(d1, [x])
+    want = jax.vmap(jax.grad(jax.grad(lambda v: jnp.exp(v) * v)))(
+        jnp.array([0.5, 1.5]))
+    assert_almost_equal(d2.asnumpy(), np.asarray(want))
+
+
+def test_second_order_through_fc_and_conv():
+    rs = np.random.RandomState(0)
+    w = mx.nd.array(rs.rand(2, 3).astype(np.float32))
+    w.attach_grad()
+    x = mx.nd.array(rs.rand(4, 3).astype(np.float32))
+    with ag.record():
+        y = mx.nd.FullyConnected(x, w, num_hidden=2, no_bias=True)
+        (dw,) = ag.grad((y * y).sum(), [w], create_graph=True)
+        ((dw * dw).sum()).backward()
+    # loss = sum((xw^T)^2): dw = 2 y^T x; meta = sum(dw^2) is quadratic
+    # in w, so d(meta)/dw = 8 (x^T x) dw-structure — check vs numpy
+    xn = x.asnumpy()
+    wn = w.asnumpy()
+    dwn = 2 * (xn @ wn.T).T @ xn
+    want = 2 * dwn @ (xn.T @ xn) * 2
+    assert_almost_equal(w.grad.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+    k = mx.nd.array(rs.rand(3, 2, 3, 3).astype(np.float32))
+    k.attach_grad()
+    img = mx.nd.array(rs.rand(1, 2, 5, 5).astype(np.float32))
+    with ag.record():
+        out = mx.nd.Convolution(img, k, num_filter=3, kernel=(3, 3),
+                                no_bias=True)
+        (dk,) = ag.grad((out * out).sum(), [k], create_graph=True)
+        ((dk * dk).sum()).backward()
+    assert k.grad.shape == (3, 2, 3, 3)
+    assert np.isfinite(k.grad.asnumpy()).all()
+
+
+def test_create_graph_rejects_prng_ops():
+    from mxnet_tpu.base import MXNetError
+
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    with pytest.raises(MXNetError, match="PRNG"):
+        with ag.record():
+            y = mx.nd.Dropout(x, p=0.5)
+            ag.grad(y, [x], create_graph=True)
+
+
+def test_create_graph_requires_marked_variables():
+    from mxnet_tpu.base import MXNetError
+
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    c = mx.nd.ones((4,))  # never marked
+    with pytest.raises(MXNetError, match="marked"):
+        with ag.record():
+            y = x * c
+            ag.grad(y, [c], create_graph=True)
+
+
+def test_create_graph_multi_variable_head_grads():
+    """Two variables, explicit head cotangent: grads and grad-of-grads
+    both flow per-variable."""
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    hg = mx.nd.array([1.0, 0.5])
+    with ag.record():
+        y = a * a * b
+        da, db = ag.grad(y, [a, b], head_grads=hg, create_graph=True)
+        assert_almost_equal(da.asnumpy(), (2 * a * b).asnumpy() *
+                            hg.asnumpy())
+        assert_almost_equal(db.asnumpy(), (a * a).asnumpy() * hg.asnumpy())
+        (da * db).sum().backward()
+    # d/da [ (2ab·hg)(a²·hg) ] = hg² · 6a²b ; d/db = hg² · 2a³
+    an, bn, hn = np.array([1.0, 2.0]), np.array([3.0, 4.0]), \
+        np.array([1.0, 0.5])
+    assert_almost_equal(a.grad.asnumpy(), hn * hn * 6 * an * an * bn)
+    assert_almost_equal(b.grad.asnumpy(), hn * hn * 2 * an ** 3)
+
+
+def test_create_graph_grads_flow_to_unrequested_variables():
+    """Code-review r5 finding: y = w*x*x, grad(y, [x]) with
+    create_graph, then dx.backward() — d(dx)/dw = 2x must land in
+    w.grad even though w was not in the requested variable list."""
+    x = mx.nd.array([2.0])
+    w = mx.nd.array([3.0])
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = w * x * x
+        (dx,) = ag.grad(y, [x], create_graph=True)
+        assert_almost_equal(dx.asnumpy(), [12.0])  # 2wx
+        dx.backward()
+    assert_almost_equal(x.grad.asnumpy(), [6.0])   # d(2wx)/dx = 2w
+    assert_almost_equal(w.grad.asnumpy(), [4.0])   # d(2wx)/dw = 2x
+
+
+def test_create_graph_records_outside_record_scope():
+    """create_graph IS the request to record the gradient computation:
+    calling grad() after the record scope closed (tape intact) must
+    still produce differentiable grads, like the reference's
+    re-enabled recording during backward."""
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+    (dx,) = ag.grad(y, [x], create_graph=True)   # outside the scope
+    assert_almost_equal(dx.asnumpy(), [27.0])
+    dx.backward()
+    assert_almost_equal(x.grad.asnumpy(), [18.0])  # 6x

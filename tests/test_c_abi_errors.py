@@ -1,0 +1,192 @@
+"""Error-path hardening of the embedded-interpreter C ABI
+(mxnet_tpu/_c_embed.py) — VERDICT r4 task #7.
+
+The existing C-driven test (test_c_tensor_abi.c) proves the happy path
+through the embed.cc transport; these tests drive the same @capi entry
+points directly with ctypes-crafted argument buffers to pin the
+CONTRACTS a C consumer relies on when things go wrong:
+
+* invalid / freed handles surface as status -1 with a diagnostic, never
+  a crash or a wrong answer (reference: MXAPIHandleException paths in
+  src/c_api/c_api_common.h);
+* the error buffer is NUL-terminated and never overflows errcap;
+* pointers returned to C stay valid for the next 256 ABI calls on that
+  thread and are actually RELEASED after (the documented
+  MXAPIThreadLocalEntry-style lifetime, _c_embed.py module docstring);
+* concurrent C threads get unique handles and isolated pin stores.
+"""
+
+import ctypes
+import gc
+import threading
+import weakref
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _c_embed as ce
+
+ERRCAP = 4096
+
+
+def call(fn, *args, errcap=ERRCAP):
+    """Invoke a @capi entry point the way embed.cc does: raw argument
+    addresses plus trailing (status, errbuf, errcap)."""
+    status = ctypes.c_int64(123)  # poison: must be overwritten
+    err = ctypes.create_string_buffer(errcap)
+    fn(*args, ctypes.addressof(status), ctypes.addressof(err), errcap)
+    return status.value, err.value.decode("utf-8", "replace")
+
+
+def make_nd(shape=(2, 3)):
+    arr = (ctypes.c_uint32 * len(shape))(*shape)
+    out = ctypes.c_uint64(0)
+    s, e = call(ce.nd_create, ctypes.addressof(arr), len(shape), 1, 0, 0,
+                0, ctypes.addressof(out))
+    assert s == 0, e
+    assert out.value != 0
+    return out.value
+
+
+def get_shape(hid):
+    ndim = ctypes.c_uint32(0)
+    pdata = ctypes.c_uint64(0)
+    s, e = call(ce.nd_get_shape, hid, ctypes.addressof(ndim),
+                ctypes.addressof(pdata))
+    return s, e, ndim.value, pdata.value
+
+
+def test_invalid_handle_reports_not_crashes():
+    s, e, _, _ = get_shape(10 ** 9)
+    assert s == -1
+    assert "invalid or freed MXTPUHandle" in e
+
+
+def test_freed_handle_rejected():
+    hid = make_nd()
+    s, e = call(ce.nd_free, hid)
+    assert s == 0, e
+    s, e, _, _ = get_shape(hid)
+    assert s == -1
+    assert "invalid or freed" in e
+
+
+def test_double_free_is_idempotent():
+    """The header's Free contract: freeing twice must not crash the
+    process (reference MXNDArrayFree tolerates it)."""
+    hid = make_nd()
+    assert call(ce.nd_free, hid)[0] == 0
+    assert call(ce.nd_free, hid)[0] == 0
+
+
+def test_error_buffer_respects_tiny_errcap():
+    """A traceback far longer than errcap must be truncated with a NUL
+    inside the buffer — C reads a clean string, no overflow."""
+    errcap = 16
+    s, e = call(ce.nd_get_shape, 10 ** 9, 0, 0, errcap=errcap)
+    assert s == -1
+    assert len(e.encode()) < errcap
+
+
+def test_status_written_on_success():
+    hid = make_nd((4,))
+    s, e, ndim, pdata = get_shape(hid)
+    assert (s, ndim) == (0, 1)
+    vals = ctypes.cast(pdata, ctypes.POINTER(ctypes.c_uint32))
+    assert vals[0] == 4
+    call(ce.nd_free, hid)
+
+
+def test_pin_buffer_stable_within_256_calls_released_after():
+    """The documented return-store lifetime: a pointer handed to C is
+    backed by a pinned buffer that survives the next 256 ABI calls on
+    the thread and is released after (deque eviction)."""
+    hid = make_nd((7, 9))
+    s, _e, ndim, pdata = get_shape(hid)
+    assert s == 0 and ndim == 2
+    # grab a weakref to the actual pinned buffer object so release is
+    # observable (the raw address may get reused by a later pin)
+    group = ce._tls.pins[-1]
+    ref = weakref.ref(group[0])
+    del group  # only the pin store may keep it alive
+
+    probe = make_nd((1,))
+    for i in range(255):
+        get_shape(probe)
+    # 1 create + 255 get_shape = 256 further calls: our entry is the
+    # oldest of the 256-deep deque, still pinned, pointer still valid
+    assert ref() is not None
+    vals = ctypes.cast(pdata, ctypes.POINTER(ctypes.c_uint32))
+    assert (vals[0], vals[1]) == (7, 9)
+
+    get_shape(probe)  # 257th call evicts the group
+    gc.collect()
+    assert ref() is None, "pinned buffer not released after 256 calls"
+    call(ce.nd_free, probe)
+    call(ce.nd_free, hid)
+
+
+def test_concurrent_c_threads_unique_handles_and_isolated_pins():
+    """Handle allocation is under _handle_lock and pin stores are
+    thread-local: hammering from many threads must yield unique ids,
+    all-zero statuses, and correct per-thread shape reads."""
+    n_threads, n_iters = 8, 60
+    all_handles = [None] * n_threads
+    failures = []
+
+    def worker(t):
+        try:
+            mine = []
+            for i in range(n_iters):
+                shape = (t + 1, i % 5 + 1)
+                hid = make_nd(shape)
+                s, e, ndim, pdata = get_shape(hid)
+                assert s == 0 and ndim == 2, e
+                vals = ctypes.cast(pdata, ctypes.POINTER(ctypes.c_uint32))
+                assert (vals[0], vals[1]) == shape
+                mine.append(hid)
+            for hid in mine[::2]:
+                assert call(ce.nd_free, hid)[0] == 0
+            all_handles[t] = mine
+        except BaseException as exc:  # pragma: no cover - failure path
+            failures.append((t, exc))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not failures, failures
+    flat = [h for hs in all_handles for h in hs]
+    assert len(set(flat)) == len(flat), "duplicate handle ids issued"
+    for hs in all_handles:  # clean up the un-freed half
+        for hid in hs[1::2]:
+            call(ce.nd_free, hid)
+
+
+def test_malformed_param_strings_do_not_crash_op_invoke():
+    """imperative_invoke through the ABI with hostile attr strings:
+    unparseable values stay strings and the op either succeeds or
+    reports -1 — never raises into the host."""
+    hid = make_nd((2, 2))
+    op_hid = ce._op_handle("Activation")
+    keys = (ctypes.c_char_p * 1)(b"act_type")
+    ok = 0
+    for hostile in [b"relu", b"]([{", b"None", b"0x" * 40]:
+        vals = (ctypes.c_char_p * 1)(hostile)
+        handles_in = (ctypes.c_uint64 * 1)(hid)
+        n_out = ctypes.c_int32(0)
+        out_ptr = ctypes.c_uint64(0)
+        s, e = call(ce.imperative_invoke, op_hid, 1,
+                    ctypes.addressof(handles_in), ctypes.addressof(n_out),
+                    ctypes.addressof(out_ptr),
+                    1, ctypes.addressof(keys), ctypes.addressof(vals))
+        assert s in (0, -1)
+        if s == 0:
+            ok += 1
+            assert n_out.value == 1
+        else:
+            assert e  # a diagnostic, not silence
+    assert ok >= 1  # the well-formed relu call must succeed
+    call(ce.nd_free, hid)

@@ -539,3 +539,39 @@ def test_bi_lstm_sort_learns():
                        ["--epochs", "14", "--dataset-size", "2000",
                         "--hidden", "64"])
     assert acc >= 0.7, acc
+
+
+@pytest.mark.slow
+def test_launch_dist_lenet_sync_training_convergence():
+    """End-to-end dist TRAINING over the process boundary (reference:
+    tests/nightly/dist_lenet.py): class-disjoint shards force real
+    gradient exchange — a non-exchanging worker cannot pass the
+    full-set accuracy bar — and the sync contract (identical params on
+    every worker) is asserted cross-process."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(REPO, "tests", "dist", "dist_lenet.py"), "sync"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("dist_lenet sync OK") == 2, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_launch_dist_lenet_async_training_convergence():
+    """Async variant through spawned PS processes (reference:
+    tests/nightly/ dist_lenet-style async runs): convergence bar only —
+    updates interleave, so no cross-worker param-equality contract."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "2", sys.executable,
+         os.path.join(REPO, "tests", "dist", "dist_lenet.py"), "async"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("dist_lenet async OK") == 2, r.stdout + r.stderr
