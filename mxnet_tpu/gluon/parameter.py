@@ -116,12 +116,21 @@ class Parameter:
         self._finish_init(init, default_init)
 
     def _finish_init(self, init, default_init):
-        init = init or self.init or default_init
+        explicit = init or self.init
+        init = explicit or default_init
         if isinstance(init, str):
             init = initializer.create(init)
         data = _np.zeros(self.shape, dtype=np_dtype(self.dtype))
-        init_desc = initializer.InitDesc(self.name)
-        init(init_desc, data)  # fills in place (reference semantics)
+        init_desc = initializer.InitDesc(self.name, global_init=init)
+        if explicit is not None:
+            # a parameter-level init wins over name-suffix dispatch —
+            # the reference routes this through InitDesc
+            # attrs['__init__'] to the init's weight filler, so a PReLU
+            # 'alpha' with init=Constant fills even though 'alpha' is
+            # no known suffix
+            init._init_weight(init_desc, data)
+        else:
+            init(init_desc, data)  # fills in place (reference semantics)
         self._data = [ndarray.array(data, ctx=c, dtype=self.dtype)
                       for c in self._ctx_list]
         self._deferred_init = ()

@@ -55,8 +55,16 @@ class PallasModule:
                 arrays = [a.data_jax if isinstance(a, NDArray) else a
                           for a in args]
                 out_shape = out_shape_fn(*arrays)
-                fn = pl.pallas_call(kernel_fn, out_shape=out_shape,
-                                    grid=grid_dims or grid)
+                kw = {}
+                if grid_dims is not None or grid is not None:
+                    # gridless kernels must OMIT the arg: pallas_call
+                    # rejects an explicit grid=None
+                    kw["grid"] = grid_dims if grid_dims is not None else grid
+                if jax.default_backend() != "tpu":
+                    # Mosaic compiles only on TPU; CPU (tests, local
+                    # dev) runs the same kernel through the interpreter
+                    kw["interpret"] = True
+                fn = pl.pallas_call(kernel_fn, out_shape=out_shape, **kw)
                 res = fn(*arrays)
                 return NDArray(res)
 

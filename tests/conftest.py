@@ -79,3 +79,51 @@ def hermetic_subprocess_env(repo=None):
     env.pop("PYTHONPATH", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return env
+
+
+# Measured-slow tests (r5 durations run: everything >= ~30 s on this
+# 1-core container).  Centralized so the tier stays maintainable; the
+# multi-process dist/dryrun tests carry @pytest.mark.slow in-place.
+# `-m "not slow"` = the fast tier (< ~20 min); full suite = both.
+_SLOW_TESTS = {
+    "test_dryrun_multichip_16_devices",
+    "test_deepspeech_ctc_cer",
+    "test_word_lm_ppl_decreases",
+    "test_ctc_ocr_converges",
+    "test_rcnn_proposal_roialign_pipeline",
+    "test_ner_tagger_f1",
+    "test_over_int32_elements_smoke",
+    "test_matrix_fact_example",
+    "test_lstnet_forecast_beats_mean",
+    "test_ssd_detects",
+    "test_rnn_train_overfit",
+    "test_captcha_whole_string_accuracy",
+    "test_tutorial_runs[unsupervised_learning/gan.py]",
+    "test_bayesian_hmc_toy",
+    "test_dec_clustering_refines_kmeans",
+    "test_inception_bn_forward_and_param_count",
+    "test_inception_bn_nhwc_matches_nchw",
+    "test_vaegan_reconstruction_improves",
+    "test_reinforce_gridworld_learns",
+    "test_bayesian_distilled_sgld",
+    "test_conv_rnn_cells_shapes",
+    "test_bucketed_lstm_lm_converges",
+    "test_sparse_matrix_factorization",
+    "test_numeric_gradient_families[<lambda>-shapes2]",
+    "test_distributed_training_8dev_mesh",
+    "test_train_imagenet_synthetic_smoke",
+    "test_ndsb2_crps_volume_regression",
+    "test_ndsb1_rec_pipeline_trains",
+    "test_models_forward[mobilenetv2_0.25]",
+    "test_models_forward[squeezenet1.1]",
+    "test_resnet_nhwc_matches_nchw",
+    "test_capsnet_routing_converges",
+    "test_bayesian_sgld_toy_posterior",
+    "test_fcn_segmentation_learns",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)

@@ -548,9 +548,9 @@ def test_launch_dist_lenet_sync_training_convergence():
     gradient exchange — a non-exchanging worker cannot pass the
     full-set accuracy bar — and the sync contract (identical params on
     every worker) is asserted cross-process."""
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(REPO)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", sys.executable,
@@ -565,9 +565,9 @@ def test_launch_dist_lenet_async_training_convergence():
     """Async variant through spawned PS processes (reference:
     tests/nightly/ dist_lenet-style async runs): convergence bar only —
     updates interleave, so no cross-worker param-equality contract."""
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    from conftest import hermetic_subprocess_env
+
+    env = hermetic_subprocess_env(REPO)
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "-s", "2", sys.executable,

@@ -14,7 +14,7 @@ MXTPU_PALLAS_CONV_DW integration, losses get recorded in BENCH_NOTES
 as measured negative results.
 
 Usage: python tools/bench_conv_dw.py [--batch 128] [--depths 8,24]
-       [--csv out.md] [--shapes all|3x3|1x1]
+       [--out table.md] [--shapes all|3x3|1x1]
 """
 
 import argparse
@@ -90,6 +90,8 @@ def main(argv=None):
     ap.add_argument("--shapes", default="all")
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--formulations", default="auto")
+    ap.add_argument("--out", default=None,
+                    help="also write the markdown table to this file")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -101,8 +103,12 @@ def main(argv=None):
     rs = np.random.RandomState(0)
 
     rows = []
-    print("| shape | impl | ms/iter | TFLOP/s | vs XLA |")
-    print("|---|---|---|---|---|")
+    lines = ["| shape | impl | ms/iter | TFLOP/s | vs XLA |",
+             "|---|---|---|---|---|"]
+
+    def emit(line):
+        print(line, flush=True)
+        lines.append(line)
     for (name, (h, w, ci), kernel, stride, pad, co) in RESNET50_SHAPES:
         if args.shapes != "all" and args.shapes not in name:
             continue
@@ -115,8 +121,8 @@ def main(argv=None):
         t_xla = bench_impl(
             lambda xv, dyv: conv_dw_xla(xv, dyv, kernel, stride, pad),
             x, dy, depths)
-        print("| %s | xla | %.3f | %.2f | 1.00x |"
-              % (name, t_xla * 1e3, fl / t_xla / 1e12), flush=True)
+        emit("| %s | xla | %.3f | %.2f | 1.00x |"
+             % (name, t_xla * 1e3, fl / t_xla / 1e12))
         forms = (["pertap", "im2col"] if args.formulations == "both"
                  else [None])
         for form in forms:
@@ -126,13 +132,16 @@ def main(argv=None):
                     lambda xv, dyv: conv_dw_nhwc(xv, dyv, kernel, stride,
                                                  pad, formulation=form),
                     x, dy, depths)
-                print("| %s | %s | %.3f | %.2f | %.2fx |"
-                      % (name, label, t_pal * 1e3, fl / t_pal / 1e12,
-                         t_xla / t_pal), flush=True)
+                emit("| %s | %s | %.3f | %.2f | %.2fx |"
+                     % (name, label, t_pal * 1e3, fl / t_pal / 1e12,
+                        t_xla / t_pal))
                 rows.append((name, label, t_xla, t_pal))
             except Exception as e:
-                print("| %s | %s | FAILED: %s | | |"
-                      % (name, label, str(e)[:80]), flush=True)
+                emit("| %s | %s | FAILED: %s | | |"
+                     % (name, label, str(e)[:80]))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("\n".join(lines) + "\n")
     return rows
 
 

@@ -14,7 +14,8 @@ Usage:
     python tools/coverage_lite.py report out.json [out2.json ...]
         (merges runs, compares against the statically-computed
          executable lines of every mxnet_tpu source file, prints a
-         per-file table and writes COVERAGE.md)
+         per-file table and writes COVERAGE_TABLE.md; COVERAGE.md is
+         the committed narrative around it)
 
 Executable lines are derived by compiling each source file and walking
 ``code.co_lines()`` over all nested code objects — the same universe

@@ -27,7 +27,9 @@ HLO pins.  Reference analog: tools/bandwidth/ measures its kvstore
 traffic empirically; here the compiler's program IS the spec.
 
 Usage:
-    python tools/scaling_report.py                  # writes SCALING.md
+    python tools/scaling_report.py                  # writes SCALING_TABLE.md
+        (SCALING.md is the committed narrative AROUND these tables —
+         refresh its numbers from the regenerated SCALING_TABLE.md)
     python tools/scaling_report.py --devices 8,16   # subset
     python tools/scaling_report.py --child 8        # (internal)
 """
@@ -185,7 +187,7 @@ def main(device_counts):
     results = [_spawn(n) for n in device_counts]
     lines = []
     w = lines.append
-    w("# SCALING.md — collective structure vs device count")
+    w("# SCALING_TABLE.md — collective structure vs device count")
     w("")
     w("Generated by `python tools/scaling_report.py` (virtual CPU mesh, "
       "post-SPMD HLO; see the tool docstring for method).  'bytes' = "
