@@ -122,12 +122,13 @@ class Parameter:
             init = initializer.create(init)
         data = _np.zeros(self.shape, dtype=np_dtype(self.dtype))
         init_desc = initializer.InitDesc(self.name, global_init=init)
-        if explicit is not None:
+        if explicit is not None and hasattr(init, "_init_weight"):
             # a parameter-level init wins over name-suffix dispatch —
             # the reference routes this through InitDesc
             # attrs['__init__'] to the init's weight filler, so a PReLU
             # 'alpha' with init=Constant fills even though 'alpha' is
-            # no known suffix
+            # no known suffix.  Mixed/Load define only __call__ (they
+            # dispatch by name themselves) and take the plain path.
             init._init_weight(init_desc, data)
         else:
             init(init_desc, data)  # fills in place (reference semantics)

@@ -1,0 +1,34 @@
+#!/usr/bin/env perl
+# Build AI::MXNetTPU's XS library with the flags this perl was built
+# with (ExtUtils::Embed) — no Makefile.PL/xsubpp round-trip, the XSUBs
+# in xs/mxnettpu_xs.c are written directly against the XS macros.
+#
+# Usage: perl build.pl   (from this directory; needs gcc + libmxtpu)
+
+use strict;
+use warnings;
+use Config;
+use ExtUtils::Embed ();
+use File::Basename qw(dirname);
+use File::Spec;
+
+my $here = dirname(File::Spec->rel2abs($0));
+my $repo = File::Spec->rel2abs(File::Spec->catdir($here, '..', '..'));
+my $native = File::Spec->catdir($repo, 'mxnet_tpu', 'native');
+my $inc = File::Spec->catdir($native, 'include');
+my $src = File::Spec->catfile($here, 'xs', 'mxnettpu_xs.c');
+my $out = File::Spec->catfile($here, 'xs', 'MXNetTPU.so');
+
+my $ccopts = ExtUtils::Embed::ccopts();
+chomp $ccopts;
+
+my $cmd = join(' ',
+    $Config{cc}, '-shared', '-fPIC', '-O2',
+    $ccopts,
+    "-I$inc",
+    $src,
+    "-L$native", '-lmxtpu', "-Wl,-rpath,$native",
+    '-o', $out);
+print "$cmd\n";
+system($cmd) == 0 or die "build failed: $?\n";
+print "built $out\n";

@@ -1,0 +1,242 @@
+package AI::MXNetTPU;
+
+# AI::MXNetTPU — Perl binding for the mxnet_tpu framework.
+#
+# Reference analog: perl-package/AI-MXNet (lib/AI/MXNet.pm NDArray /
+# AutoGrad / KVStore surfaces).  This projects the same API shapes over
+# the tensor-runtime C ABI (mxtpu/c_api.h) through hand-written XS
+# (xs/mxnettpu_xs.c) — the ABI's semantics come from the one embedded
+# implementation, so Perl, C, C++ and Python can never disagree.
+#
+# Build once with `perl build.pl`, then:
+#
+#   use AI::MXNetTPU qw(nd);
+#   my $x = AI::MXNetTPU::NDArray->array([[1,2],[3,4]]);
+#   my $y = $x * $x + 1;         # overloaded elementwise ops
+#   print "@{$y->aslist}\n";
+#
+# Autograd:
+#   $x->attach_grad;
+#   my $loss = AI::MXNetTPU::AutoGrad::record(sub { ($x * $x)->sum });
+#   $loss->backward;
+#   my $g = $x->grad;            # 2x
+
+use strict;
+use warnings;
+use File::Basename qw(dirname);
+use File::Spec;
+use DynaLoader ();
+
+our $VERSION = '0.1.0';
+
+sub _boot {
+    my $here = dirname(File::Spec->rel2abs(__FILE__));
+    my $so = File::Spec->catfile($here, '..', '..', 'xs', 'MXNetTPU.so');
+    die "AI::MXNetTPU: XS library not built; run perl build.pl ($so)\n"
+        unless -e $so;
+    # RTLD_GLOBAL (0x01): libmxtpu's embedded interpreter must see the
+    # process's libpython symbols once it dlopens them
+    my $libref = DynaLoader::dl_load_file($so, 0x01)
+        or die 'AI::MXNetTPU: ', DynaLoader::dl_error();
+    my $bootsym = DynaLoader::dl_find_symbol($libref, 'boot_AI__MXNetTPU')
+        or die 'AI::MXNetTPU: no boot symbol: ', DynaLoader::dl_error();
+    my $xs = DynaLoader::dl_install_xsub('AI::MXNetTPU::_bootstrap',
+                                         $bootsym, __FILE__);
+    &$xs();
+}
+
+_boot();
+
+my %OPCACHE;
+
+sub op {
+    my ($name) = @_;
+    $OPCACHE{$name} //= _op_handle($name);
+    return $OPCACHE{$name};
+}
+
+sub invoke {
+    # invoke('broadcast_add', [$nd1, $nd2], key => val, ...) -> NDArray(s)
+    my ($name, $inputs, %attrs) = @_;
+    my @ins = map { $_->handle } @$inputs;
+    my @keys = keys %attrs;
+    my @vals = map { "$attrs{$_}" } @keys;
+    my $outs = _invoke(op($name), \@ins, \@keys, \@vals);
+    my @nds = map { AI::MXNetTPU::NDArray->_from_handle($_) } @$outs;
+    return wantarray ? @nds : $nds[0];
+}
+
+# ---------------------------------------------------------------- NDArray
+
+package AI::MXNetTPU::NDArray;
+
+use strict;
+use warnings;
+use overload
+    '+' => sub { AI::MXNetTPU::NDArray::_binop('broadcast_add', @_) },
+    '-' => sub { AI::MXNetTPU::NDArray::_binop('broadcast_sub', @_) },
+    '*' => sub { AI::MXNetTPU::NDArray::_binop('broadcast_mul', @_) },
+    '/' => sub { AI::MXNetTPU::NDArray::_binop('broadcast_div', @_) },
+    '""' => sub { $_[0]->stringify },
+    '==' => sub {    # handle identity, not elementwise (use invoke
+                     # 'broadcast_equal' for the elementwise form)
+        my ($a, $b) = @_;
+        return ref($b) && $b->isa(__PACKAGE__) && $a->{h} == $b->{h};
+    };
+
+sub _from_handle {
+    my ($class, $h) = @_;
+    return bless { h => $h, owned => 1 }, $class;
+}
+
+sub handle { $_[0]->{h} }
+
+sub zeros {
+    my ($class, $shape) = @_;
+    my $h = AI::MXNetTPU::_nd_create($shape, 0);    # dtype 0 = float32
+    my $self = $class->_from_handle($h);
+    my $n = 1; $n *= $_ for @$shape;
+    AI::MXNetTPU::_nd_set_f32($h, pack('f*', (0) x $n));
+    return $self;
+}
+
+sub ones {
+    my ($class, $shape) = @_;
+    my $self = $class->zeros($shape);
+    my $n = 1; $n *= $_ for @$shape;
+    AI::MXNetTPU::_nd_set_f32($self->{h}, pack('f*', (1) x $n));
+    return $self;
+}
+
+sub _flatten {
+    my ($data, $out, $shape, $depth) = @_;
+    if (ref $data eq 'ARRAY') {
+        $shape->[$depth] //= scalar @$data;
+        die "ragged array\n" if $shape->[$depth] != scalar @$data;
+        _flatten($_, $out, $shape, $depth + 1) for @$data;
+    } else {
+        push @$out, $data;
+    }
+}
+
+sub array {
+    my ($class, $data) = @_;
+    my (@flat, @shape);
+    _flatten($data, \@flat, \@shape, 0);
+    @shape = (scalar @flat) unless @shape;
+    my $h = AI::MXNetTPU::_nd_create(\@shape, 0);
+    AI::MXNetTPU::_nd_set_f32($h, pack('f*', @flat));
+    return $class->_from_handle($h);
+}
+
+sub shape { AI::MXNetTPU::_nd_shape($_[0]->{h}) }
+
+sub aslist { [unpack('f*', AI::MXNetTPU::_nd_get_f32($_[0]->{h}))] }
+
+sub asscalar {
+    my @v = unpack('f*', AI::MXNetTPU::_nd_get_f32($_[0]->{h}));
+    die "asscalar on non-scalar\n" if @v != 1;
+    return $v[0];
+}
+
+sub stringify {
+    my ($self) = @_;
+    return sprintf("NDArray(%s)<%s>", join(',', @{$self->shape}),
+                   join(',', map { sprintf('%g', $_) }
+                        @{$self->aslist}[0 .. _min(5, scalar(@{$self->aslist}) - 1)]));
+}
+
+sub _min { $_[0] < $_[1] ? $_[0] : $_[1] }
+
+sub _coerce {
+    my ($v) = @_;
+    return $v if ref $v;
+    return AI::MXNetTPU::NDArray->array([$v + 0]);
+}
+
+sub _binop {
+    my ($op, $a, $b, $swap) = @_;
+    $b = _coerce($b);
+    ($a, $b) = ($b, $a) if $swap;
+    return AI::MXNetTPU::invoke($op, [$a, $b]);
+}
+
+sub dot   { AI::MXNetTPU::invoke('dot', [$_[0], $_[1]]) }
+sub relu  { AI::MXNetTPU::invoke('relu', [$_[0]]) }
+sub sum   { AI::MXNetTPU::invoke('sum', [$_[0]]) }
+sub mean  { AI::MXNetTPU::invoke('mean', [$_[0]]) }
+sub square { AI::MXNetTPU::invoke('square', [$_[0]]) }
+
+sub attach_grad {
+    my ($self, $req) = @_;
+    $req //= 1;                               # 1 = write
+    my $grad = AI::MXNetTPU::NDArray->zeros($self->shape);
+    AI::MXNetTPU::_mark_variable($self->{h}, $grad->{h}, $req);
+    $self->{_grad} = $grad;                   # keep the buffer alive
+    return $self;
+}
+
+sub grad {
+    my ($self) = @_;
+    my $h = AI::MXNetTPU::_grad($self->{h});
+    return undef unless $h;
+    return AI::MXNetTPU::NDArray->_from_handle($h);
+}
+
+sub backward {
+    my ($self, %kw) = @_;
+    AI::MXNetTPU::_backward($self->{h}, $kw{retain_graph} ? 1 : 0);
+    return;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_nd_free($self->{h})
+        if $self->{owned} && defined $self->{h};
+}
+
+# --------------------------------------------------------------- autograd
+
+package AI::MXNetTPU::AutoGrad;
+
+use strict;
+use warnings;
+
+sub record {
+    my ($code, %kw) = @_;
+    my $train = exists $kw{train_mode} ? ($kw{train_mode} ? 1 : 0) : 1;
+    my $prev_rec = AI::MXNetTPU::_set_recording(1);
+    my $prev_train = AI::MXNetTPU::_set_training($train);
+    my @out = eval { $code->() };
+    my $err = $@;
+    AI::MXNetTPU::_set_recording($prev_rec);
+    AI::MXNetTPU::_set_training($prev_train);
+    die $err if $err;
+    return wantarray ? @out : $out[0];
+}
+
+# ---------------------------------------------------------------- kvstore
+
+package AI::MXNetTPU::KVStore;
+
+use strict;
+use warnings;
+
+sub create {
+    my ($class, $type) = @_;
+    $type //= 'local';
+    return bless { h => AI::MXNetTPU::_kv_create($type) }, $class;
+}
+
+sub init { AI::MXNetTPU::_kv_init($_[0]->{h}, $_[1], $_[2]->handle); return }
+sub push_ { AI::MXNetTPU::_kv_push($_[0]->{h}, $_[1], $_[2]->handle); return }
+
+sub pull {
+    my ($self, $key, $out) = @_;
+    AI::MXNetTPU::_kv_pull($self->{h}, $key, $out->handle);
+    return $out;
+}
+
+package AI::MXNetTPU;
+
+1;

@@ -1,0 +1,282 @@
+/* AI::MXNetTPU XS glue — hand-written XSUBs over the tensor-runtime C
+ * ABI (mxtpu/c_api.h).
+ *
+ * Reference analog: perl-package/AI-MXNetCAPI (the SWIG layer under
+ * AI::MXNet).  This binding projects the same seam — every call goes
+ * through the public MXTPU* C functions, so Perl semantics can never
+ * drift from the Python package's (the ABI is one embedded
+ * implementation, native/src/embed.cc).
+ *
+ * Conventions:
+ *   - handles cross into Perl as plain UVs;
+ *   - any non-zero rc croaks with MXTPUGetLastError() — Perl callers
+ *     get exceptions, never silent failures;
+ *   - bulk data moves as packed strings (pack "f*"), element counts
+ *     follow the ABI's SyncCopy contract.
+ *
+ * Built by build.pl with the compiler flags ExtUtils::Embed reports;
+ * no Makefile.PL/xsubpp needed (the XSUBs are written directly against
+ * the XS macros).
+ */
+
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdint.h>
+#include <mxtpu/c_api.h>
+
+#define CROAK_ON(rc) do { if ((rc) != 0) \
+    croak("mxtpu: %s", MXTPUGetLastError()); } while (0)
+
+#define MAX_DIMS 16
+#define MAX_IO 64
+
+static uint32_t read_shape(pTHX_ SV* aref, uint32_t* shape) {
+  AV* av;
+  I32 i, n;
+  if (!SvROK(aref) || SvTYPE(SvRV(aref)) != SVt_PVAV)
+    croak("shape must be an array reference");
+  av = (AV*)SvRV(aref);
+  n = av_len(av) + 1;
+  if (n > MAX_DIMS) croak("too many dimensions: %d", (int)n);
+  for (i = 0; i < n; i++) {
+    SV** e = av_fetch(av, i, 0);
+    shape[i] = e ? (uint32_t)SvUV(*e) : 0;
+  }
+  return (uint32_t)n;
+}
+
+XS(xs_nd_create); XS(xs_nd_create) {
+  dXSARGS;
+  uint32_t shape[MAX_DIMS];
+  uint32_t nd;
+  MXTPUHandle out;
+  if (items != 2) croak("_nd_create(shape_aref, dtype)");
+  nd = read_shape(aTHX_ ST(0), shape);
+  CROAK_ON(MXTPUNDArrayCreateEx(shape, nd, 1, 0, 0, (int)SvIV(ST(1)),
+                                &out));
+  ST(0) = sv_2mortal(newSVuv((UV)out));
+  XSRETURN(1);
+}
+
+XS(xs_nd_free); XS(xs_nd_free) {
+  dXSARGS;
+  if (items != 1) croak("_nd_free(h)");
+  CROAK_ON(MXTPUNDArrayFree((MXTPUHandle)SvUV(ST(0))));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_nd_shape); XS(xs_nd_shape) {
+  dXSARGS;
+  uint32_t ndim = 0, i;
+  const uint32_t* dims = NULL;
+  AV* out;
+  if (items != 1) croak("_nd_shape(h)");
+  CROAK_ON(MXTPUNDArrayGetShape((MXTPUHandle)SvUV(ST(0)), &ndim, &dims));
+  out = newAV();
+  for (i = 0; i < ndim; i++) av_push(out, newSVuv(dims[i]));
+  ST(0) = sv_2mortal(newRV_noinc((SV*)out));
+  XSRETURN(1);
+}
+
+XS(xs_nd_set_f32); XS(xs_nd_set_f32) {
+  dXSARGS;
+  STRLEN len;
+  const char* buf;
+  if (items != 2) croak("_nd_set_f32(h, packed)");
+  buf = SvPVbyte(ST(1), len);
+  CROAK_ON(MXTPUNDArraySyncCopyFromCPU((MXTPUHandle)SvUV(ST(0)), buf,
+                                       (uint64_t)(len / 4)));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_nd_get_f32); XS(xs_nd_get_f32) {
+  dXSARGS;
+  uint32_t ndim = 0, i;
+  const uint32_t* dims = NULL;
+  uint64_t n = 1;
+  SV* out;
+  MXTPUHandle h;
+  if (items != 1) croak("_nd_get_f32(h)");
+  h = (MXTPUHandle)SvUV(ST(0));
+  CROAK_ON(MXTPUNDArrayGetShape(h, &ndim, &dims));
+  for (i = 0; i < ndim; i++) n *= dims[i];
+  out = newSV(n * 4 ? n * 4 : 1);
+  SvPOK_on(out);
+  CROAK_ON(MXTPUNDArraySyncCopyToCPU(h, SvPVX(out), n));
+  SvCUR_set(out, n * 4);
+  ST(0) = sv_2mortal(out);
+  XSRETURN(1);
+}
+
+XS(xs_op_handle); XS(xs_op_handle) {
+  dXSARGS;
+  MXTPUHandle out;
+  if (items != 1) croak("_op_handle(name)");
+  CROAK_ON(MXTPUGetOpHandle(SvPVbyte_nolen(ST(0)), &out));
+  ST(0) = sv_2mortal(newSVuv((UV)out));
+  XSRETURN(1);
+}
+
+/* _invoke(op, inputs_aref, keys_aref, vals_aref) -> aref of out handles */
+XS(xs_invoke); XS(xs_invoke) {
+  dXSARGS;
+  AV *in_av, *k_av, *v_av, *out_av;
+  MXTPUHandle ins[MAX_IO];
+  const char* keys[MAX_IO];
+  const char* vals[MAX_IO];
+  I32 i, nin, np;
+  int n_out = 0;
+  MXTPUHandle* outs = NULL;
+  if (items != 4) croak("_invoke(op, inputs, keys, vals)");
+  in_av = (AV*)SvRV(ST(1));
+  k_av = (AV*)SvRV(ST(2));
+  v_av = (AV*)SvRV(ST(3));
+  nin = av_len(in_av) + 1;
+  np = av_len(k_av) + 1;
+  if (nin > MAX_IO || np > MAX_IO) croak("too many inputs/params");
+  if (np != av_len(v_av) + 1) croak("keys/vals length mismatch");
+  for (i = 0; i < nin; i++)
+    ins[i] = (MXTPUHandle)SvUV(*av_fetch(in_av, i, 0));
+  for (i = 0; i < np; i++) {
+    keys[i] = SvPVbyte_nolen(*av_fetch(k_av, i, 0));
+    vals[i] = SvPVbyte_nolen(*av_fetch(v_av, i, 0));
+  }
+  CROAK_ON(MXTPUImperativeInvoke((MXTPUHandle)SvUV(ST(0)), (int)nin, ins,
+                                 &n_out, &outs, (int)np, keys, vals));
+  out_av = newAV();
+  for (i = 0; i < n_out; i++) av_push(out_av, newSVuv((UV)outs[i]));
+  ST(0) = sv_2mortal(newRV_noinc((SV*)out_av));
+  XSRETURN(1);
+}
+
+XS(xs_set_recording); XS(xs_set_recording) {
+  dXSARGS;
+  int prev = 0;
+  if (items != 1) croak("_set_recording(flag)");
+  CROAK_ON(MXTPUAutogradSetIsRecording((int)SvIV(ST(0)), &prev));
+  ST(0) = sv_2mortal(newSViv(prev));
+  XSRETURN(1);
+}
+
+XS(xs_set_training); XS(xs_set_training) {
+  dXSARGS;
+  int prev = 0;
+  if (items != 1) croak("_set_training(flag)");
+  CROAK_ON(MXTPUAutogradSetIsTraining((int)SvIV(ST(0)), &prev));
+  ST(0) = sv_2mortal(newSViv(prev));
+  XSRETURN(1);
+}
+
+XS(xs_mark_variable); XS(xs_mark_variable) {
+  dXSARGS;
+  MXTPUHandle var, grad;
+  uint32_t req;
+  if (items != 3) croak("_mark_variable(h, grad_h, req)");
+  var = (MXTPUHandle)SvUV(ST(0));
+  grad = (MXTPUHandle)SvUV(ST(1));
+  req = (uint32_t)SvUV(ST(2));
+  CROAK_ON(MXTPUAutogradMarkVariables(1, &var, &req, &grad));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_backward); XS(xs_backward) {
+  dXSARGS;
+  MXTPUHandle h;
+  if (items != 2) croak("_backward(h, retain)");
+  h = (MXTPUHandle)SvUV(ST(0));
+  CROAK_ON(MXTPUAutogradBackward(1, &h, NULL, (int)SvIV(ST(1))));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_grad); XS(xs_grad) {
+  dXSARGS;
+  MXTPUHandle out = 0;
+  if (items != 1) croak("_grad(h)");
+  CROAK_ON(MXTPUNDArrayGetGrad((MXTPUHandle)SvUV(ST(0)), &out));
+  ST(0) = sv_2mortal(newSVuv((UV)out));
+  XSRETURN(1);
+}
+
+XS(xs_wait_all); XS(xs_wait_all) {
+  dXSARGS;
+  PERL_UNUSED_VAR(items);
+  CROAK_ON(MXTPUNDArrayWaitAll());
+  XSRETURN_EMPTY;
+}
+
+XS(xs_kv_create); XS(xs_kv_create) {
+  dXSARGS;
+  MXTPUHandle out;
+  if (items != 1) croak("_kv_create(type)");
+  CROAK_ON(MXTPUKVStoreCreate(SvPVbyte_nolen(ST(0)), &out));
+  ST(0) = sv_2mortal(newSVuv((UV)out));
+  XSRETURN(1);
+}
+
+XS(xs_kv_init); XS(xs_kv_init) {
+  dXSARGS;
+  int key;
+  MXTPUHandle val;
+  if (items != 3) croak("_kv_init(kv, key, h)");
+  key = (int)SvIV(ST(1));
+  val = (MXTPUHandle)SvUV(ST(2));
+  CROAK_ON(MXTPUKVStoreInit((MXTPUHandle)SvUV(ST(0)), 1, &key, &val));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_kv_push); XS(xs_kv_push) {
+  dXSARGS;
+  int key;
+  MXTPUHandle val;
+  if (items != 3) croak("_kv_push(kv, key, h)");
+  key = (int)SvIV(ST(1));
+  val = (MXTPUHandle)SvUV(ST(2));
+  CROAK_ON(MXTPUKVStorePush((MXTPUHandle)SvUV(ST(0)), 1, &key, &val, 0));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_kv_pull); XS(xs_kv_pull) {
+  dXSARGS;
+  int key;
+  MXTPUHandle val;
+  if (items != 3) croak("_kv_pull(kv, key, h)");
+  key = (int)SvIV(ST(1));
+  val = (MXTPUHandle)SvUV(ST(2));
+  CROAK_ON(MXTPUKVStorePull((MXTPUHandle)SvUV(ST(0)), 1, &key, &val, 0));
+  XSRETURN_EMPTY;
+}
+
+XS(xs_last_error); XS(xs_last_error) {
+  dXSARGS;
+  PERL_UNUSED_VAR(items);
+  ST(0) = sv_2mortal(newSVpv(MXTPUGetLastError(), 0));
+  XSRETURN(1);
+}
+
+XS_EXTERNAL(boot_AI__MXNetTPU);
+XS_EXTERNAL(boot_AI__MXNetTPU) {
+  dXSARGS;
+  PERL_UNUSED_VAR(items);
+  newXS("AI::MXNetTPU::_nd_create", xs_nd_create, __FILE__);
+  newXS("AI::MXNetTPU::_nd_free", xs_nd_free, __FILE__);
+  newXS("AI::MXNetTPU::_nd_shape", xs_nd_shape, __FILE__);
+  newXS("AI::MXNetTPU::_nd_set_f32", xs_nd_set_f32, __FILE__);
+  newXS("AI::MXNetTPU::_nd_get_f32", xs_nd_get_f32, __FILE__);
+  newXS("AI::MXNetTPU::_op_handle", xs_op_handle, __FILE__);
+  newXS("AI::MXNetTPU::_invoke", xs_invoke, __FILE__);
+  newXS("AI::MXNetTPU::_set_recording", xs_set_recording, __FILE__);
+  newXS("AI::MXNetTPU::_set_training", xs_set_training, __FILE__);
+  newXS("AI::MXNetTPU::_mark_variable", xs_mark_variable, __FILE__);
+  newXS("AI::MXNetTPU::_backward", xs_backward, __FILE__);
+  newXS("AI::MXNetTPU::_grad", xs_grad, __FILE__);
+  newXS("AI::MXNetTPU::_wait_all", xs_wait_all, __FILE__);
+  newXS("AI::MXNetTPU::_kv_create", xs_kv_create, __FILE__);
+  newXS("AI::MXNetTPU::_kv_init", xs_kv_init, __FILE__);
+  newXS("AI::MXNetTPU::_kv_push", xs_kv_push, __FILE__);
+  newXS("AI::MXNetTPU::_kv_pull", xs_kv_pull, __FILE__);
+  newXS("AI::MXNetTPU::_last_error", xs_last_error, __FILE__);
+  XSRETURN_YES;
+}

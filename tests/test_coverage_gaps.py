@@ -221,7 +221,9 @@ def test_kvstore_server_init_server_role_gate(monkeypatch):
     monkeypatch.setenv("MXTPU_PS_PORTS", "29517")
     t = threading.Thread(target=kvstore_server.init_server, daemon=True)
     t.start()
-    monkeypatch.setenv("DMLC_ROLE", "worker")
+    # PSClient does not read DMLC_ROLE, so the env stays 'server' until
+    # monkeypatch unwinds — flipping it here would race the thread's
+    # own role read (r5 review finding)
     c = PSClient(connect_timeout=20)
     c.init("k", np.zeros((2,), np.float32))
     assert c.pull("k").shape == (2,)
@@ -294,3 +296,18 @@ def test_activation_blocks_forward():
     sw.initialize()
     np.testing.assert_allclose(
         sw(x).asnumpy(), xn / (1 + np.exp(-xn)), rtol=1e-5, atol=1e-6)
+
+
+def test_explicit_mixed_initializer_still_works():
+    """r5 review regression: Mixed/Load define only __call__ (no
+    _init_weight); an explicit init=Mixed must keep working alongside
+    the PReLU-style param-level-init routing."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Mixed([".*weight", ".*"],
+                                 [mx.init.Constant(3.0),
+                                  mx.init.Zero()]), force_reinit=True)
+    w, b = [p.data().asnumpy() for p in net.collect_params().values()]
+    np.testing.assert_allclose(w, 3.0)
+    np.testing.assert_allclose(b, 0.0)
