@@ -1,0 +1,111 @@
+"""Pallas TPU kernel for max-pooling backward (NHWC).
+
+Why: the flagship step's maxpool-backward lowers to XLA
+select-and-scatter at ~0.1% MXU and 66% of the bandwidth roofline
+(BENCH_ROOFLINE.md: 765 us vs a 502 us byte bound) — pure data
+movement with headroom.  The TPU-native formulation is gather-style:
+one pass computes each window's FIRST argmax (XLA's select tie-break)
+from strided tap slices held in VMEM, then scatters dY through nine
+strided read-modify-writes of the VMEM-resident output block — HBM
+sees x, dy and dx exactly once per image block.
+
+Layout: NHWC; symmetric padding (the 'valid' pooling convention);
+the pad region of x is filled with -inf so it never wins a max.
+`supported()` gates shapes; callers fall back to XLA's lowering.
+Reference analog: the backward kernels behind
+src/operator/nn/pooling.cc (cuDNN PoolingBackward).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+from .pallas_conv import _VMEM_BUDGET, _block_images, _pad_to
+
+_NEG = float("-inf")
+
+
+def supported(x_shape, dy_shape, kernel, stride, pad, ebytes=2):
+    if not _HAS_PALLAS or len(kernel) != 2:
+        return False
+    n, h, w, c = x_shape
+    _, oh, ow, dc = dy_shape
+    if c != dc or c < 8:
+        return False
+    if (h + 2 * pad[0] - kernel[0]) // stride[0] + 1 != oh:
+        return False
+    if (w + 2 * pad[1] - kernel[1]) // stride[1] + 1 != ow:
+        return False
+    hp, wp = h + 2 * pad[0], w + 2 * pad[1]
+    per_image = (2 * hp * _pad_to(wp, 8) * _pad_to(c, 128) +
+                 2 * oh * _pad_to(ow, 8) * _pad_to(c, 128)) * ebytes
+    return per_image <= _VMEM_BUDGET
+
+
+def _bwd_kernel(x_ref, dy_ref, out_ref, *, kh, kw, sy, sx, oh, ow):
+    out_ref[:] = jnp.zeros_like(out_ref)
+    m = None
+    idx = None
+    for t in range(kh * kw):
+        r, c = divmod(t, kw)
+        v = x_ref[:, r:r + sy * oh:sy, c:c + sx * ow:sx, :]
+        if m is None:
+            m = v
+            idx = jnp.zeros(v.shape, jnp.int32)
+        else:
+            take = v > m  # strict: ties keep the EARLIER tap (XLA select)
+            m = jnp.where(take, v, m)
+            idx = jnp.where(take, t, idx)
+    dy = dy_ref[:]
+    zero = jnp.zeros_like(dy)
+    for t in range(kh * kw):
+        r, c = divmod(t, kw)
+        contrib = jnp.where(idx == t, dy, zero)
+        cur = out_ref[:, r:r + sy * oh:sy, c:c + sx * ow:sx, :]
+        out_ref[:, r:r + sy * oh:sy, c:c + sx * ow:sx, :] = cur + contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kernel", "stride", "pad", "interpret"))
+def maxpool_bwd_nhwc(x, dy, kernel, stride, pad=(0, 0), interpret=False):
+    """dX for NHWC max pooling: x (N,H,W,C) forward input, dy the
+    (N,OH,OW,C) cotangent; returns (N,H,W,C) in dy.dtype."""
+    kh, kw = kernel
+    sy, sx = stride
+    n, h, w, c = x.shape
+    _, oh, ow, _c = dy.shape
+    if not interpret:
+        interpret = jax.default_backend() != "tpu"
+    xp = jnp.pad(x, ((0, 0), (pad[0], pad[0]), (pad[1], pad[1]), (0, 0)),
+                 constant_values=_NEG)
+    hp, wp = xp.shape[1], xp.shape[2]
+
+    ebytes = max(x.dtype.itemsize, dy.dtype.itemsize)
+    per_image = (2 * hp * _pad_to(wp, 8) * _pad_to(c, 128) +
+                 2 * oh * _pad_to(ow, 8) * _pad_to(c, 128)) * ebytes
+    nb = _block_images(n, per_image, 0)
+
+    kern = functools.partial(_bwd_kernel, kh=kh, kw=kw, sy=sy, sx=sx,
+                             oh=oh, ow=ow)
+    dxp = pl.pallas_call(
+        kern,
+        grid=(n // nb,),
+        in_specs=[
+            pl.BlockSpec((nb, hp, wp, c), lambda g: (g, 0, 0, 0)),
+            pl.BlockSpec((nb, oh, ow, c), lambda g: (g, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((nb, hp, wp, c), lambda g: (g, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, hp, wp, c), dy.dtype),
+        interpret=interpret,
+    )(xp, dy)
+    return dxp[:, pad[0]:pad[0] + h, pad[1]:pad[1] + w, :]

@@ -1,0 +1,118 @@
+"""Pallas max-pool backward kernel (ops/pallas_pool.py): equivalence
+against XLA's select-and-scatter lowering (including tie-breaks), shape
+gating, and the MXTPU_PALLAS_POOL_BWD integration through a Gluon
+train step.  Perf lives in tools/bench_pool_bwd.py on TPU hardware."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.pallas_pool import maxpool_bwd_nhwc, supported
+
+
+def _xla_pool_bwd(x, dy, kernel, stride, pad):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def pool(v):
+        return lax.reduce_window(
+            v, -jnp.inf, lax.max, (1,) + kernel + (1,),
+            (1,) + stride + (1,),
+            [(0, 0), (pad[0], pad[0]), (pad[1], pad[1]), (0, 0)])
+
+    _, vjp = jax.vjp(pool, x)
+    (dx,) = vjp(dy)
+    return dx
+
+
+CASES = [
+    ((2, 8, 8, 16), (3, 3), (2, 2), (1, 1)),   # the ResNet stem pool
+    ((2, 8, 8, 16), (2, 2), (2, 2), (0, 0)),
+    ((1, 9, 9, 8), (3, 3), (2, 2), (1, 1)),
+    ((2, 8, 8, 8), (3, 3), (1, 1), (1, 1)),    # overlapping windows
+]
+
+
+@pytest.mark.parametrize("xs,k,s,p", CASES)
+def test_pool_bwd_matches_xla_oracle(xs, k, s, p):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    n, h, w, c = xs
+    oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+    x = jnp.asarray(rs.rand(*xs).astype(np.float32))
+    dy = jnp.asarray(rs.rand(n, oh, ow, c).astype(np.float32))
+    want = _xla_pool_bwd(x, dy, k, s, p)
+    got = maxpool_bwd_nhwc(x, dy, k, s, p, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pool_bwd_tie_break_matches_select_semantics():
+    """Constant input: every window is all-ties, so the ENTIRE gradient
+    routing is decided by the tie rule — must match XLA exactly."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(1)
+    x = jnp.ones((1, 6, 6, 8), jnp.float32)
+    dy = jnp.asarray(rs.rand(1, 3, 3, 8).astype(np.float32))
+    for k, s, p in [((2, 2), (2, 2), (0, 0)), ((3, 3), (1, 1), (1, 1))]:
+        oh = (6 + 2 * p[0] - k[0]) // s[0] + 1
+        dyk = jnp.asarray(rs.rand(1, oh, oh, 8).astype(np.float32))
+        want = _xla_pool_bwd(x, dyk, k, s, p)
+        got = maxpool_bwd_nhwc(x, dyk, k, s, p, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+
+
+def test_supported_gating():
+    assert supported((4, 8, 8, 16), (4, 4, 4, 16), (3, 3), (2, 2), (1, 1))
+    # channel mismatch, tiny channels, bad arithmetic
+    assert not supported((4, 8, 8, 16), (4, 4, 4, 8), (3, 3), (2, 2),
+                         (1, 1))
+    assert not supported((4, 8, 8, 3), (4, 4, 4, 3), (3, 3), (2, 2),
+                         (1, 1))
+    assert not supported((4, 8, 8, 16), (4, 5, 5, 16), (3, 3), (2, 2),
+                         (1, 1))
+
+
+def _train_step_vals(monkeypatch, flag):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.gluon_step import GluonTrainStep
+    from mxnet_tpu.parallel.mesh import create_mesh
+    import mxnet_tpu.ops.nn as ops_nn
+
+    monkeypatch.setenv("MXTPU_PALLAS_POOL_BWD", "1" if flag else "0")
+    ops_nn._nhwc_maxpool2d_pallas_bwd.cache_clear()
+
+    np.random.seed(5)
+    mx.random.seed(5)
+    import jax
+
+    mesh = create_mesh({"dp": 1}, devices=jax.devices("cpu")[:1])
+    net = nn.HybridSequential(prefix="ppool_")
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC", in_channels=8))
+        net.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1,
+                             layout="NHWC"))
+        net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net(mx.nd.zeros((1, 8, 8, 8), ctx=mx.cpu()))
+    step = GluonTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, lr=0.1)
+    rs = np.random.RandomState(0)
+    x, y = step.put_batch(rs.rand(4, 8, 8, 8).astype(np.float32),
+                          rs.randint(0, 3, (4,)).astype(np.int32))
+    loss = float(np.asarray(step(x, y)))
+    return loss, [np.asarray(v) for v in step.train_vals]
+
+
+def test_flagged_training_step_matches_default(monkeypatch):
+    loss_off, vals_off = _train_step_vals(monkeypatch, False)
+    loss_on, vals_on = _train_step_vals(monkeypatch, True)
+    assert np.isclose(loss_on, loss_off, rtol=1e-5)
+    for a, b in zip(vals_on, vals_off):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

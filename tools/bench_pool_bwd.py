@@ -1,0 +1,90 @@
+"""Benchmark: Pallas max-pool backward vs XLA's select-and-scatter
+(BENCH_ROOFLINE.md: 765 us at 0.1% MXU in the flagship step).
+
+Same chained fetch-barrier method as tools/bench_conv_dw.py (whose
+bench_impl this reuses).  The flagship shape is the ResNet stem pool:
+bs=128, 112x112x64 -> 56x56x64, 3x3/s2/p1.
+
+Usage: python tools/bench_pool_bwd.py [--batch 128] [--depths 8,24]
+       [--out table.md]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_conv_dw import bench_impl  # noqa: E402
+
+SHAPES = [
+    ("stem.pool.112-56", (112, 112, 64), (3, 3), (2, 2), (1, 1)),
+    ("pool.56-28", (56, 56, 128), (3, 3), (2, 2), (1, 1)),
+    ("pool.2x2.56-28", (56, 56, 64), (2, 2), (2, 2), (0, 0)),
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--depths", default="8,24")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.ops.pallas_pool import maxpool_bwd_nhwc
+
+    depths = tuple(int(d) for d in args.depths.split(","))
+    dtype = jnp.dtype(args.dtype)
+    rs = np.random.RandomState(0)
+
+    lines = ["| shape | impl | ms/iter | GB/s moved | vs XLA |",
+             "|---|---|---|---|---|"]
+
+    def emit(line):
+        print(line, flush=True)
+        lines.append(line)
+
+    for name, (h, w, c), k, s, p in SHAPES:
+        oh = (h + 2 * p[0] - k[0]) // s[0] + 1
+        ow = (w + 2 * p[1] - k[1]) // s[1] + 1
+        x = jnp.asarray(rs.rand(args.batch, h, w, c), dtype)
+        dy = jnp.asarray(rs.rand(args.batch, oh, ow, c), dtype)
+        # minimal HBM bytes: read x + dy, write dx
+        gb = (2 * x.size + dy.size) * x.dtype.itemsize / 1e9
+
+        def xla_bwd(xv, dyv, k=k, s=s, p=p):
+            def pool(v):
+                return lax.reduce_window(
+                    v, -jnp.inf, lax.max, (1,) + k + (1,),
+                    (1,) + s + (1,),
+                    [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)])
+
+            _, vjp = jax.vjp(pool, xv)
+            return vjp(dyv)[0]
+
+        t_xla = bench_impl(xla_bwd, x, dy, depths)
+        emit("| %s | xla | %.3f | %.1f | 1.00x |"
+             % (name, t_xla * 1e3, gb / t_xla))
+        try:
+            t_pal = bench_impl(
+                lambda xv, dyv: maxpool_bwd_nhwc(xv, dyv, k, s, p),
+                x, dy, depths)
+            emit("| %s | pallas | %.3f | %.1f | %.2fx |"
+                 % (name, t_pal * 1e3, gb / t_pal, t_xla / t_pal))
+        except Exception as e:
+            emit("| %s | pallas | FAILED: %s | | |" % (name, str(e)[:80]))
+
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()
