@@ -50,6 +50,26 @@ def pytest_configure(config):
         "<20-minute tier")
 
 
+@pytest.fixture()
+def ps_server(monkeypatch):
+    """In-process PSServer on a random port with the DMLC_*/MXTPU_* env
+    a worker-side client reads — shared by test_ps_errors.py and
+    test_kvstore_facade.py so server bring-up/teardown lives once."""
+    import threading
+
+    from mxnet_tpu.kvstore.ps import PSServer
+
+    srv = PSServer(port=0, num_workers=1)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    yield srv
+    srv._stop.set()
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """Reproducible-yet-varied tests (reference: tests/python/unittest/

@@ -1,0 +1,114 @@
+"""KVStore facade paths runnable in ONE process (COVERAGE.md laggard:
+kvstore/kvstore.py's dist client code normally runs only inside
+launch.py workers).  Two in-process configurations exercise it:
+
+* dist_sync with DMLC_NUM_WORKER=1 — the full client code path
+  (merge, compression, updater/replace) minus the DCN allreduce;
+* dist_async against a PSServer thread in this process — the whole
+  worker facade (init/push/pull/row_sparse_pull/set_optimizer/
+  barrier/stop) over the real wire protocol.
+
+Exact-value semantics mirror tests/dist/dist_*_kvstore.py (reference:
+tests/nightly) so the in-process and multi-process tiers pin the same
+contracts.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_dist_sync_single_worker_full_client_path(monkeypatch):
+    monkeypatch.delenv("DMLC_NUM_WORKER", raising=False)
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+
+    # replace semantics without an updater; multi-value merge
+    kv.init("w", mx.nd.zeros((2, 2)))
+    kv.push("w", [mx.nd.ones((2, 2)), mx.nd.ones((2, 2)) * 2])
+    out = mx.nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)  # add_n merge
+
+    # updater path: server-side-style accumulation
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.create("test", rescale_grad=2.0))
+    kv2.init(7, mx.nd.ones((3,)))
+    kv2.push(7, mx.nd.ones((3,)))
+    val = mx.nd.zeros((3,))
+    kv2.pull(7, out=val)
+    np.testing.assert_allclose(val.asnumpy(), 3.0)  # 1 + 2*1
+
+    # 2-bit compression with error feedback (exact thresholds as the
+    # multi-process tier)
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv3.init("c", mx.nd.zeros((4,)))
+    kv3.push("c", mx.nd.ones((4,)) * 0.3)
+    o = mx.nd.zeros((4,))
+    kv3.pull("c", out=o)
+    np.testing.assert_allclose(o.asnumpy(), 0.0)
+    kv3.push("c", mx.nd.ones((4,)) * 0.3)
+    kv3.pull("c", out=o)
+    np.testing.assert_allclose(o.asnumpy(), 0.5)
+
+    # push before init is a clear error
+    with pytest.raises(MXNetError, match="not initialized"):
+        kv.push("never", mx.nd.ones((1,)))
+
+
+def test_dist_async_facade_in_process(ps_server):
+    kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_async"
+    assert kv.rank == 0 and kv.num_workers == 1
+
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init("w", mx.nd.ones((2, 2)))
+    kv.push("w", mx.nd.ones((2, 2)))        # w -= 0.5 * 1
+    out = mx.nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+    # multi-device merge then push
+    kv.push("w", [mx.nd.ones((2, 2)), mx.nd.ones((2, 2))])  # grad 2
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -0.5)
+
+    # row_sparse_pull: only requested rows come back dense
+    kv.init("emb", mx.nd.array([[1, 1], [2, 2], [3, 3]]))
+    rs_out = mx.nd.zeros((3, 2))
+    kv.row_sparse_pull("emb", out=rs_out,
+                       row_ids=mx.nd.array([0, 2]))
+    np.testing.assert_allclose(rs_out.asnumpy(),
+                               [[1, 1], [0, 0], [3, 3]])
+
+    kv.barrier()
+    kv.stop_servers()
+    kv._client.close()
+
+
+def test_dist_async_set_optimizer_strips_param_dict(ps_server):
+    """The wire blob must not embed live Parameters (their pickling
+    carries full weights); per-param lr/wd multipliers survive as
+    plain dicts."""
+
+    class FakeParam:
+        lr_mult = 0.25
+        wd_mult = 4.0
+
+        def __reduce__(self):  # poison: pickling a live param = bug
+            raise RuntimeError("live Parameter reached the wire")
+
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    opt.param_dict = {5: FakeParam()}
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(opt)   # must not raise through FakeParam
+    kv.init(5, mx.nd.ones((2,)))
+    kv.push(5, mx.nd.ones((2,)))    # server applies lr*lr_mult = 0.025
+    out = mx.nd.zeros((2,))
+    kv.pull(5, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * 0.25, rtol=1e-6)
+    kv.stop_servers()
+    kv._client.close()

@@ -22,15 +22,8 @@ from mxnet_tpu.base import MXNetError
 from mxnet_tpu.kvstore.ps import PSClient, PSServer
 
 
-@pytest.fixture()
-def server(monkeypatch):
-    srv = PSServer(port=0, num_workers=1)
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
-    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
-    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
-    yield srv
-    srv._stop.set()
+# the in-process server fixture lives in conftest.py (ps_server),
+# shared with test_kvstore_facade.py
 
 
 def _optimizer_blob(lr=0.1):
@@ -40,7 +33,7 @@ def _optimizer_blob(lr=0.1):
                         protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def test_push_before_init_is_clear_error(server):
+def test_push_before_init_is_clear_error(ps_server):
     c = PSClient(connect_timeout=10)
     c.set_optimizer(_optimizer_blob())
     with pytest.raises(MXNetError, match="not initialized"):
@@ -48,7 +41,7 @@ def test_push_before_init_is_clear_error(server):
     c.close()
 
 
-def test_push_without_optimizer_is_clear_error(server):
+def test_push_without_optimizer_is_clear_error(ps_server):
     c = PSClient(connect_timeout=10)
     c.init("w", np.ones((2, 2), np.float32))
     with pytest.raises(MXNetError, match="set_optimizer"):
@@ -56,7 +49,7 @@ def test_push_without_optimizer_is_clear_error(server):
     c.close()
 
 
-def test_server_death_mid_session_raises_not_hangs(server):
+def test_server_death_mid_session_raises_not_hangs(ps_server):
     """After the server goes away, the next call must raise (the
     protocol reply read sees the closed stream), not block forever."""
     c = PSClient(connect_timeout=10)
@@ -64,8 +57,8 @@ def test_server_death_mid_session_raises_not_hangs(server):
     c.init("w", np.zeros((2, 2), np.float32))
     c.push("w", np.ones((2, 2), np.float32))  # healthy round first
 
-    server._stop.set()
-    server._sock.close()
+    ps_server._stop.set()
+    ps_server._sock.close()
     # the accept loop notices within its 0.5s poll and closes the live
     # worker connections; drive paced pushes until the stream breaks —
     # must be an exception within bounded time, never a hang
@@ -111,8 +104,8 @@ def test_fresh_client_reconnects_after_restart(monkeypatch):
         srv2._stop.set()
 
 
-def _raw_frame(server, payload, expect_reply):
-    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+def _raw_frame(ps_server, payload, expect_reply):
+    s = socket.create_connection(("127.0.0.1", ps_server.port), timeout=10)
     s.settimeout(10)
     s.sendall(struct.pack(">Q", len(payload)) + payload)
     try:
@@ -123,7 +116,7 @@ def _raw_frame(server, payload, expect_reply):
         s.close()
 
 
-def test_forbidden_global_in_data_message_rejected(server):
+def test_forbidden_global_in_data_message_rejected(ps_server):
     """A pickle referencing os.system must never execute: the restricted
     unpickler kills the decode, the connection drops, and the server
     keeps serving honest clients."""
@@ -131,7 +124,7 @@ def test_forbidden_global_in_data_message_rejected(server):
     # splice a GLOBAL os.system reference: craft directly
     evil = b"\x80\x04\x95\x1a\x00\x00\x00\x00\x00\x00\x00\x8c\x02os\x94" \
            b"\x8c\x06system\x94\x93\x94."
-    reply = _raw_frame(server, evil, expect_reply=False)
+    reply = _raw_frame(ps_server, evil, expect_reply=False)
     assert not reply  # connection closed, nothing leaked
 
     # the server must still be alive for honest clients
@@ -141,12 +134,12 @@ def test_forbidden_global_in_data_message_rejected(server):
     c.close()
 
 
-def test_garbage_and_truncated_frames_do_not_kill_server(server):
+def test_garbage_and_truncated_frames_do_not_kill_server(ps_server):
     for payload in [b"not a pickle at all", b"\x80\x04", b""]:
-        _raw_frame(server, payload, expect_reply=False)
+        _raw_frame(ps_server, payload, expect_reply=False)
     # oversized length header then an abrupt close: the reader sees a
     # short stream and drops the connection
-    s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    s = socket.create_connection(("127.0.0.1", ps_server.port), timeout=10)
     s.sendall(struct.pack(">Q", 1 << 50))
     s.close()
 
@@ -156,7 +149,7 @@ def test_garbage_and_truncated_frames_do_not_kill_server(server):
     c.close()
 
 
-def test_optimizer_blob_rejects_non_optimizer_classes(server):
+def test_optimizer_blob_rejects_non_optimizer_classes(ps_server):
     """The set_optimizer channel admits only Optimizer/LRScheduler
     classes: shipping an arbitrary (even in-framework) class surfaces a
     server-side UnpicklingError at the worker, and no updater is
@@ -173,7 +166,7 @@ def test_optimizer_blob_rejects_non_optimizer_classes(server):
     c.close()
 
 
-def test_unknown_op_is_clear_error(server):
+def test_unknown_op_is_clear_error(ps_server):
     c = PSClient(connect_timeout=10)
     with pytest.raises(MXNetError, match="unknown op"):
         c._call(c._socks[0], ("frobnicate", 1, 2))
