@@ -62,3 +62,29 @@ grads = autograd.grad(loss, [w])
 assert np.allclose(grads[0].asnumpy(), 12.0)
 
 print("autograd tutorial: OK")
+
+# --- higher-order gradients (r5) -----------------------------------------
+# grad(create_graph=True) returns first-order grads that are THEMSELVES
+# differentiable: the tape is replayed as a pure function and the
+# gradient computation is recorded back.  Works for the registry-op
+# subset (elemwise/FC/conv/...); PRNG ops (Dropout) raise with a
+# redirect to hybridize() + jax.grad composition.
+x = mx.nd.array([1.0, 2.0, 3.0])
+x.attach_grad()
+with autograd.record():
+    y = x * x * x                         # x^3
+    (dx,) = autograd.grad(y, [x], create_graph=True)
+    assert np.allclose(dx.asnumpy(), 3 * x.asnumpy() ** 2)
+    dx.backward()                         # d(3x^2)/dx = 6x
+assert np.allclose(x.grad.asnumpy(), 6 * x.asnumpy())
+
+# Third order by chaining grad calls:
+w = mx.nd.array([2.0])
+w.attach_grad()
+with autograd.record():
+    out = w * w * w * w                   # w^4
+    (d1,) = autograd.grad(out, [w], create_graph=True)   # 4w^3
+    (d2,) = autograd.grad(d1, [w], create_graph=True)    # 12w^2
+    (d3,) = autograd.grad(d2, [w])                       # 24w
+assert np.allclose(d3.asnumpy(), [48.0])
+
