@@ -91,7 +91,9 @@ def prior_round_values(batch, layout, chain_depth=DEVICE_CHAIN):
                        key=round_no):
         try:
             with open(path) as f:
-                parsed = json.load(f).get("parsed", {})
+                # failed rounds record "parsed": null (r4's wedged-relay
+                # artifact) — they carry no comparison point
+                parsed = json.load(f).get("parsed") or {}
             value = parsed.get("value")
             # only gate like-for-like: a `bench.py 32` exploration run,
             # an NCHW comparison run, or a record captured on another

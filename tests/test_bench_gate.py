@@ -167,3 +167,23 @@ def test_provision_devices_delegates_without_touching_jax(monkeypatch):
     assert seen["env"]["JAX_PLATFORMS"] == "cpu"
     assert "--xla_force_host_platform_device_count=8" in seen["env"]["XLA_FLAGS"]
     assert seen["env"]["_MXTPU_DRYRUN_REEXEC"] == "1"
+
+
+def test_prior_round_values_skips_failed_round_records(tmp_path,
+                                                       monkeypatch):
+    """A failed round records "parsed": null (r4's wedged-relay
+    artifact); the gate must skip it and fall back to the newest GREEN
+    record instead of crashing."""
+    import json
+
+    bench = _load_bench()
+    green = {"parsed": {"metric": "resnet50_v1 training img/s (bs=128, "
+                        "bf16 compute, NHWC, 1 chip, median of 3)",
+                        "value": 2328.04}}
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(green))
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps({"rc": 1, "parsed": None}))
+    monkeypatch.setattr(bench.glob, "glob", lambda pat: [
+        str(tmp_path / "BENCH_r03.json"), str(tmp_path / "BENCH_r04.json")])
+    got = bench.prior_round_values(128, "NHWC")
+    assert got == ("BENCH_r03.json", 2328.04, None)
