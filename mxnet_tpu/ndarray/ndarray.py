@@ -811,12 +811,40 @@ def _wrap_outputs(result, ctx, out=None):
 def array(source, ctx=None, dtype=None):
     import jax
 
-    if isinstance(source, NDArray):
-        src = source.asnumpy()
-    else:
-        src = _np.asarray(source)
+    if isinstance(source, (NDArray, jax.Array)):
+        # device-backed sources stay on device: a host roundtrip here
+        # (asnumpy + re-upload) would block eager dispatch — this is
+        # the hot path for `nd +/* raw-jax-array` arithmetic (mxlint:
+        # trace-host-sync caught the old copy).  Typed sources keep
+        # their dtype (f64 narrows: fp32-native framework).
+        src = source._data if isinstance(source, NDArray) else source
+        if dtype is not None:
+            d = np_dtype(dtype)
+        elif src.dtype == _np.float64:
+            d = _np.float32  # framework is fp32-native
+        else:
+            d = src.dtype
+        ctx = ctx or current_context()
+        dev = ctx.jax_device  # outside the try: a bad ctx must raise
+        try:
+            same_device = dev in src.devices()
+        except Exception:  # tracer / abstract value: no device yet
+            same_device = None
+        if src.dtype != d:
+            src = src.astype(d)  # fresh buffer, already a snapshot
+        elif same_device:
+            # nd.array is documented as a snapshot — a same-device
+            # device_put would alias the source buffer, and a later
+            # donated jit step (parallel/gluon_step.py) would delete
+            # it out from under the snapshot.  The cross-device
+            # transfer below already yields an independent buffer.
+            src = _jnp().array(src, copy=True)
+        if same_device is False:
+            src = jax.device_put(src, dev)
+        return NDArray(src, ctx)
+    src = _np.asarray(source)
     if dtype is None:
-        if isinstance(source, (NDArray, _np.ndarray)):
+        if isinstance(source, _np.ndarray):
             # typed sources keep their dtype (float64 narrows: the
             # framework is fp32-native, reference does the same)
             dtype = src.dtype if src.dtype != _np.float64 else _np.float32

@@ -86,9 +86,10 @@ def _hashable(v):
     if isinstance(v, dict):
         return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
     if isinstance(v, _np.ndarray):
-        return tuple(v.ravel().tolist()) if v.size < 64 else v.tobytes()
+        # host numpy by the isinstance guard — never a device value
+        return tuple(v.ravel().tolist()) if v.size < 64 else v.tobytes()  # mxlint: disable=trace-host-sync
     if isinstance(v, _np.generic):
-        return v.item()
+        return v.item()  # mxlint: disable=trace-host-sync -- np scalar, host-side
     return v
 
 

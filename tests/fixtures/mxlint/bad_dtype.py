@@ -1,0 +1,28 @@
+"""Known-bad dtype-default fixture.
+
+Expected dtype-default findings: exactly 4
+  1. np.float64 literal
+  2. dtype="float64" string
+  3. np.zeros() without dtype (float64 on host)
+  4. np.arange() without dtype
+"""
+
+import numpy as np
+
+
+def accumulate(x):
+    """Upcasts everything it touches to f64."""
+    acc = np.float64(0.0)
+    return x + acc
+
+
+def make_table(n):
+    """dtype='float64' requested explicitly."""
+    return np.full((n,), 1.0, dtype="float64")
+
+
+def make_buffers(n):
+    """dtype-less creation: numpy defaults to float64."""
+    buf = np.zeros((n,))
+    idx = np.arange(n)
+    return buf, idx

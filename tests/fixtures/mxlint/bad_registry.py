@@ -1,0 +1,34 @@
+"""Known-bad registry-consistency fixture (self-contained registry).
+
+Expected registry-consistency findings: exactly 4
+  1. OP_INPUT_NAMES key 'Ghost' names no registered op
+  2. OP_AUX_INPUTS key 'Phantom' missing from OP_INPUT_NAMES
+  3. OP_AUX_INPUTS['Norm'] names input 'running_max' not in
+     OP_INPUT_NAMES['Norm']
+  4. registered op 'undocumented' has no docstring
+"""
+
+from mxnet_tpu.ops.registry import register  # noqa: F401  (fixture only)
+
+OP_INPUT_NAMES = {
+    "Norm": ("data", "gamma"),
+    "Ghost": ("data",),
+}
+
+OP_AUX_INPUTS = {
+    "Norm": ("running_max",),
+    "Phantom": ("state",),
+}
+
+OP_LABEL_INPUTS = {"Norm"}
+
+
+@register("Norm")
+def norm(data, gamma, eps=1e-5):
+    """A documented op, so only its tables are at fault."""
+    return data * gamma
+
+
+@register("undocumented")
+def undocumented(data):
+    return data

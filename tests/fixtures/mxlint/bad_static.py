@@ -1,0 +1,40 @@
+"""Known-bad static-argnames fixture.
+
+Expected static-argnames findings: exactly 4
+  1. static_argnames names a parameter that does not exist
+  2. static arg with a list-literal default (unhashable)
+  3. static arg with an np.array default (hashes by id -> recompiles)
+  4. non-literal static_argnames (unverifiable cache key)
+"""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("kernel", "strife"))
+def misnamed(x, kernel=(3, 3), stride=(1, 1)):
+    """'strife' is a typo: jit silently never treats it as static."""
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("pads",))
+def unhashable_default(x, pads=[0, 0]):
+    """list default: jit raises TypeError the first time pads defaults."""
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("table",))
+def array_default(x, table=np.array([1, 2])):
+    """ndarray static arg: cache key is id() -> recompile storm."""
+    return x
+
+
+_NAMES = ("kernel",)
+
+
+@functools.partial(jax.jit, static_argnames=_NAMES)
+def dynamic_names(x, kernel=(3, 3)):
+    """non-literal static_argnames: mxlint cannot prove hygiene."""
+    return x

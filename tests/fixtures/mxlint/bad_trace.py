@@ -1,0 +1,54 @@
+"""Known-bad trace-safety fixture (linted as a fake ops/ file).
+
+Expected trace-host-sync findings: exactly 7
+  1. .item() in compute code
+  2. .tolist() in compute code
+  3. .asnumpy() in compute code
+  4. .block_until_ready() outside a sync point
+  5. jax.device_get()
+  6. float() on a tensor-typed name (registered-op positional input)
+  7. np.asarray() on a value derived from a tensor input
+The pragma line and the whitelisted wait_to_read() must NOT fire.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.ops.registry import register  # noqa: F401  (fixture only)
+
+
+def peek_scalar(x):
+    return x.item()            # finding 1
+
+
+def peek_list(x):
+    return x.tolist()          # finding 2
+
+
+def peek_host(x):
+    return x.asnumpy()         # finding 3
+
+
+def hard_sync(x):
+    x.block_until_ready()      # finding 4
+    return jax.device_get(x)   # finding 5
+
+
+@register("_mxlint_fixture_bad", num_outputs=1)
+def bad_op(data, scale=1.0):
+    """Registered op: `data` is a tensor input, `scale` is an attr."""
+    peak = float(data)         # finding 6: host sync + breaks tracing
+    y = jnp.exp(data) * scale
+    host = np.asarray(y)       # finding 7: y is derived from data
+    return host + peak
+
+
+def suppressed(x):
+    return x.item()  # mxlint: disable=trace-host-sync -- fixture pragma
+
+
+def wait_to_read(x):
+    # whitelisted sync point: blocking here is the contract
+    x.block_until_ready()
+    return x.asnumpy().item()

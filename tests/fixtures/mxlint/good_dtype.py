@@ -1,0 +1,19 @@
+"""Known-good dtype-default fixture.
+
+Expected dtype-default findings: 0.
+"""
+
+import numpy as np
+
+
+def make_buffers(n):
+    """Every creation pins a TPU-friendly dtype."""
+    buf = np.zeros((n,), dtype=np.float32)
+    idx = np.arange(n, dtype=np.int32)
+    ones = np.ones((n,), dtype="float32")
+    return buf, idx, ones
+
+
+def preserve(x):
+    """asarray/array preserve the input dtype — exempt from the rule."""
+    return np.asarray(x)

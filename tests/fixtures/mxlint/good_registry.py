@@ -1,0 +1,32 @@
+"""Known-good registry-consistency fixture.
+
+Expected registry-consistency findings: 0.
+"""
+
+from mxnet_tpu.ops.registry import alias, register  # noqa: F401
+
+OP_INPUT_NAMES = {
+    "Norm": ("data", "gamma", "running_max"),
+    "Scale": ("data",),
+}
+
+OP_AUX_INPUTS = {
+    "Norm": ("running_max",),
+}
+
+OP_LABEL_INPUTS = {"Norm"}
+
+
+@register("Norm", aliases=("norm_v2",))
+def norm(data, gamma, running_max, eps=1e-5):
+    """Documented, registered, and its table entries agree."""
+    return data * gamma
+
+
+@register("scale_impl")
+def scale_impl(data, factor=1.0):
+    """Documented; 'Scale' reaches it through alias() below."""
+    return data * factor
+
+
+alias("Scale", "scale_impl")

@@ -1,0 +1,25 @@
+"""Known-good static-argnames fixture.
+
+Expected static-argnames findings: 0.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kernel", "stride", "interpret"))
+def tiled_kernel(x, kernel=(3, 3), stride=(1, 1), interpret=False):
+    """tuple/bool statics: hashable by construction."""
+    return x
+
+
+def staged(fn):
+    """jit(fn, ...) call form with a resolvable module-level target."""
+    return jax.jit(pool2d, static_argnames=("mode",))
+
+
+def pool2d(x, mode="max"):
+    """str static: hashable by construction."""
+    return x

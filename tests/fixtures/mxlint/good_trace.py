@@ -1,0 +1,22 @@
+"""Known-good trace-safety fixture: everything stays on device.
+
+Expected trace-host-sync findings: 0.
+"""
+
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import register  # noqa: F401  (fixture only)
+
+
+@register("_mxlint_fixture_good", num_outputs=1)
+def good_op(data, scale=1.0):
+    """Pure jax math: casts via jnp, attrs used as python scalars."""
+    s = float(scale)               # attr (defaulted param) — not a tensor
+    y = jnp.exp(data) * s
+    return y.astype(jnp.float32)   # on-device cast, no sync
+
+
+def shape_math(data, axis=0):
+    """Shape/static attrs are host ints by construction — fine."""
+    n = int(data.shape[axis] if hasattr(data, "shape") else axis)
+    return jnp.zeros((n,), dtype=jnp.float32)

@@ -1,0 +1,88 @@
+"""Tier-1 gate: mxnet_tpu/ must be mxlint-clean against the baseline.
+
+Runs mxlint in-process (no subprocess, no new CI infra) so the gate
+rides the existing tier-1 pytest command.  Pre-existing findings are
+grandfathered in tools/mxlint/baseline.json; anything NEW fails here
+with the exact finding list.  To intentionally accept a finding, run
+
+    python -m tools.mxlint mxnet_tpu/ --update-baseline
+
+and justify the baseline diff in review (see docs/LINTING.md).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxlint import (DEFAULT_BASELINE, apply_baseline,  # noqa: E402
+                          lint_paths, load_baseline)
+from tools.mxlint.findings import load_registry_grandfather  # noqa: E402
+from tools.mxlint.registry_audit import audit_registry  # noqa: E402
+
+
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=None)
+def _run_lint():
+    """One full-tree lint shared by every gate test in this module."""
+    findings, errors = lint_paths([os.path.join(REPO, "mxnet_tpu")],
+                                  base=REPO)
+    assert errors == [], "mxlint could not parse the tree:\n%s" \
+        % "\n".join(errors)
+    return apply_baseline(findings, load_baseline(DEFAULT_BASELINE))
+
+
+@functools.lru_cache(maxsize=None)
+def _audit(eval_shapes):
+    return audit_registry(eval_shapes=eval_shapes)
+
+
+def test_mxlint_zero_new_findings():
+    """No non-baselined static findings anywhere under mxnet_tpu/."""
+    result = _run_lint()
+    assert result.new == [], (
+        "mxlint found NEW violations (fix them, or — only for "
+        "deliberate exceptions — add a `# mxlint: disable=<rule>` "
+        "pragma or update the baseline):\n"
+        + "\n".join(f.format() for f in result.new))
+
+
+def test_mxlint_baseline_not_stale():
+    """Fixed findings must leave the baseline (run --update-baseline)."""
+    result = _run_lint()
+    assert result.stale == [], (
+        "stale baseline entries (the flagged code was fixed/moved; run "
+        "`python -m tools.mxlint mxnet_tpu/ --update-baseline`):\n"
+        + "\n".join("%s %s %r" % (e.get("rule"), e.get("path"),
+                                  e.get("code_line"))
+                    for e in result.stale))
+
+
+def test_registry_audit_tables_consistent():
+    """Runtime tables (incl. dynamically-added entries) match the
+    registry: every key registered, aux/label subsets hold."""
+    res = _audit(False)
+    assert res.table_errors == [], "\n".join(res.table_errors)
+
+
+def test_registry_audit_ops_trace_under_eval_shape():
+    """Every OP_INPUT_NAMES op traces on its canonical spec — zero-cost
+    proof the op stays inside the jax-traceable subset."""
+    res = _audit(True)
+    assert res.shape_errors == [], "\n".join(res.shape_errors)
+
+
+def test_registry_audit_no_new_docless_ops():
+    """Newly registered ops must carry docstrings; the pre-existing
+    doc-less ones are grandfathered in the baseline's registry section."""
+    res = _audit(False)
+    allowed = load_registry_grandfather(DEFAULT_BASELINE)
+    docless = {name for name, _fn in res.missing_docstrings}
+    new = sorted(docless - allowed)
+    assert new == [], (
+        "newly registered ops without docstrings: %s (document them; "
+        "only pre-existing ops are grandfathered)" % ", ".join(new))

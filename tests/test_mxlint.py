@@ -1,0 +1,478 @@
+"""mxlint's own tests: each rule fires on its known-bad fixture with an
+exact count, stays silent on the known-good one, and the baseline /
+pragma mechanisms suppress and expire correctly.
+
+The fixtures live in tests/fixtures/mxlint/ and are linted under
+synthetic mxnet_tpu/ paths so the default rule scoping applies.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.mxlint import (ALL_RULES, Config, apply_baseline,  # noqa: E402
+                          fingerprint, lint_sources, load_baseline,
+                          save_baseline)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "mxlint")
+
+
+def _fixture_src(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return f.read()
+
+
+def _lint_fixture(name, rule, as_path="mxnet_tpu/ops/fixture.py"):
+    findings, errors = lint_sources({as_path: _fixture_src(name)},
+                                    Config(rules=(rule,)))
+    assert not errors
+    return findings
+
+
+# ------------------------------------------------------------ per rule
+
+BAD_GOOD = [
+    ("trace-host-sync", "bad_trace.py", 7, "good_trace.py"),
+    ("static-argnames", "bad_static.py", 4, "good_static.py"),
+    ("registry-consistency", "bad_registry.py", 4, "good_registry.py"),
+    ("dtype-default", "bad_dtype.py", 4, "good_dtype.py"),
+]
+
+
+def test_every_rule_has_fixtures():
+    assert {r for r, _, _, _ in BAD_GOOD} == set(ALL_RULES)
+
+
+@pytest.mark.parametrize("rule,bad,count,good", BAD_GOOD,
+                         ids=[r for r, _, _, _ in BAD_GOOD])
+def test_rule_fires_exactly_on_bad_fixture(rule, bad, count, good):
+    findings = _lint_fixture(bad, rule)
+    assert len(findings) == count, "\n".join(f.format() for f in findings)
+    assert all(f.rule == rule for f in findings)
+    assert _lint_fixture(good, rule) == []
+
+
+def test_trace_rule_details():
+    findings = _lint_fixture("bad_trace.py", "trace-host-sync")
+    msgs = "\n".join(f.format() for f in findings)
+    # one finding per documented pattern
+    for needle in (".item()", ".tolist()", ".asnumpy()",
+                   ".block_until_ready()", "device_get", "float()",
+                   "np.asarray"):
+        assert needle in msgs, "missing %r in:\n%s" % (needle, msgs)
+    # the pragma'd line and the whitelisted wait_to_read stayed silent
+    symbols = {f.symbol for f in findings}
+    assert "suppressed" not in symbols
+    assert "wait_to_read" not in symbols
+
+
+def test_trace_rule_scoped_to_compute_paths():
+    """The same bad source outside the compute path is not trace-linted."""
+    src = _fixture_src("bad_trace.py")
+    findings, _ = lint_sources({"mxnet_tpu/metric.py": src},
+                               Config(rules=("trace-host-sync",)))
+    assert findings == []
+
+
+def test_dtype_rule_scoped_to_ops():
+    src = _fixture_src("bad_dtype.py")
+    findings, _ = lint_sources({"mxnet_tpu/executor.py": src},
+                               Config(rules=("dtype-default",)))
+    assert findings == []
+
+
+def test_registry_cross_file():
+    """Registration in one file satisfies a table key in another."""
+    table_src = ("OP_INPUT_NAMES = {'Remote': ('data',)}\n"
+                 "OP_AUX_INPUTS = {}\n")
+    op_src = ("from mxnet_tpu.ops.registry import register\n\n\n"
+              "@register('Remote')\n"
+              "def remote(data):\n"
+              "    \"\"\"doc\"\"\"\n"
+              "    return data\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/ops/registry.py": table_src,
+         "mxnet_tpu/ops/other.py": op_src},
+        Config(rules=("registry-consistency",)))
+    assert findings == []
+
+
+# ------------------------------------------------------------ pragmas
+
+
+def test_pragma_disables_single_rule():
+    src = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))  # mxlint: disable=dtype-default\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("dtype-default",)))
+    assert findings == []
+
+
+def test_pragma_other_rule_does_not_disable():
+    src = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))  # mxlint: disable=trace-host-sync\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("dtype-default",)))
+    assert len(findings) == 1
+
+
+def test_pragma_bare_disable_allows_reason_suffix():
+    src = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))  # mxlint: disable -- host table\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("dtype-default",)))
+    assert findings == []
+
+
+def test_pragma_unknown_spelling_is_not_disable_all():
+    """pylint-style 'disable-next-line=' (or a typo) must not silently
+    suppress every rule on the line."""
+    src = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))"
+           "  # mxlint: disable-next-line=dtype-default\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("dtype-default",)))
+    assert len(findings) == 1
+
+
+def test_duplicate_key_within_one_table_literal_flagged():
+    src = ("OP_INPUT_NAMES = {'dot': ('a', 'b'), 'dot': ('x',)}\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/registry.py": src},
+                               Config(rules=("registry-consistency",)))
+    assert len(findings) == 1
+    assert "appears twice" in findings[0].message
+
+
+# ----------------------------------------------------------- baseline
+
+
+def _bad_dtype_findings(path="mxnet_tpu/ops/fixture.py"):
+    return _lint_fixture("bad_dtype.py", "dtype-default", as_path=path)
+
+
+def test_baseline_suppresses_grandfathered(tmp_path):
+    findings = _bad_dtype_findings()
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(bl_path, findings)
+    result = apply_baseline(findings, load_baseline(bl_path))
+    assert result.new == []
+    assert len(result.suppressed) == len(findings)
+    assert result.stale == []
+
+
+def test_baseline_reports_new_findings(tmp_path):
+    findings = _bad_dtype_findings()
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(bl_path, findings[:-1])  # one finding not grandfathered
+    result = apply_baseline(findings, load_baseline(bl_path))
+    assert len(result.new) == 1
+    assert fingerprint(result.new[0]) == fingerprint(findings[-1])
+
+
+def test_baseline_expires_when_code_fixed(tmp_path):
+    bad = _bad_dtype_findings()
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(bl_path, bad)
+    good = _lint_fixture("good_dtype.py", "dtype-default")
+    result = apply_baseline(good, load_baseline(bl_path))
+    assert result.new == [] and result.suppressed == []
+    # every grandfathered entry is now stale -> reported for removal
+    assert len(result.stale) == len(load_baseline(bl_path))
+
+
+def test_baseline_counts_duplicate_violations(tmp_path):
+    """Copy-pasting a baselined violation is still a new finding."""
+    src = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))\n")
+    cfg = Config(rules=("dtype-default",))
+    one, _ = lint_sources({"mxnet_tpu/ops/x.py": src}, cfg)
+    assert len(one) == 1
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(bl_path, one)
+    dup = ("import numpy as np\n"
+           "def f(n):\n"
+           "    return np.zeros((n,))\n"
+           "def g(n):\n"
+           "    return np.zeros((n,))\n")
+    two, _ = lint_sources({"mxnet_tpu/ops/x.py": dup}, cfg)
+    assert len(two) == 2
+    result = apply_baseline(two, load_baseline(bl_path))
+    # same function name + same code line -> same fingerprint, but the
+    # count budget (1) absorbs only one of them... unless the enclosing
+    # symbol differs (f vs g), which keeps fingerprints distinct
+    assert len(result.new) == 1
+    assert len(result.suppressed) == 1
+
+
+def test_baseline_partial_fix_goes_stale(tmp_path):
+    """A count-2 entry with one occurrence fixed is stale until the
+    baseline is regenerated — counts only ever shrink."""
+    two_src = ("import numpy as np\n"
+               "def f(n):\n"
+               "    a = np.zeros((n,))\n"
+               "    b = np.zeros((n,))\n"
+               "    return a, b\n")
+    one_src = ("import numpy as np\n"
+               "def f(n):\n"
+               "    a = np.zeros((n,))\n"
+               "    return a\n")
+    cfg = Config(rules=("dtype-default",))
+    two, _ = lint_sources({"mxnet_tpu/ops/x.py": two_src}, cfg)
+    assert len(two) == 2
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(bl_path, two)
+    one, _ = lint_sources({"mxnet_tpu/ops/x.py": one_src}, cfg)
+    result = apply_baseline(one, load_baseline(bl_path))
+    assert result.new == [] and len(result.suppressed) == 1
+    assert len(result.stale) == 1
+    assert result.stale[0]["unmatched"] == 1
+
+
+def test_tables_merged_across_files():
+    """Tables split across registry files are still cross-checked."""
+    a = "OP_INPUT_NAMES = {'Norm': ('data',)}\n"
+    b = "OP_AUX_INPUTS = {'Phantom': ('state',)}\n"
+    op = ("from mxnet_tpu.ops.registry import register\n\n\n"
+          "@register('Norm')\n"
+          "def norm(data):\n"
+          "    \"\"\"doc\"\"\"\n"
+          "    return data\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/ops/registry.py": a, "mxnet_tpu/ops/extra.py": b,
+         "mxnet_tpu/ops/impl.py": op},
+        Config(rules=("registry-consistency",)))
+    assert len(findings) == 1
+    assert "Phantom" in findings[0].message
+
+
+def test_duplicate_table_key_across_files_flagged():
+    a = ("OP_INPUT_NAMES = {'Norm': ('data',)}\n")
+    b = ("OP_INPUT_NAMES = {'Norm': ('data', 'gamma')}\n")
+    op = ("from mxnet_tpu.ops.registry import register\n\n\n"
+          "@register('Norm')\n"
+          "def norm(data):\n"
+          "    \"\"\"doc\"\"\"\n"
+          "    return data\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/ops/registry.py": a, "mxnet_tpu/ops/extra.py": b,
+         "mxnet_tpu/ops/impl.py": op},
+        Config(rules=("registry-consistency",)))
+    assert len(findings) == 1
+    assert "more than one file" in findings[0].message
+
+
+def test_nonexistent_path_is_an_error(capsys):
+    from tools.mxlint import lint_paths as lp
+    from tools.mxlint import main
+
+    _findings, errors = lp(["no/such/dir"])
+    assert errors and "does not exist" in errors[0]
+    assert main(["no/such/dir", "--no-baseline"]) == 2
+
+
+def test_non_python_file_is_an_error():
+    from tools.mxlint import lint_paths as lp
+
+    _findings, errors = lp([os.path.join(REPO, "docs", "LINTING.md")])
+    assert errors and "not a python file" in errors[0]
+
+
+def test_table_internal_checks_run_without_register_sites():
+    """A tables-only file (like ops/registry.py) still gets duplicate/
+    subset checks even when no @register site is in scope."""
+    src = ("OP_INPUT_NAMES = {'Foo': ('data',)}\n"
+           "OP_AUX_INPUTS = {'Foo': ('gamma',)}\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/registry.py": src},
+                               Config(rules=("registry-consistency",)))
+    assert len(findings) == 1
+    assert "gamma" in findings[0].message
+
+
+def test_partial_scope_skips_unregistered_key_check():
+    """Linting registry.py without its siblings must not flag table
+    keys whose @register sites live in the unlinted files."""
+    from tools.mxlint import lint_paths as lp
+
+    findings, errors = lp(
+        [os.path.join(REPO, "mxnet_tpu", "ops", "registry.py")],
+        base=REPO)
+    assert errors == []
+    assert not any("does not name a registered op" in f.message
+                   for f in findings)
+
+
+def test_fingerprint_survives_line_drift():
+    src = _fixture_src("bad_dtype.py")
+    shifted = "# padding\n# padding\n\n" + src
+    cfg = Config(rules=("dtype-default",))
+    a, _ = lint_sources({"mxnet_tpu/ops/x.py": src}, cfg)
+    b, _ = lint_sources({"mxnet_tpu/ops/x.py": shifted}, cfg)
+    assert [fingerprint(f) for f in a] == [fingerprint(f) for f in b]
+    assert [f.line for f in a] != [f.line for f in b]
+
+
+def test_baseline_roundtrip_preserves_registry_section(tmp_path):
+    from tools.mxlint.findings import (load_registry_grandfather,
+                                       save_registry_grandfather)
+
+    bl_path = str(tmp_path / "baseline.json")
+    save_registry_grandfather(bl_path, ["op_a", "op_b"])
+    save_baseline(bl_path, _bad_dtype_findings())
+    assert load_registry_grandfather(bl_path) == {"op_a", "op_b"}
+    with open(bl_path) as f:
+        data = json.load(f)
+    assert data["findings"]
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_bad_file_exits_nonzero(tmp_path, capsys):
+    """CLI flags findings in a compute-path-shaped tree and exits 1."""
+    import shutil
+
+    from tools.mxlint import main
+
+    ops_dir = tmp_path / "mxnet_tpu" / "ops"
+    ops_dir.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_dtype.py"),
+                str(ops_dir / "bad.py"))
+    rc = main([str(tmp_path / "mxnet_tpu"), "--no-baseline",
+               "--rules", "dtype-default"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "4 new finding(s)" in out
+
+
+def test_cli_repo_gate_is_clean(capsys):
+    """`python -m tools.mxlint mxnet_tpu/` exits 0 against the baseline."""
+    from tools.mxlint import main
+
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = main(["mxnet_tpu"])
+    finally:
+        os.chdir(old)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 new finding(s)" in out
+
+
+def test_cli_gate_is_cwd_independent(tmp_path, capsys):
+    """Fingerprints anchor to the repo root, not the invoking cwd."""
+    from tools.mxlint import main
+
+    old = os.getcwd()
+    os.chdir(str(tmp_path))
+    try:
+        rc = main([os.path.join(REPO, "mxnet_tpu")])
+    finally:
+        os.chdir(old)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 new finding(s)" in out and "0 stale" in out
+
+
+def test_cli_partial_scope_reports_no_bogus_stale(capsys):
+    """Linting one file must not flag the rest of the baseline stale."""
+    from tools.mxlint import main
+
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = main(["mxnet_tpu/ops/elemwise.py"])
+    finally:
+        os.chdir(old)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 stale" in out
+
+
+def test_partial_update_baseline_keeps_out_of_scope(tmp_path, capsys):
+    """--update-baseline on a sub-path preserves other files' entries."""
+    import shutil
+
+    from tools.mxlint import main
+
+    ops = tmp_path / "mxnet_tpu" / "ops"
+    ops.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_dtype.py"), str(ops / "a.py"))
+    shutil.copy(os.path.join(FIXTURES, "bad_dtype.py"), str(ops / "b.py"))
+    bl = str(tmp_path / "bl.json")
+    assert main([str(ops), "--baseline", bl, "--rules", "dtype-default",
+                 "--update-baseline"]) == 0
+    # "fix" a.py, then partially update only a.py: b.py entries survive
+    (ops / "a.py").write_text("x = 1\n")
+    assert main([str(ops / "a.py"), "--baseline", bl, "--rules",
+                 "dtype-default", "--update-baseline"]) == 0
+    entries = load_baseline(bl)
+    paths = {e["path"] for e in entries.values()}
+    assert any(p.endswith("ops/b.py") for p in paths)
+    assert not any(p.endswith("ops/a.py") for p in paths)
+    capsys.readouterr()
+
+
+def test_cli_unknown_rule_usage_error(capsys):
+    from tools.mxlint import main
+
+    assert main(["--rules", "no-such-rule"]) == 2
+
+
+# ------------------------------------------------------ runtime audit
+
+
+def test_registry_audit_clean():
+    from tools.mxlint.registry_audit import audit_registry
+
+    res = audit_registry(eval_shapes=False)
+    assert res.table_errors == []
+
+
+def test_registry_audit_detects_injected_drift():
+    from mxnet_tpu.ops import registry as R
+    from tools.mxlint.registry_audit import audit_registry
+
+    R.OP_INPUT_NAMES["_mxlint_ghost_op"] = ("data",)
+    try:
+        res = audit_registry(eval_shapes=False)
+        assert any("_mxlint_ghost_op" in e for e in res.table_errors)
+    finally:
+        del R.OP_INPUT_NAMES["_mxlint_ghost_op"]
+
+
+def test_registry_audit_detects_aux_drift():
+    from mxnet_tpu.ops import registry as R
+    from tools.mxlint.registry_audit import audit_registry
+
+    R.OP_AUX_INPUTS["BatchNorm"] = R.OP_AUX_INPUTS["BatchNorm"] + \
+        ("not_an_input",)
+    try:
+        res = audit_registry(eval_shapes=False)
+        assert any("not_an_input" in e for e in res.table_errors)
+    finally:
+        R.OP_AUX_INPUTS["BatchNorm"] = \
+            R.OP_AUX_INPUTS["BatchNorm"][:-1]
+
+
+def test_canonical_specs_cover_input_table():
+    """Every table op has an eval_shape spec with matching arity."""
+    from mxnet_tpu.ops import registry as R
+    from tools.mxlint.registry_audit import canonical_spec
+
+    for name, input_names in R.OP_INPUT_NAMES.items():
+        spec = canonical_spec(name)
+        assert spec is not None, "no canonical spec for %r" % name
+        input_specs, _attrs = spec
+        assert len(input_specs) == len(input_names), name

@@ -171,3 +171,40 @@ def test_executor_wraps_device_runtime_errors():
     exe._get_fns = lambda is_train: (boom_fwd, None, None)
     with pytest.raises(MXNetError, match="executor forward: device exploded"):
         exe.forward(is_train=True)
+
+
+def test_nd_array_device_source_is_independent_snapshot():
+    """nd.array() on device-backed sources (NDArray / raw jax.Array)
+    stays on device (no host roundtrip) but still snapshots: the
+    result must not alias the source buffer, or a donated jit step
+    (parallel/gluon_step.py) could delete it out from under the
+    snapshot."""
+    import jax.numpy as jnp
+
+    def buf(x):
+        # object identity is not enough: device_put returns a distinct
+        # jax.Array that can share the underlying buffer
+        return x.unsafe_buffer_pointer()
+
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    snap = mx.nd.array(a)
+    assert buf(snap._data) != buf(a._data)
+    a[:] = 7.0
+    np.testing.assert_allclose(snap.asnumpy(), [[1, 2], [3, 4]])
+
+    raw = jnp.arange(4.0)
+    snap2 = mx.nd.array(raw)
+    assert buf(snap2._data) != buf(raw)
+    np.testing.assert_allclose(snap2.asnumpy(), [0, 1, 2, 3])
+
+
+def test_nd_array_device_source_keeps_dtype():
+    """Typed device sources keep their dtype (int stays int, f64
+    narrows to f32) — same contract as numpy sources."""
+    import jax.numpy as jnp
+
+    assert mx.nd.array(jnp.arange(3)).dtype == np.int32
+    assert mx.nd.array(jnp.ones((2,), jnp.bfloat16)).dtype.name == "bfloat16"
+    assert mx.nd.array(jnp.arange(3), dtype="float32").dtype == np.float32
+    src = mx.nd.array(np.arange(3, dtype=np.int64))
+    assert mx.nd.array(src).dtype == src.dtype

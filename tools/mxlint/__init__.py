@@ -1,0 +1,33 @@
+"""mxlint — trace-safety and op-registry static analyzer for mxnet_tpu.
+
+The framework's whole performance premise is that every op is a pure
+jax function whose eager path hits a cached ``jax.jit`` executable.
+One accidental host sync (``.item()``, ``float()`` on a traced value,
+``np.asarray`` on a jax array) or one unhashable value leaking into
+``static_argnames`` silently turns the async dependency-engine analog
+into a blocking, recompile-storming slow path.  mxlint proves the op
+compute paths stay inside the traceable subset — statically (AST
+rules) plus a runtime registry audit (``registry_audit.py``).
+
+Usage::
+
+    python -m tools.mxlint mxnet_tpu/          # gate against baseline
+    python -m tools.mxlint --update-baseline   # re-grandfather
+    python -m tools.mxlint --no-baseline       # full report
+
+In-process (how tests/test_lint_clean.py rides tier-1)::
+
+    from tools.mxlint import lint_paths, load_baseline, apply_baseline
+    findings, errors = lint_paths(["mxnet_tpu"])
+
+See docs/LINTING.md for the rule catalogue.
+"""
+
+from .checkers import ALL_RULES, Config, lint_paths, lint_sources  # noqa: F401
+from .findings import (Finding, apply_baseline, fingerprint,  # noqa: F401
+                       load_baseline, save_baseline)
+from .cli import DEFAULT_BASELINE, main  # noqa: F401
+
+__all__ = ["ALL_RULES", "Config", "lint_paths", "lint_sources", "Finding",
+           "apply_baseline", "fingerprint", "load_baseline",
+           "save_baseline", "DEFAULT_BASELINE", "main"]

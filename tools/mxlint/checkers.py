@@ -1,0 +1,789 @@
+"""AST checkers: the four mxlint rules.
+
+Rules
+-----
+trace-host-sync
+    Implicit device->host syncs in op compute paths: ``.item()`` /
+    ``.tolist()`` / ``.asnumpy()`` / ``.block_until_ready()`` calls,
+    ``jax.device_get``, ``float()/int()/bool()`` applied to
+    tensor-typed names, and ``np.asarray``/``np.array`` on jax values.
+    Allowed inside the explicit sync points (``wait_to_read``,
+    ``asnumpy``, ``__bool__``, ...) whose whole purpose is to sync.
+
+static-argnames
+    ``jax.jit(..., static_argnames=...)`` hygiene: every name must be a
+    real parameter of the jitted function and must be
+    hashable-by-construction (no list/dict/set/ndarray defaults) — an
+    unhashable static arg raises at call time, and an array-valued one
+    recompiles per step.
+
+registry-consistency
+    The hand-maintained tables in ops/registry.py (OP_INPUT_NAMES,
+    OP_AUX_INPUTS, OP_LABEL_INPUTS) must agree with the ops actually
+    registered via ``@register(...)``/``alias(...)``, and every
+    registered op function must carry a docstring.
+
+dtype-default
+    Bare ``np.float64`` (or dtype="float64") and dtype-less numpy
+    array creation (``np.zeros`` & friends default to float64) in op
+    code — silently upcasts, then XLA truncates on TPU.
+
+Suppression: a ``# mxlint: disable`` or ``# mxlint: disable=rule[,rule]``
+comment on the finding's line silences it at the source; the baseline
+file (findings.py) grandfathers whole findings instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+
+from .findings import Finding
+
+__all__ = ["Config", "lint_paths", "lint_sources", "ALL_RULES"]
+
+ALL_RULES = ("trace-host-sync", "static-argnames", "registry-consistency",
+             "dtype-default")
+
+# functions whose contract IS the device->host sync (reference parity:
+# WaitToRead/asnumpy are the documented engine sync points)
+SYNC_WHITELIST = frozenset({
+    "asnumpy", "asscalar", "item", "tolist", "wait_to_read",
+    "wait_to_write", "waitall", "save", "debug_str",
+    "__bool__", "__repr__", "__str__", "__array__", "__float__",
+    "__int__", "__index__", "__len__", "__format__",
+})
+
+# numpy creation routines whose dtype defaults to float64
+_NP_F64_CREATORS = frozenset({
+    "zeros", "ones", "empty", "full", "arange", "linspace", "logspace",
+    "eye", "identity", "geomspace",
+})
+
+_REGISTRY_TABLES = ("OP_INPUT_NAMES", "OP_AUX_INPUTS", "OP_LABEL_INPUTS")
+
+
+class _Loc:
+    """Bare line anchor for findings not tied to one AST node."""
+
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0
+
+
+class Config:
+    """What to lint and where each rule applies."""
+
+    def __init__(self, rules=ALL_RULES, compute_path_globs=None,
+                 ops_globs=None, registry_globs=None,
+                 sync_whitelist=SYNC_WHITELIST):
+        self.rules = tuple(rules)
+        # trace-host-sync scope: the op compute paths
+        self.compute_path_globs = tuple(compute_path_globs or (
+            "*mxnet_tpu/ops/*.py",
+            "*mxnet_tpu/ndarray/ndarray.py",
+            "*mxnet_tpu/executor.py",
+            "*mxnet_tpu/autograd.py",
+        ))
+        # dtype-default scope: op kernel code
+        self.ops_globs = tuple(ops_globs or ("*mxnet_tpu/ops/*.py",))
+        # files whose registry tables / @register sites feed the
+        # registry-consistency cross-check
+        self.registry_globs = tuple(registry_globs or
+                                    ("*mxnet_tpu/ops/*.py",))
+        self.sync_whitelist = frozenset(sync_whitelist)
+        # the table-key-vs-registered-op cross-check needs the WHOLE op
+        # package in scope to be sound; lint_paths turns this off for
+        # partial runs (table-internal checks still run)
+        self.check_unregistered_table_keys = True
+
+    def matches(self, globs, path):
+        p = path.replace(os.sep, "/")
+        return any(fnmatch.fnmatch(p, g) for g in globs)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _iter_py_files(paths, errors=None):
+    for p in paths:
+        if not os.path.exists(p):
+            # a mistyped path must not read as a clean lint
+            if errors is not None:
+                errors.append("%s: path does not exist" % p)
+            continue
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+        elif p.endswith(".py"):
+            yield p
+        elif errors is not None:
+            # an existing non-.py file must not read as a clean lint
+            errors.append("%s: not a python file" % p)
+
+
+def _pragma_disabled(line_text, rule):
+    """`# mxlint: disable` / `# mxlint: disable=a,b` on the line."""
+    marker = "# mxlint:"
+    idx = line_text.find(marker)
+    if idx < 0:
+        return False
+    directive = line_text[idx + len(marker):].strip()
+    if not directive.startswith("disable"):
+        return False
+    rest = directive[len("disable"):]
+    if rest.startswith("="):
+        names = rest[1:].split("--")[0]
+        return rule in [n.strip()
+                        for n in names.replace(";", ",").split(",")]
+    # bare disable-all only when nothing (or just a reason) follows —
+    # 'disable-next-line=x' / 'disabled' must not suppress everything
+    rest = rest.strip()
+    return rest == "" or rest.startswith("--")
+
+
+class _Aliases:
+    """Import-name resolution for numpy / jax / jax.numpy / functools."""
+
+    def __init__(self, tree):
+        self.numpy = set()
+        self.jnp = set()
+        self.jax = set()
+        self.functools = set()
+        self.from_jax = {}  # local name -> jax attr (e.g. jit, device_get)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if a.name == "numpy":
+                        self.numpy.add(name)
+                    elif a.name in ("jax.numpy", "jax.numpy.linalg"):
+                        self.jnp.add(name)
+                    elif a.name == "jax":
+                        self.jax.add(name)
+                    elif a.name == "functools":
+                        self.functools.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        local = a.asname or a.name
+                        if a.name == "numpy":
+                            self.jnp.add(local)
+                        else:
+                            self.from_jax[local] = a.name
+                elif node.module == "numpy":
+                    pass  # from numpy import X — not alias-tracked
+
+    def is_np_attr(self, node, attr_names):
+        """node is `np.<attr>` for a numpy alias and attr in attr_names."""
+        return (isinstance(node, ast.Attribute)
+                and node.attr in attr_names
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.numpy)
+
+    def is_jnp_call_root(self, node):
+        """node's dotted root is a jax.numpy / jax.lax / jax.nn alias."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and (node.id in self.jnp
+                                               or node.id in self.jax)
+
+    def is_jax_jit(self, node):
+        """node is `jax.jit` / a from-jax `jit` name."""
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.jax)
+        if isinstance(node, ast.Name):
+            return self.from_jax.get(node.id) == "jit"
+        return False
+
+    def is_device_get(self, node):
+        if isinstance(node, ast.Attribute) and node.attr == "device_get":
+            return (isinstance(node.value, ast.Name)
+                    and node.value.id in self.jax)
+        if isinstance(node, ast.Name):
+            return self.from_jax.get(node.id) == "device_get"
+        return False
+
+
+def _is_register_decorated(fn_node):
+    for dec in fn_node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = getattr(target, "id", getattr(target, "attr", None))
+        if name == "register":
+            return True
+    return False
+
+
+def _has_docstring(fn_node):
+    return bool(fn_node.body
+                and isinstance(fn_node.body[0], ast.Expr)
+                and isinstance(fn_node.body[0].value, ast.Constant)
+                and isinstance(fn_node.body[0].value.value, str))
+
+
+def _literal_str_seq(node):
+    """['a', 'b'] / ('a', 'b') / 'a' -> list of strings, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+# ------------------------------------------------------- per-file state
+
+
+class _FileCtx:
+    def __init__(self, path, source, config):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _Aliases(self.tree)
+        self.config = config
+        self.findings = []
+        # registry-consistency collection (aggregated across files)
+        self.registered = []     # (name, fn_node, has_doc, lineno)
+        self.alias_calls = []    # (name, target, lineno)
+        self.tables = {}         # table name -> {key: (lineno, values)}
+
+    def line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def add(self, rule, node, message, symbol=""):
+        lineno = getattr(node, "lineno", 1)
+        text = self.line(lineno)
+        if _pragma_disabled(text, rule):
+            return
+        self.findings.append(Finding(
+            rule, self.path, lineno, getattr(node, "col_offset", 0),
+            message, symbol=symbol, code_line=text))
+
+
+# ------------------------------------------------- rule: trace-host-sync
+
+
+class _TraceSafetyVisitor(ast.NodeVisitor):
+    """Walks one module; checks every function on the compute path."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.stack = []       # (name, tensor_names, whitelisted)
+
+    # -- tensor-ness inference ------------------------------------------
+    def _tensor_params(self, fn):
+        """For @register ops the calling convention is
+        ``fn(*tensor_inputs, **attrs)``: positional params with no
+        default are tensor inputs, defaulted params are attrs."""
+        if not _is_register_decorated(fn):
+            return set()
+        args = fn.args
+        pos = list(args.posonlyargs) + list(args.args)
+        n_tensor = len(pos) - len(args.defaults)
+        return {a.arg for a in pos[:n_tensor]}
+
+    @staticmethod
+    def _own_scope_nodes(fn):
+        """All nodes of `fn` except bodies of nested function defs —
+        a nested scope's local names must not leak into this one."""
+        out = []
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _collect_tensor_names(self, fn, seed):
+        """Fixpoint over simple assignments: names bound to tensor
+        expressions (x._data, jnp calls, arithmetic on tensors)."""
+        names = set(seed)
+        scope = self._own_scope_nodes(fn)
+        for _ in range(3):
+            before = len(names)
+            for node in scope:
+                if isinstance(node, ast.Assign):
+                    if self._is_tensor_expr(node.value, names):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                names.add(t.id)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    if (isinstance(node.target, ast.Name)
+                            and self._is_tensor_expr(node.value, names)):
+                        names.add(node.target.id)
+            if len(names) == before:
+                break
+        return names
+
+    def _is_tensor_expr(self, node, tensor_names):
+        if isinstance(node, ast.Name):
+            return node.id in tensor_names
+        if isinstance(node, ast.Attribute):
+            return node.attr == "_data"
+        if isinstance(node, ast.BinOp):
+            return (self._is_tensor_expr(node.left, tensor_names)
+                    or self._is_tensor_expr(node.right, tensor_names))
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tensor_expr(node.operand, tensor_names)
+        if isinstance(node, ast.Subscript):
+            return self._is_tensor_expr(node.value, tensor_names)
+        if isinstance(node, ast.Call):
+            return self.ctx.aliases.is_jnp_call_root(node.func)
+        return False
+
+    # -- traversal -------------------------------------------------------
+    def _visit_function(self, node):
+        whitelisted = (node.name in self.ctx.config.sync_whitelist
+                       or any(w for _, _, w in self.stack))
+        tensors = self._collect_tensor_names(
+            node, self._tensor_params(node))
+        self.stack.append((node.name, tensors, whitelisted))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _qualname(self):
+        return ".".join(n for n, _, _ in self.stack)
+
+    def _in_whitelisted(self):
+        return any(w for _, _, w in self.stack)
+
+    def _tensors(self):
+        return self.stack[-1][1] if self.stack else set()
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if self._in_whitelisted():
+            return
+        ctx, al = self.ctx, self.ctx.aliases
+        qual = self._qualname()
+        fn = node.func
+        # .item() / .tolist() / .asnumpy() / .block_until_ready()
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in ("item", "asnumpy", "tolist"):
+                ctx.add("trace-host-sync", node,
+                        ".%s() forces a device->host copy; keep values "
+                        "on device or sync via asnumpy() at an explicit "
+                        "sync point" % fn.attr, qual)
+                return
+            if fn.attr == "block_until_ready":
+                ctx.add("trace-host-sync", node,
+                        ".block_until_ready() blocks the dispatch "
+                        "thread; only wait_to_read/waitall may sync",
+                        qual)
+                return
+        # jax.device_get(...)
+        if al.is_device_get(fn):
+            ctx.add("trace-host-sync", node,
+                    "jax.device_get() is an implicit host sync", qual)
+            return
+        # float/int/bool/complex on tensor-typed names
+        if (isinstance(fn, ast.Name)
+                and fn.id in ("float", "int", "bool", "complex")
+                and len(node.args) == 1 and not node.keywords
+                and self._is_tensor_expr(node.args[0], self._tensors())):
+            ctx.add("trace-host-sync", node,
+                    "%s() on a tensor value materializes it on host "
+                    "(and fails under jit tracing); use jnp casts or "
+                    "keep the value symbolic" % fn.id, qual)
+            return
+        # np.asarray / np.array on tensor values
+        if (al.is_np_attr(fn, ("asarray", "array", "ascontiguousarray"))
+                and node.args
+                and self._is_tensor_expr(node.args[0], self._tensors())):
+            ctx.add("trace-host-sync", node,
+                    "np.%s() on a jax value copies it to host; use "
+                    "jnp.asarray to stay on device" % fn.attr, qual)
+
+
+def _check_trace_safety(ctx):
+    _TraceSafetyVisitor(ctx).visit(ctx.tree)
+
+
+# ----------------------------------------------- rule: static-argnames
+
+
+def _check_static_argnames(ctx):
+    # map: function name -> FunctionDef (module level), for jit(fn, ...)
+    module_fns = {n.name: n for n in ctx.tree.body
+                  if isinstance(n, ast.FunctionDef)}
+    decorated = {}  # id(call node) -> FunctionDef it decorates
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                for sub in ast.walk(dec):
+                    decorated[id(sub)] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if "static_argnames" not in kw:
+            continue
+        al = ctx.aliases
+        is_jit = al.is_jax_jit(node.func)
+        # functools.partial(jax.jit, static_argnames=...)
+        if (not is_jit and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "partial"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in al.functools
+                and node.args and al.is_jax_jit(node.args[0])):
+            is_jit = True
+        if not is_jit:
+            continue
+        names = _literal_str_seq(kw["static_argnames"])
+        if names is None:
+            ctx.add("static-argnames", node,
+                    "static_argnames is not a literal list of strings; "
+                    "mxlint cannot prove the cache key is hashable")
+            continue
+        # find the target function: decorator site, or jit(fn, ...)
+        fn_node = decorated.get(id(node))
+        if fn_node is None:
+            cand = None
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in module_fns:
+                    cand = module_fns[a.id]
+                    break
+            fn_node = cand
+        if fn_node is None:
+            continue  # dynamic target: signature not statically known
+        args = fn_node.args
+        pos = list(args.posonlyargs) + list(args.args)
+        kwonly = list(args.kwonlyargs)
+        all_params = {a.arg for a in pos + kwonly}
+        defaults = dict(zip([a.arg for a in pos[len(pos)
+                                                - len(args.defaults):]],
+                            args.defaults))
+        defaults.update({a.arg: d for a, d in zip(kwonly,
+                                                  args.kw_defaults) if d})
+        for name in names:
+            if name not in all_params:
+                if args.kwarg is not None:
+                    continue  # absorbed by **kwargs; not provable
+                ctx.add("static-argnames", node,
+                        "static_argnames names %r which is not a "
+                        "parameter of %s() — it will never be treated "
+                        "as static" % (name, fn_node.name))
+                continue
+            d = defaults.get(name)
+            if d is None:
+                continue
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                ctx.add("static-argnames", fn_node,
+                        "static arg %r of %s() defaults to an "
+                        "unhashable %s literal — jit raises on it, and "
+                        "per-call containers recompile every step"
+                        % (name, fn_node.name, type(d).__name__.lower()))
+            elif (isinstance(d, ast.Call)
+                  and (ctx.aliases.is_jnp_call_root(d.func)
+                       or ctx.aliases.is_np_attr(
+                           d.func, _NP_F64_CREATORS | {"array",
+                                                       "asarray"}))):
+                ctx.add("static-argnames", fn_node,
+                        "static arg %r of %s() defaults to an array "
+                        "value — arrays as static args hash by id and "
+                        "recompile every call" % (name, fn_node.name))
+
+
+# ------------------------------------------- rule: registry-consistency
+
+
+def _collect_registry_info(ctx):
+    """Per-file collection: registrations, alias() calls, tables."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names = _registered_names(node)
+            if not names and _is_register_decorated(node):
+                # registered under a computed name (factory loops);
+                # the runtime audit resolves the real name
+                names = ["<%s>" % node.name]
+            for n in names:
+                ctx.registered.append((n, node, _has_docstring(node),
+                                       node.lineno))
+        elif isinstance(node, ast.Call):
+            target = node.func
+            cname = getattr(target, "id", getattr(target, "attr", None))
+            if cname == "alias" and len(node.args) >= 2:
+                a0 = _literal_str_seq(node.args[0])
+                a1 = _literal_str_seq(node.args[1])
+                # non-literal alias loops (linalg.py) are covered by the
+                # runtime audit instead
+                if a0 and a1 and len(a0) == 1 and len(a1) == 1:
+                    ctx.alias_calls.append((a0[0], a1[0], node.lineno))
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id in _REGISTRY_TABLES):
+                    ctx.tables[t.id] = _parse_table(node.value, ctx, t.id)
+
+
+def _registered_names(fn_node):
+    """All op names this def registers: register("name", aliases=[...])."""
+    out = []
+    for dec in fn_node.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        target = dec.func
+        name = getattr(target, "id", getattr(target, "attr", None))
+        if name != "register":
+            continue
+        if dec.args:
+            lit = _literal_str_seq(dec.args[0])
+            if lit:
+                out.extend(lit)
+        for k in dec.keywords:
+            if k.arg == "aliases":
+                lit = _literal_str_seq(k.value)
+                if lit:
+                    out.extend(lit)
+    return out
+
+
+def _parse_table(value_node, ctx, tname):
+    """Dict/set literal -> {key: (lineno, tuple-of-value-strings)};
+    flags duplicate keys within the literal (later wins at runtime,
+    silently shadowing the first entry)."""
+    table = {}
+
+    def put(key, lineno, vals):
+        if key in table:
+            ctx.add("registry-consistency", _Loc(lineno),
+                    "%s key %r appears twice in the same literal; the "
+                    "second entry silently shadows the first"
+                    % (tname, key))
+            return
+        table[key] = (lineno, tuple(vals))
+
+    if isinstance(value_node, ast.Dict):
+        for k, v in zip(value_node.keys, value_node.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                put(k.value, k.lineno, _literal_str_seq(v) or ())
+    elif isinstance(value_node, ast.Set):
+        for e in value_node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                put(e.value, e.lineno, ())
+    return table
+
+
+def _check_registry_consistency(contexts):
+    """Cross-file pass over everything collected from registry files."""
+    registered = set()
+    by_target = {}
+    reg_ctxs = []
+    for ctx in contexts:
+        if not ctx.config.matches(ctx.config.registry_globs, ctx.path):
+            continue
+        reg_ctxs.append(ctx)
+        flagged_defs = set()
+        for name, fn_node, has_doc, lineno in ctx.registered:
+            registered.add(name)
+            if not has_doc and id(fn_node) not in flagged_defs:
+                flagged_defs.add(id(fn_node))
+                ctx.add("registry-consistency", fn_node,
+                        "registered op %r has no docstring (op docs "
+                        "drive list_ops()/help introspection)" % name,
+                        fn_node.name)
+        for name, target, _lineno in ctx.alias_calls:
+            by_target.setdefault(target, []).append(name)
+    # resolve literal alias() chains
+    frontier = True
+    while frontier:
+        frontier = False
+        for target, names in by_target.items():
+            if target in registered:
+                for n in names:
+                    if n not in registered:
+                        registered.add(n)
+                        frontier = True
+    if not reg_ctxs:
+        return
+    config = reg_ctxs[0].config
+
+    # merge tables across every registry file (duplicate keys flagged)
+    merged = {t: {} for t in _REGISTRY_TABLES}
+    any_tables = False
+    for ctx in reg_ctxs:
+        for tname, table in ctx.tables.items():
+            any_tables = True
+            for key, (lineno, vals) in table.items():
+                if key in merged[tname]:
+                    ctx.add("registry-consistency", _Loc(lineno),
+                            "%s key %r is defined in more than one "
+                            "file; one definition silently wins at "
+                            "import time" % (tname, key))
+                    continue
+                merged[tname][key] = (ctx, lineno, vals)
+    if not any_tables:
+        return
+    input_table = merged["OP_INPUT_NAMES"]
+
+    # the cross-check against @register sites needs those sites in
+    # scope; table-INTERNAL checks below run regardless
+    if config.check_unregistered_table_keys and registered:
+        for key, (ctx, lineno, _vals) in input_table.items():
+            if key not in registered:
+                ctx.add("registry-consistency", _Loc(lineno),
+                        "OP_INPUT_NAMES key %r does not name a "
+                        "registered op (stale table entry?)" % key)
+    for tname in ("OP_AUX_INPUTS", "OP_LABEL_INPUTS"):
+        for key, (ctx, lineno, vals) in merged[tname].items():
+            if key not in input_table:
+                ctx.add("registry-consistency", _Loc(lineno),
+                        "%s key %r is missing from OP_INPUT_NAMES"
+                        % (tname, key))
+                continue
+            in_names = set(input_table[key][2])
+            for v in vals:
+                if v not in in_names:
+                    ctx.add(
+                        "registry-consistency", _Loc(lineno),
+                        "%s[%r] names input %r which is not in "
+                        "OP_INPUT_NAMES[%r]" % (tname, key, v, key))
+
+
+# ------------------------------------------------- rule: dtype-default
+
+
+class _DtypeVisitor(ast.NodeVisitor):
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.stack = []
+
+    def _visit_function(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _qual(self):
+        return ".".join(self.stack)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+        if self.ctx.aliases.is_np_attr(node, ("float64", "double")):
+            self.ctx.add("dtype-default", node,
+                         "np.%s silently upcasts op math to 64-bit; "
+                         "TPUs have no f64 — use float32/bfloat16"
+                         % node.attr, self._qual())
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        dtype = kw.get("dtype")
+        if (isinstance(dtype, ast.Constant)
+                and isinstance(dtype.value, str)
+                and dtype.value in ("float64", "double", "f8", ">f8",
+                                    "<f8")):
+            self.ctx.add("dtype-default", node,
+                         "dtype=%r requests 64-bit floats; TPUs have "
+                         "no f64" % dtype.value, self._qual())
+            return
+        if (self.ctx.aliases.is_np_attr(node.func, _NP_F64_CREATORS)
+                and "dtype" not in kw):
+            self.ctx.add("dtype-default", node,
+                         "np.%s() without dtype= defaults to float64 "
+                         "on host and upcasts downstream math; pass an "
+                         "explicit dtype" % node.func.attr, self._qual())
+
+
+def _check_dtype_default(ctx):
+    _DtypeVisitor(ctx).visit(ctx.tree)
+
+
+# --------------------------------------------------------------- driver
+
+
+def lint_sources(named_sources, config=None):
+    """Lint {path: source} mappings; returns (findings, errors)."""
+    config = config or Config()
+    contexts, errors = [], []
+    for path in sorted(named_sources):
+        try:
+            contexts.append(_FileCtx(path, named_sources[path], config))
+        except SyntaxError as e:
+            errors.append("%s: syntax error: %s" % (path, e))
+    for ctx in contexts:
+        if ("trace-host-sync" in config.rules
+                and config.matches(config.compute_path_globs, ctx.path)):
+            _check_trace_safety(ctx)
+        if "static-argnames" in config.rules:
+            _check_static_argnames(ctx)
+        if "dtype-default" in config.rules \
+                and config.matches(config.ops_globs, ctx.path):
+            _check_dtype_default(ctx)
+        if "registry-consistency" in config.rules \
+                and config.matches(config.registry_globs, ctx.path):
+            _collect_registry_info(ctx)
+    if "registry-consistency" in config.rules:
+        _check_registry_consistency(contexts)
+    findings = []
+    for ctx in contexts:
+        findings.extend(ctx.findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+def lint_paths(paths, config=None, base=None):
+    """Lint files/directories on disk; returns (findings, errors).
+
+    Findings carry paths relative to `base` (default: cwd) so baseline
+    fingerprints are stable no matter where mxlint is invoked from.
+    """
+    import copy
+
+    base = base or os.getcwd()
+    config = config or Config()
+    sources, errors = {}, []
+    abs_linted = set()
+    for path in _iter_py_files(paths, errors):
+        ap = os.path.abspath(path)
+        abs_linted.add(ap)
+        rel = os.path.relpath(ap, base)
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            errors.append("%s: %s" % (path, e))
+    # the unregistered-table-key cross-check is only sound when every
+    # on-disk sibling of a linted registry file is linted too — a
+    # partial run (one ops file) must not flag keys whose @register
+    # sites it never saw
+    if config.check_unregistered_table_keys:
+        complete = True
+        for ap in abs_linted:
+            rel = os.path.relpath(ap, base)
+            if not config.matches(config.registry_globs, rel):
+                continue
+            d = os.path.dirname(ap)
+            for fn in os.listdir(d):
+                if fn.endswith(".py") \
+                        and os.path.join(d, fn) not in abs_linted:
+                    complete = False
+                    break
+            if not complete:
+                break
+        if not complete:
+            config = copy.copy(config)
+            config.check_unregistered_table_keys = False
+    findings, perrors = lint_sources(sources, config)
+    return findings, errors + perrors

@@ -1,0 +1,116 @@
+"""mxlint command line: ``python -m tools.mxlint [paths...]``.
+
+Exit codes: 0 clean (or everything baselined), 1 new findings or stale
+baseline entries, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .checkers import ALL_RULES, Config, lint_paths
+from .findings import apply_baseline, load_baseline, save_baseline
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+# fingerprint paths are always repo-relative, no matter the invoking cwd
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Trace-safety and op-registry static analyzer for "
+                    "the mxnet_tpu op compute paths.")
+    p.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                   help="files/directories to lint (default: mxnet_tpu)")
+    p.add_argument("--rules", default=",".join(ALL_RULES),
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file grandfathering old findings "
+                        "(default: tools/mxlint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to grandfather the "
+                        "current findings (drops stale entries)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings the baseline suppressed")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+    unknown = [r for r in rules if r not in ALL_RULES]
+    if unknown:
+        print("unknown rule(s): %s (known: %s)"
+              % (", ".join(unknown), ", ".join(ALL_RULES)),
+              file=sys.stderr)
+        return 2
+    findings, errors = lint_paths(args.paths, Config(rules=rules),
+                                  base=REPO_ROOT)
+    for e in errors:
+        print("error: %s" % e, file=sys.stderr)
+    if errors:
+        return 2
+    linted = [os.path.relpath(os.path.abspath(p), REPO_ROOT)
+              for p in args.paths]
+
+    if args.update_baseline:
+        # a partial-scope run must not erase entries it could not
+        # have re-observed: carry out-of-scope entries over verbatim
+        kept = []
+        if os.path.exists(args.baseline):
+            from .findings import _in_scope
+
+            kept = [e for e in load_baseline(args.baseline).values()
+                    if not _in_scope(e, [os.path.relpath(
+                        os.path.abspath(p), REPO_ROOT)
+                        for p in args.paths], rules)]
+        save_baseline(args.baseline, findings, keep_entries=kept)
+        print("baseline updated: %d finding(s) grandfathered (%d "
+              "out-of-scope entr(y/ies) kept) -> %s"
+              % (len(findings), len(kept), args.baseline))
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print("error: unreadable baseline %s: %s"
+                  % (args.baseline, e), file=sys.stderr)
+            return 2
+    result = apply_baseline(findings, baseline, linted_paths=linted,
+                            rules=rules)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in result.new],
+            "baselined": [f.to_dict() for f in result.suppressed],
+            "stale_baseline": result.stale,
+        }, indent=1))
+        return 1 if (result.new or result.stale) else 0
+
+    for f in result.new:
+        print(f.format())
+    if args.show_baselined:
+        for f in result.suppressed:
+            print("[baselined] " + f.format())
+    for e in result.stale:
+        print("stale baseline entry (code fixed or moved — run "
+              "--update-baseline): %s %s %r"
+              % (e.get("rule"), e.get("path"), e.get("code_line")))
+    print("mxlint: %d new finding(s), %d baselined, %d stale baseline "
+          "entr(y/ies)" % (len(result.new), len(result.suppressed),
+                           len(result.stale)))
+    return 1 if (result.new or result.stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

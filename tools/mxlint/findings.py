@@ -1,0 +1,183 @@
+"""Finding model + baseline mechanics for mxlint.
+
+A finding is one rule violation at one source location.  The baseline
+file grandfathers pre-existing findings: each entry is a *fingerprint*
+(rule + file + enclosing symbol + normalized source line) with a count,
+so findings survive unrelated line-number drift but a fingerprint whose
+code is fixed or deleted goes *stale* and is reported for removal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import Counter
+
+__all__ = ["Finding", "fingerprint", "load_baseline", "save_baseline",
+           "apply_baseline", "BaselineResult"]
+
+
+class Finding:
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "symbol",
+                 "code_line")
+
+    def __init__(self, rule, path, line, col, message, symbol="",
+                 code_line=""):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = line
+        self.col = col
+        self.message = message
+        self.symbol = symbol
+        self.code_line = code_line.strip()
+
+    def __repr__(self):
+        return "Finding(%s, %s:%d)" % (self.rule, self.path, self.line)
+
+    def format(self):
+        loc = "%s:%d:%d" % (self.path, self.line, self.col + 1)
+        sym = (" [%s]" % self.symbol) if self.symbol else ""
+        return "%s: %s: %s%s" % (loc, self.rule, self.message, sym)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "symbol": self.symbol, "code_line": self.code_line,
+                "fingerprint": fingerprint(self)}
+
+
+def fingerprint(finding):
+    """Stable identity for baselining: deliberately excludes the line
+    number so unrelated edits above a finding don't un-grandfather it."""
+    key = "\x1f".join([finding.rule, finding.path, finding.symbol,
+                       " ".join(finding.code_line.split())])
+    return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+
+def load_baseline(path):
+    """baseline.json -> {fingerprint: {"count": n, ...meta}}."""
+    with open(path) as f:
+        data = json.load(f)
+    entries = {}
+    for e in data.get("findings", []):
+        entries[e["fingerprint"]] = e
+    return entries
+
+
+def load_registry_grandfather(path):
+    """The runtime-audit grandfather list: op names registered before
+    the docstring rule existed (tests/test_lint_clean.py holds new ops
+    to zero)."""
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("registry", {}).get("missing_docstrings", []))
+
+
+def save_registry_grandfather(path, op_names):
+    """Rewrite only the registry section, preserving findings."""
+    data = {"version": 1, "findings": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data["registry"] = {"missing_docstrings": sorted(op_names)}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def save_baseline(path, findings, keep_entries=()):
+    """Write a baseline that grandfathers exactly `findings` (the
+    registry section, if present, is preserved).
+
+    `keep_entries`: existing entry dicts to carry over verbatim —
+    used by partial-scope --update-baseline runs so entries the run
+    could not re-observe are not silently erased."""
+    counts = Counter(fingerprint(f) for f in findings)
+    seen = set()
+    entries = []
+    for e in keep_entries:
+        seen.add(e["fingerprint"])
+        entries.append({k: v for k, v in e.items() if k != "unmatched"})
+    for f in findings:
+        fp = fingerprint(f)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append({"fingerprint": fp, "count": counts[fp],
+                        "rule": f.rule, "path": f.path,
+                        "symbol": f.symbol, "code_line": f.code_line})
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["code_line"]))
+    data = {"version": 1, "findings": entries}
+    if os.path.exists(path):
+        with open(path) as f:
+            try:
+                old = json.load(f)
+            except ValueError:
+                old = {}
+        if "registry" in old:
+            data["registry"] = old["registry"]
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+class BaselineResult:
+    """Split of a lint run against a baseline."""
+
+    __slots__ = ("new", "suppressed", "stale")
+
+    def __init__(self, new, suppressed, stale):
+        self.new = new                # findings not covered by baseline
+        self.suppressed = suppressed  # findings absorbed by baseline
+        self.stale = stale            # baseline entries matching nothing
+
+
+def _in_scope(entry, linted_paths, rules):
+    """Whether a partial run (subset of paths/rules) can judge this
+    baseline entry stale at all."""
+    if rules is not None and entry.get("rule") not in rules:
+        return False
+    if linted_paths is None:
+        return True
+    path = entry.get("path", "")
+    for root in linted_paths:
+        root = root.replace(os.sep, "/").rstrip("/")
+        if root in (".", "") or path == root \
+                or path.startswith(root + "/"):
+            return True
+    return False
+
+
+def apply_baseline(findings, baseline, linted_paths=None, rules=None):
+    """Match findings against baseline entries (count-aware).
+
+    A baseline entry absorbs up to `count` findings with its
+    fingerprint; extra occurrences of the same fingerprint are NEW
+    (copy-pasting a baselined violation is still a violation).
+
+    `linted_paths` / `rules`: the scope this run actually covered.
+    Entries outside it are never reported stale — a partial run
+    (single file, rule subset) must not demand a baseline rewrite for
+    findings it could not have re-observed.
+    """
+    budget = {fp: e.get("count", 1) for fp, e in baseline.items()}
+    new, suppressed = [], []
+    for f in findings:
+        fp = fingerprint(f)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            suppressed.append(f)
+        else:
+            new.append(f)
+    # leftover budget = grandfathered findings that no longer exist.
+    # Counting (not just presence) keeps the baseline shrink-only: a
+    # half-fixed count-2 entry goes stale until --update-baseline
+    # lowers it, so the fixed slot can't silently absorb a
+    # reintroduced violation later.
+    stale = [dict(e, unmatched=budget[fp])
+             for fp, e in baseline.items()
+             if budget[fp] > 0 and _in_scope(e, linted_paths, rules)]
+    return BaselineResult(new, suppressed, stale)

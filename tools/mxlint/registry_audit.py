@@ -1,0 +1,256 @@
+"""Runtime op-registry audit — the importing half of registry-consistency.
+
+The AST rule (checkers.py) proves what it can without importing; this
+module imports ``mxnet_tpu.ops`` and audits the *actual* registry:
+
+- every ``OP_INPUT_NAMES`` key (including entries added dynamically by
+  quantization/extended/contrib modules) names a registered op;
+- ``OP_AUX_INPUTS`` / ``OP_LABEL_INPUTS`` are consistent subsets;
+- every op in ``OP_INPUT_NAMES`` traces under ``jax.eval_shape`` on a
+  canonical input spec — proof the op stays inside the traceable
+  subset with zero FLOPs and zero device memory;
+- every registered op function carries a docstring (doc-less ops are
+  reported; the tier-1 gate grandfathers the pre-existing ones via
+  tools/mxlint/baseline.json).
+
+Used by tests/test_lint_clean.py; also runnable standalone::
+
+    python -m tools.mxlint.registry_audit
+"""
+
+from __future__ import annotations
+
+__all__ = ["audit_registry", "canonical_spec", "AuditResult"]
+
+_F32 = "float32"
+
+
+def _rnn_param_len(input_size, state_size, num_layers, dirs, gates):
+    """Total packed RNN parameter length (matches ops/rnn.py _unpack)."""
+    total = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            total += gates * state_size * in_sz       # w_i2h
+            total += gates * state_size * state_size  # w_h2h
+            total += 2 * gates * state_size           # b_i2h + b_h2h
+    return total
+
+
+def canonical_spec(name):
+    """(input_specs, attrs) for one table op, or None if unknown.
+
+    input_specs: list of (shape, dtype) matching OP_INPUT_NAMES[name]
+    order.  Shapes are minimal-but-representative: conv-like ops get
+    NCHW images, sequence ops get (T, B, C), etc.
+    """
+    f = _F32
+    i32 = "int32"
+    specs = {
+        "Convolution": ([((2, 3, 8, 8), f), ((4, 3, 3, 3), f), ((4,), f)],
+                        {"kernel": (3, 3), "num_filter": 4}),
+        "Deconvolution": ([((2, 4, 8, 8), f), ((4, 3, 3, 3), f),
+                           ((3,), f)],
+                          {"kernel": (3, 3), "num_filter": 3,
+                           "no_bias": False}),
+        "FullyConnected": ([((2, 8), f), ((4, 8), f), ((4,), f)],
+                           {"num_hidden": 4}),
+        "BatchNorm": ([((2, 3, 4, 4), f)] + [((3,), f)] * 4, {}),
+        "LayerNorm": ([((2, 8), f), ((8,), f), ((8,), f)], {}),
+        "InstanceNorm": ([((2, 3, 4, 4), f), ((3,), f), ((3,), f)], {}),
+        "L2Normalization": ([((2, 8), f)], {}),
+        "Embedding": ([((2, 3), i32), ((10, 4), f)],
+                      {"input_dim": 10, "output_dim": 4}),
+        "LeakyReLU": ([((2, 3, 4, 4), f), ((3,), f)],
+                      {"act_type": "prelu"}),
+        "SoftmaxOutput": ([((2, 5), f), ((2,), f)], {}),
+        "choose_element_0index": ([((2, 5), f), ((2,), f)], {}),
+        "fill_element_0index": ([((2, 5), f), ((2,), f), ((2,), f)], {}),
+        "SVMOutput": ([((2, 5), f), ((2,), f)], {}),
+        "LinearRegressionOutput": ([((2, 5), f), ((2, 5), f)], {}),
+        "MAERegressionOutput": ([((2, 5), f), ((2, 5), f)], {}),
+        "LogisticRegressionOutput": ([((2, 5), f), ((2, 5), f)], {}),
+        "CTCLoss": ([((10, 2, 5), f), ((2, 4), f), ((2,), i32),
+                     ((2,), i32)],
+                    {"use_data_lengths": True, "use_label_lengths": True}),
+        "SequenceMask": ([((4, 2, 3), f), ((2,), i32)],
+                         {"use_sequence_length": True}),
+        "SequenceLast": ([((4, 2, 3), f), ((2,), i32)],
+                         {"use_sequence_length": True}),
+        "SequenceReverse": ([((4, 2, 3), f), ((2,), i32)],
+                            {"use_sequence_length": True}),
+        "dot": ([((2, 3), f), ((3, 4), f)], {}),
+        "batch_dot": ([((2, 3, 4), f), ((2, 4, 5), f)], {}),
+        "where": ([((2, 3), f), ((2, 3), f), ((2, 3), f)], {}),
+        "take": ([((5, 3), f), ((2,), i32)], {}),
+        "ROIPooling": ([((1, 3, 8, 8), f), ((2, 5), f)],
+                       {"pooled_size": (2, 2), "spatial_scale": 1.0}),
+        "BilinearSampler": ([((1, 3, 8, 8), f), ((1, 2, 4, 4), f)], {}),
+        "GridGenerator": ([((1, 6), f)],
+                          {"transform_type": "affine",
+                           "target_shape": (4, 4)}),
+        "SpatialTransformer": ([((1, 3, 8, 8), f), ((1, 6), f)],
+                               {"target_shape": (4, 4)}),
+        "RNN": ([((4, 2, 3), f),
+                 ((_rnn_param_len(3, 4, 1, 1, 1),), f),
+                 ((1, 2, 4), f), ((1, 2, 4), f)],
+                {"state_size": 4, "num_layers": 1, "mode": "rnn_tanh"}),
+        "_contrib_quantize": ([((2, 3), f), ((1,), f), ((1,), f)], {}),
+        "_contrib_quantize_v2": ([((2, 3), f)],
+                                 {"min_calib_range": -1.0,
+                                  "max_calib_range": 1.0}),
+        "_contrib_dequantize": ([((2, 3), "int8"), ((1,), f),
+                                 ((1,), f)], {}),
+        "_contrib_requantize": ([((2, 3), "int32"), ((1,), f), ((1,), f)],
+                                {"min_calib_range": -1.0,
+                                 "max_calib_range": 1.0}),
+        "_contrib_quantized_fully_connected": (
+            [((2, 8), "uint8"), ((4, 8), "int8"), ((4,), "int8")]
+            + [((1,), f)] * 6,
+            {"num_hidden": 4}),
+        "_contrib_quantized_conv": (
+            [((1, 3, 8, 8), "uint8"), ((4, 3, 3, 3), "int8"),
+             ((4,), "int8")] + [((1,), f)] * 6,
+            {"kernel": (3, 3), "num_filter": 4, "stride": (1, 1),
+             "pad": (0, 0), "dilate": (1, 1)}),
+        "_contrib_quantized_pooling": (
+            [((1, 3, 8, 8), "uint8"), ((1,), f), ((1,), f)],
+            {"kernel": (2, 2), "stride": (2, 2), "pad": (0, 0),
+             "pool_type": "max"}),
+        "_contrib_quantized_flatten": (
+            [((2, 3, 4), "uint8"), ((1,), f), ((1,), f)], {}),
+        "_contrib_Proposal": (
+            [((1, 24, 4, 4), f), ((1, 48, 4, 4), f), ((1, 3), f)],
+            {"rpn_pre_nms_top_n": 12, "rpn_post_nms_top_n": 4}),
+        "_contrib_PSROIPooling": (
+            [((1, 12, 8, 8), f), ((2, 5), f)],
+            {"output_dim": 3, "pooled_size": 2, "group_size": 2}),
+        "_contrib_DeformableConvolution": (
+            [((1, 3, 8, 8), f), ((1, 18, 6, 6), f), ((4, 3, 3, 3), f),
+             ((4,), f)],
+            {"kernel": (3, 3), "num_filter": 4}),
+        "Correlation": ([((1, 3, 8, 8), f), ((1, 3, 8, 8), f)],
+                        {"kernel_size": 1, "max_displacement": 1}),
+        "group_adagrad_update": ([((4, 3), f), ((4, 3), f), ((4,), f)],
+                                 {}),
+    }
+    return specs.get(name)
+
+
+class AuditResult:
+    """Outcome of audit_registry(): lists of problem strings."""
+
+    __slots__ = ("table_errors", "shape_errors", "missing_docstrings")
+
+    def __init__(self):
+        self.table_errors = []       # table <-> registry inconsistencies
+        self.shape_errors = []       # eval_shape failures / missing specs
+        self.missing_docstrings = []  # (op_name, fn_name) doc-less ops
+
+    @property
+    def ok(self):
+        return not (self.table_errors or self.shape_errors)
+
+
+def audit_registry(eval_shapes=True):
+    """Audit the live registry; importing mxnet_tpu.ops as needed."""
+    import jax
+
+    from mxnet_tpu.ops import registry as R
+
+    res = AuditResult()
+    registered = set(R._OP_REGISTRY)
+
+    # --- table cross-checks (authoritative: includes dynamic entries)
+    for key in R.OP_INPUT_NAMES:
+        if key not in registered:
+            res.table_errors.append(
+                "OP_INPUT_NAMES key %r is not a registered op" % key)
+    for key, aux in R.OP_AUX_INPUTS.items():
+        if key not in R.OP_INPUT_NAMES:
+            res.table_errors.append(
+                "OP_AUX_INPUTS key %r missing from OP_INPUT_NAMES" % key)
+            continue
+        extra = [n for n in aux if n not in R.OP_INPUT_NAMES[key]]
+        if extra:
+            res.table_errors.append(
+                "OP_AUX_INPUTS[%r] names %r not in OP_INPUT_NAMES[%r]"
+                % (key, extra, key))
+    for key in R.OP_LABEL_INPUTS:
+        if key not in R.OP_INPUT_NAMES:
+            res.table_errors.append(
+                "OP_LABEL_INPUTS key %r missing from OP_INPUT_NAMES" % key)
+
+    # --- docstring coverage over canonical ops
+    seen = set()
+    for op in R._OP_REGISTRY.values():
+        if op.name in seen:
+            continue
+        seen.add(op.name)
+        if not (op.fn.__doc__ or "").strip():
+            res.missing_docstrings.append((op.name, op.fn.__name__))
+    res.missing_docstrings.sort()
+
+    # --- eval_shape: every table op must trace on its canonical spec
+    if eval_shapes:
+        from mxnet_tpu.ndarray.ndarray import RANDOM_OPS
+
+        for name in sorted(R.OP_INPUT_NAMES):
+            if name not in registered:
+                continue  # already a table error above
+            spec = canonical_spec(name)
+            if spec is None:
+                res.shape_errors.append(
+                    "no canonical eval_shape spec for table op %r — add "
+                    "one to tools/mxlint/registry_audit.py" % name)
+                continue
+            input_specs, attrs = spec
+            op = R.get(name)
+            attrs = op.canonicalize_attrs(attrs)
+            args = [jax.ShapeDtypeStruct(s, d) for s, d in input_specs]
+            if name in RANDOM_OPS:
+                args = [jax.random.PRNGKey(0)] + args
+            try:
+                jax.eval_shape(op.bind_attrs(attrs), *args)
+            except Exception as e:  # any trace failure is a finding
+                msg = str(e).split("\n")[0][:200]
+                res.shape_errors.append(
+                    "eval_shape(%s) failed: %s: %s"
+                    % (name, type(e).__name__, msg))
+    return res
+
+
+def main(argv=None):
+    import argparse
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mxlint.registry_audit",
+        description="Runtime audit of the mxnet_tpu op registry.")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="grandfather the current doc-less ops into "
+                        "tools/mxlint/baseline.json (registry section)")
+    args = p.parse_args(argv)
+    res = audit_registry()
+    for e in res.table_errors + res.shape_errors:
+        print("audit: %s" % e)
+    print("registry audit: %d table error(s), %d eval_shape error(s), "
+          "%d op(s) without docstrings"
+          % (len(res.table_errors), len(res.shape_errors),
+             len(res.missing_docstrings)))
+    if args.update_baseline:
+        from .cli import DEFAULT_BASELINE
+        from .findings import save_registry_grandfather
+
+        save_registry_grandfather(
+            DEFAULT_BASELINE, [n for n, _ in res.missing_docstrings])
+        print("baseline registry section updated: %d op name(s) -> %s"
+              % (len(res.missing_docstrings), DEFAULT_BASELINE))
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
