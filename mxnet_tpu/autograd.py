@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 
+from . import profiler as _profiler
 from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
@@ -109,9 +110,24 @@ class _DualScope:
         st["recording"], st["training"] = self._old
 
 
+class _RecordScope(_DualScope):
+    """record() scope with a profiler span over the recorded region —
+    the forward boundary of the training-step anatomy in traces."""
+
+    def __enter__(self):
+        self._span = _profiler.span("autograd:record", "autograd")
+        self._span.__enter__()
+        return super().__enter__()
+
+    def __exit__(self, *a):
+        r = super().__exit__(*a)
+        self._span.__exit__(*a)
+        return r
+
+
 def record(train_mode=True):
     """``with autograd.record():`` — enable recording (+train mode)."""
-    return _DualScope(True, train_mode)
+    return _RecordScope(True, train_mode)
 
 
 def pause(train_mode=False):
@@ -274,7 +290,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         heads = [heads]
     if head_grads is not None and not isinstance(head_grads, (list, tuple)):
         head_grads = [head_grads]
-    _backward_impl(heads, head_grads, retain_graph, accumulate_to_vars=True)
+    with _profiler.span("autograd:backward", "autograd",
+                        args={"n_heads": len(heads)}
+                        if _profiler._state["running"] else None):
+        _backward_impl(heads, head_grads, retain_graph,
+                       accumulate_to_vars=True)
 
 
 def _reachable_entries(tape, head_nodes):

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import numpy as _np
 
+from . import profiler as _profiler
+from . import runtime_stats as _rts
 from .base import MXNetError
 from .ndarray import NDArray
 from .ops import registry as _reg
@@ -191,11 +193,16 @@ class Executor:
 
     # ------------------------------------------------------------- compile
     def _get_fns(self, is_train):
+        if is_train in self._fns:
+            return self._fns[is_train]
+        _rts.inc("executor_builds")
+        with _profiler.span("executor:build_fns", "executor",
+                            args={"is_train": is_train}):
+            return self._build_fns(is_train)
+
+    def _build_fns(self, is_train):
         import jax
 
-        key = is_train
-        if key in self._fns:
-            return self._fns[key]
         fn, _meta = make_eval_fn(self._symbol, is_train)
 
         fwd = jax.jit(fn)
@@ -223,8 +230,8 @@ class Executor:
             return outs, new_aux, dargs
 
         bwd = jax.jit(fwd_bwd)
-        self._fns[key] = (fwd, bwd, diff_idx)
-        return self._fns[key]
+        self._fns[is_train] = (fwd, bwd, diff_idx)
+        return self._fns[is_train]
 
     # ------------------------------------------------------------- running
     def forward(self, is_train=False, **kwargs):
@@ -261,7 +268,10 @@ class Executor:
         arg_vals, aux_vals, seed, is_train = self._fwd_state
         fwd, _bwd, _d = self._get_fns(is_train)
         try:
-            outs, new_aux = fwd(arg_vals, aux_vals, seed)
+            with _profiler.span("executor:forward", "executor",
+                                args={"is_train": is_train}
+                                if _profiler._state["running"] else None):
+                outs, new_aux = fwd(arg_vals, aux_vals, seed)
         except (TypeError, ValueError, RuntimeError) as e:
             # surface graph-execution failures as MXNetError (reference:
             # engine errors reach WaitForVar/asnumpy as MXNetError).
@@ -301,7 +311,8 @@ class Executor:
                 out_grads = [out_grads]
             ogs = [g._data if isinstance(g, NDArray) else g for g in out_grads]
         try:
-            outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
+            with _profiler.span("executor:backward", "executor"):
+                outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
         except (TypeError, ValueError, RuntimeError) as e:
             raise MXNetError("executor backward: %s" % e) from e
         if self._outputs is None:

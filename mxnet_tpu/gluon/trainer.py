@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from .. import kvstore as _kvstore
 from .. import optimizer as _optimizer
+from .. import profiler as _profiler
+from .. import runtime_stats as _rts
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -129,6 +131,13 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads across devices, then update
         (reference: trainer.py step:302)."""
+        _rts.inc("trainer_steps")
+        with _profiler.span("trainer:step", "trainer",
+                            args={"batch_size": batch_size}
+                            if _profiler._state["running"] else None):
+            self._step(batch_size, ignore_stale_grad)
+
+    def _step(self, batch_size, ignore_stale_grad):
         # rescale BEFORE the kvstore ships the optimizer server-side
         # (reference: step() calls _check_and_rescale_grad first; changing
         # batch_size after init would silently use the stale rescale)
@@ -172,11 +181,12 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req != "null":
-                grads = p.list_grad()
-                self._kvstore.push(i, grads)
-                self._kvstore.pull(i, out=grads)
+        with _profiler.span("trainer:allreduce", "trainer"):
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    grads = p.list_grad()
+                    self._kvstore.push(i, grads)
+                    self._kvstore.pull(i, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -190,6 +200,10 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        with _profiler.span("trainer:update", "trainer"):
+            self._update_impl(ignore_stale_grad)
+
+    def _update_impl(self, ignore_stale_grad=False):
         n_dev = max(len(p.list_data()) for p in self._params) \
             if self._params else 1
         while len(self._updaters) < n_dev:

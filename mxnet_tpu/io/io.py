@@ -22,6 +22,8 @@ from collections import namedtuple
 
 import numpy as _np
 
+from .. import profiler as _profiler
+from .. import runtime_stats as _rts
 from ..base import MXNetError
 from ..ndarray import NDArray, array
 
@@ -75,7 +77,15 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        # the for-batch-in-iter hot loop: span shows host-side batch
+        # assembly time in the step anatomy (guard-first: args dict is
+        # only built while recording, so the off path allocates nothing)
+        with _profiler.span("io:next_batch", "io",
+                            args={"iter": self.__class__.__name__}
+                            if _profiler._state["running"] else None):
+            batch = self.next()
+        _rts.inc("io_batches")
+        return batch
 
     def iter_next(self):
         raise NotImplementedError()

@@ -29,6 +29,8 @@ import pickle
 
 import numpy as _np
 
+from .. import profiler as _profiler
+from .. import runtime_stats as _rts
 from ..base import MXNetError
 from ..ndarray import NDArray, array, zeros
 from ..optimizer import Optimizer, get_updater
@@ -89,6 +91,13 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Reduce pushed values per key; apply updater if set
         (reference: KVStoreLocal::PushImpl → Comm::Reduce comm.h:57)."""
+        _rts.inc("kvstore_pushes")
+        with _profiler.span("kvstore:push", "kvstore",
+                            args={"type": self._type}
+                            if _profiler._state["running"] else None):
+            self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority):
         keys, values = _key_value_list(key, value)
         for k, vlist in zip(keys, values):
             if k not in self._store:
@@ -114,6 +123,13 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored value (reference: Comm::Broadcast comm.h:62)."""
         assert out is not None
+        _rts.inc("kvstore_pulls")
+        with _profiler.span("kvstore:pull", "kvstore",
+                            args={"type": self._type}
+                            if _profiler._state["running"] else None):
+            self._pull_impl(key, out, priority, ignore_sparse)
+
+    def _pull_impl(self, key, out, priority, ignore_sparse):
         keys, outs = _key_value_list(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -245,7 +261,7 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._num_workers
 
-    def push(self, key, value, priority=0):
+    def _push_impl(self, key, value, priority):
         keys, values = _key_value_list(key, value)
         for k, vlist in zip(keys, values):
             if k not in self._store:
@@ -362,9 +378,9 @@ class DistAsyncKVStore(KVStore):
                 self._client.init(k, v.asnumpy())
         self.barrier()
 
-    def push(self, key, value, priority=0):
+    def _push_impl(self, key, value, priority):
         if self._client is None:
-            return super().push(key, value, priority)
+            return super()._push_impl(key, value, priority)
         keys, values = _key_value_list(key, value)
         for k, vlist in zip(keys, values):
             merged = vlist[0]
@@ -376,9 +392,9 @@ class DistAsyncKVStore(KVStore):
                 merged = self._compression.compress_decompress(k, merged)
             self._client.push(k, merged.asnumpy())
 
-    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+    def _pull_impl(self, key, out, priority, ignore_sparse):
         if self._client is None:
-            return super().pull(key, out, priority, ignore_sparse)
+            return super()._pull_impl(key, out, priority, ignore_sparse)
         assert out is not None
         keys, outs = _key_value_list(key, out)
         for k, olist in zip(keys, outs):

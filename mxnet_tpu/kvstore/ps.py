@@ -354,8 +354,12 @@ class PSServer:
             profiler.set_config(**kwargs)
         elif fn == "set_state":
             profiler.set_state(**kwargs)
+        elif fn == "pause":
+            profiler.pause(**kwargs)
+        elif fn == "resume":
+            profiler.resume(**kwargs)
         elif fn == "dump":
-            return profiler.dump()
+            return profiler.dump(**kwargs)
         else:
             raise ValueError("unknown profiler fn %r" % (fn,))
         return None

@@ -8,8 +8,10 @@ from __future__ import annotations
 
 import logging
 import sys
+import time
 
-__all__ = ["get_logger", "getLogger",
+__all__ = ["get_logger", "getLogger", "warn_rate_limited",
+           "reset_rate_limits",
            "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG", "NOTSET"]
 
 CRITICAL = logging.CRITICAL
@@ -57,6 +59,35 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
         logger.propagate = False
     logger._mxtpu_log_init = True
     return logger
+
+
+# key -> monotonic time of the last emitted warning
+_rate_state: dict = {}
+
+
+def warn_rate_limited(logger, key, interval, msg, *args):
+    """``logger.warning(msg, *args)`` at most once per ``interval``
+    seconds per ``key``; returns True when the warning was emitted.
+
+    Telemetry paths (runtime_stats recompile-storm detector) warn from
+    hot loops — without rate limiting a storm of recompiles would also
+    be a storm of log lines."""
+    now = time.monotonic()
+    last = _rate_state.get(key)
+    if last is not None and now - last < interval:
+        return False
+    _rate_state[key] = now
+    logger.warning(msg, *args)
+    return True
+
+
+def reset_rate_limits(prefix=None):
+    """Re-arm rate-limited warnings (all keys, or those under a prefix)."""
+    if prefix is None:
+        _rate_state.clear()
+        return
+    for k in [k for k in _rate_state if k.startswith(prefix)]:
+        del _rate_state[k]
 
 
 def getLogger(name=None, filename=None, filemode=None, level=WARNING):

@@ -8,9 +8,12 @@ hooks / executor outputs instead of engine callbacks.
 from __future__ import annotations
 
 import re
+import time
 
 import numpy as _np
 
+from . import profiler as _profiler
+from . import runtime_stats as _rts
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -42,8 +45,19 @@ class Monitor:
                 for i, o in enumerate(outs):
                     key = "%s_output%d" % (name, i)
                     if self.re_pattern.match(key) and isinstance(o, NDArray):
-                        self.queue.append((self.step, key,
-                                           self.stat_func(o.asnumpy())))
+                        # Monitor is a DELIBERATE host-sync point: the
+                        # stat is computed on host numpy, blocking on the
+                        # device value mid-forward (reference semantics).
+                        # Timed into runtime_stats so traces show what
+                        # the monitor costs the step.
+                        t0 = time.perf_counter()
+                        with _profiler.span("monitor:stat", "monitor",
+                                            args={"key": key}):
+                            value = self.stat_func(o.asnumpy())  # mxlint: disable=trace-host-sync
+                        _rts.inc("monitor_stats")
+                        _rts.inc("monitor_seconds",
+                                 time.perf_counter() - t0)
+                        self.queue.append((self.step, key, value))
             return hook
 
         def attach(blk, path):

@@ -28,9 +28,15 @@ from __future__ import annotations
 
 import numpy as _np
 
+from .. import profiler as _prof
+from .. import runtime_stats as _rts
 from ..base import MXNetError, np_dtype, numeric_types
 from ..context import Context, current_context
 from ..ops import registry as _reg
+
+# dict read on every dispatch: cheapest possible "is the profiler on"
+# check (guard-first — no event/span allocation when it is off)
+_prof_state = _prof._state
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "save", "load", "waitall", "imperative_invoke",
@@ -748,11 +754,19 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
         import jax
 
         fn = op.bind_attrs(attrs)
-        with _op_errors(op_name, arrays):
-            if needs_key:
-                outv, vjp_fn = _vjp_with_aux(fn, arrays)
-            else:
-                outv, vjp_fn = jax.vjp(fn, *arrays)
+        # telemetry is keyed on op.name so aliases (nd.identity vs
+        # '_copy') aggregate into ONE per-op row, matching jitted_ex.
+        # vjp capture bypasses the static jit cache by design — the
+        # span still shows where forward-trace time goes in training
+        _rts.record_dispatch(op.name, "uncached")
+        with _prof.span("dispatch:" + op.name, "operator",
+                        args={"op": op.name, "cache": "bypass-autograd"}
+                        if _prof_state["running"] else None):
+            with _op_errors(op_name, arrays):
+                if needs_key:
+                    outv, vjp_fn = _vjp_with_aux(fn, arrays)
+                else:
+                    outv, vjp_fn = jax.vjp(fn, *arrays)
         result = outv if isinstance(outv, tuple) else (outv,)
         out_nds = _wrap_outputs(result, ctx, out)
         _ag.record_op(inputs, out_nds, vjp_fn, op_name=op_name, attrs=attrs)
@@ -761,25 +775,68 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
     if needs_key:
         # keys vary per call → bypass the static jit cache (jax still
         # compiles the underlying primitives)
-        with _op_errors(op_name, arrays):
-            result = op.bind_attrs(attrs)(*arrays)
-    else:
-        try:
+        _rts.record_dispatch(op.name, "uncached")
+        with _prof.span("dispatch:" + op.name, "operator",
+                        args={"op": op.name, "cache": "bypass-rng"}
+                        if _prof_state["running"] else None):
             with _op_errors(op_name, arrays):
-                result = op.jitted(attrs)(*arrays)
-        except ValueError as e:
-            if "incompatible devices" not in str(e):
-                raise
-            # cross-device inputs (e.g. kvstore reduce over per-device
-            # grads): gather to the first input's device, like the
-            # reference's CommCPU copy-to-reduce (src/kvstore/comm.h:103)
-            import jax
-
-            dev = list(arrays[0].devices())[0]
-            arrays = [jax.device_put(a, dev) for a in arrays]
-            result = op.jitted(attrs)(*arrays)
+                result = op.bind_attrs(attrs)(*arrays)
+    else:
+        result = _dispatch_jit(op, op_name, attrs, arrays)
     result = result if isinstance(result, tuple) else (result,)
     return _wrap_outputs(result, ctx, out)
+
+
+def _dispatch_jit(op, op_name, attrs, arrays):
+    """The jit-cached dispatch path, instrumented.
+
+    Always (profiler on or off): the registry counts the cache hit/miss
+    and storms (inside ``jitted_ex``), and a miss's wall-time — which
+    the trace+XLA-compile dominates, execution being async-dispatched —
+    is attributed to ``runtime_stats`` compile_seconds.  Guard-first:
+    when the profiler is off and the cache hits, the extra cost is one
+    flag read — no timestamps, no event allocation, no host sync."""
+    entry, hit = op.jitted_ex(attrs)
+    cname = op.name  # canonical — jitted_ex counts under this name
+    prof_on = _prof_state["running"]
+    if hit and not prof_on:
+        return _call_jit_entry(op_name, cname, entry, arrays)
+    t0 = _prof._now_us()
+    result = _call_jit_entry(op_name, cname, entry, arrays)
+    dur = _prof._now_us() - t0
+    if not hit:
+        _rts.add_compile_seconds(cname, dur / 1e6)
+    if prof_on:
+        # aval churn recompiles inside the jax.jit entry (registry-level
+        # hit!) — feed shape/dtype signatures to the storm detector
+        _rts.note_aval_key(cname, tuple(
+            (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+            for a in arrays))
+        ev_args = {"op": cname, "cache": "hit" if hit else "miss"}
+        if not hit:
+            ev_args["compile_ms"] = round(dur / 1e3, 3)
+        _prof.add_event("dispatch:" + cname, "operator", "X", ts=t0,
+                        dur=dur, args=ev_args)
+    return result
+
+
+def _call_jit_entry(op_name, cname, entry, arrays):
+    try:
+        with _op_errors(op_name, arrays):
+            return entry(*arrays)
+    except ValueError as e:
+        if "incompatible devices" not in str(e):
+            raise
+        # cross-device inputs (e.g. kvstore reduce over per-device
+        # grads): gather to the first input's device, like the
+        # reference's CommCPU copy-to-reduce (src/kvstore/comm.h:103)
+        import jax
+
+        _rts.record_fallback(cname, "cross-device")
+        dev = list(arrays[0].devices())[0]
+        arrays = [jax.device_put(a, dev) for a in arrays]
+        with _op_errors(op_name, arrays):
+            return entry(*arrays)
 
 
 def _vjp_with_aux(fn, arrays):

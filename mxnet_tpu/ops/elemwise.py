@@ -91,22 +91,29 @@ for _n, _f in _UNARY.items():
 
 @register("softrelu")
 def softrelu(x, **_):
-    # log(1+exp(x)), numerically stable (reference: mshadow_op::softrelu)
+    """Soft-ReLU activation log(1+exp(x)), numerically stable
+    (reference: mshadow_op::softrelu)."""
     return jax.nn.softplus(x)
 
 
 @register("hard_sigmoid")
 def hard_sigmoid(x, alpha=0.2, beta=0.5, **_):
+    """Piecewise-linear sigmoid clip(alpha*x + beta, 0, 1)
+    (reference: hard_sigmoid-inl.h)."""
     return jnp.clip(alpha * x + beta, 0.0, 1.0)
 
 
 @register("clip")
 def clip(x, a_min=None, a_max=None, **_):
+    """Clamp every element into [a_min, a_max]
+    (reference: tensor/matrix_op.cc clip)."""
     return jnp.clip(x, a_min, a_max)
 
 
 @register("Cast", aliases=("cast",))
 def cast(x, dtype="float32", **_):
+    """Element type conversion to ``dtype``
+    (reference: elemwise_unary_op_basic.cc Cast)."""
     from ..base import np_dtype
 
     return x.astype(np_dtype(dtype))
@@ -114,16 +121,22 @@ def cast(x, dtype="float32", **_):
 
 @register("_copy", aliases=("identity",))
 def identity(x, **_):
+    """Identity / copy (reference: elemwise_unary_op_basic.cc _copy);
+    XLA elides the no-op under jit."""
     return x
 
 
 @register("BlockGrad", aliases=("stop_gradient", "block_grad"))
 def stop_gradient(x, **_):
+    """Identity forward, zero gradient backward
+    (reference: BlockGrad, elemwise_unary_op_basic.cc)."""
     return lax.stop_gradient(x)
 
 
 @register("make_loss", aliases=("MakeLoss",))
 def make_loss(x, **_):
+    """Mark an output as a loss head: identity value whose gradient
+    seeds backward with ones (reference: make_loss, MakeLoss op)."""
     return x
 
 
@@ -179,6 +192,8 @@ for _n, _f in _BINARY.items():
 
 @register("_scatter_elemwise_div")
 def scatter_elemwise_div(a, b, **_):
+    """Elementwise division with sparse-aware storage in the reference
+    (elemwise_binary_op_basic.cc _scatter_elemwise_div); dense here."""
     return a / b
 
 
@@ -228,7 +243,8 @@ for _n, _f in _SCALAR.items():
 
 @register("smooth_l1", traced_attrs=("scalar",))
 def smooth_l1(x, scalar=1.0, **_):
-    # reference: mshadow_op::smooth_l1_loss with sigma=scalar
+    """Smooth-L1 (Huber) loss with sigma=scalar
+    (reference: mshadow_op::smooth_l1_loss)."""
     s2 = scalar * scalar
     ax = jnp.abs(x)
     return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
@@ -241,6 +257,8 @@ def smooth_l1(x, scalar=1.0, **_):
 
 @register("add_n", aliases=("ElementWiseSum", "_sum_multi"))
 def add_n(*args, **_):
+    """Sum of N same-shape tensors in one fused kernel — the kvstore
+    push-reduce primitive (reference: ElementWiseSumCompute)."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     out = args[0]
@@ -251,6 +269,8 @@ def add_n(*args, **_):
 
 @register("where")
 def where(condition, x, y, **_):
+    """Select x where condition is nonzero else y; a 1-D condition
+    selects whole rows (reference: control_flow_op.cc where)."""
     if condition.ndim < x.ndim and condition.ndim == 1:
         # reference allows 1-D condition selecting rows
         shape = (condition.shape[0],) + (1,) * (x.ndim - 1)

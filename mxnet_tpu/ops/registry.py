@@ -26,6 +26,7 @@ import functools
 import jax
 import numpy as _np
 
+from .. import runtime_stats as _stats
 from ..base import MXNetError
 
 __all__ = ["Op", "register", "get", "list_ops", "apply_op"]
@@ -137,6 +138,16 @@ class Op:
         Attrs named in ``traced_attrs`` (when numeric) are fed to the
         compiled fn as weak-typed scalar arguments — the cache key holds
         only their *names*, so a changing value reuses the executable."""
+        return self.jitted_ex(attrs)[0]
+
+    def jitted_ex(self, attrs):
+        """:meth:`jitted` plus the jit-cache hit flag.
+
+        The dispatch layer uses the miss flag to attribute compile
+        wall-time (runtime_stats counters, profiler miss spans); every
+        miss also registers its cache key with the recompile-storm
+        detector.  The telemetry cost on the hit path is one dict
+        lookup and two integer increments."""
         traced = {k: v for k, v in attrs.items()
                   if k in self.traced_attrs
                   and isinstance(v, (int, float))
@@ -144,15 +155,19 @@ class Op:
         if not traced:
             key = tuple(sorted(attrs.items()))
             entry = self._jit_cache.get(key)
-            if entry is None:
+            hit = entry is not None
+            if not hit:
                 entry = jax.jit(self.bind_attrs(attrs))
                 self._jit_cache[key] = entry
-            return entry
+                _stats.record_compile_key(self.name, key)
+            _stats.record_dispatch(self.name, "hit" if hit else "miss")
+            return entry, hit
         static = {k: v for k, v in attrs.items() if k not in traced}
         tnames = tuple(sorted(traced))
         key = (tuple(sorted(static.items())), tnames)
         entry = self._jit_cache.get(key)
-        if entry is None:
+        hit = entry is not None
+        if not hit:
             fn = self.fn
 
             def call(arrays, tvals):
@@ -162,10 +177,12 @@ class Op:
 
             entry = jax.jit(call)
             self._jit_cache[key] = entry
+            _stats.record_compile_key(self.name, key)
+        _stats.record_dispatch(self.name, "hit" if hit else "miss")
         # python floats stay weak-typed under tracing: no recompile across
         # values AND no dtype promotion of bf16/fp16 tensors
         tvals = tuple(float(traced[k]) for k in tnames)
-        return functools.partial(_call_traced, entry, tvals)
+        return functools.partial(_call_traced, entry, tvals), hit
 
     def nout(self, attrs):
         if callable(self.num_outputs):
@@ -237,11 +254,33 @@ def list_ops():
 
 def apply_op(name, *arrays, **attrs):
     """Eagerly apply a registered op to raw jax arrays."""
+    from .. import profiler as _prof
+
     op = get(name)
     attrs = op.canonicalize_attrs(attrs)
+    counted = False
     try:
-        return op.jitted(attrs)(*arrays)
+        entry, hit = op.jitted_ex(attrs)  # counts the call (hit/miss)
+        counted = True
+        if hit and not _prof._state["running"]:  # guard-first fast path
+            return entry(*arrays)
+        t0 = _prof._now_us()
+        result = entry(*arrays)
+        dur = _prof._now_us() - t0
+        if not hit:
+            _stats.add_compile_seconds(op.name, dur / 1e6)
+        ev_args = {"op": op.name, "cache": "hit" if hit else "miss"}
+        if not hit:
+            ev_args["compile_ms"] = round(dur / 1e3, 3)
+        _prof.add_event("dispatch:" + op.name, "operator", "X", ts=t0,
+                        dur=dur, args=ev_args)
+        return result
     except TypeError:
         # attrs that fail jit staging (e.g. unhashable leftovers) fall back
-        # to op-by-op eager tracing
+        # to op-by-op eager tracing.  An unhashable cache key raises out
+        # of jitted_ex before the call is counted — count it here so
+        # calls >= fallbacks always holds in snapshot()
+        if not counted:
+            _stats.record_dispatch(op.name, "uncached")
+        _stats.record_fallback(op.name, "eager-trace")
         return op.bind_attrs(attrs)(*arrays)

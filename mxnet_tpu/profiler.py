@@ -101,28 +101,66 @@ def add_event(name, cat, ph, ts=None, pid=0, tid=None, args=None, dur=None):
 class scope:
     """``with profiler.scope('fwd'):`` records a complete event."""
 
-    def __init__(self, name, cat="framework"):
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name, cat="framework", args=None):
         self.name = name
         self.cat = cat
+        self.args = args
 
     def __enter__(self):
         self.t0 = _now_us()
         return self
 
     def __exit__(self, *a):
-        add_event(self.name, self.cat, "X", ts=self.t0, dur=_now_us() - self.t0)
+        add_event(self.name, self.cat, "X", ts=self.t0,
+                  dur=_now_us() - self.t0, args=self.args)
+        return False
+
+
+class _NullSpan:
+    """Shared do-nothing context manager: the disabled-profiler fast
+    path of :func:`span` — no allocation, no timestamps."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name, cat="framework", args=None):
+    """Guard-first complete-event span for framework hot loops.
+
+    Returns a shared no-op when the profiler is not recording, so
+    instrumented code pays one flag check and no event/span allocation
+    when telemetry is off (the hard constraint of PR 2's tentpole).
+    Exceptions propagate; the event is still recorded."""
+    if not _state["running"]:
+        return _NULL_SPAN
+    return scope(name, cat, args)
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write chrome-tracing JSON (reference: profiler.py dump)."""
+    """Write chrome-tracing JSON; returns the absolute path.
+
+    ``finished=True`` also stops recording (reference semantics:
+    profiler.py dump's `finished` finalizes the profiler)."""
     if profile_process == "server":
-        return _server_command("dump", {})
+        return _server_command("dump", {"finished": finished})
+    if finished:
+        _state["running"] = False
     fname = _state["config"].get("filename", "profile.json")
     with _state["lock"]:
         events = list(_state["events"])
     with open(fname, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    return fname
+    return os.path.abspath(fname)
 
 
 def dumps(reset=False):
@@ -152,10 +190,19 @@ def dumps(reset=False):
 
 
 def pause(profile_process="worker"):
+    """Stop recording without clearing events (reference: profiler.py
+    pause → MXProfilePause).  ``profile_process='server'`` forwards to
+    the parameter-server processes like ``set_state`` does."""
+    if profile_process == "server":
+        return _server_command("pause", {})
     _state["running"] = False
 
 
 def resume(profile_process="worker"):
+    """Resume a paused recording; ``profile_process='server'`` forwards
+    to the parameter-server processes like ``set_state`` does."""
+    if profile_process == "server":
+        return _server_command("resume", {})
     _state["running"] = True
 
 
@@ -266,3 +313,29 @@ class Marker:
     def mark(self, scope="process"):
         add_event(self.name, self.domain.name, "i",
                   args={"scope": scope})
+
+
+# ------------------------------------------------------- env activation
+
+
+def _dump_at_exit():
+    if _state["running"] or _state["events"]:
+        dump(finished=True)
+
+
+def _activate_from_env():
+    """``MXNET_TPU_PROFILE=<file>``: record the whole process and dump
+    the chrome trace at exit — zero-code-change profiling of any
+    training script (docs/OBSERVABILITY.md)."""
+    fname = os.environ.get("MXNET_TPU_PROFILE")
+    if not fname:
+        return False
+    import atexit
+
+    set_config(filename=fname, profile_all=True)
+    set_state("run")
+    atexit.register(_dump_at_exit)
+    return True
+
+
+_activate_from_env()

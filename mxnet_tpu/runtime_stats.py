@@ -1,0 +1,282 @@
+"""Always-cheap runtime counters + the recompile-storm detector.
+
+``profiler.py`` records *events* (chrome-trace spans) and pays an
+allocation per event, so it is opt-in; this module is the always-on
+complement: monotonic counters bumped from the dispatch hot path with
+plain dict increments (GIL-atomic, no locks, no allocation), readable
+at any time via :func:`snapshot` / :func:`report` even when the
+profiler is off.
+
+Feeding layers (PR 2): ``ops/registry.py`` (jit-cache hit/miss and the
+cache key of every compile), ``ndarray`` imperative dispatch (compile
+wall-time, fallback/uncached paths), ``executor`` / Gluon ``Trainer`` /
+``io`` / ``kvstore`` (step anatomy counters), and ``monitor.py``
+(deliberate host-sync overhead).
+
+Recompile-storm detector: every jit-cache miss registers the cache key
+that missed.  When one op accumulates more than :data:`STORM_THRESHOLD`
+compiles, a rate-limited warning (through ``log.py``) names the attr
+key component that churned — per-step recompiles are the canonical
+silent 100x slowdown on XLA backends ("Operator Fusion in XLA",
+arXiv:2301.13062).  When the profiler is running the dispatch layer
+additionally feeds input aval signatures, so shape/dtype churn (which
+recompiles *inside* an existing jax.jit entry) is detected too.
+
+Environment variables
+---------------------
+``MXNET_TPU_RECOMPILE_STORM_THRESHOLD``  compiles per op before the
+    storm warning fires (default 8; ``0`` disables the detector).
+``MXNET_TPU_RECOMPILE_STORM_INTERVAL``   minimum seconds between storm
+    warnings for the same op (default 30).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .log import get_logger, warn_rate_limited
+
+__all__ = ["snapshot", "report", "reset", "inc",
+           "record_dispatch", "record_compile_key", "add_compile_seconds",
+           "record_fallback", "note_aval_key",
+           "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
+
+STORM_THRESHOLD = int(os.environ.get(
+    "MXNET_TPU_RECOMPILE_STORM_THRESHOLD", "8"))
+STORM_WARN_INTERVAL = float(os.environ.get(
+    "MXNET_TPU_RECOMPILE_STORM_INTERVAL", "30"))
+
+# recent cache keys kept per op for churn diagnosis
+_STORM_KEY_WINDOW = 8
+# distinct aval signatures remembered per op; saturates so a long
+# profiled run with genuinely dynamic shapes cannot grow unboundedly
+# (the storm warning fires at STORM_THRESHOLD, far below this cap)
+_AVAL_CAP = 64
+
+# name -> {"calls", "hits", "misses", "uncached", "fallbacks",
+#          "compile_seconds"}.  Increments are plain unsynchronized
+# dict read-modify-writes: no locks on the hot path by design, so
+# concurrent dispatch from other threads (PS server updater, prefetch
+# workers) may drop the occasional count.  Counters are exact on a
+# single thread (what the tests/bench assert) and best-effort
+# diagnostics under concurrency.
+_PER_OP: dict = {}
+# generic named counters (trainer_steps, io_batches, monitor_seconds…)
+_COUNTERS: dict = {}
+# name -> {"compiles", "keys", "avals", "warned"}
+_STORM: dict = {}
+
+_logger_cache = []
+
+
+def _logger():
+    if not _logger_cache:
+        _logger_cache.append(get_logger("mxnet_tpu.runtime_stats"))
+    return _logger_cache[0]
+
+
+def _op_stats(name):
+    s = _PER_OP.get(name)
+    if s is None:
+        s = _PER_OP[name] = {"calls": 0, "hits": 0, "misses": 0,
+                             "uncached": 0, "fallbacks": 0,
+                             "compile_seconds": 0.0}
+    return s
+
+
+# ------------------------------------------------------------ hot path
+
+
+def record_dispatch(name, kind):
+    """One op dispatch: ``kind`` is ``"hit"`` / ``"miss"`` (jit cache)
+    or ``"uncached"`` (autograd vjp capture, per-call RNG keys — paths
+    that bypass the static cache by design)."""
+    s = _PER_OP.get(name)
+    if s is None:
+        s = _op_stats(name)
+    s["calls"] += 1
+    if kind == "hit":
+        s["hits"] += 1
+    elif kind == "miss":
+        s["misses"] += 1
+    else:
+        s["uncached"] += 1
+
+
+def record_compile_key(name, key):
+    """Called by the op registry on every jit-cache miss with the cache
+    key that missed; drives the recompile-storm detector."""
+    st = _STORM.get(name)
+    if st is None:
+        st = _STORM[name] = {"compiles": 0, "keys": [], "avals": set(),
+                             "warned": 0}
+    st["compiles"] += 1
+    st["keys"].append(key)
+    if len(st["keys"]) > _STORM_KEY_WINDOW:
+        del st["keys"][0]
+    if STORM_THRESHOLD and st["compiles"] > STORM_THRESHOLD:
+        _maybe_warn_storm(
+            name, st,
+            "compiled %d times (threshold %d); churning %s"
+            % (st["compiles"], STORM_THRESHOLD,
+               _describe_attr_churn(st["keys"])))
+
+
+def add_compile_seconds(name, seconds):
+    """Attribute compile wall-time to an op (measured by the dispatch
+    layer as the duration of the jit-cache-miss call: trace + XLA
+    compile dominate; execution is async-dispatched)."""
+    _op_stats(name)["compile_seconds"] += seconds
+
+
+def record_fallback(name, kind):
+    """A dispatch left the compiled path: ``"eager-trace"`` (attrs that
+    fail jit staging) or ``"cross-device"`` (inputs gathered to one
+    device and retried)."""
+    _op_stats(name)["fallbacks"] += 1
+    k = "fallback:" + kind
+    _COUNTERS[k] = _COUNTERS.get(k, 0) + 1
+
+
+def note_aval_key(name, aval_key):
+    """Track distinct input shape/dtype signatures per op (fed by the
+    dispatch layer only while the profiler runs — aval churn recompiles
+    inside an existing jax.jit entry, invisible to the registry cache).
+    The per-op set saturates at ``_AVAL_CAP`` signatures, so
+    ``distinct_avals`` in :func:`snapshot` is exact up to the cap."""
+    st = _STORM.get(name)
+    if st is None:
+        st = _STORM[name] = {"compiles": 0, "keys": [], "avals": set(),
+                             "warned": 0}
+    avals = st["avals"]
+    if aval_key in avals or len(avals) >= _AVAL_CAP:
+        return
+    avals.add(aval_key)
+    if STORM_THRESHOLD and len(avals) > STORM_THRESHOLD:
+        _maybe_warn_storm(
+            name, st,
+            "saw %d distinct input shape/dtype signatures (threshold %d; "
+            "latest: %s); churning input avals — each one compiles inside "
+            "the op's jax.jit entry"
+            % (len(avals), STORM_THRESHOLD, _fmt_aval(aval_key)))
+
+
+def inc(name, delta=1):
+    """Bump a generic named counter (int or float delta)."""
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
+
+
+# ------------------------------------------------------- storm detector
+
+
+def _maybe_warn_storm(name, st, detail):
+    if warn_rate_limited(
+            _logger(), "recompile-storm:" + name, STORM_WARN_INTERVAL,
+            "recompile storm: op %r %s.  Every recompile stalls dispatch "
+            "for a full XLA compile — hoist per-step attrs into "
+            "traced_attrs or stabilize input shapes "
+            "(docs/OBSERVABILITY.md).",
+            name, detail):
+        st["warned"] += 1
+
+
+def _attr_pairs(key):
+    """The (attr, value) pairs of a registry cache key, if it has the
+    attr-key shape; handles both the plain and traced-attr key forms."""
+    if not isinstance(key, tuple):
+        return None
+    if len(key) == 2 and isinstance(key[0], tuple) and \
+            isinstance(key[1], tuple) and \
+            all(isinstance(p, tuple) and len(p) == 2 and
+                isinstance(p[0], str) for p in key[0]) and \
+            all(isinstance(n, str) for n in key[1]):
+        return key[0]  # traced form: ((static pairs), traced names)
+    if all(isinstance(p, tuple) and len(p) == 2 and isinstance(p[0], str)
+           for p in key):
+        return key
+    return None
+
+
+def _describe_attr_churn(keys):
+    seen: dict = {}
+    for k in keys:
+        pairs = _attr_pairs(k)
+        if pairs is None:
+            continue
+        for a, v in pairs:
+            try:
+                seen.setdefault(a, set()).add(v)
+            except TypeError:  # unhashable normalized value; count repr
+                seen.setdefault(a, set()).add(repr(v))
+    churned = sorted(a for a, vs in seen.items() if len(vs) > 1)
+    if churned:
+        return "attr key component(s): %s" % ", ".join(churned)
+    return "cache key (attrs stable across recent keys; suspect input " \
+           "avals or key structure)"
+
+
+def _fmt_aval(aval_key):
+    try:
+        return ", ".join("%s%s" % (dt, list(sh)) for sh, dt in aval_key)
+    except (TypeError, ValueError):
+        return repr(aval_key)
+
+
+# ---------------------------------------------------------- read side
+
+
+def snapshot():
+    """A consistent copy of every counter: ``{"ops": {...}, "totals":
+    {...}, "counters": {...}, "storms": {...}}``.  Works with the
+    profiler off — this is the always-on view."""
+    ops = {name: dict(s) for name, s in _PER_OP.items()}
+    totals = {"op_calls": 0, "jit_cache_hits": 0, "jit_cache_misses": 0,
+              "uncached_calls": 0, "fallbacks": 0, "compile_seconds": 0.0}
+    for s in ops.values():
+        totals["op_calls"] += s["calls"]
+        totals["jit_cache_hits"] += s["hits"]
+        totals["jit_cache_misses"] += s["misses"]
+        totals["uncached_calls"] += s["uncached"]
+        totals["fallbacks"] += s["fallbacks"]
+        totals["compile_seconds"] += s["compile_seconds"]
+    storms = {name: {"compiles": st["compiles"], "warned": st["warned"],
+                     "distinct_avals": len(st["avals"])}
+              for name, st in _STORM.items()}
+    return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
+            "storms": storms}
+
+
+def report():
+    """Text table of the snapshot (op rows sorted by calls desc)."""
+    snap = snapshot()
+    lines = ["%-32s %9s %9s %7s %9s %10s %11s"
+             % ("Op", "Calls", "Hits", "Misses", "Uncached",
+                "Fallbacks", "Compile(s)")]
+    for name, s in sorted(snap["ops"].items(),
+                          key=lambda kv: -kv[1]["calls"]):
+        lines.append("%-32s %9d %9d %7d %9d %10d %11.3f"
+                     % (name[:32], s["calls"], s["hits"], s["misses"],
+                        s["uncached"], s["fallbacks"], s["compile_seconds"]))
+    t = snap["totals"]
+    lines.append("%-32s %9d %9d %7d %9d %10d %11.3f"
+                 % ("TOTAL", t["op_calls"], t["jit_cache_hits"],
+                    t["jit_cache_misses"], t["uncached_calls"],
+                    t["fallbacks"], t["compile_seconds"]))
+    if snap["counters"]:
+        lines.append("")
+        lines.append("%-32s %12s" % ("Counter", "Value"))
+        for name, v in sorted(snap["counters"].items()):
+            lines.append("%-32s %12s"
+                         % (name[:32],
+                            ("%.3f" % v) if isinstance(v, float) else v))
+    return "\n".join(lines)
+
+
+def reset():
+    """Zero every counter and re-arm the storm detector (tests)."""
+    from .log import reset_rate_limits
+
+    _PER_OP.clear()
+    _COUNTERS.clear()
+    _STORM.clear()
+    reset_rate_limits("recompile-storm:")

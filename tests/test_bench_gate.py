@@ -169,6 +169,42 @@ def test_provision_devices_delegates_without_touching_jax(monkeypatch):
     assert seen["env"]["_MXTPU_DRYRUN_REEXEC"] == "1"
 
 
+def test_disabled_instrumentation_dispatch_overhead_bound():
+    """PR 2 gate: telemetry must be pay-for-use.  With the profiler off
+    and the jit cache hot, imperative dispatch must (a) allocate zero
+    profiler events and (b) keep per-call host time within noise of the
+    seed's dispatch path.  (b) is enforced as a generous absolute bound:
+    the added guard is one dict read + two counter increments (~1µs),
+    while the whole dispatch costs ~50-200µs on CI CPU — the bound only
+    trips if always-on instrumentation grows real per-call work."""
+    import time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler, runtime_stats
+
+    assert not profiler.is_running()
+    x = mx.nd.ones((8, 8))
+    for _ in range(3):
+        mx.nd.clip(x, -2.03125, 2.03125)  # warm the jit cache
+    n_events = len(profiler._state["events"])
+
+    n_calls = 200
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            mx.nd.clip(x, -2.03125, 2.03125)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+
+    assert len(profiler._state["events"]) == n_events, \
+        "disabled profiler must not allocate events on the hot path"
+    assert best < 2e-3, \
+        "cached dispatch with telemetry off took %.1fus/call" % (best * 1e6)
+    # the always-on counter layer must have seen every call
+    st = runtime_stats.snapshot()["ops"]["clip"]
+    assert st["calls"] >= 5 * n_calls
+
+
 def test_prior_round_values_skips_failed_round_records(tmp_path,
                                                        monkeypatch):
     """A failed round records "parsed": null (r4's wedged-relay

@@ -237,6 +237,26 @@ def test_profiler_example_writes_trace():
     assert len(events) >= 2
 
 
+def test_runtime_telemetry_example_anatomy():
+    """PR-2 telemetry walkthrough (example/profiler/runtime_telemetry.py):
+    the trace shows the step anatomy and counters agree with the trace
+    (the script asserts misses == trace-miss spans itself)."""
+    import json
+
+    from mxnet_tpu import profiler, runtime_stats
+
+    try:
+        path = _run_example("profiler/runtime_telemetry.py", [])
+    finally:
+        profiler.set_state("stop")
+        profiler._state["events"] = []
+        runtime_stats.reset()
+    trace = json.load(open(path))["traceEvents"]
+    names = {e["name"] for e in trace}
+    assert {"io:next_batch", "trainer:step", "autograd:backward"} <= names
+    assert any(e["name"].startswith("dispatch:") for e in trace)
+
+
 def test_reinforce_gridworld_learns():
     """RL training loop (reference: example/reinforcement-learning/):
     REINFORCE reaches the optimal return on the toy gridworld."""
