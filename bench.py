@@ -65,14 +65,26 @@ DEVICE_FLOOR_IMG_S = {(128, "NHWC"): 2650.0}
 # measured on; absolute-throughput gating on any other backend would
 # fail a healthy-but-different environment (ADVICE r4 #4)
 RECORDED_PLATFORM = "tpu"
-# relay probe: each probe child gets the full PROBE_TIMEOUT (a healthy
-# relay can take minutes to answer on cold start); only TIMED-OUT
-# probes retry, until PROBE_WINDOW elapses — a transiently wedged relay
-# then delays the round's number instead of erasing it (r4: one
-# no-retry probe -> rc=1 artifact).  A probe child that EXITS non-zero
-# is a deterministic environment failure and fails fast.
+# relay probing (r4/r5 post-mortems): a wedged relay must neither hang
+# the parent (jax.devices() blocks in non-interruptible C code) nor
+# burn the driver's whole budget on retries (r5: two 600 s probes ->
+# the DRIVER killed the round, rc=124, "parsed": null).  Scheme: a
+# cheap liveness PING first, then up to MAX_FULL_PROBES full probes,
+# all inside a PROBE_WINDOW budget sized well under the driver's
+# patience.  The WINDOW takes precedence over per-probe patience: the
+# last probe is truncated to the window remainder, because a bounded
+# worst case (no rc=124) matters more than giving a slow relay its
+# full per-probe timeout.  Killing a mid-init probe child (the ping on
+# a >30 s cold start) can itself wedge the relay — accepted: the full
+# probes still give it a chance, and the terminal fallback is an
+# informational record (value null + the last green chained-depth
+# metrics) with exit 0, not a failed round — see emit_wedged_record().
+# A probe child that EXITS non-zero is a deterministic environment
+# failure and fails fast.
+PING_TIMEOUT = 30
 PROBE_TIMEOUT = 600
-PROBE_WINDOW = 45 * 60
+MAX_FULL_PROBES = 2
+PROBE_WINDOW = 15 * 60
 
 
 def prior_round_values(batch, layout, chain_depth=DEVICE_CHAIN):
@@ -123,53 +135,94 @@ def check_regression(name, value, prior, tolerance):
     return True
 
 
-def main():
-    # Watchdog around device acquisition: the TPU relay is this
-    # container's only device path, and killed jax clients can wedge it
-    # server-side (observed r4: every process then hangs inside
-    # jax.devices() in non-interruptible C code — SIGALRM cannot break
-    # it).  Probe in a KILLABLE child first so a wedged relay surfaces
-    # as a clear failure instead of an eternal hang.
+def _probe_once(timeout):
+    """One KILLABLE device-probe child (the TPU relay is this
+    container's only device path, and killed jax clients can wedge it
+    server-side: every process then hangs inside jax.devices() in
+    non-interruptible C code — SIGALRM cannot break it, a child's
+    kill() can).  Returns 'ok'/'timeout'; a child that EXITS non-zero
+    is a deterministic environment failure and raises SystemExit."""
     import subprocess
 
+    try:
+        subprocess.run([sys.executable, "-c",
+                        "import jax; jax.devices()"],
+                       timeout=timeout, check=True,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        return "ok"
+    except subprocess.CalledProcessError:
+        # retrying cannot help a broken jax/plugin init — diagnose now
+        raise SystemExit(
+            "bench: the device probe child exited non-zero (jax "
+            "backend failed to initialize — environment problem, "
+            "not a relay wedge); run `python -c 'import jax; "
+            "jax.devices()'` to see the error.")
+    except subprocess.TimeoutExpired:
+        return "timeout"
+
+
+def probe_relay():
+    """True when the relay answered a probe; False when it looks
+    wedged.  A cheap PING_TIMEOUT liveness ping settles the healthy
+    case in seconds; only then do up to MAX_FULL_PROBES full-timeout
+    probes run, capped by the PROBE_WINDOW budget so the whole probe
+    phase stays well under the bench driver's patience (r5: unbounded
+    600 s retries got the round killed with rc=124)."""
     deadline = time.monotonic() + PROBE_WINDOW
-    attempt = 0
-    while True:
-        attempt += 1
-        try:
-            subprocess.run([sys.executable, "-c",
-                            "import jax; jax.devices()"],
-                           timeout=PROBE_TIMEOUT, check=True,
-                           stdout=subprocess.DEVNULL,
-                           stderr=subprocess.DEVNULL)
+    if _probe_once(PING_TIMEOUT) == "ok":
+        return True
+    print("bench: relay liveness ping timed out after %ds; escalating "
+          "to full probes" % PING_TIMEOUT, file=sys.stderr)
+    for attempt in range(1, MAX_FULL_PROBES + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
             break
-        except subprocess.CalledProcessError:
-            # the child EXITED non-zero: jax/plugin init is broken, not
-            # a wedged relay — retrying cannot help, diagnose now
-            raise SystemExit(
-                "bench: the device probe child exited non-zero (jax "
-                "backend failed to initialize — environment problem, "
-                "not a relay wedge); run `python -c 'import jax; "
-                "jax.devices()'` to see the error.")
-        except subprocess.TimeoutExpired:
-            if time.monotonic() >= deadline:
-                prior = prior_round_values(
-                    int(sys.argv[1]) if len(sys.argv) > 1 else 128,
-                    sys.argv[3] if len(sys.argv) > 3 else "NHWC")
-                last = (" Last green record: %s headline=%.1f img/s, "
-                        "device=%s img/s." % (prior[0], prior[1], prior[2])
-                        if prior else "")
-                raise SystemExit(
-                    "bench: TPU relay unreachable after %d probes over "
-                    "%d min (wedged relay — killed jax clients hold the "
-                    "single session server-side; see BENCH_NOTES 'Relay "
-                    "variance'). Re-run once the relay recovers.%s"
-                    % (attempt, PROBE_WINDOW // 60, last))
-            print("bench: relay probe %d timed out after %ds; retrying "
-                  "(%d min left in probe window)"
-                  % (attempt, PROBE_TIMEOUT,
-                     int((deadline - time.monotonic()) / 60)),
-                  file=sys.stderr)
+        t = int(min(PROBE_TIMEOUT, max(1, remaining)))
+        if _probe_once(t) == "ok":
+            return True
+        print("bench: relay probe %d/%d timed out after %ds"
+              % (attempt, MAX_FULL_PROBES, t), file=sys.stderr)
+    return False
+
+
+def emit_wedged_record(batch, layout):
+    """Wedged-relay fallback: print ONE parseable JSON record with
+    ``value: null`` (prior_round_values skips null-valued records, so
+    no future gate compares against it) carrying the last green
+    round's headline and chained-depth device metrics informationally,
+    and report success — a wedged relay costs the round its fresh
+    number, it must not fail the round (r4 rc=1 / r5 rc=124
+    artifacts)."""
+    prior = prior_round_values(batch, layout)
+    rec = {
+        "metric": "resnet50_v1 training img/s (bs=%d, bf16 compute, %s, "
+                  "1 chip, median of 3)" % (batch, layout),
+        "value": None,
+        "unit": "img/s",
+        "device_value": None,
+        "device_metric": "device-only img/s (%d steps chained in one "
+                         "jit, host-fetch barrier, median of 3)"
+                         % DEVICE_CHAIN,
+        "relay": "wedged",
+    }
+    if prior:
+        rec["last_green"] = {"file": prior[0], "value": prior[1],
+                             "device_value": prior[2]}
+    print(json.dumps(rec))
+    print("bench: TPU relay unreachable (wedged — killed jax clients "
+          "hold the single session server-side; see BENCH_NOTES 'Relay "
+          "variance'); recorded the last green chained-depth metrics "
+          "informationally instead of failing the round.",
+          file=sys.stderr)
+
+
+def main():
+    batch_arg = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    layout_arg = sys.argv[3] if len(sys.argv) > 3 else "NHWC"
+    if not probe_relay():
+        emit_wedged_record(batch_arg, layout_arg)
+        return
 
     import jax
 
@@ -180,9 +233,8 @@ def main():
     from mxnet_tpu.parallel.gluon_step import GluonTrainStep
     from mxnet_tpu.parallel.mesh import create_mesh
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    batch, layout = batch_arg, layout_arg  # parsed before the probe
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    layout = sys.argv[3] if len(sys.argv) > 3 else "NHWC"
 
     devices = jax.devices()[:1]  # single-chip benchmark
     mesh = create_mesh({"dp": 1}, devices=devices)

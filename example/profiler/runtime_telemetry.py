@@ -1,12 +1,14 @@
-"""PR-2 telemetry walkthrough: a ~20-step Gluon training loop whose
-chrome trace shows the full step anatomy (dispatch cache hit/miss, io,
-autograd, trainer), plus the always-on runtime_stats counters and the
+"""Telemetry walkthrough: a ~20-step Gluon training loop whose chrome
+trace shows the full step anatomy (dispatch cache hit/miss, io,
+autograd, trainer) AND a live/peak device-memory timeline, plus the
+always-on runtime_stats counters, per-op XLA cost analytics, and the
 recompile-storm detector.
 
-Run directly (the script activates the profiler itself), or with zero
-code changes on any script via the env var:
+Run directly (the script activates the profiler and buffer tracker
+itself), or with zero code changes on any script via the env vars:
 
     MXNET_TPU_PROFILE=trace.json python your_train.py
+    MXNET_TPU_DIAG=diag.json     python your_train.py   # + kill -USR1
 
 Docs: docs/OBSERVABILITY.md.
 """
@@ -19,7 +21,8 @@ import tempfile
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon, profiler, runtime_stats
+from mxnet_tpu import (autograd, device_memory, gluon, profiler,
+                       runtime_stats)
 
 
 def main(argv=None):
@@ -33,10 +36,13 @@ def main(argv=None):
     if not os.environ.get("MXNET_TPU_PROFILE"):
         profiler.set_config(filename=out)
         profiler.set_state("run")
-    # start both layers from zero so the trace/counter cross-check at
-    # the end is exact (dumps(reset=True) drains any prior events)
+    # start all layers from zero so the trace/counter cross-check at
+    # the end is exact (dumps(reset=True) drains any prior events);
+    # the tracker is on BEFORE the loop so parameter buffers count
     profiler.dumps(reset=True)
     runtime_stats.reset()
+    device_memory.reset()
+    device_memory.start()
 
     # ---- a small imperative training loop, fully instrumented
     net = gluon.nn.Dense(4)
@@ -73,11 +79,24 @@ def main(argv=None):
                  if e.get("args", {}).get("cache") == "miss")
     print("dispatch spans: %d cache hits, %d misses" % (hits, misses))
 
+    mem_events = [e for e in trace if e.get("ph") == "C"
+                  and e["name"] == "device_memory"]
+    print("memory counter events: %d (open the trace: a live/peak-bytes"
+          " track renders alongside the spans)" % len(mem_events))
+
     print("\nruntime_stats.report():")
     print(runtime_stats.report())
     snap = runtime_stats.snapshot()
     assert snap["totals"]["jit_cache_misses"] == misses, \
         "trace and counters must agree on compiles"
+    assert snap["memory"]["totals"]["peak_bytes"] > 0
+
+    # the production diagnostic: same picture, one atomic JSON file
+    # (a live run does this on SIGUSR1 when MXNET_TPU_DIAG is set)
+    diag = runtime_stats.dump_diag(os.path.join(
+        tempfile.gettempdir(), "runtime_telemetry_diag.json"))
+    print("\ndiag dump: %s (pretty-print: python -m "
+          "mxnet_tpu.runtime_stats %s)" % (diag, diag))
     return path
 
 

@@ -58,7 +58,7 @@ _OPTIONAL = [
     "cached_op", "profiler", "runtime", "test_utils", "visualization",
     "parallel", "contrib", "model", "image", "operator", "monitor",
     "executor_manager", "rtc", "engine", "predictor", "rnn", "log",
-    "util", "name", "attribute", "runtime_stats",
+    "util", "name", "attribute", "runtime_stats", "device_memory",
 ]
 
 

@@ -1,0 +1,215 @@
+"""Device-buffer tracker — live HBM accounting for NDArray buffers.
+
+``runtime_stats`` counts *work* (dispatches, compiles); this module
+counts *bytes*: every device buffer wrapped by an ``NDArray`` is
+registered here (deduplicated by buffer identity, so views/aliases of
+one buffer count once) and un-registered by a ``weakref.finalize``
+callback when the buffer dies.  That yields live bytes / live count /
+peak bytes / cumulative allocated, broken down per creating op and per
+dtype — the in-process analog of a device memory profiler, with zero
+change to array lifetimes (weak references only; sizes come from the
+buffer's shape/dtype aval, never from a device read).
+
+Cost model (PR 2's guard-first contract, pinned by
+``tests/test_bench_gate.py``): tracking is OFF by default and every
+hook site pays one dict read when it is off.  When ON, an allocation
+costs a few dict increments plus one ``weakref.finalize`` registration;
+when additionally the profiler is recording, each alloc/free emits a
+chrome-trace counter ("C") event so traces show a live/peak-bytes
+timeline alongside the dispatch spans (``docs/OBSERVABILITY.md``).
+
+Attribution: the dispatch layer (``ndarray.imperative_invoke``) labels
+output buffers with the creating op's canonical name via
+:func:`set_origin`; creation helpers (``array``/``zeros``/...) label
+themselves; anything else lands in the ``"<wrap>"`` bucket.
+
+Concurrency: like ``runtime_stats``, increments are plain GIL-atomic
+dict read-modify-writes — exact on a single thread, best-effort under
+concurrent dispatch.  Finalizers may run from any thread at GC time.
+
+Environment: ``MXNET_TPU_MEMORY_TRACK=1`` enables tracking from import;
+``MXNET_TPU_DIAG=<file>`` (the diagnostic-dump env, see
+``runtime_stats``) enables it too so the dump's memory section is
+populated in production runs.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+
+from . import profiler as _prof
+
+__all__ = ["start", "stop", "reset", "is_enabled", "track", "set_origin",
+           "snapshot", "emit_counter"]
+
+_state = {"on": False}
+
+# id(buffer) -> (nbytes, op, dtype, finalizer) for every live tracked
+# buffer.  id() reuse is safe: the finalizer removes the entry before
+# CPython can hand the address to a new object.
+_live: dict = {}
+_totals = {"live_bytes": 0, "live_count": 0, "peak_bytes": 0,
+           "allocated_bytes": 0, "allocations": 0,
+           "freed_bytes": 0, "frees": 0}
+# op/dtype -> {"live_bytes", "live_count", "peak_bytes",
+#              "allocated_bytes", "allocations"}
+_per_op: dict = {}
+_per_dtype: dict = {}
+
+# creating-op label for the next tracked buffer(s); written by the
+# dispatch layer (only while tracking is on) around output wrapping
+_origin = [None]
+
+_tracer_cls = []  # cached jax.core.Tracer, resolved on first track()
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def start():
+    """Begin tracking buffers wrapped from now on (idempotent)."""
+    _state["on"] = True
+
+
+def stop():
+    """Stop tracking new buffers.  Already-tracked buffers keep their
+    finalizers, so live counts stay correct as they die."""
+    _state["on"] = False
+
+
+def set_origin(op):
+    """Label subsequently tracked buffers with creating op ``op``;
+    returns the previous label so callers can restore it."""
+    prev = _origin[0]
+    _origin[0] = op
+    return prev
+
+
+def _bucket(table, key):
+    b = table.get(key)
+    if b is None:
+        b = table[key] = {"live_bytes": 0, "live_count": 0,
+                          "peak_bytes": 0, "allocated_bytes": 0,
+                          "allocations": 0}
+    return b
+
+
+def _is_concrete_device_array(buf):
+    import jax
+
+    if not _tracer_cls:
+        _tracer_cls.append(jax.core.Tracer)
+    return isinstance(buf, jax.Array) and not isinstance(buf,
+                                                        _tracer_cls[0])
+
+
+def track(buf, op=None):
+    """Register one device buffer (no-op when disabled, deduplicated).
+
+    Size comes from ``shape x dtype.itemsize`` — aval metadata, never a
+    device read, so this is safe on async/undelivered arrays and keeps
+    the compute path host-sync-free (mxlint).
+    """
+    if not _state["on"]:
+        return
+    key = id(buf)
+    if key in _live:
+        return  # alias/view of an already-tracked buffer
+    try:
+        if not _is_concrete_device_array(buf):
+            return  # tracers hold no HBM; host values aren't device mem
+        nbytes = int(buf.size) * int(buf.dtype.itemsize)
+        dtype = str(buf.dtype)
+    except Exception:
+        return  # abstract/exotic value: never let tracking break dispatch
+    if op is None:
+        op = _origin[0] or "<wrap>"
+    fin = weakref.finalize(buf, _on_free, key, nbytes, op, dtype)
+    fin.atexit = False  # accounting only; nothing to flush at exit
+    _live[key] = (nbytes, op, dtype, fin)
+    _totals["live_bytes"] += nbytes
+    _totals["live_count"] += 1
+    _totals["allocated_bytes"] += nbytes
+    _totals["allocations"] += 1
+    if _totals["live_bytes"] > _totals["peak_bytes"]:
+        _totals["peak_bytes"] = _totals["live_bytes"]
+    for table, k in ((_per_op, op), (_per_dtype, dtype)):
+        b = _bucket(table, k)
+        b["live_bytes"] += nbytes
+        b["live_count"] += 1
+        b["allocated_bytes"] += nbytes
+        b["allocations"] += 1
+        if b["live_bytes"] > b["peak_bytes"]:
+            b["peak_bytes"] = b["live_bytes"]
+    emit_counter()
+
+
+def _on_free(key, nbytes, op, dtype):
+    if _live.pop(key, None) is None:
+        return  # reset() already dropped it
+    _totals["live_bytes"] -= nbytes
+    _totals["live_count"] -= 1
+    _totals["freed_bytes"] += nbytes
+    _totals["frees"] += 1
+    for table, k in ((_per_op, op), (_per_dtype, dtype)):
+        b = table.get(k)
+        if b is not None:
+            b["live_bytes"] -= nbytes
+            b["live_count"] -= 1
+    emit_counter()
+
+
+def emit_counter():
+    """Chrome-trace counter event of the current live/peak bytes (only
+    while the profiler records).  Also called per step by the Gluon
+    trainer/executor so traces keep a memory timeline even between
+    allocations."""
+    if not _prof._state["running"]:
+        return
+    _prof.add_event("device_memory", "memory", "C",
+                    args={"live_bytes": _totals["live_bytes"],
+                          "peak_bytes": _totals["peak_bytes"]})
+
+
+def snapshot(top=12):
+    """Consistent copy of the tracker state: ``{"enabled", "totals",
+    "per_op", "per_dtype"}``.  ``per_op``/``per_dtype`` keep the
+    ``top`` rows by peak bytes (always all rows when ``top`` is None)."""
+
+    def trim(table):
+        # list() first: atomic C-level copy — a concurrent alloc/free
+        # must not raise "dict changed size" mid-snapshot (SIGUSR1)
+        items = sorted(list(table.items()),
+                       key=lambda kv: -kv[1]["peak_bytes"])
+        if top is not None:
+            items = items[:top]
+        return {k: dict(v) for k, v in items}
+
+    return {"enabled": _state["on"], "totals": dict(_totals),
+            "per_op": trim(_per_op), "per_dtype": trim(_per_dtype)}
+
+
+def reset():
+    """Zero all accounting and detach every finalizer, so the tracker
+    retains no references (weak or otherwise) to past buffers."""
+    for _nbytes, _op, _dtype, fin in list(_live.values()):
+        fin.detach()
+    _live.clear()
+    for k in _totals:
+        _totals[k] = 0
+    _per_op.clear()
+    _per_dtype.clear()
+    _origin[0] = None
+
+
+def _activate_from_env():
+    if os.environ.get("MXNET_TPU_MEMORY_TRACK") == "1" \
+            or os.environ.get("MXNET_TPU_DIAG"):
+        start()
+        return True
+    return False
+
+
+_activate_from_env()

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import numpy as _np
 
+from . import device_memory as _dm
 from . import profiler as _profiler
 from . import runtime_stats as _rts
 from .base import MXNetError
@@ -280,6 +281,9 @@ class Executor:
             # XLA failures wrap too, not just trace-time errors.
             raise MXNetError("executor forward: %s" % e) from e
         self._set_outputs(outs, new_aux)
+        if _dm._state["on"]:
+            # per-run memory-timeline anchor, like the Gluon trainer's
+            _dm.emit_counter()
 
     def _set_outputs(self, outs, new_aux):
         self._outputs = [NDArray(o, self._ctx) for o in outs]

@@ -14,6 +14,7 @@ train step over the mesh (parallel/data_parallel.py).
 
 from __future__ import annotations
 
+from .. import device_memory as _dm
 from .. import kvstore as _kvstore
 from .. import optimizer as _optimizer
 from .. import profiler as _profiler
@@ -136,6 +137,10 @@ class Trainer:
                             args={"batch_size": batch_size}
                             if _profiler._state["running"] else None):
             self._step(batch_size, ignore_stale_grad)
+        if _dm._state["on"]:
+            # per-step live/peak-bytes counter event: anchors the trace's
+            # memory timeline even when no buffer was (de)allocated
+            _dm.emit_counter()
 
     def _step(self, batch_size, ignore_stale_grad):
         # rescale BEFORE the kvstore ships the optimizer server-side

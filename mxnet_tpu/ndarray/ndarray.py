@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import numpy as _np
 
+from .. import device_memory as _dm
 from .. import profiler as _prof
 from .. import runtime_stats as _rts
 from ..base import MXNetError, np_dtype, numeric_types
@@ -37,6 +38,8 @@ from ..ops import registry as _reg
 # dict read on every dispatch: cheapest possible "is the profiler on"
 # check (guard-first — no event/span allocation when it is off)
 _prof_state = _prof._state
+# same guard shape for the device-buffer tracker (device_memory.py)
+_dm_state = _dm._state
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "save", "load", "waitall", "imperative_invoke",
@@ -73,6 +76,8 @@ class NDArray:
         self._ctx = ctx
         self._ag_node = None
         self._writeback = _writeback  # (base NDArray, index) for slice views
+        if _dm_state["on"]:
+            _dm.track(data)
 
     # ------------------------------------------------------------- basics
     @property
@@ -211,6 +216,8 @@ class NDArray:
             raise MXNetError(
                 "in-place write on an array participating in a recorded graph"
             )
+        if _dm_state["on"]:
+            _dm.track(new_jax_value, "_assign")
         self._data = new_jax_value
         if self._writeback is not None:
             base, index = self._writeback
@@ -742,7 +749,7 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
         if attrs.get("mode", "training") == "always" or _ag.is_training():
             needs_key = True  # key=... kwarg threaded below
         else:
-            return _wrap_outputs((arrays[0],), ctx, out)
+            return _wrap_outputs((arrays[0],), ctx, out, op=op.name)
 
     if needs_key:
         from ..random import next_key
@@ -768,7 +775,7 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
                 else:
                     outv, vjp_fn = jax.vjp(fn, *arrays)
         result = outv if isinstance(outv, tuple) else (outv,)
-        out_nds = _wrap_outputs(result, ctx, out)
+        out_nds = _wrap_outputs(result, ctx, out, op=op.name)
         _ag.record_op(inputs, out_nds, vjp_fn, op_name=op_name, attrs=attrs)
         return out_nds
 
@@ -784,7 +791,7 @@ def imperative_invoke(op_name, inputs, attrs, out=None):
     else:
         result = _dispatch_jit(op, op_name, attrs, arrays)
     result = result if isinstance(result, tuple) else (result,)
-    return _wrap_outputs(result, ctx, out)
+    return _wrap_outputs(result, ctx, out, op=op.name)
 
 
 def _dispatch_jit(op, op_name, attrs, arrays):
@@ -799,13 +806,26 @@ def _dispatch_jit(op, op_name, attrs, arrays):
     entry, hit = op.jitted_ex(attrs)
     cname = op.name  # canonical — jitted_ex counts under this name
     prof_on = _prof_state["running"]
-    if hit and not prof_on:
+    if hit and not prof_on and not _rts.DIAG_TIMING:
         return _call_jit_entry(op_name, cname, entry, arrays)
     t0 = _prof._now_us()
     result = _call_jit_entry(op_name, cname, entry, arrays)
     dur = _prof._now_us() - t0
     if not hit:
         _rts.add_compile_seconds(cname, dur / 1e6)
+        # compile-time-only XLA cost/memory analysis of the fresh
+        # entry (flops, bytes accessed, output/temp footprint) — feeds
+        # the runtime_stats roofline/footprint sections.  Never on the
+        # hit path; no-op unless cost capture is active (registry).
+        op.analyze_entry(attrs, arrays)
+    else:
+        # timed CACHE-WARM wall-time per op (profiler on, or a
+        # MXNET_TPU_DIAG run — the dump needs rate denominators): the
+        # achieved GB/s / GFLOP/s divisor.  Misses are excluded —
+        # their dur is compile-dominated and already attributed to
+        # compile_seconds; folding it in would put every freshly
+        # compiled op at the top of the roofline table
+        _rts.add_dispatch_seconds(cname, dur / 1e6)
     if prof_on:
         # aval churn recompiles inside the jax.jit entry (registry-level
         # hit!) — feed shape/dtype signatures to the storm detector
@@ -852,8 +872,17 @@ def _vjp_with_aux(fn, arrays):
     return outv, vjp_fn
 
 
-def _wrap_outputs(result, ctx, out=None):
-    nds = [NDArray(r, ctx) for r in result]
+def _wrap_outputs(result, ctx, out=None, op=None):
+    if _dm_state["on"]:
+        # label output buffers with the creating op for the per-op
+        # memory breakdown; restore so unrelated wraps don't inherit it
+        prev = _dm.set_origin(op)
+        try:
+            nds = [NDArray(r, ctx) for r in result]
+        finally:
+            _dm.set_origin(prev)
+    else:
+        nds = [NDArray(r, ctx) for r in result]
     if out is not None:
         outs = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs, nds):
@@ -898,6 +927,8 @@ def array(source, ctx=None, dtype=None):
             src = _jnp().array(src, copy=True)
         if same_device is False:
             src = jax.device_put(src, dev)
+        if _dm_state["on"]:
+            _dm.track(src, "array")
         return NDArray(src, ctx)
     src = _np.asarray(source)
     if dtype is None:
@@ -912,7 +943,10 @@ def array(source, ctx=None, dtype=None):
             dtype = _np.float32
     src = src.astype(np_dtype(dtype))
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(src, ctx.jax_device), ctx)
+    d = jax.device_put(src, ctx.jax_device)
+    if _dm_state["on"]:
+        _dm.track(d, "array")
+    return NDArray(d, ctx)
 
 
 def empty(shape, ctx=None, dtype=None):
@@ -927,6 +961,8 @@ def zeros(shape, ctx=None, dtype=None, **kwargs):
     jnp = _jnp()
     with jax.default_device(ctx.jax_device):
         d = jnp.zeros(shape, dtype=np_dtype(dtype))
+    if _dm_state["on"]:
+        _dm.track(d, "zeros")
     return NDArray(d, ctx)
 
 
@@ -938,6 +974,8 @@ def ones(shape, ctx=None, dtype=None, **kwargs):
     jnp = _jnp()
     with jax.default_device(ctx.jax_device):
         d = jnp.ones(shape, dtype=np_dtype(dtype))
+    if _dm_state["on"]:
+        _dm.track(d, "ones")
     return NDArray(d, ctx)
 
 
@@ -949,6 +987,8 @@ def full(shape, val, ctx=None, dtype=None, **kwargs):
     jnp = _jnp()
     with jax.default_device(ctx.jax_device):
         d = jnp.full(shape, val, dtype=np_dtype(dtype or "float32"))
+    if _dm_state["on"]:
+        _dm.track(d, "full")
     return NDArray(d, ctx)
 
 

@@ -64,26 +64,32 @@ def reshape(x, shape=(), reverse=False, **_):
 
 @register("reshape_like")
 def reshape_like(x, y, **_):
+    """Reshape ``x`` to ``y``'s shape (element counts must match)."""
     return x.reshape(y.shape)
 
 
 @register("shape_array")
 def shape_array(x, **_):
+    """``x``'s shape as a 1-D int64 array (shapes are static under
+    tracing, so this stages as a constant)."""
     return jnp.asarray(x.shape, dtype=jnp.int64)
 
 
 @register("size_array")
 def size_array(x, **_):
+    """``x``'s element count as a 1-element int64 array."""
     return jnp.asarray([x.size], dtype=jnp.int64)
 
 
 @register("Flatten", aliases=("flatten",))
 def flatten(x, **_):
+    """Collapse all but the batch (first) axis: ``(N, ...) -> (N, -1)``."""
     return x.reshape((x.shape[0], -1))
 
 
 @register("transpose")
 def transpose(x, axes=(), **_):
+    """Permute axes; empty ``axes`` reverses them (numpy .T semantics)."""
     if not axes:
         axes = tuple(range(x.ndim))[::-1]
     return jnp.transpose(x, axes)
@@ -91,11 +97,14 @@ def transpose(x, axes=(), **_):
 
 @register("expand_dims")
 def expand_dims(x, axis=0, **_):
+    """Insert a size-1 dim at ``axis``."""
     return jnp.expand_dims(x, int(axis))
 
 
 @register("squeeze")
 def squeeze(x, axis=None, **_):
+    """Drop size-1 dims — all of them when ``axis`` is None, else the
+    listed one(s)."""
     if axis is None:
         return jnp.squeeze(x)
     return jnp.squeeze(x, axis=axis if isinstance(axis, tuple) else (int(axis),))
@@ -103,6 +112,8 @@ def squeeze(x, axis=None, **_):
 
 @register("Concat", aliases=("concat",))
 def concat(*args, dim=1, **_):
+    """Concatenate inputs along ``dim`` (default 1, the reference's
+    channel-concat convention); accepts a single list/tuple too."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return jnp.concatenate(args, axis=int(dim))
@@ -110,6 +121,8 @@ def concat(*args, dim=1, **_):
 
 @register("stack")
 def stack(*args, axis=0, **_):
+    """Stack inputs along a NEW ``axis``; accepts a single
+    list/tuple too."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return jnp.stack(args, axis=int(axis))
@@ -233,6 +246,8 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False, **_):
 
 @register("sort")
 def sort(x, axis=-1, is_ascend=True, **_):
+    """Sort values along ``axis`` (None flattens first);
+    ``is_ascend=False`` reverses the order."""
     ax = None if axis is None else int(axis)
     out = jnp.sort(x.reshape(-1) if ax is None else x, axis=0 if ax is None else ax)
     if not is_ascend:
@@ -322,6 +337,9 @@ def _as_gather_indices(a, indices):
 
 @register("take")
 def take(a, indices, axis=0, mode="clip", **_):
+    """Gather slices of ``a`` at ``indices`` along ``axis``; out-of-
+    range handling per ``mode`` ("raise" clips — no device-side raise
+    on XLA, matching the reference's accelerator behaviour)."""
     jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
     with _index_ctx(a):
         return jnp.take(a, _as_gather_indices(a, indices), axis=int(axis),
@@ -341,6 +359,8 @@ def batch_take(x, index, axis=-1, keepdims=False, mode="clip", **_):
 
 @register("one_hot")
 def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **_):
+    """One-hot encode ``indices`` into a trailing ``depth`` axis, with
+    ``on_value``/``off_value`` fills and output ``dtype``."""
     from ..base import np_dtype
 
     oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth))

@@ -32,6 +32,12 @@ _DTYPE_REDUCES = ("sum", "mean", "prod", "nansum", "nanprod")
 def _make_reduce(name, jf):
     @register(name, aliases=("%s_axis" % name,))
     def _op(x, axis=None, keepdims=False, exclude=False, dtype=None, **_):
+        """Reduce ``x`` over ``axis`` (int, tuple, or None for all
+        axes); ``exclude`` reduces over every axis *not* listed,
+        ``keepdims`` keeps reduced axes as size 1.  ``dtype`` selects
+        the accumulation dtype for sum-like reductions (64-bit
+        accumulators stage under ``jax.enable_x64``).  Registered as
+        sum/mean/prod/max/min/nansum/nanprod (+ ``*_axis`` aliases)."""
         axes = _norm_axis(axis, x.ndim, exclude)
         if dtype is not None and name in _DTYPE_REDUCES:
             if jnp.dtype(dtype).itemsize == 8:
@@ -62,6 +68,8 @@ for _name, _jf in [
 
 @register("norm")
 def norm(x, ord=2, axis=None, keepdims=False, **_):
+    """L1/L2 norm of ``x`` over ``axis`` (None reduces all axes);
+    only ``ord`` 1 and 2 exist, matching the reference's norm op."""
     axes = None if axis is None else _norm_axis(axis, x.ndim)
     if ord == 1:
         return jnp.sum(jnp.abs(x), axis=axes, keepdims=bool(keepdims))
@@ -71,6 +79,9 @@ def norm(x, ord=2, axis=None, keepdims=False, **_):
 def _index_reduce(name, jf):
     @register(name)
     def _op(x, axis=None, keepdims=False, **_):
+        """Index of the extremum along ``axis`` (None flattens first),
+        returned as float32 indices — the reference's mshadow-legacy
+        contract.  Registered as argmax/argmin."""
         if axis is None:
             out = jf(x.reshape(-1), axis=0)
             if keepdims:
@@ -91,18 +102,23 @@ _index_reduce("argmin", jnp.argmin)
 
 @register("argmax_channel")
 def argmax_channel(x, **_):
+    """Argmax over the channel axis (axis 1) as float32 indices —
+    the reference's argmax_channel convenience op."""
     return jnp.argmax(x, axis=1).astype(jnp.float32)
 
 
 @register("broadcast_to")
 def broadcast_to(x, shape=None, **_):
-    # MXNet: 0 in target shape means "keep source dim"
+    """Broadcast ``x`` to ``shape``; a 0 in the target shape keeps the
+    source dim (MXNet convention)."""
     tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
     return jnp.broadcast_to(x, tgt)
 
 
 @register("broadcast_axis", aliases=("broadcast_axes",))
 def broadcast_axis(x, axis=(), size=(), **_):
+    """Broadcast the size-1 ``axis`` dims of ``x`` up to the paired
+    ``size`` entries (int or tuple forms accepted for both)."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
     tgt = list(x.shape)
@@ -113,6 +129,8 @@ def broadcast_axis(x, axis=(), size=(), **_):
 
 @register("broadcast_like")
 def broadcast_like(x, y, lhs_axes=None, rhs_axes=None, **_):
+    """Broadcast ``x`` to ``y``'s shape; with ``lhs_axes``/``rhs_axes``
+    only the paired axes take their size from ``y``."""
     if lhs_axes is None:
         return jnp.broadcast_to(x, y.shape)
     tgt = list(x.shape)
@@ -123,6 +141,8 @@ def broadcast_like(x, y, lhs_axes=None, rhs_axes=None, **_):
 
 @register("cumsum")
 def cumsum(x, axis=None, dtype=None, **_):
+    """Cumulative sum along ``axis`` (None flattens first), optionally
+    accumulating in ``dtype``."""
     from ..base import np_dtype
 
     d = np_dtype(dtype) if dtype is not None else None

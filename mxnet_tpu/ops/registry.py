@@ -22,14 +22,19 @@ reference engine's cached ThreadedOpr path (src/engine/threaded_engine.h).
 from __future__ import annotations
 
 import functools
+import os
+import time
 
 import jax
 import numpy as _np
 
+from .. import profiler as _prof
 from .. import runtime_stats as _stats
 from ..base import MXNetError
 
-__all__ = ["Op", "register", "get", "list_ops", "apply_op"]
+__all__ = ["Op", "register", "get", "list_ops", "apply_op",
+           "compiled_cost", "cost_capture_active", "cost_snapshot"]
+
 
 _OP_REGISTRY: dict[str, "Op"] = {}
 
@@ -118,6 +123,10 @@ class Op:
         # attr the fn branches on in Python must stay static.
         self.traced_attrs = frozenset(traced_attrs)
         self._jit_cache = {}
+        # cache key -> normalized XLA cost/memory analysis of that
+        # entry (or None when the backend exposes none) — captured at
+        # compile time by analyze_entry(), read by cost_snapshot()
+        self._cost = {}
 
     def __repr__(self):
         return "Op(%s)" % self.name
@@ -140,6 +149,21 @@ class Op:
         only their *names*, so a changing value reuses the executable."""
         return self.jitted_ex(attrs)[0]
 
+    def _split_attrs(self, attrs):
+        """``(cache key, traced names, static attrs, traced attrs)`` for
+        an attr-set — the single definition of the jit-cache key, shared
+        by :meth:`jitted_ex` and :meth:`analyze_entry`."""
+        traced = {k: v for k, v in attrs.items()
+                  if k in self.traced_attrs
+                  and isinstance(v, (int, float))
+                  and not isinstance(v, bool)}
+        if not traced:
+            return tuple(sorted(attrs.items())), (), attrs, traced
+        static = {k: v for k, v in attrs.items() if k not in traced}
+        tnames = tuple(sorted(traced))
+        return (tuple(sorted(static.items())), tnames), tnames, static, \
+            traced
+
     def jitted_ex(self, attrs):
         """:meth:`jitted` plus the jit-cache hit flag.
 
@@ -148,12 +172,8 @@ class Op:
         miss also registers its cache key with the recompile-storm
         detector.  The telemetry cost on the hit path is one dict
         lookup and two integer increments."""
-        traced = {k: v for k, v in attrs.items()
-                  if k in self.traced_attrs
-                  and isinstance(v, (int, float))
-                  and not isinstance(v, bool)}
-        if not traced:
-            key = tuple(sorted(attrs.items()))
+        key, tnames, static, traced = self._split_attrs(attrs)
+        if not tnames:
             entry = self._jit_cache.get(key)
             hit = entry is not None
             if not hit:
@@ -162,9 +182,6 @@ class Op:
                 _stats.record_compile_key(self.name, key)
             _stats.record_dispatch(self.name, "hit" if hit else "miss")
             return entry, hit
-        static = {k: v for k, v in attrs.items() if k not in traced}
-        tnames = tuple(sorted(traced))
-        key = (tuple(sorted(static.items())), tnames)
         entry = self._jit_cache.get(key)
         hit = entry is not None
         if not hit:
@@ -184,6 +201,52 @@ class Op:
         tvals = tuple(float(traced[k]) for k in tnames)
         return functools.partial(_call_traced, entry, tvals), hit
 
+    def analyze_entry(self, attrs, arrays):
+        """Capture XLA ``cost_analysis()``/``memory_analysis()`` for the
+        cache entry keyed by ``attrs`` and store it on the entry (in
+        ``self._cost``), once per entry.
+
+        Compile-time only: the dispatch layer calls this on jit-cache
+        misses, never on the hit path, and it no-ops unless cost capture
+        is active (:func:`cost_capture_active`).  The AOT
+        ``lower().compile()`` pays one extra XLA compile for the entry's
+        first aval — a bounded, compile-path-only cost, surfaced in the
+        ``cost_analysis_seconds`` counter.  Any backend that lacks the
+        analyses just yields an empty record (try/except)."""
+        if not cost_capture_active():
+            return None
+        key, tnames, _static, traced = self._split_attrs(attrs)
+        if key in self._cost:
+            return self._cost[key]
+        entry = self._jit_cache.get(key)
+        if entry is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            # lower on avals, not the live arrays: shape/dtype is all
+            # the analysis needs, and concrete cross-device inputs
+            # (the kvstore-reduce fallback path) would fail pjit's
+            # device check here even though the call itself succeeded
+            # on gathered copies
+            specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     if isinstance(a, jax.Array) else a for a in arrays]
+            if tnames:
+                tvals = tuple(float(traced[k]) for k in tnames)
+                compiled = entry.lower(tuple(specs), tvals).compile()
+            else:
+                compiled = entry.lower(*specs).compile()
+            cost = compiled_cost(compiled)
+        except Exception:  # analysis must never break dispatch
+            cost = None
+        self._cost[key] = cost
+        # entries counts SUCCESSFUL analyses (agrees with the per-op
+        # "analyzed" in cost_snapshot); failed attempts get their own
+        # counter, and both accrue their wall-time
+        _stats.inc("cost_analysis_entries" if cost
+                   else "cost_analysis_failures")
+        _stats.inc("cost_analysis_seconds", time.perf_counter() - t0)
+        return cost
+
     def nout(self, attrs):
         if callable(self.num_outputs):
             return self.num_outputs(attrs)
@@ -192,6 +255,97 @@ class Op:
 
 def _call_traced(entry, tvals, *arrays):
     return entry(arrays, tvals)
+
+
+# ------------------------------------------------------ cost analytics
+
+
+def cost_capture_active():
+    """Whether jit-cache misses should capture XLA cost analytics.
+
+    Capture pays one extra AOT compile per cache entry, so it runs only
+    when telemetry wants the data: the profiler is recording, a
+    ``MXNET_TPU_DIAG`` dump destination is set, or
+    ``MXNET_TPU_COST_ANALYSIS=1`` forces it; ``=0`` disables it
+    unconditionally.  Checked only on the (already compile-bound) miss
+    path — the hit path never reaches it — so the env reads are live,
+    not import-time snapshots (both vars toggle at runtime)."""
+    force = os.environ.get("MXNET_TPU_COST_ANALYSIS", "")
+    if force == "0":
+        return False
+    if force == "1" or os.environ.get("MXNET_TPU_DIAG"):
+        return True
+    return _prof._state["running"]
+
+
+def compiled_cost(compiled):
+    """Normalize an XLA ``Compiled``'s analyses into one flat dict:
+    ``flops`` / ``bytes_accessed`` (cost model, per call) and
+    ``output_bytes`` / ``temp_bytes`` / ``argument_bytes`` /
+    ``generated_code_bytes`` (memory analysis, per executable).
+    Backends differ in what they expose; absent pieces are simply
+    missing keys, and a fully silent backend yields ``None``."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # CPU returns [dict]
+            ca = ca[0] if ca else {}
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed")):
+            v = ca.get(src)
+            if v is not None and v >= 0:
+                out[dst] = float(v)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for src, dst in (
+                ("output_size_in_bytes", "output_bytes"),
+                ("temp_size_in_bytes", "temp_bytes"),
+                ("argument_size_in_bytes", "argument_bytes"),
+                ("generated_code_size_in_bytes", "generated_code_bytes")):
+            v = getattr(ma, src, None)
+            if v is not None:
+                out[dst] = int(v)
+    except Exception:
+        pass
+    return out or None
+
+
+def cost_snapshot():
+    """Read-side aggregate over every registered op's jit cache:
+    ``{op: {"cache_entries", "analyzed", "flops_per_call",
+    "bytes_per_call", "output_bytes", "temp_bytes",
+    "argument_bytes"}}``.
+
+    ``*_per_call`` are means over the analyzed entries (cost-model,
+    per executed call); the ``*_bytes`` footprints are sums over
+    entries (what the cache as a whole holds in output/temp buffers).
+    Iterates the registry — read path only, never dispatch."""
+    out = {}
+    seen = set()
+    # list() copies: concurrent dispatch may register entries/analyses
+    # while a snapshot (e.g. the SIGUSR1 diag handler) iterates
+    for op in list(_OP_REGISTRY.values()):
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        n = len(op._jit_cache)
+        analyzed = [c for c in list(op._cost.values()) if c]
+        if not n and not analyzed:
+            continue
+        rec = {"cache_entries": n, "analyzed": len(analyzed)}
+        for k, dst in (("flops", "flops_per_call"),
+                       ("bytes_accessed", "bytes_per_call")):
+            vals = [c[k] for c in analyzed if k in c]
+            if vals:
+                rec[dst] = sum(vals) / len(vals)
+        for k in ("output_bytes", "temp_bytes", "argument_bytes"):
+            vals = [c[k] for c in analyzed if k in c]
+            if vals:
+                rec[k] = int(sum(vals))
+        out[op.name] = rec
+    return out
 
 
 def register(name, num_outputs=1, aliases=(), traced_attrs=(), **defaults):
@@ -254,26 +408,33 @@ def list_ops():
 
 def apply_op(name, *arrays, **attrs):
     """Eagerly apply a registered op to raw jax arrays."""
-    from .. import profiler as _prof
-
     op = get(name)
     attrs = op.canonicalize_attrs(attrs)
     counted = False
     try:
         entry, hit = op.jitted_ex(attrs)  # counts the call (hit/miss)
         counted = True
-        if hit and not _prof._state["running"]:  # guard-first fast path
+        if hit and not _prof._state["running"] \
+                and not _stats.DIAG_TIMING:  # guard-first fast path
             return entry(*arrays)
         t0 = _prof._now_us()
         result = entry(*arrays)
         dur = _prof._now_us() - t0
         if not hit:
             _stats.add_compile_seconds(op.name, dur / 1e6)
-        ev_args = {"op": op.name, "cache": "hit" if hit else "miss"}
-        if not hit:
-            ev_args["compile_ms"] = round(dur / 1e3, 3)
-        _prof.add_event("dispatch:" + op.name, "operator", "X", ts=t0,
-                        dur=dur, args=ev_args)
+            op.analyze_entry(attrs, arrays)
+        else:
+            # cache-warm only: miss dur is compile-dominated and lives
+            # in compile_seconds (see _dispatch_jit in ndarray.py)
+            _stats.add_dispatch_seconds(op.name, dur / 1e6)
+        if _prof._state["running"]:
+            # event allocation only while recording — a DIAG-timing run
+            # with the profiler off must not build dicts per call
+            ev_args = {"op": op.name, "cache": "hit" if hit else "miss"}
+            if not hit:
+                ev_args["compile_ms"] = round(dur / 1e3, 3)
+            _prof.add_event("dispatch:" + op.name, "operator", "X",
+                            ts=t0, dur=dur, args=ev_args)
         return result
     except TypeError:
         # attrs that fail jit staging (e.g. unhashable leftovers) fall back

@@ -22,29 +22,64 @@ arXiv:2301.13062).  When the profiler is running the dispatch layer
 additionally feeds input aval signatures, so shape/dtype churn (which
 recompiles *inside* an existing jax.jit entry) is detected too.
 
+Memory & cost analytics (PR 3): ``snapshot()`` additionally carries a
+``memory`` section (live/peak device bytes from ``device_memory.py``),
+a ``costs`` section (per-op XLA cost/memory analysis captured at
+compile time by ``ops/registry.py``), and :func:`roofline` derives
+achieved GB/s / GFLOP/s per op from profiled dispatch wall-time — the
+in-production analog of the offline ``BENCH_ROOFLINE.md`` audit.
+:func:`dump_diag` writes the whole picture atomically to a JSON file;
+``MXNET_TPU_DIAG=<file>`` arms a ``SIGUSR1`` handler (plus an atexit
+dump) so a live training job can be asked for it at any time, and
+``python -m mxnet_tpu.runtime_stats [dump.json]`` pretty-prints it.
+
 Environment variables
 ---------------------
 ``MXNET_TPU_RECOMPILE_STORM_THRESHOLD``  compiles per op before the
     storm warning fires (default 8; ``0`` disables the detector).
 ``MXNET_TPU_RECOMPILE_STORM_INTERVAL``   minimum seconds between storm
     warnings for the same op (default 30).
+``MXNET_TPU_DIAG``  diagnostic-dump destination; arms SIGUSR1 + atexit
+    dump, and turns on the device-memory tracker and compile-time cost
+    capture so the dump is populated.
+``MXNET_TPU_HBM_PEAK_GBPS`` / ``MXNET_TPU_PEAK_TFLOPS``  roofline peaks
+    used for the headroom columns (defaults: v5e — 819 GB/s, 394
+    bf16 TFLOP/s).
 """
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
+import time
 
+from . import device_memory
 from .log import get_logger, warn_rate_limited
 
 __all__ = ["snapshot", "report", "reset", "inc",
            "record_dispatch", "record_compile_key", "add_compile_seconds",
-           "record_fallback", "note_aval_key",
+           "add_dispatch_seconds", "record_fallback", "note_aval_key",
+           "roofline", "diag_snapshot", "dump_diag", "main",
            "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
 
 STORM_THRESHOLD = int(os.environ.get(
     "MXNET_TPU_RECOMPILE_STORM_THRESHOLD", "8"))
 STORM_WARN_INTERVAL = float(os.environ.get(
     "MXNET_TPU_RECOMPILE_STORM_INTERVAL", "30"))
+
+# MXNET_TPU_DIAG also turns on dispatch wall-time collection (the
+# denominator of the diag dump's achieved GB/s / GFLOP/s columns) —
+# without it a DIAG-only run would dump a roofline with cost columns
+# but no rates.  Import-time, like the rest of the DIAG arming.
+DIAG_TIMING = bool(os.environ.get("MXNET_TPU_DIAG"))
+
+# roofline peaks for the derived headroom columns (defaults: TPU v5e
+# public numbers, the same constants tools/profile_step.py audits with)
+ROOFLINE_BW_PEAK = float(os.environ.get(
+    "MXNET_TPU_HBM_PEAK_GBPS", "819")) * 1e9
+ROOFLINE_FLOP_PEAK = float(os.environ.get(
+    "MXNET_TPU_PEAK_TFLOPS", "394")) * 1e12
 
 # recent cache keys kept per op for churn diagnosis
 _STORM_KEY_WINDOW = 8
@@ -80,7 +115,8 @@ def _op_stats(name):
     if s is None:
         s = _PER_OP[name] = {"calls": 0, "hits": 0, "misses": 0,
                              "uncached": 0, "fallbacks": 0,
-                             "compile_seconds": 0.0}
+                             "compile_seconds": 0.0,
+                             "dispatch_seconds": 0.0, "timed_calls": 0}
     return s
 
 
@@ -127,6 +163,21 @@ def add_compile_seconds(name, seconds):
     layer as the duration of the jit-cache-miss call: trace + XLA
     compile dominate; execution is async-dispatched)."""
     _op_stats(name)["compile_seconds"] += seconds
+
+
+def add_dispatch_seconds(name, seconds):
+    """Attribute one timed dispatch's wall-time to an op.  Fed by the
+    dispatch layer only while the profiler records (the timestamps exist
+    for the span anyway) or ``MXNET_TPU_DIAG`` is set (DIAG_TIMING) —
+    the denominator of the achieved GB/s / GFLOP/s columns.  Cache-warm
+    hits only.  This is HOST wall-time of the dispatch call: on a
+    synchronous backend (CPU tests) it tracks execution, but async
+    device dispatch returns early, so the derived rates are cache-warm
+    dispatch diagnostics, not physics — the measured-trace audit
+    (tools/profile_step.py) stays the ground-truth instrument."""
+    s = _op_stats(name)
+    s["dispatch_seconds"] += seconds
+    s["timed_calls"] += 1
 
 
 def record_fallback(name, kind):
@@ -227,11 +278,21 @@ def _fmt_aval(aval_key):
 
 def snapshot():
     """A consistent copy of every counter: ``{"ops": {...}, "totals":
-    {...}, "counters": {...}, "storms": {...}}``.  Works with the
-    profiler off — this is the always-on view."""
-    ops = {name: dict(s) for name, s in _PER_OP.items()}
+    {...}, "counters": {...}, "storms": {...}, "memory": {...},
+    "costs": {...}}``.  Works with the profiler off — this is the
+    always-on view.  ``memory`` is the device-buffer tracker's view
+    (``device_memory.snapshot``); ``costs`` aggregates the XLA
+    cost/memory analyses captured per jit-cache entry at compile time
+    (``ops.registry.cost_snapshot`` — includes the jit-cache footprint:
+    entries + output/temp bytes per op)."""
+    # list() the dict items first: the C-level copy is atomic under the
+    # GIL, so a concurrent thread first-dispatching a new op (or the
+    # SIGUSR1 handler's own timing) cannot raise "dictionary changed
+    # size during iteration" mid-snapshot
+    ops = {name: dict(s) for name, s in list(_PER_OP.items())}
     totals = {"op_calls": 0, "jit_cache_hits": 0, "jit_cache_misses": 0,
-              "uncached_calls": 0, "fallbacks": 0, "compile_seconds": 0.0}
+              "uncached_calls": 0, "fallbacks": 0, "compile_seconds": 0.0,
+              "dispatch_seconds": 0.0}
     for s in ops.values():
         totals["op_calls"] += s["calls"]
         totals["jit_cache_hits"] += s["hits"]
@@ -239,16 +300,68 @@ def snapshot():
         totals["uncached_calls"] += s["uncached"]
         totals["fallbacks"] += s["fallbacks"]
         totals["compile_seconds"] += s["compile_seconds"]
+        totals["dispatch_seconds"] += s.get("dispatch_seconds", 0.0)
     storms = {name: {"compiles": st["compiles"], "warned": st["warned"],
                      "distinct_avals": len(st["avals"])}
-              for name, st in _STORM.items()}
+              for name, st in list(_STORM.items())}
+    # read-side only: the registry import is lazy (registry imports this
+    # module at its top), and the iteration never runs on dispatch
+    from .ops import registry as _registry
+
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
-            "storms": storms}
+            "storms": storms, "memory": device_memory.snapshot(),
+            "costs": _registry.cost_snapshot()}
+
+
+def roofline(snap=None, top=None):
+    """Per-op achieved GB/s and GFLOP/s vs the chip roofline, derived by
+    dividing each op's cost-model bytes/flops per call by its profiled
+    mean dispatch wall-time; rows sorted by headroom (µs above the
+    roofline bound) descending — the in-production analog of
+    ``BENCH_ROOFLINE.md``.  Ops never profiled get cost columns only.
+    Works on a live :func:`snapshot` or a loaded diag dump."""
+    snap = snap or snapshot()
+    rows = []
+    for name, cost in sorted(snap.get("costs", {}).items()):
+        row = {"op": name,
+               "cache_entries": cost.get("cache_entries", 0),
+               "analyzed": cost.get("analyzed", 0)}
+        bpc = cost.get("bytes_per_call")
+        fpc = cost.get("flops_per_call")
+        if bpc is not None:
+            row["bytes_per_call"] = bpc
+        if fpc is not None:
+            row["flops_per_call"] = fpc
+        s = snap["ops"].get(name) or {}
+        timed = s.get("timed_calls", 0)
+        secs = s.get("dispatch_seconds", 0.0)
+        if timed and secs > 0:
+            per_call = secs / timed
+            row["us_per_call"] = per_call * 1e6
+            if bpc:
+                row["achieved_gbps"] = bpc / per_call / 1e9
+            if fpc:
+                row["achieved_gflops"] = fpc / per_call / 1e9
+            bound = max((bpc or 0.0) / ROOFLINE_BW_PEAK,
+                        (fpc or 0.0) / ROOFLINE_FLOP_PEAK)
+            if bound > 0:
+                row["bound_us"] = bound * 1e6
+                row["headroom_us"] = (per_call - bound) * 1e6
+        rows.append(row)
+    rows.sort(key=lambda r: -r.get("headroom_us", float("-inf")))
+    return rows[:top] if top else rows
 
 
 def report():
-    """Text table of the snapshot (op rows sorted by calls desc)."""
-    snap = snapshot()
+    """Text tables of the full snapshot: per-op dispatch counters, named
+    counters, per-op XLA cost model + achieved rates, jit-cache
+    footprint, and device-memory accounting.  Section headers always
+    print (empty sections say why), so the output is self-describing on
+    a fresh process too."""
+    return _render(snapshot())
+
+
+def _render(snap, top=None):
     lines = ["%-32s %9s %9s %7s %9s %10s %11s"
              % ("Op", "Calls", "Hits", "Misses", "Uncached",
                 "Fallbacks", "Compile(s)")]
@@ -269,14 +382,254 @@ def report():
             lines.append("%-32s %12s"
                          % (name[:32],
                             ("%.3f" % v) if isinstance(v, float) else v))
+    lines.extend(_render_costs(snap, top=top))
+    lines.extend(_render_memory(snap.get("memory") or {}))
     return "\n".join(lines)
 
 
+def _render_costs(snap, top=None):
+    lines = ["", "XLA cost model (per op; rates from profiled dispatch "
+             "wall-time)",
+             "%-28s %8s %12s %10s %9s %9s %10s"
+             % ("Op", "Entries", "GFLOP/call", "MB/call", "GB/s",
+                "GFLOP/s", "Headroom")]
+    rows = roofline(snap, top=top)
+    if not any(r.get("analyzed") for r in rows):
+        lines.append("(no entries analyzed — cost capture is "
+                     "compile-time-only and needs the profiler running, "
+                     "MXNET_TPU_DIAG, or MXNET_TPU_COST_ANALYSIS=1)")
+    for r in rows:
+        if not r.get("analyzed"):
+            continue
+        lines.append("%-28s %8d %12s %10s %9s %9s %10s" % (
+            r["op"][:28], r["cache_entries"],
+            _fmt(r.get("flops_per_call"), 1e9),
+            _fmt(r.get("bytes_per_call"), 1e6),
+            _fmt(r.get("achieved_gbps")),
+            _fmt(r.get("achieved_gflops")),
+            ("%.0fus" % r["headroom_us"])
+            if "headroom_us" in r else "-"))
+    lines.append("")
+    lines.append("Jit-cache footprint (estimated output+temp bytes per "
+                 "op, summed over entries)")
+    lines.append("%-28s %8s %9s %10s %10s"
+                 % ("Op", "Entries", "Analyzed", "Out MB", "Temp MB"))
+    foot = [(name, c) for name, c in sorted(snap.get("costs", {}).items())
+            if c.get("cache_entries")]
+    if not foot:
+        lines.append("(jit cache empty)")
+    for name, c in sorted(foot, key=lambda kv: -(
+            kv[1].get("output_bytes", 0) + kv[1].get("temp_bytes", 0))):
+        lines.append("%-28s %8d %9d %10s %10s" % (
+            name[:28], c["cache_entries"], c.get("analyzed", 0),
+            _fmt(c.get("output_bytes"), 1e6),
+            _fmt(c.get("temp_bytes"), 1e6)))
+    return lines
+
+
+def _render_memory(mem):
+    lines = ["", "Device memory (buffer tracker)"]
+    if not mem.get("enabled") and not mem.get("totals", {}).get(
+            "allocations"):
+        lines.append("(tracker off — device_memory.start(), "
+                     "MXNET_TPU_MEMORY_TRACK=1, or MXNET_TPU_DIAG)")
+        return lines
+    t = mem["totals"]
+    lines.append("live %s in %d buffers; peak %s; allocated %s in %d "
+                 "allocations%s"
+                 % (_fmt(t["live_bytes"], 1e6) + "MB", t["live_count"],
+                    _fmt(t["peak_bytes"], 1e6) + "MB",
+                    _fmt(t["allocated_bytes"], 1e6) + "MB",
+                    t["allocations"],
+                    "" if mem.get("enabled") else " (tracker stopped)"))
+    lines.append("%-28s %10s %8s %10s %10s"
+                 % ("Creating op", "Live MB", "Buffers", "Peak MB",
+                    "Alloc MB"))
+    for name, b in mem.get("per_op", {}).items():
+        lines.append("%-28s %10s %8d %10s %10s" % (
+            name[:28], _fmt(b["live_bytes"], 1e6), b["live_count"],
+            _fmt(b["peak_bytes"], 1e6), _fmt(b["allocated_bytes"], 1e6)))
+    lines.append("%-28s %10s %8s %10s %10s"
+                 % ("Dtype", "Live MB", "Buffers", "Peak MB", "Alloc MB"))
+    for name, b in mem.get("per_dtype", {}).items():
+        lines.append("%-28s %10s %8d %10s %10s" % (
+            name[:28], _fmt(b["live_bytes"], 1e6), b["live_count"],
+            _fmt(b["peak_bytes"], 1e6), _fmt(b["allocated_bytes"], 1e6)))
+    return lines
+
+
+def _fmt(v, scale=1.0):
+    if v is None:
+        return "-"
+    return "%.2f" % (v / scale)
+
+
 def reset():
-    """Zero every counter and re-arm the storm detector (tests)."""
+    """Zero every counter and re-arm the storm detector (tests).
+
+    Deliberately leaves the device-memory tracker alone — live-buffer
+    accounting must survive a counter reset; use
+    ``device_memory.reset()`` to drop that too."""
     from .log import reset_rate_limits
 
     _PER_OP.clear()
     _COUNTERS.clear()
     _STORM.clear()
     reset_rate_limits("recompile-storm:")
+
+
+# ------------------------------------------------------ diagnostic dump
+
+
+def diag_snapshot(top=20):
+    """The full diagnostic picture as one JSON-serializable dict:
+    counters snapshot (with memory + costs), the top-``top`` roofline
+    rows, and each storming op's recent cache keys (repr'd) — what
+    ``BENCH_ROOFLINE.md`` reconstructs offline, captured live."""
+    snap = snapshot()
+    # the dump is "the full picture": swap in the UNtrimmed memory
+    # breakdown (snapshot()'s default keeps report() tables short)
+    snap["memory"] = device_memory.snapshot(top=None)
+    storm_keys = {name: [repr(k) for k in list(st["keys"])]
+                  for name, st in list(_STORM.items()) if st["keys"]}
+    return {"version": 1, "pid": os.getpid(), "time": time.time(),
+            "snapshot": snap, "roofline": roofline(snap, top=top),
+            "recent_storm_keys": storm_keys}
+
+
+# per-call temp-name sequence; next() on a C iterator is signal-atomic
+_tmp_seq = itertools.count()
+
+
+def dump_diag(path=None, top=20):
+    """Atomically write :func:`diag_snapshot` as JSON to ``path``
+    (default: ``$MXNET_TPU_DIAG`` or ``mxnet_tpu_diag.json``); returns
+    the absolute path.  Write-to-temp + ``os.replace`` so a reader (or
+    a second SIGUSR1) never sees a torn file; the temp name is unique
+    per call (atomic counter), so a SIGUSR1 interrupting an in-progress
+    dump writes its own temp file instead of truncating the outer
+    one's — whichever replace lands last, the final file is whole."""
+    path = path or os.environ.get("MXNET_TPU_DIAG") \
+        or "mxnet_tpu_diag.json"
+    path = os.path.abspath(path)
+    tmp = os.path.join(os.path.dirname(path),
+                       ".%s.%d.%d.tmp" % (os.path.basename(path),
+                                          os.getpid(), next(_tmp_seq)))
+    with open(tmp, "w") as f:
+        json.dump(diag_snapshot(top=top), f, indent=1, default=repr)
+    os.replace(tmp, path)
+    return path
+
+
+def _install_diag_handler(path):
+    """SIGUSR1 -> dump_diag(path).  Safe to call from tests; tolerates
+    platforms without SIGUSR1 and non-main threads."""
+    import signal
+
+    sig = getattr(signal, "SIGUSR1", None)
+    if sig is None:
+        return False
+
+    def _handler(_signum, _frame):
+        try:
+            dump_diag(path)
+        except Exception:  # a diag request must never kill training
+            _logger().exception("MXNET_TPU_DIAG dump failed")
+
+    try:
+        signal.signal(sig, _handler)
+    except ValueError:  # not the main thread
+        return False
+    return True
+
+
+# the env-armed atexit dump can be disarmed by pure-reader processes
+# (the CLI / diagnose.py): a reader inheriting MXNET_TPU_DIAG from the
+# shell must not overwrite the training run's dump with its own empty
+# snapshot on exit
+_DIAG_STATE = {"armed": True}
+
+
+def _dump_diag_at_exit(path):
+    if not _DIAG_STATE["armed"]:
+        return
+    try:
+        dump_diag(path)
+    except Exception:
+        pass
+
+
+def _activate_diag_from_env():
+    """``MXNET_TPU_DIAG=<file>``: arm SIGUSR1 and dump at exit — ask a
+    live run for its roofline/memory picture with ``kill -USR1 <pid>``
+    (docs/OBSERVABILITY.md).  The same env turns on the device-memory
+    tracker (device_memory.py) and compile-time cost capture
+    (ops/registry.py) so the dump has data."""
+    path = os.environ.get("MXNET_TPU_DIAG")
+    if not path:
+        return False
+    import atexit
+
+    _install_diag_handler(path)
+    atexit.register(_dump_diag_at_exit, path)
+    return True
+
+
+_activate_diag_from_env()
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def main(argv=None):
+    """``python -m mxnet_tpu.runtime_stats [dump.json]`` — pretty-print
+    a diag dump, or this process's live counters when no file is given
+    (useful at a debugger prompt / fresh REPL)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.runtime_stats",
+        description="Pretty-print runtime telemetry: a MXNET_TPU_DIAG "
+                    "JSON dump, or the current process's counters.")
+    p.add_argument("dump", nargs="?", default=None,
+                   help="path of a diag dump written by dump_diag() / "
+                        "SIGUSR1; omit for the live in-process view")
+    p.add_argument("--top", type=int, default=20,
+                   help="roofline rows to show from a dump")
+    args = p.parse_args(argv)
+    # under `python -m` THIS file is the __main__ module while the
+    # framework counts into the canonical `mxnet_tpu.runtime_stats`
+    # import — always render through the canonical module
+    from mxnet_tpu import runtime_stats as _canonical
+
+    # this process is a READER: never let an inherited MXNET_TPU_DIAG
+    # overwrite the dump it came to display (both module copies may
+    # have armed an atexit hook under `python -m`)
+    _DIAG_STATE["armed"] = False
+    _canonical._DIAG_STATE["armed"] = False
+
+    if args.dump is None:
+        print(_canonical.report())
+        return 0
+    with open(args.dump) as f:
+        data = json.load(f)
+    snap = data.get("snapshot", data)
+    print(_canonical._render(snap, top=args.top))
+    storms = data.get("recent_storm_keys") or {}
+    print()
+    print("Recent storm keys")
+    if not storms:
+        print("(no recompile storms recorded)")
+    for name, keys in sorted(storms.items()):
+        print("%-28s %s" % (name[:28], "; ".join(keys[-3:])))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `... | head` closed the pipe: fine
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -33,9 +33,13 @@ def parse_args():
     p = argparse.ArgumentParser(
         formatter_class=argparse.ArgumentDefaultsHelpFormatter,
         description='Diagnose the current system for bug reports.')
-    for choice in ('python', 'pip', 'framework', 'os', 'hardware', 'environment'):
+    for choice in ('python', 'pip', 'framework', 'os', 'hardware', 'environment',
+                   'telemetry'):
         p.add_argument('--' + choice, default=1, type=int,
                        help='Diagnose {}.'.format(choice))
+    p.add_argument('--diag', default=None,
+                   help='Pretty-print this MXNET_TPU_DIAG dump in the telemetry '
+                        'section (default: $MXNET_TPU_DIAG, else live counters).')
     p.add_argument('--network', default=0, type=int,
                    help='Diagnose network (off by default: many TPU pods have no egress).')
     p.add_argument('--timeout', default=10, type=int,
@@ -95,6 +99,32 @@ def check_framework():
             print('  ... and %d more' % (len(devs) - 8,))
     except Exception as e:
         print('jax          : <unavailable: %s>' % (e,))
+
+
+def check_telemetry(diag_path=None):
+    """Telemetry view: pretty-print a MXNET_TPU_DIAG dump when given
+    (or found in the environment), else this process's live counters —
+    so a bug report carries the memory/cost picture, not just versions
+    (docs/OBSERVABILITY.md 'Memory & cost analytics')."""
+    _section('Telemetry Info')
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    try:
+        from mxnet_tpu import runtime_stats
+    except ImportError as e:
+        print('No framework installed:', e)
+        return
+    # diagnose is a pure reader: an inherited MXNET_TPU_DIAG must not
+    # make our exit overwrite the training run's dump (same disarm the
+    # runtime_stats CLI performs)
+    runtime_stats._DIAG_STATE['armed'] = False
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        runtime_stats.main([diag_path])
+        return
+    if diag_path:
+        print('Diag dump    : %s (not written yet — send SIGUSR1 to the '
+              'training pid or wait for exit)' % diag_path)
+    print(runtime_stats.report())
 
 
 def check_os():
@@ -173,6 +203,8 @@ def main():
         check_pip()
     if args.framework:
         check_framework()
+    if args.telemetry:
+        check_telemetry(args.diag)
     if args.network:
         check_network(args.timeout)
 

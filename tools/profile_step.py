@@ -104,7 +104,26 @@ def min_hbm_bytes(long_name):
     return shapes_bytes(long_name, hbm_only=True)
 
 
-def capture(batch, steps, trace_dir):
+def step_cost_model(step, x, y):
+    """Whole-step XLA cost/memory analysis (flops, cost-model bytes,
+    output/temp footprint) of the compiled train-step executable — the
+    same capture the dispatch layer performs per jit-cache entry
+    (mxnet_tpu.ops.registry.compiled_cost), surfaced here so the
+    summary carries the cost-model columns next to the measured ones.
+    Backends without the analyses just yield no columns."""
+    try:
+        from mxnet_tpu import random as mxrandom
+        from mxnet_tpu.ops.registry import compiled_cost
+
+        compiled = step._step.lower(
+            step.train_vals, step.opt_state, step.aux_vals, x, y,
+            mxrandom.next_key()).compile()
+        return compiled_cost(compiled) or {}
+    except Exception:
+        return {}
+
+
+def capture(batch, steps, trace_dir, want_cost=True):
     import jax
 
     from bench_common import build_train_step
@@ -113,6 +132,10 @@ def capture(batch, steps, trace_dir):
     for _ in range(3):
         l = step(x, y)
     float(np.asarray(l))
+    # the AOT lower().compile() behind the cost columns re-compiles the
+    # whole step once — skippable (--cost 0) when only the measured
+    # trace matters
+    cost = step_cost_model(step, x, y) if want_cost else {}
 
     jax.profiler.start_trace(trace_dir)
     for _ in range(steps):
@@ -120,7 +143,7 @@ def capture(batch, steps, trace_dir):
     float(np.asarray(l))
     jax.profiler.stop_trace()
     return sorted(glob.glob(os.path.join(
-        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))[-1]
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))[-1], cost
 
 
 def parse(trace_path, steps):
@@ -223,10 +246,16 @@ def main(argv=None):
     p.add_argument("--parse-only", default=None,
                    help="parse an existing trace.json.gz instead of "
                         "capturing")
+    p.add_argument("--cost", type=int, default=1,
+                   help="also capture the whole-step XLA cost model "
+                        "(one extra compile); 0 skips it")
     args = p.parse_args(argv)
 
-    trace = args.parse_only or capture(args.batch, args.steps,
-                                       args.trace_dir)
+    if args.parse_only:
+        trace, cost = args.parse_only, {}
+    else:
+        trace, cost = capture(args.batch, args.steps, args.trace_dir,
+                              want_cost=bool(args.cost))
     rows, step_us, prefetch = parse(trace, args.steps)
     total_us = sum(r["us_per_step"] for r in rows)
     total_bound = sum(r["bound_us"] for r in rows)
@@ -245,6 +274,18 @@ def main(argv=None):
         "implied_gbps_whole_step": round(
             hbm_gb * 1e9 / (step_us * 1e-6) / 1e9, 1),
     }
+    if cost.get("flops"):
+        summary["cost_model_gflops"] = round(cost["flops"] / 1e9, 2)
+    if cost.get("bytes_accessed"):
+        # cost-model bytes overcount HBM (fusion-internal reads — the
+        # r3 lesson); reported for comparison against the measured floor
+        summary["cost_model_gb"] = round(cost["bytes_accessed"] / 1e9, 2)
+    if cost.get("temp_bytes") is not None:
+        # temp + output combined: the executable's working set beyond
+        # its arguments — named to say so
+        summary["cost_model_temp_out_gb"] = round(
+            (cost.get("temp_bytes", 0) + cost.get("output_bytes", 0))
+            / 1e9, 2)
     print(json.dumps(summary))
     for r in rows[:args.top]:
         print("%8.1f us  bound %7.1f  %6.1f GB/s  mxu %5.1f%%  %-28s %s"
