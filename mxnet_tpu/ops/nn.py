@@ -248,6 +248,10 @@ def fully_connected(data, weight, bias=None, num_hidden=1, no_bias=False, flatte
 
 @register("Activation")
 def activation(data, act_type="relu", **_):
+    """Elementwise activation (reference: src/operator/nn/activation.cc).
+
+    ``act_type``: relu / sigmoid / tanh / softrelu (softplus) /
+    softsign — each lowers to the matching jax.nn / jnp primitive."""
     f = {
         "relu": jax.nn.relu,
         "sigmoid": jax.nn.sigmoid,
@@ -261,6 +265,9 @@ def activation(data, act_type="relu", **_):
 @register("LeakyReLU")
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
                upper_bound=0.334, **_):
+    """Leaky-ReLU family (reference: src/operator/leaky_relu.cc):
+    leaky / prelu (learned ``gamma``) / elu / selu / gelu / rrelu
+    (eval-mode mean slope — training rrelu needs the Dropout key path)."""
     if act_type == "leaky":
         return jnp.where(data > 0, data, slope * data)
     if act_type == "prelu":
@@ -281,6 +288,9 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125
 
 @register("softmax")
 def softmax(data, axis=-1, temperature=None, length=None, **_):
+    """Softmax along ``axis`` (reference: src/operator/nn/softmax.cc)
+    with optional ``temperature`` scaling and ``length``-masked
+    variable-length rows (masked positions emit exact zeros)."""
     x = data
     if temperature is not None and temperature != 1.0:
         x = x / temperature
@@ -297,6 +307,8 @@ def softmax(data, axis=-1, temperature=None, length=None, **_):
 
 @register("log_softmax")
 def log_softmax(data, axis=-1, temperature=None, **_):
+    """Numerically-stable log(softmax(data)) along ``axis`` with
+    optional ``temperature`` (reference: src/operator/nn/softmax.cc)."""
     x = data
     if temperature is not None and temperature != 1.0:
         x = x / temperature
@@ -305,11 +317,16 @@ def log_softmax(data, axis=-1, temperature=None, **_):
 
 @register("softmin")
 def softmin(data, axis=-1, **_):
+    """softmax(-data): assigns the highest probability to the SMALLEST
+    element along ``axis`` (reference: src/operator/nn/softmin.cc)."""
     return jax.nn.softmax(-data, axis=int(axis))
 
 
 @register("SoftmaxActivation")
 def softmax_activation(data, mode="instance", **_):
+    """Deprecated-in-reference softmax layer
+    (src/operator/nn/softmax_activation.cc): mode='instance' flattens
+    each sample, mode='channel' normalizes along axis 1."""
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
@@ -376,6 +393,8 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=
 
 @register("softmax_cross_entropy")
 def softmax_cross_entropy(data, label, **_):
+    """Summed cross-entropy of softmax(data) against integer ``label``
+    indices (reference: src/operator/loss_binary_op.cc)."""
     logp = jax.nn.log_softmax(data, axis=-1)
     nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
     return jnp.sum(nll)
@@ -383,16 +402,22 @@ def softmax_cross_entropy(data, label, **_):
 
 @register("LinearRegressionOutput")
 def linear_regression_output(data, label, grad_scale=1.0, **_):
+    """Identity forward with fused L2-loss backward ``pred - label``
+    (reference: src/operator/regression_output.cc — Module-API head)."""
     return _regression_out(data, label, grad_scale, "linear")
 
 
 @register("MAERegressionOutput")
 def mae_regression_output(data, label, grad_scale=1.0, **_):
+    """Identity forward with fused L1-loss backward ``sign(pred -
+    label)`` (reference: src/operator/regression_output.cc)."""
     return _regression_out(data, label, grad_scale, "mae")
 
 
 @register("LogisticRegressionOutput")
 def logistic_regression_output(data, label, grad_scale=1.0, **_):
+    """sigmoid(data) forward with the fused cross-entropy backward
+    ``pred - label`` (reference: src/operator/regression_output.cc)."""
     return _regression_out(data, label, grad_scale, "logistic")
 
 
@@ -498,6 +523,8 @@ def _expand(v, axis, ndim):
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **_):
+    """Layer normalization over ``axis`` with learned ``gamma``/``beta``
+    (reference: src/operator/nn/layer_norm.cc)."""
     ax = int(axis)
     mean = jnp.mean(data, axis=ax, keepdims=True)
     var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
@@ -509,6 +536,8 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **_)
 
 @register("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3, **_):
+    """Instance normalization: per-sample, per-channel statistics over
+    the spatial axes (reference: src/operator/instance_norm.cc)."""
     red = tuple(range(2, data.ndim))
     mean = jnp.mean(data, axis=red, keepdims=True)
     var = jnp.mean(jnp.square(data - mean), axis=red, keepdims=True)
@@ -519,6 +548,8 @@ def instance_norm(data, gamma, beta, eps=1e-3, **_):
 
 @register("L2Normalization")
 def l2_normalization(data, eps=1e-10, mode="instance", **_):
+    """Scale entries to unit L2 norm per instance/channel/spatial
+    position (reference: src/operator/l2_normalization.cc)."""
     if mode == "instance":
         red = tuple(range(1, data.ndim))
     elif mode == "channel":
@@ -677,6 +708,9 @@ def dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False, **_):
 @register("UpSampling")
 def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
                multi_input_mode="concat", workspace=512, **_):
+    """Spatial upsampling (reference: src/operator/nn/upsampling.cc):
+    'nearest' repeats pixels (multi-input concat supported), 'bilinear'
+    uses jax.image.resize in place of the reference's deconv kernel."""
     data = args[0]
     s = int(scale)
     if sample_type == "nearest":
@@ -723,6 +757,10 @@ def bilinear_sampler(data, grid, **_):
 
 @register("GridGenerator")
 def grid_generator(data, transform_type="affine", target_shape=(0, 0), **_):
+    """Sampling-grid generation for the spatial transformer (reference:
+    src/operator/grid_generator.cc): 'affine' expands 2x3 thetas onto a
+    normalized (h, w) mesh, 'warp' converts a flow field to grid
+    coordinates."""
     h, w = int(target_shape[0]), int(target_shape[1])
     if transform_type == "affine":
         theta = data.reshape((-1, 2, 3))
@@ -746,6 +784,9 @@ def grid_generator(data, transform_type="affine", target_shape=(0, 0), **_):
 @register("SpatialTransformer")
 def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
                         sampler_type="bilinear", **_):
+    """Spatial transformer network head (reference:
+    src/operator/spatial_transformer.cc): affine grid from ``loc``
+    thetas + bilinear sampling of ``data``."""
     grid = grid_generator(loc, transform_type="affine", target_shape=target_shape)
     return bilinear_sampler(data, grid)
 

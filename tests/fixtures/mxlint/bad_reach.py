@@ -1,0 +1,92 @@
+"""Known-bad interprocedural fixture (linted as a fake ops/ file).
+
+Expected host-sync-reachability findings: exactly 9
+  1. _indirect calls _to_scalar (helper chain, one hop)
+  2. dispatch_like calls _indirect (TWO-hop: the full path
+     dispatch_like → _indirect → _to_scalar → .item() is reported)
+  3. decorated_reader calls _to_scalar (decorated fns still analyzed)
+  4. grab (a ``name = lambda`` binding) calls _indirect
+  5. fetch_buffer calls _alias_helper (whose sink is np.asarray via the
+     aliased ``import numpy as _np``)
+  6. _ping calls _pong   (call-graph cycle, syncing)
+  7. _pong calls _ping   (the cycle's other edge; propagation terminates)
+  8. branchy_op branches on a tensor value (``if data:``)
+  9. flush_cache calls save() — a sync-by-contract (whitelisted) fn
+
+_to_scalar's own ``.item()`` is the per-function trace-host-sync rule's
+finding, NOT one of this rule's.
+"""
+
+import functools
+
+import jax.numpy as jnp
+import numpy as _np
+
+from mxnet_tpu.ops.registry import register  # noqa: F401  (fixture only)
+
+
+def _to_scalar(v):
+    return v.item()              # direct sink (owned by trace-host-sync)
+
+
+def _indirect(v):
+    return _to_scalar(v)         # finding 1
+
+
+@register("_mxlint_reach_bad", num_outputs=1)
+def dispatch_like(data, scale=1.0):
+    """Registered op reaching .item() two calls away."""
+    y = jnp.exp(data) * scale
+    return _indirect(y)          # finding 2 (two-hop path in message)
+
+
+def _deco(fn):
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        return fn(*a, **k)
+    return wrap
+
+
+@_deco
+def decorated_reader(x):
+    return _to_scalar(x)         # finding 3
+
+
+grab = lambda v: _indirect(v)    # noqa: E731  finding 4
+
+
+def _alias_helper(arr):
+    buf = arr._data              # tensor-typed by inference
+    return _np.asarray(buf)      # sink via aliased numpy import
+
+
+def fetch_buffer(x):
+    return _alias_helper(x)      # finding 5
+
+
+def _ping(v, n):
+    if n:
+        return _pong(v, n - 1)   # finding 6 (cycle edge)
+    return v
+
+
+def _pong(v, n):
+    v.block_until_ready()        # direct sink inside the cycle
+    return _ping(v, n)           # finding 7 (cycle closes; BFS terminates)
+
+
+@register("_mxlint_reach_branch", num_outputs=1)
+def branchy_op(data, flag=False):
+    """Branching on a tensor triggers __bool__ — a host sync."""
+    if data:                     # finding 8
+        return data
+    return data
+
+
+def save(arrays):
+    # whitelisted name: blocking inside is the contract — exempt
+    return [a.asnumpy() for a in arrays]
+
+
+def flush_cache(arrays):
+    return save(arrays)          # finding 9 (sync by contract)

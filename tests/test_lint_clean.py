@@ -8,10 +8,20 @@ with the exact finding list.  To intentionally accept a finding, run
     python -m tools.mxlint mxnet_tpu/ --update-baseline
 
 and justify the baseline diff in review (see docs/LINTING.md).
+
+Beyond the static rules this module also gates the *runtime* registry
+audits: table consistency, per-op eval_shape traceability, docstring
+coverage, and — new — transform conformance (every canonical-spec op
+must trace under jax.vjp and jax.vmap, or be pragma'd/grandfathered;
+the grandfather lists in the baseline's "transforms" section only ever
+shrink) plus the generated capability matrix staying in sync.  A
+wall-time budget keeps the whole gate honest about its tier-1 cost.
 """
 
+import functools
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -19,18 +29,33 @@ if REPO not in sys.path:
 
 from tools.mxlint import (DEFAULT_BASELINE, apply_baseline,  # noqa: E402
                           lint_paths, load_baseline)
-from tools.mxlint.findings import load_registry_grandfather  # noqa: E402
-from tools.mxlint.registry_audit import audit_registry  # noqa: E402
+from tools.mxlint.findings import (load_registry_grandfather,  # noqa: E402
+                                   load_transform_grandfather)
+from tools.mxlint.registry_audit import (audit_registry,  # noqa: E402
+                                         transform_audit)
+
+# wall-time spent in each (cold) gate component, for the budget test
+_TIMINGS = {}
+
+# generous-but-real bound for the full static lint (now including the
+# interprocedural call-graph pass) + eval_shape audit + dual-transform
+# audit on CPU: observed ~15s cold on the CI-class container, so 8x
+# headroom before the gate is considered to have outgrown tier-1
+_BUDGET_SECONDS = 120.0
 
 
-import functools  # noqa: E402
+def _timed(key, fn):
+    t0 = time.monotonic()
+    out = fn()
+    _TIMINGS[key] = _TIMINGS.get(key, 0.0) + (time.monotonic() - t0)
+    return out
 
 
 @functools.lru_cache(maxsize=None)
 def _run_lint():
     """One full-tree lint shared by every gate test in this module."""
-    findings, errors = lint_paths([os.path.join(REPO, "mxnet_tpu")],
-                                  base=REPO)
+    findings, errors = _timed("lint", lambda: lint_paths(
+        [os.path.join(REPO, "mxnet_tpu")], base=REPO))
     assert errors == [], "mxlint could not parse the tree:\n%s" \
         % "\n".join(errors)
     return apply_baseline(findings, load_baseline(DEFAULT_BASELINE))
@@ -38,7 +63,15 @@ def _run_lint():
 
 @functools.lru_cache(maxsize=None)
 def _audit(eval_shapes):
-    return audit_registry(eval_shapes=eval_shapes)
+    # share the transform matrix so each op is traced once per session
+    matrix = _transforms() if eval_shapes else None
+    return _timed("audit", lambda: audit_registry(
+        eval_shapes=eval_shapes, matrix=matrix))
+
+
+@functools.lru_cache(maxsize=None)
+def _transforms():
+    return _timed("transforms", transform_audit)
 
 
 def test_mxlint_zero_new_findings():
@@ -86,3 +119,74 @@ def test_registry_audit_no_new_docless_ops():
     assert new == [], (
         "newly registered ops without docstrings: %s (document them; "
         "only pre-existing ops are grandfathered)" % ", ".join(new))
+
+
+# ------------------------------------------------ transform conformance
+
+
+def test_transform_verdicts_complete():
+    """Every canonical-spec table op has a recorded trace/grad/vmap
+    verdict — a new table entry cannot dodge the audit."""
+    from mxnet_tpu.ops import registry as R
+
+    matrix = _transforms()
+    assert set(matrix) == set(R.OP_INPUT_NAMES), (
+        "ops missing from the transform matrix: %s"
+        % sorted(set(R.OP_INPUT_NAMES) - set(matrix)))
+    for name, caps in matrix.items():
+        assert set(caps) == {"trace", "grad", "vmap"}, name
+        for t, (verdict, _detail) in caps.items():
+            assert verdict in ("ok", "fail", "pragma", "n/a"), (name, t)
+
+
+def test_transform_conformance_gate():
+    """New ops must be grad- and vmap-clean (or explicitly pragma'd in
+    TRANSFORM_PRAGMAS); the baseline's transforms section grandfathers
+    pre-existing failures and only ever shrinks."""
+    matrix = _transforms()
+    allowed = load_transform_grandfather(DEFAULT_BASELINE)
+    new, stale = [], []
+    for t in ("grad", "vmap"):
+        failing = {op for op, caps in matrix.items()
+                   if caps[t][0] == "fail"}
+        grandfathered = allowed.get(t, set())
+        for op in sorted(failing - grandfathered):
+            new.append("%s under %s: %s" % (op, t, matrix[op][t][1]))
+        for op in sorted(grandfathered - failing):
+            stale.append("%s under %s" % (op, t))
+    assert new == [], (
+        "ops newly failing a transform (fix the op, or — only for "
+        "by-design cases — add a TRANSFORM_PRAGMAS entry in "
+        "tools/mxlint/registry_audit.py with a reason):\n"
+        + "\n".join(new))
+    assert stale == [], (
+        "stale transforms grandfather entries (the op now conforms; "
+        "run `python -m tools.mxlint.registry_audit "
+        "--update-baseline`):\n" + "\n".join(stale))
+
+
+def test_capability_matrix_up_to_date():
+    """docs/OP_CAPABILITIES.md is generated and deterministic: the
+    committed file must match a fresh regeneration byte-for-byte."""
+    from tools.mxlint.capabilities import DOC_PATH, generate
+
+    with open(DOC_PATH, encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == generate(_transforms()), (
+        "docs/OP_CAPABILITIES.md is stale — regenerate with "
+        "`python -m tools.mxlint.capabilities`")
+
+
+def test_lint_and_audit_runtime_budget():
+    """The full gate (static lint incl. the interprocedural pass +
+    eval_shape audit + dual-transform audit) must stay cheap enough to
+    ride tier-1 on CPU."""
+    _run_lint()
+    _audit(True)
+    _transforms()
+    total = sum(_TIMINGS.values())
+    assert total < _BUDGET_SECONDS, (
+        "lint+audit gate took %.1fs (> %.0fs budget): %s — profile the "
+        "analyzer before letting tier-1 eat this"
+        % (total, _BUDGET_SECONDS,
+           ", ".join("%s=%.1fs" % kv for kv in sorted(_TIMINGS.items()))))

@@ -42,6 +42,7 @@ BAD_GOOD = [
     ("static-argnames", "bad_static.py", 4, "good_static.py"),
     ("registry-consistency", "bad_registry.py", 4, "good_registry.py"),
     ("dtype-default", "bad_dtype.py", 4, "good_dtype.py"),
+    ("host-sync-reachability", "bad_reach.py", 9, "good_reach.py"),
 ]
 
 
@@ -100,6 +101,202 @@ def test_registry_cross_file():
         {"mxnet_tpu/ops/registry.py": table_src,
          "mxnet_tpu/ops/other.py": op_src},
         Config(rules=("registry-consistency",)))
+    assert findings == []
+
+
+# ---------------------------------------------- interprocedural rule
+
+
+def test_reach_rule_details():
+    """The seeded fixture reports full call paths, incl. the two-hop
+    chain, the sync-by-contract edge, and the tensor host-branch."""
+    findings = _lint_fixture("bad_reach.py", "host-sync-reachability")
+    msgs = "\n".join(f.format() for f in findings)
+    # the acceptance two-hop: compute fn -> helper -> .item(), with the
+    # whole path in the message
+    assert "dispatch_like → _indirect → _to_scalar → .item()" in msgs
+    assert "(sync by contract)" in msgs           # flush_cache -> save
+    assert "if data:" in msgs                     # host branch
+    assert "np.asarray(<tensor>)" in msgs         # aliased _np import
+    assert ".block_until_ready()" in msgs         # cycle sink
+    symbols = {f.symbol for f in findings}
+    assert "grab" in symbols                      # name = lambda
+    assert "decorated_reader" in symbols          # decorated fn
+    # the by-design pragma'd helper and whitelisted fns stayed silent
+    assert "save" not in symbols
+    assert "_to_scalar" not in symbols            # direct rule owns it
+
+
+def test_reach_cross_file():
+    """A sync hidden in a helper MODULE is caught at the compute-path
+    call site, with the cross-module path reported."""
+    util = ("def leak(v):\n"
+            "    return v.item()\n")
+    comp = ("from mxnet_tpu.util import leak\n\n\n"
+            "def dispatch(x):\n"
+            "    return leak(x)\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/util.py": util, "mxnet_tpu/executor.py": comp},
+        Config(rules=("host-sync-reachability",)))
+    assert len(findings) == 1, \
+        "\n".join(f.format() for f in findings)
+    assert findings[0].path == "mxnet_tpu/executor.py"
+    assert "dispatch → leak → .item()" in findings[0].message
+
+
+def test_reach_partial_scope_is_conservative():
+    """Without the helper's module in scope the callee is unresolvable
+    -> unknown -> silent (no false positives on partial runs)."""
+    comp = ("from mxnet_tpu.util import leak\n\n\n"
+            "def dispatch(x):\n"
+            "    return leak(x)\n")
+    findings, _ = lint_sources({"mxnet_tpu/executor.py": comp},
+                               Config(rules=("host-sync-reachability",)))
+    assert findings == []
+
+
+def test_reach_scoped_to_compute_paths():
+    """The same chain OUTSIDE the compute-path globs is not flagged."""
+    src = ("def leak(v):\n"
+           "    return v.item()\n\n\n"
+           "def caller(x):\n"
+           "    return leak(x)\n")
+    findings, _ = lint_sources({"mxnet_tpu/metric.py": src},
+                               Config(rules=("host-sync-reachability",)))
+    assert findings == []
+
+
+def test_reach_pragma_at_call_site():
+    util = ("def leak(v):\n"
+            "    return v.item()\n")
+    comp = ("from mxnet_tpu.util import leak\n\n\n"
+            "def dispatch(x):\n"
+            "    return leak(x)  "
+            "# mxlint: disable=host-sync-reachability -- bridge\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/util.py": util, "mxnet_tpu/executor.py": comp},
+        Config(rules=("host-sync-reachability",)))
+    assert findings == []
+
+
+def test_reach_pragma_at_sink_clears_all_callers():
+    """trace-host-sync pragmas carry over: a by-design bridge pragma'd
+    at the SOURCE clears every transitive call site at once."""
+    util = ("def leak(v):\n"
+            "    return v.item()  "
+            "# mxlint: disable=trace-host-sync -- host bridge\n")
+    comp = ("from mxnet_tpu.util import leak\n\n\n"
+            "def dispatch(x):\n"
+            "    return leak(x)\n"
+            "def dispatch2(x):\n"
+            "    return leak(x)\n")
+    findings, _ = lint_sources(
+        {"mxnet_tpu/util.py": util, "mxnet_tpu/executor.py": comp},
+        Config(rules=("host-sync-reachability",)))
+    assert findings == []
+
+
+def test_callgraph_classification():
+    from tools.mxlint.callgraph import build_graph, classify
+    from tools.mxlint.checkers import _FileCtx
+
+    src = ("import jax.numpy as jnp\n"
+           "def syncer(v):\n"
+           "    return v.item()\n"
+           "def pure_fn(v):\n"
+           "    return jnp.exp(v)\n"
+           "def caller(v):\n"
+           "    return pure_fn(v)\n"
+           "def transitive(v):\n"
+           "    return syncer(v)\n"
+           "def unknown_fn(cb, v):\n"
+           "    return cb(v)\n"
+           "def tainted(cb, v):\n"
+           "    return unknown_fn(cb, v)\n")
+    ctx = _FileCtx("mxnet_tpu/ops/x.py", src, Config())
+    cls = classify(build_graph([ctx]))
+
+    def k(n):
+        return ("mxnet_tpu.ops.x", n)
+
+    assert cls[k("syncer")] == "host-syncing"
+    assert cls[k("pure_fn")] == "pure"
+    assert cls[k("caller")] == "pure"
+    assert cls[k("transitive")] == "host-syncing"
+    assert cls[k("unknown_fn")] == "unknown"
+    assert cls[k("tainted")] == "unknown"  # unknown-ness propagates
+
+
+def test_callgraph_pure_cycle_terminates():
+    src = ("import jax.numpy as jnp\n"
+           "def a(v, n):\n"
+           "    if n:\n"
+           "        return b(v, n - 1)\n"
+           "    return v\n"
+           "def b(v, n):\n"
+           "    return a(jnp.tanh(v), n)\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("host-sync-reachability",)))
+    assert findings == []
+
+
+def test_reach_branch_descs_match_source_construct():
+    """While-loops and negated tests are reported as written, not as a
+    generic `if name:`."""
+    src = ("from mxnet_tpu.ops.registry import register\n\n\n"
+           "@register('_w')\n"
+           "def spin(data):\n"
+           "    \"\"\"doc\"\"\"\n"
+           "    while data:\n"
+           "        data = data - 1\n"
+           "    return data\n\n\n"
+           "@register('_n')\n"
+           "def neg(mask):\n"
+           "    \"\"\"doc\"\"\"\n"
+           "    if not mask:\n"
+           "        return mask\n"
+           "    return mask\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("host-sync-reachability",)))
+    msgs = "\n".join(f.format() for f in findings)
+    assert len(findings) == 2, msgs
+    assert "while data:" in msgs
+    assert "if not mask:" in msgs
+
+
+def test_reach_param_and_local_shadowing():
+    """A parameter or local rebinding shadowing a syncing module-level
+    name makes the call UNKNOWN, never a false positive."""
+    src = ("def leak(v):\n"
+           "    return v.item()\n\n\n"
+           "def via_param(x, leak):\n"
+           "    return leak(x)\n\n\n"
+           "def via_local(x):\n"
+           "    leak = abs\n"
+           "    return leak(x)\n\n\n"
+           "def via_loop(x, fns):\n"
+           "    for leak in fns:\n"
+           "        x = leak(x)\n"
+           "    return x\n\n\n"
+           "def real_call(x):\n"
+           "    return leak(x)\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("host-sync-reachability",)))
+    assert len(findings) == 1, "\n".join(f.format() for f in findings)
+    assert findings[0].symbol == "real_call"
+
+
+def test_reach_nested_def_resolution():
+    """A nested def shadowing a syncing module-level name wins — python
+    scoping, not dotted-name guessing."""
+    src = ("def leak(v):\n"
+           "    return v.item()\n\n\n"
+           "def dispatch(x):\n"
+           "    def leak(y):\n"
+           "        return y * 2\n"
+           "    return leak(x)\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("host-sync-reachability",)))
     assert findings == []
 
 
@@ -476,3 +673,242 @@ def test_canonical_specs_cover_input_table():
         assert spec is not None, "no canonical spec for %r" % name
         input_specs, _attrs = spec
         assert len(input_specs) == len(input_names), name
+
+
+# ------------------------------------------- transform conformance
+
+
+def test_check_grad_flags_bad_cotangent_shape():
+    """A custom_vjp whose backward emits the wrong shape is caught —
+    the audit checks cotangents against primals, not just 'it traced'."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.mxlint.registry_audit import _check_grad
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(res, g):
+        return (jnp.zeros((7,), g.dtype),)  # wrong: primal is (3,)
+
+    f.defvjp(fwd, bwd)
+    spec = [jax.ShapeDtypeStruct((3,), jnp.float32)]
+    err = _check_grad(f, spec, [0])
+    # jax itself validates custom_vjp bwd shapes at trace time (newer
+    # versions); the audit's own cotangent check is the backstop —
+    # either way a shape-lying backward must surface as an error
+    assert err is not None and ("cotangent shape" in err
+                                or "bwd rule" in err)
+
+
+def test_check_grad_ok_on_plain_fn():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.mxlint.registry_audit import _check_grad
+
+    spec = [jax.ShapeDtypeStruct((3, 4), jnp.float32)]
+    assert _check_grad(lambda x: jnp.sum(jnp.tanh(x)), spec, [0]) is None
+
+
+def test_check_vmap_flags_unbatchable_callback():
+    """A host-callback op (the CustomOp analog) does not compose with
+    vmap — the audit reports it instead of letting it crash later."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    from tools.mxlint.registry_audit import _check_vmap
+
+    def f(x):
+        return io_callback(
+            lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x,
+            ordered=True)
+
+    spec = [jax.ShapeDtypeStruct((3,), jnp.float32)]
+    err = _check_vmap(f, spec)
+    assert err is not None and "vmap" in err
+
+
+def test_transform_audit_excludes_aux_and_int_inputs():
+    """BatchNorm's moving stats (aux) and Embedding's indices (int) are
+    not differentiated — mirroring executor grad_req semantics."""
+    from mxnet_tpu.ops import registry as R
+    from tools.mxlint.registry_audit import _diff_argnums, canonical_spec
+
+    bn_specs, _ = canonical_spec("BatchNorm")
+    nums = _diff_argnums("BatchNorm", bn_specs, 0)
+    names = R.OP_INPUT_NAMES["BatchNorm"]
+    picked = [names[i] for i in nums]
+    assert "moving_mean" not in picked and "moving_var" not in picked
+    assert "data" in picked and "gamma" in picked
+
+    emb_specs, _ = canonical_spec("Embedding")
+    nums = _diff_argnums("Embedding", emb_specs, 0)
+    assert [R.OP_INPUT_NAMES["Embedding"][i] for i in nums] == ["weight"]
+
+
+def test_transform_pragma_renders_in_matrix():
+    """A TRANSFORM_PRAGMAS entry turns the verdict into 'pragma' and
+    the generated doc footnotes the reason."""
+    from tools.mxlint import capabilities, registry_audit
+
+    registry_audit.TRANSFORM_PRAGMAS["dot"] = {
+        "vmap": "test-only pragma reason"}
+    try:
+        matrix = registry_audit.transform_audit()
+        assert matrix["dot"]["vmap"] == ("pragma",
+                                         "test-only pragma reason")
+        doc = capabilities.generate(matrix)
+        assert "pragma[^1]" in doc
+        assert "[^1]: test-only pragma reason" in doc
+    finally:
+        del registry_audit.TRANSFORM_PRAGMAS["dot"]
+
+
+def test_capability_doc_deterministic():
+    from tools.mxlint.capabilities import generate
+    from tools.mxlint.registry_audit import transform_audit
+
+    m = transform_audit()
+    assert generate(m) == generate(m)
+    assert generate(m) == generate(transform_audit())
+
+
+def test_transform_baseline_roundtrip(tmp_path):
+    from tools.mxlint.findings import (load_transform_grandfather,
+                                       save_registry_grandfather,
+                                       save_transform_grandfather)
+
+    bl = str(tmp_path / "baseline.json")
+    save_transform_grandfather(bl, {"grad": ["OpA"], "vmap": []})
+    save_registry_grandfather(bl, ["op_x"])      # preserves transforms
+    save_baseline(bl, _bad_dtype_findings())     # preserves both
+    assert load_transform_grandfather(bl) == {"grad": {"OpA"},
+                                              "vmap": set()}
+    with open(bl) as f:
+        data = json.load(f)
+    assert data["registry"]["missing_docstrings"] == ["op_x"]
+    assert data["findings"]
+
+
+def test_registry_audit_cli_fails_on_new_transform_failure(tmp_path,
+                                                           capsys):
+    """The standalone audit's exit code must reflect non-grandfathered
+    grad/vmap failures (an rc-checking CI step may run it without the
+    pytest gate)."""
+    from tools.mxlint import registry_audit
+
+    # inject a vmap failure by monkeypatching the matrix for one op
+    real = registry_audit.transform_audit
+
+    def fake():
+        m = real()
+        m["dot"] = dict(m["dot"], vmap=("fail", "injected failure"))
+        return m
+
+    registry_audit.transform_audit = fake
+    try:
+        rc = registry_audit.main([])
+    finally:
+        registry_audit.transform_audit = real
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "dot under vmap: injected failure" in out
+    # and an 'op does not trace' collapse is NOT a grandfather
+    # candidate: --update-baseline to a scratch copy must skip it
+
+    def fake2():
+        m = real()
+        m["dot"] = dict(m["dot"],
+                        grad=("fail", "op does not trace"),
+                        vmap=("fail", "real vmap defect"))
+        return m
+
+    import shutil
+
+    from tools.mxlint import cli as mxcli
+
+    scratch = str(tmp_path / "bl.json")
+    shutil.copy(mxcli.DEFAULT_BASELINE, scratch)
+    registry_audit.transform_audit = fake2
+    old_default = mxcli.DEFAULT_BASELINE
+    mxcli.DEFAULT_BASELINE = scratch
+    try:
+        registry_audit.main(["--update-baseline"])
+    finally:
+        mxcli.DEFAULT_BASELINE = old_default
+        registry_audit.transform_audit = real
+    from tools.mxlint.findings import load_transform_grandfather
+
+    gf = load_transform_grandfather(scratch)
+    assert "dot" not in gf.get("grad", set())   # trace collapse skipped
+    assert "dot" in gf.get("vmap", set())       # genuine defect kept
+    capsys.readouterr()
+
+
+# --------------------------------------------------- github format
+
+
+def test_cli_github_format_annotations(tmp_path, capsys):
+    import shutil
+
+    from tools.mxlint import main
+
+    ops_dir = tmp_path / "mxnet_tpu" / "ops"
+    ops_dir.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_dtype.py"),
+                str(ops_dir / "bad.py"))
+    rc = main([str(tmp_path / "mxnet_tpu"), "--no-baseline",
+               "--rules", "dtype-default", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith("::error file=")]
+    assert len(lines) == 4
+    assert all(",line=" in ln and "title=mxlint dtype-default" in ln
+               for ln in lines)
+    # workflow-command escaping: no raw newline can survive inside a
+    # message, and the summary line still prints
+    assert "4 new finding(s)" in out
+
+
+def test_cli_github_format_clean_repo(capsys):
+    from tools.mxlint import main
+
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = main(["mxnet_tpu", "--format", "github"])
+    finally:
+        os.chdir(old)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "::error" not in out
+
+
+def test_cli_github_format_show_baselined(capsys):
+    """--show-baselined surfaces suppressed findings as ::notice
+    annotations in github mode (it is not silently ignored)."""
+    from tools.mxlint import main
+
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc = main(["mxnet_tpu", "--format", "github",
+                   "--show-baselined"])
+    finally:
+        os.chdir(old)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "::error" not in out
+    notices = [ln for ln in out.splitlines()
+               if ln.startswith("::notice file=")]
+    assert notices, out
+    assert "%d baselined" % len(notices) in out  # one notice per entry
+    assert all("mxlint baselined" in ln for ln in notices)

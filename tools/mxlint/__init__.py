@@ -6,8 +6,12 @@ One accidental host sync (``.item()``, ``float()`` on a traced value,
 ``np.asarray`` on a jax array) or one unhashable value leaking into
 ``static_argnames`` silently turns the async dependency-engine analog
 into a blocking, recompile-storming slow path.  mxlint proves the op
-compute paths stay inside the traceable subset — statically (AST
-rules) plus a runtime registry audit (``registry_audit.py``).
+compute paths stay inside the traceable subset — statically (per-file
+AST rules plus the interprocedural host-sync-reachability pass in
+``callgraph.py``) and at runtime (``registry_audit.py``: registry
+tables, eval_shape traceability, and vjp/vmap transform conformance —
+the per-op capability matrix is generated into docs/OP_CAPABILITIES.md
+by ``capabilities.py``).
 
 Usage::
 

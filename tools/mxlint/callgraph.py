@@ -1,0 +1,609 @@
+"""Interprocedural host-sync reachability — the ``host-sync-reachability``
+rule.
+
+The per-function ``trace-host-sync`` rule (checkers.py) only sees syncs
+written *inside* a compute-path function.  A helper that calls
+``.item()`` is invisible the moment it is wrapped in another function:
+
+    def _to_scalar(v):          # mxnet_tpu/util.py — not a compute path
+        return v.item()         # <- never linted by the per-function rule
+
+    def dispatch(x):            # mxnet_tpu/executor.py — compute path
+        return _to_scalar(x)    # <- silent device->host sync per call
+
+This module builds a module-level call graph over every linted file,
+classifies each function as **host-syncing** (contains a non-pragma'd
+sync, or transitively reaches one), **pure** (no sync, every callee
+resolved and clean) or **unknown** (at least one unresolvable callee),
+and flags every call site in a compute-path function whose callee
+*transitively* reaches a host sync — printing the offending path
+(``dispatch → _to_scalar → .item()``).
+
+Resolution is deliberately conservative — zero false positives over
+completeness: a call becomes a graph edge only when the target is
+statically resolvable (nested defs in enclosing function scopes,
+module-level functions, literal ``name = lambda ...`` bindings,
+``self.``/``cls.`` methods of the enclosing class, ``from .mod import
+fn`` names, ``mod.fn`` where ``mod`` aliases a linted module, and
+one-hop re-exports through a linted package ``__init__``).  Everything
+else is *unknown* and propagates nothing.
+
+Sink catalogue (a function is directly host-syncing when its own scope
+has any of these, not pragma-suppressed):
+
+- ``.item()`` / ``.tolist()`` / ``.asnumpy()`` / ``.asscalar()`` calls;
+- ``.block_until_ready()`` / ``.wait_to_read()`` / ``.wait_to_write()``;
+- ``jax.device_get(...)``;
+- ``float()/int()/bool()/complex()`` on tensor-typed names;
+- ``np.asarray``/``np.array``/``np.ascontiguousarray`` on tensor values;
+- host-side branching on a tensor value (``if mask:`` — ``__bool__``
+  copies to host eagerly and raises under jit tracing).
+
+Functions whose *contract* is the sync (checkers.SYNC_WHITELIST names:
+``asnumpy``, ``wait_to_read``, ``save``, ``__repr__``, ...) are exempt
+inside, but a resolved call into one from a compute path is still an
+edge into a sync (reported as ``(sync by contract)``).  A ``# mxlint:
+disable=trace-host-sync`` (or ``=host-sync-reachability``) pragma on a
+sink line keeps that sink out of the graph — by-design host bridges are
+pragma'd once at the source instead of at every transitive call site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .checkers import (SYNC_WHITELIST, _Loc, _collect_tensor_names,
+                       _is_tensor_expr, _pragma_disabled, _tensor_params)
+
+__all__ = ["build_graph", "check_reachability", "classify", "FnNode",
+           "RULE"]
+
+RULE = "host-sync-reachability"
+
+# attribute-call sync verbs; per-function trace-host-sync already owns
+# the first set in compute scope, so call EDGES never re-report them —
+# sink detection here is what makes the *containing* helper syncing
+_DIRECT_SYNC_ATTRS = frozenset({"item", "asnumpy", "tolist", "asscalar",
+                                "block_until_ready"})
+_SYNC_VERB_ATTRS = frozenset({"wait_to_read", "wait_to_write"})
+
+CLASS_SYNC = "host-syncing"
+CLASS_PURE = "pure"
+CLASS_UNKNOWN = "unknown"
+
+_ROOT_PKG = "mxnet_tpu"
+
+
+def _module_name(path):
+    """mxnet_tpu/ops/nn.py -> mxnet_tpu.ops.nn (anchored at the LAST
+    path component named like the root package, so absolute and
+    repo-relative paths agree); package __init__ maps to the package."""
+    parts = path.replace("\\", "/").split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == _ROOT_PKG:
+            parts = parts[i:]
+            break
+    return ".".join(parts)
+
+
+def _resolve_relative(module, level, target):
+    """('mxnet_tpu.ops.nn', 1, 'registry') -> 'mxnet_tpu.ops.registry'."""
+    base = module.split(".")
+    if len(base) < level:
+        return None
+    base = base[:len(base) - level]
+    if target:
+        base += target.split(".")
+    return ".".join(base) if base else None
+
+
+class _Imports:
+    """Name-resolution tables for one module."""
+
+    def __init__(self, module, tree):
+        self.module_alias = {}   # local name -> dotted module path
+        self.from_import = {}    # local name -> (module, attr)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.module_alias[a.asname] = a.name
+                    else:
+                        # `import mxnet_tpu.ops.nn` binds `mxnet_tpu`
+                        root = a.name.split(".")[0]
+                        self.module_alias[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    mod = _resolve_relative(module, node.level,
+                                            node.module)
+                else:
+                    mod = node.module
+                if mod is None:
+                    continue
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_import[local] = (mod, a.name)
+
+
+def _local_bindings(fn_node):
+    """Names bound in `fn_node`'s own scope (parameters, assignment /
+    loop / with / except / walrus targets, in-function imports, nested
+    def and class names).  Python scoping: any of these shadows a
+    module-level name, so a call through one must NOT resolve to the
+    module-level def of the same name."""
+    bound = set()
+    a = fn_node.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        bound.add(arg.arg)
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+            continue  # its body is its own scope
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.ClassDef):
+            bound.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.For, ast.AsyncFor)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr)):
+            if isinstance(node.target, ast.Name):
+                bound.add(node.target.id)
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is not None:
+                for sub in ast.walk(node.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name:
+                bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                bound.add((al.asname or al.name).split(".")[0])
+        stack.extend(ast.iter_child_nodes(node))
+    return bound
+
+
+class FnNode:
+    """One function (def or ``name = lambda``) in the call graph."""
+
+    __slots__ = ("module", "qualname", "path", "lineno", "whitelisted",
+                 "parent", "cls", "sinks", "calls", "unresolved",
+                 "witness", "ast_node", "_bound")
+
+    def __init__(self, module, qualname, path, lineno, whitelisted,
+                 parent, cls, ast_node):
+        self.module = module
+        self.qualname = qualname
+        self.path = path
+        self.lineno = lineno
+        self.whitelisted = whitelisted
+        self.parent = parent   # qualname of enclosing function, or None
+        self.cls = cls         # qualname prefix of enclosing class, or None
+        self.ast_node = ast_node
+        self.sinks = []        # (lineno, desc, kind) direct host syncs;
+                               # kind is "sync" or "branch"
+        self.calls = []        # (callee (module, qualname), ast.Call)
+        self.unresolved = 0    # unresolvable call targets seen
+        self.witness = None    # key of first syncing callee (set by BFS)
+        self._bound = None     # lazy _local_bindings cache
+
+    @property
+    def bound(self):
+        if self._bound is None:
+            self._bound = _local_bindings(self.ast_node)
+        return self._bound
+
+    @property
+    def key(self):
+        return (self.module, self.qualname)
+
+    @property
+    def display(self):
+        return self.qualname
+
+    def __repr__(self):
+        return "FnNode(%s:%s)" % (self.module, self.qualname)
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes = {}        # (module, qualname) -> FnNode
+        self.by_module = {}    # module -> {qualname: FnNode}
+        self.imports = {}      # module -> _Imports
+
+    def lookup_attr(self, module, attr, _depth=0):
+        """Find def `attr` in `module`, chasing one-hop re-exports
+        through linted ``__init__`` / facade modules (bounded)."""
+        hit = self.by_module.get(module, {}).get(attr)
+        if hit is not None:
+            return hit.key
+        imp = self.imports.get(module)
+        if imp is not None and _depth < 3:
+            tgt = imp.from_import.get(attr)
+            if tgt is not None:
+                return self.lookup_attr(tgt[0], tgt[1], _depth + 1)
+            alias = imp.module_alias.get(attr)
+            if alias is not None:
+                return None  # `mod.attr` names a module, not a function
+        if module in self.by_module:
+            return False  # linted module without such a def: benign
+        return None if module.split(".")[0] == _ROOT_PKG else False
+
+
+class _Collector(ast.NodeVisitor):
+    """Pass 1: register every function def / ``name = lambda``."""
+
+    def __init__(self, graph, module, path, tree):
+        self.graph = graph
+        self.module = module
+        self.path = path
+        self.scope = []        # qualname components (classes + fns)
+        self.fn_stack = []     # enclosing FnNode qualnames
+        self.cls_stack = []    # enclosing class qualname prefixes
+        self.whitelist_depth = 0
+        self.graph.imports[module] = _Imports(module, tree)
+
+    def visit_ClassDef(self, node):
+        self.scope.append(node.name)
+        self.cls_stack.append(".".join(self.scope))
+        self.generic_visit(node)
+        self.cls_stack.pop()
+        self.scope.pop()
+
+    def _register(self, name, node):
+        whitelisted = (name in SYNC_WHITELIST or self.whitelist_depth > 0)
+        qualname = ".".join(self.scope + [name])
+        fn = FnNode(self.module, qualname, self.path, node.lineno,
+                    whitelisted,
+                    self.fn_stack[-1] if self.fn_stack else None,
+                    self.cls_stack[-1] if self.cls_stack else None,
+                    node)
+        self.graph.nodes[fn.key] = fn
+        self.graph.by_module.setdefault(self.module, {})[qualname] = fn
+        return fn, whitelisted
+
+    def _visit_fn(self, node, name):
+        fn, whitelisted = self._register(name, node)
+        self.scope.append(name)
+        self.fn_stack.append(fn.qualname)
+        if whitelisted:
+            self.whitelist_depth += 1
+        self.generic_visit(node)
+        if whitelisted:
+            self.whitelist_depth -= 1
+        self.fn_stack.pop()
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_fn(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        # `name = lambda ...` is a function definition in disguise
+        if isinstance(node.value, ast.Lambda) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            self._visit_fn(node.value, node.targets[0].id)
+            return
+        self.generic_visit(node)
+
+
+def _attr_root(node):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+class _FnScanner:
+    """Pass 2: sinks + call edges for one FnNode's own scope."""
+
+    _BENIGN_BUILTINS = frozenset({
+        "len", "isinstance", "issubclass", "getattr", "setattr", "hasattr",
+        "tuple", "list", "dict", "set", "frozenset", "sorted", "reversed",
+        "zip", "map", "filter", "enumerate", "range", "min", "max", "sum",
+        "abs", "repr", "str", "type", "id", "print", "super", "iter",
+        "next", "all", "any", "callable", "vars", "round", "divmod",
+        "slice", "hash", "format", "float", "int", "bool", "complex",
+        "bytes", "object", "ValueError", "TypeError", "KeyError",
+        "IndexError", "RuntimeError", "NotImplementedError",
+        "AttributeError", "StopIteration", "OverflowError", "Exception",
+        "ImportError", "OSError", "ZeroDivisionError",
+    })
+
+    def __init__(self, graph, ctx, module, fn):
+        self.graph = graph
+        self.ctx = ctx
+        self.module = module
+        self.imports = graph.imports[module]
+        self.fn = fn
+        node = fn.ast_node
+        if isinstance(node, ast.Lambda):
+            self.tensors = set()
+        else:
+            self.tensors = _collect_tensor_names(
+                node, _tensor_params(node), ctx.aliases)
+
+    def _pragmad(self, lineno):
+        text = self.ctx.line(lineno)
+        return (_pragma_disabled(text, RULE)
+                or _pragma_disabled(text, "trace-host-sync"))
+
+    def _sink(self, node, desc, kind="sync"):
+        if self.fn.whitelisted or self._pragmad(node.lineno):
+            return
+        self.fn.sinks.append((node.lineno, desc, kind))
+
+    def _own_scope(self):
+        """Own-scope nodes; nested defs and ``name = lambda`` are their
+        OWN graph nodes, anonymous lambdas fold into this scope."""
+        out = []
+        stack = list(ast.iter_child_nodes(self.fn.ast_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Lambda) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def run(self):
+        for node in self._own_scope():
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                self._scan_branch(node)
+
+    # -- sinks ----------------------------------------------------------
+
+    def _scan_branch(self, node):
+        keyword = "while" if isinstance(node, ast.While) else "if"
+        tests = node.test.values if isinstance(node.test, ast.BoolOp) \
+            else [node.test]
+        for t in tests:
+            negated = ""
+            if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+                t = t.operand
+                negated = "not "
+            if isinstance(t, ast.Name) and t.id in self.tensors:
+                self._sink(node, "%s %s%s:" % (keyword, negated, t.id),
+                           kind="branch")
+                return
+
+    def _scan_call(self, node):
+        al = self.ctx.aliases
+        fnx = node.func
+        if isinstance(fnx, ast.Attribute):
+            if fnx.attr in _DIRECT_SYNC_ATTRS \
+                    or fnx.attr in _SYNC_VERB_ATTRS:
+                self._sink(node, ".%s()" % fnx.attr)
+                return
+        if al.is_device_get(fnx):
+            self._sink(node, "jax.device_get()")
+            return
+        if (isinstance(fnx, ast.Name)
+                and fnx.id in ("float", "int", "bool", "complex")
+                and len(node.args) == 1 and not node.keywords
+                and _is_tensor_expr(node.args[0], self.tensors, al)):
+            self._sink(node, "%s(<tensor>)" % fnx.id)
+            return
+        if (al.is_np_attr(fnx, ("asarray", "array", "ascontiguousarray"))
+                and node.args
+                and _is_tensor_expr(node.args[0], self.tensors, al)):
+            self._sink(node, "np.%s(<tensor>)" % fnx.attr)
+            return
+        self._resolve_edge(node)
+
+    # -- call edges -----------------------------------------------------
+
+    def _resolve_edge(self, node):
+        target = self._resolve_target(node.func)
+        if target is None:
+            self.fn.unresolved += 1
+        elif target is not False:
+            self.fn.calls.append((target, node))
+
+    def _resolve_target(self, fnx):
+        """FnNode key, False (provably benign), or None (unknown)."""
+        al = self.ctx.aliases
+        mod_fns = self.graph.by_module.get(self.module, {})
+        if isinstance(fnx, ast.Name):
+            name = fnx.id
+            # enclosing FUNCTION scopes, innermost first (class bodies
+            # are not name scopes in python).  At each level a nested
+            # def wins; any OTHER local binding of the name (parameter,
+            # assignment, loop/with target, in-function import) shadows
+            # outer scopes with something we cannot resolve -> unknown,
+            # NEVER the module-level def of the same name
+            cur = self.fn
+            while cur is not None:
+                qn = cur.qualname + "." + name
+                if qn in mod_fns:
+                    return (self.module, qn)
+                if name in cur.bound:
+                    return None
+                cur = mod_fns.get(cur.parent) if cur.parent else None
+            if name in mod_fns:
+                return (self.module, name)
+            if name in self.imports.from_import:
+                mod, attr = self.imports.from_import[name]
+                return self.graph.lookup_attr(mod, attr)
+            if name in self._BENIGN_BUILTINS:
+                return False
+            if name in self.imports.module_alias:
+                return False  # calling a module object: not a call
+            return None
+        if isinstance(fnx, ast.Attribute):
+            root = _attr_root(fnx)
+            if not isinstance(root, ast.Name):
+                return None
+            # self.method() / cls.method() -> same-class method
+            if root.id in ("self", "cls") \
+                    and isinstance(fnx.value, ast.Name):
+                if self.fn.cls is not None:
+                    qn = self.fn.cls + "." + fnx.attr
+                    if qn in mod_fns:
+                        return (self.module, qn)
+                return None
+            # jnp./jax./np. math is device-side (or host-numpy) compute;
+            # the sync-prone members were already handled as sinks
+            if al.is_jnp_call_root(fnx) \
+                    or (isinstance(fnx.value, ast.Name)
+                        and fnx.value.id in al.numpy):
+                return False
+            # mod.fn() where mod aliases a module
+            if isinstance(fnx.value, ast.Name):
+                target_mod = None
+                if root.id in self.imports.module_alias:
+                    target_mod = self.imports.module_alias[root.id]
+                elif root.id in self.imports.from_import:
+                    m, a = self.imports.from_import[root.id]
+                    target_mod = m + "." + a
+                if target_mod is not None:
+                    return self.graph.lookup_attr(target_mod, fnx.attr)
+            return None
+        return None  # computed callee expression
+
+
+# ----------------------------------------------------------- public API
+
+
+def build_graph(contexts):
+    """contexts (checkers._FileCtx list) -> populated graph with
+    sync-ness propagated."""
+    graph = _Graph()
+    ordered = sorted(contexts, key=lambda c: c.path)
+    for ctx in ordered:
+        module = _module_name(ctx.path)
+        _Collector(graph, module, ctx.path, ctx.tree).visit(ctx.tree)
+    for ctx in ordered:
+        module = _module_name(ctx.path)
+        for fn in list(graph.by_module.get(module, {}).values()):
+            if fn.path == ctx.path:
+                _FnScanner(graph, ctx, module, fn).run()
+    _propagate(graph)
+    return graph
+
+
+def _propagate(graph):
+    """Reverse BFS from syncing nodes: callers of a syncing function
+    sync too.  BFS keeps witness chains shortest and terminates on
+    call-graph cycles for free."""
+    callers = {}
+    for fn in graph.nodes.values():
+        for key, _call in fn.calls:
+            callers.setdefault(key, []).append(fn)
+    frontier = [fn for fn in graph.nodes.values()
+                if fn.sinks or fn.whitelisted]
+    seen = {fn.key for fn in frontier}
+    while frontier:
+        nxt = []
+        for callee in frontier:
+            for caller in callers.get(callee.key, ()):
+                if caller.key in seen or caller.whitelisted:
+                    continue
+                seen.add(caller.key)
+                caller.witness = callee.key
+                nxt.append(caller)
+        frontier = nxt
+
+
+def _syncing(fn):
+    return bool(fn.sinks) or fn.whitelisted or fn.witness is not None
+
+
+def classify(graph):
+    """{(module, qualname): 'host-syncing' | 'pure' | 'unknown'}."""
+    out = {}
+    for key, fn in graph.nodes.items():
+        if _syncing(fn):
+            out[key] = CLASS_SYNC
+        elif fn.unresolved:
+            out[key] = CLASS_UNKNOWN
+        else:
+            out[key] = CLASS_PURE
+    changed = True
+    while changed:  # pure is only pure if every callee is pure
+        changed = False
+        for key, fn in graph.nodes.items():
+            if out[key] != CLASS_PURE:
+                continue
+            if any(out.get(k) == CLASS_UNKNOWN for k, _ in fn.calls):
+                out[key] = CLASS_UNKNOWN
+                changed = True
+    return out
+
+
+def _path_of(graph, fn):
+    """fn -> callee -> ... -> sink description, rendered with arrows."""
+    chain = [fn.display]
+    cur = fn
+    guard = 0
+    while cur.witness is not None and guard < 64:
+        cur = graph.nodes[cur.witness]
+        chain.append(cur.display)
+        guard += 1
+    if cur.sinks:
+        chain.append(cur.sinks[0][1])
+    elif cur.whitelisted:
+        chain.append("(sync by contract)")
+    return " → ".join(chain)
+
+
+def check_reachability(contexts, config):
+    """The cross-file rule pass: flag compute-path call sites whose
+    callee transitively host-syncs, and compute-path functions that
+    host-branch on tensor values.  Appends findings to each ctx's
+    findings list; returns the graph (for classification consumers)."""
+    by_path = {ctx.path: ctx for ctx in contexts}
+    graph = build_graph(contexts)
+    for fn in graph.nodes.values():
+        ctx = by_path.get(fn.path)
+        if ctx is None or fn.whitelisted:
+            continue
+        if not config.matches(config.compute_path_globs, fn.path):
+            continue
+        # own host-branch sinks: the per-function rule does not cover
+        # tensor truthiness, so this rule owns them outright
+        for lineno, desc, kind in fn.sinks:
+            if kind == "branch":
+                ctx.add(RULE, _Loc(lineno),
+                        "host-side branch on a tensor value (`%s` "
+                        "triggers __bool__: an eager device->host copy, "
+                        "and a TracerBoolConversionError under jit); "
+                        "use jnp.where / lax.cond instead" % desc,
+                        fn.qualname)
+        reported = set()
+        for key, call in fn.calls:
+            callee = graph.nodes.get(key)
+            if callee is None or not _syncing(callee):
+                continue
+            if key in reported:
+                continue  # one finding per (caller, callee) pair
+            reported.add(key)
+            path = "%s → %s" % (fn.display, _path_of(graph, callee))
+            ctx.add(RULE, call,
+                    "call into %r which transitively reaches a host "
+                    "sync: %s — keep compute paths device-only, or "
+                    "pragma the sync at its source if it is a "
+                    "by-design host bridge" % (callee.display, path),
+                    fn.qualname)
+    return graph

@@ -1,0 +1,131 @@
+"""Generator for docs/OP_CAPABILITIES.md — the per-op transform
+capability matrix.
+
+Renders :func:`tools.mxlint.registry_audit.transform_audit` (trace /
+grad / vmap verdicts for every canonical-spec registry op) as a
+deterministic markdown table: sorted rows, no timestamps, no
+environment-dependent error text — regenerating on any machine must be
+byte-identical or the tier-1 gate fails (tests/test_lint_clean.py
+``test_capability_matrix_up_to_date``).
+
+Usage::
+
+    python -m tools.mxlint.capabilities            # rewrite the doc
+    python -m tools.mxlint.capabilities --check    # exit 1 if stale
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["generate", "DOC_PATH", "main"]
+
+DOC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "docs", "OP_CAPABILITIES.md")
+
+_SYMBOL = {"ok": "✓", "fail": "✗", "n/a": "–"}
+
+_HEADER = """\
+# Op transform capabilities
+
+<!-- GENERATED FILE — do not edit.  Regenerate with:
+     python -m tools.mxlint.capabilities -->
+
+Per-op conformance of every registry table op (`OP_INPUT_NAMES`) under
+the three jax transforms the framework's layers depend on, proven
+abstractly (`jax.eval_shape` — zero FLOPs, zero device memory) on the
+op's canonical spec by `tools/mxlint/registry_audit.py`:
+
+- **trace** — the op stays inside the jax-traceable subset (eager
+  dispatch can cache a `jax.jit` executable for it);
+- **grad** — `jax.vjp` over the non-aux float inputs traces and every
+  cotangent matches its primal's shape (autograd/executor backward);
+- **vmap** — the op composes with `jax.vmap` on a leading batch axis
+  and no output loses the batch dimension (batching, and the
+  cross-replica sharding work layers on this).
+
+Legend: ✓ conforms · ✗ fails (grandfathered in
+`tools/mxlint/baseline.json`, shrink-only) · – not applicable (no
+differentiable inputs) · `pragma` exempt by design
+(`TRANSFORM_PRAGMAS`, reason footnoted).
+
+New table ops must be ✓ (or explicitly pragma'd) on all three — the
+tier-1 gate (`tests/test_lint_clean.py`) holds grandfather lists to
+shrink-only.  See `docs/LINTING.md` ("Transform conformance").
+
+| op | trace | grad | vmap |
+|---|:---:|:---:|:---:|
+"""
+
+
+def _cell(verdict, detail, notes):
+    if verdict == "pragma":
+        notes.append(detail)
+        return "pragma[^%d]" % len(notes)
+    return _SYMBOL.get(verdict, verdict)
+
+
+def generate(matrix=None):
+    """The full markdown document as a string (deterministic)."""
+    if matrix is None:
+        from .registry_audit import transform_audit
+
+        matrix = transform_audit()
+    notes = []
+    lines = [_HEADER]
+    for name in sorted(matrix):
+        caps = matrix[name]
+        cells = [_cell(*caps[t], notes=notes)
+                 for t in ("trace", "grad", "vmap")]
+        lines.append("| `%s` | %s | %s | %s |\n"
+                     % (name, cells[0], cells[1], cells[2]))
+    counts = {"ok": 0, "fail": 0, "pragma": 0, "n/a": 0}
+    for caps in matrix.values():
+        for verdict, _ in caps.values():
+            counts[verdict] = counts.get(verdict, 0) + 1
+    lines.append("\n%d ops audited — %d ✓ · %d ✗ · %d pragma · %d –\n"
+                 % (len(matrix), counts["ok"], counts["fail"],
+                    counts["pragma"], counts["n/a"]))
+    if notes:
+        lines.append("\n")
+        for i, reason in enumerate(notes, 1):
+            lines.append("[^%d]: %s\n" % (i, reason))
+    return "".join(lines)
+
+
+def main(argv=None):
+    import argparse
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mxlint.capabilities",
+        description="(Re)generate docs/OP_CAPABILITIES.md.")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 if the committed doc is stale instead "
+                        "of rewriting it")
+    p.add_argument("--out", default=DOC_PATH)
+    args = p.parse_args(argv)
+    text = generate()
+    if args.check:
+        try:
+            with open(args.out, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print("stale: %s does not match the live registry — run "
+                  "python -m tools.mxlint.capabilities" % args.out)
+            return 1
+        print("up to date: %s" % args.out)
+        return 0
+    with open(args.out, "w", encoding="utf-8") as f:
+        f.write(text)
+    print("wrote %s (%d ops)" % (args.out, text.count("\n| `")))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

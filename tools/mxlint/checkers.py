@@ -1,4 +1,5 @@
-"""AST checkers: the four mxlint rules.
+"""AST checkers: the per-file mxlint rules (plus the driver that also
+runs the interprocedural pass in callgraph.py).
 
 Rules
 -----
@@ -28,6 +29,13 @@ dtype-default
     array creation (``np.zeros`` & friends default to float64) in op
     code — silently upcasts, then XLA truncates on TPU.
 
+host-sync-reachability
+    Interprocedural: a compute-path function whose callee
+    *transitively* reaches a host sync through any chain of statically
+    resolvable calls, plus host-side branching on tensor values.
+    Implemented in callgraph.py (module-level call graph, reverse-BFS
+    reachability, full offending path in the message).
+
 Suppression: a ``# mxlint: disable`` or ``# mxlint: disable=rule[,rule]``
 comment on the finding's line silences it at the source; the baseline
 file (findings.py) grandfathers whole findings instead.
@@ -44,7 +52,7 @@ from .findings import Finding
 __all__ = ["Config", "lint_paths", "lint_sources", "ALL_RULES"]
 
 ALL_RULES = ("trace-host-sync", "static-argnames", "registry-consistency",
-             "dtype-default")
+             "dtype-default", "host-sync-reachability")
 
 # functions whose contract IS the device->host sync (reference parity:
 # WaitToRead/asnumpy are the documented engine sync points)
@@ -220,6 +228,77 @@ def _is_register_decorated(fn_node):
     return False
 
 
+# ------------------------------------- shared tensor-ness inference
+# (used by the per-function trace-host-sync visitor below and by the
+# interprocedural pass in callgraph.py)
+
+
+def _tensor_params(fn):
+    """For @register ops the calling convention is
+    ``fn(*tensor_inputs, **attrs)``: positional params with no
+    default are tensor inputs, defaulted params are attrs."""
+    if not _is_register_decorated(fn):
+        return set()
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    n_tensor = len(pos) - len(args.defaults)
+    return {a.arg for a in pos[:n_tensor]}
+
+
+def _own_scope_nodes(fn):
+    """All nodes of `fn` except bodies of nested function defs —
+    a nested scope's local names must not leak into this one."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _is_tensor_expr(node, tensor_names, aliases):
+    if isinstance(node, ast.Name):
+        return node.id in tensor_names
+    if isinstance(node, ast.Attribute):
+        return node.attr == "_data"
+    if isinstance(node, ast.BinOp):
+        return (_is_tensor_expr(node.left, tensor_names, aliases)
+                or _is_tensor_expr(node.right, tensor_names, aliases))
+    if isinstance(node, ast.UnaryOp):
+        return _is_tensor_expr(node.operand, tensor_names, aliases)
+    if isinstance(node, ast.Subscript):
+        return _is_tensor_expr(node.value, tensor_names, aliases)
+    if isinstance(node, ast.Call):
+        return aliases.is_jnp_call_root(node.func)
+    return False
+
+
+def _collect_tensor_names(fn, seed, aliases):
+    """Fixpoint over simple assignments: names bound to tensor
+    expressions (x._data, jnp calls, arithmetic on tensors)."""
+    names = set(seed)
+    scope = _own_scope_nodes(fn)
+    for _ in range(3):
+        before = len(names)
+        for node in scope:
+            if isinstance(node, ast.Assign):
+                if _is_tensor_expr(node.value, names, aliases):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                if (isinstance(node.target, ast.Name)
+                        and _is_tensor_expr(node.value, names, aliases)):
+                    names.add(node.target.id)
+        if len(names) == before:
+            break
+    return names
+
+
 def _has_docstring(fn_node):
     return bool(fn_node.body
                 and isinstance(fn_node.body[0], ast.Expr)
@@ -284,76 +363,15 @@ class _TraceSafetyVisitor(ast.NodeVisitor):
         self.ctx = ctx
         self.stack = []       # (name, tensor_names, whitelisted)
 
-    # -- tensor-ness inference ------------------------------------------
-    def _tensor_params(self, fn):
-        """For @register ops the calling convention is
-        ``fn(*tensor_inputs, **attrs)``: positional params with no
-        default are tensor inputs, defaulted params are attrs."""
-        if not _is_register_decorated(fn):
-            return set()
-        args = fn.args
-        pos = list(args.posonlyargs) + list(args.args)
-        n_tensor = len(pos) - len(args.defaults)
-        return {a.arg for a in pos[:n_tensor]}
-
-    @staticmethod
-    def _own_scope_nodes(fn):
-        """All nodes of `fn` except bodies of nested function defs —
-        a nested scope's local names must not leak into this one."""
-        out = []
-        stack = list(ast.iter_child_nodes(fn))
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                continue
-            out.append(node)
-            stack.extend(ast.iter_child_nodes(node))
-        return out
-
-    def _collect_tensor_names(self, fn, seed):
-        """Fixpoint over simple assignments: names bound to tensor
-        expressions (x._data, jnp calls, arithmetic on tensors)."""
-        names = set(seed)
-        scope = self._own_scope_nodes(fn)
-        for _ in range(3):
-            before = len(names)
-            for node in scope:
-                if isinstance(node, ast.Assign):
-                    if self._is_tensor_expr(node.value, names):
-                        for t in node.targets:
-                            if isinstance(t, ast.Name):
-                                names.add(t.id)
-                elif isinstance(node, ast.AnnAssign) and node.value:
-                    if (isinstance(node.target, ast.Name)
-                            and self._is_tensor_expr(node.value, names)):
-                        names.add(node.target.id)
-            if len(names) == before:
-                break
-        return names
-
     def _is_tensor_expr(self, node, tensor_names):
-        if isinstance(node, ast.Name):
-            return node.id in tensor_names
-        if isinstance(node, ast.Attribute):
-            return node.attr == "_data"
-        if isinstance(node, ast.BinOp):
-            return (self._is_tensor_expr(node.left, tensor_names)
-                    or self._is_tensor_expr(node.right, tensor_names))
-        if isinstance(node, ast.UnaryOp):
-            return self._is_tensor_expr(node.operand, tensor_names)
-        if isinstance(node, ast.Subscript):
-            return self._is_tensor_expr(node.value, tensor_names)
-        if isinstance(node, ast.Call):
-            return self.ctx.aliases.is_jnp_call_root(node.func)
-        return False
+        return _is_tensor_expr(node, tensor_names, self.ctx.aliases)
 
     # -- traversal -------------------------------------------------------
     def _visit_function(self, node):
         whitelisted = (node.name in self.ctx.config.sync_whitelist
                        or any(w for _, _, w in self.stack))
-        tensors = self._collect_tensor_names(
-            node, self._tensor_params(node))
+        tensors = _collect_tensor_names(
+            node, _tensor_params(node), self.ctx.aliases)
         self.stack.append((node.name, tensors, whitelisted))
         self.generic_visit(node)
         self.stack.pop()
@@ -736,6 +754,12 @@ def lint_sources(named_sources, config=None):
             _collect_registry_info(ctx)
     if "registry-consistency" in config.rules:
         _check_registry_consistency(contexts)
+    if "host-sync-reachability" in config.rules:
+        # interprocedural pass: the call graph spans EVERY linted file,
+        # findings anchor to compute-path call sites (callgraph.py)
+        from .callgraph import check_reachability
+
+        check_reachability(contexts, config)
     findings = []
     for ctx in contexts:
         findings.extend(ctx.findings)

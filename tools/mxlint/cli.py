@@ -15,6 +15,16 @@ from .checkers import ALL_RULES, Config, lint_paths
 from .findings import apply_baseline, load_baseline, save_baseline
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _gh_msg(s):
+    """Escape a github workflow-command message value."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def _gh_prop(s):
+    """Escape a github workflow-command property value."""
+    return (_gh_msg(s).replace(":", "%3A").replace(",", "%2C"))
 # fingerprint paths are always repo-relative, no matter the invoking cwd
 REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -37,7 +47,11 @@ def build_parser():
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline to grandfather the "
                         "current findings (drops stale entries)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
+                   help="'github' emits ::error workflow-command "
+                        "annotations (one per new finding / stale "
+                        "baseline entry) for inline PR surfacing")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings the baseline suppressed")
     return p
@@ -88,6 +102,30 @@ def main(argv=None):
             return 2
     result = apply_baseline(findings, baseline, linted_paths=linted,
                             rules=rules)
+
+    if args.format == "github":
+        for f in result.new:
+            print("::error file=%s,line=%d,col=%d,title=%s::%s"
+                  % (_gh_prop(f.path), f.line, f.col + 1,
+                     _gh_prop("mxlint " + f.rule), _gh_msg(f.message)))
+        if args.show_baselined:
+            for f in result.suppressed:
+                print("::notice file=%s,line=%d,col=%d,title=%s::%s"
+                      % (_gh_prop(f.path), f.line, f.col + 1,
+                         _gh_prop("mxlint baselined " + f.rule),
+                         _gh_msg(f.message)))
+        for e in result.stale:
+            print("::error file=%s,title=%s::%s"
+                  % (_gh_prop(e.get("path", "")),
+                     _gh_prop("mxlint stale-baseline"),
+                     _gh_msg("stale baseline entry (code fixed or "
+                             "moved — run --update-baseline): %s %r"
+                             % (e.get("rule"), e.get("code_line")))))
+        print("mxlint: %d new finding(s), %d baselined, %d stale "
+              "baseline entr(y/ies)"
+              % (len(result.new), len(result.suppressed),
+                 len(result.stale)))
+        return 1 if (result.new or result.stale) else 0
 
     if args.format == "json":
         print(json.dumps({

@@ -88,9 +88,37 @@ def save_registry_grandfather(path, op_names):
         f.write("\n")
 
 
+def load_transform_grandfather(path):
+    """The transform-conformance grandfather lists: ops registered
+    before the vjp/vmap audit existed that fail a transform.  New ops
+    are held to zero failures (or an explicit TRANSFORM_PRAGMAS entry);
+    these sets only ever shrink."""
+    with open(path) as f:
+        data = json.load(f)
+    t = data.get("transforms", {})
+    return {k: set(v) for k, v in t.items()}
+
+
+def save_transform_grandfather(path, failures):
+    """Rewrite only the transforms section, preserving everything else.
+
+    `failures`: {"grad": [op, ...], "vmap": [op, ...]} (trace failures
+    are never grandfathered — a non-tracing op fails the eval_shape
+    gate outright)."""
+    data = {"version": 1, "findings": []}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data["transforms"] = {k: sorted(set(v))
+                          for k, v in sorted(failures.items())}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def save_baseline(path, findings, keep_entries=()):
     """Write a baseline that grandfathers exactly `findings` (the
-    registry section, if present, is preserved).
+    registry and transforms sections, if present, are preserved).
 
     `keep_entries`: existing entry dicts to carry over verbatim —
     used by partial-scope --update-baseline runs so entries the run
@@ -117,8 +145,9 @@ def save_baseline(path, findings, keep_entries=()):
                 old = json.load(f)
             except ValueError:
                 old = {}
-        if "registry" in old:
-            data["registry"] = old["registry"]
+        for section in ("registry", "transforms"):
+            if section in old:
+                data[section] = old[section]
     with open(path, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")

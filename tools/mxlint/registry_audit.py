@@ -13,6 +13,19 @@ module imports ``mxnet_tpu.ops`` and audits the *actual* registry:
   reported; the tier-1 gate grandfathers the pre-existing ones via
   tools/mxlint/baseline.json).
 
+**Transform conformance** (:func:`transform_audit`): beyond plain
+tracing, every canonical-spec op is abstractly pushed through the two
+jax transforms the rest of the stack depends on — ``jax.vjp``
+(autograd/executor backward; differentiability over the non-aux float
+inputs, with cotangent shapes checked against the primals) and
+``jax.vmap`` (batching; the future sharding work composes through it) —
+still under ``jax.eval_shape``, so the whole audit costs zero FLOPs and
+zero device memory.  The per-op trace/grad/vmap verdicts form the
+capability matrix rendered into docs/OP_CAPABILITIES.md by
+``tools/mxlint/capabilities.py``; by-design exemptions live in
+:data:`TRANSFORM_PRAGMAS`, and pre-existing failures are grandfathered
+(shrink-only) in the baseline's ``transforms`` section.
+
 Used by tests/test_lint_clean.py; also runnable standalone::
 
     python -m tools.mxlint.registry_audit
@@ -20,7 +33,8 @@ Used by tests/test_lint_clean.py; also runnable standalone::
 
 from __future__ import annotations
 
-__all__ = ["audit_registry", "canonical_spec", "AuditResult"]
+__all__ = ["audit_registry", "canonical_spec", "AuditResult",
+           "transform_audit", "TRANSFORM_PRAGMAS", "TRANSFORMS"]
 
 _F32 = "float32"
 
@@ -152,10 +166,14 @@ class AuditResult:
         return not (self.table_errors or self.shape_errors)
 
 
-def audit_registry(eval_shapes=True):
-    """Audit the live registry; importing mxnet_tpu.ops as needed."""
-    import jax
+def audit_registry(eval_shapes=True, matrix=None):
+    """Audit the live registry; importing mxnet_tpu.ops as needed.
 
+    ``matrix``: an already-computed :func:`transform_audit` result to
+    derive the eval_shape verdicts from — callers running both audits
+    (the tier-1 gate, :func:`main`) pass it so each op is traced once,
+    not once per audit.  When omitted and ``eval_shapes`` is true, the
+    transform audit is computed here."""
     from mxnet_tpu.ops import registry as R
 
     res = AuditResult()
@@ -191,33 +209,174 @@ def audit_registry(eval_shapes=True):
             res.missing_docstrings.append((op.name, op.fn.__name__))
     res.missing_docstrings.sort()
 
-    # --- eval_shape: every table op must trace on its canonical spec
+    # --- eval_shape: every table op must trace on its canonical spec.
+    # The actual tracing lives in transform_audit (whose "trace"
+    # verdict is exactly this check); missing specs are reported here.
     if eval_shapes:
-        from mxnet_tpu.ndarray.ndarray import RANDOM_OPS
-
+        if matrix is None:
+            matrix = transform_audit()
         for name in sorted(R.OP_INPUT_NAMES):
             if name not in registered:
                 continue  # already a table error above
-            spec = canonical_spec(name)
-            if spec is None:
+            if canonical_spec(name) is None:
                 res.shape_errors.append(
                     "no canonical eval_shape spec for table op %r — add "
                     "one to tools/mxlint/registry_audit.py" % name)
                 continue
-            input_specs, attrs = spec
-            op = R.get(name)
-            attrs = op.canonicalize_attrs(attrs)
-            args = [jax.ShapeDtypeStruct(s, d) for s, d in input_specs]
-            if name in RANDOM_OPS:
-                args = [jax.random.PRNGKey(0)] + args
-            try:
-                jax.eval_shape(op.bind_attrs(attrs), *args)
-            except Exception as e:  # any trace failure is a finding
-                msg = str(e).split("\n")[0][:200]
+            verdict, detail = matrix.get(name, {}).get(
+                "trace", ("fail", "op not audited"))
+            if verdict == "fail":
                 res.shape_errors.append(
-                    "eval_shape(%s) failed: %s: %s"
-                    % (name, type(e).__name__, msg))
+                    "eval_shape(%s) failed: %s" % (name, detail))
     return res
+
+
+# ------------------------------------------------- transform conformance
+
+TRANSFORMS = ("trace", "grad", "vmap")
+
+# By-design transform exemptions: {op: {"grad"|"vmap": one-line reason}}.
+# A pragma here is the runtime analog of `# mxlint: disable=...` — it
+# renders as "pragma" in the capability matrix instead of ✗ and is NOT
+# grandfathering: the reason must hold by construction, not by history.
+TRANSFORM_PRAGMAS = {}
+
+
+def _diff_argnums(name, input_specs, key_offset):
+    """Positions (into the full arg list) the vjp differentiates:
+    non-aux, float-dtype tensor inputs.  The PRNG key (when present)
+    and integer inputs (indices, lengths) are never gradient targets,
+    matching the executor's grad_req handling."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import registry as R
+
+    names = R.OP_INPUT_NAMES[name]
+    aux = set(R.OP_AUX_INPUTS.get(name, ()))
+    nums = []
+    for i, (_shape, dtype) in enumerate(input_specs):
+        if names[i] in aux:
+            continue
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            continue
+        nums.append(key_offset + i)
+    return nums
+
+
+def _check_grad(fn, args, argnums):
+    """eval_shape the op's vjp over `argnums`; cotangent shapes must
+    round-trip to the primal shapes.  Returns None or an error string."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(*all_args):
+        def f(*diff):
+            full = list(all_args)
+            for j, d in zip(argnums, diff):
+                full[j] = d
+            return fn(*full)
+
+        out, vjp_fn = jax.vjp(f, *[all_args[i] for i in argnums])
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+        return vjp_fn(cot)
+
+    try:
+        grads = jax.eval_shape(run, *args)
+    except Exception as e:
+        return "%s: %s" % (type(e).__name__, str(e).split("\n")[0][:200])
+    for j, g in zip(argnums, grads):
+        if tuple(g.shape) != tuple(args[j].shape):
+            return ("cotangent shape %s does not match primal %s for "
+                    "input %d" % (tuple(g.shape), tuple(args[j].shape), j))
+    return None
+
+
+def _check_vmap(fn, args, batch=2):
+    """eval_shape the op under jax.vmap on a leading batch axis; every
+    output must carry the batch dimension."""
+    import jax
+
+    batched = [jax.ShapeDtypeStruct((batch,) + tuple(a.shape), a.dtype)
+               for a in args]
+    try:
+        out = jax.eval_shape(jax.vmap(fn), *batched)
+    except Exception as e:
+        return "%s: %s" % (type(e).__name__, str(e).split("\n")[0][:200])
+    leaves = jax.tree_util.tree_leaves(out)
+    for leaf in leaves:
+        if not leaf.shape or leaf.shape[0] != batch:
+            return ("output %s lost the batch axis (expected leading %d)"
+                    % (tuple(leaf.shape), batch))
+    return None
+
+
+def transform_audit():
+    """Trace/grad/vmap conformance for every canonical-spec table op.
+
+    Returns ``{op_name: {"trace"|"grad"|"vmap": (verdict, detail)}}``
+    with verdict one of ``"ok"`` / ``"fail"`` / ``"pragma"`` / ``"n/a"``
+    (no differentiable inputs).  Abstract-only: zero FLOPs, zero device
+    memory — cheap enough to ride tier-1 on CPU.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.ndarray import RANDOM_OPS
+    from mxnet_tpu.ops import registry as R
+
+    matrix = {}
+    registered = set(R._OP_REGISTRY)
+    for name in sorted(R.OP_INPUT_NAMES):
+        if name not in registered:
+            continue  # a table error, reported by audit_registry()
+        spec = canonical_spec(name)
+        if spec is None:
+            continue  # a shape error, reported by audit_registry()
+        input_specs, attrs = spec
+        op = R.get(name)
+        attrs = op.canonicalize_attrs(attrs)
+        fn = op.bind_attrs(attrs)
+        args = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                for s, d in input_specs]
+        key_offset = 0
+        if name in RANDOM_OPS:
+            k = jax.random.PRNGKey(0)
+            args = [jax.ShapeDtypeStruct(tuple(k.shape), k.dtype)] + args
+            key_offset = 1
+        caps = {}
+        pragmas = TRANSFORM_PRAGMAS.get(name, {})
+        # trace
+        try:
+            jax.eval_shape(fn, *args)
+            caps["trace"] = ("ok", "")
+            traced = True
+        except Exception as e:
+            caps["trace"] = ("fail", "%s: %s"
+                             % (type(e).__name__,
+                                str(e).split("\n")[0][:200]))
+            traced = False
+        # grad
+        if "grad" in pragmas:
+            caps["grad"] = ("pragma", pragmas["grad"])
+        elif not traced:
+            caps["grad"] = ("fail", "op does not trace")
+        else:
+            argnums = _diff_argnums(name, input_specs, key_offset)
+            if not argnums:
+                caps["grad"] = ("n/a", "no differentiable inputs")
+            else:
+                err = _check_grad(fn, args, argnums)
+                caps["grad"] = ("ok", "") if err is None else ("fail", err)
+        # vmap
+        if "vmap" in pragmas:
+            caps["vmap"] = ("pragma", pragmas["vmap"])
+        elif not traced:
+            caps["vmap"] = ("fail", "op does not trace")
+        else:
+            err = _check_vmap(fn, args)
+            caps["vmap"] = ("ok", "") if err is None else ("fail", err)
+        matrix[name] = caps
+    return matrix
 
 
 def main(argv=None):
@@ -230,24 +389,58 @@ def main(argv=None):
         description="Runtime audit of the mxnet_tpu op registry.")
     p.add_argument("--update-baseline", action="store_true",
                    help="grandfather the current doc-less ops into "
-                        "tools/mxlint/baseline.json (registry section)")
+                        "tools/mxlint/baseline.json (registry section) "
+                        "and the current transform failures "
+                        "(transforms section)")
     args = p.parse_args(argv)
-    res = audit_registry()
+    matrix = transform_audit()
+    res = audit_registry(matrix=matrix)  # ops traced once, not twice
     for e in res.table_errors + res.shape_errors:
         print("audit: %s" % e)
+    tfails = {"grad": [], "vmap": []}
+    for name, caps in sorted(matrix.items()):
+        for t in ("grad", "vmap"):
+            verdict, detail = caps[t]
+            if verdict != "fail":
+                continue
+            print("transform: %s under %s: %s" % (name, t, detail))
+            # a trace-collapsed op is a shape error (gated above), not
+            # a grad/vmap grandfather candidate — once its trace bug is
+            # fixed, genuine transform defects must still surface
+            if detail != "op does not trace":
+                tfails[t].append(name)
     print("registry audit: %d table error(s), %d eval_shape error(s), "
-          "%d op(s) without docstrings"
+          "%d op(s) without docstrings, %d transform failure(s) over "
+          "%d op(s)"
           % (len(res.table_errors), len(res.shape_errors),
-             len(res.missing_docstrings)))
+             len(res.missing_docstrings),
+             sum(len(v) for v in tfails.values()), len(matrix)))
+    from .cli import DEFAULT_BASELINE
+
     if args.update_baseline:
-        from .cli import DEFAULT_BASELINE
-        from .findings import save_registry_grandfather
+        from .findings import (save_registry_grandfather,
+                               save_transform_grandfather)
 
         save_registry_grandfather(
             DEFAULT_BASELINE, [n for n, _ in res.missing_docstrings])
-        print("baseline registry section updated: %d op name(s) -> %s"
-              % (len(res.missing_docstrings), DEFAULT_BASELINE))
-    return 0 if res.ok else 1
+        save_transform_grandfather(DEFAULT_BASELINE, tfails)
+        print("baseline registry section updated: %d op name(s), "
+              "transforms section: %d grad / %d vmap failure(s) -> %s"
+              % (len(res.missing_docstrings), len(tfails["grad"]),
+                 len(tfails["vmap"]), DEFAULT_BASELINE))
+        return 0 if res.ok else 1
+    # exit code mirrors the tier-1 gate: non-grandfathered transform
+    # failures fail the standalone run too (rc-checking CI pipelines
+    # must not need the pytest gate to catch a grad/vmap regression)
+    tnew = 0
+    allowed = {}
+    if os.path.exists(DEFAULT_BASELINE):
+        from .findings import load_transform_grandfather
+
+        allowed = load_transform_grandfather(DEFAULT_BASELINE)
+    for t in ("grad", "vmap"):
+        tnew += len(set(tfails[t]) - allowed.get(t, set()))
+    return 0 if (res.ok and tnew == 0) else 1
 
 
 if __name__ == "__main__":
