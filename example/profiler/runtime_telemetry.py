@@ -1,14 +1,17 @@
 """Telemetry walkthrough: a ~20-step Gluon training loop whose chrome
 trace shows the full step anatomy (dispatch cache hit/miss, io,
 autograd, trainer) AND a live/peak device-memory timeline, plus the
-always-on runtime_stats counters, per-op XLA cost analytics, and the
-recompile-storm detector.
+always-on runtime_stats counters, per-op XLA cost analytics, the
+recompile-storm detector, and the numerics health layer (device-side
+grad-norm/NaN sentinels, flight recorder, first-NaN warning + dump).
 
-Run directly (the script activates the profiler and buffer tracker
-itself), or with zero code changes on any script via the env vars:
+Run directly (the script activates the profiler, buffer tracker, and
+health monitor itself), or with zero code changes on any script via
+the env vars:
 
     MXNET_TPU_PROFILE=trace.json python your_train.py
     MXNET_TPU_DIAG=diag.json     python your_train.py   # + kill -USR1
+    MXNET_TPU_HEALTH=1           python your_train.py
 
 Docs: docs/OBSERVABILITY.md.
 """
@@ -21,7 +24,7 @@ import tempfile
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import (autograd, device_memory, gluon, profiler,
+from mxnet_tpu import (autograd, device_memory, gluon, health, profiler,
                        runtime_stats)
 
 
@@ -44,9 +47,15 @@ def main(argv=None):
     device_memory.reset()
     device_memory.start()
 
-    # ---- a small imperative training loop, fully instrumented
+    # ---- a small imperative training loop, fully instrumented; the
+    # health monitor computes grad-norm/NaN sentinels ON DEVICE and the
+    # host only pays at the per-step drain
+    mon = health.enable(dump_path=os.path.join(tempfile.gettempdir(),
+                                               "runtime_telemetry_flight"
+                                               ".json"))
     net = gluon.nn.Dense(4)
     net.initialize()
+    mon.install(net)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     rs = np.random.RandomState(0)
     batch_size = 2
@@ -59,6 +68,7 @@ def main(argv=None):
         with autograd.record():
             loss = loss_fn(net(batch.data[0]), batch.label[0])
         loss.backward()
+        mon.note_loss(loss)
         trainer.step(batch_size)
 
     # ---- provoke the recompile-storm detector: a churning attr value
@@ -83,6 +93,18 @@ def main(argv=None):
                   and e["name"] == "device_memory"]
     print("memory counter events: %d (open the trace: a live/peak-bytes"
           " track renders alongside the spans)" % len(mem_events))
+
+    gn_events = [e for e in trace if e.get("ph") == "C"
+                 and e["name"] == "grad_norm"]
+    print("grad_norm counter events: %d (the numerics timeline — "
+          "nan_total renders next to it)" % len(gn_events))
+    flight = health.snapshot()["flight"]
+    print("flight recorder: %d per-step record(s); latest: step %d "
+          "loss %.4f grad_norm %.4f nan %d"
+          % (len(flight), flight[-1]["step"], flight[-1]["loss"],
+             flight[-1]["grad_norm"], int(flight[-1]["nan_total"])))
+    assert all(r["nan_total"] == 0 for r in flight), \
+        "a healthy demo loop must stay NaN-free"
 
     print("\nruntime_stats.report():")
     print(runtime_stats.report())

@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as _np
 
 from . import device_memory as _dm
+from . import health as _health
 from . import profiler as _profiler
 from . import runtime_stats as _rts
 from .base import MXNetError
@@ -292,6 +293,12 @@ class Executor:
         if self._monitor_callback is not None:
             for name, out in zip(self._symbol.list_outputs(), self._outputs):
                 self._monitor_callback(name, out)
+        if _health._state["on"]:
+            # numerics health feed: queue device-side stat vectors for
+            # every graph output (async — no host sync on this path)
+            for name, out in zip(self._symbol.list_outputs(),
+                                 self._outputs):
+                _health.observe("exec:%s" % name, out)
 
     @property
     def outputs(self):
@@ -321,6 +328,7 @@ class Executor:
             raise MXNetError("executor backward: %s" % e) from e
         if self._outputs is None:
             self._set_outputs(outs, new_aux)
+        health_on = _health._state["on"]
         for i, g in zip(diff_idx, dargs):
             name = self._arg_names[i]
             garr = self.grad_dict.get(name)
@@ -330,6 +338,9 @@ class Executor:
                 garr._assign(garr._data + g)
             else:
                 garr._assign(g)
+            if health_on:
+                # numerics health feed for the written argument grads
+                _health.observe("exec_grad:%s" % name, garr)
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new input shapes (reference: executor.py reshape).

@@ -40,7 +40,7 @@ from ..ndarray import NDArray
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
                         param_override)
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "is_staging"]
 
 
 class _BlockScope:
@@ -364,6 +364,14 @@ class _StagingScope:
         return stack[-1] if stack else None
 
 
+def is_staging():
+    """True while a HybridBlock subtree is being traced into one XLA
+    computation — hook code that must not leak tracers (monitors,
+    health observers) checks this (or buffer concreteness) before
+    queueing values across the trace boundary."""
+    return _StagingScope.current() is not None
+
+
 def update_aux_state(param, new_value):
     """Write an auxiliary state (running stat): eager write normally,
     traced side-output inside a staged graph."""
@@ -437,6 +445,13 @@ class _CachedGraph:
 
         ctx = args[0]._ctx if args else None
         out_nds = [NDArray(o, ctx) for o in outs]
+        # numerics-health note: steady-state hybridized forward never
+        # re-enters child __call__ (the whole subtree is one cached
+        # executable), so per-child forward hooks can't observe — but
+        # the ROOT block's forward hooks fire in Block.__call__ with
+        # these concrete outputs, so an installed HealthMonitor still
+        # covers the staged graph's outputs (and skips the tracer
+        # values seen during the staging trace itself).
 
         if recording:
             param_nds = [p.data(args[0].context if args else None)

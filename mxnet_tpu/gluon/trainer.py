@@ -15,6 +15,7 @@ train step over the mesh (parallel/data_parallel.py).
 from __future__ import annotations
 
 from .. import device_memory as _dm
+from .. import health as _health
 from .. import kvstore as _kvstore
 from .. import optimizer as _optimizer
 from .. import profiler as _profiler
@@ -131,18 +132,56 @@ class Trainer:
     # ------------------------------------------------------------ step
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads across devices, then update
-        (reference: trainer.py step:302)."""
+        (reference: trainer.py step:302).
+
+        With the numerics health layer enabled (``health.enable()`` /
+        ``MXNET_TPU_HEALTH=1``) each sampled step additionally feeds the
+        global monitor a fused device-side global grad-norm, per-grad
+        NaN/Inf sentinels, and per-param update-to-weight ratios, then
+        advances its clock (drain + flight record happen at interval
+        boundaries); an unhandled exception dumps the flight recorder
+        before propagating.  Disabled: one dict read."""
         _rts.inc("trainer_steps")
-        with _profiler.span("trainer:step", "trainer",
-                            args={"batch_size": batch_size}
-                            if _profiler._state["running"] else None):
-            self._step(batch_size, ignore_stale_grad)
+        hm = _health.monitor() if _health._state["on"] else None
+        try:
+            with _profiler.span("trainer:step", "trainer",
+                                args={"batch_size": batch_size}
+                                if _profiler._state["running"] else None):
+                self._step(batch_size, ignore_stale_grad, hm)
+        except Exception:
+            if hm is not None:
+                # the ring holds the steps leading up to the crash —
+                # dump it before the exception unwinds the training loop
+                hm.dump_on_crash()
+            raise
         if _dm._state["on"]:
             # per-step live/peak-bytes counter event: anchors the trace's
             # memory timeline even when no buffer was (de)allocated
             _dm.emit_counter()
+        if hm is not None:
+            hm.end_step()
 
-    def _step(self, batch_size, ignore_stale_grad):
+    def _health_grads_and_prev(self, hm):
+        """Feed gradients to the health monitor and snapshot the
+        pre-update weight buffers (device references only — no copies,
+        no syncs).  Returns the snapshot for ``_health_updates``."""
+        if hm is None or not hm.sampling:
+            return None
+        named = [(p.name, p.list_grad()[0]) for p in self._params
+                 if p.grad_req != "null"]
+        hm.observe_grads(named)
+        return [(p, p.list_data()[0]._data) for p in self._params
+                if p.grad_req != "null"]
+
+    def _health_updates(self, hm, prev):
+        """Feed per-param update-to-weight ratios from the pre/post
+        update buffer pairs captured by ``_health_grads_and_prev``."""
+        if prev is None:
+            return
+        for p, old in prev:
+            hm.observe_update(p.name, p.list_data()[0]._data, old)
+
+    def _step(self, batch_size, ignore_stale_grad, hm=None):
         # rescale BEFORE the kvstore ships the optimizer server-side
         # (reference: step() calls _check_and_rescale_grad first; changing
         # batch_size after init would silently use the stale rescale)
@@ -160,15 +199,23 @@ class Trainer:
             self._init_kvstore()
         if self._update_on_kvstore:
             # server-side update: push grads, pull back fresh WEIGHTS
-            # (reference: trainer.py _update with update_on_kvstore)
+            # (reference: trainer.py _update with update_on_kvstore).
+            # Health caveat: the aggregated gradient only ever exists on
+            # the server, so grad_norm/grad:* here reflect THIS worker's
+            # local pre-aggregation grads (the update-to-weight ratios
+            # below do reflect the applied server update).
+            prev = self._health_grads_and_prev(hm)
             for i, p in enumerate(self._params):
                 if p.grad_req == "null":
                     continue
                 self._kvstore.push(i, p.list_grad())
                 self._kvstore.pull(i, out=p.list_data())
+            self._health_updates(hm, prev)
             return
         self._allreduce_grads()
+        prev = self._health_grads_and_prev(hm)
         self._update(ignore_stale_grad)
+        self._health_updates(hm, prev)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

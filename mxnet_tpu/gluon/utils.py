@@ -49,25 +49,67 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
 
 
+# one fused clip executable: norm + finite flag + clamped scale + the
+# scaled arrays, all in a single XLA computation (reuses the health
+# layer's global-norm kernel; jit-cached per shape set, max_norm traced)
+_CLIP_KERNEL: list = []
+
+
+def _clip_kernel():
+    if not _CLIP_KERNEL:
+        import jax
+        import jax.numpy as jnp
+
+        from .. import health as _health
+
+        def _clip(vals, max_norm):
+            norm = _health.global_norm(vals)
+            finite = jnp.isfinite(norm)
+            # a non-finite norm must leave the arrays untouched
+            # (reference semantics: the host `if scale < 1.0` branch was
+            # False for NaN) — callers detect via the returned norm
+            scale = jnp.where(finite,
+                              jnp.minimum(jnp.float32(1.0),
+                                          max_norm / (norm + 1e-8)),
+                              jnp.float32(1.0))
+            out = [(v * scale).astype(v.dtype) for v in vals]
+            return out, jnp.stack([norm, finite.astype(jnp.float32)])
+
+        _CLIP_KERNEL.append(jax.jit(_clip))
+    return _CLIP_KERNEL[0]
+
+
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
     """Rescale arrays so the concatenated L2 norm is at most max_norm
-    (reference: gluon/utils.py clip_global_norm)."""
+    (reference: gluon/utils.py clip_global_norm).
+
+    TPU-native: the norm, the nan/inf check, and the clamped scale are
+    ONE fused device computation (the health layer's global-norm
+    kernel), and the rescale applies on device unconditionally — no
+    host-side ``if scale < 1.0`` branch, so the compute path stays
+    host-sync-free.  The only host materialization is the returned
+    scalar (the function's contract), fetched once together with the
+    fused finite flag."""
+    from .. import health as _health
+
     assert len(arrays) > 0
-    ctx = arrays[0].context
-    total = None
-    for a in arrays:
-        n = (a.astype("float32") ** 2).sum()
-        total = n if total is None else total + n.as_in_context(ctx)
-    total_norm = float(total.sqrt().asscalar())
-    if check_isfinite and not _np.isfinite(total_norm):
+    scaled, stats = _clip_kernel()([a._data for a in arrays],
+                                   _np.float32(max_norm))
+    host = _health._fetch([stats])[0]
+    total_norm, finite = float(host[0]), bool(host[1])
+    if check_isfinite and not finite:
         import warnings
 
         warnings.warn("nan or inf is detected. Clipping results will be "
                       "undefined.", stacklevel=2)
-    scale = max_norm / (total_norm + 1e-8)
-    if scale < 1.0:
-        for a in arrays:
-            a *= scale
+    # rebind only when clipping actually happened (scale < 1): the
+    # common under-norm step keeps its buffers (no tracker churn), and
+    # a non-finite norm leaves the arrays untouched — both the
+    # reference's `if scale < 1.0` semantics, decided off the scalar
+    # the contract already fetched
+    if finite and total_norm > max_norm:
+        for a, new in zip(arrays, scaled):
+            a._assign(new)
     return total_norm
 
 

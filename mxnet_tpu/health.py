@@ -1,0 +1,699 @@
+"""Numerics health layer — device-resident NaN/Inf sentinels, an async
+tensor-stat monitor, and the training flight recorder.
+
+PR 2 made *time* observable and PR 3 made *memory/FLOPs* observable;
+this module covers the axis that actually kills training runs:
+numerical health.  The legacy ``monitor.Monitor`` computed statistics
+on host numpy, blocking mid-forward on every watched tensor — the
+exact host-sync anti-pattern mxlint guards against.  Here statistics
+are computed where the data lives:
+
+- :func:`stat_kernel` builds one jitted per-tensor kernel (selectable
+  stat set: nan count, inf count, abs-mean, min/max, l2-norm,
+  zero-fraction) returning a tiny device vector.  Next to the ops it
+  watches the fused XLA reductions cost near nothing ("Operator Fusion
+  in XLA", arXiv:2301.13062) — the same keep-it-on-device discipline
+  that motivates full-program TPU compilation (arXiv:1810.09868).
+- :class:`HealthMonitor` queues those device vectors **without
+  blocking**; host materialization happens only at rate-limited drain
+  points (end-of-interval, :meth:`HealthMonitor.report`, a dump) —
+  one deliberate sync sink (:func:`_fetch`), pragma'd once per the
+  callgraph rule.  Feeding surfaces: Gluon forward hooks
+  (:meth:`HealthMonitor.install`), ``gluon.Trainer`` gradient hooks
+  (global grad-norm + per-param update-to-weight ratio), and the
+  symbolic executor's fwd/bwd outputs.
+- :class:`FlightRecorder` keeps a bounded ring of recent per-step
+  health records (step, loss, grad-norm, nan/inf flags, recompile and
+  memory counters snapshotted from ``runtime_stats``) and dumps it
+  atomically on first-NaN detection, on an unhandled exception inside
+  ``Trainer.step``, and with the ``MXNET_TPU_DIAG`` SIGUSR1 snapshot
+  (``runtime_stats.diag_snapshot`` embeds the health section).
+
+Cost model (the PR 2 contract, pinned by ``tests/test_bench_gate.py``):
+disabled (the default), every hook site pays one dict read and nothing
+else — no kernel, no queue entry, no allocation.  Enabled, an observed
+tensor costs one cached-jit kernel dispatch plus a deque append; the
+host pays only at drain.
+
+Environment variables
+---------------------
+``MXNET_TPU_HEALTH=1``              enable the global monitor at import.
+``MXNET_TPU_HEALTH_INTERVAL``       sample/drain every N steps (default 1).
+``MXNET_TPU_HEALTH_STATS``          comma list from :data:`STAT_NAMES`
+    (default ``nan_count,inf_count,abs_mean,l2_norm``; the two
+    sentinel counts are always included).
+``MXNET_TPU_HEALTH_RING``           flight-recorder capacity (default 256).
+``MXNET_TPU_HEALTH_DUMP``           flight-recorder dump path (default
+    ``mxnet_tpu_flight.json``; with ``MXNET_TPU_DIAG`` set the full
+    diag dump is written instead, health section included).
+``MXNET_TPU_HEALTH_WARN_INTERVAL``  min seconds between NaN warnings
+    (default 60).
+
+Docs: docs/OBSERVABILITY.md "Numerics health".
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import re
+import time
+
+from . import profiler as _profiler
+from . import runtime_stats as _rts
+from .log import get_logger, warn_once, warn_rate_limited
+
+__all__ = ["STAT_NAMES", "DEFAULT_STATS", "stat_kernel", "tensor_stats",
+           "global_norm", "update_ratio", "HealthMonitor",
+           "FlightRecorder", "enable", "disable", "is_enabled", "monitor",
+           "observe", "snapshot", "dump_flight", "reset",
+           "HEALTH_INTERVAL", "WARN_INTERVAL", "RING_CAPACITY"]
+
+HEALTH_INTERVAL = int(os.environ.get("MXNET_TPU_HEALTH_INTERVAL", "1"))
+WARN_INTERVAL = float(os.environ.get("MXNET_TPU_HEALTH_WARN_INTERVAL", "60"))
+RING_CAPACITY = int(os.environ.get("MXNET_TPU_HEALTH_RING", "256"))
+
+# pending device stat entries kept before a drain; a runaway producer
+# (observe without end_step) drops the oldest and counts the drop
+_PENDING_CAP = int(os.environ.get("MXNET_TPU_HEALTH_QUEUE", "4096"))
+
+STAT_NAMES = ("nan_count", "inf_count", "abs_mean", "min", "max",
+              "l2_norm", "zero_frac")
+DEFAULT_STATS = ("nan_count", "inf_count", "abs_mean", "l2_norm")
+
+
+def _env_stats():
+    """The ``MXNET_TPU_HEALTH_STATS`` selection, or None when unset —
+    read per-monitor (like ``HEALTH_INTERVAL``) so programmatic
+    ``enable()`` without an explicit ``stats`` honors the env too."""
+    raw = os.environ.get("MXNET_TPU_HEALTH_STATS")
+    if not raw:
+        return None
+    return tuple(s.strip() for s in raw.split(",") if s.strip())
+
+# the flight recorder's nan/inf flags need the sentinel counts, so a
+# custom stat selection always includes them
+SENTINEL_STATS = ("nan_count", "inf_count")
+
+_state = {"on": False}
+_GLOBAL: list = []          # [HealthMonitor] while enabled
+
+_logger_cache: list = []
+
+
+def _logger():
+    if not _logger_cache:
+        _logger_cache.append(get_logger("mxnet_tpu.health"))
+    return _logger_cache[0]
+
+
+# ------------------------------------------------------------- kernels
+
+
+_STAT_IMPLS = None
+_KERNELS: dict = {}
+_NORM_KERNEL: list = []
+_RATIO_KERNEL: list = []
+_tracer_cls: list = []      # cached jax.core.Tracer
+
+
+def _stat_impls():
+    global _STAT_IMPLS
+    if _STAT_IMPLS is None:
+        import jax.numpy as jnp
+
+        f32 = jnp.float32
+        _STAT_IMPLS = {
+            # all stats computed in float32: NaN/Inf survive the cast,
+            # integer inputs map to clean zero sentinel counts
+            "nan_count": lambda x, xf: jnp.isnan(xf).sum().astype(f32),
+            "inf_count": lambda x, xf: jnp.isinf(xf).sum().astype(f32),
+            "abs_mean": lambda x, xf: jnp.abs(xf).mean(),
+            "min": lambda x, xf: xf.min(),
+            "max": lambda x, xf: xf.max(),
+            "l2_norm": lambda x, xf: jnp.sqrt((xf * xf).sum()),
+            "zero_frac": lambda x, xf: (x == 0).mean(dtype=f32),
+        }
+    return _STAT_IMPLS
+
+
+def stat_kernel(stats=DEFAULT_STATS):
+    """The jitted per-tensor stat kernel for a stat selection: maps one
+    array to a ``float32[len(stats)]`` **device** vector (one fused XLA
+    reduction; jit-cached per stat set and input aval).  The returned
+    callable is pure and host-sync-free — materialize its result only
+    at a drain point."""
+    stats = tuple(stats)
+    kern = _KERNELS.get(stats)
+    if kern is not None:
+        return kern
+    unknown = sorted(set(stats) - set(STAT_NAMES))
+    if unknown:
+        raise ValueError("unknown health stat(s) %s (known: %s)"
+                         % (", ".join(unknown), ", ".join(STAT_NAMES)))
+    import jax
+    import jax.numpy as jnp
+
+    impls = _stat_impls()
+    chosen = [impls[s] for s in stats]
+
+    def _stats(x):
+        xf = x.astype(jnp.float32)
+        return jnp.stack([f(x, xf) for f in chosen])
+
+    kern = _KERNELS[stats] = jax.jit(_stats)
+    return kern
+
+
+def tensor_stats(value, stats=DEFAULT_STATS):
+    """Stats of one NDArray / jax array as a host dict — convenience
+    wrapper (kernel + immediate fetch), NOT for compute paths."""
+    data = getattr(value, "_data", value)
+    vec = _fetch([stat_kernel(stats)(data)])[0]
+    return dict(zip(stats, (float(v) for v in vec)))
+
+
+def global_norm(values):
+    """Fused global L2 norm of a list of jax arrays, on device: one
+    jitted ``sqrt(sum_i sum(x_i^2))`` over the whole list (jit-cached
+    per shape set — parameters are fixed across steps, so steady state
+    is one executable).  Returns a device scalar; also the kernel
+    behind ``gluon.utils.clip_global_norm``'s fused finite check."""
+    if not _NORM_KERNEL:
+        import jax
+        import jax.numpy as jnp
+
+        def _norm(vals):
+            total = None
+            for v in vals:
+                s = (v.astype(jnp.float32) ** 2).sum()
+                total = s if total is None else total + s
+            return jnp.sqrt(total)
+
+        _NORM_KERNEL.append(jax.jit(_norm))
+    return _NORM_KERNEL[0](list(values))
+
+
+def update_ratio(new, old):
+    """Per-parameter update-to-weight ratio ``||new-old|| / ||old||``
+    as a device scalar (one fused kernel; eps-guarded denominator)."""
+    if not _RATIO_KERNEL:
+        import jax
+        import jax.numpy as jnp
+
+        def _ratio(n, o):
+            nf = n.astype(jnp.float32)
+            of = o.astype(jnp.float32)
+            un = jnp.sqrt(((nf - of) ** 2).sum())
+            wn = jnp.sqrt((of * of).sum())
+            return un / (wn + 1e-12)
+
+        _RATIO_KERNEL.append(jax.jit(_ratio))
+    return _RATIO_KERNEL[0](new, old)
+
+
+def _concrete(buf):
+    """True for a real device array (not a tracer, not a host value) —
+    tracers must never be queued across trace boundaries."""
+    import jax
+
+    if not _tracer_cls:
+        _tracer_cls.append(jax.core.Tracer)
+    return isinstance(buf, jax.Array) and not isinstance(buf,
+                                                        _tracer_cls[0])
+
+
+def _fetch(values):
+    """Materialize queued device stat buffers on host.
+
+    THE deliberate host-sync sink of the health layer: every queued
+    vector is tiny (a handful of float32s), the whole list transfers
+    in ONE batched device_get, and this runs only at rate-limited
+    drain points, never on a compute path."""
+    import jax
+
+    return jax.device_get(list(values))  # mxlint: disable=trace-host-sync
+
+
+# ------------------------------------------------------ flight recorder
+
+
+_flight_seq = itertools.count()
+
+
+class FlightRecorder:
+    """Bounded ring of recent per-step health records, dumped atomically
+    (write-temp + ``os.replace``) when training goes numerically bad."""
+
+    def __init__(self, capacity=None):
+        self._ring = collections.deque(maxlen=capacity or RING_CAPACITY)
+        self.dumps = 0
+        self.last_dump_path = None
+
+    def append(self, record):
+        self._ring.append(record)
+
+    def records(self):
+        return list(self._ring)
+
+    def __len__(self):
+        return len(self._ring)
+
+    def dump(self, path=None, reason=None, health=None):
+        """Atomically write the ring (plus the owning monitor's summary)
+        as JSON; returns the absolute path.  Unique temp name per call,
+        same torn-file discipline as ``runtime_stats.dump_diag``."""
+        path = path or os.environ.get("MXNET_TPU_HEALTH_DUMP") \
+            or "mxnet_tpu_flight.json"
+        path = os.path.abspath(path)
+        payload = {"version": 1, "pid": os.getpid(), "time": time.time(),
+                   "reason": reason,
+                   "health": health if health is not None
+                   else {"flight": self.records()}}
+        tmp = os.path.join(os.path.dirname(path),
+                           ".%s.%d.%d.tmp" % (os.path.basename(path),
+                                              os.getpid(),
+                                              next(_flight_seq)))
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+        os.replace(tmp, path)
+        self.dumps += 1
+        self.last_dump_path = path
+        return path
+
+
+# ------------------------------------------------------- health monitor
+
+
+class HealthMonitor:
+    """Asynchronous device-resident numerics monitor.
+
+    Producers call :meth:`observe` (or the install'd Gluon hooks /
+    Trainer feeds); every observation enqueues a tiny device vector and
+    returns immediately.  One call to :meth:`end_step` per training
+    step advances the clock; at each sampled interval boundary the
+    pending queue is drained to host in one batch, the flight recorder
+    gets a per-step record, chrome-trace counters (``grad_norm``,
+    ``nan_total``) are emitted while the profiler runs, and the first
+    NaN/Inf fires a rate-limited warning naming the earliest offending
+    tensor plus an atomic flight dump.
+    """
+
+    def __init__(self, interval=None, stats=None, pattern=".*",
+                 ring=None, dump_path=None, warn_interval=None):
+        self.interval = max(1, int(interval or HEALTH_INTERVAL))
+        stats = tuple(stats or _env_stats() or DEFAULT_STATS)
+        self.stats = stats + tuple(s for s in SENTINEL_STATS
+                                   if s not in stats)
+        self.re_pattern = re.compile(pattern)
+        self.dump_path = dump_path
+        self.warn_interval = WARN_INTERVAL if warn_interval is None \
+            else warn_interval
+        self._kernel = stat_kernel(self.stats)
+        # deactivated by disable()/enable()-replacement so orphaned
+        # install() hooks stop dispatching kernels into a dead queue
+        self.active = True
+        # pending device values, FIFO: ("stats", step, key, vec) |
+        # ("scalar", step, key, scalar) — drained in arrival order
+        self._pending: collections.deque = collections.deque()
+        self.step = 0
+        self._sampling = True          # step 0 is a sample step
+        self.flight = FlightRecorder(ring)
+        self.records: collections.deque = collections.deque(
+            maxlen=self.flight._ring.maxlen)
+        self.totals = {"observed": 0, "drained": 0, "dropped": 0,
+                       "nan_steps": 0, "inf_steps": 0}
+        self.first_nan = None          # {"step", "key", ...} once seen
+        self._nan_dumped = False
+        self._installed: list = []
+
+    # ------------------------------------------------------- producers
+    @property
+    def sampling(self):
+        """True while the current step is a sampled one — producers may
+        use this to skip building feed lists entirely."""
+        return self._sampling
+
+    def _enqueue(self, entry):
+        if len(self._pending) >= _PENDING_CAP:
+            self._pending.popleft()
+            self.totals["dropped"] += 1
+        self._pending.append(entry)
+        self.totals["observed"] += 1
+        _rts.inc("health_observed")
+
+    def observe(self, key, value):
+        """Queue the stat vector of one tensor under ``key`` — a cached
+        jitted kernel dispatch plus a deque append, no host sync.
+        Tracer-backed values (inside a staged/hybridized trace) and
+        non-matching keys are skipped."""
+        if not (self.active and self._sampling) \
+                or not self.re_pattern.match(key):
+            return
+        data = getattr(value, "_data", value)
+        if not _concrete(data):
+            return
+        self._enqueue(("stats", self.step, key, self._kernel(data)))
+
+    def observe_scalar(self, key, device_scalar):
+        """Queue an already-computed device scalar (grad-norm,
+        update-to-weight ratio, loss) under ``key``."""
+        if not (self.active and self._sampling):
+            return
+        if not _concrete(device_scalar):
+            return
+        self._enqueue(("scalar", self.step, key, device_scalar))
+
+    def observe_grads(self, named_grads):
+        """Trainer gradient hook: one fused global grad-norm over all
+        gradients (queued as ``grad_norm``) plus per-gradient sentinel
+        stats for pattern-matched names (``grad:<param>``)."""
+        if not (self.active and self._sampling) or not named_grads:
+            return
+        vals = [getattr(g, "_data", g) for _, g in named_grads]
+        if not all(_concrete(v) for v in vals):
+            return
+        self._enqueue(("scalar", self.step, "grad_norm",
+                       global_norm(vals)))
+        for (name, _), v in zip(named_grads, vals):
+            key = "grad:%s" % name
+            if self.re_pattern.match(key):
+                self._enqueue(("stats", self.step, key, self._kernel(v)))
+
+    def observe_update(self, name, new, old):
+        """Trainer update hook: per-parameter update-to-weight ratio
+        (``uwr:<param>``) from the pre/post-update device buffers;
+        pattern-scoped like every per-tensor key."""
+        key = "uwr:%s" % name
+        if not (self.active and self._sampling) \
+                or not self.re_pattern.match(key):
+            return
+        new = getattr(new, "_data", new)
+        old = getattr(old, "_data", old)
+        if not (_concrete(new) and _concrete(old)):
+            return
+        self._enqueue(("scalar", self.step, key, update_ratio(new, old)))
+
+    def note_loss(self, loss):
+        """Queue the step's loss value (device scalar; multi-element
+        losses are mean-reduced on device)."""
+        if not (self.active and self._sampling):
+            return
+        data = getattr(loss, "_data", loss)
+        if not _concrete(data):
+            return
+        if getattr(data, "ndim", 0):
+            data = data.mean()
+        self._enqueue(("scalar", self.step, "loss", data))
+
+    # ---------------------------------------------------- Gluon install
+    def install(self, block, prefix=""):
+        """Attach forward hooks over a Gluon block tree; every watched
+        output feeds :meth:`observe` as ``<path>_output<i>`` (same key
+        scheme as the legacy ``Monitor``).  During a hybridize staging
+        trace the hooks bail out up front (``block.is_staging``) —
+        child outputs are tracers there; at steady state only the root
+        hook fires, with the cached graph's concrete outputs."""
+        # lazy: health loads before the gluon package finishes importing
+        from .gluon.block import is_staging
+        from .ndarray import NDArray
+
+        def make_hook(name):
+            def hook(_blk, _inputs, outputs):
+                if is_staging():
+                    return
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray):
+                        self.observe("%s_output%d" % (name, i), o)
+            return hook
+
+        def attach(blk, path):
+            h = blk.register_forward_hook(make_hook(path or blk.name))
+            self._installed.append((blk, h))
+            for k, c in blk._children.items():
+                attach(c, (path + "." if path else "") + k)
+
+        attach(block, prefix)
+        return self
+
+    def uninstall(self):
+        """Remove every hook :meth:`install` attached."""
+        for blk, h in self._installed:
+            if h in blk._forward_hooks:
+                blk._forward_hooks.remove(h)
+        self._installed = []
+
+    # ----------------------------------------------------------- clock
+    def end_step(self, loss=None):
+        """Advance the step clock; at sampled steps drain the queue,
+        append the flight record, and run the NaN sentinel."""
+        if loss is not None:
+            self.note_loss(loss)
+        if self._sampling:
+            self.drain()
+        self.step += 1
+        self._sampling = (self.step % self.interval) == 0
+
+    def drain(self):
+        """Materialize every queued device value on host (ONE batched
+        fetch — the layer's only sync point), fold them into per-step
+        records + the flight ring, emit profiler counters, and fire the
+        first-NaN warning/dump.  Returns the drained host records."""
+        if not self._pending:
+            return []
+        t0 = time.perf_counter()
+        entries = list(self._pending)
+        self._pending.clear()
+        host = _fetch([e[3] for e in entries])
+        drained = []
+        by_step: dict = {}
+        for (kind, step, key, _dev), hv in zip(entries, host):
+            if kind == "stats":
+                rec = {"step": step, "key": key,
+                       "stats": dict(zip(self.stats,
+                                         (float(v) for v in hv)))}
+                nan = rec["stats"]["nan_count"]
+                inf = rec["stats"]["inf_count"]
+            else:
+                rec = {"step": step, "key": key, "value": float(hv)}
+                v = rec["value"]
+                nan = 1.0 if v != v else 0.0
+                inf = 1.0 if (v in (float("inf"), float("-inf"))) else 0.0
+            drained.append(rec)
+            agg = by_step.setdefault(step, {"nan_total": 0.0,
+                                            "inf_total": 0.0,
+                                            "first_bad": None,
+                                            "grad_norm": None,
+                                            "loss": None})
+            agg["nan_total"] += nan
+            agg["inf_total"] += inf
+            if (nan or inf) and agg["first_bad"] is None:
+                agg["first_bad"] = key
+            if key == "grad_norm" and kind == "scalar":
+                agg["grad_norm"] = rec["value"]
+            if key == "loss" and kind == "scalar":
+                agg["loss"] = rec["value"]
+        self.records.extend(drained)
+        self.totals["drained"] += len(drained)
+        probe = _rts.health_probe()
+        for step in sorted(by_step):
+            agg = by_step[step]
+            # a mid-step drain (report()/drain() between observations)
+            # must MERGE into the step's existing flight record — one
+            # record per step, nan_steps counted once
+            ring = self.flight._ring
+            if ring and ring[-1]["step"] == step:
+                rec = ring[-1]
+                had_nan, had_inf = rec["nan_total"], rec["inf_total"]
+                rec["time"] = time.time()
+                rec["nan_total"] += agg["nan_total"]
+                rec["inf_total"] += agg["inf_total"]
+                if rec["first_bad"] is None:
+                    rec["first_bad"] = agg["first_bad"]
+                if agg["grad_norm"] is not None:
+                    rec["grad_norm"] = agg["grad_norm"]
+                if agg["loss"] is not None:
+                    rec["loss"] = agg["loss"]
+                rec["counters"] = probe
+            else:
+                had_nan = had_inf = 0.0
+                rec = {"step": step, "time": time.time(),
+                       "loss": agg["loss"],
+                       "grad_norm": agg["grad_norm"],
+                       "nan_total": agg["nan_total"],
+                       "inf_total": agg["inf_total"],
+                       "first_bad": agg["first_bad"],
+                       "counters": probe}
+                self.flight.append(rec)
+            if agg["nan_total"] and not had_nan:
+                self.totals["nan_steps"] += 1
+            if agg["inf_total"] and not had_inf:
+                self.totals["inf_steps"] += 1
+            _profiler.counter("nan_total",
+                              {"nan_total": rec["nan_total"],
+                               "inf_total": rec["inf_total"]},
+                              cat="health")
+            if rec["grad_norm"] is not None:
+                _profiler.counter("grad_norm",
+                                  {"grad_norm": rec["grad_norm"]},
+                                  cat="health")
+            if (agg["nan_total"] or agg["inf_total"]) \
+                    and self.first_nan is None:
+                self.first_nan = {"step": step, "key": agg["first_bad"],
+                                  "nan_total": agg["nan_total"],
+                                  "inf_total": agg["inf_total"]}
+        if self.first_nan is not None and not self._nan_dumped:
+            self._first_nan_alarm()
+        _rts.inc("health_drains")
+        _rts.inc("health_seconds", time.perf_counter() - t0)
+        return drained
+
+    def _first_nan_alarm(self):
+        """First NaN/Inf: one rate-limited warning naming the earliest
+        offending tensor, plus an atomic flight-recorder dump (the full
+        diag dump when ``MXNET_TPU_DIAG`` is armed)."""
+        self._nan_dumped = True
+        try:
+            path = self.dump("first-nan")
+        except Exception:  # a failed dump must never kill training
+            path = "<dump failed>"
+            _logger().exception("flight-recorder dump failed")
+        fn = self.first_nan
+        warn_rate_limited(
+            _logger(), "numerics-health:nan", self.warn_interval,
+            "non-finite values detected at step %d: earliest offending "
+            "tensor %r (%d nan, %d inf this step).  Flight recorder "
+            "dumped to %s — inspect with `python -m "
+            "mxnet_tpu.runtime_stats %s` (docs/OBSERVABILITY.md).",
+            fn["step"], fn["key"], int(fn["nan_total"]),
+            int(fn["inf_total"]), path, path)
+
+    # ------------------------------------------------------- read side
+    def dump(self, reason=None, path=None):
+        """Atomic health dump: the full diag snapshot when
+        ``MXNET_TPU_DIAG`` is armed (health section included), else a
+        standalone flight-recorder JSON."""
+        if path is None and os.environ.get("MXNET_TPU_DIAG"):
+            return _rts.dump_diag()
+        return self.flight.dump(path or self.dump_path, reason=reason,
+                                health=self.snapshot())
+
+    def dump_on_crash(self):
+        """Trainer.step exception hook: best-effort drain + dump (the
+        ring should carry the records queued before the crash)."""
+        try:
+            self.drain()
+        except Exception:
+            pass
+        try:
+            warn_once(_logger(), "numerics-health:crash",
+                      "unhandled exception in Trainer.step — flight "
+                      "recorder dumped to %s",
+                      self.dump("trainer-step-exception"))
+        except Exception:
+            _logger().exception("crash-path flight dump failed")
+
+    def snapshot(self):
+        """JSON-serializable view: config, totals, recent drained
+        records, the flight ring, and the first-NaN marker.  Never
+        syncs — pending device values are reported as a count only."""
+        return {"enabled": _state["on"], "step": self.step,
+                "interval": self.interval, "stats": list(self.stats),
+                "pending": len(self._pending),
+                "totals": dict(self.totals),
+                "first_nan": dict(self.first_nan)
+                if self.first_nan else None,
+                "records": list(self.records)[-32:],
+                "flight": self.flight.records()}
+
+    def report(self):
+        """Drain, then render the text section (same renderer the
+        ``runtime_stats`` report/CLI uses)."""
+        self.drain()
+        return "\n".join(_rts._render_health(self.snapshot()))
+
+
+# ------------------------------------------------------- module surface
+
+
+def enable(interval=None, stats=None, pattern=".*", ring=None,
+           dump_path=None, warn_interval=None):
+    """Create (or replace) the global :class:`HealthMonitor` and switch
+    the guard flag every feeding surface checks.  Returns the monitor."""
+    mon = HealthMonitor(interval=interval, stats=stats, pattern=pattern,
+                        ring=ring, dump_path=dump_path,
+                        warn_interval=warn_interval)
+    if _GLOBAL:
+        # a replaced monitor may still have install()'d hooks attached
+        # out there — deactivate it so they stop feeding a dead queue
+        _GLOBAL[0].active = False
+    _GLOBAL.clear()
+    _GLOBAL.append(mon)
+    _state["on"] = True
+    return mon
+
+
+def disable():
+    """Stop feeding the global monitor (its records stay readable;
+    install()'d hooks go inert rather than keep queueing)."""
+    _state["on"] = False
+    if _GLOBAL:
+        _GLOBAL[0].active = False
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def monitor():
+    """The global monitor while enabled, else None."""
+    return _GLOBAL[0] if _state["on"] and _GLOBAL else None
+
+
+def observe(key, value):
+    """Feed one tensor to the global monitor (one flag check when
+    disabled — safe on any hot path)."""
+    if not _state["on"]:
+        return
+    _GLOBAL[0].observe(key, value)
+
+
+def snapshot():
+    """Global monitor snapshot, or a disabled stub (what
+    ``runtime_stats.snapshot()['health']`` embeds)."""
+    if _GLOBAL:
+        return _GLOBAL[0].snapshot()
+    return {"enabled": False}
+
+
+def dump_flight(path=None, reason=None):
+    """Dump the global monitor's flight recorder atomically; returns
+    the path (None when health was never enabled)."""
+    if not _GLOBAL:
+        return None
+    return _GLOBAL[0].dump(reason or "manual", path=path)
+
+
+def reset():
+    """Disable and drop the global monitor (tests)."""
+    _state["on"] = False
+    if _GLOBAL:
+        _GLOBAL[0].active = False
+    _GLOBAL.clear()
+    from .log import reset_rate_limits
+
+    reset_rate_limits("numerics-health:")
+
+
+def _activate_from_env():
+    if os.environ.get("MXNET_TPU_HEALTH") == "1":
+        enable()
+        return True
+    return False
+
+
+_activate_from_env()

@@ -10,7 +10,7 @@ import logging
 import sys
 import time
 
-__all__ = ["get_logger", "getLogger", "warn_rate_limited",
+__all__ = ["get_logger", "getLogger", "warn_rate_limited", "warn_once",
            "reset_rate_limits",
            "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG", "NOTSET"]
 
@@ -79,6 +79,14 @@ def warn_rate_limited(logger, key, interval, msg, *args):
     _rate_state[key] = now
     logger.warning(msg, *args)
     return True
+
+
+def warn_once(logger, key, msg, *args):
+    """``logger.warning(msg, *args)`` exactly once per ``key`` for the
+    process lifetime (re-armed by :func:`reset_rate_limits`) — for
+    events that matter once, like the health layer's crash-path
+    flight-recorder dump notice."""
+    return warn_rate_limited(logger, key, float("inf"), msg, *args)
 
 
 def reset_rate_limits(prefix=None):

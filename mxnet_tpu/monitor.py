@@ -3,6 +3,15 @@
 Reference: python/mxnet/monitor.py (Monitor over
 MXExecutorSetMonitorCallback).  Here the hook taps Gluon block forward
 hooks / executor outputs instead of engine callbacks.
+
+TPU-native default (PR 5): with no ``stat_func`` the Monitor computes
+its statistic **on device** through the numerics health layer's fused
+stat kernel (``health.stat_kernel``) and queues the tiny result without
+blocking; the host materializes everything in one batch at ``toc()`` —
+so monitoring no longer stalls the forward pass on a device->host copy
+per watched tensor.  Passing an explicit ``stat_func`` keeps the
+reference's host-numpy semantics (a DELIBERATE host-sync point, timed
+into ``runtime_stats`` so traces show what it costs the step).
 """
 
 from __future__ import annotations
@@ -10,20 +19,23 @@ from __future__ import annotations
 import re
 import time
 
-import numpy as _np
-
+from . import health as _health
 from . import profiler as _profiler
 from . import runtime_stats as _rts
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
 
+# device-mode statistic: abs-mean, the reference default (toc() returns
+# one value per tensor; NaN/Inf sentinels are the health layer's job)
+_DEVICE_STATS = ("abs_mean",)
+
 
 class Monitor:
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def stat_func(x):
-                return _np.abs(x).mean()
+        # stat_func=None selects the device-resident path; an explicit
+        # stat_func is the legacy host-numpy mode (reference parity)
+        self.legacy = stat_func is not None
         self.stat_func = stat_func
         self.interval = interval
         self.step = 0
@@ -32,32 +44,23 @@ class Monitor:
         self.re_pattern = re.compile(pattern)
         self.sort = sort
         self._installed = []
+        self._kernel = None if self.legacy \
+            else _health.stat_kernel(_DEVICE_STATS)
 
     def install(self, block):
         """Attach to a Gluon block tree (TPU-native analog of
         executor monitor callbacks)."""
+        from .gluon.block import is_staging
 
         def make_hook(name):
             def hook(blk, inputs, outputs):
-                if not self.activated:
+                if not self.activated or is_staging():
                     return
                 outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
                 for i, o in enumerate(outs):
                     key = "%s_output%d" % (name, i)
                     if self.re_pattern.match(key) and isinstance(o, NDArray):
-                        # Monitor is a DELIBERATE host-sync point: the
-                        # stat is computed on host numpy, blocking on the
-                        # device value mid-forward (reference semantics).
-                        # Timed into runtime_stats so traces show what
-                        # the monitor costs the step.
-                        t0 = time.perf_counter()
-                        with _profiler.span("monitor:stat", "monitor",
-                                            args={"key": key}):
-                            value = self.stat_func(o.asnumpy())  # mxlint: disable=trace-host-sync
-                        _rts.inc("monitor_stats")
-                        _rts.inc("monitor_seconds",
-                                 time.perf_counter() - t0)
-                        self.queue.append((self.step, key, value))
+                        self._observe(key, o)
             return hook
 
         def attach(blk, path):
@@ -69,6 +72,29 @@ class Monitor:
         attach(block, "")
         return self
 
+    def _observe(self, key, o):
+        t0 = time.perf_counter()
+        if self.legacy:
+            # legacy mode is a DELIBERATE host-sync point: the stat is
+            # computed on host numpy, blocking on the device value
+            # mid-forward (reference semantics).
+            with _profiler.span("monitor:stat", "monitor",
+                                args={"key": key}):
+                value = self.stat_func(o.asnumpy())  # mxlint: disable=trace-host-sync
+        else:
+            # device mode: queue the fused stat vector, no blocking —
+            # inside a staged/hybridized trace the output is a tracer
+            # and must not escape, so it is skipped
+            if not _health._concrete(o._data):
+                return
+            with _profiler.span("monitor:stat", "monitor",
+                                args={"key": key}
+                                if _profiler._state["running"] else None):
+                value = self._kernel(o._data)
+        _rts.inc("monitor_stats")
+        _rts.inc("monitor_seconds", time.perf_counter() - t0)
+        self.queue.append((self.step, key, value))
+
     def tic(self):
         if self.step % self.interval == 0:
             self.activated = True
@@ -76,11 +102,22 @@ class Monitor:
         self.step += 1
 
     def toc(self):
+        """Drain: in device mode every queued stat vector materializes
+        here in one batch (the rate-limited sync point); legacy entries
+        are already host values."""
         if not self.activated:
             return []
         self.activated = False
-        res = list(self.queue)
+        queued = list(self.queue)
         self.queue = []
+        if self.legacy:
+            res = queued
+        else:
+            t0 = time.perf_counter()
+            host = _health._fetch([v for _, _, v in queued])
+            res = [(step, key, vec[0]) for (step, key, _), vec
+                   in zip(queued, host)]
+            _rts.inc("monitor_seconds", time.perf_counter() - t0)
         if self.sort:
             res.sort(key=lambda x: x[1])
         return res

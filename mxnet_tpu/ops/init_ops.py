@@ -21,31 +21,38 @@ from .registry import register
 
 @register("_zeros", aliases=("zeros",))
 def zeros(shape=(), dtype="float32", **_):
+    """All-zeros array of `shape` (reference: _zeros, init_op.cc)."""
     return jnp.zeros(tuple(shape), dtype=np_dtype(dtype))
 
 
 @register("_ones", aliases=("ones",))
 def ones(shape=(), dtype="float32", **_):
+    """All-ones array of `shape` (reference: _ones, init_op.cc)."""
     return jnp.ones(tuple(shape), dtype=np_dtype(dtype))
 
 
 @register("_full", aliases=("full",))
 def full(shape=(), value=0.0, dtype="float32", **_):
+    """Array of `shape` filled with scalar `value` (reference: _full)."""
     return jnp.full(tuple(shape), value, dtype=np_dtype(dtype))
 
 
 @register("zeros_like")
 def zeros_like(x, **_):
+    """Zeros with the shape/dtype of `x` (reference: zeros_like)."""
     return jnp.zeros_like(x)
 
 
 @register("ones_like")
 def ones_like(x, **_):
+    """Ones with the shape/dtype of `x` (reference: ones_like)."""
     return jnp.ones_like(x)
 
 
 @register("_arange", aliases=("arange",))
 def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
+    """Evenly spaced values in ``[start, stop)`` with `step`, each value
+    repeated `repeat` times (reference: _arange, init_op.cc)."""
     out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
     if repeat != 1:
         out = jnp.repeat(out, int(repeat))
@@ -54,12 +61,16 @@ def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
 
 @register("_linspace", aliases=("linspace",))
 def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", **_):
+    """`num` evenly spaced values from `start` to `stop`, endpoint
+    included when `endpoint` (reference: _linspace)."""
     return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint),
                         dtype=np_dtype(dtype))
 
 
 @register("_eye", aliases=("eye",))
 def eye(N=1, M=0, k=0, dtype="float32", **_):
+    """Identity-like (N, M) matrix with ones on diagonal `k`
+    (reference: _eye, init_op.cc)."""
     m = int(M) if M else int(N)
     return jnp.eye(int(N), m, k=int(k), dtype=np_dtype(dtype))
 
@@ -83,12 +94,16 @@ def _check_param(op, name, value, ok):
 
 @register("_random_uniform", aliases=("random_uniform", "uniform"))
 def random_uniform(key, low=0.0, high=1.0, shape=(1,), dtype="float32", **_):
+    """Uniform samples over ``[low, high)`` of `shape`
+    (reference: _random_uniform, sample_op.cc)."""
     d = np_dtype(dtype)
     return jax.random.uniform(key, tuple(shape), dtype=d, minval=low, maxval=high)
 
 
 @register("_random_normal", aliases=("random_normal", "normal"))
 def random_normal(key, loc=0.0, scale=1.0, shape=(1,), dtype="float32", **_):
+    """Gaussian samples with mean `loc` and stddev `scale`
+    (reference: _random_normal, sample_op.cc)."""
     _check_param("random_normal", "scale", scale, lambda v: v >= 0)
     d = np_dtype(dtype)
     return jax.random.normal(key, tuple(shape), dtype=d) * scale + loc
@@ -96,6 +111,8 @@ def random_normal(key, loc=0.0, scale=1.0, shape=(1,), dtype="float32", **_):
 
 @register("_random_gamma", aliases=("random_gamma",))
 def random_gamma(key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", **_):
+    """Gamma samples with shape `alpha` and scale `beta`
+    (reference: _random_gamma, sample_op.cc)."""
     _check_param("random_gamma", "alpha", alpha, lambda v: v > 0)
     _check_param("random_gamma", "beta", beta, lambda v: v > 0)
     d = np_dtype(dtype)
@@ -104,6 +121,8 @@ def random_gamma(key, alpha=1.0, beta=1.0, shape=(1,), dtype="float32", **_):
 
 @register("_random_exponential", aliases=("random_exponential",))
 def random_exponential(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    """Exponential samples with rate `lam`
+    (reference: _random_exponential, sample_op.cc)."""
     _check_param("random_exponential", "lam", lam, lambda v: v > 0)
     d = np_dtype(dtype)
     return jax.random.exponential(key, tuple(shape), dtype=d) / lam
@@ -111,6 +130,8 @@ def random_exponential(key, lam=1.0, shape=(1,), dtype="float32", **_):
 
 @register("_random_poisson", aliases=("random_poisson",))
 def random_poisson(key, lam=1.0, shape=(1,), dtype="float32", **_):
+    """Poisson counts with mean `lam`, cast to `dtype`
+    (reference: _random_poisson, sample_op.cc)."""
     _check_param("random_poisson", "lam", lam, lambda v: v >= 0)
     out = jax.random.poisson(key, lam, tuple(shape))
     return out.astype(np_dtype(dtype))
@@ -118,6 +139,8 @@ def random_poisson(key, lam=1.0, shape=(1,), dtype="float32", **_):
 
 @register("_random_negative_binomial", aliases=("random_negative_binomial",))
 def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype="float32", **_):
+    """Negative-binomial counts (failures `k`, success prob `p`) via the
+    gamma-Poisson mixture (reference: _random_negative_binomial)."""
     _check_param("random_negative_binomial", "k", k, lambda v: v > 0)
     _check_param("random_negative_binomial", "p", p, lambda v: 0 < v <= 1)
     k1, k2 = jax.random.split(key)
@@ -128,6 +151,9 @@ def random_negative_binomial(key, k=1, p=1.0, shape=(1,), dtype="float32", **_):
 @register("_random_generalized_negative_binomial",
           aliases=("random_generalized_negative_binomial",))
 def random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(1,), dtype="float32", **_):
+    """Generalized negative-binomial counts parameterized by mean `mu`
+    and dispersion `alpha` (reference:
+    _random_generalized_negative_binomial, sample_op.cc)."""
     _check_param("random_generalized_negative_binomial", "mu", mu,
                  lambda v: v > 0)
     _check_param("random_generalized_negative_binomial", "alpha", alpha,
@@ -141,6 +167,8 @@ def random_gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(1,), dtype="float32",
 
 @register("_random_randint", aliases=("random_randint", "randint"))
 def random_randint(key, low=0, high=1, shape=(1,), dtype="int32", **_):
+    """Uniform integers in ``[low, high)`` of `shape`
+    (reference: _random_randint, sample_op.cc)."""
     return jax.random.randint(key, tuple(shape), int(low), int(high),
                               dtype=np_dtype(dtype))
 
@@ -149,6 +177,9 @@ def random_randint(key, low=0, high=1, shape=(1,), dtype="int32", **_):
           num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
 def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32",
                        **_):
+    """Draw `shape` categorical indices per row of probabilities `data`;
+    with ``get_prob`` also return the per-draw log-likelihood (second
+    output, used for REINFORCE) (reference: _sample_multinomial)."""
     n = int(shape[0]) if shape else 1
     logits = jnp.log(jnp.maximum(data, 1e-37))
     if data.ndim == 1:
@@ -179,6 +210,8 @@ def sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32",
 
 @register("_sample_uniform", aliases=("sample_uniform",))
 def sample_uniform(key, low, high, shape=(), dtype="float32", **_):
+    """Per-element uniform draws: one `shape`-tailed sample for every
+    (low, high) pair (reference: _sample_uniform, sample_op.cc)."""
     d = np_dtype(dtype)
     tail = tuple(shape) if shape else ()
     u = jax.random.uniform(key, low.shape + tail, dtype=d)
@@ -189,6 +222,8 @@ def sample_uniform(key, low, high, shape=(), dtype="float32", **_):
 
 @register("_sample_normal", aliases=("sample_normal",))
 def sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
+    """Per-element Gaussian draws for every (mu, sigma) pair
+    (reference: _sample_normal, sample_op.cc)."""
     d = np_dtype(dtype)
     tail = tuple(shape) if shape else ()
     z = jax.random.normal(key, mu.shape + tail, dtype=d)
@@ -199,6 +234,8 @@ def sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
 
 @register("_sample_gamma", aliases=("sample_gamma",))
 def sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
+    """Per-element gamma draws for every (alpha, beta) pair
+    (reference: _sample_gamma, sample_op.cc)."""
     d = np_dtype(dtype)
     tail = tuple(shape) if shape else ()
     alpha_b = alpha.reshape(alpha.shape + (1,) * len(tail))
@@ -209,6 +246,8 @@ def sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
 
 @register("_sample_exponential", aliases=("sample_exponential",))
 def sample_exponential(key, lam, shape=(), dtype="float32", **_):
+    """Per-element exponential draws for every rate in `lam`
+    (reference: _sample_exponential, sample_op.cc)."""
     d = np_dtype(dtype)
     tail = tuple(shape) if shape else ()
     e = jax.random.exponential(key, lam.shape + tail, dtype=d)
@@ -222,6 +261,8 @@ def _bcast_tail(arr, tail):
 
 @register("_sample_poisson", aliases=("sample_poisson",))
 def sample_poisson(key, lam, shape=(), dtype="float32", **_):
+    """Per-element Poisson counts for every mean in `lam`
+    (reference: _sample_poisson, sample_op.cc)."""
     tail = tuple(shape) if shape else ()
     return jax.random.poisson(key, _bcast_tail(lam, tail)).astype(
         np_dtype(dtype))
@@ -229,6 +270,8 @@ def sample_poisson(key, lam, shape=(), dtype="float32", **_):
 
 @register("_sample_negative_binomial", aliases=("sample_negative_binomial",))
 def sample_negative_binomial(key, k, p, shape=(), dtype="float32", **_):
+    """Per-element negative-binomial counts for every (k, p) pair via
+    the gamma-Poisson mixture (reference: _sample_negative_binomial)."""
     k1, k2 = jax.random.split(key)
     tail = tuple(shape) if shape else ()
     k_b = _bcast_tail(k.astype(jnp.float32), tail)
@@ -241,6 +284,9 @@ def sample_negative_binomial(key, k, p, shape=(), dtype="float32", **_):
           aliases=("sample_generalized_negative_binomial",))
 def sample_gen_negative_binomial(key, mu, alpha, shape=(), dtype="float32",
                                  **_):
+    """Per-element generalized negative-binomial counts for every
+    (mu, alpha) pair (reference:
+    _sample_generalized_negative_binomial, sample_op.cc)."""
     k1, k2 = jax.random.split(key)
     tail = tuple(shape) if shape else ()
     r = 1.0 / _bcast_tail(alpha, tail)
@@ -251,11 +297,17 @@ def sample_gen_negative_binomial(key, mu, alpha, shape=(), dtype="float32",
 
 @register("_shuffle", aliases=("shuffle",))
 def shuffle(key, data, **_):
+    """Random permutation of `data` along axis 0
+    (reference: _shuffle, shuffle_op.cc)."""
     return jax.random.permutation(key, data, axis=0)
 
 
 @register("_sample_unique_zipfian")
 def sample_unique_zipfian(key, range_max=1, shape=(1,), **_):
+    """Approximately Zipfian (log-uniform) candidate indices in
+    ``[0, range_max)`` — sampled-softmax candidates (reference:
+    _sample_unique_zipfian, unique_sample_op.cc; approximate: samples
+    are not deduplicated)."""
     # approximate: log-uniform samples (used by sampled softmax candidates)
     n = int(shape[-1]) if shape else 1
     u = jax.random.uniform(key, (n,))

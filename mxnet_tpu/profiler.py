@@ -146,6 +146,17 @@ def span(name, cat="framework", args=None):
     return scope(name, cat, args)
 
 
+def counter(name, values, cat="framework"):
+    """Guard-first chrome-trace counter ("C") event: one flag check and
+    nothing else while the profiler is off.  ``values`` is the
+    ``{series: number}`` args dict — the per-step telemetry sinks
+    (device-memory timeline, numerics-health ``grad_norm`` /
+    ``nan_total``) emit through this."""
+    if not _state["running"]:
+        return
+    add_event(name, cat, "C", args=values)
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome-tracing JSON; returns the absolute path.
 

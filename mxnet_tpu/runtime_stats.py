@@ -33,6 +33,12 @@ in-production analog of the offline ``BENCH_ROOFLINE.md`` audit.
 dump) so a live training job can be asked for it at any time, and
 ``python -m mxnet_tpu.runtime_stats [dump.json]`` pretty-prints it.
 
+Numerics health (PR 5): ``snapshot()`` embeds a ``health`` section —
+the device-resident NaN/Inf monitor and training flight recorder from
+``health.py`` — so :func:`report`, the diag dump, and the CLI all
+carry the numerics picture; the CLI also renders standalone
+flight-recorder dumps (files whose top level is ``health`` only).
+
 Environment variables
 ---------------------
 ``MXNET_TPU_RECOMPILE_STORM_THRESHOLD``  compiles per op before the
@@ -61,7 +67,7 @@ __all__ = ["snapshot", "report", "reset", "inc",
            "record_dispatch", "record_compile_key", "add_compile_seconds",
            "add_dispatch_seconds", "record_fallback", "note_aval_key",
            "roofline", "diag_snapshot", "dump_diag", "main",
-           "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
+           "health_probe", "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
 
 STORM_THRESHOLD = int(os.environ.get(
     "MXNET_TPU_RECOMPILE_STORM_THRESHOLD", "8"))
@@ -217,6 +223,26 @@ def inc(name, delta=1):
     _COUNTERS[name] = _COUNTERS.get(name, 0) + delta
 
 
+def health_probe():
+    """A few-dict-read counter probe for the health flight recorder's
+    per-step records: compile/fallback totals plus the live/peak
+    device-memory bytes.  Deliberately NOT :func:`snapshot` — this runs
+    once per drained training step, so it must stay O(ops), no cost
+    aggregation, no registry import."""
+    misses = compiles = fallbacks = 0
+    for s in list(_PER_OP.values()):
+        misses += s["misses"]
+        fallbacks += s["fallbacks"]
+    for st in list(_STORM.values()):
+        compiles += st["compiles"]
+    mem = device_memory._totals
+    return {"jit_cache_misses": misses, "compiles": compiles,
+            "fallbacks": fallbacks,
+            "trainer_steps": _COUNTERS.get("trainer_steps", 0),
+            "live_bytes": mem["live_bytes"],
+            "peak_bytes": mem["peak_bytes"]}
+
+
 # ------------------------------------------------------- storm detector
 
 
@@ -304,13 +330,17 @@ def snapshot():
     storms = {name: {"compiles": st["compiles"], "warned": st["warned"],
                      "distinct_avals": len(st["avals"])}
               for name, st in list(_STORM.items())}
-    # read-side only: the registry import is lazy (registry imports this
-    # module at its top), and the iteration never runs on dispatch
+    # read-side only: the registry/health imports are lazy (both import
+    # this module at their tops), and the iteration never runs on
+    # dispatch.  health.snapshot() never syncs — pending device stats
+    # are reported as a count.
+    from . import health as _health
     from .ops import registry as _registry
 
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
             "storms": storms, "memory": device_memory.snapshot(),
-            "costs": _registry.cost_snapshot()}
+            "costs": _registry.cost_snapshot(),
+            "health": _health.snapshot()}
 
 
 def roofline(snap=None, top=None):
@@ -384,6 +414,7 @@ def _render(snap, top=None):
                             ("%.3f" % v) if isinstance(v, float) else v))
     lines.extend(_render_costs(snap, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
+    lines.extend(_render_health(snap.get("health") or {}))
     return "\n".join(lines)
 
 
@@ -455,6 +486,46 @@ def _render_memory(mem):
         lines.append("%-28s %10s %8d %10s %10s" % (
             name[:28], _fmt(b["live_bytes"], 1e6), b["live_count"],
             _fmt(b["peak_bytes"], 1e6), _fmt(b["allocated_bytes"], 1e6)))
+    return lines
+
+
+def _render_health(health):
+    lines = ["", "Numerics health (device-resident NaN/Inf monitor)"]
+    if not health or (not health.get("enabled")
+                      and not health.get("totals", {}).get("drained")):
+        lines.append("(monitor off — health.enable() or "
+                     "MXNET_TPU_HEALTH=1; docs/OBSERVABILITY.md)")
+        return lines
+    t = health.get("totals", {})
+    lines.append("step %d (interval %d, stats: %s): %d observed, %d "
+                 "drained, %d pending, %d dropped; %d nan-step(s), %d "
+                 "inf-step(s)%s"
+                 % (health.get("step", 0), health.get("interval", 1),
+                    ",".join(health.get("stats", ())),
+                    t.get("observed", 0), t.get("drained", 0),
+                    health.get("pending", 0), t.get("dropped", 0),
+                    t.get("nan_steps", 0), t.get("inf_steps", 0),
+                    "" if health.get("enabled") else " (monitor off)"))
+    fn = health.get("first_nan")
+    if fn:
+        lines.append("FIRST NON-FINITE: step %d tensor %r (%d nan, %d "
+                     "inf)" % (fn.get("step", -1), fn.get("key"),
+                               int(fn.get("nan_total", 0)),
+                               int(fn.get("inf_total", 0))))
+    flight = health.get("flight") or []
+    lines.append("Flight recorder (%d record(s), newest last)"
+                 % len(flight))
+    if flight:
+        lines.append("%8s %12s %12s %8s %8s %-24s %10s"
+                     % ("Step", "Loss", "GradNorm", "NaN", "Inf",
+                        "FirstBad", "Misses"))
+        for r in flight[-12:]:
+            lines.append("%8d %12s %12s %8d %8d %-24s %10s" % (
+                r.get("step", -1), _fmt(r.get("loss")),
+                _fmt(r.get("grad_norm")),
+                int(r.get("nan_total", 0)), int(r.get("inf_total", 0)),
+                str(r.get("first_bad"))[:24],
+                (r.get("counters") or {}).get("jit_cache_misses", "-")))
     return lines
 
 
@@ -614,6 +685,15 @@ def main(argv=None):
     with open(args.dump) as f:
         data = json.load(f)
     snap = data.get("snapshot", data)
+    if "ops" not in snap:
+        # standalone flight-recorder dump (health.dump_flight / the
+        # first-NaN auto-dump): render just the numerics section
+        health = data.get("health") or snap.get("health") or {}
+        if data.get("reason"):
+            print("flight-recorder dump (reason: %s, pid %s)"
+                  % (data["reason"], data.get("pid", "?")))
+        print("\n".join(_canonical._render_health(health)))
+        return 0
     print(_canonical._render(snap, top=args.top))
     storms = data.get("recent_storm_keys") or {}
     print()

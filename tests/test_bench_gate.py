@@ -240,6 +240,42 @@ def test_disabled_tracker_creation_overhead_bound():
         "disabled tracker must record nothing"
 
 
+def test_disabled_health_observe_overhead_bound():
+    """PR 5 gate: the numerics health layer must be pay-for-use.  With
+    the monitor disabled (the default), feeding a tensor to
+    ``health.observe`` — the hook every surface (trainer, executor,
+    cached-graph outputs) calls — is ONE dict read: no kernel, no queue
+    entry, no counter.  Pinned as a generous absolute bound plus
+    zero-state assertions."""
+    import time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import health, runtime_stats
+
+    assert not health.is_enabled()
+    x = mx.nd.ones((8, 8))
+    kernels_before = dict(health._KERNELS)
+    base_observed = runtime_stats.snapshot()["counters"].get(
+        "health_observed", 0)
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            health.observe("bench", x)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+    # the guard is a module attr + dict read (~0.1us); 10us tolerates
+    # slow shared CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "health.observe with monitor off took %.2fus" % (best * 1e6)
+    assert dict(health._KERNELS) == kernels_before, \
+        "disabled observe must not build stat kernels"
+    assert runtime_stats.snapshot()["counters"].get(
+        "health_observed", 0) == base_observed, \
+        "disabled observe must record nothing"
+
+
 def test_probe_relay_ping_short_circuits(monkeypatch):
     """A healthy relay answers the cheap liveness ping: ONE probe child,
     no full-timeout probes."""

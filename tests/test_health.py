@@ -1,0 +1,495 @@
+"""PR 5 numerics health layer: device-resident stat kernels, the async
+HealthMonitor, and the training flight recorder.
+
+The tentpole's contract, pinned end to end:
+
+- the jitted per-tensor stat kernel matches numpy on crafted tensors
+  (all-NaN, infs, zeros, random, integer dtypes);
+- a 20-step Gluon loop with an induced mid-run NaN yields exactly ONE
+  rate-limited warning naming the earliest offending tensor plus an
+  atomic flight-recorder dump readable by the ``runtime_stats`` CLI;
+- observations queue tiny DEVICE vectors in arrival order and the host
+  materializes them only at the drain point (async-drain ordering);
+- the trainer/executor/Monitor feeds and report/diag integrations;
+- disabled-mode overhead is pinned separately in test_bench_gate.py.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, health, profiler, runtime_stats
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+@pytest.fixture(autouse=True)
+def _clean_health():
+    health.reset()
+    runtime_stats.reset()
+    cap = _Capture()
+    logging.getLogger("mxnet_tpu.health").addHandler(cap)
+    yield cap
+    logging.getLogger("mxnet_tpu.health").removeHandler(cap)
+    profiler.set_state("stop")
+    profiler._state["events"] = []
+    health.reset()
+    runtime_stats.reset()
+
+
+# ------------------------------------------------------ stat kernel
+
+
+def _np_stats(a):
+    af = a.astype(np.float32)
+    return {"nan_count": float(np.isnan(af).sum()),
+            "inf_count": float(np.isinf(af).sum()),
+            "abs_mean": np.abs(af).mean(),
+            "min": af.min(), "max": af.max(),
+            "l2_norm": np.sqrt((af * af).sum()),
+            "zero_frac": float((a == 0).mean())}
+
+
+@pytest.mark.parametrize("case", ["all_nan", "some_inf", "zeros",
+                                  "random", "int32"])
+def test_stat_kernel_matches_numpy(case):
+    rs = np.random.RandomState(3)
+    a = {"all_nan": np.full((4, 5), np.nan, np.float32),
+         "some_inf": np.array([[1.0, -np.inf], [np.inf, 0.0]], np.float32),
+         "zeros": np.zeros((3, 3), np.float32),
+         "random": (rs.randn(6, 7) * 10).astype(np.float32),
+         "int32": np.arange(-4, 8, dtype=np.int32).reshape(3, 4)}[case]
+    got = health.tensor_stats(mx.nd.array(a, dtype=a.dtype),
+                              health.STAT_NAMES)
+    want = _np_stats(a)
+    assert set(got) == set(want)
+    for name in health.STAT_NAMES:
+        np.testing.assert_allclose(got[name], want[name], rtol=1e-6,
+                                   atol=1e-6, equal_nan=True,
+                                   err_msg="stat %s on %s" % (name, case))
+
+
+def test_stat_kernel_rejects_unknown_stat():
+    with pytest.raises(ValueError, match="unknown health stat"):
+        health.stat_kernel(("nan_count", "entropy"))
+
+
+def test_custom_stat_selection_keeps_sentinels():
+    mon = health.enable(stats=("abs_mean",))
+    assert "nan_count" in mon.stats and "inf_count" in mon.stats
+
+
+def test_env_stat_selection_honored(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_HEALTH_STATS", "zero_frac, abs_mean")
+    mon = health.enable()
+    assert mon.stats == ("zero_frac", "abs_mean",
+                         "nan_count", "inf_count")
+    mon.observe("t", mx.nd.array(np.array([0.0, 2.0], np.float32)))
+    drained = mon.drain()
+    assert drained[0]["stats"]["zero_frac"] == 0.5
+
+
+# ------------------------------------------------ async-drain ordering
+
+
+def test_observe_queues_device_values_and_drains_in_order():
+    import jax
+
+    mon = health.enable(interval=2)
+    xs = {k: mx.nd.array(np.full((2, 2), i, np.float32))
+          for i, k in enumerate(["t0", "t1", "t2"])}
+    for k in ("t0", "t1", "t2"):
+        mon.observe(k, xs[k])
+    # queued DEVICE vectors, in arrival order, nothing on host yet
+    assert len(mon._pending) == 3
+    for kind, step, _key, dev in mon._pending:
+        assert kind == "stats" and step == 0
+        assert isinstance(dev, jax.Array)
+        assert not isinstance(dev, np.ndarray)
+    assert list(mon.records) == []
+
+    mon.end_step()  # step 0 is a sampled step -> drain happens here
+    assert len(mon._pending) == 0
+    assert [r["key"] for r in mon.records] == ["t0", "t1", "t2"]
+    assert [r["step"] for r in mon.records] == [0, 0, 0]
+    np.testing.assert_allclose(
+        [r["stats"]["abs_mean"] for r in mon.records], [0.0, 1.0, 2.0])
+
+    # step 1 is NOT sampled under interval=2: observe must be a no-op
+    mon.observe("skipped", xs["t0"])
+    assert len(mon._pending) == 0
+    mon.end_step()
+    # step 2 samples again
+    mon.observe("t3", xs["t1"])
+    assert len(mon._pending) == 1 and mon._pending[0][1] == 2
+
+
+def test_pending_queue_is_bounded_and_counts_drops(monkeypatch):
+    mon = health.enable()
+    monkeypatch.setattr(health, "_PENDING_CAP", 4)
+    x = mx.nd.ones((2,))
+    for i in range(7):
+        mon.observe("k%d" % i, x)
+    assert len(mon._pending) == 4
+    assert mon.totals["dropped"] == 3
+    drained = mon.drain()
+    assert [r["key"] for r in drained] == ["k3", "k4", "k5", "k6"]
+
+
+def test_tracer_values_are_skipped_and_no_double_observation():
+    """Inside a staged/hybridized trace outputs are tracers — queueing
+    one across the trace boundary would be a leak, so observe skips;
+    the root forward hook then observes each concrete cached output
+    exactly ONCE per forward."""
+    from mxnet_tpu.gluon.block import is_staging
+
+    mon = health.enable()
+    net = nn.Dense(3)
+    net.initialize(ctx=mx.cpu())
+    mon.install(net)
+    net.hybridize()
+    assert not is_staging()
+    net(mx.nd.ones((2, 4)))  # staging pass + cached-graph call
+    mon.drain()
+    # concrete outputs only, and no duplicate key for the same forward
+    assert all(np.isfinite(r["stats"]["abs_mean"]) for r in mon.records)
+    keys = [r["key"] for r in mon.records]
+    assert len(keys) == len(set(keys)) == 1, keys
+    # steady state (cached executable): still one observation per call
+    before = len(mon.records)
+    net(mx.nd.ones((2, 4)))
+    mon.drain()
+    assert len(mon.records) == before + 1
+
+
+def test_disable_makes_installed_hooks_inert():
+    """disable() must stop install()'d hooks from dispatching kernels
+    into a queue nothing will ever drain."""
+    mon = health.enable()
+    net = nn.Dense(3)
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.ones((2, 4)))  # finish deferred init
+    mon.install(net)
+    net(mx.nd.ones((2, 4)))
+    assert len(mon._pending) == 1
+    health.disable()
+    net(mx.nd.ones((2, 4)))
+    assert len(mon._pending) == 1, "inert hook must not enqueue"
+    # a replaced monitor's orphaned hooks go inert the same way
+    mon2 = health.enable()
+    net(mx.nd.ones((2, 4)))
+    assert len(mon._pending) == 1 and len(mon2._pending) == 0
+
+
+def test_update_ratio_keys_respect_pattern():
+    mon = health.enable(pattern="grad_norm|loss|uwr:dense.*weight.*")
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    _train(net, 2)
+    keys = {r["key"] for r in mon.records}
+    assert any(k.startswith("uwr:") and "weight" in k for k in keys)
+    assert not any("bias" in k for k in keys), keys
+    assert "grad_norm" in keys
+
+
+# ------------------------------------- the acceptance loop: induced NaN
+
+
+def _train(net, steps, poison_at=None, batch=2):
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rs = np.random.RandomState(0)
+    mon = health.monitor()
+    for step in range(steps):
+        if step == poison_at:
+            w = net.weight.data()
+            net.weight.set_data(mx.nd.array(
+                np.full(w.shape, np.nan, np.float32)))
+        x = mx.nd.array(rs.rand(batch, 6).astype(np.float32))
+        y = mx.nd.array(rs.randint(0, 4, (batch,)).astype(np.float32))
+        with autograd.record():
+            L = loss_fn(net(x), y)
+        L.backward()
+        if mon is not None:
+            mon.note_loss(L)
+        trainer.step(batch)
+    return trainer
+
+
+def test_twenty_step_loop_records_grad_norm_and_nan_free(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "health_trace.json"))
+    profiler.set_state("run")
+    mon = health.enable(dump_path=str(tmp_path / "flight.json"))
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    mon.install(net)
+    _train(net, 20)
+
+    snap = health.snapshot()
+    assert snap["step"] == 20
+    flight = snap["flight"]
+    assert len(flight) == 20
+    for rec in flight:
+        assert rec["grad_norm"] is not None and rec["grad_norm"] >= 0
+        assert rec["nan_total"] == 0 and rec["inf_total"] == 0
+        assert rec["loss"] is not None
+        assert "jit_cache_misses" in rec["counters"]
+    assert [r["step"] for r in flight] == list(range(20))
+    # per-param update-to-weight ratios rode along
+    assert any(r["key"].startswith("uwr:") for r in mon.records)
+    # forward-hook observations too
+    assert any(r["key"].endswith("_output0") for r in mon.records)
+    assert snap["first_nan"] is None
+
+    # chrome-trace counter events while the profiler ran
+    path = profiler.dump(finished=True)
+    trace = json.load(open(path))["traceEvents"]
+    gn = [e for e in trace if e.get("ph") == "C"
+          and e["name"] == "grad_norm"]
+    nt = [e for e in trace if e.get("ph") == "C"
+          and e["name"] == "nan_total"]
+    assert len(gn) == 20 and len(nt) == 20
+    assert all(e["args"]["nan_total"] == 0 for e in nt)
+
+
+def test_induced_nan_warns_once_and_dumps_flight(tmp_path, _clean_health):
+    dump = str(tmp_path / "flight.json")
+    mon = health.enable(dump_path=dump)
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    mon.install(net)
+    _train(net, 20, poison_at=10)
+
+    snap = health.snapshot()
+    fn = snap["first_nan"]
+    assert fn is not None and fn["step"] == 10
+    assert fn["key"], "first-NaN marker must name the offending tensor"
+    # half the steps are poisoned, ONE rate-limited warning fired
+    warns = [m for m in _clean_health.messages if "non-finite" in m]
+    assert len(warns) == 1, warns
+    assert fn["key"] in warns[0]
+    assert snap["totals"]["nan_steps"] >= 10
+
+    # the atomic dump exists, parses, and carries the poisoned records
+    assert os.path.exists(dump)
+    assert mon.flight.dumps == 1, "first NaN dumps exactly once"
+    data = json.load(open(dump))
+    assert data["reason"] == "first-nan"
+    flight = data["health"]["flight"]
+    assert any(r["nan_total"] > 0 for r in flight)
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".")], \
+        "no temp file left behind by the atomic dump"
+
+    # readable by the runtime_stats CLI
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert runtime_stats.main([dump]) == 0
+    out = buf.getvalue()
+    assert "Numerics health" in out
+    assert "FIRST NON-FINITE" in out
+    assert "first-nan" in out
+
+
+def test_trainer_step_exception_dumps_flight(tmp_path, monkeypatch):
+    dump = str(tmp_path / "crash_flight.json")
+    mon = health.enable(dump_path=dump)
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    trainer = _train(net, 3)
+
+    def boom(*a, **kw):
+        raise RuntimeError("induced optimizer failure")
+
+    monkeypatch.setattr(trainer, "_update", boom)
+    x = mx.nd.ones((2, 6))
+    with autograd.record():
+        L = gluon.loss.SoftmaxCrossEntropyLoss()(net(x), mx.nd.zeros((2,)))
+    L.backward()
+    with pytest.raises(RuntimeError, match="induced optimizer failure"):
+        trainer.step(2)
+    assert os.path.exists(dump)
+    data = json.load(open(dump))
+    assert data["reason"] == "trainer-step-exception"
+    # the ring carried the healthy steps recorded before the crash
+    assert len(data["health"]["flight"]) >= 3
+
+
+# --------------------------------------------------- surface integrations
+
+
+def test_executor_outputs_and_grads_feed_health():
+    health.enable()
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 5))
+    ex.arg_dict["data"][:] = np.ones((2, 5), np.float32)
+    ex.forward(is_train=True)
+    ex.backward(out_grads=mx.nd.ones((2, 3)))
+    drained = health.monitor().drain()
+    keys = {r["key"] for r in drained}
+    assert any(k.startswith("exec:") for k in keys)
+    assert any(k.startswith("exec_grad:") for k in keys)
+
+
+def test_monitor_device_default_has_no_host_sync_until_toc(monkeypatch):
+    """The legacy Monitor's default path now computes on device: the
+    per-tensor hook must not call asnumpy; toc() is the sync point."""
+    from mxnet_tpu.ndarray import NDArray
+
+    net = nn.Dense(3)
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.ones((2, 5)))  # finish deferred init (a one-off host copy)
+    mon = mx.monitor.Monitor(1, pattern=".*")
+    mon.install(net)
+
+    calls = []
+    orig = NDArray.asnumpy
+
+    def counting(self):
+        calls.append(1)
+        return orig(self)
+
+    monkeypatch.setattr(NDArray, "asnumpy", counting)
+    mon.tic()
+    net(mx.nd.ones((2, 5)))
+    assert calls == [], "device-mode Monitor must not sync mid-forward"
+    res = mon.toc()
+    assert res and all(np.isfinite(v) for _, _, v in res)
+
+
+def test_monitor_legacy_stat_func_still_host_numpy():
+    net = nn.Dense(3)
+    net.initialize(ctx=mx.cpu())
+    seen = []
+
+    def stat(arr):
+        seen.append(type(arr))
+        return np.abs(arr).max()
+
+    mon = mx.monitor.Monitor(1, stat_func=stat, pattern=".*")
+    mon.install(net)
+    mon.tic()
+    net(mx.nd.ones((2, 5)))
+    res = mon.toc()
+    assert res and seen and all(t is np.ndarray for t in seen)
+
+
+def test_clip_global_norm_scales_on_device_and_warns_on_nan():
+    import warnings
+
+    arrays = [mx.nd.ones((3,)) * 4, mx.nd.ones((2,)) * 3]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    want = np.sqrt(3 * 16 + 2 * 9)  # three 4s + two 3s
+    np.testing.assert_allclose(norm, want, rtol=1e-5)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_total - 1.0) < 1e-4
+
+    bad = [mx.nd.array(np.array([np.nan, 1.0], np.float32))]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        gluon.utils.clip_global_norm(bad, 1.0)
+    assert any("nan or inf" in str(x.message) for x in w)
+    # and check_isfinite=False stays silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        gluon.utils.clip_global_norm(
+            [mx.nd.array(np.array([np.inf], np.float32))], 1.0,
+            check_isfinite=False)
+    assert not w
+
+
+def test_clip_global_norm_nonfinite_norm_leaves_arrays_untouched():
+    """Reference semantics: a NaN/Inf global norm must not rescale —
+    the old host branch (`if scale < 1.0`) was False for NaN, so the
+    arrays (including the finite ones) stayed intact for a caller that
+    detects via the returned norm and skips the step."""
+    import warnings
+
+    bad = mx.nd.array(np.array([np.nan, 1.0], np.float32))
+    good = mx.nd.array(np.array([2.0, 3.0], np.float32))
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        norm = gluon.utils.clip_global_norm([bad, good], 1.0)
+    assert norm != norm  # NaN propagates to the returned scalar
+    np.testing.assert_array_equal(good.asnumpy(), [2.0, 3.0])
+    np.testing.assert_array_equal(bad.asnumpy()[1:], [1.0])
+
+
+def test_mid_step_drain_merges_into_one_flight_record(tmp_path):
+    """report()/drain() between observations must not split a step
+    into two flight records or double-count nan_steps."""
+    mon = health.enable(dump_path=str(tmp_path / "flight.json"))
+    nan = mx.nd.array(np.array([np.nan], np.float32))
+    mon.observe("first_half", nan)
+    mon.drain()                      # mid-step drain (e.g. report())
+    mon.observe("second_half", nan)
+    mon.end_step()
+    flight = mon.flight.records()
+    assert [r["step"] for r in flight] == [0]
+    assert flight[0]["nan_total"] == 2.0
+    assert mon.totals["nan_steps"] == 1
+
+
+def test_report_and_diag_carry_health_section(tmp_path):
+    mon = health.enable()
+    mon.observe("t", mx.nd.ones((2, 2)))
+    mon.end_step()
+    report = runtime_stats.report()
+    assert "Numerics health" in report
+    assert "Flight recorder" in report
+
+    p = runtime_stats.dump_diag(str(tmp_path / "diag.json"))
+    data = json.load(open(p))
+    h = data["snapshot"]["health"]
+    assert h["enabled"] and len(h["flight"]) == 1
+
+
+def test_report_health_section_self_describing_when_off():
+    assert "monitor off" in runtime_stats.report()
+
+
+def test_snapshot_never_drains_pending():
+    mon = health.enable()
+    mon.observe("t", mx.nd.ones((2, 2)))
+    snap = health.snapshot()
+    assert snap["pending"] == 1
+    assert len(mon._pending) == 1, "snapshot must not drain (no sync)"
+
+
+def test_env_activation(tmp_path):
+    import subprocess
+    import sys
+
+    out = tmp_path / "env_flight.json"
+    code = ("import mxnet_tpu as mx\n"
+            "from mxnet_tpu import health\n"
+            "assert health.is_enabled()\n"
+            "m = health.monitor()\n"
+            "m.observe('t', mx.nd.ones((2, 2)))\n"
+            "m.end_step()\n"
+            "print(health.dump_flight(%r))\n" % str(out))
+    env = dict(os.environ, MXNET_TPU_HEALTH="1", JAX_PLATFORMS="cpu")
+    env.pop("MXNET_TPU_DIAG", None)
+    env.pop("PYTHONPATH", None)
+    subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                   check=True, timeout=180)
+    data = json.load(open(out))
+    assert data["health"]["totals"]["drained"] == 1

@@ -40,6 +40,10 @@ def parse_args():
     p.add_argument('--diag', default=None,
                    help='Pretty-print this MXNET_TPU_DIAG dump in the telemetry '
                         'section (default: $MXNET_TPU_DIAG, else live counters).')
+    p.add_argument('--health', action='store_true',
+                   help='Render only the numerics-health / flight-recorder '
+                        'section of the dump (works on full diag dumps and on '
+                        'standalone flight-recorder dumps).')
     p.add_argument('--network', default=0, type=int,
                    help='Diagnose network (off by default: many TPU pods have no egress).')
     p.add_argument('--timeout', default=10, type=int,
@@ -101,12 +105,15 @@ def check_framework():
         print('jax          : <unavailable: %s>' % (e,))
 
 
-def check_telemetry(diag_path=None):
+def check_telemetry(diag_path=None, health_only=False):
     """Telemetry view: pretty-print a MXNET_TPU_DIAG dump when given
     (or found in the environment), else this process's live counters —
     so a bug report carries the memory/cost picture, not just versions
-    (docs/OBSERVABILITY.md 'Memory & cost analytics')."""
-    _section('Telemetry Info')
+    (docs/OBSERVABILITY.md 'Memory & cost analytics').  With
+    ``health_only`` only the numerics-health / flight-recorder section
+    renders (docs/OBSERVABILITY.md 'Numerics health'); standalone
+    flight-recorder dumps are accepted too."""
+    _section('Telemetry Info' if not health_only else 'Numerics Health')
     diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
     try:
         from mxnet_tpu import runtime_stats
@@ -119,11 +126,25 @@ def check_telemetry(diag_path=None):
     runtime_stats._DIAG_STATE['armed'] = False
     if diag_path and os.path.exists(diag_path):
         print('Diag dump    :', os.path.abspath(diag_path))
+        if health_only:
+            import json
+            with open(diag_path) as f:
+                data = json.load(f)
+            if data.get('reason'):
+                print('Dump reason  :', data['reason'])
+            health = data.get('health') \
+                or data.get('snapshot', {}).get('health') or {}
+            print('\n'.join(runtime_stats._render_health(health)))
+            return
         runtime_stats.main([diag_path])
         return
     if diag_path:
         print('Diag dump    : %s (not written yet — send SIGUSR1 to the '
               'training pid or wait for exit)' % diag_path)
+    if health_only:
+        from mxnet_tpu import health
+        print('\n'.join(runtime_stats._render_health(health.snapshot())))
+        return
     print(runtime_stats.report())
 
 
@@ -191,6 +212,10 @@ def check_network(timeout):
 
 def main():
     args = parse_args()
+    if args.health:
+        # focused view for numerics triage: skip the platform sections
+        check_telemetry(args.diag, health_only=True)
+        return
     if args.hardware:
         check_hardware()
     if args.os:
