@@ -239,9 +239,16 @@ class Block:
 
     # ------------------------------------------------------------ save/load
     def save_parameters(self, filename):
+        """Save parameters by structural name (reference:
+        block.py save_parameters) — atomically via
+        ``checkpoint.atomic_write`` so a crash mid-save can never leave
+        a torn params file under the final name."""
+        from ..checkpoint import atomic_write
+
         params = self._collect_params_with_prefix()
         arg_dict = {k: v.data().as_in_context(cpu()) for k, v in params.items()}
-        ndarray.save(filename, arg_dict)
+        with atomic_write(filename) as tmp:
+            ndarray.save(tmp, arg_dict)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False):

@@ -14,6 +14,7 @@ train step over the mesh (parallel/data_parallel.py).
 
 from __future__ import annotations
 
+from .. import checkpoint as _ckpt
 from .. import device_memory as _dm
 from .. import health as _health
 from .. import kvstore as _kvstore
@@ -160,6 +161,11 @@ class Trainer:
             _dm.emit_counter()
         if hm is not None:
             hm.end_step()
+        # auto-checkpoint hook (checkpoint.enable()/MXNET_TPU_CKPT):
+        # advances the manager's step clock and snapshots at interval
+        # boundaries without blocking.  Disabled: one dict read.
+        if _ckpt._state["on"]:
+            _ckpt.on_step(self)
 
     def _health_grads_and_prev(self, hm):
         """Feed gradients to the health monitor and snapshot the
@@ -279,20 +285,47 @@ class Trainer:
 
     # ------------------------------------------------------------ states
     def save_states(self, fname):
+        """Save optimizer/updater state (reference: trainer.py
+        save_states) — atomically (temp + fsync + rename via
+        ``checkpoint.atomic_write``) and with a version header, so a
+        crash mid-save can never leave a torn states file under the
+        final name (docs/CHECKPOINTING.md)."""
         import pickle
 
-        with open(fname, "wb") as f:
-            pickle.dump(self._updaters[0].get_states(dump_optimizer=True)
-                        if hasattr(self._updaters[0], "get_states")
-                        else self._updaters[0].states, f)
+        payload = self._updaters[0].get_states(dump_optimizer=True) \
+            if hasattr(self._updaters[0], "get_states") \
+            else self._updaters[0].states
+        if not isinstance(payload, bytes):
+            payload = pickle.dumps(payload,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+        with _ckpt.atomic_write(fname) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(_ckpt.TRAINER_STATES_MAGIC)
+                f.write(bytes([_ckpt.TRAINER_STATES_VERSION]))
+                f.write(b"\n")
+                f.write(payload)
 
     def load_states(self, fname):
+        """Load optimizer/updater state; understands both the versioned
+        header format and legacy headerless pickles."""
         import pickle
 
         with open(fname, "rb") as f:
-            states = pickle.load(f)
+            head = f.read(len(_ckpt.TRAINER_STATES_MAGIC))
+            if head == _ckpt.TRAINER_STATES_MAGIC:
+                version = f.read(1)[0]
+                if version > _ckpt.TRAINER_STATES_VERSION:
+                    raise ValueError(
+                        "trainer states file %s has version %d; this "
+                        "build understands <= %d"
+                        % (fname, version, _ckpt.TRAINER_STATES_VERSION))
+                f.read(1)  # newline
+                states = f.read()
+            else:
+                states = pickle.loads(head + f.read())
         for u in self._updaters:
             if hasattr(u, "set_states"):
                 u.set_states(states)
             else:
-                u.states = states
+                u.states = pickle.loads(states) \
+                    if isinstance(states, bytes) else states

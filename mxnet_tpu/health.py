@@ -563,14 +563,22 @@ class HealthMonitor:
             path = "<dump failed>"
             _logger().exception("flight-recorder dump failed")
         fn = self.first_nan
+        from . import checkpoint as _ckpt
+
+        lin = _ckpt.lineage()
+        resume = ""
+        if lin and lin.get("last_good_path"):
+            resume = "  Last good checkpoint: %s (step %s) — resume " \
+                "with checkpoint.auto_resume() (docs/CHECKPOINTING.md)." \
+                % (lin["last_good_path"], lin["step"])
         warn_rate_limited(
             _logger(), "numerics-health:nan", self.warn_interval,
             "non-finite values detected at step %d: earliest offending "
             "tensor %r (%d nan, %d inf this step).  Flight recorder "
             "dumped to %s — inspect with `python -m "
-            "mxnet_tpu.runtime_stats %s` (docs/OBSERVABILITY.md).",
+            "mxnet_tpu.runtime_stats %s` (docs/OBSERVABILITY.md).%s",
             fn["step"], fn["key"], int(fn["nan_total"]),
-            int(fn["inf_total"]), path, path)
+            int(fn["inf_total"]), path, path, resume)
 
     # ------------------------------------------------------- read side
     def dump(self, reason=None, path=None):
@@ -599,14 +607,20 @@ class HealthMonitor:
 
     def snapshot(self):
         """JSON-serializable view: config, totals, recent drained
-        records, the flight ring, and the first-NaN marker.  Never
-        syncs — pending device values are reported as a count only."""
+        records, the flight ring, the first-NaN marker, and the
+        checkpoint lineage (last-good checkpoint path + step, when the
+        checkpoint layer is enabled) so a flight dump tells the
+        operator exactly where to resume from.  Never syncs — pending
+        device values are reported as a count only."""
+        from . import checkpoint as _ckpt
+
         return {"enabled": _state["on"], "step": self.step,
                 "interval": self.interval, "stats": list(self.stats),
                 "pending": len(self._pending),
                 "totals": dict(self.totals),
                 "first_nan": dict(self.first_nan)
                 if self.first_nan else None,
+                "checkpoint": _ckpt.lineage(),
                 "records": list(self.records)[-32:],
                 "flight": self.flight.records()}
 

@@ -205,8 +205,11 @@ class KVStore:
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "updater is not set"
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        from ..checkpoint import atomic_write
+
+        with atomic_write(fname) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "updater is not set"

@@ -29,6 +29,15 @@ classes from this framework's optimizer/lr_scheduler modules plus the
 numpy reconstructors — the worker still ships its configured Optimizer
 instance like the reference (python/mxnet/kvstore.py set_optimizer),
 but a rogue peer can no longer reach arbitrary globals through it.
+
+Fault tolerance (PR 6): worker→server RPCs retry transient transport
+errors with bounded exponential backoff + reconnect (``PSClient._call``;
+``MXNET_TPU_KV_RETRIES``/``MXNET_TPU_KV_RETRY_BACKOFF``), server-side
+per-connection errors are logged rate-limited with the peer address
+instead of silently swallowed, and ``MXNET_TPU_FAULT`` injects
+deterministic failures (drop/delay/refuse connections,
+kill-server-after-N-messages) so all of it is testable —
+docs/CHECKPOINTING.md "Fault injection".
 """
 
 from __future__ import annotations
@@ -39,9 +48,56 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 __all__ = ["PSServer", "PSClient", "server_addresses", "run_server",
-           "set_app_controller"]
+           "set_app_controller", "parse_fault_spec"]
+
+_logger_cache: list = []
+
+
+def _logger():
+    if not _logger_cache:
+        from ..log import get_logger
+
+        _logger_cache.append(get_logger("mxnet_tpu.kvstore.ps"))
+    return _logger_cache[0]
+
+
+# --------------------------------------------------------- fault harness --
+# Deterministic fault injection for the dist kvstore (MXNET_TPU_FAULT):
+# the failure modes a real cluster produces nondeterministically —
+# dropped/delayed/refused connections, a parameter server dying
+# mid-push — become reproducible test fixtures.  Injection is entirely
+# server-side and counted under one lock, so "the Nth message" means
+# the same message every run.  Faults fire BEFORE a message is handled,
+# which keeps retried pushes exactly-once on the server state (a push
+# whose connection died after apply would double-apply on retry; see
+# PSClient._call's caveat on reply-loss ambiguity).
+#
+#   MXNET_TPU_FAULT=drop_after:N   close the worker connection instead
+#                                  of handling every Nth message
+#   MXNET_TPU_FAULT=delay:S        sleep S seconds before each message
+#   MXNET_TPU_FAULT=refuse:N       close the first N accepted
+#                                  connections immediately
+#   MXNET_TPU_FAULT=kill_after:N   stop the whole server upon receiving
+#                                  the Nth message (before handling it)
+
+_FAULT_MODES = ("drop_after", "delay", "refuse", "kill_after")
+
+
+def parse_fault_spec(spec):
+    """``MXNET_TPU_FAULT`` spec → ``{"mode", "arg"}`` or None."""
+    if not spec:
+        return None
+    mode, _, arg = spec.partition(":")
+    mode = mode.strip()
+    if mode not in _FAULT_MODES:
+        raise ValueError(
+            "unknown MXNET_TPU_FAULT mode %r (known: %s)"
+            % (mode, ", ".join(_FAULT_MODES)))
+    return {"mode": mode,
+            "arg": float(arg) if mode == "delay" else int(arg)}
 
 # App-level server controller (reference: KVStore::RunServer(controller)):
 # receives (head, body) for every non-framework command a worker sends via
@@ -192,6 +248,12 @@ class PSServer:
         self.port = self._sock.getsockname()[1]
         self._conns = set()
         self._conns_lock = threading.Lock()
+        # fault-injection state (parsed per server so tests can flip the
+        # env between instances); message/refusal counters share one lock
+        self._fault = parse_fault_spec(os.environ.get("MXNET_TPU_FAULT"))
+        self._fault_lock = threading.Lock()
+        self._fault_msgs = 0
+        self._fault_refused = 0
 
     # -- handler plumbing --------------------------------------------------
     def serve_forever(self):
@@ -208,6 +270,17 @@ class PSServer:
                 continue
             except OSError:
                 break
+            if self._fault is not None and self._fault["mode"] == "refuse":
+                with self._fault_lock:
+                    refuse = self._fault_refused < self._fault["arg"]
+                    if refuse:
+                        self._fault_refused += 1
+                if refuse:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
@@ -226,26 +299,45 @@ class PSServer:
 
     def _serve_conn(self, conn):
         try:
+            peer = "%s:%d" % conn.getpeername()[:2]
+        except OSError:
+            peer = "<unknown>"
+        try:
             while True:
                 try:
                     msg = _recv_msg(conn)
-                except Exception:
+                except Exception as e:
                     # a peer that cannot speak the framed-pickle
                     # protocol (or trips the restricted unpickler) is
-                    # dropped; decode failures must neither execute
-                    # anything nor kill the server thread loudly
+                    # dropped; decode failures must never execute
+                    # anything or kill the server thread — but they ARE
+                    # logged (rate-limited per peer) with the address,
+                    # so a flaky or hostile client is diagnosable
+                    self._log_conn_error(peer, "undecodable frame", e)
                     return
                 if msg is None:
                     return
+                if self._fault is not None:
+                    action = self._fault_tick()
+                    if action == "drop":
+                        return
+                    if action == "kill":
+                        self._stop.set()
+                        try:
+                            self._sock.close()  # accept loop exits now
+                        except OSError:
+                            pass
+                        return
                 try:
                     reply = self._handle(msg)
                 except Exception as e:  # error surfaces on the worker
                     reply = ("err", "%s: %s" % (type(e).__name__, e))
                 try:
                     _send_msg(conn, reply)
-                except OSError:
+                except OSError as e:
                     # shutdown race: serve_forever closed this conn
-                    # while the reply was in flight — drop quietly
+                    # while the reply was in flight — drop, but logged
+                    self._log_conn_error(peer, "reply send failed", e)
                     return
                 if msg[0] == "stop":
                     return
@@ -253,6 +345,34 @@ class PSServer:
             with self._conns_lock:
                 self._conns.discard(conn)
             conn.close()
+
+    def _log_conn_error(self, peer, what, exc):
+        from .. import runtime_stats as _rts
+        from ..log import warn_rate_limited
+
+        _rts.inc("kvstore_server_conn_errors")
+        warn_rate_limited(
+            _logger(), "ps-conn:%s" % peer, 30,
+            "dropping parameter-server connection from %s: %s (%s: %s)",
+            peer, what, type(exc).__name__, exc)
+
+    def _fault_tick(self):
+        """Advance the injected-fault clock for one received message;
+        returns 'drop', 'kill', or None (after any injected delay)."""
+        mode, arg = self._fault["mode"], self._fault["arg"]
+        if mode == "delay":
+            time.sleep(arg)
+            return None
+        if mode == "refuse":
+            return None
+        with self._fault_lock:
+            self._fault_msgs += 1
+            n = self._fault_msgs
+        if mode == "drop_after" and arg > 0 and n % arg == 0:
+            return "drop"
+        if mode == "kill_after" and n >= arg:
+            return "kill"
+        return None
 
     def _key_lock(self, key):
         with self._store_lock:
@@ -401,45 +521,147 @@ def run_server(port=None, num_workers=None):
 # ---------------------------------------------------------------- client --
 class PSClient:
     """Worker-side connections to every server shard; key → shard by
-    int_key % num_servers (reference: EncodeDefaultKey)."""
+    int_key % num_servers (reference: EncodeDefaultKey).
+
+    Transient transport errors (connection reset/refused/closed —
+    ps-lite's van resend territory) are retried with bounded
+    exponential backoff and a fresh dial of the failed shard
+    (``MXNET_TPU_KV_RETRIES`` / ``MXNET_TPU_KV_RETRY_BACKOFF``), so a
+    flaky network or a briefly-restarting server no longer kills the
+    worker on the first socket error.  Exhausted retries raise a clear
+    ``MXNetError`` naming the shard.  Caveat (documented, like ps-lite
+    without per-message seq-acks): a request whose reply is lost after
+    the server applied it is re-sent on retry — idempotent for
+    init/pull, and within dist_async's Hogwild staleness model for
+    push; ``barrier``/``stop`` are never retried (a double barrier
+    arrival would desynchronize every subsequent generation), nor is
+    ``command`` (app-level controllers registered via
+    ``set_app_controller`` run arbitrary, possibly non-idempotent
+    code — a replayed "decay lr" must surface as an error, not apply
+    twice).
+    """
+
+    _NON_RETRYABLE_OPS = ("barrier", "stop", "command")
 
     def __init__(self, connect_timeout=60):
-        import time
-
         host, ports = server_addresses()
-        self._socks = []
-        for p in ports:
-            # the launcher Popens servers and workers back-to-back; a
-            # server binds its port only after its (slow) import, so
-            # refused connections are a startup race, not an error —
-            # retry until the deadline
-            deadline = time.monotonic() + connect_timeout
-            while True:
-                try:
-                    s = socket.create_connection((host, p), timeout=300)
-                    break
-                except ConnectionRefusedError:
-                    if time.monotonic() >= deadline:
-                        raise
-                    time.sleep(0.2)
-            # create_connection's timeout is only for the dial; a blocking
-            # protocol op (barrier chains, large pulls, slow server-side
-            # optimizer) may legitimately exceed it, and a mid-protocol
-            # socket.timeout would desynchronize the framed stream
-            s.settimeout(None)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(s)
+        self._addrs = [(host, p) for p in ports]
+        self._max_retries = int(os.environ.get(
+            "MXNET_TPU_KV_RETRIES", "5"))
+        self._backoff = float(os.environ.get(
+            "MXNET_TPU_KV_RETRY_BACKOFF", "0.1"))
+        self._socks = [self._dial(a, connect_timeout)
+                       for a in self._addrs]
         self._lock = threading.Lock()
 
-    def _shard(self, key):
-        return self._socks[key_to_int(key) % len(self._socks)]
+    @staticmethod
+    def _dial(addr, connect_timeout, dial_timeout=300):
+        # the launcher Popens servers and workers back-to-back; a
+        # server binds its port only after its (slow) import, so
+        # refused connections are a startup race, not an error —
+        # retry until the deadline
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                s = socket.create_connection(addr, timeout=dial_timeout)
+                break
+            except ConnectionRefusedError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        # create_connection's timeout is only for the dial; a blocking
+        # protocol op (barrier chains, large pulls, slow server-side
+        # optimizer) may legitimately exceed it, and a mid-protocol
+        # socket.timeout would desynchronize the framed stream
+        s.settimeout(None)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
 
-    def _call(self, sock, msg):
+    def _shard(self, key):
+        """Shard INDEX for a key (indices stay valid across reconnects;
+        socket objects do not)."""
+        return key_to_int(key) % len(self._socks)
+
+    def _reconnect(self, idx):
+        """Redial one shard after a transport error; the (possibly
+        slow) dial happens OUTSIDE the client lock so RPCs to healthy
+        shards keep flowing, and the fresh socket is swapped in under
+        it.  Returns True when a fresh connection is in place (a failed
+        dial leaves the dead socket — the next attempt's send fails
+        fast and retries again)."""
+        from .. import runtime_stats as _rts
+
         with self._lock:
-            _send_msg(sock, msg)
-            reply = _recv_msg(sock)
-        if reply is None:
-            raise ConnectionError("parameter server closed the connection")
+            try:
+                self._socks[idx].close()
+            except OSError:
+                pass
+        try:
+            s = self._dial(self._addrs[idx], connect_timeout=0,
+                           dial_timeout=5)
+        except OSError:
+            return False
+        with self._lock:
+            self._socks[idx] = s
+        _rts.inc("kvstore_reconnects")
+        return True
+
+    def _call(self, target, msg):
+        """One request/response round on a shard.  ``target`` is a
+        shard index (the internal form) or a socket object (accepted
+        for compatibility; resolved to its index when possible)."""
+        from .. import runtime_stats as _rts
+        from ..log import warn_rate_limited
+
+        if isinstance(target, int):
+            idx, sock = target, None
+        else:
+            sock = target
+            with self._lock:
+                try:
+                    idx = self._socks.index(sock)
+                except ValueError:
+                    idx = None
+        retryable = idx is not None and \
+            msg[0] not in self._NON_RETRYABLE_OPS and \
+            self._max_retries > 0
+        attempt = 0
+        while True:
+            try:
+                with self._lock:
+                    s = self._socks[idx] if idx is not None else sock
+                    _send_msg(s, msg)
+                    reply = _recv_msg(s)
+                if reply is None:
+                    raise ConnectionError(
+                        "parameter server closed the connection")
+                break
+            except (ConnectionError, socket.timeout, OSError) as e:
+                if not retryable:
+                    raise
+                if attempt >= self._max_retries:
+                    from ..base import MXNetError
+
+                    raise MXNetError(
+                        "parameter server shard %d (%s:%d) unreachable "
+                        "after %d retries with backoff (%s op, last "
+                        "error %s: %s) — check the server process / "
+                        "network, or raise MXNET_TPU_KV_RETRIES"
+                        % (idx, self._addrs[idx][0], self._addrs[idx][1],
+                           self._max_retries, msg[0],
+                           type(e).__name__, e)) from e
+                delay = min(self._backoff * (2 ** attempt), 2.0)
+                attempt += 1
+                _rts.inc("kvstore_retries")
+                warn_rate_limited(
+                    _logger(), "ps-retry:%d" % idx, 10,
+                    "transient parameter-server error on shard %d "
+                    "(%s:%d): %s: %s — retry %d/%d in %.2fs",
+                    idx, self._addrs[idx][0], self._addrs[idx][1],
+                    type(e).__name__, e, attempt, self._max_retries,
+                    delay)
+                time.sleep(delay)
+                self._reconnect(idx)
         status, payload = reply
         if status != "ok":
             from ..base import MXNetError
@@ -457,22 +679,22 @@ class PSClient:
         return self._call(self._shard(key), ("pull", key))
 
     def set_optimizer(self, blob):
-        for s in self._socks:
-            self._call(s, ("set_optimizer", blob))
+        for i in range(len(self._socks)):
+            self._call(i, ("set_optimizer", blob))
 
     def send_command(self, head, body):
-        for s in self._socks:
-            self._call(s, ("command", head, body))
+        for i in range(len(self._socks)):
+            self._call(i, ("command", head, body))
 
     def barrier(self):
         # every server counts all workers; hitting each keeps shards in step
-        for s in self._socks:
-            self._call(s, ("barrier",))
+        for i in range(len(self._socks)):
+            self._call(i, ("barrier",))
 
     def stop_servers(self):
-        for s in self._socks:
+        for i in range(len(self._socks)):
             try:
-                self._call(s, ("stop",))
+                self._call(i, ("stop",))
             except Exception:
                 pass
 

@@ -1,41 +1,37 @@
 """Checkpoint helpers (reference: python/mxnet/model.py
 save_checkpoint/load_checkpoint; the legacy FeedForward API is covered
 by Module).
+
+Both helpers are thin shims over the checkpoint & recovery subsystem
+(``mxnet_tpu.checkpoint``): saves are atomic (temp + fsync + rename)
+with a sidecar checksum manifest, and loads verify the manifest so a
+torn ``.params`` file raises a clear error instead of silently feeding
+half-written weights into a run (docs/CHECKPOINTING.md).
 """
 
 from __future__ import annotations
 
-from .ndarray import load as nd_load, save as nd_save
-from .symbol import load as sym_load
+from . import checkpoint as _checkpoint
 
 BatchEndParam = None  # kept in module.base_module
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Save symbol JSON + params (reference: model.py save_checkpoint)."""
-    if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd_save(param_name, save_dict)
+    """Save symbol JSON + params (reference: model.py save_checkpoint).
+
+    Shim over :func:`mxnet_tpu.checkpoint.save_legacy` — same file
+    layout as the reference, written atomically with checksums."""
+    _checkpoint.save_legacy(prefix, epoch, symbol, arg_params, aux_params)
 
 
 def load_checkpoint(prefix, epoch):
     """Load (symbol, arg_params, aux_params)
-    (reference: model.py load_checkpoint)."""
-    symbol = sym_load("%s-symbol.json" % prefix)
-    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
-    return (symbol, arg_params, aux_params)
+    (reference: model.py load_checkpoint).
+
+    Shim over :func:`mxnet_tpu.checkpoint.load_legacy` — verifies the
+    sidecar manifest's checksums when present."""
+    return _checkpoint.load_legacy(prefix, epoch)
 
 
 class FeedForward:

@@ -428,8 +428,12 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            # atomic like every persistence path (docs/CHECKPOINTING.md)
+            from ..checkpoint import atomic_write
+
+            with atomic_write(fname) as tmp:
+                with open(tmp, "wb") as fout:
+                    fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

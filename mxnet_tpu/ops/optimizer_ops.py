@@ -29,6 +29,9 @@ def _apply_wd_rescale(weight, grad, rescale_grad, clip_gradient, wd):
 @register("sgd_update", traced_attrs=("lr", "wd"))
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=False, **_):
+    """Plain SGD step ``w' = w - lr * (rescale*clip(g) + wd*w)``
+    (reference: src/operator/optimizer_op.cc sgd_update); lr/wd are
+    traced so per-step schedules never recompile."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     return weight - lr * g
@@ -37,6 +40,9 @@ def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register("sgd_mom_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False, **_):
+    """SGD with momentum: ``m' = momentum*m - lr*g``, ``w' = w + m'``
+    (reference: optimizer_op.cc sgd_mom_update); returns (weight',
+    mom') fused into one XLA kernel."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mom = momentum * mom - lr * g
@@ -46,6 +52,9 @@ def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 @register("nag_mom_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, **_):
+    """Nesterov accelerated gradient: momentum update with the
+    lookahead correction ``w' = w - lr*(g + momentum*m')`` (reference:
+    optimizer_op.cc nag_mom_update); returns (weight', mom')."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mom = momentum * mom + g
@@ -56,6 +65,10 @@ def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gra
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9,
                 beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                 clip_gradient=-1.0, lazy_update=False, **_):
+    """Adam step over first/second-moment state (reference:
+    optimizer_op.cc adam_update; bias correction is folded into ``lr``
+    by the python Optimizer layer, as in the reference); returns
+    (weight', mean', var') as one fused kernel."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mean = beta1 * mean + (1.0 - beta1) * g
@@ -80,6 +93,9 @@ def adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
 @register("rmsprop_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0, **_):
+    """RMSProp (Tieleman & Hinton variant): running squared-gradient
+    cache ``n`` scales the step; optional post-update weight clipping
+    (reference: optimizer_op.cc rmsprop_update); returns (weight', n')."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
@@ -93,6 +109,10 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
 def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, **_):
+    """RMSProp (Graves 2013 centered variant): tracks squared-gradient
+    ``n``, gradient mean ``g``, and momentum ``delta``; the variance
+    estimate is ``n - g^2`` (reference: optimizer_op.cc
+    rmspropalex_update); returns (weight', n', g', delta')."""
     g = _apply_wd_rescale(weight, grad, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
@@ -103,6 +123,9 @@ def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
 
 @register("signsgd_update", traced_attrs=("lr", "wd"))
 def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """SignSGD: step by the SIGN of the gradient only,
+    ``w' = w - lr*(sign(g) + wd*w)`` (reference: optimizer_op.cc
+    signsgd_update, Bernstein et al. 2018)."""
     g = grad * rescale_grad
     if clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -112,6 +135,9 @@ def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradien
 @register("signum_update", num_outputs=2, traced_attrs=("lr", "wd"))
 def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                   clip_gradient=-1.0, wd_lh=0.0, **_):
+    """Signum: momentum-smoothed SignSGD with optional decoupled decay
+    ``wd_lh`` (reference: optimizer_op.cc signum_update); returns
+    (weight', mom')."""
     g = grad * rescale_grad
     if clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -123,6 +149,10 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad
 @register("ftrl_update", num_outputs=3, traced_attrs=("lr", "wd"))
 def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """FTRL-proximal with L1 (``lamda1``) shrinkage over accumulator
+    state ``z, n``: weights snap to exact zero inside the L1 ball
+    (reference: optimizer_op.cc ftrl_update, McMahan et al. 2013);
+    returns (weight', z', n')."""
     g = grad * rescale_grad
     if clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -140,6 +170,10 @@ def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
 @register("ftml_update", num_outputs=3, traced_attrs=("lr", "wd", "t"))
 def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
                 wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1, **_):
+    """FTML (Follow The Moving Leader, Zheng & Kwok 2017) over state
+    ``d, v, z``; the step count ``t`` drives the bias corrections and
+    is traced so steps never recompile (reference: optimizer_op.cc
+    ftml_update); returns (weight', d', v', z')."""
     g = grad * rescale_grad + wd * weight
     if clip_grad >= 0:
         g = jnp.clip(g, -clip_grad, clip_grad)
@@ -213,6 +247,10 @@ def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
 @register("mp_sgd_mom_update", num_outputs=3, traced_attrs=("lr", "wd"))
 def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Multi-precision momentum SGD: the update runs on fp32 master
+    weights and momentum, then casts back to the model dtype
+    (reference: optimizer_op.cc mp_sgd_mom_update); returns (weight',
+    mom', weight32')."""
     g = _apply_wd_rescale(weight32, grad.astype(jnp.float32), rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
     new_mom = momentum * mom - lr * g
@@ -230,6 +268,10 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0
 @register("_sparse_sgd_update", traced_attrs=("lr", "wd"))
 def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
                       rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Lazy row_sparse SGD: only the rows named by ``grad_idx`` are
+    gathered, updated, and scattered back — one fused XLA
+    gather/update/scatter with bandwidth proportional to the touched
+    rows (reference: optimizer_op.cc SGDUpdateRowSparse)."""
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
@@ -240,6 +282,10 @@ def sparse_sgd_update(weight, grad_val, grad_idx, lr=0.01, wd=0.0,
 def sparse_sgd_mom_update(weight, grad_val, grad_idx, mom, lr=0.01,
                           momentum=0.0, wd=0.0, rescale_grad=1.0,
                           clip_gradient=-1.0, **_):
+    """Lazy row_sparse momentum SGD: weight AND momentum state rows are
+    touched only where the gradient has rows (reference:
+    optimizer_op.cc sgd_mom_update row_sparse path); returns (weight',
+    mom')."""
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)
@@ -267,6 +313,9 @@ def sparse_adagrad_update(weight, grad_val, grad_idx, history, lr=0.01,
 def sparse_adam_update(weight, grad_val, grad_idx, mean, var,
                        lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Lazy row_sparse Adam: first/second-moment rows decay and update
+    only where the gradient has rows (reference: optimizer_op.cc
+    AdamUpdateEx lazy path); returns (weight', mean', var')."""
     rows = weight[grad_idx]
     g = _apply_wd_rescale(rows, grad_val, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None, wd)

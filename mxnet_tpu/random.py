@@ -18,7 +18,7 @@ import threading
 
 import numpy as _np
 
-__all__ = ["seed", "next_key", "TraceRNG", "get_state"]
+__all__ = ["seed", "next_key", "TraceRNG", "get_state", "set_state"]
 
 _state = threading.local()
 
@@ -79,3 +79,13 @@ def next_key():
 
 def get_state():
     return dict(_global())
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot — seed AND key counter — so
+    a checkpoint-resumed run continues the exact key chain an
+    uninterrupted run would have used (``mxnet_tpu.checkpoint`` stores
+    this in every manifest)."""
+    g = _global()
+    g["seed"] = int(state["seed"])
+    g["counter"] = int(state.get("counter", 0))

@@ -334,13 +334,15 @@ def snapshot():
     # this module at their tops), and the iteration never runs on
     # dispatch.  health.snapshot() never syncs — pending device stats
     # are reported as a count.
+    from . import checkpoint as _checkpoint
     from . import health as _health
     from .ops import registry as _registry
 
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
             "storms": storms, "memory": device_memory.snapshot(),
             "costs": _registry.cost_snapshot(),
-            "health": _health.snapshot()}
+            "health": _health.snapshot(),
+            "checkpoint": _checkpoint.snapshot()}
 
 
 def roofline(snap=None, top=None):
@@ -512,6 +514,16 @@ def _render_health(health):
                      "inf)" % (fn.get("step", -1), fn.get("key"),
                                int(fn.get("nan_total", 0)),
                                int(fn.get("inf_total", 0))))
+    ckpt = health.get("checkpoint")
+    if ckpt:
+        if ckpt.get("last_good_path"):
+            lines.append("RESUME FROM: %s (step %s) — "
+                         "checkpoint.auto_resume() restores params/"
+                         "optimizer/RNG/step in one call"
+                         % (ckpt["last_good_path"], ckpt.get("step")))
+        else:
+            lines.append("Checkpointing on (%s) but no checkpoint "
+                         "committed yet" % ckpt.get("directory"))
     flight = health.get("flight") or []
     lines.append("Flight recorder (%d record(s), newest last)"
                  % len(flight))

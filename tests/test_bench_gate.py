@@ -276,6 +276,39 @@ def test_disabled_health_observe_overhead_bound():
         "disabled observe must record nothing"
 
 
+def test_disabled_checkpoint_step_overhead_bound():
+    """PR 6 gate: the checkpoint layer must be pay-for-use.  With the
+    manager disabled (the default), the ``checkpoint.on_step`` hook
+    ``gluon.Trainer.step`` calls every step is ONE dict read: no
+    manager, no capture, no thread, no counter.  Pinned like the
+    health/telemetry bounds above."""
+    import time
+
+    from mxnet_tpu import checkpoint, runtime_stats
+
+    assert not checkpoint.is_enabled()
+    assert checkpoint._GLOBAL == []
+    base_saves = runtime_stats.snapshot()["counters"].get(
+        "checkpoint_saves", 0)
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            checkpoint.on_step(None)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+    # the guard is a module attr + dict read (~0.1us); 10us tolerates
+    # slow shared CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "checkpoint.on_step with manager off took %.2fus" % (best * 1e6)
+    assert checkpoint._GLOBAL == [], \
+        "disabled on_step must not create a manager"
+    assert runtime_stats.snapshot()["counters"].get(
+        "checkpoint_saves", 0) == base_saves, \
+        "disabled on_step must record nothing"
+
+
 def test_probe_relay_ping_short_circuits(monkeypatch):
     """A healthy relay answers the cheap liveness ping: ONE probe child,
     no full-timeout probes."""

@@ -108,3 +108,445 @@ def test_trainer_states_roundtrip(tmp_path):
     # continuation, not a restart
     assert tr2._updaters[0].optimizer._index_update_count == \
         tr._updaters[0].optimizer._index_update_count
+
+
+# ---------------------------------------------------------------------------
+# PR 6 (robustness): CheckpointManager — atomic async checkpointing,
+# corruption fallback, retention, and crash-consistent auto-resume
+# (docs/CHECKPOINTING.md).
+
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mxnet_tpu import checkpoint
+
+
+def _net_and_trainer(optimizer="sgd", opt_args=None, prefix="ck_"):
+    net = gluon.nn.Dense(3, prefix=prefix)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    tr = gluon.Trainer(net.collect_params(), optimizer,
+                       opt_args or {"learning_rate": 0.1,
+                                    "momentum": 0.9})
+    return net, tr
+
+
+def _train_steps(net, tr, X, lo, hi):
+    for i in range(lo, hi):
+        x = mx.nd.array(X[i])
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(X.shape[1])
+
+
+def test_manager_roundtrip_bit_exact(tmp_path):
+    """Params, momentum state, optimizer counters, RNG, and step all
+    round-trip BIT-exact through a manager checkpoint."""
+    X = np.random.RandomState(11).rand(6, 8, 5).astype(np.float32)
+    net, tr = _net_and_trainer()
+    _train_steps(net, tr, X, 0, 3)
+    mx.random.seed(123)
+    rng_before = dict(mx.random.get_state())
+
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep=3,
+                                       async_write=False)
+    mgr.save_trainer(tr, step=3)
+    saved_w = {p.name: p.data().asnumpy().copy() for p in tr._params}
+    saved_mom = {k: (v.asnumpy().copy() if v is not None else None)
+                 for k, v in tr._updaters[0].states.items()}
+    saved_iuc = dict(tr._updaters[0].optimizer._index_update_count)
+
+    # diverge: more steps + RNG advance, then restore
+    _train_steps(net, tr, X, 3, 6)
+    mx.random.next_key()
+    manifest = mgr.restore(trainer=tr)
+    assert manifest["step"] == 3
+    assert mgr.step_clock == 3
+    for p in tr._params:
+        assert np.array_equal(p.data().asnumpy(), saved_w[p.name])
+    for k, v in tr._updaters[0].states.items():
+        if v is None:
+            assert saved_mom[k] is None
+        else:
+            assert np.array_equal(v.asnumpy(), saved_mom[k])
+    assert dict(tr._updaters[0].optimizer._index_update_count) == \
+        saved_iuc
+    assert dict(mx.random.get_state()) == rng_before
+    # lineage in the manifest records the previous commit chain
+    assert manifest["lineage"]["previous"] is None
+
+
+def test_manager_restore_into_fresh_objects(tmp_path):
+    X = np.random.RandomState(12).rand(4, 8, 5).astype(np.float32)
+    net, tr = _net_and_trainer(prefix="fr_")
+    _train_steps(net, tr, X, 0, 4)
+    mgr = checkpoint.CheckpointManager(str(tmp_path),
+                                       async_write=False)
+    mgr.save_trainer(tr, step=4)
+
+    net2, tr2 = _net_and_trainer(prefix="fr_")
+    _ = net2(mx.nd.array(X[0]))  # realize params
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path),
+                                        async_write=False)
+    manifest = mgr2.restore(trainer=tr2)
+    assert manifest["step"] == 4
+    for p, q in zip(tr._params, tr2._params):
+        assert np.array_equal(p.data().asnumpy(), q.data().asnumpy())
+    # continued training matches: one more identical step on both
+    _train_steps(net, tr, X, 0, 1)
+    _train_steps(net2, tr2, X, 0, 1)
+    for p, q in zip(tr._params, tr2._params):
+        assert np.array_equal(p.data().asnumpy(), q.data().asnumpy())
+
+
+def test_keep_last_n_retention(tmp_path):
+    net, tr = _net_and_trainer(prefix="rt_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep=2,
+                                       async_write=False)
+    for s in range(1, 6):
+        mgr.save_trainer(tr, step=s)
+    dirs = sorted(d for d in os.listdir(str(tmp_path))
+                  if d.startswith("ckpt-"))
+    assert dirs == ["ckpt-00000004", "ckpt-00000005"]
+    assert mgr.latest()["step"] == 5
+
+
+def test_corrupt_checkpoint_skipped_with_fallback(tmp_path):
+    """A bit-flipped params file fails its manifest checksum: latest()
+    skips it and falls back to the previous valid checkpoint."""
+    net, tr = _net_and_trainer(prefix="co_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep=5,
+                                       async_write=False)
+    mgr.save_trainer(tr, step=1)
+    mgr.save_trainer(tr, step=2)
+    ppath = tmp_path / "ckpt-00000002" / "params.npz"
+    blob = bytearray(ppath.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    ppath.write_bytes(bytes(blob))  # same size, different content
+
+    before = mgr.totals["corrupt_skipped"]
+    m = mgr.latest()
+    assert m["step"] == 1
+    assert mgr.totals["corrupt_skipped"] > before
+    # and restore() lands on the fallback
+    assert mgr.restore(trainer=tr)["step"] == 1
+
+
+def test_torn_checkpoint_without_manifest_skipped(tmp_path):
+    net, tr = _net_and_trainer(prefix="to_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path),
+                                       async_write=False)
+    mgr.save_trainer(tr, step=1)
+    torn = tmp_path / "ckpt-00000009"
+    torn.mkdir()
+    (torn / "params.npz").write_bytes(b"half a file")
+    assert mgr.latest()["step"] == 1
+
+
+def test_async_save_does_not_block_and_coalesces(tmp_path):
+    """The training thread returns immediately from save_trainer();
+    back-to-back saves while the writer is busy coalesce to the newest
+    snapshot."""
+    import threading as _threading
+
+    net, tr = _net_and_trainer(prefix="as_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep=10,
+                                       async_write=True)
+    gate = _threading.Event()
+    orig_write = mgr._write
+
+    def slow_write(snapshot):
+        gate.wait(30)
+        return orig_write(snapshot)
+
+    mgr._write = slow_write
+    t0 = time.perf_counter()
+    for s in range(1, 6):
+        mgr.save_trainer(tr, step=s)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, "save_trainer blocked on the writer"
+    assert mgr.latest() is None  # nothing committed while gated
+    gate.set()
+    assert mgr.wait(30)
+    assert mgr.latest()["step"] == 5  # newest snapshot won
+    assert mgr.totals["coalesced"] >= 1
+    assert mgr.totals["written"] < mgr.totals["saves"]
+    mgr.close()
+
+
+def test_trainer_auto_checkpoint_hook(tmp_path):
+    """checkpoint.enable() + Trainer.step auto-saves at interval
+    boundaries and lineage() names the last committed checkpoint."""
+    X = np.random.RandomState(13).rand(4, 8, 5).astype(np.float32)
+    try:
+        mgr = checkpoint.enable(str(tmp_path), interval=2,
+                                async_write=False)
+        net, tr = _net_and_trainer(prefix="au_")
+        _train_steps(net, tr, X, 0, 4)
+        assert mgr.totals["written"] == 2
+        assert mgr.latest()["step"] == 4
+        lin = checkpoint.lineage()
+        assert lin["step"] == 4
+        assert lin["last_good_path"].endswith("ckpt-00000004")
+        # one-call resume into fresh objects
+        net2, tr2 = _net_and_trainer(prefix="au_")
+        _ = net2(mx.nd.array(X[0]))
+        assert checkpoint.auto_resume(trainer=tr2) == 4
+        for p, q in zip(tr._params, tr2._params):
+            assert np.array_equal(p.data().asnumpy(),
+                                  q.data().asnumpy())
+    finally:
+        checkpoint.reset()
+
+
+def test_health_flight_dump_records_lineage(tmp_path):
+    """Satellite: the health snapshot (and therefore the flight dump
+    diagnose.py renders) carries the last-good checkpoint so the
+    operator knows where to resume from."""
+    from mxnet_tpu import health, runtime_stats
+
+    X = np.random.RandomState(14).rand(2, 8, 5).astype(np.float32)
+    try:
+        checkpoint.enable(str(tmp_path), interval=1, async_write=False)
+        health.enable(interval=1)
+        net, tr = _net_and_trainer(prefix="hl_")
+        _train_steps(net, tr, X, 0, 2)
+        snap = health.snapshot()
+        assert snap["checkpoint"]["last_good_path"].endswith(
+            "ckpt-00000002")
+        rendered = "\n".join(runtime_stats._render_health(snap))
+        assert "RESUME FROM" in rendered
+        assert "ckpt-00000002" in rendered
+    finally:
+        health.reset()
+        checkpoint.reset()
+
+
+def test_trainer_states_versioned_and_atomic(tmp_path):
+    """Satellite: save_states writes the version header atomically;
+    legacy headerless files still load."""
+    rng = np.random.RandomState(3)
+    net, tr = _net_and_trainer("adam", {"learning_rate": 0.01},
+                               prefix="vs_")
+    x = mx.nd.array(rng.rand(8, 5).astype(np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(8)
+    path = str(tmp_path / "t.states")
+    tr.save_states(path)
+    with open(path, "rb") as f:
+        head = f.read(len(checkpoint.TRAINER_STATES_MAGIC))
+    assert head == checkpoint.TRAINER_STATES_MAGIC
+    assert not [n for n in os.listdir(str(tmp_path)) if ".tmp" in n]
+
+    net2, tr2 = _net_and_trainer("adam", {"learning_rate": 0.01},
+                                 prefix="vs_")
+    _ = net2(x)
+    tr2.load_states(path)
+    assert tr2._updaters[0].optimizer._index_update_count == \
+        tr._updaters[0].optimizer._index_update_count
+
+    # legacy format: a plain pickle of the get_states blob
+    legacy = str(tmp_path / "legacy.states")
+    with open(legacy, "wb") as f:
+        pickle.dump(tr._updaters[0].get_states(dump_optimizer=True), f)
+    tr2.load_states(legacy)
+    assert tr2._updaters[0].optimizer._index_update_count == \
+        tr._updaters[0].optimizer._index_update_count
+
+
+def test_legacy_checkpoint_checksum_detects_corruption(tmp_path):
+    """Satellite: model.save_checkpoint now writes a sidecar manifest;
+    a torn/corrupt params file raises a clear error on load."""
+    from mxnet_tpu.base import MXNetError
+
+    prefix = str(tmp_path / "m")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(),
+                        label_names=("softmax_label",))
+    x = np.random.RandomState(0).rand(16, 10).astype(np.float32)
+    y = np.zeros(16, np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    mod.save_checkpoint(prefix, 1)
+    assert os.path.exists(prefix + "-0001.manifest.json")
+    mx.model.load_checkpoint(prefix, 1)  # intact: loads fine
+
+    ppath = prefix + "-0001.params"
+    blob = bytearray(open(ppath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(ppath, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(MXNetError, match="checksum"):
+        mx.model.load_checkpoint(prefix, 1)
+
+
+_CRASH_CHILD = r"""
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, checkpoint
+
+ckdir, mode, marker = sys.argv[1], sys.argv[2], sys.argv[3]
+TOTAL, CKPT_AT = 20, 10
+X = np.random.RandomState(5).rand(TOTAL, 8, 5).astype(np.float32)
+
+net = gluon.nn.Dense(3, prefix="cr_")
+net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+               ctx=mx.cpu())
+# deterministic init across processes: overwrite with fixed values
+winit = np.random.RandomState(9).rand(3, 5).astype(np.float32)
+binit = np.zeros(3, np.float32)
+_ = net(mx.nd.array(X[0]))
+net.weight.set_data(mx.nd.array(winit))
+net.bias.set_data(mx.nd.array(binit))
+tr = gluon.Trainer(net.collect_params(), "sgd",
+                   {"learning_rate": 0.1, "momentum": 0.9})
+
+def steps(lo, hi):
+    for i in range(lo, hi):
+        x = mx.nd.array(X[i])
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(8)
+
+mgr = checkpoint.CheckpointManager(ckdir, keep=5, async_write=False)
+if mode == "full":
+    steps(0, TOTAL)
+    np.savez(marker, **{p.name: p.data().asnumpy()
+                        for p in tr._params})
+elif mode == "kill":
+    steps(0, CKPT_AT)
+    mgr.save_trainer(tr, step=CKPT_AT)          # valid checkpoint
+    steps(CKPT_AT, CKPT_AT + 1)
+    # arm a stall inside the NEXT checkpoint's write, after the params
+    # file hits disk but before the manifest commit, then wait for the
+    # parent's SIGKILL
+    real_sha = checkpoint._sha256
+    def stalling_sha(path, chunk=1 << 20):
+        with open(marker, "w") as f:
+            f.write("mid-write")
+        time.sleep(300)
+        return real_sha(path, chunk)
+    checkpoint._sha256 = stalling_sha
+    mgr.save_trainer(tr, step=CKPT_AT + 1)      # never completes
+elif mode == "resume":
+    resumed = checkpoint.auto_resume  # noqa: F841 (doc pointer)
+    m = mgr.restore(trainer=tr)
+    assert m is not None, "no valid checkpoint found"
+    assert m["step"] == CKPT_AT, "resumed wrong step: %r" % (m,)
+    steps(m["step"], TOTAL)
+    np.savez(marker, **{p.name: p.data().asnumpy()
+                        for p in tr._params})
+"""
+
+
+def test_sigkill_mid_checkpoint_then_bitexact_resume(tmp_path):
+    """Acceptance (b): SIGKILL a child mid-checkpoint-write; latest()
+    skips the torn checkpoint and auto-resume restores the previous
+    valid one; a resumed 20-step Gluon loop matches an uninterrupted
+    run bit-exact (params after step 20 identical byte-for-byte)."""
+    script = tmp_path / "crash_child.py"
+    script.write_text(_CRASH_CHILD)
+    ckdir = tmp_path / "ckpts"
+    ckdir.mkdir()
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root
+
+    def run(mode, marker, wait=True):
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(ckdir), mode,
+             str(marker)],
+            cwd=repo_root, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        if wait:
+            out, _ = proc.communicate(timeout=240)
+            assert proc.returncode == 0, out.decode()
+        return proc
+
+    # uninterrupted run
+    full_npz = tmp_path / "full.npz"
+    run("full", full_npz)
+
+    # run that gets SIGKILLed mid-checkpoint-write at step 11
+    marker = tmp_path / "mid_write_marker"
+    proc = run("kill", marker, wait=False)
+    deadline = time.monotonic() + 240
+    while not marker.exists():
+        assert proc.poll() is None, \
+            proc.stdout.read().decode()
+        assert time.monotonic() < deadline, "child never reached stall"
+        time.sleep(0.1)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=60)
+
+    # on disk: one valid checkpoint (step 10) + the torn step-11 write
+    names = os.listdir(str(ckdir))
+    assert any(".tmp-" in n for n in names), names
+    mgr = checkpoint.CheckpointManager(str(ckdir))
+    # (constructing the manager pruned the stale tmp dir)
+    assert not any(".tmp-" in n for n in os.listdir(str(ckdir)))
+    assert mgr.latest()["step"] == 10
+
+    # resumed run: restores step 10 and finishes 11..20
+    resume_npz = tmp_path / "resume.npz"
+    run("resume", resume_npz)
+
+    with np.load(str(full_npz)) as a, np.load(str(resume_npz)) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for k in a.files:
+            assert np.array_equal(a[k], b[k]), \
+                "param %s diverged after resume" % k
+
+
+def test_retired_checkpoint_recovered_after_crash(tmp_path):
+    """A same-step overwrite moves the old committed dir aside before
+    the new one lands; if the process dies in that window, manager init
+    must restore the aside copy — it is the only surviving copy."""
+    net, tr = _net_and_trainer(prefix="re_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path),
+                                       async_write=False)
+    mgr.save_trainer(tr, step=3)
+    # simulate the crash window: final renamed aside, replacement gone
+    os.rename(str(tmp_path / "ckpt-00000003"),
+              str(tmp_path / "ckpt-00000003.retire-999-1"))
+    mgr2 = checkpoint.CheckpointManager(str(tmp_path))
+    assert (tmp_path / "ckpt-00000003").is_dir()
+    assert not (tmp_path / "ckpt-00000003.retire-999-1").exists()
+    assert mgr2.latest()["step"] == 3
+
+
+def test_quarantined_checkpoints_bounded(tmp_path):
+    """Repeated corruption cannot grow disk use without bound: _prune
+    keeps at most ``keep`` quarantined dirs."""
+    net, tr = _net_and_trainer(prefix="qb_")
+    _ = net(mx.nd.ones((2, 5)))
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep=2,
+                                       async_write=False)
+    mgr.save_trainer(tr, step=1)
+    for s in range(2, 8):
+        mgr.save_trainer(tr, step=s)
+        ppath = tmp_path / ("ckpt-%08d" % s) / "params.npz"
+        blob = bytearray(ppath.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        ppath.write_bytes(bytes(blob))
+        assert mgr.latest()["step"] == 1  # corrupt one quarantined
+    mgr.save_trainer(tr, step=8)  # commit triggers _prune
+    quarantined = [n for n in os.listdir(str(tmp_path))
+                   if ".corrupt-" in n]
+    assert len(quarantined) <= 2

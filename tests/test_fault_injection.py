@@ -1,0 +1,240 @@
+"""Dist-kvstore fault injection (kvstore/ps.py, PR 6 robustness):
+``MXNET_TPU_FAULT`` makes the failure modes a real cluster produces
+nondeterministically — dropped/delayed/refused connections, a parameter
+server dying mid-push — reproducible, and the worker-side
+retry-with-backoff (``PSClient._call``) is asserted to carry a run
+through them with exact values.
+
+Reference analog: ps-lite's van resend/heartbeat machinery
+(kvstore_dist.h); here the contract is bounded exponential backoff +
+reconnect with a clear error once exhausted (docs/CHECKPOINTING.md
+"Fault injection").
+"""
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore.ps import (PSClient, PSServer, key_to_int,
+                                  parse_fault_spec)
+
+
+def _optimizer_blob(lr=1.0):
+    from mxnet_tpu import optimizer as opt
+
+    return pickle.dumps(opt.SGD(learning_rate=lr),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _counter(name):
+    from mxnet_tpu import runtime_stats
+
+    return runtime_stats.snapshot()["counters"].get(name, 0)
+
+
+def _start_server(monkeypatch, fault=None, port=0, retries="40",
+                  backoff="0.02"):
+    if fault is None:
+        monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_TPU_FAULT", fault)
+    srv = PSServer(port=port, num_workers=1)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
+    monkeypatch.setenv("MXNET_TPU_KV_RETRIES", retries)
+    monkeypatch.setenv("MXNET_TPU_KV_RETRY_BACKOFF", backoff)
+    return srv, t
+
+
+def test_parse_fault_spec():
+    assert parse_fault_spec("") is None
+    assert parse_fault_spec(None) is None
+    assert parse_fault_spec("drop_after:3") == {"mode": "drop_after",
+                                                "arg": 3}
+    assert parse_fault_spec("delay:0.25") == {"mode": "delay",
+                                              "arg": 0.25}
+    with pytest.raises(ValueError, match="unknown MXNET_TPU_FAULT"):
+        parse_fault_spec("explode:1")
+
+
+def test_drop_connections_retry_completes_exact(monkeypatch):
+    """Acceptance (a), transient-drop flavor: the server closes the
+    worker connection instead of handling every 3rd message; the worker
+    retries with backoff and the run completes with EXACT values —
+    faults fire before handling, so a retried push applies exactly
+    once."""
+    srv, t = _start_server(monkeypatch, fault="drop_after:3")
+    try:
+        retries_before = _counter("kvstore_retries")
+        c = PSClient(connect_timeout=10)
+        c.set_optimizer(_optimizer_blob(lr=1.0))
+        c.init("w", np.zeros((4,), np.float32))
+        for _ in range(10):
+            c.push("w", np.ones((4,), np.float32))
+        out = c.pull("w")
+        # SGD lr=1: every push subtracts exactly one gradient
+        np.testing.assert_array_equal(out, np.full((4,), -10.0,
+                                                   np.float32))
+        assert _counter("kvstore_retries") > retries_before
+        assert _counter("kvstore_reconnects") > 0
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_delay_mode_slows_but_completes(monkeypatch):
+    srv, t = _start_server(monkeypatch, fault="delay:0.05")
+    try:
+        c = PSClient(connect_timeout=10)
+        t0 = time.monotonic()
+        c.init("w", np.ones((2,), np.float32))
+        out = c.pull("w")
+        assert time.monotonic() - t0 >= 0.1  # two messages, 50ms each
+        np.testing.assert_array_equal(out, np.ones((2,), np.float32))
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_refused_connections_reconnect(monkeypatch):
+    """refuse:N closes the first N accepted connections immediately —
+    the client's first protocol round dies, reconnects, and succeeds."""
+    srv, t = _start_server(monkeypatch, fault="refuse:2")
+    try:
+        before = _counter("kvstore_reconnects")
+        c = PSClient(connect_timeout=10)
+        c.init("w", np.full((3,), 7.0, np.float32))
+        out = c.pull("w")
+        np.testing.assert_array_equal(out, np.full((3,), 7.0,
+                                                   np.float32))
+        assert _counter("kvstore_reconnects") > before
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_kill_server_mid_push_retries_until_back(monkeypatch):
+    """Acceptance (a), kill flavor: the server dies upon receiving the
+    4th message (the 2nd push, BEFORE applying it); the worker's
+    retry-with-backoff rides out the outage, a replacement server with
+    restored state comes up on the same port, and the run completes
+    with exact values."""
+    srv, t = _start_server(monkeypatch, fault="kill_after:4")
+    port = srv.port
+    srv2_holder = []
+
+    def _revive():
+        t.join(timeout=30)
+        # replacement server: state restored (what the checkpoint layer
+        # provides for real runs), fault injection off
+        os.environ.pop("MXNET_TPU_FAULT", None)
+        from mxnet_tpu import optimizer as opt
+
+        srv2 = PSServer(port=port, num_workers=1)
+        srv2._store = {k: v.copy() for k, v in srv._store.items()}
+        srv2._updater = opt.get_updater(opt.SGD(learning_rate=1.0))
+        srv2_holder.append(srv2)
+        srv2.serve_forever()
+
+    reviver = threading.Thread(target=_revive, daemon=True)
+    reviver.start()
+    try:
+        c = PSClient(connect_timeout=10)
+        c.set_optimizer(_optimizer_blob(lr=1.0))        # msg 1
+        c.init("w", np.zeros((2,), np.float32))         # msg 2
+        for _ in range(5):                              # msgs 3..7
+            c.push("w", np.ones((2,), np.float32))
+        out = c.pull("w")
+        # the kill fires before the 2nd push is applied; its retry
+        # applies it exactly once on the revived server: 5 pushes total
+        np.testing.assert_array_equal(out, np.full((2,), -5.0,
+                                                   np.float32))
+        c.close()
+    finally:
+        srv._stop.set()
+        if srv2_holder:
+            srv2_holder[0]._stop.set()
+
+
+def test_retries_exhausted_is_clear_error(monkeypatch):
+    srv, t = _start_server(monkeypatch, retries="2", backoff="0.01")
+    c = PSClient(connect_timeout=10)
+    c.init("w", np.zeros((2,), np.float32))
+    srv._stop.set()
+    srv._sock.close()
+    t.join(timeout=10)
+    with pytest.raises(MXNetError, match="unreachable after 2 retries"):
+        for _ in range(50):
+            c.pull("w")
+            time.sleep(0.02)
+    c.close()
+
+
+def test_barrier_is_never_retried(monkeypatch):
+    """A retried barrier would double-count this worker's arrival and
+    desynchronize every later generation — after the server goes away a
+    barrier must fail fast, not retry."""
+    srv, t = _start_server(monkeypatch)
+    c = PSClient(connect_timeout=10)
+    c.barrier()  # healthy round
+    srv._stop.set()
+    srv._sock.close()
+    t.join(timeout=10)
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionError, OSError)):
+        for _ in range(50):
+            c.barrier()
+            time.sleep(0.02)
+    assert time.monotonic() - t0 < 5  # no backoff ladder ran
+    c.close()
+
+
+def test_server_logs_undecodable_frames(monkeypatch):
+    """Satellite: per-connection decode errors are logged (rate-limited,
+    with the peer address) and counted — not silently swallowed."""
+    import logging
+
+    records = []
+
+    class _Catcher(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("mxnet_tpu.kvstore.ps")
+    catcher = _Catcher(level=logging.WARNING)
+    logger.addHandler(catcher)
+    srv, t = _start_server(monkeypatch)
+    try:
+        before = _counter("kvstore_server_conn_errors")
+        from mxnet_tpu.log import reset_rate_limits
+
+        reset_rate_limits("ps-conn:")
+        s = socket.create_connection(("127.0.0.1", srv.port),
+                                     timeout=10)
+        payload = b"not a pickle"
+        s.sendall(struct.pack(">Q", len(payload)) + payload)
+        deadline = time.monotonic() + 10
+        while _counter("kvstore_server_conn_errors") == before:
+            assert time.monotonic() < deadline, \
+                "conn-error counter never moved"
+            time.sleep(0.05)
+        s.close()
+        assert any("dropping parameter-server connection from 127.0.0.1"
+                   in r.getMessage() for r in records)
+        # server still serves honest clients
+        c = PSClient(connect_timeout=10)
+        c.init("ok", np.zeros((1,), np.float32))
+        assert c.pull("ok").shape == (1,)
+        c.close()
+    finally:
+        logger.removeHandler(catcher)
+        srv._stop.set()
