@@ -72,6 +72,7 @@ import shutil
 import threading
 import time
 
+from . import histogram as _histogram
 from . import runtime_stats as _rts
 from .log import get_logger, warn_rate_limited
 
@@ -496,7 +497,13 @@ class CheckpointManager:
         self.last_good = {"path": final, "step": step}
         self.totals["written"] += 1
         _rts.inc("checkpoint_writes")
-        _rts.inc("checkpoint_write_seconds", time.perf_counter() - t0)
+        write_seconds = time.perf_counter() - t0
+        _rts.inc("checkpoint_write_seconds", write_seconds)
+        if _histogram._state["on"]:
+            # full commit wall-time (materialize + hash + fsync +
+            # rename) — the tail of this distribution is what decides
+            # whether async saves coalesce under a given interval
+            _histogram.observe("checkpoint:write", write_seconds)
         self._prune()
         return final
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from .. import checkpoint as _ckpt
 from .. import device_memory as _dm
 from .. import health as _health
+from .. import histogram as _histogram
 from .. import kvstore as _kvstore
 from .. import optimizer as _optimizer
 from .. import profiler as _profiler
@@ -144,11 +145,19 @@ class Trainer:
         before propagating.  Disabled: one dict read."""
         _rts.inc("trainer_steps")
         hm = _health.monitor() if _health._state["on"] else None
+        # step wall-time distribution (guard-first): the per-rank
+        # series the cluster report compares to quantify step-time skew
+        hist_on = _histogram._state["on"]
+        if hist_on:
+            t0 = _profiler._now_us()
         try:
             with _profiler.span("trainer:step", "trainer",
                                 args={"batch_size": batch_size}
                                 if _profiler._state["running"] else None):
                 self._step(batch_size, ignore_stale_grad, hm)
+            if hist_on:
+                _histogram.observe("trainer:step",
+                                   (_profiler._now_us() - t0) / 1e6)
         except Exception:
             if hm is not None:
                 # the ring holds the steps leading up to the crash —

@@ -22,6 +22,9 @@ from collections import namedtuple
 
 import numpy as _np
 
+from time import perf_counter as _perf_counter
+
+from .. import histogram as _histogram
 from .. import profiler as _profiler
 from .. import runtime_stats as _rts
 from ..base import MXNetError
@@ -79,11 +82,19 @@ class DataIter:
     def __next__(self):
         # the for-batch-in-iter hot loop: span shows host-side batch
         # assembly time in the step anatomy (guard-first: args dict is
-        # only built while recording, so the off path allocates nothing)
+        # only built while recording, so the off path allocates nothing;
+        # the latency histogram takes timestamps only when collecting —
+        # input-wait distributions are what the cluster report compares
+        # across ranks to spot a starving worker)
+        hist_on = _histogram._state["on"]
+        if hist_on:
+            t0 = _perf_counter()
         with _profiler.span("io:next_batch", "io",
                             args={"iter": self.__class__.__name__}
                             if _profiler._state["running"] else None):
             batch = self.next()
+        if hist_on:
+            _histogram.observe("io:next_batch", _perf_counter() - t0)
         _rts.inc("io_batches")
         return batch
 

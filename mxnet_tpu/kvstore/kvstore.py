@@ -24,6 +24,7 @@ so Module/Trainer code written against the reference runs unchanged:
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 
@@ -361,6 +362,28 @@ class DistAsyncKVStore(KVStore):
                 "dist_async needs parameter-server processes — start the "
                 "job with `tools/launch.py -n <workers> -s <servers>` "
                 "(%s)" % e)
+        # diag-push cadence: MXNET_TPU_DIAG_PUSH=N>1 parks this rank's
+        # diag snapshot on shard 0 every N pushes (N=1: on dump only)
+        try:
+            self._diag_push_every = int(
+                os.environ.get("MXNET_TPU_DIAG_PUSH", "0") or 0)
+        except ValueError:
+            self._diag_push_every = 0
+        self._diag_push_count = 0
+        # register as the server-command channel (profiler forwarding,
+        # diag push on dump) — the reference needs an explicit
+        # set_kvstore_handle call; the TPU-native form self-registers
+        # since a process has at most one dist store
+        _profiler.set_kvstore_handle(self)
+        if os.environ.get("MXNET_TPU_PROFILE") or \
+                _profiler._state["running"]:
+            # profiled run: estimate the worker→server clock offset now
+            # so this rank's chrome trace can be merged onto the
+            # cluster timeline (profiler.merge_traces)
+            try:
+                self.estimate_clock_offset()
+            except Exception:
+                pass  # telemetry must never block store construction
 
     @property
     def rank(self):
@@ -394,6 +417,13 @@ class DistAsyncKVStore(KVStore):
             if self._compression is not None:
                 merged = self._compression.compress_decompress(k, merged)
             self._client.push(k, merged.asnumpy())
+        if self._diag_push_every > 1:
+            self._diag_push_count += 1
+            if self._diag_push_count % self._diag_push_every == 0:
+                try:
+                    self.push_diag()
+                except Exception:
+                    pass  # interval telemetry must never fail a push
 
     def _pull_impl(self, key, out, priority, ignore_sparse):
         if self._client is None:
@@ -468,6 +498,60 @@ class DistAsyncKVStore(KVStore):
         finalize)."""
         if self._client is not None:
             self._client.stop_servers()
+        # deregister the server-command channel: an atexit diag dump
+        # after shutdown must not try to push through a stopped store
+        if _profiler._kvstore_handle is self:
+            _profiler.set_kvstore_handle(None)
+
+    # --------------------------------------------- distributed telemetry
+    def server_stats(self):
+        """Every PS shard's server-side metrics (per-key bytes in/out,
+        per-peer request counts, apply/handle latency histograms, queue
+        depth, accepted connections) — the ``stats`` command
+        (docs/OBSERVABILITY.md "Distributed telemetry").  Empty list on
+        a degraded in-process store."""
+        if self._client is None:
+            return []
+        return self._client.server_stats()
+
+    def push_diag(self, top=20):
+        """Park this rank's ``runtime_stats.diag_snapshot()`` on PS
+        shard 0 (``diag_put``) so the operator can pull every rank's
+        dump from one place.  Returns False on a degraded store."""
+        if self._client is None:
+            return False
+        from .. import runtime_stats as _rts2
+
+        snap = _rts2.diag_snapshot(top=top)
+        ident = snap.get("identity") or {}
+        # the rank key travels on its own line ahead of the payload so
+        # the server never JSON-parses the (potentially large) dump
+        key = "%s %s" % (ident.get("role", "worker"),
+                         ident.get("rank", "?"))
+        self._client.command_shard(
+            0, "diag_put",
+            key + "\n" + json.dumps(snap, default=repr))
+        return True
+
+    def cluster_diag(self):
+        """Fetch every rank's parked diag dump from shard 0:
+        ``{"worker 3": dump-dict, ...}`` — feed the values to
+        ``runtime_stats.cluster_report`` for the merged view."""
+        if self._client is None:
+            return {}
+        raw = self._client.command_shard(0, "diag_get") or {}
+        return {k: json.loads(v) for k, v in raw.items()}
+
+    def estimate_clock_offset(self, samples=5):
+        """Ping shard 0 and register this process's wall-clock offset
+        with the profiler (``set_clock_offset``) so per-rank chrome
+        traces merge onto one cluster timeline.  Returns the offset in
+        seconds (None on a degraded store)."""
+        if self._client is None:
+            return None
+        offset, _rtt = self._client.ping(0, samples=samples)
+        _profiler.set_clock_offset(offset)
+        return offset
 
 
 def _key_value(key, value):

@@ -38,17 +38,36 @@ instead of silently swallowed, and ``MXNET_TPU_FAULT`` injects
 deterministic failures (drop/delay/refuse connections,
 kill-server-after-N-messages) so all of it is testable —
 docs/CHECKPOINTING.md "Fault injection".
+
+Distributed telemetry (PR 7): each server shard keeps always-on
+metrics — per-key bytes in/out and request counts, per-peer request
+counts, optimizer-apply and message-handle latency histograms
+(``histogram.py``), in-flight request depth, accepted connections (the
+server-visible proxy for client reconnects/retries) — served to any
+worker through a new ``stats`` head on the existing ``_command``
+channel, so operators pull them with ``kv.server_stats()`` instead of
+needing a side channel.  A ``ping`` head returns the server's wall
+clock for the client's trace clock-offset estimate, and
+``diag_put``/``diag_get`` let every rank park its diag dump on shard 0
+for one-stop cluster aggregation (``tools/diagnose.py --cluster``).
+Client-side, ``PSClient._call`` records per-shard push/pull RTT
+histograms when collection is on and fires a rate-limited straggler
+warning when one shard's RTT p99 diverges past
+``MXNET_TPU_STRAGGLER_RATIO`` × the median shard p99.
 """
 
 from __future__ import annotations
 
 import io
+import json as _json
 import os
 import pickle
 import socket
 import struct
 import threading
 import time
+
+from .. import histogram as _histogram
 
 __all__ = ["PSServer", "PSClient", "server_addresses", "run_server",
            "set_app_controller", "parse_fault_spec"]
@@ -107,7 +126,12 @@ _app_controller = [None]
 
 def set_app_controller(fn):
     """Register fn(head, body) to handle app-level server commands;
-    pass None to clear."""
+    pass None to clear.
+
+    The heads ``profiler``, ``stats``, ``ping``, ``diag_put`` and
+    ``diag_get`` are RESERVED by the framework (telemetry channel,
+    docs/OBSERVABILITY.md "Distributed telemetry") and are intercepted
+    before the app controller — pick other names."""
     _app_controller[0] = fn
 
 
@@ -254,6 +278,22 @@ class PSServer:
         self._fault_lock = threading.Lock()
         self._fault_msgs = 0
         self._fault_refused = 0
+        # server-side telemetry (always on: every request already pays a
+        # network RTT, so the accounting is noise).  One lock covers the
+        # cross-thread aggregates; the two latency histograms are
+        # lock-free per the histogram module's contract.
+        self._t_start = time.time()
+        self._metrics_lock = threading.Lock()
+        self._per_key = {}
+        self._per_peer = {}
+        self._op_counts = {}
+        self._apply_hist = _histogram.Histogram()
+        self._handle_hist = _histogram.Histogram()
+        self._inflight = 0
+        self._inflight_peak = 0
+        self._accepted = 0
+        # rank → diag-dump JSON string parked by the diag_put command
+        self._rank_dumps = {}
 
     # -- handler plumbing --------------------------------------------------
     def serve_forever(self):
@@ -281,6 +321,11 @@ class PSServer:
                     except OSError:
                         pass
                     continue
+            with self._metrics_lock:
+                # steady state is one connection per worker: growth past
+                # that is the server-visible trace of client
+                # reconnects/retries (PSClient._reconnect)
+                self._accepted += 1
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
@@ -328,10 +373,23 @@ class PSServer:
                         except OSError:
                             pass
                         return
+                t_handle = time.perf_counter()
+                with self._metrics_lock:
+                    self._op_counts[msg[0]] = \
+                        self._op_counts.get(msg[0], 0) + 1
+                    self._per_peer[peer] = self._per_peer.get(peer, 0) + 1
+                    self._inflight += 1
+                    if self._inflight > self._inflight_peak:
+                        self._inflight_peak = self._inflight
                 try:
                     reply = self._handle(msg)
                 except Exception as e:  # error surfaces on the worker
                     reply = ("err", "%s: %s" % (type(e).__name__, e))
+                finally:
+                    with self._metrics_lock:
+                        self._inflight -= 1
+                    self._handle_hist.observe(
+                        time.perf_counter() - t_handle)
                 try:
                     _send_msg(conn, reply)
                 except OSError as e:
@@ -381,10 +439,21 @@ class PSServer:
             return self._locks[key]
 
     # -- handlers ----------------------------------------------------------
+    def _note_key(self, key, op, nbytes):
+        """Per-key request/byte accounting (``stats`` command)."""
+        with self._metrics_lock:
+            d = self._per_key.get(key)
+            if d is None:
+                d = self._per_key[key] = {"init": 0, "push": 0, "pull": 0,
+                                          "bytes_in": 0, "bytes_out": 0}
+            d[op] += 1
+            d["bytes_out" if op == "pull" else "bytes_in"] += int(nbytes)
+
     def _handle(self, msg):
         op = msg[0]
         if op == "init":
             _, key, arr = msg
+            self._note_key(key, "init", getattr(arr, "nbytes", 0))
             with self._key_lock(key):
                 self._store[key] = arr.copy()
             return ("ok", None)
@@ -392,11 +461,14 @@ class PSServer:
             _, key, grad = msg
             from .. import profiler
 
+            self._note_key(key, "push", getattr(grad, "nbytes", 0))
             with profiler.scope("ps_push:%s" % (key,), "kvstore"):
                 with self._key_lock(key):
                     if key not in self._store:
                         raise KeyError("key %r not initialized" % (key,))
+                    t0 = time.perf_counter()
                     self._apply(key, grad)
+                    self._apply_hist.observe(time.perf_counter() - t0)
             return ("ok", None)
         if op == "pull":
             _, key = msg
@@ -406,7 +478,9 @@ class PSServer:
                 with self._key_lock(key):
                     if key not in self._store:
                         raise KeyError("key %r not initialized" % (key,))
-                    return ("ok", self._store[key].copy())
+                    out = self._store[key].copy()
+            self._note_key(key, "pull", getattr(out, "nbytes", 0))
+            return ("ok", out)
         if op == "set_optimizer":
             _, blob = msg
             self._set_optimizer(blob)
@@ -447,20 +521,84 @@ class PSServer:
         optimizer = _OptimizerUnpickler(io.BytesIO(blob)).load()
         self._updater = opt_mod.get_updater(optimizer)
 
+    def stats_snapshot(self):
+        """This shard's server-side metrics as one JSON-ready dict —
+        the payload of the ``stats`` command.  ``connections_accepted``
+        above one per worker is the server-visible trace of client
+        reconnects/retries; ``queue_depth`` is the in-flight request
+        gauge at snapshot time (its ``_peak`` the high-water mark)."""
+        from .. import runtime_stats as _rts
+
+        with self._metrics_lock:
+            per_key = {str(k): dict(v) for k, v in self._per_key.items()}
+            per_peer = dict(self._per_peer)
+            requests = dict(self._op_counts)
+            inflight, peak = self._inflight, self._inflight_peak
+            accepted = self._accepted
+            rank_dumps = sorted(self._rank_dumps)
+        with self._fault_lock:
+            fault = None if self._fault is None else dict(
+                self._fault, messages=self._fault_msgs,
+                refused=self._fault_refused)
+        return {"role": "server",
+                "server_id": int(os.environ.get(
+                    "MXTPU_PS_SERVER_ID",
+                    os.environ.get("DMLC_SERVER_ID", "0")) or 0),
+                "pid": os.getpid(), "time": time.time(),
+                "uptime_seconds": time.time() - self._t_start,
+                "keys": len(self._store),
+                "requests": requests,
+                "per_key": per_key,
+                "per_peer": per_peer,
+                "queue_depth": inflight,
+                "queue_depth_peak": peak,
+                "connections_accepted": accepted,
+                "conn_errors": _rts._COUNTERS.get(
+                    "kvstore_server_conn_errors", 0),
+                "apply": self._apply_hist.snapshot(),
+                "handle": self._handle_hist.snapshot(),
+                "fault": fault,
+                "rank_dumps": rank_dumps}
+
     def _command(self, head, body):
         """Controller channel (reference: ps-lite server commands;
         KVStoreServerProfilerCommand include/mxnet/kvstore.h:49).
         'profiler' drives this server process's profiler so pushes can be
         traced server-side (reference: tests/nightly/
-        test_server_profiling.py).  Any other head goes to the
-        app-level controller when one is registered (reference:
-        KVStore::RunServer's controller argument)."""
+        test_server_profiling.py).  'stats' returns this shard's
+        server-side metrics, 'ping' its wall clock (the client's trace
+        clock-offset probe), and 'diag_put'/'diag_get' park / serve
+        per-rank diag dumps for cluster aggregation
+        (docs/OBSERVABILITY.md "Distributed telemetry").  Any other
+        head goes to the app-level controller when one is registered
+        (reference: KVStore::RunServer's controller argument)."""
+        if head == "stats":
+            return _json.dumps(self.stats_snapshot())
+        if head == "ping":
+            return _json.dumps({"t_server": time.time(),
+                                "pid": os.getpid()})
+        if head == "diag_put":
+            # body = "<rank key>\n<json dump>": the key travels outside
+            # the payload so this handler thread never JSON-parses a
+            # potentially large dump; a bare-JSON body (no key line)
+            # falls back to reading the identity from the payload
+            key, sep, payload = (body or "").partition("\n")
+            if not sep or key.lstrip().startswith("{"):
+                payload = body or ""
+                ident = (_json.loads(payload).get("identity") or {}) \
+                    if payload else {}
+                key = "%s %s" % (ident.get("role", "worker"),
+                                 ident.get("rank", "?"))
+            with self._metrics_lock:
+                self._rank_dumps[key.strip()] = payload
+            return None
+        if head == "diag_get":
+            with self._metrics_lock:
+                return dict(self._rank_dumps)
         if head != "profiler":
             if _app_controller[0] is not None:
                 return _app_controller[0](head, body)
             raise ValueError("unknown server command %r" % (head,))
-        import json as _json
-
         from .. import profiler
 
         req = _json.loads(body)
@@ -543,6 +681,12 @@ class PSClient:
 
     _NON_RETRYABLE_OPS = ("barrier", "stop", "command")
 
+    # RTT ops measured into per-shard latency histograms; every
+    # _RTT_CHECK_EVERY observations the straggler detector compares
+    # shard p99s (both only when histogram collection is on)
+    _RTT_OPS = ("push", "pull")
+    _RTT_CHECK_EVERY = 64
+
     def __init__(self, connect_timeout=60):
         host, ports = server_addresses()
         self._addrs = [(host, p) for p in ports]
@@ -553,6 +697,7 @@ class PSClient:
         self._socks = [self._dial(a, connect_timeout)
                        for a in self._addrs]
         self._lock = threading.Lock()
+        self._rtt_obs = 0
 
     @staticmethod
     def _dial(addr, connect_timeout, dial_timeout=300):
@@ -625,16 +770,32 @@ class PSClient:
         retryable = idx is not None and \
             msg[0] not in self._NON_RETRYABLE_OPS and \
             self._max_retries > 0
+        # per-shard RTT distribution (guard-first; timestamps only while
+        # collecting).  Each attempt is timed alone: a retried request's
+        # failed rounds must not smear the successful round's latency.
+        # t0 is taken INSIDE the client lock — waiting for another
+        # thread's round trip is queueing, not shard RTT, and counting
+        # it would fire straggler warnings at healthy shards.
+        rtt_on = idx is not None and msg[0] in self._RTT_OPS and \
+            _histogram._state["on"]
         attempt = 0
         while True:
             try:
                 with self._lock:
+                    if rtt_on:
+                        t0 = time.perf_counter()
                     s = self._socks[idx] if idx is not None else sock
                     _send_msg(s, msg)
                     reply = _recv_msg(s)
                 if reply is None:
                     raise ConnectionError(
                         "parameter server closed the connection")
+                if rtt_on:
+                    dur = time.perf_counter() - t0
+                    _histogram.observe("kv:%s_rtt" % msg[0], dur)
+                    _histogram.observe(
+                        "kv:%s_rtt:shard%d" % (msg[0], idx), dur)
+                    self._maybe_warn_straggler()
                 break
             except (ConnectionError, socket.timeout, OSError) as e:
                 if not retryable:
@@ -669,6 +830,33 @@ class PSClient:
             raise MXNetError("parameter server error: %s" % payload)
         return payload
 
+    def _maybe_warn_straggler(self):
+        """Every ``_RTT_CHECK_EVERY`` RTT observations, compare the
+        per-shard push-RTT p99s and warn (rate-limited, counted) when
+        one shard has diverged past ``MXNET_TPU_STRAGGLER_RATIO`` × the
+        median — the live, in-job form of the cluster report's
+        straggler callout."""
+        self._rtt_obs += 1
+        if self._rtt_obs % self._RTT_CHECK_EVERY or len(self._socks) < 2:
+            return
+        found = _histogram.detect_straggler("kv:push_rtt:shard") \
+            or _histogram.detect_straggler("kv:pull_rtt:shard")
+        if found is None:
+            return
+        from .. import runtime_stats as _rts
+        from ..log import warn_rate_limited
+
+        if warn_rate_limited(
+                _logger(), "kv-straggler",
+                _histogram.STRAGGLER_WARN_INTERVAL,
+                "parameter-server straggler: %s p99 %.1fms is %.1fx the "
+                "median shard p99 (%.1fms) — that shard's host/network "
+                "is holding the job back (docs/OBSERVABILITY.md "
+                "'Distributed telemetry')",
+                found["name"], found["p99"] * 1e3, found["ratio"],
+                found["median_p99"] * 1e3):
+            _rts.inc("kvstore_straggler_warnings")
+
     def init(self, key, arr):
         self._call(self._shard(key), ("init", key, arr))
 
@@ -677,6 +865,36 @@ class PSClient:
 
     def pull(self, key):
         return self._call(self._shard(key), ("pull", key))
+
+    def command_shard(self, idx, head, body=""):
+        """App/controller command on ONE shard, returning its reply
+        payload (``send_command`` broadcasts and discards replies —
+        the telemetry heads need the answer)."""
+        return self._call(idx, ("command", head, body))
+
+    def server_stats(self):
+        """Every shard's server-side metrics (the ``stats`` command),
+        as a list of dicts indexed by shard."""
+        return [_json.loads(self.command_shard(i, "stats"))
+                for i in range(len(self._socks))]
+
+    def ping(self, idx=0, samples=5):
+        """Estimate this process's wall-clock offset to shard ``idx``:
+        returns ``(offset_seconds, rtt_seconds)`` from the
+        lowest-RTT of ``samples`` pings (midpoint method — the offset
+        error is bounded by rtt/2).  Feeds the merged-trace clock
+        alignment (``profiler.set_clock_offset``)."""
+        best = None
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            w0 = time.time()
+            reply = _json.loads(self.command_shard(idx, "ping"))
+            rtt = time.perf_counter() - t0
+            w1 = time.time()
+            offset = reply["t_server"] - (w0 + w1) / 2.0
+            if best is None or rtt < best[1]:
+                best = (offset, rtt)
+        return best
 
     def set_optimizer(self, blob):
         for i in range(len(self._socks)):

@@ -7,11 +7,12 @@ letter + timestamp + pid + source location, then the message.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 import time
 
 __all__ = ["get_logger", "getLogger", "warn_rate_limited", "warn_once",
-           "reset_rate_limits",
+           "reset_rate_limits", "process_identity",
            "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG", "NOTSET"]
 
 CRITICAL = logging.CRITICAL
@@ -61,6 +62,38 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     return logger
 
 
+def process_identity():
+    """This process's rank/role under the ``DMLC_*``/``MXTPU_*`` launch
+    contract (``tools/launch.py``), or None when running single-process.
+
+    ``{"role": "worker"|"server", "rank": int, "num_workers": int}`` —
+    the shared identity the distributed-telemetry layer stamps on
+    rate-limited warnings, diag-dump headers, and chrome-trace pids so
+    multi-rank output is attributable (docs/OBSERVABILITY.md
+    "Distributed telemetry").  Read fresh from the env each call: the
+    launcher sets these before exec, and tests monkeypatch them."""
+    def _int(v, default):
+        # a malformed value (unexpanded '$RANK', stray wrapper export)
+        # must never crash `import mxnet_tpu` or a warning call
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    env = os.environ
+    role = env.get("DMLC_ROLE")
+    nw = _int(env.get("DMLC_NUM_WORKER"), 1)
+    if role == "server":
+        rank = env.get("MXTPU_PS_SERVER_ID", env.get("DMLC_SERVER_ID"))
+        return {"role": "server", "rank": _int(rank, 0),
+                "num_workers": nw}
+    wid = env.get("DMLC_WORKER_ID", env.get("JAX_PROCESS_ID"))
+    if role is None and wid is None:
+        return None
+    return {"role": role or "worker", "rank": _int(wid, 0),
+            "num_workers": nw}
+
+
 # key -> monotonic time of the last emitted warning
 _rate_state: dict = {}
 
@@ -71,12 +104,17 @@ def warn_rate_limited(logger, key, interval, msg, *args):
 
     Telemetry paths (runtime_stats recompile-storm detector) warn from
     hot loops — without rate limiting a storm of recompiles would also
-    be a storm of log lines."""
+    be a storm of log lines.  Under a distributed launch the message is
+    prefixed with this process's rank/role, so interleaved multi-rank
+    stderr stays attributable."""
     now = time.monotonic()
     last = _rate_state.get(key)
     if last is not None and now - last < interval:
         return False
     _rate_state[key] = now
+    ident = process_identity()
+    if ident is not None:
+        msg = "[%s %d] %s" % (ident["role"], ident["rank"], msg)
     logger.warning(msg, *args)
     return True
 

@@ -134,6 +134,9 @@ def _split_nout(attrs):
 
 @register("SliceChannel", aliases=("split",), num_outputs=_split_nout)
 def split(x, num_outputs=1, axis=1, squeeze_axis=False, **_):
+    """Split ``x`` into ``num_outputs`` equal parts along ``axis``
+    (default 1, the reference's channel convention);
+    ``squeeze_axis`` drops the now-size-1 split axis from each part."""
     parts = jnp.split(x, int(num_outputs), axis=int(axis))
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
@@ -142,6 +145,8 @@ def split(x, num_outputs=1, axis=1, squeeze_axis=False, **_):
 
 @register("slice", aliases=("crop",))
 def slice_op(x, begin=(), end=(), step=(), **_):
+    """N-D strided slice: per-axis ``begin``/``end``/``step`` tuples
+    (None entries keep the full extent, trailing axes default open)."""
     ndim = x.ndim
     begin = tuple(begin) + (None,) * (ndim - len(begin))
     end = tuple(end) + (None,) * (ndim - len(end))
@@ -158,6 +163,8 @@ builtins_slice = slice  # keep the builtin reachable under the op name
 
 @register("slice_axis")
 def slice_axis(x, axis=0, begin=0, end=None, **_):
+    """Slice ``[begin, end)`` along ONE axis, all others untouched
+    (``end=None`` runs to the axis's extent; negative axis wraps)."""
     axis = int(axis) % x.ndim
     idx = [builtins_slice(None)] * x.ndim
     idx[axis] = builtins_slice(begin, end)
@@ -166,6 +173,8 @@ def slice_axis(x, axis=0, begin=0, end=None, **_):
 
 @register("slice_like")
 def slice_like(x, y, axes=(), **_):
+    """Crop ``x`` from index 0 to ``y``'s extent on the listed ``axes``
+    (empty: every axis the two arrays share)."""
     axes = tuple(axes) if axes else tuple(range(min(x.ndim, y.ndim)))
     idx = [builtins_slice(None)] * x.ndim
     for a in axes:
@@ -175,22 +184,30 @@ def slice_like(x, y, axes=(), **_):
 
 @register("tile")
 def tile(x, reps=(), **_):
+    """Repeat the whole array ``reps[i]`` times along each axis
+    (numpy tile semantics)."""
     return jnp.tile(x, tuple(reps))
 
 
 @register("repeat")
 def repeat(x, repeats=1, axis=None, **_):
+    """Repeat each ELEMENT ``repeats`` times along ``axis`` (None
+    flattens first, numpy repeat semantics)."""
     return jnp.repeat(x, int(repeats), axis=None if axis is None else int(axis))
 
 
 @register("reverse", aliases=("flip",))
 def reverse(x, axis=(), **_):
+    """Reverse element order along the given axis (or tuple of axes)."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     return jnp.flip(x, axis=axes)
 
 
 @register("Pad", aliases=("pad",))
 def pad(x, mode="constant", pad_width=(), constant_value=0.0, **_):
+    """Pad with the reference's flat ``(before0, after0, before1, ...)``
+    ``pad_width`` layout; modes: constant (with ``constant_value``),
+    edge, reflect."""
     pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
     jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
     if jmode == "constant":
@@ -200,6 +217,8 @@ def pad(x, mode="constant", pad_width=(), constant_value=0.0, **_):
 
 @register("space_to_depth")
 def space_to_depth(x, block_size=1, **_):
+    """NCHW: move each ``block_size``² spatial tile into channels —
+    ``(N,C,H,W) → (N, C·b², H/b, W/b)``."""
     n, c, h, w = x.shape
     b = int(block_size)
     x = x.reshape(n, c, h // b, b, w // b, b)
@@ -209,6 +228,8 @@ def space_to_depth(x, block_size=1, **_):
 
 @register("depth_to_space")
 def depth_to_space(x, block_size=1, **_):
+    """NCHW inverse of ``space_to_depth``: redistribute channel groups
+    back onto the spatial grid — ``(N,C,H,W) → (N, C/b², H·b, W·b)``."""
     n, c, h, w = x.shape
     b = int(block_size)
     x = x.reshape(n, b, b, c // (b * b), h, w)
@@ -234,6 +255,8 @@ def dot(a, b, transpose_a=False, transpose_b=False, **_):
 
 @register("batch_dot")
 def batch_dot(a, b, transpose_a=False, transpose_b=False, **_):
+    """Batched matmul over the trailing two axes (leading axes are the
+    batch), with optional per-operand transpose — MXU dot_general."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
@@ -257,6 +280,9 @@ def sort(x, axis=-1, is_ascend=True, **_):
 
 @register("argsort")
 def argsort(x, axis=-1, is_ascend=True, dtype="float32", **_):
+    """Indices that would sort ``x`` along ``axis`` (None flattens),
+    returned in the requested ``dtype`` (the reference's float
+    default)."""
     from ..base import np_dtype
 
     ax = 0 if axis is None else int(axis)
@@ -274,6 +300,9 @@ def _topk_nout(attrs):
 
 @register("topk", num_outputs=_topk_nout)
 def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **_):
+    """Top-``k`` along ``axis`` via ``lax.top_k``; ``ret_typ`` selects
+    values / indices / a 0-1 mask / both, ``is_ascend`` picks the
+    smallest-k instead, ``k<=0`` means the full axis."""
     from ..base import np_dtype
 
     if axis is None:
@@ -348,6 +377,8 @@ def take(a, indices, axis=0, mode="clip", **_):
 
 @register("batch_take")
 def batch_take(x, index, axis=-1, keepdims=False, mode="clip", **_):
+    """Per-row element pick: ``index`` selects one entry along ``axis``
+    for each leading position (take_along_axis with clipped indices)."""
     ax = int(axis) % x.ndim
     with _index_ctx(x):
         idx = jnp.clip(_as_gather_indices(x, index), 0, x.shape[ax] - 1)
@@ -380,12 +411,17 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
 
 @register("gather_nd")
 def gather_nd(data, indices, **_):
+    """N-D gather: ``indices`` is ``(M, ...)`` whose leading axis
+    indexes the first M axes of ``data`` (reference gather_nd)."""
     with _index_ctx(data):
         return data[tuple(_as_gather_indices(data, indices))]
 
 
 @register("scatter_nd")
 def scatter_nd(data, indices, shape=(), **_):
+    """Scatter ``data`` into zeros of ``shape`` at gather_nd-style
+    ``indices``; duplicate indices overwrite (last write wins, the
+    reference's nondeterminism pinned to XLA scatter order)."""
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
     with _index_ctx(out):
         return out.at[tuple(_as_gather_indices(out, indices))].set(data)
@@ -393,6 +429,8 @@ def scatter_nd(data, indices, shape=(), **_):
 
 @register("_backward_gather_nd", aliases=("gather_nd_accumulate",))
 def gather_nd_accumulate(data, indices, shape=(), **_):
+    """gather_nd's VJP: scatter-ADD ``data`` into zeros of ``shape`` so
+    duplicate indices accumulate."""
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
     with _index_ctx(out):
         return out.at[tuple(_as_gather_indices(out, indices))].add(data)
@@ -400,6 +438,9 @@ def gather_nd_accumulate(data, indices, shape=(), **_):
 
 @register("where_nd", aliases=("boolean_mask_unsupported",))
 def where_nd(cond, **_):
+    """Unsupported-by-design stub: nonzero-style ops have
+    data-dependent output shapes, which cannot stage under jit on
+    TPU — raises with the static-capacity alternative."""
     raise NotImplementedError(
         "data-dependent output shapes are not jittable on TPU; "
         "use boolean_mask with static capacity"
@@ -408,12 +449,16 @@ def where_nd(cond, **_):
 
 @register("index_copy")
 def index_copy(old, index, new_tensor, **_):
+    """Copy ``new_tensor`` rows into ``old`` at positions ``index``
+    (out-of-place; the reference's contrib.index_copy)."""
     with _index_ctx(old):
         return old.at[_as_gather_indices(old, index)].set(new_tensor)
 
 
 @register("index_add")
 def index_add(old, index, new_tensor, **_):
+    """Add ``new_tensor`` rows into ``old`` at positions ``index``;
+    duplicate indices accumulate (contrib.index_add)."""
     with _index_ctx(old):
         return old.at[_as_gather_indices(old, index)].add(new_tensor)
 
@@ -423,6 +468,8 @@ def index_add(old, index, new_tensor, **_):
 
 @register("linalg_gemm")
 def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-3, **_):
+    """BLAS-3 GEMM on the trailing two axes:
+    ``alpha·op(a)·op(b) + beta·c`` (reference la_op.cc linalg_gemm)."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
@@ -432,6 +479,7 @@ def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1
 
 @register("linalg_gemm2")
 def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, **_):
+    """GEMM without the additive term: ``alpha·op(a)·op(b)``."""
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
@@ -441,17 +489,23 @@ def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, **_):
 
 @register("linalg_potrf")
 def linalg_potrf(a, **_):
+    """Cholesky factor L of a symmetric positive-definite ``a``
+    (``a = L·Lᵀ``, lower triangular)."""
     return jnp.linalg.cholesky(a)
 
 
 @register("linalg_potri")
 def linalg_potri(a, **_):
+    """Inverse of ``L·Lᵀ`` from a Cholesky factor ``a = L``:
+    ``(L·Lᵀ)⁻¹ = L⁻ᵀ·L⁻¹`` (reference linalg_potri)."""
     l_inv = jnp.linalg.inv(a)
     return jnp.matmul(jnp.swapaxes(l_inv, -1, -2), l_inv)
 
 
 @register("linalg_trmm")
 def linalg_trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    """Triangular matmul: ``alpha·op(tri(a))·b`` (or ``b·op(tri(a))``
+    with ``rightside``), ``lower`` picking the triangle of ``a``."""
     t = jnp.tril(a) if lower else jnp.triu(a)
     if transpose:
         t = jnp.swapaxes(t, -1, -2)
@@ -460,6 +514,8 @@ def linalg_trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, *
 
 @register("linalg_trsm")
 def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    """Triangular solve: ``alpha · op(tri(a))⁻¹·b`` (or the
+    ``rightside`` form ``b·op(tri(a))⁻¹``) via solve_triangular."""
     import jax.scipy.linalg as jsl
 
     t = jnp.tril(a) if lower else jnp.triu(a)
@@ -477,16 +533,22 @@ def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, *
 
 @register("linalg_sumlogdiag")
 def linalg_sumlogdiag(a, **_):
+    """Sum of the log of the diagonal of the trailing 2-D block(s) —
+    the log-determinant of a Cholesky factor."""
     return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
 
 
 @register("linalg_extractdiag")
 def linalg_extractdiag(a, offset=0, **_):
+    """The ``offset``-th diagonal of the trailing 2-D block(s) as a
+    vector (batched jnp.diagonal)."""
     return jnp.diagonal(a, offset=int(offset), axis1=-2, axis2=-1)
 
 
 @register("linalg_makediag")
 def linalg_makediag(a, offset=0, **_):
+    """Embed the trailing vector of ``a`` as the ``offset``-th diagonal
+    of an otherwise-zero square matrix (inverse of extractdiag)."""
     n = a.shape[-1] + abs(int(offset))
     out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
     i = jnp.arange(a.shape[-1])
@@ -497,12 +559,17 @@ def linalg_makediag(a, offset=0, **_):
 
 @register("linalg_syrk")
 def linalg_syrk(a, transpose=False, alpha=1.0, **_):
+    """Symmetric rank-k update: ``alpha·a·aᵀ`` (``alpha·aᵀ·a`` with
+    ``transpose``) on the trailing two axes."""
     at = jnp.swapaxes(a, -1, -2)
     return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
 
 
 @register("diag")
 def diag(x, k=0, **_):
+    """1-D input: build the matrix with ``x`` on diagonal ``k``;
+    N-D input: extract diagonal ``k`` of the trailing 2-D block(s)
+    (numpy diag/diagonal semantics)."""
     if x.ndim == 1:
         return jnp.diag(x, k=int(k))
     return jnp.diagonal(x, offset=int(k), axis1=-2, axis2=-1)
@@ -510,6 +577,8 @@ def diag(x, k=0, **_):
 
 @register("trace_op", aliases=("trace",))
 def trace(x, offset=0, axis1=0, axis2=1, **_):
+    """Sum of the ``offset``-th diagonal over the ``(axis1, axis2)``
+    plane (numpy trace semantics)."""
     return jnp.trace(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
 
 
@@ -518,6 +587,9 @@ def trace(x, offset=0, axis1=0, axis2=1, **_):
 
 @register("SequenceMask", aliases=("sequence_mask",))
 def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0, **_):
+    """Replace each sequence's positions past its ``sequence_length`` with
+    ``value``; ``axis`` picks the (seq, batch) vs (batch, seq) layout,
+    and without ``use_sequence_length`` the data passes through."""
     if not use_sequence_length or sequence_length is None:
         return data
     axis = int(axis)  # 0 = (seq, batch, ...), 1 = (batch, seq, ...)
@@ -534,6 +606,9 @@ def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0
 
 @register("SequenceLast", aliases=("sequence_last",))
 def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **_):
+    """Each sequence's LAST valid element — position
+    ``sequence_length-1`` per batch entry (or the final step for all,
+    without ``use_sequence_length``)."""
     axis = int(axis)
     if not use_sequence_length or sequence_length is None:
         return jnp.take(data, data.shape[axis] - 1, axis=axis)
@@ -544,6 +619,9 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0,
 
 @register("SequenceReverse", aliases=("sequence_reverse",))
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0, **_):
+    """Reverse the first ``sequence_length`` steps of each (seq, batch)
+    column, leaving the padding tail in place (whole-axis flip without
+    ``use_sequence_length``)."""
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=0)
     seq_len = data.shape[0]

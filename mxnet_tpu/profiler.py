@@ -12,6 +12,16 @@ dispatch, iterator, kvstore). (2) ``start_xla_trace``/``stop_xla_trace``
 wrap ``jax.profiler`` for device-side traces viewable in TensorBoard /
 Perfetto — the analog of the reference's device-level opr profiling,
 since XLA owns kernel timing on TPU.
+
+Distributed telemetry (PR 7): under a ``tools/launch.py`` job every
+event carries a rank-tagged pid (worker rank, or 10000 + shard id for
+servers), the dumped JSON gains ``process_name``/``process_sort_index``
+metadata plus an ``mxtpu`` header — role/rank, a perf-counter →
+wall-clock anchor pair captured at import, and the kvstore-ping clock
+offset (``set_clock_offset``; ``DistAsyncKVStore.estimate_clock_offset``)
+— and :func:`merge_traces` folds several ranks' files into ONE
+chrome trace on a common timeline, so the whole cluster's step anatomy
+renders in a single viewer.
 """
 
 from __future__ import annotations
@@ -20,6 +30,8 @@ import json
 import os
 import threading
 import time
+
+from .log import process_identity
 
 _state = {
     "config": {"profile_all": False, "profile_symbolic": True,
@@ -30,7 +42,31 @@ _state = {
     "events": [],
     "lock": threading.Lock(),
     "xla_dir": None,
+    # estimated wall-clock offset of this process vs PS shard 0
+    # (seconds; set_clock_offset) — merge_traces subtracts it
+    "clock_offset": None,
 }
+
+# rank-tagged trace pid: distinct per role/rank so merged traces show
+# one labelled track per process (servers offset far above any worker
+# rank).  Single-process runs keep the historical pid 0.
+_IDENTITY = process_identity()
+TRACE_PID = 0 if _IDENTITY is None else (
+    _IDENTITY["rank"] if _IDENTITY["role"] != "server"
+    else 10000 + _IDENTITY["rank"])
+
+# perf_counter↔wall anchor pair, captured back-to-back at import: event
+# timestamps are perf_counter µs (monotonic, per-process epoch), so
+# cross-process merging needs each file to say where its epoch sits on
+# the wall clock
+_ANCHOR = (time.perf_counter_ns() / 1000.0, time.time() * 1e6)
+
+
+def set_clock_offset(offset_seconds):
+    """Record this process's estimated wall-clock offset (seconds)
+    relative to the cluster reference clock (PS shard 0) — stamped into
+    the trace header for :func:`merge_traces`."""
+    _state["clock_offset"] = float(offset_seconds)
 
 
 _kvstore_handle = None
@@ -84,12 +120,14 @@ def _now_us():
     return time.perf_counter_ns() / 1000.0
 
 
-def add_event(name, cat, ph, ts=None, pid=0, tid=None, args=None, dur=None):
+def add_event(name, cat, ph, ts=None, pid=None, tid=None, args=None,
+              dur=None):
     if not _state["running"]:
         return
     ev = {"name": name, "cat": cat, "ph": ph,
           "ts": ts if ts is not None else _now_us(),
-          "pid": pid, "tid": tid if tid is not None else threading.get_ident()}
+          "pid": TRACE_PID if pid is None else pid,
+          "tid": tid if tid is not None else threading.get_ident()}
     if args:
         ev["args"] = args
     if dur is not None:
@@ -157,11 +195,40 @@ def counter(name, values, cat="framework"):
     add_event(name, cat, "C", args=values)
 
 
+def _identity_meta():
+    """chrome-trace metadata events naming this process's track, plus
+    the ``mxtpu`` header dict :func:`merge_traces` aligns clocks with.
+    Uses the SAME import-time identity as ``TRACE_PID`` — events are
+    already tagged with it, so a header from a fresh env read could
+    name a rank whose pid no event carries."""
+    ident = _IDENTITY
+    if ident is not None:
+        pname = "%s %d (pid %d)" % (ident["role"], ident["rank"],
+                                    os.getpid())
+    else:
+        pname = "process %d" % os.getpid()
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": TRACE_PID,
+         "args": {"name": pname}},
+        {"name": "process_sort_index", "ph": "M", "pid": TRACE_PID,
+         "args": {"sort_index": TRACE_PID}},
+    ]
+    header = {"role": ident["role"] if ident else None,
+              "rank": ident["rank"] if ident else None,
+              "pid": os.getpid(), "trace_pid": TRACE_PID,
+              "perf_anchor_us": _ANCHOR[0], "wall_anchor_us": _ANCHOR[1],
+              "clock_offset_us": None if _state["clock_offset"] is None
+              else _state["clock_offset"] * 1e6}
+    return meta, header
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome-tracing JSON; returns the absolute path.
 
     ``finished=True`` also stops recording (reference semantics:
-    profiler.py dump's `finished` finalizes the profiler)."""
+    profiler.py dump's `finished` finalizes the profiler).  The file
+    carries rank-tagged process metadata and the ``mxtpu`` clock
+    header, so per-rank files are :func:`merge_traces`-ready."""
     if profile_process == "server":
         return _server_command("dump", {"finished": finished})
     if finished:
@@ -169,9 +236,72 @@ def dump(finished=True, profile_process="worker"):
     fname = _state["config"].get("filename", "profile.json")
     with _state["lock"]:
         events = list(_state["events"])
+    meta, header = _identity_meta()
     with open(fname, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        # metadata trails the real events: chrome accepts "M" records
+        # anywhere, and readers that index traceEvents[0] keep seeing a
+        # timestamped span
+        json.dump({"traceEvents": events + meta,
+                   "displayTimeUnit": "ms", "mxtpu": header}, f)
     return os.path.abspath(fname)
+
+
+def merge_traces(paths, out="merged_trace.json"):
+    """Merge per-rank chrome traces into ONE file on a shared timeline.
+
+    Each input's event timestamps are per-process ``perf_counter`` µs;
+    using the file's ``mxtpu`` header they are re-based onto the wall
+    clock (anchor pair) minus the rank's kvstore-ping clock offset, so
+    spans line up across machines to within the ping RTT/2.  Files
+    without a header (pre-PR-7, or hand-made) are kept on their own
+    epoch.  Colliding pids between files are remapped to keep one
+    track per process; the merged timeline is normalized to start at
+    t=0.  Returns the absolute output path."""
+    merged = []
+    used_pids: set = set()
+    sources = []
+    for path in paths:
+        with open(path) as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        header = data.get("mxtpu") or {}
+        shift = 0.0
+        if header.get("perf_anchor_us") is not None:
+            # event ts (per-process perf µs) → this process's wall
+            # clock (anchor pair) → the reference clock: offset is
+            # server_minus_this (PSClient.ping), so reference time =
+            # local wall + offset — ADD it
+            shift = header["wall_anchor_us"] - header["perf_anchor_us"] \
+                + (header.get("clock_offset_us") or 0.0)
+        pids = {ev.get("pid", 0) for ev in events}
+        remap = {}
+        for p in sorted(pids):
+            new = p
+            while new in used_pids:
+                new += 100000  # far past any rank/server tag
+            remap[p] = new
+            used_pids.add(new)
+        for ev in events:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift
+            ev["pid"] = remap.get(ev.get("pid", 0), ev.get("pid", 0))
+            merged.append(ev)
+        sources.append({"path": os.path.abspath(path),
+                        "role": header.get("role"),
+                        "rank": header.get("rank"),
+                        "trace_pids": sorted(remap.values()),
+                        "clock_offset_us": header.get("clock_offset_us")})
+    timed = [ev["ts"] for ev in merged if "ts" in ev]
+    if timed:
+        t0 = min(timed)
+        for ev in merged:
+            if "ts" in ev:
+                ev["ts"] -= t0
+    with open(out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms",
+                   "mxtpu": {"merged_from": sources}}, f)
+    return os.path.abspath(out)
 
 
 def dumps(reset=False):

@@ -39,6 +39,17 @@ the device-resident NaN/Inf monitor and training flight recorder from
 carry the numerics picture; the CLI also renders standalone
 flight-recorder dumps (files whose top level is ``health`` only).
 
+Distributed telemetry (PR 7): ``snapshot()`` carries a ``histograms``
+section (log2-bucketed latency distributions from ``histogram.py``:
+kvstore push/pull RTT per shard, warm dispatch, io next-batch wait,
+checkpoint writes, trainer steps) and diag dumps are stamped with this
+process's rank/role identity (``log.process_identity``).
+:func:`cluster_report` merges several ranks' diag dumps into one
+cluster view — per-rank latency table, merged distributions, and a
+straggler callout with the p99/median skew ratio — rendered by
+``tools/diagnose.py --cluster`` and by this module's CLI when given
+more than one dump file.
+
 Environment variables
 ---------------------
 ``MXNET_TPU_RECOMPILE_STORM_THRESHOLD``  compiles per op before the
@@ -61,13 +72,15 @@ import os
 import time
 
 from . import device_memory
-from .log import get_logger, warn_rate_limited
+from . import histogram as _histogram
+from .log import get_logger, process_identity, warn_rate_limited
 
 __all__ = ["snapshot", "report", "reset", "inc",
            "record_dispatch", "record_compile_key", "add_compile_seconds",
            "add_dispatch_seconds", "record_fallback", "note_aval_key",
            "roofline", "diag_snapshot", "dump_diag", "main",
-           "health_probe", "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
+           "health_probe", "cluster_report", "render_cluster",
+           "load_dumps", "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
 
 STORM_THRESHOLD = int(os.environ.get(
     "MXNET_TPU_RECOMPILE_STORM_THRESHOLD", "8"))
@@ -174,16 +187,21 @@ def add_compile_seconds(name, seconds):
 def add_dispatch_seconds(name, seconds):
     """Attribute one timed dispatch's wall-time to an op.  Fed by the
     dispatch layer only while the profiler records (the timestamps exist
-    for the span anyway) or ``MXNET_TPU_DIAG`` is set (DIAG_TIMING) —
-    the denominator of the achieved GB/s / GFLOP/s columns.  Cache-warm
-    hits only.  This is HOST wall-time of the dispatch call: on a
-    synchronous backend (CPU tests) it tracks execution, but async
-    device dispatch returns early, so the derived rates are cache-warm
-    dispatch diagnostics, not physics — the measured-trace audit
-    (tools/profile_step.py) stays the ground-truth instrument."""
+    for the span anyway) or ``MXNET_TPU_DIAG`` is set (DIAG_TIMING,
+    which ``histogram.enable()`` also raises) — the denominator of the
+    achieved GB/s / GFLOP/s columns.  Cache-warm hits only.  This is
+    HOST wall-time of the dispatch call: on a synchronous backend (CPU
+    tests) it tracks execution, but async device dispatch returns
+    early, so the derived rates are cache-warm dispatch diagnostics,
+    not physics — the measured-trace audit (tools/profile_step.py)
+    stays the ground-truth instrument.  When latency histograms are on
+    the sample additionally lands in the ``dispatch:warm``
+    distribution."""
     s = _op_stats(name)
     s["dispatch_seconds"] += seconds
     s["timed_calls"] += 1
+    if _histogram._state["on"]:
+        _histogram.observe("dispatch:warm", seconds)
 
 
 def record_fallback(name, kind):
@@ -342,7 +360,9 @@ def snapshot():
             "storms": storms, "memory": device_memory.snapshot(),
             "costs": _registry.cost_snapshot(),
             "health": _health.snapshot(),
-            "checkpoint": _checkpoint.snapshot()}
+            "checkpoint": _checkpoint.snapshot(),
+            "histograms": _histogram.snapshot(),
+            "identity": process_identity()}
 
 
 def roofline(snap=None, top=None):
@@ -417,7 +437,30 @@ def _render(snap, top=None):
     lines.extend(_render_costs(snap, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
     lines.extend(_render_health(snap.get("health") or {}))
+    lines.extend(_render_hists(snap.get("histograms") or {}))
     return "\n".join(lines)
+
+
+def _fmt_ms(v):
+    return "-" if v is None else "%.3f" % (v * 1e3)
+
+
+def _render_hists(hists):
+    lines = ["", "Latency histograms (ms)"]
+    if not hists:
+        lines.append("(no histograms — histogram.enable() or "
+                     "MXNET_TPU_HISTOGRAMS=1; auto-on under "
+                     "MXNET_TPU_PROFILE / MXNET_TPU_DIAG)")
+        return lines
+    lines.append("%-32s %9s %9s %9s %9s %9s %9s"
+                 % ("Name", "Count", "Mean", "p50", "p90", "p99", "Max"))
+    for name in sorted(hists):
+        h = hists[name]
+        lines.append("%-32s %9d %9s %9s %9s %9s %9s"
+                     % (name[:32], h.get("count", 0), _fmt_ms(h.get("mean")),
+                        _fmt_ms(h.get("p50")), _fmt_ms(h.get("p90")),
+                        _fmt_ms(h.get("p99")), _fmt_ms(h.get("max"))))
+    return lines
 
 
 def _render_costs(snap, top=None):
@@ -552,12 +595,14 @@ def reset():
 
     Deliberately leaves the device-memory tracker alone — live-buffer
     accounting must survive a counter reset; use
-    ``device_memory.reset()`` to drop that too."""
+    ``device_memory.reset()`` to drop that too.  Latency histograms
+    are pure counters and reset with everything else."""
     from .log import reset_rate_limits
 
     _PER_OP.clear()
     _COUNTERS.clear()
     _STORM.clear()
+    _histogram.reset()
     reset_rate_limits("recompile-storm:")
 
 
@@ -566,9 +611,11 @@ def reset():
 
 def diag_snapshot(top=20):
     """The full diagnostic picture as one JSON-serializable dict:
-    counters snapshot (with memory + costs), the top-``top`` roofline
-    rows, and each storming op's recent cache keys (repr'd) — what
-    ``BENCH_ROOFLINE.md`` reconstructs offline, captured live."""
+    counters snapshot (with memory + costs + latency histograms), the
+    top-``top`` roofline rows, each storming op's recent cache keys
+    (repr'd), and — under a distributed launch — this process's
+    rank/role identity, so per-rank dumps are attributable and
+    :func:`cluster_report` can merge them."""
     snap = snapshot()
     # the dump is "the full picture": swap in the UNtrimmed memory
     # breakdown (snapshot()'s default keeps report() tables short)
@@ -576,6 +623,7 @@ def diag_snapshot(top=20):
     storm_keys = {name: [repr(k) for k in list(st["keys"])]
                   for name, st in list(_STORM.items()) if st["keys"]}
     return {"version": 1, "pid": os.getpid(), "time": time.time(),
+            "identity": process_identity(),
             "snapshot": snap, "roofline": roofline(snap, top=top),
             "recent_storm_keys": storm_keys}
 
@@ -601,7 +649,36 @@ def dump_diag(path=None, top=20):
     with open(tmp, "w") as f:
         json.dump(diag_snapshot(top=top), f, indent=1, default=repr)
     os.replace(tmp, path)
+    _maybe_push_diag(top)
     return path
+
+
+def _maybe_push_diag(top):
+    """``MXNET_TPU_DIAG_PUSH``: after writing the local dump, also push
+    the snapshot to parameter-server shard 0 (``diag_put``) when a
+    dist_async kvstore was registered via
+    ``profiler.set_kvstore_handle`` — the operator can then pull every
+    rank's dump from one place (``kv.cluster_diag()`` /
+    ``tools/diagnose.py --cluster``) without touching worker
+    filesystems.  Best-effort: a dead server must never break a diag
+    dump."""
+    try:
+        if int(os.environ.get("MXNET_TPU_DIAG_PUSH") or 0) <= 0:
+            return
+    except ValueError:
+        return
+    try:
+        from . import profiler as _prof
+
+        kv = _prof._kvstore_handle
+        if kv is not None and hasattr(kv, "push_diag"):
+            kv.push_diag(top=top)
+    except Exception as e:
+        warn_rate_limited(
+            _logger(), "diag-push", 60,
+            "pushing the diag snapshot to the parameter server failed "
+            "(%s: %s) — the local dump was still written",
+            type(e).__name__, e)
 
 
 def _install_diag_handler(path):
@@ -659,24 +736,173 @@ def _activate_diag_from_env():
 
 
 _activate_diag_from_env()
+# deferred from histogram.py's import (its enable() writes this
+# module's DIAG_TIMING, so arming must wait until the global exists)
+_histogram._activate_from_env()
+
+
+# -------------------------------------------------- cluster aggregation
+
+
+# the latency metrics the cluster report tables and skew analysis read
+# out of each rank's histogram section, in straggler-priority order
+_CLUSTER_METRICS = ("kv:push_rtt", "kv:pull_rtt", "trainer:step",
+                    "io:next_batch")
+
+
+def load_dumps(paths):
+    """Load diag dumps for :func:`cluster_report`; a directory expands
+    to the ``*.json`` files inside it (sorted).  Each dump dict gains a
+    ``_path`` key for attribution in the rendered report."""
+    import glob
+
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.json"))))
+        else:
+            files.append(p)
+    dumps = []
+    for f in files:
+        with open(f) as fh:
+            d = json.load(fh)
+        d["_path"] = f
+        dumps.append(d)
+    return dumps
+
+
+def _rank_key(ident, fallback):
+    if not ident:
+        return fallback
+    return "%s %s" % (ident.get("role", "?"), ident.get("rank", "?"))
+
+
+def cluster_report(dumps):
+    """Merge per-rank diag dumps into one cluster view.
+
+    Returns ``{"ranks": [...], "merged": {...}, "skews": [...],
+    "straggler": {...}|None}``: a per-rank row (identity, step/push
+    counters, per-metric p50/p99), cluster-wide merged histograms
+    (associative bucket merge), per-metric skew — the slowest rank and
+    its p99 / median-p99 ratio — and the overall straggler callout (the
+    highest-ratio metric, push RTT first in ties by priority order).
+    Works on loaded dump dicts (:func:`load_dumps`) or raw snapshots."""
+    ranks = []
+    for i, d in enumerate(dumps):
+        snap = d.get("snapshot", d)
+        ident = d.get("identity") or snap.get("identity")
+        counters = snap.get("counters") or {}
+        ranks.append({
+            "key": _rank_key(ident, d.get("_path", "rank%d" % i)),
+            "identity": ident, "pid": d.get("pid"),
+            "path": d.get("_path"),
+            "steps": counters.get("trainer_steps", 0),
+            "pushes": counters.get("kvstore_pushes", 0),
+            "pulls": counters.get("kvstore_pulls", 0),
+            "retries": counters.get("kvstore_retries", 0),
+            "time": d.get("time") or 0,
+            "hists": snap.get("histograms") or {}})
+    # a dump directory may hold several generations of one rank's dump;
+    # keep only the newest per key — duplicate keys would make
+    # median_of_others exclude that rank twice and inflate the
+    # straggler ratio
+    newest: dict = {}
+    for r in ranks:
+        if r["key"] not in newest or r["time"] >= newest[r["key"]]["time"]:
+            newest[r["key"]] = r
+    ranks = list(newest.values())
+    names = set()
+    for r in ranks:
+        names.update(r["hists"])
+    merged = {n: _histogram.merge_snapshots(
+        [r["hists"][n] for r in ranks if n in r["hists"]])
+        for n in sorted(names)}
+    skews = []
+    for metric in _CLUSTER_METRICS:
+        rows = [(r, r["hists"][metric]) for r in ranks
+                if r["hists"].get(metric, {}).get("p99") is not None]
+        if len(rows) < 2:
+            continue
+        worst_rank, worst = max(rows, key=lambda rh: rh[1]["p99"])
+        # worst vs the median of the OTHER ranks (see
+        # histogram.median_of_others for why not the full median)
+        med = _histogram.median_of_others(
+            [(r["key"], h["p99"]) for r, h in rows], worst_rank["key"])
+        skews.append({"metric": metric, "rank": worst_rank["key"],
+                      "p50": worst["p50"], "p99": worst["p99"],
+                      "median_p99": med,
+                      "ratio": (worst["p99"] / med) if med else
+                      float("inf")})
+    straggler = max(skews, key=lambda s: s["ratio"]) if skews else None
+    return {"ranks": ranks, "merged": merged, "skews": skews,
+            "straggler": straggler}
+
+
+def render_cluster(report):
+    """Text tables for a :func:`cluster_report` result."""
+    ranks = report["ranks"]
+    lines = ["Cluster telemetry (%d rank dump(s))" % len(ranks),
+             "%-14s %7s %7s %7s %7s %10s %10s %10s %10s"
+             % ("Rank", "Steps", "Pushes", "Pulls", "Retries",
+                "Push p50", "Push p99", "Step p50", "Step p99")]
+    for r in sorted(ranks, key=lambda r: r["key"]):
+        push = r["hists"].get("kv:push_rtt") or {}
+        step = r["hists"].get("trainer:step") or {}
+        lines.append("%-14s %7d %7d %7d %7d %10s %10s %10s %10s"
+                     % (r["key"][:14], r["steps"], r["pushes"], r["pulls"],
+                        r["retries"], _fmt_ms(push.get("p50")),
+                        _fmt_ms(push.get("p99")), _fmt_ms(step.get("p50")),
+                        _fmt_ms(step.get("p99"))))
+    for s in report["skews"]:
+        lines.append("skew %-14s slowest %-12s p50 %sms p99 %sms = "
+                     "%.2fx the other ranks' median p99 (%sms)"
+                     % (s["metric"], s["rank"], _fmt_ms(s["p50"]),
+                        _fmt_ms(s["p99"]), s["ratio"],
+                        _fmt_ms(s["median_p99"])))
+    st = report["straggler"]
+    if st is not None and st["ratio"] > _histogram.STRAGGLER_RATIO:
+        lines.append("STRAGGLER: %s — %s p99 %sms is %.2fx the other "
+                     "ranks' median p99 (%sms); investigate that "
+                     "process/host (docs/OBSERVABILITY.md 'Distributed "
+                     "telemetry')"
+                     % (st["rank"], st["metric"], _fmt_ms(st["p99"]),
+                        st["ratio"], _fmt_ms(st["median_p99"])))
+    elif st is not None:
+        lines.append("slowest rank: %s (%s p99 %sms, %.2fx median — "
+                     "within the straggler threshold %.1fx)"
+                     % (st["rank"], st["metric"], _fmt_ms(st["p99"]),
+                        st["ratio"], _histogram.STRAGGLER_RATIO))
+    else:
+        lines.append("(no shared latency metric across >=2 dumps — "
+                     "run workers with MXNET_TPU_HISTOGRAMS=1)")
+    hist_lines = _render_hists(report["merged"])
+    hist_lines[1] = "Merged latency histograms — all ranks (ms)"
+    lines.extend(hist_lines)
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------- CLI
 
 
 def main(argv=None):
-    """``python -m mxnet_tpu.runtime_stats [dump.json]`` — pretty-print
-    a diag dump, or this process's live counters when no file is given
-    (useful at a debugger prompt / fresh REPL)."""
+    """``python -m mxnet_tpu.runtime_stats [dump.json ...]`` —
+    pretty-print a diag dump, this process's live counters when no file
+    is given (useful at a debugger prompt / fresh REPL), or — given
+    SEVERAL per-rank dumps (or a directory of them) — the merged
+    cluster report with the straggler callout."""
     import argparse
+    import sys
 
     p = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.runtime_stats",
         description="Pretty-print runtime telemetry: a MXNET_TPU_DIAG "
-                    "JSON dump, or the current process's counters.")
-    p.add_argument("dump", nargs="?", default=None,
-                   help="path of a diag dump written by dump_diag() / "
-                        "SIGUSR1; omit for the live in-process view")
+                    "JSON dump (several merge into a cluster report), "
+                    "or the current process's counters.")
+    p.add_argument("dump", nargs="*", default=None,
+                   help="diag dump(s) written by dump_diag() / SIGUSR1 "
+                        "(a directory expands to its *.json); two or "
+                        "more render the merged cluster report; omit "
+                        "for the live in-process view")
     p.add_argument("--top", type=int, default=20,
                    help="roofline rows to show from a dump")
     args = p.parse_args(argv)
@@ -691,11 +917,24 @@ def main(argv=None):
     _DIAG_STATE["armed"] = False
     _canonical._DIAG_STATE["armed"] = False
 
-    if args.dump is None:
+    if not args.dump:
         print(_canonical.report())
         return 0
-    with open(args.dump) as f:
-        data = json.load(f)
+    dumps = _canonical.load_dumps(args.dump)
+    if not dumps:
+        # a directory argument can expand to zero *.json files
+        print("no diag dumps found in: %s" % " ".join(args.dump),
+              file=sys.stderr)
+        return 2
+    if len(dumps) > 1:
+        print(_canonical.render_cluster(_canonical.cluster_report(dumps)))
+        return 0
+    data = dumps[0]
+    ident = data.get("identity")
+    if ident:
+        print("diag dump from %s %s (pid %s)"
+              % (ident.get("role", "?"), ident.get("rank", "?"),
+                 data.get("pid", "?")))
     snap = data.get("snapshot", data)
     if "ops" not in snap:
         # standalone flight-recorder dump (health.dump_flight / the

@@ -309,6 +309,41 @@ def test_disabled_checkpoint_step_overhead_bound():
         "disabled on_step must record nothing"
 
 
+def test_disabled_histogram_observe_overhead_bound():
+    """PR 7 gate: latency histograms must be pay-for-use.  With
+    collection disabled (the default), ``histogram.observe`` — the hook
+    the kvstore RTT / io / checkpoint / trainer feeds call — is ONE
+    dict read: no bucket math, no Histogram allocation.  The feeding
+    sites additionally guard BEFORE taking timestamps, so the off path
+    pays no clock reads either (asserted via zero recorded state)."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import histogram, runtime_stats
+
+    if os.environ.get("MXNET_TPU_HISTOGRAMS") == "1" \
+            or os.environ.get("MXNET_TPU_DIAG") \
+            or os.environ.get("MXNET_TPU_PROFILE"):
+        pytest.skip("histogram collection active in this run")
+    assert not histogram.is_enabled()
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            histogram.observe("bench", 0.001)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+    # the guard is one dict read (~0.1us); 10us tolerates slow shared
+    # CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "histogram.observe with collection off took %.2fus" % (best * 1e6)
+    assert histogram.snapshot() == {}, \
+        "disabled observe must record nothing"
+    assert "bench" not in runtime_stats.snapshot()["histograms"]
+
+
 def test_probe_relay_ping_short_circuits(monkeypatch):
     """A healthy relay answers the cheap liveness ping: ONE probe child,
     no full-timeout probes."""

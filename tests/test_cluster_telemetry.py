@@ -1,0 +1,544 @@
+"""Distributed telemetry (PR 7): server-side PS metrics, per-shard RTT
+histograms + straggler warning, rank identity, cluster aggregation
+(`diagnose --cluster`), merged multi-rank chrome traces, and the
+launcher's rank-suffixed observability env propagation."""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import histogram
+from tests.conftest import hermetic_subprocess_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_histograms():
+    # start each test from collection-off (the suite may run with
+    # MXNET_TPU_HISTOGRAMS/MXNET_TPU_PROFILE exported) and restore the
+    # ambient state afterwards
+    was_on = histogram.is_enabled()
+    histogram.disable()
+    histogram.reset()
+    yield
+    histogram.reset()
+    if was_on:
+        histogram.enable()
+    else:
+        histogram.disable()
+
+
+def _start_server(num_workers=1):
+    from mxnet_tpu.kvstore.ps import PSServer
+
+    srv = PSServer(port=0, num_workers=num_workers)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def _client_for(monkeypatch, *servers):
+    from mxnet_tpu.kvstore.ps import PSClient
+
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS",
+                       ",".join(str(s.port) for s in servers))
+    return PSClient()
+
+
+# ------------------------------------------------- server-side metrics
+
+
+def test_server_stats_command(monkeypatch):
+    from mxnet_tpu import optimizer
+
+    srv = _start_server()
+    c = _client_for(monkeypatch, srv)
+    try:
+        c.set_optimizer(pickle.dumps(optimizer.SGD(learning_rate=0.1)))
+        arr = np.ones((16,), dtype=np.float32)
+        c.init(3, arr)
+        for _ in range(5):
+            c.push(3, arr * 0.1)
+            c.pull(3)
+        stats = c.server_stats()
+        assert len(stats) == 1
+        s = stats[0]
+        assert s["role"] == "server"
+        assert s["requests"]["push"] == 5 and s["requests"]["pull"] == 5
+        per_key = s["per_key"]["3"]
+        assert per_key["push"] == 5 and per_key["pull"] == 5
+        # 16 f32 = 64 bytes per message
+        assert per_key["bytes_in"] == 64 * 6  # init + 5 pushes
+        assert per_key["bytes_out"] == 64 * 5
+        assert len(s["per_peer"]) == 1
+        assert list(s["per_peer"].values())[0] >= 12
+        assert s["apply"]["count"] == 5
+        assert s["handle"]["count"] >= 12
+        assert s["apply"]["p99"] is not None
+        assert s["queue_depth"] >= 0 and s["queue_depth_peak"] >= 1
+        assert s["connections_accepted"] == 1
+        assert s["keys"] == 1 and s["uptime_seconds"] > 0
+    finally:
+        c.stop_servers()
+
+
+def test_server_ping_clock_offset(monkeypatch):
+    srv = _start_server()
+    c = _client_for(monkeypatch, srv)
+    try:
+        offset, rtt = c.ping(0, samples=3)
+        # same host, same clock: the midpoint estimate is sub-RTT
+        assert rtt > 0
+        assert abs(offset) < max(rtt, 0.05)
+    finally:
+        c.stop_servers()
+
+
+def test_diag_put_get_roundtrip(monkeypatch):
+    srv = _start_server()
+    c = _client_for(monkeypatch, srv)
+    try:
+        for rank in (0, 2):
+            c.command_shard(0, "diag_put", json.dumps(
+                {"identity": {"role": "worker", "rank": rank},
+                 "snapshot": {"counters": {"trainer_steps": rank}}}))
+        got = c.command_shard(0, "diag_get")
+        assert sorted(got) == ["worker 0", "worker 2"]
+        parsed = json.loads(got["worker 2"])
+        assert parsed["snapshot"]["counters"]["trainer_steps"] == 2
+        # the stats payload lists which ranks have parked dumps
+        assert c.server_stats()[0]["rank_dumps"] == ["worker 0",
+                                                     "worker 2"]
+    finally:
+        c.stop_servers()
+
+
+# ------------------------------------ client RTT hists + live straggler
+
+
+def test_rtt_histograms_and_straggler_warning(monkeypatch):
+    """Two shards, shard 1 delayed via MXNET_TPU_FAULT: per-shard RTT
+    histograms diverge and the live check warns + counts exactly the
+    injected straggler."""
+    from mxnet_tpu import optimizer, runtime_stats
+    from mxnet_tpu.kvstore.ps import PSClient
+
+    srv0 = _start_server()
+    # 80ms: far above anything suite-load scheduling noise can add to
+    # the healthy shard's p99 (its rounds are loopback + a cached jit
+    # apply), so the >=3x ratio is deterministic even on a busy box
+    monkeypatch.setenv("MXNET_TPU_FAULT", "delay:0.08")
+    srv1 = _start_server()
+    monkeypatch.delenv("MXNET_TPU_FAULT")
+    histogram.enable()
+    monkeypatch.setattr(histogram, "STRAGGLER_MIN_SAMPLES", 8)
+    monkeypatch.setattr(PSClient, "_RTT_CHECK_EVERY", 16)
+    c = _client_for(monkeypatch, srv0, srv1)
+    try:
+        c.set_optimizer(pickle.dumps(optimizer.SGD(learning_rate=0.1)))
+        arr = np.ones((8,), dtype=np.float32)
+        c.init(0, arr)  # int keys shard by key % 2
+        c.init(1, arr)
+        # warm the server-side optimizer jit cache: the first apply
+        # compiles (~tens of ms) and would otherwise smear shard 0's
+        # RTT tail
+        c.push(0, arr)
+        c.push(1, arr)
+        histogram.reset()
+        from mxnet_tpu.log import reset_rate_limits
+
+        reset_rate_limits("kv-straggler")
+        base_warns = runtime_stats.snapshot()["counters"].get(
+            "kvstore_straggler_warnings", 0)
+        # 16 iterations = 32 RTT observations after the 2 warmups: the
+        # every-16th-observation live check fires at obs 32 with 15
+        # samples per shard, past the (monkeypatched) min of 8
+        for _ in range(16):
+            c.push(0, arr)
+            c.push(1, arr)
+        hists = histogram.snapshot()
+        assert hists["kv:push_rtt:shard0"]["count"] == 16
+        assert hists["kv:push_rtt:shard1"]["count"] == 16
+        assert hists["kv:push_rtt"]["count"] == 32
+        assert hists["kv:push_rtt:shard1"]["p50"] > \
+            hists["kv:push_rtt:shard0"]["p50"]
+        found = histogram.detect_straggler("kv:push_rtt:shard",
+                                           min_samples=8, ratio=3.0)
+        assert found is not None and found["name"] == \
+            "kv:push_rtt:shard1"
+        assert runtime_stats.snapshot()["counters"].get(
+            "kvstore_straggler_warnings", 0) > base_warns
+    finally:
+        c.stop_servers()
+
+
+def test_rtt_disabled_records_nothing(monkeypatch):
+    from mxnet_tpu import optimizer
+
+    srv = _start_server()
+    c = _client_for(monkeypatch, srv)
+    try:
+        assert not histogram.is_enabled()
+        c.set_optimizer(pickle.dumps(optimizer.SGD(learning_rate=0.1)))
+        arr = np.ones((8,), dtype=np.float32)
+        c.init(0, arr)
+        c.push(0, arr)
+        assert "kv:push_rtt" not in histogram.snapshot()
+    finally:
+        c.stop_servers()
+
+
+# --------------------------------------------------- dist_async facade
+
+
+def test_dist_async_facade_telemetry(monkeypatch):
+    """DistAsyncKVStore surfaces server_stats / push_diag /
+    cluster_diag / estimate_clock_offset, and registers itself as the
+    profiler's server-command channel."""
+    from mxnet_tpu import kvstore, optimizer, profiler
+
+    srv = _start_server()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    kv = kvstore.create("dist_async")
+    try:
+        assert profiler._kvstore_handle is kv
+        from mxnet_tpu import nd
+
+        kv.init("w", nd.ones((4,)))
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.1))
+        kv.push("w", nd.ones((4,)))
+        stats = kv.server_stats()
+        assert len(stats) == 1 and stats[0]["requests"]["push"] >= 1
+        assert kv.push_diag() is True
+        cluster = kv.cluster_diag()
+        assert "worker 0" in cluster
+        assert cluster["worker 0"]["identity"]["rank"] == 0
+        offset = kv.estimate_clock_offset()
+        assert offset is not None and abs(offset) < 1.0
+        assert profiler._state["clock_offset"] == offset
+    finally:
+        kv.stop_servers()
+        profiler.set_kvstore_handle(None)
+        profiler._state["clock_offset"] = None
+
+
+# ------------------------------------------------------ rank identity
+
+
+def test_process_identity_and_warn_prefix(monkeypatch, capsys):
+    from mxnet_tpu import log
+
+    assert log.process_identity() is None or "DMLC_ROLE" in os.environ
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "3")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "4")
+    ident = log.process_identity()
+    assert ident == {"role": "worker", "rank": 3, "num_workers": 4}
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("MXTPU_PS_SERVER_ID", "1")
+    assert log.process_identity()["role"] == "server"
+    assert log.process_identity()["rank"] == 1
+    # rate-limited warnings carry the identity tag
+    logger = log.get_logger("mxtpu.test.identity")
+    log.reset_rate_limits("ident-test")
+    assert log.warn_rate_limited(logger, "ident-test", 60,
+                                 "something %s", "broke")
+    err = capsys.readouterr().err
+    assert "[server 1] something broke" in err
+
+
+def test_diag_dump_carries_identity(monkeypatch, tmp_path):
+    from mxnet_tpu import runtime_stats
+
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "2")
+    path = runtime_stats.dump_diag(str(tmp_path / "d.json"))
+    data = json.load(open(path))
+    assert data["identity"] == {"role": "worker", "rank": 2,
+                                "num_workers": 1}
+    assert data["snapshot"]["identity"]["rank"] == 2
+
+
+# --------------------------------------------------- launcher satellite
+
+
+def test_launch_rank_suffixes_observability_env(monkeypatch, tmp_path):
+    """launch.py hands every worker/server its OWN trace/diag/flight
+    file path, so a distributed run is traceable without manual env
+    plumbing."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    seen = []
+
+    class _FakeProc:
+        returncode = 0
+
+        def __init__(self, cmd, env=None):
+            seen.append((cmd, env))
+
+        def wait(self, timeout=None):
+            return 0
+
+    monkeypatch.setattr(launch.subprocess, "Popen", _FakeProc)
+    monkeypatch.setenv("MXNET_TPU_PROFILE", str(tmp_path / "trace.json"))
+    monkeypatch.setenv("MXNET_TPU_DIAG", str(tmp_path / "diag.json"))
+    monkeypatch.setenv("MXNET_TPU_HEALTH_DUMP",
+                       str(tmp_path / "flight.json"))
+    monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    rc = launch.main(["-n", "2", "-s", "1", "python", "train.py"])
+    assert rc == 0
+    assert len(seen) == 3  # 1 server + 2 workers
+    server_env = seen[0][1]
+    assert server_env["MXNET_TPU_PROFILE"].endswith("trace.server0.json")
+    assert server_env["MXNET_TPU_DIAG"].endswith("diag.server0.json")
+    for rank in (0, 1):
+        env = seen[1 + rank][1]
+        assert env["DMLC_WORKER_ID"] == str(rank)
+        assert env["MXNET_TPU_PROFILE"].endswith(
+            "trace.worker%d.json" % rank)
+        assert env["MXNET_TPU_DIAG"].endswith("diag.worker%d.json" % rank)
+        assert env["MXNET_TPU_HEALTH_DUMP"].endswith(
+            "flight.worker%d.json" % rank)
+        # flag-valued vars propagate untouched
+        assert env["MXNET_TPU_HEALTH"] == "1"
+
+
+# ------------------------------------------------- merged chrome traces
+
+
+def _spawn_profiled_worker(rank, trace_path):
+    # no DMLC_NUM_WORKER: >1 would join jax.distributed at import,
+    # which this container's jax lacks (the known-red dist gap) — the
+    # identity contract only needs role + rank
+    env = hermetic_subprocess_env(REPO)
+    env.update({"MXNET_TPU_PROFILE": str(trace_path),
+                "DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(rank)})
+    return subprocess.Popen(
+        [sys.executable, "-c",
+         "import mxnet_tpu as mx; x = mx.nd.ones((4, 4)); "
+         "mx.nd.clip(x, -1.0, 1.0)"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+
+
+def test_rank_tagged_traces_merge(tmp_path):
+    """Per-rank MXNET_TPU_PROFILE files carry rank-tagged pids + the
+    mxtpu clock header, and merge_traces folds them into one trace
+    holding every rank's spans under labelled tracks."""
+    procs = [_spawn_profiled_worker(r, tmp_path / ("t%d.json" % r))
+             for r in (0, 1)]
+    for p in procs:
+        _, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err.decode()
+    d0 = json.load(open(tmp_path / "t0.json"))
+    assert d0["mxtpu"]["role"] == "worker" and d0["mxtpu"]["rank"] == 0
+    assert d0["mxtpu"]["perf_anchor_us"] > 0
+    assert {e["pid"] for e in d0["traceEvents"]} == {0}
+    d1 = json.load(open(tmp_path / "t1.json"))
+    assert {e["pid"] for e in d1["traceEvents"]} == {1}
+
+    from mxnet_tpu import profiler
+
+    out = profiler.merge_traces(
+        [str(tmp_path / "t0.json"), str(tmp_path / "t1.json")],
+        out=str(tmp_path / "merged.json"))
+    m = json.load(open(out))
+    pids = {e["pid"] for e in m["traceEvents"]}
+    assert {0, 1} <= pids
+    names = {e["args"]["name"] for e in m["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert any(n.startswith("worker 0") for n in names)
+    assert any(n.startswith("worker 1") for n in names)
+    ts = [e["ts"] for e in m["traceEvents"] if "ts" in e]
+    assert min(ts) == 0.0
+    # both ranks' dispatch spans present in ONE file
+    span_pids = {e["pid"] for e in m["traceEvents"]
+                 if str(e.get("name", "")).startswith("dispatch:")}
+    assert span_pids == {0, 1}
+
+
+def test_merge_traces_headerless_files_survive(tmp_path):
+    from mxnet_tpu import profiler
+
+    for i in (0, 1):
+        with open(tmp_path / ("h%d.json" % i), "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "x", "cat": "c", "ph": "X", "ts": 10.0 + i,
+                 "dur": 1.0, "pid": 0, "tid": 1}]}, f)
+    out = profiler.merge_traces(
+        [str(tmp_path / "h0.json"), str(tmp_path / "h1.json")],
+        out=str(tmp_path / "m.json"))
+    m = json.load(open(out))
+    assert len(m["traceEvents"]) == 2
+    # colliding pid 0 remapped so each file keeps its own track
+    assert len({e["pid"] for e in m["traceEvents"]}) == 2
+
+
+def test_merge_traces_clock_offset_sign(tmp_path):
+    """Pin the offset sign: PSClient.ping computes offset as
+    server_minus_client, so a rank whose clock is 1s BEHIND the
+    reference (offset = +1e6 µs) must land 1s LATER on the merged
+    timeline — identical local timestamps, identical anchors, only the
+    offset differs."""
+    from mxnet_tpu import profiler
+
+    for rank, off in ((0, 0.0), (1, 1e6)):
+        with open(tmp_path / ("c%d.json" % rank), "w") as f:
+            json.dump({"traceEvents": [
+                {"name": "x", "cat": "c", "ph": "X", "ts": 50.0,
+                 "dur": 1.0, "pid": rank, "tid": 1}],
+                "mxtpu": {"role": "worker", "rank": rank,
+                          "perf_anchor_us": 0.0,
+                          "wall_anchor_us": 1000.0,
+                          "clock_offset_us": off}}, f)
+    out = profiler.merge_traces(
+        [str(tmp_path / "c0.json"), str(tmp_path / "c1.json")],
+        out=str(tmp_path / "m.json"))
+    m = json.load(open(out))
+    ts = {e["pid"]: e["ts"] for e in m["traceEvents"] if "ts" in e}
+    assert ts[0] == 0.0           # reference rank anchors the timeline
+    assert ts[1] == pytest.approx(1e6)  # lagging rank shifted LATER
+
+
+# ------------------------------------- cluster aggregation (acceptance)
+
+
+_WORKER_SCRIPT = r"""
+import json, os, pickle, sys, threading
+import numpy as np
+
+rank = int(os.environ["TEST_RANK"])
+delay = os.environ.get("TEST_DELAY")
+if delay:
+    os.environ["MXNET_TPU_FAULT"] = "delay:" + delay
+from mxnet_tpu.kvstore.ps import PSServer, PSClient
+
+srv = PSServer(port=0, num_workers=1)
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+os.environ.pop("MXNET_TPU_FAULT", None)
+os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+os.environ["MXTPU_PS_PORTS"] = str(srv.port)
+os.environ["DMLC_ROLE"] = "worker"
+os.environ["DMLC_WORKER_ID"] = str(rank)
+
+from mxnet_tpu import histogram, optimizer, runtime_stats
+
+assert histogram.is_enabled()  # MXNET_TPU_HISTOGRAMS=1 from the parent
+c = PSClient()
+c.set_optimizer(pickle.dumps(optimizer.SGD(learning_rate=0.1)))
+arr = np.ones((32,), dtype=np.float32)
+c.init(0, arr)
+for _ in range(12):
+    c.push(0, arr * 0.01)
+    c.pull(0)
+c.stop_servers()
+runtime_stats.dump_diag(os.environ["TEST_OUT"])
+"""
+
+
+def test_cluster_diagnose_names_injected_straggler(tmp_path):
+    """Acceptance: >= 3 per-rank dumps, one rank's PS delayed via
+    MXNET_TPU_FAULT=delay:… — `tools/diagnose.py --cluster` names the
+    injected straggler and reports push-RTT p50/p99 skew; the
+    runtime_stats CLI renders the same merged view."""
+    procs = []
+    dumps = []
+    for rank in range(3):
+        out = tmp_path / ("rank%d.json" % rank)
+        dumps.append(str(out))
+        env = hermetic_subprocess_env(REPO)
+        env.update({"TEST_RANK": str(rank), "TEST_OUT": str(out),
+                    "MXNET_TPU_HISTOGRAMS": "1"})
+        if rank == 2:
+            # large enough that the healthy ranks' p99 (loopback +
+            # cached apply, but on a loaded CI box) stays >3x below
+            env["TEST_DELAY"] = "0.08"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SCRIPT], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        _, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err.decode()
+
+    # per-rank dumps carry identity + push-RTT histograms
+    d2 = json.load(open(dumps[2]))
+    assert d2["identity"]["rank"] == 2
+    assert d2["snapshot"]["histograms"]["kv:push_rtt"]["count"] == 12
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--cluster"] + dumps,
+        capture_output=True, text=True, env=hermetic_subprocess_env(REPO),
+        cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "Cluster telemetry (3 rank dump(s))" in out
+    assert "STRAGGLER: worker 2" in out
+    assert "kv:push_rtt" in out
+    assert "Push p50" in out and "Push p99" in out
+    # skew line quantifies p99 vs the other ranks' median
+    assert "the other ranks' median p99" in out
+
+    # the runtime_stats CLI renders the same cluster view from N dumps
+    r2 = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.runtime_stats"] + dumps,
+        capture_output=True, text=True, env=hermetic_subprocess_env(REPO),
+        cwd=REPO, timeout=300)
+    assert r2.returncode == 0, r2.stderr
+    assert "STRAGGLER: worker 2" in r2.stdout
+    assert "Merged latency histograms" in r2.stdout
+
+
+def test_cluster_report_in_process(monkeypatch, tmp_path):
+    """cluster_report over synthetic per-rank dumps: merged histogram
+    counts are the rank sums and the straggler ratio is vs the other
+    ranks' median."""
+    from mxnet_tpu import runtime_stats
+
+    paths = []
+    for rank, lat in ((0, 0.001), (1, 0.001), (2, 0.02)):
+        histogram.reset()
+        histogram.enable()
+        monkeypatch.setenv("DMLC_ROLE", "worker")
+        monkeypatch.setenv("DMLC_WORKER_ID", str(rank))
+        for _ in range(40):
+            histogram.observe("kv:push_rtt", lat)
+        p = str(tmp_path / ("r%d.json" % rank))
+        runtime_stats.dump_diag(p)
+        paths.append(p)
+    report = runtime_stats.cluster_report(runtime_stats.load_dumps(paths))
+    assert len(report["ranks"]) == 3
+    assert report["merged"]["kv:push_rtt"]["count"] == 120
+    st = report["straggler"]
+    assert st["metric"] == "kv:push_rtt" and st["rank"] == "worker 2"
+    assert st["ratio"] > 3
+    text = runtime_stats.render_cluster(report)
+    assert "STRAGGLER: worker 2" in text
+
+
+def test_checkpoint_write_histogram(tmp_path):
+    from mxnet_tpu import checkpoint, nd
+
+    histogram.enable()
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ckpt"),
+                                       async_write=False)
+    mgr.save(1, {"w": nd.ones((4,))})
+    mgr.close()
+    snap = histogram.snapshot()
+    assert snap["checkpoint:write"]["count"] == 1
+    assert snap["checkpoint:write"]["sum"] > 0

@@ -1,0 +1,210 @@
+"""Histogram primitive: bucket math, exact aggregates, derived
+percentiles, associative merging, and the runtime_stats wiring
+(PR 7 distributed telemetry)."""
+
+import json
+import math
+
+import pytest
+
+from mxnet_tpu import histogram
+from mxnet_tpu.histogram import Histogram, bucket_bounds, bucket_index
+
+
+@pytest.fixture(autouse=True)
+def _clean_histograms():
+    """Each test starts and ends with collection off and no state."""
+    was_on = histogram.is_enabled()
+    histogram.reset()
+    histogram.disable()
+    yield
+    histogram.reset()
+    if was_on:
+        histogram.enable()
+    else:
+        histogram.disable()
+
+
+# ------------------------------------------------------------- buckets
+
+
+def test_bucket_boundaries_powers_of_two():
+    # bucket e covers [2^(e-1), 2^e): an exact power of two opens its
+    # own bucket; anything just below lands one bucket down
+    for k in (-10, -3, 0, 1, 7):
+        v = math.ldexp(1.0, k)  # 2^k
+        b = bucket_index(v)
+        lo, hi = bucket_bounds(b)
+        assert lo == v and hi == 2 * v
+        assert bucket_index(math.nextafter(v, 0.0)) == b - 1
+
+
+def test_bucket_zero_and_negative():
+    assert bucket_index(0.0) == histogram._ZERO_BUCKET
+    assert bucket_index(-1.0) == histogram._ZERO_BUCKET
+    assert bucket_bounds(histogram._ZERO_BUCKET) == (0.0, 0.0)
+
+
+def test_bucket_subnormal_still_finite_bucket():
+    tiny = 5e-324  # smallest positive subnormal
+    b = bucket_index(tiny)
+    lo, hi = bucket_bounds(b)
+    assert lo <= tiny < hi
+    assert b > histogram._ZERO_BUCKET
+
+
+# ----------------------------------------------------- exact aggregates
+
+
+def test_exact_count_sum_min_max():
+    h = Histogram()
+    vals = [0.001, 0.004, 0.25, 0.25, 3.0]
+    for v in vals:
+        h.observe(v)
+    assert h.count == len(vals)
+    assert h.total == pytest.approx(sum(vals))
+    assert h.min == min(vals)
+    assert h.max == max(vals)
+    snap = h.snapshot()
+    assert snap["count"] == len(vals)
+    assert snap["mean"] == pytest.approx(sum(vals) / len(vals))
+
+
+def test_percentiles_exact_on_uniform_samples():
+    # all samples share one value -> the min/max-tightened bucket
+    # degenerates to a point and every percentile is EXACT
+    h = Histogram()
+    for _ in range(100):
+        h.observe(0.25)
+    for q in (1, 50, 90, 99, 100):
+        assert h.percentile(q) == 0.25
+
+
+def test_percentiles_known_mixed_samples():
+    # 50x 1ms-bucket + 40x 2ms-bucket + 10x 8ms-bucket: hand-computed
+    # interpolation (p50 sits at the full first bucket -> its hi bound)
+    h = Histogram()
+    for v in [0.001] * 50 + [0.002] * 40 + [0.008] * 10:
+        h.observe(v)
+    lo1, hi1 = bucket_bounds(bucket_index(0.001))
+    assert h.percentile(50) == pytest.approx(hi1)
+    # monotonic and within one bucket (factor 2) of the true order stat
+    p50, p90, p99 = h.percentile(50), h.percentile(90), h.percentile(99)
+    assert p50 <= p90 <= p99 <= h.max
+    assert 0.001 <= p50 <= 0.002
+    assert 0.004 <= p99 <= 0.008
+
+
+def test_percentile_empty_is_none():
+    assert Histogram().percentile(50) is None
+    snap = Histogram().snapshot()
+    assert snap["p50"] is None and snap["min"] is None
+
+
+# -------------------------------------------------------------- merging
+
+
+def _mk(vals):
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    return h
+
+
+def test_merge_matches_pooled_observation():
+    a, b = _mk([0.001, 0.004]), _mk([0.25, 1.0, 4.0])
+    pooled = _mk([0.001, 0.004, 0.25, 1.0, 4.0])
+    assert a.merge(b).snapshot() == pooled.snapshot()
+
+
+def test_merge_associativity():
+    # exact binary floats -> bit-identical sums in either grouping
+    sets = ([0.5, 0.25], [1.0, 2.0, 0.125], [4.0])
+    left = _mk(sets[0]).merge(_mk(sets[1])).merge(_mk(sets[2]))
+    right = _mk(sets[0]).merge(_mk(sets[1]).merge(_mk(sets[2])))
+    assert left.snapshot() == right.snapshot()
+
+
+def test_merge_snapshots_after_json_roundtrip():
+    # bucket keys become strings through JSON; merge must survive that
+    snaps = [json.loads(json.dumps(_mk([0.001] * 10).snapshot())),
+             json.loads(json.dumps(_mk([0.016] * 10).snapshot()))]
+    merged = histogram.merge_snapshots(snaps)
+    assert merged["count"] == 20
+    assert merged["min"] == 0.001 and merged["max"] == 0.016
+    assert merged["p50"] <= merged["p99"]
+
+
+# ----------------------------------------------------- registry + guard
+
+
+def test_observe_disabled_is_noop():
+    histogram.observe("x", 1.0)
+    assert histogram.snapshot() == {}
+
+
+def test_enable_raises_dispatch_timing():
+    from mxnet_tpu import runtime_stats
+
+    histogram.enable()
+    assert runtime_stats.DIAG_TIMING
+    histogram.observe("x", 0.5)
+    assert histogram.snapshot()["x"]["count"] == 1
+    histogram.disable()
+    import os
+
+    assert runtime_stats.DIAG_TIMING == bool(os.environ.get(
+        "MXNET_TPU_DIAG"))
+
+
+def test_runtime_stats_snapshot_and_report_carry_histograms():
+    from mxnet_tpu import runtime_stats
+
+    histogram.enable()
+    for v in (0.001, 0.002, 0.004):
+        histogram.observe("bench:lat", v)
+    snap = runtime_stats.snapshot()
+    assert snap["histograms"]["bench:lat"]["count"] == 3
+    rep = runtime_stats.report()
+    assert "Latency histograms" in rep and "bench:lat" in rep
+
+
+def test_report_without_histograms_says_how_to_enable():
+    from mxnet_tpu import runtime_stats
+
+    assert "MXNET_TPU_HISTOGRAMS" in runtime_stats.report()
+
+
+# ---------------------------------------------------------- stragglers
+
+
+def test_detect_straggler_names_slow_shard():
+    histogram.enable()
+    for shard, lat in ((0, 0.001), (1, 0.001), (2, 0.02)):
+        for _ in range(40):
+            histogram.observe("rtt:shard%d" % shard, lat)
+    found = histogram.detect_straggler("rtt:shard", min_samples=32,
+                                       ratio=3.0)
+    assert found is not None
+    assert found["name"] == "rtt:shard2"
+    assert found["ratio"] > 3.0
+    assert found["p99"] == pytest.approx(0.02)
+
+
+def test_detect_straggler_even_shards_quiet():
+    histogram.enable()
+    for shard in range(3):
+        for _ in range(40):
+            histogram.observe("even:shard%d" % shard, 0.001)
+    assert histogram.detect_straggler("even:shard") is None
+
+
+def test_detect_straggler_needs_min_samples_and_two_shards():
+    histogram.enable()
+    for _ in range(100):
+        histogram.observe("one:shard0", 0.001)
+    assert histogram.detect_straggler("one:shard") is None
+    for _ in range(5):
+        histogram.observe("one:shard1", 1.0)
+    assert histogram.detect_straggler("one:shard",
+                                      min_samples=32) is None

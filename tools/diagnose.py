@@ -44,6 +44,16 @@ def parse_args():
                    help='Render only the numerics-health / flight-recorder '
                         'section of the dump (works on full diag dumps and on '
                         'standalone flight-recorder dumps).')
+    p.add_argument('--cluster', nargs='+', metavar='DUMP',
+                   help='Merge several per-rank MXNET_TPU_DIAG dumps (files '
+                        'or a directory of *.json) into one cluster report: '
+                        'per-rank latency table, merged histograms, and the '
+                        'straggler callout with p99/median skew.')
+    p.add_argument('--merge-traces', nargs='+', metavar='TRACE',
+                   help='Merge per-rank MXNET_TPU_PROFILE chrome traces into '
+                        'one clock-aligned file (see --out).')
+    p.add_argument('--out', default='merged_trace.json',
+                   help='Output path for --merge-traces.')
     p.add_argument('--network', default=0, type=int,
                    help='Diagnose network (off by default: many TPU pods have no egress).')
     p.add_argument('--timeout', default=10, type=int,
@@ -210,8 +220,35 @@ def check_network(timeout):
         test_connection(name, url, timeout)
 
 
+def check_cluster(paths):
+    """Merged multi-rank view: fold per-rank diag dumps into one report
+    naming the slowest rank and quantifying the p99/median latency skew
+    (docs/OBSERVABILITY.md 'Distributed telemetry')."""
+    _section('Cluster Telemetry')
+    from mxnet_tpu import runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    dumps = runtime_stats.load_dumps(paths)
+    if not dumps:
+        print('no diag dumps found in: %s' % ' '.join(paths))
+        return
+    print(runtime_stats.render_cluster(runtime_stats.cluster_report(dumps)))
+
+
+def merge_traces(paths, out):
+    from mxnet_tpu import profiler
+    merged = profiler.merge_traces(paths, out=out)
+    print('Merged trace :', merged)
+
+
 def main():
     args = parse_args()
+    if args.cluster or args.merge_traces:
+        # focused distributed-telemetry views: skip the platform sections
+        if args.cluster:
+            check_cluster(args.cluster)
+        if args.merge_traces:
+            merge_traces(args.merge_traces, args.out)
+        return
     if args.health:
         # focused view for numerics triage: skip the platform sections
         check_telemetry(args.diag, health_only=True)

@@ -29,6 +29,27 @@ def free_port():
     return port
 
 
+# observability env vars whose value is a FILE PATH: every spawned
+# process gets its own rank-suffixed copy, so a distributed run is
+# traceable end-to-end without manual env plumbing (per-rank trace /
+# diag-dump / flight-dump files merge later via
+# `tools/diagnose.py --cluster` / `--merge-traces`)
+_PATH_ENVS = ("MXNET_TPU_PROFILE", "MXNET_TPU_DIAG",
+              "MXNET_TPU_HEALTH_DUMP")
+
+
+def rank_suffix_observability(env, role, rank):
+    """Rewrite the path-valued observability vars in ``env`` to
+    ``<base>.<role><rank><ext>`` (flag-valued vars like
+    MXNET_TPU_HEALTH=1 are inherited untouched)."""
+    for var in _PATH_ENVS:
+        val = env.get(var)
+        if val:
+            base, ext = os.path.splitext(val)
+            env[var] = "%s.%s%d%s" % (base, role, rank, ext)
+    return env
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Launch a distributed job locally",
@@ -65,6 +86,7 @@ def main(argv=None):
                         # the PS is numpy/host-side; keep jax off any
                         # accelerator the workers may be using
                         "JAX_PLATFORMS": "cpu"})
+            rank_suffix_observability(env, "server", sid)
             server_procs.append(subprocess.Popen(
                 [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env))
 
@@ -73,6 +95,7 @@ def main(argv=None):
         env = dict(os.environ)
         env.update(common)
         env.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(rank)})
+        rank_suffix_observability(env, "worker", rank)
         procs.append(subprocess.Popen(args.command, env=env))
     rc = 0
     for p in procs:
