@@ -2,8 +2,10 @@
 trace shows the full step anatomy (dispatch cache hit/miss, io,
 autograd, trainer) AND a live/peak device-memory timeline, plus the
 always-on runtime_stats counters, per-op XLA cost analytics, the
-recompile-storm detector, and the numerics health layer (device-side
-grad-norm/NaN sentinels, flight recorder, first-NaN warning + dump).
+recompile-storm detector, the numerics health layer (device-side
+grad-norm/NaN sentinels, flight recorder, first-NaN warning + dump),
+and the PR-8 analysis layer: per-step phase attribution (stepstats),
+the perf doctor's ranked findings, and the dump-diff regression report.
 
 Run directly (the script activates the profiler, buffer tracker, and
 health monitor itself), or with zero code changes on any script via
@@ -12,6 +14,7 @@ the env vars:
     MXNET_TPU_PROFILE=trace.json python your_train.py
     MXNET_TPU_DIAG=diag.json     python your_train.py   # + kill -USR1
     MXNET_TPU_HEALTH=1           python your_train.py
+    MXNET_TPU_STEPSTATS=1        python your_train.py   # step anatomy
 
 Docs: docs/OBSERVABILITY.md.
 """
@@ -20,12 +23,13 @@ import argparse
 import json
 import os
 import tempfile
+import time
 
 import numpy as np
 
 import mxnet_tpu as mx
-from mxnet_tpu import (autograd, device_memory, gluon, health, profiler,
-                       runtime_stats)
+from mxnet_tpu import (autograd, device_memory, gluon, health, perfdoctor,
+                       profiler, runtime_stats, stepstats)
 
 
 def main(argv=None):
@@ -46,6 +50,9 @@ def main(argv=None):
     runtime_stats.reset()
     device_memory.reset()
     device_memory.start()
+    # per-step phase attribution: where each iteration's wall time goes
+    # (data wait / forward / backward / update / ... / remainder)
+    stepstats.enable()
 
     # ---- a small imperative training loop, fully instrumented; the
     # health monitor computes grad-norm/NaN sentinels ON DEVICE and the
@@ -119,6 +126,52 @@ def main(argv=None):
         tempfile.gettempdir(), "runtime_telemetry_diag.json"))
     print("\ndiag dump: %s (pretty-print: python -m "
           "mxnet_tpu.runtime_stats %s)" % (diag, diag))
+
+    # ---- the perf doctor: ranked findings over the dump.  This run
+    # deliberately provoked a recompile storm above, so the doctor must
+    # rank it first with the churned attr as evidence.  CLI equivalent:
+    #   python tools/diagnose.py --doctor <diag.json> [<trace.json>]
+    ss = stepstats.snapshot()
+    assert ss["steps"] == args.steps - 1  # first window arms the clock
+    print("\nperf doctor on this run's dump:")
+    _kind, dump = perfdoctor.classify(diag)
+    findings = perfdoctor.diagnose(dump=dump)
+    print(perfdoctor.render(findings, inputs=[diag]))
+    assert any(f["rule"] == "recompile-storm" for f in findings), \
+        "the provoked storm must be diagnosed"
+
+    # ---- dump-diff regression report: rerun the same loop with a
+    # delayed iterator and let compare() name the regressed phase.
+    # CLI equivalent (rc=1 on regression, JSON verdict line for CI):
+    #   python tools/diagnose.py --compare base.json slow.json
+    runtime_stats.reset()
+    stepstats.enable()
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch_size)
+    orig_next = it.next
+
+    def slow_next():
+        time.sleep(0.005)  # the injected input-pipeline regression
+        return orig_next()
+
+    it.next = slow_next
+    for batch in it:
+        with autograd.record():
+            loss = loss_fn(net(batch.data[0]), batch.label[0])
+        loss.backward()
+        trainer.step(batch_size)
+    slow = runtime_stats.dump_diag(os.path.join(
+        tempfile.gettempdir(), "runtime_telemetry_diag_slow.json"))
+    a, b = runtime_stats.load_dumps([diag, slow])
+    result = runtime_stats.compare(a, b, threshold=0.75)
+    print("\ndump-diff (baseline vs delayed-io rerun):")
+    print(runtime_stats.render_compare(result))
+    assert result["verdict"] == "regression"
+    assert any(e["metric"] == "phase:data_wait"
+               for e in result["regressions"]), \
+        "the injected io delay must be named"
+    # leave global collection off for any in-process caller (tests run
+    # this example inside the suite)
+    stepstats.disable()
     return path
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 
 from . import profiler as _profiler
+from . import stepstats as _stepstats
 from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
@@ -112,9 +113,13 @@ class _DualScope:
 
 class _RecordScope(_DualScope):
     """record() scope with a profiler span over the recorded region —
-    the forward boundary of the training-step anatomy in traces."""
+    the forward boundary of the training-step anatomy in traces, and
+    the ``forward`` container phase of the step-time attribution
+    (exclusive of nested compile/dispatch feeds; stepstats.py)."""
 
     def __enter__(self):
+        self._ss_tok = _stepstats.begin() \
+            if _stepstats._state["on"] else None
         self._span = _profiler.span("autograd:record", "autograd")
         self._span.__enter__()
         return super().__enter__()
@@ -122,6 +127,8 @@ class _RecordScope(_DualScope):
     def __exit__(self, *a):
         r = super().__exit__(*a)
         self._span.__exit__(*a)
+        if self._ss_tok is not None:
+            _stepstats.end("forward", self._ss_tok)
         return r
 
 
@@ -290,11 +297,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         heads = [heads]
     if head_grads is not None and not isinstance(head_grads, (list, tuple)):
         head_grads = [head_grads]
+    ss_tok = _stepstats.begin() if _stepstats._state["on"] else None
     with _profiler.span("autograd:backward", "autograd",
                         args={"n_heads": len(heads)}
                         if _profiler._state["running"] else None):
         _backward_impl(heads, head_grads, retain_graph,
                        accumulate_to_vars=True)
+    if ss_tok is not None:
+        _stepstats.end("backward", ss_tok)
 
 
 def _reachable_entries(tape, head_nodes):

@@ -74,6 +74,7 @@ import time
 
 from . import histogram as _histogram
 from . import runtime_stats as _rts
+from . import stepstats as _stepstats
 from .log import get_logger, warn_rate_limited
 
 __all__ = ["atomic_write", "CheckpointManager", "enable", "disable",
@@ -799,7 +800,16 @@ def on_step(trainer):
     mgr = _GLOBAL[0]
     mgr.step_clock += 1
     if mgr.interval and mgr.step_clock % mgr.interval == 0:
+        # step-anatomy checkpoint_write phase: the TRAINING-thread cost
+        # only (async mode: the device-reference capture; sync mode:
+        # the full write).  The background writer's commit time stays
+        # in the checkpoint:write histogram, not in any step's window.
+        ss_on = _stepstats._state["on"]
+        if ss_on:
+            ss_tok = _stepstats.begin()
         mgr.save_trainer(trainer, step=mgr.step_clock)
+        if ss_on:
+            _stepstats.end("checkpoint_write", ss_tok)
 
 
 def auto_resume(trainer=None, block=None):

@@ -24,6 +24,7 @@ from . import device_memory as _dm
 from . import health as _health
 from . import profiler as _profiler
 from . import runtime_stats as _rts
+from . import stepstats as _stepstats
 from .base import MXNetError
 from .ndarray import NDArray
 from .ops import registry as _reg
@@ -269,11 +270,16 @@ class Executor:
             return
         arg_vals, aux_vals, seed, is_train = self._fwd_state
         fwd, _bwd, _d = self._get_fns(is_train)
+        ss_tok = _stepstats.begin() if _stepstats._state["on"] else None
         try:
             with _profiler.span("executor:forward", "executor",
                                 args={"is_train": is_train}
                                 if _profiler._state["running"] else None):
                 outs, new_aux = fwd(arg_vals, aux_vals, seed)
+            if ss_tok is not None:
+                # symbolic forward: same step-anatomy phase the Gluon
+                # autograd.record() container feeds (stepstats.py)
+                _stepstats.end("forward", ss_tok)
         except (TypeError, ValueError, RuntimeError) as e:
             # surface graph-execution failures as MXNetError (reference:
             # engine errors reach WaitForVar/asnumpy as MXNetError).
@@ -321,9 +327,12 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             ogs = [g._data if isinstance(g, NDArray) else g for g in out_grads]
+        ss_tok = _stepstats.begin() if _stepstats._state["on"] else None
         try:
             with _profiler.span("executor:backward", "executor"):
                 outs, new_aux, dargs = bwd(arg_vals, aux_vals, seed, ogs)
+            if ss_tok is not None:
+                _stepstats.end("backward", ss_tok)
         except (TypeError, ValueError, RuntimeError) as e:
             raise MXNetError("executor backward: %s" % e) from e
         if self._outputs is None:

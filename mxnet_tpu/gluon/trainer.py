@@ -22,6 +22,7 @@ from .. import kvstore as _kvstore
 from .. import optimizer as _optimizer
 from .. import profiler as _profiler
 from .. import runtime_stats as _rts
+from .. import stepstats as _stepstats
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
@@ -175,6 +176,12 @@ class Trainer:
         # boundaries without blocking.  Disabled: one dict read.
         if _ckpt._state["on"]:
             _ckpt.on_step(self)
+        # step-anatomy boundary (stepstats.py): closes the window that
+        # opened at the previous step's end, so the recorded wall time
+        # covers the whole iteration (data wait + fwd/bwd + reduce +
+        # update + hooks).  Disabled: one dict read.
+        if _stepstats._state["on"]:
+            _stepstats.end_step()
 
     def _health_grads_and_prev(self, hm):
         """Feed gradients to the health monitor and snapshot the
@@ -267,8 +274,16 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        # optimizer_update is a container phase: warm dispatch of the
+        # fused optimizer ops inside stays in dispatch_warm; this
+        # records the update's exclusive remainder (stepstats.py)
+        ss_on = _stepstats._state["on"]
+        if ss_on:
+            ss_tok = _stepstats.begin()
         with _profiler.span("trainer:update", "trainer"):
             self._update_impl(ignore_stale_grad)
+        if ss_on:
+            _stepstats.end("optimizer_update", ss_tok)
 
     def _update_impl(self, ignore_stale_grad=False):
         n_dev = max(len(p.list_data()) for p in self._params) \

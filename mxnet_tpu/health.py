@@ -63,6 +63,7 @@ import time
 
 from . import profiler as _profiler
 from . import runtime_stats as _rts
+from . import stepstats as _stepstats
 from .log import get_logger, warn_once, warn_rate_limited
 
 __all__ = ["STAT_NAMES", "DEFAULT_STATS", "stat_kernel", "tensor_stats",
@@ -549,7 +550,12 @@ class HealthMonitor:
         if self.first_nan is not None and not self._nan_dumped:
             self._first_nan_alarm()
         _rts.inc("health_drains")
-        _rts.inc("health_seconds", time.perf_counter() - t0)
+        drain_seconds = time.perf_counter() - t0
+        _rts.inc("health_seconds", drain_seconds)
+        if _stepstats._state["on"]:
+            # step-anatomy health_drain phase: the layer's one host
+            # sync, attributed to the step window it ran in
+            _stepstats.add("health_drain", drain_seconds)
         return drained
 
     def _first_nan_alarm(self):

@@ -205,11 +205,14 @@ def enable():
 
 def disable():
     """Turn collection off (existing histograms are kept; ``reset()``
-    drops them).  Dispatch timing reverts to its env-derived state."""
+    drops them).  Dispatch timing reverts to its env-derived state —
+    unless step-time attribution (``stepstats``) still needs it."""
     _state["on"] = False
     from . import runtime_stats as _rts
+    from . import stepstats as _stepstats
 
-    _rts.DIAG_TIMING = bool(os.environ.get("MXNET_TPU_DIAG"))
+    _rts.DIAG_TIMING = bool(os.environ.get("MXNET_TPU_DIAG")) \
+        or _stepstats._state["on"]
 
 
 def is_enabled():

@@ -27,6 +27,7 @@ from time import perf_counter as _perf_counter
 from .. import histogram as _histogram
 from .. import profiler as _profiler
 from .. import runtime_stats as _rts
+from .. import stepstats as _stepstats
 from ..base import MXNetError
 from ..ndarray import NDArray, array
 
@@ -89,10 +90,18 @@ class DataIter:
         hist_on = _histogram._state["on"]
         if hist_on:
             t0 = _perf_counter()
+        # step-anatomy data_wait phase: a CONTAINER window, so any op
+        # dispatch inside batch assembly stays attributed to its own
+        # phase and batch-wait time is exclusive (stepstats.py)
+        ss_on = _stepstats._state["on"]
+        if ss_on:
+            ss_tok = _stepstats.begin()
         with _profiler.span("io:next_batch", "io",
                             args={"iter": self.__class__.__name__}
                             if _profiler._state["running"] else None):
             batch = self.next()
+        if ss_on:
+            _stepstats.end("data_wait", ss_tok)
         if hist_on:
             _histogram.observe("io:next_batch", _perf_counter() - t0)
         _rts.inc("io_batches")

@@ -32,6 +32,7 @@ import numpy as _np
 
 from .. import profiler as _profiler
 from .. import runtime_stats as _rts
+from .. import stepstats as _stepstats
 from ..base import MXNetError
 from ..ndarray import NDArray, array, zeros
 from ..optimizer import Optimizer, get_updater
@@ -93,10 +94,18 @@ class KVStore:
         """Reduce pushed values per key; apply updater if set
         (reference: KVStoreLocal::PushImpl → Comm::Reduce comm.h:57)."""
         _rts.inc("kvstore_pushes")
+        # step-anatomy kvstore phase (base + dist backends all route
+        # through this wrapper): a container window, so the add_n
+        # reduce dispatch inside stays in dispatch_warm (stepstats.py)
+        ss_on = _stepstats._state["on"]
+        if ss_on:
+            ss_tok = _stepstats.begin()
         with _profiler.span("kvstore:push", "kvstore",
                             args={"type": self._type}
                             if _profiler._state["running"] else None):
             self._push_impl(key, value, priority)
+        if ss_on:
+            _stepstats.end("kvstore", ss_tok)
 
     def _push_impl(self, key, value, priority):
         keys, values = _key_value_list(key, value)
@@ -125,10 +134,15 @@ class KVStore:
         """Broadcast stored value (reference: Comm::Broadcast comm.h:62)."""
         assert out is not None
         _rts.inc("kvstore_pulls")
+        ss_on = _stepstats._state["on"]
+        if ss_on:
+            ss_tok = _stepstats.begin()
         with _profiler.span("kvstore:pull", "kvstore",
                             args={"type": self._type}
                             if _profiler._state["running"] else None):
             self._pull_impl(key, out, priority, ignore_sparse)
+        if ss_on:
+            _stepstats.end("kvstore", ss_tok)
 
     def _pull_impl(self, key, out, priority, ignore_sparse):
         keys, outs = _key_value_list(key, out)

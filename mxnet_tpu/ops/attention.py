@@ -389,6 +389,10 @@ except ImportError:  # pragma: no cover
 
 @register("_contrib_flash_attention", aliases=("flash_attention",))
 def flash_attention_op(query, key, value, causal=False, sm_scale=None, **_):
+    """Fused scaled-dot-product attention over (B, H, T, D) q/k/v —
+    the registry face of :func:`flash_attention` (tiled online-softmax
+    kernel; ``causal`` masks the upper triangle, ``sm_scale`` defaults
+    to 1/sqrt(D))."""
     return flash_attention(query, key, value, causal=bool(causal),
                            sm_scale=sm_scale)
 

@@ -416,6 +416,9 @@ def quadratic(data, a=0.0, b=0.0, c=0.0, **_):
 
 @register("arange_like", aliases=("_contrib_arange_like",))
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
+    """Arithmetic sequence shaped like ``data`` (or along one axis),
+    each value repeated ``repeat`` times — a shape-polymorphic arange
+    (reference: contrib RangeLikeParam, tensor/init_op.cc)."""
     r = max(int(repeat), 1)
     if axis is None:
         n = data.size
@@ -428,6 +431,9 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **_):
 
 @register("getnnz", aliases=("_contrib_getnnz",))
 def getnnz(data, axis=None, **_):
+    """Count of nonzero elements, total or per ``axis`` (reference:
+    contrib/nnz.cc over CSR storage; dense count here — storage is an
+    XLA layout concern on TPU)."""
     return (data != 0).sum(axis=axis).astype(jnp.int64)
 
 

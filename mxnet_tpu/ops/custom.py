@@ -35,6 +35,10 @@ def _custom_nout(attrs):
 
 @register("Custom", num_outputs=_custom_nout)
 def custom(*arrays, op_type=None, **kwargs):
+    """Run a user-registered CustomOp (``mx.operator.register``) named
+    ``op_type`` — a deliberate host-side escape hatch: inputs are
+    materialized for the python forward, so this op is never fused and
+    never jitted (reference: operator/custom/custom.cc)."""
     import jax
 
     from .. import ndarray as nd_mod

@@ -79,9 +79,15 @@ _UNARY = {
 def _register_unary(name, f):
     @register(name, aliases=("_npi_" + name,))
     def _op(x, **_):
+        """Elementwise unary op, generated from the _UNARY table."""
         return f(x)
 
     _op.__name__ = name
+    _op.__doc__ = (
+        "Elementwise %s(x), applied per element (generated from the "
+        "_UNARY table; reference: the elemwise_unary_op_basic.cc / "
+        "*_trig.cc / *_logexp.cc macro families).  XLA fuses chains "
+        "of these into single kernels." % name)
     return _op
 
 
@@ -175,12 +181,23 @@ def _register_binary(name, f):
     bool_out = name in _BOOL_RESULT
 
     def _impl(a, b, **_):
+        """Elementwise binary op, generated from the _BINARY table."""
         out = f(a, b)
         if bool_out:
             # reference returns same-dtype 0/1 tensors, not bools
             out = out.astype(jnp.result_type(a, b))
         return out
 
+    _impl.__name__ = "elemwise_%s" % name
+    _impl.__doc__ = (
+        "Elementwise %s(lhs, rhs), registered both as elemwise_%s "
+        "(same-shape) and broadcast_%s (numpy broadcasting) — XLA "
+        "handles both identically (generated from the _BINARY table; "
+        "reference: elemwise_binary_op_basic.cc / "
+        "elemwise_binary_broadcast_op_basic.cc).%s"
+        % (name, name, name,
+           "  Comparison/logical results are same-dtype 0/1 tensors, "
+           "not bools, matching the reference." if bool_out else ""))
     register("elemwise_%s" % name, aliases=("_%s" % name,))(_impl)
     register("broadcast_%s" % name)(_impl)
     return _impl
@@ -232,8 +249,15 @@ def _register_scalar(name, f):
     # (scheduler lr in composite optimizer loops) must not recompile
     @register(name, traced_attrs=("scalar",))
     def _op(x, scalar=0.0, **_):
+        """Tensor-scalar elementwise op, from the _SCALAR table."""
         return f(x, scalar)
 
+    _op.__name__ = name
+    _op.__doc__ = (
+        "%s(x, scalar=...) applied per element, with the scalar passed "
+        "as a TRACED attr so per-step values (e.g. a scheduled lr) "
+        "never recompile (generated from the _SCALAR table; reference: "
+        "the elemwise_binary_scalar_op_*.cc macro family)." % name)
     return _op
 
 

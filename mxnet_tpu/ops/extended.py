@@ -57,6 +57,9 @@ def ravel_multi_index(data, shape=(), **_):
 
 @register("unravel_index", aliases=("_unravel_index",))
 def unravel_index(data, shape=(), **_):
+    """Convert flat indices into a stacked row of coordinate arrays
+    for ``shape`` (row 0 = outermost axis), keeping the input dtype
+    (reference: tensor/ravel.cc unravel_index)."""
     out = []
     rem = data.astype(jnp.int64)
     acc = 1
@@ -175,6 +178,11 @@ def contrib_fft(data, compute_size=128, **_):
 
 @register("_contrib_ifft", aliases=("ifft",))
 def contrib_ifft(data, compute_size=128, **_):
+    """Inverse FFT over interleaved (re, im) pairs in the last axis,
+    returning the real part scaled by n — the inverse of
+    ``_contrib_fft``'s packing (reference: contrib/fft.cc IFFT;
+    ``compute_size`` is the reference's batching knob, unused here
+    since XLA fuses the whole batch)."""
     n = data.shape[-1] // 2
     pairs = data.reshape(data.shape[:-1] + (n, 2))
     comp = pairs[..., 0] + 1j * pairs[..., 1]
@@ -521,6 +529,10 @@ def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
 def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
                          rescale_grad=1.0, clip_gradient=-1.0,
                          num_weights=1, **_):
+    """Aggregated SGD-with-momentum over ``num_weights`` (weight, grad,
+    mom) triples in ONE fused kernel, per-tensor lr/wd — the reference's
+    multi-tensor apply (optimizer_op.cc multi_sgd_mom_update); outputs
+    are the updated weights then the updated momenta."""
     n = int(num_weights)
     new_w, new_m = [], []
     for i in range(n):
@@ -585,6 +597,11 @@ def multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
 def multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0,
                             num_weights=1, **_):
+    """Multi-precision aggregated SGD-momentum over ``num_weights``
+    (weight, grad, mom, weight32) quads: the update runs in fp32 master
+    weights and the low-precision copy is re-cast per step (reference:
+    optimizer_op.cc multi_mp_sgd_mom_update); outputs are updated
+    weights, momenta, then master weights."""
     n = int(num_weights)
     new_w, new_m, new_w32 = [], [], []
     for i in range(n):

@@ -203,6 +203,10 @@ def quantized_pooling(data, min_data, max_data, kernel=None, stride=None,
 
 @register("_contrib_quantized_flatten", num_outputs=3)
 def quantized_flatten(data, min_data, max_data, **_):
+    """Flatten quantized data to (batch, -1), passing the calibration
+    range through unchanged — layout-only, so the int8 values and
+    their scale are untouched (reference: quantization/
+    quantized_flatten.cc)."""
     return (data.reshape(data.shape[0], -1),
             _s1(jnp.reshape(min_data, ())), _s1(jnp.reshape(max_data, ())))
 

@@ -1,0 +1,455 @@
+"""Perf doctor — ranked bottleneck findings from a trace + diag dump.
+
+The observability layers (PR 2/3/5/7) record what happened; this module
+*interprets* it: given a chrome trace (``MXNET_TPU_PROFILE``) and/or a
+diag dump (``MXNET_TPU_DIAG``), :func:`diagnose` returns findings
+**ranked by estimated share of step time**, each naming the concrete
+span/op/shard it indicts and a concrete next action — the
+measure-compare-decide loop the autotune roadmap item needs (TVM,
+arXiv:1802.04799) and the fusion/idle-gap lens of XLA perf work
+(arXiv:2301.13062), automated so every perf PR ships with a verdict
+instead of a hand-read trace.
+
+Rules
+-----
+- **step-anatomy shares** — a phase (data wait, allreduce/kvstore,
+  optimizer update, checkpoint snapshot) eating an outsized share of
+  the per-step wall time (``stepstats`` section of the dump).
+- **recompile storms** — ops compiling past the storm threshold, with
+  the churned attr/aval evidence from ``recent_storm_keys`` and the
+  compile share of step time.
+- **host-sync stalls** — monitor/health host-sync seconds on the hot
+  path (the deliberate sync sinks, when their cost stops being small).
+- **idle gaps inside steps** — wall time inside ``trainer:step`` spans
+  covered by NO recorded span (untracked host work or device waits),
+  from the chrome trace.
+- **roofline headroom** — the top profiled ops whose cache-warm
+  dispatch time sits far above their cost-model roofline bound.
+- **kvstore stragglers** — one PS shard's push/pull RTT p99 an outlier
+  vs the other shards' median (``histogram.median_of_others``).
+
+Findings are ``{"rule", "severity": "warn"|"info", "score",
+"title", "anchor", "evidence": [...], "action"}`` — ``score`` is the
+estimated fraction of step time at stake (what the ranking sorts by),
+``anchor`` the span/op/rank/shard name the evidence points at.
+
+CLI: ``python tools/diagnose.py --doctor <trace.json|diag.json ...>``
+(``--format github`` emits ``::error``/``::notice`` workflow
+annotations, the mxlint convention).
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import histogram as _histogram
+from . import runtime_stats as _rts
+from . import stepstats as _stepstats
+
+__all__ = ["diagnose", "classify", "render", "render_github",
+           "gh_annotation", "SHARE_NOTICE", "SHARE_WARN",
+           "HEADROOM_RATIO", "IDLE_GAP_SHARE"]
+
+# a phase/rule at or above this share of step time is worth a line /
+# a warning; tunable per call via diagnose(..., notice=, warn=)
+SHARE_NOTICE = 0.10
+SHARE_WARN = 0.25
+# host-sync sinks are meant to be cheap: flag earlier
+SYNC_SHARE_NOTICE = 0.05
+# an op is "far off its roofline" when headroom exceeds this fraction
+# of its dispatch time AND it carries a meaningful share of total time
+HEADROOM_RATIO = 0.5
+# untracked time inside trainer:step spans worth flagging
+IDLE_GAP_SHARE = 0.20
+
+
+def classify(path):
+    """Load ``path`` and say what it is: ``("trace", data)`` for a
+    chrome trace, ``("dump", data)`` for a diag dump / snapshot."""
+    with open(path) as f:
+        data = json.load(f)
+    if "traceEvents" in data:
+        return "trace", data
+    data.setdefault("_path", path)
+    return "dump", data
+
+
+def _finding(rule, score, title, anchor, evidence, action,
+             warn_at=SHARE_WARN):
+    return {"rule": rule, "score": float(score),
+            "severity": "warn" if score >= warn_at else "info",
+            "title": title, "anchor": anchor,
+            "evidence": list(evidence), "action": action}
+
+
+# ------------------------------------------------------------ dump rules
+
+
+def _anatomy_of(dump):
+    snap = dump.get("snapshot", dump)
+    return _stepstats.anatomy(snap.get("stepstats") or {})
+
+
+def _check_step_anatomy(dump):
+    """Phase-share findings: the phases an operator can act on
+    directly (data wait / kvstore / optimizer / checkpoint /
+    unattributed remainder)."""
+    a = _anatomy_of(dump)
+    if not a.get("steps"):
+        return []
+    actions = {
+        "data_wait": "overlap input with compute (PrefetchingIter / "
+                     "wider io workers) or cache preprocessing "
+                     "(docs/OBSERVABILITY.md 'Step anatomy')",
+        "kvstore": "check shard placement and gradient sizes; compare "
+                   "push/pull RTT histograms per shard (--cluster for "
+                   "multi-rank runs)",
+        "optimizer_update": "fuse the update (update_on_kvstore or the "
+                            "multi-tensor optimizer ops) or batch "
+                            "small parameters",
+        "checkpoint_write": "raise MXNET_TPU_CKPT_INTERVAL or keep "
+                            "MXNET_TPU_CKPT_ASYNC=1 (the capture "
+                            "should be microseconds; a large share "
+                            "means sync mode or host-resident params)",
+        "unattributed": "wall time no instrumented phase covers: "
+                        "profile with MXNET_TPU_PROFILE and look for "
+                        "host syncs / untracked user code between "
+                        "spans (tools/mxlint host-sync-reachability)",
+    }
+    out = []
+    for phase, action in actions.items():
+        d = a["phases"].get(phase) if phase != "unattributed" \
+            else a.get("unattributed")
+        if not d or d["share"] < SHARE_NOTICE:
+            continue
+        out.append(_finding(
+            "step-anatomy", d["share"],
+            "%s is %.0f%% of step time"
+            % (_stepstats.PHASE_LABELS.get(phase, phase),
+               d["share"] * 100),
+            phase,
+            ["per-step mean %.3f ms (p99 %.3f ms) over %d step(s); "
+             "step wall mean %.3f ms"
+             % (d["mean_ms"] or 0, d["p99_ms"] or 0, a["steps"],
+                a["step_wall_ms"]["mean_ms"] or 0)],
+            action))
+    return out
+
+
+def _check_recompiles(dump):
+    """Recompile storms: per-op compile counts past the storm
+    threshold, scored by the compile phase's share of step time."""
+    snap = dump.get("snapshot", dump)
+    storms = snap.get("storms") or {}
+    threshold = _rts.STORM_THRESHOLD or 8
+    hot = {name: st for name, st in storms.items()
+           if st.get("compiles", 0) > threshold
+           or st.get("distinct_avals", 0) > threshold}
+    if not hot:
+        return []
+    a = _anatomy_of(dump)
+    compile_share = (a.get("phases", {}).get("compile") or
+                     {}).get("share")
+    if compile_share is None:
+        # no anatomy in the dump: fall back to compile seconds vs
+        # profiled dispatch+compile time (coarse, but still ranks)
+        totals = snap.get("totals") or {}
+        denom = (totals.get("dispatch_seconds") or 0.0) \
+            + (totals.get("compile_seconds") or 0.0)
+        compile_share = (totals.get("compile_seconds", 0.0) / denom) \
+            if denom else 0.5
+    worst = max(hot, key=lambda n: hot[n].get("compiles", 0))
+    keys = (dump.get("recent_storm_keys") or {}).get(worst) or []
+    evidence = ["%s: %d compile(s), %d distinct input signature(s)"
+                % (name, st.get("compiles", 0),
+                   st.get("distinct_avals", 0))
+                for name, st in sorted(
+                    hot.items(), key=lambda kv: -kv[1]["compiles"])]
+    if keys:
+        evidence.append("recent %s cache keys: %s"
+                        % (worst, "; ".join(keys[-3:])))
+    return [_finding(
+        "recompile-storm", compile_share,
+        "recompile storm: %d op(s), worst %r (%d compiles) — "
+        "compile is %.0f%% of step time"
+        % (len(hot), worst, hot[worst].get("compiles", 0),
+           compile_share * 100),
+        worst, evidence,
+        "hoist the churning attr into traced_attrs or stabilize input "
+        "shapes — every recompile stalls dispatch for a full XLA "
+        "compile (docs/OBSERVABILITY.md 'Recompile-storm detector')")]
+
+
+def _check_host_sync(dump):
+    """Deliberate host-sync sinks (monitor stats, health drain) whose
+    per-step cost stopped being small."""
+    snap = dump.get("snapshot", dump)
+    counters = snap.get("counters") or {}
+    a = _anatomy_of(dump)
+    wall_sum_ms = (a.get("step_wall_ms") or {}).get("sum_ms") \
+        if a.get("steps") else None
+    out = []
+    for counter, anchor, what, action in (
+            ("monitor_seconds", "monitor:stat",
+             "Monitor stat host-syncs",
+             "drop the Monitor (or raise its interval) for production "
+             "runs; the default stat path is device-resident but "
+             "toc() still syncs"),
+            ("health_seconds", "health:drain",
+             "numerics-health drains",
+             "raise MXNET_TPU_HEALTH_INTERVAL or trim "
+             "MXNET_TPU_HEALTH_STATS — the drain is the layer's one "
+             "deliberate sync")):
+        secs = counters.get(counter, 0.0)
+        if not secs:
+            continue
+        if wall_sum_ms:
+            share = (secs * 1e3) / wall_sum_ms
+        else:
+            continue  # no step clock: cannot rank, skip
+        if share < SYNC_SHARE_NOTICE:
+            continue
+        out.append(_finding(
+            "host-sync", share,
+            "%s are %.0f%% of step time" % (what, share * 100),
+            anchor,
+            ["%s=%.3fs over %d step(s)"
+             % (counter, secs, a["steps"])],
+            action, warn_at=2 * SYNC_SHARE_NOTICE))
+    return out
+
+
+def _check_roofline(dump, top=3):
+    """Top profiled ops sitting far above their cost-model roofline
+    bound, weighted by their share of total profiled dispatch time."""
+    snap = dump.get("snapshot", dump)
+    rows = dump.get("roofline") or _rts.roofline(snap)
+    totals = snap.get("totals") or {}
+    total_secs = totals.get("dispatch_seconds") or 0.0
+    if not total_secs:
+        return []
+    # scores are "share of step time": scale each op's share of the
+    # profiled dispatch time by dispatch_warm's share of the step when
+    # the anatomy is available (dispatch is only part of a step)
+    a = _anatomy_of(dump)
+    dispatch_share = (a.get("phases", {}).get("dispatch_warm")
+                      or {}).get("share", 1.0) if a.get("steps") else 1.0
+    culprits = []
+    for r in rows:
+        if "headroom_us" not in r or "us_per_call" not in r:
+            continue
+        if r["headroom_us"] < HEADROOM_RATIO * r["us_per_call"]:
+            continue
+        op = (snap.get("ops") or {}).get(r["op"]) or {}
+        op_secs = op.get("dispatch_seconds", 0.0)
+        share = op_secs / total_secs
+        if share < SHARE_NOTICE / 2:
+            continue
+        culprits.append((share * dispatch_share, share, r))
+    if not culprits:
+        return []
+    culprits.sort(key=lambda sr: -sr[0])
+    culprits = culprits[:top]
+    total_share = sum(s for s, _, _ in culprits)
+    worst = culprits[0][2]
+    evidence = []
+    for _score, share, r in culprits:
+        evidence.append(
+            "%s: %.1f us/call vs %.1f us roofline bound (%.0f us "
+            "headroom/call, %.0f%% of profiled dispatch time%s)"
+            % (r["op"], r["us_per_call"], r.get("bound_us", 0.0),
+               r["headroom_us"], share * 100,
+               (", %.1f GB/s achieved" % r["achieved_gbps"])
+               if r.get("achieved_gbps") else ""))
+    return [_finding(
+        "roofline-headroom", total_share,
+        "%d op(s) far above their roofline bound, worst %r"
+        % (len(culprits), worst["op"]),
+        worst["op"], evidence,
+        "these are cache-warm HOST dispatch rates — confirm with the "
+        "measured device trace (tools/profile_step.py), then fuse/"
+        "batch the op or fix its layout")]
+
+
+def _check_stragglers(dump):
+    """One PS shard's RTT p99 an outlier vs the other shards — the
+    single-rank view of the cluster straggler check (per-shard
+    ``kv:push_rtt:shardN`` / ``kv:pull_rtt:shardN`` histograms)."""
+    snap = dump.get("snapshot", dump)
+    hists = snap.get("histograms") or {}
+    out = []
+    for op in ("push", "pull"):
+        prefix = "kv:%s_rtt:shard" % op
+        group = [(name, h) for name, h in hists.items()
+                 if name.startswith(prefix)
+                 and h.get("p99") is not None]
+        if len(group) < 2:
+            continue
+        worst_name, worst = max(group, key=lambda nh: nh[1]["p99"])
+        med = _histogram.median_of_others(
+            [(n, h["p99"]) for n, h in group], worst_name)
+        if not med or med <= 0:
+            continue
+        ratio = worst["p99"] / med
+        if ratio <= _histogram.STRAGGLER_RATIO:
+            continue
+        a = _anatomy_of(dump)
+        kv_share = (a.get("phases", {}).get("kvstore") or {}).get(
+            "share", 0.0) if a.get("steps") else 0.0
+        out.append(_finding(
+            "kvstore-straggler", max(kv_share, SHARE_NOTICE),
+            "PS shard straggler: %s p99 %.1f ms is %.1fx the other "
+            "shards' median"
+            % (worst_name, worst["p99"] * 1e3, ratio),
+            worst_name,
+            ["%s p99 %.3f ms vs median-of-others %.3f ms over %d "
+             "sample(s)" % (worst_name, worst["p99"] * 1e3, med * 1e3,
+                            worst.get("count", 0))],
+            "investigate that shard's host/network; kvstore waits "
+            "serialize the step (docs/OBSERVABILITY.md 'Distributed "
+            "telemetry'; cross-rank view: diagnose.py --cluster)"))
+    return out
+
+
+def _check_retries(dump):
+    snap = dump.get("snapshot", dump)
+    counters = snap.get("counters") or {}
+    retries = counters.get("kvstore_retries", 0)
+    if not retries:
+        return []
+    return [_finding(
+        "kvstore-retries", SHARE_NOTICE / 2,
+        "%d kvstore retry(ies) (%d reconnect(s)) during the run"
+        % (retries, counters.get("kvstore_reconnects", 0)),
+        "kvstore",
+        ["each retry adds a full backoff to some step's push/pull"],
+        "check PS server health/logs; transient faults are retried "
+        "with backoff but still stall the step "
+        "(docs/CHECKPOINTING.md 'Dist kvstore hardening')")]
+
+
+# ----------------------------------------------------------- trace rules
+
+
+def _union_us(intervals):
+    """Total length of the union of (start, end) microsecond spans."""
+    total = 0.0
+    end = -1.0
+    for s, e in sorted(intervals):
+        if s > end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def _check_idle_gaps(trace):
+    """Wall time inside ``trainer:step`` spans covered by NO other
+    recorded span: untracked host work, or a host-sync wait the
+    framework spans cannot see."""
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X" and "ts" in e]
+    steps = [e for e in events if e.get("name") == "trainer:step"]
+    if not steps:
+        return []
+    # coverage is per process track: in a merged multi-rank trace,
+    # another rank's spans must not mask this rank's gap
+    others_by_pid: dict = {}
+    for e in events:
+        if e.get("name") != "trainer:step":
+            others_by_pid.setdefault(e.get("pid", 0), []).append(
+                (e["ts"], e["ts"] + e.get("dur", 0.0)))
+    total_gap = 0.0
+    total_dur = 0.0
+    worst = (0.0, None)
+    for st in steps:
+        s0, s1 = st["ts"], st["ts"] + st.get("dur", 0.0)
+        others = others_by_pid.get(st.get("pid", 0), ())
+        covered = _union_us([(max(a, s0), min(b, s1))
+                             for a, b in others if b > s0 and a < s1])
+        gap = max(0.0, (s1 - s0) - covered)
+        total_gap += gap
+        total_dur += s1 - s0
+        if gap > worst[0]:
+            worst = (gap, st)
+    if not total_dur:
+        return []
+    share = total_gap / total_dur
+    if share < IDLE_GAP_SHARE:
+        return []
+    wev = worst[1]
+    return [_finding(
+        "idle-gaps", share,
+        "%.0f%% of trainer:step time is covered by no span"
+        % (share * 100),
+        "trainer:step",
+        ["total gap %.3f ms across %d step span(s); worst step at "
+         "ts=%.0f us with %.3f ms untracked"
+         % (total_gap / 1e3, len(steps), wev["ts"], worst[0] / 1e3)],
+        "host syncs or untracked user code inside step(): profile the "
+        "gap region (chrome://tracing), audit with tools/mxlint "
+        "host-sync-reachability, or wrap user phases in "
+        "profiler.scope()")]
+
+
+# --------------------------------------------------------------- driver
+
+
+def diagnose(trace=None, dump=None, top=20):
+    """Run every applicable rule over a loaded chrome ``trace`` and/or
+    diag ``dump`` and return findings ranked worst-first (by estimated
+    share of step time).  Either input may be None; rules missing
+    their data contribute nothing."""
+    findings = []
+    if dump is not None:
+        findings += _check_step_anatomy(dump)
+        findings += _check_recompiles(dump)
+        findings += _check_host_sync(dump)
+        findings += _check_roofline(dump)
+        findings += _check_stragglers(dump)
+        findings += _check_retries(dump)
+    if trace is not None:
+        findings += _check_idle_gaps(trace)
+    findings.sort(key=lambda f: -f["score"])
+    return findings[:top]
+
+
+def render(findings, inputs=()):
+    """Human report: ranked findings with evidence and next actions."""
+    lines = ["Perf doctor: %d finding(s)%s"
+             % (len(findings),
+                (" over %s" % ", ".join(inputs)) if inputs else "")]
+    if not findings:
+        lines.append("no bottleneck past the reporting thresholds — "
+                     "nothing obviously wrong in the provided "
+                     "trace/dump")
+    for i, f in enumerate(findings, 1):
+        lines.append("%d. [%s] (%3.0f%% of step time) %s"
+                     % (i, f["severity"].upper(), f["score"] * 100,
+                        f["title"]))
+        for ev in f["evidence"]:
+            lines.append("     evidence: %s" % ev)
+        lines.append("     next: %s" % f["action"])
+    return "\n".join(lines)
+
+
+def gh_annotation(level, message):
+    """One GitHub workflow-command annotation line (the
+    ``tools/mxlint --format github`` escaping convention)."""
+    msg = message.replace("%", "%25").replace("\r", "%0D") \
+        .replace("\n", "%0A")
+    return "::%s::%s" % (level, msg)
+
+
+def render_github(findings):
+    """``::error``/``::notice`` annotation lines: warn-severity
+    findings error, the rest notice."""
+    lines = []
+    for f in findings:
+        level = "error" if f["severity"] == "warn" else "notice"
+        lines.append(gh_annotation(
+            level, "perf-doctor[%s] %s — next: %s"
+            % (f["rule"], f["title"], f["action"])))
+    return "\n".join(lines)

@@ -73,6 +73,7 @@ import time
 
 from . import device_memory
 from . import histogram as _histogram
+from . import stepstats as _stepstats
 from .log import get_logger, process_identity, warn_rate_limited
 
 __all__ = ["snapshot", "report", "reset", "inc",
@@ -80,7 +81,8 @@ __all__ = ["snapshot", "report", "reset", "inc",
            "add_dispatch_seconds", "record_fallback", "note_aval_key",
            "roofline", "diag_snapshot", "dump_diag", "main",
            "health_probe", "cluster_report", "render_cluster",
-           "load_dumps", "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
+           "load_dumps", "compare", "render_compare",
+           "STORM_THRESHOLD", "STORM_WARN_INTERVAL"]
 
 STORM_THRESHOLD = int(os.environ.get(
     "MXNET_TPU_RECOMPILE_STORM_THRESHOLD", "8"))
@@ -182,6 +184,8 @@ def add_compile_seconds(name, seconds):
     layer as the duration of the jit-cache-miss call: trace + XLA
     compile dominate; execution is async-dispatched)."""
     _op_stats(name)["compile_seconds"] += seconds
+    if _stepstats._state["on"]:
+        _stepstats.add("compile", seconds)
 
 
 def add_dispatch_seconds(name, seconds):
@@ -202,6 +206,8 @@ def add_dispatch_seconds(name, seconds):
     s["timed_calls"] += 1
     if _histogram._state["on"]:
         _histogram.observe("dispatch:warm", seconds)
+    if _stepstats._state["on"]:
+        _stepstats.add("dispatch_warm", seconds)
 
 
 def record_fallback(name, kind):
@@ -362,6 +368,7 @@ def snapshot():
             "health": _health.snapshot(),
             "checkpoint": _checkpoint.snapshot(),
             "histograms": _histogram.snapshot(),
+            "stepstats": _stepstats.snapshot(),
             "identity": process_identity()}
 
 
@@ -434,6 +441,7 @@ def _render(snap, top=None):
             lines.append("%-32s %12s"
                          % (name[:32],
                             ("%.3f" % v) if isinstance(v, float) else v))
+    lines.extend(_stepstats.render(snap.get("stepstats") or {}))
     lines.extend(_render_costs(snap, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
     lines.extend(_render_health(snap.get("health") or {}))
@@ -603,6 +611,7 @@ def reset():
     _COUNTERS.clear()
     _STORM.clear()
     _histogram.reset()
+    _stepstats.reset()
     reset_rate_limits("recompile-storm:")
 
 
@@ -736,9 +745,11 @@ def _activate_diag_from_env():
 
 
 _activate_diag_from_env()
-# deferred from histogram.py's import (its enable() writes this
-# module's DIAG_TIMING, so arming must wait until the global exists)
+# deferred from histogram.py's / stepstats.py's import (their enable()
+# writes this module's DIAG_TIMING, so arming must wait until the
+# global exists)
 _histogram._activate_from_env()
+_stepstats._activate_from_env()
 
 
 # -------------------------------------------------- cluster aggregation
@@ -878,6 +889,177 @@ def render_cluster(report):
     hist_lines = _render_hists(report["merged"])
     hist_lines[1] = "Merged latency histograms — all ranks (ms)"
     lines.extend(hist_lines)
+    return "\n".join(lines)
+
+
+# ------------------------------------------------- dump-diff regression
+
+
+def _steps_of(snap):
+    """Step count of a snapshot: stepstats windows when present, else
+    the trainer_steps counter — the per-step normalizer that makes two
+    runs of different lengths comparable."""
+    ss = snap.get("stepstats") or {}
+    if ss.get("steps"):
+        return ss["steps"]
+    return (snap.get("counters") or {}).get("trainer_steps", 0)
+
+
+def _comparable_metrics(dump, min_seconds):
+    """Flatten one diag dump (or raw snapshot) into ``{metric: (value,
+    unit, kind)}`` rows for :func:`compare` — every metric oriented so
+    that UP means WORSE.  Time-like metrics below ``min_seconds`` in
+    total are dropped (sub-noise phases must not produce findings)."""
+    snap = dump.get("snapshot", dump)
+    steps = _steps_of(snap)
+    out = {}
+    # step anatomy: per-step mean ms per phase (+ wall + remainder)
+    ss = snap.get("stepstats") or {}
+    if ss.get("steps"):
+        n = ss["steps"]
+
+        def _phase_row(name, h, kind):
+            total = (h or {}).get("sum") or 0.0
+            if total >= min_seconds:
+                out[name] = (total / n * 1e3, "ms/step", kind)
+
+        _phase_row("step_wall", ss.get("wall"), "wall")
+        for p, h in (ss.get("phases") or {}).items():
+            _phase_row("phase:%s" % p, h, "phase")
+        _phase_row("phase:unattributed", ss.get("unattributed"), "phase")
+    # latency histograms: mean + p99 per series
+    for name, h in (snap.get("histograms") or {}).items():
+        if (h.get("sum") or 0.0) < min_seconds:
+            continue
+        if h.get("mean") is not None:
+            out["hist:%s mean" % name] = (h["mean"] * 1e3, "ms", "histogram")
+        if h.get("p99") is not None:
+            out["hist:%s p99" % name] = (h["p99"] * 1e3, "ms", "histogram")
+    # per-op cache-warm dispatch rate (the roofline denominator)
+    for name, s in (snap.get("ops") or {}).items():
+        timed = s.get("timed_calls", 0)
+        secs = s.get("dispatch_seconds", 0.0)
+        if timed and secs >= min_seconds:
+            out["op:%s us/call" % name] = (secs / timed * 1e6, "us",
+                                           "op")
+    # cost counters, normalized per step when a step clock exists
+    totals = snap.get("totals") or {}
+    counters = snap.get("counters") or {}
+    for key, label in (("compile_seconds", "s"),):
+        v = totals.get(key)
+        if v:
+            out["total:%s" % key] = (v / steps if steps else v,
+                                     label + ("/step" if steps else ""),
+                                     "counter")
+    for key in ("jit_cache_misses", "fallbacks"):
+        v = totals.get(key, 0)
+        if v:
+            out["total:%s" % key] = (v / steps if steps else v,
+                                     "/step" if steps else "count",
+                                     "counter")
+    for key in ("kvstore_retries", "health_seconds", "monitor_seconds"):
+        v = counters.get(key, 0)
+        # the *_seconds counters are time-like: below the noise floor
+        # they are pure clock jitter, not a verdict-worthy signal
+        if key.endswith("_seconds") and v < min_seconds:
+            continue
+        if v:
+            out["counter:%s" % key] = (v / steps if steps else v,
+                                       "/step" if steps else "count",
+                                       "counter")
+    # device-memory peak
+    peak = ((snap.get("memory") or {}).get("totals") or {}).get(
+        "peak_bytes", 0)
+    if peak:
+        out["memory:peak_bytes"] = (peak / 1e6, "MB", "memory")
+    return out
+
+
+def compare(a, b, threshold=0.2, min_seconds=1e-3):
+    """Diff two diag dumps (baseline ``a`` vs candidate ``b``) into a
+    machine-readable verdict — the one-command before/after of a perf
+    PR (``tools/diagnose.py --compare A B``).
+
+    Every comparable metric (step-anatomy phase means, latency-histogram
+    mean/p99, per-op warm-dispatch rates, per-step compile/miss/fallback
+    counters, device-memory peak) is oriented so UP means WORSE; a
+    metric whose relative change exceeds ``threshold`` lands in
+    ``regressions`` (worse) or ``improvements`` (better).  Metrics whose
+    summed time stays under ``min_seconds`` on both sides are ignored —
+    sub-noise phases must not page anyone.  Identical dumps compare
+    flat (zero findings) by construction.
+
+    Returns ``{"verdict": "regression"|"improvement"|"flat",
+    "regressions": [...], "improvements": [...], "compared": N,
+    "threshold": ..., "a"/"b": {"path", "steps"}}`` with each finding
+    ``{"metric", "kind", "unit", "before", "after", "ratio"}`` sorted
+    worst-first."""
+    # significance (which metrics are worth a verdict) comes from the
+    # floored collection; VALUES come from an unfloored pass — a metric
+    # straddling the floor (just under on one side, just over on the
+    # other) must compare its real small values (ratio ~1), not read
+    # as 0 -> infinity.  A genuinely new cost still reads as 0 -> inf.
+    ma = _comparable_metrics(a, min_seconds)
+    mb = _comparable_metrics(b, min_seconds)
+    ma_all = _comparable_metrics(a, 0.0)
+    mb_all = _comparable_metrics(b, 0.0)
+    regressions, improvements = [], []
+    compared = 0
+    for metric in sorted(set(ma) | set(mb)):
+        va = ma_all.get(metric) or ma.get(metric)
+        vb = mb_all.get(metric) or mb.get(metric)
+        before = va[0] if va else 0.0
+        after = vb[0] if vb else 0.0
+        unit, kind = (vb or va)[1], (vb or va)[2]
+        compared += 1
+        if before <= 0.0 and after <= 0.0:
+            continue
+        ratio = (after / before) if before > 0.0 else float("inf")
+        entry = {"metric": metric, "kind": kind, "unit": unit,
+                 "before": before, "after": after, "ratio": ratio}
+        if ratio > 1.0 + threshold:
+            regressions.append(entry)
+        elif ratio < 1.0 - threshold:
+            improvements.append(entry)
+    regressions.sort(key=lambda e: -e["ratio"])
+    improvements.sort(key=lambda e: e["ratio"])
+    verdict = ("regression" if regressions else
+               "improvement" if improvements else "flat")
+    return {"verdict": verdict, "threshold": threshold,
+            "min_seconds": min_seconds, "compared": compared,
+            "regressions": regressions, "improvements": improvements,
+            "a": {"path": a.get("_path"),
+                  "steps": _steps_of(a.get("snapshot", a))},
+            "b": {"path": b.get("_path"),
+                  "steps": _steps_of(b.get("snapshot", b))}}
+
+
+def render_compare(result):
+    """Text report for a :func:`compare` result."""
+    lines = ["Dump diff: %s -> %s (threshold %.0f%%, %d metric(s) "
+             "compared)"
+             % (result["a"]["path"] or "A", result["b"]["path"] or "B",
+                result["threshold"] * 100, result["compared"])]
+
+    def _rows(title, entries):
+        if not entries:
+            return
+        lines.append(title)
+        lines.append("  %-44s %12s %12s %8s"
+                     % ("Metric", "Before", "After", "Change"))
+        for e in entries:
+            change = ("+inf" if e["ratio"] == float("inf")
+                      else "%+.0f%%" % ((e["ratio"] - 1.0) * 100))
+            lines.append("  %-44s %12.3f %12.3f %8s  (%s)"
+                         % (e["metric"][:44], e["before"], e["after"],
+                            change, e["unit"]))
+
+    _rows("REGRESSIONS (worse in B)", result["regressions"])
+    _rows("improvements (better in B)", result["improvements"])
+    if not result["regressions"] and not result["improvements"]:
+        lines.append("no change past the threshold — dumps are "
+                     "performance-equivalent")
+    lines.append("VERDICT: %s" % result["verdict"])
     return "\n".join(lines)
 
 

@@ -432,3 +432,54 @@ def test_prior_round_values_skips_failed_round_records(tmp_path,
         str(tmp_path / "BENCH_r03.json"), str(tmp_path / "BENCH_r04.json")])
     got = bench.prior_round_values(128, "NHWC")
     assert got == ("BENCH_r03.json", 2328.04, None)
+
+
+def test_disabled_stepstats_overhead_bound():
+    """PR 8 gate: step-time attribution must be pay-for-use.  With
+    attribution disabled (the default), every feeding hook —
+    ``stepstats.add`` (leaf phases), ``stepstats.end`` (container
+    phases), ``stepstats.end_step`` (the Trainer boundary) — is ONE
+    dict read: no timestamps, no window arithmetic, no Histogram
+    allocation.  Feeding sites additionally guard BEFORE calling
+    ``begin()``, so the off path pays no clock reads either (asserted
+    via zero recorded state)."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import stepstats
+
+    if os.environ.get("MXNET_TPU_STEPSTATS") == "1" \
+            or os.environ.get("MXNET_TPU_DIAG") \
+            or os.environ.get("MXNET_TPU_PROFILE"):
+        pytest.skip("step-time attribution active in this run")
+    assert not stepstats.is_enabled()
+
+    n_calls = 1000
+    best = {"add": float("inf"), "end": float("inf"),
+            "end_step": float("inf")}
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            stepstats.add("bench", 0.001)
+        best["add"] = min(best["add"],
+                          (time.perf_counter() - t0) / n_calls)
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            stepstats.end("bench", None)
+        best["end"] = min(best["end"],
+                          (time.perf_counter() - t0) / n_calls)
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            stepstats.end_step()
+        best["end_step"] = min(best["end_step"],
+                               (time.perf_counter() - t0) / n_calls)
+    for name, b in best.items():
+        # the guard is one dict read (~0.1us); 10us tolerates slow
+        # shared CI while catching any real disabled-path work
+        assert b < 1e-5, \
+            "stepstats.%s with attribution off took %.2fus" % (
+                name, b * 1e6)
+    snap = stepstats.snapshot()
+    assert snap["steps"] == 0, "disabled hooks must record nothing"
+    assert "phases" not in snap

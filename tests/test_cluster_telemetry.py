@@ -389,6 +389,44 @@ def test_merge_traces_headerless_files_survive(tmp_path):
     assert len({e["pid"] for e in m["traceEvents"]}) == 2
 
 
+def test_merge_traces_mixed_header_and_headerless(tmp_path):
+    """A header-carrying rank file and a headerless (pre-PR-7 or
+    hand-made) file merged TOGETHER: the header file is rebased onto
+    the wall clock while the headerless one keeps its own epoch, both
+    survive into one t=0-normalized timeline, and the colliding pid is
+    remapped so each keeps its own track."""
+    from mxnet_tpu import profiler
+
+    with open(tmp_path / "rank0.json", "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "with_header", "cat": "c", "ph": "X", "ts": 50.0,
+             "dur": 1.0, "pid": 0, "tid": 1}],
+            "mxtpu": {"role": "worker", "rank": 0,
+                      "perf_anchor_us": 0.0,
+                      "wall_anchor_us": 1000.0,
+                      "clock_offset_us": 0.0}}, f)
+    with open(tmp_path / "legacy.json", "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "headerless", "cat": "c", "ph": "X", "ts": 10.0,
+             "dur": 1.0, "pid": 0, "tid": 1}]}, f)
+    out = profiler.merge_traces(
+        [str(tmp_path / "rank0.json"), str(tmp_path / "legacy.json")],
+        out=str(tmp_path / "m.json"))
+    m = json.load(open(out))
+    ev = {e["name"]: e for e in m["traceEvents"]}
+    assert len(ev) == 2
+    # header file: ts 50 + (wall 1000 - perf 0) = 1050; headerless
+    # keeps its epoch at 10; t0-normalization subtracts the min (10)
+    assert ev["headerless"]["ts"] == pytest.approx(0.0)
+    assert ev["with_header"]["ts"] == pytest.approx(1040.0)
+    # same source pid 0 in both files -> distinct tracks after merge
+    assert ev["headerless"]["pid"] != ev["with_header"]["pid"]
+    # provenance: merged_from records which input had no clock header
+    offsets = {s["rank"]: s["clock_offset_us"]
+               for s in m["mxtpu"]["merged_from"]}
+    assert offsets == {0: 0.0, None: None}
+
+
 def test_merge_traces_clock_offset_sign(tmp_path):
     """Pin the offset sign: PSClient.ping computes offset as
     server_minus_client, so a rank whose clock is 1s BEHIND the

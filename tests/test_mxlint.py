@@ -892,23 +892,33 @@ def test_cli_github_format_clean_repo(capsys):
     assert "::error" not in out
 
 
-def test_cli_github_format_show_baselined(capsys):
+def test_cli_github_format_show_baselined(tmp_path, capsys):
     """--show-baselined surfaces suppressed findings as ::notice
-    annotations in github mode (it is not silently ignored)."""
+    annotations in github mode (it is not silently ignored). Runs
+    against a fixture with a scratch baseline — the repo's own
+    baseline is empty."""
+    import shutil
+
     from tools.mxlint import main
 
-    old = os.getcwd()
-    os.chdir(REPO)
-    try:
-        rc = main(["mxnet_tpu", "--format", "github",
-                   "--show-baselined"])
-    finally:
-        os.chdir(old)
+    ops_dir = tmp_path / "mxnet_tpu" / "ops"
+    ops_dir.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_dtype.py"),
+                str(ops_dir / "bad.py"))
+    bl = str(tmp_path / "bl.json")
+    assert main([str(tmp_path / "mxnet_tpu"), "--baseline", bl,
+                 "--rules", "dtype-default",
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    rc = main([str(tmp_path / "mxnet_tpu"), "--baseline", bl,
+               "--rules", "dtype-default", "--format", "github",
+               "--show-baselined"])
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "::error" not in out
     notices = [ln for ln in out.splitlines()
                if ln.startswith("::notice file=")]
-    assert notices, out
+    assert len(notices) == 4, out
     assert "%d baselined" % len(notices) in out  # one notice per entry
-    assert all("mxlint baselined" in ln for ln in notices)
+    assert all("mxlint baselined dtype-default" in ln
+               for ln in notices)

@@ -54,6 +54,34 @@ def parse_args():
                         'one clock-aligned file (see --out).')
     p.add_argument('--out', default='merged_trace.json',
                    help='Output path for --merge-traces.')
+    p.add_argument('--doctor', nargs='+', metavar='FILE',
+                   help='Perf doctor: rank bottlenecks (idle gaps, recompile '
+                        'storms, data wait, host syncs, roofline headroom, '
+                        'shard stragglers) from a chrome trace and/or a '
+                        'MXNET_TPU_DIAG dump, with evidence and a next '
+                        'action per finding.  Files are classified by '
+                        'content; pass both kinds for full coverage.')
+    p.add_argument('--compare', nargs=2, metavar=('A', 'B'),
+                   help='Dump-diff regression report: diff two diag dumps '
+                        '(baseline A vs candidate B) — step-anatomy phases, '
+                        'latency histograms, per-op dispatch rates, '
+                        'compile/miss counters, memory peak — and print '
+                        'regressions/improvements past --threshold plus a '
+                        'machine-readable JSON verdict line.  Exit code 1 '
+                        'on regression.')
+    p.add_argument('--threshold', type=float, default=0.2,
+                   help='Relative change that counts as a regression/'
+                        'improvement for --compare (0.2 = 20%%).')
+    p.add_argument('--format', choices=('text', 'github'), default='text',
+                   help="'github' adds ::error/::notice workflow-command "
+                        'annotations for --doctor/--compare findings '
+                        '(the tools/mxlint convention).')
+    p.add_argument('--json', action='store_true',
+                   help='For --doctor/--compare: print the machine-readable '
+                        'JSON (findings list / verdict) instead of only the '
+                        'human report.')
+    p.add_argument('--top', type=int, default=20,
+                   help='Max findings for --doctor.')
     p.add_argument('--network', default=0, type=int,
                    help='Diagnose network (off by default: many TPU pods have no egress).')
     p.add_argument('--timeout', default=10, type=int,
@@ -240,8 +268,95 @@ def merge_traces(paths, out):
     print('Merged trace :', merged)
 
 
+def run_doctor(paths, top=20, fmt='text', as_json=False):
+    """Perf doctor over a chrome trace and/or diag dump: ranked
+    findings with evidence + next actions (docs/OBSERVABILITY.md
+    'Step anatomy & perf doctor').  Returns 0 (findings are advice,
+    not failures)."""
+    import json as _json
+
+    from mxnet_tpu import perfdoctor, runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    trace = dump = None
+    for p in paths:
+        kind, data = perfdoctor.classify(p)
+        if kind == 'trace':
+            if trace is not None:
+                print('error: --doctor takes at most one chrome trace '
+                      '(got a second: %s)' % p, file=sys.stderr)
+                return 2
+            trace = data
+        else:
+            if dump is not None:
+                print('error: --doctor takes at most one diag dump '
+                      '(got a second: %s); for a multi-rank view use '
+                      '--cluster' % p, file=sys.stderr)
+                return 2
+            dump = data
+    findings = perfdoctor.diagnose(trace=trace, dump=dump, top=top)
+    if as_json:
+        print(_json.dumps(findings, indent=1))
+    else:
+        print(perfdoctor.render(findings, inputs=paths))
+    if fmt == 'github' and findings:
+        print(perfdoctor.render_github(findings))
+    return 0
+
+
+def run_compare(a_path, b_path, threshold=0.2, fmt='text',
+                as_json=False):
+    """Dump-diff regression report between two diag dumps; always ends
+    with one machine-readable JSON verdict line.  Exit code 1 on
+    regression (so a perf PR's CI can gate on it)."""
+    import json as _json
+
+    from mxnet_tpu import perfdoctor, runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    for p in (a_path, b_path):
+        if os.path.isdir(p):
+            print('error: --compare diffs exactly two dump FILES '
+                  '(%s is a directory)' % p, file=sys.stderr)
+            return 2
+    dumps = runtime_stats.load_dumps([a_path, b_path])
+    result = runtime_stats.compare(dumps[0], dumps[1],
+                                   threshold=threshold)
+    if as_json:
+        print(_json.dumps(result, indent=1))
+    else:
+        print(runtime_stats.render_compare(result))
+        # the one-line machine-readable verdict (grep-able from CI logs
+        # even in text mode)
+        print(_json.dumps({'verdict': result['verdict'],
+                           'regressions': len(result['regressions']),
+                           'improvements': len(result['improvements']),
+                           'threshold': result['threshold']}))
+    if fmt == 'github':
+        for e in result['regressions']:
+            print(perfdoctor.gh_annotation(
+                'error', 'perf regression: %s %.3f -> %.3f %s (%+.0f%%)'
+                % (e['metric'], e['before'], e['after'], e['unit'],
+                   (e['ratio'] - 1.0) * 100)))
+        for e in result['improvements']:
+            print(perfdoctor.gh_annotation(
+                'notice', 'perf improvement: %s %.3f -> %.3f %s (%+.0f%%)'
+                % (e['metric'], e['before'], e['after'], e['unit'],
+                   (e['ratio'] - 1.0) * 100)))
+    return 1 if result['regressions'] else 0
+
+
 def main():
     args = parse_args()
+    if args.doctor or args.compare:
+        # focused analysis views: skip the platform sections
+        rc = 0
+        if args.doctor:
+            rc = run_doctor(args.doctor, top=args.top, fmt=args.format,
+                            as_json=args.json) or rc
+        if args.compare:
+            rc = run_compare(args.compare[0], args.compare[1],
+                             threshold=args.threshold, fmt=args.format,
+                             as_json=args.json) or rc
+        sys.exit(rc)
     if args.cluster or args.merge_traces:
         # focused distributed-telemetry views: skip the platform sections
         if args.cluster:

@@ -286,6 +286,18 @@ def main(argv=None):
         summary["cost_model_temp_out_gb"] = round(
             (cost.get("temp_bytes", 0) + cost.get("output_bytes", 0))
             / 1e9, 2)
+    # the measured step anatomy, in the SAME shape/names/units as the
+    # host-side attribution (mxnet_tpu/stepstats.py): device compute is
+    # the one phase a whole-step-jitted trace can attribute, with the
+    # remainder explicit — so this summary, report()'s "Step anatomy"
+    # table, and diagnose.py --doctor findings read identically
+    from mxnet_tpu import stepstats
+    summary["step_anatomy"] = stepstats.device_anatomy_ms(
+        summary["jit_step_ms"],
+        {"device_compute": summary["sum_hlo_ms"],
+         # overlapped HBM<->VMEM prefetch: reported as its own phase;
+         # any sum past the wall surfaces as overlap_ms, never hidden
+         "hbm_prefetch": prefetch["us_per_step"] / 1e3})
     print(json.dumps(summary))
     for r in rows[:args.top]:
         print("%8.1f us  bound %7.1f  %6.1f GB/s  mxu %5.1f%%  %-28s %s"
