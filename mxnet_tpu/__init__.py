@@ -27,9 +27,18 @@ def _maybe_init_distributed():
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if n <= 1 or os.environ.get("DMLC_ROLE", "worker") != "worker":
         return
+    if int(os.environ.get("DMLC_NUM_SERVER", "0") or 0) > 0:
+        # dist_async launch (launch.py -s N): worker coordination is
+        # the host-side parameter server (kvstore/ps.py), not a
+        # jax.distributed process group — joining one would be pure
+        # startup cost and requires jax features some builds lack
+        return
     import jax
 
-    if jax.distributed.is_initialized():
+    # feature-detect is_initialized: some jax builds ship
+    # jax.distributed without it
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
         return  # user script already joined the group
     jax.distributed.initialize(
         coordinator_address="%s:%s" % (

@@ -79,7 +79,7 @@ from .log import get_logger, warn_rate_limited
 
 __all__ = ["atomic_write", "CheckpointManager", "enable", "disable",
            "is_enabled", "manager", "on_step", "auto_resume", "lineage",
-           "save_legacy", "load_legacy", "MANIFEST_NAME",
+           "save_legacy", "load_legacy", "load_aux", "MANIFEST_NAME",
            "TRAINER_STATES_MAGIC", "TRAINER_STATES_VERSION"]
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -333,14 +333,22 @@ class CheckpointManager:
                     "extra": extra}
         return self._submit(snapshot)
 
-    def save(self, step, params, extra=None):
-        """Snapshot a plain ``{name: NDArray}`` mapping (no trainer)."""
+    def save(self, step, params, extra=None, aux=None):
+        """Snapshot a plain ``{name: NDArray}`` mapping (no trainer).
+
+        ``aux``, when given, is an opaque picklable sideband payload
+        committed alongside the arrays (``aux.pkl``, checksummed in the
+        manifest) and read back with :func:`load_aux` — the hook
+        non-Trainer state owners (the dist parameter-server shards, the
+        coming ZeRO per-rank shard files) persist their bookkeeping
+        through, atomically with the data it describes."""
         caps = {k: _NDLeaf(getattr(v, "_data", v))
                 for k, v in params.items()}
         from . import random as _random
 
         snapshot = {"step": int(step), "params": caps, "trainer": {},
-                    "rng": dict(_random.get_state()), "extra": extra}
+                    "rng": dict(_random.get_state()), "extra": extra,
+                    "aux": aux}
         return self._submit(snapshot)
 
     def _submit(self, snapshot):
@@ -460,11 +468,21 @@ class CheckpointManager:
                     os.fsync(f.fileno())
                 files["trainer.pkl"] = {"sha256": _sha256(tpath),
                                         "bytes": os.path.getsize(tpath)}
+            if snapshot.get("aux") is not None:
+                apath = os.path.join(tmp, "aux.pkl")
+                with open(apath, "wb") as f:
+                    pickle.dump(snapshot["aux"], f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+                files["aux.pkl"] = {"sha256": _sha256(apath),
+                                    "bytes": os.path.getsize(apath)}
             manifest = {"version": MANIFEST_VERSION, "step": step,
                         "time": snapshot["time"], "pid": os.getpid(),
                         "files": files,
                         "params": sorted(snapshot["params"]),
                         "has_trainer": bool(snapshot["trainer"]),
+                        "has_aux": snapshot.get("aux") is not None,
                         "rng": snapshot["rng"],
                         "probe": snapshot.get("probe"),
                         "extra": snapshot.get("extra"),
@@ -641,6 +659,13 @@ class CheckpointManager:
                      allow_pickle=False) as data:
             return {k: array(data[k]) for k in data.files}
 
+    def load_aux(self, manifest):
+        """The opaque sideband payload saved via ``save(..., aux=)``,
+        or None when the checkpoint carries none.  Plain pickle — same
+        trust model as ``trainer.pkl`` (load only checkpoints from
+        directories you trust)."""
+        return load_aux(manifest)
+
     def restore(self, trainer=None, block=None, manifest=None):
         """One-call auto-resume: load the newest valid checkpoint back
         into a ``Trainer`` (parameters by name, updater state, optimizer
@@ -810,6 +835,17 @@ def on_step(trainer):
         mgr.save_trainer(trainer, step=mgr.step_clock)
         if ss_on:
             _stepstats.end("checkpoint_write", ss_tok)
+
+
+def load_aux(manifest):
+    """Read a checkpoint's opaque ``aux.pkl`` sideband payload (see
+    ``CheckpointManager.save``); None when the manifest carries none.
+    The file's checksum was already verified by ``latest()``/``verify``
+    before the manifest was handed out."""
+    if not manifest or not manifest.get("has_aux"):
+        return None
+    with open(os.path.join(manifest["path"], "aux.pkl"), "rb") as f:
+        return pickle.load(f)
 
 
 def auto_resume(trainer=None, block=None):

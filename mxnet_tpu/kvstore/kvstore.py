@@ -256,8 +256,11 @@ class DistKVStore(KVStore):
         import jax
 
         # normally already joined at import (mxnet_tpu._maybe_init_distributed
-        # reads the same DMLC_* contract); handle direct construction too
-        if jax.distributed.is_initialized():
+        # reads the same DMLC_* contract); handle direct construction too.
+        # Feature-detect is_initialized: some jax builds ship
+        # jax.distributed without it
+        is_init = getattr(jax.distributed, "is_initialized", None)
+        if is_init is not None and is_init():
             self._group = True
             return
         coord = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -519,14 +522,27 @@ class DistAsyncKVStore(KVStore):
 
     # --------------------------------------------- distributed telemetry
     def server_stats(self):
-        """Every PS shard's server-side metrics (per-key bytes in/out,
-        per-peer request counts, apply/handle latency histograms, queue
-        depth, accepted connections) — the ``stats`` command
-        (docs/OBSERVABILITY.md "Distributed telemetry").  Empty list on
-        a degraded in-process store."""
+        """Every PS shard's server-side metrics (per-key bytes in/out +
+        applied-mutation versions, per-peer request counts, apply/handle
+        latency histograms, queue depth, accepted connections, plus the
+        ``dedup`` exactly-once table and ``durability`` checkpoint
+        state) — the ``stats`` command (docs/OBSERVABILITY.md
+        "Distributed telemetry").  Empty list on a degraded in-process
+        store."""
         if self._client is None:
             return []
         return self._client.server_stats()
+
+    def checkpoint_servers(self):
+        """Ask every PS shard to commit its durable store snapshot NOW
+        (the reserved ``ckpt`` command head): one
+        ``{"enabled", "step", "path"}`` dict per shard — ``enabled`` is
+        False for servers running without ``MXNET_TPU_PS_CKPT``
+        (docs/CHECKPOINTING.md "Server-side durability").  Empty list
+        on a degraded in-process store."""
+        if self._client is None:
+            return []
+        return self._client.checkpoint_shards()
 
     def push_diag(self, top=20):
         """Park this rank's ``runtime_stats.diag_snapshot()`` on PS

@@ -35,9 +35,39 @@ errors with bounded exponential backoff + reconnect (``PSClient._call``;
 ``MXNET_TPU_KV_RETRIES``/``MXNET_TPU_KV_RETRY_BACKOFF``), server-side
 per-connection errors are logged rate-limited with the peer address
 instead of silently swallowed, and ``MXNET_TPU_FAULT`` injects
-deterministic failures (drop/delay/refuse connections,
-kill-server-after-N-messages) so all of it is testable —
+deterministic failures (drop/delay/refuse connections, drop replies,
+kill/restart the server after N messages) so all of it is testable —
 docs/CHECKPOINTING.md "Fault injection".
+
+Self-healing (PR 9, docs/CHECKPOINTING.md "Server-side durability"):
+
+- **Durable shards.**  ``MXNET_TPU_PS_CKPT=<dir>`` makes each shard
+  persist its store (key → value + per-key applied-mutation version),
+  the worker-shipped optimizer blob, the exactly-once dedup table, and
+  any app-controller state through ``checkpoint.CheckpointManager`` —
+  one atomic manifest commit every ``MXNET_TPU_PS_CKPT_INTERVAL``
+  applied mutations (on the handler thread, BEFORE the ack, so with
+  interval 1 no acknowledged mutation can be lost) and on demand via
+  the reserved ``ckpt`` command head.  A restarted server auto-restores
+  from its newest valid manifest in ``__init__``.
+- **Exactly-once retried mutations.**  Every mutating request
+  (``push``/``init``/``set_optimizer``/``command``) carries a
+  ``{"cid", "seq"}`` header; each shard keeps a
+  bounded per-client last-applied-seq table (persisted with the store)
+  and acks duplicates with the cached reply WITHOUT re-applying — a
+  request whose reply is lost after the server applied it is therefore
+  safe to retry, which is what makes ``command`` retryable and deletes
+  the historical double-apply caveat.  ``barrier``/``stop`` stay
+  never-retried (a double barrier arrival would desynchronize every
+  later generation).
+- **Liveness supervision.**  ``MXNET_TPU_KV_DEADLINE=<s>`` arms a
+  worker-side heartbeat thread (guard-first: no thread, no probe
+  sockets when unset) that pings idle shards on short-lived
+  connections and warns (rate-limited, ``kvstore_dead_shard_warnings``
+  counter) when a shard has had no successful contact past the
+  deadline; under ``tools/launch.py`` with ``MXNET_TPU_SUPERVISE=N``
+  a dead server process is relaunched (bounded restarts) and
+  self-restores from its durable shard checkpoint.
 
 Distributed telemetry (PR 7): each server shard keeps always-on
 metrics — per-key bytes in/out and request counts, per-peer request
@@ -59,6 +89,7 @@ warning when one shard's RTT p99 diverges past
 from __future__ import annotations
 
 import io
+import itertools
 import json as _json
 import os
 import pickle
@@ -66,6 +97,7 @@ import socket
 import struct
 import threading
 import time
+import uuid
 
 from .. import histogram as _histogram
 
@@ -86,23 +118,38 @@ def _logger():
 # --------------------------------------------------------- fault harness --
 # Deterministic fault injection for the dist kvstore (MXNET_TPU_FAULT):
 # the failure modes a real cluster produces nondeterministically —
-# dropped/delayed/refused connections, a parameter server dying
-# mid-push — become reproducible test fixtures.  Injection is entirely
-# server-side and counted under one lock, so "the Nth message" means
-# the same message every run.  Faults fire BEFORE a message is handled,
-# which keeps retried pushes exactly-once on the server state (a push
-# whose connection died after apply would double-apply on retry; see
-# PSClient._call's caveat on reply-loss ambiguity).
+# dropped/delayed/refused connections, lost replies, a parameter server
+# dying mid-push — become reproducible test fixtures.  Injection is
+# entirely server-side and counted under one lock, so "the Nth message"
+# means the same message every run.  Crash-style faults fire BEFORE a
+# message is handled (the in-flight mutation is neither applied nor
+# acked, so its retry applies exactly once), while reply_drop fires
+# AFTER handling — the apply succeeded but the ack is lost, which is
+# precisely the window the (cid, seq) dedup table exists for.
 #
-#   MXNET_TPU_FAULT=drop_after:N   close the worker connection instead
-#                                  of handling every Nth message
-#   MXNET_TPU_FAULT=delay:S        sleep S seconds before each message
-#   MXNET_TPU_FAULT=refuse:N       close the first N accepted
-#                                  connections immediately
-#   MXNET_TPU_FAULT=kill_after:N   stop the whole server upon receiving
-#                                  the Nth message (before handling it)
+#   MXNET_TPU_FAULT=drop_after:N     close the worker connection instead
+#                                    of handling every Nth message
+#   MXNET_TPU_FAULT=delay:S          sleep S seconds before each message
+#   MXNET_TPU_FAULT=refuse:N         close the first N accepted
+#                                    connections immediately
+#   MXNET_TPU_FAULT=kill_after:N     stop the whole server upon receiving
+#                                    the Nth message (before handling it)
+#   MXNET_TPU_FAULT=reply_drop:N     handle every Nth message normally,
+#                                    then close the connection instead of
+#                                    sending the reply (exercises the
+#                                    exactly-once dedup path)
+#   MXNET_TPU_FAULT=restart_after:N  exit the server PROCESS nonzero
+#                                    upon receiving the Nth message
+#                                    (before handling it) so a
+#                                    supervisor (MXNET_TPU_SUPERVISE)
+#                                    revives it and it self-restores
 
-_FAULT_MODES = ("drop_after", "delay", "refuse", "kill_after")
+_FAULT_MODES = ("drop_after", "delay", "refuse", "kill_after",
+                "reply_drop", "restart_after")
+
+# exit code of a restart_after drill: distinctive so the launcher's
+# supervisor log lines are attributable to the injected fault
+RESTART_FAULT_EXIT = 40
 
 
 def parse_fault_spec(spec):
@@ -128,11 +175,25 @@ def set_app_controller(fn):
     """Register fn(head, body) to handle app-level server commands;
     pass None to clear.
 
-    The heads ``profiler``, ``stats``, ``ping``, ``diag_put`` and
-    ``diag_get`` are RESERVED by the framework (telemetry channel,
-    docs/OBSERVABILITY.md "Distributed telemetry") and are intercepted
-    before the app controller — pick other names."""
+    The heads ``profiler``, ``stats``, ``ping``, ``diag_put``,
+    ``diag_get`` and ``ckpt`` are RESERVED by the framework (telemetry
+    + durability channel, docs/OBSERVABILITY.md "Distributed
+    telemetry", docs/CHECKPOINTING.md "Server-side durability") and are
+    intercepted before the app controller — pick other names.
+
+    A controller that owns server-side state can expose
+    ``fn.get_state() -> picklable`` / ``fn.set_state(state)``:
+    durable shards (``MXNET_TPU_PS_CKPT``) persist that state with the
+    store and hand it back on restore, so an app controller survives a
+    server restart too.  Registration order does not matter — state
+    restored before the controller existed is held by the server and
+    delivered on the controller's first command."""
     _app_controller[0] = fn
+
+
+# command heads the framework intercepts before the app controller
+_RESERVED_HEADS = ("profiler", "stats", "ping", "diag_put", "diag_get",
+                   "ckpt")
 
 
 # modules/names a data message may reference: enough to rebuild numpy
@@ -294,6 +355,51 @@ class PSServer:
         self._accepted = 0
         # rank → diag-dump JSON string parked by the diag_put command
         self._rank_dumps = {}
+        self._server_id = int(os.environ.get(
+            "MXTPU_PS_SERVER_ID",
+            os.environ.get("DMLC_SERVER_ID", "0")) or 0)
+        # per-key applied-mutation versions (init counts as version 1);
+        # unlike _per_key's wire accounting these move only when a
+        # mutation actually APPLIES, so dedup drills can assert
+        # exactly-once server-side
+        self._versions = {}
+        # exactly-once dedup: cid → {"seq", "reply", "t"} of the last
+        # APPLIED stamped request per client (bounded, LRU-evicted;
+        # persisted with the store so it survives a restart)
+        self._seq_lock = threading.Lock()
+        self._seq = {}
+        self._dup_suppressed = 0
+        # pairs an apply with its seq-table record atomically AGAINST
+        # durable-snapshot capture: a checkpoint must never see a seq
+        # entry without its apply (a retry would be suppressed and the
+        # mutation lost) nor an apply without its seq entry (a retry
+        # would double-apply).  Mutations already serialize through
+        # _opt_lock inside _apply, so this costs no real parallelism.
+        self._mutate_lock = threading.Lock()
+        # durable-shard state (MXNET_TPU_PS_CKPT): one CheckpointManager
+        # per shard, SYNCHRONOUS writes on the handler thread so a
+        # periodic commit always lands BEFORE the ack it covers
+        self._opt_blob = None
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_mgr = None
+        self._ckpt_interval = 0
+        self._mutations = 0
+        self._last_ckpt_time = None
+        self._restored_step = None
+        # restored app-controller state awaiting a controller (one may
+        # be registered after construction); applied lazily on its
+        # first command and re-persisted until then
+        self._app_state = None
+        ckpt_dir = os.environ.get("MXNET_TPU_PS_CKPT")
+        if ckpt_dir:
+            from ..checkpoint import CheckpointManager
+
+            self._ckpt_interval = int(os.environ.get(
+                "MXNET_TPU_PS_CKPT_INTERVAL", "100") or 0)
+            self._ckpt_mgr = CheckpointManager(
+                os.path.join(ckpt_dir, "server%d" % self._server_id),
+                async_write=False, prefix="ps")
+            self._restore()
 
     # -- handler plumbing --------------------------------------------------
     def serve_forever(self):
@@ -341,6 +447,14 @@ class PSServer:
         for t in threads:
             t.join(timeout=5)
         self._sock.close()
+        if self._ckpt_mgr is not None:
+            # a clean stop leaves the newest state durable even when
+            # the interval boundary was not reached
+            try:
+                self._ckpt_save()
+            except Exception:
+                _logger().exception(
+                    "final durable-shard checkpoint failed on stop")
 
     def _serve_conn(self, conn):
         try:
@@ -362,7 +476,16 @@ class PSServer:
                     return
                 if msg is None:
                     return
-                if self._fault is not None:
+                drop_reply = False
+                # liveness 'ping' commands are FAULT-EXEMPT: the
+                # heartbeat (MXNET_TPU_KV_DEADLINE) probes on its own
+                # wall-clock cadence, and letting those messages
+                # advance the fault counter would break the "the Nth
+                # message is the same message every run" determinism
+                # the drills are built on
+                is_ping = msg[0] == "command" and len(msg) > 1 \
+                    and msg[1] == "ping"
+                if self._fault is not None and not is_ping:
                     action = self._fault_tick()
                     if action == "drop":
                         return
@@ -373,6 +496,19 @@ class PSServer:
                         except OSError:
                             pass
                         return
+                    if action == "restart":
+                        # crash drill: die BEFORE handling (the in-flight
+                        # mutation is neither applied nor acked) with a
+                        # nonzero code so the launcher's supervisor
+                        # revives the process; durable-shard writes are
+                        # synchronous, so there is nothing to flush
+                        _logger().warning(
+                            "MXNET_TPU_FAULT=restart_after: server "
+                            "shard %d exiting %d on message %d",
+                            self._server_id, RESTART_FAULT_EXIT,
+                            self._fault["arg"])
+                        os._exit(RESTART_FAULT_EXIT)
+                    drop_reply = action == "reply_drop"
                 t_handle = time.perf_counter()
                 with self._metrics_lock:
                     self._op_counts[msg[0]] = \
@@ -390,6 +526,11 @@ class PSServer:
                         self._inflight -= 1
                     self._handle_hist.observe(
                         time.perf_counter() - t_handle)
+                if drop_reply:
+                    # the request WAS handled (and, for a mutation,
+                    # applied + recorded in the seq table); losing the
+                    # reply forces the client through retry → dedup
+                    return
                 try:
                     _send_msg(conn, reply)
                 except OSError as e:
@@ -416,7 +557,8 @@ class PSServer:
 
     def _fault_tick(self):
         """Advance the injected-fault clock for one received message;
-        returns 'drop', 'kill', or None (after any injected delay)."""
+        returns 'drop', 'kill', 'restart', 'reply_drop', or None (after
+        any injected delay)."""
         mode, arg = self._fault["mode"], self._fault["arg"]
         if mode == "delay":
             time.sleep(arg)
@@ -428,8 +570,12 @@ class PSServer:
             n = self._fault_msgs
         if mode == "drop_after" and arg > 0 and n % arg == 0:
             return "drop"
+        if mode == "reply_drop" and arg > 0 and n % arg == 0:
+            return "reply_drop"
         if mode == "kill_after" and n >= arg:
             return "kill"
+        if mode == "restart_after" and n >= arg:
+            return "restart"
         return None
 
     def _key_lock(self, key):
@@ -437,6 +583,149 @@ class PSServer:
             if key not in self._locks:
                 self._locks[key] = threading.Lock()
             return self._locks[key]
+
+    # -- durable shard (MXNET_TPU_PS_CKPT) ---------------------------------
+    # The store's numpy buffers are never mutated in place: init binds a
+    # fresh copy and _apply REBINDS (`self._store[key] = weight.asnumpy()`),
+    # so capturing references under _store_lock is a consistent snapshot
+    # even while other keys keep applying — the same immutability argument
+    # the worker-side zero-copy checkpoint rests on (checkpoint.py).
+
+    def _restore(self):
+        """Auto-restore this shard from its newest valid manifest:
+        store + per-key versions, the dedup seq table, the optimizer
+        blob (updater rebuilt through the allowlisted unpickler), and
+        app-controller state.  A shard revived by the launcher's
+        supervisor recovers its own state from disk — no operator or
+        test-side seeding."""
+        manifest = self._ckpt_mgr.latest()
+        if manifest is None:
+            return
+        import numpy as np
+
+        from .. import runtime_stats as _rts
+        from ..checkpoint import load_aux
+
+        aux = load_aux(manifest) or {}
+        keys = list(aux.get("keys") or [])
+        with np.load(os.path.join(manifest["path"], "params.npz"),
+                     allow_pickle=False) as data:
+            self._store = {k: data["a%d" % i]
+                           for i, k in enumerate(keys)}
+        self._versions = dict(aux.get("versions") or {})
+        self._seq = {cid: dict(ent)
+                     for cid, ent in (aux.get("seq_table") or {}).items()}
+        self._mutations = int(manifest.get("step", 0))
+        blob = aux.get("optimizer_blob")
+        if blob:
+            self._set_optimizer(blob)
+        app_state = aux.get("app_state")
+        ctrl = _app_controller[0]
+        if app_state is not None and hasattr(ctrl, "set_state"):
+            ctrl.set_state(app_state)
+        elif app_state is not None:
+            # no controller registered (yet): carry the state so a
+            # controller installed after construction still receives
+            # it (applied lazily on its first command) and so it is
+            # re-persisted rather than silently dropped
+            self._app_state = app_state
+        self._restored_step = self._mutations
+        _rts.inc("kvstore_server_restores")
+        _logger().info(
+            "parameter-server shard %d restored %d key(s) at mutation "
+            "%d from %s", self._server_id, len(self._store),
+            self._mutations, manifest["path"])
+
+    def _ckpt_save(self):
+        """Commit one durable snapshot of this shard (store + versions +
+        seq table + optimizer blob + app-controller state) through the
+        CheckpointManager; returns the manager's ``last_good`` record or
+        None when durability is off."""
+        if self._ckpt_mgr is None:
+            return None
+        with self._ckpt_lock:
+            # capture under _mutate_lock: the snapshot must be
+            # mutation-ATOMIC — store, seq table, and versions from the
+            # same instant, with no apply/record pair straddling it
+            with self._mutate_lock:
+                with self._store_lock:
+                    keys = list(self._store)
+                    params = {"a%d" % i: self._store[k]
+                              for i, k in enumerate(keys)}
+                with self._seq_lock:
+                    seq = {cid: dict(ent)
+                           for cid, ent in self._seq.items()}
+                with self._metrics_lock:
+                    versions = dict(self._versions)
+                aux = {"keys": keys, "versions": versions,
+                       "seq_table": seq,
+                       "optimizer_blob": self._opt_blob,
+                       "mutations": self._mutations}
+                ctrl = _app_controller[0]
+                if hasattr(ctrl, "get_state"):
+                    aux["app_state"] = ctrl.get_state()
+                elif self._app_state is not None:
+                    # restored state still awaiting its controller:
+                    # keep persisting it, never silently drop it
+                    aux["app_state"] = self._app_state
+            self._ckpt_mgr.save(self._mutations, params, aux=aux)
+            self._last_ckpt_time = time.time()
+            return self._ckpt_mgr.last_good
+
+    def _mutation_tick(self):
+        """Advance the applied-mutation clock; at interval boundaries
+        commit the durable snapshot BEFORE the handler's ack goes out
+        (with MXNET_TPU_PS_CKPT_INTERVAL=1 every acknowledged mutation
+        is therefore on disk — the bit-exact recovery drills rely on
+        it; larger intervals trade a bounded window of acked-but-
+        unpersisted mutations for fewer fsyncs)."""
+        with self._ckpt_lock:
+            self._mutations += 1
+            due = self._ckpt_mgr is not None and self._ckpt_interval \
+                and self._mutations % self._ckpt_interval == 0
+        if due:
+            self._ckpt_save()
+
+    # -- exactly-once dedup ------------------------------------------------
+    _SEQ_CLIENTS_MAX = 1024
+
+    def _seq_check(self, meta):
+        """Duplicate lookup for a stamped request: the cached reply when
+        this ``(cid, seq)`` was already applied (the request is a retry
+        whose original reply was lost), else None.  Never re-applies."""
+        if not meta:
+            return None
+        with self._seq_lock:
+            ent = self._seq.get(meta["cid"])
+            if ent is None or meta["seq"] > ent["seq"]:
+                return None
+            reply = tuple(ent["reply"]) if meta["seq"] == ent["seq"] \
+                else ("ok", None)
+            self._dup_suppressed += 1
+        from .. import runtime_stats as _rts
+
+        _rts.inc("kvstore_dup_suppressed")
+        return reply
+
+    def _seq_record(self, meta, reply):
+        """Record a stamped request's successful reply so a retry acks
+        without re-applying.  One entry per client (the client protocol
+        has one request in flight), LRU-bounded to ``_SEQ_CLIENTS_MAX``
+        clients."""
+        if not meta:
+            return
+        with self._seq_lock:
+            self._seq[meta["cid"]] = {"seq": int(meta["seq"]),
+                                      "reply": reply, "t": time.time()}
+            while len(self._seq) > self._SEQ_CLIENTS_MAX:
+                oldest = min(self._seq, key=lambda c: self._seq[c]["t"])
+                del self._seq[oldest]
+
+    def _note_apply(self, key):
+        """Bump a key's applied-mutation version (init/push that really
+        applied — duplicates never reach this)."""
+        with self._metrics_lock:
+            self._versions[key] = self._versions.get(key, 0) + 1
 
     # -- handlers ----------------------------------------------------------
     def _note_key(self, key, op, nbytes):
@@ -451,27 +740,58 @@ class PSServer:
 
     def _handle(self, msg):
         op = msg[0]
+        # push/command carry an optional 4th element: the client's
+        # {"cid", "seq"} exactly-once header (unstamped legacy messages
+        # still handled)
         if op == "init":
-            _, key, arr = msg
+            key, arr = msg[1], msg[2]
+            meta = msg[3] if len(msg) > 3 else None
             self._note_key(key, "init", getattr(arr, "nbytes", 0))
-            with self._key_lock(key):
-                self._store[key] = arr.copy()
-            return ("ok", None)
+            # init is stamped too: a reply-lost retried init would
+            # otherwise re-bind the key and silently discard another
+            # worker's push applied in the retry window
+            dup = self._seq_check(meta)
+            if dup is not None:
+                return dup
+            reply = ("ok", None)
+            with self._mutate_lock:
+                with self._key_lock(key):
+                    self._store[key] = arr.copy()
+                self._note_apply(key)
+                self._seq_record(meta, reply)
+            self._mutation_tick()
+            return reply
         if op == "push":
-            _, key, grad = msg
+            key, grad = msg[1], msg[2]
+            meta = msg[3] if len(msg) > 3 else None
             from .. import profiler
 
             self._note_key(key, "push", getattr(grad, "nbytes", 0))
+            dup = self._seq_check(meta)
+            if dup is not None:
+                return dup
+            reply = ("ok", None)
             with profiler.scope("ps_push:%s" % (key,), "kvstore"):
-                with self._key_lock(key):
-                    if key not in self._store:
-                        raise KeyError("key %r not initialized" % (key,))
-                    t0 = time.perf_counter()
-                    self._apply(key, grad)
-                    self._apply_hist.observe(time.perf_counter() - t0)
-            return ("ok", None)
+                # apply + seq record as one unit w.r.t. snapshot
+                # capture (see _mutate_lock), BEFORE the durable
+                # commit: a crash before the commit leaves the
+                # mutation unacked and unpersisted, so the retry
+                # re-applies exactly once on the restored store
+                with self._mutate_lock:
+                    with self._key_lock(key):
+                        if key not in self._store:
+                            raise KeyError(
+                                "key %r not initialized" % (key,))
+                        t0 = time.perf_counter()
+                        self._apply(key, grad)
+                        self._apply_hist.observe(
+                            time.perf_counter() - t0)
+                    self._note_apply(key)
+                    self._seq_record(meta, reply)
+            self._mutation_tick()
+            return reply
         if op == "pull":
-            _, key = msg
+            key = msg[1]
             from .. import profiler
 
             with profiler.scope("ps_pull:%s" % (key,), "kvstore"):
@@ -482,12 +802,48 @@ class PSServer:
             self._note_key(key, "pull", getattr(out, "nbytes", 0))
             return ("ok", out)
         if op == "set_optimizer":
-            _, blob = msg
-            self._set_optimizer(blob)
-            return ("ok", None)
+            blob = msg[1]
+            meta = msg[2] if len(msg) > 2 else None
+            dup = self._seq_check(meta)
+            if dup is not None:
+                return dup
+            reply = ("ok", None)
+            with self._mutate_lock:
+                self._set_optimizer(blob)
+                self._seq_record(meta, reply)
+            # the optimizer blob is part of the durable state: count it
+            # toward the snapshot cadence so an acked set_optimizer at
+            # interval 1 survives a crash (a revived server must not
+            # train with stale hyperparameters — or no updater at all)
+            self._mutation_tick()
+            return reply
         if op == "command":
-            _, head, body = msg
-            return ("ok", self._command(head, body))
+            head, body = msg[1], msg[2]
+            meta = msg[3] if len(msg) > 3 else None
+            dup = self._seq_check(meta)
+            if dup is not None:
+                return dup
+            if head in _RESERVED_HEADS or _app_controller[0] is None:
+                # framework heads are read-only (or, for 'ckpt', take
+                # the checkpoint locks themselves) — no mutation pairing
+                reply = ("ok", self._command(head, body))
+                self._seq_record(meta, reply)
+                return reply
+            # an app-controller command may mutate the state the
+            # controller owns: run + seq-record as one unit w.r.t.
+            # snapshot capture, and count it toward the durable cadence
+            with self._mutate_lock:
+                ctrl = _app_controller[0]
+                if self._app_state is not None and \
+                        hasattr(ctrl, "set_state"):
+                    # controller registered after construction: hand it
+                    # the restored state before its first command
+                    ctrl.set_state(self._app_state)
+                    self._app_state = None
+                reply = ("ok", self._command(head, body))
+                self._seq_record(meta, reply)
+            self._mutation_tick()
+            return reply
         if op == "barrier":
             self._barrier()
             return ("ok", None)
@@ -517,20 +873,29 @@ class PSServer:
         # the worker ships its Optimizer instance like the reference's
         # kv.set_optimizer pickled blob, but decoding is allowlisted to
         # this framework's optimizer/scheduler classes (r3; closes the
-        # r2 residual wire caveat)
+        # r2 residual wire caveat).  The raw blob is kept so durable
+        # shards can persist it and a revived server rebuilds its
+        # updater without the worker re-shipping it.
         optimizer = _OptimizerUnpickler(io.BytesIO(blob)).load()
         self._updater = opt_mod.get_updater(optimizer)
+        self._opt_blob = blob
 
     def stats_snapshot(self):
         """This shard's server-side metrics as one JSON-ready dict —
         the payload of the ``stats`` command.  ``connections_accepted``
         above one per worker is the server-visible trace of client
         reconnects/retries; ``queue_depth`` is the in-flight request
-        gauge at snapshot time (its ``_peak`` the high-water mark)."""
+        gauge at snapshot time (its ``_peak`` the high-water mark).
+        ``per_key[...]["version"]`` counts APPLIED mutations (dedup'd
+        retries excluded); ``dedup`` and ``durability`` describe the
+        exactly-once table and the shard's durable-checkpoint state
+        (docs/CHECKPOINTING.md "Server-side durability")."""
         from .. import runtime_stats as _rts
 
         with self._metrics_lock:
-            per_key = {str(k): dict(v) for k, v in self._per_key.items()}
+            versions = dict(self._versions)
+            per_key = {str(k): dict(v, version=versions.get(k, 0))
+                       for k, v in self._per_key.items()}
             per_peer = dict(self._per_peer)
             requests = dict(self._op_counts)
             inflight, peak = self._inflight, self._inflight_peak
@@ -540,10 +905,24 @@ class PSServer:
             fault = None if self._fault is None else dict(
                 self._fault, messages=self._fault_msgs,
                 refused=self._fault_refused)
+        with self._seq_lock:
+            dedup = {"clients": len(self._seq),
+                     "suppressed": self._dup_suppressed}
+        mgr = self._ckpt_mgr
+        durability = {"enabled": mgr is not None,
+                      "mutations": self._mutations}
+        if mgr is not None:
+            lg = mgr.last_good
+            durability.update({
+                "directory": mgr.directory,
+                "interval": self._ckpt_interval,
+                "saves": mgr.totals["written"],
+                "last_ckpt_step": lg["step"] if lg else None,
+                "last_ckpt_path": lg["path"] if lg else None,
+                "last_ckpt_time": self._last_ckpt_time,
+                "restored_step": self._restored_step})
         return {"role": "server",
-                "server_id": int(os.environ.get(
-                    "MXTPU_PS_SERVER_ID",
-                    os.environ.get("DMLC_SERVER_ID", "0")) or 0),
+                "server_id": self._server_id,
                 "pid": os.getpid(), "time": time.time(),
                 "uptime_seconds": time.time() - self._t_start,
                 "keys": len(self._store),
@@ -558,6 +937,8 @@ class PSServer:
                 "apply": self._apply_hist.snapshot(),
                 "handle": self._handle_hist.snapshot(),
                 "fault": fault,
+                "dedup": dedup,
+                "durability": durability,
                 "rank_dumps": rank_dumps}
 
     def _command(self, head, body):
@@ -567,13 +948,23 @@ class PSServer:
         traced server-side (reference: tests/nightly/
         test_server_profiling.py).  'stats' returns this shard's
         server-side metrics, 'ping' its wall clock (the client's trace
-        clock-offset probe), and 'diag_put'/'diag_get' park / serve
+        clock-offset probe), 'diag_put'/'diag_get' park / serve
         per-rank diag dumps for cluster aggregation
-        (docs/OBSERVABILITY.md "Distributed telemetry").  Any other
+        (docs/OBSERVABILITY.md "Distributed telemetry"), and 'ckpt'
+        commits the durable shard snapshot on demand
+        (docs/CHECKPOINTING.md "Server-side durability").  Any other
         head goes to the app-level controller when one is registered
         (reference: KVStore::RunServer's controller argument)."""
         if head == "stats":
             return _json.dumps(self.stats_snapshot())
+        if head == "ckpt":
+            if self._ckpt_mgr is None:
+                return _json.dumps({"enabled": False, "step": None,
+                                    "path": None})
+            lg = self._ckpt_save()
+            return _json.dumps({"enabled": True,
+                                "step": lg["step"] if lg else None,
+                                "path": lg["path"] if lg else None})
         if head == "ping":
             return _json.dumps({"t_server": time.time(),
                                 "pid": os.getpid()})
@@ -667,19 +1058,30 @@ class PSClient:
     (``MXNET_TPU_KV_RETRIES`` / ``MXNET_TPU_KV_RETRY_BACKOFF``), so a
     flaky network or a briefly-restarting server no longer kills the
     worker on the first socket error.  Exhausted retries raise a clear
-    ``MXNetError`` naming the shard.  Caveat (documented, like ps-lite
-    without per-message seq-acks): a request whose reply is lost after
-    the server applied it is re-sent on retry — idempotent for
-    init/pull, and within dist_async's Hogwild staleness model for
-    push; ``barrier``/``stop`` are never retried (a double barrier
-    arrival would desynchronize every subsequent generation), nor is
-    ``command`` (app-level controllers registered via
-    ``set_app_controller`` run arbitrary, possibly non-idempotent
-    code — a replayed "decay lr" must surface as an error, not apply
-    twice).
+    ``MXNetError`` naming the shard.  Retried mutations are
+    **exactly-once**: every ``push``/``init``/``set_optimizer``/
+    ``command`` is stamped with this client's ``(cid, seq)`` header
+    and the server's per-client
+    last-applied-seq table acks a retry whose original reply was lost
+    with the cached reply, without re-applying — which is also what
+    makes ``command`` (app-level controllers run arbitrary,
+    non-idempotent code) safe to retry.  Only ``barrier``/``stop``
+    are never retried: a double barrier arrival would desynchronize
+    every subsequent generation, and dedup cannot help because a
+    barrier's effect (blocking a generation) is not a replayable reply.
+
+    Liveness supervision (``MXNET_TPU_KV_DEADLINE=<seconds>``): a
+    heartbeat thread pings idle shards on short-lived probe
+    connections and warns (rate-limited,
+    ``kvstore_dead_shard_warnings`` counter) when a shard has had no
+    successful contact past the deadline — the in-job detector for a
+    dead server process before retries exhaust.  Guard-first: with the
+    env unset (the default) there is no thread, no probe socket, and
+    the per-request cost is the O(1) seq stamp
+    (``tests/test_bench_gate.py`` pins it).
     """
 
-    _NON_RETRYABLE_OPS = ("barrier", "stop", "command")
+    _NON_RETRYABLE_OPS = ("barrier", "stop")
 
     # RTT ops measured into per-shard latency histograms; every
     # _RTT_CHECK_EVERY observations the straggler detector compares
@@ -698,6 +1100,25 @@ class PSClient:
                        for a in self._addrs]
         self._lock = threading.Lock()
         self._rtt_obs = 0
+        # exactly-once identity: one cid per client object, a monotonic
+        # seq per stamped request (itertools.count: atomic under the
+        # GIL, no lock on the stamp path)
+        self._cid = uuid.uuid4().hex[:16]
+        self._seq_counter = itertools.count(1)
+        # liveness supervision (MXNET_TPU_KV_DEADLINE): guard-first —
+        # no thread, no probe sockets, no last-seen bookkeeping unless
+        # the deadline is set
+        self._last_ok = [time.monotonic()] * len(self._addrs)
+        self._deadline = float(os.environ.get(
+            "MXNET_TPU_KV_DEADLINE", "0") or 0)
+        self._hb_stop = None
+        self._hb_thread = None
+        if self._deadline > 0:
+            self._hb_stop = threading.Event()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name="mxtpu-kv-heartbeat", daemon=True)
+            self._hb_thread.start()
 
     @staticmethod
     def _dial(addr, connect_timeout, dial_timeout=300):
@@ -721,6 +1142,79 @@ class PSClient:
         s.settimeout(None)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
+
+    def _stamp(self):
+        """The per-request exactly-once header: ``{"cid", "seq"}``.
+        O(1) — one counter increment and one small dict
+        (``tests/test_bench_gate.py`` pins the bound).
+
+        The cid is per (client, thread): the server's dedup table keeps
+        only the LAST seq per cid, which is correct iff each cid has at
+        most one request in flight — true per thread by construction
+        (a thread blocks in ``_call`` until its request resolves), but
+        NOT across threads sharing one cid (thread B's later seq could
+        land first and make thread A's retry look like a stale
+        duplicate, silently dropping a real mutation)."""
+        return {"cid": "%s-%x" % (self._cid, threading.get_ident()),
+                "seq": next(self._seq_counter)}
+
+    def _probe_shard(self, idx):
+        """One liveness ping on a fresh short-timeout connection —
+        never touches the request path's sockets or lock, so a wedged
+        shard cannot stall healthy traffic.  True iff the shard
+        answered."""
+        timeout = max(min(2.0, self._deadline / 2.0), 0.1)
+        try:
+            s = socket.create_connection(self._addrs[idx],
+                                         timeout=timeout)
+        except OSError:
+            return False
+        try:
+            s.settimeout(timeout)
+            _send_msg(s, ("command", "ping", ""))
+            return _recv_msg(s) is not None
+        except Exception:
+            return False
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _heartbeat_loop(self):
+        """Liveness supervision: every ``deadline/3`` seconds, probe
+        shards with no recent successful traffic; a shard silent past
+        ``MXNET_TPU_KV_DEADLINE`` gets a rate-limited warning naming
+        the shard and its last-seen age, counted in
+        ``kvstore_dead_shard_warnings``."""
+        from .. import runtime_stats as _rts
+        from ..log import warn_rate_limited
+
+        interval = max(self._deadline / 3.0, 0.05)
+        while not self._hb_stop.wait(interval):
+            for idx, addr in enumerate(self._addrs):
+                if time.monotonic() - self._last_ok[idx] < interval:
+                    continue  # recent traffic already proves liveness
+                if self._probe_shard(idx):
+                    self._last_ok[idx] = time.monotonic()
+                    continue
+                age = time.monotonic() - self._last_ok[idx]
+                if age < self._deadline:
+                    continue
+                if warn_rate_limited(
+                        _logger(), "kv-dead:%d" % idx,
+                        max(self._deadline, 5.0),
+                        "parameter-server shard %d (%s:%d) is "
+                        "unresponsive: no successful contact for %.1fs "
+                        "(MXNET_TPU_KV_DEADLINE=%.1fs) — in-flight "
+                        "requests retry with backoff and raise a clear "
+                        "MXNetError when exhausted; under "
+                        "tools/launch.py MXNET_TPU_SUPERVISE a dead "
+                        "server process is relaunched and self-restores "
+                        "(docs/CHECKPOINTING.md 'Server-side "
+                        "durability')",
+                        idx, addr[0], addr[1], age, self._deadline):
+                    _rts.inc("kvstore_dead_shard_warnings")
 
     def _shard(self, key):
         """Shard INDEX for a key (indices stay valid across reconnects;
@@ -790,6 +1284,8 @@ class PSClient:
                 if reply is None:
                     raise ConnectionError(
                         "parameter server closed the connection")
+                if self._hb_thread is not None and idx is not None:
+                    self._last_ok[idx] = time.monotonic()
                 if rtt_on:
                     dur = time.perf_counter() - t0
                     _histogram.observe("kv:%s_rtt" % msg[0], dur)
@@ -803,14 +1299,18 @@ class PSClient:
                 if attempt >= self._max_retries:
                     from ..base import MXNetError
 
+                    seen = ""
+                    if self._hb_thread is not None:
+                        seen = "; last successful contact %.1fs ago" \
+                            % (time.monotonic() - self._last_ok[idx])
                     raise MXNetError(
                         "parameter server shard %d (%s:%d) unreachable "
                         "after %d retries with backoff (%s op, last "
-                        "error %s: %s) — check the server process / "
+                        "error %s: %s%s) — check the server process / "
                         "network, or raise MXNET_TPU_KV_RETRIES"
                         % (idx, self._addrs[idx][0], self._addrs[idx][1],
                            self._max_retries, msg[0],
-                           type(e).__name__, e)) from e
+                           type(e).__name__, e, seen)) from e
                 delay = min(self._backoff * (2 ** attempt), 2.0)
                 attempt += 1
                 _rts.inc("kvstore_retries")
@@ -858,10 +1358,12 @@ class PSClient:
             _rts.inc("kvstore_straggler_warnings")
 
     def init(self, key, arr):
-        self._call(self._shard(key), ("init", key, arr))
+        self._call(self._shard(key),
+                   ("init", key, arr, self._stamp()))
 
     def push(self, key, grad):
-        self._call(self._shard(key), ("push", key, grad))
+        self._call(self._shard(key),
+                   ("push", key, grad, self._stamp()))
 
     def pull(self, key):
         return self._call(self._shard(key), ("pull", key))
@@ -869,13 +1371,24 @@ class PSClient:
     def command_shard(self, idx, head, body=""):
         """App/controller command on ONE shard, returning its reply
         payload (``send_command`` broadcasts and discards replies —
-        the telemetry heads need the answer)."""
-        return self._call(idx, ("command", head, body))
+        the telemetry heads need the answer).  Stamped with the
+        exactly-once header, so a retried command is acked from the
+        server's seq table instead of running twice."""
+        return self._call(idx, ("command", head, body, self._stamp()))
 
     def server_stats(self):
         """Every shard's server-side metrics (the ``stats`` command),
         as a list of dicts indexed by shard."""
         return [_json.loads(self.command_shard(i, "stats"))
+                for i in range(len(self._socks))]
+
+    def checkpoint_shards(self):
+        """Force every shard to commit its durable snapshot NOW (the
+        reserved ``ckpt`` command head): one
+        ``{"enabled", "step", "path"}`` dict per shard — ``enabled``
+        False when that server runs without ``MXNET_TPU_PS_CKPT``
+        (docs/CHECKPOINTING.md "Server-side durability")."""
+        return [_json.loads(self.command_shard(i, "ckpt"))
                 for i in range(len(self._socks))]
 
     def ping(self, idx=0, samples=5):
@@ -898,11 +1411,11 @@ class PSClient:
 
     def set_optimizer(self, blob):
         for i in range(len(self._socks)):
-            self._call(i, ("set_optimizer", blob))
+            self._call(i, ("set_optimizer", blob, self._stamp()))
 
     def send_command(self, head, body):
         for i in range(len(self._socks)):
-            self._call(i, ("command", head, body))
+            self._call(i, ("command", head, body, self._stamp()))
 
     def barrier(self):
         # every server counts all workers; hitting each keeps shards in step
@@ -917,6 +1430,8 @@ class PSClient:
                 pass
 
     def close(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
         for s in self._socks:
             try:
                 s.close()

@@ -27,6 +27,12 @@ Rules
   dispatch time sits far above their cost-model roofline bound.
 - **kvstore stragglers** — one PS shard's push/pull RTT p99 an outlier
   vs the other shards' median (``histogram.median_of_others``).
+- **kvstore self-healing** — dead-shard heartbeat warnings
+  (``kvstore_dead_shard_warnings``: a PS shard went unresponsive past
+  ``MXNET_TPU_KV_DEADLINE``) and server-side duplicate suppression
+  (``kvstore_dup_suppressed`` on a server's dump: retried mutations
+  were acked from the exactly-once table instead of re-applying — the
+  fingerprint of reply loss / restart drills).
 
 Findings are ``{"rule", "severity": "warn"|"info", "score",
 "title", "anchor", "evidence": [...], "action"}`` — ``score`` is the
@@ -328,6 +334,49 @@ def _check_retries(dump):
         "(docs/CHECKPOINTING.md 'Dist kvstore hardening')")]
 
 
+def _check_self_healing(dump):
+    """Self-healing signals: dead-shard heartbeat warnings (a PS shard
+    silent past MXNET_TPU_KV_DEADLINE — worker dumps) and server-side
+    duplicate suppression (retried mutations acked from the
+    exactly-once seq table — server dumps), so recovery drills and
+    real incidents both show up in the doctor report."""
+    snap = dump.get("snapshot", dump)
+    counters = snap.get("counters") or {}
+    out = []
+    dead = counters.get("kvstore_dead_shard_warnings", 0)
+    if dead:
+        out.append(_finding(
+            "kvstore-dead-shard", SHARE_WARN,
+            "%d dead-shard warning(s): a PS shard went unresponsive "
+            "past MXNET_TPU_KV_DEADLINE" % dead,
+            "kvstore",
+            ["every deadline window a shard stays silent, pushes to it "
+             "sit in the retry/backoff ladder"],
+            "check that server process/host; run under tools/launch.py "
+            "with MXNET_TPU_SUPERVISE=N so a dead server is relaunched "
+            "and self-restores from its durable shard checkpoint "
+            "(docs/CHECKPOINTING.md 'Server-side durability')"))
+    dup = counters.get("kvstore_dup_suppressed", 0)
+    if dup:
+        restores = counters.get("kvstore_server_restores", 0)
+        evidence = ["reply-loss retries were acked from the "
+                    "(client_id, seq) table without re-applying — "
+                    "exactly-once held"]
+        if restores:
+            evidence.append("%d store restore(s) from the durable "
+                            "shard manifest this run" % restores)
+        out.append(_finding(
+            "kvstore-dedup", SHARE_NOTICE / 4,
+            "%d retried mutation(s) suppressed as duplicate(s) "
+            "server-side" % dup,
+            "kvstore", evidence,
+            "expected during reply_drop/restart_after drills; in "
+            "production it means replies are being lost — check the "
+            "network and server load (docs/CHECKPOINTING.md "
+            "'Server-side durability')"))
+    return out
+
+
 # ----------------------------------------------------------- trace rules
 
 
@@ -410,6 +459,7 @@ def diagnose(trace=None, dump=None, top=20):
         findings += _check_roofline(dump)
         findings += _check_stragglers(dump)
         findings += _check_retries(dump)
+        findings += _check_self_healing(dump)
     if trace is not None:
         findings += _check_idle_gaps(trace)
     findings.sort(key=lambda f: -f["score"])

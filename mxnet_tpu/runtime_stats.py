@@ -957,7 +957,9 @@ def _comparable_metrics(dump, min_seconds):
             out["total:%s" % key] = (v / steps if steps else v,
                                      "/step" if steps else "count",
                                      "counter")
-    for key in ("kvstore_retries", "health_seconds", "monitor_seconds"):
+    for key in ("kvstore_retries", "kvstore_dup_suppressed",
+                "kvstore_dead_shard_warnings", "health_seconds",
+                "monitor_seconds"):
         v = counters.get(key, 0)
         # the *_seconds counters are time-like: below the noise floor
         # they are pure clock jitter, not a verdict-worthy signal

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Self-healing acceptance drill worker, run by
+``tools/launch.py -n 1 -s 1 python dist_self_healing.py``.
+
+The interesting part happens OUTSIDE this script: the test launches it
+twice — once uninterrupted, once with ``MXNET_TPU_FAULT=restart_after:N``
+on the server plus ``MXNET_TPU_SUPERVISE`` on the launcher — and asserts
+the ``FINAL`` line (the exact bytes of the trained weights) is
+bit-identical.  The worker just trains: deterministic SGD pushes over
+the dist_async parameter server, then prints the pulled result.
+
+With ``MXTPU_EXPECT_RESTORE=1`` the worker additionally asserts,
+through ``kv.server_stats()``, that some shard really did restore
+itself from its durable manifest (``restored_step``) — proving the
+recovery came from the server's own checkpoint, not from luck.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    assert kv.num_workers == 1, "drill is single-worker for determinism"
+    # plain SGD lr=1: w -= grad, exactly, in float32 — bit-reproducible
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    shape = (4, 3)
+    kv.init("w", mx.nd.zeros(shape))
+    rs = np.random.RandomState(7)
+    grads = rs.rand(12, *shape).astype(np.float32)
+    for g in grads:
+        kv.push("w", mx.nd.array(g))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    final = out.asnumpy()
+    if os.environ.get("MXTPU_EXPECT_RESTORE") == "1":
+        stats = kv.server_stats()
+        assert any(s["durability"]["enabled"] for s in stats), \
+            "drill expected durable shards (MXNET_TPU_PS_CKPT)"
+        assert any(s["durability"].get("restored_step") for s in stats), \
+            "no shard restored itself from its manifest"
+    print("FINAL %s" % final.tobytes().hex())
+    print("dist_self_healing OK")
+    kv.stop_servers()
+
+
+if __name__ == "__main__":
+    main()

@@ -434,6 +434,44 @@ def test_prior_round_values_skips_failed_round_records(tmp_path,
     assert got == ("BENCH_r03.json", 2328.04, None)
 
 
+def test_disabled_heartbeat_and_seq_stamp_overhead_bound(ps_server):
+    """PR 9 gate: self-healing must be pay-for-use.  Without
+    MXNET_TPU_KV_DEADLINE (the default) the client starts NO heartbeat
+    thread and opens no probe sockets; the per-request exactly-once
+    header (``PSClient._stamp``) is O(1) — one counter increment + one
+    small dict — pinned like the other disabled-path bounds."""
+    import threading
+    import time
+
+    import pytest
+
+    from mxnet_tpu.kvstore.ps import PSClient
+
+    if os.environ.get("MXNET_TPU_KV_DEADLINE"):
+        pytest.skip("kvstore heartbeat active in this run")
+    c = PSClient(connect_timeout=10)
+    try:
+        assert c._hb_thread is None, \
+            "no deadline env must mean no heartbeat thread"
+        assert not any(t.name == "mxtpu-kv-heartbeat"
+                       for t in threading.enumerate())
+
+        n_calls = 1000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                c._stamp()
+            best = min(best, (time.perf_counter() - t0) / n_calls)
+        # the stamp is one itertools.count next + a dict literal
+        # (~0.2us); 10us tolerates slow shared CI while catching any
+        # real per-request work creeping in
+        assert best < 1e-5, \
+            "per-request seq stamp took %.2fus" % (best * 1e6)
+    finally:
+        c.close()
+
+
 def test_disabled_stepstats_overhead_bound():
     """PR 8 gate: step-time attribution must be pay-for-use.  With
     attribution disabled (the default), every feeding hook —

@@ -8,7 +8,10 @@ through them with exact values.
 Reference analog: ps-lite's van resend/heartbeat machinery
 (kvstore_dist.h); here the contract is bounded exponential backoff +
 reconnect with a clear error once exhausted (docs/CHECKPOINTING.md
-"Fault injection").
+"Fault injection").  The PR 9 self-healing drills — ``reply_drop``
+exactly-once dedup, ``restart_after`` + supervisor revival, durable
+shard restore, heartbeat liveness — live in
+``tests/test_self_healing.py``.
 """
 
 import os
@@ -122,26 +125,25 @@ def test_refused_connections_reconnect(monkeypatch):
         srv._stop.set()
 
 
-def test_kill_server_mid_push_retries_until_back(monkeypatch):
+def test_kill_server_mid_push_retries_until_back(monkeypatch, tmp_path):
     """Acceptance (a), kill flavor: the server dies upon receiving the
     4th message (the 2nd push, BEFORE applying it); the worker's
-    retry-with-backoff rides out the outage, a replacement server with
-    restored state comes up on the same port, and the run completes
-    with exact values."""
+    retry-with-backoff rides out the outage, a replacement server comes
+    up on the same port and SELF-RESTORES its store + optimizer from
+    the durable shard checkpoint (MXNET_TPU_PS_CKPT — no test-side
+    state seeding), and the run completes with exact values."""
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT", str(tmp_path))
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT_INTERVAL", "1")
     srv, t = _start_server(monkeypatch, fault="kill_after:4")
     port = srv.port
     srv2_holder = []
 
     def _revive():
         t.join(timeout=30)
-        # replacement server: state restored (what the checkpoint layer
-        # provides for real runs), fault injection off
+        # replacement server, fault injection off: it restores its own
+        # state from the per-mutation durable checkpoint in __init__
         os.environ.pop("MXNET_TPU_FAULT", None)
-        from mxnet_tpu import optimizer as opt
-
         srv2 = PSServer(port=port, num_workers=1)
-        srv2._store = {k: v.copy() for k, v in srv._store.items()}
-        srv2._updater = opt.get_updater(opt.SGD(learning_rate=1.0))
         srv2_holder.append(srv2)
         srv2.serve_forever()
 
@@ -158,6 +160,8 @@ def test_kill_server_mid_push_retries_until_back(monkeypatch):
         # applies it exactly once on the revived server: 5 pushes total
         np.testing.assert_array_equal(out, np.full((2,), -5.0,
                                                    np.float32))
+        # and the revival really came from the shard's own manifest
+        assert srv2_holder and srv2_holder[0]._restored_step
         c.close()
     finally:
         srv._stop.set()

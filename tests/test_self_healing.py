@@ -1,0 +1,515 @@
+"""Self-healing dist kvstore (PR 9): durable PS shards, exactly-once
+retried mutations, liveness supervision, crash-recovery drills.
+
+What PR 6 left open is closed here and asserted:
+
+- a retried mutation whose reply was lost applies EXACTLY once (the
+  ``reply_drop`` drill; server apply-count asserted via per-key
+  versions) — the historical double-apply caveat is gone, and
+  ``command`` is now safely retryable;
+- the dedup seq table is bounded and survives a server restart through
+  the persisted manifest;
+- a shard restores its own state (store + optimizer + seq table) from
+  its ``MXNET_TPU_PS_CKPT`` checkpoint on startup — no operator or
+  test-side seeding;
+- a worker-side heartbeat (``MXNET_TPU_KV_DEADLINE``) names a dead
+  shard with a rate-limited warning and counter;
+- the acceptance drill: ``restart_after`` kills a server mid-run, the
+  launcher's supervisor (``MXNET_TPU_SUPERVISE``) revives it, the shard
+  self-restores, and the training result is bit-exact vs an
+  uninterrupted run.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.kvstore.ps import (PSClient, PSServer, parse_fault_spec,
+                                  set_app_controller)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _optimizer_blob(lr=1.0):
+    from mxnet_tpu import optimizer as opt
+
+    return pickle.dumps(opt.SGD(learning_rate=lr),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _counter(name):
+    from mxnet_tpu import runtime_stats
+
+    return runtime_stats.snapshot()["counters"].get(name, 0)
+
+
+def _start_server(monkeypatch, fault=None, port=0, retries="40",
+                  backoff="0.02", ckpt_dir=None, ckpt_interval="1"):
+    if fault is None:
+        monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_TPU_FAULT", fault)
+    if ckpt_dir is None:
+        monkeypatch.delenv("MXNET_TPU_PS_CKPT", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_TPU_PS_CKPT", str(ckpt_dir))
+        monkeypatch.setenv("MXNET_TPU_PS_CKPT_INTERVAL", ckpt_interval)
+    monkeypatch.delenv("MXNET_TPU_KV_DEADLINE", raising=False)
+    srv = PSServer(port=port, num_workers=1)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
+    monkeypatch.setenv("MXNET_TPU_KV_RETRIES", retries)
+    monkeypatch.setenv("MXNET_TPU_KV_RETRY_BACKOFF", backoff)
+    return srv, t
+
+
+def test_new_fault_specs_parse():
+    assert parse_fault_spec("reply_drop:3") == {"mode": "reply_drop",
+                                                "arg": 3}
+    assert parse_fault_spec("restart_after:8") == {"mode":
+                                                   "restart_after",
+                                                   "arg": 8}
+
+
+def test_reply_drop_push_applies_exactly_once(monkeypatch):
+    """The dedup acceptance drill: every 3rd message is handled and its
+    reply dropped; the client's retry must be acked from the seq table
+    WITHOUT re-applying.  Exact final value + server-side applied
+    version prove exactly-once; the suppression counter proves the
+    dedup path (not luck) carried it."""
+    srv, t = _start_server(monkeypatch, fault="reply_drop:3")
+    try:
+        before = _counter("kvstore_dup_suppressed")
+        c = PSClient(connect_timeout=10)
+        c.set_optimizer(_optimizer_blob(lr=1.0))          # msg 1
+        c.init("w", np.zeros((4,), np.float32))           # msg 2
+        for _ in range(10):                               # msgs 3..
+            c.push("w", np.ones((4,), np.float32))
+        out = c.pull("w")
+        np.testing.assert_array_equal(out, np.full((4,), -10.0,
+                                                   np.float32))
+        # exactly-once server-side: 1 init + 10 pushes APPLIED, the
+        # reply-dropped pushes' retries suppressed as duplicates
+        assert srv._versions["w"] == 11
+        assert srv._dup_suppressed > 0
+        assert _counter("kvstore_dup_suppressed") > before
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_reply_drop_command_not_reapplied(monkeypatch):
+    """``command`` is retryable now BECAUSE it is deduplicated: a
+    retried app-controller command must be acked with the ORIGINAL
+    cached reply, not run twice (controllers are arbitrary
+    non-idempotent code)."""
+    calls = []
+
+    def controller(head, body):
+        calls.append((head, body))
+        return "r%d" % len(calls)
+
+    set_app_controller(controller)
+    srv, t = _start_server(monkeypatch, fault="reply_drop:2")
+    try:
+        c = PSClient(connect_timeout=10)
+        replies = [c.command_shard(0, "bump", "b%d" % i)
+                   for i in range(4)]
+        # every even message's reply was dropped; the retry returned
+        # the cached reply — so replies stay in order and the
+        # controller ran exactly once per command
+        assert replies == ["r1", "r2", "r3", "r4"]
+        assert len(calls) == 4
+        assert srv._dup_suppressed >= 1
+        c.close()
+    finally:
+        set_app_controller(None)
+        srv._stop.set()
+
+
+def test_seq_table_bounded_lru(monkeypatch):
+    """The per-client table is bounded: past ``_SEQ_CLIENTS_MAX``
+    clients the oldest entry is evicted, and a still-tracked client's
+    duplicate stays suppressed."""
+    monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    monkeypatch.delenv("MXNET_TPU_PS_CKPT", raising=False)
+    monkeypatch.setattr(PSServer, "_SEQ_CLIENTS_MAX", 32)
+    srv = PSServer(port=0, num_workers=1)
+    try:
+        srv._handle(("set_optimizer", _optimizer_blob(1.0)))
+        srv._handle(("init", "w", np.zeros((2,), np.float32)))
+        for i in range(32 + 20):
+            srv._handle(("push", "w", np.ones((2,), np.float32),
+                         {"cid": "c%d" % i, "seq": 1}))
+        assert len(srv._seq) <= 32
+        assert "c0" not in srv._seq          # oldest evicted
+        v = srv._versions["w"]
+        # a still-tracked client's duplicate: suppressed, version flat
+        r = srv._handle(("push", "w", np.ones((2,), np.float32),
+                         {"cid": "c51", "seq": 1}))
+        assert r == ("ok", None)
+        assert srv._versions["w"] == v
+        # an evicted client's retry re-applies (the bounded-table
+        # trade-off, same as ps-lite's finite resend window)
+        srv._handle(("push", "w", np.ones((2,), np.float32),
+                     {"cid": "c0", "seq": 1}))
+        assert srv._versions["w"] == v + 1
+    finally:
+        srv._sock.close()
+
+
+def test_store_and_seq_table_survive_restart(monkeypatch, tmp_path):
+    """Durable shards: a fresh PSServer restores store, per-key
+    versions, the optimizer (updater works without re-shipping), AND
+    the dedup table from the persisted manifest — so a duplicate of a
+    pre-restart mutation is still suppressed after revival."""
+    monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT", str(tmp_path))
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT_INTERVAL", "0")  # on demand
+    srv = PSServer(port=0, num_workers=1)
+    srv._handle(("set_optimizer", _optimizer_blob(1.0)))
+    srv._handle(("init", "w", np.zeros((3,), np.float32)))
+    for i in range(4):
+        srv._handle(("push", "w", np.ones((3,), np.float32),
+                     {"cid": "cA", "seq": i + 1}))
+    info = json.loads(srv._handle(("command", "ckpt", ""))[1])
+    # mutations: set_optimizer (blob is durable state) + init + 4 pushes
+    assert info["enabled"] and info["step"] == 6
+    assert os.path.isdir(info["path"])
+    srv._sock.close()
+
+    srv2 = PSServer(port=0, num_workers=1)
+    try:
+        assert srv2._restored_step == 6
+        np.testing.assert_array_equal(srv2._store["w"],
+                                      np.full((3,), -4.0, np.float32))
+        assert srv2._versions["w"] == 5
+        # duplicate of the pre-restart push: suppressed from the
+        # RESTORED table
+        r = srv2._handle(("push", "w", np.ones((3,), np.float32),
+                          {"cid": "cA", "seq": 4}))
+        assert r == ("ok", None)
+        assert srv2._versions["w"] == 5
+        # updater restored from the persisted optimizer blob: a NEW
+        # push applies without set_optimizer
+        srv2._handle(("push", "w", np.ones((3,), np.float32),
+                      {"cid": "cA", "seq": 5}))
+        np.testing.assert_array_equal(srv2._store["w"],
+                                      np.full((3,), -5.0, np.float32))
+        assert _counter("kvstore_server_restores") > 0
+    finally:
+        srv2._sock.close()
+
+
+def test_ckpt_head_and_durability_stats(monkeypatch, tmp_path):
+    """Wire-level: the reserved ``ckpt`` head commits on demand and
+    ``stats`` exposes the durability/dedup fields; without
+    MXNET_TPU_PS_CKPT the head reports enabled=False."""
+    srv, t = _start_server(monkeypatch, ckpt_dir=tmp_path,
+                           ckpt_interval="0")
+    try:
+        c = PSClient(connect_timeout=10)
+        c.set_optimizer(_optimizer_blob(lr=1.0))
+        c.init("w", np.zeros((2,), np.float32))
+        c.push("w", np.ones((2,), np.float32))
+        info = json.loads(c.command_shard(0, "ckpt"))
+        # mutations: set_optimizer + init + push
+        assert info["enabled"] and info["step"] == 3
+        stats = json.loads(c.command_shard(0, "stats"))
+        d = stats["durability"]
+        assert d["enabled"] and d["last_ckpt_step"] == 3
+        assert d["saves"] >= 1 and d["mutations"] == 3
+        assert stats["per_key"]["w"]["version"] == 2
+        assert stats["dedup"]["clients"] >= 1
+        c.close()
+    finally:
+        srv._stop.set()
+
+    srv2, t2 = _start_server(monkeypatch)  # durability off
+    try:
+        c2 = PSClient(connect_timeout=10)
+        info = json.loads(c2.command_shard(0, "ckpt"))
+        assert info == {"enabled": False, "step": None, "path": None}
+        stats = json.loads(c2.command_shard(0, "stats"))
+        assert stats["durability"]["enabled"] is False
+        c2.close()
+    finally:
+        srv2._stop.set()
+
+
+def test_init_and_set_optimizer_are_deduped(monkeypatch):
+    """Review fix pinned: init and set_optimizer are stamped too — a
+    reply-lost retried init must NOT re-bind the key (it would discard
+    another worker's push applied in the retry window) or double-bump
+    the applied version."""
+    monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    monkeypatch.delenv("MXNET_TPU_PS_CKPT", raising=False)
+    srv = PSServer(port=0, num_workers=1)
+    try:
+        srv._handle(("set_optimizer", _optimizer_blob(1.0),
+                     {"cid": "c", "seq": 1}))
+        srv._handle(("init", "w", np.zeros((2,), np.float32),
+                     {"cid": "c", "seq": 2}))
+        assert srv._versions["w"] == 1
+        # another worker's push lands in the retry window
+        srv._handle(("push", "w", np.ones((2,), np.float32),
+                     {"cid": "other", "seq": 1}))
+        # the retried init: suppressed — B's push survives
+        r = srv._handle(("init", "w", np.zeros((2,), np.float32),
+                         {"cid": "c", "seq": 2}))
+        assert r == ("ok", None)
+        np.testing.assert_array_equal(srv._store["w"],
+                                      np.full((2,), -1.0, np.float32))
+        assert srv._versions["w"] == 2
+        # retried set_optimizer suppressed too (mutation clock flat)
+        m = srv._mutations
+        srv._handle(("set_optimizer", _optimizer_blob(1.0),
+                     {"cid": "c", "seq": 1}))
+        assert srv._mutations == m
+    finally:
+        srv._sock.close()
+
+
+def test_ping_is_fault_exempt(monkeypatch):
+    """Review fix pinned: liveness pings never advance the fault
+    counter, so an armed heartbeat cannot perturb "the Nth message"
+    drill determinism."""
+    monkeypatch.setenv("MXNET_TPU_FAULT", "restart_after:100")
+    monkeypatch.delenv("MXNET_TPU_PS_CKPT", raising=False)
+    srv = PSServer(port=0, num_workers=1)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("MXTPU_PS_PORTS", str(srv.port))
+    try:
+        c = PSClient(connect_timeout=10)
+        for _ in range(5):
+            c.command_shard(0, "ping")
+        c.init("w", np.zeros((2,), np.float32))
+        assert srv._fault_msgs == 1   # only the init counted
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_app_state_survives_late_controller_registration(monkeypatch,
+                                                         tmp_path):
+    """Review fix pinned: app-controller state restored before any
+    controller was registered is held by the server and delivered on
+    the (late-registered) controller's first command — and
+    re-persisted, never silently dropped."""
+    monkeypatch.delenv("MXNET_TPU_FAULT", raising=False)
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT", str(tmp_path))
+    monkeypatch.setenv("MXNET_TPU_PS_CKPT_INTERVAL", "0")
+
+    class Ctrl:
+        def __init__(self):
+            self.state = {"gen": 0}
+
+        def __call__(self, head, body):
+            self.state["gen"] += 1
+            return str(self.state["gen"])
+
+        def get_state(self):
+            return dict(self.state)
+
+        def set_state(self, s):
+            self.state = dict(s)
+
+    c1 = Ctrl()
+    set_app_controller(c1)
+    try:
+        srv = PSServer(port=0, num_workers=1)
+        srv._handle(("command", "bump", "", {"cid": "x", "seq": 1}))
+        srv._handle(("command", "bump", "", {"cid": "x", "seq": 2}))
+        srv._ckpt_save()
+        srv._sock.close()
+
+        # restart with NO controller registered yet
+        set_app_controller(None)
+        srv2 = PSServer(port=0, num_workers=1)
+        assert srv2._app_state == {"gen": 2}
+        # a re-persist before registration must carry the state
+        srv2._ckpt_save()
+        # late registration: first command sees the restored state
+        c2 = Ctrl()
+        set_app_controller(c2)
+        r = srv2._handle(("command", "bump", "", {"cid": "x", "seq": 3}))
+        assert r == ("ok", "3") and c2.state == {"gen": 3}
+        assert srv2._app_state is None
+        srv2._sock.close()
+
+        # and the carried-state re-persist round-trips too
+        set_app_controller(None)
+        srv3 = PSServer(port=0, num_workers=1)
+        assert srv3._app_state == {"gen": 2}
+        srv3._sock.close()
+    finally:
+        set_app_controller(None)
+
+
+def test_concurrent_threads_exactly_once(monkeypatch):
+    """Review fix pinned: the cid is per (client, thread) — so the
+    last-seq dedup table can never mistake one thread's retried push
+    for a stale duplicate of another thread's later request.  Four
+    threads share one PSClient through a reply_drop fault; every push
+    must apply exactly once."""
+    srv, t = _start_server(monkeypatch, fault="reply_drop:3")
+    try:
+        c = PSClient(connect_timeout=10)
+        # distinct per-thread cids, one shared monotonic seq stream
+        cids = []
+
+        def grab():
+            cids.append(c._stamp()["cid"])
+
+        th = threading.Thread(target=grab)
+        th.start()
+        th.join()
+        grab()
+        assert len(set(cids)) == 2
+        assert all(cid.startswith(c._cid + "-") for cid in cids)
+
+        c.set_optimizer(_optimizer_blob(lr=1.0))
+        c.init("w", np.zeros((2,), np.float32))
+        errors = []
+
+        def pusher():
+            try:
+                for _ in range(10):
+                    c.push("w", np.ones((2,), np.float32))
+            except Exception as e:  # surfaces in the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=pusher) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors, errors
+        out = c.pull("w")
+        np.testing.assert_array_equal(out, np.full((2,), -40.0,
+                                                   np.float32))
+        assert srv._versions["w"] == 41   # init + 40 applied pushes
+        c.close()
+    finally:
+        srv._stop.set()
+
+
+def test_heartbeat_dead_shard_warning(monkeypatch):
+    """Liveness supervision: with MXNET_TPU_KV_DEADLINE set, a shard
+    that stops answering gets a rate-limited warning naming it (with
+    the last-seen age) and the ``kvstore_dead_shard_warnings``
+    counter moves."""
+    import logging
+
+    records = []
+
+    class _Catcher(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("mxnet_tpu.kvstore.ps")
+    catcher = _Catcher(level=logging.WARNING)
+    logger.addHandler(catcher)
+    srv, t = _start_server(monkeypatch)
+    monkeypatch.setenv("MXNET_TPU_KV_DEADLINE", "0.4")
+    from mxnet_tpu.log import reset_rate_limits
+
+    reset_rate_limits("kv-dead:")
+    try:
+        before = _counter("kvstore_dead_shard_warnings")
+        c = PSClient(connect_timeout=10)
+        assert c._hb_thread is not None and c._hb_thread.is_alive()
+        c.init("w", np.zeros((2,), np.float32))
+        srv._stop.set()
+        srv._sock.close()
+        t.join(timeout=10)
+        deadline = time.monotonic() + 20
+        while _counter("kvstore_dead_shard_warnings") == before:
+            assert time.monotonic() < deadline, \
+                "dead-shard warning never fired"
+            time.sleep(0.05)
+        assert any("shard 0" in r.getMessage()
+                   and "unresponsive" in r.getMessage()
+                   for r in records)
+        c.close()
+        assert c._hb_stop.is_set()
+    finally:
+        logger.removeHandler(catcher)
+        srv._stop.set()
+
+
+def test_perfdoctor_self_healing_rules():
+    """The doctor surfaces drills/incidents: dead-shard warnings rank
+    as a WARN finding, duplicate suppression as an info finding with
+    the restore evidence."""
+    from mxnet_tpu import perfdoctor
+
+    dump = {"counters": {"kvstore_dead_shard_warnings": 2,
+                         "kvstore_dup_suppressed": 5,
+                         "kvstore_server_restores": 1}}
+    findings = perfdoctor.diagnose(dump=dump)
+    by_rule = {f["rule"]: f for f in findings}
+    assert by_rule["kvstore-dead-shard"]["severity"] == "warn"
+    assert "MXNET_TPU_KV_DEADLINE" in \
+        by_rule["kvstore-dead-shard"]["title"]
+    dup = by_rule["kvstore-dedup"]
+    assert dup["severity"] == "info"
+    assert "5 retried mutation(s)" in dup["title"]
+    assert any("restore" in e for e in dup["evidence"])
+    # quiet run: neither rule fires
+    assert not perfdoctor.diagnose(dump={"counters": {}})
+
+
+def test_restart_after_supervisor_self_heals_bit_exact(tmp_path):
+    """THE acceptance drill (tier-1): ``restart_after:8`` kills the
+    server process mid-run (nonzero exit) → the launcher's supervisor
+    relaunches it → the shard restores store/optimizer/seq-table from
+    its own manifest (asserted in-worker via ``server_stats``; no
+    test-side seeding) → the retried push applies exactly once and the
+    final weights are BIT-EXACT vs an uninterrupted run."""
+    script = os.path.join(REPO, "tests", "dist", "dist_self_healing.py")
+    launch = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+              "-n", "1", "-s", "1", sys.executable, script]
+    base = dict(os.environ)
+    base.pop("PYTHONPATH", None)
+    for var in ("MXNET_TPU_FAULT", "MXNET_TPU_SUPERVISE",
+                "MXNET_TPU_PS_CKPT", "MXNET_TPU_PS_CKPT_INTERVAL",
+                "MXNET_TPU_KV_DEADLINE", "MXNET_TPU_PROFILE",
+                "MXNET_TPU_DIAG"):
+        base.pop(var, None)
+    base["JAX_PLATFORMS"] = "cpu"
+
+    r0 = subprocess.run(launch, env=dict(base), capture_output=True,
+                        text=True, timeout=300)
+    assert r0.returncode == 0, r0.stdout + r0.stderr
+    assert "dist_self_healing OK" in r0.stdout
+
+    env = dict(base)
+    env.update({"MXNET_TPU_FAULT": "restart_after:8",
+                "MXNET_TPU_SUPERVISE": "2",
+                "MXNET_TPU_PS_CKPT": str(tmp_path / "psckpt"),
+                "MXNET_TPU_PS_CKPT_INTERVAL": "1",
+                "MXNET_TPU_KV_RETRIES": "60",
+                "MXNET_TPU_KV_RETRY_BACKOFF": "0.25",
+                "MXNET_TPU_KV_DEADLINE": "5",
+                "MXTPU_EXPECT_RESTORE": "1"})
+    r1 = subprocess.run(launch, env=env, capture_output=True,
+                        text=True, timeout=300)
+    assert r1.returncode == 0, r1.stdout + r1.stderr
+    assert "supervisor: server 0 exited" in r1.stdout, \
+        r1.stdout + r1.stderr
+    f0 = [ln for ln in r0.stdout.splitlines() if ln.startswith("FINAL ")]
+    f1 = [ln for ln in r1.stdout.splitlines() if ln.startswith("FINAL ")]
+    assert f0 and f1, (r0.stdout, r1.stdout)
+    assert f0 == f1, "self-healed run diverged from uninterrupted run"

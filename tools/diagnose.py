@@ -57,8 +57,9 @@ def parse_args():
     p.add_argument('--doctor', nargs='+', metavar='FILE',
                    help='Perf doctor: rank bottlenecks (idle gaps, recompile '
                         'storms, data wait, host syncs, roofline headroom, '
-                        'shard stragglers) from a chrome trace and/or a '
-                        'MXNET_TPU_DIAG dump, with evidence and a next '
+                        'shard stragglers, dead-shard / duplicate-'
+                        'suppression incidents) from a chrome trace and/or '
+                        'a MXNET_TPU_DIAG dump, with evidence and a next '
                         'action per finding.  Files are classified by '
                         'content; pass both kinds for full coverage.')
     p.add_argument('--compare', nargs=2, metavar=('A', 'B'),

@@ -12,13 +12,30 @@ spawns N host-side PS server processes (mxnet_tpu/kvstore_server.py);
 their ports are handed to workers via MXTPU_PS_PORTS.  Only the local
 launcher is implemented; ssh/mpi cluster modes are host-scheduling
 concerns outside this container.
+
+Supervisor mode (`MXNET_TPU_SUPERVISE=N`): while workers are still
+running, a parameter-server process that exits NONZERO (crash, fault
+drill, signal) is relaunched on the same port, up to N times per
+server — exit 0 is the clean stop-command path and is left alone (a
+worker's final `stop` racing the supervisor poll must not burn a
+restart on a finished job).  The revived
+server self-restores its store from its durable shard checkpoint
+(`MXNET_TPU_PS_CKPT`, docs/CHECKPOINTING.md "Server-side durability") —
+when supervision is requested without a checkpoint dir, one is
+defaulted (with a per-mutation interval) so revival actually recovers
+state.  `MXNET_TPU_FAULT` is stripped from a relaunched server's env:
+the injected fault already simulated the crash it was scripted for, and
+re-arming it would just crash-loop the drill to the restart bound.
 """
 
 import argparse
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
+import time
 
 
 def free_port():
@@ -67,28 +84,56 @@ def main(argv=None):
         parser.error("no command given")
 
     port = free_port()
+    default_ckpt_dir = None
     common = {
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(args.num_servers),
         "DMLC_PS_ROOT_URI": "127.0.0.1",
         "DMLC_PS_ROOT_PORT": str(port),
     }
+    try:
+        supervise = int(os.environ.get("MXNET_TPU_SUPERVISE", "0") or 0)
+    except ValueError:
+        supervise = 0
+
+    def server_env(sid, fault=True):
+        env = dict(os.environ)
+        env.update(common)
+        env.update({"DMLC_ROLE": "server",
+                    "MXTPU_PS_SERVER_ID": str(sid),
+                    # the PS is numpy/host-side; keep jax off any
+                    # accelerator the workers may be using
+                    "JAX_PLATFORMS": "cpu"})
+        if not fault:
+            env.pop("MXNET_TPU_FAULT", None)
+        rank_suffix_observability(env, "server", sid)
+        return env
+
+    def spawn_server(sid, fault=True):
+        return subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+            env=server_env(sid, fault=fault))
 
     server_procs = []
     if args.num_servers > 0:
         ports = [free_port() for _ in range(args.num_servers)]
         common["MXTPU_PS_PORTS"] = ",".join(str(p) for p in ports)
+        if supervise > 0 and not os.environ.get("MXNET_TPU_PS_CKPT"):
+            # a revived server can only self-restore if its shard is
+            # durable: default a checkpoint dir (per-mutation interval,
+            # so no acknowledged mutation can be lost across a restart)
+            default_ckpt_dir = tempfile.mkdtemp(prefix="mxtpu-ps-ckpt-")
+            common["MXNET_TPU_PS_CKPT"] = default_ckpt_dir
+            common.setdefault("MXNET_TPU_PS_CKPT_INTERVAL",
+                              os.environ.get("MXNET_TPU_PS_CKPT_INTERVAL",
+                                             "1"))
+            print("launch.py: MXNET_TPU_SUPERVISE without "
+                  "MXNET_TPU_PS_CKPT — defaulting server durability to "
+                  "%s (interval %s)"
+                  % (common["MXNET_TPU_PS_CKPT"],
+                     common["MXNET_TPU_PS_CKPT_INTERVAL"]), flush=True)
         for sid in range(args.num_servers):
-            env = dict(os.environ)
-            env.update(common)
-            env.update({"DMLC_ROLE": "server",
-                        "MXTPU_PS_SERVER_ID": str(sid),
-                        # the PS is numpy/host-side; keep jax off any
-                        # accelerator the workers may be using
-                        "JAX_PLATFORMS": "cpu"})
-            rank_suffix_observability(env, "server", sid)
-            server_procs.append(subprocess.Popen(
-                [sys.executable, "-m", "mxnet_tpu.kvstore_server"], env=env))
+            server_procs.append(spawn_server(sid))
 
     procs = []
     for rank in range(args.num_workers):
@@ -98,6 +143,25 @@ def main(argv=None):
         rank_suffix_observability(env, "worker", rank)
         procs.append(subprocess.Popen(args.command, env=env))
     rc = 0
+    if supervise > 0 and server_procs:
+        # supervisor loop: while any worker is still running, relaunch
+        # dead server processes (bounded restarts per server); the
+        # revived server self-restores from its durable checkpoint
+        restarts = [0] * len(server_procs)
+        while any(p.poll() is None for p in procs):
+            for sid, sp in enumerate(server_procs):
+                code = sp.poll()
+                # code 0 = the clean stop-command exit: not a failure
+                # (and possibly racing the workers' own shutdown)
+                if code is None or code == 0 or \
+                        restarts[sid] >= supervise:
+                    continue
+                restarts[sid] += 1
+                print("launch.py supervisor: server %d exited rc=%s — "
+                      "restart %d/%d" % (sid, code, restarts[sid],
+                                         supervise), flush=True)
+                server_procs[sid] = spawn_server(sid, fault=False)
+            time.sleep(0.2)
     for p in procs:
         p.wait()
         rc = rc or p.returncode
@@ -118,6 +182,17 @@ def main(argv=None):
             # is the real fault even when workers also errored
             if p.returncode > 0:
                 rc = rc or p.returncode
+    if default_ckpt_dir is not None:
+        if rc == 0:
+            # we created it, the job finished cleanly: per-mutation
+            # full-store snapshots must not pile up in /tmp
+            shutil.rmtree(default_ckpt_dir, ignore_errors=True)
+        else:
+            # the shards' durable state IS the resume point — keep it
+            print("launch.py: job failed (rc=%d); server checkpoints "
+                  "kept at %s (MXNET_TPU_PS_CKPT)" % (rc,
+                                                      default_ckpt_dir),
+                  flush=True)
     return rc
 
 
