@@ -5,7 +5,10 @@ always-on runtime_stats counters, per-op XLA cost analytics, the
 recompile-storm detector, the numerics health layer (device-side
 grad-norm/NaN sentinels, flight recorder, first-NaN warning + dump),
 and the PR-8 analysis layer: per-step phase attribution (stepstats),
-the perf doctor's ranked findings, and the dump-diff regression report.
+the perf doctor's ranked findings, and the dump-diff regression report,
+plus the PR-10 continuous-monitoring layer: the live metrics timeline,
+its JSONL export + Prometheus /metrics endpoint (scraped mid-loop
+below), and the trend doctor catching an induced throughput drift.
 
 Run directly (the script activates the profiler, buffer tracker, and
 health monitor itself), or with zero code changes on any script via
@@ -15,6 +18,8 @@ the env vars:
     MXNET_TPU_DIAG=diag.json     python your_train.py   # + kill -USR1
     MXNET_TPU_HEALTH=1           python your_train.py
     MXNET_TPU_STEPSTATS=1        python your_train.py   # step anatomy
+    MXNET_TPU_METRICS=m.jsonl  MXNET_TPU_METRICS_PORT=9100 \
+        python your_train.py                            # live timeline
 
 Docs: docs/OBSERVABILITY.md.
 """
@@ -169,8 +174,67 @@ def main(argv=None):
     assert any(e["metric"] == "phase:data_wait"
                for e in result["regressions"]), \
         "the injected io delay must be named"
+
+    # ---- the live metrics timeline: per-step samples into a ring + a
+    # JSONL file, a Prometheus /metrics endpoint scraped MID-LOOP, and
+    # the trend doctor catching an induced mid-run drift.  Production
+    # equivalent (zero code changes):
+    #   MXNET_TPU_METRICS=m.jsonl MXNET_TPU_METRICS_PORT=9100 python ...
+    import urllib.request
+
+    from mxnet_tpu import metrics_timeline
+
+    runtime_stats.reset()
+    jsonl = os.path.join(tempfile.gettempdir(),
+                         "runtime_telemetry_metrics.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)
+    metrics_timeline.enable(path=jsonl)
+    metrics_timeline.serve(port=0)  # 0 = pick a free port
+    port = metrics_timeline.server_port()
+    steps = max(30, args.steps)
+    X2 = rs.rand(steps * batch_size, 6).astype(np.float32)
+    Y2 = rs.randint(0, 4, (steps * batch_size,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X2, Y2, batch_size=batch_size)
+    orig_next2 = it.next
+    seen = [0]
+
+    def drifting_next():
+        seen[0] += 1
+        if seen[0] > steps // 2:
+            time.sleep(0.02)  # the induced mid-run drift
+        if seen[0] == steps // 2:
+            # scrape our own endpoint while the loop is live
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port,
+                timeout=10).read().decode()
+            wall = [ln for ln in body.splitlines()
+                    if ln.startswith("mxnet_tpu_step_duration_seconds")]
+            print("\nmid-loop /metrics scrape (port %d): %d lines; %s"
+                  % (port, len(body.splitlines()),
+                     wall[0] if wall else "<no step yet>"))
+        return orig_next2()
+
+    it.next = drifting_next
+    for batch in it:
+        with autograd.record():
+            loss = loss_fn(net(batch.data[0]), batch.label[0])
+        loss.backward()
+        trainer.step(batch_size)
+    print("timeline: %d ring sample(s), %d JSONL line(s) at %s"
+          % (len(metrics_timeline.samples()),
+             metrics_timeline.snapshot()["written"], jsonl))
+    trend = perfdoctor.diagnose(timeline=metrics_timeline.samples())
+    print("\ntrend doctor on the live ring:")
+    print(perfdoctor.render(trend))
+    slow = [f for f in trend if f["rule"] == "timeline-throughput"]
+    assert slow, "the induced drift must be caught as a trend"
+    assert slow[0]["anchor"] == "phase:data_wait", \
+        "the drifting phase must be named"
+
     # leave global collection off for any in-process caller (tests run
     # this example inside the suite)
+    metrics_timeline.disable()
     stepstats.disable()
     return path
 

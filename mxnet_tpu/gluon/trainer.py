@@ -19,6 +19,7 @@ from .. import device_memory as _dm
 from .. import health as _health
 from .. import histogram as _histogram
 from .. import kvstore as _kvstore
+from .. import metrics_timeline as _metrics
 from .. import optimizer as _optimizer
 from .. import profiler as _profiler
 from .. import runtime_stats as _rts
@@ -182,6 +183,12 @@ class Trainer:
         # update + hooks).  Disabled: one dict read.
         if _stepstats._state["on"]:
             _stepstats.end_step()
+        # live metrics timeline (metrics_timeline.py): one per-step
+        # sample into the ring/JSONL/endpoint — AFTER end_step so the
+        # sample carries this step's phase window.  Disabled: one dict
+        # read.
+        if _metrics._state["on"]:
+            _metrics.on_step(batch_size)
 
     def _health_grads_and_prev(self, hm):
         """Feed gradients to the health monitor and snapshot the

@@ -64,7 +64,8 @@ import time
 from . import profiler as _profiler
 from . import runtime_stats as _rts
 from . import stepstats as _stepstats
-from .log import get_logger, warn_once, warn_rate_limited
+from .log import (get_logger, rank_suffix_path, warn_once,
+                  warn_rate_limited)
 
 __all__ = ["STAT_NAMES", "DEFAULT_STATS", "stat_kernel", "tensor_stats",
            "global_norm", "update_ratio", "HealthMonitor",
@@ -266,8 +267,12 @@ class FlightRecorder:
         """Atomically write the ring (plus the owning monitor's summary)
         as JSON; returns the absolute path.  Unique temp name per call,
         same torn-file discipline as ``runtime_stats.dump_diag``."""
-        path = path or os.environ.get("MXNET_TPU_HEALTH_DUMP") \
-            or "mxnet_tpu_flight.json"
+        # explicit paths are honored verbatim; the env/default fallback
+        # self-suffixes with role+rank so multi-rank runs without
+        # launch.py cannot clobber rank 0's flight dump
+        path = path or rank_suffix_path(
+            os.environ.get("MXNET_TPU_HEALTH_DUMP")
+            or "mxnet_tpu_flight.json")
         path = os.path.abspath(path)
         payload = {"version": 1, "pid": os.getpid(), "time": time.time(),
                    "reason": reason,

@@ -12,7 +12,7 @@ import sys
 import time
 
 __all__ = ["get_logger", "getLogger", "warn_rate_limited", "warn_once",
-           "reset_rate_limits", "process_identity",
+           "reset_rate_limits", "process_identity", "rank_suffix_path",
            "CRITICAL", "ERROR", "WARNING", "INFO", "DEBUG", "NOTSET"]
 
 CRITICAL = logging.CRITICAL
@@ -92,6 +92,34 @@ def process_identity():
         return None
     return {"role": role or "worker", "rank": _int(wid, 0),
             "num_workers": nw}
+
+
+def rank_suffix_path(path):
+    """Self-suffix an observability output path (trace / diag / metrics
+    JSONL / flight dump) with this process's role+rank when running
+    multi-process WITHOUT ``tools/launch.py``'s env rewriting.
+
+    Rank-0 workers and single-process runs keep the plain path (the
+    single-writer default); every other rank — and servers, whose rank
+    space is separate from the workers' — gets
+    ``<base>.<role><rank><ext>`` (launch.py's convention) so it can
+    never silently clobber rank 0's file.  Paths launch.py already
+    suffixed pass through unchanged."""
+    if not path:
+        return path
+    ident = process_identity()
+    if ident is None:
+        return path
+    role, rank = ident["role"], ident["rank"]
+    if role != "server" and rank == 0:
+        return path
+    token = ".%s%d" % (role, rank)
+    base, ext = os.path.splitext(path)
+    # idempotent against launch.py's rewriting: on an extension-less
+    # value the launcher's token lands in the ext slot, not the base
+    if base.endswith(token) or ext == token:
+        return path
+    return base + token + ext
 
 
 # key -> monotonic time of the last emitted warning

@@ -1,0 +1,792 @@
+"""Live metrics timeline — per-step time series, scrapeable export.
+
+Every observability layer so far is post-mortem: ``runtime_stats``
+counters are cumulative, diag dumps land at atexit/SIGUSR1, traces
+cover one run.  A long production run needs *what is happening now and
+how it is trending*: memory creeping up, throughput decaying, a
+straggler emerging at step 40k — the continuous-monitoring shape of
+every serving stack, and what the ZeRO-style runs of arXiv:2004.13336
+watch their push-RTT skew with.  This module is that layer:
+
+- a **bounded ring** of per-step samples, captured guard-first at the
+  ``gluon.Trainer.step`` seam (disabled: one dict read, bench-gated in
+  ``tests/test_bench_gate.py``).  Each sample folds the other layers'
+  state into one host-side dict: step wall time + the ``stepstats``
+  phase window, throughput (samples/s), **windowed deltas** of the
+  cumulative compile/miss/fallback/kv-retry/dedup counters (so rates,
+  not lifetime totals), live/peak device bytes (``device_memory``),
+  jit-cache size, per-series kv push/pull-RTT window p50/p99
+  (bucket-delta over ``histogram``), and the health layer's latest
+  grad-norm / NaN flags (ring read only — never drains).
+- a **JSONL appender** (``MXNET_TPU_METRICS=<file>``): every
+  ``MXNET_TPU_METRICS_INTERVAL`` steps (default 1) the newest sample is
+  appended as one ``write()`` of a full line, so a tailing reader /
+  dashboard never sees a torn record.  ``tools/launch.py`` rank-suffixes
+  the path per spawned process; a multi-rank run *without* launch.py
+  self-suffixes from ``log.process_identity()`` (non-zero ranks and
+  servers) instead of silently clobbering rank 0's file.  Render with
+  ``python -m mxnet_tpu.runtime_stats metrics.jsonl`` or
+  ``python tools/diagnose.py --timeline metrics.jsonl``.
+- a **read-only Prometheus endpoint** (``MXNET_TPU_METRICS_PORT=<p>``):
+  a daemon thread serves ``/metrics`` in Prometheus text format —
+  counters, gauges, and latency summaries — built from snapshots only.
+  It never drains health queues and never touches the device, so the
+  compute path stays host-sync-free (the mxlint callgraph rule).
+
+The trend doctor (``perfdoctor.diagnose(timeline=...)`` /
+``tools/diagnose.py --doctor``) reads the same series — from this ring,
+a JSONL file, or a diag dump (``runtime_stats.diag_snapshot`` embeds
+the ring) — and ranks leaks, throughput decay, step-time spikes, and
+kv-RTT drift like any other finding.
+
+Environment variables
+---------------------
+``MXNET_TPU_METRICS``           JSONL destination; enables the timeline.
+``MXNET_TPU_METRICS_PORT``      port for the ``/metrics`` endpoint;
+    enables the timeline.  One process per port — give each rank its
+    own, or rely on the JSONL export for multi-rank runs.
+``MXNET_TPU_METRICS_HOST``      bind address for the endpoint (default
+    all interfaces; set ``127.0.0.1`` for loopback-only).
+``MXNET_TPU_METRICS_INTERVAL``  steps between JSONL appends (default 1;
+    the in-memory ring samples every step regardless).
+Unset, the timeline auto-enables under ``MXNET_TPU_PROFILE`` /
+``MXNET_TPU_DIAG`` (ring only — those runs already pay for telemetry,
+and their diag dump should carry a populated timeline).
+
+Docs: docs/OBSERVABILITY.md "Live metrics & trends".
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import threading
+import time
+
+from . import device_memory as _dm
+from . import histogram as _histogram
+from . import stepstats as _stepstats
+from .log import (get_logger, process_identity, rank_suffix_path,
+                  warn_rate_limited)
+
+__all__ = ["enable", "disable", "is_enabled", "on_step", "samples",
+           "timeline", "snapshot", "serve", "stop_server",
+           "prometheus_text", "parse_jsonl", "load", "render", "reset",
+           "RING_DEFAULT"]
+
+RING_DEFAULT = 1024
+
+# kv-RTT series sampled as windowed percentiles (aggregate + per shard)
+_KV_PREFIXES = ("kv:push_rtt", "kv:pull_rtt")
+
+_state = {"on": False}
+_ring: collections.deque = collections.deque(maxlen=RING_DEFAULT)
+# per-run mutable config/clock state; all mutation is GIL-atomic dict
+# arithmetic on the training thread (the runtime_stats contract)
+_cur = {"boundary": None, "step": 0, "interval": 1,
+        "path": None, "writer": None, "abs_path": None,
+        # cumulative-counter baselines for the windowed deltas
+        "prev": None, "prev_hist": {}}
+_agg = {"samples": 0, "written": 0}
+_server: list = []            # [ThreadingHTTPServer] while serving
+
+_logger_cache: list = []
+
+
+def _logger():
+    if not _logger_cache:
+        _logger_cache.append(get_logger("mxnet_tpu.metrics_timeline"))
+    return _logger_cache[0]
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def enable(path=None, port=None, interval=None, ring=None):
+    """Turn the timeline on: re-arm the sample ring, optionally attach
+    the JSONL appender (``path``) and the ``/metrics`` endpoint
+    (``port``; 0 picks a free port — read it back from the returned
+    state via :func:`server_port`).  Also raises the cheap host-side
+    layers the samples read from — ``stepstats`` and ``histogram`` —
+    unless their env vars force them off."""
+    global _ring
+    _ring = collections.deque(maxlen=int(ring or RING_DEFAULT))
+    if interval is None:
+        try:
+            interval = int(os.environ.get(
+                "MXNET_TPU_METRICS_INTERVAL", "1"))
+        except ValueError:
+            interval = 1
+    _cur.update({"boundary": None, "step": 0,
+                 "interval": max(1, int(interval)),
+                 "path": path, "prev": None, "prev_hist": {}})
+    _close_writer()
+    _agg["samples"] = 0
+    _agg["written"] = 0
+    # a timeline without phase/latency feeds is just wall times: raise
+    # the pure-host layers it samples (both are dict arithmetic; an
+    # explicit MXNET_TPU_STEPSTATS=0 / MXNET_TPU_HISTOGRAMS=0 wins)
+    if os.environ.get("MXNET_TPU_STEPSTATS") != "0":
+        _stepstats.enable()
+    if os.environ.get("MXNET_TPU_HISTOGRAMS") != "0":
+        _histogram.enable()
+    _state["on"] = True
+    if port is not None:
+        serve(port)
+    return _cur
+
+
+def disable():
+    """Stop sampling (the ring stays readable; ``reset()`` drops it).
+    The JSONL writer is flushed+closed and the endpoint shut down."""
+    _state["on"] = False
+    _close_writer()
+    stop_server()
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def reset():
+    """Drop every sample/baseline and re-open the warmup window
+    (tests); keeps the enabled flag, writer path, and server as-is."""
+    _ring.clear()
+    _cur.update({"boundary": None, "step": 0, "prev": None,
+                 "prev_hist": {}})
+    _agg["samples"] = 0
+
+
+def _close_writer():
+    w = _cur["writer"]
+    _cur["writer"] = None
+    _cur["abs_path"] = None
+    if w is not None:
+        try:
+            w.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------- sampling
+
+
+def on_step(batch_size=None):
+    """One training-step boundary (called by ``gluon.Trainer.step``
+    after the stepstats window closes, so the sample carries this
+    step's phase breakdown).  The first boundary only arms the clock —
+    the warmup window (imports, first compiles) is discarded, and the
+    cumulative-counter baselines are primed so the first real sample's
+    deltas cover exactly one step.  Callers guard on ``_state["on"]``;
+    this re-check makes a mid-step disable safe."""
+    if not _state["on"]:
+        return
+    now = time.perf_counter()
+    boundary = _cur["boundary"]
+    _cur["boundary"] = now
+    _cur["step"] += 1
+    if boundary is None:
+        _cur["prev"] = _cum_totals()
+        _cur["prev_hist"] = _hist_baseline()
+        return
+    sample = _build_sample(now - boundary, batch_size)
+    _ring.append(sample)
+    _agg["samples"] += 1
+    if _cur["path"] and _cur["step"] % _cur["interval"] == 0:
+        _write_jsonl(sample)
+
+
+def _cum_totals():
+    """Cheap cumulative totals the windowed deltas are cut from —
+    O(ops) dict reads, same budget as ``runtime_stats.health_probe``
+    (which runs per drained step); no cost aggregation, no snapshot."""
+    from . import runtime_stats as _rts
+
+    misses = fallbacks = 0
+    for s in list(_rts._PER_OP.values()):
+        misses += s["misses"]
+        fallbacks += s["fallbacks"]
+    compiles = 0
+    for st in list(_rts._STORM.values()):
+        compiles += st["compiles"]
+    c = _rts._COUNTERS
+    return {"compiles": compiles, "misses": misses,
+            "fallbacks": fallbacks,
+            "kv_retries": c.get("kvstore_retries", 0),
+            "kv_dedup": c.get("kvstore_dup_suppressed", 0)}
+
+
+def _jit_cache_size():
+    """Total jit-cache entries across the op registry (read-side dict
+    ``len()`` per op, never a dispatch)."""
+    from .ops import registry as _registry
+
+    total = 0
+    seen = set()
+    for op in list(_registry._OP_REGISTRY.values()):
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        total += len(op._jit_cache)
+    return total
+
+
+def _hist_baseline():
+    """Bucket-level snapshot of every kv-RTT histogram, for the
+    windowed-percentile delta."""
+    out = {}
+    for name, h in list(_histogram._HISTS.items()):
+        if name.startswith(_KV_PREFIXES):
+            out[name] = (dict(h.buckets), h.count, h.total)
+    return out
+
+
+def _hist_windows():
+    """Windowed p50/p99 per kv-RTT series: the bucket counts that
+    arrived since the previous step boundary, percentile-interpolated
+    over the delta histogram (within one log2 bucket of the true order
+    statistic — the ``histogram.py`` contract, minus the exact-min/max
+    tightening a window cannot keep)."""
+    prev = _cur["prev_hist"]
+    new_prev = {}
+    out = {}
+    for name, h in list(_histogram._HISTS.items()):
+        if not name.startswith(_KV_PREFIXES):
+            continue
+        buckets = dict(h.buckets)
+        count, total = h.count, h.total
+        new_prev[name] = (buckets, count, total)
+        p = prev.get(name)
+        if p:
+            pb, pc, pt = p
+            dbuckets = {b: c - pb.get(b, 0) for b, c in buckets.items()
+                        if c - pb.get(b, 0) > 0}
+            dcount, dtotal = count - pc, total - pt
+        else:
+            dbuckets, dcount, dtotal = buckets, count, total
+        if dcount <= 0 or not dbuckets:
+            continue
+        wh = _histogram.Histogram()
+        wh.buckets = dbuckets
+        wh.count = dcount
+        wh.total = max(0.0, dtotal)
+        bs = sorted(dbuckets)
+        wh.min = _histogram.bucket_bounds(bs[0])[0]
+        wh.max = _histogram.bucket_bounds(bs[-1])[1]
+        out[name] = {"count": dcount,
+                     "mean_ms": wh.total / dcount * 1e3,
+                     "p50_ms": wh.percentile(50) * 1e3,
+                     "p99_ms": wh.percentile(99) * 1e3}
+    _cur["prev_hist"] = new_prev
+    return out
+
+
+def _health_flags():
+    """Latest flight-ring record's grad-norm / non-finite flags — a
+    plain host read of already-drained values; NEVER drains the
+    monitor's pending device queue (the health-layer contract)."""
+    from . import health as _health
+
+    mon = _health._GLOBAL[0] if _health._state["on"] and _health._GLOBAL \
+        else None
+    if mon is None:
+        return None
+    ring = mon.flight._ring
+    if not ring:
+        return None
+    rec = ring[-1]
+    out = {"nan": 1 if rec.get("nan_total") else 0,
+           "inf": 1 if rec.get("inf_total") else 0}
+    if rec.get("grad_norm") is not None:
+        out["grad_norm"] = rec["grad_norm"]
+    return out
+
+
+def _build_sample(wall, batch_size):
+    sample = {"t": time.time(), "step": _cur["step"],
+              "wall_ms": wall * 1e3}
+    if batch_size and wall > 0:
+        sample["throughput"] = batch_size / wall
+    if _stepstats._state["on"]:
+        last = _stepstats._agg["last"]
+        if last is not None:
+            sample["phases_ms"] = {k: v * 1e3 for k, v in last.items()
+                                   if k != "wall"}
+    cum = _cum_totals()
+    prev = _cur["prev"] or {}
+    for k, v in cum.items():
+        d = v - prev.get(k, 0)
+        if d:
+            sample[k] = d
+    _cur["prev"] = cum
+    mem = _dm._totals
+    sample["live_bytes"] = mem["live_bytes"]
+    sample["peak_bytes"] = mem["peak_bytes"]
+    sample["jit_entries"] = _jit_cache_size()
+    kv = _hist_windows()
+    if kv:
+        sample["kv_rtt_ms"] = kv
+    h = _health_flags()
+    if h:
+        sample.update(h)
+    return sample
+
+
+def _write_jsonl(sample):
+    w = _cur["writer"]
+    if w is None:
+        # lazy open in append mode; the path self-suffixes with this
+        # process's role+rank when running multi-process without
+        # launch.py's env rewriting (rank 0 keeps the plain path)
+        path = rank_suffix_path(_cur["path"])
+        try:
+            w = open(path, "a", buffering=1)
+        except OSError as e:
+            warn_rate_limited(
+                _logger(), "metrics-timeline:open", 60,
+                "cannot open MXNET_TPU_METRICS file %s (%s) — timeline "
+                "export disabled for this run", path, e)
+            _cur["path"] = None
+            return
+        _cur["writer"] = w
+        _cur["abs_path"] = os.path.abspath(path)
+    # one write() of a complete line (line-buffered flush): a tailing
+    # reader sees whole records or nothing
+    try:
+        w.write(json.dumps(sample, separators=(",", ":"),
+                           default=repr) + "\n")
+    except (OSError, ValueError) as e:
+        # same contract as the open failure: say why the export went
+        # dark (disk full, bad fd) and stop paying for dead writes —
+        # the in-memory ring keeps recording either way
+        warn_rate_limited(
+            _logger(), "metrics-timeline:write", 60,
+            "writing MXNET_TPU_METRICS sample to %s failed (%s) — "
+            "timeline export disabled for this run, ring still "
+            "recording", _cur["abs_path"], e)
+        _cur["path"] = None
+        _close_writer()
+        return
+    _agg["written"] += 1
+
+
+# ------------------------------------------------------------ read side
+
+
+def samples():
+    """The in-memory ring, oldest first (host dicts; safe to mutate)."""
+    return [dict(s) for s in _ring]
+
+
+# samples embedded per diag dump: plenty for every trend window (the
+# rules compare series quarters) while keeping the dump — and the
+# MXNET_TPU_DIAG_PUSH payload serialized on the training thread —
+# bounded well below the full ring
+EMBED_TAIL = 256
+
+
+def timeline(tail=EMBED_TAIL):
+    """The ring's newest ``tail`` samples as an embeddable dump
+    section: ``{"interval", "samples": [...]}``, or None while empty —
+    what ``runtime_stats.diag_snapshot`` attaches so a diag dump
+    carries the recent time series for the trend doctor."""
+    if not _ring:
+        return None
+    out = samples()
+    if tail is not None:
+        out = out[-tail:]
+    return {"interval": _cur["interval"], "samples": out}
+
+
+def looks_like_sample(data):
+    """True for a dict shaped like one timeline sample — what a
+    one-line JSONL file parses to (it IS valid JSON, so plain
+    ``json.loads`` sniffing would misread it as a diag dump)."""
+    return isinstance(data, dict) and "wall_ms" in data \
+        and "snapshot" not in data and "ops" not in data \
+        and "traceEvents" not in data
+
+
+def snapshot():
+    """Small JSON-ready status view (never the full ring)."""
+    last = _ring[-1] if _ring else None
+    return {"enabled": _state["on"], "step": _cur["step"],
+            "interval": _cur["interval"], "samples": len(_ring),
+            "written": _agg["written"], "path": _cur["abs_path"]
+            or _cur["path"], "port": server_port(),
+            "last": dict(last) if last else None}
+
+
+def parse_jsonl(text):
+    """Parse JSONL text into a sample list.  Blank lines are skipped; a
+    trailing torn line (a crash mid-append) is dropped, not fatal; and
+    only dict lines count — scalar-per-line garbage must not pass as a
+    valid (rule-silent) timeline."""
+    out = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def sniff_text(text, path="<input>"):
+    """THE content sniffer every timeline-aware loader shares
+    (``perfdoctor.classify``, ``runtime_stats.load_dumps``,
+    :func:`load`): returns ``("timeline", {"samples": [...]})``,
+    ``("trace", data)``, or ``("dump", data)``.  Content that is
+    neither JSON nor sample-bearing JSONL raises ``ValueError`` — a
+    corrupt input must never read as a finding-free clean run."""
+    try:
+        data = json.loads(text)
+    except ValueError:
+        samples = parse_jsonl(text)
+        if not samples:
+            raise ValueError(
+                "%s is neither JSON nor a metrics JSONL timeline"
+                % path) from None
+        return "timeline", {"samples": samples}
+    if isinstance(data, list):
+        samples = [s for s in data if isinstance(s, dict)]
+        if not samples:
+            raise ValueError(
+                "%s is a JSON array with no timeline samples" % path)
+        return "timeline", {"samples": samples}
+    if looks_like_sample(data):
+        return "timeline", {"samples": [data]}
+    if isinstance(data, dict) and "traceEvents" in data:
+        return "trace", data
+    if not isinstance(data, dict):
+        raise ValueError(
+            "%s is neither a diag dump, chrome trace, nor metrics "
+            "timeline" % path)
+    return "dump", data
+
+
+def load(path):
+    """Samples from a timeline source: a JSONL file (even a one-line
+    one), a JSON sample array, or a diag dump embedding a ``timeline``
+    section (a dump without one yields ``[]``).  Non-JSON/JSONL
+    content raises ``ValueError`` (:func:`sniff_text`)."""
+    with open(path) as f:
+        text = f.read()
+    kind, data = sniff_text(text, path=path)
+    if kind == "timeline":
+        return data["samples"]
+    tl = data.get("timeline")
+    if isinstance(tl, dict):
+        return tl.get("samples") or []
+    return tl or []
+
+
+def _fmt(v, fmt="%.2f"):
+    return "-" if v is None else fmt % v
+
+
+def render(samp, tail=30):
+    """Text table of a sample list (the CLI / ``diagnose.py --timeline``
+    view): newest ``tail`` rows plus a summary line."""
+    lines = ["Live metrics timeline (%d sample(s)%s)"
+             % (len(samp),
+                ", steps %s-%s" % (samp[0].get("step", "?"),
+                                   samp[-1].get("step", "?"))
+                if samp else "")]
+    if not samp:
+        lines.append("(no samples — MXNET_TPU_METRICS=<file> / "
+                     "MXNET_TPU_METRICS_PORT=<port>, or auto-on under "
+                     "MXNET_TPU_PROFILE / MXNET_TPU_DIAG)")
+        return "\n".join(lines)
+    lines.append("%8s %9s %9s %9s %9s %8s %10s %5s"
+                 % ("Step", "Wall ms", "Thr/s", "Live MB", "Peak MB",
+                    "Compiles", "kv p99 ms", "NaN"))
+    for s in samp[-tail:]:
+        kv = s.get("kv_rtt_ms") or {}
+        push = kv.get("kv:push_rtt") or {}
+        lines.append("%8s %9s %9s %9s %9s %8d %10s %5s"
+                     % (s.get("step", "?"), _fmt(s.get("wall_ms"), "%.3f"),
+                        _fmt(s.get("throughput"), "%.1f"),
+                        _fmt((s.get("live_bytes") or 0) / 1e6),
+                        _fmt((s.get("peak_bytes") or 0) / 1e6),
+                        s.get("compiles", 0),
+                        _fmt(push.get("p99_ms"), "%.3f"),
+                        "*" if s.get("nan") or s.get("inf") else ""))
+    walls = [s["wall_ms"] for s in samp if s.get("wall_ms") is not None]
+    thrs = [s["throughput"] for s in samp if s.get("throughput")]
+    lives = [s.get("live_bytes") for s in samp
+             if s.get("live_bytes") is not None]
+    parts = []
+    if walls:
+        parts.append("mean wall %.3f ms" % (sum(walls) / len(walls)))
+    if thrs:
+        parts.append("mean throughput %.1f/s" % (sum(thrs) / len(thrs)))
+    if lives:
+        parts.append("live bytes %s -> %s MB"
+                     % (_fmt(lives[0] / 1e6), _fmt(lives[-1] / 1e6)))
+    if parts:
+        lines.append("summary: " + "; ".join(parts))
+    lines.append("(trend analysis: python tools/diagnose.py --doctor "
+                 "<this file or its diag dump>)")
+    return "\n".join(lines)
+
+
+# -------------------------------------------------- Prometheus endpoint
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name):
+    n = _NAME_RE.sub("_", name)
+    if not n or not (n[0].isalpha() or n[0] == "_"):
+        n = "_" + n
+    return n
+
+
+def _prom_label(value):
+    return str(value).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _prom_num(v):
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    return "%.10g" % v
+
+
+def prometheus_text():
+    """The ``/metrics`` payload: Prometheus text format (version 0.0.4)
+    built from snapshot reads only — counters and per-op totals from
+    ``runtime_stats``, device-memory / jit-cache / health-queue gauges,
+    the newest timeline sample's step gauges, and every latency
+    histogram (plus the stepstats phases) as a ``summary`` family."""
+    from . import health as _health
+    from . import runtime_stats as _rts
+
+    lines = []
+
+    def family(name, mtype, help_, rows):
+        # rows: [(labels-dict-or-None, value)]; suffix rides in name
+        emitted = False
+        for labels, v in rows:
+            if v is None:
+                continue
+            if not emitted:
+                lines.append("# HELP %s %s" % (name, help_))
+                lines.append("# TYPE %s %s" % (name, mtype))
+                emitted = True
+            lab = ""
+            if labels:
+                lab = "{%s}" % ",".join(
+                    '%s="%s"' % (k, _prom_label(v2))
+                    for k, v2 in labels.items())
+            lines.append("%s%s %s" % (name, lab, _prom_num(float(v))))
+
+    ident = process_identity()
+    family("mxnet_tpu_identity", "gauge",
+           "Process identity under the DMLC_* launch contract.",
+           [({"role": ident["role"], "rank": ident["rank"]}, 1)]
+           if ident else [(None, 1)])
+
+    # dispatch totals (counter semantics: monotonic for process life)
+    totals = {"op_calls": 0, "jit_cache_hits": 0, "jit_cache_misses": 0,
+              "fallbacks": 0, "compile_seconds": 0.0,
+              "dispatch_seconds": 0.0}
+    for s in list(_rts._PER_OP.values()):
+        totals["op_calls"] += s["calls"]
+        totals["jit_cache_hits"] += s["hits"]
+        totals["jit_cache_misses"] += s["misses"]
+        totals["fallbacks"] += s["fallbacks"]
+        totals["compile_seconds"] += s["compile_seconds"]
+        totals["dispatch_seconds"] += s.get("dispatch_seconds", 0.0)
+    for key, help_ in (("op_calls", "Op dispatches."),
+                       ("jit_cache_hits", "Jit-cache hits."),
+                       ("jit_cache_misses", "Jit-cache misses."),
+                       ("fallbacks", "Dispatches off the compiled path."),
+                       ("compile_seconds", "Compile wall seconds."),
+                       ("dispatch_seconds",
+                        "Cache-warm dispatch wall seconds.")):
+        family("mxnet_tpu_%s_total" % key, "counter", help_,
+               [(None, totals[key])])
+    # generic named counters (trainer_steps, kvstore_retries, ...)
+    for name, v in sorted(list(_rts._COUNTERS.items())):
+        family("mxnet_tpu_%s_total" % _prom_name(name), "counter",
+               "runtime_stats counter %r." % name, [(None, v)])
+
+    mem = _dm._totals
+    family("mxnet_tpu_device_live_bytes", "gauge",
+           "Live tracked device bytes.", [(None, mem["live_bytes"])])
+    family("mxnet_tpu_device_peak_bytes", "gauge",
+           "Peak tracked device bytes.", [(None, mem["peak_bytes"])])
+    family("mxnet_tpu_jit_cache_entries", "gauge",
+           "Jit-cache entries across the op registry.",
+           [(None, _jit_cache_size())])
+    if _health._state["on"] and _health._GLOBAL:
+        family("mxnet_tpu_health_pending", "gauge",
+               "Queued (undrained) health stat entries.",
+               [(None, len(_health._GLOBAL[0]._pending))])
+    family("mxnet_tpu_timeline_samples", "gauge",
+           "Samples in the metrics-timeline ring.", [(None, len(_ring))])
+
+    last = _ring[-1] if _ring else None
+    if last:
+        family("mxnet_tpu_step", "gauge",
+               "Step number of the newest timeline sample.",
+               [(None, last.get("step"))])
+        wall = last.get("wall_ms")
+        family("mxnet_tpu_step_duration_seconds", "gauge",
+               "Newest sampled step wall time.",
+               [(None, wall / 1e3 if wall is not None else None)])
+        family("mxnet_tpu_step_throughput_samples_per_second", "gauge",
+               "Newest sampled training throughput.",
+               [(None, last.get("throughput"))])
+        phases = last.get("phases_ms") or {}
+        family("mxnet_tpu_step_phase_seconds", "gauge",
+               "Newest step's per-phase wall time (stepstats).",
+               [({"phase": p}, v / 1e3)
+                for p, v in sorted(phases.items())])
+
+    # every latency histogram as one summary family (associative
+    # snapshots — the same numbers report()/cluster_report show)
+    rows = []
+    for name, h in sorted(list(_histogram._HISTS.items())):
+        snap = h.snapshot()
+        if not snap["count"]:
+            continue
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            rows.append((name, {"series": name, "quantile": "%g" % q},
+                         snap[key]))
+    if rows:
+        lines.append("# HELP mxnet_tpu_latency_seconds Latency "
+                     "distributions (histogram.py log2 buckets).")
+        lines.append("# TYPE mxnet_tpu_latency_seconds summary")
+        for _name, labels, v in rows:
+            lines.append("mxnet_tpu_latency_seconds{%s} %s" % (
+                ",".join('%s="%s"' % (k, _prom_label(v2))
+                         for k, v2 in labels.items()), _prom_num(v)))
+        for name, h in sorted(list(_histogram._HISTS.items())):
+            if not h.count:
+                continue
+            lines.append('mxnet_tpu_latency_seconds_sum{series="%s"} %s'
+                         % (_prom_label(name), _prom_num(h.total)))
+            lines.append('mxnet_tpu_latency_seconds_count{series="%s"} %s'
+                         % (_prom_label(name), _prom_num(h.count)))
+    return "\n".join(lines) + "\n"
+
+
+def serve(port=None, host=None):
+    """Start (or restart) the read-only ``/metrics`` HTTP endpoint on a
+    daemon thread; returns the server (its bound port is
+    ``server_port()``).  Serves snapshots only: no health drain, no
+    device access, no writes.
+
+    Binds every interface by default (the node-exporter convention — a
+    Prometheus scraper is usually remote); the payload is read-only
+    runtime telemetry, but on an untrusted network set
+    ``MXNET_TPU_METRICS_HOST=127.0.0.1`` (or ``host=``) to keep it
+    loopback-only."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    stop_server()
+    if host is None:
+        host = os.environ.get("MXNET_TPU_METRICS_HOST", "")
+    if port is None:
+        try:
+            port = int(os.environ.get("MXNET_TPU_METRICS_PORT", "0"))
+        except ValueError:
+            port = 0
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                self.send_error(404, "only /metrics is served")
+                return
+            try:
+                body = prometheus_text().encode("utf-8")
+            except Exception:  # pragma: no cover - a scrape must not 500
+                _logger().exception("metrics render failed")
+                self.send_error(500)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="mxtpu-metrics", daemon=True)
+    t.start()
+    _server.append(srv)
+    return srv
+
+
+def server_port():
+    """The endpoint's bound port, or None when not serving."""
+    return _server[0].server_address[1] if _server else None
+
+
+def stop_server():
+    """Shut the endpoint down (idempotent)."""
+    while _server:
+        srv = _server.pop()
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------- env activation
+
+
+def _activate_from_env():
+    """Import-time arming — called by ``runtime_stats`` once its module
+    globals exist (``enable()`` raises stepstats/histogram state there).
+    ``MXNET_TPU_METRICS``/``MXNET_TPU_METRICS_PORT`` arm their exports;
+    ``MXNET_TPU_PROFILE``/``MXNET_TPU_DIAG`` arm the ring alone."""
+    path = os.environ.get("MXNET_TPU_METRICS")
+    port_raw = os.environ.get("MXNET_TPU_METRICS_PORT")
+    port = None
+    want_port = bool(port_raw)
+    if port_raw:
+        try:
+            port = int(port_raw)
+        except ValueError:
+            # the user explicitly asked for the endpoint: a typo'd
+            # port must not silently drop the whole timeline
+            warn_rate_limited(
+                _logger(), "metrics-timeline:port", 60,
+                "MXNET_TPU_METRICS_PORT=%r is not a port number — "
+                "/metrics endpoint disabled, timeline ring still "
+                "recording", port_raw)
+    if not (path or want_port
+            or os.environ.get("MXNET_TPU_PROFILE")
+            or os.environ.get("MXNET_TPU_DIAG")):
+        return False
+    try:
+        enable(path=path, port=port)
+    except OSError as e:
+        # a busy metrics port must never kill training: keep the ring
+        warn_rate_limited(
+            _logger(), "metrics-timeline:port", 60,
+            "cannot bind MXNET_TPU_METRICS_PORT=%s (%s) — /metrics "
+            "endpoint disabled, timeline ring still recording",
+            port_raw, e)
+        if not _state["on"]:
+            enable(path=path, port=None)
+    return True

@@ -34,6 +34,24 @@ Rules
   were acked from the exactly-once table instead of re-applying — the
   fingerprint of reply loss / restart drills).
 
+Trend rules (PR 10) run over a **timeline** — the per-step time series
+``metrics_timeline`` records (its live ring, a ``MXNET_TPU_METRICS``
+JSONL file, or the ``timeline`` section of a diag dump):
+
+- **timeline-leak** — monotonic live-device-bytes growth past a slope
+  threshold: the signature of retained NDArrays / autograd graphs that
+  OOMs a long run at step 400k, invisible to any single snapshot.
+- **timeline-throughput** — the recent window's mean step wall time vs
+  the early window's: sustained decay (fragmentation, queue buildup,
+  input starvation), with the fastest-growing phase named when the
+  samples carry a stepstats breakdown.
+- **timeline-spikes** — step-time spikes vs the series median, with
+  periodicity detection (a spike every N steps is a cadence —
+  checkpoint, eval, logging) and the offending phase named.
+- **timeline-kv-drift** — one kv push/pull-RTT series' windowed p99
+  drifting up over the run, per shard: the *emerging* straggler the
+  end-of-run skew report only catches after the damage.
+
 Findings are ``{"rule", "severity": "warn"|"info", "score",
 "title", "anchor", "evidence": [...], "action"}`` — ``score`` is the
 estimated fraction of step time at stake (what the ranking sorts by),
@@ -46,15 +64,15 @@ annotations, the mxlint convention).
 
 from __future__ import annotations
 
-import json
-
 from . import histogram as _histogram
 from . import runtime_stats as _rts
 from . import stepstats as _stepstats
 
 __all__ = ["diagnose", "classify", "render", "render_github",
            "gh_annotation", "SHARE_NOTICE", "SHARE_WARN",
-           "HEADROOM_RATIO", "IDLE_GAP_SHARE"]
+           "HEADROOM_RATIO", "IDLE_GAP_SHARE", "TREND_MIN_SAMPLES",
+           "TREND_SLOWDOWN", "LEAK_SLOPE_BYTES", "SPIKE_RATIO",
+           "KV_DRIFT_RATIO"]
 
 # a phase/rule at or above this share of step time is worth a line /
 # a warning; tunable per call via diagnose(..., notice=, warn=)
@@ -68,16 +86,45 @@ HEADROOM_RATIO = 0.5
 # untracked time inside trainer:step spans worth flagging
 IDLE_GAP_SHARE = 0.20
 
+# ---- trend-rule knobs (timeline series) --------------------------------
+# samples below this leave every trend rule silent (too little signal)
+TREND_MIN_SAMPLES = 8
+# late-window mean step wall must exceed the early window's by this
+# fraction before the throughput rule fires (0.5 = 50% slower)
+TREND_SLOWDOWN = 0.5
+# live-bytes leak: regression slope past this many bytes/step AND total
+# growth past LEAK_MIN_GROWTH AND mostly-nondecreasing deltas
+LEAK_SLOPE_BYTES = 4096.0
+LEAK_MIN_GROWTH = 1 << 20
+LEAK_MONOTONIC_FRAC = 0.6
+# step-time spikes: > SPIKE_RATIO x the series median, at least
+# SPIKE_MIN_COUNT of them past the warmup tail, carrying at least
+# SPIKE_MIN_SHARE of the windowed wall time
+SPIKE_RATIO = 4.0
+SPIKE_MIN_COUNT = 2
+SPIKE_WARMUP = 3
+SPIKE_MIN_SHARE = 0.10
+# a kv-RTT series' late-window mean p99 / early-window mean p99 past
+# this ratio is drift
+KV_DRIFT_RATIO = 2.0
+
 
 def classify(path):
     """Load ``path`` and say what it is: ``("trace", data)`` for a
-    chrome trace, ``("dump", data)`` for a diag dump / snapshot."""
+    chrome trace, ``("dump", data)`` for a diag dump / snapshot, or
+    ``("timeline", {"samples": [...]})`` for a metrics-timeline source
+    (``MXNET_TPU_METRICS`` JSONL — even a one-line file — or a bare
+    JSON sample array).  A file that is neither JSON nor JSONL raises
+    ``ValueError`` — a corrupt input must never read as a finding-free
+    clean run."""
+    from . import metrics_timeline as _mt
+
     with open(path) as f:
-        data = json.load(f)
-    if "traceEvents" in data:
-        return "trace", data
-    data.setdefault("_path", path)
-    return "dump", data
+        text = f.read()
+    kind, data = _mt.sniff_text(text, path=path)
+    if kind != "trace":
+        data.setdefault("_path", path)
+    return kind, data
 
 
 def _finding(rule, score, title, anchor, evidence, action,
@@ -377,6 +424,246 @@ def _check_self_healing(dump):
     return out
 
 
+# ----------------------------------------------------------- trend rules
+
+
+def _lin_slope(xs, ys):
+    """Least-squares slope of ys over xs (0 for a degenerate x span)."""
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    den = sum((x - mx) ** 2 for x in xs)
+    if not den:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / den
+
+
+def _window_means(vals):
+    """``(early mean, late mean, window size)`` over the first/last
+    quarter of the series (min 3 samples per window)."""
+    k = max(3, len(vals) // 4)
+    early = vals[:k]
+    late = vals[-k:]
+    return sum(early) / len(early), sum(late) / len(late), k
+
+
+def _phase_means(samples):
+    """Per-phase mean ms over samples that carry a stepstats window."""
+    sums: dict = {}
+    counts: dict = {}
+    for s in samples:
+        for p, v in (s.get("phases_ms") or {}).items():
+            sums[p] = sums.get(p, 0.0) + v
+            counts[p] = counts.get(p, 0) + 1
+    return {p: sums[p] / counts[p] for p in sums}
+
+
+def _grown_phase(early_samples, late_samples):
+    """``(phase, early ms, late ms)`` of the phase whose mean grew the
+    most between the windows, or None without phase data."""
+    early = _phase_means(early_samples)
+    late = _phase_means(late_samples)
+    best = None
+    for p, lv in late.items():
+        ev = early.get(p, 0.0)
+        if best is None or lv - ev > best[2] - best[1]:
+            best = (p, ev, lv)
+    if best is None or best[2] <= best[1]:
+        return None
+    return best
+
+
+def _check_leak(samples):
+    """Monotonic live-device-bytes growth: the leak signature no single
+    snapshot can see.  Needs the device-memory tracker feeding the
+    samples (``MXNET_TPU_DIAG`` / ``MXNET_TPU_MEMORY_TRACK=1``)."""
+    pts = [(s.get("step", i), s["live_bytes"])
+           for i, s in enumerate(samples)
+           if s.get("live_bytes") is not None]
+    if len(pts) < TREND_MIN_SAMPLES:
+        return []
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    growth = ys[-1] - ys[0]
+    slope = _lin_slope(xs, ys)
+    nondec = sum(1 for a, b in zip(ys, ys[1:]) if b >= a) \
+        / max(1, len(ys) - 1)
+    if slope < LEAK_SLOPE_BYTES or growth < LEAK_MIN_GROWTH \
+            or nondec < LEAK_MONOTONIC_FRAC:
+        return []
+    steps = max(1, xs[-1] - xs[0])
+    return [_finding(
+        "timeline-leak", 2 * SHARE_WARN,
+        "device-memory leak: live bytes grew %.1f MB over %d step(s) "
+        "(%.1f KB/step slope)"
+        % (growth / 1e6, steps, slope / 1e3),
+        "live_bytes",
+        ["live bytes %.2f MB at step %s -> %.2f MB at step %s"
+         % (ys[0] / 1e6, xs[0], ys[-1] / 1e6, xs[-1]),
+         "regression slope %.0f bytes/step; %.0f%% of deltas "
+         "non-decreasing" % (slope, nondec * 100)],
+        "find the retaining op in the dump's device-memory per-op "
+        "table (python -m mxnet_tpu.runtime_stats <dump>); usual "
+        "suspects: a growing Python list of NDArrays, autograd graphs "
+        "kept past backward, metric state never reset "
+        "(docs/OBSERVABILITY.md 'Live metrics & trends')")]
+
+
+def _check_throughput(samples):
+    """Sustained slowdown: recent-window mean step wall vs the early
+    window's, with the fastest-growing phase named when the samples
+    carry a stepstats breakdown."""
+    timed = [s for s in samples if s.get("wall_ms") is not None]
+    if len(timed) < TREND_MIN_SAMPLES:
+        return []
+    walls = [s["wall_ms"] for s in timed]
+    early, late, k = _window_means(walls)
+    if early <= 0:
+        return []
+    ratio = late / early
+    if ratio < 1.0 + TREND_SLOWDOWN:
+        return []
+    slow_frac = 1.0 - early / late
+    evidence = ["step wall mean %.3f ms (first %d sample(s)) -> "
+                "%.3f ms (last %d): %.2fx" % (early, k, late, k, ratio)]
+    thr = [s.get("throughput") for s in timed if s.get("throughput")]
+    if len(thr) >= 2 * k:
+        te = sum(thr[:k]) / k
+        tl = sum(thr[-k:]) / k
+        evidence.append("throughput %.1f -> %.1f samples/s" % (te, tl))
+    grown = _grown_phase(timed[:k], timed[-k:])
+    action = ("profile an early and a late window (MXNET_TPU_PROFILE) "
+              "and diff their dumps (diagnose.py --compare); no phase "
+              "attribution in these samples — enable "
+              "MXNET_TPU_STEPSTATS to name the growing phase")
+    anchor = "step_wall"
+    if grown is not None:
+        p, ev, lv = grown
+        evidence.append("fastest-growing phase: %s %.3f -> %.3f "
+                        "ms/step" % (p, ev, lv))
+        anchor = "phase:%s" % p
+        action = ("the growth sits in phase %r — check that "
+                  "subsystem's inputs over time (io queue depth, kv "
+                  "RTT drift, compile churn); confirm with "
+                  "diagnose.py --compare on an early vs late diag dump"
+                  % p)
+    return [_finding(
+        "timeline-throughput", slow_frac,
+        "throughput regression: recent steps %.2fx slower than the "
+        "early window" % ratio,
+        anchor, evidence, action, warn_at=1.0 - 1.0 /
+        (1.0 + TREND_SLOWDOWN))]
+
+
+def _check_spikes(samples):
+    """Step-time spikes vs the series median, with periodicity
+    detection and the offending phase named.  The first
+    ``SPIKE_WARMUP`` samples are exempt (late compiles / allocator
+    warmup read as spikes otherwise)."""
+    body = [s for s in samples[SPIKE_WARMUP:]
+            if s.get("wall_ms") is not None]
+    if len(body) < TREND_MIN_SAMPLES:
+        return []
+    ordered = sorted(s["wall_ms"] for s in body)
+    med = ordered[len(ordered) // 2]
+    if med <= 0:
+        return []
+    spikes = [s for s in body if s["wall_ms"] > SPIKE_RATIO * med]
+    if len(spikes) < SPIKE_MIN_COUNT:
+        return []
+    total = sum(s["wall_ms"] for s in body)
+    excess = sum(s["wall_ms"] - med for s in spikes)
+    share = excess / total if total else 0.0
+    if share < SPIKE_MIN_SHARE:
+        return []
+    steps = [s.get("step", 0) for s in spikes]
+    diffs = [b - a for a, b in zip(steps, steps[1:])]
+    period = None
+    if diffs and diffs[0] > 1 and \
+            all(abs(d - diffs[0]) <= 1 for d in diffs):
+        period = diffs[0]
+    worst = max(spikes, key=lambda s: s["wall_ms"])
+    evidence = ["%d spike(s) > %.0fx the median step wall (%.3f ms); "
+                "worst step %s at %.3f ms"
+                % (len(spikes), SPIKE_RATIO, med,
+                   worst.get("step", "?"), worst["wall_ms"])]
+    if period:
+        evidence.append("periodic: one spike every ~%d step(s) — a "
+                        "cadence, not noise" % period)
+    # name the phase carrying the spike: worst spike's phases vs the
+    # non-spike phase means
+    quiet = [s for s in body if s not in spikes]
+    grown = _grown_phase(quiet, [worst])
+    anchor = "step_wall"
+    action = ("align the spike steps with your loop's cadences "
+              "(checkpoint/eval/logging every N steps); no phase "
+              "attribution in these samples — enable "
+              "MXNET_TPU_STEPSTATS to name the phase")
+    if grown is not None:
+        p, ev, lv = grown
+        evidence.append("offending phase: %s %.3f ms (quiet steps) -> "
+                        "%.3f ms in the worst spike" % (p, ev, lv))
+        anchor = "phase:%s" % p
+        action = ("the spikes sit in phase %r — check that "
+                  "subsystem's every-N-steps work (checkpoint "
+                  "interval, eval loop, log flush); spread or async "
+                  "it" % p)
+    return [_finding(
+        "timeline-spikes", share,
+        "step-time spikes: %d step(s) > %.0fx the median%s"
+        % (len(spikes), SPIKE_RATIO,
+           (", every ~%d steps" % period) if period else ""),
+        anchor, evidence, action)]
+
+
+def _check_kv_drift(samples, top=3):
+    """A kv push/pull-RTT series whose windowed p99 drifts up over the
+    run — the emerging straggler, per shard."""
+    series: dict = {}
+    for s in samples:
+        for name, h in (s.get("kv_rtt_ms") or {}).items():
+            if h.get("p99_ms") is not None:
+                series.setdefault(name, []).append(h["p99_ms"])
+    out = []
+    for name, vals in sorted(series.items()):
+        if len(vals) < TREND_MIN_SAMPLES:
+            continue
+        early, late, k = _window_means(vals)
+        if early <= 0:
+            continue
+        ratio = late / early
+        if ratio <= KV_DRIFT_RATIO:
+            continue
+        out.append(_finding(
+            "timeline-kv-drift", min(1.0, SHARE_NOTICE * ratio),
+            "kv RTT drift: %s windowed p99 %.2fx its early window"
+            % (name, ratio),
+            name,
+            ["windowed p99 mean %.3f ms (first %d sample(s)) -> "
+             "%.3f ms (last %d)" % (early, k, late, k)],
+            "that shard/route is degrading mid-run (host load, "
+            "network, GC) — watch it live via the /metrics endpoint, "
+            "cross-check ranks with diagnose.py --cluster, and see "
+            "the MXNET_TPU_STRAGGLER_* warnings "
+            "(docs/OBSERVABILITY.md 'Distributed telemetry')"))
+    out.sort(key=lambda f: -f["score"])
+    return out[:top]
+
+
+def _check_timeline(samples):
+    """Every trend rule over one timeline (a list of per-step sample
+    dicts, oldest first)."""
+    samples = [s for s in samples if isinstance(s, dict)]
+    if len(samples) < TREND_MIN_SAMPLES:
+        return []
+    out = []
+    out += _check_leak(samples)
+    out += _check_throughput(samples)
+    out += _check_spikes(samples)
+    out += _check_kv_drift(samples)
+    return out
+
+
 # ----------------------------------------------------------- trace rules
 
 
@@ -446,11 +733,16 @@ def _check_idle_gaps(trace):
 # --------------------------------------------------------------- driver
 
 
-def diagnose(trace=None, dump=None, top=20):
-    """Run every applicable rule over a loaded chrome ``trace`` and/or
-    diag ``dump`` and return findings ranked worst-first (by estimated
-    share of step time).  Either input may be None; rules missing
-    their data contribute nothing."""
+def diagnose(trace=None, dump=None, timeline=None, top=20):
+    """Run every applicable rule over a loaded chrome ``trace``, diag
+    ``dump``, and/or per-step ``timeline`` and return findings ranked
+    worst-first (by estimated share of step time).  Any input may be
+    None; rules missing their data contribute nothing.
+
+    ``timeline`` is a list of ``metrics_timeline`` samples (or a
+    ``{"samples": [...]}`` wrapper).  When omitted and the dump embeds
+    a ``timeline`` section (``runtime_stats.diag_snapshot`` attaches
+    the live ring), the trend rules run over that."""
     findings = []
     if dump is not None:
         findings += _check_step_anatomy(dump)
@@ -460,6 +752,12 @@ def diagnose(trace=None, dump=None, top=20):
         findings += _check_stragglers(dump)
         findings += _check_retries(dump)
         findings += _check_self_healing(dump)
+        if timeline is None:
+            timeline = dump.get("timeline")
+    if isinstance(timeline, dict):
+        timeline = timeline.get("samples")
+    if timeline:
+        findings += _check_timeline(list(timeline))
     if trace is not None:
         findings += _check_idle_gaps(trace)
     findings.sort(key=lambda f: -f["score"])

@@ -31,7 +31,7 @@ import os
 import threading
 import time
 
-from .log import process_identity
+from .log import process_identity, rank_suffix_path
 
 _state = {
     "config": {"profile_all": False, "profile_symbolic": True,
@@ -473,7 +473,10 @@ def _activate_from_env():
         return False
     import atexit
 
-    set_config(filename=fname, profile_all=True)
+    # multi-rank runs launched WITHOUT tools/launch.py (which rewrites
+    # the env per process) self-suffix the path — a non-zero rank must
+    # not silently overwrite rank 0's trace
+    set_config(filename=rank_suffix_path(fname), profile_all=True)
     set_state("run")
     atexit.register(_dump_at_exit)
     return True

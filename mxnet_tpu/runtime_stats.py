@@ -74,7 +74,8 @@ import time
 from . import device_memory
 from . import histogram as _histogram
 from . import stepstats as _stepstats
-from .log import get_logger, process_identity, warn_rate_limited
+from .log import (get_logger, process_identity, rank_suffix_path,
+                  warn_rate_limited)
 
 __all__ = ["snapshot", "report", "reset", "inc",
            "record_dispatch", "record_compile_key", "add_compile_seconds",
@@ -605,6 +606,7 @@ def reset():
     accounting must survive a counter reset; use
     ``device_memory.reset()`` to drop that too.  Latency histograms
     are pure counters and reset with everything else."""
+    from . import metrics_timeline as _metrics_timeline
     from .log import reset_rate_limits
 
     _PER_OP.clear()
@@ -612,6 +614,7 @@ def reset():
     _STORM.clear()
     _histogram.reset()
     _stepstats.reset()
+    _metrics_timeline.reset()
     reset_rate_limits("recompile-storm:")
 
 
@@ -631,10 +634,20 @@ def diag_snapshot(top=20):
     snap["memory"] = device_memory.snapshot(top=None)
     storm_keys = {name: [repr(k) for k in list(st["keys"])]
                   for name, st in list(_STORM.items()) if st["keys"]}
-    return {"version": 1, "pid": os.getpid(), "time": time.time(),
-            "identity": process_identity(),
-            "snapshot": snap, "roofline": roofline(snap, top=top),
-            "recent_storm_keys": storm_keys}
+    out = {"version": 1, "pid": os.getpid(), "time": time.time(),
+           "identity": process_identity(),
+           "snapshot": snap, "roofline": roofline(snap, top=top),
+           "recent_storm_keys": storm_keys}
+    # the recent per-step time series (metrics_timeline ring) rides
+    # along like roofline/storm keys — top-level, NOT inside
+    # "snapshot", so compare()'s per-section flattening never
+    # double-counts the per-step metrics it already derives
+    from . import metrics_timeline as _metrics_timeline
+
+    tl = _metrics_timeline.timeline()
+    if tl:
+        out["timeline"] = tl
+    return out
 
 
 # per-call temp-name sequence; next() on a C iterator is signal-atomic
@@ -648,9 +661,15 @@ def dump_diag(path=None, top=20):
     a second SIGUSR1) never sees a torn file; the temp name is unique
     per call (atomic counter), so a SIGUSR1 interrupting an in-progress
     dump writes its own temp file instead of truncating the outer
-    one's — whichever replace lands last, the final file is whole."""
-    path = path or os.environ.get("MXNET_TPU_DIAG") \
-        or "mxnet_tpu_diag.json"
+    one's — whichever replace lands last, the final file is whole.
+
+    An explicit ``path`` is honored verbatim; the env/default fallback
+    self-suffixes with this process's role+rank (``rank_suffix_path``)
+    so a multi-rank run without launch.py's env rewriting cannot
+    clobber rank 0's dump."""
+    if path is None:
+        path = rank_suffix_path(os.environ.get("MXNET_TPU_DIAG")
+                                or "mxnet_tpu_diag.json")
     path = os.path.abspath(path)
     tmp = os.path.join(os.path.dirname(path),
                        ".%s.%d.%d.tmp" % (os.path.basename(path),
@@ -739,6 +758,9 @@ def _activate_diag_from_env():
         return False
     import atexit
 
+    # the same self-suffix dump_diag's env fallback applies: the armed
+    # handlers must write the per-rank file, not rank 0's
+    path = rank_suffix_path(path)
     _install_diag_handler(path)
     atexit.register(_dump_diag_at_exit, path)
     return True
@@ -750,6 +772,12 @@ _activate_diag_from_env()
 # global exists)
 _histogram._activate_from_env()
 _stepstats._activate_from_env()
+# the metrics timeline is imported here (bottom of module: everything
+# it lazily reads exists) and armed after stepstats/histograms — its
+# enable() raises their state too
+from . import metrics_timeline as _metrics_timeline  # noqa: E402
+
+_metrics_timeline._activate_from_env()
 
 
 # -------------------------------------------------- cluster aggregation
@@ -764,8 +792,13 @@ _CLUSTER_METRICS = ("kv:push_rtt", "kv:pull_rtt", "trainer:step",
 def load_dumps(paths):
     """Load diag dumps for :func:`cluster_report`; a directory expands
     to the ``*.json`` files inside it (sorted).  Each dump dict gains a
-    ``_path`` key for attribution in the rendered report."""
+    ``_path`` key for attribution in the rendered report.  A metrics
+    JSONL file (``MXNET_TPU_METRICS``) or a bare JSON sample array
+    loads as a timeline-only dump (``{"timeline": {"samples": ...}}``)
+    so the CLI and the perf doctor take both kinds."""
     import glob
+
+    from . import metrics_timeline as _metrics_timeline
 
     files = []
     for p in paths:
@@ -776,7 +809,13 @@ def load_dumps(paths):
     dumps = []
     for f in files:
         with open(f) as fh:
-            d = json.load(fh)
+            text = fh.read()
+        # the shared sniffer: JSONL / sample-array / one-line-sample
+        # files become timeline-only dumps; corrupt content raises
+        # instead of rendering as an empty (finding-free) dump
+        kind, d = _metrics_timeline.sniff_text(text, path=f)
+        if kind == "timeline":
+            d = {"timeline": d}
         d["_path"] = f
         dumps.append(d)
     return dumps
@@ -1104,7 +1143,11 @@ def main(argv=None):
     if not args.dump:
         print(_canonical.report())
         return 0
-    dumps = _canonical.load_dumps(args.dump)
+    try:
+        dumps = _canonical.load_dumps(args.dump)
+    except ValueError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 2
     if not dumps:
         # a directory argument can expand to zero *.json files
         print("no diag dumps found in: %s" % " ".join(args.dump),
@@ -1120,7 +1163,16 @@ def main(argv=None):
               % (ident.get("role", "?"), ident.get("rank", "?"),
                  data.get("pid", "?")))
     snap = data.get("snapshot", data)
+    tl = data.get("timeline")
+    tl_samples = (tl.get("samples") if isinstance(tl, dict) else tl) \
+        if tl else None
     if "ops" not in snap:
+        if tl_samples:
+            # a metrics JSONL file / timeline-only dump: just the series
+            from mxnet_tpu import metrics_timeline as _mt
+
+            print(_mt.render(tl_samples))
+            return 0
         # standalone flight-recorder dump (health.dump_flight / the
         # first-NaN auto-dump): render just the numerics section
         health = data.get("health") or snap.get("health") or {}
@@ -1137,6 +1189,11 @@ def main(argv=None):
         print("(no recompile storms recorded)")
     for name, keys in sorted(storms.items()):
         print("%-28s %s" % (name[:28], "; ".join(keys[-3:])))
+    if tl_samples:
+        from mxnet_tpu import metrics_timeline as _mt
+
+        print()
+        print(_mt.render(tl_samples))
     return 0
 
 

@@ -521,3 +521,43 @@ def test_disabled_stepstats_overhead_bound():
     snap = stepstats.snapshot()
     assert snap["steps"] == 0, "disabled hooks must record nothing"
     assert "phases" not in snap
+
+
+def test_disabled_metrics_timeline_overhead_bound():
+    """PR 10 gate: the live metrics timeline must be pay-for-use.  With
+    the timeline disabled (the default), ``metrics_timeline.on_step`` —
+    the hook ``gluon.Trainer.step`` guards with one dict read — is
+    itself ONE dict read: no clock, no sample dict, no counter deltas,
+    no file write.  Pinned like the other disabled-path bounds."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import metrics_timeline
+
+    if os.environ.get("MXNET_TPU_METRICS") \
+            or os.environ.get("MXNET_TPU_METRICS_PORT") \
+            or os.environ.get("MXNET_TPU_DIAG") \
+            or os.environ.get("MXNET_TPU_PROFILE"):
+        pytest.skip("metrics timeline active in this run")
+    assert not metrics_timeline.is_enabled()
+    # baseline, not absolute zero: an earlier in-process timeline user
+    # (the example, test_metrics_timeline) leaves a readable ring behind
+    before = metrics_timeline.snapshot()
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            metrics_timeline.on_step(32)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+    # the guard is one dict read (~0.1us); 10us tolerates slow shared
+    # CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "metrics_timeline.on_step with timeline off took %.2fus" \
+        % (best * 1e6)
+    after = metrics_timeline.snapshot()
+    assert after["samples"] == before["samples"], \
+        "disabled on_step must record nothing"
+    assert after["step"] == before["step"]

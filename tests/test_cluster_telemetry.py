@@ -299,13 +299,18 @@ def test_launch_rank_suffixes_observability_env(monkeypatch, tmp_path):
     monkeypatch.setenv("MXNET_TPU_DIAG", str(tmp_path / "diag.json"))
     monkeypatch.setenv("MXNET_TPU_HEALTH_DUMP",
                        str(tmp_path / "flight.json"))
+    monkeypatch.setenv("MXNET_TPU_METRICS",
+                       str(tmp_path / "metrics.jsonl"))
     monkeypatch.setenv("MXNET_TPU_HEALTH", "1")
+    monkeypatch.setenv("MXNET_TPU_METRICS_PORT", "9100")
     rc = launch.main(["-n", "2", "-s", "1", "python", "train.py"])
     assert rc == 0
     assert len(seen) == 3  # 1 server + 2 workers
     server_env = seen[0][1]
     assert server_env["MXNET_TPU_PROFILE"].endswith("trace.server0.json")
     assert server_env["MXNET_TPU_DIAG"].endswith("diag.server0.json")
+    assert server_env["MXNET_TPU_METRICS"].endswith(
+        "metrics.server0.jsonl")
     for rank in (0, 1):
         env = seen[1 + rank][1]
         assert env["DMLC_WORKER_ID"] == str(rank)
@@ -314,8 +319,13 @@ def test_launch_rank_suffixes_observability_env(monkeypatch, tmp_path):
         assert env["MXNET_TPU_DIAG"].endswith("diag.worker%d.json" % rank)
         assert env["MXNET_TPU_HEALTH_DUMP"].endswith(
             "flight.worker%d.json" % rank)
+        assert env["MXNET_TPU_METRICS"].endswith(
+            "metrics.worker%d.jsonl" % rank)
         # flag-valued vars propagate untouched
         assert env["MXNET_TPU_HEALTH"] == "1"
+        # port-valued vars too: one process per port is the operator's
+        # call (the JSONL export is the multi-rank path)
+        assert env["MXNET_TPU_METRICS_PORT"] == "9100"
 
 
 # ------------------------------------------------- merged chrome traces
@@ -339,23 +349,27 @@ def _spawn_profiled_worker(rank, trace_path):
 def test_rank_tagged_traces_merge(tmp_path):
     """Per-rank MXNET_TPU_PROFILE files carry rank-tagged pids + the
     mxtpu clock header, and merge_traces folds them into one trace
-    holding every rank's spans under labelled tracks."""
-    procs = [_spawn_profiled_worker(r, tmp_path / ("t%d.json" % r))
-             for r in (0, 1)]
+    holding every rank's spans under labelled tracks.  Both ranks get
+    the SAME env value (the un-launched multi-rank scenario): rank 0
+    keeps the plain path, rank 1 self-suffixes — no clobber."""
+    shared = tmp_path / "t.json"
+    procs = [_spawn_profiled_worker(r, shared) for r in (0, 1)]
     for p in procs:
         _, err = p.communicate(timeout=180)
         assert p.returncode == 0, err.decode()
-    d0 = json.load(open(tmp_path / "t0.json"))
+    rank1 = tmp_path / "t.worker1.json"
+    d0 = json.load(open(shared))
     assert d0["mxtpu"]["role"] == "worker" and d0["mxtpu"]["rank"] == 0
     assert d0["mxtpu"]["perf_anchor_us"] > 0
     assert {e["pid"] for e in d0["traceEvents"]} == {0}
-    d1 = json.load(open(tmp_path / "t1.json"))
+    d1 = json.load(open(rank1))
+    assert d1["mxtpu"]["rank"] == 1
     assert {e["pid"] for e in d1["traceEvents"]} == {1}
 
     from mxnet_tpu import profiler
 
     out = profiler.merge_traces(
-        [str(tmp_path / "t0.json"), str(tmp_path / "t1.json")],
+        [str(shared), str(rank1)],
         out=str(tmp_path / "merged.json"))
     m = json.load(open(out))
     pids = {e["pid"] for e in m["traceEvents"]}

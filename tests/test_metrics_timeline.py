@@ -1,0 +1,642 @@
+"""PR 10: the live metrics timeline + trend doctor.
+
+Pins the acceptance criteria:
+
+- a ~20-step Gluon loop produces a per-step ring AND an atomic JSONL
+  export that round-trips through the ``runtime_stats`` CLI and
+  ``tools/diagnose.py --timeline``;
+- an induced leak (growing retained NDArray list) plus an induced
+  mid-run slowdown (delayed io) produce a timeline where the doctor
+  ranks and names BOTH trends with slope / window-ratio evidence and a
+  concrete action, while a flat control run yields no trend findings;
+- the ``/metrics`` endpoint serves valid Prometheus text format while
+  a training loop runs, without draining health queues;
+- multi-process runs without launch.py self-suffix their output paths
+  (two-process pin) and launch.py rank-suffixes ``MXNET_TPU_METRICS``;
+- ``runtime_stats.compare`` accepts timeline-bearing dumps without
+  double-counting the per-step metrics (exit-code contract pinned).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import (autograd, device_memory, gluon, health, histogram,
+                       metrics_timeline, perfdoctor, runtime_stats,
+                       stepstats)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.log import rank_suffix_path
+from tests.conftest import hermetic_subprocess_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_timeline():
+    """Each test starts and ends with the timeline (and the layers its
+    enable() raises) off and empty."""
+    metrics_timeline.disable()
+    runtime_stats.reset()  # also resets stepstats/histograms/timeline
+    stepstats.disable()
+    histogram.disable()
+    yield
+    metrics_timeline.disable()
+    runtime_stats.reset()
+    stepstats.disable()
+    histogram.disable()
+    device_memory.stop()
+    device_memory.reset()
+    health.reset()
+
+
+def _train_loop(steps=20, batch=2, delay_io_after=None, delay=0.0,
+                retain=None):
+    """The canonical small Gluon loop: optionally delay the iterator
+    from batch ``delay_io_after`` on (the induced mid-run slowdown) and
+    retain one fresh NDArray per step in ``retain`` (the induced
+    leak)."""
+    net = nn.Dense(4)
+    net.initialize(ctx=mx.cpu())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    X = rs.rand(steps * batch, 6).astype(np.float32)
+    Y = rs.randint(0, 4, (steps * batch,)).astype(np.float32)
+
+    seen = [0]
+
+    class SlowIter(mx.io.NDArrayIter):
+        def next(self):
+            seen[0] += 1
+            if delay_io_after is not None and seen[0] > delay_io_after:
+                time.sleep(delay)
+            return super().next()
+
+    it = SlowIter(X, Y, batch_size=batch)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for b in it:
+        with autograd.record():
+            L = loss_fn(net(b.data[0]), b.label[0])
+        L.backward()
+        if retain is not None:
+            # the induced leak: ~256 KB of fresh device buffer retained
+            # per step, never released
+            retain.append(mx.nd.ones((256, 256)))
+        trainer.step(batch)
+    return trainer
+
+
+# ------------------------------------------------------------- sampling
+
+
+def test_ring_and_jsonl_roundtrip_real_loop(tmp_path, capsys):
+    """20-step loop: one ring sample per full step window, the same
+    records appended as whole JSONL lines, phase breakdown + throughput
+    present, and both CLIs render the file."""
+    path = tmp_path / "metrics.jsonl"
+    metrics_timeline.enable(path=str(path), interval=1)
+    _train_loop(steps=20)
+    samples = metrics_timeline.samples()
+    assert len(samples) == 19  # the first boundary only arms the clock
+    assert [s["step"] for s in samples] == list(range(2, 21))
+    last = samples[-1]
+    assert last["wall_ms"] > 0
+    assert last["throughput"] > 0
+    # enable() raised stepstats, so the phase window rides along
+    assert "phases_ms" in last and "unattributed" in last["phases_ms"]
+    assert "live_bytes" in last and "jit_entries" in last
+
+    lines = [json.loads(ln) for ln in
+             path.read_text().splitlines() if ln.strip()]
+    assert lines == samples  # every ring sample hit the file, in order
+
+    # runtime_stats CLI renders the JSONL timeline
+    rc = runtime_stats.main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Live metrics timeline (19 sample(s)" in out
+
+    # diagnose.py --timeline renders it too
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    assert diagnose.run_timeline(str(path)) == 0
+    assert "Live metrics timeline" in capsys.readouterr().out
+
+
+def test_jsonl_interval_downsamples_writes(tmp_path):
+    """MXNET_TPU_METRICS_INTERVAL thins the file, not the ring."""
+    path = tmp_path / "metrics.jsonl"
+    metrics_timeline.enable(path=str(path), interval=5)
+    _train_loop(steps=20)
+    assert len(metrics_timeline.samples()) == 19
+    lines = [json.loads(ln) for ln in
+             path.read_text().splitlines() if ln.strip()]
+    # steps 5/10/15/20 hit the interval boundary (step 1 armed the clock)
+    assert [s["step"] for s in lines] == [5, 10, 15, 20]
+
+
+def test_counter_deltas_are_windowed_not_cumulative():
+    """Cumulative counters become per-step rates: a compile storm in
+    one window lands in that window's sample only."""
+    metrics_timeline.enable()
+    _train_loop(steps=8)
+    x = mx.nd.ones((3, 3))
+    # churned attr -> fresh compiles inside ONE step window
+    for i in range(4):
+        mx.nd.clip(x, -1.0, 7000.0 + i)
+    tr = _train_loop(steps=4)
+    del tr
+    samples = metrics_timeline.samples()
+    storm = [s for s in samples if s.get("compiles", 0) >= 4]
+    assert storm, "the compile burst must appear in exactly one window"
+    total = sum(s.get("compiles", 0) for s in samples)
+    burst = max(s.get("compiles", 0) for s in samples)
+    assert burst >= 4
+    # windowed: later samples must not re-report the burst
+    assert total < 2 * burst + 8
+
+
+def test_kv_rtt_window_percentiles_are_deltas():
+    """The kv-RTT sample is a WINDOW over the shared cumulative
+    histogram: observations land in the step window they arrived in,
+    and a quiet window carries no kv section at all."""
+    metrics_timeline.enable()
+    metrics_timeline.on_step()  # arms the clock + baselines
+    for _ in range(8):
+        histogram.observe("kv:push_rtt:shard0", 0.001)
+    metrics_timeline.on_step()
+    for _ in range(8):
+        histogram.observe("kv:push_rtt:shard0", 0.016)
+    metrics_timeline.on_step()
+    metrics_timeline.on_step()
+    s1, s2, s3 = metrics_timeline.samples()
+    w1 = s1["kv_rtt_ms"]["kv:push_rtt:shard0"]
+    w2 = s2["kv_rtt_ms"]["kv:push_rtt:shard0"]
+    assert w1["count"] == 8 and w2["count"] == 8
+    # each window's percentiles reflect ITS observations (within one
+    # log2 bucket), not the cumulative distribution
+    assert w1["p99_ms"] <= 2.1
+    assert 8.0 <= w2["p99_ms"] <= 32.1
+    assert w2["mean_ms"] == pytest.approx(16.0, rel=0.01)
+    assert "kv_rtt_ms" not in s3  # quiet window: no kv section
+
+
+def test_disabled_on_step_records_nothing():
+    assert not metrics_timeline.is_enabled()
+    metrics_timeline.on_step(32)
+    assert metrics_timeline.samples() == []
+    assert metrics_timeline.snapshot()["samples"] == 0
+
+
+# ------------------------------------------------------ trend doctor
+
+
+def _flat(n=40, wall=10.0, **extra):
+    out = []
+    for i in range(2, 2 + n):
+        s = {"step": i, "wall_ms": wall + (0.2 if i % 3 else -0.2)}
+        s.update(extra)
+        out.append(s)
+    return out
+
+
+def test_trend_leak_ramp():
+    tl = [{"step": i, "wall_ms": 10.0,
+           "live_bytes": 10_000_000 + i * 65536,
+           "peak_bytes": 20_000_000} for i in range(2, 42)]
+    findings = perfdoctor.diagnose(timeline=tl)
+    leak = [f for f in findings if f["rule"] == "timeline-leak"]
+    assert len(leak) == 1
+    f = leak[0]
+    assert f["severity"] == "warn"
+    assert f["anchor"] == "live_bytes"
+    assert any("slope" in ev for ev in f["evidence"])
+    assert "per-op" in f["action"]
+
+
+def test_trend_throughput_regression_names_phase():
+    tl = []
+    for i in range(2, 42):
+        slow = i >= 22
+        tl.append({"step": i, "wall_ms": 30.0 if slow else 10.0,
+                   "throughput": 66.0 if slow else 200.0,
+                   "phases_ms": {"data_wait": 21.0 if slow else 1.0,
+                                 "forward": 4.0}})
+    findings = perfdoctor.diagnose(timeline=tl)
+    thr = [f for f in findings if f["rule"] == "timeline-throughput"]
+    assert len(thr) == 1
+    f = thr[0]
+    assert f["anchor"] == "phase:data_wait"
+    assert f["severity"] == "warn"
+    assert any("->" in ev and "ms" in ev for ev in f["evidence"])
+    assert any("throughput" in ev for ev in f["evidence"])
+    assert "data_wait" in f["action"]
+
+
+def test_trend_spike_train_periodicity_and_phase():
+    tl = []
+    for i in range(2, 42):
+        s = {"step": i, "wall_ms": 10.0,
+             "phases_ms": {"optimizer_update": 3.0}}
+        if i % 10 == 0:
+            s["wall_ms"] = 100.0
+            s["phases_ms"] = {"optimizer_update": 3.0,
+                              "checkpoint_write": 88.0}
+        tl.append(s)
+    findings = perfdoctor.diagnose(timeline=tl)
+    sp = [f for f in findings if f["rule"] == "timeline-spikes"]
+    assert len(sp) == 1
+    f = sp[0]
+    assert "every ~10 steps" in f["title"]
+    assert f["anchor"] == "phase:checkpoint_write"
+    assert any("periodic" in ev for ev in f["evidence"])
+
+
+def test_trend_kv_drift_names_shard():
+    tl = []
+    for i in range(2, 42):
+        p99 = 1.0 + (i * 0.2 if i >= 20 else 0.0)
+        tl.append({"step": i, "wall_ms": 10.0,
+                   "kv_rtt_ms": {
+                       "kv:push_rtt:shard0": {"p99_ms": 1.0, "count": 4},
+                       "kv:push_rtt:shard1": {"p99_ms": p99, "count": 4},
+                   }})
+    findings = perfdoctor.diagnose(timeline=tl)
+    kv = [f for f in findings if f["rule"] == "timeline-kv-drift"]
+    assert len(kv) == 1
+    assert kv[0]["anchor"] == "kv:push_rtt:shard1"
+    assert any("windowed p99" in ev for ev in kv[0]["evidence"])
+
+
+def test_trend_flat_control_is_silent():
+    findings = perfdoctor.diagnose(
+        timeline=_flat(live_bytes=10_000_000,
+                       phases_ms={"forward": 4.0}))
+    assert findings == []
+    # and too-short series never speak
+    assert perfdoctor.diagnose(timeline=_flat()[:4]) == []
+
+
+def test_trend_warmup_spikes_exempt():
+    """Early compile/allocator spikes (the first samples) must not read
+    as a spike train."""
+    tl = _flat(36)
+    tl[0]["wall_ms"] = 200.0
+    tl[1]["wall_ms"] = 150.0
+    assert [f for f in perfdoctor.diagnose(timeline=tl)
+            if f["rule"] == "timeline-spikes"] == []
+
+
+def test_acceptance_leak_and_slowdown_vs_control(tmp_path):
+    """The PR's acceptance run: an induced leak + an induced mid-run io
+    slowdown produce a timeline where the doctor ranks and names both
+    trends with evidence; the flat control run yields none."""
+    device_memory.start()
+    metrics_timeline.enable()
+    retained = []
+    _train_loop(steps=40, delay_io_after=24, delay=0.05,
+                retain=retained)
+    tl = metrics_timeline.samples()
+    assert len(tl) == 39
+    findings = perfdoctor.diagnose(timeline=tl)
+    rules = [f["rule"] for f in findings]
+    assert "timeline-leak" in rules
+    assert "timeline-throughput" in rules
+    leak = next(f for f in findings if f["rule"] == "timeline-leak")
+    assert any("slope" in ev for ev in leak["evidence"])
+    thr = next(f for f in findings
+               if f["rule"] == "timeline-throughput")
+    assert any(re.search(r"\d+\.\d+x", ev) for ev in thr["evidence"])
+    assert thr["action"]
+    # the slowdown is io: with stepstats on, the doctor names the phase
+    assert thr["anchor"] == "phase:data_wait"
+    del retained
+
+    # control: same loop, no leak, no delay -> no trend findings
+    metrics_timeline.disable()
+    runtime_stats.reset()
+    device_memory.reset()
+    device_memory.start()
+    metrics_timeline.enable()
+    _train_loop(steps=40)
+    control = perfdoctor.diagnose(timeline=metrics_timeline.samples())
+    assert [f for f in control
+            if f["rule"].startswith("timeline-")] == []
+
+
+def test_doctor_reads_jsonl_and_embedded_dump(tmp_path, capsys):
+    """The same trends rank from a JSONL file (classify -> timeline)
+    and from a diag dump embedding the ring; --format github emits
+    ::error lines for a warn-severity trend."""
+    leak = [{"step": i, "wall_ms": 10.0,
+             "live_bytes": 10_000_000 + i * 65536}
+            for i in range(2, 42)]
+    jsonl = tmp_path / "metrics.jsonl"
+    jsonl.write_text("".join(json.dumps(s) + "\n" for s in leak))
+    kind, data = perfdoctor.classify(str(jsonl))
+    assert kind == "timeline"
+    assert [f["rule"] for f in perfdoctor.diagnose(
+        timeline=data["samples"])] == ["timeline-leak"]
+
+    # the CLI path: a JSONL operand to --doctor, github annotations
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    rc = diagnose.run_doctor([str(jsonl)], fmt="github")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "timeline-leak" in out
+    assert "::error::perf-doctor[timeline-leak]" in out
+    # two timelines -> explicit refusal, not silent last-wins
+    assert diagnose.run_doctor([str(jsonl), str(jsonl)]) == 2
+    capsys.readouterr()
+
+    # embedded in a diag dump: dump_diag attaches the live ring
+    metrics_timeline.enable()
+    _train_loop(steps=10)
+    dump_path = runtime_stats.dump_diag(str(tmp_path / "diag.json"))
+    dump = json.load(open(dump_path))
+    assert len(dump["timeline"]["samples"]) == 9
+    # and diagnose(dump=...) picks the embedded timeline up by itself
+    kind, data = perfdoctor.classify(dump_path)
+    assert kind == "dump"
+    findings = perfdoctor.diagnose(dump=data)
+    assert isinstance(findings, list)  # trend rules ran (flat: none)
+    assert [f for f in findings
+            if f["rule"].startswith("timeline-")] == []
+
+
+def test_one_line_jsonl_and_corrupt_inputs(tmp_path, capsys):
+    """A one-line JSONL file (valid JSON on its own) still routes as a
+    timeline everywhere, and a corrupt file errors (rc 2) instead of
+    reading as a finding-free clean run."""
+    one = tmp_path / "one.jsonl"
+    one.write_text(json.dumps({"step": 5, "wall_ms": 10.0}) + "\n")
+    kind, data = perfdoctor.classify(str(one))
+    assert kind == "timeline" and len(data["samples"]) == 1
+    assert metrics_timeline.load(str(one)) == [{"step": 5,
+                                                "wall_ms": 10.0}]
+    assert runtime_stats.main([str(one)]) == 0
+    assert "1 sample(s)" in capsys.readouterr().out
+
+    bad = tmp_path / "corrupt.json"
+    bad.write_text('{"snapshot": {"ops":')  # torn dump
+    with pytest.raises(ValueError):
+        perfdoctor.classify(str(bad))
+    assert runtime_stats.main([str(bad)]) == 2
+    capsys.readouterr()
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    assert diagnose.run_doctor([str(bad)]) == 2
+    assert "neither JSON" in capsys.readouterr().err
+
+    # scalar-per-line garbage is NOT a timeline (every loader agrees)
+    scalars = tmp_path / "scalars.jsonl"
+    scalars.write_text("1\n2\n3\n")
+    with pytest.raises(ValueError):
+        perfdoctor.classify(str(scalars))
+    with pytest.raises(ValueError):
+        metrics_timeline.load(str(scalars))
+    assert runtime_stats.main([str(scalars)]) == 2
+    assert diagnose.run_doctor([str(scalars)]) == 2
+    assert diagnose.run_timeline(str(scalars)) == 2
+    # a missing file errors cleanly too (no raw traceback)
+    assert diagnose.run_timeline(str(tmp_path / "nope.jsonl")) == 2
+    capsys.readouterr()
+
+
+def test_diag_embed_caps_ring_tail():
+    """A diag dump embeds the newest EMBED_TAIL samples, not the whole
+    ring — the MXNET_TPU_DIAG_PUSH payload stays bounded."""
+    metrics_timeline.enable()
+    metrics_timeline.on_step()  # arm
+    for _ in range(metrics_timeline.EMBED_TAIL + 40):
+        metrics_timeline.on_step(8)
+    assert len(metrics_timeline.samples()) \
+        == metrics_timeline.EMBED_TAIL + 40
+    tl = metrics_timeline.timeline()
+    assert len(tl["samples"]) == metrics_timeline.EMBED_TAIL
+    # the newest samples survive the cap
+    assert tl["samples"][-1]["step"] \
+        == metrics_timeline.snapshot()["step"]
+
+
+# ------------------------------------------------- compare() contract
+
+
+def test_compare_timeline_dumps_no_double_count(tmp_path):
+    """A timeline-bearing dump compares flat against itself, none of
+    the compared metrics come from the timeline section, and the CLI
+    exit-code contract holds (0 flat / 1 regression / 2 usage)."""
+    metrics_timeline.enable()
+    _train_loop(steps=10)
+    a_path = runtime_stats.dump_diag(str(tmp_path / "a.json"))
+    a, b = runtime_stats.load_dumps([a_path, a_path])
+    result = runtime_stats.compare(a, b)
+    assert result["verdict"] == "flat"
+    assert not result["regressions"] and not result["improvements"]
+    ma = runtime_stats._comparable_metrics(a, 1e-3)
+    assert not any("timeline" in m for m in ma)
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    assert diagnose.run_compare(a_path, a_path) == 0
+
+
+def test_compare_rejects_timeline_operands(tmp_path, capsys):
+    """Two metrics JSONL files have no comparable counter sections —
+    --compare must refuse (rc 2), never report a vacuous 'flat'."""
+    jsonl = tmp_path / "m.jsonl"
+    jsonl.write_text(json.dumps({"step": 2, "wall_ms": 10.0}) + "\n")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    assert diagnose.run_compare(str(jsonl), str(jsonl)) == 2
+    assert "metrics timeline" in capsys.readouterr().err
+
+
+def test_malformed_port_env_keeps_ring(monkeypatch):
+    """A typo'd MXNET_TPU_METRICS_PORT warns and drops only the
+    endpoint — the timeline the user asked for still records."""
+    monkeypatch.setenv("MXNET_TPU_METRICS_PORT", "9100x")
+    monkeypatch.delenv("MXNET_TPU_METRICS", raising=False)
+    assert metrics_timeline._activate_from_env() is True
+    assert metrics_timeline.is_enabled()
+    assert metrics_timeline.server_port() is None
+
+
+def test_write_failure_warns_and_disables_export(tmp_path):
+    """A mid-run write failure (disk full, dead fd) disables the JSONL
+    export with a warning instead of silently stalling the file; the
+    ring keeps sampling."""
+    path = tmp_path / "m.jsonl"
+    metrics_timeline.enable(path=str(path), interval=1)
+    metrics_timeline.on_step()  # arm
+    metrics_timeline.on_step(4)
+    assert metrics_timeline.snapshot()["written"] == 1
+
+    class _DeadFile:
+        def write(self, _s):
+            raise OSError(28, "No space left on device")
+
+        def close(self):
+            pass
+
+    metrics_timeline._cur["writer"] = _DeadFile()
+    metrics_timeline.on_step(4)
+    assert metrics_timeline._cur["path"] is None  # export disabled
+    metrics_timeline.on_step(4)  # no crash, ring still sampling
+    assert len(metrics_timeline.samples()) == 3
+    assert metrics_timeline.snapshot()["written"] == 1
+
+
+# ------------------------------------------------- Prometheus endpoint
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? (NaN|[+-]?Inf|[-+0-9.eE]+)$")
+
+
+def test_metrics_endpoint_serves_valid_prometheus_text():
+    """/metrics answers with parseable Prometheus text while a loop
+    runs, carries the counter/gauge/summary families, and never drains
+    the health monitor's pending queue."""
+    histogram.enable()
+    metrics_timeline.enable()
+    srv = metrics_timeline.serve(port=0, host="127.0.0.1")
+    try:
+        port = metrics_timeline.server_port()
+        assert port and port == srv.server_address[1]
+        assert srv.server_address[0] == "127.0.0.1"  # host= honored
+        mon = health.enable()
+        _train_loop(steps=10)
+        mon.observe("endpoint_probe", mx.nd.ones((3, 3)))
+        pending = len(mon._pending)
+        assert pending >= 1
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=10
+        ).read().decode()
+        assert len(mon._pending) == pending, \
+            "a scrape must never drain health queues"
+        for ln in body.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            assert _PROM_LINE.match(ln), "invalid exposition line: %r" % ln
+        assert "# TYPE mxnet_tpu_trainer_steps_total counter" in body
+        assert "mxnet_tpu_trainer_steps_total 10" in body
+        assert "# TYPE mxnet_tpu_device_live_bytes gauge" in body
+        assert "mxnet_tpu_step_duration_seconds" in body
+        assert "# TYPE mxnet_tpu_latency_seconds summary" in body
+        assert 'series="trainer:step",quantile="0.99"' in body
+        assert 'mxnet_tpu_latency_seconds_count{series="trainer:step"} 10' \
+            in body
+        assert re.search(
+            r'mxnet_tpu_step_phase_seconds\{phase="forward"\}', body)
+        # only /metrics (and /) are served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/secrets" % port, timeout=10)
+        assert ei.value.code == 404
+    finally:
+        metrics_timeline.stop_server()
+
+
+# --------------------------------------------- multi-process suffixing
+
+
+def test_rank_suffix_path_unit(monkeypatch):
+    for var in ("DMLC_ROLE", "DMLC_WORKER_ID", "MXTPU_PS_SERVER_ID",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert rank_suffix_path("/tmp/m.jsonl") == "/tmp/m.jsonl"
+    assert rank_suffix_path(None) is None
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    assert rank_suffix_path("/tmp/m.jsonl") == "/tmp/m.jsonl"
+    monkeypatch.setenv("DMLC_WORKER_ID", "3")
+    assert rank_suffix_path("/tmp/m.jsonl") == "/tmp/m.worker3.jsonl"
+    # idempotent: a launch.py-suffixed path passes through — with and
+    # without an extension (extension-less values put the launcher's
+    # token in splitext's ext slot)
+    assert rank_suffix_path("/tmp/m.worker3.jsonl") \
+        == "/tmp/m.worker3.jsonl"
+    assert rank_suffix_path("/tmp/m.worker3") == "/tmp/m.worker3"
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("MXTPU_PS_SERVER_ID", "0")
+    # servers always suffix: their rank space is separate from workers'
+    assert rank_suffix_path("/tmp/m.jsonl") == "/tmp/m.server0.jsonl"
+
+
+def test_dump_diag_env_fallback_self_suffixes(tmp_path, monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "3")
+    monkeypatch.setenv("MXNET_TPU_DIAG", str(tmp_path / "diag.json"))
+    path = runtime_stats.dump_diag()
+    assert path.endswith("diag.worker3.json")
+    # explicit paths stay verbatim
+    explicit = runtime_stats.dump_diag(str(tmp_path / "mine.json"))
+    assert explicit.endswith("mine.json")
+
+
+def test_two_process_metrics_self_suffix(tmp_path):
+    """Two ranks sharing one MXNET_TPU_METRICS value WITHOUT launch.py:
+    rank 0 keeps the plain path, rank 1 self-suffixes — no clobber."""
+    shared = tmp_path / "metrics.jsonl"
+    script = (
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import autograd, gluon\n"
+        "net = gluon.nn.Dense(2)\n"
+        "net.initialize()\n"
+        "loss_fn = gluon.loss.L2Loss()\n"
+        "tr = gluon.Trainer(net.collect_params(), 'sgd',"
+        " {'learning_rate': 0.1})\n"
+        "x = mx.nd.ones((2, 3)); y = mx.nd.ones((2, 2))\n"
+        "for _ in range(4):\n"
+        "    with autograd.record():\n"
+        "        L = loss_fn(net(x), y)\n"
+        "    L.backward(); tr.step(2)\n"
+        "from mxnet_tpu import metrics_timeline\n"
+        "assert metrics_timeline.snapshot()['written'] >= 3\n"
+    )
+    procs = []
+    for rank in (0, 1):
+        env = hermetic_subprocess_env(REPO)
+        env.update({"MXNET_TPU_METRICS": str(shared),
+                    "DMLC_ROLE": "worker",
+                    "DMLC_WORKER_ID": str(rank)})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for p in procs:
+        _, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err.decode()
+    rank1 = tmp_path / "metrics.worker1.jsonl"
+    assert shared.exists() and rank1.exists()
+    for f in (shared, rank1):
+        lines = [json.loads(ln) for ln in
+                 f.read_text().splitlines() if ln.strip()]
+        assert len(lines) >= 3
+        assert all(s["wall_ms"] > 0 for s in lines)

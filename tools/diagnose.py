@@ -58,10 +58,16 @@ def parse_args():
                    help='Perf doctor: rank bottlenecks (idle gaps, recompile '
                         'storms, data wait, host syncs, roofline headroom, '
                         'shard stragglers, dead-shard / duplicate-'
-                        'suppression incidents) from a chrome trace and/or '
-                        'a MXNET_TPU_DIAG dump, with evidence and a next '
-                        'action per finding.  Files are classified by '
-                        'content; pass both kinds for full coverage.')
+                        'suppression incidents) and timeline trends (leaks, '
+                        'throughput decay, step-time spikes, kv-RTT drift) '
+                        'from a chrome trace, a MXNET_TPU_DIAG dump, and/or '
+                        'a MXNET_TPU_METRICS timeline, with evidence and a '
+                        'next action per finding.  Files are classified by '
+                        'content; pass several kinds for full coverage.')
+    p.add_argument('--timeline', metavar='FILE',
+                   help='Render a MXNET_TPU_METRICS JSONL timeline (or a '
+                        'diag dump embedding one) as a per-step table; '
+                        'trend analysis runs via --doctor.')
     p.add_argument('--compare', nargs=2, metavar=('A', 'B'),
                    help='Dump-diff regression report: diff two diag dumps '
                         '(baseline A vs candidate B) — step-anatomy phases, '
@@ -256,7 +262,11 @@ def check_cluster(paths):
     _section('Cluster Telemetry')
     from mxnet_tpu import runtime_stats
     runtime_stats._DIAG_STATE['armed'] = False
-    dumps = runtime_stats.load_dumps(paths)
+    try:
+        dumps = runtime_stats.load_dumps(paths)
+    except ValueError as e:
+        print('error: %s' % e, file=sys.stderr)
+        return
     if not dumps:
         print('no diag dumps found in: %s' % ' '.join(paths))
         return
@@ -278,15 +288,26 @@ def run_doctor(paths, top=20, fmt='text', as_json=False):
 
     from mxnet_tpu import perfdoctor, runtime_stats
     runtime_stats._DIAG_STATE['armed'] = False
-    trace = dump = None
+    trace = dump = timeline = None
     for p in paths:
-        kind, data = perfdoctor.classify(p)
+        try:
+            kind, data = perfdoctor.classify(p)
+        except (ValueError, OSError) as e:
+            print('error: %s' % e, file=sys.stderr)
+            return 2
         if kind == 'trace':
             if trace is not None:
                 print('error: --doctor takes at most one chrome trace '
                       '(got a second: %s)' % p, file=sys.stderr)
                 return 2
             trace = data
+        elif kind == 'timeline':
+            if timeline is not None:
+                print('error: --doctor takes at most one metrics '
+                      'timeline (got a second: %s)' % p,
+                      file=sys.stderr)
+                return 2
+            timeline = data
         else:
             if dump is not None:
                 print('error: --doctor takes at most one diag dump '
@@ -294,7 +315,8 @@ def run_doctor(paths, top=20, fmt='text', as_json=False):
                       '--cluster' % p, file=sys.stderr)
                 return 2
             dump = data
-    findings = perfdoctor.diagnose(trace=trace, dump=dump, top=top)
+    findings = perfdoctor.diagnose(trace=trace, dump=dump,
+                                   timeline=timeline, top=top)
     if as_json:
         print(_json.dumps(findings, indent=1))
     else:
@@ -318,7 +340,20 @@ def run_compare(a_path, b_path, threshold=0.2, fmt='text',
             print('error: --compare diffs exactly two dump FILES '
                   '(%s is a directory)' % p, file=sys.stderr)
             return 2
-    dumps = runtime_stats.load_dumps([a_path, b_path])
+    try:
+        dumps = runtime_stats.load_dumps([a_path, b_path])
+    except ValueError as e:
+        print('error: %s' % e, file=sys.stderr)
+        return 2
+    for p, d in zip((a_path, b_path), dumps):
+        if 'timeline' in d and 'snapshot' not in d and 'ops' not in d:
+            # a metrics JSONL / sample-array operand has no comparable
+            # counter sections: comparing would report a vacuous
+            # 'flat' (rc 0) no matter how badly perf moved
+            print('error: --compare diffs diag DUMPS; %s is a metrics '
+                  'timeline (trend analysis: --doctor)' % p,
+                  file=sys.stderr)
+            return 2
     result = runtime_stats.compare(dumps[0], dumps[1],
                                    threshold=threshold)
     if as_json:
@@ -345,18 +380,41 @@ def run_compare(a_path, b_path, threshold=0.2, fmt='text',
     return 1 if result['regressions'] else 0
 
 
+def run_timeline(path):
+    """Per-step table of a metrics timeline (JSONL file, JSON sample
+    array, or a diag dump embedding a ``timeline`` section)."""
+    _section('Metrics Timeline')
+    from mxnet_tpu import metrics_timeline, runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    try:
+        samples = metrics_timeline.load(path)
+    except (ValueError, OSError) as e:
+        print('error: %s' % e, file=sys.stderr)
+        return 2
+    if not samples:
+        print('no timeline samples in: %s' % path, file=sys.stderr)
+        return 2
+    print(metrics_timeline.render(samples))
+    return 0
+
+
 def main():
     args = parse_args()
-    if args.doctor or args.compare:
-        # focused analysis views: skip the platform sections
+    if args.timeline or args.doctor or args.compare:
+        # focused analysis views: skip the platform sections; the
+        # flags chain and the WORST exit code wins (2 usage > 1
+        # regression > 0), so --timeline never swallows a gate and a
+        # usage error is never misreported as a perf regression
         rc = 0
+        if args.timeline:
+            rc = max(rc, run_timeline(args.timeline))
         if args.doctor:
-            rc = run_doctor(args.doctor, top=args.top, fmt=args.format,
-                            as_json=args.json) or rc
+            rc = max(rc, run_doctor(args.doctor, top=args.top,
+                                    fmt=args.format, as_json=args.json))
         if args.compare:
-            rc = run_compare(args.compare[0], args.compare[1],
-                             threshold=args.threshold, fmt=args.format,
-                             as_json=args.json) or rc
+            rc = max(rc, run_compare(args.compare[0], args.compare[1],
+                                     threshold=args.threshold,
+                                     fmt=args.format, as_json=args.json))
         sys.exit(rc)
     if args.cluster or args.merge_traces:
         # focused distributed-telemetry views: skip the platform sections

@@ -49,10 +49,10 @@ def free_port():
 # observability env vars whose value is a FILE PATH: every spawned
 # process gets its own rank-suffixed copy, so a distributed run is
 # traceable end-to-end without manual env plumbing (per-rank trace /
-# diag-dump / flight-dump files merge later via
-# `tools/diagnose.py --cluster` / `--merge-traces`)
+# diag-dump / flight-dump / metrics-JSONL files merge later via
+# `tools/diagnose.py --cluster` / `--merge-traces` / `--timeline`)
 _PATH_ENVS = ("MXNET_TPU_PROFILE", "MXNET_TPU_DIAG",
-              "MXNET_TPU_HEALTH_DUMP")
+              "MXNET_TPU_HEALTH_DUMP", "MXNET_TPU_METRICS")
 
 
 def rank_suffix_observability(env, role, rank):
