@@ -34,6 +34,11 @@ Gating compares against the newest recorded BENCH_r*.json (falling back
 to the committed r4 floor for device_value) and exits non-zero.
 
 Usage: python bench.py [batch] [steps] [NHWC|NCHW]
+       python bench.py --compiled-step [batch] [steps] [image]
+           (or MXNET_TPU_COMPILED_STEP=1): eager Trainer loop vs the
+           fused whole-step program on the same model/seed — emits
+           before/after diag dumps + one runtime_stats.compare()
+           verdict (docs/COMPILED_STEP.md; record goes to BENCH_NOTES).
 """
 
 import glob
@@ -217,7 +222,188 @@ def emit_wedged_record(batch, layout):
           file=sys.stderr)
 
 
+def run_compiled_compare(batch=8, steps=6, image=64, layout="NHWC",
+                         net_fn=None, out_prefix="bench_compiled",
+                         data_shape=None, num_classes=1000):
+    """``--compiled-step`` mode: eager Trainer loop vs the fused
+    whole-step program (mxnet_tpu/compiled_step.py) on the same model,
+    seed, and synthetic data — the ROADMAP's one-``--compare``-run
+    contract for perf PRs.
+
+    Runs each side with stepstats/diag timing on, resets the counters
+    after a warmup step, dumps both diag snapshots
+    (``<out_prefix>.eager.diag.json`` / ``.fused.diag.json``), prints
+    ``runtime_stats.compare()``'s verdict (note: the new
+    ``phase:compiled_step`` / ``op:compiled_step`` rows on the fused
+    side read as 0→inf "new cost" entries by compare()'s documented
+    semantics — the wall/dispatch rows carry the actual before/after)
+    plus one machine-readable JSON line, and returns (rc, record):
+    rc 0 iff the losses match and the fused side shows BOTH the
+    warm-dispatch collapse to ~1 call/step AND a step-wall
+    improvement.  ``net_fn(`` builds a fresh identically-seeded model
+    (defaults to the bench ResNet-50)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import random as mxrandom
+    from mxnet_tpu import runtime_stats as rts
+    from mxnet_tpu import stepstats
+
+    stepstats.enable()
+
+    def default_net():
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        net = vision.resnet50_v1(layout=layout)
+        probe = (1, 3, 32, 32) if layout == "NCHW" else (1, 32, 32, 3)
+        net.initialize(ctx=mx.cpu() if not mx.context.num_tpus()
+                       else mx.tpu())
+        net(mx.nd.zeros(probe))
+        return net
+
+    build = net_fn or default_net
+    if data_shape is None:
+        data_shape = (batch, 3, image, image) if layout == "NCHW" \
+            else (batch, image, image, 3)
+    rng = np.random.RandomState(0)
+    xs = [rng.rand(*data_shape).astype(np.float32)
+          for _ in range(steps + 1)]
+    ys = [rng.randint(0, num_classes, (batch,)).astype(np.int32)
+          for _ in range(steps + 1)]
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def fresh(seed=7):
+        mxrandom.seed(seed)
+        np.random.seed(seed)
+        return build()
+
+    def steady_anatomy():
+        snap = rts.snapshot()
+        ss = snap.get("stepstats") or {}
+        n = ss.get("steps") or 1
+        wall = ((ss.get("wall") or {}).get("sum") or 0.0) / n * 1e3
+        # per-step RATES divide by the counted steps, not the stepstats
+        # window count: the first end_step after reset() only arms the
+        # clock, so windows = steps-1 and using it would inflate the
+        # headline dispatches/step by N/(N-1)
+        steps = (snap.get("counters") or {}).get("trainer_steps") or 1
+        warm = (snap.get("totals") or {}).get("jit_cache_hits", 0) / steps
+        return snap, wall, warm
+
+    # ---- eager side ---------------------------------------------------
+    net = fresh()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "wd": 1e-4})
+    losses_eager = []
+
+    def eager_step(x, y):
+        xa, ya = mx.nd.array(x), mx.nd.array(y)
+        with autograd.record():
+            l = loss_fn(net(xa), ya)
+        l.backward()
+        trainer.step(batch)
+        return l
+
+    eager_step(xs[0], ys[0])  # warmup: compiles land before the window
+    rts.reset()
+    for x, y in zip(xs[1:], ys[1:]):
+        losses_eager.append(eager_step(x, y))
+    # capture the dump BEFORE the loss fetches: the readback means are
+    # measurement overhead, not part of the measured loop
+    eager_dump, eager_wall, eager_warm = steady_anatomy()
+    eager_path = out_prefix + ".eager.diag.json"
+    rts.dump_diag(eager_path)
+    losses_eager = [float(np.asarray(l.mean().data_jax))
+                    for l in losses_eager]
+
+    # ---- fused side ---------------------------------------------------
+    rts.reset()
+    net = fresh()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "wd": 1e-4})
+    cs = trainer.compile(net, loss_fn)
+    cs.step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))  # warmup: build+compile
+    rts.reset()
+    losses_fused = []
+    for x, y in zip(xs[1:], ys[1:]):
+        losses_fused.append(cs.step(mx.nd.array(x), mx.nd.array(y)))
+    fused_dump, fused_wall, fused_warm = steady_anatomy()
+    fused_path = out_prefix + ".fused.diag.json"
+    rts.dump_diag(fused_path)
+    losses_fused = [float(np.asarray(l.mean().data_jax))
+                    for l in losses_fused]
+
+    # ---- verdict ------------------------------------------------------
+    result = rts.compare(eager_dump, fused_dump)
+    print(rts.render_compare(result), file=sys.stderr)
+    # step 1 ran the same function on the same init: near-bit-equal.
+    # later steps drift in the last float ulps (the fused program's
+    # XLA autodiff reassociates conv-backward reductions vs the
+    # per-op tape) and training amplifies it — trajectory-level
+    # tolerance, not bit equality, is the right check there.
+    losses_match = bool(
+        np.allclose(losses_eager[:1], losses_fused[:1], rtol=1e-5)
+        and np.allclose(losses_eager, losses_fused, rtol=5e-2))
+    import jax
+
+    ok = losses_match and fused_warm <= 2.0 and fused_wall < eager_wall
+    record = {
+        "metric": "compiled_step eager-vs-fused (bs=%d, data %s, %d "
+                  "steps, same seed)" % (batch, list(data_shape[1:]),
+                                         steps),
+        "verdict": "improvement" if ok else "regression",
+        # raw compare() verdict: the fused side's NEW
+        # phase:compiled_step / op:compiled_step rows read as 0->inf
+        # entries by its documented new-cost semantics — the wall /
+        # dispatch / per-phase rows carry the real before/after
+        "compare_verdict": result["verdict"],
+        "step_wall_ms": {"eager": round(eager_wall, 3),
+                         "fused": round(fused_wall, 3)},
+        "warm_dispatches_per_step": {"eager": round(eager_warm, 1),
+                                     "fused": round(fused_warm, 1)},
+        "losses_match": losses_match,
+        "dumps": [eager_path, fused_path],
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(record))
+    if not ok:
+        print("compiled-step compare FAILED: losses_match=%s "
+              "fused_warm=%.1f/step fused_wall=%.3fms vs eager "
+              "%.3fms" % (losses_match, fused_warm, fused_wall,
+                          eager_wall), file=sys.stderr)
+    return (0 if ok else 1), record
+
+
 def main():
+    if "--compiled-step" in sys.argv or \
+            os.environ.get("MXNET_TPU_COMPILED_STEP") == "1":
+        # tolerate BOTH argv shapes: the compare form
+        # `--compiled-step [batch] [steps] [image]` and the standard
+        # `bench.py [batch] [steps] [NHWC|NCHW]` that launch wiring
+        # uses with MXNET_TPU_COMPILED_STEP=1 — a layout token selects
+        # the layout instead of crashing int() (and NCHW is compared
+        # as NCHW)
+        layout = "NHWC"
+        nums = []
+        for a in sys.argv[1:]:
+            if a == "--compiled-step":
+                continue
+            if a in ("NHWC", "NCHW"):
+                layout = a
+            else:
+                nums.append(int(a))
+        batch = nums[0] if len(nums) > 0 else 8
+        steps = nums[1] if len(nums) > 1 else 6
+        image = nums[2] if len(nums) > 2 else 64
+        if not probe_relay():
+            emit_wedged_record(batch, layout)
+            return
+        rc, _rec = run_compiled_compare(batch=batch, steps=steps,
+                                        image=image, layout=layout)
+        sys.exit(rc)
     batch_arg = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     layout_arg = sys.argv[3] if len(sys.argv) > 3 else "NHWC"
     if not probe_relay():

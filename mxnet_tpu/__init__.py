@@ -68,7 +68,7 @@ _OPTIONAL = [
     "parallel", "contrib", "model", "image", "operator", "monitor",
     "executor_manager", "rtc", "engine", "predictor", "rnn", "log",
     "util", "name", "attribute", "runtime_stats", "device_memory",
-    "health", "checkpoint", "metrics_timeline",
+    "health", "checkpoint", "metrics_timeline", "compiled_step",
 ]
 
 

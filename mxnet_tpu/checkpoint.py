@@ -306,13 +306,28 @@ class CheckpointManager:
         self._prune_stale_tmp()
 
     # ------------------------------------------------------------ save
-    def save_trainer(self, trainer, step=None, extra=None):
+    def save_trainer(self, trainer, step=None, extra=None, pin=False):
         """Snapshot a ``gluon.Trainer``'s complete resumable unit —
         parameters, updater state, optimizer hyper-state, RNG, step —
         without blocking: device buffers are captured by reference
         (immutable under XLA; in-place writes rebind), everything else
-        is host scalars.  Returns immediately in async mode."""
+        is host scalars.  Returns immediately in async mode.
+
+        ``pin=True`` materializes the captured buffers to host BEFORE
+        returning (one batched transfer on the calling thread): the
+        compiled whole-step path (compiled_step.py) DONATES the param /
+        optimizer buffers into its next program call, which would
+        invalidate by-reference captures before the background writer
+        reads them — pinning trades one bounded sync per checkpoint
+        interval for a snapshot donation cannot corrupt.  Pinning also
+        engages AUTOMATICALLY once any CompiledStep has stepped in this
+        process (``compiled_step.donation_active``), so a manual
+        ``save_trainer`` or a mixed eager/compiled loop can never hand
+        the writer buffers a later compiled step deletes."""
+        from . import compiled_step as _compiled
         from . import random as _random
+
+        pin = pin or _compiled.donation_active()
 
         step = self.step_clock if step is None else int(step)
         params = {}
@@ -331,6 +346,8 @@ class CheckpointManager:
                     "trainer": trainer_state,
                     "rng": dict(_random.get_state()),
                     "extra": extra}
+        if pin:
+            _materialize(snapshot)
         return self._submit(snapshot)
 
     def save(self, step, params, extra=None, aux=None):
@@ -809,10 +826,15 @@ def manager():
     return _GLOBAL[0] if _state["on"] and _GLOBAL else None
 
 
-def on_step(trainer):
+def on_step(trainer, pin=False):
     """``Trainer.step`` hook: advance the global manager's step clock
     and auto-save at interval boundaries.  ONE dict read when disabled
     (the default) — safe on the hot path.
+
+    ``pin=True`` (the compiled-step path) materializes each snapshot
+    at capture: the whole-step program donates the param/optimizer
+    buffers on the next call, so by-reference captures must be brought
+    to host before then (``save_trainer``'s pin contract).
 
     The global clock assumes ONE Trainer drives the run (the reference
     training-loop shape).  Multi-trainer setups (e.g. GANs) should
@@ -832,7 +854,7 @@ def on_step(trainer):
         ss_on = _stepstats._state["on"]
         if ss_on:
             ss_tok = _stepstats.begin()
-        mgr.save_trainer(trainer, step=mgr.step_clock)
+        mgr.save_trainer(trainer, step=mgr.step_clock, pin=pin)
         if ss_on:
             _stepstats.end("checkpoint_write", ss_tok)
 

@@ -40,7 +40,8 @@ from ..ndarray import NDArray
 from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
                         param_override)
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "is_staging"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "is_staging",
+           "staged_call"]
 
 
 class _BlockScope:
@@ -379,6 +380,30 @@ def is_staging():
     return _StagingScope.current() is not None
 
 
+def staged_call(block, override, seed, args, train=True):
+    """Run ``block(*args)`` under a fresh staging scope with parameter
+    overrides and a traced RNG: the one idiom every whole-graph tracer
+    shares (``parallel/gluon_step.py``'s SPMD step builder and
+    ``compiled_step.py``'s whole-step program).
+
+    ``block`` is any callable over NDArrays (a Block, or a closure
+    composing forward + loss); ``override`` maps Parameter -> NDArray
+    (typically tracer-backed); ``seed`` is a traced PRNG key (or None
+    to keep the ambient RNG); ``args`` are NDArray inputs.  Returns
+    ``(out, scope)`` where ``scope.aux_updates`` holds the traced
+    auxiliary-state updates (BatchNorm running stats) collected during
+    the call."""
+    from .. import random as _rand
+
+    scope = _StagingScope()
+    mode = autograd.train_mode() if train else autograd.predict_mode()
+    with param_override(override), scope, \
+            (_rand.TraceRNG(seed) if seed is not None else _nullctx()), \
+            mode:
+        out = block(*args)
+    return out, scope
+
+
 def update_aux_state(param, new_value):
     """Write an auxiliary state (running stat): eager write normally,
     traced side-output inside a staged graph."""
@@ -425,9 +450,19 @@ class _CachedGraph:
         def bwd(pvals, avals, seed, cts):
             # vjp-with-recompute: XLA sees fwd+bwd in one module and CSEs /
             # remats (reference analog: CachedOp::SetBackwardGraph caches
-            # the grad graph; mirror policy graph_executor.cc:261)
-            _outs, vjp = jax.vjp(
-                lambda p, a: core(p, a, seed)[0], pvals, avals)
+            # the grad graph; mirror policy graph_executor.cc:261).
+            # The recompute must re-trace under the FORWARD's train mode:
+            # this jit is first traced inside backward(), outside the
+            # record() scope, and without the pin BatchNorm/Dropout would
+            # take their inference branches — differentiating a different
+            # function than the one that produced the outputs (grads
+            # through running stats instead of batch stats, dropout
+            # masks dropped from the backward).
+            mode = autograd.train_mode() if is_train \
+                else autograd.predict_mode()
+            with mode:
+                _outs, vjp = jax.vjp(
+                    lambda p, a: core(p, a, seed)[0], pvals, avals)
             return vjp(cts)
 
         self._bwd = jax.jit(bwd)

@@ -30,6 +30,76 @@ from .parameter import Parameter, ParameterDict
 __all__ = ["Trainer"]
 
 
+class _StepTelemetry:
+    """THE shared per-step instrumentation of ``Trainer.step`` and
+    ``compiled_step.CompiledStep.step``: the ``trainer:step`` span +
+    step-wall histogram around the body, the health flight dump when an
+    exception unwinds the step, and the accreting end-of-step hook tail
+    (device-memory counter event, health step clock, auto-checkpoint,
+    stepstats window close, metrics-timeline sample).  One place to
+    extend when the next observability layer lands — a hook added here
+    fires on BOTH training paths.
+
+    ``compiled=True`` tags the span and pins the auto-checkpoint
+    capture (the compiled path donates the param/optimizer buffers on
+    its next call — ``checkpoint.save_trainer``'s pin contract)."""
+
+    def __init__(self, trainer, batch_size, hm, compiled=False):
+        self.trainer = trainer
+        self.batch_size = batch_size
+        self.hm = hm
+        self.compiled = compiled
+
+    def __enter__(self):
+        self._hist_on = _histogram._state["on"]
+        if self._hist_on:
+            self._t0 = _profiler._now_us()
+        args = None
+        if _profiler._state["running"]:
+            args = {"batch_size": self.batch_size}
+            if self.compiled:
+                args["compiled"] = 1
+        self._span = _profiler.span("trainer:step", "trainer", args=args)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._span.__exit__(exc_type, exc, tb)
+        if exc_type is not None:
+            if self.hm is not None:
+                # the ring holds the steps leading up to the crash —
+                # dump it before the exception unwinds the training loop
+                self.hm.dump_on_crash()
+            return False
+        if self._hist_on:
+            # step wall-time distribution (guard-first): the per-rank
+            # series the cluster report compares for step-time skew
+            _histogram.observe("trainer:step",
+                               (_profiler._now_us() - self._t0) / 1e6)
+        if _dm._state["on"]:
+            # per-step live/peak-bytes counter event: anchors the trace's
+            # memory timeline even when no buffer was (de)allocated
+            _dm.emit_counter()
+        if self.hm is not None:
+            self.hm.end_step()
+        # auto-checkpoint hook (checkpoint.enable()/MXNET_TPU_CKPT):
+        # advances the manager's step clock and snapshots at interval
+        # boundaries without blocking.  Disabled: one dict read.
+        if _ckpt._state["on"]:
+            _ckpt.on_step(self.trainer, pin=self.compiled)
+        # step-anatomy boundary (stepstats.py): closes the window that
+        # opened at the previous step's end, so the recorded wall time
+        # covers the whole iteration.  Disabled: one dict read.
+        if _stepstats._state["on"]:
+            _stepstats.end_step()
+        # live metrics timeline: one per-step sample AFTER end_step so
+        # the sample carries this step's phase window.  Disabled: one
+        # dict read.
+        if _metrics._state["on"]:
+            _metrics.on_step(self.batch_size)
+        return False
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None):
@@ -133,6 +203,19 @@ class Trainer:
     def optimizer(self):
         return self._optimizer
 
+    # ------------------------------------------------------- compiled step
+    def compile(self, block, loss):
+        """Fuse ``block``'s forward + ``loss`` + backward + this
+        trainer's optimizer update into ONE donated XLA program
+        (``compiled_step.CompiledStep``): ``cs = trainer.compile(net,
+        loss_fn)`` then ``cs.step(x, y)`` replaces the whole
+        ``record()/backward()/step()`` iteration.  The eager path stays
+        the default/debug mode; see docs/COMPILED_STEP.md for the
+        donation/rebind contract and the supported-optimizer set."""
+        from .. import compiled_step as _compiled
+
+        return _compiled.compile_step(block, loss, self)
+
     # ------------------------------------------------------------ step
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads across devices, then update
@@ -147,48 +230,8 @@ class Trainer:
         before propagating.  Disabled: one dict read."""
         _rts.inc("trainer_steps")
         hm = _health.monitor() if _health._state["on"] else None
-        # step wall-time distribution (guard-first): the per-rank
-        # series the cluster report compares to quantify step-time skew
-        hist_on = _histogram._state["on"]
-        if hist_on:
-            t0 = _profiler._now_us()
-        try:
-            with _profiler.span("trainer:step", "trainer",
-                                args={"batch_size": batch_size}
-                                if _profiler._state["running"] else None):
-                self._step(batch_size, ignore_stale_grad, hm)
-            if hist_on:
-                _histogram.observe("trainer:step",
-                                   (_profiler._now_us() - t0) / 1e6)
-        except Exception:
-            if hm is not None:
-                # the ring holds the steps leading up to the crash —
-                # dump it before the exception unwinds the training loop
-                hm.dump_on_crash()
-            raise
-        if _dm._state["on"]:
-            # per-step live/peak-bytes counter event: anchors the trace's
-            # memory timeline even when no buffer was (de)allocated
-            _dm.emit_counter()
-        if hm is not None:
-            hm.end_step()
-        # auto-checkpoint hook (checkpoint.enable()/MXNET_TPU_CKPT):
-        # advances the manager's step clock and snapshots at interval
-        # boundaries without blocking.  Disabled: one dict read.
-        if _ckpt._state["on"]:
-            _ckpt.on_step(self)
-        # step-anatomy boundary (stepstats.py): closes the window that
-        # opened at the previous step's end, so the recorded wall time
-        # covers the whole iteration (data wait + fwd/bwd + reduce +
-        # update + hooks).  Disabled: one dict read.
-        if _stepstats._state["on"]:
-            _stepstats.end_step()
-        # live metrics timeline (metrics_timeline.py): one per-step
-        # sample into the ring/JSONL/endpoint — AFTER end_step so the
-        # sample carries this step's phase window.  Disabled: one dict
-        # read.
-        if _metrics._state["on"]:
-            _metrics.on_step(batch_size)
+        with _StepTelemetry(self, batch_size, hm):
+            self._step(batch_size, ignore_stale_grad, hm)
 
     def _health_grads_and_prev(self, hm):
         """Feed gradients to the health monitor and snapshot the

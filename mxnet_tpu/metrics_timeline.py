@@ -215,7 +215,12 @@ def _cum_totals():
     return {"compiles": compiles, "misses": misses,
             "fallbacks": fallbacks,
             "kv_retries": c.get("kvstore_retries", 0),
-            "kv_dedup": c.get("kvstore_dup_suppressed", 0)}
+            "kv_dedup": c.get("kvstore_dup_suppressed", 0),
+            # whole-step-program calls (compiled_step.py): keeps the
+            # windows coherent when per-op warm-dispatch deltas
+            # collapse to ~1 call/step — a sample showing zero misses
+            # and compiled_steps=1 reads as "fused", not "idle"
+            "compiled_steps": c.get("compiled_step_steps", 0)}
 
 
 def _jit_cache_size():

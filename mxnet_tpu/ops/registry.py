@@ -152,11 +152,18 @@ class Op:
     def _split_attrs(self, attrs):
         """``(cache key, traced names, static attrs, traced attrs)`` for
         an attr-set — the single definition of the jit-cache key, shared
-        by :meth:`jitted_ex` and :meth:`analyze_entry`."""
+        by :meth:`jitted_ex` and :meth:`analyze_entry`.
+
+        A ``jax.core.Tracer`` value for a traced-attr name also routes to
+        the traced side: when a whole-step program (compiled_step.py)
+        traces an optimizer update, the per-step scalars arrive as
+        tracers and must become jit arguments, never cache-key
+        components (tracers are unhashable by design)."""
         traced = {k: v for k, v in attrs.items()
                   if k in self.traced_attrs
-                  and isinstance(v, (int, float))
-                  and not isinstance(v, bool)}
+                  and ((isinstance(v, (int, float))
+                        and not isinstance(v, bool))
+                       or isinstance(v, jax.core.Tracer))}
         if not traced:
             return tuple(sorted(attrs.items())), (), attrs, traced
         static = {k: v for k, v in attrs.items() if k not in traced}
@@ -197,8 +204,11 @@ class Op:
             _stats.record_compile_key(self.name, key)
         _stats.record_dispatch(self.name, "hit" if hit else "miss")
         # python floats stay weak-typed under tracing: no recompile across
-        # values AND no dtype promotion of bf16/fp16 tensors
-        tvals = tuple(float(traced[k]) for k in tnames)
+        # values AND no dtype promotion of bf16/fp16 tensors; a tracer
+        # (an enclosing whole-step trace feeding per-step scalars) is
+        # already abstract and passes through as-is
+        tvals = tuple(traced[k] if isinstance(traced[k], jax.core.Tracer)
+                      else float(traced[k]) for k in tnames)
         return functools.partial(_call_traced, entry, tvals), hit
 
     def analyze_entry(self, attrs, arrays):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import math
 import pickle
+import threading
 
 import numpy as _np
 
@@ -21,6 +22,52 @@ from ..base import MXNetError, Registry
 from ..ndarray import NDArray, imperative_invoke, zeros
 
 _REG = Registry("optimizer")
+
+
+# ------------------------------------------------------------------ compiled-step scalar feed
+# Active while compiled_step.py traces an optimizer update into the
+# whole-step XLA program.  Per-step host scalars (scheduler lr,
+# bias-correction terms, the step count t) must not be baked into the
+# trace as constants — the feed supplies a traced stand-in per
+# (param index, scalar name) slot, and the CompiledStep recomputes the
+# concrete values host-side every step (via Optimizer.step_scalars)
+# and passes them into the jitted program as arguments.  The fused
+# update kernels already declare these names in traced_attrs, so the
+# tracer values flow straight through the per-op jit cache without
+# becoming cache-key components.
+_SCALAR_FEED = threading.local()
+
+
+class scalar_feed:
+    """Scope mapping ``(param index, scalar name) -> traced value`` for
+    the duration of a compiled-step trace (compiled_step.py)."""
+
+    def __init__(self, table):
+        self.table = table
+
+    def __enter__(self):
+        stack = getattr(_SCALAR_FEED, "stack", None)
+        if stack is None:
+            stack = _SCALAR_FEED.stack = []
+        stack.append(self.table)
+        return self
+
+    def __exit__(self, *a):
+        _SCALAR_FEED.stack.pop()
+
+
+def _fed(index, name):
+    """The traced stand-in for slot ``(index, name)``, or None when no
+    feed is active (the eager path: zero cost beyond one getattr)."""
+    stack = getattr(_SCALAR_FEED, "stack", None)
+    if not stack:
+        return None
+    return stack[-1].get((index, name))
+
+
+def feed_active():
+    """True while a compiled-step trace is feeding optimizer scalars."""
+    return bool(getattr(_SCALAR_FEED, "stack", None))
 
 
 def register(klass):
@@ -36,6 +83,15 @@ def create(name, **kwargs):
 
 class Optimizer:
     """Base optimizer (reference: optimizer.py:46)."""
+
+    # True when update() reads its per-step scalars only through the
+    # feed-aware accessors below (_get_lr/_get_wd/_t or an overridden
+    # step_scalars) — the contract compiled_step.py needs to trace the
+    # update into a whole-step XLA program without baking per-step
+    # values in.  Optimizers with host-side cross-step recurrences
+    # (Nadam's m_schedule), host syncs (LBSGD's norm fetch), or raw
+    # NDArray-math on host scalars stay False and keep the eager path.
+    compiled_step_safe = False
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
@@ -123,6 +179,11 @@ class Optimizer:
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
+        if feed_active():
+            # compiled-step trace: the CompiledStep advances the host
+            # counters itself (once per real step); the one-time trace
+            # must not double-advance them
+            return
         if not isinstance(index, (list, tuple)):
             index = [index]
         for idx in index:
@@ -132,6 +193,9 @@ class Optimizer:
             self.num_update = max(self._index_update_count[idx], self.num_update)
 
     def _get_lr(self, index):
+        fed = _fed(index, "lr")
+        if fed is not None:
+            return fed
         if self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)
         else:
@@ -145,6 +209,9 @@ class Optimizer:
         return lr
 
     def _get_wd(self, index):
+        fed = _fed(index, "wd")
+        if fed is not None:
+            return fed
         wd = self.wd
         if index in self.param_dict:
             wd *= self.param_dict[index].wd_mult
@@ -153,6 +220,33 @@ class Optimizer:
         elif index in self.idx2name:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
+
+    def _t(self, index):
+        """The step count update() derives bias corrections from —
+        the already-advanced per-index count on the eager path, the
+        feed's traced ``t`` under a compiled-step trace."""
+        fed = _fed(index, "t")
+        if fed is not None:
+            return fed
+        return self._index_update_count[index]
+
+    def _t_host(self, index):
+        """Host-side per-index step count for ``step_scalars``; 1
+        before the first update (CompiledStep probes step_scalars once
+        at build time purely for the slot NAMES — the values are
+        refilled after every real count advance)."""
+        return max(1, self._index_update_count.get(index, 0))
+
+    def step_scalars(self, index):
+        """Per-step scalars this optimizer's ``update()`` reads for
+        ``index`` — the compiled-step protocol: ``CompiledStep``
+        recomputes this dict host-side every step (after advancing the
+        update counts) and feeds the values into the jitted whole-step
+        program as traced arguments, one slot per (index, name).
+        Keys must match the names ``update()`` reads through the
+        feed-aware accessors (``lr``/``wd``/``t`` here; subclasses
+        with extra per-step scalars extend the dict)."""
+        return {"lr": self._get_lr(index), "wd": self._get_wd(index)}
 
     def __getstate__(self):
         d = self.__dict__.copy()
@@ -202,6 +296,8 @@ class SGD(Optimizer):
     """SGD with momentum + optional multi-precision
     (reference: optimizer.py SGD; fused kernels optimizer_op.cc)."""
 
+    compiled_step_safe = True
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -241,6 +337,9 @@ ccSGD = register(type("ccSGD", (SGD,), {}))  # deprecated alias (reference parit
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style layer-wise adaptation
     (reference: optimizer.py LBSGD)."""
+
+    # update() host-syncs (weight/grad norm fetch) — eager only
+    compiled_step_safe = False
 
     def __init__(self, warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
                  updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
@@ -311,6 +410,8 @@ class DCASGD(Optimizer):
 class NAG(Optimizer):
     """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
 
+    compiled_step_safe = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -350,6 +451,8 @@ class SGLD(Optimizer):
 class Adam(Optimizer):
     """reference: optimizer.py Adam; fused adam_update kernel."""
 
+    compiled_step_safe = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -362,23 +465,38 @@ class Adam(Optimizer):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index)
+    def _bc_lr(self, index):
+        """Bias-corrected per-step lr.  Computed host-side in double
+        precision (the reference semantics); under a compiled-step
+        trace the feed supplies the traced stand-in and the SAME host
+        math runs in step_scalars each step — eager and compiled runs
+        see bit-identical scalar values."""
+        fed = _fed(index, "lr")
+        if fed is not None:
+            return fed
+        t = self._t_host(index)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr = lr * math.sqrt(coef2) / coef1
-        mean, var = state
+        return self._get_lr(index) * math.sqrt(coef2) / coef1
+
+    def step_scalars(self, index):
+        return {"lr": self._bc_lr(index), "wd": self._get_wd(index)}
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
         # bias-corrected lr varies EVERY step → traced input, not attr
         # (a static attr would recompile the kernel each step)
+        lr = self._bc_lr(index)
+        if isinstance(lr, (int, float)):
+            lr = float(lr)
+        mean, var = state
         attrs = {"wd": self._get_wd(index),
                  "rescale_grad": self.rescale_grad,
                  "clip_gradient": self.clip_gradient if self.clip_gradient else -1.0,
                  "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
         opname, inputs = _route_sparse("adam_update", weight, grad,
                                        [mean, var], self.lazy_update)
-        outs = imperative_invoke(opname, inputs + [float(lr)], attrs)
+        outs = imperative_invoke(opname, inputs + [lr], attrs)
         weight._assign(outs[0]._data)
         mean._assign(outs[1]._data)
         var._assign(outs[2]._data)
@@ -387,6 +505,8 @@ class Adam(Optimizer):
 @register
 class Signum(Optimizer):
     """reference: optimizer.py Signum (signSGD + momentum)."""
+
+    compiled_step_safe = True
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -411,6 +531,8 @@ class Signum(Optimizer):
 class FTML(Optimizer):
     """reference: optimizer.py FTML."""
 
+    compiled_step_safe = True
+
     def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -423,9 +545,13 @@ class FTML(Optimizer):
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
+    def step_scalars(self, index):
+        return {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                "t": float(self._t_host(index))}
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        t = self._index_update_count[index]
+        t = self._t(index)
         d, v, z = state
         attrs = {"lr": self._get_lr(index), "wd": self._get_wd(index),
                  "rescale_grad": self.rescale_grad,
@@ -442,6 +568,8 @@ class FTML(Optimizer):
 @register
 class Ftrl(Optimizer):
     """reference: optimizer.py Ftrl."""
+
+    compiled_step_safe = True
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -469,6 +597,8 @@ class Ftrl(Optimizer):
 class Adamax(Optimizer):
     """reference: optimizer.py Adamax."""
 
+    compiled_step_safe = True
+
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1 = beta1
@@ -478,12 +608,15 @@ class Adamax(Optimizer):
         return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
+    def step_scalars(self, index):
+        return {"lr": self._get_lr(index), "wd": self._get_wd(index),
+                "t": float(self._t_host(index))}
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         m, u = state
         _fused("adamax_update", index, weight, grad, [m, u], self,
-               beta1=self.beta1, beta2=self.beta2,
-               t=self._index_update_count[index])
+               beta1=self.beta1, beta2=self.beta2, t=self._t(index))
 
 
 @register
@@ -556,6 +689,8 @@ class AdaGrad(Optimizer):
 @register
 class RMSProp(Optimizer):
     """reference: optimizer.py RMSProp (Tieleman & Hinton; centered variant)."""
+
+    compiled_step_safe = True
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
                  centered=False, clip_weights=None, **kwargs):

@@ -16,8 +16,7 @@ import numpy as _np
 
 from .. import autograd
 from .. import random as _random
-from ..gluon.block import _StagingScope
-from ..gluon.parameter import param_override
+from ..gluon.block import staged_call
 from ..ndarray import NDArray
 
 __all__ = ["GluonTrainStep", "sgd_momentum_init", "sgd_momentum_update"]
@@ -35,14 +34,15 @@ def _pure_loss_builder(block, loss_block, trainable, aux,
     def pure_loss(train_vals, aux_vals, x, y, key):
         override = {p: NDArray(v) for p, v in zip(trainable, train_vals)}
         override.update({p: NDArray(v) for p, v in zip(aux, aux_vals)})
-        scope = _StagingScope()
-        with param_override(override), scope, _random.TraceRNG(key), \
-                autograd.train_mode():
-            out = block(NDArray(x))
-            loss = loss_block(out, NDArray(y))
+
+        def fwd(x_nd):
+            loss = loss_block(block(x_nd), NDArray(y))
             loss = loss.mean()
             if aux_loss_weight is not None:
                 loss = loss + aux_loss_weight * block.collect_aux_losses()
+            return loss
+
+        loss, scope = staged_call(fwd, override, key, (NDArray(x),))
         new_aux = tuple(
             scope.aux_updates.get(p, override[p]._data) for p in aux)
         return loss._data, new_aux
@@ -198,9 +198,15 @@ class GluonTrainStep:
         key is fold_in(key, i), so chained(n) visits the same key
         sequence regardless of chain depth.
 
-        Returns fn(x, y, key) -> (last_loss, updated GluonTrainStep
-        state is NOT written back — the chain is a measurement primitive,
-        not a training API; use __call__ for real training loops).
+        The param/optimizer/aux carry is DONATED into the chain (like
+        the single-step path): without donation XLA must keep the
+        undonated inputs alive across the whole fori_loop, doubling
+        peak param+optimizer memory.  Donation invalidates the input
+        buffers, so the final carry is written back into this object's
+        state — chained(n) advances training exactly like n ``__call__``
+        steps (same fold_in key schedule) and repeat calls keep working.
+
+        Returns fn(x, y, key) -> last_loss.
         """
         import jax
         import jax.numpy as jnp
@@ -219,15 +225,17 @@ class GluonTrainStep:
 
             init = (train_vals, opt_state, aux_vals,
                     jnp.zeros((), jnp.float32))
-            _, _, _, loss = lax.fori_loop(0, n_steps, body, init)
-            return loss
+            tv, os_, av, loss = lax.fori_loop(0, n_steps, body, init)
+            return loss, tv, os_, av
 
-        jitted = jax.jit(chained)
+        jitted = jax.jit(chained, donate_argnums=(0, 1, 2))
 
         def run(x, y, key):
-            return jitted(self.train_vals, self.opt_state, self.aux_vals,
-                          x, y, key)
+            loss, self.train_vals, self.opt_state, self.aux_vals = jitted(
+                self.train_vals, self.opt_state, self.aux_vals, x, y, key)
+            return loss
 
+        run._jitted = jitted  # donation introspection (tests)
         return run
 
     def put_batch(self, x, y):

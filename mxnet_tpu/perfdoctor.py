@@ -18,6 +18,10 @@ Rules
 - **recompile storms** — ops compiling past the storm threshold, with
   the churned attr/aval evidence from ``recent_storm_keys`` and the
   compile share of step time.
+- **eager dispatch tax** — warm per-op dispatch (+ compile) dominating
+  an eager run's step time: recommends the compiled whole-step path
+  (``MXNET_TPU_COMPILED_STEP`` / ``trainer.compile``) with projected
+  savings derived from the warm-dispatch counters.
 - **host-sync stalls** — monitor/health host-sync seconds on the hot
   path (the deliberate sync sinks, when their cost stops being small).
 - **idle gaps inside steps** — wall time inside ``trainer:step`` spans
@@ -231,6 +235,56 @@ def _check_recompiles(dump):
         "hoist the churning attr into traced_attrs or stabilize input "
         "shapes — every recompile stalls dispatch for a full XLA "
         "compile (docs/OBSERVABILITY.md 'Recompile-storm detector')")]
+
+
+def _check_eager_dispatch(dump):
+    """Eager per-op dispatch tax: warm dispatch (+ compile) dominating
+    the step while the run never used the compiled whole-step path —
+    the exact profile ``MXNET_TPU_COMPILED_STEP`` exists for
+    (compiled_step.py: fwd+bwd+update traced into ONE donated XLA
+    program, ~1 warm dispatch per step instead of one per op).
+    Projected savings derive from the warm-dispatch counters: of the
+    measured ``dispatch_warm`` share, a compiled step keeps roughly
+    1/calls-per-step (one remaining dispatch) and fuses the rest."""
+    snap = dump.get("snapshot", dump)
+    counters = snap.get("counters") or {}
+    if counters.get("compiled_step_steps"):
+        return []  # the run already trains through the compiled path
+    steps = counters.get("trainer_steps", 0)
+    if not steps:
+        return []
+    a = _anatomy_of(dump)
+    if not a.get("steps"):
+        return []
+    dw = (a["phases"].get("dispatch_warm") or {}).get("share") or 0.0
+    comp = (a["phases"].get("compile") or {}).get("share") or 0.0
+    share = dw + comp
+    if share < SHARE_WARN:
+        return []
+    totals = snap.get("totals") or {}
+    warm = totals.get("jit_cache_hits", 0)
+    calls_per_step = warm / steps
+    if calls_per_step < 2:
+        return []  # already ~one dispatch per step: nothing to collapse
+    projected = dw * (1.0 - 1.0 / calls_per_step)
+    dw_ms = (a["phases"].get("dispatch_warm") or {}).get("mean_ms") or 0.0
+    return [_finding(
+        "eager-dispatch-tax", share,
+        "eager dispatch is %.0f%% of step time (%.0f warm op "
+        "dispatches/step) — whole-step compilation would collapse "
+        "them to ~1, saving ~%.0f%% of step time"
+        % (share * 100, calls_per_step, projected * 100),
+        "dispatch_warm",
+        ["%d warm jit-cache hits over %d step(s): %.1f dispatches/"
+         "step at %.3f ms/step of warm-dispatch wall"
+         % (warm, steps, calls_per_step, dw_ms),
+         "compile share %.0f%% also amortizes to one program per "
+         "input signature under the compiled step" % (comp * 100)],
+        "train through the fused whole-step program: "
+        "cs = trainer.compile(net, loss); cs.step(x, y) — or set "
+        "MXNET_TPU_COMPILED_STEP=1 where the launch wiring honors it "
+        "(docs/COMPILED_STEP.md); the eager path remains the "
+        "debugging/interop mode")]
 
 
 def _check_host_sync(dump):
@@ -747,6 +801,7 @@ def diagnose(trace=None, dump=None, timeline=None, top=20):
     if dump is not None:
         findings += _check_step_anatomy(dump)
         findings += _check_recompiles(dump)
+        findings += _check_eager_dispatch(dump)
         findings += _check_host_sync(dump)
         findings += _check_roofline(dump)
         findings += _check_stragglers(dump)

@@ -79,7 +79,8 @@ from .log import (get_logger, process_identity, rank_suffix_path,
 
 __all__ = ["snapshot", "report", "reset", "inc",
            "record_dispatch", "record_compile_key", "add_compile_seconds",
-           "add_dispatch_seconds", "record_fallback", "note_aval_key",
+           "add_dispatch_seconds", "add_compiled_step_seconds",
+           "record_fallback", "note_aval_key",
            "roofline", "diag_snapshot", "dump_diag", "main",
            "health_probe", "cluster_report", "render_cluster",
            "load_dumps", "compare", "render_compare",
@@ -209,6 +210,25 @@ def add_dispatch_seconds(name, seconds):
         _histogram.observe("dispatch:warm", seconds)
     if _stepstats._state["on"]:
         _stepstats.add("dispatch_warm", seconds)
+
+
+def add_compiled_step_seconds(seconds):
+    """Attribute one warm whole-step program call's wall-time
+    (``compiled_step.py``).  The shape of :func:`add_dispatch_seconds`
+    — per-op row ``compiled_step`` — but BOTH distribution feeds go to
+    dedicated series (``compiled_step`` histogram, ``compiled_step``
+    stepstats phase), never ``dispatch:warm``/``dispatch_warm``: the
+    whole-step call IS the step's compute, and mixing seconds-long
+    step samples into the sub-ms per-op dispatch distribution would
+    wreck its mean/p99 and read as a dispatch regression in
+    ``compare()`` when it is the opposite."""
+    s = _op_stats("compiled_step")
+    s["dispatch_seconds"] += seconds
+    s["timed_calls"] += 1
+    if _histogram._state["on"]:
+        _histogram.observe("compiled_step", seconds)
+    if _stepstats._state["on"]:
+        _stepstats.add("compiled_step", seconds)
 
 
 def record_fallback(name, kind):
@@ -360,12 +380,15 @@ def snapshot():
     # dispatch.  health.snapshot() never syncs — pending device stats
     # are reported as a count.
     from . import checkpoint as _checkpoint
+    from . import compiled_step as _compiled
     from . import health as _health
     from .ops import registry as _registry
 
+    costs = _registry.cost_snapshot()
+    costs.update(_compiled.cost_snapshot())
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
             "storms": storms, "memory": device_memory.snapshot(),
-            "costs": _registry.cost_snapshot(),
+            "costs": costs,
             "health": _health.snapshot(),
             "checkpoint": _checkpoint.snapshot(),
             "histograms": _histogram.snapshot(),

@@ -19,13 +19,18 @@ Phases (:data:`PHASES`; shared vocabulary with ``tools/diagnose.py
 - ``backward``         ``autograd.backward`` / ``executor:backward``
 - ``dispatch_warm``    cache-warm op dispatch wall time
 - ``compile``          jit-cache-miss wall time (trace + XLA compile)
+- ``compiled_step``    the one warm whole-step program call
+  (``compiled_step.py``: fused fwd+bwd+update; its build/compile time
+  lands in ``compile``)
 - ``kvstore``          allreduce / kvstore push+pull (incl. dist RTT)
 - ``optimizer_update`` worker-side optimizer update
 - ``checkpoint_write`` in-step checkpoint snapshot (the async capture,
   or the full write in ``MXNET_TPU_CKPT_ASYNC=0`` mode)
 - ``health_drain``     numerics-health queue drain (the layer's one sync)
 
-Leaf phases accumulate measured durations directly; container phases
+Leaf phases accumulate measured durations directly (``compiled_step``
+is a leaf: the one warm whole-step call, timed by ``compiled_step.py``
+whenever dispatch timing is on); container phases
 (``forward``, ``backward``, ``kvstore``, ``optimizer_update``,
 ``data_wait``, ``checkpoint_write``)
 record their wall time **exclusive** of any attribution that landed
@@ -70,8 +75,8 @@ __all__ = ["PHASES", "PHASE_LABELS", "enable", "disable", "is_enabled",
 # tools/profile_step.py all name phases from this table so a finding,
 # a diff row, and a measured-trace column agree on names and units.
 PHASES = ("data_wait", "forward", "backward", "dispatch_warm", "compile",
-          "kvstore", "optimizer_update", "checkpoint_write",
-          "health_drain")
+          "compiled_step", "kvstore", "optimizer_update",
+          "checkpoint_write", "health_drain")
 
 PHASE_LABELS = {
     "data_wait": "data wait (io:next_batch)",
@@ -79,6 +84,7 @@ PHASE_LABELS = {
     "backward": "backward (autograd:backward)",
     "dispatch_warm": "warm dispatch",
     "compile": "compile (jit-cache miss)",
+    "compiled_step": "compiled whole-step call",
     "kvstore": "allreduce / kvstore",
     "optimizer_update": "optimizer update",
     "checkpoint_write": "checkpoint snapshot",

@@ -62,7 +62,10 @@ def test_gate_skips_without_prior():
 def test_make_chained_matches_sequential_steps():
     """chained(n) must compute the same loss trajectory as n sequential
     _step calls with the same fold_in key schedule — the measurement
-    primitive must measure the real training computation."""
+    primitive must measure the real training computation.  The carry is
+    DONATED and written back (tests/test_compiled_step.py pins the
+    donation), so the chain also ADVANCES the step state like n
+    __call__ steps."""
     import jax
 
     import mxnet_tpu as mx
@@ -94,8 +97,11 @@ def test_make_chained_matches_sequential_steps():
     got = step.make_chained(3)(x, y, key)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-6)
-    # and the chain must not have written back into the step's state
-    assert step.train_vals is orig_train_vals
+    # the donated carry was written back: the chain advanced training
+    assert step.train_vals is not orig_train_vals
+    for new, ref in zip(step.train_vals, tv):
+        np.testing.assert_allclose(np.asarray(new), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-6)
 
 
 def test_prior_round_values_skips_other_platform_records(tmp_path, monkeypatch):
