@@ -39,6 +39,13 @@ Usage: python bench.py [batch] [steps] [NHWC|NCHW]
            fused whole-step program on the same model/seed — emits
            before/after diag dumps + one runtime_stats.compare()
            verdict (docs/COMPILED_STEP.md; record goes to BENCH_NOTES).
+       python bench.py --serve [duration_s]
+           serving bench: the tools/loadgen.py open-loop sweep
+           (Poisson arrivals, p50/p99/p99.9 vs offered QPS, serial
+           Predictor baseline + same-load serial-server replay) over
+           the continuous-batching InferenceServer; prints the JSON
+           report and writes the bench_serve.json artifact
+           (docs/SERVING.md; record goes to BENCH_NOTES).
 """
 
 import glob
@@ -377,7 +384,49 @@ def run_compiled_compare(batch=8, steps=6, image=64, layout="NHWC",
     return (0 if ok else 1), record
 
 
+def run_serve_bench(duration=2.0, out_path="bench_serve.json"):
+    """``--serve`` mode: the loadgen sweep as a bench artifact.  Runs
+    on the current backend (the serving bench is CPU-meaningful — it
+    measures batching/queueing economics, not kernel speed); the
+    artifact records the platform so later rounds compare
+    like-for-like.  Returns (rc, report): rc 0 iff the sweep sustained
+    a level and the timeline soak gated clean through the trend
+    doctor."""
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import loadgen
+
+    metrics = os.path.join(here, "bench_serve_timeline.jsonl")
+    # a fresh soak timeline per round: stale samples from a prior run
+    # would feed the trend doctor a fake regression
+    if os.path.exists(metrics):
+        os.remove(metrics)
+    report = loadgen.sweep(duration=duration, metrics_path=metrics)
+    report["platform"] = jax.devices()[0].platform
+    report["unit"] = "requests/s"
+    print(json.dumps(report))
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    # the bench ALWAYS requests the soak timeline, so a missing gate
+    # (soak_clean None: export failed or no level sustained) is a
+    # failure, not a vacuous pass
+    ok = bool(report["max_sustained_qps"]) \
+        and report["soak_clean"] is True
+    if not ok:
+        print("serve bench FAILED: max_sustained_qps=%s soak_clean=%s"
+              % (report["max_sustained_qps"], report["soak_clean"]),
+              file=sys.stderr)
+    return (0 if ok else 1), report
+
+
 def main():
+    if "--serve" in sys.argv:
+        nums = [a for a in sys.argv[1:] if a not in ("--serve",)]
+        duration = float(nums[0]) if nums else 2.0
+        rc, _rep = run_serve_bench(duration=duration)
+        sys.exit(rc)
     if "--compiled-step" in sys.argv or \
             os.environ.get("MXNET_TPU_COMPILED_STEP") == "1":
         # tolerate BOTH argv shapes: the compare form

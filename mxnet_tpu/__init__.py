@@ -61,6 +61,11 @@ from . import random  # noqa: F401
 
 # API layers above the core — populated over the build plan (SURVEY.md §7);
 # each module raises a clear error at *use* time if incomplete, never at import.
+# Deliberately NOT listed: `serving` (the continuous-batching inference
+# server, docs/SERVING.md) — a training process must never pay its
+# import; `runtime_stats` reads its diag section via sys.modules, and
+# deployments opt in with `from mxnet_tpu import serving`
+# (tests/test_bench_gate.py pins the zero-import-cost contract).
 _OPTIONAL = [
     "initializer", "optimizer", "metric", "lr_scheduler", "callback",
     "symbol", "io", "recordio", "gluon", "module", "kvstore", "executor",

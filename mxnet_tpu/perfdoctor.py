@@ -31,6 +31,10 @@ Rules
   dispatch time sits far above their cost-model roofline bound.
 - **kvstore stragglers** — one PS shard's push/pull RTT p99 an outlier
   vs the other shards' median (``histogram.median_of_others``).
+- **serving** — ``serve-queue-dominated`` (queue-wait p99 past
+  ``SERVE_QUEUE_RATIO`` x the batch-compute p99: this replica is past
+  capacity) and ``serve-bucket-churn`` (bucket executables rebuilt past
+  the one-per-bucket warmup) from an ``InferenceServer`` run's dump.
 - **kvstore self-healing** — dead-shard heartbeat warnings
   (``kvstore_dead_shard_warnings``: a PS shard went unresponsive past
   ``MXNET_TPU_KV_DEADLINE``) and server-side duplicate suppression
@@ -76,7 +80,7 @@ __all__ = ["diagnose", "classify", "render", "render_github",
            "gh_annotation", "SHARE_NOTICE", "SHARE_WARN",
            "HEADROOM_RATIO", "IDLE_GAP_SHARE", "TREND_MIN_SAMPLES",
            "TREND_SLOWDOWN", "LEAK_SLOPE_BYTES", "SPIKE_RATIO",
-           "KV_DRIFT_RATIO"]
+           "KV_DRIFT_RATIO", "SERVE_QUEUE_RATIO", "SERVE_MIN_REQUESTS"]
 
 # a phase/rule at or above this share of step time is worth a line /
 # a warning; tunable per call via diagnose(..., notice=, warn=)
@@ -111,6 +115,14 @@ SPIKE_MIN_SHARE = 0.10
 # a kv-RTT series' late-window mean p99 / early-window mean p99 past
 # this ratio is drift
 KV_DRIFT_RATIO = 2.0
+
+# ---- serving-rule knobs (InferenceServer dumps) ------------------------
+# queue-wait p99 past this multiple of the batch-compute p99 means the
+# server is queue-dominated: requests wait longer than they compute
+SERVE_QUEUE_RATIO = 2.0
+# served requests below this leave the serving rules silent (a handful
+# of warmup requests carries no operating-point signal)
+SERVE_MIN_REQUESTS = 32
 
 
 def classify(path):
@@ -478,6 +490,94 @@ def _check_self_healing(dump):
     return out
 
 
+# --------------------------------------------------------- serving rules
+
+
+def _check_serving(dump):
+    """Serving-layer findings from an ``InferenceServer`` run's dump:
+
+    - **serve-queue-dominated** — the ``serve:queue_wait`` p99 exceeds
+      ``SERVE_QUEUE_RATIO`` x the ``serve:batch`` compute p99: requests
+      spend longer waiting for a batch slot than being computed, the
+      signature of offered load past this replica's capacity.
+    - **serve-bucket-churn** — more bucket-executable builds than the
+      ladder has buckets past warmup: executables are being rebuilt
+      (reconstructed servers, shape churn reaching the build path),
+      each one a full XLA compile on the serving path.
+    """
+    snap = dump.get("snapshot", dump)
+    serving = snap.get("serving") or {}
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+    requests = serving.get("requests") or counters.get(
+        "serve_requests", 0)
+    if not requests:
+        return []
+    out = []
+    qw = hists.get("serve:queue_wait") or {}
+    batch = hists.get("serve:batch") or {}
+    e2e = hists.get("serve:e2e") or {}
+    if requests >= SERVE_MIN_REQUESTS and qw.get("p99") \
+            and batch.get("p99"):
+        ratio = qw["p99"] / batch["p99"]
+        if ratio > SERVE_QUEUE_RATIO:
+            # score = the fraction of a served request's life spent
+            # queueing (the serving analog of "share of step time")
+            share = (qw["mean"] / e2e["mean"]) \
+                if e2e.get("mean") else min(1.0, ratio / 10.0)
+            occ = serving.get("mean_occupancy")
+            evidence = [
+                "queue_wait p99 %.3f ms vs batch compute p99 %.3f ms "
+                "(%.1fx) over %d request(s)"
+                % (qw["p99"] * 1e3, batch["p99"] * 1e3, ratio,
+                   requests)]
+            if e2e.get("p99") is not None:
+                evidence.append("end-to-end p99 %.3f ms"
+                                % (e2e["p99"] * 1e3))
+            if occ is not None:
+                evidence.append("mean bucket occupancy %.0f%% (ladder "
+                                "%s)" % (occ * 100,
+                                         serving.get("buckets")))
+            out.append(_finding(
+                "serve-queue-dominated", share,
+                "serving is queue-dominated: queue-wait p99 is %.1fx "
+                "the batch-compute p99" % ratio,
+                "serve:queue_wait", evidence,
+                "this replica is past capacity — raise the max bucket "
+                "(bigger batches amortize dispatch), add a replica "
+                "behind the load balancer, or shed load earlier with a "
+                "smaller MXNET_TPU_SERVE_QUEUE (docs/SERVING.md "
+                "'Latency SLOs')"))
+    # take the MAX of the newest server's section and the process-wide
+    # counters: a process re-creating servers per batch (the exact
+    # churn scenario) shows a small per-server section value while the
+    # cumulative counter carries the real build count
+    compiles = max(serving.get("bucket_compiles") or 0,
+                   counters.get("serve_bucket_compiles", 0))
+    ladder = serving.get("buckets") or []
+    batches = max(serving.get("batches") or 0,
+                  counters.get("serve_batches", 0))
+    # guard only on having SERVED something (a warmup-only process
+    # compiles <= len(ladder) and stays silent anyway); requiring
+    # batches > compiles would mute exactly the worst churn —
+    # server-per-batch recreation compiles the ladder per batch
+    if ladder and compiles > len(ladder) and batches:
+        extra = compiles - len(ladder)
+        out.append(_finding(
+            "serve-bucket-churn", SHARE_NOTICE * min(4.0, extra),
+            "bucket-executable churn: %d build(s) for a %d-bucket "
+            "ladder" % (compiles, len(ladder)),
+            "serve_bucket_compiles",
+            ["%d build(s) past the one-per-bucket warmup across %d "
+             "batch(es) — every extra build is a full XLA compile on "
+             "the serving path" % (extra, batches)],
+            "executables should compile once per bucket and be cached "
+            "for the server's life — avoid re-creating servers per "
+            "request batch and keep request shapes on the configured "
+            "ladder (docs/SERVING.md 'Bucket ladder')"))
+    return out
+
+
 # ----------------------------------------------------------- trend rules
 
 
@@ -807,6 +907,7 @@ def diagnose(trace=None, dump=None, timeline=None, top=20):
         findings += _check_stragglers(dump)
         findings += _check_retries(dump)
         findings += _check_self_healing(dump)
+        findings += _check_serving(dump)
         if timeline is None:
             timeline = dump.get("timeline")
     if isinstance(timeline, dict):

@@ -16,8 +16,13 @@ C ABI for non-Python consumers in `native/src/predict.cc`
 from __future__ import annotations
 
 import io
+import time
 
 import numpy as np
+
+from . import histogram as _histogram
+from . import profiler as _profiler
+from . import runtime_stats as _rts
 
 __all__ = ["Predictor", "load_ndarray_file"]
 
@@ -110,7 +115,28 @@ class Predictor:
     # ------------------------------------------------------------ running
     def forward(self, **kwargs):
         """Run forward with named inputs (numpy arrays); then
-        ``get_output(i)``."""
+        ``get_output(i)``.
+
+        Telemetry seam (the ``Trainer.step`` convention): the forward
+        rides a ``predictor:forward`` profiler span, lands in the
+        ``predictor:forward`` latency histogram (guard-first — one dict
+        read when collection is off), and bumps the always-on
+        ``predictor_forwards`` counter, so legacy predictor and serving
+        runs show up in diag dumps / ``--compare`` like training
+        steps do.  The executor underneath feeds the ``forward``
+        stepstats phase as usual."""
+        hist_on = _histogram._state["on"]
+        if hist_on:
+            t0 = time.perf_counter()
+        with _profiler.span("predictor:forward", "predictor"):
+            self._forward_impl(**kwargs)
+        _rts.inc("predictor_forwards")
+        if hist_on:
+            _histogram.observe("predictor:forward",
+                               time.perf_counter() - t0)
+        return self
+
+    def _forward_impl(self, **kwargs):
         for k, v in kwargs.items():
             if not isinstance(v, np.ndarray):
                 raise ValueError("Expect numpy ndarray as input")
@@ -125,7 +151,6 @@ class Predictor:
                                  "(use reshape())" % (k, v.shape, expect))
             self._inputs[k] = v
         self._outputs = self._exec.forward(is_train=False, **self._inputs)
-        return self
 
     def get_output(self, index):
         """The index-th output as a numpy array."""

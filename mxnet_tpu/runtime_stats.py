@@ -386,6 +386,11 @@ def snapshot():
 
     costs = _registry.cost_snapshot()
     costs.update(_compiled.cost_snapshot())
+    # the serving layer is deliberately NOT imported here: a training
+    # process that never served pays nothing (sys.modules read only)
+    import sys as _sys
+
+    _serving = _sys.modules.get("mxnet_tpu.serving")
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
             "storms": storms, "memory": device_memory.snapshot(),
             "costs": costs,
@@ -393,6 +398,8 @@ def snapshot():
             "checkpoint": _checkpoint.snapshot(),
             "histograms": _histogram.snapshot(),
             "stepstats": _stepstats.snapshot(),
+            "serving": _serving.snapshot() if _serving is not None
+            else {"enabled": False},
             "identity": process_identity()}
 
 
@@ -469,6 +476,10 @@ def _render(snap, top=None):
     lines.extend(_render_costs(snap, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
     lines.extend(_render_health(snap.get("health") or {}))
+    serving = snap.get("serving") or {}
+    if serving.get("enabled"):
+        lines.extend(_render_serving(serving,
+                                     snap.get("histograms") or {}))
     lines.extend(_render_hists(snap.get("histograms") or {}))
     return "\n".join(lines)
 
@@ -563,6 +574,56 @@ def _render_memory(mem):
         lines.append("%-28s %10s %8d %10s %10s" % (
             name[:28], _fmt(b["live_bytes"], 1e6), b["live_count"],
             _fmt(b["peak_bytes"], 1e6), _fmt(b["allocated_bytes"], 1e6)))
+    return lines
+
+
+def _render_serving(serving, hists):
+    """The "Inference serving" section of ``report()`` / diag-dump
+    rendering and of ``tools/diagnose.py --serving``: totals, derived
+    QPS, per-bucket occupancy, rejection counts, and the ``serve:*``
+    latency percentiles from the shared histogram section."""
+    lines = ["", "Inference serving (continuous batching)"]
+    rej = serving.get("rejected") or {}
+    lines.append("%d request(s) / %d sample(s) in %d batch(es); "
+                 "buckets %s; %d bucket executable build(s); "
+                 "QPS %s; mean occupancy %s; queue depth %d"
+                 % (serving.get("requests", 0),
+                    serving.get("samples", 0),
+                    serving.get("batches", 0),
+                    serving.get("buckets"),
+                    serving.get("bucket_compiles", 0),
+                    _fmt(serving.get("qps")),
+                    _fmt(serving.get("mean_occupancy")),
+                    serving.get("queue_depth", 0)))
+    lines.append("rejected: %d queue-full, %d non-finite, %d bad-shape; "
+                 "%d padded row(s) total"
+                 % (rej.get("queue", 0), rej.get("nonfinite", 0),
+                    rej.get("shape", 0), serving.get("padded_rows", 0)))
+    per_bucket = serving.get("per_bucket") or {}
+    if per_bucket:
+        lines.append("%-10s %9s %9s %10s %10s"
+                     % ("Bucket", "Batches", "Samples", "Occupancy",
+                        "p99 ms"))
+        for b in sorted(per_bucket, key=int):
+            v = per_bucket[b]
+            h = hists.get("serve:batch:b%s" % b) or {}
+            occ = v["samples"] / (int(b) * v["batches"]) \
+                if v["batches"] else 0.0
+            lines.append("%-10s %9d %9d %9.0f%% %10s"
+                         % (b, v["batches"], v["samples"], occ * 100,
+                            _fmt_ms(h.get("p99"))))
+    lat = [(name, hists[name]) for name in
+           ("serve:queue_wait", "serve:batch", "serve:e2e")
+           if hists.get(name)]
+    for name, h in lat:
+        lines.append("%-18s count %6d  mean %sms  p50 %sms  p99 %sms  "
+                     "max %sms"
+                     % (name, h.get("count", 0), _fmt_ms(h.get("mean")),
+                        _fmt_ms(h.get("p50")), _fmt_ms(h.get("p99")),
+                        _fmt_ms(h.get("max"))))
+    if not lat:
+        lines.append("(no serve:* latency series — histograms were off "
+                     "during the run)")
     return lines
 
 
@@ -1021,7 +1082,7 @@ def _comparable_metrics(dump, min_seconds):
                                      "counter")
     for key in ("kvstore_retries", "kvstore_dup_suppressed",
                 "kvstore_dead_shard_warnings", "health_seconds",
-                "monitor_seconds"):
+                "monitor_seconds", "serve_rejected"):
         v = counters.get(key, 0)
         # the *_seconds counters are time-like: below the noise floor
         # they are pure clock jitter, not a verdict-worthy signal
@@ -1036,6 +1097,14 @@ def _comparable_metrics(dump, min_seconds):
         "peak_bytes", 0)
     if peak:
         out["memory:peak_bytes"] = (peak / 1e6, "MB", "memory")
+    # serving throughput, oriented up-is-worse (ms per served sample):
+    # a QPS regression between two load runs fails --compare like any
+    # latency regression (the serve:* histogram rows above carry the
+    # percentile side)
+    serving = snap.get("serving") or {}
+    qps = serving.get("qps")
+    if qps:
+        out["serving:ms_per_sample"] = (1e3 / qps, "ms", "serving")
     return out
 
 

@@ -440,6 +440,49 @@ def test_prior_round_values_skips_failed_round_records(tmp_path,
     assert got == ("BENCH_r03.json", 2328.04, None)
 
 
+def test_serving_layer_costs_training_imports_nothing():
+    """PR 12 gate: the serving subsystem must be pay-for-use.  (a) A
+    training process never imports it — ``import mxnet_tpu`` leaves
+    ``mxnet_tpu.serving`` out of sys.modules (runtime_stats reads the
+    serving section via sys.modules, never an import), so an idle/
+    absent server adds ZERO import cost to training.  (b) Importing the
+    module is inert: no threads, no histogram enablement, no counters —
+    costs start only when an InferenceServer is constructed."""
+    import subprocess
+    import sys as _sys
+    import threading
+
+    from conftest import hermetic_subprocess_env
+
+    r = subprocess.run(
+        [_sys.executable, "-c",
+         "import mxnet_tpu, sys; "
+         "assert 'mxnet_tpu.serving' not in sys.modules, "
+         "'training imports pulled in the serving layer'"],
+        capture_output=True, text=True, timeout=300,
+        env=hermetic_subprocess_env(REPO))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    import importlib
+
+    from mxnet_tpu import histogram, runtime_stats
+
+    hist_was_on = histogram.is_enabled()
+    threads_before = {t.name for t in threading.enumerate()}
+    counters_before = dict(runtime_stats.snapshot()["counters"])
+    importlib.import_module("mxnet_tpu.serving")
+    assert histogram.is_enabled() == hist_was_on, \
+        "importing serving must not flip histogram collection"
+    new_threads = {t.name for t in threading.enumerate()} \
+        - threads_before
+    assert not any(n.startswith("mxtpu-serve") for n in new_threads), \
+        "importing serving must not start threads"
+    after = runtime_stats.snapshot()["counters"]
+    assert not any(k.startswith("serve") for k in set(after)
+                   - set(counters_before)), \
+        "importing serving must not record counters"
+
+
 def test_disabled_heartbeat_and_seq_stamp_overhead_bound(ps_server):
     """PR 9 gate: self-healing must be pay-for-use.  Without
     MXNET_TPU_KV_DEADLINE (the default) the client starts NO heartbeat

@@ -44,6 +44,11 @@ def parse_args():
                    help='Render only the numerics-health / flight-recorder '
                         'section of the dump (works on full diag dumps and on '
                         'standalone flight-recorder dumps).')
+    p.add_argument('--serving', action='store_true',
+                   help='Render only the inference-serving section (per-'
+                        'bucket occupancy, rejection counts, serve:* latency '
+                        'percentiles) from a MXNET_TPU_DIAG dump (--diag / '
+                        '$MXNET_TPU_DIAG) or from this live process.')
     p.add_argument('--cluster', nargs='+', metavar='DUMP',
                    help='Merge several per-rank MXNET_TPU_DIAG dumps (files '
                         'or a directory of *.json) into one cluster report: '
@@ -191,6 +196,38 @@ def check_telemetry(diag_path=None, health_only=False):
         print('\n'.join(runtime_stats._render_health(health.snapshot())))
         return
     print(runtime_stats.report())
+
+
+def check_serving(diag_path=None):
+    """Serving view: the continuous-batching section (per-bucket
+    occupancy, rejection counts, serve:* latency percentiles) of a
+    MXNET_TPU_DIAG dump, or of this live process when no dump is given
+    (docs/SERVING.md).  Returns 0, or 2 when the dump names no serving
+    run — a load test asserting on this view must not silently pass on
+    an empty section."""
+    _section('Inference Serving')
+    import json
+    from mxnet_tpu import runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        with open(diag_path) as f:
+            data = json.load(f)
+        snap = data.get('snapshot', data)
+    else:
+        if diag_path:
+            print('Diag dump    : %s (not written yet)' % diag_path)
+        snap = runtime_stats.snapshot()
+    serving = snap.get('serving') or {}
+    if not serving.get('enabled'):
+        print('(no serving run in this %s — construct an '
+              'InferenceServer, or point --diag at a load run\'s dump)'
+              % ('dump' if diag_path else 'process'))
+        return 2
+    print('\n'.join(runtime_stats._render_serving(
+        serving, snap.get('histograms') or {})))
+    return 0
 
 
 def check_os():
@@ -423,6 +460,9 @@ def main():
         if args.merge_traces:
             merge_traces(args.merge_traces, args.out)
         return
+    if args.serving:
+        # focused serving view: skip the platform sections
+        sys.exit(check_serving(args.diag))
     if args.health:
         # focused view for numerics triage: skip the platform sections
         check_telemetry(args.diag, health_only=True)
