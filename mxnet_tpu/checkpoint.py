@@ -368,6 +368,121 @@ class CheckpointManager:
                     "aux": aux}
         return self._submit(snapshot)
 
+    def save_sharded(self, step, shard_files, aux=None):
+        """Commit a SHARDED checkpoint: per-rank payload files under ONE
+        global manifest (the ZeRO weight-update-sharding persistence
+        path — each rank writes only the 1/n of params + optimizer
+        state it owns, so checkpoint I/O shrinks with the data).
+
+        ``shard_files`` maps file stem → picklable payload for the
+        ranks THIS process owns.  Every process stages into the same
+        deterministic directory (``<final>.tmp-shared`` — covered by
+        the stale-tmp prune on crash), fsyncs its own files, then joins
+        a ``host_allreduce`` barrier; process 0 ALONE then checksums
+        everything staged, writes the single manifest (shard filenames
+        in ``shard_files``, per-file SHA-256 in ``files`` so
+        :meth:`verify`/:meth:`latest` gain corruption detection for
+        free) and performs the atomic rename — the rank-0 commit
+        barrier.  A SIGKILL anywhere before that rename leaves only a
+        staging dir the next manager init removes; the previous valid
+        checkpoint is untouched.  Synchronous by design: shard payloads
+        are already host numpy (1/n sized), and the commit barrier must
+        not race the next step's donation."""
+        import jax
+        import numpy as np
+
+        from . import random as _random
+        from .parallel.mesh import host_allreduce
+
+        t0 = time.perf_counter()
+        step = int(step)
+        final = os.path.join(self.directory,
+                             "%s-%08d" % (self.prefix, step))
+        tmp = final + ".tmp-shared"
+        proc0 = jax.process_index() == 0
+        if proc0:
+            # a stale staging dir from a crashed attempt would leak its
+            # files into this manifest (the listdir below) — clear it
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+        host_allreduce(1.0)     # staging dir exists and is clean
+        try:
+            for name, payload in shard_files.items():
+                fpath = os.path.join(tmp, name + ".pkl")
+                with open(fpath, "wb") as f:
+                    pickle.dump(payload, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+            host_allreduce(1.0)  # every rank's shard files are durable
+            if not proc0:
+                return final
+            files = {}
+            shard_names = sorted(os.listdir(tmp))
+            for name in shard_names:
+                fpath = os.path.join(tmp, name)
+                files[name] = {"sha256": _sha256(fpath),
+                               "bytes": os.path.getsize(fpath)}
+            # empty params.npz keeps whole-checkpoint readers
+            # (load_params, external tools) working unchanged
+            ppath = os.path.join(tmp, "params.npz")
+            with open(ppath, "wb") as f:
+                np.savez(f)
+                f.flush()
+                os.fsync(f.fileno())
+            files["params.npz"] = {"sha256": _sha256(ppath),
+                                   "bytes": os.path.getsize(ppath)}
+            if aux is not None:
+                apath = os.path.join(tmp, "aux.pkl")
+                with open(apath, "wb") as f:
+                    pickle.dump(aux, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+                files["aux.pkl"] = {"sha256": _sha256(apath),
+                                    "bytes": os.path.getsize(apath)}
+            manifest = {"version": MANIFEST_VERSION, "step": step,
+                        "time": time.time(), "pid": os.getpid(),
+                        "files": files, "params": [],
+                        "has_trainer": False,
+                        "has_aux": aux is not None,
+                        "shard_files": shard_names,
+                        "rng": dict(_random.get_state()),
+                        "probe": self._probe(), "extra": None,
+                        "lineage": {"previous":
+                                    self.last_good["path"]
+                                    if self.last_good else None}}
+            mpath = os.path.join(tmp, MANIFEST_NAME)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, default=repr)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            retired = None
+            if os.path.isdir(final):
+                retired = "%s.retire-%d-%d" % (final, os.getpid(),
+                                               next(_tmp_seq))
+                os.replace(final, retired)
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+            if retired is not None:
+                shutil.rmtree(retired, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self.last_good = {"path": final, "step": step}
+        self.totals["saves"] += 1
+        self.totals["written"] += 1
+        _rts.inc("checkpoint_saves")
+        _rts.inc("checkpoint_writes")
+        _rts.inc("checkpoint_sharded_saves")
+        write_seconds = time.perf_counter() - t0
+        _rts.inc("checkpoint_write_seconds", write_seconds)
+        if _histogram._state["on"]:
+            _histogram.observe("checkpoint:write", write_seconds)
+        self._prune()
+        return final
+
     def _submit(self, snapshot):
         snapshot["probe"] = self._probe()
         snapshot["time"] = time.time()
@@ -683,6 +798,22 @@ class CheckpointManager:
         directories you trust)."""
         return load_aux(manifest)
 
+    def load_shard_files(self, manifest):
+        """``{rank: payload}`` from a sharded checkpoint's per-rank
+        files (see :meth:`save_sharded`).  Rank indices are parsed from
+        the ``<stem>-<rank>-of-<n>`` filename convention; checksums
+        were already verified by ``latest()``/``verify`` before the
+        manifest was handed out."""
+        pat = re.compile(r"-(\d+)-of-(\d+)(?:\.pkl)?$")
+        out = {}
+        for name in manifest.get("shard_files", []):
+            m = pat.search(name)
+            if not m:
+                continue
+            with open(os.path.join(manifest["path"], name), "rb") as f:
+                out[int(m.group(1))] = pickle.load(f)
+        return out
+
     def restore(self, trainer=None, block=None, manifest=None):
         """One-call auto-resume: load the newest valid checkpoint back
         into a ``Trainer`` (parameters by name, updater state, optimizer
@@ -870,13 +1001,39 @@ def load_aux(manifest):
         return pickle.load(f)
 
 
-def auto_resume(trainer=None, block=None):
+def auto_resume(trainer=None, block=None, zero_step=None):
     """One call: restore the newest valid checkpoint from the global
     manager into ``trainer``/``block``.  Returns the resumed step (int)
-    or None when checkpointing is off or nothing valid exists."""
+    or None when checkpointing is off or nothing valid exists.
+
+    ``zero_step`` (a ``GluonStep(..., zero=True)`` or
+    ``ZeroCompiledStep``) selects the SHARDED resume path instead: the
+    newest valid checkpoint's per-rank shard files are loaded and
+    re-sharded onto the current mesh layout (``restore_zero`` — a run
+    saved at one dp width resumes at another).  A newest checkpoint
+    that is not sharded restores nothing (warned, returns None) rather
+    than silently mixing the two formats."""
     mgr = manager()
     if mgr is None:
         return None
+    if zero_step is not None:
+        mgr.wait()
+        manifest = mgr.latest()
+        if manifest is None:
+            return None
+        if not manifest.get("shard_files"):
+            warn_rate_limited(
+                _logger(), "checkpoint:notsharded:%s" % manifest["path"],
+                60, "auto_resume(zero_step=): newest checkpoint %s is "
+                "not sharded — nothing restored (save with save_zero "
+                "or pass trainer=/block= for the replicated format)",
+                manifest["path"])
+            return None
+        step = zero_step.restore_zero(manifest, mgr=mgr)
+        mgr.step_clock = step
+        mgr.last_good = {"path": manifest["path"], "step": step}
+        _rts.inc("checkpoint_restores")
+        return step
     manifest = mgr.restore(trainer=trainer, block=block)
     return None if manifest is None else int(manifest.get("step", 0))
 

@@ -39,9 +39,16 @@ Contract
   vs compiled numerics agree to the bit for the fused-kernel
   optimizers.
 - Supported optimizers declare ``compiled_step_safe = True`` (SGD,
-  NAG, Signum, Adam, Adamax, FTML, Ftrl, RMSProp); the rest — host
-  syncs (LBSGD), cross-step host recurrences (Nadam), raw host-scalar
-  NDArray math — keep the eager path and raise a clear error here.
+  NAG, Signum, Adam, Adamax, FTML, Ftrl, RMSProp, AdaGrad, AdaDelta);
+  the rest — host syncs (LBSGD), cross-step host recurrences (Nadam),
+  raw host-scalar NDArray math — keep the eager path and raise a clear
+  error here.
+- ``compile_step(..., zero=True)`` / ``MXNET_TPU_ZERO=1`` routes the
+  same seam through :class:`ZeroCompiledStep`: the fused program with
+  ZeRO weight-update sharding over the 'dp' mesh axis — grads
+  reduce-scattered to 1/n shards, the update on each device's
+  param+state shard, updated params all-gathered inside the program
+  (parallel/gluon_step.py zero path; docs/ZERO.md).
 - The eager path stays the untouched default and the
   debugging/interop mode; ``MXNET_TPU_COMPILED_STEP=1``
   (:func:`env_enabled`) is the opt-in for bench/launch wiring.
@@ -70,8 +77,8 @@ from .ndarray import NDArray
 from .optimizer import optimizer as _opt
 from .ops import registry as _registry
 
-__all__ = ["CompiledStep", "compile_step", "env_enabled",
-           "donation_active", "cost_snapshot"]
+__all__ = ["CompiledStep", "ZeroCompiledStep", "compile_step",
+           "env_enabled", "donation_active", "cost_snapshot"]
 
 # live CompiledStep instances, for the read-side cost aggregation
 # (runtime_stats.snapshot merges cost_snapshot() into its "costs"
@@ -101,10 +108,54 @@ def env_enabled():
     return os.environ.get("MXNET_TPU_COMPILED_STEP") == "1"
 
 
-def compile_step(block, loss, trainer):
+def compile_step(block, loss, trainer, zero=None, mesh=None):
     """Compile ``block`` + ``loss`` + ``trainer``'s optimizer into one
-    donated whole-step XLA program (see module docstring)."""
+    donated whole-step XLA program (see module docstring).
+
+    ``zero=True`` (default from ``MXNET_TPU_ZERO=1``) routes through
+    :class:`ZeroCompiledStep` — the same fused program with ZeRO
+    weight-update sharding over the 'dp' mesh axis (docs/ZERO.md);
+    ``mesh`` optionally pins the device mesh for that path."""
+    if zero is None:
+        from .parallel.gluon_step import zero_env_enabled
+        zero = zero_env_enabled()
+    if zero:
+        return ZeroCompiledStep(block, loss, trainer, mesh=mesh)
     return CompiledStep(block, loss, trainer)
+
+
+def _guard_trainer(trainer, zero=False):
+    """The shared compile-time eligibility checks: a traceable
+    fused-kernel optimizer, updates running locally (not on kvstore
+    servers / across processes), and — for the single-program
+    replicated path only — a single context."""
+    opt = trainer._optimizer
+    if not getattr(opt, "compiled_step_safe", False):
+        raise MXNetError(
+            "compiled_step: optimizer %s is not compiled-step safe "
+            "(host syncs, cross-step host recurrences, or raw "
+            "host-scalar math in update()); supported: SGD, NAG, "
+            "Signum, Adam, Adamax, FTML, Ftrl, RMSProp, AdaGrad, "
+            "AdaDelta.  Use the eager Trainer path instead."
+            % type(opt).__name__)
+    if trainer._update_on_kvstore:
+        raise MXNetError(
+            "compiled_step: updates run on the kvstore servers "
+            "(update_on_kvstore=True) — the update cannot be traced "
+            "into a device program; use the eager path")
+    kv_type = trainer._kvstore_type
+    kv_name = kv_type if isinstance(kv_type, str) \
+        else getattr(kv_type, "type", "") or ""
+    if "dist" in kv_name:
+        raise MXNetError(
+            "compiled_step: dist kvstore training is not compiled "
+            "(gradients must cross processes); use the eager path "
+            "or the sharded parallel/gluon_step.py step")
+    if not zero and len(trainer._contexts) > 1:
+        raise MXNetError(
+            "compiled_step: multi-context (per-device replica) "
+            "training is not compiled; use parallel/gluon_step.py "
+            "for the sharded whole-step path")
 
 
 class _Entry:
@@ -158,31 +209,7 @@ class CompiledStep:
         self.loss_block = loss
         self.trainer = trainer
         opt = trainer._optimizer
-        if not getattr(opt, "compiled_step_safe", False):
-            raise MXNetError(
-                "compiled_step: optimizer %s is not compiled-step safe "
-                "(host syncs, cross-step host recurrences, or raw "
-                "host-scalar math in update()); supported: SGD, NAG, "
-                "Signum, Adam, Adamax, FTML, Ftrl, RMSProp.  Use the "
-                "eager Trainer path instead." % type(opt).__name__)
-        if trainer._update_on_kvstore:
-            raise MXNetError(
-                "compiled_step: updates run on the kvstore servers "
-                "(update_on_kvstore=True) — the update cannot be traced "
-                "into a device program; use the eager path")
-        kv_type = trainer._kvstore_type
-        kv_name = kv_type if isinstance(kv_type, str) \
-            else getattr(kv_type, "type", "") or ""
-        if "dist" in kv_name:
-            raise MXNetError(
-                "compiled_step: dist kvstore training is not compiled "
-                "(gradients must cross processes); use the eager path "
-                "or the sharded parallel/gluon_step.py step")
-        if len(trainer._contexts) > 1:
-            raise MXNetError(
-                "compiled_step: multi-context (per-device replica) "
-                "training is not compiled; use parallel/gluon_step.py "
-                "for the sharded whole-step path")
+        _guard_trainer(trainer)
         params = list(block.collect_params().values())
         self.trainable = [p for p in params if p.grad_req != "null"]
         self.aux = [p for p in params if p.grad_req == "null"]
@@ -468,6 +495,161 @@ class CompiledStep:
             _prof.add_event("dispatch:compiled_step", "operator", "X",
                             ts=t0, dur=dur, args=ev)
         return NDArray(loss_v, x_nd._ctx)
+
+
+class ZeroCompiledStep:
+    """``trainer.compile(block, loss, zero=True)``: the whole-step
+    program with ZeRO weight-update sharding (the
+    parallel/gluon_step.py zero path) behind the same ``step()`` /
+    telemetry contract as :class:`CompiledStep`.
+
+    Differences from the replicated CompiledStep:
+
+    - **Functional state**: params and optimizer state live as flat
+      1/n 'dp' shards inside the wrapped ``GluonTrainStep``, not in the
+      Gluon Parameters.  ``sync_to_params()`` writes them back, and
+      runs automatically on the step right before an auto-checkpoint
+      interval boundary so the captured parameter snapshot is fresh
+      (optimizer state in that snapshot is the sharded run's business:
+      use ``save_zero``/``restore_zero`` — the sharded checkpoint —
+      for a complete resumable unit, docs/ZERO.md).
+    - ``step()`` returns the mean loss (a scalar NDArray), not the
+      per-sample loss vector: the sharded step reduces the loss inside
+      the program.
+    - ``rescale_grad`` semantics: gradients leave the backward as
+      mean-of-batch (the sharded step differentiates the mean loss),
+      so the optimizer's effective rescale is ``trainer._scale`` — set
+      at build time and baked into the program; changing the scale
+      afterwards requires a rebuild and raises.
+    """
+
+    def __init__(self, block, loss, trainer, mesh=None):
+        from .parallel.gluon_step import GluonTrainStep
+
+        self.block = block
+        self.loss_block = loss
+        self.trainer = trainer
+        _guard_trainer(trainer, zero=True)
+        opt = trainer._optimizer
+        self._scale = float(trainer._scale)
+        opt.rescale_grad = self._scale
+        self._gstep = GluonTrainStep(block, loss, mesh=mesh, zero=True,
+                                     optimizer=opt)
+        self.zero_layout = self._gstep.zero_layout
+        self._cache = {}
+        _LIVE.add(self)
+
+    # -------------------------------------------------------- interop
+    def sync_to_params(self):
+        """Gather the sharded functional params off the mesh back into
+        the Gluon Parameters (checkpoint/eager-eval interop)."""
+        self._gstep.sync_to_params()
+
+    def save_zero(self, step, mgr=None):
+        return self._gstep.save_zero(step, mgr=mgr)
+
+    def restore_zero(self, manifest, mgr=None):
+        return self._gstep.restore_zero(manifest, mgr=mgr)
+
+    # ------------------------------------------------------------- step
+    def step(self, x, y):
+        """One fused ZeRO training step; returns the mean loss (async).
+        Same per-step instrumentation as ``CompiledStep.step`` (see
+        its docstring) plus the ``zero_*`` collective-bytes counters
+        the wrapped sharded step emits."""
+        from .gluon.trainer import _StepTelemetry
+
+        _rts.inc("trainer_steps")
+        _rts.inc("compiled_step_steps")
+        hm = _health.monitor() if _health._state["on"] else None
+        batch_size = int(x.shape[0]) if hasattr(x, "shape") else None
+        with _StepTelemetry(self.trainer, batch_size, hm, compiled=True):
+            return self._step_impl(x, y)
+
+    def _step_impl(self, x, y):
+        import numpy as np
+
+        if float(self.trainer._scale) != self._scale:
+            raise MXNetError(
+                "zero compiled step: the loss scale changed (%s -> %s) "
+                "after the program baked it — rebuild with "
+                "trainer.compile(..., zero=True)"
+                % (self._scale, self.trainer._scale))
+        xv = getattr(x, "_data", x)
+        yv = getattr(y, "_data", y)
+        xq, yq = self._gstep.put_batch(np.asarray(xv), np.asarray(yv))
+        key = (tuple(xq.shape), str(xq.dtype),
+               tuple(yq.shape), str(yq.dtype))
+        entry = self._cache.get(key)
+        hit = entry is not None
+        timed = _prof._state["running"] or _rts.DIAG_TIMING
+        t0 = _prof._now_us() if (timed or not hit) else 0
+        if not hit:
+            _rts.record_dispatch("compiled_step", "miss")
+            _rts.record_compile_key("compiled_step", key)
+            entry = _Entry(self._gstep._step, 0)
+            self._cache[key] = entry
+        else:
+            _rts.record_dispatch("compiled_step", "hit")
+
+        loss = self._gstep(xq, yq)
+
+        dur = (_prof._now_us() - t0) if (timed or not hit) else 0
+        if not hit:
+            _rts.add_compile_seconds("compiled_step", dur / 1e6)
+            self._analyze(entry, (xq, yq))
+        elif timed:
+            _rts.add_compiled_step_seconds(dur / 1e6)
+        if _prof._state["running"]:
+            ev = {"op": "compiled_step", "zero": True,
+                  "cache": "hit" if hit else "miss"}
+            if not hit:
+                ev["compile_ms"] = round(dur / 1e3, 3)
+            _prof.add_event("dispatch:compiled_step", "operator", "X",
+                            ts=t0, dur=dur, args=ev)
+
+        # auto-checkpoint fires in _StepTelemetry.__exit__ when this
+        # step crosses the interval boundary — the Gluon Parameters
+        # must carry THIS step's values by then (the functional shards
+        # are the source of truth otherwise)
+        from . import checkpoint as _ckpt
+
+        mgr = _ckpt.manager()
+        if mgr is not None and mgr.interval \
+                and (mgr.step_clock + 1) % mgr.interval == 0:
+            self._gstep.sync_to_params()
+        return NDArray(loss)
+
+    def _analyze(self, entry, batch):
+        """AOT cost/memory capture of the sharded program (the
+        CompiledStep._analyze convention) — feeds the diag-dump cost
+        section the perfdoctor zero rule reads."""
+        if not _registry.cost_capture_active():
+            return
+        import time as _time
+
+        import jax
+
+        g = self._gstep
+        t0 = _time.perf_counter()
+        try:
+            def spec(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            key = jax.random.PRNGKey(0)  # shape/dtype stand-in only
+            args = [tuple(spec(v) for v in g.train_vals),
+                    tuple(spec(v) for v in g.opt_state),
+                    tuple(spec(v) for v in g.aux_vals),
+                    spec(batch[0]), spec(batch[1]), spec(key)]
+            if g._opt_update is not None:
+                args.append(tuple(0.0 for _ in g._opt_update.slots))
+            compiled = g._step.lower(*args).compile()
+            entry.cost = _registry.compiled_cost(compiled)
+        except Exception:  # analysis must never break the step
+            entry.cost = None
+        _rts.inc("cost_analysis_entries" if entry.cost
+                 else "cost_analysis_failures")
+        _rts.inc("cost_analysis_seconds", _time.perf_counter() - t0)
 
 
 def _as_jax(a):

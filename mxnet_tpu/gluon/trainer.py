@@ -204,17 +204,25 @@ class Trainer:
         return self._optimizer
 
     # ------------------------------------------------------- compiled step
-    def compile(self, block, loss):
+    def compile(self, block, loss, zero=None, mesh=None):
         """Fuse ``block``'s forward + ``loss`` + backward + this
         trainer's optimizer update into ONE donated XLA program
         (``compiled_step.CompiledStep``): ``cs = trainer.compile(net,
         loss_fn)`` then ``cs.step(x, y)`` replaces the whole
         ``record()/backward()/step()`` iteration.  The eager path stays
         the default/debug mode; see docs/COMPILED_STEP.md for the
-        donation/rebind contract and the supported-optimizer set."""
+        donation/rebind contract and the supported-optimizer set.
+
+        ``zero=True`` (default from ``MXNET_TPU_ZERO=1``) builds the
+        same fused program with ZeRO weight-update sharding over the
+        'dp' mesh axis — params and optimizer state live as 1/n
+        per-device shards inside the program
+        (``compiled_step.ZeroCompiledStep``, docs/ZERO.md); ``mesh``
+        optionally pins the device mesh for that path."""
         from .. import compiled_step as _compiled
 
-        return _compiled.compile_step(block, loss, self)
+        return _compiled.compile_step(block, loss, self, zero=zero,
+                                      mesh=mesh)
 
     # ------------------------------------------------------------ step
     def step(self, batch_size, ignore_stale_grad=False):

@@ -220,7 +220,14 @@ def _cum_totals():
             # windows coherent when per-op warm-dispatch deltas
             # collapse to ~1 call/step — a sample showing zero misses
             # and compiled_steps=1 reads as "fused", not "idle"
-            "compiled_steps": c.get("compiled_step_steps", 0)}
+            "compiled_steps": c.get("compiled_step_steps", 0),
+            # ZeRO weight-update sharding collective traffic
+            # (parallel/gluon_step.py): per-window byte deltas make
+            # all-gather growth visible in the same timeline the
+            # perfdoctor trend rules read
+            "zero_steps": c.get("zero_steps", 0),
+            "zero_allgather_bytes": c.get("zero_allgather_bytes", 0),
+            "zero_reduce_bytes": c.get("zero_reduce_bytes", 0)}
 
 
 def _jit_cache_size():

@@ -121,6 +121,37 @@ def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
     return weight + new_delta, new_n, new_g, new_delta
 
 
+@register("adagrad_update", num_outputs=2, traced_attrs=("lr", "wd"))
+def adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """AdaGrad over the dense accumulated-square history (reference:
+    optimizer_op.cc adagrad semantics; Duchi et al. 2011); one fused
+    kernel on both the eager and whole-step-compiled paths, so the two
+    agree to the bit; returns (weight', history')."""
+    g = _apply_wd_rescale(weight, grad, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None, wd)
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
+
+
+@register("adadelta_update", num_outputs=3, traced_attrs=("lr", "wd"))
+def adadelta_update(weight, grad, acc_g, acc_delta, lr=0.01, rho=0.9,
+                    epsilon=1e-5, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **_):
+    """AdaDelta (Zeiler 2012): the ratio of running RMS accumulators
+    sets the step, no lr in the update itself (``lr`` is accepted so
+    the shared fused-call protocol fits, and ignored, as in the
+    reference); wd decays the weight directly; returns (weight',
+    acc_g', acc_delta')."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_acc_g = rho * acc_g + (1.0 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1.0 - rho) * jnp.square(delta)
+    return weight - delta - wd * weight, new_acc_g, new_acc_delta
+
+
 @register("signsgd_update", traced_attrs=("lr", "wd"))
 def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
     """SignSGD: step by the SIGN of the gradient only,

@@ -654,6 +654,12 @@ class Nadam(Optimizer):
 class AdaGrad(Optimizer):
     """reference: optimizer.py AdaGrad."""
 
+    # dense path runs the fused adagrad_update kernel reading lr/wd
+    # through the feed-aware accessors — traceable into the whole-step
+    # program (the row_sparse branch never triggers under a trace:
+    # traced grads are dense)
+    compiled_step_safe = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -678,12 +684,8 @@ class AdaGrad(Optimizer):
             weight._assign(outs[0]._data)
             state._assign(outs[1]._data)
             return
-        g = grad * self.rescale_grad
-        if self.clip_gradient:
-            g = g.clip(-self.clip_gradient, self.clip_gradient)
-        g = g + wd * weight
-        state[:] = state + g * g
-        weight[:] = weight - lr * g / ((state ** 0.5) + self.float_stable_eps)
+        _fused("adagrad_update", index, weight, grad, [state], self,
+               epsilon=self.float_stable_eps)
 
 
 @register
@@ -733,6 +735,10 @@ class RMSProp(Optimizer):
 class AdaDelta(Optimizer):
     """reference: optimizer.py AdaDelta."""
 
+    # fused adadelta_update kernel, wd via the feed-aware accessor, no
+    # lr in the step math — traceable into the whole-step program
+    compiled_step_safe = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -744,16 +750,9 @@ class AdaDelta(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        wd = self._get_wd(index)
-        g = grad * self.rescale_grad
-        if self.clip_gradient:
-            g = g.clip(-self.clip_gradient, self.clip_gradient)
         acc_g, acc_delta = state
-        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * g * g
-        current_delta = ((acc_delta + self.epsilon) ** 0.5
-                         / (acc_g + self.epsilon) ** 0.5) * g
-        acc_delta[:] = self.rho * acc_delta + (1.0 - self.rho) * current_delta ** 2
-        weight[:] = weight - current_delta - wd * weight
+        _fused("adadelta_update", index, weight, grad, [acc_g, acc_delta],
+               self, rho=self.rho, epsilon=self.epsilon)
 
 
 class Updater:

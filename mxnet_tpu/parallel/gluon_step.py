@@ -7,19 +7,60 @@ reference splits this across GraphExecutor fwd/bwd + KVStore push/pull
 + python optimizer updates (SURVEY.md §3.1/§3.4); GSPMD inserts the
 gradient all-reduce over the 'dp' mesh axis automatically, riding ICI.
 
+ZeRO weight-update sharding (``zero=True`` / ``MXNET_TPU_ZERO=1``,
+Xu et al. arXiv:2004.13336): instead of every device holding the full
+replicated parameters + optimizer state, each parameter is flattened,
+padded to a multiple of the 'dp' axis size n, and laid out as 1-D
+shards — each device owns exactly 1/n of every parameter and of every
+optimizer-state leaf (state is *born* on that layout, never
+materialized replicated).  Inside the one donated program the flat
+shards are constrained to replicated for the forward (GSPMD emits the
+param all-gather, overlapped with forward compute), the backward's
+summed gradients are constrained back to the 1/n layout (the
+reduce-scatter; on some backends GSPMD expresses it as
+all-reduce + slice — semantically identical), and the optimizer update
+runs elementwise on the shards.  The math is unchanged — elementwise
+updates commute with sharding — so the step is bit-exact vs the
+unsharded dp step.  Docs: docs/ZERO.md.
+
+``optimizer=`` accepts any ``compiled_step_safe`` Optimizer (SGD, NAG,
+Signum, Adam, Adamax, FTML, Ftrl, RMSProp, AdaGrad, AdaDelta): the
+real fused-kernel update is traced into the step, with per-step
+scalars (scheduler lr, bias corrections, t) refilled host-side each
+call — the compiled_step.py protocol.  The default stays the fused
+sgd-momentum closure.
+
 Used by bench.py, __graft_entry__.py and the multi-chip Trainer path.
 """
 
 from __future__ import annotations
 
+import os
+
 import numpy as _np
 
 from .. import autograd
+from .. import health as _health
 from .. import random as _random
+from .. import runtime_stats as _rts
+from ..base import MXNetError
 from ..gluon.block import staged_call
 from ..ndarray import NDArray
 
-__all__ = ["GluonTrainStep", "sgd_momentum_init", "sgd_momentum_update"]
+__all__ = ["GluonTrainStep", "GluonStep", "sgd_momentum_init",
+           "sgd_momentum_update", "zero_env_enabled"]
+
+
+def zero_env_enabled():
+    """True when ``MXNET_TPU_ZERO=1`` asks training wiring to run the
+    ZeRO weight-update-sharded step (docs/ZERO.md)."""
+    return os.environ.get("MXNET_TPU_ZERO") == "1"
+
+
+def _padded_size(size, n):
+    """``size`` rounded up to a multiple of ``n`` — the flat-shard
+    granularity (each of the n devices owns padded/n elements)."""
+    return -(-size // n) * n
 
 
 def _pure_loss_builder(block, loss_block, trainable, aux,
@@ -72,6 +113,128 @@ def sgd_momentum_update(lr, momentum=0.9, wd=0.0):
     return update
 
 
+def _global_grad_norm(grads):
+    """Fused global grad L2 norm over RAVELED f32 views — the same
+    reduction shape on the dp and ZeRO paths (full vs flat-padded
+    grads; the pads are exact zeros), so the two paths' health
+    trajectories agree bit for bit."""
+    import jax.numpy as jnp
+
+    if not grads:
+        return jnp.zeros((), jnp.float32)
+    total = None
+    for g in grads:
+        s = jnp.sum(jnp.square(jnp.ravel(g).astype(jnp.float32)))
+        total = s if total is None else total + s
+    return jnp.sqrt(total)
+
+
+class _OptimizerUpdate:
+    """The real fused-kernel ``Optimizer`` traced into the functional
+    step — compiled_step.py's updater-tracing idiom, functional-state
+    edition.
+
+    State trees are discovered from 1-element probe weights, never a
+    full-size replicated materialization: that is what lets the ZeRO
+    path allocate the real leaves directly onto their 1/n shard layout
+    (state sharded from step 0).  Probe leaves must be zero-initialized
+    — true for every compiled-step-safe optimizer; anything else would
+    need a replicated materialization first and raises instead.
+    Per-step scalars (scheduler lr, Adam bias correction, ``t``) are
+    recomputed host-side each step by :meth:`host_scalars` and enter
+    the jitted program as traced arguments via ``scalar_feed``, so
+    schedules never recompile and eager vs functional numerics agree
+    to the bit.
+    """
+
+    def __init__(self, optimizer, dtypes):
+        import jax.numpy as jnp
+
+        from ..compiled_step import _state_leaves
+
+        if not getattr(optimizer, "compiled_step_safe", False):
+            raise MXNetError(
+                "GluonTrainStep(optimizer=...): %s is not compiled-step "
+                "safe (host syncs, cross-step host recurrences, or raw "
+                "host-scalar math in update()) — see compiled_step.py "
+                "for the supported set" % type(optimizer).__name__)
+        self.opt = optimizer
+        self.templates = []        # per-index probe state tree
+        self.leaf_dtypes = []      # per-index [leaf dtype, ...]
+        for i, dt in enumerate(dtypes):
+            probe = optimizer.create_state(i, NDArray(jnp.zeros((1,), dt)))
+            leaves = []
+            _state_leaves(probe, leaves)
+            for nd in leaves:
+                if float(_np.asarray(nd._data).sum()) != 0.0:
+                    raise MXNetError(
+                        "GluonTrainStep: %s state for parameter %d is "
+                        "not zero-initialized — it cannot be allocated "
+                        "directly onto a shard layout"
+                        % (type(optimizer).__name__, i))
+            self.templates.append(probe)
+            self.leaf_dtypes.append([nd._data.dtype for nd in leaves])
+        self.slots = [(i, name) for i in range(len(dtypes))
+                      for name in sorted(optimizer.step_scalars(i))]
+
+    def init_state(self, alloc):
+        """Flat state-leaf tuple via ``alloc(param_index, leaf_dtype)``
+        — the caller chooses placement (ZeRO passes jitted zeros with
+        sharded out_shardings, so leaves are born 1/n per device)."""
+        return tuple(alloc(i, dt)
+                     for i, dts in enumerate(self.leaf_dtypes)
+                     for dt in dts)
+
+    def host_scalars(self):
+        """Advance the host step counters and refill every per-step
+        scalar slot — one float per (index, name) — for the next call."""
+        opt = self.opt
+        table = {}
+        for i in range(len(self.templates)):
+            opt._update_count(i)
+            table[i] = opt.step_scalars(i)
+        return tuple(float(table[i][name]) for i, name in self.slots)
+
+    def apply(self, train_vals, grads, state_vals, scalars):
+        """Traced: run the real ``update()`` on NDArray views of the
+        traced values; returns (new train values, new state leaves)."""
+        from ..compiled_step import _rebuild_state, _state_leaves
+        from ..optimizer import optimizer as _optmod
+
+        it = iter(state_vals)
+        traced = [_rebuild_state(t, it) for t in self.templates]
+        feed = {(i, name): scalars[k]
+                for k, (i, name) in enumerate(self.slots)}
+        new_vals = []
+        with _optmod.scalar_feed(feed):
+            for j, (w, g) in enumerate(zip(train_vals, grads)):
+                w_nd, g_nd = NDArray(w), NDArray(g)
+                self.opt.update(j, w_nd, g_nd, traced[j])
+                new_vals.append(w_nd._data)
+        new_state = []
+        for t in traced:
+            leaves = []
+            _state_leaves(t, leaves)
+            new_state.extend(nd._data for nd in leaves)
+        return tuple(new_vals), tuple(new_state)
+
+
+def _put(vals, shard):
+    """Place functional values onto their shardings up front: committed
+    single-device arrays cannot be implicitly resharded by jit, and
+    this also avoids a first-step transfer.  jnp.array(copy=True)
+    first: device_put to an equivalent sharding aliases the source
+    buffer, and the first donated step would then delete the Gluon
+    Parameter's own array out from under the user."""
+    import jax
+    import jax.numpy as jnp
+
+    vals = tuple(jnp.array(v, copy=True) for v in vals)
+    if isinstance(shard, tuple):
+        return tuple(jax.device_put(v, s) for v, s in zip(vals, shard))
+    return tuple(jax.device_put(v, shard) for v in vals)
+
+
 class GluonTrainStep:
     """Compile a Gluon block + loss + optimizer into one sharded step.
 
@@ -83,11 +246,22 @@ class GluonTrainStep:
     conv path while keeping master weights and the update fp32 — the
     TPU-native analog of the reference's multi-precision SGD
     (mp_sgd_update, src/operator/optimizer_op.cc).
+
+    zero: weight-update sharding (module docstring) — params and
+    optimizer state live as flat 1/n 'dp' shards; default from
+    ``MXNET_TPU_ZERO``.  ``self.zero_layout`` describes the layout and
+    the per-step collective bytes (also fed into the
+    ``zero_allgather_bytes`` / ``zero_reduce_bytes`` runtime counters).
+
+    optimizer: a ``compiled_step_safe`` Optimizer instance traced into
+    the step (the real fused-kernel update); None keeps the fused
+    sgd-momentum closure built from ``lr/momentum/wd``.
     """
 
     def __init__(self, block, loss_block, mesh=None, lr=0.1, momentum=0.9,
                  wd=0.0, compute_dtype=None, param_spec_fn=None,
-                 data_spec=None, label_spec=None, aux_loss_weight=None):
+                 data_spec=None, label_spec=None, aux_loss_weight=None,
+                 zero=None, optimizer=None):
         import jax
         from jax.sharding import NamedSharding
 
@@ -96,49 +270,31 @@ class GluonTrainStep:
 
         self.block = block
         self.mesh = mesh or get_default_mesh()
+        self._zero = zero_env_enabled() if zero is None else bool(zero)
+        if self._zero and param_spec_fn is not None:
+            raise MXNetError(
+                "GluonTrainStep: zero=True owns the parameter layout "
+                "(flat 1-D 'dp' shards) and cannot compose with "
+                "param_spec_fn tensor sharding")
         params = list(block.collect_params().values())
         self.trainable = [p for p in params if p.grad_req != "null"]
         self.aux = [p for p in params if p.grad_req == "null"]
         self.train_vals = tuple(p.data().data_jax for p in self.trainable)
         self.aux_vals = tuple(p.data().data_jax for p in self.aux)
-        self.opt_state = sgd_momentum_init(self.train_vals)
-        self._update = sgd_momentum_update(lr, momentum, wd)
+        if optimizer is not None:
+            self._opt_update = _OptimizerUpdate(
+                optimizer, [v.dtype for v in self.train_vals])
+            self._update = None
+        else:
+            self._opt_update = None
+            self._update = sgd_momentum_update(lr, momentum, wd)
         self._compute_dtype = compute_dtype
+        self.last_grad_norm = None
         pure_loss = _pure_loss_builder(block, loss_block, self.trainable,
                                        self.aux,
                                        aux_loss_weight=aux_loss_weight)
 
-        cast = compute_dtype
-
-        def step(train_vals, opt_state, aux_vals, x, y, key):
-            def loss_of(tv):
-                if cast is not None:
-                    tv = tuple(v.astype(cast) if v.dtype == _np.float32 else v
-                               for v in tv)
-                    x_ = x.astype(cast)
-                else:
-                    x_ = x
-                return pure_loss(tv, aux_vals, x_, y, key)
-
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_vals)
-            grads = tuple(g.astype(v.dtype)
-                          for g, v in zip(grads, train_vals))
-            new_vals, new_state = self._update(train_vals, grads, opt_state)
-            return loss, new_vals, new_state, new_aux
-
         repl = replicated_sharding(self.mesh)
-        if param_spec_fn is None:
-            tv_shard = aux_shard = repl
-        else:
-            # per-parameter shardings (tensor parallelism etc.) — the
-            # optimizer state mirrors the parameter sharding
-            tv_shard = tuple(
-                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
-                for p in self.trainable)
-            aux_shard = tuple(
-                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
-                for p in self.aux)
         x_shard = (NamedSharding(self.mesh, data_spec) if data_spec is not None
                    else data_parallel_sharding(self.mesh, 1))
         if label_spec is not None:
@@ -151,41 +307,244 @@ class GluonTrainStep:
             y_shard = x_shard  # P(): replicated batch -> replicated labels
         else:
             y_shard = x_shard
-        # place the functional state onto its shardings up front: committed
-        # single-device arrays cannot be implicitly resharded by jit, and
-        # this also avoids a first-step transfer.  jnp.array(copy=True)
-        # first: device_put to an equivalent sharding aliases the source
-        # buffer, and the first donated step would then delete the Gluon
-        # Parameter's own array out from under the user
-        import jax.numpy as jnp
-
-        def _put(vals, shard):
-            vals = tuple(jnp.array(v, copy=True) for v in vals)
-            if isinstance(shard, tuple):
-                return tuple(jax.device_put(v, s)
-                             for v, s in zip(vals, shard))
-            return tuple(jax.device_put(v, shard) for v in vals)
-
-        self.train_vals = _put(self.train_vals, tv_shard)
-        self.opt_state = _put(self.opt_state, tv_shard)
-        self.aux_vals = _put(self.aux_vals, aux_shard)
-
-        self._step_py = step  # un-jitted; composed by make_chained()
-        self._step = jax.jit(
-            step,
-            in_shardings=(tv_shard, tv_shard, aux_shard, x_shard, y_shard,
-                          repl),
-            # pin outputs to the input layouts: the functional state must
-            # keep its sharding across steps (otherwise the compiler may
-            # re-shard e.g. a bias, and step 2's in_shardings reject it)
-            out_shardings=(repl, tv_shard, tv_shard, aux_shard),
-            donate_argnums=(0, 1, 2),
-        )
         # place batch-sharded inputs via these shardings
         self.batch_sharding = x_shard
         self.label_sharding = y_shard
         self._repl = repl
 
+        if self._zero:
+            self._build_zero(pure_loss, compute_dtype, repl,
+                             x_shard, y_shard)
+        else:
+            self._build_classic(pure_loss, compute_dtype, repl,
+                                x_shard, y_shard, param_spec_fn)
+
+    # ------------------------------------------------- replicated/dp path
+    def _build_classic(self, pure_loss, cast, repl, x_shard, y_shard,
+                       param_spec_fn):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        opt_update = self._opt_update
+        update = self._update
+
+        if param_spec_fn is None:
+            tv_shard = aux_shard = repl
+        else:
+            # per-parameter shardings (tensor parallelism etc.) — the
+            # optimizer state mirrors the parameter sharding
+            tv_shard = tuple(
+                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
+                for p in self.trainable)
+            aux_shard = tuple(
+                NamedSharding(self.mesh, param_spec_fn(p.name, p.shape))
+                for p in self.aux)
+        if opt_update is None:
+            self.opt_state = sgd_momentum_init(self.train_vals)
+            state_shard = tv_shard
+        else:
+            shapes = [v.shape for v in self.train_vals]
+            self.opt_state = opt_update.init_state(
+                lambda i, dt: jnp.zeros(shapes[i], dt))
+            if param_spec_fn is None:
+                state_shard = repl
+            else:
+                # one sharding per state leaf, mirroring its parameter
+                state_shard = tuple(
+                    tv_shard[i]
+                    for i, dts in enumerate(opt_update.leaf_dtypes)
+                    for _ in dts)
+
+        def fwd_bwd(train_vals, aux_vals, x, y, key):
+            def loss_of(tv):
+                if cast is not None:
+                    tv = tuple(v.astype(cast) if v.dtype == _np.float32
+                               else v for v in tv)
+                    x_ = x.astype(cast)
+                else:
+                    x_ = x
+                return pure_loss(tv, aux_vals, x_, y, key)
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            grads = tuple(g.astype(v.dtype)
+                          for g, v in zip(grads, train_vals))
+            return loss, grads, new_aux, _global_grad_norm(grads)
+
+        if opt_update is None:
+            def step(train_vals, opt_state, aux_vals, x, y, key):
+                loss, grads, new_aux, gnorm = fwd_bwd(
+                    train_vals, aux_vals, x, y, key)
+                new_vals, new_state = update(train_vals, grads, opt_state)
+                return loss, new_vals, new_state, new_aux, gnorm
+
+            sig_in = (tv_shard, state_shard, aux_shard, x_shard, y_shard,
+                      repl)
+        else:
+            def step(train_vals, opt_state, aux_vals, x, y, key, scalars):
+                loss, grads, new_aux, gnorm = fwd_bwd(
+                    train_vals, aux_vals, x, y, key)
+                new_vals, new_state = opt_update.apply(
+                    train_vals, grads, opt_state, scalars)
+                return loss, new_vals, new_state, new_aux, gnorm
+
+            sig_in = (tv_shard, state_shard, aux_shard, x_shard, y_shard,
+                      repl, repl)
+
+        self.train_vals = _put(self.train_vals, tv_shard)
+        self.opt_state = _put(self.opt_state, state_shard)
+        self.aux_vals = _put(self.aux_vals, aux_shard)
+
+        self._step_py = step  # un-jitted; composed by make_chained()
+        self._step = jax.jit(
+            step,
+            in_shardings=sig_in,
+            # pin outputs to the input layouts: the functional state must
+            # keep its sharding across steps (otherwise the compiler may
+            # re-shard e.g. a bias, and step 2's in_shardings reject it)
+            out_shardings=(repl, tv_shard, state_shard, aux_shard, repl),
+            donate_argnums=(0, 1, 2),
+        )
+
+    # ------------------------------------------------- ZeRO sharded path
+    def _build_zero(self, pure_loss, cast, repl, x_shard, y_shard):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        opt_update = self._opt_update
+        update = self._update
+        mesh = self.mesh
+        n = int(mesh.shape["dp"])
+        flat_shard = NamedSharding(mesh, _P("dp"))
+        self._flat_shard = flat_shard
+
+        layout = []
+        for p, v in zip(self.trainable, self.train_vals):
+            size = int(v.size)
+            layout.append({"name": p.name,
+                           "shape": tuple(int(s) for s in v.shape),
+                           "dtype": str(v.dtype), "size": size,
+                           "padded": _padded_size(size, n)})
+
+        def _flat_put(v, meta):
+            flat = _np.zeros((meta["padded"],), _np.dtype(meta["dtype"]))
+            flat[:meta["size"]] = _np.asarray(v).reshape(-1)
+            return jax.device_put(flat, flat_shard)
+
+        self.train_vals = tuple(
+            _flat_put(v, m) for v, m in zip(self.train_vals, layout))
+        self.aux_vals = _put(self.aux_vals, repl)
+
+        # optimizer state is BORN on the shard layout — a jitted zeros
+        # with sharded out_shardings allocates 1/n per device directly;
+        # the replicated full-size state never exists at any point
+        def _shard_zeros(padded, dtype):
+            return jax.jit(lambda: jnp.zeros((padded,), dtype),
+                           out_shardings=flat_shard)()
+
+        if opt_update is not None:
+            self.opt_state = opt_update.init_state(
+                lambda i, dt: _shard_zeros(layout[i]["padded"], dt))
+            leaves_per = [len(d) for d in opt_update.leaf_dtypes]
+            leaf_dtypes = [[str(d) for d in dts]
+                           for dts in opt_update.leaf_dtypes]
+        else:
+            self.opt_state = tuple(
+                _shard_zeros(m["padded"], _np.dtype(m["dtype"]))
+                for m in layout)
+            leaves_per = [1] * len(layout)
+            leaf_dtypes = [[m["dtype"]] for m in layout]
+
+        isz = [_np.dtype(m["dtype"]).itemsize for m in layout]
+        gather_bytes = sum(m["padded"] * s for m, s in zip(layout, isz))
+        self.zero_layout = {
+            "n": n,
+            "params": layout,
+            "state_leaves": leaves_per,
+            "state_dtypes": leaf_dtypes,
+            # logical collective payload per step: every param is
+            # gathered once for the forward and its grad reduced once
+            # into the shard layout
+            "per_step_allgather_bytes": gather_bytes,
+            "per_step_reduce_bytes": gather_bytes,
+            "replicated_param_bytes": sum(
+                m["size"] * s for m, s in zip(layout, isz)),
+            "per_device_param_bytes": sum(
+                m["padded"] // n * s for m, s in zip(layout, isz)),
+            "per_device_state_bytes": sum(
+                m["padded"] // n * s * l
+                for m, s, l in zip(layout, isz, leaves_per)),
+        }
+
+        sizes = [m["size"] for m in layout]
+        shapes = [m["shape"] for m in layout]
+        wsc = jax.lax.with_sharding_constraint
+
+        def fwd_bwd(train_flat, aux_vals, x, y, key):
+            def loss_of(tf):
+                # the param all-gather: constraining each flat shard to
+                # replicated makes GSPMD materialize the full value on
+                # every device inside this one program, overlapped with
+                # forward compute
+                tv = tuple(
+                    wsc(f, repl)[:size].reshape(shape)
+                    for f, size, shape in zip(tf, sizes, shapes))
+                if cast is not None:
+                    tv = tuple(v.astype(cast) if v.dtype == _np.float32
+                               else v for v in tv)
+                    x_ = x.astype(cast)
+                else:
+                    x_ = x
+                return pure_loss(tv, aux_vals, x_, y, key)
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_flat)
+            # norm over the still-replicated grads: identical reduction
+            # to the dp path's, so health trajectories match bit-exact
+            gnorm = _global_grad_norm(grads)
+            # the reduce-scatter: the backward's dp-summed grads are
+            # constrained back to the 1/n flat layout — each device
+            # keeps only the shard its update needs (GSPMD may lower
+            # this as all-reduce + slice on backends without a fused
+            # reduce-scatter; the data movement is semantically the
+            # ZeRO reduce-scatter either way)
+            grads = tuple(wsc(g.astype(f.dtype), flat_shard)
+                          for g, f in zip(grads, train_flat))
+            return loss, grads, new_aux, gnorm
+
+        if opt_update is None:
+            def step(train_flat, opt_flat, aux_vals, x, y, key):
+                loss, grads, new_aux, gnorm = fwd_bwd(
+                    train_flat, aux_vals, x, y, key)
+                # elementwise update on the 1/n shards (pads carry
+                # exact zeros through: zero grad -> zero update)
+                new_vals, new_state = update(train_flat, grads, opt_flat)
+                return loss, new_vals, new_state, new_aux, gnorm
+
+            sig_in = (flat_shard, flat_shard, repl, x_shard, y_shard,
+                      repl)
+        else:
+            def step(train_flat, opt_flat, aux_vals, x, y, key, scalars):
+                loss, grads, new_aux, gnorm = fwd_bwd(
+                    train_flat, aux_vals, x, y, key)
+                new_vals, new_state = opt_update.apply(
+                    train_flat, grads, opt_flat, scalars)
+                return loss, new_vals, new_state, new_aux, gnorm
+
+            sig_in = (flat_shard, flat_shard, repl, x_shard, y_shard,
+                      repl, repl)
+
+        self._step_py = step
+        self._step = jax.jit(
+            step,
+            in_shardings=sig_in,
+            out_shardings=(repl, flat_shard, flat_shard, repl, repl),
+            donate_argnums=(0, 1, 2),
+        )
+
+    # --------------------------------------------------------- execution
     def make_chained(self, n_steps):
         """Jit n_steps training steps as ONE device computation.
 
@@ -206,19 +565,31 @@ class GluonTrainStep:
         state — chained(n) advances training exactly like n ``__call__``
         steps (same fold_in key schedule) and repeat calls keep working.
 
+        Works in both layouts (the ZeRO chain carries the flat shards);
+        not with ``optimizer=``: its per-step scalars are refilled
+        host-side each step and cannot cross a fori_loop.
+
         Returns fn(x, y, key) -> last_loss.
         """
         import jax
         import jax.numpy as jnp
         from jax import lax
 
+        if self._opt_update is not None:
+            raise MXNetError(
+                "make_chained: per-step optimizer scalars (schedules, "
+                "bias corrections) are refilled host-side each step and "
+                "cannot cross a fori_loop chain; use optimizer=None "
+                "(the fused sgd-momentum closure) for chained "
+                "micro-benchmarks")
+
         step = self._step_py
 
         def chained(train_vals, opt_state, aux_vals, x, y, key):
             def body(i, carry):
                 tv, os_, av, _ = carry
-                loss, tv, os_, av = step(tv, os_, av, x, y,
-                                         jax.random.fold_in(key, i))
+                loss, tv, os_, av, _gn = step(tv, os_, av, x, y,
+                                              jax.random.fold_in(key, i))
                 # fp32 carry regardless of compute dtype (bf16 steps
                 # return a bf16 loss; the carry structure must be fixed)
                 return (tv, os_, av, loss.astype(jnp.float32))
@@ -252,8 +623,22 @@ class GluonTrainStep:
         if not isinstance(x, jax.Array):
             x, y = self.put_batch(x, y)
         key = _random.next_key()
-        loss, self.train_vals, self.opt_state, self.aux_vals = self._step(
-            self.train_vals, self.opt_state, self.aux_vals, x, y, key)
+        args = [self.train_vals, self.opt_state, self.aux_vals, x, y, key]
+        if self._opt_update is not None:
+            args.append(self._opt_update.host_scalars())
+        (loss, self.train_vals, self.opt_state, self.aux_vals,
+         gnorm) = self._step(*args)
+        self.last_grad_norm = gnorm
+        if self._zero:
+            zl = self.zero_layout
+            _rts.inc("zero_steps")
+            _rts.inc("zero_allgather_bytes",
+                     zl["per_step_allgather_bytes"])
+            _rts.inc("zero_reduce_bytes", zl["per_step_reduce_bytes"])
+        if _health._state["on"]:
+            hm = _health.monitor()
+            if hm is not None:
+                hm.observe_scalar("grad_norm", gnorm)
         return loss
 
     def sync_to_params(self):
@@ -262,14 +647,157 @@ class GluonTrainStep:
         Values are gathered off the mesh first: the Parameters feed the
         normal eager API afterwards, and a mesh-committed array mixed
         with default-device eager operands is a placement error on
-        multi-device hosts."""
+        multi-device hosts.  In the ZeRO layout each flat value is
+        unpadded and reshaped back to the parameter's shape."""
         import jax.numpy as jnp
 
-        for p, v in zip(self.trainable, self.train_vals):
-            host = jnp.asarray(_np.asarray(v))
-            for d in p._data:
-                d._assign(host)
+        if self._zero:
+            for p, v, m in zip(self.trainable, self.train_vals,
+                               self.zero_layout["params"]):
+                host = jnp.asarray(
+                    _np.asarray(v)[:m["size"]].reshape(m["shape"]))
+                for d in p._data:
+                    d._assign(host)
+        else:
+            for p, v in zip(self.trainable, self.train_vals):
+                host = jnp.asarray(_np.asarray(v))
+                for d in p._data:
+                    d._assign(host)
         for p, v in zip(self.aux, self.aux_vals):
             host = jnp.asarray(_np.asarray(v))
             for d in p._data:
                 d._assign(host)
+
+    # ------------------------------------------------ sharded checkpoint
+    def zero_shard_payloads(self):
+        """``{rank: payload}`` for every locally-addressable 'dp'
+        position — the per-rank shard files of a sharded checkpoint.
+        Each payload carries exactly the 1/n slice that rank owns
+        (params + optimizer-state leaves), so a rank never persists
+        another rank's bytes; in a multi-host run each process sees
+        only its own ranks here."""
+        if not self._zero:
+            raise MXNetError(
+                "zero_shard_payloads: this step was not built with "
+                "zero=True")
+        n = self.zero_layout["n"]
+        out = {}
+
+        def collect(vals, kind):
+            for j, v in enumerate(vals):
+                shard_len = int(v.shape[0]) // n
+                for s in v.addressable_shards:
+                    rank = int(s.index[0].start or 0) // shard_len
+                    slot = out.setdefault(
+                        rank, {"params": {}, "state": {}})
+                    slot[kind][j] = _np.asarray(s.data)
+
+        collect(self.train_vals, "params")
+        collect(self.opt_state, "state")
+        return out
+
+    def save_zero(self, step, mgr=None):
+        """Commit a sharded checkpoint: one global manifest over
+        per-rank shard files (``CheckpointManager.save_sharded`` — the
+        rank-0 commit barrier lives there), layout metadata in the
+        ``aux`` sideband so resume can re-shard."""
+        from .. import checkpoint as _ckpt
+
+        mgr = mgr if mgr is not None else _ckpt.manager()
+        if mgr is None:
+            raise MXNetError(
+                "save_zero: no checkpoint manager — call "
+                "checkpoint.enable(directory) first or pass mgr=")
+        n = self.zero_layout["n"]
+        files = {"zero-shard-%05d-of-%05d" % (r, n): payload
+                 for r, payload in self.zero_shard_payloads().items()}
+        aux = {"zero_layout": self.zero_layout}
+        if self._opt_update is not None:
+            # host-side optimizer hyper-state (update counts drive
+            # Adam-family bias correction; schedulers drive lr) — the
+            # device shards alone do not make the step resumable
+            aux["optimizer"] = _ckpt._strip_optimizer(
+                self._opt_update.opt)
+        return mgr.save_sharded(step, files, aux=aux)
+
+    def restore_zero(self, manifest, mgr=None):
+        """Load a sharded checkpoint back into this step's flat shards,
+        RE-SHARDING when the checkpoint's dp width differs from the
+        current mesh (the layout-change resume path): each full flat
+        vector is rebuilt from the old ranks' slices, stripped of the
+        old padding, re-padded to the current multiple and placed onto
+        the current 'dp' layout.  Restores the RNG stream too; returns
+        the checkpoint step."""
+        import jax
+
+        from .. import checkpoint as _ckpt
+
+        if not self._zero:
+            raise MXNetError(
+                "restore_zero: this step was not built with zero=True")
+        mgr = mgr if mgr is not None else _ckpt.manager()
+        if mgr is None:
+            raise MXNetError("restore_zero: no checkpoint manager")
+        aux = mgr.load_aux(manifest)
+        if not aux or "zero_layout" not in aux:
+            raise MXNetError(
+                "restore_zero: checkpoint %s carries no zero_layout "
+                "sideband — not a sharded checkpoint"
+                % manifest.get("path"))
+        old = aux["zero_layout"]
+        ranks = mgr.load_shard_files(manifest)
+        if len(ranks) != old["n"]:
+            raise MXNetError(
+                "restore_zero: checkpoint %s has %d of %d rank shard "
+                "files" % (manifest.get("path"), len(ranks), old["n"]))
+        if old["state_leaves"] != self.zero_layout["state_leaves"]:
+            raise MXNetError(
+                "restore_zero: optimizer state structure changed "
+                "(%r leaves saved vs %r now) — restore with the same "
+                "optimizer family"
+                % (old["state_leaves"], self.zero_layout["state_leaves"]))
+
+        def rebuild(kind, j, meta_old, meta_new, dtype):
+            full = _np.concatenate(
+                [ranks[r][kind][j] for r in range(old["n"])])
+            flat = _np.zeros((meta_new["padded"],), dtype)
+            flat[:meta_new["size"]] = full[:meta_old["size"]]
+            return jax.device_put(flat, self._flat_shard)
+
+        new_params = []
+        for j, (mo, mn) in enumerate(zip(old["params"],
+                                         self.zero_layout["params"])):
+            if (mo["name"], mo["size"]) != (mn["name"], mn["size"]):
+                raise MXNetError(
+                    "restore_zero: parameter %d mismatch (%s/%d saved "
+                    "vs %s/%d now) — the model changed"
+                    % (j, mo["name"], mo["size"], mn["name"], mn["size"]))
+            new_params.append(
+                rebuild("params", j, mo, mn, _np.dtype(mn["dtype"])))
+        self.train_vals = tuple(new_params)
+
+        new_state = []
+        leaf = 0
+        for i, count in enumerate(self.zero_layout["state_leaves"]):
+            mo, mn = old["params"][i], self.zero_layout["params"][i]
+            for c in range(count):
+                dt = _np.dtype(self.zero_layout["state_dtypes"][i][c])
+                new_state.append(rebuild("state", leaf, mo, mn, dt))
+                leaf += 1
+        self.opt_state = tuple(new_state)
+        blob = aux.get("optimizer")
+        if blob is not None and self._opt_update is not None:
+            import pickle
+
+            src = pickle.loads(blob)
+            hyper = dict(src.__dict__)
+            hyper.pop("param_dict", None)
+            self._opt_update.opt.__dict__.update(hyper)
+        rng = manifest.get("rng")
+        if rng:
+            _random.set_state(rng)
+        return int(manifest.get("step", 0))
+
+
+#: ISSUE-14 spelling: ``GluonStep(..., zero=True)``
+GluonStep = GluonTrainStep

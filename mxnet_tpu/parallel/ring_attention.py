@@ -115,8 +115,13 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
     # as the rotating K/V shards for scan carry unification
     if hasattr(lax, "pcast"):
         _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
-    else:  # older jax
+    elif hasattr(lax, "pvary"):
         _vary = lambda x: lax.pvary(x, (axis_name,))
+    else:
+        # jax without varying-type annotations (no pcast/pvary, e.g.
+        # 0.4.x): every value inside shard_map is already device-varying,
+        # so the accumulators unify with the rotating K/V carry as-is
+        _vary = lambda x: x
     out0 = _vary(jnp.zeros((b, h, sq, d), jnp.float32))
     lse0 = _vary(jnp.full((b, h, sq), _NEG, jnp.float32))
     (out, _, _, _), _ = lax.scan(step, (out0, lse0, k, v), jnp.arange(n))

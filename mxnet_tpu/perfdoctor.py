@@ -124,6 +124,13 @@ SERVE_QUEUE_RATIO = 2.0
 # of warmup requests carries no operating-point signal)
 SERVE_MIN_REQUESTS = 32
 
+# ---- ZeRO-sharding knobs (parallel/gluon_step.py zero=True runs) -------
+# the per-step parameter all-gather past this fraction of the compiled
+# step's total bytes-accessed means collectives dominate the traffic the
+# sharding saves in state — the model is too small (or the per-device
+# batch too thin) for the current dp width
+ZERO_AG_RATIO = 0.5
+
 
 def classify(path):
     """Load ``path`` and say what it is: ``("trace", data)`` for a
@@ -488,6 +495,45 @@ def _check_self_healing(dump):
             "network and server load (docs/CHECKPOINTING.md "
             "'Server-side durability')"))
     return out
+
+
+def _check_zero_allgather(dump):
+    """ZeRO weight-update sharding: the per-step parameter all-gather
+    is pure overhead bought to shrink per-device state ~n×.  When it
+    moves more than ``ZERO_AG_RATIO`` of the compiled step's total
+    bytes-accessed, the trade has inverted — the collectives cost more
+    traffic than the forward/backward math moves, the signature of a
+    model too small (or a per-device batch too thin) for the dp width.
+    """
+    snap = dump.get("snapshot", dump)
+    counters = snap.get("counters") or {}
+    zsteps = counters.get("zero_steps", 0)
+    ag = counters.get("zero_allgather_bytes", 0)
+    if not zsteps or not ag:
+        return []
+    per_step = ag / zsteps
+    bpc = ((snap.get("costs") or {}).get("compiled_step") or {}).get(
+        "bytes_per_call")
+    if not bpc:
+        return []
+    share = per_step / bpc
+    if share < ZERO_AG_RATIO:
+        return []
+    rs = counters.get("zero_reduce_bytes", 0)
+    return [_finding(
+        "zero-allgather-dominated", min(share, 1.0),
+        "ZeRO param all-gather moves %.0f%% of the compiled step's "
+        "bytes-accessed (%.1f MB/step of %.1f MB/step)"
+        % (share * 100, per_step / 1e6, bpc / 1e6),
+        "zero",
+        ["%.1f MB/step all-gather + %.1f MB/step reduce-scatter over "
+         "%d zero step(s); compiled-step cost model reads %.1f "
+         "MB/step total" % (per_step / 1e6,
+                            rs / zsteps / 1e6, zsteps, bpc / 1e6)],
+        "raise the per-device batch (amortizes the gather over more "
+        "math), shrink the dp width, or drop zero=True — at this "
+        "model size replicated state is cheaper than the collectives "
+        "(docs/ZERO.md 'When not to shard')")]
 
 
 # --------------------------------------------------------- serving rules
@@ -907,6 +953,7 @@ def diagnose(trace=None, dump=None, timeline=None, top=20):
         findings += _check_stragglers(dump)
         findings += _check_retries(dump)
         findings += _check_self_healing(dump)
+        findings += _check_zero_allgather(dump)
         findings += _check_serving(dump)
         if timeline is None:
             timeline = dump.get("timeline")

@@ -1092,6 +1092,18 @@ def _comparable_metrics(dump, min_seconds):
             out["counter:%s" % key] = (v / steps if steps else v,
                                        "/step" if steps else "count",
                                        "counter")
+    # ZeRO weight-update sharding collective traffic, per zero step
+    # (parallel/gluon_step.py counters).  kind "zero" gets special
+    # treatment in compare(): one-sided presence (an eager-vs-zero or
+    # dp-vs-zero A/B) is a topology CHANGE, not a regression — those
+    # rows land in "notes", never in the verdict.
+    zsteps = counters.get("zero_steps", 0)
+    if zsteps:
+        for key in ("zero_allgather_bytes", "zero_reduce_bytes"):
+            v = counters.get(key, 0)
+            if v:
+                out["zero:%s" % key] = (v / zsteps / 1e6, "MB/step",
+                                        "zero")
     # device-memory peak
     peak = ((snap.get("memory") or {}).get("totals") or {}).get(
         "peak_bytes", 0)
@@ -1136,7 +1148,7 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
     mb = _comparable_metrics(b, min_seconds)
     ma_all = _comparable_metrics(a, 0.0)
     mb_all = _comparable_metrics(b, 0.0)
-    regressions, improvements = [], []
+    regressions, improvements, notes = [], [], []
     compared = 0
     for metric in sorted(set(ma) | set(mb)):
         va = ma_all.get(metric) or ma.get(metric)
@@ -1150,6 +1162,14 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
         ratio = (after / before) if before > 0.0 else float("inf")
         entry = {"metric": metric, "kind": kind, "unit": unit,
                  "before": before, "after": after, "ratio": ratio}
+        if kind == "zero" and (va is None or vb is None):
+            # collective-bytes counters existing on only one side mean
+            # the two runs used different sharding topologies (eager vs
+            # zero) — worth surfacing, but 0 -> N bytes is a change of
+            # shape, not a performance verdict
+            entry["side"] = "after-only" if va is None else "before-only"
+            notes.append(entry)
+            continue
         if ratio > 1.0 + threshold:
             regressions.append(entry)
         elif ratio < 1.0 - threshold:
@@ -1161,6 +1181,7 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
     return {"verdict": verdict, "threshold": threshold,
             "min_seconds": min_seconds, "compared": compared,
             "regressions": regressions, "improvements": improvements,
+            "notes": notes,
             "a": {"path": a.get("_path"),
                   "steps": _steps_of(a.get("snapshot", a))},
             "b": {"path": b.get("_path"),
@@ -1189,6 +1210,11 @@ def render_compare(result):
 
     _rows("REGRESSIONS (worse in B)", result["regressions"])
     _rows("improvements (better in B)", result["improvements"])
+    for e in result.get("notes", []):
+        lines.append("  note: %s present %s (%.3f -> %.3f %s) — "
+                     "sharding topology differs between the dumps"
+                     % (e["metric"], e.get("side", "one-sided"),
+                        e["before"], e["after"], e["unit"]))
     if not result["regressions"] and not result["improvements"]:
         lines.append("no change past the threshold — dumps are "
                      "performance-equivalent")

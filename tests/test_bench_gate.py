@@ -90,8 +90,8 @@ def test_make_chained_matches_sequential_steps():
     # reference trajectory: the un-jitted step fn, eagerly, same keys
     tv, os_, av = step.train_vals, step.opt_state, step.aux_vals
     for i in range(3):
-        want, tv, os_, av = step._step_py(tv, os_, av, x, y,
-                                          jax.random.fold_in(key, i))
+        want, tv, os_, av, _gn = step._step_py(tv, os_, av, x, y,
+                                               jax.random.fold_in(key, i))
 
     orig_train_vals = step.train_vals
     got = step.make_chained(3)(x, y, key)

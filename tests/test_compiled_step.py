@@ -572,8 +572,8 @@ def test_make_chained_donates_carry_and_writes_back():
     # reference trajectory: 3 sequential un-jitted steps, same keys
     tv, os_, av = step.train_vals, step.opt_state, step.aux_vals
     for i in range(3):
-        want, tv, os_, av = step._step_py(tv, os_, av, x, y,
-                                          jax.random.fold_in(key, i))
+        want, tv, os_, av, _gn = step._step_py(tv, os_, av, x, y,
+                                               jax.random.fold_in(key, i))
     old_train_vals = step.train_vals
     got = run(x, y, key)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

@@ -1092,7 +1092,8 @@ def test_registry_fully_covered():
     """Every registered op must be claimed by some tier; a new op with
     no test fails here."""
     direct = {"signsgd_update", "adamw_update", "_contrib_adamw_update",
-              "rmspropalex_update", "mp_sgd_update", "mp_sgd_mom_update",
+              "rmspropalex_update", "adagrad_update", "adadelta_update",
+              "mp_sgd_update", "mp_sgd_mom_update",
               "multi_sgd_update", "multi_sgd_mom_update",
               "multi_mp_sgd_update", "multi_mp_sgd_mom_update",
               "group_adagrad_update", "_contrib_mp_adamw_update",

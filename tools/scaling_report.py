@@ -95,6 +95,44 @@ def _child(n):
 
     out = {"n": n}
 
+    # ---- zero: ZeRO weight-update sharding (MLP + Adam) -------------
+    # params + Adam state born sharded 1/n (parallel/gluon_step.py
+    # zero=True, docs/ZERO.md); the compiled HLO shows the grad
+    # reduce-scatter + param all-gather replacing the dp all-reduce.
+    # A BN-free MLP keeps n=256 lowering cheap — the shrink evidence
+    # is model-independent.
+    from mxnet_tpu import optimizer as _opt
+    from mxnet_tpu.gluon import nn
+
+    mesh_z = create_mesh({"dp": n})
+    mlp = nn.HybridSequential()
+    mlp.add(nn.Dense(512, activation="relu"),
+            nn.Dense(512, activation="relu"), nn.Dense(100))
+    mlp.initialize(ctx=mx.cpu())
+    mlp(mx.nd.zeros((2, 256), ctx=mx.cpu()))
+    zstep = GluonTrainStep(mlp, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=mesh_z, zero=True,
+                           optimizer=_opt.create("adam",
+                                                 learning_rate=1e-3))
+    xz, yz = zstep.put_batch(np.zeros((n, 256), np.float32),
+                             np.zeros((n,), np.int32))
+    hloz = zstep._step.lower(
+        zstep.train_vals, zstep.opt_state, zstep.aux_vals, xz, yz,
+        mxrandom.next_key(),
+        tuple(0.0 for _ in zstep._opt_update.slots)).compile().as_text()
+    out["zero"] = {
+        "param_bytes_per_dev": _sharded_bytes(zstep.train_vals),
+        "opt_bytes_per_dev": _sharded_bytes(zstep.opt_state),
+        "replicated_param_bytes":
+            zstep.zero_layout["replicated_param_bytes"],
+        "collectives": collective_stats(hloz),
+    }
+    if n > 64:
+        # the ResNet-50 dp / 3-axis sections compile minutes-slow at
+        # SPMD widths past 64; the zero table is what scales to 256
+        json.dump(out, sys.stdout)
+        return
+
     # ---- dp: flagship ResNet-50 step --------------------------------
     mesh = create_mesh({"dp": n})
     net = vision.resnet50_v1(classes=10)
@@ -200,6 +238,8 @@ def main(device_counts):
       "other collectives |")
     w("|---|---|---|---|---|")
     for r in results:
+        if "dp" not in r:
+            continue
         dp = r["dp"]
         c = dp["collectives"]
         other = ", ".join("%s %d/%s" % (op, c[op]["count"],
@@ -211,6 +251,35 @@ def main(device_counts):
             _fmt_bytes(dp["opt_bytes_per_dev"]),
             c["all-reduce"]["count"], _fmt_bytes(c["all-reduce"]["bytes"]),
             other or "—"))
+    w("")
+    w("## ZeRO weight-update sharding (MLP 256-512×2-100 + Adam, "
+      "`zero=True`)")
+    w("")
+    w("Params and Adam moments live sharded 1/n from step 0; the grad "
+      "all-reduce becomes reduce-scatter + param all-gather "
+      "(docs/ZERO.md).  'shrink' = replicated param bytes / measured "
+      "per-device param bytes (padding makes it slightly under n).")
+    w("")
+    w("| n | param B/dev | opt B/dev | shrink | all-gather | "
+      "reduce-scatter / all-reduce |")
+    w("|---|---|---|---|---|---|")
+    for r in results:
+        if "zero" not in r:
+            continue
+        z = r["zero"]
+        c = z["collectives"]
+        shrink = z["replicated_param_bytes"] / max(
+            1, z["param_bytes_per_dev"])
+        rs_cell = ", ".join(
+            "%s %d/%s" % (op, c[op]["count"], _fmt_bytes(c[op]["bytes"]))
+            for op in ("reduce-scatter", "all-reduce")
+            if c[op]["count"]) or "—"
+        ag = c["all-gather"]
+        w("| %d | %s | %s | %.2f× | %s | %s |" % (
+            r["n"], _fmt_bytes(z["param_bytes_per_dev"]),
+            _fmt_bytes(z["opt_bytes_per_dev"]), shrink,
+            ("%d/%s" % (ag["count"], _fmt_bytes(ag["bytes"])))
+            if ag["count"] else "—", rs_cell))
     w("")
     w("## dp2 × tp2 × pp(n/4) composition (GPipe ring + Megatron psum)")
     w("")
@@ -238,7 +307,7 @@ if __name__ == "__main__":
     if "--child" in sys.argv:
         _child(int(sys.argv[sys.argv.index("--child") + 1]))
     else:
-        counts = [8, 16, 32, 64]
+        counts = [8, 16, 32, 64, 128, 256]
         if "--devices" in sys.argv:
             counts = [int(x) for x in
                       sys.argv[sys.argv.index("--devices") + 1].split(",")]
