@@ -72,13 +72,15 @@ from . import health as _health
 from . import profiler as _prof
 from . import random as _random
 from . import runtime_stats as _rts
+from . import xray as _xray
 from .base import MXNetError
 from .ndarray import NDArray
 from .optimizer import optimizer as _opt
 from .ops import registry as _registry
 
 __all__ = ["CompiledStep", "ZeroCompiledStep", "compile_step",
-           "env_enabled", "donation_active", "cost_snapshot"]
+           "env_enabled", "donation_active", "cost_snapshot",
+           "xray_snapshot"]
 
 # live CompiledStep instances, for the read-side cost aggregation
 # (runtime_stats.snapshot merges cost_snapshot() into its "costs"
@@ -161,12 +163,13 @@ def _guard_trainer(trainer, zero=False):
 class _Entry:
     """One jitted whole-step program for a fixed input signature."""
 
-    __slots__ = ("fn", "n_state_leaves", "cost")
+    __slots__ = ("fn", "n_state_leaves", "cost", "xray")
 
     def __init__(self, fn, n_state_leaves):
         self.fn = fn
         self.n_state_leaves = n_state_leaves
         self.cost = None
+        self.xray = None
 
 
 def _state_leaves(st, out):
@@ -319,7 +322,8 @@ class CompiledStep:
 
                 def fwd(x_in):
                     out = block(x_in)
-                    loss = loss_block(out, NDArray(y))
+                    with _xray.scope(_xray.REGION_LOSS):
+                        loss = loss_block(out, NDArray(y))
                     if not isinstance(loss, NDArray):
                         raise MXNetError(
                             "compiled_step: the loss must return one "
@@ -336,8 +340,12 @@ class CompiledStep:
                 # tape bit for bit
                 return jnp.sum(loss._data), (loss._data, new_aux)
 
-            (_, (loss_vec, new_aux)), grads = jax.value_and_grad(
-                loss_sum, has_aux=True)(tuple(pvals))
+            # x-ray: the grad wrapper is a direction marker only — the
+            # transpose() metadata XLA records inside is what flags
+            # backward instructions; canonical_scope filters the marker
+            with _xray.scope(_xray.GRAD_MARKER):
+                (_, (loss_vec, new_aux)), grads = jax.value_and_grad(
+                    loss_sum, has_aux=True)(tuple(pvals))
 
             # the REAL optimizer update: rebuild each state tree with
             # traced leaves, swap it into the live Updater, and run the
@@ -352,7 +360,8 @@ class CompiledStep:
             new_pvals = []
             try:
                 upd.states = traced_states
-                with _opt.scalar_feed(feed):
+                with _opt.scalar_feed(feed), \
+                        _xray.scope(_xray.REGION_OPT):
                     for j, p in enumerate(trainable):
                         w_nd = NDArray(pvals[j])
                         g_nd = NDArray(grads[j])
@@ -388,10 +397,13 @@ class CompiledStep:
                 if hasattr(a, "shape") else a, args)
             compiled = entry.fn.lower(*specs).compile()
             entry.cost = _registry.compiled_cost(compiled)
+            entry.xray = _xray.analyze(compiled, cost=entry.cost)
         except Exception:  # analysis must never break the step
             entry.cost = None
         _rts.inc("cost_analysis_entries" if entry.cost
                  else "cost_analysis_failures")
+        if entry.xray:
+            _rts.inc("xray_programs")
         _rts.inc("cost_analysis_seconds", _time.perf_counter() - t0)
 
     # ------------------------------------------------------------- step
@@ -645,10 +657,14 @@ class ZeroCompiledStep:
                 args.append(tuple(0.0 for _ in g._opt_update.slots))
             compiled = g._step.lower(*args).compile()
             entry.cost = _registry.compiled_cost(compiled)
+            entry.xray = _xray.analyze(compiled, cost=entry.cost,
+                                       label="zero_step", zero=True)
         except Exception:  # analysis must never break the step
             entry.cost = None
         _rts.inc("cost_analysis_entries" if entry.cost
                  else "cost_analysis_failures")
+        if entry.xray:
+            _rts.inc("xray_programs")
         _rts.inc("cost_analysis_seconds", _time.perf_counter() - t0)
 
 
@@ -679,3 +695,22 @@ def cost_snapshot():
         if vals:
             rec[k] = int(sum(vals))
     return {"compiled_step": rec}
+
+
+def xray_snapshot():
+    """Read-side aggregate of every live program's x-ray table (the
+    cost_snapshot convention): ``{"programs": [table, ...]}`` ordered
+    oldest→newest by capture sequence, ``{}`` when nothing was
+    captured.  runtime_stats.snapshot merges this as its ``xray``
+    section; the report/diagnose renderers and the perfdoctor rules
+    read the newest table per program label."""
+    programs = []
+    for cs in list(_LIVE):
+        for e in list(cs._cache.values()):
+            t = getattr(e, "xray", None)
+            if t:
+                programs.append(t)
+    if not programs:
+        return {}
+    programs.sort(key=lambda t: t.get("seq", 0))
+    return {"programs": programs}

@@ -34,6 +34,7 @@ import numpy as _np
 
 from .. import autograd, initializer, ndarray
 from .. import random as _random
+from .. import xray as _xray
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray import NDArray
@@ -289,6 +290,17 @@ class Block:
 
     # ------------------------------------------------------------ run
     def __call__(self, *args):
+        # fused-step x-ray: inside a staging trace, each block's forward
+        # runs under a named scope so the compiled program's HLO carries
+        # the block path in op_name metadata (xray.analyze attributes
+        # per-instruction cost back to it).  Off OR eager = one dict
+        # read + the is_staging check — nothing on the eager hot path.
+        if _xray._state["on"] and is_staging():
+            with _xray.block_scope(self):
+                return self._hooked_forward(args)
+        return self._hooked_forward(args)
+
+    def _hooked_forward(self, args):
         for hook in self._forward_pre_hooks:
             hook(self, args)
         out = self.forward(*args)

@@ -667,6 +667,34 @@ def prometheus_text():
                [({"phase": p}, v / 1e3)
                 for p, v in sorted(phases.items())])
 
+    # fused-step x-ray: newest per-scope cost shares per program label
+    # (xray.py tables — snapshot reads only; a process that never
+    # compiled a whole-step program pays a sys.modules lookup)
+    import sys as _sys
+
+    _cs = _sys.modules.get("mxnet_tpu.compiled_step")
+    xprogs = (_cs.xray_snapshot() if _cs is not None
+              else {}).get("programs") or []
+    if xprogs:
+        newest = {}
+        for t in xprogs:  # seq-sorted: later wins
+            newest[t.get("label", "compiled_step")] = t
+        xrows = []
+        for label, t in sorted(newest.items()):
+            srows = dict(t.get("scopes") or {})
+            srows["unattributed"] = t.get("unattributed") or {}
+            for scope, rec in sorted(srows.items()):
+                for metric in ("flops", "bytes"):
+                    xrows.append((
+                        {"program": label, "scope": scope,
+                         "metric": metric},
+                        rec.get("%s_share" % metric)))
+        family("mxnet_tpu_xray_scope_share", "gauge",
+               "Newest compiled whole-step program's per-scope share "
+               "of whole-program flops/bytes (fused-step x-ray; "
+               "unattributed remainder completes the sum to 1).",
+               xrows)
+
     # every latency histogram as one summary family (associative
     # snapshots — the same numbers report()/cluster_report show)
     rows = []

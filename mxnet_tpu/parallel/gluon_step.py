@@ -43,6 +43,7 @@ from .. import autograd
 from .. import health as _health
 from .. import random as _random
 from .. import runtime_stats as _rts
+from .. import xray as _xray
 from ..base import MXNetError
 from ..gluon.block import staged_call
 from ..ndarray import NDArray
@@ -77,10 +78,13 @@ def _pure_loss_builder(block, loss_block, trainable, aux,
         override.update({p: NDArray(v) for p, v in zip(aux, aux_vals)})
 
         def fwd(x_nd):
-            loss = loss_block(block(x_nd), NDArray(y))
-            loss = loss.mean()
-            if aux_loss_weight is not None:
-                loss = loss + aux_loss_weight * block.collect_aux_losses()
+            out = block(x_nd)
+            with _xray.scope(_xray.REGION_LOSS):
+                loss = loss_block(out, NDArray(y))
+                loss = loss.mean()
+                if aux_loss_weight is not None:
+                    loss = loss \
+                        + aux_loss_weight * block.collect_aux_losses()
             return loss
 
         loss, scope = staged_call(fwd, override, key, (NDArray(x),))
@@ -366,8 +370,9 @@ class GluonTrainStep:
                     x_ = x
                 return pure_loss(tv, aux_vals, x_, y, key)
 
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_vals)
+            with _xray.scope(_xray.GRAD_MARKER):
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_vals)
             grads = tuple(g.astype(v.dtype)
                           for g, v in zip(grads, train_vals))
             return loss, grads, new_aux, _global_grad_norm(grads)
@@ -376,7 +381,9 @@ class GluonTrainStep:
             def step(train_vals, opt_state, aux_vals, x, y, key):
                 loss, grads, new_aux, gnorm = fwd_bwd(
                     train_vals, aux_vals, x, y, key)
-                new_vals, new_state = update(train_vals, grads, opt_state)
+                with _xray.scope(_xray.REGION_OPT):
+                    new_vals, new_state = update(train_vals, grads,
+                                                 opt_state)
                 return loss, new_vals, new_state, new_aux, gnorm
 
             sig_in = (tv_shard, state_shard, aux_shard, x_shard, y_shard,
@@ -385,8 +392,9 @@ class GluonTrainStep:
             def step(train_vals, opt_state, aux_vals, x, y, key, scalars):
                 loss, grads, new_aux, gnorm = fwd_bwd(
                     train_vals, aux_vals, x, y, key)
-                new_vals, new_state = opt_update.apply(
-                    train_vals, grads, opt_state, scalars)
+                with _xray.scope(_xray.REGION_OPT):
+                    new_vals, new_state = opt_update.apply(
+                        train_vals, grads, opt_state, scalars)
                 return loss, new_vals, new_state, new_aux, gnorm
 
             sig_in = (tv_shard, state_shard, aux_shard, x_shard, y_shard,
@@ -488,9 +496,10 @@ class GluonTrainStep:
                 # replicated makes GSPMD materialize the full value on
                 # every device inside this one program, overlapped with
                 # forward compute
-                tv = tuple(
-                    wsc(f, repl)[:size].reshape(shape)
-                    for f, size, shape in zip(tf, sizes, shapes))
+                with _xray.scope(_xray.REGION_ZERO_AG):
+                    tv = tuple(
+                        wsc(f, repl)[:size].reshape(shape)
+                        for f, size, shape in zip(tf, sizes, shapes))
                 if cast is not None:
                     tv = tuple(v.astype(cast) if v.dtype == _np.float32
                                else v for v in tv)
@@ -499,19 +508,22 @@ class GluonTrainStep:
                     x_ = x
                 return pure_loss(tv, aux_vals, x_, y, key)
 
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_flat)
+            with _xray.scope(_xray.GRAD_MARKER):
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_flat)
             # norm over the still-replicated grads: identical reduction
             # to the dp path's, so health trajectories match bit-exact
-            gnorm = _global_grad_norm(grads)
+            with _xray.scope(_xray.REGION_ZERO_GNORM):
+                gnorm = _global_grad_norm(grads)
             # the reduce-scatter: the backward's dp-summed grads are
             # constrained back to the 1/n flat layout — each device
             # keeps only the shard its update needs (GSPMD may lower
             # this as all-reduce + slice on backends without a fused
             # reduce-scatter; the data movement is semantically the
             # ZeRO reduce-scatter either way)
-            grads = tuple(wsc(g.astype(f.dtype), flat_shard)
-                          for g, f in zip(grads, train_flat))
+            with _xray.scope(_xray.REGION_ZERO_RS):
+                grads = tuple(wsc(g.astype(f.dtype), flat_shard)
+                              for g, f in zip(grads, train_flat))
             return loss, grads, new_aux, gnorm
 
         if opt_update is None:
@@ -520,7 +532,9 @@ class GluonTrainStep:
                     train_flat, aux_vals, x, y, key)
                 # elementwise update on the 1/n shards (pads carry
                 # exact zeros through: zero grad -> zero update)
-                new_vals, new_state = update(train_flat, grads, opt_flat)
+                with _xray.scope(_xray.REGION_OPT):
+                    new_vals, new_state = update(train_flat, grads,
+                                                 opt_flat)
                 return loss, new_vals, new_state, new_aux, gnorm
 
             sig_in = (flat_shard, flat_shard, repl, x_shard, y_shard,
@@ -529,8 +543,9 @@ class GluonTrainStep:
             def step(train_flat, opt_flat, aux_vals, x, y, key, scalars):
                 loss, grads, new_aux, gnorm = fwd_bwd(
                     train_flat, aux_vals, x, y, key)
-                new_vals, new_state = opt_update.apply(
-                    train_flat, grads, opt_flat, scalars)
+                with _xray.scope(_xray.REGION_OPT):
+                    new_vals, new_state = opt_update.apply(
+                        train_flat, grads, opt_flat, scalars)
                 return loss, new_vals, new_state, new_aux, gnorm
 
             sig_in = (flat_shard, flat_shard, repl, x_shard, y_shard,

@@ -41,6 +41,13 @@ Rules
   (``kvstore_dup_suppressed`` on a server's dump: retried mutations
   were acked from the exactly-once table instead of re-applying — the
   fingerprint of reply loss / restart drills).
+- **fused-step x-ray** (PR 15, ``xray`` section of the dump) —
+  ``xray-scope-dominated`` (one Gluon block's fwd+bwd scopes carry
+  most of the fused program's flops/bytes, named by path),
+  ``xray-zero-collective-share`` (collective vs compute bytes inside
+  the ZeRO program, docs/ZERO.md "When not to shard") and
+  ``xray-optimizer-share`` (the fused update region's bytes dominate:
+  state-dtype/sharding check).
 
 Trend rules (PR 10) run over a **timeline** — the per-step time series
 ``metrics_timeline`` records (its live ring, a ``MXNET_TPU_METRICS``
@@ -80,7 +87,9 @@ __all__ = ["diagnose", "classify", "render", "render_github",
            "gh_annotation", "SHARE_NOTICE", "SHARE_WARN",
            "HEADROOM_RATIO", "IDLE_GAP_SHARE", "TREND_MIN_SAMPLES",
            "TREND_SLOWDOWN", "LEAK_SLOPE_BYTES", "SPIKE_RATIO",
-           "KV_DRIFT_RATIO", "SERVE_QUEUE_RATIO", "SERVE_MIN_REQUESTS"]
+           "KV_DRIFT_RATIO", "SERVE_QUEUE_RATIO", "SERVE_MIN_REQUESTS",
+           "XRAY_DOMINANT_SHARE", "XRAY_ZERO_COLL_SHARE",
+           "XRAY_OPT_SHARE"]
 
 # a phase/rule at or above this share of step time is worth a line /
 # a warning; tunable per call via diagnose(..., notice=, warn=)
@@ -130,6 +139,21 @@ SERVE_MIN_REQUESTS = 32
 # sharding saves in state — the model is too small (or the per-device
 # batch too thin) for the current dp width
 ZERO_AG_RATIO = 0.5
+
+# ---- fused-step x-ray knobs (xray.py per-scope tables) -----------------
+# one block scope at or above this share of the whole program's flops
+# OR bytes dominates the fused step — name it so the next perf PR
+# knows where to aim; warns when it crosses XRAY_DOMINANT_WARN
+XRAY_DOMINANT_SHARE = 0.5
+XRAY_DOMINANT_WARN = 0.75
+# collective traffic inside the ZeRO program past this fraction of the
+# forward+backward scopes' bytes (the compute the gather feeds) means
+# the sharding's data movement rivals the math — the in-program cousin
+# of ZERO_AG_RATIO
+XRAY_ZERO_COLL_SHARE = 0.5
+# the fused optimizer-update region moving more than this fraction of
+# program bytes means the step is state-bound, not math-bound
+XRAY_OPT_SHARE = 0.4
 
 
 def classify(path):
@@ -534,6 +558,145 @@ def _check_zero_allgather(dump):
         "math), shrink the dp width, or drop zero=True — at this "
         "model size replicated state is cheaper than the collectives "
         "(docs/ZERO.md 'When not to shard')")]
+
+
+# ---------------------------------------------------------- x-ray rules
+
+
+def _xray_newest(dump, zero=None):
+    """The newest x-ray table in ``dump`` (optionally restricted to
+    zero / non-zero programs), or None."""
+    snap = dump.get("snapshot", dump)
+    programs = ((snap.get("xray") or {}).get("programs")) or []
+    if zero is not None:
+        programs = [t for t in programs if bool(t.get("zero")) == zero]
+    return programs[-1] if programs else None
+
+
+def _check_xray_scope(dump):
+    """**xray-scope-dominated** — one block's scope (forward+backward
+    summed) carries ``XRAY_DOMINANT_SHARE`` of the fused program's
+    flops or bytes: the named block is where the step's cost lives."""
+    t = _xray_newest(dump)
+    if t is None:
+        return []
+    blocks = {}
+    for scope, rec in (t.get("scopes") or {}).items():
+        if scope.startswith("forward/"):
+            path = scope[len("forward/"):]
+        elif scope.startswith("backward/"):
+            path = scope[len("backward/"):]
+        else:
+            continue  # optimizer / zero_* regions have their own rules
+        agg = blocks.setdefault(path, {"flops": 0.0, "bytes": 0.0})
+        agg["flops"] += rec.get("flops_share") or 0.0
+        agg["bytes"] += rec.get("bytes_share") or 0.0
+    if not blocks:
+        return []
+    path, agg = max(blocks.items(),
+                    key=lambda kv: max(kv[1]["flops"], kv[1]["bytes"]))
+    share = max(agg["flops"], agg["bytes"])
+    if share < XRAY_DOMINANT_SHARE:
+        return []
+    return [_finding(
+        "xray-scope-dominated", min(share, 1.0),
+        "block '%s' carries %.0f%% of the fused program's %s"
+        % (path, share * 100,
+           "flops" if agg["flops"] >= agg["bytes"] else "bytes"),
+        path,
+        ["fwd+bwd share of program %s: flops %.0f%%, bytes %.0f%% "
+         "(x-ray of %s, %d instruction(s))"
+         % (t.get("label", "compiled_step"), agg["flops"] * 100,
+            agg["bytes"] * 100, t.get("label", "compiled_step"),
+            t.get("instructions", 0))],
+        "this block is the fused step — aim kernel/layout/precision "
+        "work here and cite the x-ray share in the perf PR "
+        "(docs/OBSERVABILITY.md 'Fused-step X-ray')",
+        warn_at=XRAY_DOMINANT_WARN)]
+
+
+def _check_xray_zero_collective(dump):
+    """**xray-zero-collective-share** — collective bytes vs compute
+    bytes INSIDE the ZeRO program: the param all-gather / grad
+    reduce-scatter traffic against the forward+backward scopes' bytes
+    (the math that traffic feeds).  Prefers the HLO-measured collective
+    instructions; on single-device traces (where GSPMD elides the
+    collectives) it falls back to the measured per-step
+    ``zero_allgather_bytes``/``zero_reduce_bytes`` counters."""
+    t = _xray_newest(dump, zero=True)
+    if t is None:
+        return []
+    scopes = t.get("scopes") or {}
+    compute = sum((rec.get("bytes") or 0.0)
+                  for scope, rec in scopes.items()
+                  if scope.startswith(("forward/", "backward/")))
+    if not compute:
+        compute = (t.get("totals") or {}).get("bytes_accessed") or 0.0
+    if not compute:
+        return []
+    coll = sum((rec.get("collective_bytes") or 0.0)
+               for rec in scopes.values())
+    coll += (t.get("unattributed") or {}).get("collective_bytes") or 0.0
+    source = "HLO collective instructions"
+    if not coll:
+        snap = dump.get("snapshot", dump)
+        counters = snap.get("counters") or {}
+        zsteps = counters.get("zero_steps", 0)
+        if zsteps:
+            coll = (counters.get("zero_allgather_bytes", 0)
+                    + counters.get("zero_reduce_bytes", 0)) / zsteps
+            source = "zero_allgather/reduce counters (single-device " \
+                     "trace: GSPMD elided the collectives)"
+    if not coll:
+        return []
+    ratio = coll / compute
+    if ratio < XRAY_ZERO_COLL_SHARE:
+        return []
+    # score = collectives' fraction of the combined collective+compute
+    # traffic, so it stays a [0,1) share like every other rule
+    return [_finding(
+        "xray-zero-collective-share", coll / (coll + compute),
+        "ZeRO collectives move %.0f%% of what the fwd+bwd math moves "
+        "(%.1f vs %.1f MB/step)" % (ratio * 100, coll / 1e6,
+                                    compute / 1e6),
+        "zero",
+        ["measured from %s; program %s, %d instruction(s); "
+         "forward+backward scopes move %.1f MB"
+         % (source, t.get("label", "zero_step"),
+            t.get("instructions", 0), compute / 1e6)],
+        "the sharding's data movement rivals the math it feeds: raise "
+        "the per-device batch, shrink the dp width, or drop zero=True "
+        "(docs/ZERO.md 'When not to shard')")]
+
+
+def _check_xray_optimizer(dump):
+    """**xray-optimizer-share** — the fused update region's bytes
+    dominate the program: the step is optimizer-state-bound."""
+    t = _xray_newest(dump)
+    if t is None:
+        return []
+    rec = (t.get("scopes") or {}).get("optimizer")
+    if not rec:
+        return []
+    share = rec.get("bytes_share") or 0.0
+    if share < XRAY_OPT_SHARE:
+        return []
+    return [_finding(
+        "xray-optimizer-share", min(share, 1.0),
+        "the fused optimizer update moves %.0f%% of the program's "
+        "bytes (%.1f of %.1f MB)"
+        % (share * 100, rec.get("bytes", 0.0) / 1e6,
+           ((t.get("totals") or {}).get("bytes_accessed") or 0.0)
+           / 1e6),
+        "optimizer",
+        ["update-region flops share %.0f%%, bytes share %.0f%% "
+         "(x-ray of %s)" % ((rec.get("flops_share") or 0.0) * 100,
+                            share * 100,
+                            t.get("label", "compiled_step"))],
+        "the step is state-bound: check the optimizer state dtype "
+        "(fp32 master copies double the traffic), shard the state "
+        "with zero=True (docs/ZERO.md), or pick a lighter-state "
+        "optimizer")]
 
 
 # --------------------------------------------------------- serving rules
@@ -954,6 +1117,9 @@ def diagnose(trace=None, dump=None, timeline=None, top=20):
         findings += _check_retries(dump)
         findings += _check_self_healing(dump)
         findings += _check_zero_allgather(dump)
+        findings += _check_xray_scope(dump)
+        findings += _check_xray_zero_collective(dump)
+        findings += _check_xray_optimizer(dump)
         findings += _check_serving(dump)
         if timeline is None:
             timeline = dump.get("timeline")

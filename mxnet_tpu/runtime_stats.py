@@ -394,6 +394,7 @@ def snapshot():
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
             "storms": storms, "memory": device_memory.snapshot(),
             "costs": costs,
+            "xray": _compiled.xray_snapshot(),
             "health": _health.snapshot(),
             "checkpoint": _checkpoint.snapshot(),
             "histograms": _histogram.snapshot(),
@@ -474,6 +475,7 @@ def _render(snap, top=None):
                             ("%.3f" % v) if isinstance(v, float) else v))
     lines.extend(_stepstats.render(snap.get("stepstats") or {}))
     lines.extend(_render_costs(snap, top=top))
+    lines.extend(_render_xray(snap.get("xray") or {}, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
     lines.extend(_render_health(snap.get("health") or {}))
     serving = snap.get("serving") or {}
@@ -543,6 +545,50 @@ def _render_costs(snap, top=None):
             name[:28], c["cache_entries"], c.get("analyzed", 0),
             _fmt(c.get("output_bytes"), 1e6),
             _fmt(c.get("temp_bytes"), 1e6)))
+    return lines
+
+
+def _render_xray(xr, top=None):
+    """Render the fused-step x-ray tables (newest program per label):
+    per-scope flops/bytes with shares of the whole-program
+    cost_analysis totals, the explicit unattributed remainder last —
+    rows sum to TOTAL by the conservation contract."""
+    programs = (xr or {}).get("programs") or []
+    if not programs:
+        return []
+    newest = {}
+    for t in programs:  # seq-sorted: later wins
+        newest[t.get("label", "compiled_step")] = t
+    lines = []
+    for label, t in sorted(newest.items()):
+        lines.append("")
+        flags = []
+        if t.get("estimated"):
+            flags.append("estimated totals: no cost_analysis truth")
+        if t.get("overattributed"):
+            flags.append("estimates scaled to totals")
+        lines.append("Fused-step x-ray: %s (%d instructions%s)"
+                     % (label, t.get("instructions", 0),
+                        ("; " + "; ".join(flags)) if flags else ""))
+        lines.append("%-44s %10s %6s %10s %6s %9s"
+                     % ("Scope", "GFLOP", "", "MB", "", "Coll MB"))
+        rows = sorted(t.get("scopes", {}).items(),
+                      key=lambda kv: -kv[1].get("bytes", 0.0))
+        if top:
+            rows = rows[:top]
+        un = t.get("unattributed") or {}
+        rows.append(("unattributed", un))
+        for name, r in rows:
+            lines.append("%-44s %10s %5.1f%% %10s %5.1f%% %9s" % (
+                name[:44], _fmt(r.get("flops"), 1e9),
+                100.0 * r.get("flops_share", 0.0),
+                _fmt(r.get("bytes"), 1e6),
+                100.0 * r.get("bytes_share", 0.0),
+                _fmt(r.get("collective_bytes"), 1e6)))
+        tot = t.get("totals") or {}
+        lines.append("%-44s %10s %6s %10s %6s %9s" % (
+            "TOTAL", _fmt(tot.get("flops"), 1e9), "",
+            _fmt(tot.get("bytes_accessed"), 1e6), "", ""))
     return lines
 
 
@@ -862,6 +908,14 @@ _stepstats._activate_from_env()
 from . import metrics_timeline as _metrics_timeline  # noqa: E402
 
 _metrics_timeline._activate_from_env()
+# fused-step x-ray kill switch (MXNET_TPU_XRAY=0) and hang-forensics
+# stack dumps (MXNET_TPU_STACKDUMP=<file> arms SIGUSR2) join the same
+# import-time activation chain
+from . import stackdump as _stackdump  # noqa: E402
+from . import xray as _xray  # noqa: E402
+
+_xray._activate_from_env()
+_stackdump._activate_from_env()
 
 
 # -------------------------------------------------- cluster aggregation
@@ -1104,6 +1158,23 @@ def _comparable_metrics(dump, min_seconds):
             if v:
                 out["zero:%s" % key] = (v / zsteps / 1e6, "MB/step",
                                         "zero")
+    # fused-step x-ray: the newest program's per-scope share of whole-
+    # program bytes, oriented up-is-worse (a targeted perf PR drives
+    # its region's share DOWN).  kind "xray" shares the "zero" rule in
+    # compare(): a scope present on only one side is a model/topology
+    # change — a note, never a verdict.  Sub-percent scopes are noise.
+    xprogs = ((snap.get("xray") or {}).get("programs")) or []
+    xnewest = {}
+    for t in xprogs:  # seq-sorted: later wins
+        xnewest[t.get("label", "compiled_step")] = t
+    for label, t in sorted(xnewest.items()):
+        rows = dict(t.get("scopes") or {})
+        rows["unattributed"] = t.get("unattributed") or {}
+        for scope, rec in rows.items():
+            share = rec.get("bytes_share") or 0.0
+            if share >= 0.01:
+                out["xray:%s:%s bytes_share" % (label, scope)] = (
+                    share * 100.0, "%", "xray")
     # device-memory peak
     peak = ((snap.get("memory") or {}).get("totals") or {}).get(
         "peak_bytes", 0)
@@ -1162,11 +1233,11 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
         ratio = (after / before) if before > 0.0 else float("inf")
         entry = {"metric": metric, "kind": kind, "unit": unit,
                  "before": before, "after": after, "ratio": ratio}
-        if kind == "zero" and (va is None or vb is None):
-            # collective-bytes counters existing on only one side mean
-            # the two runs used different sharding topologies (eager vs
-            # zero) — worth surfacing, but 0 -> N bytes is a change of
-            # shape, not a performance verdict
+        if kind in ("zero", "xray") and (va is None or vb is None):
+            # collective-bytes counters (or x-ray scopes) existing on
+            # only one side mean the two runs used different sharding
+            # topologies / model structures — worth surfacing, but
+            # 0 -> N is a change of shape, not a performance verdict
             entry["side"] = "after-only" if va is None else "before-only"
             notes.append(entry)
             continue
@@ -1211,10 +1282,12 @@ def render_compare(result):
     _rows("REGRESSIONS (worse in B)", result["regressions"])
     _rows("improvements (better in B)", result["improvements"])
     for e in result.get("notes", []):
-        lines.append("  note: %s present %s (%.3f -> %.3f %s) — "
-                     "sharding topology differs between the dumps"
+        why = ("the traced model/step structure differs between the "
+               "dumps" if e.get("kind") == "xray" else
+               "sharding topology differs between the dumps")
+        lines.append("  note: %s present %s (%.3f -> %.3f %s) — %s"
                      % (e["metric"], e.get("side", "one-sided"),
-                        e["before"], e["after"], e["unit"]))
+                        e["before"], e["after"], e["unit"], why))
     if not result["regressions"] and not result["improvements"]:
         lines.append("no change past the threshold — dumps are "
                      "performance-equivalent")

@@ -1,0 +1,108 @@
+"""Hang forensics — all-thread stack dumps on demand.
+
+The flight recorder (health.py) covers crashes and NaN storms, but a
+HUNG training process — a deadlocked collective, a stuck host
+callback, an input pipeline that never returns — leaves nothing.  This
+module wires Python's ``faulthandler`` so an operator can ask a live
+(even wedged) process for every thread's stack:
+
+* ``MXNET_TPU_STACKDUMP=<file>`` arms SIGUSR2 at import (the same
+  activation chain as ``MXNET_TPU_DIAG``'s SIGUSR1): ``kill -USR2
+  <pid>`` writes the dump and training continues.
+* :func:`dump_stacks` does the same programmatically (watchdogs,
+  tests).
+
+The dump is written through ``checkpoint.atomic_write`` — the one
+atomic-write primitive every persistence path routes through — so a
+reader never sees a torn file, and the path is rank-suffixed by
+``log.rank_suffix_path`` so multi-process launches don't clobber each
+other.  A header maps thread idents to Python thread names (the
+``faulthandler`` traceback identifies threads by ident only).
+Docs: docs/OBSERVABILITY.md "Hang forensics".
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import threading
+
+__all__ = ["dump_stacks", "install", "installed"]
+
+DEFAULT_PATH = "mxnet_tpu_stacks.txt"
+
+_state = {"installed": False, "path": None}
+
+
+def installed():
+    """True once the SIGUSR2 handler is armed."""
+    return _state["installed"]
+
+
+def dump_stacks(path=None):
+    """Write every thread's current Python stack to ``path`` (default:
+    the armed/env path, else ``mxnet_tpu_stacks.txt``) atomically,
+    rank-suffixed.  Returns the absolute path written."""
+    from .checkpoint import atomic_write
+    from .log import process_identity, rank_suffix_path
+
+    path = path or _state["path"] \
+        or os.environ.get("MXNET_TPU_STACKDUMP") or DEFAULT_PATH
+    path = rank_suffix_path(path)
+    ident = process_identity()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    with atomic_write(path) as tmp:
+        with open(tmp, "w") as f:
+            f.write("mxnet_tpu stack dump: pid=%d identity=%s\n"
+                    % (os.getpid(),
+                       "%s%d/%d" % (ident["role"], ident["rank"],
+                                    ident["num_workers"])
+                       if ident else "single-process"))
+            f.write("threads: %s\n\n"
+                    % ", ".join("0x%x=%s" % (i, n)
+                                for i, n in sorted(names.items())
+                                if i is not None))
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+    from . import runtime_stats as _rts
+
+    _rts.inc("stack_dumps")
+    return os.path.abspath(path)
+
+
+def install(path=None):
+    """Arm SIGUSR2 -> :func:`dump_stacks`.  Tolerates platforms
+    without SIGUSR2 and non-main threads (returns False), like the
+    SIGUSR1 diag handler."""
+    import signal
+
+    sig = getattr(signal, "SIGUSR2", None)
+    if sig is None:
+        return False
+
+    def _handler(_signum, _frame):
+        try:
+            dump_stacks()
+        except Exception:  # a forensics request must never kill training
+            from .log import get_logger
+
+            get_logger("stackdump").exception(
+                "MXNET_TPU_STACKDUMP dump failed")
+
+    try:
+        signal.signal(sig, _handler)
+    except ValueError:  # not the main thread
+        return False
+    if path:
+        _state["path"] = path
+    _state["installed"] = True
+    return True
+
+
+def _activate_from_env():
+    """``MXNET_TPU_STACKDUMP=<file>``: arm the SIGUSR2 handler — called
+    from runtime_stats' import-time activation chain."""
+    path = os.environ.get("MXNET_TPU_STACKDUMP")
+    if not path:
+        return False
+    return install(path)

@@ -1,0 +1,403 @@
+"""Fused-step X-ray — named-scope cost attribution inside the donated
+whole-step program.
+
+PR 11/14 moved the whole training iteration (forward + backward +
+optimizer update + ZeRO collectives) into ONE donated XLA program, so
+the per-op telemetry reads "compiled_step: ~100%" with nothing inside.
+This module restores per-region attribution:
+
+* **Trace time** — the canonical regions of the fused step are wrapped
+  in ``jax.named_scope``: every Gluon block's forward (at the
+  ``Block.__call__`` staging seam, scope = block name path), the loss
+  (:data:`REGION_LOSS`), the ``value_and_grad`` backward
+  (:data:`GRAD_MARKER`), the fused optimizer update
+  (:data:`REGION_OPT`) and the ZeRO all-gather / grad-norm /
+  reduce-scatter regions in ``parallel/gluon_step.py``.  XLA carries
+  the scope path into every HLO instruction's
+  ``metadata={op_name="jit(f)/.../<scope>/<primitive>"}``, through
+  fusion: fused computations list their inner instructions with their
+  own metadata.
+
+* **Compile time** — ``compiled_step.py``'s two AOT
+  ``lower(...).compile()`` sites call :func:`analyze`, which parses the
+  optimized HLO text and attributes per-instruction flops, bytes
+  accessed, output bytes and collective bytes back to canonical scopes
+  (``forward/<block path>``, ``backward/<block path>``, ``optimizer``,
+  ``zero_allgather`` …).  JAX's AD markers are folded in:
+  ``jvp(<scope>)`` instructions stay forward, anything under
+  ``transpose(...)`` is the backward of that scope.
+
+The table obeys the same conservation contract ``stepstats`` pins for
+wall time: an explicit ``unattributed`` remainder absorbs whatever the
+per-instruction estimates did not cover, and when the estimates
+OVERSHOOT the whole-program ``cost_analysis`` totals they are scaled
+down (and the metric listed under ``overattributed``) — scope sums can
+never exceed program totals, sum(scopes) + unattributed == totals.
+
+Attribution runs only at the two compile sites, gated on the same
+``cost_capture_active()`` switch as cost analysis — never on the step
+hot path.  Trace-time annotation is on by default and costs one dict
+read per block call when disabled; ``MXNET_TPU_XRAY=0`` is the kill
+switch (``=1`` force-enables after a programmatic :func:`disable`).
+Docs: docs/OBSERVABILITY.md "Fused-step X-ray".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import re
+import threading
+
+__all__ = ["enable", "disable", "is_enabled", "scope", "block_scope",
+           "canonical_scope", "attribute", "analyze",
+           "REGION_LOSS", "REGION_OPT", "GRAD_MARKER",
+           "REGION_ZERO_AG", "REGION_ZERO_RS", "REGION_ZERO_GNORM"]
+
+# single-dict-read disabled check (the stepstats/profiler convention):
+# Block.__call__ and the scope() helpers consult _state["on"] and do
+# nothing else when off — pinned by the bench gate
+_state = {"on": True}
+
+_SEQ = itertools.count(1)
+
+# canonical region names the fused-step tracers wrap
+REGION_LOSS = "loss"
+REGION_OPT = "optimizer"
+REGION_ZERO_AG = "zero_allgather"
+REGION_ZERO_RS = "zero_reduce_scatter"
+REGION_ZERO_GNORM = "zero_gradnorm"
+# wrapper scope around the value_and_grad CALL — a direction marker
+# only, filtered out of canonical paths (transpose() in the op_name is
+# what actually flags backward)
+GRAD_MARKER = "grad"
+
+# regions reported as-is, without a forward/backward direction prefix:
+# the optimizer update and the ZeRO data movement are step phases of
+# their own, whichever side of the transpose they land on
+_PLAIN_REGIONS = frozenset(
+    {REGION_OPT, REGION_ZERO_AG, REGION_ZERO_RS, REGION_ZERO_GNORM})
+
+_NULL = contextlib.nullcontext()
+
+
+def enable():
+    """(Re-)arm trace-time scope annotation (the default state)."""
+    _state["on"] = True
+
+
+def disable():
+    """Stop annotating traces; already-captured tables remain."""
+    _state["on"] = False
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def _activate_from_env():
+    """``MXNET_TPU_XRAY=0`` kills annotation, ``=1`` force-enables —
+    called from runtime_stats' import-time activation chain."""
+    v = os.environ.get("MXNET_TPU_XRAY")
+    if v == "0":
+        disable()
+    elif v == "1":
+        enable()
+
+
+# ------------------------------------------------------------ trace side
+def scope(name):
+    """``jax.named_scope(name)`` when armed, a no-op context otherwise.
+
+    Trace-time only — the returned context manager never appears on the
+    executed step path."""
+    if not _state["on"]:
+        return _NULL
+    import jax
+
+    return jax.named_scope(name)
+
+
+# Block names are FULL prefixes ("sequential0_dense0" for a child of
+# "sequential0"), so a naive nesting would render
+# "sequential0/sequential0_dense0".  A per-thread stack of the raw
+# names lets each child strip its parent's prefix and the scope path
+# read "sequential0/dense0".
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def block_scope(block):
+    """Named scope for one Gluon block's forward, labelled with the
+    block name minus the enclosing block's prefix (so nested paths
+    compose as ``parent/child``)."""
+    import jax
+
+    name = getattr(block, "_name", None) or type(block).__name__.lower()
+    stack = getattr(_tls, "blocks", None)
+    if stack is None:
+        stack = _tls.blocks = []
+    label = name
+    if stack:
+        parent = stack[-1]
+        if label.startswith(parent + "_"):
+            label = label[len(parent) + 1:]
+    label = label or name
+    stack.append(name)
+    try:
+        with jax.named_scope(label):
+            yield
+    finally:
+        stack.pop()
+
+
+# ---------------------------------------------------------- compile side
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(f8e4m3fn|f8e5m2|pred|bf16|f16|f32|f64|c64|c128|"
+    r"s4|s8|s16|s32|s64|u4|u8|u16|u32|u64)\[([0-9,]*)\]")
+
+# one HLO instruction: "  [ROOT] %name = <shape> opcode(operands...)"
+# — shape is either one array ("f32[8,16]{1,0}") or a tuple
+# ("(f32[8]{0}, f32[])"); array layouts use braces, so the tuple never
+# nests parens
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+ = (?P<shape>\([^)]*\)|\S+) "
+    r"(?P<op>[a-z][\w\-]*)\(")
+
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CDIMS_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIMLABELS_RE = re.compile(r"dim_labels=([\w>\-]+)")
+_WRAPPER_RE = re.compile(
+    r"\b(transpose|jvp|vjp|vmap|pmap|remat|checkpoint|pjit)\(")
+
+# bookkeeping opcodes that move no data of their own (or whose cost is
+# carried by their inner/paired instruction): the fusion container is
+# skipped because its fused computation's instructions are parsed with
+# their own metadata
+_SKIP_OPS = frozenset({
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "bitcast-convert", "fusion", "call", "after-all", "partition-id",
+    "replica-id", "domain", "get-dimension-size", "opt-barrier",
+    "add-dependency",
+})
+
+_COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+})
+
+
+def _shapes(s):
+    """Every ``dtype[dims]`` token in ``s`` as (dims tuple, elems,
+    bytes) triples."""
+    out = []
+    for m in _SHAPE_RE.finditer(s):
+        dims = tuple(int(d) for d in m.group(2).split(",") if d)
+        elems = 1
+        for d in dims:
+            elems *= d
+        out.append((dims, elems, elems * _DTYPE_BYTES.get(m.group(1), 4)))
+    return out
+
+
+def _unwrap(op_name):
+    """Flatten JAX transform markers out of an op_name: ``jvp(X)`` →
+    ``X`` (still forward), ``transpose(X)`` → ``X`` with the backward
+    flag raised."""
+    s = op_name
+    backward = False
+    while True:
+        m = _WRAPPER_RE.search(s)
+        if m is None:
+            return s, backward
+        if m.group(1) == "transpose":
+            backward = True
+        depth, j = 1, m.end()
+        while j < len(s) and depth:
+            if s[j] == "(":
+                depth += 1
+            elif s[j] == ")":
+                depth -= 1
+            j += 1
+        s = s[:m.start()] + s[m.end():j - 1] + s[j:]
+
+
+def canonical_scope(op_name):
+    """The canonical scope for one instruction's ``op_name`` metadata,
+    or ``None`` when it carries no user scope (→ unattributed).
+
+    ``jit(...)`` components and the :data:`GRAD_MARKER` wrapper are
+    dropped, transform markers are unwrapped (``transpose`` anywhere
+    flags backward), the trailing component (the primitive name) is
+    stripped, and the remaining path gets a ``forward/`` or
+    ``backward/`` prefix — except the plain step regions (optimizer,
+    zero_*), which report as-is."""
+    if not op_name:
+        return None
+    s, backward = _unwrap(op_name)
+    parts = [p for p in s.split("/")
+             if p and not p.startswith("jit(") and p != GRAD_MARKER]
+    if len(parts) < 2:
+        return None  # just the primitive name: no user scope
+    path = parts[:-1]
+    if path[0] in _PLAIN_REGIONS:
+        return "/".join(path)
+    return ("backward/" if backward else "forward/") + "/".join(path)
+
+
+def _instr_cost(op, shape_str, operand_str, attr_str):
+    """(flops, bytes_accessed, output_bytes, collective_bytes)
+    estimates for one instruction from its shapes + attributes."""
+    outs = _shapes(shape_str)
+    out_elems = sum(e for _, e, _ in outs)
+    out_bytes = sum(b for _, _, b in outs)
+    opnds = _shapes(operand_str)
+    opnd_bytes = sum(b for _, _, b in opnds)
+    base = op[:-6] if op.endswith("-start") else op
+    flops = 0.0
+    coll = 0.0
+    if base == "dot":
+        flops = 2.0 * out_elems
+        m = _CDIMS_RE.search(attr_str)
+        if m and opnds:
+            lhs_dims = opnds[0][0]
+            for d in m.group(1).split(","):
+                if d and int(d) < len(lhs_dims):
+                    flops *= lhs_dims[int(d)]
+    elif base == "convolution":
+        flops = 2.0 * out_elems
+        m = _DIMLABELS_RE.search(attr_str)
+        if m and len(opnds) > 1 and "_" in m.group(1):
+            rhs_lab = m.group(1).split("_", 1)[1].split("->", 1)[0]
+            rhs_dims = opnds[1][0]
+            if len(rhs_lab) == len(rhs_dims):
+                for ch, d in zip(rhs_lab, rhs_dims):
+                    if ch != "o":  # Cin and the kernel spatial dims
+                        flops *= d
+    elif base in ("reduce", "reduce-window"):
+        flops = float(sum(e for _, e, _ in opnds))
+    elif base in _COLLECTIVE_OPS:
+        coll = float(max(opnd_bytes, out_bytes))
+    elif base in ("custom-call", "rng-bit-generator", "copy", "iota",
+                  "broadcast", "reshape", "transpose", "slice",
+                  "concatenate", "pad", "gather", "scatter",
+                  "dynamic-slice", "dynamic-update-slice"):
+        flops = 0.0  # data movement / opaque: bytes carry the cost
+    else:
+        flops = float(out_elems)  # elementwise default
+    return flops, float(opnd_bytes + out_bytes), float(out_bytes), coll
+
+
+def _zero_rec():
+    return {"flops": 0.0, "bytes": 0.0, "output_bytes": 0.0,
+            "collective_bytes": 0.0, "instructions": 0}
+
+
+def attribute(hlo_text):
+    """Parse optimized HLO text into raw per-scope cost estimates.
+
+    Returns ``(scopes, unattributed, parsed)`` — ``scopes`` maps
+    canonical scope → {flops, bytes, output_bytes, collective_bytes,
+    instructions}, instructions without a user scope land in
+    ``unattributed``, ``parsed`` counts costed instructions."""
+    scopes = {}
+    un = _zero_rec()
+    parsed = 0
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        if op in _SKIP_OPS or op.endswith("-done") \
+                or op.endswith("-update"):
+            continue
+        rest = line[m.end():]
+        depth, j = 1, 0
+        while j < len(rest) and depth:
+            if rest[j] == "(":
+                depth += 1
+            elif rest[j] == ")":
+                depth -= 1
+            j += 1
+        operand_str, attr_str = rest[:j], rest[j:]
+        flops, bacc, obytes, coll = _instr_cost(
+            op, m.group("shape"), operand_str, attr_str)
+        om = _OPNAME_RE.search(attr_str)
+        sc = canonical_scope(om.group(1)) if om else None
+        rec = scopes.setdefault(sc, _zero_rec()) if sc else un
+        rec["flops"] += flops
+        rec["bytes"] += bacc
+        rec["output_bytes"] += obytes
+        rec["collective_bytes"] += coll
+        rec["instructions"] += 1
+        parsed += 1
+    return scopes, un, parsed
+
+
+def analyze(compiled, cost=None, label="compiled_step", zero=False):
+    """Build the conservation-normalized per-scope table for one
+    compiled whole-step program.
+
+    ``cost`` is the program's ``compiled_cost`` record; when it carries
+    whole-program ``flops`` / ``bytes_accessed`` truth, per-scope
+    estimates that fall short leave the difference in ``unattributed``
+    and estimates that overshoot are scaled down (metric listed in
+    ``overattributed``) — either way sum(scopes) + unattributed ==
+    totals.  Metrics with no truth fall back to the estimate sums and
+    are listed in ``estimated``."""
+    if not _state["on"]:
+        return None
+    scopes, un, parsed = attribute(compiled.as_text())
+    totals = {}
+    estimated = []
+    over = []
+    for metric, ckey in (("flops", "flops"), ("bytes", "bytes_accessed")):
+        attr = sum(rec[metric] for rec in scopes.values())
+        true = None
+        if cost is not None and ckey in cost:
+            try:
+                true = float(cost[ckey])
+            except (TypeError, ValueError):
+                true = None
+        if true is None:
+            totals[ckey] = attr + un[metric]
+            estimated.append(ckey)
+        elif attr <= true:
+            totals[ckey] = true
+            un[metric] = true - attr
+        else:
+            # estimates overshoot the measured program total (fusion
+            # intermediates overcount real traffic): scale every bucket
+            # down proportionally, unattributed included, so the sum
+            # still lands exactly on the truth
+            scale = true / (attr + un[metric])
+            for rec in scopes.values():
+                rec[metric] *= scale
+            un[metric] *= scale
+            totals[ckey] = true
+            over.append(ckey)
+    tf, tb = totals["flops"], totals["bytes_accessed"]
+    for rec in list(scopes.values()) + [un]:
+        rec["flops_share"] = rec["flops"] / tf if tf else 0.0
+        rec["bytes_share"] = rec["bytes"] / tb if tb else 0.0
+    return {
+        "seq": next(_SEQ),
+        "label": label,
+        "zero": bool(zero),
+        "instructions": parsed,
+        "totals": totals,
+        "estimated": estimated,
+        "overattributed": over,
+        "scopes": scopes,
+        "unattributed": un,
+    }

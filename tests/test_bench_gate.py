@@ -610,3 +610,39 @@ def test_disabled_metrics_timeline_overhead_bound():
     assert after["samples"] == before["samples"], \
         "disabled on_step must record nothing"
     assert after["step"] == before["step"]
+
+
+def test_disabled_xray_annotation_overhead_bound():
+    """PR 15 gate: fused-step x-ray annotation must be pay-for-use.
+    With annotation disabled (``MXNET_TPU_XRAY=0``), ``xray.scope`` —
+    the helper every fused-step tracer and ``Block.__call__`` route
+    through — is ONE dict read returning a shared null context: no jax
+    import, no named_scope allocation.  (HLO attribution itself runs
+    only at the two compile sites, never per step.)  Pinned like the
+    other disabled-path bounds."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import xray
+
+    if os.environ.get("MXNET_TPU_XRAY") == "1":
+        pytest.skip("x-ray annotation force-enabled in this run")
+    was_on = xray.is_enabled()
+    xray.disable()
+    try:
+        n_calls = 1000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                xray.scope(xray.REGION_OPT)
+            best = min(best, (time.perf_counter() - t0) / n_calls)
+        # the guard is one dict read (~0.1us); 10us tolerates slow
+        # shared CI while catching any real disabled-path work
+        assert best < 1e-5, \
+            "xray.scope with annotation off took %.2fus" % (best * 1e6)
+        assert xray.scope("anything") is xray._NULL
+    finally:
+        if was_on:
+            xray.enable()

@@ -1,0 +1,478 @@
+"""PR 15: fused-step X-ray (mxnet_tpu/xray.py) + hang forensics
+(mxnet_tpu/stackdump.py).
+
+Pins the acceptance criteria:
+
+- CONSERVATION: on a compiled MLP+Adam step (and a conv model) the
+  per-scope flops/bytes plus the explicit ``unattributed`` remainder
+  sum EXACTLY to the whole-program ``cost_analysis`` totals, and the
+  table names per-block forward/backward scopes, the loss, and the
+  fused optimizer region;
+- the three perf-doctor x-ray rules (scope-dominated,
+  zero-collective-share, optimizer-share) fire on dumps built to
+  violate them and stay quiet on healthy ones, and emit through the
+  ``--format github`` ``::error``/``::notice`` path;
+- ``compare()`` carries x-ray scope shares as oriented rows — flat on
+  identical dumps, and a scope existing on only one side lands in
+  ``notes`` (a topology change), never in the verdict;
+- ``tools/diagnose.py --xray`` renders the table from a diag dump;
+- SIGUSR2 / ``dump_stacks`` writes an atomic, rank-suffixed all-thread
+  stack dump through ``checkpoint.atomic_write``.
+"""
+
+import copy
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import (compiled_step, gluon, metrics_timeline,
+                       perfdoctor, runtime_stats, stackdump, xray)
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    runtime_stats.reset()
+    xray.enable()
+    yield
+    runtime_stats.reset()
+    xray.enable()
+
+
+def _make_mlp(seed=7):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.zeros((2, 8)))
+    return net
+
+
+def _make_conv(seed=9):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC"))
+        net.add(nn.GlobalAvgPool2D(layout="NHWC"))
+        net.add(nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net(mx.nd.zeros((1, 8, 8, 3)))
+    return net
+
+
+def _run_compiled(net, x, y, opt="adam", opt_args=None):
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       opt_args or {"learning_rate": 0.01})
+    cs = tr.compile(net, loss_fn)
+    cs.step(mx.nd.array(x), mx.nd.array(y))
+    return cs
+
+
+def _newest_table(label="compiled_step"):
+    # NB: the caller must still hold its CompiledStep — the tables
+    # live on the weak registry's cache entries and die with it
+    programs = (compiled_step.xray_snapshot() or {}).get("programs", [])
+    # earlier suites' CompiledSteps can linger in the weak registry:
+    # filter by label and take the newest (highest seq)
+    programs = [t for t in programs if t.get("label") == label]
+    assert programs, "no x-ray table captured for label %r" % label
+    return programs[-1]
+
+
+def _assert_conserved(t):
+    """sum(scopes) + unattributed == totals, for both metrics."""
+    scopes = t["scopes"]
+    for metric, ckey in (("flops", "flops"), ("bytes", "bytes_accessed")):
+        total = t["totals"][ckey]
+        attributed = sum(rec[metric] for rec in scopes.values())
+        attributed += t["unattributed"][metric]
+        assert attributed == pytest.approx(total, rel=1e-9), \
+            "%s: scopes+unattributed %.1f != program total %.1f" \
+            % (metric, attributed, total)
+        assert total > 0
+
+
+# ------------------------------------------------- conservation contract
+
+
+def test_conservation_mlp_adam(monkeypatch):
+    """ACCEPTANCE: per-block forward/backward scopes + loss + optimizer
+    are named, and their flops/bytes with the explicit unattributed
+    remainder sum to the whole-program cost_analysis totals."""
+    monkeypatch.setenv("MXNET_TPU_COST_ANALYSIS", "1")
+    rs = np.random.RandomState(0)
+    net = _make_mlp()
+    cs = _run_compiled(net, rs.rand(2, 8).astype(np.float32),
+                       rs.randint(0, 4, (2,)).astype(np.float32))
+    t = _newest_table("compiled_step")
+    scopes = t["scopes"]
+    # per-block forward AND backward scopes, named by block path
+    assert any(s.startswith("forward/") and s.endswith("dense0")
+               for s in scopes), sorted(scopes)
+    assert any(s.startswith("backward/") and "dense" in s
+               for s in scopes), sorted(scopes)
+    assert any("loss" in s for s in scopes), sorted(scopes)
+    assert "optimizer" in scopes, sorted(scopes)
+    # Adam's state update moves real bytes
+    assert scopes["optimizer"]["bytes"] > 0
+    assert t["instructions"] > 0
+    # truth-anchored: cost capture was active, so neither metric fell
+    # back to estimate-only totals
+    assert t["estimated"] == []
+    _assert_conserved(t)
+    # shares are consistent with the raw numbers
+    for rec in list(scopes.values()) + [t["unattributed"]]:
+        assert rec["bytes_share"] == pytest.approx(
+            rec["bytes"] / t["totals"]["bytes_accessed"], rel=1e-9)
+
+
+def test_conservation_conv_model(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COST_ANALYSIS", "1")
+    rs = np.random.RandomState(1)
+    net = _make_conv()
+    cs = _run_compiled(net, rs.rand(2, 8, 8, 3).astype(np.float32),
+                       rs.randint(0, 4, (2,)).astype(np.float32),
+                       opt="sgd", opt_args={"learning_rate": 0.1})
+    t = _newest_table("compiled_step")
+    scopes = t["scopes"]
+    assert any("conv2d0" in s for s in scopes), sorted(scopes)
+    assert "optimizer" in scopes
+    _assert_conserved(t)
+
+
+def test_conservation_zero_step(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_COST_ANALYSIS", "1")
+    rs = np.random.RandomState(2)
+    net = _make_mlp()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    zs = compiled_step.ZeroCompiledStep(net, loss_fn, tr)
+    # batch 8: conftest forces 8 virtual devices, the zero path shards
+    # the batch across them
+    zs.step(mx.nd.array(rs.rand(8, 8).astype(np.float32)),
+            mx.nd.array(rs.randint(0, 4, (8,)).astype(np.float32)))
+    t = _newest_table("zero_step")
+    assert t["zero"] is True
+    assert "optimizer" in t["scopes"], sorted(t["scopes"])
+    _assert_conserved(t)
+
+
+def test_disabled_xray_captures_nothing(monkeypatch):
+    """With annotation disabled the compile sites skip attribution —
+    the entry's table stays None (the single-dict-read off path)."""
+    monkeypatch.setenv("MXNET_TPU_COST_ANALYSIS", "1")
+    xray.disable()
+    rs = np.random.RandomState(3)
+    net = _make_mlp()
+    cs = _run_compiled(net, rs.rand(2, 8).astype(np.float32),
+                       rs.randint(0, 4, (2,)).astype(np.float32))
+    assert all(e.xray is None for e in cs._cache.values())
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_XRAY", "0")
+    xray._activate_from_env()
+    assert not xray.is_enabled()
+    assert xray.scope("anything") is xray._NULL
+    monkeypatch.setenv("MXNET_TPU_XRAY", "1")
+    xray._activate_from_env()
+    assert xray.is_enabled()
+
+
+# ------------------------------------------------------- canonical_scope
+
+
+def test_canonical_scope_paths():
+    cs = xray.canonical_scope
+    # forward path: jit(...) parts and the trailing primitive drop
+    assert cs("jit(step)/jit(main)/hybridsequential0/dense0/dot_general") \
+        == "forward/hybridsequential0/dense0"
+    # jvp stays forward; transpose anywhere flags backward
+    assert cs("jit(step)/jvp(hybridsequential0/dense0)/dot_general") \
+        == "forward/hybridsequential0/dense0"
+    assert cs("jit(step)/transpose(jvp(hybridsequential0/dense0))/"
+              "dot_general") == "backward/hybridsequential0/dense0"
+    # the grad wrapper scope is a direction marker, not a path part
+    assert cs("jit(step)/%s/loss/reduce" % xray.GRAD_MARKER) \
+        == "forward/loss"
+    # plain step regions get no direction prefix
+    assert cs("jit(step)/optimizer/add") == "optimizer"
+    assert cs("jit(step)/transpose(zero_allgather/all_gather)") \
+        == "zero_allgather"
+    # a bare primitive carries no user scope
+    assert cs("jit(step)/jit(main)/add") is None
+    assert cs("") is None
+
+
+# --------------------------------------------------- perf-doctor rules
+
+
+def _rec(flops=0.0, bytes_=0.0, coll=0.0, tot_f=1.0, tot_b=1.0):
+    return {"flops": flops, "bytes": bytes_, "output_bytes": bytes_ / 2,
+            "collective_bytes": coll, "instructions": 1,
+            "flops_share": flops / tot_f if tot_f else 0.0,
+            "bytes_share": bytes_ / tot_b if tot_b else 0.0}
+
+
+def _dump(scope_spec, zero=False, label="compiled_step", seq=1,
+          counters=None):
+    """A synthetic diag dump with one x-ray program built from
+    ``{scope: (flops, bytes, collective_bytes)}``."""
+    tot_f = sum(v[0] for v in scope_spec.values()) or 1.0
+    tot_b = sum(v[1] for v in scope_spec.values()) or 1.0
+    scopes = {s: _rec(f, b, c, tot_f, tot_b)
+              for s, (f, b, c) in scope_spec.items()}
+    table = {"seq": seq, "label": label, "zero": zero,
+             "instructions": len(scopes),
+             "totals": {"flops": tot_f, "bytes_accessed": tot_b},
+             "estimated": [], "overattributed": [],
+             "scopes": scopes,
+             "unattributed": _rec(0.0, 0.0, 0.0, tot_f, tot_b)}
+    return {"snapshot": {"xray": {"programs": [table]},
+                         "counters": counters or {}}}
+
+
+def _rules(dump):
+    return [f["rule"] for f in perfdoctor.diagnose(dump=dump)]
+
+
+def test_scope_dominated_fires_and_aggregates_fwd_bwd():
+    d = _dump({"forward/net/dense0": (40.0, 40.0, 0.0),
+               "backward/net/dense0": (40.0, 40.0, 0.0),
+               "forward/net/dense1": (20.0, 20.0, 0.0)})
+    findings = perfdoctor._check_xray_scope(d)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["rule"] == "xray-scope-dominated"
+    assert f["anchor"] == "net/dense0"  # fwd+bwd summed per block path
+    assert f["score"] == pytest.approx(0.8)
+    assert f["severity"] == "warn"  # past XRAY_DOMINANT_WARN
+    assert "xray-scope-dominated" in _rules(d)
+
+
+def test_scope_dominated_quiet_when_balanced():
+    d = _dump({"forward/net/dense0": (30.0, 30.0, 0.0),
+               "forward/net/dense1": (35.0, 35.0, 0.0),
+               "forward/net/dense2": (35.0, 35.0, 0.0)})
+    assert perfdoctor._check_xray_scope(d) == []
+
+
+def test_zero_collective_fires_on_hlo_collectives():
+    """Collective bytes vs the forward+backward scopes' bytes (the
+    compute the gather feeds) — fires on the measured HLO path."""
+    d = _dump({"forward/net/dense0": (10.0, 6.0, 0.0),
+               "backward/net/dense0": (10.0, 4.0, 0.0),
+               "zero_allgather": (0.0, 8.0, 8.0)},
+              zero=True, label="zero_step")
+    findings = perfdoctor._check_xray_zero_collective(d)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["rule"] == "xray-zero-collective-share"
+    # coll 8 vs compute 10 -> ratio 0.8, score 8/18
+    assert f["score"] == pytest.approx(8.0 / 18.0)
+    assert "HLO collective instructions" in f["evidence"][0]
+    assert "docs/ZERO.md" in f["action"]
+
+
+def test_zero_collective_counter_fallback_single_device():
+    """Single-device traces have no collective HLO (GSPMD elides
+    them): the rule falls back to the per-step allgather/reduce
+    counters and says so."""
+    d = _dump({"forward/net/dense0": (10.0, 6.0, 0.0),
+               "backward/net/dense0": (10.0, 4.0, 0.0)},
+              zero=True, label="zero_step",
+              counters={"zero_steps": 2, "zero_allgather_bytes": 16.0,
+                        "zero_reduce_bytes": 8.0})
+    findings = perfdoctor._check_xray_zero_collective(d)
+    assert len(findings) == 1
+    # (16+8)/2 = 12 vs compute 10
+    assert findings[0]["score"] == pytest.approx(12.0 / 22.0)
+    assert "GSPMD elided" in findings[0]["evidence"][0]
+
+
+def test_zero_collective_quiet_when_compute_dominates():
+    d = _dump({"forward/net/dense0": (100.0, 80.0, 0.0),
+               "backward/net/dense0": (100.0, 80.0, 0.0),
+               "zero_allgather": (0.0, 8.0, 8.0)},
+              zero=True, label="zero_step")
+    assert perfdoctor._check_xray_zero_collective(d) == []
+
+
+def test_zero_collective_quiet_without_zero_program():
+    d = _dump({"forward/net/dense0": (10.0, 10.0, 0.0),
+               "zero_allgather": (0.0, 8.0, 8.0)})  # zero=False
+    assert perfdoctor._check_xray_zero_collective(d) == []
+
+
+def test_optimizer_share_fires_and_quiet():
+    hot = _dump({"forward/net/dense0": (10.0, 30.0, 0.0),
+                 "optimizer": (5.0, 70.0, 0.0)})
+    findings = perfdoctor._check_xray_optimizer(hot)
+    assert len(findings) == 1
+    assert findings[0]["rule"] == "xray-optimizer-share"
+    assert findings[0]["score"] == pytest.approx(0.7)
+    assert "dtype" in findings[0]["action"]
+    quiet = _dump({"forward/net/dense0": (10.0, 90.0, 0.0),
+                   "optimizer": (5.0, 10.0, 0.0)})
+    assert perfdoctor._check_xray_optimizer(quiet) == []
+
+
+def test_xray_rules_emit_github_annotations():
+    d = _dump({"forward/net/dense0": (80.0, 80.0, 0.0),
+               "forward/net/dense1": (10.0, 10.0, 0.0),
+               "optimizer": (5.0, 60.0, 0.0)})
+    # force the optimizer share past warn too: bytes_share 60/150=0.4
+    # is exactly the fire threshold and past SHARE_WARN
+    text = perfdoctor.render_github(perfdoctor.diagnose(dump=d))
+    assert "::error::" in text
+    assert "xray-scope-dominated" in text
+    assert "xray-optimizer-share" in text
+
+
+# -------------------------------------------------- report / CLI / compare
+
+
+def _diag_dump_with_xray(tmp_path, monkeypatch, name="a.json"):
+    """Returns (dump path, CompiledStep) — the caller must hold the
+    CompiledStep while it reads LIVE snapshots (the tables are
+    weakly registered); the on-disk dump embeds them either way."""
+    monkeypatch.setenv("MXNET_TPU_COST_ANALYSIS", "1")
+    rs = np.random.RandomState(4)
+    net = _make_mlp()
+    cs = _run_compiled(net, rs.rand(2, 8).astype(np.float32),
+                       rs.randint(0, 4, (2,)).astype(np.float32))
+    return runtime_stats.dump_diag(str(tmp_path / name)), cs
+
+
+def test_report_and_diagnose_cli_render_xray(tmp_path, monkeypatch):
+    path, cs = _diag_dump_with_xray(tmp_path, monkeypatch)
+    text = runtime_stats.report()
+    assert "Fused-step x-ray" in text
+    assert "optimizer" in text and "unattributed" in text
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--xray", "--diag", path],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Fused-step x-ray" in out.stdout
+    assert "optimizer" in out.stdout
+
+
+def test_diagnose_cli_xray_empty_dump_exits_2(tmp_path):
+    import json
+    path = str(tmp_path / "empty.json")
+    with open(path, "w") as f:
+        json.dump({"snapshot": {"counters": {}}}, f)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--xray", "--diag", path],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert out.returncode == 2, out.stdout + out.stderr
+
+
+def test_prometheus_exposes_scope_shares(tmp_path, monkeypatch):
+    _path, cs = _diag_dump_with_xray(tmp_path, monkeypatch)
+    text = metrics_timeline.prometheus_text()
+    assert "mxnet_tpu_xray_scope_share" in text
+    assert 'scope="optimizer"' in text
+    assert 'metric="bytes"' in text and 'metric="flops"' in text
+    assert 'scope="unattributed"' in text
+
+
+def test_compare_roundtrip_flat_and_topology_notes(tmp_path,
+                                                   monkeypatch):
+    path, _cs = _diag_dump_with_xray(tmp_path, monkeypatch)
+    d = runtime_stats.load_dumps([path])[0]
+    keys = [k for k in runtime_stats._comparable_metrics(d, 0.0)
+            if k.startswith("xray:")]
+    assert keys, "no x-ray rows entered the comparable metrics"
+    result = runtime_stats.compare(d, d)
+    assert result["verdict"] == "flat"
+    assert result["regressions"] == [] and result["improvements"] == []
+    # a scope existing on only one side is a topology change -> notes,
+    # never a regression verdict
+    b = copy.deepcopy(d)
+    prog = b["snapshot"]["xray"]["programs"][-1]
+    prog["scopes"]["optimizer_v2"] = prog["scopes"].pop("optimizer")
+    result = runtime_stats.compare(d, b)
+    sided = [n for n in result["notes"] if n["kind"] == "xray"]
+    assert sided, result
+    assert {n["side"] for n in sided} == {"before-only", "after-only"}
+    assert not any(e["kind"] == "xray" for e in result["regressions"])
+    text = runtime_stats.render_compare(result)
+    assert "structure differs" in text
+
+
+# ------------------------------------------------------- hang forensics
+
+
+def test_stackdump_direct(tmp_path):
+    path = str(tmp_path / "stacks.txt")
+    out = stackdump.dump_stacks(path)
+    assert out == os.path.abspath(path)
+    text = open(out).read()
+    assert "mxnet_tpu stack dump" in text
+    assert "pid=%d" % os.getpid() in text
+    assert "Current thread" in text  # faulthandler's all-thread dump
+    assert "MainThread" in text  # the ident -> name header
+    assert runtime_stats.snapshot()["counters"]["stack_dumps"] == 1
+
+
+def test_stackdump_rank_suffix(tmp_path, monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    out = stackdump.dump_stacks(str(tmp_path / "s.txt"))
+    assert out.endswith("s.worker1.txt")
+    assert "worker1/2" in open(out).read()
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR2"),
+                    reason="no SIGUSR2 on this platform")
+def test_stackdump_sigusr2(tmp_path):
+    path = str(tmp_path / "sig.txt")
+    prev = signal.getsignal(signal.SIGUSR2)
+    prev_state = dict(stackdump._state)
+    try:
+        assert stackdump.install(path)
+        assert stackdump.installed()
+        os.kill(os.getpid(), signal.SIGUSR2)
+        for _ in range(200):
+            if os.path.exists(path):
+                break
+            time.sleep(0.01)
+        assert os.path.exists(path), "SIGUSR2 produced no dump"
+        assert "Current thread" in open(path).read()
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+        stackdump._state.update(prev_state)
+
+
+def test_stackdump_env_activation(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.txt")
+    prev = signal.getsignal(getattr(signal, "SIGUSR2", signal.SIGTERM))
+    prev_state = dict(stackdump._state)
+    monkeypatch.setenv("MXNET_TPU_STACKDUMP", path)
+    try:
+        assert stackdump._activate_from_env()
+        assert stackdump._state["path"] == path
+    finally:
+        if hasattr(signal, "SIGUSR2"):
+            signal.signal(signal.SIGUSR2, prev)
+        stackdump._state.update(prev_state)

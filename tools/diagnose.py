@@ -49,6 +49,12 @@ def parse_args():
                         'bucket occupancy, rejection counts, serve:* latency '
                         'percentiles) from a MXNET_TPU_DIAG dump (--diag / '
                         '$MXNET_TPU_DIAG) or from this live process.')
+    p.add_argument('--xray', action='store_true',
+                   help='Render only the fused-step x-ray tables (per-scope '
+                        'flops/bytes attribution inside the compiled whole-'
+                        'step programs, with the unattributed remainder) '
+                        'from a MXNET_TPU_DIAG dump (--diag / '
+                        '$MXNET_TPU_DIAG) or from this live process.')
     p.add_argument('--cluster', nargs='+', metavar='DUMP',
                    help='Merge several per-rank MXNET_TPU_DIAG dumps (files '
                         'or a directory of *.json) into one cluster report: '
@@ -227,6 +233,38 @@ def check_serving(diag_path=None):
         return 2
     print('\n'.join(runtime_stats._render_serving(
         serving, snap.get('histograms') or {})))
+    return 0
+
+
+def check_xray(diag_path=None):
+    """Fused-step x-ray view: the per-scope cost-attribution tables
+    (xray.py) of a MXNET_TPU_DIAG dump, or of this live process when no
+    dump is given (docs/OBSERVABILITY.md "Fused-step X-ray").  Returns
+    0, or 2 when no x-ray was captured — a gate asserting on this view
+    must not silently pass on an empty section."""
+    _section('Fused-step X-ray')
+    import json
+    from mxnet_tpu import runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        with open(diag_path) as f:
+            data = json.load(f)
+        snap = data.get('snapshot', data)
+    else:
+        if diag_path:
+            print('Diag dump    : %s (not written yet)' % diag_path)
+        snap = runtime_stats.snapshot()
+    xr = snap.get('xray') or {}
+    if not xr.get('programs'):
+        print('(no x-ray captured in this %s — compile a whole-step '
+              'program with cost capture active: MXNET_TPU_DIAG, '
+              'MXNET_TPU_COST_ANALYSIS=1, or the profiler running; '
+              'MXNET_TPU_XRAY=0 disables the annotation)'
+              % ('dump' if diag_path else 'process'))
+        return 2
+    print('\n'.join(runtime_stats._render_xray(xr)).lstrip('\n'))
     return 0
 
 
@@ -463,6 +501,9 @@ def main():
     if args.serving:
         # focused serving view: skip the platform sections
         sys.exit(check_serving(args.diag))
+    if args.xray:
+        # focused fused-step attribution view: skip the platform sections
+        sys.exit(check_xray(args.diag))
     if args.health:
         # focused view for numerics triage: skip the platform sections
         check_telemetry(args.diag, health_only=True)
