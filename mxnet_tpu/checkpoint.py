@@ -296,6 +296,10 @@ class CheckpointManager:
         self.step_clock = 0
         self.last_good = None       # {"path", "step"} of newest commit
         self.last_error = None
+        # best-effort monitoring counters; each key has exactly one
+        # writer (trainer thread: saves/coalesced; writer thread:
+        # written/errors/corrupt_skipped), so no lock is shared
+        # mxlint: disable=thread-shared-state -- single writer per key
         self.totals = {"saves": 0, "written": 0, "coalesced": 0,
                        "corrupt_skipped": 0, "errors": 0}
         self._cv = threading.Condition()

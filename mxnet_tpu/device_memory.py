@@ -23,9 +23,13 @@ output buffers with the creating op's canonical name via
 :func:`set_origin`; creation helpers (``array``/``zeros``/...) label
 themselves; anything else lands in the ``"<wrap>"`` bucket.
 
-Concurrency: like ``runtime_stats``, increments are plain GIL-atomic
-dict read-modify-writes — exact on a single thread, best-effort under
-concurrent dispatch.  Finalizers may run from any thread at GC time.
+Concurrency: finalizers run on whatever thread triggers GC, while
+``track`` runs on the dispatching thread — unlike ``runtime_stats``'
+independent flat counters, the tables here are multi-field invariants
+(live = allocated - freed, per-op rows must sum to totals), so every
+mutation and every read happens under one module lock (``_lock``).
+Lost increments would be *permanent* drift in the live/peak gauges,
+not transient noise, which is why this tracker pays for the lock.
 
 Environment: ``MXNET_TPU_MEMORY_TRACK=1`` enables tracking from import;
 ``MXNET_TPU_DIAG=<file>`` (the diagnostic-dump env, see
@@ -36,14 +40,24 @@ populated in production runs.
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 
 from . import profiler as _prof
 
 __all__ = ["start", "stop", "reset", "is_enabled", "track", "set_origin",
-           "snapshot", "emit_counter"]
+           "snapshot", "emit_counter", "live_totals"]
 
+# mxlint: disable=thread-shared-state -- single-key GIL-atomic enable flag; the guard-first contract forbids a lock on the disabled path
 _state = {"on": False}
+
+# guards _live/_totals/_per_op/_per_dtype below; leaf lock (nothing is
+# acquired while held — trace events are emitted after release).
+# RLock, not Lock: registering a weakref inside track() can trigger a
+# GC cycle that runs _on_free on the SAME thread while the lock is
+# held; the per-key decrements are arithmetically independent of the
+# in-flight increments, so reentrancy is safe where deadlock is not.
+_lock = threading.RLock()
 
 # id(buffer) -> (nbytes, op, dtype, finalizer) for every live tracked
 # buffer.  id() reuse is safe: the finalizer removes the entry before
@@ -115,8 +129,6 @@ def track(buf, op=None):
     if not _state["on"]:
         return
     key = id(buf)
-    if key in _live:
-        return  # alias/view of an already-tracked buffer
     try:
         if not _is_concrete_device_array(buf):
             return  # tracers hold no HBM; host values aren't device mem
@@ -126,39 +138,60 @@ def track(buf, op=None):
         return  # abstract/exotic value: never let tracking break dispatch
     if op is None:
         op = _origin[0] or "<wrap>"
-    fin = weakref.finalize(buf, _on_free, key, nbytes, op, dtype)
-    fin.atexit = False  # accounting only; nothing to flush at exit
-    _live[key] = (nbytes, op, dtype, fin)
-    _totals["live_bytes"] += nbytes
-    _totals["live_count"] += 1
-    _totals["allocated_bytes"] += nbytes
-    _totals["allocations"] += 1
-    if _totals["live_bytes"] > _totals["peak_bytes"]:
-        _totals["peak_bytes"] = _totals["live_bytes"]
-    for table, k in ((_per_op, op), (_per_dtype, dtype)):
-        b = _bucket(table, k)
-        b["live_bytes"] += nbytes
-        b["live_count"] += 1
-        b["allocated_bytes"] += nbytes
-        b["allocations"] += 1
-        if b["live_bytes"] > b["peak_bytes"]:
-            b["peak_bytes"] = b["live_bytes"]
-    emit_counter()
+    with _lock:
+        if key in _live:
+            return  # alias/view of an already-tracked buffer
+        fin = weakref.finalize(buf, _on_free, key, nbytes, op, dtype)
+        fin.atexit = False  # accounting only; nothing to flush at exit
+        _live[key] = (nbytes, op, dtype, fin)
+        _totals["live_bytes"] += nbytes
+        _totals["live_count"] += 1
+        _totals["allocated_bytes"] += nbytes
+        _totals["allocations"] += 1
+        if _totals["live_bytes"] > _totals["peak_bytes"]:
+            _totals["peak_bytes"] = _totals["live_bytes"]
+        for table, k in ((_per_op, op), (_per_dtype, dtype)):
+            b = _bucket(table, k)
+            b["live_bytes"] += nbytes
+            b["live_count"] += 1
+            b["allocated_bytes"] += nbytes
+            b["allocations"] += 1
+            if b["live_bytes"] > b["peak_bytes"]:
+                b["peak_bytes"] = b["live_bytes"]
+        live, peak = _totals["live_bytes"], _totals["peak_bytes"]
+    _emit(live, peak)
 
 
 def _on_free(key, nbytes, op, dtype):
-    if _live.pop(key, None) is None:
-        return  # reset() already dropped it
-    _totals["live_bytes"] -= nbytes
-    _totals["live_count"] -= 1
-    _totals["freed_bytes"] += nbytes
-    _totals["frees"] += 1
-    for table, k in ((_per_op, op), (_per_dtype, dtype)):
-        b = table.get(k)
-        if b is not None:
-            b["live_bytes"] -= nbytes
-            b["live_count"] -= 1
-    emit_counter()
+    with _lock:
+        if _live.pop(key, None) is None:
+            return  # reset() already dropped it
+        _totals["live_bytes"] -= nbytes
+        _totals["live_count"] -= 1
+        _totals["freed_bytes"] += nbytes
+        _totals["frees"] += 1
+        for table, k in ((_per_op, op), (_per_dtype, dtype)):
+            b = table.get(k)
+            if b is not None:
+                b["live_bytes"] -= nbytes
+                b["live_count"] -= 1
+        live, peak = _totals["live_bytes"], _totals["peak_bytes"]
+    _emit(live, peak)
+
+
+def _emit(live, peak):
+    if not _prof._state["running"]:
+        return
+    _prof.add_event("device_memory", "memory", "C",
+                    args={"live_bytes": live, "peak_bytes": peak})
+
+
+def live_totals():
+    """``(live_bytes, peak_bytes)`` read under the tracker lock — the
+    accessor external gauges (serving metrics, health probe, metrics
+    timeline) use instead of reaching into ``_totals`` directly."""
+    with _lock:
+        return _totals["live_bytes"], _totals["peak_bytes"]
 
 
 def emit_counter():
@@ -166,11 +199,8 @@ def emit_counter():
     while the profiler records).  Also called per step by the Gluon
     trainer/executor so traces keep a memory timeline even between
     allocations."""
-    if not _prof._state["running"]:
-        return
-    _prof.add_event("device_memory", "memory", "C",
-                    args={"live_bytes": _totals["live_bytes"],
-                          "peak_bytes": _totals["peak_bytes"]})
+    live, peak = live_totals()
+    _emit(live, peak)
 
 
 def snapshot(top=12):
@@ -179,29 +209,29 @@ def snapshot(top=12):
     ``top`` rows by peak bytes (always all rows when ``top`` is None)."""
 
     def trim(table):
-        # list() first: atomic C-level copy — a concurrent alloc/free
-        # must not raise "dict changed size" mid-snapshot (SIGUSR1)
-        items = sorted(list(table.items()),
+        items = sorted(table.items(),
                        key=lambda kv: -kv[1]["peak_bytes"])
         if top is not None:
             items = items[:top]
         return {k: dict(v) for k, v in items}
 
-    return {"enabled": _state["on"], "totals": dict(_totals),
-            "per_op": trim(_per_op), "per_dtype": trim(_per_dtype)}
+    with _lock:
+        return {"enabled": _state["on"], "totals": dict(_totals),
+                "per_op": trim(_per_op), "per_dtype": trim(_per_dtype)}
 
 
 def reset():
     """Zero all accounting and detach every finalizer, so the tracker
     retains no references (weak or otherwise) to past buffers."""
-    for _nbytes, _op, _dtype, fin in list(_live.values()):
-        fin.detach()
-    _live.clear()
-    for k in _totals:
-        _totals[k] = 0
-    _per_op.clear()
-    _per_dtype.clear()
-    _origin[0] = None
+    with _lock:
+        for _nbytes, _op, _dtype, fin in list(_live.values()):
+            fin.detach()
+        _live.clear()
+        for k in _totals:
+            _totals[k] = 0
+        _per_op.clear()
+        _per_dtype.clear()
+        _origin[0] = None
 
 
 def _activate_from_env():

@@ -63,8 +63,10 @@ STRAGGLER_WARN_INTERVAL = float(os.environ.get(
 # every subnormal exponent, so it always sorts first
 _ZERO_BUCKET = -1100
 
+# mxlint: disable=thread-shared-state -- single-key GIL-atomic enable flag; the guard-first contract forbids a lock on the disabled path
 _state = {"on": False}
 # name -> Histogram; mutated with GIL-atomic ops only
+# mxlint: disable=thread-shared-state -- best-effort telemetry histograms: a lost increment under concurrent observe() is accepted noise (runtime_stats contract)
 _HISTS: dict = {}
 
 

@@ -168,6 +168,7 @@ def parse_fault_spec(spec):
 # App-level server controller (reference: KVStore::RunServer(controller)):
 # receives (head, body) for every non-framework command a worker sends via
 # _send_command_to_servers; its return value travels back to the sender.
+# mxlint: disable=thread-shared-state -- startup publication: registered once before the server accepts commands; handlers only read
 _app_controller = [None]
 
 
@@ -597,7 +598,13 @@ class PSServer:
         blob (updater rebuilt through the allowlisted unpickler), and
         app-controller state.  A shard revived by the launcher's
         supervisor recovers its own state from disk — no operator or
-        test-side seeding."""
+        test-side seeding.
+
+        Runs today only from __init__ (before serve threads exist),
+        but rebinds the same state the handler threads read, so it
+        takes the checkpoint locks in _ckpt_save's order
+        (ckpt → mutate): a future live-restore command stays
+        deadlock-free and snapshot-atomic by construction."""
         manifest = self._ckpt_mgr.latest()
         if manifest is None:
             return
@@ -608,33 +615,41 @@ class PSServer:
 
         aux = load_aux(manifest) or {}
         keys = list(aux.get("keys") or [])
-        with np.load(os.path.join(manifest["path"], "params.npz"),
-                     allow_pickle=False) as data:
-            self._store = {k: data["a%d" % i]
-                           for i, k in enumerate(keys)}
-        self._versions = dict(aux.get("versions") or {})
-        self._seq = {cid: dict(ent)
-                     for cid, ent in (aux.get("seq_table") or {}).items()}
-        self._mutations = int(manifest.get("step", 0))
-        blob = aux.get("optimizer_blob")
-        if blob:
-            self._set_optimizer(blob)
-        app_state = aux.get("app_state")
-        ctrl = _app_controller[0]
-        if app_state is not None and hasattr(ctrl, "set_state"):
-            ctrl.set_state(app_state)
-        elif app_state is not None:
-            # no controller registered (yet): carry the state so a
-            # controller installed after construction still receives
-            # it (applied lazily on its first command) and so it is
-            # re-persisted rather than silently dropped
-            self._app_state = app_state
-        self._restored_step = self._mutations
+        with self._ckpt_lock:
+            with self._mutate_lock:
+                with np.load(os.path.join(manifest["path"],
+                                          "params.npz"),
+                             allow_pickle=False) as data:
+                    with self._store_lock:
+                        self._store = {k: data["a%d" % i]
+                                       for i, k in enumerate(keys)}
+                with self._metrics_lock:
+                    self._versions = dict(aux.get("versions") or {})
+                with self._seq_lock:
+                    self._seq = {cid: dict(ent) for cid, ent in
+                                 (aux.get("seq_table") or {}).items()}
+                blob = aux.get("optimizer_blob")
+                if blob:
+                    self._set_optimizer_locked(blob)
+                app_state = aux.get("app_state")
+                ctrl = _app_controller[0]
+                if app_state is not None and hasattr(ctrl, "set_state"):
+                    ctrl.set_state(app_state)
+                elif app_state is not None:
+                    # no controller registered (yet): carry the state
+                    # so a controller installed after construction
+                    # still receives it (applied lazily on its first
+                    # command) and so it is re-persisted rather than
+                    # silently dropped
+                    self._app_state = app_state
+            self._mutations = int(manifest.get("step", 0))
+            self._restored_step = self._mutations
+            n_keys, mutations = len(keys), self._mutations
         _rts.inc("kvstore_server_restores")
         _logger().info(
             "parameter-server shard %d restored %d key(s) at mutation "
-            "%d from %s", self._server_id, len(self._store),
-            self._mutations, manifest["path"])
+            "%d from %s", self._server_id, n_keys, mutations,
+            manifest["path"])
 
     def _ckpt_save(self):
         """Commit one durable snapshot of this shard (store + versions +
@@ -809,7 +824,7 @@ class PSServer:
                 return dup
             reply = ("ok", None)
             with self._mutate_lock:
-                self._set_optimizer(blob)
+                self._set_optimizer_locked(blob)
                 self._seq_record(meta, reply)
             # the optimizer blob is part of the durable state: count it
             # toward the snapshot cadence so an acked set_optimizer at
@@ -840,7 +855,13 @@ class PSServer:
                     # the restored state before its first command
                     ctrl.set_state(self._app_state)
                     self._app_state = None
-                reply = ("ok", self._command(head, body))
+                # dispatch straight to the controller: reserved heads
+                # never reach this branch, and routing back through
+                # _command while holding _mutate_lock would self-
+                # deadlock on the non-reentrant lock if a framework
+                # head ('ckpt' takes _mutate_lock itself) ever slipped
+                # through
+                reply = ("ok", ctrl(head, body))
                 self._seq_record(meta, reply)
             self._mutation_tick()
             return reply
@@ -867,7 +888,7 @@ class PSServer:
             self._updater(key_to_int(key), nd.array(grad), weight)
         self._store[key] = weight.asnumpy()
 
-    def _set_optimizer(self, blob):
+    def _set_optimizer_locked(self, blob):
         from .. import optimizer as opt_mod
 
         # the worker ships its Optimizer instance like the reference's
@@ -909,23 +930,30 @@ class PSServer:
             dedup = {"clients": len(self._seq),
                      "suppressed": self._dup_suppressed}
         mgr = self._ckpt_mgr
-        durability = {"enabled": mgr is not None,
-                      "mutations": self._mutations}
-        if mgr is not None:
-            lg = mgr.last_good
-            durability.update({
-                "directory": mgr.directory,
-                "interval": self._ckpt_interval,
-                "saves": mgr.totals["written"],
-                "last_ckpt_step": lg["step"] if lg else None,
-                "last_ckpt_path": lg["path"] if lg else None,
-                "last_ckpt_time": self._last_ckpt_time,
-                "restored_step": self._restored_step})
+        # the durability fields are written under _ckpt_lock
+        # (_mutation_tick / _ckpt_save / _restore): read them under the
+        # same lock so the mutation clock and last-checkpoint stamp in
+        # one snapshot belong to the same instant
+        with self._ckpt_lock:
+            durability = {"enabled": mgr is not None,
+                          "mutations": self._mutations}
+            if mgr is not None:
+                lg = mgr.last_good
+                durability.update({
+                    "directory": mgr.directory,
+                    "interval": self._ckpt_interval,
+                    "saves": mgr.totals["written"],
+                    "last_ckpt_step": lg["step"] if lg else None,
+                    "last_ckpt_path": lg["path"] if lg else None,
+                    "last_ckpt_time": self._last_ckpt_time,
+                    "restored_step": self._restored_step})
+        with self._store_lock:
+            n_keys = len(self._store)
         return {"role": "server",
                 "server_id": self._server_id,
                 "pid": os.getpid(), "time": time.time(),
                 "uptime_seconds": time.time() - self._t_start,
-                "keys": len(self._store),
+                "keys": n_keys,
                 "requests": requests,
                 "per_key": per_key,
                 "per_peer": per_peer,

@@ -123,6 +123,7 @@ def rank_suffix_path(path):
 
 
 # key -> monotonic time of the last emitted warning
+# mxlint: disable=thread-shared-state -- best-effort rate-limit bookkeeping: a race costs at most one duplicate or dropped warning
 _rate_state: dict = {}
 
 

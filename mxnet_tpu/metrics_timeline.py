@@ -85,6 +85,7 @@ _state = {"on": False}
 _ring: collections.deque = collections.deque(maxlen=RING_DEFAULT)
 # per-run mutable config/clock state; all mutation is GIL-atomic dict
 # arithmetic on the training thread (the runtime_stats contract)
+# mxlint: disable=thread-shared-state -- single-writer by contract: on_step runs on the training thread; other roots only read snapshots
 _cur = {"boundary": None, "step": 0, "interval": 1,
         "path": None, "writer": None, "abs_path": None,
         # cumulative-counter baselines for the windowed deltas
@@ -333,9 +334,9 @@ def _build_sample(wall, batch_size):
         if d:
             sample[k] = d
     _cur["prev"] = cum
-    mem = _dm._totals
-    sample["live_bytes"] = mem["live_bytes"]
-    sample["peak_bytes"] = mem["peak_bytes"]
+    live, peak = _dm.live_totals()
+    sample["live_bytes"] = live
+    sample["peak_bytes"] = peak
     sample["jit_entries"] = _jit_cache_size()
     kv = _hist_windows()
     if kv:
@@ -634,11 +635,11 @@ def prometheus_text():
         family("mxnet_tpu_%s_total" % _prom_name(name), "counter",
                "runtime_stats counter %r." % name, [(None, v)])
 
-    mem = _dm._totals
+    live, peak = _dm.live_totals()
     family("mxnet_tpu_device_live_bytes", "gauge",
-           "Live tracked device bytes.", [(None, mem["live_bytes"])])
+           "Live tracked device bytes.", [(None, live)])
     family("mxnet_tpu_device_peak_bytes", "gauge",
-           "Peak tracked device bytes.", [(None, mem["peak_bytes"])])
+           "Peak tracked device bytes.", [(None, peak)])
     family("mxnet_tpu_jit_cache_entries", "gauge",
            "Jit-cache entries across the op registry.",
            [(None, _jit_cache_size())])

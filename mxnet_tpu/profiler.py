@@ -69,6 +69,7 @@ def set_clock_offset(offset_seconds):
     _state["clock_offset"] = float(offset_seconds)
 
 
+# mxlint: disable=thread-shared-state -- startup publication, set once
 _kvstore_handle = None
 
 

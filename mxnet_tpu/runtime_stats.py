@@ -120,6 +120,7 @@ _AVAL_CAP = 64
 # diagnostics under concurrency.
 _PER_OP: dict = {}
 # generic named counters (trainer_steps, io_batches, monitor_seconds…)
+# mxlint: disable=thread-shared-state -- documented best-effort counters: plain GIL-atomic increments, exact single-threaded, approximate under concurrency
 _COUNTERS: dict = {}
 # name -> {"compiles", "keys", "avals", "warned"}
 _STORM: dict = {}
@@ -280,12 +281,12 @@ def health_probe():
         fallbacks += s["fallbacks"]
     for st in list(_STORM.values()):
         compiles += st["compiles"]
-    mem = device_memory._totals
+    live, peak = device_memory.live_totals()
     return {"jit_cache_misses": misses, "compiles": compiles,
             "fallbacks": fallbacks,
             "trainer_steps": _COUNTERS.get("trainer_steps", 0),
-            "live_bytes": mem["live_bytes"],
-            "peak_bytes": mem["peak_bytes"]}
+            "live_bytes": live,
+            "peak_bytes": peak}
 
 
 # ------------------------------------------------------- storm detector

@@ -397,14 +397,19 @@ class InferenceServer:
 
         self._cond = threading.Condition()
         self._queue: collections.deque = collections.deque()
+        # mxlint: disable=thread-shared-state -- mutated under _cond; the one lock-free read is a monitoring gauge (staleness harmless)
         self._queued_samples = 0
         self._inflight = 0
+        # mxlint: disable=thread-shared-state -- monotonic publication flag: set without the lock, loops re-check it under their condition
         self._stopping = False
         self._running = False
+        # mxlint: disable=thread-shared-state -- written in start() before the workers it names exist (Thread.start happens-before)
         self._threads: list = []
+        # mxlint: disable=thread-shared-state -- mutated under _batch_cond; the batcher's emptiness peek under _cond is advisory pacing
         self._batchq: collections.deque = collections.deque()
         self._batch_cond = threading.Condition()
 
+        # mxlint: disable=thread-shared-state -- double-checked build cache: lock-free dict get fast path, builds serialized under _bucket_lock
         self._bucket_fns: dict = {}
         self._bucket_lock = threading.Lock()
         self._stats_lock = threading.Lock()
@@ -811,7 +816,7 @@ class InferenceServer:
                 if waits else 0.0,
                 "e2e_ms": sum(e2es) / len(e2es) * 1e3 if e2es else None,
                 "queue_depth": self._queued_samples,
-                "live_bytes": _dm._totals["live_bytes"]})
+                "live_bytes": _dm.live_totals()[0]})
 
     # ------------------------------------------------------- JSONL export
     def _write_metrics(self, sample):

@@ -104,6 +104,7 @@ _cur = {"attr": 0.0, "boundary": None}
 # "steps": closed step windows; "overattributed": windows whose
 # attribution exceeded the measured wall (clock noise / cross-thread
 # feeds) — remainder clamped to 0 and the event counted, never hidden
+# mxlint: disable=thread-shared-state -- single-writer by contract: end_step runs on the training thread between steps
 _agg = {"steps": 0, "overattributed": 0, "last": None}
 # per-phase per-step distributions + "wall" + "unattributed"
 _HISTS: dict = {}

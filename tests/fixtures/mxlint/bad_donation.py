@@ -1,0 +1,43 @@
+"""Known-bad donation fixtures.
+
+Expected donation-safety findings: exactly 4
+  1. a donating call whose result is discarded (nothing rebinds the
+     invalidated inputs)
+  2. a donated local read after the donating call without a rebind
+  3. a donating call passing ``self._w`` that is never rebound
+  4. a by-reference ``_data`` capture passed into a method that stores
+     it on ``self`` with no ``donation_active()`` seam
+"""
+
+import jax
+
+
+def _train(p, s):
+    return p, s
+
+
+class Stepper:
+    def __init__(self):
+        self._step = jax.jit(_train, donate_argnums=(0, 1))
+        self._fit = jax.jit(_train, donate_argnums=0)
+        self._w = None
+        self._saved = None
+
+    def run_discard(self, a, b):
+        self._step(a, b)
+
+    def run_stale_read(self, x, s):
+        step = jax.jit(_train, donate_argnums=0)
+        out = step(x, s)
+        return out, x + 1
+
+    def run_attr(self, s):
+        out = self._fit(self._w, s)
+        return out
+
+    def snap(self, arr):
+        buf = arr._data
+        self._keep(buf)
+
+    def _keep(self, b):
+        self._saved = b

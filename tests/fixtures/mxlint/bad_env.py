@@ -1,0 +1,13 @@
+"""Known-bad env-registry fixture.
+
+Expected env-registry findings: exactly 3 — three literal
+``MXNET_TPU_*``/``MXTPU_*`` environment reads (``.get``, ``in``,
+subscript) of knobs that have no ``docs/ENV_VARS.md`` row.
+"""
+
+import os
+
+_QUEUE = int(os.environ.get("MXNET_TPU_FIXTURE_ONLY_KNOB", "8"))
+
+if "MXTPU_FIXTURE_ONLY_FLAG" in os.environ:
+    _FLAG = os.environ["MXTPU_FIXTURE_ONLY_FLAG"]

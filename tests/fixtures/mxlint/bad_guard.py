@@ -1,0 +1,18 @@
+"""Known-bad guard-first fixture (linted as ``mxnet_tpu/histogram.py``
+so the ``DEFAULT_FEEDS`` registry row for ``observe`` applies).
+
+Expected guard-first findings: exactly 1
+  ``observe`` does work before its enabled check — the
+  one-dict-read-when-disabled contract is broken.
+"""
+
+_state = {"on": False}
+_sink = []
+
+
+def observe(name, value):
+    """Record one observation."""
+    key = "%s:%s" % (name, value)
+    if not _state["on"]:
+        return
+    _sink.append(key)

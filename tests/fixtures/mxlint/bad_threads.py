@@ -1,0 +1,74 @@
+"""Known-bad thread fixtures.
+
+Expected thread-shared-state findings: exactly 3
+  1. ``_shared`` — written by the api root with no lock, read by the
+     worker thread under ``_lock_a`` (lock sets never intersect)
+  2. ``_counter`` — unlocked read-modify-write (``+=``) in the worker
+     thread while the api root reads it concurrently
+  3. ``Server.state`` — written by the server thread under ``_lock_a``,
+     read by the api root under ``_lock_b``
+
+Expected thread-lock-order findings: exactly 1
+  ``_path_ab`` acquires ``_lock_a`` then ``_lock_b``; ``_path_ba``
+  acquires them in the opposite order — a classic inversion, with both
+  acquisition paths printed in the message.
+"""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+_shared = {"v": 0}
+_counter = {"n": 0}
+
+
+def _worker():
+    with _lock_a:
+        if _shared["v"]:
+            pass
+    _counter["n"] += 1
+
+
+def set_shared(v):
+    _shared["v"] = v
+
+
+def read_counter():
+    return _counter["n"]
+
+
+def _path_ab():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def _path_ba():
+    with _lock_b:
+        with _lock_a:
+            pass
+
+
+class Server:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.state = {}
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        with self._lock_a:
+            self.state["beat"] = 1
+
+    def read_state(self):
+        with self._lock_b:
+            return dict(self.state)
+
+
+def start_all():
+    threading.Thread(target=_worker).start()
+    threading.Thread(target=_path_ab).start()
+    threading.Thread(target=_path_ba).start()

@@ -1,0 +1,55 @@
+"""Known-good donation fixtures — every shape here must stay silent.
+
+  1. donating call as a ``return`` expression (functional ownership
+     transfer to the caller)
+  2. donated locals rebound by the call's own assignment targets
+  3. donated ``self._w`` rebound from the outputs after the call
+  4. only aval metadata (``.shape``) read after donation — the buffer
+     dies, the aval does not
+  5. a ``_data`` capture consumed under the ``donation_active()`` pin
+     seam before it escapes
+"""
+
+import jax
+
+
+def donation_active():
+    return False
+
+
+def _train(p, s):
+    return p, s
+
+
+class Stepper:
+    def __init__(self):
+        self._step = jax.jit(_train, donate_argnums=(0, 1))
+        self._fit = jax.jit(_train, donate_argnums=0)
+        self._w = None
+        self._saved = None
+
+    def run_return(self, a, b):
+        return self._step(a, b)
+
+    def run_rebind(self, x, s):
+        x, s = self._step(x, s)
+        return x, s
+
+    def run_attr(self, s):
+        out = self._fit(self._w, s)
+        self._w = out[0]
+        return out[1]
+
+    def run_metadata(self, x, s):
+        out = self._fit(x, s)
+        return out, x.shape
+
+    def snap_pinned(self, arr):
+        buf = arr._data
+        if donation_active():
+            self._keep(buf)
+            return
+        self._keep(buf)
+
+    def _keep(self, b):
+        self._saved = b

@@ -1,0 +1,8 @@
+"""Known-good env-registry fixture: both reads name knobs that have
+rows in ``docs/ENV_VARS.md`` (``MXNET_TPU_MEMORY_TRACK``,
+``MXNET_TPU_DIAG``), so nothing is undocumented."""
+
+import os
+
+_TRACK = os.environ.get("MXNET_TPU_MEMORY_TRACK") == "1"
+_DIAG = os.getenv("MXNET_TPU_DIAG")

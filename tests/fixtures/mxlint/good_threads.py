@@ -1,0 +1,74 @@
+"""Known-good thread fixtures — every shape here must stay silent.
+
+  1. ``_shared`` — both roots take the same ``_lock_a``
+  2. ``_plain`` — both roots access it lock-free with plain stores
+     (the GIL-atomic single-word publication idiom, not flagged)
+  3. ``_bridge`` — inconsistent lock sets, but the definition line
+     carries the by-design pragma (clears every transitive site)
+  4. ``_table`` — the worker holds a lock obtained from a CALL
+     (``_row_lock(key)``): the held set is statically unknowable, so
+     the analyzer conservatively stays silent rather than guess
+  5. ``_path_ab``/``_also_ab`` — both acquire ``_lock_a`` then
+     ``_lock_b``: consistent order, no inversion
+"""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+_shared = {"v": 0}
+_plain = {"flag": 0}
+_bridge = {"v": 0}  # mxlint: disable=thread-shared-state -- startup publication: written once before the worker starts
+_table = {}
+_row_locks = {0: threading.Lock()}
+
+
+def _row_lock(key):
+    return _row_locks[key]
+
+
+def _worker():
+    with _lock_a:
+        if _shared["v"]:
+            pass
+    if _plain["flag"]:
+        pass
+    with _lock_b:
+        if _bridge["v"]:
+            pass
+    with _row_lock(0):
+        _table[0] = 1
+
+
+def set_shared(v):
+    with _lock_a:
+        _shared["v"] = v
+
+
+def publish():
+    _plain["flag"] = 1
+    _bridge["v"] = 1
+
+
+def read_table():
+    with _lock_a:
+        return dict(_table)
+
+
+def _path_ab():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def _also_ab():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def start_all():
+    threading.Thread(target=_worker).start()
+    threading.Thread(target=_path_ab).start()
+    threading.Thread(target=_also_ab).start()

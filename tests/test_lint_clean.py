@@ -16,6 +16,13 @@ must trace under jax.vjp and jax.vmap, or be pragma'd/grandfathered;
 the grandfather lists in the baseline's "transforms" section only ever
 shrink) plus the generated capability matrix staying in sync.  A
 wall-time budget keeps the whole gate honest about its tier-1 cost.
+
+PR 16 extends the gate over the threaded runtime: the thread-topology
+pass must keep discovering the known asynchronous entry points (>= 8
+distinct roots — fewer means root discovery regressed and the race
+rules silently lost coverage), the donation pass must see all three
+donate_argnums sites, and docs/ENV_VARS.md must stay in two-way sync
+with the MXNET_TPU_*/MXTPU_* reads in the tree.
 """
 
 import functools
@@ -175,6 +182,92 @@ def test_capability_matrix_up_to_date():
     assert committed == generate(_transforms()), (
         "docs/OP_CAPABILITIES.md is stale — regenerate with "
         "`python -m tools.mxlint.capabilities`")
+
+
+# ------------------------------------------------- threaded-runtime gate
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_contexts():
+    """Parsed _FileCtx list for the whole mxnet_tpu/ package (shared)."""
+    from tools.mxlint.checkers import Config, _FileCtx, _iter_py_files
+
+    def build():
+        ctxs, errors = [], []
+        for path in _iter_py_files([os.path.join(REPO, "mxnet_tpu")],
+                                   errors):
+            rel = os.path.relpath(os.path.abspath(path), REPO)
+            with open(path, encoding="utf-8") as f:
+                ctxs.append(_FileCtx(rel, f.read(), Config()))
+        assert errors == [], "\n".join(errors)
+        return tuple(ctxs)
+
+    return _timed("tree-parse", build)
+
+
+@functools.lru_cache(maxsize=None)
+def _tree_graph():
+    from tools.mxlint.callgraph import build_graph
+
+    ctxs = list(_tree_contexts())
+    return _timed("tree-graph", lambda: build_graph(ctxs))
+
+
+def test_thread_roots_discovered_across_runtime():
+    """Root discovery keeps seeing the runtime's asynchronous entry
+    points; a drop below 8 distinct roots means the race rules silently
+    lost coverage (they only check code reachable from a root)."""
+    from tools.mxlint.threads import discover_roots
+
+    roots = list(discover_roots(_tree_graph(), list(_tree_contexts())))
+    distinct = {(r.kind, r.key) for r in roots}
+    assert len(distinct) >= 8, (
+        "only %d thread roots discovered: %s"
+        % (len(distinct), sorted("%s:%s" % (k, key[-1])
+                                 for k, key in distinct)))
+    kinds = {r.kind for r in roots}
+    # the runtime spawns worker threads AND registers GC finalizers;
+    # both discovery modes must stay alive
+    assert "thread" in kinds, kinds
+    assert "finalizer" in kinds, kinds
+
+
+def test_donation_sites_all_discovered():
+    """The donation pass proves all three donate_argnums sites are in
+    scope — if one vanishes from discovery, its callers go unchecked."""
+    from tools.mxlint.donation import find_donation_sites
+
+    sites = find_donation_sites(list(_tree_contexts()))
+    paths = {p for p, _lineno, _argnums in sites}
+    expected = {"mxnet_tpu/compiled_step.py",
+                "mxnet_tpu/parallel/gluon_step.py",
+                "mxnet_tpu/parallel/data_parallel.py"}
+    assert expected <= paths, "missing donate sites: %s" \
+        % sorted(expected - paths)
+
+
+def test_env_registry_fully_synced():
+    """docs/ENV_VARS.md <-> code two-way sync, asserted directly (the
+    env-registry rule enforces it too; this spells out both sets so a
+    failure names the exact variables)."""
+    from tools.mxlint import conformance as C
+
+    ctxs = list(_tree_contexts())
+    read, mentioned = set(), set()
+    for ctx in ctxs:
+        read.update(v for v, _node in C._env_reads(ctx))
+        mentioned.update(C._ENV_RE.findall(ctx.source))
+    rows = C._documented_rows(os.path.join(REPO, "docs", "ENV_VARS.md"))
+    assert rows, "docs/ENV_VARS.md missing or has no table rows"
+    undocumented = sorted(read - set(rows))
+    assert undocumented == [], (
+        "env vars read in mxnet_tpu/ without a docs/ENV_VARS.md row: %s"
+        % undocumented)
+    evidence = read | mentioned | C._aux_mentions(REPO)
+    stale = sorted(set(rows) - evidence)
+    assert stale == [], (
+        "docs/ENV_VARS.md rows no code/tooling reads or mentions: %s"
+        % stale)
 
 
 def test_lint_and_audit_runtime_budget():

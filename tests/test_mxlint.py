@@ -43,7 +43,16 @@ BAD_GOOD = [
     ("registry-consistency", "bad_registry.py", 4, "good_registry.py"),
     ("dtype-default", "bad_dtype.py", 4, "good_dtype.py"),
     ("host-sync-reachability", "bad_reach.py", 9, "good_reach.py"),
+    ("thread-shared-state", "bad_threads.py", 3, "good_threads.py"),
+    ("thread-lock-order", "bad_threads.py", 1, "good_threads.py"),
+    ("donation-safety", "bad_donation.py", 4, "good_donation.py"),
+    ("guard-first", "bad_guard.py", 1, "good_guard.py"),
+    ("env-registry", "bad_env.py", 3, "good_env.py"),
 ]
+
+# guard-first checks the telemetry-feed registry, which is keyed by the
+# real module paths — lint those fixtures under a registered feed path
+RULE_FIXTURE_PATH = {"guard-first": "mxnet_tpu/histogram.py"}
 
 
 def test_every_rule_has_fixtures():
@@ -51,12 +60,13 @@ def test_every_rule_has_fixtures():
 
 
 @pytest.mark.parametrize("rule,bad,count,good", BAD_GOOD,
-                         ids=[r for r, _, _, _ in BAD_GOOD])
+                         ids=["%s-%s" % (r, b) for r, b, _, _ in BAD_GOOD])
 def test_rule_fires_exactly_on_bad_fixture(rule, bad, count, good):
-    findings = _lint_fixture(bad, rule)
+    as_path = RULE_FIXTURE_PATH.get(rule, "mxnet_tpu/ops/fixture.py")
+    findings = _lint_fixture(bad, rule, as_path=as_path)
     assert len(findings) == count, "\n".join(f.format() for f in findings)
     assert all(f.rule == rule for f in findings)
-    assert _lint_fixture(good, rule) == []
+    assert _lint_fixture(good, rule, as_path=as_path) == []
 
 
 def test_trace_rule_details():
@@ -568,13 +578,16 @@ def test_cli_repo_gate_is_clean(capsys):
 
 
 def test_cli_gate_is_cwd_independent(tmp_path, capsys):
-    """Fingerprints anchor to the repo root, not the invoking cwd."""
+    """Fingerprints anchor to the repo root, not the invoking cwd.
+    One cheap rule suffices — path anchoring is rule-independent, and
+    test_cli_repo_gate_is_clean already runs the full set."""
     from tools.mxlint import main
 
     old = os.getcwd()
     os.chdir(str(tmp_path))
     try:
-        rc = main([os.path.join(REPO, "mxnet_tpu")])
+        rc = main([os.path.join(REPO, "mxnet_tpu"),
+                   "--rules", "dtype-default"])
     finally:
         os.chdir(old)
     out = capsys.readouterr().out
@@ -879,12 +892,16 @@ def test_cli_github_format_annotations(tmp_path, capsys):
 
 
 def test_cli_github_format_clean_repo(capsys):
+    """A clean run emits no ::error lines (rule-restricted for speed;
+    repo cleanliness under ALL rules is test_cli_repo_gate_is_clean's
+    job, and github formatting of findings is covered above)."""
     from tools.mxlint import main
 
     old = os.getcwd()
     os.chdir(REPO)
     try:
-        rc = main(["mxnet_tpu", "--format", "github"])
+        rc = main(["mxnet_tpu", "--format", "github",
+                   "--rules", "dtype-default,trace-host-sync"])
     finally:
         os.chdir(old)
     out = capsys.readouterr().out
@@ -922,3 +939,185 @@ def test_cli_github_format_show_baselined(tmp_path, capsys):
     assert "%d baselined" % len(notices) in out  # one notice per entry
     assert all("mxlint baselined dtype-default" in ln
                for ln in notices)
+
+
+# ------------------------------------------------- threaded runtime
+
+
+def test_thread_rule_details():
+    """The three shared-state findings name the variable, both roots,
+    and both held-lock sets (or call out the unlocked RMW)."""
+    findings = _lint_fixture("bad_threads.py", "thread-shared-state")
+    msgs = "\n".join(f.format() for f in findings)
+    assert "unlocked read-modify-write" in msgs
+    assert "_counter" in msgs and "thread:_worker" in msgs
+    assert "_shared written under root 'api' holding no lock" in msgs
+    assert "{fixture._lock_a}" in msgs
+    assert "Server.state written under root 'thread:Server._loop'" in msgs
+    assert "{Server._lock_b}" in msgs
+    assert "lock sets never intersect" in msgs
+
+
+def test_lock_order_inversion_prints_both_paths():
+    """The inversion finding is actionable only if BOTH acquisition
+    paths appear, each with its own file:line."""
+    findings = _lint_fixture("bad_threads.py", "thread-lock-order")
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert msg.count("acquires") == 2
+    assert "_path_ab acquires fixture._lock_a then fixture._lock_b" in msg
+    assert "_path_ba acquires fixture._lock_b then fixture._lock_a" in msg
+    assert msg.count("fixture.py:") == 2   # one site per path
+    assert "deadlock" in msg
+
+
+THREADED_BRIDGE = (
+    "import threading\n\n"
+    "_lock = threading.Lock()\n"
+    "_table = {}%s\n\n\n"
+    "def _worker():\n"
+    "    _table['k'] = 1\n\n\n"
+    "def start():\n"
+    "    threading.Thread(target=_worker).start()\n\n\n"
+    "def read():\n"
+    "    with _lock:\n"
+    "        return dict(_table)\n")
+
+
+def test_thread_pragma_at_definition_clears_every_site():
+    """Without a pragma the cross-root lock disagreement fires; a
+    pragma at the variable DEFINITION clears every access site."""
+    cfg = Config(rules=("thread-shared-state",))
+    bare, _ = lint_sources(
+        {"mxnet_tpu/ops/x.py": THREADED_BRIDGE % ""}, cfg)
+    assert len(bare) == 1, "\n".join(f.format() for f in bare)
+    pragma = ("  # mxlint: disable=thread-shared-state -- by-design "
+              "bridge")
+    cleared, _ = lint_sources(
+        {"mxnet_tpu/ops/x.py": THREADED_BRIDGE % pragma}, cfg)
+    assert cleared == []
+
+
+def test_thread_unknown_lock_callee_is_conservative():
+    """A `with <call>:` whose lock cannot be resolved statically poisons
+    the held set -> the access is dropped, never guessed at (zero false
+    positives by construction)."""
+    src = ("import threading\n\n"
+           "_lock = threading.Lock()\n"
+           "_table = {}\n\n\n"
+           "def _row_lock(i):\n"
+           "    return threading.Lock()\n\n\n"
+           "def _worker():\n"
+           "    with _row_lock(0):\n"
+           "        _table['k'] = 1\n\n\n"
+           "def start():\n"
+           "    threading.Thread(target=_worker).start()\n\n\n"
+           "def read():\n"
+           "    with _lock:\n"
+           "        return dict(_table)\n")
+    findings, _ = lint_sources({"mxnet_tpu/ops/x.py": src},
+                               Config(rules=("thread-shared-state",)))
+    assert findings == []
+
+
+def test_thread_roots_discovered_in_fixture():
+    """Root discovery sees the Thread targets and the bound-method
+    thread inside the class."""
+    from tools.mxlint.callgraph import build_graph
+    from tools.mxlint.checkers import _FileCtx
+    from tools.mxlint.threads import discover_roots
+
+    ctx = _FileCtx("mxnet_tpu/ops/fixture.py",
+                   _fixture_src("bad_threads.py"), Config())
+    roots = list(discover_roots(build_graph([ctx]), [ctx]))
+    labels = {"%s:%s" % (r.kind, r.key[-1]) for r in roots}
+    assert any("_worker" in l for l in labels), labels
+    assert any("_loop" in l for l in labels), labels
+    assert all(r.kind == "thread" for r in roots)
+
+
+# ------------------------------------------------- donation safety
+
+
+def test_donation_rule_details():
+    """Each bad-donation pattern gets its own actionable message."""
+    findings = _lint_fixture("bad_donation.py", "donation-safety")
+    msgs = "\n".join(f.format() for f in findings)
+    assert "discards its result" in msgs            # bare-Expr call
+    assert "read after the donating call" in msgs   # stale local read
+    assert "never rebinds it" in msgs               # self._w not rebound
+    assert "`_data` capture escapes" in msgs        # unpinned capture
+    assert "donation_active()" in msgs              # points at the seam
+    symbols = {f.symbol for f in findings}
+    assert symbols == {"Stepper.run_discard", "Stepper.run_stale_read",
+                       "Stepper.run_attr", "Stepper.snap"}
+
+
+def test_donation_pinned_capture_and_rebinds_silent():
+    """The good fixture exercises every clean idiom: return-transfer,
+    tuple rebind, attr rebind, metadata-only reads, pinned capture."""
+    assert _lint_fixture("good_donation.py", "donation-safety") == []
+
+
+def test_donation_sites_cover_all_three_jit_wrappers():
+    """The repo's three donate_argnums sites are all discovered."""
+    from tools.mxlint.checkers import _FileCtx
+    from tools.mxlint.donation import find_donation_sites
+
+    expected = {"mxnet_tpu/compiled_step.py",
+                "mxnet_tpu/parallel/gluon_step.py",
+                "mxnet_tpu/parallel/data_parallel.py"}
+    ctxs = []
+    for rel in sorted(expected):
+        with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+            ctxs.append(_FileCtx(rel, f.read(), Config()))
+    sites = find_donation_sites(ctxs)
+    assert {path for path, _lineno, _argnums in sites} == expected
+    assert all(argnums for _path, _lineno, argnums in sites)
+
+
+# --------------------------------------- baseline & CLI, new rules
+
+
+def test_update_baseline_refuses_lock_order_inversion(tmp_path, capsys):
+    """An inversion is a latent deadlock, never a legacy wart: the
+    baseline updater hard-errors instead of grandfathering it."""
+    import shutil
+
+    from tools.mxlint import main
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_threads.py"),
+                str(pkg / "racy.py"))
+    bl = str(tmp_path / "bl.json")
+    rc = main([str(pkg), "--baseline", bl,
+               "--rules", "thread-lock-order", "--update-baseline"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "refusing to baseline a lock-order inversion" in err
+    assert not os.path.exists(bl)   # nothing was grandfathered
+
+
+def test_cli_github_format_new_rules(tmp_path, capsys):
+    """The github annotations are rule-generic: thread findings come
+    out as ::error lines with the rule in the title."""
+    import shutil
+
+    from tools.mxlint import main
+
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir(parents=True)
+    shutil.copy(os.path.join(FIXTURES, "bad_threads.py"),
+                str(pkg / "racy.py"))
+    rc = main([str(pkg), "--no-baseline", "--format", "github",
+               "--rules", "thread-shared-state,thread-lock-order"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith("::error file=")]
+    assert len(lines) == 4
+    assert sum("title=mxlint thread-shared-state" in ln
+               for ln in lines) == 3
+    assert sum("title=mxlint thread-lock-order" in ln
+               for ln in lines) == 1

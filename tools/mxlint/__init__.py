@@ -13,6 +13,13 @@ tables, eval_shape traceability, and vjp/vmap transform conformance —
 the per-op capability matrix is generated into docs/OP_CAPABILITIES.md
 by ``capabilities.py``).
 
+Since PR 16 the same call graph also covers the threaded runtime:
+``threads.py`` (static race detector: thread-root discovery, held-lock
+sets, cross-root shared-state races, lock-order inversions),
+``donation.py`` (rebind-after-call and pin-before-capture around the
+``donate_argnums`` sites), and ``conformance.py`` (guard-first
+telemetry feeds, docs/ENV_VARS.md two-way env registry).
+
 Usage::
 
     python -m tools.mxlint mxnet_tpu/          # gate against baseline

@@ -56,7 +56,7 @@ from .checkers import (SYNC_WHITELIST, _Loc, _collect_tensor_names,
                        _is_tensor_expr, _pragma_disabled, _tensor_params)
 
 __all__ = ["build_graph", "check_reachability", "classify", "FnNode",
-           "RULE"]
+           "RULE", "resolve_callable"]
 
 RULE = "host-sync-reachability"
 
@@ -129,6 +129,24 @@ class _Imports:
                     self.from_import[local] = (mod, a.name)
 
 
+def _binding_names(target):
+    """Names a target expression BINDS: bare names, recursing only
+    through tuple/list/starred destructuring.  ``x[0] = v`` and
+    ``x.a = v`` mutate an object — they bind nothing, so the base name
+    must NOT be treated as shadowing a module-level name."""
+    out = set()
+    stack = [target]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Tuple, ast.List)):
+            stack.extend(n.elts)
+        elif isinstance(n, ast.Starred):
+            stack.append(n.value)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
 def _local_bindings(fn_node):
     """Names bound in `fn_node`'s own scope (parameters, assignment /
     loop / with / except / walrus targets, in-function imports, nested
@@ -155,18 +173,14 @@ def _local_bindings(fn_node):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
             for t in targets:
-                for sub in ast.walk(t):
-                    if isinstance(sub, ast.Name):
-                        bound.add(sub.id)
+                bound.update(_binding_names(t))
         elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
                                ast.NamedExpr)):
             if isinstance(node.target, ast.Name):
                 bound.add(node.target.id)
         elif isinstance(node, ast.withitem):
             if node.optional_vars is not None:
-                for sub in ast.walk(node.optional_vars):
-                    if isinstance(sub, ast.Name):
-                        bound.add(sub.id)
+                bound.update(_binding_names(node.optional_vars))
         elif isinstance(node, ast.ExceptHandler):
             if node.name:
                 bound.add(node.name)
@@ -423,64 +437,74 @@ class _FnScanner:
 
     def _resolve_target(self, fnx):
         """FnNode key, False (provably benign), or None (unknown)."""
-        al = self.ctx.aliases
-        mod_fns = self.graph.by_module.get(self.module, {})
-        if isinstance(fnx, ast.Name):
-            name = fnx.id
-            # enclosing FUNCTION scopes, innermost first (class bodies
-            # are not name scopes in python).  At each level a nested
-            # def wins; any OTHER local binding of the name (parameter,
-            # assignment, loop/with target, in-function import) shadows
-            # outer scopes with something we cannot resolve -> unknown,
-            # NEVER the module-level def of the same name
-            cur = self.fn
-            while cur is not None:
-                qn = cur.qualname + "." + name
+        return resolve_callable(self.graph, self.module, self.fn, fnx,
+                                self.ctx.aliases)
+
+
+def resolve_callable(graph, module, fn, fnx, aliases):
+    """Resolve a callee expression to a FnNode key, False (provably
+    benign), or None (unknown).  `fn` is the enclosing FnNode, or None
+    when the call sits in module-level code.  Shared by the
+    thread-topology and donation passes so every rule resolves targets
+    with identical (conservative) semantics."""
+    imports = graph.imports[module]
+    mod_fns = graph.by_module.get(module, {})
+    if isinstance(fnx, ast.Name):
+        name = fnx.id
+        # enclosing FUNCTION scopes, innermost first (class bodies
+        # are not name scopes in python).  At each level a nested
+        # def wins; any OTHER local binding of the name (parameter,
+        # assignment, loop/with target, in-function import) shadows
+        # outer scopes with something we cannot resolve -> unknown,
+        # NEVER the module-level def of the same name
+        cur = fn
+        while cur is not None:
+            qn = cur.qualname + "." + name
+            if qn in mod_fns:
+                return (module, qn)
+            if name in cur.bound:
+                return None
+            cur = mod_fns.get(cur.parent) if cur.parent else None
+        if name in mod_fns:
+            return (module, name)
+        if name in imports.from_import:
+            mod, attr = imports.from_import[name]
+            return graph.lookup_attr(mod, attr)
+        if name in _FnScanner._BENIGN_BUILTINS:
+            return False
+        if name in imports.module_alias:
+            return False  # calling a module object: not a call
+        return None
+    if isinstance(fnx, ast.Attribute):
+        root = _attr_root(fnx)
+        if not isinstance(root, ast.Name):
+            return None
+        # self.method() / cls.method() -> same-class method
+        if root.id in ("self", "cls") \
+                and isinstance(fnx.value, ast.Name):
+            if fn is not None and fn.cls is not None:
+                qn = fn.cls + "." + fnx.attr
                 if qn in mod_fns:
-                    return (self.module, qn)
-                if name in cur.bound:
-                    return None
-                cur = mod_fns.get(cur.parent) if cur.parent else None
-            if name in mod_fns:
-                return (self.module, name)
-            if name in self.imports.from_import:
-                mod, attr = self.imports.from_import[name]
-                return self.graph.lookup_attr(mod, attr)
-            if name in self._BENIGN_BUILTINS:
-                return False
-            if name in self.imports.module_alias:
-                return False  # calling a module object: not a call
+                    return (module, qn)
             return None
-        if isinstance(fnx, ast.Attribute):
-            root = _attr_root(fnx)
-            if not isinstance(root, ast.Name):
-                return None
-            # self.method() / cls.method() -> same-class method
-            if root.id in ("self", "cls") \
-                    and isinstance(fnx.value, ast.Name):
-                if self.fn.cls is not None:
-                    qn = self.fn.cls + "." + fnx.attr
-                    if qn in mod_fns:
-                        return (self.module, qn)
-                return None
-            # jnp./jax./np. math is device-side (or host-numpy) compute;
-            # the sync-prone members were already handled as sinks
-            if al.is_jnp_call_root(fnx) \
-                    or (isinstance(fnx.value, ast.Name)
-                        and fnx.value.id in al.numpy):
-                return False
-            # mod.fn() where mod aliases a module
-            if isinstance(fnx.value, ast.Name):
-                target_mod = None
-                if root.id in self.imports.module_alias:
-                    target_mod = self.imports.module_alias[root.id]
-                elif root.id in self.imports.from_import:
-                    m, a = self.imports.from_import[root.id]
-                    target_mod = m + "." + a
-                if target_mod is not None:
-                    return self.graph.lookup_attr(target_mod, fnx.attr)
-            return None
-        return None  # computed callee expression
+        # jnp./jax./np. math is device-side (or host-numpy) compute;
+        # the sync-prone members were already handled as sinks
+        if aliases.is_jnp_call_root(fnx) \
+                or (isinstance(fnx.value, ast.Name)
+                    and fnx.value.id in aliases.numpy):
+            return False
+        # mod.fn() where mod aliases a module
+        if isinstance(fnx.value, ast.Name):
+            target_mod = None
+            if root.id in imports.module_alias:
+                target_mod = imports.module_alias[root.id]
+            elif root.id in imports.from_import:
+                m, a = imports.from_import[root.id]
+                target_mod = m + "." + a
+            if target_mod is not None:
+                return graph.lookup_attr(target_mod, fnx.attr)
+        return None
+    return None  # computed callee expression
 
 
 # ----------------------------------------------------------- public API
@@ -568,13 +592,17 @@ def _path_of(graph, fn):
     return " → ".join(chain)
 
 
-def check_reachability(contexts, config):
+def check_reachability(contexts, config, graph=None):
     """The cross-file rule pass: flag compute-path call sites whose
     callee transitively host-syncs, and compute-path functions that
     host-branch on tensor values.  Appends findings to each ctx's
-    findings list; returns the graph (for classification consumers)."""
+    findings list; returns the graph (for classification consumers).
+
+    `graph`: a pre-built call graph over the same contexts (the driver
+    builds one and shares it with the thread/donation passes)."""
     by_path = {ctx.path: ctx for ctx in contexts}
-    graph = build_graph(contexts)
+    if graph is None:
+        graph = build_graph(contexts)
     for fn in graph.nodes.values():
         ctx = by_path.get(fn.path)
         if ctx is None or fn.whitelisted:

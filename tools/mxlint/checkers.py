@@ -36,6 +36,28 @@ host-sync-reachability
     Implemented in callgraph.py (module-level call graph, reverse-BFS
     reachability, full offending path in the message).
 
+thread-shared-state / thread-lock-order
+    Interprocedural thread-topology pass (threads.py): discovers
+    thread roots (Thread targets, timers, atexit/signal hooks, weakref
+    finalizers, HTTP handlers, ctypes trampolines), walks each root's
+    call cone tracking held ``with <lock>:`` sets, and flags shared
+    state written under one root and touched under another with
+    inconsistent locks, unlocked RMW on shared counters, and
+    cross-root lock-order inversions (both acquisition paths printed).
+
+donation-safety
+    From every ``jax.jit(..., donate_argnums=...)`` binding
+    (donation.py): donating call sites must rebind their donated
+    arguments (rebind-after-call), and ``._data`` captured before a
+    donating region must flow through the pin/materialize seam before
+    it can outlive the call.
+
+guard-first / env-registry
+    Conformance pass (conformance.py): every registered telemetry feed
+    statically begins with its one-dict-read enabled guard; every
+    literal MXNET_TPU_*/MXTPU_* environ read has a docs/ENV_VARS.md
+    row, and (on full-tree runs) every documented row has a real read.
+
 Suppression: a ``# mxlint: disable`` or ``# mxlint: disable=rule[,rule]``
 comment on the finding's line silences it at the source; the baseline
 file (findings.py) grandfathers whole findings instead.
@@ -52,7 +74,13 @@ from .findings import Finding
 __all__ = ["Config", "lint_paths", "lint_sources", "ALL_RULES"]
 
 ALL_RULES = ("trace-host-sync", "static-argnames", "registry-consistency",
-             "dtype-default", "host-sync-reachability")
+             "dtype-default", "host-sync-reachability",
+             "thread-shared-state", "thread-lock-order",
+             "donation-safety", "guard-first", "env-registry")
+
+# rules that need the cross-file call graph from callgraph.py
+_GRAPH_RULES = frozenset({"host-sync-reachability", "thread-shared-state",
+                          "thread-lock-order", "donation-safety"})
 
 # functions whose contract IS the device->host sync (reference parity:
 # WaitToRead/asnumpy are the documented engine sync points)
@@ -105,6 +133,14 @@ class Config:
         # package in scope to be sound; lint_paths turns this off for
         # partial runs (table-internal checks still run)
         self.check_unregistered_table_keys = True
+        # guard-first feed registry override (None -> conformance.py's
+        # DEFAULT_FEEDS) and env-registry anchors; the stale-doc-row
+        # direction is only sound when the whole package was linted, so
+        # lint_paths enables it for complete runs only
+        self.guard_feeds = None
+        self.env_docs_path = None
+        self.repo_root = None
+        self.check_env_doc_stale = False
 
     def matches(self, globs, path):
         p = path.replace(os.sep, "/")
@@ -754,13 +790,29 @@ def lint_sources(named_sources, config=None):
             _collect_registry_info(ctx)
     if "registry-consistency" in config.rules:
         _check_registry_consistency(contexts)
-    if "host-sync-reachability" in config.rules:
-        # interprocedural pass: the call graph spans EVERY linted file,
-        # findings anchor to compute-path call sites (callgraph.py)
-        from .callgraph import check_reachability
+    extra = []
+    if _GRAPH_RULES & set(config.rules):
+        # interprocedural passes share ONE call graph spanning every
+        # linted file (building it dominates their cost)
+        from .callgraph import build_graph, check_reachability
 
-        check_reachability(contexts, config)
-    findings = []
+        graph = build_graph(contexts)
+        if "host-sync-reachability" in config.rules:
+            check_reachability(contexts, config, graph=graph)
+        if ("thread-shared-state" in config.rules
+                or "thread-lock-order" in config.rules):
+            from .threads import check_threads
+
+            check_threads(contexts, config, graph)
+        if "donation-safety" in config.rules:
+            from .donation import check_donation
+
+            check_donation(contexts, config, graph)
+    if "guard-first" in config.rules or "env-registry" in config.rules:
+        from .conformance import check_conformance
+
+        extra = check_conformance(contexts, config)
+    findings = list(extra)
     for ctx in contexts:
         findings.extend(ctx.findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
@@ -809,5 +861,28 @@ def lint_paths(paths, config=None, base=None):
         if not complete:
             config = copy.copy(config)
             config.check_unregistered_table_keys = False
+    # the stale-doc-row direction of env-registry claims a documented
+    # var is read NOWHERE — only provable when the whole mxnet_tpu
+    # package is in this run's scope
+    if "env-registry" in config.rules and not config.check_env_doc_stale:
+        pkg_roots = set()
+        for ap in abs_linted:
+            parts = ap.replace(os.sep, "/").split("/")
+            if "mxnet_tpu" in parts[:-1]:
+                idx = parts.index("mxnet_tpu")
+                pkg_roots.add(os.sep.join(parts[:idx + 1]))
+        for pkg in pkg_roots:
+            whole = set()
+            for root, dirs, files in os.walk(pkg):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                whole.update(os.path.join(root, fn) for fn in files
+                             if fn.endswith(".py"))
+            if whole and whole <= abs_linted:
+                config = copy.copy(config)
+                config.check_env_doc_stale = True
+                if config.repo_root is None:
+                    config.repo_root = os.path.dirname(pkg)
+                break
     findings, perrors = lint_sources(sources, config)
     return findings, errors + perrors

@@ -76,6 +76,15 @@ def main(argv=None):
               for p in args.paths]
 
     if args.update_baseline:
+        # a lock-order inversion is a latent deadlock, never a legacy
+        # wart: refuse to grandfather it (fix the ordering or pragma
+        # the acquisition site with a justification)
+        inversions = [f for f in findings if f.rule == "thread-lock-order"]
+        if inversions:
+            for f in inversions:
+                print("error: refusing to baseline a lock-order "
+                      "inversion: %s" % f.format(), file=sys.stderr)
+            return 2
         # a partial-scope run must not erase entries it could not
         # have re-observed: carry out-of-scope entries over verbatim
         kept = []

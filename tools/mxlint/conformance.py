@@ -1,0 +1,323 @@
+"""Guard-first and env-registry conformance — the ``guard-first`` and
+``env-registry`` rules.
+
+guard-first
+-----------
+Every telemetry feed's overhead contract is "ONE dict read and nothing
+else while disabled" — `tests/test_bench_gate.py` pins it dynamically
+per feed; this rule proves it statically for EVERY feed in the
+registry below: the first non-docstring statement must be an ``if``
+that reads the feed's state object and only returns.  A registry row
+whose function no longer exists is itself a finding (registry drift),
+so the proved set can't silently rot.
+
+env-registry
+------------
+Every ``MXNET_TPU_*`` / ``MXTPU_*`` environment read in the linted
+tree must have a row in ``docs/ENV_VARS.md`` (finding at the read
+site), and every documented row must correspond to a real read
+somewhere in the repo — linted sources, tools/, tests/, or the native
+C++ sources, which are regex-scanned as auxiliary evidence (finding
+anchored at the stale doc row).  The stale-row direction is only sound
+when the whole ``mxnet_tpu`` package was linted; ``lint_paths``
+enables it for complete runs (``Config.check_env_doc_stale``), exactly
+like the registry table cross-check.
+
+Suppression: ``# mxlint: disable=guard-first`` on the def line /
+``# mxlint: disable=env-registry`` on the read line.  Doc rows have no
+pragma — a stale row is deleted, not suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+import re
+
+from .findings import Finding
+from .checkers import _pragma_disabled
+
+__all__ = ["check_conformance", "DEFAULT_FEEDS", "RULE_GUARD",
+           "RULE_ENV"]
+
+RULE_GUARD = "guard-first"
+RULE_ENV = "env-registry"
+
+# (module, function qualname, state object read by the guard).  The
+# dynamically-pinned feeds from tests/test_bench_gate.py; stepstats
+# ``begin`` is deliberately absent (caller-guarded by contract).
+DEFAULT_FEEDS = (
+    ("mxnet_tpu.histogram", "observe", "_state"),
+    ("mxnet_tpu.stepstats", "add", "_state"),
+    ("mxnet_tpu.stepstats", "end", "_state"),
+    ("mxnet_tpu.stepstats", "end_step", "_state"),
+    ("mxnet_tpu.metrics_timeline", "on_step", "_state"),
+    ("mxnet_tpu.checkpoint", "on_step", "_state"),
+    ("mxnet_tpu.health", "observe", "_state"),
+    ("mxnet_tpu.xray", "scope", "_state"),
+    ("mxnet_tpu.device_memory", "track", "_state"),
+)
+
+_ENV_RE = re.compile(r"\b(?:MXNET_TPU|MXTPU)_[A-Z0-9_]+\b")
+
+# repo root: tools/mxlint/conformance.py -> three dirname hops
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DOCS_REL = "docs/ENV_VARS.md"
+# extra trees regex-scanned as evidence a documented var is real (they
+# are not linted by default, so rows for their vars must not go stale)
+_AUX_TREES = ("tools", "tests", os.path.join("mxnet_tpu", "native"))
+
+
+def check_conformance(contexts, config):
+    """Run both rules.  Per-file findings go onto each ctx; findings
+    anchored in docs/ENV_VARS.md are RETURNED (no ctx owns that file)."""
+    extra = []
+    if RULE_GUARD in config.rules:
+        _check_guard_first(contexts, config)
+    if RULE_ENV in config.rules:
+        extra.extend(_check_env_registry(contexts, config))
+    return extra
+
+
+# ----------------------------------------------------------- guard-first
+
+
+def _first_real_stmt(fn_node):
+    body = list(fn_node.body)
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant) \
+            and isinstance(body[0].value.value, str):
+        body = body[1:]
+    return body[0] if body else None
+
+
+def _reads_state(test, state_name):
+    """The guard test touches the feed's state object (``not
+    _state["on"]``, ``_state.get(...)`` — possibly one arm of a
+    BoolOp)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name) and sub.id == state_name \
+                and isinstance(sub.ctx, ast.Load):
+            return True
+    return False
+
+
+def _guard_shape_ok(stmt, state_name):
+    """``if <reads state>: return/pass`` and nothing heavier."""
+    if not isinstance(stmt, ast.If):
+        return False
+    if not _reads_state(stmt.test, state_name):
+        return False
+    if stmt.orelse:
+        return False
+    return all(isinstance(s, (ast.Return, ast.Pass)) for s in stmt.body)
+
+
+def _check_guard_first(contexts, config):
+    from .callgraph import _module_name
+
+    feeds = getattr(config, "guard_feeds", None) or DEFAULT_FEEDS
+    by_module = {}
+    for ctx in contexts:
+        by_module.setdefault(_module_name(ctx.path), ctx)
+    for module, qualname, state_name in feeds:
+        ctx = by_module.get(module)
+        if ctx is None:
+            continue  # partial run: module not in scope
+        fn_node = _find_def(ctx.tree, qualname)
+        if fn_node is None:
+            ctx.add(RULE_GUARD, _Loc0(),
+                    "feed registry row %s.%s names no function in this "
+                    "module — update tools/mxlint/conformance.py's "
+                    "DEFAULT_FEEDS (registry drift)" % (module,
+                                                        qualname))
+            continue
+        stmt = _first_real_stmt(fn_node)
+        if stmt is None or not _guard_shape_ok(stmt, state_name):
+            ctx.add(RULE_GUARD, fn_node,
+                    "telemetry feed %s() must begin with its enabled "
+                    "guard (`if not %s[...]: return`) before any other "
+                    "work — the one-dict-read-when-disabled contract "
+                    "test_bench_gate.py pins dynamically"
+                    % (qualname, state_name), qualname)
+
+
+class _Loc0:
+    lineno = 1
+    col_offset = 0
+
+
+def _find_def(tree, qualname):
+    parts = qualname.split(".")
+    body = tree.body
+    node = None
+    for i, part in enumerate(parts):
+        node = None
+        for child in body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) and child.name == part:
+                node = child
+                break
+        if node is None:
+            return None
+        body = getattr(node, "body", [])
+    return node if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) else None
+
+
+# ---------------------------------------------------------- env-registry
+
+
+def _env_reads(ctx):
+    """[(var, ast node)] for every literal MXNET_TPU_*/MXTPU_* access:
+    os.environ.get/[]/in/pop/setdefault, os.getenv, from-os environ."""
+    # cheap source-text prefilter: a file with no environ/getenv token
+    # cannot contain an env read — skip the AST walks entirely
+    if "environ" not in ctx.source and "getenv" not in ctx.source:
+        return []
+    reads = []
+    environ_names = {"environ"} if _from_os(ctx, "environ") else set()
+    getenv_names = {"getenv"} if _from_os(ctx, "getenv") else set()
+
+    def is_environ(node):
+        if isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os":
+            return True
+        return isinstance(node, ast.Name) and node.id in environ_names
+
+    def lit(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         str):
+            m = _ENV_RE.search(node.value)
+            if m and m.group(0) == node.value:
+                return node.value
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fnx = node.func
+            if isinstance(fnx, ast.Attribute) \
+                    and fnx.attr in ("get", "pop", "setdefault") \
+                    and is_environ(fnx.value) and node.args:
+                var = lit(node.args[0])
+                if var:
+                    reads.append((var, node))
+            elif ((isinstance(fnx, ast.Attribute)
+                   and fnx.attr == "getenv"
+                   and isinstance(fnx.value, ast.Name)
+                   and fnx.value.id == "os")
+                  or (isinstance(fnx, ast.Name)
+                      and fnx.id in getenv_names)) and node.args:
+                var = lit(node.args[0])
+                if var:
+                    reads.append((var, node))
+        elif isinstance(node, ast.Subscript) and is_environ(node.value):
+            var = lit(node.slice)
+            if var:
+                reads.append((var, node))
+        elif isinstance(node, ast.Compare) \
+                and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and is_environ(node.comparators[0]):
+            var = lit(node.left)
+            if var:
+                reads.append((var, node))
+    return reads
+
+
+def _from_os(ctx, attr):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == attr:
+                    return True
+    return False
+
+
+def _documented_rows(docs_path):
+    """{var: (lineno, row text)} — the FIRST env-var token in each
+    markdown table row's first cell is the documented variable; tokens
+    later in the row are prose cross-references, not rows."""
+    rows = {}
+    try:
+        with open(docs_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    for i, line in enumerate(lines, 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        first = cells[1] if len(cells) > 1 else ""
+        m = _ENV_RE.search(first)
+        if m and m.group(0) not in rows:
+            rows[m.group(0)] = (i, line.strip())
+    return rows
+
+
+@functools.lru_cache(maxsize=4)
+def _aux_mentions(repo_root):
+    """Env-var tokens appearing anywhere in the auxiliary (non-linted)
+    trees — evidence that a doc row is not stale.  Cached per root: the
+    aux trees don't change within one lint process (the gate and the
+    CLI tests run several full-package lints back to back)."""
+    seen = set()
+    for tree in _AUX_TREES:
+        top = os.path.join(repo_root, tree)
+        for root, dirs, files in os.walk(top):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__",
+                                                    ".git")]
+            for fname in files:
+                if not fname.endswith((".py", ".cc", ".h", ".cpp",
+                                       ".sh", ".md")):
+                    continue
+                try:
+                    with open(os.path.join(root, fname),
+                              encoding="utf-8", errors="replace") as f:
+                        seen.update(_ENV_RE.findall(f.read()))
+                except OSError:
+                    pass
+    return seen
+
+
+def _check_env_registry(contexts, config):
+    repo_root = getattr(config, "repo_root", None) or REPO_ROOT
+    docs_path = getattr(config, "env_docs_path", None) \
+        or os.path.join(repo_root, DOCS_REL)
+    rows = _documented_rows(docs_path)
+    if rows is None:
+        return []  # no registry in this tree: nothing to cross-check
+    read_vars = set()
+    mentioned = set()  # literal tokens anywhere in linted sources:
+    # helper-wrapped reads (`_env_int("MXNET_TPU_X", d)`) are real
+    # reads even though no os.environ access names the var directly
+    for ctx in contexts:
+        mentioned.update(_ENV_RE.findall(ctx.source))
+        for var, node in _env_reads(ctx):
+            read_vars.add(var)
+            if var not in rows:
+                ctx.add(RULE_ENV, node,
+                        "env var %r is read here but has no "
+                        "docs/ENV_VARS.md row — every MXNET_TPU_* "
+                        "knob must be documented (add a row, or "
+                        "rename onto an existing knob)" % var)
+    extra = []
+    if getattr(config, "check_env_doc_stale", False):
+        aux = _aux_mentions(repo_root)
+        docs_rel = os.path.relpath(docs_path, repo_root) \
+            if os.path.isabs(docs_path) else docs_path
+        for var in sorted(rows):
+            if var in read_vars or var in mentioned or var in aux:
+                continue
+            lineno, text = rows[var]
+            if _pragma_disabled(text, RULE_ENV):
+                continue
+            extra.append(Finding(
+                RULE_ENV, docs_rel.replace(os.sep, "/"), lineno, 0,
+                "documented env var %r is read nowhere in the repo — "
+                "stale row; delete it (or restore the knob)" % var,
+                symbol=var, code_line=text))
+    return extra

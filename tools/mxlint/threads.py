@@ -1,0 +1,793 @@
+"""Static thread-topology + lock-discipline analysis — the
+``thread-shared-state`` and ``thread-lock-order`` rules.
+
+The runtime grew a real thread population (checkpoint writer, serving
+batcher/worker pool, PS accept/heartbeat threads, metrics HTTP daemon,
+signal/atexit dump paths, weakref finalizers, engine FFI trampolines);
+this pass makes their synchronization discipline a *proved* property
+instead of a remembered one.
+
+Model
+-----
+1. **Roots.**  Every statically-visible asynchronous entry point:
+
+   - ``threading.Thread(target=f)`` / ``threading.Timer(t, f)``
+   - ``atexit.register(f)`` and ``signal.signal(sig, f)``
+   - ``weakref.finalize(obj, f, ...)``
+   - ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses (each
+     request runs them on a ``ThreadingHTTPServer`` worker thread)
+   - ``ctypes.CFUNCTYPE``-trampoline wrappers (``ENGINE_OP_FN(f)``):
+     the wrapped python callable runs on native worker threads
+
+   plus the implicit **api** root: every function reachable from
+   outside the discovered thread cones (public entry points — what the
+   importing/training thread can run).  Functions named ``*_locked``
+   are never api entries of their own: the suffix is this codebase's
+   caller-holds-the-lock convention, so they are only analyzed through
+   their real (lock-holding) callers.
+
+2. **Reachability + held locks.**  Per root, a DFS over the PR 4 call
+   graph (statically-resolved edges only) carries the set of locks
+   *provably held* at each point: ``with <lock>:`` scopes where the
+   lock expression resolves to a module-global or ``self.<attr>``
+   assigned from ``threading.Lock/RLock/Condition/Semaphore``.  A
+   ``with`` on anything else (per-key lock dicts, arbitrary context
+   managers) poisons the held-set with an *unknown* marker — accesses
+   under it are never judged (conservative silence, zero false
+   positives over completeness).
+
+3. **Shared state.**  Module globals and ``self.<attr>`` slots
+   (``__init__`` writes excluded: construction happens-before
+   ``start()``).  A finding needs a *write* under one root and any
+   access under a different root whose guaranteed lock sets are
+   **inconsistent** — disjoint, with at least one side actually
+   holding a lock.  Two lock-free accesses are NOT flagged: the
+   GIL-atomic single-dict-op idiom (``_state["on"]`` guard flags) is
+   this codebase's documented convention.  Unlocked read-modify-write
+   (``x += 1`` / ``x[k] += 1`` with *no* lock held) on multi-root
+   state is flagged separately — increments are not atomic.
+
+4. **Lock order.**  Every acquisition of lock B while lock A is held
+   (syntactic nesting or through resolved calls) records an A→B edge
+   with its root + call path.  An A→B *and* B→A pair is a potential
+   deadlock; the finding prints both acquisition paths
+   (``batcher → _pack → stats_lock ; scraper → snapshot →
+   metrics_lock``) and is a hard error class: ``--update-baseline``
+   refuses to grandfather it.
+
+Suppression: a ``# mxlint: disable=thread-shared-state`` pragma on the
+conflicting *write* line or on the shared variable's definition line
+(the module-level assignment, or the first ``self.x = ...`` in the
+class) clears every finding for that variable (source clears
+transitive sites); ``thread-lock-order`` pragmas work on either
+acquisition line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .checkers import _Loc, _pragma_disabled
+from .callgraph import _module_name, resolve_callable
+
+__all__ = ["check_threads", "discover_roots", "RULE_STATE", "RULE_ORDER"]
+
+RULE_STATE = "thread-shared-state"
+RULE_ORDER = "thread-lock-order"
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
+# mutating container methods: calling one through a shared ref writes it
+_MUTATORS = frozenset({"append", "extend", "insert", "remove", "pop",
+                       "popitem", "popleft", "appendleft", "clear",
+                       "update", "add", "discard", "setdefault"})
+_UNKNOWN = ("?", "?", "?")  # poison lock id: unanalyzable acquisition
+_HANDLER_BASES = ("BaseHTTPRequestHandler", "SimpleHTTPRequestHandler")
+# constructors that run before any thread exists (happens-before start)
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+class Root:
+    """One asynchronous entry point: (kind, target FnNode)."""
+
+    __slots__ = ("kind", "key", "path", "lineno")
+
+    def __init__(self, kind, key, path, lineno):
+        self.kind = kind      # thread | timer | atexit | signal |
+        self.key = key        # finalizer | http-handler | ffi | api
+        self.path = path
+        self.lineno = lineno
+
+    @property
+    def name(self):
+        return "%s:%s" % (self.kind, self.key[1])
+
+    def __repr__(self):
+        return "Root(%s)" % self.name
+
+
+# ------------------------------------------------------------ discovery
+
+
+def _scope_map(graph, ctx, module):
+    """{id(ast node): FnNode-or-None} for every node, attributing each
+    to its innermost enclosing function (None = module/class level)."""
+    by_ast = {id(fn.ast_node): fn
+              for fn in graph.by_module.get(module, {}).values()
+              if fn.path == ctx.path}
+    out = {}
+
+    def rec(node, owner):
+        for child in ast.iter_child_nodes(node):
+            fn = by_ast.get(id(child))
+            out[id(child)] = fn if fn is not None else owner
+            rec(child, fn if fn is not None else owner)
+
+    out[id(ctx.tree)] = None
+    rec(ctx.tree, None)
+    return out
+
+
+def _is_module_attr(expr, names):
+    """expr is ``<alias>.<attr>`` with alias in `names` -> attr."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in names):
+        return expr.attr
+    return None
+
+
+def _stdlib_aliases(imports, stdmod):
+    """Local names that alias stdlib module `stdmod` in this file."""
+    return {local for local, target in imports.module_alias.items()
+            if target == stdmod}
+
+
+def _from_names(imports, stdmod):
+    """Local names from-imported from `stdmod`: {local: attr}."""
+    return {local: attr for local, (mod, attr)
+            in imports.from_import.items() if mod == stdmod}
+
+
+def _collect_cfunc_types(contexts, graph):
+    """{(module, name)} of module-level ``X = ctypes.CFUNCTYPE(...)``
+    assignments — calls ``X(py_fn)`` build FFI trampolines whose
+    wrapped callable runs on native threads."""
+    out = set()
+    for ctx in contexts:
+        module = _module_name(ctx.path)
+        imports = graph.imports.get(module)
+        if imports is None:
+            continue
+        ct_aliases = _stdlib_aliases(imports, "ctypes")
+        ct_from = _from_names(imports, "ctypes")
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            fnx = node.value.func
+            hit = (_is_module_attr(fnx, ct_aliases) == "CFUNCTYPE"
+                   or (isinstance(fnx, ast.Name)
+                       and ct_from.get(fnx.id) == "CFUNCTYPE"))
+            if hit:
+                out.add((module, node.targets[0].id))
+    return out
+
+
+def discover_roots(graph, contexts):
+    """All statically-provable asynchronous entry points."""
+    roots = {}
+    cfunc_types = _collect_cfunc_types(contexts, graph)
+
+    def add(kind, key, ctx, lineno):
+        if key is None or not isinstance(key, tuple):
+            return
+        fn = graph.nodes.get(key)
+        if fn is None:
+            return
+        roots.setdefault((kind, key), Root(kind, key, ctx.path, lineno))
+
+    # tokens a file must literally contain to possibly declare a root;
+    # the source-text prefilter skips the per-node walk for the many
+    # files that spawn nothing
+    _root_tokens = ("Thread", "Timer", "atexit", "signal", "finalize",
+                    "CFUNCTYPE") + _HANDLER_BASES
+
+    for ctx in contexts:
+        module = _module_name(ctx.path)
+        imports = graph.imports.get(module)
+        if imports is None:
+            continue
+        if not any(tok in ctx.source for tok in _root_tokens):
+            continue
+        scope = _scope_map(graph, ctx, module)
+        th_aliases = _stdlib_aliases(imports, "threading")
+        th_from = _from_names(imports, "threading")
+        sig_aliases = _stdlib_aliases(imports, "signal")
+        ax_aliases = _stdlib_aliases(imports, "atexit")
+        ax_from = _from_names(imports, "atexit")
+        wr_aliases = _stdlib_aliases(imports, "weakref")
+        wr_from = _from_names(imports, "weakref")
+
+        def resolve(expr, at):
+            return resolve_callable(graph, module, scope.get(id(at)),
+                                    expr, ctx.aliases)
+
+        for node in ast.walk(ctx.tree):
+            # do_* methods of HTTP request-handler subclasses
+            if isinstance(node, ast.ClassDef):
+                base_names = {getattr(b, "attr", getattr(b, "id", ""))
+                              for b in node.bases}
+                if base_names & set(_HANDLER_BASES):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) \
+                                and item.name.startswith("do_"):
+                            for fn in graph.by_module.get(
+                                    module, {}).values():
+                                if fn.ast_node is item:
+                                    add("http-handler", fn.key, ctx,
+                                        item.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fnx = node.func
+            attr = _is_module_attr(fnx, th_aliases)
+            local = fnx.id if isinstance(fnx, ast.Name) else None
+            # threading.Thread(target=f) / Thread(target=f)
+            if attr == "Thread" or th_from.get(local) == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        add("thread", resolve(kw.value, node), ctx,
+                            node.lineno)
+            # threading.Timer(t, f)
+            elif attr == "Timer" or th_from.get(local) == "Timer":
+                if len(node.args) >= 2:
+                    add("timer", resolve(node.args[1], node), ctx,
+                        node.lineno)
+            # atexit.register(f, ...)
+            elif (_is_module_attr(fnx, ax_aliases) == "register"
+                  or ax_from.get(local) == "register"):
+                if node.args:
+                    add("atexit", resolve(node.args[0], node), ctx,
+                        node.lineno)
+            # signal.signal(sig, f)
+            elif _is_module_attr(fnx, sig_aliases) == "signal":
+                if len(node.args) >= 2:
+                    add("signal", resolve(node.args[1], node), ctx,
+                        node.lineno)
+            # weakref.finalize(obj, f, ...)
+            elif (_is_module_attr(fnx, wr_aliases) == "finalize"
+                  or wr_from.get(local) == "finalize"):
+                if len(node.args) >= 2:
+                    add("finalizer", resolve(node.args[1], node), ctx,
+                        node.lineno)
+            # CFUNCTYPE trampoline: WRAPPER(f) -> f runs on C threads
+            else:
+                target = None
+                if isinstance(fnx, ast.Name) \
+                        and local in imports.from_import:
+                    target = imports.from_import[local]
+                elif isinstance(fnx, ast.Attribute) \
+                        and isinstance(fnx.value, ast.Name) \
+                        and fnx.value.id in imports.module_alias:
+                    target = (imports.module_alias[fnx.value.id],
+                              fnx.attr)
+                if target in cfunc_types and node.args:
+                    add("ffi", resolve(node.args[0], node), ctx,
+                        node.lineno)
+    return list(roots.values())
+
+
+# -------------------------------------------------------- lock identity
+
+
+def _collect_locks(contexts):
+    """Provable lock objects: module-global / self-attr names assigned
+    ``threading.Lock()`` (or RLock/Condition/Semaphore).  Returns
+    ({("global", module, name)} | {("attr", module, cls, name)},
+    {lock_id: definition lineno})."""
+    locks, def_lines = set(), {}
+    for ctx in contexts:
+        module = _module_name(ctx.path)
+
+        def is_ctor(value):
+            if not isinstance(value, ast.Call):
+                return False
+            fnx = value.func
+            name = getattr(fnx, "attr", getattr(fnx, "id", None))
+            if name not in _LOCK_CTORS:
+                return False
+            # Condition(lock) wraps; bare Name ctor must come from
+            # threading (from-import) — attribute form checks the root
+            if isinstance(fnx, ast.Attribute):
+                return (isinstance(fnx.value, ast.Name)
+                        and fnx.value.id == "threading")
+            return True
+
+        cls_stack = []
+
+        def rec(node):
+            if isinstance(node, ast.ClassDef):
+                cls_stack.append(node.name)
+                for c in ast.iter_child_nodes(node):
+                    rec(c)
+                cls_stack.pop()
+                return
+            if isinstance(node, ast.Assign) and is_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and not cls_stack:
+                        lid = ("global", module, t.id)
+                    elif (isinstance(t, ast.Attribute)
+                          and isinstance(t.value, ast.Name)
+                          and t.value.id == "self" and cls_stack):
+                        lid = ("attr", module, ".".join(cls_stack),
+                               t.attr)
+                    else:
+                        continue
+                    locks.add(lid)
+                    def_lines.setdefault(lid, (ctx, node.lineno))
+            for c in ast.iter_child_nodes(node):
+                rec(c)
+
+        rec(ctx.tree)
+    return locks, def_lines
+
+
+def _lock_display(lid):
+    if lid is _UNKNOWN:
+        return "<unknown>"
+    if lid[0] == "global":
+        return "%s.%s" % (lid[1].rsplit(".", 1)[-1], lid[2])
+    return "%s.%s" % (lid[2], lid[3])
+
+
+# ----------------------------------------------------- per-fn summaries
+
+
+class _Access:
+    __slots__ = ("var", "kind", "lineno", "locks")
+
+    def __init__(self, var, kind, lineno, locks):
+        self.var = var        # ("global", mod, name) | ("attr", mod, cls, a)
+        self.kind = kind      # "read" | "write" | "rmw"
+        self.lineno = lineno
+        self.locks = locks    # tuple of lock ids held *within* the fn
+
+
+class _Summary:
+    """One function's lock/shared-state behaviour, lock context
+    attached syntactically (``with`` nesting inside this function)."""
+
+    __slots__ = ("fn", "accesses", "acquires", "calls")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.accesses = []    # [_Access]
+        self.acquires = []    # (lock_id, lineno, locks_before)
+        self.calls = []       # (callee_key, lineno, locks)
+
+
+def _build_summary(fn, ctx, module, graph, module_globals, locks):
+    """Scan `fn`'s own scope once, tracking the with-lock context."""
+    s = _Summary(fn)
+    imports = graph.imports[module]
+    in_init = fn.qualname.rsplit(".", 1)[-1] in _INIT_METHODS
+    call_sites = {id(call) for _key, call in fn.calls}
+    call_locks = {}  # id(ast.Call) -> locks tuple held at the site
+    global_decls = set()  # names this fn rebinds via `global x`
+    for node in ast.walk(fn.ast_node):
+        if isinstance(node, ast.Global):
+            global_decls.update(node.names)
+
+    def lock_of(expr):
+        """Lock id for a with-context expression, _UNKNOWN when the
+        acquisition cannot be modelled, None when provably not a lock.
+        A ``with <call>:`` is normally not one of our lock objects
+        (open(), scope()) — EXCEPT calls whose name says lock
+        (``self._key_lock(k)``): those return per-key locks we cannot
+        identify, so they poison the held-set instead of silently
+        reading as lock-free."""
+        if isinstance(expr, ast.Call):
+            name = getattr(expr.func, "attr",
+                           getattr(expr.func, "id", "")) or ""
+            if any(s in name.lower() for s in ("lock", "cond", "sem",
+                                               "mutex")):
+                return _UNKNOWN
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.bound:
+                return _UNKNOWN  # local rebind: unmodelled
+            lid = ("global", module, expr.id)
+            return lid if lid in locks else _UNKNOWN
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            if expr.value.id in ("self", "cls") and fn.cls:
+                lid = ("attr", module, fn.cls, expr.attr)
+                return lid if lid in locks else _UNKNOWN
+            alias = imports.module_alias.get(expr.value.id)
+            if alias is not None:
+                lid = ("global", alias, expr.attr)
+                return lid if lid in locks else _UNKNOWN
+        return _UNKNOWN
+
+    def shared_var(expr):
+        """expr (a Name/Attribute base being accessed) -> var id."""
+        if isinstance(expr, ast.Name):
+            if expr.id in global_decls \
+                    and expr.id in module_globals.get(module, {}):
+                return ("global", module, expr.id)
+            if expr.id in fn.bound or expr.id not in \
+                    module_globals.get(module, {}):
+                return None
+            return ("global", module, expr.id)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and fn.cls and not in_init:
+                return ("attr", module, fn.cls, expr.attr)
+            alias = imports.module_alias.get(expr.value.id)
+            if alias is not None and expr.attr in \
+                    module_globals.get(alias, {}):
+                return ("global", alias, expr.attr)
+        return None
+
+    def record(var, kind, node, held):
+        if var is not None:
+            s.accesses.append(_Access(var, kind, node.lineno, held))
+
+    def base_of(target):
+        """Peel Subscript/Attribute chains: the object mutated."""
+        while isinstance(target, (ast.Subscript, ast.Attribute)):
+            inner = target.value
+            if isinstance(inner, ast.Name):
+                return inner
+            if isinstance(inner, ast.Attribute) \
+                    and isinstance(inner.value, ast.Name) \
+                    and inner.value.id in ("self", "cls"):
+                return inner
+            target = inner
+        return None
+
+    def store_target(t, kind, node, held):
+        if isinstance(t, ast.Name):
+            if t.id in global_decls:
+                record(shared_var(t), kind, node, held)
+        elif isinstance(t, ast.Attribute):
+            record(shared_var(t), kind, node, held)
+        elif isinstance(t, ast.Subscript):
+            record(shared_var(base_of(t)), kind, node, held)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                store_target(e, kind, node, held)
+        elif isinstance(t, ast.Starred):
+            store_target(t.value, kind, node, held)
+
+    def rec(node, held):
+        # nested defs / name=lambda are their own graph nodes
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Lambda) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                rec(item.context_expr, inner)
+                lid = lock_of(item.context_expr)
+                if lid is not None:
+                    s.acquires.append((lid, node.lineno, inner))
+                    inner = inner + (lid,)
+            for stmt in node.body:
+                rec(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                store_target(t, "write", node, held)
+        elif isinstance(node, ast.AugAssign):
+            store_target(node.target, "rmw", node, held)
+        elif isinstance(node, ast.Call):
+            fnx = node.func
+            if isinstance(fnx, ast.Attribute) and fnx.attr in _MUTATORS:
+                record(shared_var(fnx.value), "write", node, held)
+            if id(node) in call_sites:
+                call_locks[id(node)] = held
+        elif isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load):
+            record(shared_var(node), "read", node, held)
+            return
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            var = shared_var(node)
+            if var is not None:
+                record(var, "read", node, held)
+                return  # don't double-count the inner Name
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    body = fn.ast_node.body if not isinstance(fn.ast_node, ast.Lambda) \
+        else [fn.ast_node.body]
+    for stmt in body:
+        rec(stmt, ())
+    for key, call in fn.calls:
+        s.calls.append((key, call.lineno,
+                        call_locks.get(id(call), ())))
+    return s
+
+
+# ------------------------------------------------------------ the pass
+
+
+def _module_global_map(contexts):
+    """{module: {name: (ctx, def lineno)}} for module-level assigns."""
+    out = {}
+    for ctx in contexts:
+        module = _module_name(ctx.path)
+        table = out.setdefault(module, {})
+        for node in ctx.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                    and isinstance(node.target, ast.Name):
+                targets = [node.target]
+            for t in targets:
+                table.setdefault(t.id, (ctx, node.lineno))
+    return out
+
+
+def _attr_def_map(contexts):
+    """{("attr", module, cls, attr): (ctx, lineno)} — the FIRST
+    ``self.<attr> = ...`` assignment inside each class body (the slot's
+    definition line, where a disable pragma clears every finding)."""
+    out = {}
+    for ctx in contexts:
+        module = _module_name(ctx.path)
+
+        def rec(node, cls_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    rec(child, cls_stack + [child.name])
+                    continue
+                if isinstance(child, (ast.Assign,
+                                      ast.AnnAssign)) and cls_stack:
+                    targets = child.targets \
+                        if isinstance(child, ast.Assign) \
+                        else [child.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            out.setdefault(
+                                ("attr", module, ".".join(cls_stack),
+                                 t.attr), (ctx, child.lineno))
+                rec(child, cls_stack)
+
+        rec(ctx.tree, [])
+    return out
+
+
+class _RootWalk:
+    """DFS from one root carrying held-lock sets and the call path."""
+
+    def __init__(self, graph, summaries, root_name):
+        self.graph = graph
+        self.summaries = summaries
+        self.root = root_name
+        self.accesses = []     # (root, _Access-like with absolute locks,
+                               # fn, path tuple)
+        self.edges = {}        # (lockA, lockB) -> (root, path, ctxfn,
+                               # lineno)
+        self.memo = set()
+
+    def walk(self, key, held=frozenset(), path=(), depth=0):
+        fn = self.graph.nodes.get(key)
+        s = self.summaries.get(key)
+        if fn is None or s is None or depth > 48:
+            return
+        mkey = (key, held)
+        if mkey in self.memo:
+            return
+        self.memo.add(mkey)
+        path = path + (fn.display,)
+        for a in s.accesses:
+            self.accesses.append((self.root, a.var, a.kind, a.lineno,
+                                  frozenset(held | set(a.locks)), fn,
+                                  path))
+        for lid, lineno, before in s.acquires:
+            now_held = held | set(before)
+            for h in now_held:
+                if h is _UNKNOWN or lid is _UNKNOWN or h == lid:
+                    continue
+                self.edges.setdefault(
+                    (h, lid), (self.root, path, fn, lineno))
+        for callee, lineno, locks in s.calls:
+            self.walk(callee, frozenset(held | set(locks)), path,
+                      depth + 1)
+
+
+def check_threads(contexts, config, graph):
+    """Run both thread rules; appends findings to ctx.findings."""
+    want_state = RULE_STATE in config.rules
+    want_order = RULE_ORDER in config.rules
+    if not (want_state or want_order):
+        return []
+    roots = discover_roots(graph, contexts)
+    locks, _lock_defs = _collect_locks(contexts)
+    module_globals = _module_global_map(contexts)
+    by_path = {ctx.path: ctx for ctx in contexts}
+
+    # one summary per function, built once
+    summaries = {}
+    for key, fn in graph.nodes.items():
+        ctx = by_path.get(fn.path)
+        if ctx is None:
+            continue
+        summaries[key] = _build_summary(fn, ctx, fn.module, graph,
+                                        module_globals, locks)
+
+    # the api root: everything not exclusively inside a thread cone
+    root_keys = {r.key for r in roots}
+    cone = set()
+    frontier = list(root_keys)
+    while frontier:
+        key = frontier.pop()
+        if key in cone:
+            continue
+        cone.add(key)
+        s = summaries.get(key)
+        if s:
+            frontier.extend(k for k, _l, _h in s.calls)
+    callers = {}
+    for key, s in summaries.items():
+        for callee, _l, _h in s.calls:
+            callers.setdefault(callee, set()).add(key)
+    api_entries = [key for key in summaries
+                   if key not in root_keys
+                   # *_locked: caller-holds-the-lock convention — only
+                   # reachable through callers that took the lock
+                   and not key[1].rsplit(".", 1)[-1].endswith("_locked")
+                   and (key not in cone
+                        or any(c not in cone
+                               for c in callers.get(key, ())))]
+
+    walks = []
+    for r in roots:
+        w = _RootWalk(graph, summaries, r.name)
+        w.walk(r.key)
+        walks.append(w)
+    api_walk = _RootWalk(graph, summaries, "api")
+    for key in api_entries:
+        api_walk.walk(key)
+    walks.append(api_walk)
+
+    if want_state:
+        attr_defs = _attr_def_map(contexts)
+        _check_shared_state(walks, module_globals, attr_defs, by_path)
+    if want_order:
+        _check_lock_order(walks, by_path)
+    return roots
+
+
+def _var_display(var):
+    if var[0] == "global":
+        return "%s.%s" % (var[1], var[2])
+    return "%s.%s" % (var[2], var[3])
+
+
+def _def_line_pragma(var, module_globals, attr_defs, rule):
+    """Pragma on the shared variable's definition line — or on a pure
+    comment line directly above it, where a one-line justification
+    fits — clears every finding for it (pragma at the source clears
+    transitive sites)."""
+    if var[0] == "global":
+        entry = module_globals.get(var[1], {}).get(var[2])
+    else:
+        entry = attr_defs.get(var)
+    if entry is None:
+        return False
+    ctx, lineno = entry
+    if _pragma_disabled(ctx.line(lineno), rule):
+        return True
+    above = ctx.line(lineno - 1).strip() if lineno > 1 else ""
+    return above.startswith("#") and _pragma_disabled(above, rule)
+
+
+def _fmt_locks(locks):
+    real = sorted(_lock_display(x) for x in locks if x is not _UNKNOWN)
+    return "{%s}" % ", ".join(real) if real else "no lock"
+
+
+def _check_shared_state(walks, module_globals, attr_defs, by_path):
+    by_var = {}
+    for w in walks:
+        for root, var, kind, lineno, held, fn, path in w.accesses:
+            by_var.setdefault(var, []).append(
+                (root, kind, lineno, held, fn, path))
+    for var in sorted(by_var):
+        accs = [a for a in by_var[var] if _UNKNOWN not in a[3]]
+        roots = {a[0] for a in accs}
+        if len(roots) < 2:
+            continue
+        writes = [a for a in accs if a[1] in ("write", "rmw")]
+        if not writes:
+            continue
+        if _def_line_pragma(var, module_globals, attr_defs, RULE_STATE):
+            continue
+        hit = None
+        for w_ in writes:
+            for a in accs:
+                if a[0] == w_[0]:
+                    continue
+                if w_[3] & a[3]:
+                    continue  # common lock: consistent
+                if not (w_[3] | a[3]):
+                    continue  # both lock-free: GIL-atomic idiom
+                hit = (w_, a, "inconsistent")
+                break
+            if hit:
+                break
+        if hit is None:
+            for w_ in writes:
+                if w_[1] == "rmw" and not w_[3]:
+                    hit = (w_, None, "rmw")
+                    break
+        if hit is None:
+            continue
+        w_, a, why = hit
+        ctx = by_path.get(w_[4].path)
+        if ctx is None:
+            continue
+        if why == "inconsistent":
+            msg = ("shared %s written under root '%s' holding %s but "
+                   "accessed under root '%s' holding %s (%s:%d in %s) "
+                   "— the lock sets never intersect, so the two sides "
+                   "race; take one common lock or pragma the variable "
+                   "definition if the disagreement is by design"
+                   % (_var_display(var), w_[0], _fmt_locks(w_[3]),
+                      a[0], _fmt_locks(a[3]), by_path[a[4].path].path,
+                      a[2], a[4].display))
+        else:
+            msg = ("unlocked read-modify-write on shared %s under root "
+                   "'%s' — increments are LOAD/ADD/STORE, not atomic; "
+                   "another root accesses this variable concurrently"
+                   % (_var_display(var), w_[0]))
+        ctx.add(RULE_STATE, _Loc(w_[2]), msg, w_[4].qualname)
+
+
+def _check_lock_order(walks, by_path):
+    edges = {}
+    for w in walks:
+        for pair, witness in w.edges.items():
+            edges.setdefault(pair, witness)
+    reported = set()
+    for (a, b), (root1, path1, fn1, line1) in sorted(
+            edges.items(), key=lambda kv: (kv[1][2].path, kv[1][3])):
+        inv = edges.get((b, a))
+        if inv is None:
+            continue
+        pair_key = frozenset(((a, b), (b, a)))
+        if pair_key in reported:
+            continue
+        reported.add(pair_key)
+        root2, path2, fn2, line2 = inv
+        ctx1 = by_path.get(fn1.path)
+        ctx2 = by_path.get(fn2.path)
+        if ctx1 is None or ctx2 is None:
+            continue
+        # pragma on EITHER acquisition line clears the pair
+        if _pragma_disabled(ctx2.line(line2), RULE_ORDER):
+            continue
+        msg = ("lock-order inversion between %s and %s: %s → %s "
+               "acquires %s then %s (%s:%d); %s → %s acquires %s then "
+               "%s (%s:%d) — two threads interleaving these paths "
+               "deadlock"
+               % (_lock_display(a), _lock_display(b),
+                  root1, " → ".join(path1), _lock_display(a),
+                  _lock_display(b), ctx1.path, line1,
+                  root2, " → ".join(path2), _lock_display(b),
+                  _lock_display(a), ctx2.path, line2))
+        ctx1.add(RULE_ORDER, _Loc(line1), msg, fn1.qualname)
