@@ -1,0 +1,645 @@
+"""Observability autopilot — gated, audited reflexes that close the
+doctor→action loop (the ROADMAP's "Observability autopilot" item).
+
+PRs 8/10/15 built the *judge*: ranked perfdoctor findings, timeline
+trend rules, x-ray shares, dead-shard heartbeats.  Every finding still
+terminated at a human; production scale cannot page an operator per
+shard.  This module is the *actuator*: a reflex engine evaluated
+guard-first at the two seams where telemetry already flows — the
+``Trainer.step`` tail (``on_step``, right after ``metrics_timeline``
+samples, so the ring is fresh) and the serving accounting path
+(``on_serve``) — which re-runs the cheap doctor rules over the live
+state every ``MXNET_TPU_AUTOPILOT_INTERVAL`` evaluations and maps each
+firing rule onto one bounded, reversible action:
+
+====================  ==================  ==============================
+trigger rule          reflex              armed action
+====================  ==================  ==============================
+timeline-leak         force-checkpoint    async ``CheckpointManager``
+                                          snapshot now + projected-OOM
+                                          warning (PR 6 manager)
+recompile-storm       pin-bucket          install a registry bucket hint
+                                          on the churned integer attr so
+                                          the cache key ladder collapses
+                                          (``ops.registry``)
+timeline-kv-drift     restart-rank        park a ``restart_rank``
+                                          request on PS shard 0; the
+                                          ``tools/launch.py`` supervisor
+                                          polls and relaunches (PR 9)
+serve-queue-dominated serve-tune          nudge ``InferenceServer``
+                                          knobs within bounds (workers
+                                          up, max-wait up, queue down)
+first-nan             halt-after-         checkpoint, then raise
+                      checkpoint          :class:`AutopilotHalt`
+====================  ==================  ==============================
+
+Safety model (every reflex, no exceptions):
+
+- **off by default** — the whole engine is dead until
+  ``MXNET_TPU_AUTOPILOT=1`` (or :func:`enable`); disabled cost is ONE
+  dict read, pinned by ``test_bench_gate.py`` and proved statically by
+  mxlint's guard-first pass.
+- **per-reflex gate** — each reflex reads its own env
+  (``MXNET_TPU_AUTOPILOT_CKPT`` / ``_BUCKET`` / ``_RESTART`` /
+  ``_SERVE`` / ``_HALT``): ``1`` arms the real action, ``0`` silences
+  the reflex entirely, *unset* means **dry-run** — the safe default
+  when the master switch is on: the reflex evaluates, logs the
+  would-be action, and ledgers it, but acts on nothing.
+- **hysteresis** — a per-reflex cooldown
+  (``MXNET_TPU_AUTOPILOT_COOLDOWN`` seconds) and a per-run action cap
+  (``MXNET_TPU_AUTOPILOT_MAX_ACTIONS``); suppressed firings are
+  ledgered with the reason, so the audit trail shows restraint too.
+- **append-only ledger** — every fired / dry-run / suppressed decision
+  is recorded (rule, evidence snapshot, action, outcome) in a bounded
+  deque that rides diag dumps as a top-level ``autopilot`` section,
+  renders in ``runtime_stats.report()`` and ``tools/diagnose.py
+  --autopilot``, and feeds the ``mxnet_tpu_autopilot_*`` Prometheus
+  counters.
+
+Thread model: ``on_step`` runs on the training thread only (its clock
+is a lock-free single-writer dict); ``on_serve`` runs on serving
+worker threads; the ledger, counters, and hysteresis maps are shared
+across both and mutate only under the module ``_lock``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+from .base import MXNetError
+from .log import get_logger
+
+__all__ = ["enable", "disable", "is_enabled", "reset", "on_step",
+           "on_serve", "ledger", "ledger_section", "snapshot",
+           "AutopilotHalt", "REFLEXES", "GATE_ENVS"]
+
+# one reflex per doctor rule; GATE_ENVS is the per-reflex arm switch
+REFLEXES = ("force-checkpoint", "pin-bucket", "restart-rank",
+            "serve-tune", "halt-after-checkpoint")
+GATE_ENVS = {
+    "force-checkpoint": "MXNET_TPU_AUTOPILOT_CKPT",
+    "pin-bucket": "MXNET_TPU_AUTOPILOT_BUCKET",
+    "restart-rank": "MXNET_TPU_AUTOPILOT_RESTART",
+    "serve-tune": "MXNET_TPU_AUTOPILOT_SERVE",
+    "halt-after-checkpoint": "MXNET_TPU_AUTOPILOT_HALT",
+}
+
+INTERVAL_DEFAULT = 32       # evaluate every N on_step/on_serve ticks
+COOLDOWN_DEFAULT = 60.0     # seconds between actions of one reflex
+MAX_ACTIONS_DEFAULT = 4     # per reflex per run (reset() re-opens)
+HBM_GB_DEFAULT = 16.0       # leak-projection budget (v4-lite HBM)
+SERVE_MAX_WORKERS_DEFAULT = 8
+SERVE_MAX_WAIT_MS_DEFAULT = 50.0
+SERVE_MIN_QUEUE_DEFAULT = 64
+LEDGER_CAP = 256            # append-only, oldest entries roll off
+
+_state = {"on": False}
+_cfg = {"interval": INTERVAL_DEFAULT, "cooldown": COOLDOWN_DEFAULT,
+        "max_actions": MAX_ACTIONS_DEFAULT, "hbm_gb": HBM_GB_DEFAULT,
+        "serve_max_workers": SERVE_MAX_WORKERS_DEFAULT,
+        "serve_max_wait_ms": SERVE_MAX_WAIT_MS_DEFAULT,
+        "serve_min_queue": SERVE_MIN_QUEUE_DEFAULT,
+        "gates": {r: "dry_run" for r in REFLEXES}}
+
+# ledger / counters / hysteresis: shared between the training thread
+# and serving workers — mutate under _lock only
+_lock = threading.Lock()
+_LEDGER: collections.deque = collections.deque(maxlen=LEDGER_CAP)
+_counts = {"evals": 0, "fired": 0, "dry_run": 0, "suppressed": 0}
+_last_action: dict = {}     # reflex -> monotonic time of last action
+_actions: dict = {}         # reflex -> actions taken this run
+# single-writer clocks: on_step runs on the training thread only, so
+# its tick is the GIL-atomic lock-free idiom; the serve tick is bumped
+# from worker threads and lives under _lock
+_train_clock = {"n": 0}
+_serve_clock = {"n": 0}
+_nan_memo = [None]          # first_nan step already reacted to
+
+_logger_cache: list = []
+
+
+def _logger():
+    if not _logger_cache:
+        _logger_cache.append(get_logger("mxnet_tpu.autopilot"))
+    return _logger_cache[0]
+
+
+class AutopilotHalt(MXNetError):
+    """Raised out of ``Trainer.step`` by an ARMED halt-after-checkpoint
+    reflex: the first non-finite value was observed, a checkpoint was
+    submitted, and continuing would only burn accelerator time
+    polluting every parameter."""
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name) or default)
+    except (TypeError, ValueError):
+        return float(default)
+
+
+def _gate_from_env(reflex):
+    """``1``/truthy arms, ``0`` silences, unset -> dry-run (the safe
+    default: a master-switched autopilot narrates before it touches)."""
+    raw = os.environ.get(GATE_ENVS[reflex])
+    if raw is None or raw == "":
+        return "dry_run"
+    return "off" if raw == "0" else "armed"
+
+
+def enable(interval=None, cooldown=None, max_actions=None, hbm_gb=None,
+           gates=None):
+    """Arm the reflex engine.  Explicit arguments win over the
+    ``MXNET_TPU_AUTOPILOT_*`` envs; ``gates`` merges per-reflex mode
+    overrides (``"armed"`` / ``"dry_run"`` / ``"off"``) over the
+    env-derived defaults.  Returns the resolved config."""
+    _cfg["interval"] = max(1, int(
+        interval if interval is not None
+        else _env_float("MXNET_TPU_AUTOPILOT_INTERVAL",
+                        INTERVAL_DEFAULT)))
+    _cfg["cooldown"] = max(0.0, float(
+        cooldown if cooldown is not None
+        else _env_float("MXNET_TPU_AUTOPILOT_COOLDOWN",
+                        COOLDOWN_DEFAULT)))
+    _cfg["max_actions"] = max(1, int(
+        max_actions if max_actions is not None
+        else _env_float("MXNET_TPU_AUTOPILOT_MAX_ACTIONS",
+                        MAX_ACTIONS_DEFAULT)))
+    _cfg["hbm_gb"] = float(
+        hbm_gb if hbm_gb is not None
+        else _env_float("MXNET_TPU_AUTOPILOT_HBM_GB", HBM_GB_DEFAULT))
+    _cfg["serve_max_workers"] = max(1, int(_env_float(
+        "MXNET_TPU_AUTOPILOT_SERVE_MAX_WORKERS",
+        SERVE_MAX_WORKERS_DEFAULT)))
+    _cfg["serve_max_wait_ms"] = _env_float(
+        "MXNET_TPU_AUTOPILOT_SERVE_MAX_WAIT_MS",
+        SERVE_MAX_WAIT_MS_DEFAULT)
+    _cfg["serve_min_queue"] = max(1, int(_env_float(
+        "MXNET_TPU_AUTOPILOT_SERVE_MIN_QUEUE",
+        SERVE_MIN_QUEUE_DEFAULT)))
+    g = {r: _gate_from_env(r) for r in REFLEXES}
+    if gates:
+        for r, mode in gates.items():
+            if r not in GATE_ENVS:
+                raise MXNetError("unknown autopilot reflex %r (have %s)"
+                                 % (r, ", ".join(REFLEXES)))
+            if mode not in ("armed", "dry_run", "off"):
+                raise MXNetError("unknown gate mode %r for reflex %r"
+                                 % (mode, r))
+            g[r] = mode
+    _cfg["gates"] = g
+    _state["on"] = True
+    return dict(_cfg)
+
+
+def disable():
+    """Stop evaluating (the ledger stays readable; ``reset`` drops it)."""
+    _state["on"] = False
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def reset():
+    """Drop the ledger, counters, clocks, and hysteresis (tests); the
+    enabled flag and resolved config stay as-is."""
+    with _lock:
+        _LEDGER.clear()
+        _counts.update({"evals": 0, "fired": 0, "dry_run": 0,
+                        "suppressed": 0})
+        _last_action.clear()
+        _actions.clear()
+        _serve_clock["n"] = 0
+        _nan_memo[0] = None
+    _train_clock["n"] = 0
+
+
+def _activate_from_env():
+    """``MXNET_TPU_AUTOPILOT=1`` at import arms the engine (telemetry
+    must never kill a training job: failures warn and leave it off)."""
+    raw = os.environ.get("MXNET_TPU_AUTOPILOT")
+    if not raw or raw == "0":
+        return
+    try:
+        enable()
+    except Exception:
+        _logger().warning(
+            "MXNET_TPU_AUTOPILOT is set but autopilot.enable() failed "
+            "— reflexes stay off", exc_info=True)
+
+
+# ------------------------------------------------------------ the seams
+
+
+def on_step(trainer=None):
+    """Training-step seam, called by ``Trainer.step``'s telemetry tail
+    AFTER ``metrics_timeline.on_step`` (so the live ring already holds
+    this step's sample).  Callers guard on ``_state["on"]``; the
+    re-check keeps a mid-step disable safe and is the entire disabled
+    cost.  An ARMED halt-after-checkpoint reflex raises
+    :class:`AutopilotHalt` through here; every other failure warns."""
+    if not _state["on"]:
+        return
+    _train_clock["n"] += 1
+    if _train_clock["n"] % _cfg["interval"]:
+        return
+    try:
+        _evaluate_training(trainer, _train_clock["n"])
+    except AutopilotHalt:
+        raise
+    except Exception:
+        _logger().warning("autopilot training evaluation failed "
+                          "(reflexes skipped this round)",
+                          exc_info=True)
+
+
+def on_serve(server):
+    """Serving seam, called from ``InferenceServer._account_batch`` on
+    worker threads after each batch's stats commit.  Same guard/interval
+    contract as :func:`on_step`; the tick lives under ``_lock`` because
+    several workers race it."""
+    if not _state["on"]:
+        return
+    with _lock:
+        _serve_clock["n"] += 1
+        tick = _serve_clock["n"]
+    if tick % _cfg["interval"] != 0:
+        return
+    try:
+        _evaluate_serving(server, tick)
+    except Exception:
+        _logger().warning("autopilot serving evaluation failed "
+                          "(reflexes skipped this round)",
+                          exc_info=True)
+
+
+# ----------------------------------------------------------- evaluation
+
+
+def _count_eval():
+    from . import runtime_stats as _rts
+
+    with _lock:
+        _counts["evals"] += 1
+    _rts.inc("autopilot_evals")
+
+
+def _evaluate_training(trainer, step):
+    from . import metrics_timeline as _metrics
+    from . import perfdoctor as _doctor
+
+    _count_eval()
+    samples = [s for s in _metrics.samples() if isinstance(s, dict)]
+    for f in _doctor._check_leak(samples):
+        _reflex_checkpoint(f, trainer, step, samples)
+    for f in _doctor._check_kv_drift(samples, top=1):
+        _reflex_restart(f, step)
+    dump = _doctor.live_dump(serving=False)
+    for f in _doctor._check_recompiles(dump):
+        _reflex_bucket(f, step)
+    _reflex_nan(trainer, step)
+
+
+def _evaluate_serving(server, tick):
+    from . import perfdoctor as _doctor
+
+    _count_eval()
+    for f in _doctor._check_serving(_doctor.live_dump()):
+        if f["rule"] == "serve-queue-dominated":
+            _reflex_serve(f, server, tick)
+
+
+# -------------------------------------------------------------- reflexes
+
+
+def _reflex_checkpoint(finding, trainer, step, samples):
+    """timeline-leak -> force an async checkpoint before the projected
+    exhaustion, and say WHEN that is (the warning a human can act on
+    even when the gate stays dry)."""
+    pts = [(s.get("step", i), s["live_bytes"])
+           for i, s in enumerate(samples)
+           if s.get("live_bytes") is not None]
+    projected = None
+    if len(pts) >= 2:
+        from . import perfdoctor as _doctor
+
+        slope = _doctor._lin_slope([p[0] for p in pts],
+                                   [p[1] for p in pts])
+        budget = _cfg["hbm_gb"] * (1 << 30)
+        live = pts[-1][1]
+        if slope > 0 and live < budget:
+            projected = int(pts[-1][0] + (budget - live) / slope)
+    action = ("force an async checkpoint now (CheckpointManager."
+              "save_trainer) so the run can resume past the OOM")
+    evidence = list(finding.get("evidence") or [])
+    if projected is not None:
+        action += " — projected %.0f GB HBM exhaustion ~ step %d" \
+            % (_cfg["hbm_gb"], projected)
+        evidence.append("projected exhaustion of the %.0f GB budget "
+                        "(MXNET_TPU_AUTOPILOT_HBM_GB) ~ step %d"
+                        % (_cfg["hbm_gb"], projected))
+
+    def act():
+        from . import checkpoint as _ckpt
+
+        mgr = _ckpt.manager()
+        if mgr is None:
+            return {"saved": False,
+                    "reason": "checkpointing disabled "
+                              "(checkpoint.enable() first)"}
+        if trainer is None:
+            return {"saved": False,
+                    "reason": "no trainer handle at the step seam"}
+        mgr.save_trainer(trainer, step=step)
+        return {"saved": True, "step": step}
+
+    _consider("force-checkpoint", finding, step, act,
+              action=action, evidence=evidence)
+
+
+def _churned_int_attrs(op):
+    """{attr: sorted values} for the integer (non-bool) attrs that vary
+    across the op's recent storm cache keys — the dimensions a bucket
+    hint can pin."""
+    from . import runtime_stats as _rts
+
+    st = _rts._STORM.get(op)
+    if not st:
+        return {}
+    values: dict = {}
+    for key in list(st.get("keys") or ()):
+        pairs = _rts._attr_pairs(key)
+        if not pairs:
+            continue
+        for attr, val in pairs:
+            if isinstance(val, int) and not isinstance(val, bool):
+                values.setdefault(attr, set()).add(val)
+    return {a: sorted(vs) for a, vs in values.items() if len(vs) > 1}
+
+
+def _pow2_ladder(maxv):
+    """Power-of-two rungs 8..>=maxv — every distinct value collapses
+    onto O(log) buckets instead of one cache entry each."""
+    top = 8
+    while top < maxv:
+        top *= 2
+    ladder, v = [], 8
+    while v <= top:
+        ladder.append(v)
+        v *= 2
+    return tuple(ladder)
+
+
+def _reflex_bucket(finding, step):
+    """recompile-storm -> install a registry bucket hint on the churned
+    integer attr so later values pad up onto a small ladder and the
+    storm STOPS (not just gets named).  Ops already hinted are skipped
+    outright: storm counters are cumulative, so without this memo one
+    storm would re-fire every evaluation forever."""
+    from .ops import registry as _registry
+
+    op = finding.get("anchor")
+    if not op or op in _registry.bucket_hints():
+        return
+    churn = _churned_int_attrs(op)
+    ladders = {a: _pow2_ladder(max(vs)) for a, vs in churn.items()}
+    action = ("install pad-to-bucket hint(s) on %r: %s"
+              % (op, ", ".join("%s -> ladder %s" % (a, ladders[a])
+                               for a in sorted(ladders))
+                 or "no churning integer attr identified — aval/shape "
+                    "churn needs a source-side fix"))
+
+    def act():
+        installed = {}
+        for attr, ladder in ladders.items():
+            _registry.install_bucket_hint(op, attr, ladder)
+            installed[attr] = list(ladder)
+        if not installed:
+            return {"op": op, "installed": {},
+                    "reason": "no churning integer attr in the recent "
+                              "cache keys (shape churn is not attr "
+                              "churn)"}
+        return {"op": op, "installed": installed}
+
+    _consider("pin-bucket", finding, step, act, action=action)
+
+
+def _reflex_restart(finding, step):
+    """timeline-kv-drift -> park a ``restart_rank`` request on PS shard
+    0; the ``tools/launch.py`` supervisor polls the head and relaunches
+    this worker through the PR 9 supervise/auto-resume loop."""
+
+    def act():
+        from . import profiler as _prof
+
+        kv = _prof._kvstore_handle
+        if kv is None or not hasattr(kv, "request_restart"):
+            return {"requested": False,
+                    "reason": "no kvstore handle registered "
+                              "(dist run required)"}
+        rank = getattr(kv, "rank", None)
+        ok = kv.request_restart(rank=rank, reason=finding["title"])
+        return {"requested": bool(ok), "rank": rank}
+
+    _consider("restart-rank", finding, step, act,
+              action="request supervised relaunch of this worker "
+                     "(restart_rank via PS shard 0; honored by "
+                     "tools/launch.py --supervise)")
+
+
+def _reflex_serve(finding, server, tick):
+    """serve-queue-dominated -> nudge the live server's knobs within
+    bounds: one more worker (toward SERVE_MAX_WORKERS), a longer batch
+    window (x1.5 toward SERVE_MAX_WAIT_MS — fuller batches amortize
+    dispatch), and a tighter queue bound (x0.75 toward SERVE_MIN_QUEUE
+    — shed load earlier instead of queueing past the SLO)."""
+
+    def act():
+        if server is None:
+            return {"adjusted": {},
+                    "reason": "no server handle at the seam"}
+        changed = {}
+        w = int(server.num_workers)
+        if w < _cfg["serve_max_workers"]:
+            server.set_workers(w + 1)
+            changed["workers"] = [w, w + 1]
+        wait_ms = float(server.max_wait) * 1e3
+        cap = float(_cfg["serve_max_wait_ms"])
+        if wait_ms < cap:
+            new = min(cap, max(wait_ms * 1.5, wait_ms + 0.5))
+            server.set_max_wait_ms(new)
+            changed["max_wait_ms"] = [round(wait_ms, 3), round(new, 3)]
+        q = int(server.max_queue)
+        floor = max(int(_cfg["serve_min_queue"]),
+                    int(getattr(server, "max_bucket", 1)))
+        if q > floor:
+            new_q = max(floor, int(q * 0.75))
+            if new_q < q:
+                server.set_max_queue(new_q)
+                changed["max_queue"] = [q, new_q]
+        if not changed:
+            return {"adjusted": {},
+                    "reason": "every knob already at its bound"}
+        return {"adjusted": changed}
+
+    _consider("serve-tune", finding, tick, act,
+              action="nudge serving knobs within bounds (workers up, "
+                     "max-wait up, queue bound down)")
+
+
+def _reflex_nan(trainer, step):
+    """health first-NaN -> checkpoint the last finite state, then (when
+    ARMED) raise :class:`AutopilotHalt`: every step past the first
+    non-finite value only spreads it.  Once per incident — the memo
+    keys on the recorded first_nan step."""
+    from . import health as _health
+
+    mon = _health.monitor()
+    if mon is None:
+        return
+    fn = getattr(mon, "first_nan", None)
+    if not fn:
+        return
+    if _nan_memo[0] == fn.get("step"):
+        return
+    _nan_memo[0] = fn.get("step")
+    finding = {"rule": "first-nan", "score": 1.0, "severity": "warn",
+               "title": "first non-finite value at step %s in %r"
+                        % (fn.get("step"), fn.get("key")),
+               "anchor": fn.get("key"),
+               "evidence": ["first_nan: %r" % (fn,)],
+               "action": "checkpoint the last finite state, then halt"}
+
+    def act():
+        from . import checkpoint as _ckpt
+
+        mgr = _ckpt.manager()
+        saved = False
+        if mgr is not None and trainer is not None:
+            mgr.save_trainer(trainer, step=step)
+            saved = True
+        raise AutopilotHalt(
+            "autopilot: halting after first non-finite value "
+            "(step %s, key %r)%s — inspect the flight dump / health "
+            "snapshot, then resume from the checkpoint"
+            % (fn.get("step"), fn.get("key"),
+               "; checkpoint submitted" if saved
+               else "; NO checkpoint (no manager/trainer)"))
+
+    _consider("halt-after-checkpoint", finding, step, act,
+              action="checkpoint last finite state, then halt the run")
+
+
+# ------------------------------------------------------- gate + ledger
+
+
+def _consider(reflex, finding, step, act, action=None, evidence=None):
+    """The single decision point every reflex funnels through: gate
+    mode, cooldown + max-actions hysteresis, the ledger append, and the
+    Prometheus-visible counters.  ``act`` runs only when ARMED; an
+    :class:`AutopilotHalt` it raises is ledgered, then re-raised."""
+    from . import runtime_stats as _rts
+
+    mode = _cfg["gates"].get(reflex, "dry_run")
+    if mode == "off":
+        return
+    now = time.monotonic()
+    entry = {"t": time.time(), "step": int(step),
+             "rule": finding.get("rule"), "reflex": reflex,
+             "severity": finding.get("severity"),
+             "score": finding.get("score"),
+             "action": action or finding.get("action"),
+             "evidence": list(evidence if evidence is not None
+                              else finding.get("evidence") or [])[:6]}
+    cooldown, max_actions = _cfg["cooldown"], _cfg["max_actions"]
+    with _lock:
+        last = _last_action.get(reflex)
+        if last is not None and now - last < cooldown:
+            entry.update(mode="suppressed",
+                         reason="cooldown (%.0fs of %.0fs left)"
+                                % (cooldown - (now - last), cooldown))
+            suppressed = True
+        elif _actions.get(reflex, 0) >= max_actions:
+            entry.update(mode="suppressed",
+                         reason="max-actions cap (%d) reached this run"
+                                % max_actions)
+            suppressed = True
+        else:
+            _last_action[reflex] = now
+            _actions[reflex] = _actions.get(reflex, 0) + 1
+            suppressed = False
+        if suppressed:
+            _LEDGER.append(entry)
+            _counts["suppressed"] += 1
+    if suppressed:
+        _rts.inc("autopilot_suppressed")
+        return
+    if mode == "dry_run":
+        entry.update(mode="dry_run",
+                     reason="gate %s unset — dry-run default"
+                            % GATE_ENVS[reflex])
+        with _lock:
+            _LEDGER.append(entry)
+            _counts["dry_run"] += 1
+        _rts.inc("autopilot_dry_run")
+        _logger().warning(
+            "autopilot[dry-run] %s: %s — would: %s (set %s=1 to act, "
+            "=0 to silence)", reflex, finding.get("title"),
+            entry["action"], GATE_ENVS[reflex])
+        return
+    halt = None
+    try:
+        outcome = act()
+    except AutopilotHalt as e:
+        halt = e
+        outcome = {"halt": str(e)}
+    except Exception as e:  # an action must never crash the seam
+        outcome = {"error": "%s: %s" % (type(e).__name__, e)}
+    entry.update(mode="fired", outcome=outcome)
+    with _lock:
+        _LEDGER.append(entry)
+        _counts["fired"] += 1
+    _rts.inc("autopilot_fired")
+    _logger().warning("autopilot[fired] %s: %s — %s -> %r",
+                      reflex, finding.get("title"), entry["action"],
+                      outcome)
+    if halt is not None:
+        raise halt
+
+
+def ledger():
+    """The append-only action ledger, oldest first (bounded at
+    ``LEDGER_CAP``; older entries roll off)."""
+    with _lock:
+        return [dict(e) for e in _LEDGER]
+
+
+def ledger_section():
+    """The ``autopilot`` section diag dumps embed and ``report()`` /
+    ``diagnose.py --autopilot`` render: config, decision counters, and
+    the full ledger."""
+    # config/state are single-writer dicts read lock-free everywhere
+    # (the guard-first convention); only the ledger and its counters
+    # are multi-writer and need the lock
+    out = {"enabled": _state["on"],
+           "interval": _cfg["interval"],
+           "cooldown_s": _cfg["cooldown"],
+           "max_actions": _cfg["max_actions"],
+           "gates": dict(_cfg["gates"])}
+    with _lock:
+        out["counters"] = dict(_counts)
+        out["entries"] = [dict(e) for e in _LEDGER]
+    return out
+
+
+def snapshot():
+    """Alias of :func:`ledger_section` (the module-surface convention
+    the other telemetry layers follow)."""
+    return ledger_section()

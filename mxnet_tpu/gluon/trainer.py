@@ -14,6 +14,7 @@ train step over the mesh (parallel/data_parallel.py).
 
 from __future__ import annotations
 
+from .. import autopilot as _autopilot
 from .. import checkpoint as _ckpt
 from .. import device_memory as _dm
 from .. import health as _health
@@ -97,6 +98,12 @@ class _StepTelemetry:
         # dict read.
         if _metrics._state["on"]:
             _metrics.on_step(self.batch_size)
+        # observability autopilot: gated reflexes over the live ring,
+        # AFTER the timeline sample so the evidence includes this step.
+        # Disabled: one dict read.  An ARMED halt-after-checkpoint
+        # reflex raises AutopilotHalt through here by design.
+        if _autopilot._state["on"]:
+            _autopilot.on_step(self.trainer)
         return False
 
 

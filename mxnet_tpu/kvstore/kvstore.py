@@ -572,6 +572,17 @@ class DistAsyncKVStore(KVStore):
         raw = self._client.command_shard(0, "diag_get") or {}
         return {k: json.loads(v) for k, v in raw.items()}
 
+    def request_restart(self, rank=None, reason=""):
+        """Park a supervised-relaunch request for ``rank`` (default:
+        THIS worker) on PS shard 0 — the reserved ``restart_rank``
+        head the ``tools/launch.py --supervise`` loop polls and honors
+        (the autopilot's kv-RTT straggler reflex).  Returns the
+        shard's ack dict, or False on a degraded in-process store."""
+        if self._client is None:
+            return False
+        return self._client.request_restart(
+            self.rank if rank is None else int(rank), reason=reason)
+
     def estimate_clock_offset(self, samples=5):
         """Ping shard 0 and register this process's wall-clock offset
         with the profiler (``set_clock_offset``) so per-rank chrome

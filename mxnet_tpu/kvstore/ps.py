@@ -194,7 +194,7 @@ def set_app_controller(fn):
 
 # command heads the framework intercepts before the app controller
 _RESERVED_HEADS = ("profiler", "stats", "ping", "diag_put", "diag_get",
-                   "ckpt")
+                   "ckpt", "restart_rank", "restart_poll")
 
 
 # modules/names a data message may reference: enough to rebuild numpy
@@ -356,6 +356,10 @@ class PSServer:
         self._accepted = 0
         # rank → diag-dump JSON string parked by the diag_put command
         self._rank_dumps = {}
+        # worker-relaunch requests parked by the restart_rank command
+        # (the autopilot's straggler reflex) until the launch.py
+        # supervisor drains them via restart_poll; under _metrics_lock
+        self._restart_requests = []
         self._server_id = int(os.environ.get(
             "MXTPU_PS_SERVER_ID",
             os.environ.get("DMLC_SERVER_ID", "0")) or 0)
@@ -1014,6 +1018,41 @@ class PSServer:
         if head == "diag_get":
             with self._metrics_lock:
                 return dict(self._rank_dumps)
+        if head == "restart_rank":
+            # body = JSON {"rank": int, "reason": str} (a bare int
+            # body also parses): park the request for the supervisor.
+            # The server only RECORDS — relaunch authority stays with
+            # the process that owns the worker (tools/launch.py
+            # --supervise), so an unsupervised run degrades to a
+            # visible no-op instead of a kill.
+            try:
+                req = _json.loads(body or "{}")
+            except ValueError:
+                raise ValueError("restart_rank body must be JSON, got "
+                                 "%r" % (body,))
+            if isinstance(req, int):
+                req = {"rank": req}
+            if not isinstance(req, dict) or not isinstance(
+                    req.get("rank"), int):
+                raise ValueError("restart_rank body needs an integer "
+                                 "'rank', got %r" % (body,))
+            rec = {"rank": req["rank"],
+                   "reason": str(req.get("reason", "")), "t": time.time()}
+            with self._metrics_lock:
+                self._restart_requests.append(rec)
+                # bounded: a supervisor-less run must not grow forever
+                del self._restart_requests[:-64]
+            from .. import runtime_stats as _rts
+
+            _rts.inc("kvstore_restart_requests")
+            return _json.dumps({"parked": True, "rank": rec["rank"]})
+        if head == "restart_poll":
+            # drain-and-return: each request is handed to exactly one
+            # poller (the supervisor loop)
+            with self._metrics_lock:
+                out = list(self._restart_requests)
+                del self._restart_requests[:]
+            return _json.dumps(out)
         if head != "profiler":
             if _app_controller[0] is not None:
                 return _app_controller[0](head, body)
@@ -1418,6 +1457,17 @@ class PSClient:
         (docs/CHECKPOINTING.md "Server-side durability")."""
         return [_json.loads(self.command_shard(i, "ckpt"))
                 for i in range(len(self._socks))]
+
+    def request_restart(self, rank, reason=""):
+        """Park a worker-relaunch request on shard 0 (the reserved
+        ``restart_rank`` head).  The ``tools/launch.py --supervise``
+        loop polls ``restart_poll`` and relaunches that worker through
+        the PR 9 supervise/auto-resume path; without a supervisor the
+        request is a recorded no-op.  Returns the shard's ack dict."""
+        body = _json.dumps({"rank": int(rank),
+                            "reason": str(reason)})
+        return _json.loads(
+            self.command_shard(0, "restart_rank", body))
 
     def ping(self, idx=0, samples=5):
         """Estimate this process's wall-clock offset to shard ``idx``:

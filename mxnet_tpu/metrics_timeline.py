@@ -696,6 +696,31 @@ def prometheus_text():
                "unattributed remainder completes the sum to 1).",
                xrows)
 
+    # live perfdoctor findings as a gauge family: external alerting
+    # reads the SAME signal the autopilot's reflexes act on.  Snapshot
+    # reads only, and a diagnosis failure must never fail the scrape.
+    try:
+        from . import perfdoctor as _doctor
+
+        findings = _doctor.live_findings()
+    except Exception:
+        findings = []
+    if findings:
+        # one series per (rule, severity): several findings of one rule
+        # (e.g. per-shard kv drift) collapse to the max score — a
+        # Prometheus family must not repeat a label-set
+        by_labels = {}
+        for f in findings:
+            key = (f["rule"], f["severity"])
+            if f["score"] > by_labels.get(key, (None, -1.0))[1]:
+                by_labels[key] = (f, f["score"])
+        family("mxnet_tpu_doctor_finding", "gauge",
+               "Live perfdoctor findings (score = estimated share of "
+               "step time at stake); absent series = rule quiet.",
+               [({"rule": rule, "severity": sev}, score)
+                for (rule, sev), (_f, score) in sorted(
+                    by_labels.items())])
+
     # every latency histogram as one summary family (associative
     # snapshots — the same numbers report()/cluster_report show)
     rows = []

@@ -33,7 +33,8 @@ from .. import runtime_stats as _stats
 from ..base import MXNetError
 
 __all__ = ["Op", "register", "get", "list_ops", "apply_op",
-           "compiled_cost", "cost_capture_active", "cost_snapshot"]
+           "compiled_cost", "cost_capture_active", "cost_snapshot",
+           "install_bucket_hint", "bucket_hints", "clear_bucket_hints"]
 
 
 _OP_REGISTRY: dict[str, "Op"] = {}
@@ -99,6 +100,57 @@ def _hashable(v):
     return v
 
 
+# Pad-to-bucket hints: {op name: {attr: ladder tuple or None}}.  A
+# hinted integer attr is rounded UP onto its ladder during attr
+# canonicalization, so a per-call churning dimension (sequence length,
+# pad amount) collapses onto O(log) jit-cache keys instead of one
+# executable per distinct value — the registry-level actuator the
+# autopilot's recompile-storm reflex installs (the op must tolerate the
+# larger value as padding; that is what makes the attr a *dimension*).
+# Empty by default: the hot path pays one falsy-dict check.
+_BUCKET_HINTS: dict = {}
+
+
+def install_bucket_hint(op_name, attr, ladder=None):
+    """Round ``attr`` of ``op_name`` up onto ``ladder`` (a sorted tuple
+    of ints; values past the top rung round up to a multiple of it) at
+    every future :meth:`Op.canonicalize_attrs`.  ``ladder=None`` means
+    next power of two.  Idempotent per (op, attr); returns the
+    installed ladder."""
+    if ladder is not None:
+        ladder = tuple(sorted(int(v) for v in ladder))
+        if not ladder or any(v <= 0 for v in ladder):
+            raise MXNetError("bucket ladder must be positive ints, got "
+                             "%r" % (ladder,))
+    _BUCKET_HINTS.setdefault(str(op_name), {})[str(attr)] = ladder
+    return ladder
+
+
+def bucket_hints():
+    """{op: {attr: ladder}} of every installed hint (a copy)."""
+    return {op: dict(hints) for op, hints in _BUCKET_HINTS.items()}
+
+
+def clear_bucket_hints():
+    """Drop every installed hint (tests / manual rollback)."""
+    _BUCKET_HINTS.clear()
+
+
+def _bucket_up(v, ladder):
+    """Smallest rung >= v; past the top rung, the next multiple of it.
+    ``ladder=None`` -> next power of two (>= 1)."""
+    if ladder is None:
+        b = 1
+        while b < v:
+            b *= 2
+        return b
+    for rung in ladder:
+        if rung >= v:
+            return rung
+    top = ladder[-1]
+    return ((v + top - 1) // top) * top
+
+
 class Op:
     """A registered operator.
 
@@ -134,7 +186,18 @@ class Op:
     def canonicalize_attrs(self, attrs):
         out = dict(self.defaults)
         out.update(attrs)
-        return {k: _hashable(v) for k, v in out.items()}
+        out = {k: _hashable(v) for k, v in out.items()}
+        if _BUCKET_HINTS:
+            hints = _BUCKET_HINTS.get(self.name)
+            if hints:
+                for attr, ladder in hints.items():
+                    v = out.get(attr)
+                    if isinstance(v, int) and not isinstance(v, bool):
+                        b = _bucket_up(v, ladder)
+                        if b != v:
+                            out[attr] = b
+                            _stats.inc("bucket_hint_rounded")
+        return out
 
     def bind_attrs(self, attrs):
         """A pure fn of tensors only, with attrs closed over (for vjp/trace)."""

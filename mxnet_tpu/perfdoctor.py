@@ -84,7 +84,8 @@ from . import runtime_stats as _rts
 from . import stepstats as _stepstats
 
 __all__ = ["diagnose", "classify", "render", "render_github",
-           "gh_annotation", "SHARE_NOTICE", "SHARE_WARN",
+           "gh_annotation", "live_dump", "live_findings",
+           "SHARE_NOTICE", "SHARE_WARN",
            "HEADROOM_RATIO", "IDLE_GAP_SHARE", "TREND_MIN_SAMPLES",
            "TREND_SLOWDOWN", "LEAK_SLOPE_BYTES", "SPIKE_RATIO",
            "KV_DRIFT_RATIO", "SERVE_QUEUE_RATIO", "SERVE_MIN_REQUESTS",
@@ -1094,6 +1095,58 @@ def _check_idle_gaps(trace):
 
 
 # --------------------------------------------------------------- driver
+
+
+def live_dump(serving=True):
+    """A LIGHT synthetic dump over the live process — just the
+    sections the cheap rules (:func:`_check_recompiles`,
+    :func:`_check_serving`) read: storm/counter dict reads plus the
+    histogram and serving snapshots.  Deliberately NOT
+    ``runtime_stats.snapshot()``: no cost aggregation, no xray, no
+    memory walk — this runs inside the autopilot's evaluation tick and
+    the ``/metrics`` scrape.  ``serving=False`` skips the serving
+    snapshot too (the training-side tick doesn't read it)."""
+    import sys as _sys
+
+    storms = {}
+    storm_keys = {}
+    for name, st in list(_rts._STORM.items()):
+        storms[name] = {"compiles": st.get("compiles", 0),
+                        "warned": st.get("warned", 0),
+                        "distinct_avals": len(st.get("avals") or ())}
+        storm_keys[name] = [repr(k) for k in list(st.get("keys") or ())]
+    snap = {"storms": storms, "counters": dict(_rts._COUNTERS),
+            "histograms": _histogram.snapshot()}
+    if serving:
+        _serving = _sys.modules.get("mxnet_tpu.serving")
+        snap["serving"] = _serving.snapshot() if _serving is not None \
+            else {"enabled": False}
+    else:
+        snap["serving"] = {"enabled": False}
+    return {"snapshot": snap, "recent_storm_keys": storm_keys}
+
+
+def live_findings(top=20):
+    """Doctor findings over the LIVE process: the trend rules over
+    ``metrics_timeline``'s ring plus the recompile-storm and serving
+    rules over :func:`live_dump`, ranked worst-first.  This is the
+    shared signal the ``mxnet_tpu_doctor_finding`` Prometheus gauges
+    export and the autopilot's reflexes act on — snapshot reads only,
+    and it never raises (a scrape must not take down the endpoint)."""
+    findings = []
+    try:
+        from . import metrics_timeline as _metrics
+
+        samples = [s for s in _metrics.samples() if isinstance(s, dict)]
+        if samples:
+            findings += _check_timeline(samples)
+        dump = live_dump()
+        findings += _check_recompiles(dump)
+        findings += _check_serving(dump)
+    except Exception:  # diagnosis must never break the surface it rides
+        pass
+    findings.sort(key=lambda f: -f["score"])
+    return findings[:top]
 
 
 def diagnose(trace=None, dump=None, timeline=None, top=20):

@@ -450,7 +450,17 @@ def report():
     footprint, and device-memory accounting.  Section headers always
     print (empty sections say why), so the output is self-describing on
     a fresh process too."""
-    return _render(snapshot())
+    from . import autopilot as _autopilot
+
+    snap = snapshot()
+    # the ledger is deliberately not part of snapshot() (compare()
+    # flattens snapshot sections numerically); the human report carries
+    # it the way diag dumps do
+    ap = _autopilot.ledger_section()
+    if ap.get("enabled") or ap.get("entries"):
+        snap = dict(snap)
+        snap["autopilot"] = ap
+    return _render(snap)
 
 
 def _render(snap, top=None):
@@ -483,6 +493,9 @@ def _render(snap, top=None):
     if serving.get("enabled"):
         lines.extend(_render_serving(serving,
                                      snap.get("histograms") or {}))
+    ap = snap.get("autopilot") or {}
+    if ap.get("enabled") or ap.get("entries"):
+        lines.extend(_render_autopilot(ap))
     lines.extend(_render_hists(snap.get("histograms") or {}))
     return "\n".join(lines)
 
@@ -674,6 +687,45 @@ def _render_serving(serving, hists):
     return lines
 
 
+def _render_autopilot(ap):
+    """The "Observability autopilot" section of ``report()`` / diag-dump
+    rendering and of ``tools/diagnose.py --autopilot``: engine config,
+    decision counters, per-reflex gates, and the action ledger
+    (newest last — the append order IS the audit order)."""
+    lines = ["", "Observability autopilot (gated reflexes)"]
+    c = ap.get("counters") or {}
+    lines.append("%s; every %s evaluation tick(s), cooldown %ss, "
+                 "max %s action(s)/reflex; %d eval(s): %d fired, %d "
+                 "dry-run, %d suppressed"
+                 % ("enabled" if ap.get("enabled") else "disabled",
+                    ap.get("interval", "?"), ap.get("cooldown_s", "?"),
+                    ap.get("max_actions", "?"), c.get("evals", 0),
+                    c.get("fired", 0), c.get("dry_run", 0),
+                    c.get("suppressed", 0)))
+    gates = ap.get("gates") or {}
+    if gates:
+        lines.append("gates: " + ", ".join(
+            "%s=%s" % (r, gates[r]) for r in sorted(gates)))
+    entries = ap.get("entries") or []
+    if not entries:
+        lines.append("(ledger empty — no reflex has tripped; dry-run "
+                     "entries appear here too)")
+        return lines
+    lines.append("%-22s %8s %-10s %-20s %s"
+                 % ("Rule", "Step", "Mode", "Reflex", "Action/outcome"))
+    for e in entries:
+        what = e.get("reason") if e.get("mode") == "suppressed" \
+            else e.get("action")
+        out = e.get("outcome")
+        if out:
+            what = "%s -> %s" % (what, out)
+        lines.append("%-22s %8s %-10s %-20s %s"
+                     % (str(e.get("rule"))[:22], e.get("step", "?"),
+                        e.get("mode", "?"),
+                        str(e.get("reflex"))[:20], what))
+    return lines
+
+
 def _render_health(health):
     lines = ["", "Numerics health (device-resident NaN/Inf monitor)"]
     if not health or (not health.get("enabled")
@@ -737,6 +789,7 @@ def reset():
     accounting must survive a counter reset; use
     ``device_memory.reset()`` to drop that too.  Latency histograms
     are pure counters and reset with everything else."""
+    from . import autopilot as _autopilot
     from . import metrics_timeline as _metrics_timeline
     from .log import reset_rate_limits
 
@@ -746,6 +799,7 @@ def reset():
     _histogram.reset()
     _stepstats.reset()
     _metrics_timeline.reset()
+    _autopilot.reset()
     reset_rate_limits("recompile-storm:")
 
 
@@ -778,6 +832,14 @@ def diag_snapshot(top=20):
     tl = _metrics_timeline.timeline()
     if tl:
         out["timeline"] = tl
+    # the autopilot's action ledger rides the same way (top-level, not
+    # inside "snapshot": its entries are audit records, not numeric
+    # series for compare() to flatten)
+    from . import autopilot as _autopilot
+
+    ap = _autopilot.ledger_section()
+    if ap.get("enabled") or ap.get("entries"):
+        out["autopilot"] = ap
     return out
 
 
@@ -917,6 +979,11 @@ from . import xray as _xray  # noqa: E402
 
 _xray._activate_from_env()
 _stackdump._activate_from_env()
+# the observability autopilot (MXNET_TPU_AUTOPILOT=1) arms last: its
+# reflexes read every layer raised above
+from . import autopilot as _autopilot  # noqa: E402
+
+_autopilot._activate_from_env()
 
 
 # -------------------------------------------------- cluster aggregation
@@ -1373,6 +1440,12 @@ def main(argv=None):
                   % (data["reason"], data.get("pid", "?")))
         print("\n".join(_canonical._render_health(health)))
         return 0
+    # the action ledger rides the dump top-level (like the timeline):
+    # merge it into the rendered view so the audit trail prints too
+    ap = data.get("autopilot")
+    if ap and "autopilot" not in snap:
+        snap = dict(snap)
+        snap["autopilot"] = ap
     print(_canonical._render(snap, top=args.top))
     storms = data.get("recent_storm_keys") or {}
     print()

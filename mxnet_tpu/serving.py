@@ -72,6 +72,7 @@ import time
 
 import numpy as np
 
+from . import autopilot as _autopilot
 from . import device_memory as _dm
 from . import health as _health
 from . import histogram as _histogram
@@ -416,11 +417,19 @@ class InferenceServer:
         self.stats = {"requests": 0, "samples": 0, "batches": 0,
                       "padded_rows": 0, "rejected_queue": 0,
                       "rejected_nonfinite": 0, "rejected_shape": 0,
-                      "bucket_compiles": 0,
+                      "bucket_compiles": 0, "knob_adjusts": 0,
                       "per_bucket": {b: {"batches": 0, "samples": 0}
                                      for b in self.buckets},
                       "first_batch_t": None, "last_batch_t": None}
         self._rejections: collections.deque = collections.deque(maxlen=64)
+        # runtime knob-adjust audit trail (set_workers/set_max_wait_ms/
+        # set_max_queue); mutated under _stats_lock
+        self._adjustments: collections.deque = collections.deque(
+            maxlen=32)
+        # live worker-thread count, mutated under _batch_cond: grown by
+        # set_workers spawning, shrunk by idle workers retiring when it
+        # exceeds num_workers
+        self._worker_count = 0
         self._batch_seq = 0
         # serving is an observability-first surface: latency percentiles
         # ARE the product, so raise the histogram layer unless the env
@@ -447,6 +456,8 @@ class InferenceServer:
                              name="mxtpu-serve-batcher", daemon=True)
         t.start()
         self._threads = [t]
+        with self._batch_cond:
+            self._worker_count = self.num_workers
         for i in range(self.num_workers):
             w = threading.Thread(target=self._worker_loop,
                                  name="mxtpu-serve-worker-%d" % i,
@@ -660,6 +671,11 @@ class InferenceServer:
         while True:
             with self._batch_cond:
                 while not self._batchq:
+                    if self._worker_count > self.num_workers:
+                        # shrunk via set_workers: surplus workers
+                        # retire when idle (never mid-batch)
+                        self._worker_count -= 1
+                        return
                     if self._stopping and self._batcher_done():
                         return
                     self._batch_cond.wait(timeout=0.1)
@@ -817,6 +833,11 @@ class InferenceServer:
                 "e2e_ms": sum(e2es) / len(e2es) * 1e3 if e2es else None,
                 "queue_depth": self._queued_samples,
                 "live_bytes": _dm.live_totals()[0]})
+        # observability autopilot serving seam: gated reflexes over the
+        # live serving stats, AFTER this batch's accounting committed.
+        # Disabled: one dict read.
+        if _autopilot._state["on"]:
+            _autopilot.on_serve(self)
 
     # ------------------------------------------------------- JSONL export
     def _write_metrics(self, sample):
@@ -862,6 +883,70 @@ class InferenceServer:
             except OSError:
                 pass
 
+    # -------------------------------------------------------- runtime knobs
+    def _note_adjust(self, knob, old, new):
+        rec = {"t": time.time(), "knob": knob, "old": old, "new": new}
+        with self._stats_lock:
+            self.stats["knob_adjusts"] += 1
+            self._adjustments.append(rec)
+        _rts.inc("serve_knob_adjusts")
+
+    def set_workers(self, n):
+        """Adjust the pipeline worker count at runtime (thread-safe).
+        Growing spawns workers immediately on a running server;
+        shrinking lets surplus workers retire at their next idle wait
+        (a worker never abandons a batch mid-execution).  The batcher
+        reads ``num_workers`` fresh every iteration, so the dispatch
+        and pipeline bounds follow without a restart."""
+        n = max(1, int(n))
+        # both conditions guard reads of ``num_workers`` (the batcher's
+        # idle-worker check under _cond, the pipeline bound under
+        # _batch_cond); no other path holds the two at once, so the
+        # nested acquisition cannot deadlock
+        with self._cond, self._batch_cond:
+            old = self.num_workers
+            self.num_workers = n
+            spawn = 0
+            if self._running and not self._stopping:
+                spawn = max(0, n - self._worker_count)
+                self._worker_count += spawn
+            self._batch_cond.notify_all()
+            self._cond.notify_all()
+        for _ in range(spawn):
+            w = threading.Thread(
+                target=self._worker_loop,
+                name="mxtpu-serve-worker-%d" % len(self._threads),
+                daemon=True)
+            w.start()
+            self._threads.append(w)
+        if n != old:
+            self._note_adjust("workers", old, n)
+        return n
+
+    def set_max_wait_ms(self, ms):
+        """Adjust the batch-formation wait at runtime (thread-safe:
+        published under the batcher's condition, read fresh per
+        batch)."""
+        ms = max(0.0, float(ms))
+        with self._cond:
+            old = self.max_wait * 1e3
+            self.max_wait = ms / 1e3
+            self._cond.notify_all()
+        if ms != old:
+            self._note_adjust("max_wait_ms", round(old, 3),
+                              round(ms, 3))
+        return ms
+
+    def set_max_queue(self, n):
+        """Adjust the queued-sample bound (the load-shed threshold) at
+        runtime (thread-safe: ``submit`` reads it fresh per request)."""
+        n = max(1, int(n))
+        old = self.max_queue
+        self.max_queue = n
+        if n != old:
+            self._note_adjust("max_queue", old, n)
+        return n
+
     # ----------------------------------------------------------- read side
     def queue_depth(self):
         """Currently queued samples (accepted, not yet batched)."""
@@ -898,6 +983,8 @@ class InferenceServer:
                "per_bucket": {str(b): v for b, v in per_bucket.items()
                               if v["batches"]},
                "qps": qps,
+               "knob_adjusts": s["knob_adjusts"],
+               "adjustments": list(self._adjustments)[-8:],
                "rejections": list(self._rejections)[-16:]}
         mean_occ = None
         if s["batches"]:

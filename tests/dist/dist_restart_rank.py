@@ -1,0 +1,85 @@
+"""Worker half of the launch.py restart_rank supervisor drill
+(tests/test_autopilot.py::test_launch_supervisor_honors_restart_rank).
+
+Deliberately jax-free and mxnet_tpu-free (raw sockets, the same
+length-prefixed-pickle wire the autopilot's restart reflex reaches the
+PS with), so both incarnations start in milliseconds and the test
+times the SUPERVISOR, not two interpreter warmups.
+
+First incarnation (no flag file yet): write the flag, park a
+``restart_rank`` request for our own rank on shard 0, then sleep — the
+supervisor must terminate and relaunch us.  Second incarnation (flag
+present): print the proof line, stop the servers, exit 0.
+"""
+
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+import time
+
+
+def _call(port, msg, deadline_s=120.0):
+    """One request/reply roundtrip, retrying the connect: this script
+    starts in milliseconds while the PS server is still importing its
+    interpreter-heavy world, so the first connects may be refused."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            return _call_once(port, msg)
+        except (ConnectionError, OSError):
+            if time.monotonic() - t0 > deadline_s:
+                raise
+            time.sleep(0.2)
+
+
+def _call_once(port, msg):
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+        s.sendall(struct.pack(">Q", len(payload)) + payload)
+        head = b""
+        while len(head) < 8:
+            chunk = s.recv(8 - len(head))
+            if not chunk:
+                raise ConnectionError("server closed mid-header")
+            head += chunk
+        (n,) = struct.unpack(">Q", head)
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(min(1 << 16, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("server closed mid-payload")
+            buf += chunk
+    return pickle.loads(buf)
+
+
+def main():
+    ports = [int(p) for p in os.environ["MXTPU_PS_PORTS"].split(",")]
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    flag = os.environ["MXTPU_RESTART_FLAG"]
+    if not os.path.exists(flag):
+        with open(flag, "w") as f:
+            f.write("first incarnation pid %d\n" % os.getpid())
+        body = json.dumps({"rank": rank, "reason": "restart drill"})
+        reply = _call(ports[0], ("command", "restart_rank", body))
+        assert reply[0] == "ok", reply
+        assert json.loads(reply[1])["parked"] is True, reply
+        print("dist_restart_rank: parked restart_rank for rank %d"
+              % rank, flush=True)
+        # wait for the supervisor's SIGTERM; exiting on our own would
+        # test nothing
+        time.sleep(120)
+        print("dist_restart_rank: supervisor never relaunched us",
+              flush=True)
+        sys.exit(1)
+    print("RESTARTED OK (rank %d relaunched by the supervisor)" % rank,
+          flush=True)
+    for port in ports:
+        _call(port, ("stop",))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,761 @@
+"""Observability autopilot (mxnet_tpu/autopilot.py): gated, audited
+reflexes closing the doctor->action loop.
+
+Pins the PR's acceptance drills: each provoked condition (induced
+device-memory leak, recompile storm, kv-RTT straggler via an injected
+server delay, queue-saturated serving run, first-NaN) triggers exactly
+its own reflex — a real action with the gate armed, a logged intent in
+dry-run (the default when only the master switch is on), complete
+silence with the gate off — plus the hysteresis (cooldown and
+max-actions suppression), the append-only ledger riding diag dumps
+through ``tools/diagnose.py --autopilot`` (rc 2 on a ledger-free dump,
+matching ``--serving``/``--xray``), the ``report()`` rendering, and
+the Prometheus doctor-gauge/autopilot-counter exports.  Docs:
+docs/OBSERVABILITY.md "Autopilot".
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, autopilot, checkpoint, device_memory
+from mxnet_tpu import gluon, health, histogram, metrics_timeline
+from mxnet_tpu import perfdoctor, profiler, runtime_stats, serving
+from mxnet_tpu import stepstats
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ops import registry
+from mxnet_tpu.serving import InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_autopilot(monkeypatch):
+    """Every test starts and ends with the reflex engine (and every
+    layer it reads or actuates) off and empty, and no ambient
+    ``MXNET_TPU_AUTOPILOT*`` env leaking gate modes into the drills."""
+    for var in list(os.environ):
+        if var.startswith("MXNET_TPU_AUTOPILOT"):
+            monkeypatch.delenv(var, raising=False)
+    autopilot.disable()
+    metrics_timeline.disable()
+    runtime_stats.reset()  # also resets timeline/histograms/autopilot
+    registry.clear_bucket_hints()
+    yield
+    autopilot.disable()
+    checkpoint.reset()
+    profiler.set_kvstore_handle(None)
+    for srv in serving.servers():
+        srv.stop(drain=False, timeout=5.0)
+    serving.reset()
+    metrics_timeline.disable()
+    runtime_stats.reset()
+    registry.clear_bucket_hints()
+    stepstats.disable()
+    histogram.disable()
+    health.reset()
+    device_memory.stop()
+    device_memory.reset()
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def _leak_ring(n=40):
+    """A synthetic timeline ring with the leak signature (~64 KB/step,
+    monotonic) — the same shape test_metrics_timeline's trend tests
+    feed perfdoctor."""
+    metrics_timeline._ring.clear()
+    metrics_timeline._ring.extend(
+        {"step": i, "wall_ms": 10.0,
+         "live_bytes": 10_000_000 + i * 65536} for i in range(2, 2 + n))
+
+
+def _tiny_trainer(prefix="ap_"):
+    net = nn.Dense(3, prefix=prefix)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 5))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    return tr
+
+
+def _entries(reflex=None):
+    out = autopilot.ledger()
+    if reflex is not None:
+        out = [e for e in out if e["reflex"] == reflex]
+    return out
+
+
+# ------------------------------------------------------------ gate modes
+
+
+def test_disabled_engine_is_one_guarded_noop():
+    """Engine off (the default): the seams record nothing, even with a
+    live finding in the ring."""
+    _leak_ring()
+    assert not autopilot.is_enabled()
+    autopilot.on_step(None)
+    autopilot.on_serve(None)
+    sec = autopilot.ledger_section()
+    assert sec["entries"] == []
+    assert sec["counters"]["evals"] == 0
+
+
+def test_dry_run_default_ledgers_and_logs_but_never_acts(tmp_path,
+                                                         monkeypatch):
+    """Master switch on, per-reflex gate unset -> dry-run: the reflex
+    evaluates, logs the would-be action, and ledgers it — but a live
+    checkpoint manager writes NOTHING."""
+    monkeypatch.delenv("MXNET_TPU_AUTOPILOT_CKPT", raising=False)
+    tr = _tiny_trainer(prefix="apdry_")
+    checkpoint.enable(str(tmp_path), interval=10 ** 6, async_write=False)
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0)
+    handler = _CaptureHandler()
+    logger = autopilot._logger()
+    logger.addHandler(handler)
+    try:
+        autopilot.on_step(tr)
+    finally:
+        logger.removeHandler(handler)
+    fired = _entries("force-checkpoint")
+    assert fired and fired[-1]["mode"] == "dry_run"
+    assert fired[-1]["rule"] == "timeline-leak"
+    assert "MXNET_TPU_AUTOPILOT_CKPT" in fired[-1]["reason"]
+    # the projection a human can act on rides the dry-run entry too
+    assert "projected" in fired[-1]["action"]
+    assert not [p for p in os.listdir(str(tmp_path))
+                if p.startswith("ckpt")], \
+        "dry-run must never write a checkpoint"
+    msgs = [r.getMessage() for r in handler.records]
+    assert any("dry-run" in m and "would:" in m for m in msgs)
+    assert runtime_stats.snapshot()["counters"]["autopilot_dry_run"] >= 1
+
+
+def test_gate_off_is_complete_silence(tmp_path):
+    """Gate env ``0``: no action, no ledger entry, no log — the off
+    state leaves no trace beyond the eval counter."""
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0,
+                     gates={"force-checkpoint": "off"})
+    autopilot.on_step(None)
+    assert _entries("force-checkpoint") == []
+    counters = autopilot.ledger_section()["counters"]
+    assert counters["evals"] == 1
+    assert counters["fired"] == counters["dry_run"] == 0
+
+
+def test_enable_reads_envs_and_rejects_bad_gates(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT_INTERVAL", "7")
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT_COOLDOWN", "9.5")
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT_CKPT", "1")
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT_BUCKET", "0")
+    monkeypatch.delenv("MXNET_TPU_AUTOPILOT_RESTART", raising=False)
+    cfg = autopilot.enable()
+    assert cfg["interval"] == 7 and cfg["cooldown"] == 9.5
+    assert cfg["gates"]["force-checkpoint"] == "armed"
+    assert cfg["gates"]["pin-bucket"] == "off"
+    assert cfg["gates"]["restart-rank"] == "dry_run"
+    with pytest.raises(mx.MXNetError):
+        autopilot.enable(gates={"bogus-reflex": "armed"})
+    with pytest.raises(mx.MXNetError):
+        autopilot.enable(gates={"pin-bucket": "sometimes"})
+    # master-switch env path
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT", "1")
+    autopilot.disable()
+    autopilot._activate_from_env()
+    assert autopilot.is_enabled()
+
+
+def test_interval_downsamples_evaluations():
+    autopilot.enable(interval=4, cooldown=0.0)
+    for _ in range(7):
+        autopilot.on_step(None)
+    assert autopilot.ledger_section()["counters"]["evals"] == 1
+    autopilot.on_step(None)
+    assert autopilot.ledger_section()["counters"]["evals"] == 2
+
+
+# ------------------------------------------------------------ hysteresis
+
+
+def test_cooldown_suppresses_second_firing_with_reason():
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=3600.0)
+    autopilot.on_step(None)
+    autopilot.on_step(None)
+    ent = _entries("force-checkpoint")
+    assert [e["mode"] for e in ent] == ["dry_run", "suppressed"]
+    assert "cooldown" in ent[-1]["reason"]
+    assert runtime_stats.snapshot()["counters"][
+        "autopilot_suppressed"] >= 1
+
+
+def test_max_actions_cap_suppresses_with_reason():
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0, max_actions=1)
+    autopilot.on_step(None)
+    autopilot.on_step(None)
+    ent = _entries("force-checkpoint")
+    assert [e["mode"] for e in ent] == ["dry_run", "suppressed"]
+    assert "max-actions cap (1)" in ent[-1]["reason"]
+    # reset() re-opens the budget (a fresh "run")
+    autopilot.reset()
+    _leak_ring()
+    autopilot.on_step(None)
+    assert _entries("force-checkpoint")[-1]["mode"] == "dry_run"
+
+
+# ------------------------------------------- reflex: force-checkpoint
+
+
+def test_leak_reflex_armed_forces_checkpoint_with_projection(tmp_path):
+    tr = _tiny_trainer(prefix="apleak_")
+    checkpoint.enable(str(tmp_path), interval=10 ** 6, async_write=False)
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0,
+                     gates={"force-checkpoint": "armed"})
+    autopilot.on_step(tr)
+    ent = _entries("force-checkpoint")
+    assert ent and ent[-1]["mode"] == "fired"
+    assert ent[-1]["outcome"]["saved"] is True
+    assert any("projected exhaustion" in ev for ev in ent[-1]["evidence"])
+    ckpts = [p for p in os.listdir(str(tmp_path)) if p.startswith("ckpt")]
+    assert ckpts, "armed leak reflex must write a real checkpoint"
+    assert runtime_stats.snapshot()["counters"]["autopilot_fired"] >= 1
+
+
+def test_leak_reflex_without_manager_records_graceful_outcome():
+    """Armed but checkpointing disabled: the action runs, can't save,
+    and the ledger says exactly why instead of crashing the step."""
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0,
+                     gates={"force-checkpoint": "armed"})
+    autopilot.on_step(None)
+    out = _entries("force-checkpoint")[-1]["outcome"]
+    assert out["saved"] is False and "disabled" in out["reason"]
+
+
+def test_leak_drill_end_to_end_through_trainer_seam(tmp_path):
+    """THE leak acceptance drill, through the real seam: a Gluon loop
+    retaining ~256 KB of fresh NDArray per step -> the timeline ring
+    carries the growth -> ``Trainer.step``'s telemetry tail evaluates
+    the autopilot -> the ARMED reflex checkpoints before the projected
+    OOM."""
+    device_memory.start()  # live_bytes feeds the timeline samples
+    metrics_timeline.enable(interval=1)
+    checkpoint.enable(str(tmp_path), interval=10 ** 6, async_write=False)
+    autopilot.enable(interval=8, cooldown=0.0,
+                     gates={"force-checkpoint": "armed"})
+    net = nn.Dense(4, prefix="ape2e_")
+    net.initialize(ctx=mx.cpu())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    rs = np.random.RandomState(0)
+    retained = []  # the induced leak
+    for _ in range(40):
+        x = mx.nd.array(rs.rand(2, 6).astype(np.float32))
+        y = mx.nd.array(rs.randint(0, 4, (2,)).astype(np.float32))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        retained.append(mx.nd.ones((256, 256)))
+        tr.step(2)
+    ent = _entries("force-checkpoint")
+    assert ent and ent[-1]["mode"] == "fired", \
+        "the leak reflex must trip from the live training seam"
+    assert ent[-1]["outcome"]["saved"] is True
+    assert [p for p in os.listdir(str(tmp_path)) if p.startswith("ckpt")]
+    # exactly its own reflex: nothing else fired on this run
+    assert {e["reflex"] for e in _entries() if e["mode"] == "fired"} \
+        == {"force-checkpoint"}
+    del retained
+
+
+# ------------------------------------------------ reflex: pin-bucket
+
+
+def _register_probe(name):
+    def fn(x, width=1):
+        return x * width
+
+    registry.register(name, width=1)(fn)
+    return fn
+
+
+def test_storm_reflex_installs_bucket_hint_and_stops_storm(monkeypatch):
+    """THE recompile-storm acceptance drill: an int attr churned past
+    the storm threshold -> the ARMED reflex installs a registry-level
+    pad-to-bucket ladder on the churning attr -> subsequent values
+    collapse onto the ladder and the storm STOPS (at most one new
+    compile), with hysteresis against re-firing on the cumulative
+    counters."""
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(runtime_stats, "STORM_THRESHOLD", 4)
+    op = "_autopilot_probe_pad"
+    _register_probe(op)
+    try:
+        x = jnp.ones((2,))
+        for w in range(2, 12):  # 10 distinct cache keys: the storm
+            registry.apply_op(op, x, width=w)
+        autopilot.enable(interval=1, cooldown=0.0,
+                         gates={"pin-bucket": "armed"})
+        autopilot.on_step(None)
+        ent = _entries("pin-bucket")
+        assert ent and ent[-1]["mode"] == "fired"
+        assert ent[-1]["rule"] == "recompile-storm"
+        installed = ent[-1]["outcome"]["installed"]
+        assert "width" in installed
+        hints = registry.bucket_hints()
+        assert list(hints[op]) == ["width"]
+        compiles_before = runtime_stats.snapshot()["storms"][op][
+            "compiles"]
+        for w in range(2, 12):  # the same churn, now bucketed
+            registry.apply_op(op, x, width=w)
+        grew = runtime_stats.snapshot()["storms"][op]["compiles"] \
+            - compiles_before
+        assert grew <= 1, \
+            "bucketed churn must collapse onto the ladder (got %d " \
+            "fresh compiles)" % grew
+        assert runtime_stats.snapshot()["counters"][
+            "bucket_hint_rounded"] >= 1
+        # hysteresis: the op is hinted — the cumulative storm counters
+        # must not re-fire the reflex forever
+        autopilot.on_step(None)
+        assert len(_entries("pin-bucket")) == len(ent)
+    finally:
+        registry._OP_REGISTRY.pop(op, None)
+
+
+def test_storm_quiet_and_dry_run_pair(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setattr(runtime_stats, "STORM_THRESHOLD", 4)
+    # quiet: no storm, no entry
+    autopilot.enable(interval=1, cooldown=0.0)
+    autopilot.on_step(None)
+    assert _entries("pin-bucket") == []
+    # dry-run: storm named, ladder proposed, NOTHING installed
+    op = "_autopilot_probe_dry"
+    _register_probe(op)
+    try:
+        x = jnp.ones((2,))
+        for w in range(2, 12):
+            registry.apply_op(op, x, width=w)
+        autopilot.on_step(None)
+        ent = _entries("pin-bucket")
+        assert ent and ent[-1]["mode"] == "dry_run"
+        assert "width" in ent[-1]["action"]
+        assert registry.bucket_hints() == {}
+    finally:
+        registry._OP_REGISTRY.pop(op, None)
+
+
+def test_registry_bucket_hint_unit():
+    """The registry half of the reflex, in isolation: install/round/
+    clear semantics of the pad-to-bucket hint."""
+    op = "_autopilot_probe_unit"
+    _register_probe(op)
+    try:
+        ladder = registry.install_bucket_hint(op, "width", (8, 16))
+        assert ladder == (8, 16)
+        o = registry.get(op)
+        assert o.canonicalize_attrs({"width": 5})["width"] == 8
+        assert o.canonicalize_attrs({"width": 9})["width"] == 16
+        assert o.canonicalize_attrs({"width": 16})["width"] == 16
+        # past the top rung: next multiple of the top rung
+        assert o.canonicalize_attrs({"width": 100})["width"] == 112
+        # bools and non-ints are never rounded
+        assert o.canonicalize_attrs({"width": True})["width"] is True
+        assert o.canonicalize_attrs({"width": 2.5})["width"] == 2.5
+        with pytest.raises(mx.MXNetError):
+            registry.install_bucket_hint(op, "width", (0, 8))
+        registry.clear_bucket_hints()
+        assert registry.bucket_hints() == {}
+        assert o.canonicalize_attrs({"width": 5})["width"] == 5
+    finally:
+        registry._OP_REGISTRY.pop(op, None)
+
+
+# ---------------------------------------------- reflex: restart-rank
+
+
+def test_straggler_reflex_parks_restart_on_shard0(ps_server, monkeypatch):
+    """THE straggler acceptance drill: real dist_async pushes, a
+    mid-run ``delay`` fault injected on the live shard -> the kv-RTT
+    windowed p99 drifts past the doctor threshold -> the ARMED reflex
+    parks a ``restart_rank`` request on shard 0, drained exactly once
+    via the ``restart_poll`` head the launch.py supervisor uses."""
+    from mxnet_tpu.kvstore import ps as ps_mod
+
+    kv = mx.kv.create("dist_async")
+    try:
+        profiler.set_kvstore_handle(kv)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        kv.init("w", mx.nd.ones((2, 2)))
+        for _ in range(3):
+            # unobserved warmup: the first pushes pay the server-side
+            # optimizer-apply warmup (~60ms) and would poison the
+            # early-window baseline the drift rule compares against
+            kv.push("w", mx.nd.ones((2, 2)))
+        metrics_timeline.enable(interval=1)
+        metrics_timeline.on_step()  # arm the window clock
+        for win in range(14):
+            if win == 7:
+                # the injected straggler: every later message crawls
+                ps_server._fault = ps_mod.parse_fault_spec("delay:0.02")
+            for _ in range(3):
+                kv.push("w", mx.nd.ones((2, 2)))
+            metrics_timeline.on_step()
+        autopilot.enable(interval=1, cooldown=0.0,
+                         gates={"restart-rank": "armed"})
+        autopilot.on_step(None)
+        ent = _entries("restart-rank")
+        assert ent and ent[-1]["mode"] == "fired"
+        assert ent[-1]["rule"] == "timeline-kv-drift"
+        assert ent[-1]["outcome"] == {"requested": True, "rank": 0}
+        # exactly its own reflex
+        assert {e["reflex"] for e in _entries()} == {"restart-rank"}
+        parked = json.loads(kv._client.command_shard(0, "restart_poll"))
+        assert [r["rank"] for r in parked] == [0]
+        assert parked[0]["reason"].startswith("kv RTT drift")
+        # the poll drains: a second poll sees an empty queue
+        assert json.loads(
+            kv._client.command_shard(0, "restart_poll")) == []
+        assert runtime_stats.snapshot()["counters"][
+            "kvstore_restart_requests"] == 1
+    finally:
+        ps_server._fault = None
+        profiler.set_kvstore_handle(None)
+        kv._client.close()
+
+
+def test_restart_reflex_without_kvstore_is_graceful():
+    """Armed, drifting ring, but no registered kvstore handle (a
+    single-process run): the action records why it could not act."""
+    metrics_timeline._ring.clear()
+    metrics_timeline._ring.extend(
+        {"step": i, "wall_ms": 10.0,
+         "kv_rtt_ms": {"kv:push_rtt:shard1":
+                       {"p99_ms": 1.0 + (i * 0.5 if i >= 20 else 0.0),
+                        "count": 4}}}
+        for i in range(2, 42))
+    autopilot.enable(interval=1, cooldown=0.0,
+                     gates={"restart-rank": "armed"})
+    autopilot.on_step(None)
+    out = _entries("restart-rank")[-1]["outcome"]
+    assert out["requested"] is False and "no kvstore handle" in \
+        out["reason"]
+
+
+# ------------------------------------------------- reflex: serve-tune
+
+
+def _slow_server(sleep_s=0.005, max_queue=256):
+    def slow_model(inputs, bucket):
+        time.sleep(sleep_s)
+        return [inputs["data"]]
+
+    return InferenceServer(slow_model, input_shapes={"data": (3,)},
+                           buckets=(1, 2, 4), workers=1,
+                           max_queue=max_queue)
+
+
+def _saturate(srv, n=48):
+    futs = [srv.submit(np.zeros((1, 3), np.float32)) for _ in range(n)]
+    for f in futs:
+        f.result(30.0)
+
+
+def test_serve_reflex_armed_nudges_knobs_within_bounds(monkeypatch):
+    """THE serving acceptance drill: one slow worker, 48 queued
+    requests -> queue-wait p99 dominates batch p99 -> the ARMED reflex
+    nudges the live knobs (workers up toward the cap, max-wait up,
+    queue bound down toward the floor), audited in the server's own
+    adjustment trail."""
+    monkeypatch.setenv("MXNET_TPU_AUTOPILOT_SERVE_MAX_WORKERS", "2")
+    autopilot.enable(interval=1, cooldown=0.0, max_actions=3,
+                     gates={"serve-tune": "armed"})
+    srv = _slow_server()
+    wait0, queue0 = srv.max_wait, srv.max_queue
+    with srv:
+        _saturate(srv)
+    ent = _entries("serve-tune")
+    assert ent, "saturation must trip the serve reflex"
+    fired = [e for e in ent if e["mode"] == "fired"]
+    assert fired and fired[0]["rule"] == "serve-queue-dominated"
+    assert srv.num_workers == 2, "worker count must stop at the cap"
+    assert srv.max_wait > wait0
+    assert srv.max_queue < queue0
+    snap = srv.snapshot()
+    assert snap["knob_adjusts"] >= 1 and snap["adjustments"]
+    assert {a["knob"] for a in snap["adjustments"]} >= {"workers"}
+    # exactly its own reflex
+    assert {e["reflex"] for e in _entries()} == {"serve-tune"}
+
+
+def test_serve_reflex_dry_run_and_quiet_pair():
+    autopilot.enable(interval=1, cooldown=0.0)  # gates: dry-run default
+    srv = _slow_server()
+    wait0, queue0 = srv.max_wait, srv.max_queue
+    with srv:
+        _saturate(srv)
+    ent = _entries("serve-tune")
+    assert ent and all(e["mode"] == "dry_run" for e in ent[:1])
+    assert srv.num_workers == 1 and srv.max_wait == wait0 \
+        and srv.max_queue == queue0, "dry-run must not touch a knob"
+    assert srv.snapshot()["knob_adjusts"] == 0
+    # quiet pair: a light load never trips the rule
+    autopilot.reset()
+    runtime_stats.reset()
+    srv2 = _slow_server(sleep_s=0.0)
+    with srv2:
+        _saturate(srv2, n=8)
+    assert _entries("serve-tune") == []
+
+
+def test_serving_runtime_knob_setters_unit():
+    """Satellite: the thread-safe runtime setters in isolation —
+    clamping, live worker growth and idle-retirement, the audited
+    adjustment counters."""
+    srv = _slow_server(sleep_s=0.0)
+    with srv:
+        srv.set_workers(3)
+        assert srv.num_workers == 3
+        deadline = time.time() + 5.0
+        while srv._worker_count < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv._worker_count == 3
+        srv.infer(np.ones((2, 3), np.float32))
+        srv.set_workers(0)  # clamps to 1; surplus workers retire idle
+        assert srv.num_workers == 1
+        deadline = time.time() + 5.0
+        while srv._worker_count > 1 and time.time() < deadline:
+            with srv._batch_cond:
+                srv._batch_cond.notify_all()
+            time.sleep(0.01)
+        assert srv._worker_count == 1
+        # the shrunken pool still serves
+        out = srv.infer(np.ones((2, 3), np.float32))
+        assert out[0].shape == (2, 3)
+        srv.set_max_wait_ms(12.5)
+        assert srv.max_wait == pytest.approx(0.0125)
+        srv.set_max_wait_ms(-3.0)
+        assert srv.max_wait == 0.0
+        srv.set_max_queue(7)
+        assert srv.max_queue == 7
+        srv.set_max_queue(0)
+        assert srv.max_queue == 1
+        snap = srv.snapshot()
+        assert snap["knob_adjusts"] >= 5
+        for a in snap["adjustments"]:
+            assert set(a) == {"t", "knob", "old", "new"}
+    assert runtime_stats.snapshot()["counters"][
+        "serve_knob_adjusts"] >= 5
+
+
+# ------------------------------------- reflex: halt-after-checkpoint
+
+
+def _seed_first_nan(step=7):
+    health.enable(interval=1)
+    mon = health.monitor()
+    mon.first_nan = {"step": step, "key": "dense0_weight",
+                     "nan_total": 3, "inf_total": 0}
+
+
+def test_nan_reflex_armed_checkpoints_then_halts(tmp_path):
+    tr = _tiny_trainer(prefix="apnan_")
+    checkpoint.enable(str(tmp_path), interval=10 ** 6, async_write=False)
+    _seed_first_nan()
+    autopilot.enable(interval=1, cooldown=0.0,
+                     gates={"halt-after-checkpoint": "armed"})
+    with pytest.raises(autopilot.AutopilotHalt, match="checkpoint "
+                                                      "submitted"):
+        autopilot.on_step(tr)
+    ent = _entries("halt-after-checkpoint")
+    assert ent and ent[-1]["mode"] == "fired"
+    assert ent[-1]["rule"] == "first-nan"
+    assert "halt" in ent[-1]["outcome"]
+    assert [p for p in os.listdir(str(tmp_path)) if p.startswith("ckpt")]
+    # once per incident: the memoed step must not re-halt forever
+    autopilot.on_step(tr)
+    assert len(_entries("halt-after-checkpoint")) == len(ent)
+
+
+def test_nan_reflex_dry_run_never_raises(tmp_path):
+    _seed_first_nan(step=9)
+    autopilot.enable(interval=1, cooldown=0.0)
+    autopilot.on_step(None)  # must NOT raise
+    ent = _entries("halt-after-checkpoint")
+    assert ent and ent[-1]["mode"] == "dry_run"
+    assert "halt" in ent[-1]["action"]
+
+
+# ------------------------------------------- ledger / diag / report
+
+
+def _dump_with_ledger(tmp_path):
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0)
+    autopilot.on_step(None)
+    return runtime_stats.dump_diag(str(tmp_path / "ap_diag.json"))
+
+
+def test_ledger_rides_diag_dump_and_diagnose_cli(tmp_path):
+    """Satellite: dump -> ``diagnose.py --autopilot`` roundtrip (rc 0
+    with a ledger, rc 2 without — matching ``--serving``/``--xray``)."""
+    path = _dump_with_ledger(tmp_path)
+    with open(path) as f:
+        data = json.load(f)
+    ap = data["autopilot"]  # TOP-level, beside "timeline"
+    assert ap["enabled"] and ap["entries"]
+    assert ap["entries"][-1]["reflex"] == "force-checkpoint"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--autopilot", "--diag", path],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Observability Autopilot" in out.stdout
+    assert "timeline-leak" in out.stdout
+    assert "force-checkpoint" in out.stdout
+
+
+def test_diagnose_cli_autopilot_ledger_free_dump_exits_2(tmp_path):
+    path = str(tmp_path / "empty.json")
+    with open(path, "w") as f:
+        json.dump({"snapshot": {"counters": {}}}, f)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--autopilot", "--diag", path],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "MXNET_TPU_AUTOPILOT" in out.stdout
+
+
+def test_report_renders_gates_counters_and_ledger(tmp_path):
+    _dump_with_ledger(tmp_path)
+    rpt = runtime_stats.report()
+    assert "Observability autopilot" in rpt
+    assert "timeline-leak" in rpt
+    assert "force-checkpoint" in rpt
+    assert "dry_run" in rpt
+    assert "gates:" in rpt
+
+
+def test_ledger_is_bounded_and_reset_drops_it():
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0, max_actions=10 ** 6)
+    for _ in range(autopilot.LEDGER_CAP + 20):
+        autopilot.on_step(None)
+    assert len(autopilot.ledger()) == autopilot.LEDGER_CAP
+    autopilot.reset()
+    assert autopilot.ledger() == []
+    assert autopilot.is_enabled(), "reset keeps the engine armed"
+
+
+# ------------------------------------------------------- prometheus
+
+
+def test_prometheus_doctor_gauges_and_autopilot_counters():
+    """Satellite: live findings export as the
+    ``mxnet_tpu_doctor_finding{rule,severity}`` gauge family (score as
+    value, absent series = quiet rule) and the autopilot decision
+    counters ride the generic counter export."""
+    quiet = metrics_timeline.prometheus_text()
+    assert "mxnet_tpu_doctor_finding" not in quiet
+    _leak_ring()
+    autopilot.enable(interval=1, cooldown=0.0)
+    autopilot.on_step(None)
+    text = metrics_timeline.prometheus_text()
+    assert "# TYPE mxnet_tpu_doctor_finding gauge" in text
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("mxnet_tpu_doctor_finding{")]
+    assert any('rule="timeline-leak"' in ln
+               and 'severity="warn"' in ln for ln in line)
+    score = float([ln for ln in line
+                   if 'rule="timeline-leak"' in ln][0].split()[-1])
+    assert score == pytest.approx(0.5)
+    assert "mxnet_tpu_autopilot_evals_total" in text
+    assert "mxnet_tpu_autopilot_dry_run_total" in text
+
+
+def test_live_findings_never_raises_and_ranks():
+    _leak_ring()
+    findings = perfdoctor.live_findings()
+    assert findings and findings[0]["rule"] == "timeline-leak"
+    scores = [f["score"] for f in findings]
+    assert scores == sorted(scores, reverse=True)
+    # an empty world is an empty list, not an exception
+    metrics_timeline._ring.clear()
+    runtime_stats.reset()
+    assert perfdoctor.live_findings() == []
+
+
+# -------------------------------------------- launch.py supervisor
+
+
+def test_launch_supervisor_honors_restart_rank(tmp_path):
+    """End-to-end: a worker parks ``restart_rank`` on shard 0 (raw
+    sockets, exactly what the reflex sends) -> the ``launch.py``
+    supervisor polls ``restart_poll`` and relaunches that worker with
+    its original env -> the second incarnation proves it restarted and
+    stops the servers cleanly."""
+    script = os.path.join(REPO, "tests", "dist", "dist_restart_rank.py")
+    launch = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+              "-n", "1", "-s", "1", sys.executable, script]
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    for var in ("MXNET_TPU_FAULT", "MXNET_TPU_PS_CKPT",
+                "MXNET_TPU_PROFILE", "MXNET_TPU_DIAG"):
+        env.pop(var, None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MXNET_TPU_SUPERVISE": "2",
+                "MXTPU_RESTART_FLAG": str(tmp_path / "incarnation")})
+    r = subprocess.run(launch, env=env, capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "supervisor: restart_rank worker 0" in r.stdout, \
+        r.stdout + r.stderr
+    assert "RESTARTED OK" in r.stdout, r.stdout + r.stderr
+
+
+# --------------------------------------------------- mxlint feeds
+
+
+def test_autopilot_seams_are_registered_guard_first_feeds():
+    """Satellite: the conformance registry proves the two seams
+    statically; a registry row naming a dead function is itself a
+    finding, so this test pins the rows exist AND the pass stays
+    clean on the module."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from mxlint.conformance import DEFAULT_FEEDS
+    finally:
+        sys.path.pop(0)
+    feeds = {(m, f) for m, f, _s in DEFAULT_FEEDS}
+    assert ("mxnet_tpu.autopilot", "on_step") in feeds
+    assert ("mxnet_tpu.autopilot", "on_serve") in feeds

@@ -646,3 +646,46 @@ def test_disabled_xray_annotation_overhead_bound():
     finally:
         if was_on:
             xray.enable()
+
+
+def test_disabled_autopilot_overhead_bound():
+    """PR 17 gate: the observability autopilot must be pay-for-use.
+    With the reflex engine disabled (the default), ``autopilot.on_step``
+    and ``autopilot.on_serve`` — the hooks at the ``Trainer.step`` tail
+    and the serving accounting path — are ONE dict read each: no clock,
+    no doctor rules, no ledger entry, no counter.  Pinned like the
+    other disabled-path bounds."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import autopilot, runtime_stats
+
+    if os.environ.get("MXNET_TPU_AUTOPILOT"):
+        pytest.skip("autopilot force-enabled in this run")
+    assert not autopilot.is_enabled()
+    before = autopilot.ledger_section()
+    clock_before = autopilot._train_clock["n"]
+    base_evals = runtime_stats.snapshot()["counters"].get(
+        "autopilot_evals", 0)
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            autopilot.on_step(None)
+            autopilot.on_serve(None)
+        best = min(best, (time.perf_counter() - t0) / (2 * n_calls))
+    # the guard is one dict read (~0.1us); 10us tolerates slow shared
+    # CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "autopilot seam with engine off took %.2fus" % (best * 1e6)
+    after = autopilot.ledger_section()
+    assert after["entries"] == before["entries"], \
+        "disabled seams must record nothing"
+    assert after["counters"] == before["counters"]
+    assert autopilot._train_clock["n"] == clock_before, \
+        "disabled on_step must not even tick its clock"
+    assert runtime_stats.snapshot()["counters"].get(
+        "autopilot_evals", 0) == base_evals

@@ -55,6 +55,12 @@ def parse_args():
                         'step programs, with the unattributed remainder) '
                         'from a MXNET_TPU_DIAG dump (--diag / '
                         '$MXNET_TPU_DIAG) or from this live process.')
+    p.add_argument('--autopilot', action='store_true',
+                   help='Render only the observability-autopilot section '
+                        '(gates, decision counters, and the action ledger '
+                        'of fired/dry-run/suppressed reflexes) from a '
+                        'MXNET_TPU_DIAG dump (--diag / $MXNET_TPU_DIAG) '
+                        'or from this live process.')
     p.add_argument('--cluster', nargs='+', metavar='DUMP',
                    help='Merge several per-rank MXNET_TPU_DIAG dumps (files '
                         'or a directory of *.json) into one cluster report: '
@@ -233,6 +239,39 @@ def check_serving(diag_path=None):
         return 2
     print('\n'.join(runtime_stats._render_serving(
         serving, snap.get('histograms') or {})))
+    return 0
+
+
+def check_autopilot(diag_path=None):
+    """Autopilot view: the reflex engine's gates, decision counters,
+    and action ledger from a MXNET_TPU_DIAG dump (the ledger rides the
+    dump TOP-LEVEL, beside the timeline), or from this live process
+    when no dump is given (docs/OBSERVABILITY.md "Autopilot").  Returns
+    0, or 2 when no ledger was recorded — an autopilot drill asserting
+    on this view must not silently pass on an empty section."""
+    _section('Observability Autopilot')
+    import json
+    from mxnet_tpu import runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        with open(diag_path) as f:
+            data = json.load(f)
+        ap = data.get('autopilot') or {}
+    else:
+        if diag_path:
+            print('Diag dump    : %s (not written yet)' % diag_path)
+        from mxnet_tpu import autopilot
+        ap = autopilot.ledger_section()
+    if not ap.get('entries'):
+        print('(no autopilot ledger in this %s — enable the engine '
+              'with MXNET_TPU_AUTOPILOT=1 (reflexes dry-run by '
+              'default) and let a reflex trip; docs/OBSERVABILITY.md '
+              '"Autopilot")'
+              % ('dump' if diag_path else 'process'))
+        return 2
+    print('\n'.join(runtime_stats._render_autopilot(ap)).lstrip('\n'))
     return 0
 
 
@@ -504,6 +543,9 @@ def main():
     if args.xray:
         # focused fused-step attribution view: skip the platform sections
         sys.exit(check_xray(args.diag))
+    if args.autopilot:
+        # focused reflex-ledger view: skip the platform sections
+        sys.exit(check_autopilot(args.diag))
     if args.health:
         # focused view for numerics triage: skip the platform sections
         check_telemetry(args.diag, health_only=True)

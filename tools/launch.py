@@ -26,12 +26,24 @@ defaulted (with a per-mutation interval) so revival actually recovers
 state.  `MXNET_TPU_FAULT` is stripped from a relaunched server's env:
 the injected fault already simulated the crash it was scripted for, and
 re-arming it would just crash-loop the drill to the restart bound.
+
+The supervisor also honors WORKER relaunch requests: the observability
+autopilot's kv-straggler reflex parks `restart_rank` commands on PS
+shard 0 (mxnet_tpu/kvstore/ps.py reserved heads); the loop polls the
+shard's `restart_poll` head (~1 s cadence, raw sockets — the launcher
+never imports mxnet_tpu, so it stays jax-free) and relaunches the named
+worker with its original env, bounded by the same per-process restart
+budget.  The relaunched worker resumes through the normal
+`checkpoint.auto_resume` path.
 """
 
 import argparse
+import json
 import os
+import pickle
 import shutil
 import socket
+import struct
 import subprocess
 import sys
 import tempfile
@@ -44,6 +56,44 @@ def free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _poll_restart_requests(port, timeout=1.0):
+    """Drain parked worker-relaunch requests from the PS shard on
+    ``port`` (the ``restart_poll`` reserved head) and return them as a
+    list of ``{"rank", "reason", "t"}`` dicts.  The wire format mirrors
+    mxnet_tpu/kvstore/ps.py's length-prefixed pickle (reimplemented
+    inline: the launcher must stay importable without jax); ANY failure
+    — server busy, mid-restart, protocol surprise — returns [] and the
+    next poll tries again."""
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=timeout) as s:
+            payload = pickle.dumps(("command", "restart_poll", ""),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            s.sendall(struct.pack(">Q", len(payload)) + payload)
+            head = b""
+            while len(head) < 8:
+                chunk = s.recv(8 - len(head))
+                if not chunk:
+                    return []
+                head += chunk
+            (n,) = struct.unpack(">Q", head)
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(min(1 << 16, n - len(buf)))
+                if not chunk:
+                    return []
+                buf += chunk
+        reply = pickle.loads(buf)
+        if not (isinstance(reply, tuple) and len(reply) == 2
+                and reply[0] == "ok"):
+            return []
+        reqs = json.loads(reply[1] or "[]")
+        return [r for r in reqs if isinstance(r, dict)
+                and isinstance(r.get("rank"), int)]
+    except (OSError, ValueError, pickle.PickleError, EOFError):
+        return []
 
 
 # observability env vars whose value is a FILE PATH: every spawned
@@ -135,19 +185,24 @@ def main(argv=None):
         for sid in range(args.num_servers):
             server_procs.append(spawn_server(sid))
 
-    procs = []
-    for rank in range(args.num_workers):
+    def spawn_worker(rank):
         env = dict(os.environ)
         env.update(common)
         env.update({"DMLC_ROLE": "worker", "DMLC_WORKER_ID": str(rank)})
         rank_suffix_observability(env, "worker", rank)
-        procs.append(subprocess.Popen(args.command, env=env))
+        return subprocess.Popen(args.command, env=env)
+
+    procs = [spawn_worker(rank) for rank in range(args.num_workers)]
     rc = 0
     if supervise > 0 and server_procs:
         # supervisor loop: while any worker is still running, relaunch
         # dead server processes (bounded restarts per server); the
-        # revived server self-restores from its durable checkpoint
+        # revived server self-restores from its durable checkpoint.
+        # Worker relaunches are REQUEST-driven: the autopilot's
+        # straggler reflex parks restart_rank on shard 0, polled here.
         restarts = [0] * len(server_procs)
+        w_restarts = [0] * len(procs)
+        last_poll = 0.0
         while any(p.poll() is None for p in procs):
             for sid, sp in enumerate(server_procs):
                 code = sp.poll()
@@ -161,6 +216,35 @@ def main(argv=None):
                       "restart %d/%d" % (sid, code, restarts[sid],
                                          supervise), flush=True)
                 server_procs[sid] = spawn_server(sid, fault=False)
+            now = time.monotonic()
+            if now - last_poll >= 1.0:
+                last_poll = now
+                for req in _poll_restart_requests(ports[0]):
+                    rank = req["rank"]
+                    if not 0 <= rank < len(procs):
+                        print("launch.py supervisor: restart_rank %r "
+                              "out of range — ignored" % (rank,),
+                              flush=True)
+                        continue
+                    if w_restarts[rank] >= supervise:
+                        print("launch.py supervisor: worker %d restart "
+                              "budget (%d) exhausted — request ignored"
+                              % (rank, supervise), flush=True)
+                        continue
+                    w_restarts[rank] += 1
+                    print("launch.py supervisor: restart_rank worker "
+                          "%d (%s) — restart %d/%d"
+                          % (rank, req.get("reason") or "no reason",
+                             w_restarts[rank], supervise), flush=True)
+                    wp = procs[rank]
+                    if wp.poll() is None:
+                        wp.terminate()
+                        try:
+                            wp.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            wp.kill()
+                            wp.wait()
+                    procs[rank] = spawn_worker(rank)
             time.sleep(0.2)
     for p in procs:
         p.wait()

@@ -57,6 +57,8 @@ DEFAULT_FEEDS = (
     ("mxnet_tpu.health", "observe", "_state"),
     ("mxnet_tpu.xray", "scope", "_state"),
     ("mxnet_tpu.device_memory", "track", "_state"),
+    ("mxnet_tpu.autopilot", "on_step", "_state"),
+    ("mxnet_tpu.autopilot", "on_serve", "_state"),
 )
 
 _ENV_RE = re.compile(r"\b(?:MXNET_TPU|MXTPU)_[A-Z0-9_]+\b")
