@@ -24,9 +24,11 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray import NDArray, array
+from ..symbol.passes import Pass as _Pass
 from ..symbol.symbol import Symbol, _Node
 
-__all__ = ["quantize_model", "quantize_net", "quantize_graph"]
+__all__ = ["quantize_model", "quantize_net", "quantize_graph",
+           "QuantizePass"]
 
 # ops rewritten to int8 compute (reference pass quantizes conv/FC/pooling/
 # flatten/concat; pooling & reshaping stay float here — they are
@@ -176,9 +178,11 @@ class _GraphBuilder:
         return [(dq, 0)]
 
 
-def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
+def _quantize_impl(sym, excluded_sym_names=(), th_dict=None,
                    quantized_dtype="int8"):
-    """Rewrite a Symbol: quantizable layers -> int8 compute subgraphs."""
+    """The int8 rewrite itself: quantizable layers -> int8 compute
+    subgraphs.  Public entry is :func:`quantize_graph`, which routes
+    through the symbol pass manager."""
     excluded = set(excluded_sym_names or ())
     gb = _GraphBuilder(th_dict, quantized_dtype)
     for node in sym._topo_nodes():
@@ -191,6 +195,35 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
         else:
             gb.mapping[id(node)] = gb.rewrite(node)
     return Symbol([gb.mapped(e) for e in sym._outputs])
+
+
+class QuantizePass(_Pass):
+    """Pass-manager wrapper around :func:`_quantize_impl`: the rewrite
+    is unchanged, but its output is re-verified (structure, registry
+    arity, cache-key soundness, partial shape/dtype interpretation)
+    before the quantized graph reaches any executor."""
+
+    name = "quantize"
+
+    def __init__(self, excluded_sym_names=(), th_dict=None,
+                 quantized_dtype="int8"):
+        self._excluded = tuple(excluded_sym_names or ())
+        self._th_dict = th_dict
+        self._dtype = quantized_dtype
+
+    def run(self, sym, ctx):
+        return _quantize_impl(sym, self._excluded, self._th_dict,
+                              self._dtype)
+
+
+def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
+                   quantized_dtype="int8", ctx=None):
+    """Rewrite a Symbol: quantizable layers -> int8 compute subgraphs,
+    verified by the pass manager before it is returned."""
+    from ..symbol.passes import PassContext
+
+    return QuantizePass(excluded_sym_names, th_dict, quantized_dtype)(
+        sym, ctx or PassContext())
 
 
 # ------------------------------------------------------------ calibration

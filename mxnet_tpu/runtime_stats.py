@@ -392,7 +392,12 @@ def snapshot():
     import sys as _sys
 
     _serving = _sys.modules.get("mxnet_tpu.serving")
+    # same deliberate laziness for the symbol pass manager: reading
+    # sys.modules costs nothing when no graph pass ever ran
+    _passes = _sys.modules.get("mxnet_tpu.symbol.passes")
     return {"ops": ops, "totals": totals, "counters": dict(_COUNTERS),
+            "graph_passes": _passes.pass_stats_snapshot()
+            if _passes is not None else {},
             "storms": storms, "memory": device_memory.snapshot(),
             "costs": costs,
             "xray": _compiled.xray_snapshot(),
@@ -485,6 +490,8 @@ def _render(snap, top=None):
                          % (name[:32],
                             ("%.3f" % v) if isinstance(v, float) else v))
     lines.extend(_stepstats.render(snap.get("stepstats") or {}))
+    if snap.get("graph_passes"):
+        lines.extend(_render_passes(snap["graph_passes"]))
     lines.extend(_render_costs(snap, top=top))
     lines.extend(_render_xray(snap.get("xray") or {}, top=top))
     lines.extend(_render_memory(snap.get("memory") or {}))
@@ -502,6 +509,35 @@ def _render(snap, top=None):
 
 def _fmt_ms(v):
     return "-" if v is None else "%.3f" % (v * 1e3)
+
+
+def _render_passes(passes):
+    """Per-pass node/flops/bytes deltas recorded by the symbol pass
+    manager (symbol/passes.py) — what each graph rewrite cost."""
+
+    def _delta(before, after):
+        if before is None or after is None:
+            return "-"
+        return "%+d" % (after - before)
+
+    lines = ["", "Graph passes (node/flops/bytes deltas per rewrite)",
+             "%-24s %5s %8s %7s %7s %12s %12s %10s"
+             % ("Pass", "Runs", "Changed", "Nodes", "dNodes",
+                "dFLOPs", "dBytes", "Verify(s)")]
+    for name in sorted(passes):
+        st = passes[name]
+        lines.append("%-24s %5d %8d %7s %7s %12s %12s %10.3f"
+                     % (name[:24], st.get("runs", 0), st.get("changed", 0),
+                        st.get("nodes_after") if st.get("nodes_after")
+                        is not None else "-",
+                        _delta(st.get("nodes_before"),
+                               st.get("nodes_after")),
+                        _delta(st.get("flops_before"),
+                               st.get("flops_after")),
+                        _delta(st.get("bytes_before"),
+                               st.get("bytes_after")),
+                        st.get("verify_seconds", 0.0)))
+    return lines
 
 
 def _render_hists(hists):
@@ -1243,6 +1279,18 @@ def _comparable_metrics(dump, min_seconds):
             if share >= 0.01:
                 out["xray:%s:%s bytes_share" % (label, scope)] = (
                     share * 100.0, "%", "xray")
+    # symbol graph passes: post-rewrite whole-graph flops/bytes (XLA
+    # cost analysis, recorded when a PassContext opts into
+    # measure_cost).  kind "graphpass" shares the "zero"/"xray" rule in
+    # compare(): a pass run on only one side (an f32-vs-AMP A/B) is a
+    # program change worth noting, never a perf verdict by itself.
+    for pname, st in (snap.get("graph_passes") or {}).items():
+        for key, unit, scale in (("flops_after", "GFLOP", 1e9),
+                                 ("bytes_after", "MB", 1e6)):
+            v = st.get(key)
+            if v:
+                out["graphpass:%s %s" % (pname, key)] = (
+                    v / scale, unit, "graphpass")
     # device-memory peak
     peak = ((snap.get("memory") or {}).get("totals") or {}).get(
         "peak_bytes", 0)
@@ -1301,11 +1349,13 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
         ratio = (after / before) if before > 0.0 else float("inf")
         entry = {"metric": metric, "kind": kind, "unit": unit,
                  "before": before, "after": after, "ratio": ratio}
-        if kind in ("zero", "xray") and (va is None or vb is None):
-            # collective-bytes counters (or x-ray scopes) existing on
-            # only one side mean the two runs used different sharding
-            # topologies / model structures — worth surfacing, but
-            # 0 -> N is a change of shape, not a performance verdict
+        if kind in ("zero", "xray", "graphpass") \
+                and (va is None or vb is None):
+            # collective-bytes counters, x-ray scopes or graph-pass
+            # costs existing on only one side mean the two runs used
+            # different sharding topologies / model structures /
+            # rewrite pipelines — worth surfacing, but 0 -> N is a
+            # change of shape, not a performance verdict
             entry["side"] = "after-only" if va is None else "before-only"
             notes.append(entry)
             continue

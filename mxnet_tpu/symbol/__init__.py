@@ -8,5 +8,11 @@ _populate(globals())
 from . import random  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401
 
+from .amp import AMPPass, amp_convert  # noqa: E402,F401
+from .passes import (  # noqa: E402,F401
+    FunctionPass, Pass, PassContext, PassError, sequential)
+from .verify import (  # noqa: E402,F401
+    GraphFinding, VerifyResult, assert_valid, verify_graph)
+
 zeros = globals()["_zeros"]
 ones = globals()["_ones"]

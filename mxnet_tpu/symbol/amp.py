@@ -1,0 +1,188 @@
+"""Automatic mixed precision — the first production pass on the pass
+manager.
+
+``amp_convert`` sweeps a Symbol graph to bfloat16 compute while keeping
+f32 *islands* where reduced precision is known to hurt:
+
+* normalization ops (BatchNorm/LayerNorm/InstanceNorm/L2Normalization)
+  — small-variance statistics cancel catastrophically in bf16;
+* softmax / log_softmax and every loss head in ``OP_LABEL_INPUTS``
+  (SoftmaxOutput & friends) — exp/sum reductions plus the
+  optimizer-visible loss stay f32;
+* explicit reductions (sum, mean, prod, norm, moments) — long
+  accumulation chains need f32 accumulators;
+* anything the caller lists in ``excluded`` (by node name).
+
+Master weights stay f32: variables are *not* retyped — a single cached
+``Cast`` node per (producer output, dtype) converts values at the
+precision boundary, so the optimizer, initializers and checkpoints see
+the same f32 parameters as before.  Graph heads are cast back to f32
+(optimizer- and metric-visible outputs keep their dtype contract).
+Integer inputs (Embedding indices, sequence lengths) are never cast —
+the shared :func:`..symbol.verify.variable_dtypes` seeding knows an
+int32 when it sees one.  ``Cast``/``Custom``/``_subgraph_exec`` and
+the int8 quantization family are left untouched, with their original
+input dtypes restored at the boundary.
+
+The pass-manager wrapper re-verifies the converted graph (shape/dtype
+abstract interpretation included) before anyone can bind it, and the
+numerics contract — loss parity vs the f32 graph within documented
+tolerance — is pinned in tests/test_graph_passes.py.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import np_dtype as _np_dtype
+from ..ops import registry as _reg
+from ..ops.registry import OP_LABEL_INPUTS
+from .passes import Pass, PassContext
+from .symbol import Symbol, _Node
+from .verify import variable_dtypes
+
+__all__ = ["AMPPass", "amp_convert", "FP32_ISLAND_OPS"]
+
+# ops whose *computation* stays f32 (inputs cast back up at the edge)
+FP32_ISLAND_OPS = frozenset(
+    {"BatchNorm", "LayerNorm", "InstanceNorm", "L2Normalization",
+     "softmax", "log_softmax", "SoftmaxActivation",
+     "sum", "mean", "prod", "nansum", "nanprod", "norm", "moments"}
+    | set(OP_LABEL_INPUTS))
+
+# ops AMP must not restructure at all: casts themselves, opaque
+# callbacks, spliced subgraphs, and the int8 quantization family
+# (their dtype choreography is the whole point of that pass)
+_NEVER_TOUCH = frozenset({"Cast", "Custom", "_subgraph_exec"})
+
+
+def _untouchable(op_name):
+    if op_name in _NEVER_TOUCH:
+        return True
+    low = op_name.lower()
+    return "quantize" in low or "dequantize" in low
+
+
+def _is_float(dtype):
+    try:
+        return _np.issubdtype(_np.dtype(dtype), _np.floating) \
+            or str(dtype) == "bfloat16"
+    except TypeError:
+        return str(dtype) == "bfloat16"
+
+
+def _amp_impl(sym, target_dtype="bfloat16", excluded=(), input_dtypes=None):
+    """Rebuild ``sym`` with bf16 compute + f32 islands; returns ``sym``
+    itself when nothing converts (identity contract for the pass
+    manager)."""
+    target = _np_dtype(target_dtype)
+    f32 = _np.dtype(_np.float32)
+    excluded = set(excluded)
+    var_dtypes = variable_dtypes(sym, input_dtypes)
+    cast_op = _reg.get("Cast")
+
+    mapped = {}     # id(old node) -> new node
+    tags = {}       # (id(new node), out idx) -> np dtype (best effort)
+    casts = {}      # (id(new node), out idx, dtype str) -> cast node
+    changed = [False]
+
+    def cast_to(node, idx, dtype):
+        """Cached Cast node converting output ``idx`` of ``node``."""
+        key = (id(node), idx, str(dtype))
+        hit = casts.get(key)
+        if hit is not None:
+            return hit
+        short = "bf16" if dtype == target and target != f32 else \
+            str(dtype).replace("float", "f")
+        attrs = cast_op.canonicalize_attrs(
+            {"dtype": "bfloat16" if short == "bf16" else str(dtype)})
+        cnode = _Node("Cast", "%s_amp_cast%d_%s" % (node.name, idx, short),
+                      attrs, [(node, idx)], 1, {})
+        casts[key] = cnode
+        tags[(id(cnode), 0)] = dtype
+        changed[0] = True
+        return cnode
+
+    def edge(old_inp, idx, want):
+        """New (node, idx) edge for an old input, cast to ``want`` when
+        the carried value is float and differs."""
+        new_inp = mapped[id(old_inp)]
+        have = tags.get((id(new_inp), idx))
+        if want is None or have is None or not _is_float(have) \
+                or have == want:
+            return (new_inp, idx)
+        return (cast_to(new_inp, idx, want), 0)
+
+    for node in sym._topo_nodes():
+        if node.is_variable:
+            mapped[id(node)] = node  # master weights untouched
+            tags[(id(node), 0)] = var_dtypes.get(node.name, f32)
+            continue
+        wants_f32 = (node.op in FP32_ISLAND_OPS or _untouchable(node.op)
+                     or node.name in excluded)
+        # an existing Cast converts whatever arrives — forcing its
+        # input back to f32 would just stack a redundant cast (and
+        # break idempotence); leave its edges alone
+        want = None if node.op == "Cast" else f32 if wants_f32 else target
+        new_inputs = [edge(inp, idx, want) for inp, idx in node.inputs]
+        if all(ni is oi and nx == ox for (ni, nx), (oi, ox)
+               in zip(new_inputs, node.inputs)):
+            new_node = node  # nothing converted upstream: reuse as-is
+        else:
+            new_node = _Node(node.op, node.name, node.attrs, new_inputs,
+                             node.num_outputs, node.attr_dict)
+        mapped[id(node)] = new_node
+        out_tag = f32 if wants_f32 else target
+        if node.op == "Cast":
+            try:
+                out_tag = _np_dtype(dict(node.attrs).get("dtype"))
+            except Exception:
+                out_tag = None
+        for i in range(node.num_outputs):
+            tags[(id(new_node), i)] = out_tag
+
+    # optimizer/metric-visible heads stay f32
+    outputs = []
+    for hn, hidx in sym._outputs:
+        new_hn = mapped[id(hn)]
+        have = tags.get((id(new_hn), hidx))
+        if have is not None and _is_float(have) and have != f32:
+            outputs.append((cast_to(new_hn, hidx, f32), 0))
+        else:
+            outputs.append((new_hn, hidx))
+
+    if not changed[0]:
+        return sym
+    return Symbol(outputs)
+
+
+class AMPPass(Pass):
+    """Pass-manager wrapper; reads ``target_dtype`` / ``excluded`` from
+    ``ctx.options`` (defaults: bfloat16, none)."""
+
+    name = "amp"
+
+    def __init__(self, target_dtype="bfloat16", excluded=()):
+        self.target_dtype = target_dtype
+        self.excluded = tuple(excluded)
+
+    def run(self, sym, ctx):
+        return _amp_impl(
+            sym,
+            target_dtype=ctx.options.get("amp_target_dtype",
+                                         self.target_dtype),
+            excluded=tuple(ctx.options.get("amp_excluded", self.excluded)),
+            input_dtypes=ctx.input_dtypes)
+
+
+def amp_convert(sym, target_dtype="bfloat16", excluded=(),
+                input_shapes=None, input_dtypes=None, ctx=None):
+    """Convert ``sym`` to mixed precision, verified by the pass manager.
+
+    ``input_shapes``/``input_dtypes`` seed the post-pass verifier (and
+    the integer-input detection); pass a full set for exact dtype-level
+    verification of the converted graph.
+    """
+    ctx = ctx or PassContext(input_shapes=input_shapes,
+                             input_dtypes=input_dtypes)
+    return AMPPass(target_dtype=target_dtype, excluded=excluded)(sym, ctx)

@@ -21,11 +21,12 @@ API:
 from __future__ import annotations
 
 from ..base import MXNetError
+from .passes import Pass, PassContext
 from .symbol import Symbol, Variable, _Node
 
 __all__ = ["SubgraphSelector", "SubgraphProperty",
            "register_subgraph_property", "list_subgraph_properties",
-           "partition_graph"]
+           "partition_graph", "PartitionPass"]
 
 _PROPERTIES: dict[str, type] = {}
 
@@ -220,10 +221,11 @@ def _extract_subgraph(region, topo):
     return ordered, clones, ext_inputs, ext_names
 
 
-def partition_graph(sym, prop):
-    """Replace every region the property selects (reference:
-    partition_graph.cc PartitionGraph).  Returns a new Symbol; the
-    input is untouched."""
+def _partition_impl(sym, prop):
+    """The partitioning rewrite itself (reference: partition_graph.cc
+    PartitionGraph).  Returns a new Symbol — or ``sym`` itself when no
+    region matches; the input is untouched either way.  Public entry is
+    :func:`partition_graph`, which routes through the pass manager."""
     prop = _get_property(prop)
     topo = sym._topo_nodes()
 
@@ -315,6 +317,29 @@ def partition_graph(sym, prop):
             entry_map[(id(node), idx)] = (new_node, idx)
 
     return Symbol([entry_map[(id(n), idx)] for n, idx in sym._outputs])
+
+
+class PartitionPass(Pass):
+    """Pass-manager wrapper around :func:`_partition_impl`: the rewrite
+    is unchanged, but its output is re-verified before anyone binds it
+    and its node/cost delta lands in runtime_stats' ``graph_passes``."""
+
+    def __init__(self, prop):
+        self._prop = prop
+        label = prop if isinstance(prop, str) else \
+            getattr(prop, "__name__", type(prop).__name__)
+        self.name = "partition:%s" % label
+
+    def run(self, sym, ctx):
+        return _partition_impl(sym, self._prop)
+
+
+def partition_graph(sym, prop, ctx=None):
+    """Replace every region the property selects (reference:
+    partition_graph.cc PartitionGraph).  Returns a new, verified Symbol
+    — or ``sym`` itself when no region matches (callers like
+    ``simple_bind`` test ``part is not self``)."""
+    return PartitionPass(prop)(sym, ctx or PassContext())
 
 
 def _rewire_arguments(replacement, arg_map):

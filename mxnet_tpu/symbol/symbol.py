@@ -978,15 +978,26 @@ def _infer_param_shapes(symbol, known):
                   if v is None or not any(int(d) == 0 for d in v)}
         shapes.update(solved)
 
-    def get_in_shapes(node):
-        res = []
-        for inp, idx in node.inputs:
+    def entry_shape(inp, idx):
+        # Cast is exactly shape-preserving: when a cast's own output
+        # shape is still unknown (its source was a then-unsolved
+        # parameter, e.g. behind an AMP-inserted cast), read through
+        # the chain instead of giving up — this keeps infer_shape
+        # single-pass even with casts between params and consumers
+        while True:
             if inp.is_variable:
-                res.append(tuple(shapes[inp.name]) if inp.name in shapes else None)
-            else:
-                outs = node_out_shapes.get(id(inp))
-                res.append(None if outs is None else outs[idx])
-        return res
+                return tuple(shapes[inp.name]) if inp.name in shapes \
+                    else None
+            outs = node_out_shapes.get(id(inp))
+            if outs is not None:
+                return outs[idx]
+            if inp.op == "Cast" and inp.inputs:
+                inp, idx = inp.inputs[0]
+                continue
+            return None
+
+    def get_in_shapes(node):
+        return [entry_shape(inp, idx) for inp, idx in node.inputs]
 
     import jax
 
@@ -1025,6 +1036,10 @@ def _infer_param_shapes(symbol, known):
 
 _RANDOMISH = {"Dropout"}
 
+# parsed sub-symbols for _subgraph_exec param-shape solving, keyed by
+# the serialized JSON (same key the executor-side cache uses)
+_SUBGRAPH_SOLVE = {}
+
 
 def _solve_params(node, in_shapes, shapes):
     """Derive parameter shapes for common layers (FC/conv/BN/embedding)."""
@@ -1053,6 +1068,36 @@ def _solve_params(node, in_shapes, shapes):
                 if inp.is_variable and inp.name not in shapes:
                     shapes[inp.name] = tuple(int(x) for x in s2)
         return
+    if node.op == "_subgraph_exec":
+        # the ops whose semantics solve these shapes live inside the
+        # serialized sub-symbol: recurse, then pull solved variable
+        # shapes back onto the outer inputs, which bind positionally in
+        # list_inputs() order (ops/custom.py subgraph_exec contract)
+        sj = node.attrs.get("subgraph_json")
+        if sj is None or not any(s is not None for s in in_shapes) \
+                or not any(s is None for s in in_shapes):
+            return
+        cached = _SUBGRAPH_SOLVE.get(sj)
+        if cached is None:
+            sub = load_json(sj)
+            cached = (sub, sub.list_inputs())
+            _SUBGRAPH_SOLVE[sj] = cached
+        sub, in_names = cached
+        if len(in_names) != len(node.inputs):
+            return
+        inner_known = {n: s for n, s in zip(in_names, in_shapes)
+                       if s is not None}
+        solved = _infer_param_shapes(sub, inner_known)
+        for i, nm in enumerate(in_names):
+            s2 = solved.get(nm)
+            if s2 is None or in_shapes[i] is not None:
+                continue
+            inp, _ = node.inputs[i]
+            while inp.op == "Cast" and inp.inputs:
+                inp = inp.inputs[0][0]
+            if inp.is_variable and inp.name not in shapes:
+                shapes[inp.name] = tuple(int(x) for x in s2)
+        return
     names = OP_INPUT_NAMES.get(node.op, ())
     if not names or in_shapes[0] is None:
         return
@@ -1061,6 +1106,11 @@ def _solve_params(node, in_shapes, shapes):
 
     def setv(i, shape, strict=True):
         inp, _ = node.inputs[i]
+        # the structural constraint lands on the source variable even
+        # through dtype-only Cast chains (AMP inserts one between each
+        # parameter and its consumer; casts never change shape)
+        while inp.op == "Cast" and inp.inputs:
+            inp = inp.inputs[0][0]
         if not inp.is_variable:
             return
         want = tuple(int(x) for x in shape)

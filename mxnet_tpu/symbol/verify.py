@@ -1,0 +1,506 @@
+"""Graph verifier — a static abstract interpreter over the Symbol DAG.
+
+Every graph rewrite in this repo (subgraph partitioning, int8
+quantization, AMP, and whatever lands next) produces a new ``Symbol``
+by hand-building ``_Node`` objects.  A single wrong edge — a dangling
+output index, an op the registry never heard of, an unhashable attr
+that silently drops every call out of the jit cache — survives until
+bind time, where it surfaces as an opaque executor trace error (or
+worse, as a perf cliff with no error at all).  This module is the
+mxlint of the graph IR: it proves a Symbol sound *before* the executor
+sees it, and prints the offending node with its path to a graph head.
+
+Checks, in order:
+
+1. **Structural invariants** (no jax needed): acyclicity (own DFS
+   coloring — ``Symbol._topo_nodes`` terminates on cycles but returns
+   a wrong order, so the verifier cannot reuse it), no dangling input
+   refs (``0 <= idx < producer.num_outputs``), variables carry no
+   inputs, unique node names, every op registered, arity within the
+   exact range ``symbol._create`` can produce for the op's
+   ``OP_INPUT_NAMES`` row (mirroring its optional-slot skipping:
+   no_bias, use_sequence_length, data/label lengths, LeakyReLU gamma).
+2. **Cache-key soundness**: attrs are canonicalized and split
+   static-vs-traced exactly as ``registry.Op._split_attrs`` will split
+   them at dispatch; the resulting cache key must hash.  An unhashable
+   static attr is named — it would demote every call of that node to
+   the eager-trace fallback (``apply_op``'s TypeError path), a silent
+   perf bug no runtime error ever reports.
+3. **Abstract interpretation**: per-node ``jax.eval_shape`` over
+   propagated shape/dtype avals — variable shapes seeded through
+   ``_infer_param_shapes`` (the same solver ``infer_shape`` uses),
+   variable dtypes through ``__dtype__`` attrs, the quantization
+   naming contract (``*_quantize`` -> int8, ``*_quantize_min/_max`` ->
+   f32 range scalars), and — for registry-table ops — the canonical
+   input specs of ``tools/mxlint/registry_audit`` as dtype hints
+   (Embedding indices, sequence lengths).  Random ops get the PRNG key
+   prepended exactly as the executor prepends it.  A node that fails
+   to trace, or traces to a different output count than it declares,
+   is a finding; nodes whose input shapes stay unknown are *skipped*
+   (partial verification), never guessed.
+
+Zero-false-positive contract (the mxlint tradition): every graph the
+public builders produce — symbol API, gluon traces, ``load_json``
+round-trips, and both production rewrites — verifies clean.  The
+mutation suite (tests/test_graph_verify.py) pins the other side: each
+seeded fault is caught with the exact node named.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype as _np_dtype
+from ..ops import registry as _reg
+from ..ops.registry import OP_INPUT_NAMES
+from .symbol import _infer_param_shapes
+
+__all__ = ["GraphFinding", "VerifyResult", "verify_graph", "assert_valid",
+           "variable_dtypes"]
+
+
+class GraphFinding:
+    """One invariant violation at one graph node (mxlint-style)."""
+
+    __slots__ = ("rule", "node", "op", "message", "path")
+
+    def __init__(self, rule, node, op, message, path=""):
+        self.rule = rule        # short rule id, e.g. "dangling-input"
+        self.node = node        # offending node name
+        self.op = op            # its op name ("" for variables)
+        self.message = message
+        self.path = path        # "node -> consumer -> ... -> head"
+
+    def __repr__(self):
+        return "GraphFinding(%s, %s)" % (self.rule, self.node)
+
+    def format(self):
+        op = (" (op %s)" % self.op) if self.op else ""
+        path = (" [path: %s]" % self.path) if self.path else ""
+        return "graph:%s: node %r%s: %s%s" % (self.rule, self.node, op,
+                                              self.message, path)
+
+    def to_dict(self):
+        return {"rule": self.rule, "node": self.node, "op": self.op,
+                "message": self.message, "path": self.path}
+
+
+class VerifyResult:
+    """Outcome of :func:`verify_graph`."""
+
+    __slots__ = ("findings", "skipped", "nodes", "evaluated")
+
+    def __init__(self, findings, skipped, nodes, evaluated):
+        self.findings = findings    # list of GraphFinding
+        self.skipped = skipped      # node names with unknown input shapes
+        self.nodes = nodes          # total nodes inspected
+        self.evaluated = evaluated  # op nodes traced under eval_shape
+
+    @property
+    def ok(self):
+        return not self.findings
+
+    def format(self):
+        lines = [f.format() for f in self.findings]
+        lines.append("graph verify: %d finding(s) over %d node(s) "
+                     "(%d traced, %d skipped for unknown shapes)"
+                     % (len(self.findings), self.nodes, self.evaluated,
+                        len(self.skipped)))
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------ traversal
+
+
+def _collect(sym):
+    """Own DFS (white/gray/black coloring): returns ``(order, nodes,
+    back_edges)``.  ``order`` is a valid evaluation order iff
+    ``back_edges`` is empty; ``_topo_nodes`` cannot be reused here
+    because its seen-set makes it terminate on cycles with a silently
+    wrong order."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {}
+    order = []
+    nodes = {}
+    back_edges = []
+    for root, _ in sym._outputs:
+        if color.get(id(root), WHITE) == BLACK:
+            continue
+        stack = [(root, 0)]
+        while stack:
+            node, i = stack.pop()
+            if i == 0:
+                if color.get(id(node), WHITE) != WHITE:
+                    continue  # duplicate stack entry
+                color[id(node)] = GRAY
+                nodes[id(node)] = node
+            if i < len(node.inputs):
+                stack.append((node, i + 1))
+                child = node.inputs[i][0]
+                c = color.get(id(child), WHITE)
+                if c == GRAY:
+                    # child is discovered-but-unfinished = on the
+                    # current DFS path: a genuine back edge
+                    back_edges.append((node, child))
+                elif c == WHITE:
+                    stack.append((child, 0))
+            else:
+                color[id(node)] = BLACK
+                order.append(node)
+    return order, nodes, back_edges
+
+
+def _consumers(order):
+    out = {}
+    for n in order:
+        for inp, _ in n.inputs:
+            out.setdefault(id(inp), []).append(n)
+    return out
+
+
+def _path_to_head(sym, node, consumers, limit=12):
+    """Render ``node -> consumer -> ... -> head`` (BFS shortest)."""
+    head_ids = {}
+    for i, (hn, _) in enumerate(sym._outputs):
+        head_ids.setdefault(id(hn), i)
+    seen = {id(node)}
+    frontier = [(node, [node])]
+    while frontier:
+        cur, path = frontier.pop(0)
+        if id(cur) in head_ids:
+            names = [p.name for p in path[:limit]]
+            if len(path) > limit:
+                names.append("...")
+            return " -> ".join(names) + \
+                " [output %d]" % head_ids[id(cur)]
+        for nxt in consumers.get(id(cur), ()):
+            if id(nxt) not in seen:
+                seen.add(id(nxt))
+                frontier.append((nxt, path + [nxt]))
+    return node.name + " (unreachable from any output)"
+
+
+# ------------------------------------------------------- dtype seeding
+
+
+def _spec_dtype_hints(order, dtypes):
+    """Non-float dtype hints for unseeded variables from the registry
+    canonical specs (tools/mxlint/registry_audit) — an Embedding's
+    ``data`` slot is int32 by spec, so the verifier must not assume
+    f32 for the variable feeding it.  Best-effort: when the tools
+    package is not importable (installed-package use), no hints."""
+    try:
+        from tools.mxlint.registry_audit import canonical_spec
+    except ImportError:  # pragma: no cover - repo layout always has it
+        return
+    for node in order:
+        if node.op is None:
+            continue
+        spec = canonical_spec(node.op)
+        if spec is None:
+            continue
+        input_specs, _attrs = spec
+        for i, (inp, _idx) in enumerate(node.inputs):
+            if i >= len(input_specs) or not inp.is_variable:
+                continue
+            if inp.name in dtypes:
+                continue
+            d = _np.dtype(input_specs[i][1])
+            if d != _np.float32:
+                dtypes[inp.name] = d
+
+
+def variable_dtypes(sym, input_dtypes=None, default=_np.float32):
+    """{variable name: numpy dtype} for every variable in ``sym``.
+
+    Precedence: explicit ``input_dtypes`` > the variable's
+    ``__dtype__`` attr > the quantization naming contract
+    (``*_quantize`` -> int8, ``*_quantize_min/_max`` -> f32 scalars,
+    mirroring ``Symbol.infer_type``) > non-float canonical-spec slot
+    hints > ``default``.  Shared with the AMP pass, which must know an
+    integer input when it sees one (indices are never cast to bf16).
+    """
+    order, _nodes, back = _collect(sym)
+    dtypes = {}
+    for node in order:
+        if not node.is_variable:
+            continue
+        name = node.name
+        if input_dtypes and name in input_dtypes:
+            dtypes[name] = _np_dtype(input_dtypes[name])
+        elif "__dtype__" in node.attr_dict:
+            try:
+                dtypes[name] = _np_dtype(node.attr_dict["__dtype__"])
+            except (TypeError, MXNetError):
+                pass
+        elif name.endswith("_quantize"):
+            dtypes[name] = _np.dtype(_np.int8)
+        elif name.endswith(("_quantize_min", "_quantize_max")):
+            dtypes[name] = _np.dtype(_np.float32)
+    if not back:
+        _spec_dtype_hints(order, dtypes)
+    for node in order:
+        if node.is_variable:
+            dtypes.setdefault(node.name, _np.dtype(default))
+    return dtypes
+
+
+# ---------------------------------------------------------- the checks
+
+
+def _default_no_bias(op_obj):
+    """The op fn's signature default for no_bias (mirrors _create)."""
+    import inspect
+
+    try:
+        p = inspect.signature(op_obj.fn).parameters.get("no_bias")
+        if p is not None and p.default is not inspect.Parameter.empty:
+            return bool(p.default)
+    except (TypeError, ValueError):
+        pass
+    return False
+
+
+def _arity_range(op_name, op_obj, attrs):
+    """``(lo, hi)`` input counts ``symbol._create`` can produce for a
+    table op under these attrs, or None for non-table (variadic) ops."""
+    names = OP_INPUT_NAMES.get(op_name)
+    if not names:
+        return None
+    hi = len(names)
+    lo = hi
+    no_bias = attrs.get("no_bias", _default_no_bias(op_obj))
+    use_seq = attrs.get("use_sequence_length", False)
+    for iname in names:
+        if iname == "bias" and no_bias:
+            lo -= 1
+        elif iname == "sequence_length" and not use_seq:
+            lo -= 1
+        elif iname in ("data_lengths", "label_lengths"):
+            lo -= 1
+        elif iname == "gamma" and op_name == "LeakyReLU" \
+                and attrs.get("act_type", "leaky") != "prelu":
+            lo -= 1
+    return lo, hi
+
+
+def _unhashable_attr(attrs):
+    """Name of the first attr whose canonical value does not hash."""
+    for k in sorted(attrs):
+        try:
+            hash(attrs[k])
+        except TypeError:
+            return k
+    return None
+
+
+def _random_op_names():
+    from ..ndarray.ndarray import RANDOM_OPS
+
+    return set(RANDOM_OPS) | {"Dropout"}
+
+
+def verify_graph(sym, input_shapes=None, input_dtypes=None):
+    """Verify a Symbol DAG; returns a :class:`VerifyResult`.
+
+    ``input_shapes`` / ``input_dtypes``: {variable name: shape/dtype}
+    seeds for the abstract interpretation — without them structural and
+    cache-key checks still run in full, and nodes whose shapes stay
+    unknown are reported in ``result.skipped`` instead of guessed.
+    """
+    order, nodes, back_edges = _collect(sym)
+    consumers = _consumers(order)
+    findings = []
+
+    def find(rule, node, message):
+        findings.append(GraphFinding(
+            rule, node.name, node.op or "", message,
+            _path_to_head(sym, node, consumers)))
+
+    # ---- acyclicity (everything downstream assumes a DAG)
+    for node, child in back_edges:
+        find("cycle", node,
+             "input edge to %r closes a cycle — the graph is not a DAG"
+             % child.name)
+
+    # ---- dangling refs, variable shape, duplicate names
+    by_name = {}
+    for node in order:
+        by_name.setdefault(node.name, []).append(node)
+        for inp, idx in node.inputs:
+            if not (0 <= idx < inp.num_outputs):
+                find("dangling-input", node,
+                     "input references output %d of %r, which has only "
+                     "%d output(s)" % (idx, inp.name, inp.num_outputs))
+        if node.is_variable and node.inputs:
+            find("variable-inputs", node,
+                 "variable node carries %d input edge(s); variables "
+                 "must be leaves" % len(node.inputs))
+    for hn, hidx in sym._outputs:
+        if not (0 <= hidx < hn.num_outputs):
+            find("dangling-output", hn,
+                 "graph head references output %d, but the node has "
+                 "only %d output(s)" % (hidx, hn.num_outputs))
+    for name, dups in sorted(by_name.items()):
+        if len(dups) > 1:
+            kinds = ", ".join(d.op or "variable" for d in dups)
+            find("duplicate-name", dups[1],
+                 "name %r is used by %d distinct nodes (%s) — executor "
+                 "argument binding and JSON round-trips key by name"
+                 % (name, len(dups), kinds))
+
+    # ---- registry presence, arity, cache-key soundness, num_outputs
+    canon_attrs = {}  # id(node) -> canonicalized attrs (for eval below)
+    for node in order:
+        if node.is_variable:
+            continue
+        op_obj = _reg._OP_REGISTRY.get(node.op)
+        if op_obj is None:
+            find("unknown-op", node,
+                 "op %r is not in the operator registry" % node.op)
+            continue
+        try:
+            canon = op_obj.canonicalize_attrs(node.attrs or {})
+        except Exception as e:
+            find("attr-canon", node,
+                 "canonicalize_attrs failed: %s: %s"
+                 % (type(e).__name__, str(e).split("\n")[0]))
+            continue
+        canon_attrs[id(node)] = canon
+        # cache key exactly as dispatch will build it
+        try:
+            key = op_obj._split_attrs(canon)[0]
+        except TypeError:
+            key = None
+        hashable = True
+        if key is not None:
+            try:
+                hash(key)
+            except TypeError:
+                hashable = False
+        if key is None or not hashable:
+            bad = _unhashable_attr(canon)
+            find("unhashable-attr", node,
+                 "attr %r (%s) is unhashable after canonicalization — "
+                 "the jit-cache key cannot be built, so every call of "
+                 "this node falls back to eager tracing"
+                 % (bad, type(canon.get(bad)).__name__))
+            canon_attrs.pop(id(node), None)
+            continue
+        rng = _arity_range(node.op, op_obj, canon)
+        if rng is not None:
+            lo, hi = rng
+            if not (lo <= len(node.inputs) <= hi):
+                find("arity", node,
+                     "op %r takes %s input(s) (%s) under these attrs, "
+                     "but the node has %d"
+                     % (node.op,
+                        ("%d" % hi) if lo == hi else "%d..%d" % (lo, hi),
+                        ", ".join(OP_INPUT_NAMES[node.op]),
+                        len(node.inputs)))
+        declared = node.num_outputs
+        try:
+            nout = op_obj.nout(canon)
+        except Exception:
+            nout = None
+        if nout is not None and nout != declared:
+            find("num-outputs", node,
+                 "node declares %d output(s) but op %r produces %d "
+                 "under these attrs" % (declared, node.op, nout))
+
+    # ---- abstract interpretation (skipped entirely on a cyclic graph)
+    skipped = []
+    evaluated = 0
+    if not back_edges:
+        skipped, evaluated = _abstract_interp(
+            sym, order, canon_attrs, input_shapes, input_dtypes, find,
+            findings)
+    return VerifyResult(findings, skipped, len(order), evaluated)
+
+
+def _abstract_interp(sym, order, canon_attrs, input_shapes, input_dtypes,
+                     find, findings):
+    import jax
+
+    known = dict(input_shapes or {})
+    try:
+        shapes = _infer_param_shapes(sym, known)
+    except MXNetError as e:
+        # a structural contradiction (provided shape vs op semantics)
+        # is itself a finding; fall back to the raw seeds so the rest
+        # of the graph still gets partial verification
+        findings.append(GraphFinding(
+            "shape-infer", sym._outputs[0][0].name, "",
+            "parameter shape inference failed: %s"
+            % str(e).split("\n")[0]))
+        shapes = known
+    dtypes = variable_dtypes(sym, input_dtypes)
+    flagged = {f.node for f in findings}
+    random_ops = _random_op_names()
+    key_aval = None
+    entry = {}  # (id(node), idx) -> ShapeDtypeStruct or None
+    skipped = []
+    evaluated = 0
+    for node in order:
+        if node.is_variable:
+            s = shapes.get(node.name)
+            entry[(id(node), 0)] = None if s is None else \
+                jax.ShapeDtypeStruct(tuple(s), dtypes[node.name])
+            if s is None:
+                skipped.append(node.name)
+            continue
+        if id(node) not in canon_attrs:
+            # unknown op / broken attrs: already a finding; outputs
+            # stay unknown downstream
+            for i in range(node.num_outputs):
+                entry[(id(node), i)] = None
+            continue
+        avals = [entry.get((id(inp), idx)) for inp, idx in node.inputs]
+        if any(a is None for a in avals):
+            skipped.append(node.name)
+            for i in range(node.num_outputs):
+                entry[(id(node), i)] = None
+            continue
+        canon = canon_attrs[id(node)]
+        op_obj = _reg._OP_REGISTRY[node.op]
+        fn = op_obj.bind_attrs(canon)
+        if node.op in random_ops:
+            # the executor prepends a TraceRNG key for these; mirror it
+            if key_aval is None:
+                k = jax.random.PRNGKey(0)
+                key_aval = jax.ShapeDtypeStruct(tuple(k.shape), k.dtype)
+            avals = [key_aval] + avals
+        try:
+            out = jax.eval_shape(fn, *avals)
+            evaluated += 1
+        except Exception as e:
+            find("node-eval", node,
+                 "abstract evaluation failed on input avals (%s): "
+                 "%s: %s"
+                 % (", ".join("%s%s" % (a.dtype, list(a.shape))
+                              for a in avals),
+                    type(e).__name__, str(e).split("\n")[0][:300]))
+            for i in range(node.num_outputs):
+                entry[(id(node), i)] = None
+            continue
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if len(outs) != node.num_outputs and node.name not in flagged:
+            find("num-outputs", node,
+                 "node declares %d output(s) but tracing produced %d"
+                 % (node.num_outputs, len(outs)))
+        for i in range(node.num_outputs):
+            entry[(id(node), i)] = outs[i] if i < len(outs) else None
+    return skipped, evaluated
+
+
+def assert_valid(sym, input_shapes=None, input_dtypes=None, context=""):
+    """Raise :class:`MXNetError` listing every finding (with node paths)
+    when ``sym`` fails verification; returns the VerifyResult when
+    clean.  ``context`` names the producer (e.g. the pass) in the
+    error."""
+    result = verify_graph(sym, input_shapes=input_shapes,
+                          input_dtypes=input_dtypes)
+    if not result.ok:
+        where = (" after %s" % context) if context else ""
+        raise MXNetError("invalid graph%s:\n%s" % (where, result.format()))
+    return result

@@ -1,0 +1,331 @@
+"""Pass-manager contract tests (symbol/passes.py) + the AMP pass.
+
+Pins the acceptance criteria of the pass-manager PR:
+- subgraph partitioning and int8 quantization run AS passes with
+  bit-identical outputs to their pre-port implementations;
+- a pass producing an invalid graph is refused with the pass AND the
+  finding named (the executor never sees a broken DAG);
+- per-pass node/flops/bytes deltas surface in runtime_stats
+  (snapshot()["graph_passes"], report(), and --compare's metric rows);
+- AMP: verified graph, bf16 compute with f32 islands, master weights
+  untouched, loss parity vs the f32 graph within tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import runtime_stats
+from mxnet_tpu.executor import make_eval_fn
+from mxnet_tpu.symbol import passes as P
+from mxnet_tpu.symbol.amp import FP32_ISLAND_OPS, amp_convert
+from mxnet_tpu.symbol.subgraph import (SubgraphProperty, SubgraphSelector,
+                                       _partition_impl, partition_graph)
+from mxnet_tpu.symbol.symbol import Symbol, _Node
+from mxnet_tpu.symbol.verify import verify_graph
+
+sym = mx.sym
+
+# AMP: documented numerics tolerance vs f32 (bf16 has ~3 decimal digits
+# of mantissa; post-softmax probabilities stay well inside 2e-2)
+AMP_ATOL = 2e-2
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=8, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _convnet():
+    data = sym.var("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           name="conv1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    act = sym.Activation(bn, act_type="relu", name="crelu")
+    flat = sym.Flatten(act, name="flat")
+    fc = sym.FullyConnected(flat, num_hidden=6, name="cfc")
+    return sym.SoftmaxOutput(fc, name="csoftmax")
+
+
+class _FCChainSelector(SubgraphSelector):
+    def select(self, node):
+        return node.op == "FullyConnected"
+
+    def select_output(self, cur_node, output_node):
+        return output_node.op == "Activation"
+
+
+class _FCChainProperty(SubgraphProperty):
+    def create_selector(self):
+        return _FCChainSelector()
+
+
+class _NothingProperty(SubgraphProperty):
+    def create_selector(self):
+        s = SubgraphSelector()
+        s.select = lambda node: False
+        return s
+
+
+def _forward(s, vals, is_train=False):
+    fn, meta = make_eval_fn(s, is_train)
+    aux = [vals[n] for n in meta["aux_names"]]
+    outs, _ = fn([vals[n] for n in meta["arg_names"]], aux, 0)
+    return [np.asarray(o, np.float32) for o in outs]
+
+
+def _init_vals(s, shapes, rng):
+    arg_shapes, _, aux_shapes = s.infer_shape(**shapes)
+    vals = {}
+    for n, shp in zip(s.list_arguments(), arg_shapes):
+        vals[n] = (rng.randn(*shp) * 0.1).astype(np.float32)
+    for n, shp in zip(s.list_auxiliary_states(), aux_shapes):
+        vals[n] = (np.zeros(shp, np.float32) if "mean" in n
+                   else np.ones(shp, np.float32))
+    return vals
+
+
+# ---------------------------------------------------------- ported passes
+
+
+def test_partition_as_pass_bit_identical():
+    """partition_graph (pass-managed) == _partition_impl, byte for byte
+    in the serialized graph."""
+    base = _mlp()
+    via_pass = partition_graph(base, _FCChainProperty)
+    direct = _partition_impl(base, _FCChainProperty)
+    assert via_pass is not base
+    assert via_pass.tojson() == direct.tojson()
+
+
+def test_partition_preserves_identity_when_no_match():
+    """No region matched -> the input Symbol ITSELF comes back
+    (simple_bind's ``part is not self`` check depends on identity), and
+    the no-op is not re-verified into new errors."""
+    base = _mlp()
+    assert partition_graph(base, _NothingProperty) is base
+
+
+def test_quantize_as_pass_bit_identical():
+    from mxnet_tpu.contrib.quantization import _quantize_impl, quantize_graph
+
+    base = _mlp()
+    via_pass = quantize_graph(base)
+    direct = _quantize_impl(base)
+    assert via_pass.tojson() == direct.tojson()
+    # and the pass-managed output still verifies standalone
+    assert verify_graph(via_pass).ok
+
+
+def test_quantized_forward_unchanged_by_port():
+    """End-to-end: the pass-managed quantized graph computes the same
+    numbers as the direct rewrite (same executor path)."""
+    from mxnet_tpu.contrib.quantization import (_quantize_impl,
+                                                _quantize_params,
+                                                quantize_graph)
+
+    rng = np.random.RandomState(0)
+    base = _mlp()
+    vals = _init_vals(base, {"data": (4, 32)}, rng)
+    nd_args = {k: mx.nd.array(v) for k, v in vals.items()}
+    for q in (quantize_graph(base), _quantize_impl(base)):
+        qargs = _quantize_params(q, nd_args)
+        qvals = {k: v.asnumpy() for k, v in qargs.items()}
+        qvals.setdefault("softmax_label", vals["softmax_label"])
+        out = _forward(q, qvals)
+        np.testing.assert_allclose(out[0], _forward(base, vals)[0],
+                                   atol=0.05)
+
+
+# ------------------------------------------------------------ pass manager
+
+
+def test_pass_refuses_invalid_graph_naming_pass_and_finding():
+    """A rewrite that emits an unknown op is refused; the error names
+    the pass and the offending node — never handed to the executor."""
+
+    def broken(s, ctx):
+        bad = _Node("NoSuchOp", "bad_node", {},
+                    list(s._outputs[0][0].inputs), 1)
+        return Symbol([(bad, 0)])
+
+    p = P.FunctionPass("breaker", broken)
+    with pytest.raises(P.PassError) as ei:
+        p(_mlp(), P.PassContext(input_shapes={"data": (4, 32)}))
+    msg = str(ei.value)
+    assert "breaker" in msg and "bad_node" in msg and "unknown-op" in msg
+
+
+def test_sequential_composes_and_verifies_each_stage():
+    calls = []
+
+    def stage(tag):
+        def fn(s, ctx):
+            calls.append(tag)
+            out = mx.sym.elemwise_add(
+                Symbol([s._outputs[0]]),
+                mx.sym.zeros_like(Symbol([s._outputs[0]])),
+                name="seq_%s" % tag)
+            return out
+        return fn
+
+    pipe = P.sequential([P.FunctionPass("one", stage("one")),
+                         P.FunctionPass("two", stage("two"))])
+    out = pipe(_mlp(), P.PassContext(input_shapes={"data": (4, 32)}))
+    assert calls == ["one", "two"]
+    names = {n.name for n in out._topo_nodes()}
+    assert {"seq_one", "seq_two"} <= names
+    snap = P.pass_stats_snapshot()
+    assert snap["one"]["runs"] >= 1 and snap["two"]["runs"] >= 1
+
+
+def test_verify_can_be_disabled_per_context():
+    """The escape hatch: verify=False hands back even a broken graph
+    (for debugging a pass under development)."""
+
+    def broken(s, ctx):
+        return Symbol([(_Node("NoSuchOp", "bad", {}, [], 1), 0)])
+
+    out = P.FunctionPass("dev", broken)(_mlp(), P.PassContext(verify=False))
+    assert not verify_graph(out).ok  # really is broken
+
+
+def test_pass_stats_flow_into_runtime_stats():
+    """snapshot()["graph_passes"] carries the per-pass record and
+    report() renders the section."""
+    P.reset_pass_stats()
+    partition_graph(_mlp(), _FCChainProperty)
+    snap = runtime_stats.snapshot()
+    stats = snap["graph_passes"]
+    (name,) = [k for k in stats if k.startswith("partition:")]
+    st = stats[name]
+    assert st["runs"] == 1 and st["changed"] == 1
+    assert st["nodes_after"] < st["nodes_before"]  # region collapsed
+    text = runtime_stats.report()
+    assert "Graph passes" in text and name[:24] in text
+
+
+def test_measure_cost_records_flops_bytes_delta():
+    """measure_cost=True: XLA whole-graph flops/bytes land in the pass
+    record, render in report(), and surface as --compare metric rows
+    (kind "graphpass": one-sided presence is a note, not a verdict)."""
+    P.reset_pass_stats()
+    ctx = P.PassContext(input_shapes={"data": (4, 32)}, measure_cost=True)
+    from mxnet_tpu.symbol.amp import AMPPass
+
+    AMPPass()(_mlp(), ctx)
+    st = P.pass_stats_snapshot()["amp"]
+    assert st["flops_before"] and st["flops_after"]
+    assert st["bytes_before"] and st["bytes_after"]
+    # bf16 compute must not inflate the flop count (bytes CAN go up on
+    # a tiny graph, where boundary casts rewrite every weight once)
+    assert st["flops_after"] <= st["flops_before"] * 1.5
+    text = runtime_stats.report()
+    assert "amp" in text and "dFLOPs" in text
+    metrics = runtime_stats._comparable_metrics(
+        runtime_stats.snapshot(), 1e-3)
+    rows = [k for k in metrics if k.startswith("graphpass:amp")]
+    assert rows, metrics.keys()
+    assert all(metrics[k][2] == "graphpass" for k in rows)
+    # one-sided presence lands in notes, never the verdict
+    empty = {"ops": {}, "totals": {}, "counters": {}}
+    res = runtime_stats.compare(empty, {"ops": {}, "totals": {},
+                                        "counters": {},
+                                        "graph_passes":
+                                        P.pass_stats_snapshot()})
+    assert res["verdict"] == "flat"
+    assert any(e["metric"].startswith("graphpass:amp")
+               for e in res["notes"])
+
+
+# ------------------------------------------------------------------- AMP
+
+
+def test_amp_graph_verified_and_bf16_with_f32_islands():
+    base = _convnet()
+    shapes = {"data": (2, 3, 8, 8)}
+    a = amp_convert(base, input_shapes=shapes)
+    assert a is not base
+    assert verify_graph(a, input_shapes=shapes).ok
+    nodes = {n.name: n for n in a._topo_nodes()}
+    by_op = {}
+    for n in a._topo_nodes():
+        by_op.setdefault(n.op, []).append(n)
+    # bf16 casts exist (the sweep happened)
+    bf16_casts = [n for n in by_op.get("Cast", ())
+                  if dict(n.attrs).get("dtype") == "bfloat16"]
+    assert bf16_casts, sorted(nodes)
+    # f32 islands: every BatchNorm/loss-head input that carries compute
+    # arrives through a float32 cast or an untouched f32 producer
+    for n in a._topo_nodes():
+        if n.op in FP32_ISLAND_OPS:
+            for inp, _ in n.inputs:
+                if inp.op == "Cast":
+                    assert dict(inp.attrs)["dtype"] == "float32", \
+                        (n.name, inp.name)
+                else:
+                    assert dict(inp.attrs).get("dtype") != "bfloat16", \
+                        (n.name, inp.name)
+    # graph heads are f32 (optimizer/metric-visible)
+    for hn, _ in a._outputs:
+        assert dict(hn.attrs).get("dtype") != "bfloat16"
+
+
+def test_amp_keeps_master_weights_f32():
+    """Same argument/aux lists, no retyped variables: the optimizer and
+    checkpoints see the identical f32 parameter set."""
+    base = _convnet()
+    a = amp_convert(base, input_shapes={"data": (2, 3, 8, 8)})
+    assert a.list_arguments() == base.list_arguments()
+    assert a.list_auxiliary_states() == base.list_auxiliary_states()
+
+
+def test_amp_loss_parity_vs_f32():
+    """Forward outputs (train and predict mode) match f32 within the
+    documented tolerance."""
+    rng = np.random.RandomState(3)
+    base = _convnet()
+    shapes = {"data": (2, 3, 8, 8)}
+    vals = _init_vals(base, shapes, rng)
+    vals["csoftmax_label"] = rng.randint(0, 6, (2,)).astype(np.float32)
+    a = amp_convert(base, input_shapes=shapes)
+    for is_train in (False, True):
+        ref = _forward(base, vals, is_train)
+        got = _forward(a, vals, is_train)
+        for r, g in zip(ref, got):
+            assert g.dtype == np.float32
+            np.testing.assert_allclose(g, r, atol=AMP_ATOL)
+
+
+def test_amp_excluded_and_integer_inputs_untouched():
+    """Excluded nodes stay f32; integer (Embedding-index) inputs are
+    never cast to bf16."""
+    data = sym.var("data")
+    emb = sym.Embedding(data, input_dim=16, output_dim=8, name="emb")
+    pooled = sym.mean(emb, axis=1, name="poolmean")
+    fc = sym.FullyConnected(pooled, num_hidden=4, name="efc")
+    out = sym.sum(fc, name="esum")
+    a = amp_convert(out, input_shapes={"data": (4, 12)},
+                    input_dtypes={"data": np.int32}, excluded=("efc",))
+    assert verify_graph(a, input_shapes={"data": (4, 12)},
+                        input_dtypes={"data": np.int32}).ok
+    nodes = {n.name: n for n in a._topo_nodes()}
+    # no cast node was inserted on the integer index path
+    emb_node = nodes["emb"]
+    idx_inp = emb_node.inputs[0][0]
+    assert idx_inp.is_variable and idx_inp.name == "data"
+    # excluded fc consumes f32 (its inputs are not bf16 casts)
+    for inp, _ in nodes["efc"].inputs:
+        assert dict(inp.attrs).get("dtype") != "bfloat16", inp.name
+
+
+def test_amp_idempotent():
+    """Running AMP on an already-converted graph changes nothing (the
+    identity contract: the second run returns the input itself)."""
+    base = _mlp()
+    once = amp_convert(base, input_shapes={"data": (4, 32)})
+    twice = amp_convert(once, input_shapes={"data": (4, 32)})
+    assert twice is once

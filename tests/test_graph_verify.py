@@ -1,0 +1,198 @@
+"""Mutation suite for the graph verifier (symbol/verify.py).
+
+Each test seeds one deliberately broken rewrite — the fault classes a
+buggy graph pass can realistically introduce — and asserts the
+verifier catches it with the EXACT offending node named.  Together
+with the zero-false-positive zoo gate (test_lint_clean.py) this pins
+both sides of the verifier's contract: clean graphs verify clean,
+broken graphs fail with an actionable finding.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.symbol.symbol import Symbol, _Node
+from mxnet_tpu.symbol.verify import assert_valid, verify_graph
+
+sym = mx.sym
+
+
+def _var(name):
+    return sym.var(name)._outputs[0][0]
+
+
+def _findings(s, **kw):
+    return verify_graph(s, **kw).findings
+
+
+def _rules_by_node(findings):
+    return {(f.rule, f.node) for f in findings}
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=8, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+# --------------------------------------------------------- the 10 faults
+
+
+def test_catches_cycle():
+    """Fault 1: a rewrite wires an edge backwards, closing a cycle."""
+    a = _Node("elemwise_add", "add_a", {}, [(_var("x"), 0)], 1)
+    b = _Node("elemwise_add", "add_b", {}, [(a, 0)], 1)
+    a.inputs.append((b, 0))  # the broken rewrite
+    findings = _findings(Symbol([(b, 0)]))
+    assert ("cycle", "add_a") in _rules_by_node(findings), findings
+
+
+def test_catches_dangling_input_index():
+    """Fault 2: an input edge references an output slot the producer
+    does not have."""
+    n = _Node("elemwise_add", "adder", {}, [(_var("x"), 5)], 1)
+    findings = _findings(Symbol([(n, 0)]))
+    assert ("dangling-input", "adder") in _rules_by_node(findings)
+
+
+def test_catches_dangling_head_index():
+    """Fault 3: a graph head references a nonexistent output slot."""
+    n = _Node("elemwise_add", "adder", {}, [(_var("x"), 0)], 1)
+    findings = _findings(Symbol([(n, 3)]))
+    assert ("dangling-output", "adder") in _rules_by_node(findings)
+
+
+def test_catches_unknown_op():
+    """Fault 4: a node whose op the registry never registered."""
+    n = _Node("NoSuchOp", "mystery", {}, [(_var("x"), 0)], 1)
+    findings = _findings(Symbol([(n, 0)]))
+    assert ("unknown-op", "mystery") in _rules_by_node(findings)
+
+
+def test_catches_wrong_arity():
+    """Fault 5: a FullyConnected node hand-built with one input, where
+    the OP_INPUT_NAMES row (data, weight, bias) requires three (two
+    under no_bias)."""
+    n = _Node("FullyConnected", "fc_bad", {"num_hidden": 4},
+              [(_var("x"), 0)], 1)
+    findings = _findings(Symbol([(n, 0)]))
+    assert ("arity", "fc_bad") in _rules_by_node(findings)
+    msg = [f for f in findings if f.rule == "arity"][0].message
+    assert "data" in msg and "weight" in msg  # names the expected slots
+
+
+def test_catches_dtype_mismatched_edge():
+    """Fault 6: an int32 weight wired into a Convolution whose data is
+    f32 — XLA refuses mixed conv operand types; the verifier's abstract
+    interpretation reports it at the conv node."""
+    data = sym.var("data")
+    w = sym.var("badweight", dtype=np.int32)
+    b = sym.var("bias")
+    conv = sym.Convolution(data=data, weight=w, bias=b, kernel=(3, 3),
+                           num_filter=4, name="conv_bad")
+    findings = _findings(conv, input_shapes={"data": (1, 3, 8, 8)})
+    assert ("node-eval", "conv_bad") in _rules_by_node(findings)
+
+
+def test_catches_shape_mismatched_edge():
+    """Fault 7: elemwise_add over (2,3) and (4,5) operands — a shape
+    error a rewrite can introduce by rewiring the wrong producer."""
+    a = sym.var("a", shape=(2, 3))
+    b = sym.var("b", shape=(4, 5))
+    bad = mx.sym.elemwise_add(a, b, name="add_bad")
+    findings = _findings(bad)
+    assert ("node-eval", "add_bad") in _rules_by_node(findings)
+
+
+def test_catches_unhashable_attr():
+    """Fault 8: a Python set smuggled into attrs — it survives
+    canonicalization but the jit-cache key cannot hash, silently
+    demoting every call to the eager-trace fallback.  The finding names
+    the exact attr."""
+    n = _Node("FullyConnected", "fc_evil",
+              {"num_hidden": 4, "evil": {1, 2}},
+              [(_var("a"), 0), (_var("w"), 0), (_var("b"), 0)], 1)
+    findings = _findings(Symbol([(n, 0)]))
+    by = _rules_by_node(findings)
+    assert ("unhashable-attr", "fc_evil") in by, findings
+    msg = [f for f in findings if f.rule == "unhashable-attr"][0].message
+    assert "'evil'" in msg
+
+
+def test_catches_duplicate_names():
+    """Fault 9: two distinct nodes sharing one name — argument binding
+    and JSON round-trips key by name, so this corrupts both."""
+    w1 = _Node(None, "w", {}, [], 1)
+    w2 = _Node(None, "w", {}, [], 1)
+    n = _Node("elemwise_add", "adder", {}, [(w1, 0), (w2, 0)], 1)
+    findings = _findings(Symbol([(n, 0)]))
+    assert any(f.rule == "duplicate-name" and f.node == "w"
+               for f in findings), findings
+
+
+def test_catches_num_outputs_overclaim():
+    """Fault 10: a node declaring more outputs than its op produces —
+    downstream consumers of the phantom slots would explode at bind."""
+    n = _Node("FullyConnected", "fc_wide", {"num_hidden": 4},
+              [(_var("a"), 0), (_var("w"), 0), (_var("b"), 0)], 3)
+    findings = _findings(Symbol([(n, 0)]))
+    assert ("num-outputs", "fc_wide") in _rules_by_node(findings)
+
+
+def test_catches_variable_with_inputs():
+    """Bonus fault: a variable node carrying input edges — variables
+    must be leaves; a rewrite that forgets to set ``op`` produces
+    this."""
+    v = _Node(None, "notaleaf", {}, [(_var("x"), 0)], 1)
+    findings = _findings(Symbol([(v, 0)]))
+    assert ("variable-inputs", "notaleaf") in _rules_by_node(findings)
+
+
+# ------------------------------------------------------- finding quality
+
+
+def test_finding_prints_path_to_head():
+    """The offending node's path to a graph head is printed — the
+    debugging breadcrumb the acceptance criteria require."""
+    x = sym.var("x")
+    bad = _Node("NoSuchOp", "deep_bad", {}, [(x._outputs[0][0], 0)], 1)
+    mid = _Node("Activation", "mid_act", {"act_type": "relu"},
+                [(bad, 0)], 1)
+    top = _Node("sum", "head_sum", {}, [(mid, 0)], 1)
+    findings = _findings(Symbol([(top, 0)]))
+    f = [f for f in findings if f.node == "deep_bad"][0]
+    assert "deep_bad" in f.path and "mid_act" in f.path \
+        and "head_sum" in f.path
+    assert "deep_bad" in f.format() and "path" in f.format()
+
+
+def test_assert_valid_raises_with_findings():
+    bad = Symbol([(_Node("NoSuchOp", "mystery", {},
+                         [(_var("x"), 0)], 1), 0)])
+    with pytest.raises(MXNetError, match="mystery"):
+        assert_valid(bad, context="unit-test")
+    # and passes through clean graphs
+    r = assert_valid(_mlp(), input_shapes={"data": (4, 32)})
+    assert r.ok and r.evaluated > 0
+
+
+def test_clean_graph_without_shapes_is_partial_not_failing():
+    """No input shapes: structural + cache-key checks still run; nodes
+    with unknown shapes are reported as skipped, never guessed into
+    false positives."""
+    r = verify_graph(_mlp())
+    assert r.ok
+    assert r.evaluated == 0 and len(r.skipped) == r.nodes
+
+
+def test_loaded_json_graph_verifies_clean():
+    """load_json round-trips (which do NOT canonicalize attrs) must not
+    trip the attr checks — the cache-key rule checks routing and
+    hashability, not canonical form."""
+    s = mx.sym.load_json(_mlp().tojson())
+    r = verify_graph(s, input_shapes={"data": (4, 32)})
+    assert r.ok, [f.format() for f in r.findings]

@@ -270,13 +270,58 @@ def test_env_registry_fully_synced():
         % stale)
 
 
+# --------------------------------------------------- graph verification
+
+
+@functools.lru_cache(maxsize=None)
+def _graph_zoo():
+    """One zoo verification (builders + pass outputs) shared by the
+    graph gate tests; ``seconds`` is the zoo's own wall-time clock so
+    the < 60 s acceptance bound measures the run, not pytest."""
+    from tools.mxlint.graph import verify_zoo
+
+    results, seconds = _timed("graph-zoo", verify_zoo)
+    return results, seconds
+
+
+def test_graph_zoo_verifies_clean():
+    """Every Symbol graph in the zoo — all builder surfaces plus the
+    partition/quantize/AMP pass outputs — verifies with ZERO findings.
+    There is deliberately no baseline for graph findings: builders,
+    passes and verifier are all in-repo, so any finding is a bug in
+    one of them."""
+    from tools.mxlint.graph import collect_findings
+
+    results, _seconds = _graph_zoo()
+    flat = collect_findings(results)
+    assert flat == [], (
+        "graph verifier findings in the model zoo:\n"
+        + "\n".join("%s: %s" % (g, f.format()) for g, f in flat))
+    # the zoo must actually abstract-interpret, not just skip: every
+    # graph got full input shapes, so no node may be left unevaluated
+    for gname, r in results:
+        assert r.evaluated > 0, "%s: nothing traced" % gname
+        assert r.skipped == [], (
+            "%s: nodes skipped for unknown shapes: %s — the zoo must "
+            "seed full input shapes" % (gname, r.skipped))
+
+
+def test_graph_zoo_runtime_budget():
+    """Acceptance bound: the full zoo + pass outputs verify in < 60 s."""
+    _results, seconds = _graph_zoo()
+    assert seconds < 60.0, (
+        "graph zoo verification took %.1fs (>= 60s acceptance bound)"
+        % seconds)
+
+
 def test_lint_and_audit_runtime_budget():
     """The full gate (static lint incl. the interprocedural pass +
-    eval_shape audit + dual-transform audit) must stay cheap enough to
-    ride tier-1 on CPU."""
+    eval_shape audit + dual-transform audit + graph zoo) must stay
+    cheap enough to ride tier-1 on CPU."""
     _run_lint()
     _audit(True)
     _transforms()
+    _graph_zoo()
     total = sum(_TIMINGS.values())
     assert total < _BUDGET_SECONDS, (
         "lint+audit gate took %.1fs (> %.0fs budget): %s — profile the "

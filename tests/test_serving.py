@@ -503,6 +503,63 @@ def test_perfdoctor_serve_bucket_churn():
 # -------------------------------------------------------------- loadgen
 
 
+def _load_loadgen():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    return loadgen
+
+
+def test_trend_doctor_throughput_is_load_aware(tmp_path):
+    """The soak gate's throughput verdict must survive a loaded CI box:
+    the mean-window perf-doctor rule fires on a couple of
+    scheduler-jitter batches, so trend_doctor only keeps it when a
+    median-window recheck over enough samples confirms sustained decay
+    (was the test_loadgen_open_loop_smoke flake)."""
+    from mxnet_tpu import perfdoctor
+
+    loadgen = _load_loadgen()
+    path = str(tmp_path / "soak.jsonl")
+
+    def write(walls):
+        with open(path, "w") as f:
+            for i, w in enumerate(walls):
+                f.write(json.dumps({"step": i, "wall_ms": w}) + "\n")
+
+    # two jitter-slowed batches in a short soak: the raw rule fires,
+    # the confirmation (too few samples; medians flat) drops it
+    jitter = [5.0] * 10 + [55.0, 5.0]
+    write(jitter)
+    raw = perfdoctor.diagnose(
+        timeline=[{"step": i, "wall_ms": w} for i, w in enumerate(jitter)])
+    assert "timeline-throughput" in {f["rule"] for f in raw}
+    assert loadgen.trend_doctor(path) == []  # dropped, NOT None
+    # genuine sustained decay over enough samples stays a finding
+    write([5.0] * 12 + [20.0] * 12)
+    kept = loadgen.trend_doctor(path)
+    assert [f["rule"] for f in kept] == ["timeline-throughput"]
+    # sub-floor micro-batch noise never fires regardless of ratio
+    write([0.5] * 12 + [1.9] * 12)
+    assert loadgen.trend_doctor(path) == []
+
+
+def test_trend_doctor_keeps_leak_findings_unfiltered(tmp_path):
+    """A leak slope is monotonic, not jitter — the load-aware guard
+    must not swallow it even on a short timeline."""
+    loadgen = _load_loadgen()
+    path = str(tmp_path / "leak.jsonl")
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"step": i, "wall_ms": 5.0,
+                                "live_bytes": 1_000_000 + i * 500_000})
+                    + "\n")
+    kept = loadgen.trend_doctor(path)
+    assert [f["rule"] for f in kept] == ["timeline-leak"]
+
+
 def test_loadgen_open_loop_smoke(tmp_path):
     """Open-loop loadgen end-to-end: the server sustains more than the
     serial rate, and at that same offered load its p99 beats the

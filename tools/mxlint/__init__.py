@@ -38,7 +38,9 @@ from .checkers import ALL_RULES, Config, lint_paths, lint_sources  # noqa: F401
 from .findings import (Finding, apply_baseline, fingerprint,  # noqa: F401
                        load_baseline, save_baseline)
 from .cli import DEFAULT_BASELINE, main  # noqa: F401
+from .graph import collect_findings, verify_zoo  # noqa: F401
 
 __all__ = ["ALL_RULES", "Config", "lint_paths", "lint_sources", "Finding",
            "apply_baseline", "fingerprint", "load_baseline",
-           "save_baseline", "DEFAULT_BASELINE", "main"]
+           "save_baseline", "DEFAULT_BASELINE", "main", "verify_zoo",
+           "collect_findings"]

@@ -54,11 +54,20 @@ def build_parser():
                         "baseline entry) for inline PR surfacing")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings the baseline suppressed")
+    p.add_argument("--graph", action="store_true",
+                   help="verify Symbol graphs (model zoo + production "
+                        "pass outputs) with the graph verifier instead "
+                        "of linting source; no baseline — any finding "
+                        "fails")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.graph:
+        from .graph import run_graph_mode
+
+        return run_graph_mode(fmt=args.format)
     rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
     unknown = [r for r in rules if r not in ALL_RULES]
     if unknown:

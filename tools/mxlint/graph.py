@@ -1,0 +1,186 @@
+"""mxlint --graph: verify Symbol DAGs from a curated model zoo.
+
+Source linting (checkers.py) proves the *op implementations* stay
+traceable; this module points the graph verifier
+(``mxnet_tpu.symbol.verify``) at whole Symbol graphs — every builder
+surface the repo exercises (symbol API, multi-output grouping,
+integer-input embedding lookups, random-op key plumbing, gluon
+hybrid traces) plus the output of every production graph pass
+(subgraph partitioning, int8 quantization, AMP).  The zoo is the
+zero-false-positive contract for the graph rules: every entry must
+verify clean, with no baseline — a finding here is a bug in either a
+builder, a pass, or the verifier itself, and all three are ours.
+
+Riding tier-1 via tests/test_lint_clean.py (wall-time budgeted);
+``python -m tools.mxlint --graph`` runs the same zoo from the command
+line with text/json/github output.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def build_zoo():
+    """[(name, symbol, input_shapes, input_dtypes)] — one entry per
+    builder surface worth proving."""
+    import mxnet_tpu as mx
+    import numpy as np
+
+    sym = mx.sym
+    entries = []
+
+    # 1. plain symbol-API MLP (the executor's bread and butter)
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="zoo_fc1")
+    act = sym.Activation(fc1, act_type="relu", name="zoo_relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=8, name="zoo_fc2")
+    mlp = sym.SoftmaxOutput(fc2, name="zoo_softmax")
+    entries.append(("mlp", mlp, {"data": (4, 32)}, {}))
+
+    # 2. convnet: Conv -> BatchNorm (aux state) -> Act -> Pool ->
+    #    Flatten -> FC -> loss head
+    data = sym.var("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="zoo_conv1")
+    bn = sym.BatchNorm(conv, name="zoo_bn1")
+    act = sym.Activation(bn, act_type="relu", name="zoo_crelu")
+    pool = sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="zoo_pool1")
+    flat = sym.Flatten(pool, name="zoo_flat")
+    fc = sym.FullyConnected(flat, num_hidden=10, name="zoo_cfc")
+    convnet = sym.SoftmaxOutput(fc, name="zoo_csoftmax")
+    entries.append(("convnet", convnet, {"data": (2, 3, 8, 8)}, {}))
+
+    # 3. multi-output: SliceChannel fan-out regrouped (out_index
+    #    plumbing through Group)
+    data = sym.var("data")
+    parts = sym.SliceChannel(data, num_outputs=3, axis=1, name="zoo_slice")
+    merged = parts[0] + parts[1] * parts[2]
+    grouped = mx.sym.Group([merged, parts[1]])
+    entries.append(("multi_output", grouped, {"data": (2, 6)}, {}))
+
+    # 4. embedding lookup: int32 indices (canonical-spec dtype hints —
+    #    f32 would be a verifier false positive here)
+    data = sym.var("data")
+    emb = sym.Embedding(data, input_dim=16, output_dim=8, name="zoo_embed")
+    pooled = sym.mean(emb, axis=1, name="zoo_embmean")
+    eout = sym.FullyConnected(pooled, num_hidden=2, name="zoo_efc")
+    entries.append(("embedding", eout, {"data": (4, 12)},
+                    {"data": np.int32}))
+
+    # 5. random ops: Dropout consumes the executor's PRNG key (the
+    #    verifier must prepend the key aval exactly as make_eval_fn
+    #    prepends the key)
+    data = sym.var("data")
+    drop = sym.Dropout(data, p=0.5, name="zoo_drop")
+    rsum = sym.sum(drop, name="zoo_dropsum")
+    entries.append(("dropout", rsum, {"data": (4, 8)}, {}))
+
+    # 6. gluon hybrid trace (the other big Symbol producer)
+    from mxnet_tpu import gluon, nd
+
+    net = gluon.nn.HybridSequential(prefix="zoo_g_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    net(nd.zeros((2, 8)))  # materialize params
+    gsym = net(sym.var("data"))
+    entries.append(("gluon_mlp", gsym, {"data": (2, 8)}, {}))
+
+    return entries
+
+
+def build_pass_outputs(entries):
+    """Run each production pass on a zoo graph and return the outputs
+    as further zoo entries — the pass manager already verified them
+    once; the zoo re-verifies standalone (no pass context) to prove
+    the artifacts hold up under fresh seeds too."""
+    from mxnet_tpu.contrib.quantization import quantize_graph
+    from mxnet_tpu.symbol.amp import amp_convert
+    from mxnet_tpu.symbol.passes import PassContext
+    from mxnet_tpu.symbol.subgraph import (SubgraphProperty,
+                                           SubgraphSelector,
+                                           partition_graph)
+
+    by_name = {name: (s, shapes, dtypes)
+               for name, s, shapes, dtypes in entries}
+    out = []
+
+    class _FCChainSelector(SubgraphSelector):
+        def select(self, node):
+            return node.op == "FullyConnected"
+
+        def select_output(self, cur_node, output_node):
+            return output_node.op == "Activation"
+
+    class _FCChainProperty(SubgraphProperty):
+        def create_selector(self):
+            return _FCChainSelector()
+
+    mlp, mlp_shapes, mlp_dtypes = by_name["mlp"]
+    ctx = PassContext(input_shapes=mlp_shapes, input_dtypes=mlp_dtypes)
+    part = partition_graph(mlp, _FCChainProperty, ctx)
+    out.append(("pass:partition(mlp)", part, mlp_shapes, mlp_dtypes))
+    qsym = quantize_graph(mlp, ctx=ctx)
+    out.append(("pass:quantize(mlp)", qsym, mlp_shapes, mlp_dtypes))
+
+    conv, conv_shapes, conv_dtypes = by_name["convnet"]
+    amp = amp_convert(conv, input_shapes=conv_shapes,
+                      input_dtypes=conv_dtypes)
+    out.append(("pass:amp(convnet)", amp, conv_shapes, conv_dtypes))
+    return out
+
+
+def verify_zoo(include_passes=True):
+    """Verify every zoo graph; returns ``(results, seconds)`` with
+    ``results`` = [(graph name, VerifyResult)]."""
+    from mxnet_tpu.symbol.verify import verify_graph
+
+    t0 = time.perf_counter()
+    entries = build_zoo()
+    if include_passes:
+        entries = entries + build_pass_outputs(entries)
+    results = [(name, verify_graph(s, input_shapes=shapes,
+                                   input_dtypes=dtypes))
+               for name, s, shapes, dtypes in entries]
+    return results, time.perf_counter() - t0
+
+
+def collect_findings(results):
+    """Flatten to [(graph name, GraphFinding)] — no baseline: a graph
+    finding in the zoo is always a bug."""
+    return [(name, f) for name, r in results for f in r.findings]
+
+
+def run_graph_mode(fmt="text"):
+    """CLI entry for ``python -m tools.mxlint --graph``; returns the
+    process exit code (0 clean, 1 findings)."""
+    import json as _json
+
+    from .cli import _gh_msg, _gh_prop
+
+    results, seconds = verify_zoo()
+    flat = collect_findings(results)
+    graphs = len(results)
+    nodes = sum(r.nodes for _, r in results)
+
+    if fmt == "github":
+        for gname, f in flat:
+            print("::error file=tools/mxlint/graph.py,title=%s::%s"
+                  % (_gh_prop("mxlint graph:" + f.rule),
+                     _gh_msg("%s: %s" % (gname, f.format()))))
+    elif fmt == "json":
+        print(_json.dumps({
+            "findings": [dict(f.to_dict(), graph=gname)
+                         for gname, f in flat],
+            "graphs": graphs, "nodes": nodes, "seconds": seconds,
+        }, indent=1))
+        return 1 if flat else 0
+    else:
+        for gname, f in flat:
+            print("%s: %s" % (gname, f.format()))
+    print("mxlint --graph: %d finding(s) over %d graph(s) / %d node(s) "
+          "in %.1fs" % (len(flat), graphs, nodes, seconds))
+    return 1 if flat else 0
