@@ -29,6 +29,11 @@ timeline-kv-drift     restart-rank        park a ``restart_rank``
 serve-queue-dominated serve-tune          nudge ``InferenceServer``
                                           knobs within bounds (workers
                                           up, max-wait up, queue down)
+slo-fast-burn         slo-shed            bounded load-shed: shrink the
+                                          queue bound toward its floor
+                                          and add a worker, so the
+                                          budget burn stops at the
+                                          admission edge (``slo.py``)
 first-nan             halt-after-         checkpoint, then raise
                       checkpoint          :class:`AutopilotHalt`
 ====================  ==================  ==============================
@@ -41,7 +46,8 @@ Safety model (every reflex, no exceptions):
   mxlint's guard-first pass.
 - **per-reflex gate** — each reflex reads its own env
   (``MXNET_TPU_AUTOPILOT_CKPT`` / ``_BUCKET`` / ``_RESTART`` /
-  ``_SERVE`` / ``_HALT``): ``1`` arms the real action, ``0`` silences
+  ``_SERVE`` / ``_SLO`` / ``_HALT``): ``1`` arms the real action,
+  ``0`` silences
   the reflex entirely, *unset* means **dry-run** — the safe default
   when the master switch is on: the reflex evaluates, logs the
   would-be action, and ledgers it, but acts on nothing.
@@ -78,12 +84,13 @@ __all__ = ["enable", "disable", "is_enabled", "reset", "on_step",
 
 # one reflex per doctor rule; GATE_ENVS is the per-reflex arm switch
 REFLEXES = ("force-checkpoint", "pin-bucket", "restart-rank",
-            "serve-tune", "halt-after-checkpoint")
+            "serve-tune", "slo-shed", "halt-after-checkpoint")
 GATE_ENVS = {
     "force-checkpoint": "MXNET_TPU_AUTOPILOT_CKPT",
     "pin-bucket": "MXNET_TPU_AUTOPILOT_BUCKET",
     "restart-rank": "MXNET_TPU_AUTOPILOT_RESTART",
     "serve-tune": "MXNET_TPU_AUTOPILOT_SERVE",
+    "slo-shed": "MXNET_TPU_AUTOPILOT_SLO",
     "halt-after-checkpoint": "MXNET_TPU_AUTOPILOT_HALT",
 }
 
@@ -311,9 +318,13 @@ def _evaluate_serving(server, tick):
     from . import perfdoctor as _doctor
 
     _count_eval()
-    for f in _doctor._check_serving(_doctor.live_dump()):
+    dump = _doctor.live_dump()
+    for f in _doctor._check_serving(dump):
         if f["rule"] == "serve-queue-dominated":
             _reflex_serve(f, server, tick)
+    for f in _doctor._check_slo(dump):
+        if f["rule"] == "slo-fast-burn":
+            _reflex_slo(f, server, tick)
 
 
 # -------------------------------------------------------------- reflexes
@@ -492,6 +503,42 @@ def _reflex_serve(finding, server, tick):
     _consider("serve-tune", finding, tick, act,
               action="nudge serving knobs within bounds (workers up, "
                      "max-wait up, queue bound down)")
+
+
+def _reflex_slo(finding, server, tick):
+    """slo-fast-burn -> bounded load-shed at the admission edge:
+    tighten the queue bound (x0.75 toward SERVE_MIN_QUEUE, so excess
+    load turns into fast explicit rejections instead of slow
+    over-threshold completions that burn the latency budget twice) and
+    add a worker toward SERVE_MAX_WORKERS to raise drain rate.  Both
+    knobs are reversible setters on the live server; ``_consider``
+    supplies the dry-run default, cooldown, cap, and ledger."""
+
+    def act():
+        if server is None:
+            return {"adjusted": {},
+                    "reason": "no server handle at the seam"}
+        changed = {}
+        q = int(server.max_queue)
+        floor = max(int(_cfg["serve_min_queue"]),
+                    int(getattr(server, "max_bucket", 1)))
+        if q > floor:
+            new_q = max(floor, int(q * 0.75))
+            if new_q < q:
+                server.set_max_queue(new_q)
+                changed["max_queue"] = [q, new_q]
+        w = int(server.num_workers)
+        if w < _cfg["serve_max_workers"]:
+            server.set_workers(w + 1)
+            changed["workers"] = [w, w + 1]
+        if not changed:
+            return {"adjusted": {},
+                    "reason": "every knob already at its bound"}
+        return {"adjusted": changed}
+
+    _consider("slo-shed", finding, tick, act,
+              action="shed load at the admission edge (queue bound "
+                     "down toward floor, workers up toward cap)")
 
 
 def _reflex_nan(trainer, step):

@@ -721,24 +721,77 @@ def prometheus_text():
                 for (rule, sev), (_f, score) in sorted(
                     by_labels.items())])
 
+    # SLO objectives: budget remaining + per-window burn rates — an
+    # external alerter pages on the SAME multi-window verdicts the
+    # doctor rules and the slo-shed reflex read.  Snapshot reads only;
+    # an evaluation failure must never fail the scrape.
+    try:
+        from . import slo as _slo
+
+        slo_objs = _slo.snapshot().get("objectives") or []
+    except Exception:
+        slo_objs = []
+    if slo_objs:
+        family("mxnet_tpu_slo_target", "gauge",
+               "Declared SLO target (fraction of good events).",
+               [({"objective": ob["name"]}, ob["target"])
+                for ob in slo_objs])
+        family("mxnet_tpu_slo_budget_remaining", "gauge",
+               "Error budget remaining (1 = untouched, <= 0 = "
+               "exhausted; overall bad-rate over budget).",
+               [({"objective": ob["name"]}, ob["budget_remaining"])
+                for ob in slo_objs])
+        family("mxnet_tpu_slo_bad_total", "counter",
+               "Requests counted against the objective.",
+               [({"objective": ob["name"]}, ob["bad"])
+                for ob in slo_objs])
+        family("mxnet_tpu_slo_good_total", "counter",
+               "Requests inside the objective.",
+               [({"objective": ob["name"]}, ob["good"])
+                for ob in slo_objs])
+        family("mxnet_tpu_slo_burn_rate", "gauge",
+               "Window error rate over budget (burn 1.0 = spending "
+               "exactly the budget; fast pair 5m/1h pages at >= 14.4, "
+               "slow pair 30m/6h at >= 6.0).",
+               [({"objective": ob["name"], "window": label},
+                 (ob["windows"].get(label) or {}).get("burn"))
+                for ob in slo_objs
+                for label, _span in _slo.WINDOWS])
+
     # every latency histogram as one summary family (associative
-    # snapshots — the same numbers report()/cluster_report show)
+    # snapshots — the same numbers report()/cluster_report show).
+    # serve:* p99 rows carry an OpenMetrics-style exemplar naming the
+    # slowest request the x-ray ring retained, so a dashboard can jump
+    # from the quantile straight to one traced request id.
+    try:
+        from . import reqtrace as _reqtrace
+
+        _exemplar = _reqtrace.exemplar()
+    except Exception:
+        _exemplar = None
     rows = []
     for name, h in sorted(list(_histogram._HISTS.items())):
         snap = h.snapshot()
         if not snap["count"]:
             continue
         for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
-            rows.append((name, {"series": name, "quantile": "%g" % q},
+            rows.append((name, key,
+                         {"series": name, "quantile": "%g" % q},
                          snap[key]))
     if rows:
         lines.append("# HELP mxnet_tpu_latency_seconds Latency "
                      "distributions (histogram.py log2 buckets).")
         lines.append("# TYPE mxnet_tpu_latency_seconds summary")
-        for _name, labels, v in rows:
-            lines.append("mxnet_tpu_latency_seconds{%s} %s" % (
+        for name, key, labels, v in rows:
+            suffix = ""
+            if (_exemplar is not None and key == "p99"
+                    and name.startswith("serve")):
+                suffix = ' # {request_id="%s"} %s' % (
+                    _exemplar[0], _prom_num(_exemplar[1]))
+            lines.append("mxnet_tpu_latency_seconds{%s} %s%s" % (
                 ",".join('%s="%s"' % (k, _prom_label(v2))
-                         for k, v2 in labels.items()), _prom_num(v)))
+                         for k, v2 in labels.items()), _prom_num(v),
+                suffix))
         for name, h in sorted(list(_histogram._HISTS.items())):
             if not h.count:
                 continue

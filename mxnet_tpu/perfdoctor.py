@@ -81,6 +81,7 @@ from __future__ import annotations
 
 from . import histogram as _histogram
 from . import runtime_stats as _rts
+from . import slo as _slo
 from . import stepstats as _stepstats
 
 __all__ = ["diagnose", "classify", "render", "render_github",
@@ -788,6 +789,73 @@ def _check_serving(dump):
     return out
 
 
+def _check_slo(dump):
+    """SLO / error-budget findings over the ``slo`` section (the
+    multi-window burn-rate evaluation ``mxnet_tpu/slo.py`` bakes into
+    every snapshot/diag dump):
+
+    - **slo-fast-burn** — an objective's fast window pair (5m/1h,
+      scaled) both burn at >= ``slo.FAST_BURN`` (14.4): at that rate a
+      30-day error budget is gone in hours.  The page-now signal, and
+      the trigger of the ``MXNET_TPU_AUTOPILOT_SLO`` reflex.
+    - **slo-budget-exhausted** — the objective's whole error budget is
+      already spent over the observed run: every further bad event is
+      an SLO violation in the open.
+    """
+    snap = dump.get("snapshot", dump)
+    slo = snap.get("slo") or {}
+    out = []
+    for ob in slo.get("objectives") or []:
+        name = ob.get("name")
+        budget = 1.0 - (ob.get("target") or 0.0)
+        w = ob.get("windows") or {}
+        b5 = (w.get("5m") or {}).get("burn", 0.0)
+        b1h = (w.get("1h") or {}).get("burn", 0.0)
+        rem = ob.get("budget_remaining")
+        if ob.get("fast_burn"):
+            # score 0.5 at the firing threshold, saturating at 2x it —
+            # a fast burn is always at least a warn
+            score = min(1.0, max(b5, b1h) / (2.0 * _slo.FAST_BURN))
+            evidence = [
+                "fast pair burning: 5m burn %.1f (%d event(s)), 1h "
+                "burn %.1f (%d event(s)) — both >= %.1f"
+                % (b5, (w.get("5m") or {}).get("events", 0), b1h,
+                   (w.get("1h") or {}).get("events", 0),
+                   _slo.FAST_BURN),
+                "objective %s: target %.5g%%, budget %.5g%%, %d good /"
+                " %d bad" % (name, (ob.get("target") or 0) * 100,
+                             budget * 100, ob.get("good", 0),
+                             ob.get("bad", 0))]
+            if rem is not None:
+                evidence.append("error budget remaining %.1f%%"
+                                % (rem * 100))
+            out.append(_finding(
+                "slo-fast-burn", score,
+                "SLO %r fast burn: spending error budget at %.1fx the "
+                "sustainable rate" % (name, max(b5, b1h)),
+                "slo:%s" % name, evidence,
+                "act now — shed load (smaller MXNET_TPU_SERVE_QUEUE), "
+                "add capacity, or roll back the last change; the "
+                "MXNET_TPU_AUTOPILOT_SLO reflex can nudge the serving "
+                "knobs (dry-run unless armed; docs/OBSERVABILITY.md "
+                "'Request x-ray & SLOs')"))
+        if rem is not None and rem <= 0.0 \
+                and (ob.get("total") or 0) >= _slo.MIN_EVENTS:
+            out.append(_finding(
+                "slo-budget-exhausted", min(1.0, 0.5 - rem),
+                "SLO %r error budget exhausted (%.1f%% remaining)"
+                % (name, rem * 100),
+                "slo:%s" % name,
+                ["%d bad of %d event(s) vs a %.5g%% budget"
+                 % (ob.get("bad", 0), ob.get("total", 0),
+                    budget * 100)],
+                "the objective is blown for this window — freeze risky "
+                "rollouts, fix the dominant bad-outcome class (see the "
+                "per-outcome breakdown in the serving section / "
+                "diagnose.py --requests), and let the budget recover"))
+    return out
+
+
 # ----------------------------------------------------------- trend rules
 
 
@@ -1121,8 +1189,12 @@ def live_dump(serving=True):
         _serving = _sys.modules.get("mxnet_tpu.serving")
         snap["serving"] = _serving.snapshot() if _serving is not None \
             else {"enabled": False}
+        # the SLO burn verdicts ride the serving-side dump: one guard
+        # read when the layer is off, a bounded ring walk when on
+        snap["slo"] = _slo.snapshot()
     else:
         snap["serving"] = {"enabled": False}
+        snap["slo"] = {"enabled": False}
     return {"snapshot": snap, "recent_storm_keys": storm_keys}
 
 
@@ -1143,6 +1215,7 @@ def live_findings(top=20):
         dump = live_dump()
         findings += _check_recompiles(dump)
         findings += _check_serving(dump)
+        findings += _check_slo(dump)
     except Exception:  # diagnosis must never break the surface it rides
         pass
     findings.sort(key=lambda f: -f["score"])
@@ -1174,6 +1247,7 @@ def diagnose(trace=None, dump=None, timeline=None, top=20):
         findings += _check_xray_zero_collective(dump)
         findings += _check_xray_optimizer(dump)
         findings += _check_serving(dump)
+        findings += _check_slo(dump)
         if timeline is None:
             timeline = dump.get("timeline")
     if isinstance(timeline, dict):

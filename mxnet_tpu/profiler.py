@@ -122,7 +122,7 @@ def _now_us():
 
 
 def add_event(name, cat, ph, ts=None, pid=None, tid=None, args=None,
-              dur=None):
+              dur=None, id=None):
     if not _state["running"]:
         return
     ev = {"name": name, "cat": cat, "ph": ph,
@@ -133,6 +133,12 @@ def add_event(name, cat, ph, ts=None, pid=None, tid=None, args=None,
         ev["args"] = args
     if dur is not None:
         ev["dur"] = dur
+    if id is not None:
+        # flow-event binding ("s"/"t"/"f" sharing one id render as a
+        # single arrowed flow across threads/processes)
+        ev["id"] = id
+        if ph in ("s", "t", "f"):
+            ev["bp"] = "e"
     with _state["lock"]:
         _state["events"].append(ev)
 

@@ -1,0 +1,381 @@
+"""Per-request lifecycle tracing for the serving path (request x-ray).
+
+The serving telemetry built through PR 17 is aggregate-only: the
+``serve:*`` histograms say what the p99 queue wait *is*, but cannot
+answer "why was *this* request slow?" — the question a serving fleet
+is actually operated by.  This module gives every accepted request a
+monotonic id and a compact lifecycle record written at the seams
+``serving.py`` already has (submit → queue → batch-join → staging →
+compute → scatter → done/rejected), carrying the bucket it rode, the
+batch id, pad-row count, queue depth at submit, the worker that served
+it, and the final outcome.
+
+**Tail-based sampling.**  Recording every request at fleet qps would
+drown the ring in healthy traffic, and head-sampling alone would miss
+exactly the requests worth keeping.  So retention is decided at
+*completion*: slow requests (above ``MXNET_TPU_REQTRACE_SLOW_MS``, or
+above ``MXNET_TPU_REQTRACE_P99_MULT`` x the rolling p99 once the
+latency window has warmed up), rejected requests, and NaN-sentinel
+hits are ALWAYS retained; of the healthy rest, a deterministic 1-in-N
+(``rid % N == 0``) survives as the baseline sample.  The same 1-in-N
+head decision — made at submit, because span emission cannot wait for
+the verdict — selects which requests also emit rank-tagged
+chrome-trace spans, linked across the client/batcher/worker threads by
+profiler *flow events* sharing ``id=rid``, so ``tools/diagnose.py
+--merge-traces`` renders one request's journey through the pipeline.
+
+Hot-path contract: callers guard on ``_state["on"]`` before calling a
+feed (one dict read per request when disabled, pinned by
+``test_bench_gate.py``); the feeds themselves are guard-first too
+(mxlint ``DEFAULT_FEEDS``).  Retention math touches host floats only —
+no sampling decision ever syncs a device value.  A request's record is
+written sequentially along its lifecycle (the queue/condvar hand-offs
+give happens-before), so only the ring, the rolling-latency window and
+the outcome counters are shared — all mutated under ``_lock``.
+
+Environment variables
+---------------------
+``MXNET_TPU_REQTRACE``          ``1`` enables from import (via the
+    ``runtime_stats`` activation chain), ``0``/unset leaves it off.
+``MXNET_TPU_REQTRACE_RING``     retained-record ring capacity
+    (default 512).
+``MXNET_TPU_REQTRACE_SAMPLE``   deterministic head-sample modulus N:
+    ``rid % N == 0`` requests are kept and emit trace spans
+    (default 16; ``1`` samples everything).
+``MXNET_TPU_REQTRACE_SLOW_MS``  absolute slow threshold in ms; ``0``
+    (default) defers to the rolling-p99 multiple alone.
+``MXNET_TPU_REQTRACE_P99_MULT`` a completion is slow when its e2e
+    exceeds this multiple of the rolling p99 (default 3.0; needs a
+    warmed 64-sample window).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+from .log import get_logger
+
+__all__ = ["enable", "disable", "is_enabled", "on_submit",
+           "on_submitted", "on_reject", "on_join", "on_exec",
+           "on_done", "snapshot", "exemplar", "reset"]
+
+# window of recent e2e latencies backing the rolling p99 (and the
+# minimum fill before the p99-multiple slow rule may fire)
+WINDOW_CAP = 256
+WINDOW_WARM = 64
+P99_REFRESH = 32  # recompute the cached rolling p99 every N completions
+
+# mxlint: disable=thread-shared-state -- single-key GIL-atomic enable flag; the guard-first contract forbids a lock on the disabled path
+_state = {"on": False, "ring_cap": 512, "sample_n": 16, "slow_ms": 0.0,
+          "p99_mult": 3.0, "p99_ms": None}
+_lock = threading.Lock()
+_RID = itertools.count(1)   # request ids (next() is GIL-atomic)
+_BID = itertools.count(1)   # batch ids, assigned at batch-join
+_RING: deque = deque(maxlen=512)      # retained records, under _lock
+_WINDOW: deque = deque(maxlen=WINDOW_CAP)  # recent e2e ms, under _lock
+_COUNTS: dict = {}                    # outcome -> count, under _lock
+_TOTALS = {"seen": 0, "retained": 0, "dropped": 0}  # under _lock
+
+_logger_cache: list = []
+
+
+def _logger():
+    if not _logger_cache:
+        _logger_cache.append(get_logger("mxnet_tpu.reqtrace"))
+    return _logger_cache[0]
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name) or default)
+    except (TypeError, ValueError):
+        return int(default)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name) or default)
+    except (TypeError, ValueError):
+        return float(default)
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def enable(ring=None, sample=None, slow_ms=None, p99_mult=None):
+    """Turn request tracing on.  Keyword overrides beat the env knobs;
+    the ring is re-sized (existing retained records are kept when the
+    capacity is unchanged)."""
+    global _RING
+    cap = _env_int("MXNET_TPU_REQTRACE_RING", 512) if ring is None \
+        else int(ring)
+    cap = max(1, cap)
+    n = _env_int("MXNET_TPU_REQTRACE_SAMPLE", 16) if sample is None \
+        else int(sample)
+    n = max(1, n)
+    slow = _env_float("MXNET_TPU_REQTRACE_SLOW_MS", 0.0) \
+        if slow_ms is None else float(slow_ms)
+    mult = _env_float("MXNET_TPU_REQTRACE_P99_MULT", 3.0) \
+        if p99_mult is None else float(p99_mult)
+    with _lock:
+        if cap != _RING.maxlen:
+            _RING = deque(_RING, maxlen=cap)
+        _state["ring_cap"] = cap
+        _state["sample_n"] = n
+        _state["slow_ms"] = slow
+        _state["p99_mult"] = mult
+    _state["on"] = True
+
+
+def disable():
+    """Stop recording (retained records are kept; ``reset()`` drops
+    them)."""
+    _state["on"] = False
+
+
+def is_enabled():
+    return _state["on"]
+
+
+def reset():
+    """Disable and drop every record, counter and the id counters —
+    a fixed workload replayed after ``reset()`` retains the identical
+    rid set (the tail-sampling determinism contract, pinned in
+    tests)."""
+    global _RID, _BID
+    _state["on"] = False
+    with _lock:
+        _RING.clear()
+        _WINDOW.clear()
+        _COUNTS.clear()
+        _TOTALS["seen"] = 0
+        _TOTALS["retained"] = 0
+        _TOTALS["dropped"] = 0
+        _state["p99_ms"] = None
+    _RID = itertools.count(1)
+    _BID = itertools.count(1)
+
+
+# ------------------------------------------------------------ trace feeds
+
+
+def _flow(ph, rid, ts=None):
+    """Emit one chrome-trace flow event bound to ``id=rid`` on the
+    calling thread (phases ``s``/``t``/``f`` with one id render as a
+    single arrowed flow across threads in the trace viewer)."""
+    from . import profiler as _profiler
+
+    if not _profiler._state["running"]:
+        return
+    _profiler.add_event("request", cat="req", ph=ph, ts=ts, id=rid)
+
+
+def _span(name, rid, dur_s, ts_end_us=None):
+    """Emit a completed ``X`` span of ``dur_s`` seconds ending now (or
+    at ``ts_end_us``) on the calling thread."""
+    from . import profiler as _profiler
+
+    if not _profiler._state["running"]:
+        return
+    dur_us = max(0.0, dur_s * 1e6)
+    end = _profiler._now_us() if ts_end_us is None else ts_end_us
+    _profiler.add_event(name, cat="req", ph="X", ts=end - dur_us,
+                        dur=dur_us, args={"rid": rid})
+
+
+def on_submit(req, depth):
+    """Submit seam: assign the request id, open its lifecycle record
+    (queue depth observed at submit), and make the deterministic head
+    decision.  Runs on the client thread, before the batcher can see
+    the request (the caller holds the server condvar), so every later
+    seam finds ``req.trace`` set.  Deliberately touches NOTHING beyond
+    the request object — the profiler must never be entered under the
+    server condvar; :func:`on_submitted` emits the flow start after
+    the caller releases it."""
+    if not _state["on"]:
+        return
+    rid = next(_RID)
+    head = (rid % _state["sample_n"] == 0)
+    req.rid = rid
+    req.trace = {"rid": rid, "n": req.n, "queue_depth": depth,
+                 "head": head, "t_submit": req.t_submit,
+                 "bucket": None, "batch": None, "worker": None,
+                 "pad_rows": None, "outcome": None}
+
+
+def on_submitted(req):
+    """Flow-span tail of the submit seam — called on the client thread
+    AFTER the server condvar is released (the profiler takes its own
+    lock, and nesting it under the condvar would couple the two)."""
+    if not _state["on"]:
+        return
+    tr = getattr(req, "trace", None)
+    if tr is not None and tr["head"]:
+        _flow("s", tr["rid"])
+
+
+def on_reject(kind, n=0):
+    """Rejection at the front door (queue-full / shape): the request
+    never enters the pipeline, but it must not vanish from accounting —
+    record a degenerate always-retained lifecycle with the reject kind
+    as its outcome."""
+    if not _state["on"]:
+        return
+    rid = next(_RID)
+    rec = {"rid": rid, "n": n, "queue_depth": None, "head": False,
+           "bucket": None, "batch": None, "worker": None,
+           "pad_rows": None, "outcome": kind, "retained": kind,
+           "e2e_ms": 0.0, "queue_ms": None, "stage_ms": None,
+           "compute_ms": None, "scatter_ms": None}
+    with _lock:
+        _TOTALS["seen"] += 1
+        _TOTALS["retained"] += 1
+        _COUNTS[kind] = _COUNTS.get(kind, 0) + 1
+        _RING.append(rec)
+
+
+def on_join(reqs, bucket):
+    """Batch-join seam (batcher thread): stamp the bucket and a fresh
+    batch id on every member, emit the queue-wait span + flow step for
+    head-sampled members."""
+    if not _state["on"]:
+        return
+    bid = next(_BID)
+    for r in reqs:
+        tr = getattr(r, "trace", None)
+        if tr is None:
+            continue
+        tr["bucket"] = bucket
+        tr["batch"] = bid
+        tr["t_batched"] = r.t_batched
+        if tr["head"]:
+            _span("req:queue", tr["rid"], r.t_batched - tr["t_submit"])
+            _flow("t", tr["rid"])
+
+
+def on_exec(reqs, worker, pad_rows, t_staged, t_compute):
+    """Execution seam (worker thread, once per batch after the fetch
+    host-sync): stamp the worker, the batch's pad-row count and the
+    staging/compute boundary times on every member's record."""
+    if not _state["on"]:
+        return
+    for r in reqs:
+        tr = getattr(r, "trace", None)
+        if tr is None:
+            continue
+        tr["worker"] = worker
+        tr["pad_rows"] = pad_rows
+        tr["t_staged"] = t_staged
+        tr["t_compute"] = t_compute
+
+
+def on_done(req, outcome, t_done=None):
+    """Completion seam (worker thread): finalize the record — derive
+    the per-seam millisecond ladder, make the tail retention decision
+    (always keep non-``ok`` outcomes and slow completions, else the
+    deterministic head sample), and close the flow for head-sampled
+    requests."""
+    if not _state["on"]:
+        return
+    tr = getattr(req, "trace", None)
+    if tr is None:
+        return
+    now = time.perf_counter() if t_done is None else t_done
+    t_submit = tr.pop("t_submit")
+    t_batched = tr.pop("t_batched", None)
+    t_staged = tr.pop("t_staged", None)
+    t_compute = tr.pop("t_compute", None)
+    e2e_ms = (now - t_submit) * 1e3
+    tr["e2e_ms"] = e2e_ms
+    tr["queue_ms"] = None if t_batched is None \
+        else (t_batched - t_submit) * 1e3
+    tr["stage_ms"] = None if t_staged is None or t_batched is None \
+        else (t_staged - t_batched) * 1e3
+    tr["compute_ms"] = None if t_compute is None or t_staged is None \
+        else (t_compute - t_staged) * 1e3
+    tr["scatter_ms"] = None if t_compute is None \
+        else (now - t_compute) * 1e3
+    tr["outcome"] = outcome
+    slow_ms = _state["slow_ms"]
+    mult = _state["p99_mult"]
+    with _lock:
+        _TOTALS["seen"] += 1
+        _COUNTS[outcome] = _COUNTS.get(outcome, 0) + 1
+        _WINDOW.append(e2e_ms)
+        if _state["p99_ms"] is None \
+                or _TOTALS["seen"] % P99_REFRESH == 0:
+            w = sorted(_WINDOW)
+            _state["p99_ms"] = w[min(len(w) - 1,
+                                     int(len(w) * 0.99))]
+        p99 = _state["p99_ms"]
+        why = None
+        if outcome != "ok":
+            why = outcome
+        elif slow_ms and e2e_ms >= slow_ms:
+            why = "slow"
+        elif p99 is not None and len(_WINDOW) >= WINDOW_WARM \
+                and e2e_ms >= mult * p99:
+            why = "slow"
+        elif tr["head"]:
+            why = "head"
+        if why is None:
+            _TOTALS["dropped"] += 1
+        else:
+            tr["retained"] = why
+            _TOTALS["retained"] += 1
+            _RING.append(tr)
+    if tr["head"]:
+        # spans/flows outside _lock: the profiler takes its own lock
+        if t_batched is not None:
+            _span("req:exec", tr["rid"], now - t_batched)
+        _flow("f", tr["rid"])
+
+
+# ------------------------------------------------------------- snapshots
+
+
+def snapshot():
+    """JSON-ready view: sampling config, totals, per-outcome counts,
+    the rolling p99 and every retained record (oldest first)."""
+    with _lock:
+        ring = [dict(r) for r in _RING]
+        counts = dict(_COUNTS)
+        totals = dict(_TOTALS)
+        p99 = _state["p99_ms"]
+    if not _state["on"] and not totals["seen"]:
+        return {"enabled": False}
+    return {"enabled": _state["on"], "ring_cap": _state["ring_cap"],
+            "sample_n": _state["sample_n"],
+            "slow_ms": _state["slow_ms"],
+            "p99_mult": _state["p99_mult"], "rolling_p99_ms": p99,
+            "seen": totals["seen"], "retained": totals["retained"],
+            "dropped": totals["dropped"], "by_outcome": counts,
+            "ring": ring}
+
+
+def exemplar():
+    """``(rid, e2e_seconds)`` of the slowest retained completion — the
+    exemplar the ``serve:*`` Prometheus summaries attach — or None."""
+    with _lock:
+        worst = None
+        for r in _RING:
+            e2e = r.get("e2e_ms")
+            if e2e and (worst is None or e2e > worst["e2e_ms"]):
+                worst = r
+    if worst is None:
+        return None
+    return (worst["rid"], worst["e2e_ms"] / 1e3)
+
+
+def _activate_from_env():
+    """Import-time arming — called by ``runtime_stats`` once its module
+    globals exist (before the autopilot, which must arm last)."""
+    flag = os.environ.get("MXNET_TPU_REQTRACE")
+    if not flag or flag == "0":
+        return False
+    enable()
+    return True

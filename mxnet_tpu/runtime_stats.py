@@ -407,6 +407,8 @@ def snapshot():
             "stepstats": _stepstats.snapshot(),
             "serving": _serving.snapshot() if _serving is not None
             else {"enabled": False},
+            "requests": _reqtrace.snapshot(),
+            "slo": _slo.snapshot(),
             "identity": process_identity()}
 
 
@@ -500,6 +502,12 @@ def _render(snap, top=None):
     if serving.get("enabled"):
         lines.extend(_render_serving(serving,
                                      snap.get("histograms") or {}))
+    requests = snap.get("requests") or {}
+    if requests.get("enabled") or requests.get("seen"):
+        lines.extend(_render_requests(requests))
+    slo_sec = snap.get("slo") or {}
+    if slo_sec.get("enabled") or slo_sec.get("objectives"):
+        lines.extend(_render_slo(slo_sec))
     ap = snap.get("autopilot") or {}
     if ap.get("enabled") or ap.get("entries"):
         lines.extend(_render_autopilot(ap))
@@ -695,6 +703,12 @@ def _render_serving(serving, hists):
                  "%d padded row(s) total"
                  % (rej.get("queue", 0), rej.get("nonfinite", 0),
                     rej.get("shape", 0), serving.get("padded_rows", 0)))
+    outcomes = serving.get("outcomes") or {}
+    if any(outcomes.values()):
+        lines.append("outcomes: " + ", ".join(
+            "%s=%d" % (k, outcomes.get(k, 0))
+            for k in ("ok", "rejected_queue", "rejected_shape",
+                      "rejected_nonfinite", "error")))
     per_bucket = serving.get("per_bucket") or {}
     if per_bucket:
         lines.append("%-10s %9s %9s %10s %10s"
@@ -720,6 +734,95 @@ def _render_serving(serving, hists):
     if not lat:
         lines.append("(no serve:* latency series — histograms were off "
                      "during the run)")
+    return lines
+
+
+def _fmt_msv(v):
+    """Format an already-in-milliseconds value (reqtrace records)."""
+    return "-" if v is None else "%.2f" % v
+
+
+def _render_requests(req):
+    """The "Request x-ray" section of ``report()`` / diag-dump
+    rendering and of ``tools/diagnose.py --requests``: sampling
+    config + totals, per-outcome counts, and the slowest retained
+    lifecycle records (seam-by-seam ms ladder)."""
+    lines = ["", "Request x-ray (tail-sampled lifecycle ring)"]
+    lines.append("%d request(s) seen: %d retained, %d dropped "
+                 "(head 1-in-%d; slow >= %s, p99 x%g, rolling p99 %s)"
+                 % (req.get("seen", 0), req.get("retained", 0),
+                    req.get("dropped", 0), req.get("sample_n", 1),
+                    ("%gms" % req["slow_ms"]) if req.get("slow_ms")
+                    else "p99-rule only",
+                    req.get("p99_mult", 0),
+                    _fmt_msv(req.get("rolling_p99_ms")) + "ms"
+                    if req.get("rolling_p99_ms") is not None else "-"))
+    by = req.get("by_outcome") or {}
+    if by:
+        lines.append("outcomes: " + ", ".join(
+            "%s=%d" % (k, by[k]) for k in sorted(by)))
+    ring = req.get("ring") or []
+    worst = sorted((r for r in ring if r.get("e2e_ms") is not None),
+                   key=lambda r: -r["e2e_ms"])[:8]
+    if not worst:
+        lines.append("(lifecycle ring empty)")
+        return lines
+    lines.append("%-8s %-22s %6s %6s %4s %9s %9s %9s"
+                 % ("Rid", "Outcome[kept]", "Bucket", "Batch", "Pad",
+                    "Queue ms", "Comp ms", "E2e ms"))
+    for r in worst:
+        kept = r.get("retained")
+        oc = str(r.get("outcome"))
+        if kept and kept != oc:
+            oc = "%s[%s]" % (oc, kept)
+        lines.append("%-8s %-22s %6s %6s %4s %9s %9s %9s"
+                     % (r.get("rid"), oc[:22],
+                        r.get("bucket") if r.get("bucket") is not None
+                        else "-",
+                        r.get("batch") if r.get("batch") is not None
+                        else "-",
+                        r.get("pad_rows")
+                        if r.get("pad_rows") is not None else "-",
+                        _fmt_msv(r.get("queue_ms")),
+                        _fmt_msv(r.get("compute_ms")),
+                        _fmt_msv(r.get("e2e_ms"))))
+    return lines
+
+
+def _render_slo(slo):
+    """The "SLO / error budgets" section of ``report()`` / diag-dump
+    rendering and of ``tools/diagnose.py --slo``: per-objective
+    good/bad totals, remaining error budget, and the multi-window burn
+    rates the ``slo-fast-burn`` / ``slo-budget-exhausted`` doctor
+    rules fire on."""
+    lines = ["", "SLO / error budgets (multi-window burn rates)"]
+    objs = slo.get("objectives") or []
+    if not objs:
+        lines.append("(no objectives — declare via "
+                     "MXNET_TPU_SLO=name:25ms:99.9)")
+        return lines
+    scale = slo.get("window_scale", 1.0)
+    if scale != 1.0:
+        lines.append("(window scale %g — spans compressed)" % scale)
+    for ob in objs:
+        thr = "" if ob.get("threshold_ms") is None \
+            else " < %gms" % ob["threshold_ms"]
+        flag = " ** FAST BURN **" if ob.get("fast_burn") \
+            else (" * slow burn *" if ob.get("slow_burn") else "")
+        rem = ob.get("budget_remaining")
+        lines.append("%s (%s%s @ %.5g%%): %d good / %d bad; error "
+                     "budget remaining %s%s"
+                     % (ob.get("name"), ob.get("kind"), thr,
+                        (ob.get("target") or 0.0) * 100,
+                        ob.get("good", 0), ob.get("bad", 0),
+                        "-" if rem is None else "%.1f%%" % (rem * 100),
+                        flag))
+        w = ob.get("windows") or {}
+        if w:
+            lines.append("  burn: " + "  ".join(
+                "%s=%.2f (%d ev)" % (lab, w[lab].get("burn", 0.0),
+                                     w[lab].get("events", 0))
+                for lab in ("5m", "1h", "30m", "6h") if lab in w))
     return lines
 
 
@@ -827,6 +930,8 @@ def reset():
     are pure counters and reset with everything else."""
     from . import autopilot as _autopilot
     from . import metrics_timeline as _metrics_timeline
+    from . import reqtrace as _reqtrace
+    from . import slo as _slo
     from .log import reset_rate_limits
 
     _PER_OP.clear()
@@ -835,8 +940,11 @@ def reset():
     _histogram.reset()
     _stepstats.reset()
     _metrics_timeline.reset()
+    _reqtrace.reset()
+    _slo.reset()
     _autopilot.reset()
     reset_rate_limits("recompile-storm:")
+    reset_rate_limits("slo:")
 
 
 # ------------------------------------------------------ diagnostic dump
@@ -1015,6 +1123,14 @@ from . import xray as _xray  # noqa: E402
 
 _xray._activate_from_env()
 _stackdump._activate_from_env()
+# the request x-ray (MXNET_TPU_REQTRACE) and the SLO / error-budget
+# layer (MXNET_TPU_SLO) arm before the autopilot below: its SLO reflex
+# reads the burn verdicts these produce
+from . import reqtrace as _reqtrace  # noqa: E402
+from . import slo as _slo  # noqa: E402
+
+_reqtrace._activate_from_env()
+_slo._activate_from_env()
 # the observability autopilot (MXNET_TPU_AUTOPILOT=1) arms last: its
 # reflexes read every layer raised above
 from . import autopilot as _autopilot  # noqa: E402
@@ -1304,6 +1420,17 @@ def _comparable_metrics(dump, min_seconds):
     qps = serving.get("qps")
     if qps:
         out["serving:ms_per_sample"] = (1e3 / qps, "ms", "serving")
+    # SLO error budget, oriented up-is-worse as the BURNED fraction
+    # (100% = budget exhausted).  kind "slo" shares the one-sided rule
+    # with "zero"/"xray": an objective declared on only one side is a
+    # config change — a note, never a perf verdict.
+    for ob in ((snap.get("slo") or {}).get("objectives")) or []:
+        if not ob.get("total"):
+            continue
+        rem = ob.get("budget_remaining")
+        burned = 1.0 - (rem if rem is not None else 1.0)
+        out["slo:%s budget_burned" % ob.get("name")] = (
+            burned * 100.0, "%", "slo")
     return out
 
 
@@ -1349,7 +1476,7 @@ def compare(a, b, threshold=0.2, min_seconds=1e-3):
         ratio = (after / before) if before > 0.0 else float("inf")
         entry = {"metric": metric, "kind": kind, "unit": unit,
                  "before": before, "after": after, "ratio": ratio}
-        if kind in ("zero", "xray", "graphpass") \
+        if kind in ("zero", "xray", "graphpass", "slo") \
                 and (va is None or vb is None):
             # collective-bytes counters, x-ray scopes or graph-pass
             # costs existing on only one side mean the two runs used
@@ -1402,6 +1529,8 @@ def render_compare(result):
     for e in result.get("notes", []):
         why = ("the traced model/step structure differs between the "
                "dumps" if e.get("kind") == "xray" else
+               "the declared SLO objectives differ between the dumps"
+               if e.get("kind") == "slo" else
                "sharding topology differs between the dumps")
         lines.append("  note: %s present %s (%.3f -> %.3f %s) — %s"
                      % (e["metric"], e.get("side", "one-sided"),

@@ -34,7 +34,12 @@ millions-of-users north star.  This module is the serving layer:
   a non-finite row is a rate-limited warning + a rejected response +
   a flight record, never a silent bad payload;
 - :meth:`InferenceServer.stop` drains the queue before the workers
-  exit, so shutdown never drops an accepted request.
+  exit, so shutdown never drops an accepted request;
+- request-grain observability rides the same seams guard-first: the
+  ``reqtrace`` lifecycle ring (tail-sampled per-request records +
+  chrome-trace flow events) and the ``slo`` error-budget counters —
+  one dict read per seam when disabled (docs/OBSERVABILITY.md
+  "Request x-ray & SLOs").
 
 Bench: ``tools/loadgen.py`` (open-loop Poisson arrivals, p50/p99/p99.9
 vs offered QPS, serial-`Predictor.forward` baseline) — also reachable
@@ -76,7 +81,9 @@ from . import autopilot as _autopilot
 from . import device_memory as _dm
 from . import health as _health
 from . import histogram as _histogram
+from . import reqtrace as _reqtrace
 from . import runtime_stats as _rts
+from . import slo as _slo
 from .log import get_logger, rank_suffix_path, warn_rate_limited
 
 __all__ = ["InferenceServer", "RequestRejected", "ServerStopped",
@@ -166,7 +173,11 @@ class _Request:
     cost low at high request rates."""
 
     __slots__ = ("inputs", "n", "t_submit", "t_batched", "t_done",
-                 "_done", "_event", "_outputs", "_error")
+                 "_done", "_event", "_outputs", "_error",
+                 # request x-ray (reqtrace.py): id + lifecycle record,
+                 # set only while tracing is on — readers use getattr,
+                 # so the disabled path never touches these slots
+                 "rid", "trace")
 
     def __init__(self, inputs, n):
         self.inputs = inputs
@@ -417,6 +428,7 @@ class InferenceServer:
         self.stats = {"requests": 0, "samples": 0, "batches": 0,
                       "padded_rows": 0, "rejected_queue": 0,
                       "rejected_nonfinite": 0, "rejected_shape": 0,
+                      "completed": 0, "errors": 0,
                       "bucket_compiles": 0, "knob_adjusts": 0,
                       "per_bucket": {b: {"batches": 0, "samples": 0}
                                      for b in self.buckets},
@@ -529,16 +541,27 @@ class InferenceServer:
                 raise RequestRejected("server is not accepting requests"
                                       " (stopped)")
             if self._queued_samples + n > self.max_queue:
-                self._count_reject("rejected_queue")
+                self._count_reject("rejected_queue", n)
                 raise RequestRejected(
                     "queue full (%d queued samples, max %d) — backpressure;"
                     " retry or add capacity" % (self._queued_samples,
                                                self.max_queue))
+            depth = self._queued_samples
             self._queue.append(req)
             self._queued_samples += n
+            # request x-ray: open the lifecycle record while still
+            # holding _cond, so the batcher can never see a traced
+            # request before its record exists.  Disabled: 1 dict read.
+            if _reqtrace._state["on"]:
+                _reqtrace.on_submit(req, depth)
             # one waiter on this condition in steady state (the
             # batcher) — notify() keeps the submit hot path cheap
             self._cond.notify()
+        # flow-span tail of the submit seam, OUTSIDE _cond: the
+        # profiler takes its own lock and must never nest under the
+        # server condvar
+        if _reqtrace._state["on"]:
+            _reqtrace.on_submitted(req)
         return req
 
     def infer(self, inputs, timeout=60.0):
@@ -587,11 +610,20 @@ class InferenceServer:
                 % (n, self.max_bucket))
         return named
 
-    def _count_reject(self, kind):
+    def _count_reject(self, kind, n=0):
         with self._stats_lock:
             self.stats[kind] += 1
         _rts.inc("serve_rejected")
         _rts.inc("serve_" + kind)
+        # front-door rejects (queue/shape) never enter the pipeline —
+        # record them as explicit lifecycle outcomes and SLO bad events
+        # here; nonfinite rejections carry a full record and reach both
+        # layers through _reject_nonfinite instead
+        if kind != "rejected_nonfinite":
+            if _reqtrace._state["on"]:
+                _reqtrace.on_reject(kind, n)
+            if _slo._state["on"]:
+                _slo.on_request(None, False)
 
     # ------------------------------------------------------------ batching
     def _bucket_for(self, n):
@@ -628,6 +660,10 @@ class InferenceServer:
             now = time.perf_counter()
             for r in picked:
                 r.t_batched = now
+            # batch-join seam: stamp bucket/batch-id, flow-step the
+            # head-sampled members.  Disabled: one dict read per batch.
+            if _reqtrace._state["on"]:
+                _reqtrace.on_join(picked, bucket)
             with self._batch_cond:
                 # bounded pipeline: at most one staged batch per worker
                 # beyond what is executing, so accepted requests stay in
@@ -686,11 +722,21 @@ class InferenceServer:
             try:
                 self._serve_batch(picked, total, bucket)
             except Exception as e:  # a bad batch must not kill the pool
+                failed = 0
                 for r in picked:
                     if not r.done():
                         r._fail(RequestRejected(
                             "batch execution failed: %s: %s"
                             % (type(e).__name__, e)))
+                        failed += 1
+                        if _reqtrace._state["on"]:
+                            _reqtrace.on_done(r, "error", r.t_done)
+                        if _slo._state["on"]:
+                            _slo.on_request(
+                                (r.t_done - r.t_submit) * 1e3, False)
+                if failed:
+                    with self._stats_lock:
+                        self.stats["errors"] += failed
                 warn_rate_limited(
                     _logger(), "serving:batch-error", WARN_INTERVAL,
                     "serving batch failed (%s: %s) — %d request(s) "
@@ -706,6 +752,8 @@ class InferenceServer:
     def _serve_batch(self, picked, total, bucket):
         t0 = time.perf_counter()
         hist_on = _histogram._state["on"]
+        rt_on = _reqtrace._state["on"]
+        slo_on = _slo._state["on"]
         if hist_on:
             for r in picked:
                 _histogram.observe("serve:queue_wait",
@@ -726,14 +774,21 @@ class InferenceServer:
                 buf[off:] = 0  # the pad rows (masked out of the scatter)
             bytes_in += buf.nbytes
             inputs[name] = _device_put(buf)
+        t_staged = time.perf_counter() if rt_on else None
         # device compute (async dispatch on real backends) …
         outs = self._bucket_fn(bucket)(inputs)
         # … then the one host-sync: the result scatter's batched fetch
         host_outs = _fetch(outs)
         t1 = time.perf_counter()
+        if rt_on:
+            # execution seam: worker/pad/staging/compute stamps, once
+            # per batch (host floats only — the fetch already synced)
+            _reqtrace.on_exec(picked, threading.current_thread().name,
+                              bucket - total, t_staged, t1)
         bad_rows = self._sentinel(host_outs, total)
         bytes_out = sum(int(o.nbytes) for o in host_outs)
         off = 0
+        completed = 0
         for r in picked:
             rows = slice(off, off + r.n)
             off += r.n
@@ -741,6 +796,14 @@ class InferenceServer:
                 self._reject_nonfinite(r, bucket)
                 continue
             r._complete([np.asarray(o[rows]) for o in host_outs])
+            completed += 1
+            if rt_on:
+                _reqtrace.on_done(r, "ok", r.t_done)
+            if slo_on:
+                _slo.on_request((r.t_done - r.t_submit) * 1e3, True)
+        if completed:
+            with self._stats_lock:
+                self.stats["completed"] += completed
         if hist_on:
             _histogram.observe("serve:batch", t1 - t0)
             _histogram.observe("serve:batch:b%d" % bucket, t1 - t0)
@@ -795,6 +858,12 @@ class InferenceServer:
             "sample(s)) — response rejected, not returned.  Check the "
             "model's numerics (docs/SERVING.md 'Output sentinels').",
             bucket, req.n)
+        # sentinel hits are always-retained lifecycle outcomes and SLO
+        # bad events (the request DID consume pipeline capacity)
+        if _reqtrace._state["on"]:
+            _reqtrace.on_done(req, "rejected_nonfinite", req.t_done)
+        if _slo._state["on"]:
+            _slo.on_request((req.t_done - req.t_submit) * 1e3, False)
 
     def _account_batch(self, picked, total, bucket, t0, t1,
                        bytes_in, bytes_out):
@@ -980,6 +1049,14 @@ class InferenceServer:
                "rejected": {"queue": s["rejected_queue"],
                             "nonfinite": s["rejected_nonfinite"],
                             "shape": s["rejected_shape"]},
+               # per-outcome breakdown: every request a client ever
+               # handed us lands in exactly one of these buckets
+               "outcomes": {"ok": s["completed"],
+                            "rejected_queue": s["rejected_queue"],
+                            "rejected_shape": s["rejected_shape"],
+                            "rejected_nonfinite":
+                                s["rejected_nonfinite"],
+                            "error": s["errors"]},
                "per_bucket": {str(b): v for b, v in per_bucket.items()
                               if v["batches"]},
                "qps": qps,

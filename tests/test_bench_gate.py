@@ -689,3 +689,75 @@ def test_disabled_autopilot_overhead_bound():
         "disabled on_step must not even tick its clock"
     assert runtime_stats.snapshot()["counters"].get(
         "autopilot_evals", 0) == base_evals
+
+
+def test_disabled_reqtrace_overhead_bound():
+    """PR 20 gate: the request x-ray must be pay-for-use.  With tracing
+    disabled (the default), every lifecycle feed — ``on_submit`` /
+    ``on_submitted`` / ``on_join`` / ``on_exec`` / ``on_done`` — is ONE
+    dict read: no id assignment, no record, no ring append, no profiler
+    touch.  Pinned like the other disabled-path bounds."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import reqtrace
+
+    flag = os.environ.get("MXNET_TPU_REQTRACE")
+    if flag and flag != "0":
+        pytest.skip("request tracing force-enabled in this run")
+    assert not reqtrace.is_enabled()
+    before = reqtrace.snapshot()
+
+    class _Req:
+        pass
+
+    req = _Req()
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            reqtrace.on_submit(req, 0)
+            reqtrace.on_submitted(req)
+            reqtrace.on_done(req, "ok")
+        best = min(best, (time.perf_counter() - t0) / (3 * n_calls))
+    # the guard is one dict read (~0.1us); 10us tolerates slow shared
+    # CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "reqtrace seam with tracing off took %.2fus" % (best * 1e6)
+    assert not hasattr(req, "trace"), \
+        "disabled on_submit must not touch the request"
+    assert reqtrace.snapshot() == before, \
+        "disabled seams must record nothing"
+
+
+def test_disabled_slo_overhead_bound():
+    """PR 20 gate: SLO accounting must be pay-for-use.  With no
+    objective declared (the default), ``slo.on_request`` — one call per
+    finished request on the serving path — is ONE dict read: no clock,
+    no lock, no event append.  Pinned like the other disabled-path
+    bounds."""
+    import time
+
+    import pytest
+
+    from mxnet_tpu import slo
+
+    if os.environ.get("MXNET_TPU_SLO"):
+        pytest.skip("SLO objectives force-enabled in this run")
+    assert not slo.is_enabled()
+
+    n_calls = 1000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            slo.on_request(1.0, True)
+        best = min(best, (time.perf_counter() - t0) / n_calls)
+    # the guard is one dict read (~0.1us); 10us tolerates slow shared
+    # CI while catching any real disabled-path work
+    assert best < 1e-5, \
+        "slo.on_request with no objective took %.2fus" % (best * 1e6)
+    assert slo.snapshot() == {"enabled": False}, \
+        "disabled accounting must record nothing"

@@ -49,6 +49,19 @@ def parse_args():
                         'bucket occupancy, rejection counts, serve:* latency '
                         'percentiles) from a MXNET_TPU_DIAG dump (--diag / '
                         '$MXNET_TPU_DIAG) or from this live process.')
+    p.add_argument('--requests', action='store_true',
+                   help='Render only the request x-ray section (the tail-'
+                        'sampled per-request lifecycle ring: every slow / '
+                        'rejected / NaN-sentinel request with its seam-by-'
+                        'seam timings) from a MXNET_TPU_DIAG dump (--diag / '
+                        '$MXNET_TPU_DIAG) or from this live process.')
+    p.add_argument('--slo', action='store_true',
+                   help='Render only the SLO / error-budget section (per-'
+                        'objective good/bad counts, budget remaining, multi-'
+                        'window burn rates) plus any slo-fast-burn / slo-'
+                        'budget-exhausted doctor findings, from a '
+                        'MXNET_TPU_DIAG dump (--diag / $MXNET_TPU_DIAG) or '
+                        'from this live process.')
     p.add_argument('--xray', action='store_true',
                    help='Render only the fused-step x-ray tables (per-scope '
                         'flops/bytes attribution inside the compiled whole-'
@@ -239,6 +252,79 @@ def check_serving(diag_path=None):
         return 2
     print('\n'.join(runtime_stats._render_serving(
         serving, snap.get('histograms') or {})))
+    return 0
+
+
+def check_requests(diag_path=None):
+    """Request x-ray view: the tail-sampled per-request lifecycle ring
+    (every slow / rejected / NaN-sentinel request with its seam-by-seam
+    timings) of a MXNET_TPU_DIAG dump, or of this live process when no
+    dump is given (docs/OBSERVABILITY.md "Request x-ray & SLOs").
+    Returns 0, or 2 when no request was ever traced — a soak drill
+    asserting on this view must not silently pass on an empty
+    section."""
+    _section('Request X-ray')
+    import json
+    from mxnet_tpu import runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        with open(diag_path) as f:
+            data = json.load(f)
+        snap = data.get('snapshot', data)
+    else:
+        if diag_path:
+            print('Diag dump    : %s (not written yet)' % diag_path)
+        snap = runtime_stats.snapshot()
+    req = snap.get('requests') or {}
+    if not (req.get('enabled') or req.get('seen')):
+        print('(no request x-ray in this %s — enable per-request '
+              'tracing with MXNET_TPU_REQTRACE=1 and run traffic '
+              'through an InferenceServer; docs/OBSERVABILITY.md '
+              '"Request x-ray & SLOs")'
+              % ('dump' if diag_path else 'process'))
+        return 2
+    print('\n'.join(runtime_stats._render_requests(req)).lstrip('\n'))
+    return 0
+
+
+def check_slo(diag_path=None):
+    """SLO view: per-objective good/bad counts, error-budget remaining,
+    and multi-window burn rates of a MXNET_TPU_DIAG dump, or of this
+    live process when no dump is given, plus any slo-fast-burn /
+    slo-budget-exhausted doctor findings rendered with their window
+    evidence (docs/OBSERVABILITY.md "Request x-ray & SLOs").  Returns
+    0, or 2 when no objective was ever declared — an SLO drill
+    asserting on this view must not silently pass on an empty
+    section."""
+    _section('SLO / Error Budgets')
+    import json
+    from mxnet_tpu import perfdoctor, runtime_stats
+    runtime_stats._DIAG_STATE['armed'] = False
+    diag_path = diag_path or os.environ.get('MXNET_TPU_DIAG')
+    if diag_path and os.path.exists(diag_path):
+        print('Diag dump    :', os.path.abspath(diag_path))
+        with open(diag_path) as f:
+            data = json.load(f)
+        snap = data.get('snapshot', data)
+    else:
+        if diag_path:
+            print('Diag dump    : %s (not written yet)' % diag_path)
+        snap = runtime_stats.snapshot()
+    slo_sec = snap.get('slo') or {}
+    if not (slo_sec.get('enabled') or slo_sec.get('objectives')):
+        print('(no SLO objectives in this %s — declare them with '
+              'MXNET_TPU_SLO=name:25ms:99.9 and run traffic through '
+              'an InferenceServer; docs/OBSERVABILITY.md "Request '
+              'x-ray & SLOs")'
+              % ('dump' if diag_path else 'process'))
+        return 2
+    print('\n'.join(runtime_stats._render_slo(slo_sec)).lstrip('\n'))
+    findings = perfdoctor._check_slo({'snapshot': snap})
+    if findings:
+        print()
+        print(perfdoctor.render(findings))
     return 0
 
 
@@ -540,6 +626,12 @@ def main():
     if args.serving:
         # focused serving view: skip the platform sections
         sys.exit(check_serving(args.diag))
+    if args.requests:
+        # focused request-lifecycle view: skip the platform sections
+        sys.exit(check_requests(args.diag))
+    if args.slo:
+        # focused error-budget view: skip the platform sections
+        sys.exit(check_slo(args.diag))
     if args.xray:
         # focused fused-step attribution view: skip the platform sections
         sys.exit(check_xray(args.diag))

@@ -89,6 +89,53 @@ def _latency_summary(lat_s):
             "mean_ms": sum(ordered) / len(ordered) * 1e3}
 
 
+# fixed quantile ladder for the per-request CDF — enough points to
+# chart the tail shape, few enough to stay a one-line JSON object
+CDF_QUANTILES = (10.0, 25.0, 50.0, 75.0, 90.0, 95.0, 99.0, 99.9)
+
+
+def _latency_cdf(lat_s):
+    """Per-request latency CDF (ms) at fixed quantiles plus the max —
+    a tail chart needs more than three points, and the request x-ray's
+    slow-tail triage starts from exactly this curve."""
+    if not lat_s:
+        return None
+    ordered = sorted(lat_s)
+
+    def pick(q):
+        idx = min(len(ordered) - 1,
+                  int(round(q / 100.0 * (len(ordered) - 1))))
+        return ordered[idx] * 1e3
+
+    cdf = {"p%g" % q: pick(q) for q in CDF_QUANTILES}
+    cdf["max"] = ordered[-1] * 1e3
+    return cdf
+
+
+def slo_verdict():
+    """Per-objective verdict from the live ``mxnet_tpu.slo`` counters
+    (they saw every request the sweep pushed through the server):
+    achieved good fraction vs target, budget burned.  None when no
+    objective is declared (``MXNET_TPU_SLO`` unset)."""
+    from mxnet_tpu import slo
+
+    objs = slo.snapshot().get("objectives") or []
+    if not objs:
+        return None
+    out = []
+    for ob in objs:
+        total = ob["total"]
+        achieved = (ob["good"] / total) if total else None
+        out.append({"objective": ob["name"], "kind": ob["kind"],
+                    "threshold_ms": ob["threshold_ms"],
+                    "target": ob["target"], "events": total,
+                    "achieved": achieved,
+                    "budget_burned": 1.0 - ob["budget_remaining"],
+                    "met": bool(achieved is not None
+                                and achieved >= ob["target"])})
+    return out
+
+
 def serial_baseline(pred, sample_shape, sizes=DEFAULT_SIZES,
                     n_requests=200, seed=0):
     """One-at-a-time ``Predictor.forward``: the pre-serving deployment
@@ -173,6 +220,7 @@ def run_open_loop(server, qps, duration, sample_shape,
            "achieved_qps": len(lat) / span,
            "sustained": len(lat) / span >= SUSTAIN_FRACTION * qps}
     out.update(_latency_summary(lat))
+    out["cdf_ms"] = _latency_cdf(lat)
     return out
 
 
@@ -354,6 +402,9 @@ def sweep(qps_levels=None, duration=2.0, sizes=DEFAULT_SIZES,
         else None,
         "trend_doctor_findings": doctor,
         "soak_clean": (not doctor) if doctor is not None else None,
+        # per-objective SLO verdict over EVERY request of the sweep
+        # (declared via MXNET_TPU_SLO; None when no objective is on)
+        "slo": slo_verdict(),
     }
     return report
 
@@ -391,6 +442,16 @@ def main(argv=None):
         metrics_path=args.metrics, workers=args.workers,
         seed=args.seed)
     print(json.dumps(report))
+    # human-readable SLO verdict lines ride stderr: stdout stays the
+    # one-JSON-report contract bench.py and CI parsers rely on
+    for v in report.get("slo") or []:
+        ach = ("%.4f%%" % (v["achieved"] * 100.0)
+               if v["achieved"] is not None else "n/a")
+        print("SLO %s (%s): target %.4f%%, achieved %s over %d "
+              "requests, budget burned %.1f%% -> %s"
+              % (v["objective"], v["kind"], v["target"] * 100.0, ach,
+                 v["events"], v["budget_burned"] * 100.0,
+                 "met" if v["met"] else "MISSED"), file=sys.stderr)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)

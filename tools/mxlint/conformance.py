@@ -59,6 +59,13 @@ DEFAULT_FEEDS = (
     ("mxnet_tpu.device_memory", "track", "_state"),
     ("mxnet_tpu.autopilot", "on_step", "_state"),
     ("mxnet_tpu.autopilot", "on_serve", "_state"),
+    ("mxnet_tpu.reqtrace", "on_submit", "_state"),
+    ("mxnet_tpu.reqtrace", "on_submitted", "_state"),
+    ("mxnet_tpu.reqtrace", "on_reject", "_state"),
+    ("mxnet_tpu.reqtrace", "on_join", "_state"),
+    ("mxnet_tpu.reqtrace", "on_exec", "_state"),
+    ("mxnet_tpu.reqtrace", "on_done", "_state"),
+    ("mxnet_tpu.slo", "on_request", "_state"),
 )
 
 _ENV_RE = re.compile(r"\b(?:MXNET_TPU|MXTPU)_[A-Z0-9_]+\b")
